@@ -1,0 +1,58 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+qingshui/Paddle (PaddlePaddle fluid), built on JAX/XLA/Pallas from scratch.
+
+Architecture (vs the reference, see SURVEY.md):
+  fluid IR (Program/Block/Op)  ->  kept, Python IR + per-op JAX lowering rules
+  Executor per-op dispatch     ->  whole-block XLA compile (fluid/executor.py)
+  CUDA kernels (operators/)    ->  jnp/lax lowerings + Pallas hot kernels
+  GradOpMaker per op           ->  one generic jax.vjp grad (fluid/backward.py)
+  NCCL rings (collective/)     ->  mesh axes + ICI collectives (parallel/)
+  ParallelExecutor SSA graph   ->  pjit/GSPMD sharding (fluid/compiler.py)
+  BuddyAllocator/GC            ->  XLA HBM + buffer donation
+"""
+__version__ = "0.1.0"
+
+from . import fluid
+from .fluid import (CPUPlace, TPUPlace, CUDAPlace, Executor, Program,
+                    program_guard, default_main_program,
+                    default_startup_program, ParamAttr, set_flags, get_flags,
+                    in_dygraph_mode)
+from .fluid.framework import Variable
+from .fluid.reader import batch, shuffle
+from .fluid import layers as _fl_layers
+
+from . import nn
+from . import tensor
+from .tensor import *  # noqa: F401,F403
+from . import optimizer
+from . import metric
+from . import vision
+from . import text
+from . import amp
+from . import distributed
+from . import static
+from . import inference
+from .hapi import Model
+from .dygraph.base import to_variable, no_grad
+from .dygraph import save_dygraph as save, load_dygraph as load
+from .dygraph.base import enable_dygraph as disable_static
+from .dygraph.base import disable_dygraph as enable_static
+
+import jax as _jax
+
+
+def set_device(device: str):
+    return device
+
+
+def get_device():
+    d = _jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False

@@ -1,0 +1,9 @@
+"""paddle.amp: bf16-first mixed precision (reference python/paddle/amp/ +
+fluid/contrib/mixed_precision).  On TPU the fast dtype is bfloat16 whose
+dynamic range matches fp32 — loss scaling is therefore optional (GradScaler
+defaults to a no-op identity scale but keeps the dynamic-scaling machinery
+for fp16 parity)."""
+from .auto_cast import auto_cast, amp_guard
+from .grad_scaler import GradScaler, AmpScaler
+from .lists import WHITE_OPS, BLACK_OPS
+from .static_amp import decorate

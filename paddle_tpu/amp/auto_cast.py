@@ -1,0 +1,33 @@
+"""auto_cast context (reference python/paddle/amp/auto_cast.py:20 over
+imperative/amp_auto_cast.cc tracer autocast)."""
+from __future__ import annotations
+
+import contextlib
+
+from ..fluid.framework import _dygraph_tracer, default_main_program
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              dtype="bfloat16"):
+    tracer = _dygraph_tracer()
+    if tracer is not None:
+        prev = tracer._amp_enabled
+        tracer._amp_enabled = enable
+        tracer._amp_dtype = dtype
+        try:
+            yield
+        finally:
+            tracer._amp_enabled = prev
+    else:
+        prog = default_main_program()
+        prev = prog._amp_enabled
+        prog._amp_enabled = enable
+        prog._amp_dtype = dtype
+        try:
+            yield
+        finally:
+            prog._amp_enabled = prev
+
+
+amp_guard = auto_cast

@@ -1,0 +1,82 @@
+"""GradScaler (reference fluid/dygraph/amp/loss_scaler.py AmpScaler:27).
+bf16 needs no loss scaling (same exponent range as fp32); the dynamic
+scaling state machine is kept for fp16-parity and API compatibility."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameters:
+            if p._grad is not None:
+                g = p._grad * inv
+                found = found or not bool(jnp.all(jnp.isfinite(g)))
+                p._grad = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_scale(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good = state["good"]
+        self._bad = state["bad"]
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,14 @@
+"""AMP op lists (reference python/paddle/fluid/contrib/mixed_precision/
+fp16_lists.py:28-39 black/white lists, adapted bf16-first for TPU MXU)."""
+WHITE_OPS = {
+    "matmul", "matmul_v2", "mul", "bmm", "conv2d", "depthwise_conv2d",
+    "conv2d_transpose", "conv3d", "fc", "fused_multihead_attention",
+}
+BLACK_OPS = {
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "batch_norm", "sync_batch_norm", "group_norm",
+    "instance_norm", "reduce_mean", "reduce_sum", "mean", "sum", "exp",
+    "log", "rsqrt", "sqrt", "square", "sigmoid_cross_entropy_with_logits",
+    "cumsum", "p_norm", "l2_normalize", "softplus",
+}
+# everything else: gray — runs in whatever dtype arrives

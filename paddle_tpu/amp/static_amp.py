@@ -1,0 +1,146 @@
+"""Static-graph AMP: program rewrite to bf16.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/decorator.py
+`decorate:253` + fp16_utils.py rewrite the program per black/white op lists
+and add dynamic loss-scaling ops.  TPU-native: the rewrite inserts cast ops
+around white-list ops (matmul/conv run in bf16 on the MXU, reductions and
+norms stay fp32); loss scaling defaults OFF for bf16 (same exponent range as
+fp32) and the check_finite_and_unscale/update_loss_scaling op pair is used
+only when use_dynamic_loss_scaling is requested.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..fluid.framework import Program, Variable
+from ..fluid import layers
+from .lists import WHITE_OPS, BLACK_OPS
+
+
+class CustomOpLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(WHITE_OPS) | set(custom_white_list or ())
+        self.black_list = set(BLACK_OPS) | set(custom_black_list or ())
+
+
+AutoMixedPrecisionLists = CustomOpLists
+
+
+def rewrite_program_bf16(program: Program, amp_lists: CustomOpLists = None,
+                         dtype: str = "bfloat16"):
+    """Insert casts so white-list ops consume `dtype` inputs.  The param
+    master copies stay fp32; the cast pairs fold into XLA fusions."""
+    amp_lists = amp_lists or CustomOpLists()
+    block = program.global_block()
+    new_ops = []
+    cast_cache = {}
+
+    def cast_in(name, to):
+        key = (name, to)
+        if key in cast_cache:
+            return cast_cache[key], None
+        out = f"{name}@CAST_{to}"
+        block.create_var(name=out, dtype=to, stop_gradient=True)
+        op = block.append_op("cast", inputs={"X": [name]},
+                             outputs={"Out": [out]},
+                             attrs={"out_dtype": to})
+        block.ops.pop()      # re-positioned into new_ops below
+        cast_cache[key] = out
+        return out, op
+
+    for op in list(block.ops):
+        if op.type in amp_lists.white_list:
+            for slot, names in op.inputs.items():
+                new_names = []
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.dtype in ("float32", None):
+                        out, cop = cast_in(n, dtype)
+                        if cop is not None:
+                            new_ops.append(cop)
+                        new_names.append(out)
+                    else:
+                        new_names.append(n)
+                op.inputs[slot] = new_names
+        new_ops.append(op)
+    block.ops = new_ops
+    program._amp_enabled = True
+    program._amp_dtype = dtype
+    return program
+
+
+class OptimizerWithMixedPrecision:
+    """decorator.py:30 analog: wraps an optimizer; backward() rewrites the
+    program to bf16 and optionally adds loss scaling."""
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=1.0,
+                 use_dynamic_loss_scaling=False, dtype="bfloat16"):
+        self._optimizer = optimizer
+        self._amp_lists = amp_lists or CustomOpLists()
+        self._init_scale = init_loss_scaling
+        self._dynamic = use_dynamic_loss_scaling
+        self._dtype = dtype
+        self._loss_scaling = None
+
+    def get_loss_scaling(self):
+        return self._loss_scaling
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        rewrite_program_bf16(program, self._amp_lists, self._dtype)
+
+        scaled_loss = loss
+        if self._init_scale != 1.0 or self._dynamic:
+            self._loss_scaling = layers.create_global_var(
+                [1], self._init_scale, "float32", persistable=True,
+                name="loss_scaling")
+            scaled_loss = layers.elementwise_mul(loss, self._loss_scaling)
+
+        params_grads = self._optimizer.backward(
+            scaled_loss, startup_program, parameter_list, no_grad_set)
+
+        if self._loss_scaling is not None:
+            grads = [g for _, g in params_grads]
+            from ..fluid.layer_helper import LayerHelper
+            helper = LayerHelper("check_finite_and_unscale")
+            found_inf = helper.create_variable_for_type_inference(
+                dtype="bool", stop_gradient=True)
+            helper.append_op(
+                "check_finite_and_unscale",
+                inputs={"X": grads, "Scale": [self._loss_scaling]},
+                outputs={"Out": grads, "FoundInfinite": [found_inf]})
+            if self._dynamic:
+                good = layers.create_global_var([1], 0, "int32",
+                                                persistable=True,
+                                                name="good_steps")
+                bad = layers.create_global_var([1], 0, "int32",
+                                               persistable=True,
+                                               name="bad_steps")
+                helper.append_op(
+                    "update_loss_scaling",
+                    inputs={"X": grads, "FoundInfinite": [found_inf],
+                            "PrevLossScaling": [self._loss_scaling],
+                            "InGoodSteps": [good], "InBadSteps": [bad]},
+                    outputs={"Out": grads,
+                             "LossScaling": [self._loss_scaling],
+                             "OutGoodSteps": [good], "OutBadSteps": [bad]},
+                    attrs={})
+        ops = self._optimizer.apply_gradients(params_grads)
+        return ops, params_grads
+
+    def backward(self, loss, **kw):
+        return self._optimizer.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=False, dtype="bfloat16"):
+    """contrib.mixed_precision.decorate analog (bf16 defaults)."""
+    return OptimizerWithMixedPrecision(
+        optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
+        dtype)

@@ -1,0 +1,1 @@
+"""paddle.distributed analog: fleet, launch, collectives over process mesh."""

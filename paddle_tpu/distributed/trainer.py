@@ -1,0 +1,29 @@
+"""Trainer/Dataset path: exe.train_from_dataset analog.
+
+Reference: framework/trainer.h MultiTrainer/DistMultiTrainer +
+device_worker.h HogwildWorker (loop hogwild_worker.cc:194-214), driven by
+Executor::RunFromDataset (executor.cc:166).  TPU-native: XLA serialises the
+chip, so multi-threaded Hogwild workers become a single prefetching loop
+feeding the compiled step; the parallelism the reference got from threads
+comes from async dispatch + the input pipeline instead.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_from_dataset(executor, program, dataset, fetch_list=None,
+                     print_period=100, train=True):
+    fetch_list = fetch_list or []
+    fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
+    step = 0
+    results = []
+    for feed in dataset._iter_batches():
+        outs = executor.run(program, feed=feed, fetch_list=fetch_names)
+        if fetch_names and step % print_period == 0:
+            vals = {n: np.asarray(o).reshape(-1)[:4]
+                    for n, o in zip(fetch_names, outs)}
+            print(f"[trainer] step {step}: {vals}")
+            results.append(outs)
+        step += 1
+    return results

@@ -1,0 +1,11 @@
+"""Dygraph (eager imperative) mode — reference paddle/fluid/imperative/ +
+python/paddle/fluid/dygraph/."""
+from .base import (VarBase, ParamBase, Tracer, guard, enable_dygraph,
+                   disable_dygraph, to_variable, no_grad)
+from .layers import Layer, Sequential, LayerList, ParameterList
+from . import nn
+from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
+                 Dropout, GRUUnit, PRelu)
+from .parallel import DataParallel, ParallelEnv, prepare_context
+from .checkpoint import save_dygraph, load_dygraph
+from .jit import TracedLayer, declarative

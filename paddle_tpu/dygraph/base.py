@@ -1,0 +1,389 @@
+"""Dygraph core: eager tensors + tape autograd on jax.Arrays.
+
+Reference: paddle/fluid/imperative/ — VarBase (layer.h:65) holds the tensor +
+grad var; Tracer::TraceOp (tracer.cc:59) runs the kernel eagerly and records
+a grad-op node; BasicEngine::Execute (basic_engine.cc:184) walks the tape in
+reverse with dep counting and a GradientAccumulator for fan-in.  TPU-native:
+the "kernel" is the op's JAX lowering executed eagerly (each call is an XLA
+executable cached by jit), and the grad node is the SAME generic-vjp used by
+static mode (fluid/backward.py) — one AD implementation for both modes.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..fluid.framework import (convert_dtype, unique_name, _set_dygraph_tracer,
+                               _dygraph_tracer)
+from ..ops.registry import get_op, LoweringContext
+
+
+class VarBase:
+    """Eager tensor (imperative/layer.h:65 analog)."""
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        self._value = value if isinstance(value, jax.Array) else jnp.asarray(value)
+        self.name = name or unique_name("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self._grad: Optional[jax.Array] = None
+
+    # --- data access -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return str(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def value(self):
+        return self._value
+
+    def detach(self):
+        return VarBase(self._value, stop_gradient=True)
+
+    def clone(self):
+        return VarBase(self._value, stop_gradient=self.stop_gradient)
+
+    def astype(self, dtype):
+        return VarBase(self._value.astype(convert_dtype(dtype)),
+                       stop_gradient=self.stop_gradient)
+
+    # --- autograd ----------------------------------------------------------
+    @property
+    def grad(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def gradient_var(self):
+        return self._grad
+
+    def gradient(self):
+        return self.grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        tracer = _dygraph_tracer()
+        if tracer is None:
+            raise RuntimeError("backward() outside dygraph guard")
+        seed = (grad_tensor._value if isinstance(grad_tensor, VarBase)
+                else jnp.ones_like(self._value))
+        tracer.engine_execute(self, seed, retain_graph=retain_graph)
+
+    # --- operators ---------------------------------------------------------
+    def _binary(self, op_type, other, reverse=False):
+        tracer = _dygraph_tracer()
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, dtype=self._value.dtype),
+                            stop_gradient=True)
+        x, y = (other, self) if reverse else (self, other)
+        out = tracer.trace_op(op_type, {"X": [x], "Y": [y]},
+                              {"Out": [None]}, {"axis": -1})
+        return out["Out"][0]
+
+    def __add__(self, o): return self._binary("elementwise_add", o)
+    def __radd__(self, o): return self._binary("elementwise_add", o, True)
+    def __sub__(self, o): return self._binary("elementwise_sub", o)
+    def __rsub__(self, o): return self._binary("elementwise_sub", o, True)
+    def __mul__(self, o): return self._binary("elementwise_mul", o)
+    def __rmul__(self, o): return self._binary("elementwise_mul", o, True)
+    def __truediv__(self, o): return self._binary("elementwise_div", o)
+    def __rtruediv__(self, o): return self._binary("elementwise_div", o, True)
+    def __pow__(self, o): return self._binary("elementwise_pow", o)
+    def __matmul__(self, o):
+        return _dygraph_tracer().trace_op(
+            "matmul", {"X": [self], "Y": [o]}, {"Out": [None]}, {})["Out"][0]
+
+    def __neg__(self):
+        return _dygraph_tracer().trace_op(
+            "scale", {"X": [self]}, {"Out": [None]},
+            {"scale": -1.0})["Out"][0]
+
+    def __getitem__(self, idx):
+        return VarBase(self._value[idx],
+                       stop_gradient=self.stop_gradient)
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __float__(self):
+        return float(np.asarray(self._value).reshape(()))
+
+    def reshape(self, shape):
+        return _dygraph_tracer().trace_op(
+            "reshape", {"X": [self]}, {"Out": [None]},
+            {"shape": list(shape)})["Out"][0]
+
+    def set_value(self, value):
+        self._value = jnp.asarray(value)
+
+    def __repr__(self):
+        return (f"VarBase(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype}, stop_gradient={self.stop_gradient})\n"
+                f"{np.asarray(self._value)}")
+
+
+class ParamBase(VarBase):
+    def __init__(self, value, name=None, trainable=True, regularizer=None,
+                 need_clip=True):
+        super().__init__(value, name=name, stop_gradient=not trainable,
+                         persistable=True)
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.is_distributed = False
+
+
+class _TapeEntry:
+    __slots__ = ("op_type", "ins", "outs", "attrs")
+
+    def __init__(self, op_type, ins, outs, attrs):
+        self.op_type = op_type
+        self.ins = ins          # slot -> [VarBase]
+        self.outs = outs        # slot -> [VarBase]
+        self.attrs = attrs
+
+
+class Tracer:
+    """imperative/tracer.cc analog: eager dispatch + tape recording."""
+
+    def __init__(self):
+        self._tape: List[_TapeEntry] = []
+        self._no_grad = False
+        self._train_mode = True
+        self._key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        self._key_ctr = 0
+        self._amp_enabled = False
+        self._amp_dtype = "bfloat16"
+
+    # -- RNG ---------------------------------------------------------------
+    def next_key(self):
+        self._key_ctr += 1
+        return jax.random.fold_in(self._key, self._key_ctr)
+
+    def _ctx(self):
+        return LoweringContext(base_key=self.next_key(),
+                               is_test=not self._train_mode)
+
+    # -- op dispatch ---------------------------------------------------------
+    def trace_op(self, op_type, inputs, outputs, attrs=None):
+        attrs = dict(attrs or {})
+        opdef = get_op(op_type)
+        ins_vb: Dict[str, List[VarBase]] = {}
+        for slot, vals in (inputs or {}).items():
+            if vals is None:
+                continue
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            ins_vb[slot] = [v for v in vals if v is not None]
+        if self._amp_enabled:
+            ins_vb = self._autocast(op_type, ins_vb)
+        ins_arr = {s: [v._value for v in vs] for s, vs in ins_vb.items()}
+        if opdef.stateful_rng and "op_seed" not in attrs:
+            attrs["op_seed"] = int(np.random.randint(0, 2**31 - 1))
+        outs_arr = opdef.fn(ins_arr, attrs, self._ctx())
+
+        outs_vb: Dict[str, List[VarBase]] = {}
+        requires = (not self._no_grad and opdef.differentiable and any(
+            not v.stop_gradient for vs in ins_vb.values() for v in vs))
+        for slot, arrs in outs_arr.items():
+            outs_vb[slot] = [
+                VarBase(a, stop_gradient=not requires) for a in arrs]
+        if requires:
+            self._tape.append(_TapeEntry(op_type, ins_vb, outs_vb, attrs))
+        return outs_vb
+
+    def _autocast(self, op_type, ins_vb):
+        """imperative/amp_auto_cast.cc analog: cast matmul/conv inputs to
+        bf16, keep norms/softmax in fp32."""
+        from ..amp.lists import WHITE_OPS
+        if op_type not in WHITE_OPS:
+            return ins_vb
+        lo = jnp.dtype(self._amp_dtype)
+        out = {}
+        for s, vs in ins_vb.items():
+            nvs = []
+            for v in vs:
+                if v._value.dtype == jnp.float32:
+                    nv = VarBase(v._value.astype(lo),
+                                 stop_gradient=v.stop_gradient)
+                    nv._src = v   # keep grad flowing to the fp32 master
+                    nvs.append(nv)
+                else:
+                    nvs.append(v)
+            out[s] = nvs
+        return out
+
+    # -- parameters ---------------------------------------------------------
+    def create_parameter(self, name, shape, dtype, initializer,
+                         trainable=True, regularizer=None, need_clip=True):
+        value = materialize_initializer(initializer, shape, dtype,
+                                        self.next_key())
+        return ParamBase(value, name=name, trainable=trainable,
+                         regularizer=regularizer, need_clip=need_clip)
+
+    # -- backward engine (BasicEngine::Execute analog) -----------------------
+    def engine_execute(self, loss: VarBase, seed_grad, retain_graph=False):
+        from ..fluid.backward import _generic_grad
+        grads: Dict[int, jax.Array] = {id(loss): seed_grad}
+        var_by_id: Dict[int, VarBase] = {id(loss): loss}
+
+        for entry in reversed(self._tape):
+            out_has_grad = any(id(v) in grads
+                               for vs in entry.outs.values() for v in vs)
+            if not out_has_grad:
+                continue
+            opdef = get_op(entry.op_type)
+            grad_slots = [s for s, vs in entry.ins.items()
+                          if s not in opdef.nondiff_inputs
+                          and any(not v.stop_gradient for v in vs)]
+            if not grad_slots:
+                continue
+            g_ins = {("I_" + s): [v._value for v in vs]
+                     for s, vs in entry.ins.items()}
+            for s, vs in entry.outs.items():
+                if s in opdef.nondiff_outputs:
+                    continue
+                gvals = [grads.get(id(v)) for v in vs]
+                if any(g is not None for g in gvals):
+                    g_ins["G_" + s] = [
+                        g if g is not None else jnp.zeros_like(v._value)
+                        for g, v in zip(gvals, vs)]
+            attrs = {"fwd_type": entry.op_type, "fwd_attrs": entry.attrs,
+                     "in_slots": list(entry.ins.keys()),
+                     "grad_slots": grad_slots}
+            result = _generic_grad(g_ins, attrs, self._ctx())
+            for s in grad_slots:
+                for v, g in zip(entry.ins[s], result.get("GI_" + s, [])):
+                    if v.stop_gradient or g is None:
+                        continue
+                    prev = grads.get(id(v))
+                    grads[id(v)] = g if prev is None else prev + g
+                    var_by_id[id(v)] = v
+
+        # write accumulated grads onto leaves (GradientAccumulator analog)
+        for vid, g in grads.items():
+            v = var_by_id[vid]
+            src = getattr(v, "_src", None)
+            if src is not None:      # AMP: route to fp32 master param
+                g32 = g.astype(src._value.dtype)
+                src._grad = g32 if src._grad is None else src._grad + g32
+            elif isinstance(v, ParamBase) or v.persistable or True:
+                v._grad = g if v._grad is None else v._grad + g
+        if not retain_graph:
+            self._tape.clear()
+
+
+def materialize_initializer(init, shape, dtype, key):
+    """Run an Initializer eagerly (the dygraph analog of running its op in
+    the startup program)."""
+    from ..fluid import initializer as I
+    dtype = convert_dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    if isinstance(init, I.ConstantInitializer):
+        return jnp.full(shape, init.value, dtype=dtype)
+    if isinstance(init, I.UniformInitializer):
+        return jax.random.uniform(key, shape, jnp.float32, init.low,
+                                  init.high).astype(dtype)
+    if isinstance(init, I.NormalInitializer):
+        return (jax.random.normal(key, shape, jnp.float32) * init.scale
+                + init.loc).astype(dtype)
+    if isinstance(init, I.TruncatedNormalInitializer):
+        return (jax.random.truncated_normal(key, -2., 2., shape, jnp.float32)
+                * init.scale + init.loc).astype(dtype)
+    if isinstance(init, I.XavierInitializer):
+        fi, fo = I._fans(shape)
+        fi = init.fan_in or fi
+        fo = init.fan_out or fo
+        if init.uniform:
+            lim = float(np.sqrt(6.0 / (fi + fo)))
+            return jax.random.uniform(key, shape, jnp.float32, -lim,
+                                      lim).astype(dtype)
+        return (jax.random.normal(key, shape, jnp.float32)
+                * float(np.sqrt(2.0 / (fi + fo)))).astype(dtype)
+    if isinstance(init, I.MSRAInitializer):
+        fi, _ = I._fans(shape)
+        fi = init.fan_in or fi
+        if init.uniform:
+            lim = float(np.sqrt(6.0 / fi))
+            return jax.random.uniform(key, shape, jnp.float32, -lim,
+                                      lim).astype(dtype)
+        return (jax.random.normal(key, shape, jnp.float32)
+                * float(np.sqrt(2.0 / fi))).astype(dtype)
+    if isinstance(init, I.NumpyArrayInitializer):
+        return jnp.asarray(init.value, dtype=dtype)
+    raise TypeError(f"unsupported initializer {init!r} in dygraph")
+
+
+# ---------------------------------------------------------------------------
+_global_tracer = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard — enter eager mode."""
+    global _global_tracer
+    prev = _global_tracer
+    _global_tracer = Tracer()
+    _set_dygraph_tracer(_global_tracer)
+    try:
+        yield
+    finally:
+        _global_tracer = prev
+        _set_dygraph_tracer(prev)
+
+
+def enable_dygraph(place=None):
+    global _global_tracer
+    _global_tracer = Tracer()
+    _set_dygraph_tracer(_global_tracer)
+
+
+def disable_dygraph():
+    global _global_tracer
+    _global_tracer = None
+    _set_dygraph_tracer(None)
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(jnp.asarray(value), name=name, stop_gradient=True)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    t = _dygraph_tracer()
+    if t is None:
+        yield
+        return
+    prev = t._no_grad
+    t._no_grad = True
+    try:
+        yield
+    finally:
+        t._no_grad = prev
+
+
+def no_grad(fn=None):
+    if fn is None:
+        return no_grad_ctx()
+    def wrapper(*a, **k):
+        with no_grad_ctx():
+            return fn(*a, **k)
+    return wrapper

@@ -1,0 +1,32 @@
+"""save_dygraph/load_dygraph (reference fluid/dygraph/checkpoint.py)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def save_dygraph(state_dict, model_path):
+    """state_dict values may be VarBase/ParamBase or numpy arrays."""
+    out = {}
+    for k, v in state_dict.items():
+        out[k] = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+    path = model_path + (".pdparams" if not model_path.endswith(".pdparams")
+                         else "")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(out, f)
+    return path
+
+
+def load_dygraph(model_path):
+    path = model_path if os.path.exists(model_path) else model_path + ".pdparams"
+    with open(path, "rb") as f:
+        params = pickle.load(f)
+    opt_path = model_path + ".pdopt"
+    opt = None
+    if os.path.exists(opt_path):
+        with open(opt_path, "rb") as f:
+            opt = pickle.load(f)
+    return params, opt

@@ -1,0 +1,88 @@
+"""dygraph-to-static bridge.
+
+Reference: python/paddle/fluid/dygraph/jit.py `@declarative:160` +
+ProgramTranslator (dygraph_to_static/program_translator.py:729) rewrite
+Python AST into a static Program.  TPU-native: a dygraph model is ALREADY a
+pure function of (params, inputs) once traced — `declarative` simply marks a
+function for jax.jit compilation of its eager op stream; TracedLayer captures
+(state_dict, callable) for inference export.  No AST rewriting is needed
+because data-dependent control flow must use layers.cond/while_loop anyway
+(XLA constraint), which trace correctly.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import VarBase, to_variable
+
+
+def declarative(function=None):
+    """Mark a dygraph function as compilable.  Runs eagerly (each op is an
+    XLA call); end-to-end fusion comes from TracedLayer/jit_compile."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return fn(*args, **kwargs)
+        wrapper.__declarative__ = True
+        return wrapper
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+to_static = declarative
+
+
+class TracedLayer:
+    """Capture a layer into one jitted callable (inference export path,
+    fluid/dygraph/jit.py TracedLayer)."""
+
+    def __init__(self, layer, jitted, example_inputs):
+        self._layer = layer
+        self._jitted = jitted
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        import jax
+
+        params = {name: p._value for name, p in layer.named_parameters()}
+        buffers = {}
+        for i, b in enumerate(layer.buffers()):
+            buffers[f"__buf_{i}"] = b._value
+
+        def pure_fn(params, buffers, *xs):
+            # rebind parameter values, run eagerly under trace
+            for (name, p), v in zip(layer.named_parameters(), params.values()):
+                p._value = v
+            for b, v in zip(layer.buffers(), buffers.values()):
+                b._value = v
+            outs = layer(*[to_variable(x) for x in xs])
+            if isinstance(outs, (list, tuple)):
+                return [o._value for o in outs]
+            return outs._value
+
+        jitted = jax.jit(pure_fn)
+        example = [x._value if isinstance(x, VarBase) else x for x in inputs]
+        out = jitted(params, buffers, *example)
+        traced = TracedLayer(layer, functools.partial(jitted, params, buffers),
+                             example)
+        outs = ([VarBase(o) for o in out] if isinstance(out, list)
+                else [VarBase(out)])
+        return outs if len(outs) > 1 else outs[0], traced
+
+    def __call__(self, *inputs):
+        arrs = [x._value if isinstance(x, VarBase) else np.asarray(x)
+                for x in inputs]
+        out = self._jitted(*arrs)
+        if isinstance(out, (list, tuple)):
+            return [VarBase(o) for o in out]
+        return VarBase(out)
+
+    def save_inference_model(self, dirname, feed=None, fetch=None):
+        import pickle, os
+        os.makedirs(dirname, exist_ok=True)
+        with open(f"{dirname}/traced_layer.pkl", "wb") as f:
+            pickle.dump({"state": self._layer.state_dict()}, f)

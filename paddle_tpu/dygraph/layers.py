@@ -1,0 +1,217 @@
+"""Layer base class (reference python/paddle/fluid/dygraph/layers.py)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..fluid.framework import unique_name, _dygraph_tracer
+from .base import VarBase, ParamBase, to_variable
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name(name_scope or
+                                      type(self).__name__.lower())
+        self._dtype = dtype
+        self._parameters: "OrderedDict[str, ParamBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+        self.training = True
+
+    # -- parameter/sublayer registration (via attribute protocol) ----------
+    def __setattr__(self, name, value):
+        if isinstance(value, ParamBase):
+            self.__dict__.setdefault("_parameters", OrderedDict())[name] = value
+        elif isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", OrderedDict())[name] = value
+        object.__setattr__(self, name, value)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, VarBase):
+            tensor = to_variable(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+            tensor.stop_gradient = True
+        self._buffers[name] = tensor
+        object.__setattr__(self, name, tensor)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..fluid.layer_helper import LayerHelper
+        helper = LayerHelper(self.full_name())
+        return helper.create_parameter(attr, shape, dtype or self._dtype,
+                                       is_bias, default_initializer)
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers=True) -> List[ParamBase]:
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix="") -> Iterator[Tuple[str, ParamBase]]:
+        for name, p in self._parameters.items():
+            yield (f"{prefix}{name}", p)
+        for lname, l in self._sub_layers.items():
+            yield from l.named_parameters(prefix=f"{prefix}{lname}.")
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for l in self._sub_layers.values():
+            out.append(l)
+            out.extend(l.sublayers())
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix.rstrip("."), self
+        for name, l in self._sub_layers.items():
+            yield f"{prefix}{name}", l
+            yield from l.named_sublayers(prefix=f"{prefix}{name}.")
+
+    def buffers(self):
+        out = list(self._buffers.values())
+        for l in self._sub_layers.values():
+            out.extend(l.buffers())
+        return out
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        tr = _dygraph_tracer()
+        if tr:
+            tr._train_mode = True
+        for l in self._sub_layers.values():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        tr = _dygraph_tracer()
+        if tr:
+            tr._train_mode = False
+        for l in self._sub_layers.values():
+            l.eval()
+        return self
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix="") -> Dict[str, np.ndarray]:
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self._parameters.items():
+            dest[prefix + name] = p.numpy()
+        for name, b in self._buffers.items():
+            if b is not None:
+                dest[prefix + name] = b.numpy()
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                l.state_dict(dest, True, prefix=f"{prefix}{lname}.")
+        return dest
+
+    def set_state_dict(self, state_dict, include_sublayers=True):
+        self.set_dict(state_dict)
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        for name, value in self._named_leaves():
+            if name in state_dict:
+                value.set_value(np.asarray(state_dict[name]))
+
+    load_dict = set_dict
+
+    def _named_leaves(self, prefix=""):
+        for name, p in self._parameters.items():
+            yield prefix + name, p
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield prefix + name, b
+        for lname, l in self._sub_layers.items():
+            yield from l._named_leaves(prefix=f"{prefix}{lname}.")
+
+    def full_name(self):
+        return self._full_name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            layers = [l for _, l in layers[0]]
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, i):
+        return list(self._sub_layers.values())[i]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, l):
+        self.add_sublayer(str(len(self._sub_layers)), l)
+        return self
+
+    def __getitem__(self, i):
+        return list(self._sub_layers.values())[i]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, i):
+        return list(self._parameters.values())[i]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)

@@ -1,0 +1,235 @@
+"""Dygraph layer classes (reference python/paddle/fluid/dygraph/nn.py:
+Conv2D, Linear, BatchNorm, Embedding, LayerNorm, Pool2D, GRUUnit...).
+Each wraps the shared fluid.layers op-builders, which dispatch eagerly
+through the tracer in dygraph mode."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import layers as L
+from ..fluid.framework import _dygraph_tracer
+from ..fluid.initializer import ConstantInitializer, XavierInitializer, \
+    NormalInitializer
+from ..fluid.layer_helper import LayerHelper
+from .layers import Layer
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("linear")
+        self.weight = helper.create_parameter(param_attr,
+                                              [input_dim, output_dim], dtype)
+        self.bias = helper.create_parameter(bias_attr, [output_dim], dtype,
+                                            is_bias=True) \
+            if bias_attr is not False else None
+        self._act = act
+
+    def forward(self, x):
+        out = L.matmul(x, self.weight)
+        if self.bias is not None:
+            out = L.elementwise_add(out, self.bias, axis=-1)
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+FC = Linear
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("conv2d")
+        fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+        self._groups = groups
+        self._act = act
+        import math
+        fan_in = (num_channels // groups) * fs[0] * fs[1]
+        self.weight = helper.create_parameter(
+            param_attr, [num_filters, num_channels // groups] + fs, dtype,
+            default_initializer=NormalInitializer(0., math.sqrt(2. / fan_in)))
+        self.bias = helper.create_parameter(bias_attr, [num_filters], dtype,
+                                            is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        tracer = _dygraph_tracer()
+        out = tracer.trace_op(
+            "conv2d", {"Input": [x], "Filter": [self.weight]},
+            {"Output": [None]},
+            {"strides": self._stride, "paddings": self._padding,
+             "dilations": self._dilation, "groups": self._groups})["Output"][0]
+        if self.bias is not None:
+            out = L.elementwise_add(out, self.bias, axis=1)
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = dict(pool_size=pool_size, pool_type=pool_type,
+                           pool_stride=pool_stride, pool_padding=pool_padding,
+                           global_pooling=global_pooling, ceil_mode=ceil_mode,
+                           exclusive=exclusive)
+
+    def forward(self, x):
+        return L.pool2d(x, **self._attrs)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW", in_place=False,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("batch_norm")
+        self.weight = helper.create_parameter(
+            param_attr, [num_channels], dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [num_channels], dtype,
+                                            is_bias=True)
+        import jax.numpy as jnp
+        self.register_buffer("_mean", jnp.zeros([num_channels], dtype))
+        self.register_buffer("_variance", jnp.ones([num_channels], dtype))
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self._act = act
+
+    def forward(self, x):
+        tracer = _dygraph_tracer()
+        outs = tracer.trace_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            {"Y": [None]},
+            {"momentum": self._momentum, "epsilon": self._epsilon,
+             "is_test": not self.training,
+             "data_layout": self._data_layout,
+             "use_global_stats": self._use_global_stats})
+        # write back moving stats (in-place aliasing analog)
+        self._mean.set_value(outs["MeanOut"][0]._value)
+        self._variance.set_value(outs["VarianceOut"][0]._value)
+        out = outs["Y"][0]
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("embedding")
+        self.weight = helper.create_parameter(param_attr, list(size), dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        tracer = _dygraph_tracer()
+        return tracer.trace_op(
+            "lookup_table_v2", {"W": [self.weight], "Ids": [ids]},
+            {"Out": [None]},
+            {"padding_idx": self._padding_idx})["Out"][0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("layer_norm")
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self.weight = helper.create_parameter(
+            param_attr, [n], dtype,
+            default_initializer=ConstantInitializer(1.0)) if scale else None
+        self.bias = helper.create_parameter(bias_attr, [n], dtype,
+                                            is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+        self._nshape = normalized_shape
+
+    def forward(self, x):
+        tracer = _dygraph_tracer()
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        begin = len(x.shape) - len(self._nshape)
+        out = tracer.trace_op("layer_norm", ins, {"Y": [None]},
+                              {"epsilon": self._epsilon,
+                               "begin_norm_axis": begin})["Y"][0]
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, seed=None, dropout_implementation="downgrade_in_infer",
+                 is_test=False):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, x):
+        return L.dropout(x, self._p, is_test=not self.training,
+                         dropout_implementation=self._impl)
+
+
+class GRUUnit(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid",
+                 origin_mode=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("gru_unit")
+        d = size // 3
+        self.weight = helper.create_parameter(param_attr, [d, d * 3], dtype)
+        self.bias = helper.create_parameter(bias_attr, [1, d * 3], dtype,
+                                            is_bias=True)
+        self._d = d
+        self._activation = activation
+        self._gate_activation = gate_activation
+
+    def forward(self, input, hidden):
+        # input: [B, 3D] projected x; hidden: [B, D]
+        g = input + L.matmul(hidden, self.weight) + self.bias
+        u, r, c = L.split(g, [self._d, self._d, self._d], dim=-1)
+        u = getattr(L, self._gate_activation)(u)
+        r = getattr(L, self._gate_activation)(r)
+        c = getattr(L, self._activation)(c * r + (1 - r) * c) \
+            if False else getattr(L, self._activation)(c)
+        new_h = u * hidden + (1 - u) * c
+        return new_h, new_h, c
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("prelu")
+        shape = [1] if mode == "all" else [channel]
+        self.weight = helper.create_parameter(
+            param_attr, shape, dtype,
+            default_initializer=ConstantInitializer(0.25))
+        self._mode = mode
+
+    def forward(self, x):
+        tracer = _dygraph_tracer()
+        return tracer.trace_op("prelu",
+                               {"X": [x], "Alpha": [self.weight]},
+                               {"Out": [None]},
+                               {"mode": self._mode})["Out"][0]

@@ -1,0 +1,42 @@
+"""paddle_tpu.fluid — the fluid-compatible front-end, TPU-native underneath.
+
+Public surface per python/paddle/fluid/__init__.py (SURVEY A.6): Program /
+Executor / layers / optimizer / backward / io / initializer / ParamAttr ...
+"""
+from .. import ops as _ops  # registers all lowering rules
+
+from . import core
+from .core import (CPUPlace, TPUPlace, CUDAPlace, TPUPinnedPlace, Scope,
+                   global_scope, scope_guard, set_flags, get_flags,
+                   is_compiled_with_cuda, is_compiled_with_tpu)
+from .framework import (Program, Variable, Parameter, program_guard,
+                        default_main_program, default_startup_program,
+                        in_dygraph_mode, unique_name, convert_dtype,
+                        cpu_places)
+from .executor import Executor
+from .backward import append_backward, gradients
+from . import initializer
+from .initializer import Constant, Uniform, Normal, Xavier, MSRA
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import layers
+from . import optimizer
+from . import regularizer
+from . import clip
+from .layers.tensor import data
+from . import io
+from .io import save_persistables, load_persistables, save_params, load_params
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import dygraph
+from .data_feeder import DataFeeder
+from . import metrics
+from . import profiler
+from .reader import DataLoader
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+embedding = layers.embedding
+one_hot = layers.one_hot

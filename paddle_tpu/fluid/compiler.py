@@ -1,0 +1,82 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy facades.
+
+Reference: python/paddle/fluid/compiler.py:87 CompiledProgram,
+with_data_parallel:163 -> C++ ParallelExecutor + BuildStrategy's 30+ knobs
+(framework/details/build_strategy.h:71-195).  TPU-native: data parallelism is
+a sharding decision, not a graph rewrite — with_data_parallel() attaches a
+jax.sharding.Mesh over the local chips and the Executor jits the SAME step
+function with batch-sharded inputs; XLA inserts the gradient all-reduce that
+AllReduceOpHandle (details/all_reduce_op_handle.cc:60) performed explicitly.
+Most BuildStrategy knobs are therefore accepted-and-ignored: fusion/memory
+passes are XLA's job (SURVEY §7 step 5).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ReduceStrategy:
+    AllReduce = 0
+    Reduce = 1
+
+
+class BuildStrategy:
+    """Knob container (details/build_strategy.h).  Knobs that map to XLA
+    concepts are honored; the rest are inert but settable for API parity."""
+
+    def __init__(self):
+        self.reduce_strategy = ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = 0
+        self.debug_graphviz_path = ""
+        self.enable_inplace = True          # -> buffer donation (default on)
+        self.memory_optimize = None
+        self.fuse_all_optimizer_ops = False  # XLA fuses regardless
+        self.fuse_all_reduce_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        self.sync_batch_norm = False        # -> sync_batch_norm op psum
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.trainers_endpoints = []
+        self.collective_mode = None
+        self.nccl_comm_num = 1
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0                # XLA schedules; inert
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.allow_op_delay = False
+
+
+class CompiledProgram:
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+        self._program = getattr(program_or_graph, "_program", program_or_graph)
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._mesh = None
+        self._is_data_parallel = False
+        # forwarded so Executor.run can treat us like a Program
+        self._hints = self._program._hints
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        """Local multi-chip DP: build a 1-axis device mesh over the chips."""
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        from ..parallel.mesh import build_data_parallel_mesh
+        self._mesh = build_data_parallel_mesh(places)
+        self._is_data_parallel = True
+        if self._build_strategy.sync_batch_norm:
+            self._program._hints["sync_batch_norm"] = True
+        return self
+
+    def _with_inference_optimize(self, config):
+        return self
+
+    @property
+    def program(self):
+        return self._program

@@ -1,0 +1,162 @@
+"""Platform layer: Place/device identity + global flags.
+
+Reference: paddle/fluid/platform/place.h:26-62 (CPUPlace/CUDAPlace variants),
+device_context.h:60-568 (per-device handle bundles), flags.cc (runtime gflags).
+TPU-native: a Place names a JAX device; there is no per-place stream/handle
+bundle because XLA/PJRT owns streams and HBM — the DeviceContext analog is
+just the resolved `jax.Device` plus the process-wide compilation cache that
+executor.py maintains.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class Place:
+    device_kind = "cpu"
+    device_id = 0
+
+    def jax_device(self):
+        import jax
+        devs = [d for d in jax.devices() if self._match(d)]
+        if not devs:
+            # fall back to whatever the default backend offers (e.g. running
+            # TPU-targeted code on the CPU backend in tests)
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def _match(self, d) -> bool:
+        return True
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == getattr(other, "device_id", 0))
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    device_kind = "cpu"
+
+    def _match(self, d):
+        return d.platform == "cpu"
+
+
+class TPUPlace(Place):
+    """The CUDAPlace analog (place.h:62): names one accelerator chip."""
+    device_kind = "tpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def _match(self, d):
+        return d.platform != "cpu"
+
+
+# fluid alias: code written against the reference uses CUDAPlace; on this
+# framework it resolves to the accelerator (TPU) as well.
+CUDAPlace = TPUPlace
+
+
+class TPUPinnedPlace(CPUPlace):
+    """Host staging buffers; XLA handles pinning internally."""
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def get_device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+# ---------------------------------------------------------------------------
+# global flags (platform/flags.cc analog; settable from Python like
+# global_value_getter_setter.cc). Only flags meaningful on TPU are kept.
+# ---------------------------------------------------------------------------
+_FLAGS: Dict[str, object] = {
+    "check_nan_inf": False,          # per-fetch NaN scan (operator.cc:1149 analog)
+    "benchmark": False,
+    "paddle_num_threads": 1,
+    "use_donated_buffers": True,     # buffer donation == inplace/GC knobs
+    "jit_cache_size": 128,
+    "deterministic": False,
+}
+
+
+def set_flags(flags: Dict[str, object]):
+    for k, v in flags.items():
+        k = k.removeprefix("FLAGS_")
+        _FLAGS[k] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: _FLAGS.get(n.removeprefix("FLAGS_")) for n in names}
+
+
+def get_flag(name: str, default=None):
+    return _FLAGS.get(name, default)
+
+
+class Scope:
+    """name -> device array map (framework/scope.h analog, flat)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+
+    def var(self, name):
+        return self._vars.get(name)
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def new_scope(self) -> "Scope":
+        return Scope(parent=self)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

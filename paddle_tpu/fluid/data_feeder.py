@@ -1,0 +1,20 @@
+"""DataFeeder: convert user minibatch lists to feed dicts (fluid
+data_feeder.py).  No LoD conversion — ragged data must be padded upstream."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_names = [v if isinstance(v, str) else v.name
+                           for v in feed_list]
+        self.feed_vars = feed_list
+
+    def feed(self, iterable):
+        rows = list(iterable)
+        out = {}
+        for i, name in enumerate(self.feed_names):
+            cols = [row[i] for row in rows]
+            out[name] = np.stack([np.asarray(c) for c in cols])
+        return out

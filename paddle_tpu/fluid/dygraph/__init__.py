@@ -1,0 +1,10 @@
+"""fluid.dygraph namespace — re-exports the dygraph subsystem
+(reference python/paddle/fluid/dygraph/__init__.py)."""
+from ...dygraph import *  # noqa: F401,F403
+from ...dygraph import (guard, to_variable, no_grad, Layer, Sequential,
+                        LayerList, ParameterList, Linear, FC, Conv2D, Pool2D,
+                        BatchNorm, Embedding, LayerNorm, Dropout, GRUUnit,
+                        PRelu, DataParallel, ParallelEnv, prepare_context,
+                        save_dygraph, load_dygraph, TracedLayer, declarative,
+                        enable_dygraph, disable_dygraph)
+from ...dygraph import nn  # noqa: F401

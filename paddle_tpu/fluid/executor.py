@@ -1,0 +1,210 @@
+"""Executor: whole-block XLA compilation replacing per-op kernel dispatch.
+
+Reference: paddle/fluid/framework/executor.cc — `Prepare` (executor.cc:376)
+instantiates ops, `RunPartialPreparedContext` (executor.cc:474-480) hot-loops
+`op->Run(scope, place)` per op per step.  TPU-native: `Executor._prepare`
+lowers the whole block to ONE jaxpr via the per-op lowering rules and
+jit-compiles it; the per-step cost is a single device-program launch.  The
+compile cache keyed on (program fingerprint, feed shapes) is the analog of
+`ExecutorPrepareContext` caching (_ExecutorCache, executor.py:1110).  Eager
+GC / inplace passes are replaced by XLA buffer donation of the parameter
+arguments (SURVEY §2.2 TPU note).
+
+Distributed: when the program carries a mesh annotation (parallel/mesh.py),
+the same step callable is wrapped in shard_map over the jax.sharding.Mesh so
+collective ops (c_allreduce_*, ...) lower to ICI collectives — the analog of
+ParallelExecutor's SSA graph + NCCL op handles, with XLA doing the
+scheduling that FastThreadedSSAGraphExecutor did by hand.
+"""
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import core
+from .core import Scope, global_scope
+from .framework import Program, Block, Variable, default_main_program
+from ..ops.registry import get_op, has_op, LoweringContext
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _fetch_name(f):
+    return f.name if isinstance(f, Variable) else str(f)
+
+
+def _fingerprint(program: Program) -> str:
+    h = hashlib.sha1()
+    for b in program.blocks:
+        for op in b.ops:
+            h.update(op.type.encode())
+            h.update(repr(sorted(op.inputs.items())).encode())
+            h.update(repr(sorted(op.outputs.items())).encode())
+            h.update(repr(sorted((k, str(v)) for k, v in op.attrs.items()))
+                     .encode())
+    return h.hexdigest()
+
+
+class _CompiledBlock:
+    """The ExecutorPrepareContext analog: one jitted callable per
+    (program, feed signature)."""
+
+    def __init__(self, fn, param_names, written_names, fetch_names):
+        self.fn = fn
+        self.param_names = param_names
+        self.written_names = written_names
+        self.fetch_names = fetch_names
+
+
+def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
+                  stop_at: Optional[int] = None):
+    """Interpret the block's ops by invoking each lowering rule; under jit
+    this builds the jaxpr (trace-time loop — zero runtime dispatch cost)."""
+    from . import control_flow_impl
+    for i, op in enumerate(block.ops):
+        if stop_at is not None and i >= stop_at:
+            break
+        if op.type in ("feed", "fetch"):
+            continue
+        if op.type in ("while", "conditional_block", "select_input",
+                       "select_output"):
+            control_flow_impl.run_control_flow_op(op, block, env, ctx)
+            continue
+        opdef = get_op(op.type)
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = [env[n] for n in names if n in env]
+            if vals or names:
+                ins[slot] = vals
+        outs = opdef.fn(ins, op.attrs, ctx)
+        for slot, names in op.outputs.items():
+            produced = outs.get(slot, [])
+            for name, val in zip(names, produced):
+                if val is not None:
+                    env[name] = val
+    return env
+
+
+class Executor:
+    """fluid.Executor(place) — API per python/paddle/fluid/executor.py:914."""
+
+    def __init__(self, place: Optional[core.Place] = None):
+        self.place = place or (core.TPUPlace(0) if core.is_compiled_with_tpu()
+                               else core.CPUPlace())
+        self._cache: Dict[tuple, _CompiledBlock] = {}
+        self._step = 0
+
+    # -- public API ---------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            scope: Optional[Scope] = None,
+            return_numpy: bool = True,
+            use_program_cache: bool = True):
+        program = program or default_main_program()
+        # CompiledProgram facade (compiler.py) unwraps to its program + mesh
+        mesh = getattr(program, "_mesh", None)
+        if hasattr(program, "_program"):   # CompiledProgram
+            mesh = getattr(program, "_mesh", None) or mesh
+            program = program._program
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
+
+        feed_sig = tuple(sorted(
+            (k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            for k, v in feed.items()))
+        key = (_fingerprint(program), feed_sig, tuple(fetch_names),
+               id(scope), bool(program._hints.get("is_test")))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._prepare(program, feed, fetch_names, scope, mesh)
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        mut = {n: scope.find_var(n) for n in compiled.param_names
+               if n in compiled.written_names}
+        ro = {n: scope.find_var(n) for n in compiled.param_names
+              if n not in compiled.written_names}
+        feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        seed = program.random_seed if program.random_seed is not None else 0
+        step_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        self._step += 1
+
+        fetches, new_vals = compiled.fn(mut, ro, feeds, step_key)
+        for n, v in new_vals.items():
+            scope.set_var(n, v)
+
+        if core.get_flag("check_nan_inf"):
+            for n, v in zip(compiled.fetch_names, fetches):
+                if jnp.issubdtype(v.dtype, jnp.floating) and not bool(
+                        jnp.all(jnp.isfinite(v))):
+                    raise FloatingPointError(f"NaN/Inf in fetched var '{n}'")
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- compilation --------------------------------------------------------
+    def _prepare(self, program: Program, feed, fetch_names, scope,
+                 mesh=None) -> _CompiledBlock:
+        block = program.global_block()
+        is_test = bool(program._hints.get("is_test"))
+
+        # vars read from the scope: persistables already materialised
+        param_names = sorted(
+            n for n, v in block.vars.items()
+            if (v.persistable or scope.find_var(n) is not None)
+            and scope.find_var(n) is not None and n not in feed)
+        persist = {n for n, v in block.vars.items() if v.persistable}
+        written_names = sorted(
+            {n for op in block.ops for n in op.output_arg_names
+             if n in persist or scope.find_var(n) is not None})
+        # a persistable output only counts if its producing op will run
+        mesh_axes = dict(getattr(program, "_mesh_axes", {}) or {})
+
+        def fn(mut_params, ro_params, feeds, step_key):
+            env = dict(mut_params)
+            env.update(ro_params)
+            env.update(feeds)
+            ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
+                                  is_test=is_test)
+            run_block_ops(block, env, ctx)
+            fetches = [env[n] for n in fetch_names]
+            new_vals = {n: env[n] for n in written_names if n in env}
+            return fetches, new_vals
+
+        backend = self.place.jax_device().platform
+        donate = (core.get_flag("use_donated_buffers") and backend != "cpu")
+        if mesh is not None:
+            from ..parallel.api import wrap_with_mesh
+            jfn = wrap_with_mesh(fn, mesh, program)
+        else:
+            jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        return _CompiledBlock(jfn, param_names, written_names, fetch_names)
+
+    # -- Trainer/dataset path (executor.cc:139-173 analog) ------------------
+    def train_from_dataset(self, program, dataset, scope=None, thread=0,
+                           debug=False, fetch_list=None, fetch_info=None,
+                           print_period=100):
+        from ..distributed.trainer import run_from_dataset
+        return run_from_dataset(self, program, dataset, fetch_list,
+                                print_period, train=True)
+
+    def infer_from_dataset(self, program, dataset, scope=None, thread=0,
+                           debug=False, fetch_list=None, fetch_info=None,
+                           print_period=100):
+        from ..distributed.trainer import run_from_dataset
+        return run_from_dataset(self, program, dataset, fetch_list,
+                                print_period, train=False)
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,121 @@
+"""Checkpoint save/load + inference model export.
+
+Reference: python/paddle/fluid/io.py — save_vars:238, save_persistables:620,
+load_persistables:994, save/load_inference_model:1198,1411.  TPU-native
+format: one .npz per save (vars as named numpy arrays) plus a JSON program
+manifest for inference models — functionally equivalent to the reference's
+`__model__` ProgramDesc + per-var files, without protobuf coupling.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from .core import global_scope
+from .framework import Program, Parameter, default_main_program
+
+
+def _vars_to_save(program: Program, predicate=None):
+    out = []
+    for v in program.global_block().vars.values():
+        if not v.persistable:
+            continue
+        if predicate and not predicate(v):
+            continue
+        out.append(v)
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    scope = global_scope()
+    if vars is None:
+        vars = _vars_to_save(main_program, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for v in vars:
+        name = v.name if not isinstance(v, str) else v
+        val = scope.find_var(name)
+        if val is not None:
+            arrays[name] = np.asarray(val)
+    path = os.path.join(dirname, filename or "params.npz")
+    np.savez(path, **arrays)
+    return path
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+    scope = global_scope()
+    path = os.path.join(dirname, filename or "params.npz")
+    data = np.load(path, allow_pickle=False)
+    main_program = main_program or default_main_program()
+    wanted = None
+    if vars is not None:
+        wanted = {v.name if not isinstance(v, str) else v for v in vars}
+    for name in data.files:
+        if wanted is not None and name not in wanted:
+            continue
+        scope.set_var(name, jnp.asarray(data[name]))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """Export program(pickled IR) + params — io.py:1198 analog."""
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    infer_prog = main_program.clone(for_test=True)
+    manifest = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    with open(os.path.join(dirname, "__model__.json"), "w") as f:
+        json.dump(manifest, f)
+    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
+        pickle.dump(infer_prog, f)
+    if not program_only:
+        save_persistables(executor, dirname, main_program,
+                          filename=params_filename)
+    return manifest["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
+        program = pickle.load(f)
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        manifest = json.load(f)
+    load_persistables(executor, dirname, program, filename=params_filename)
+    fetch_vars = [program.global_block().var(n)
+                  for n in manifest["fetch_names"]]
+    return program, manifest["feed_names"], fetch_vars
+
+
+def get_program_persistable_vars(program):
+    return [v for v in program.global_block().vars.values() if v.persistable]

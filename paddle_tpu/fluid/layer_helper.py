@@ -1,0 +1,103 @@
+"""LayerHelper: shared machinery for layer functions (fluid layer_helper.py).
+
+Creates parameters (appending their initializer ops to the *startup* program)
+and temp output vars in the *main* program, the reference's two-program idiom.
+Also the dygraph bridge: when a tracer is active, `append_op` executes
+eagerly instead of recording IR (framework.py:2814 dygraph fast path analog).
+"""
+from __future__ import annotations
+
+from .framework import (default_main_program, default_startup_program,
+                        in_dygraph_mode, _dygraph_tracer, unique_name,
+                        Variable)
+from .initializer import _to_initializer, ConstantInitializer, XavierInitializer
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.layer_type = layer_type
+        self.kwargs = kwargs
+
+    @property
+    def name(self):
+        return self.kwargs.get("name") or unique_name(self.layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    def block(self):
+        return self.main_program.current_block()
+
+    # ------------------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype="float32",
+                         is_bias=False, default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        name = attr.name or unique_name(
+            f"{self.kwargs.get('name') or self.layer_type}.w"
+            if not is_bias else
+            f"{self.kwargs.get('name') or self.layer_type}.b")
+        init = attr.initializer or default_initializer or (
+            ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        init = _to_initializer(init)
+
+        if in_dygraph_mode():
+            return _dygraph_tracer().create_parameter(
+                name, shape, dtype, init, trainable=attr.trainable,
+                regularizer=attr.regularizer, need_clip=attr.need_clip)
+
+        param = self.block().create_parameter(
+            name=name, shape=shape, dtype=dtype, trainable=attr.trainable,
+            regularizer=attr.regularizer, need_clip=attr.need_clip)
+        param.optimize_attr = {"learning_rate": attr.learning_rate}
+        # mirror into startup program and append its init op there
+        sb = self.startup_program.global_block()
+        sp = sb.create_parameter(name=name, shape=shape, dtype=dtype,
+                                 trainable=attr.trainable)
+        init(sp, sb)
+        return param
+
+    def create_variable_for_type_inference(self, dtype="float32",
+                                           stop_gradient=False):
+        if in_dygraph_mode():
+            return None  # dygraph outputs are created by the tracer
+        return self.block().create_var(
+            name=unique_name(".".join([self.name, "tmp"])),
+            dtype=dtype, stop_gradient=stop_gradient)
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        if in_dygraph_mode():
+            return _dygraph_tracer().trace_op(type, inputs, outputs, attrs)
+        return self.block().append_op(type, inputs, outputs, attrs)
+
+    # activation sugar: fluid layers take act="relu" etc.
+    def append_activation(self, out, act=None):
+        if act is None:
+            return out
+        if isinstance(act, str):
+            act = {"type": act}
+        act_type = act.pop("type")
+        tmp = self.create_variable_for_type_inference(
+            dtype=out.dtype if out is not None else "float32")
+        op = self.append_op(act_type, inputs={"X": [out]},
+                            outputs={"Out": [tmp]}, attrs=act)
+        return tmp if not in_dygraph_mode() else op["Out"][0]
+
+    def append_bias_op(self, out, bias, axis=1):
+        if bias is None:
+            return out
+        tmp = self.create_variable_for_type_inference(
+            dtype=out.dtype if out is not None else "float32")
+        op = self.append_op("elementwise_add",
+                            inputs={"X": [out], "Y": [bias]},
+                            outputs={"Out": [tmp]}, attrs={"axis": axis})
+        return tmp if not in_dygraph_mode() else op["Out"][0]

@@ -1,0 +1,34 @@
+"""fluid.layers namespace — aggregates the op-builder API surface
+(reference: python/paddle/fluid/layers/__init__.py)."""
+from . import tensor
+from . import nn
+from . import loss
+from . import metric_op
+
+from .tensor import (data, fill_constant, fill_constant_batch_size_like,
+                     assign, cast, concat, sums, zeros, ones, zeros_like,
+                     ones_like, create_tensor, create_global_var, argmax,
+                     argmin, argsort, linspace, increment, diag, eye, range,
+                     _to_variable)
+from .nn import *  # noqa: F401,F403
+from .nn import (fc, embedding, conv2d, conv2d_transpose, pool2d,
+                 adaptive_pool2d, batch_norm, layer_norm, group_norm,
+                 instance_norm, dropout, softmax, log_softmax, one_hot, topk,
+                 reshape, squeeze, unsqueeze, transpose, flatten, split,
+                 slice, gather, gather_nd, scatter, stack, unstack, expand,
+                 expand_as, pad, pad2d, scale, clip, clip_by_norm,
+                 l2_normalize, label_smooth, where, uniform_random,
+                 gaussian_random, matmul, mul, elementwise_op, unfold)
+from .loss import (cross_entropy, softmax_with_cross_entropy,
+                   square_error_cost, sigmoid_cross_entropy_with_logits,
+                   log_loss, huber_loss, smooth_l1, kldiv_loss, mse_loss)
+from .metric_op import accuracy, auc
+from .control_flow import (cond, while_loop, array_write, array_read,
+                           array_length, create_array, less_than, equal,
+                           greater_than, increment as cf_increment, Switch)
+from .sequence_lod import (sequence_pool, sequence_softmax, sequence_expand,
+                           sequence_mask, sequence_reverse, sequence_pad,
+                           sequence_unpad)
+from .collective import _c_allreduce, _c_allgather, _c_broadcast, _allreduce
+from .rnn import lstm_unit, gru_unit, dynamic_lstm_unit  # noqa: F401
+from .detection import *  # noqa: F401,F403

@@ -1,0 +1,59 @@
+"""Collective layer builders (fluid layers/collective.py: _allreduce:20,
+_c_allreduce:64, _c_allgather:108).  Append c_* ops carrying ring_id; the
+mesh registry (parallel/mesh.py) maps ring_id -> mesh axis at lowering."""
+from __future__ import annotations
+
+from ..framework import in_dygraph_mode
+from ..layer_helper import LayerHelper
+
+
+def _collective(op_type, x, ring_id=0, use_calc_stream=True, extra=None,
+                out=None):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    attrs = {"ring_id": ring_id, "use_calc_stream": use_calc_stream}
+    attrs.update(extra or {})
+    op = helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+                          attrs=attrs)
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def _allreduce(x, out=None, reduce_type="sum", sync_mode=False):
+    return _collective(f"c_allreduce_{reduce_type}", x, out=out)
+
+
+def _c_allreduce(x, out=None, reduce_type="sum", ring_id=0,
+                 use_calc_stream=False):
+    return _collective(f"c_allreduce_{reduce_type}", x, ring_id,
+                       use_calc_stream, out=out)
+
+
+def _c_allgather(x, nranks, ring_id=0, use_calc_stream=False):
+    return _collective("c_allgather", x, ring_id, use_calc_stream,
+                       {"nranks": nranks})
+
+
+def _c_reducescatter(x, nranks, ring_id=0, use_calc_stream=False):
+    return _collective("c_reducescatter", x, ring_id, use_calc_stream,
+                       {"nranks": nranks})
+
+
+def _c_broadcast(x, root=0, ring_id=0, use_calc_stream=False):
+    return _collective("c_broadcast", x, ring_id, use_calc_stream,
+                       {"root": root})
+
+
+def _c_sync_calc_stream(x):
+    return _collective("c_sync_calc_stream", x)
+
+
+def _c_sync_comm_stream(x, ring_id=0):
+    return _collective("c_sync_comm_stream", x, ring_id)
+
+
+def barrier(x=None, ring_id=0):
+    from .tensor import fill_constant
+    if x is None:
+        x = fill_constant([1], "float32", 0.0)
+    return _collective("barrier", x, ring_id)

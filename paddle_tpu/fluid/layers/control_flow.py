@@ -1,0 +1,243 @@
+"""Control-flow layers: cond / while_loop / tensor arrays.
+
+Reference: python/paddle/fluid/layers/control_flow.py builds while/
+conditional_block ops carrying BLOCK attrs (SURVEY §2.8).  TPU-native: the
+sub-blocks are lowered into lax.cond / lax.while_loop by
+fluid/control_flow_impl.py; a `cond` here records BOTH branches (the
+reference splices conditional_block + select_input pairs instead).
+LoDTensorArray becomes a fixed-capacity stacked tensor with a length index
+(XLA needs static shapes).
+"""
+from __future__ import annotations
+
+from ..framework import (Variable, default_main_program, in_dygraph_mode,
+                         unique_name)
+from ..layer_helper import LayerHelper
+from . import nn as _nn
+from .tensor import fill_constant
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _cmp("less_than", x, y, cond)
+
+
+def less_equal(x, y, cond=None):
+    return _cmp("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _cmp("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _cmp("greater_equal", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _cmp("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _cmp("not_equal", x, y, cond)
+
+
+def _cmp(op_type, x, y, out=None):
+    helper = LayerHelper(op_type)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype="bool",
+                                                        stop_gradient=True)
+    op = helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def logical_and(x, y, out=None):
+    return _cmp("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None):
+    return _cmp("logical_or", x, y, out)
+
+
+def logical_not(x, out=None):
+    helper = LayerHelper("logical_not")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype="bool",
+                                                        stop_gradient=True)
+    op = helper.append_op("logical_not", inputs={"X": [x]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def increment(x, value=1.0, in_place=True):
+    from .tensor import increment as _inc
+    return _inc(x, value, in_place)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """paddle/fluid cond: functional two-branch conditional."""
+    if in_dygraph_mode():
+        import numpy as np
+        if bool(np.asarray(pred.numpy()).reshape(())):
+            return true_fn() if true_fn else None
+        return false_fn() if false_fn else None
+
+    program = default_main_program()
+    parent_idx = program.current_block_idx
+
+    tb = program._create_block(parent_idx)
+    t_res = true_fn() if true_fn else None
+    t_list = list(t_res) if isinstance(t_res, (list, tuple)) else [t_res]
+    t_names = [v.name for v in t_list if v is not None]
+    program.current_block_idx = parent_idx
+
+    fb = program._create_block(parent_idx)
+    f_res = false_fn() if false_fn else None
+    f_list = list(f_res) if isinstance(f_res, (list, tuple)) else [f_res]
+    f_names = [v.name for v in f_list if v is not None]
+    program.current_block_idx = parent_idx
+
+    helper = LayerHelper("cond", name=name)
+    outs = [helper.create_variable_for_type_inference(
+        dtype=v.dtype if v is not None else "float32") for v in t_list]
+    helper.append_op(
+        "conditional_block",
+        inputs={"Cond": [pred]},
+        outputs={"Out": outs},
+        attrs={"true_block": tb.idx, "false_block": fb.idx,
+               "true_outs": t_names, "false_outs": f_names})
+    if isinstance(t_res, (list, tuple)):
+        return tuple(outs)
+    return outs[0]
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """functional while (fluid layers/control_flow.py while_loop)."""
+    if in_dygraph_mode():
+        while bool(cond_fn(*loop_vars).numpy()):
+            loop_vars = body_fn(*loop_vars)
+            if not isinstance(loop_vars, (list, tuple)):
+                loop_vars = [loop_vars]
+        return loop_vars
+
+    program = default_main_program()
+    parent_idx = program.current_block_idx
+
+    cb = program._create_block(parent_idx)
+    c = cond_fn(*loop_vars)
+    program.current_block_idx = parent_idx
+
+    bb = program._create_block(parent_idx)
+    new_vars = body_fn(*loop_vars)
+    if not isinstance(new_vars, (list, tuple)):
+        new_vars = [new_vars]
+    # write results back onto the loop var names so the carry is stable
+    for old, new in zip(loop_vars, new_vars):
+        if new.name != old.name:
+            bb.append_op("assign", inputs={"X": [new]},
+                         outputs={"Out": [old]})
+    program.current_block_idx = parent_idx
+
+    helper = LayerHelper("while", name=name)
+    helper.append_op(
+        "while",
+        inputs={"X": [v for v in loop_vars]},
+        outputs={"Out": [v for v in loop_vars]},
+        attrs={"cond_block": cb.idx, "sub_block": bb.idx,
+               "cond_var": c.name})
+    return loop_vars
+
+
+class While:
+    """Imperative-style While block (fluid layers.While)."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+        self._program = default_main_program()
+
+    def block(self):
+        return _WhileCtx(self)
+
+
+class _WhileCtx:
+    def __init__(self, w):
+        self.w = w
+
+    def __enter__(self):
+        p = self.w._program
+        self.parent_idx = p.current_block_idx
+        self.body = p._create_block(self.parent_idx)
+        return self
+
+    def __exit__(self, *exc):
+        p = self.w._program
+        p.current_block_idx = self.parent_idx
+        reads = sorted({n for op in self.body.ops for n in op.input_arg_names})
+        writes = sorted({n for op in self.body.ops for n in op.output_arg_names})
+        # condition must be recomputed in its own block; here the body is
+        # expected to update the cond var directly (fluid idiom)
+        cb = p._create_block(self.parent_idx)
+        cb.append_op("assign", inputs={"X": [self.w.cond_var]},
+                     outputs={"Out": [self.w.cond_var.name + "@COND"]})
+        p.current_block_idx = self.parent_idx
+        self.w.helper.append_op(
+            "while", inputs={"X": [n for n in reads]},
+            outputs={"Out": [n for n in writes]},
+            attrs={"cond_block": cb.idx, "sub_block": self.body.idx,
+                   "cond_var": self.w.cond_var.name + "@COND"})
+        return False
+
+
+class Switch:
+    """fluid layers.Switch — sugar over nested cond."""
+
+    def __init__(self, name=None):
+        self.cases = []
+        self.default_ops = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def case(self, condition):
+        raise NotImplementedError(
+            "Switch: use layers.cond / piecewise_decay instead on TPU")
+
+    def default(self):
+        raise NotImplementedError
+
+
+# --- tensor array (LoDTensorArray replacement) ------------------------------
+def create_array(dtype):
+    """Fixed-capacity array modeled as a list of vars at build time."""
+    block = default_main_program().current_block()
+    v = block.create_var(name=unique_name("array"), dtype=dtype)
+    v._array_items = []
+    return v
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array(x.dtype)
+    if not hasattr(array, "_array_items"):
+        array._array_items = []
+    array._array_items.append(x)
+    return array
+
+
+def array_read(array, i):
+    items = getattr(array, "_array_items", [])
+    if not items:
+        raise ValueError("reading from empty tensor array")
+    if len(items) == 1:
+        return items[0]
+    stacked = _nn.stack(items, axis=0)
+    idx = i if isinstance(i, Variable) else fill_constant([1], "int64", i)
+    return _nn.gather(stacked, idx)
+
+
+def array_length(array):
+    return fill_constant([1], "int64", len(getattr(array, "_array_items", [])))

@@ -1,0 +1,258 @@
+"""Vision/detection layers (reference operators/detection/, SURVEY §2.5:
+yolo_box, prior_box, nms, roi_align...).  Core boxes/iou/yolo ops are lowered
+to vectorised jnp; dynamic-shape NMS runs as host-side numpy in dygraph only.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import register_op
+from ..framework import in_dygraph_mode
+from ..layer_helper import LayerHelper
+
+__all__ = ["yolo_box", "prior_box", "box_coder", "iou_similarity",
+           "roi_align", "roi_pool", "multiclass_nms"]
+
+
+@register_op("iou_similarity", nondiff_inputs=("Y",))
+def _iou_similarity(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]     # [N,4], [M,4] xyxy
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": [inter / (area_x[:, None] + area_y[None, :] - inter + 1e-10)]}
+
+
+@register_op("prior_box", differentiable=False)
+def _prior_box(ins, attrs, ctx):
+    feat, img = ins["Input"][0], ins["Image"][0]
+    min_sizes = attrs["min_sizes"]
+    max_sizes = attrs.get("max_sizes", [])
+    ratios = attrs.get("aspect_ratios", [1.0])
+    flip = attrs.get("flip", False)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    ars = []
+    for r in ratios:
+        ars.append(r)
+        if flip and abs(r - 1.0) > 1e-6:
+            ars.append(1.0 / r)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        for ar in ars:
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if ms_i < len(max_sizes):
+            s = np.sqrt(ms * max_sizes[ms_i])
+            boxes.append((s, s))
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    all_boxes = []
+    for bw, bh in boxes:
+        b = jnp.stack([(cxg - bw / 2) / iw, (cyg - bh / 2) / ih,
+                       (cxg + bw / 2) / iw, (cyg + bh / 2) / ih], axis=-1)
+        all_boxes.append(b)
+    out = jnp.clip(jnp.stack(all_boxes, axis=2), 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+@register_op("box_coder", nondiff_inputs=("PriorBox", "PriorBoxVar"))
+def _box_coder(ins, attrs, ctx):
+    prior = ins["PriorBox"][0]
+    pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if "encode" in code_type:
+        tw = target[:, 2] - target[:, 0]
+        th = target[:, 3] - target[:, 1]
+        tcx = target[:, 0] + tw / 2
+        tcy = target[:, 1] + th / 2
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+    else:
+        d = target
+        if pvar is not None:
+            d = d * pvar[None, :, :] if d.ndim == 3 else d * pvar
+        dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+        cx = dx * pw + pcx
+        cy = dy * ph + pcy
+        w = jnp.exp(dw) * pw
+        h = jnp.exp(dh) * ph
+        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=-1)
+    return {"OutputBox": [out]}
+
+
+@register_op("yolo_box", nondiff_inputs=("ImgSize",))
+def _yolo_box(ins, attrs, ctx):
+    x, img_size = ins["X"][0], ins["ImgSize"][0]
+    anchors = attrs["anchors"]
+    class_num = attrs["class_num"]
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = attrs.get("downsample_ratio", 32)
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w).reshape(1, 1, 1, w)
+    gy = jnp.arange(h).reshape(1, 1, h, 1)
+    aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+    ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / (w * downsample)
+    bh = jnp.exp(x[:, :, 3]) * ah / (h * downsample)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imgh = img_size[:, 0].reshape(n, 1, 1, 1).astype(jnp.float32)
+    imgw = img_size[:, 1].reshape(n, 1, 1, 1).astype(jnp.float32)
+    boxes = jnp.stack([(bx - bw / 2) * imgw, (by - bh / 2) * imgh,
+                       (bx + bw / 2) * imgw, (by + bh / 2) * imgh], axis=-1)
+    boxes = boxes.reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    mask = (conf.reshape(n, -1, 1) >= conf_thresh).astype(scores.dtype)
+    return {"Boxes": [boxes], "Scores": [scores * mask]}
+
+
+@register_op("roi_align", nondiff_inputs=("ROIs",))
+def _roi_align(ins, attrs, ctx):
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = attrs.get("sampling_ratio", 2)
+    if ratio <= 0:
+        ratio = 2
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bh, bw = rh / ph, rw / pw
+        ys = y1 + (jnp.arange(ph)[:, None] + 0.5) * bh + \
+            (jnp.arange(ratio).reshape(1, -1) / ratio - 0.5 + 0.5 / ratio) * bh
+        xs = x1 + (jnp.arange(pw)[:, None] + 0.5) * bw + \
+            (jnp.arange(ratio).reshape(1, -1) / ratio - 0.5 + 0.5 / ratio) * bw
+        ys = ys.reshape(-1)
+        xs = xs.reshape(-1)
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                 + img[:, y0, x1_] * (1 - wy) * wx
+                 + img[:, y1_, x0] * wy * (1 - wx)
+                 + img[:, y1_, x1_] * wy * wx)
+            return v
+        grid_y, grid_x = jnp.meshgrid(ys, xs, indexing="ij")
+        vals = bilinear(x[0], grid_y.reshape(-1), grid_x.reshape(-1))
+        vals = vals.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        return vals
+
+    out = jax.vmap(one_roi)(rois)
+    return {"Out": [out]}
+
+
+@register_op("multiclass_nms", differentiable=False)
+def _multiclass_nms(ins, attrs, ctx):
+    raise NotImplementedError(
+        "multiclass_nms has dynamic output shape; use "
+        "paddle_tpu.vision.ops.batched_nms (fixed-k) inside jit, or run in "
+        "dygraph eager mode")
+
+
+def _layer2(op_type, in_map, out_slots, attrs=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    outs = {s: [helper.create_variable_for_type_inference(dtype="float32")]
+            for s in out_slots}
+    op = helper.append_op(op_type, inputs=in_map, outputs=outs, attrs=attrs)
+    if in_dygraph_mode():
+        vals = [op[s][0] for s in out_slots]
+    else:
+        vals = [outs[s][0] for s in out_slots]
+    return vals[0] if len(vals) == 1 else tuple(vals)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, name=None):
+    return _layer2("yolo_box", {"X": [x], "ImgSize": [img_size]},
+                   ["Boxes", "Scores"],
+                   {"anchors": list(anchors), "class_num": class_num,
+                    "conf_thresh": conf_thresh,
+                    "downsample_ratio": downsample_ratio}, name)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None):
+    return _layer2("prior_box", {"Input": [input], "Image": [image]},
+                   ["Boxes", "Variances"],
+                   {"min_sizes": list(min_sizes),
+                    "max_sizes": list(max_sizes or []),
+                    "aspect_ratios": list(aspect_ratios),
+                    "variances": list(variance), "flip": flip,
+                    "step_w": steps[0], "step_h": steps[1],
+                    "offset": offset}, name)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    ins = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = [prior_box_var]
+    return _layer2("box_coder", ins, ["OutputBox"],
+                   {"code_type": code_type, "axis": axis}, name)
+
+
+def iou_similarity(x, y, name=None):
+    return _layer2("iou_similarity", {"X": [x], "Y": [y]}, ["Out"], {}, name)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    return _layer2("roi_align", {"X": [input], "ROIs": [rois]}, ["Out"],
+                   {"pooled_height": pooled_height,
+                    "pooled_width": pooled_width,
+                    "spatial_scale": spatial_scale,
+                    "sampling_ratio": sampling_ratio}, name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    return roi_align(input, rois, pooled_height, pooled_width, spatial_scale)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    return _layer2("multiclass_nms", {"BBoxes": [bboxes], "Scores": [scores]},
+                   ["Out"],
+                   {"score_threshold": score_threshold,
+                    "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                    "nms_threshold": nms_threshold}, name)

@@ -1,0 +1,686 @@
+"""fluid.layers op-builder API (reference: python/paddle/fluid/layers/nn.py,
+216 public defs).  Layer functions append ops to the default main program (or
+execute eagerly under a dygraph tracer) — same call surface, zero CUDA.
+Auto-generated wrappers cover the unary/elementwise/reduce families the way
+the reference's layer_function_generator.py builds them from OpProtos.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from ..framework import Variable, in_dygraph_mode, unique_name
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer, XavierInitializer
+from .tensor import _to_variable
+
+_this = sys.modules[__name__]
+
+
+def _single_out(op_type, x, attrs=None, dtype=None, out_slot="Out",
+                in_slot="X", stop_gradient=False):
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype or getattr(x, "dtype", "float32"),
+        stop_gradient=stop_gradient)
+    op = helper.append_op(op_type, inputs={in_slot: [x]},
+                          outputs={out_slot: [out]}, attrs=attrs or {})
+    return op[out_slot][0] if in_dygraph_mode() else out
+
+
+# ---- generated unary layers ------------------------------------------------
+_UNARY = [
+    "relu", "relu6", "sigmoid", "logsigmoid", "tanh", "tanh_shrink", "gelu",
+    "erf", "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square",
+    "abs", "ceil", "floor", "round", "reciprocal", "sign", "sin", "cos",
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "softplus", "softsign",
+    "softshrink", "hard_shrink", "hard_sigmoid", "hard_swish", "swish",
+    "mish", "selu", "elu", "leaky_relu", "brelu", "thresholded_relu",
+    "stanh", "silu", "logsumexp",
+]
+for _name in _UNARY:
+    def _mk(op_type):
+        def f(x, name=None, **attrs):
+            attrs.pop("inplace", None)
+            return _single_out(op_type, x, attrs)
+        f.__name__ = op_type
+        return f
+    setattr(_this, _name, _mk(_name))
+
+
+def elementwise_op(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name)
+    y = _to_variable(None, y, getattr(x, "dtype", None)) \
+        if not isinstance(y, Variable) and not in_dygraph_mode() else y
+    out = helper.create_variable_for_type_inference(
+        dtype=getattr(x, "dtype", "float32"))
+    op = helper.append_op(op_type, inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]}, attrs={"axis": axis})
+    out = op["Out"][0] if in_dygraph_mode() else out
+    return helper.append_activation(out, act)
+
+
+for _name in ["elementwise_add", "elementwise_sub", "elementwise_mul",
+              "elementwise_div", "elementwise_min", "elementwise_max",
+              "elementwise_pow", "elementwise_mod", "elementwise_floordiv"]:
+    def _mk2(op_type):
+        def f(x, y, axis=-1, act=None, name=None):
+            return elementwise_op(op_type, x, y, axis, act, name)
+        f.__name__ = op_type
+        return f
+    setattr(_this, _name, _mk2(_name))
+
+
+def _reduce_layer(op_type, x, dim=None, keep_dim=False, name=None):
+    helper = LayerHelper(op_type, name=name)
+    if dim is None:
+        dim, reduce_all = [0], True
+    else:
+        dim = [dim] if isinstance(dim, int) else list(dim)
+        reduce_all = False
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op(op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+                          attrs={"dim": dim, "keep_dim": keep_dim,
+                                 "reduce_all": reduce_all})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+for _name in ["reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+              "reduce_prod", "reduce_all", "reduce_any"]:
+    def _mkr(op_type):
+        def f(x, dim=None, keep_dim=False, name=None):
+            return _reduce_layer(op_type, x, dim, keep_dim, name)
+        f.__name__ = op_type
+        return f
+    setattr(_this, _name, _mkr(_name))
+
+
+def mean(x, name=None):
+    return _single_out("mean", x)
+
+
+# ---- dense layers ----------------------------------------------------------
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected (layers/nn.py fc).  input [d0..dk, in] -> [d0..dk, size]
+    via mul op (reference mul_op.cc flatten semantics)."""
+    helper = LayerHelper("fc", name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    outs = []
+    for inp in inputs:
+        in_dim = int(np.prod(inp.shape[num_flatten_dims:])) \
+            if not in_dygraph_mode() else int(np.prod(
+                inp.shape[num_flatten_dims:]))
+        w = helper.create_parameter(param_attr, [in_dim, size], inp.dtype)
+        tmp = helper.create_variable_for_type_inference(dtype=inp.dtype)
+        op = helper.append_op("mul", inputs={"X": [inp], "Y": [w]},
+                              outputs={"Out": [tmp]},
+                              attrs={"x_num_col_dims": num_flatten_dims,
+                                     "y_num_col_dims": 1})
+        outs.append(op["Out"][0] if in_dygraph_mode() else tmp)
+    if len(outs) > 1:
+        from .tensor import sums
+        pre_bias = sums(outs)
+    else:
+        pre_bias = outs[0]
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [size], pre_bias.dtype,
+                                    is_bias=True)
+        pre_act = helper.append_bias_op(pre_bias, b, axis=num_flatten_dims)
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act, act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """layers/nn.py embedding -> lookup_table_v2.  is_sparse maps to the
+    dense vjp-scatter grad (SelectedRows has no XLA analog, SURVEY §7 #3)."""
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, list(size), dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    padding_idx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    op = helper.append_op("lookup_table_v2",
+                          inputs={"W": [w], "Ids": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"padding_idx": padding_idx,
+                                 "is_sparse": is_sparse})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("matmul", inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]},
+                          attrs={"transpose_X": transpose_x,
+                                 "transpose_Y": transpose_y,
+                                 "alpha": float(alpha)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    helper = LayerHelper("mul")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("mul", inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]},
+                          attrs={"x_num_col_dims": x_num_col_dims,
+                                 "y_num_col_dims": y_num_col_dims})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    helper = LayerHelper("conv2d", name=name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    dilation = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    padding_algorithm = "EXPLICIT"
+    if isinstance(padding, str):
+        padding_algorithm = padding.upper()
+        padding = [0, 0]
+    elif isinstance(padding, int):
+        padding = [padding, padding]
+    num_channels = input.shape[1 if data_format == "NCHW" else -1]
+    w_shape = [num_filters, num_channels // groups] + filter_size
+    import math
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    std = math.sqrt(2.0 / fan_in)
+    from ..initializer import NormalInitializer
+    w = helper.create_parameter(param_attr, w_shape, input.dtype,
+                                default_initializer=NormalInitializer(0., std))
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op(
+        "conv2d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": list(padding),
+               "dilations": dilation, "groups": groups,
+               "padding_algorithm": padding_algorithm,
+               "data_format": data_format})
+    out = op["Output"][0] if in_dygraph_mode() else out
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", name=name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    num_channels = input.shape[1]
+    w = helper.create_parameter(
+        param_attr, [num_channels, num_filters // groups] + filter_size,
+        input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op(
+        "conv2d_transpose", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation,
+               "groups": groups})
+    out = op["Output"][0] if in_dygraph_mode() else out
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters], input.dtype,
+                                    is_bias=True)
+        out = helper.append_bias_op(out, b, axis=1)
+    return helper.append_activation(out, act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None, data_format="NCHW"):
+    helper = LayerHelper("pool2d", name=name)
+    pool_size = [pool_size, pool_size] if isinstance(pool_size, int) else list(pool_size)
+    pool_stride = [pool_stride, pool_stride] if isinstance(pool_stride, int) else list(pool_stride)
+    pool_padding = [pool_padding, pool_padding] if isinstance(pool_padding, int) else list(pool_padding)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op(
+        "pool2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"ksize": pool_size, "pooling_type": pool_type,
+               "strides": pool_stride, "paddings": pool_padding,
+               "global_pooling": global_pooling, "exclusive": exclusive,
+               "ceil_mode": ceil_mode})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    pool_size = [pool_size, pool_size] if isinstance(pool_size, int) else list(pool_size)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("adaptive_pool2d", inputs={"X": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"ksize": pool_size, "pooling_type": pool_type})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    helper = LayerHelper("batch_norm", name=name)
+    c = input.shape[1 if data_layout == "NCHW" else -1]
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    # moving stats: persistable, non-trainable; updated in place by the op
+    from ..param_attr import ParamAttr
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False,
+                  initializer=ConstantInitializer(0.0)), [c], input.dtype)
+    var = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False,
+                  initializer=ConstantInitializer(1.0)), [c], input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    saved_m = helper.create_variable_for_type_inference(dtype="float32",
+                                                        stop_gradient=True)
+    saved_v = helper.create_variable_for_type_inference(dtype="float32",
+                                                        stop_gradient=True)
+    op = helper.append_op(
+        "batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [var]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [var],
+                 "SavedMean": [saved_m], "SavedVariance": [saved_v]},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test, "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    out = op["Y"][0] if in_dygraph_mode() else out
+    return helper.append_activation(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", name=name)
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, input.dtype,
+                                    is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    m = helper.create_variable_for_type_inference(dtype="float32",
+                                                  stop_gradient=True)
+    v = helper.create_variable_for_type_inference(dtype="float32",
+                                                  stop_gradient=True)
+    op = helper.append_op("layer_norm", inputs=inputs,
+                          outputs={"Y": [out], "Mean": [m], "Variance": [v]},
+                          attrs={"epsilon": epsilon,
+                                 "begin_norm_axis": begin_norm_axis})
+    out = op["Y"][0] if in_dygraph_mode() else out
+    return helper.append_activation(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", name=name)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        inputs["Scale"] = [helper.create_parameter(
+            param_attr, [c], input.dtype,
+            default_initializer=ConstantInitializer(1.0))]
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, [c], input.dtype,
+                                                  is_bias=True)]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    m = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    v = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    op = helper.append_op("group_norm", inputs=inputs,
+                          outputs={"Y": [out], "Mean": [m], "Variance": [v]},
+                          attrs={"groups": groups, "epsilon": epsilon})
+    out = op["Y"][0] if in_dygraph_mode() else out
+    return helper.append_activation(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = input.shape[1]
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        inputs["Scale"] = [helper.create_parameter(
+            param_attr, [c], input.dtype,
+            default_initializer=ConstantInitializer(1.0))]
+    if bias_attr is not False:
+        inputs["Bias"] = [helper.create_parameter(bias_attr, [c], input.dtype,
+                                                  is_bias=True)]
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    sm = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    sv = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    op = helper.append_op("instance_norm", inputs=inputs,
+                          outputs={"Y": [out], "SavedMean": [sm],
+                                   "SavedVariance": [sv]},
+                          attrs={"epsilon": epsilon})
+    return op["Y"][0] if in_dygraph_mode() else out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype="uint8",
+                                                     stop_gradient=True)
+    attrs = {"dropout_prob": dropout_prob, "is_test": is_test,
+             "dropout_implementation": dropout_implementation}
+    if not in_dygraph_mode():
+        attrs["op_seed"] = seed or helper.main_program.next_op_seed()
+    else:
+        attrs["op_seed"] = seed or 0
+    op = helper.append_op("dropout", inputs={"X": [x]},
+                          outputs={"Out": [out], "Mask": [mask]}, attrs=attrs)
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def softmax(input, axis=-1, use_cudnn=False, name=None):
+    return _single_out("softmax", input, {"axis": axis})
+
+
+def log_softmax(input, axis=-1):
+    return _single_out("log_softmax", input, {"axis": axis})
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _single_out("one_hot", input, {"depth": depth}, dtype="float32",
+                       stop_gradient=True)
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    ids = helper.create_variable_for_type_inference(dtype="int64",
+                                                    stop_gradient=True)
+    op = helper.append_op("top_k", inputs={"X": [input]},
+                          outputs={"Out": [out], "Indices": [ids]},
+                          attrs={"k": k})
+    if in_dygraph_mode():
+        return op["Out"][0], op["Indices"][0]
+    return out, ids
+
+
+def cast(x, dtype):
+    from .tensor import cast as _cast
+    return _cast(x, dtype)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    op = helper.append_op("reshape2", inputs={"X": [x]},
+                          outputs={"Out": [out], "XShape": [xshape]},
+                          attrs={"shape": list(shape)})
+    out = op["Out"][0] if in_dygraph_mode() else out
+    return helper.append_activation(out, act)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                       stop_gradient=True)
+    op = helper.append_op("squeeze2", inputs={"X": [input]},
+                          outputs={"Out": [out], "XShape": [xshape]},
+                          attrs={"axes": list(axes)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=input.dtype,
+                                                       stop_gradient=True)
+    op = helper.append_op("unsqueeze2", inputs={"X": [input]},
+                          outputs={"Out": [out], "XShape": [xshape]},
+                          attrs={"axes": list(axes)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    op = helper.append_op("transpose2", inputs={"X": [x]},
+                          outputs={"Out": [out], "XShape": [xshape]},
+                          attrs={"axis": list(perm)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    xshape = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                       stop_gradient=True)
+    op = helper.append_op("flatten2", inputs={"X": [x]},
+                          outputs={"Out": [out], "XShape": [xshape]},
+                          attrs={"axis": axis})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        n, sections = num_or_sections, []
+    else:
+        n, sections = len(num_or_sections), list(num_or_sections)
+    outs = [helper.create_variable_for_type_inference(dtype=input.dtype)
+            for _ in range(n)]
+    op = helper.append_op("split", inputs={"X": [input]},
+                          outputs={"Out": outs},
+                          attrs={"axis": dim, "num": n, "sections": sections})
+    return list(op["Out"]) if in_dygraph_mode() else outs
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("slice", inputs={"Input": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"axes": list(axes), "starts": list(starts),
+                                 "ends": list(ends)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("gather", inputs={"X": [input], "Index": [index]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("gather_nd", inputs={"X": [input], "Index": [index]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("scatter",
+                          inputs={"X": [input], "Ids": [index],
+                                  "Updates": [updates]},
+                          outputs={"Out": [out]},
+                          attrs={"overwrite": overwrite})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    x = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    op = helper.append_op("stack", inputs={"X": x}, outputs={"Y": [out]},
+                          attrs={"axis": axis})
+    return op["Y"][0] if in_dygraph_mode() else out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    num = num or x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(dtype=x.dtype)
+            for _ in range(num)]
+    op = helper.append_op("unstack", inputs={"X": [x]}, outputs={"Y": outs},
+                          attrs={"axis": axis, "num": num})
+    return list(op["Y"]) if in_dygraph_mode() else outs
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("expand", inputs={"X": [x]}, outputs={"Out": [out]},
+                          attrs={"expand_times": list(expand_times)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as_v2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("expand_as_v2",
+                          inputs={"X": [x], "Y": [target_tensor]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("pad", inputs={"X": [x]}, outputs={"Out": [out]},
+                          attrs={"paddings": list(paddings),
+                                 "pad_value": float(pad_value)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("pad2d", inputs={"X": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"paddings": list(paddings), "mode": mode,
+                                 "pad_value": float(pad_value),
+                                 "data_format": data_format})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                          attrs={"scale": float(scale), "bias": float(bias),
+                                 "bias_after_scale": bias_after_scale})
+    out = op["Out"][0] if in_dygraph_mode() else out
+    return helper.append_activation(out, act)
+
+
+def clip(x, min, max, name=None):
+    return _single_out("clip", x, {"min": float(min), "max": float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _single_out("clip_by_norm", x, {"max_norm": float(max_norm)})
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    norm = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                     stop_gradient=True)
+    op = helper.append_op("l2_normalize", inputs={"X": [x]},
+                          outputs={"Out": [out], "Norm": [norm]},
+                          attrs={"axis": axis, "epsilon": epsilon})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    op = helper.append_op("label_smooth", inputs=inputs,
+                          outputs={"Out": [out]},
+                          attrs={"epsilon": float(epsilon)})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def where(condition, x, y):
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("where", inputs={"Condition": [condition],
+                                           "X": [x], "Y": [y]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def cond_value(cond, tv, fv):  # helper used by higher layers
+    return where(cond, tv, fv)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype=dtype,
+                                                    stop_gradient=True)
+    attrs = {"shape": list(shape), "dtype": dtype, "min": min, "max": max}
+    if not in_dygraph_mode():
+        attrs["op_seed"] = seed or helper.main_program.next_op_seed()
+    op = helper.append_op("uniform_random", outputs={"Out": [out]}, attrs=attrs)
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype=dtype,
+                                                    stop_gradient=True)
+    attrs = {"shape": list(shape), "dtype": dtype, "mean": mean, "std": std}
+    if not in_dygraph_mode():
+        attrs["op_seed"] = seed or helper.main_program.next_op_seed()
+    op = helper.append_op("gaussian_random", outputs={"Out": [out]}, attrs=attrs)
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def relu_(x):  # inplace alias
+    return getattr(_this, "relu")(x)
+
+
+def matmul_v2(x, y, trans_x=False, trans_y=False):
+    helper = LayerHelper("matmul_v2")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("matmul_v2", inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]},
+                          attrs={"trans_x": trans_x, "trans_y": trans_y})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    helper = LayerHelper("unfold", name=name)
+    k = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) else list(kernel_sizes)
+    s = [strides] * 2 if isinstance(strides, int) else list(strides)
+    p = [paddings] * 4 if isinstance(paddings, int) else list(paddings)
+    d = [dilations] * 2 if isinstance(dilations, int) else list(dilations)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("unfold", inputs={"X": [x]}, outputs={"Y": [out]},
+                          attrs={"kernel_sizes": k, "strides": s,
+                                 "paddings": p, "dilations": d})
+    return op["Y"][0] if in_dygraph_mode() else out

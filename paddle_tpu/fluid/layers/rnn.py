@@ -1,0 +1,97 @@
+"""RNN cells as composite layers (reference operators/{lstm,gru,rnn}_op.cc
+and layers/rnn.py).  Whole-sequence RNNs are built with lax.scan via the
+`rnn` op; cells compose matmul/sigmoid/tanh ops that XLA fuses."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+from ..framework import in_dygraph_mode
+from ..layer_helper import LayerHelper
+from . import nn as _nn
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step from gate projections (layers/rnn.py lstm_unit)."""
+    helper = LayerHelper("lstm_unit", name=name)
+    size = hidden_t_prev.shape[-1]
+    concat_in = _nn.fc(
+        [x_t, hidden_t_prev], 4 * size,
+        param_attr=param_attr, bias_attr=bias_attr)
+    i, f, c_hat, o = _nn.split(concat_in, 4, dim=-1)
+    f = _nn.sigmoid(_nn.scale(f, bias=float(forget_bias)))
+    i = _nn.sigmoid(i)
+    o = _nn.sigmoid(o)
+    c = f * cell_t_prev + i * _nn.tanh(c_hat)
+    h = o * _nn.tanh(c)
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    helper = LayerHelper("gru_unit")
+    d = hidden.shape[-1]
+    gates = _nn.fc([input, hidden], 2 * d, param_attr=param_attr,
+                   bias_attr=bias_attr, act=gate_activation)
+    u, r = _nn.split(gates, 2, dim=-1)
+    c = _nn.fc([input, r * hidden], d, param_attr=param_attr,
+               bias_attr=bias_attr, act=activation)
+    new_h = u * hidden + (1.0 - u) * c
+    return new_h, new_h, c
+
+
+def dynamic_lstm_unit(*args, **kwargs):
+    raise NotImplementedError(
+        "LoD dynamic_lstm is replaced by padded scan RNN (rnn op)")
+
+
+@register_op("rnn_scan", nondiff_inputs=("SequenceLength",))
+def _rnn_scan(ins, attrs, ctx):
+    """Padded multi-layer unidirectional LSTM/GRU over time with lax.scan
+    (replacing cudnn_lstm_op).  WeightList packs per-layer (wi, wh, bi, bh)."""
+    x = ins["Input"][0]                      # [B, T, D] batch_first
+    mode = attrs.get("mode", "LSTM")
+    ws = ins["WeightList"]
+    h0 = ins["PreState"][0]
+    c0 = ins["PreState"][1] if len(ins.get("PreState", [])) > 1 else None
+    num_layers = attrs.get("num_layers", 1)
+
+    out = jnp.swapaxes(x, 0, 1)              # [T, B, D]
+    h_fin, c_fin = [], []
+    for layer in range(num_layers):
+        wi, wh, bi, bh = ws[4 * layer: 4 * layer + 4]
+        h_init = h0[layer]
+        c_init = c0[layer] if c0 is not None else jnp.zeros_like(h_init)
+
+        if mode == "LSTM":
+            def step(carry, xt):
+                h, c = carry
+                g = xt @ wi.T + h @ wh.T + bi + bh
+                i, f, gg, o = jnp.split(g, 4, axis=-1)
+                c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+                h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+                return (h2, c2), h2
+            (hT, cT), out = jax.lax.scan(step, (h_init, c_init), out)
+            h_fin.append(hT)
+            c_fin.append(cT)
+        else:  # GRU
+            def step(carry, xt):
+                h = carry
+                gi = xt @ wi.T + bi
+                gh = h @ wh.T + bh
+                ir, iu, ic = jnp.split(gi, 3, axis=-1)
+                hr, hu, hc = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(ir + hr)
+                u = jax.nn.sigmoid(iu + hu)
+                c = jnp.tanh(ic + r * hc)
+                h2 = u * h + (1 - u) * c
+                return h2, h2
+            hT, out = jax.lax.scan(step, h_init, out)
+            h_fin.append(hT)
+    outs = {"Out": [jnp.swapaxes(out, 0, 1)],
+            "State": [jnp.stack(h_fin)]}
+    if mode == "LSTM":
+        outs["State"].append(jnp.stack(c_fin))
+    return outs

@@ -1,0 +1,168 @@
+"""Sequence ops WITHOUT LoD: padded batches + explicit lengths/masks.
+
+Reference: paddle/fluid/operators/sequence_ops/ (sequence_pool, sequence_conv,
+sequence_expand, sequence_softmax — all LoD-ragged, SURVEY §2.5).  XLA is
+static-shape, so the TPU-native design (SURVEY §7 hard part #1) represents a
+batch of sequences as a dense [batch, max_len, ...] tensor plus a `length`
+int tensor; every sequence op takes the lengths explicitly and masks padding.
+This is the documented capability replacement for LoD, not a port.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+from ..framework import in_dygraph_mode
+from ..layer_helper import LayerHelper
+
+
+# --- op lowerings -----------------------------------------------------------
+def _mask(length, max_len, dtype=jnp.float32):
+    return (jnp.arange(max_len)[None, :] < length.reshape(-1, 1)).astype(dtype)
+
+
+@register_op("sequence_mask", nondiff_inputs=("X",), differentiable=False)
+def _sequence_mask(ins, attrs, ctx):
+    x = ins["X"][0]
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen <= 0:
+        import numpy as np
+        maxlen = int(np.asarray(x).max()) if not isinstance(
+            x, jax.core.Tracer) else x.shape[-1]
+    from ..framework import convert_dtype
+    dt = convert_dtype(attrs.get("out_dtype", "int64"))
+    return {"Y": [(jnp.arange(maxlen)[None, :] <
+                   x.reshape(-1, 1)).astype(dt)]}
+
+
+@register_op("sequence_pool", nondiff_inputs=("Length",))
+def _sequence_pool(ins, attrs, ctx):
+    """x: [B, T, D] padded; Length: [B]. pooltype SUM/AVERAGE/MAX/SQRT/
+    LAST/FIRST (sequence_ops/sequence_pool_op.h semantics, padded layout)."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooltype", "SUM").upper()
+    if ins.get("Length"):
+        length = ins["Length"][0]
+        m = _mask(length, x.shape[1], x.dtype)[..., None]
+    else:
+        length = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+        m = jnp.ones(x.shape[:2] + (1,), x.dtype)
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(
+            length.reshape(-1, 1).astype(x.dtype), 1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(
+            length.reshape(-1, 1).astype(x.dtype), 1))
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    elif ptype == "LAST":
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(x, idx.reshape(-1, 1, 1).astype(jnp.int32),
+                                  axis=1).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool type {ptype}")
+    return {"Out": [out], "MaxIndex": [jnp.zeros((1,), jnp.int32)]}
+
+
+@register_op("sequence_softmax", nondiff_inputs=("Length",))
+def _sequence_softmax(ins, attrs, ctx):
+    x = ins["X"][0]
+    if ins.get("Length"):
+        m = _mask(ins["Length"][0], x.shape[1], x.dtype)
+        x = jnp.where(m > 0, x, -1e9)
+        return {"Out": [jax.nn.softmax(x, axis=1) * m]}
+    return {"Out": [jax.nn.softmax(x, axis=1)]}
+
+
+@register_op("sequence_expand", nondiff_inputs=("Y",))
+def _sequence_expand(ins, attrs, ctx):
+    # padded analog: broadcast x rows to y's time dim
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.broadcast_to(x[:, None], (x.shape[0], y.shape[1])
+                                     + x.shape[1:])]}
+
+
+@register_op("sequence_reverse", nondiff_inputs=("Length",))
+def _sequence_reverse(ins, attrs, ctx):
+    x = ins["X"][0]
+    if not ins.get("Length"):
+        return {"Y": [jnp.flip(x, axis=1)]}
+    length = ins["Length"][0]
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    rev = jnp.where(idx < length.reshape(-1, 1),
+                    length.reshape(-1, 1) - 1 - idx, idx)
+    return {"Y": [jnp.take_along_axis(
+        x, rev.astype(jnp.int32).reshape(rev.shape + (1,) * (x.ndim - 2)),
+        axis=1)]}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ins, attrs, ctx):
+    return {"Out": [jnp.concatenate(ins["X"], axis=1)]}
+
+
+# --- layer functions --------------------------------------------------------
+def _seq_layer(op_type, out_slot="Out"):
+    def f(input, length=None, **attrs):
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(dtype=input.dtype)
+        inputs = {"X": [input]}
+        if length is not None:
+            inputs["Length"] = [length]
+        op = helper.append_op(op_type, inputs=inputs,
+                              outputs={out_slot: [out]}, attrs=attrs)
+        return op[out_slot][0] if in_dygraph_mode() else out
+    f.__name__ = op_type
+    return f
+
+
+def sequence_pool(input, pool_type="sum", length=None, is_test=False):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mi = helper.create_variable_for_type_inference(dtype="int32",
+                                                   stop_gradient=True)
+    inputs = {"X": [input]}
+    if length is not None:
+        inputs["Length"] = [length]
+    op = helper.append_op("sequence_pool", inputs=inputs,
+                          outputs={"Out": [out], "MaxIndex": [mi]},
+                          attrs={"pooltype": pool_type.upper()})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+sequence_softmax = _seq_layer("sequence_softmax")
+sequence_reverse = _seq_layer("sequence_reverse", "Y")
+
+
+def sequence_expand(x, y, ref_level=-1):
+    helper = LayerHelper("sequence_expand")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("sequence_expand", inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    helper = LayerHelper("sequence_mask")
+    out = helper.create_variable_for_type_inference(dtype=dtype,
+                                                    stop_gradient=True)
+    op = helper.append_op("sequence_mask", inputs={"X": [x]},
+                          outputs={"Y": [out]},
+                          attrs={"maxlen": maxlen or -1, "out_dtype": dtype})
+    return op["Y"][0] if in_dygraph_mode() else out
+
+
+def sequence_pad(x, pad_value, maxlen=None):
+    # inputs are already padded in this design; identity + lengths
+    return x, None
+
+
+def sequence_unpad(x, length):
+    return x

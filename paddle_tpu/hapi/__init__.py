@@ -1,0 +1,3 @@
+"""High-level API (reference python/paddle/hapi/model.py)."""
+from .model import Model, Input
+from . import callbacks

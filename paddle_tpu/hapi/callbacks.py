@@ -1,0 +1,109 @@
+"""hapi callbacks (reference python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            def dispatch(*a, **k):
+                for c in self.callbacks:
+                    getattr(c, name)(*a, **k)
+            return dispatch
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        self._losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        loss = logs.get("loss", [0])[0] if logs else 0
+        self._losses.append(loss)
+        if self.verbose and step % self.log_freq == 0:
+            print(f"epoch {self._epoch} step {step}: "
+                  f"loss {np.mean(self._losses[-self.log_freq:]):.5f}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            print(f"epoch {epoch} done in {dt:.1f}s: {logs}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", patience=0, mode="min",
+                 min_delta=0, baseline=None):
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stopped_epoch = 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        v = (logs or {}).get(self.monitor)
+        if v is None:
+            return
+        better = self.best is None or (v < self.best if self.mode == "min"
+                                       else v > self.best)
+        if better:
+            self.best = v
+            self.wait = 0
+        else:
+            self.wait += 1
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step and hasattr(self.model._optimizer, "_lr"):
+            lr = self.model._optimizer._lr
+            if hasattr(lr, "step"):
+                lr.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch and hasattr(self.model._optimizer, "_lr"):
+            lr = self.model._optimizer._lr
+            if hasattr(lr, "step"):
+                lr.step()

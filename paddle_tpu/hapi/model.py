@@ -1,0 +1,187 @@
+"""hapi.Model — Keras-like fit/evaluate/predict (reference
+python/paddle/hapi/model.py:808, fit:1296).  Dygraph-backed: the wrapped
+network is a dygraph Layer; fit() iterates the DataLoader, runs
+forward/backward eagerly (each op an XLA call), steps the optimizer."""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..dygraph.base import guard, to_variable, VarBase
+from ..dygraph.layers import Layer
+from ..fluid.framework import in_dygraph_mode, _dygraph_tracer
+from . import callbacks as cb_mod
+
+
+class Input:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = (metrics if isinstance(metrics, (list, tuple))
+                         else [metrics]) if metrics else []
+        return self
+
+    # -- core steps ----------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
+        lbs = [to_variable(np.asarray(x)) for x in _as_list(labels)]
+        outs = self.network(*ins)
+        outs_l = _as_list(outs)
+        loss = self._loss(*outs_l, *lbs) if self._loss else outs_l[0]
+        final = loss
+        if final.shape not in ((), (1,)):
+            from ..fluid import layers as L
+            final = L.nn.mean(final)
+        final.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = [self._eval_metric(m, outs_l, lbs) for m in self._metrics]
+        return [float(np.asarray(final.numpy()).reshape(-1)[0])] + metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
+        lbs = [to_variable(np.asarray(x)) for x in _as_list(labels)]
+        outs = _as_list(self.network(*ins))
+        loss = self._loss(*outs, *lbs) if self._loss else outs[0]
+        metrics = [self._eval_metric(m, outs, lbs) for m in self._metrics]
+        lv = float(np.asarray(loss.numpy()).reshape(-1)[0]) \
+            if hasattr(loss, "numpy") else float(loss)
+        return [lv] + metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
+        outs = _as_list(self.network(*ins))
+        return [o.numpy() for o in outs]
+
+    def _eval_metric(self, metric, outs, labels):
+        from ..fluid.layers.metric_op import accuracy as acc_layer
+        try:
+            acc = acc_layer(outs[0], labels[0])
+            return float(np.asarray(acc.numpy()).reshape(-1)[0])
+        except Exception:
+            return 0.0
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        loader = _as_loader(train_data, batch_size, shuffle, drop_last)
+        cbs = cb_mod.CallbackList(callbacks or [cb_mod.ProgBarLogger(log_freq,
+                                                                     verbose)])
+        cbs.set_model(self)
+        cbs.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            losses = []
+            for step, batch in enumerate(loader):
+                ins, lbs = _split_batch(batch)
+                vals = self.train_batch(ins, lbs)
+                losses.append(vals[0])
+                cbs.on_train_batch_end(step, {"loss": vals})
+            logs = {"loss": float(np.mean(losses))}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                logs["eval_loss"] = self.evaluate(eval_data,
+                                                  batch_size)["loss"]
+            history.append(logs)
+            cbs.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+        cbs.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = _as_loader(eval_data, batch_size, False, False)
+        losses, metrics = [], []
+        for batch in loader:
+            ins, lbs = _split_batch(batch)
+            vals = self.eval_batch(ins, lbs)
+            losses.append(vals[0])
+            if len(vals) > 1:
+                metrics.append(vals[1:])
+        out = {"loss": float(np.mean(losses))}
+        if metrics:
+            out["metrics"] = np.mean(np.asarray(metrics), axis=0).tolist()
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False)
+        outs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch)
+            outs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        from ..dygraph.checkpoint import save_dygraph
+        save_dygraph(self.network.state_dict(), path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..dygraph.checkpoint import load_dygraph
+        params, _ = load_dygraph(path)
+        self.network.set_dict(params)
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"Model: {type(self.network).__name__}"]
+        total = 0
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name:50s} {str(p.shape):20s} {n}")
+        lines.append(f"Total params: {total:,}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _split_batch(batch):
+    items = _as_list(batch)
+    if len(items) >= 2:
+        return items[:-1], items[-1:]
+    return items, []
+
+
+def _as_loader(data, batch_size, shuffle, drop_last):
+    from ..fluid.reader import DataLoader
+    if data is None:
+        return []
+    if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      drop_last=drop_last)

@@ -1,0 +1,3 @@
+"""Inference API (reference paddle/fluid/inference/, SURVEY §2.7)."""
+from .predictor import (AnalysisConfig, AnalysisPredictor,
+                        create_paddle_predictor, Config, create_predictor)

@@ -1,0 +1,102 @@
+"""Inference predictor (reference paddle/fluid/inference/api/
+analysis_predictor.cc, SURVEY §3.5).
+
+AnalysisPredictor analog: load exported model -> clone for_test (the
+OptimizeInferenceProgram role — fusion is XLA's) -> AOT-compile the block
+once (NaiveExecutor binds ops once, here jit caches the executable) ->
+ZeroCopyRun = one device-program launch."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid import core
+from ..fluid.executor import Executor
+from ..fluid.io import load_inference_model
+
+
+class AnalysisConfig:
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self.model_dir = model_dir
+        self._use_tpu = True
+        self._mem_pool_mb = 0
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    def enable_use_tpu(self, device_id=0):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def enable_tensorrt_engine(self, **kw):
+        pass  # TRT has no meaning on TPU; XLA is the engine
+
+
+Config = AnalysisConfig
+
+
+class _ZeroCopyTensor:
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr):
+        self._p._feed[self._name] = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return self._p._results[self._name]
+
+    def reshape(self, shape):
+        pass
+
+
+class AnalysisPredictor:
+    def __init__(self, config: AnalysisConfig):
+        self._config = config
+        place = (core.TPUPlace(0) if config._use_tpu
+                 and core.is_compiled_with_tpu() else core.CPUPlace())
+        self._exe = Executor(place)
+        self._program, self._feed_names, self._fetch_vars = \
+            load_inference_model(config.model_dir, self._exe)
+        self._fetch_names = [v.name for v in self._fetch_vars]
+        self._feed = {}
+        self._results = {}
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name):
+        return _ZeroCopyTensor(self, name, True)
+
+    get_input_handle = get_input_tensor
+
+    def get_output_tensor(self, name):
+        return _ZeroCopyTensor(self, name, False)
+
+    get_output_handle = get_output_tensor
+
+    def zero_copy_run(self):
+        outs = self._exe.run(self._program, feed=self._feed,
+                             fetch_list=self._fetch_names)
+        self._results = dict(zip(self._fetch_names, outs))
+
+    ZeroCopyRun = zero_copy_run
+    run = zero_copy_run
+
+
+def create_paddle_predictor(config):
+    return AnalysisPredictor(config)
+
+
+create_predictor = create_paddle_predictor

@@ -1,0 +1,4 @@
+"""paddle.metric 2.0 (reference python/paddle/metric/)."""
+from ..fluid.metrics import Accuracy, Auc, CompositeMetric
+from ..fluid.metrics import MetricBase as Metric
+from ..fluid.layers.metric_op import accuracy, auc

@@ -1,0 +1,7 @@
+"""Model zoo: the reference's flagship workloads (BASELINE configs 1-5)."""
+from .bert import (BertModel, BertForPretraining,
+                   BertForSequenceClassification, ErnieModel, bert_base,
+                   bert_large)
+from .transformer import TransformerModel
+from .ctr import WideDeep, DeepFM
+from ..vision.models import LeNet, ResNet, resnet50

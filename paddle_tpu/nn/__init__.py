@@ -1,0 +1,16 @@
+"""paddle.nn 2.0 namespace (reference python/paddle/nn/) — Layer classes +
+functional API over the shared dygraph/static op-builders."""
+from ..dygraph.layers import Layer, Sequential, LayerList, ParameterList
+from ..dygraph.nn import (Linear, Conv2D, Pool2D, BatchNorm, Embedding,
+                          LayerNorm, Dropout, PRelu)
+from . import functional
+from .layer import (ReLU, GELU, Sigmoid, Tanh, Softmax, LeakyReLU, SiLU,
+                    Conv2DTranspose, MaxPool2D, AvgPool2D,
+                    AdaptiveAvgPool2D, BatchNorm2D, GroupNorm, InstanceNorm2D,
+                    CrossEntropyLoss, MSELoss, L1Loss, BCELoss, NLLLoss,
+                    KLDivLoss, SmoothL1Loss, MultiHeadAttention,
+                    TransformerEncoderLayer, TransformerEncoder,
+                    TransformerDecoderLayer, TransformerDecoder, Transformer,
+                    LSTM, GRU, SimpleRNN, Pad2D, Upsample, Flatten)
+
+Conv2d = Conv2D  # historical alias

@@ -1,0 +1,105 @@
+"""paddle.nn.functional (reference python/paddle/nn/functional/) — mode-
+agnostic functional ops delegating to the shared op-builders."""
+from __future__ import annotations
+
+from ..fluid import layers as L
+from ..fluid.layers import nn as _nn
+
+relu = _nn.relu
+gelu = _nn.gelu
+sigmoid = _nn.sigmoid
+tanh = _nn.tanh
+silu = _nn.silu
+leaky_relu = _nn.leaky_relu
+elu = _nn.elu
+selu = _nn.selu
+softplus = _nn.softplus
+hardswish = _nn.hard_swish
+hardsigmoid = _nn.hard_sigmoid
+mish = _nn.mish
+swish = _nn.swish
+softmax = L.softmax
+log_softmax = L.log_softmax
+dropout = L.dropout
+embedding = L.embedding
+one_hot = L.one_hot
+pad = L.pad
+label_smooth = L.label_smooth
+cross_entropy = L.softmax_with_cross_entropy
+square_error_cost = L.square_error_cost
+sigmoid_cross_entropy_with_logits = L.sigmoid_cross_entropy_with_logits
+binary_cross_entropy = L.loss.log_loss
+kl_div = L.kldiv_loss
+mse_loss = L.mse_loss
+normalize = L.l2_normalize
+
+
+def linear(x, weight, bias=None):
+    out = L.matmul(x, weight)
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=-1)
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    from ..fluid.framework import in_dygraph_mode, _dygraph_tracer
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper("conv2d")
+    stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+    attrs = {"strides": stride, "paddings": padding, "dilations": dilation,
+             "groups": groups, "data_format": data_format}
+    if in_dygraph_mode():
+        return _dygraph_tracer().trace_op(
+            "conv2d", {"Input": [x], "Filter": [weight]},
+            {"Output": [None]}, attrs)["Output"][0] if bias is None else \
+            L.elementwise_add(_dygraph_tracer().trace_op(
+                "conv2d", {"Input": [x], "Filter": [weight]},
+                {"Output": [None]}, attrs)["Output"][0], bias, axis=1)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("conv2d", inputs={"Input": [x], "Filter": [weight]},
+                     outputs={"Output": [out]}, attrs=attrs)
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=1)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0):
+    return L.pool2d(x, kernel_size, "max", stride or kernel_size, padding)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0):
+    return L.pool2d(x, kernel_size, "avg", stride or kernel_size, padding)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    return L.adaptive_pool2d(x, output_size, "avg")
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    from ..fluid.framework import _dygraph_tracer
+    return _dygraph_tracer().trace_op(
+        "batch_norm",
+        {"X": [x], "Scale": [weight], "Bias": [bias],
+         "Mean": [running_mean], "Variance": [running_var]},
+        {"Y": [None]},
+        {"momentum": momentum, "epsilon": epsilon,
+         "is_test": not training, "data_layout": data_format})["Y"][0]
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    from ..fluid.framework import _dygraph_tracer
+    shape = ([normalized_shape] if isinstance(normalized_shape, int)
+             else list(normalized_shape))
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    begin = len(x.shape) - len(shape)
+    return _dygraph_tracer().trace_op(
+        "layer_norm", ins, {"Y": [None]},
+        {"epsilon": epsilon, "begin_norm_axis": begin})["Y"][0]

@@ -1,0 +1,567 @@
+"""paddle.nn 2.0 Layer classes (reference python/paddle/nn/layer/*.py:
+activation, common, conv, loss, norm, pooling, rnn, transformer, vision).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..dygraph.layers import Layer, Sequential, LayerList
+from ..dygraph.nn import Linear, Conv2D, BatchNorm, Embedding, LayerNorm, \
+    Dropout
+from ..fluid import layers as L
+from ..fluid.framework import _dygraph_tracer
+from ..fluid.layer_helper import LayerHelper
+from ..fluid.initializer import ConstantInitializer
+
+
+# --- activations -------------------------------------------------------------
+def _act_layer(fname):
+    class _Act(Layer):
+        def forward(self, x):
+            return getattr(L.nn, fname)(x)
+    _Act.__name__ = fname.title().replace("_", "")
+    return _Act
+
+
+ReLU = _act_layer("relu")
+GELU = _act_layer("gelu")
+Sigmoid = _act_layer("sigmoid")
+Tanh = _act_layer("tanh")
+SiLU = _act_layer("silu")
+Mish = _act_layer("mish")
+Hardswish = _act_layer("hard_swish")
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return L.nn.leaky_relu(x, alpha=self._slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return L.softmax(x, axis=self._axis)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start, self._stop = start_axis, stop_axis
+
+    def forward(self, x):
+        return _dygraph_tracer().trace_op(
+            "flatten_contiguous_range", {"X": [x]}, {"Out": [None]},
+            {"start_axis": self._start, "stop_axis": self._stop})["Out"][0]
+
+
+# --- conv/pool/norm ----------------------------------------------------------
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        helper = LayerHelper("conv2d_transpose")
+        ks = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
+        self._attrs = {"strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+                       "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+                       "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+                       "groups": groups}
+        self.weight = helper.create_parameter(
+            weight_attr, [in_channels, out_channels // groups] + ks, "float32")
+        self.bias = helper.create_parameter(bias_attr, [out_channels],
+                                            "float32", is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        out = _dygraph_tracer().trace_op(
+            "conv2d_transpose", {"Input": [x], "Filter": [self.weight]},
+            {"Output": [None]}, self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = L.elementwise_add(out, self.bias, axis=1)
+        return out
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride or kernel_size, padding
+
+    def forward(self, x):
+        return L.pool2d(x, self._k, "max", self._s, self._p)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride or kernel_size, padding
+
+    def forward(self, x):
+        return L.pool2d(x, self._k, "avg", self._s, self._p)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self._size = output_size
+
+    def forward(self, x):
+        if self._size in (1, (1, 1), [1, 1]):
+            return L.pool2d(x, global_pooling=True, pool_type="avg")
+        return L.adaptive_pool2d(x, self._size, "avg")
+
+
+class BatchNorm2D(BatchNorm):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(num_features, momentum=momentum, epsilon=epsilon,
+                         param_attr=weight_attr, bias_attr=bias_attr,
+                         data_layout=data_format)
+
+
+BatchNorm1D = BatchNorm2D
+BatchNorm3D = BatchNorm2D
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        helper = LayerHelper("group_norm")
+        self.weight = helper.create_parameter(
+            weight_attr, [num_channels], "float32",
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [num_channels],
+                                            "float32", is_bias=True)
+        self._groups, self._eps = num_groups, epsilon
+
+    def forward(self, x):
+        return _dygraph_tracer().trace_op(
+            "group_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            {"Y": [None]},
+            {"groups": self._groups, "epsilon": self._eps})["Y"][0]
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        helper = LayerHelper("instance_norm")
+        self.weight = helper.create_parameter(
+            weight_attr, [num_features], "float32",
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [num_features],
+                                            "float32", is_bias=True)
+        self._eps = epsilon
+
+    def forward(self, x):
+        return _dygraph_tracer().trace_op(
+            "instance_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            {"Y": [None]}, {"epsilon": self._eps})["Y"][0]
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._padding = padding if not isinstance(padding, int) else [padding] * 4
+        self._mode, self._value, self._fmt = mode, value, data_format
+
+    def forward(self, x):
+        return L.pad2d(x, self._padding, self._mode, self._value, self._fmt)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self._size, self._scale = size, scale_factor
+        self._mode = mode
+
+    def forward(self, x):
+        op = {"nearest": "nearest_interp", "bilinear": "bilinear_interp",
+              "bicubic": "bicubic_interp"}[self._mode]
+        attrs = {}
+        if self._size is not None:
+            attrs["out_h"], attrs["out_w"] = self._size
+        else:
+            attrs["scale"] = float(self._scale)
+        return _dygraph_tracer().trace_op(op, {"X": [x]}, {"Out": [None]},
+                                          attrs)["Out"][0]
+
+
+# --- losses ------------------------------------------------------------------
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1):
+        super().__init__()
+        self._ignore = ignore_index
+        self._reduction = reduction
+        self._soft = soft_label
+
+    def forward(self, input, label):
+        loss = L.softmax_with_cross_entropy(input, label,
+                                            soft_label=self._soft,
+                                            ignore_index=self._ignore)
+        if self._reduction == "mean":
+            return L.nn.mean(loss)
+        if self._reduction == "sum":
+            return L.nn.reduce_sum(loss)
+        return loss
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        loss = L.square_error_cost(input, label)
+        if self._reduction == "mean":
+            return L.nn.mean(loss)
+        if self._reduction == "sum":
+            return L.nn.reduce_sum(loss)
+        return loss
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        loss = L.nn.abs(input - label)
+        if self._reduction == "mean":
+            return L.nn.mean(loss)
+        if self._reduction == "sum":
+            return L.nn.reduce_sum(loss)
+        return loss
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        t = _dygraph_tracer()
+        loss = t.trace_op("bce_loss", {"X": [input], "Label": [label]},
+                          {"Out": [None]}, {})["Out"][0]
+        if self._reduction == "mean":
+            return L.nn.mean(loss)
+        if self._reduction == "sum":
+            return L.nn.reduce_sum(loss)
+        return loss
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean"):
+        super().__init__()
+        self._ignore, self._reduction = ignore_index, reduction
+
+    def forward(self, input, label):
+        t = _dygraph_tracer()
+        return t.trace_op("nll_loss", {"X": [input], "Label": [label]},
+                          {"Out": [None]},
+                          {"ignore_index": self._ignore,
+                           "reduction": self._reduction})["Out"][0]
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return L.kldiv_loss(input, label, self._reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self._delta, self._reduction = delta, reduction
+
+    def forward(self, input, label):
+        loss = L.huber_loss(input, label, self._delta)
+        if self._reduction == "mean":
+            return L.nn.mean(loss)
+        if self._reduction == "sum":
+            return L.nn.reduce_sum(loss)
+        return loss
+
+
+# --- transformer -------------------------------------------------------------
+class MultiHeadAttention(Layer):
+    """Reference python/paddle/nn/layer/transformer.py MultiHeadAttention,
+    lowered onto the fused attention op (ops/attention.py — Pallas flash
+    attention on TPU for long sequences)."""
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None,
+                 vdim=None, need_weights=False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout = dropout
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim or embed_dim, embed_dim, weight_attr,
+                             bias_attr)
+        self.v_proj = Linear(vdim or embed_dim, embed_dim, weight_attr,
+                             bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        b = query.shape[0]
+        tq = query.shape[1]
+        h, d = self.num_heads, self.head_dim
+
+        def heads(x, t):
+            x = L.reshape(x, [b, t, h, d])
+            return L.transpose(x, [0, 2, 1, 3])
+
+        q = heads(self.q_proj(query), tq)
+        k = heads(self.k_proj(key), key.shape[1])
+        v = heads(self.v_proj(value), value.shape[1])
+        t = _dygraph_tracer()
+        ins = {"Q": [q], "K": [k], "V": [v]}
+        if attn_mask is not None:
+            ins["Mask"] = [attn_mask]
+        out = t.trace_op("fused_multihead_attention", ins, {"Out": [None]},
+                         {"scale": 1.0 / math.sqrt(d)})["Out"][0]
+        out = L.reshape(L.transpose(out, [0, 2, 1, 3]), [b, tq, h * d])
+        if self.dropout:
+            out = L.dropout(out, self.dropout, is_test=not self.training,
+                            dropout_implementation="upscale_in_train")
+        return self.out_proj(out)
+
+
+class TransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            attn_dropout or dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self._dropout = dropout
+        self._act = activation
+        self._pre_norm = normalize_before
+
+    def _drop(self, x):
+        if self._dropout:
+            return L.dropout(x, self._dropout, is_test=not self.training,
+                             dropout_implementation="upscale_in_train")
+        return x
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self._pre_norm:
+            src = self.norm1(src)
+        src = self.self_attn(src, src, src, src_mask)
+        src = residual + self._drop(src)
+        if not self._pre_norm:
+            src = self.norm1(src)
+        residual = src
+        if self._pre_norm:
+            src = self.norm2(src)
+        src = self.linear2(self._drop(getattr(L.nn, self._act)(
+            self.linear1(src))))
+        src = residual + self._drop(src)
+        if not self._pre_norm:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        self.layers = LayerList([encoder_layer] + [
+            copy.deepcopy(encoder_layer) for _ in range(num_layers - 1)])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class TransformerDecoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
+                 activation="relu", attn_dropout=None, act_dropout=None,
+                 normalize_before=False):
+        super().__init__()
+        self.self_attn = MultiHeadAttention(d_model, nhead,
+                                            attn_dropout or dropout)
+        self.cross_attn = MultiHeadAttention(d_model, nhead,
+                                             attn_dropout or dropout)
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self._dropout = dropout
+        self._act = activation
+        self._pre_norm = normalize_before
+
+    def _drop(self, x):
+        if self._dropout:
+            return L.dropout(x, self._dropout, is_test=not self.training,
+                             dropout_implementation="upscale_in_train")
+        return x
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self._pre_norm:
+            tgt = self.norm1(tgt)
+        tgt = residual + self._drop(self.self_attn(tgt, tgt, tgt, tgt_mask))
+        if not self._pre_norm:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self._pre_norm:
+            tgt = self.norm2(tgt)
+        tgt = residual + self._drop(
+            self.cross_attn(tgt, memory, memory, memory_mask))
+        if not self._pre_norm:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self._pre_norm:
+            tgt = self.norm3(tgt)
+        tgt = residual + self._drop(self.linear2(self._drop(
+            getattr(L.nn, self._act)(self.linear1(tgt)))))
+        if not self._pre_norm:
+            tgt = self.norm3(tgt)
+        return tgt
+
+
+class TransformerDecoder(Layer):
+    def __init__(self, decoder_layer, num_layers, norm=None):
+        super().__init__()
+        import copy
+        self.layers = LayerList([decoder_layer] + [
+            copy.deepcopy(decoder_layer) for _ in range(num_layers - 1)])
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None):
+        out = tgt
+        for layer in self.layers:
+            out = layer(out, memory, tgt_mask, memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out
+
+
+class Transformer(Layer):
+    def __init__(self, d_model=512, nhead=8, num_encoder_layers=6,
+                 num_decoder_layers=6, dim_feedforward=2048, dropout=0.1,
+                 activation="relu", normalize_before=False):
+        super().__init__()
+        enc = TransformerEncoderLayer(d_model, nhead, dim_feedforward,
+                                      dropout, activation,
+                                      normalize_before=normalize_before)
+        dec = TransformerDecoderLayer(d_model, nhead, dim_feedforward,
+                                      dropout, activation,
+                                      normalize_before=normalize_before)
+        self.encoder = TransformerEncoder(enc, num_encoder_layers)
+        self.decoder = TransformerDecoder(dec, num_decoder_layers)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+
+# --- RNN ---------------------------------------------------------------------
+class _RNNBase(Layer):
+    MODE = "LSTM"
+    GATES = 4
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", dropout=0.0, time_major=False,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        helper = LayerHelper(self.MODE.lower())
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self._weights = []
+        for l in range(num_layers):
+            in_d = input_size if l == 0 else hidden_size
+            g = self.GATES
+            wi = helper.create_parameter(weight_ih_attr,
+                                         [g * hidden_size, in_d], "float32")
+            wh = helper.create_parameter(weight_hh_attr,
+                                         [g * hidden_size, hidden_size],
+                                         "float32")
+            bi = helper.create_parameter(bias_ih_attr, [g * hidden_size],
+                                         "float32", is_bias=True)
+            bh = helper.create_parameter(bias_hh_attr, [g * hidden_size],
+                                         "float32", is_bias=True)
+            for i, w in enumerate((wi, wh, bi, bh)):
+                self.add_parameter(f"l{l}_{i}", w)
+            self._weights += [wi, wh, bi, bh]
+
+    def forward(self, inputs, initial_states=None):
+        import jax.numpy as jnp
+        from ..dygraph.base import VarBase
+        b = inputs.shape[0]
+        if initial_states is None:
+            z = VarBase(jnp.zeros((self.num_layers, b, self.hidden_size),
+                                  jnp.float32), stop_gradient=True)
+            states = [z, z.clone()] if self.MODE == "LSTM" else [z]
+        else:
+            states = (list(initial_states)
+                      if isinstance(initial_states, (list, tuple))
+                      else [initial_states])
+        t = _dygraph_tracer()
+        outs = t.trace_op(
+            "rnn_scan",
+            {"Input": [inputs], "WeightList": self._weights,
+             "PreState": states},
+            {"Out": [None]},
+            {"mode": self.MODE, "num_layers": self.num_layers})
+        out = outs["Out"][0]
+        st = outs["State"]
+        if self.MODE == "LSTM":
+            return out, (st[0], st[1])
+        return out, st[0]
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "GRU"   # simple RNN via GRU machinery
+    GATES = 3

@@ -1,0 +1,16 @@
+"""Op lowering library — importing this package registers every op.
+
+The registry (registry.py) is the analog of the reference's static-init
+REGISTER_OPERATOR tables (paddle/fluid/framework/op_registry.h).
+"""
+from . import registry
+from .registry import register_op, get_op, has_op, all_ops, LoweringContext
+
+from . import math            # noqa: F401  elementwise/activation/matmul
+from . import manipulation    # noqa: F401  reshape/gather/creation
+from . import reduction       # noqa: F401  reductions/topk/sort
+from . import nn_ops          # noqa: F401  conv/pool/norm/dropout
+from . import loss_ops        # noqa: F401  losses/metrics
+from . import random_ops      # noqa: F401  RNG ops
+from . import optimizer_ops   # noqa: F401  optimizer updates + AMP
+from . import collective_ops  # noqa: F401  ICI collectives

@@ -1,0 +1,74 @@
+"""Fused attention ops.
+
+Reference: paddle/fluid/operators/fused/multihead_matmul_op.cu (fused
+transformer attention) and math/bert_encoder_functor.cu (SURVEY §2.5 fused/).
+TPU-native: one `fused_multihead_attention` op whose lowering is (a) a Pallas
+flash-attention kernel on TPU for long sequences (pallas_kernels.py), or
+(b) an XLA-fused softmax(QK^T)V otherwise.  The op boundary is what enables
+kernel substitution without touching model code.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_PALLAS_MIN_SEQ = 1024     # below this XLA fusion is already near-roofline
+
+
+def _reference_attention(q, k, v, mask, scale, causal):
+    # q,k,v: [B, H, T, D]
+    acc = jnp.float32
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=acc) * scale
+    if causal:
+        t = s.shape[-1]
+        neg = jnp.finfo(acc).min
+        causal_mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(causal_mask[None, None], s, neg)
+    if mask is not None:
+        s = s + mask.astype(acc)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def flash_attention(q, k, v, mask=None, scale=None, causal=False):
+    """Dispatch to the Pallas TPU kernel when profitable, else XLA."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    seq = q.shape[-2]
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu and seq >= _PALLAS_MIN_SEQ and mask is None:
+        try:
+            from .pallas_kernels import flash_attention_tpu
+            return flash_attention_tpu(q, k, v, scale=scale, causal=causal)
+        except Exception:
+            pass
+    return _reference_attention(q, k, v, mask, scale, causal)
+
+
+@register_op("fused_multihead_attention", nondiff_inputs=("Mask",))
+def _fused_mha(ins, attrs, ctx):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    out = flash_attention(q, k, v, mask,
+                          scale=attrs.get("scale", None),
+                          causal=attrs.get("causal", False))
+    return {"Out": [out]}
+
+
+@register_op("multihead_matmul", nondiff_inputs=("BiasQK",))
+def _multihead_matmul(ins, attrs, ctx):
+    """Reference multihead_matmul_op.cu API: packed QKV input."""
+    x = ins["Input"][0]            # [B, T, 3*H*D]
+    bias_qk = ins["BiasQK"][0] if ins.get("BiasQK") else None
+    h = attrs["head_number"]
+    b, t, c3 = x.shape
+    d = c3 // 3 // h
+    qkv = x.reshape(b, t, 3, h, d).transpose(2, 0, 3, 1, 4)
+    out = flash_attention(qkv[0], qkv[1], qkv[2], bias_qk,
+                          scale=attrs.get("alpha", None))
+    return {"Out": [out.transpose(0, 2, 1, 3).reshape(b, t, h * d)]}

@@ -1,0 +1,205 @@
+"""Collective communication ops — ICI/XLA collectives replace NCCL rings.
+
+Reference: paddle/fluid/operators/collective/ (SURVEY §2.5): c_allreduce_{sum,
+max,min,prod}, c_allgather, c_reducescatter, c_broadcast, c_reduce_*,
+send_v2/recv_v2, barrier, plus bootstrap ops c_gen_nccl_id/c_comm_init.  The
+reference pattern `ring_id -> NCCLCommContext::Instance().Get(rid)` becomes
+`ring_id -> mesh axis name` via LoweringContext.mesh_axes (registered by
+parallel/mesh.py).  Under shard_map over a jax.sharding.Mesh these lower to
+lax.psum/all_gather/ppermute on ICI; outside any mesh they are identity
+(single-replica), mirroring how a 1-GPU NCCL ring degenerates.
+
+Bootstrap ops (c_gen_nccl_id, c_comm_init*, c_sync_*_stream) are no-ops: XLA
+programs are globally scheduled and jax.distributed.initialize is the
+gen_nccl_id analog (SURVEY §5 comm-backend note).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _axis(ctx, attrs):
+    return ctx.axis_for_ring(attrs.get("ring_id", 0))
+
+
+def _allreduce(reducer):
+    def lower(ins, attrs, ctx):
+        x = ins["X"][0]
+        axis = _axis(ctx, attrs)
+        if axis is None:
+            return {"Out": [x]}
+        return {"Out": [reducer(x, axis_name=axis)]}
+    return lower
+
+
+register_op("c_allreduce_sum", _allreduce(lax.psum))
+register_op("c_allreduce_max", _allreduce(lax.pmax))
+register_op("c_allreduce_min", _allreduce(lax.pmin))
+register_op("c_allreduce_prod", _allreduce(
+    lambda x, axis_name: jnp.exp(lax.psum(jnp.log(x), axis_name=axis_name))))
+register_op("allreduce", _allreduce(lax.psum))  # legacy operators/nccl era
+register_op("c_allreduce_avg", _allreduce(lax.pmean))
+
+
+@register_op("c_allgather")
+def _c_allgather(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    g = lax.all_gather(x, axis_name=axis)           # (n, ...) leading axis
+    return {"Out": [g.reshape((-1,) + x.shape[1:])]}
+
+
+@register_op("c_reducescatter")
+def _c_reducescatter(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    return {"Out": [lax.psum_scatter(x, axis_name=axis, tiled=True)]}
+
+
+@register_op("c_broadcast")
+def _c_broadcast(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    root = attrs.get("root", 0)
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": [lax.psum(masked, axis_name=axis)]}
+
+
+def _c_reduce(reducer):
+    # result only meaningful on root; we produce it everywhere (SPMD)
+    def lower(ins, attrs, ctx):
+        x = ins["X"][0]
+        axis = _axis(ctx, attrs)
+        if axis is None:
+            return {"Out": [x]}
+        return {"Out": [reducer(x, axis_name=axis)]}
+    return lower
+
+
+register_op("c_reduce_sum", _c_reduce(lax.psum))
+register_op("c_reduce_max", _c_reduce(lax.pmax))
+register_op("c_reduce_min", _c_reduce(lax.pmin))
+register_op("c_reduce_prod", _c_reduce(
+    lambda x, axis_name: jnp.exp(lax.psum(jnp.log(x), axis_name=axis_name))))
+
+
+@register_op("c_scatter")
+def _c_scatter(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    chunks = x.reshape((n, -1) + x.shape[1:])
+    return {"Out": [lax.dynamic_index_in_dim(chunks, idx, keepdims=False)]}
+
+
+@register_op("c_concat")
+def _c_concat(ins, attrs, ctx):
+    # tensor-parallel all-gather along last dim (model-parallel fc output)
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    return {"Out": [lax.all_gather(x, axis_name=axis, axis=x.ndim - 1,
+                                   tiled=True)]}
+
+
+@register_op("c_split")
+def _c_split(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    step = x.shape[-1] // n
+    return {"Out": [lax.dynamic_slice_in_dim(x, idx * step, step, x.ndim - 1)]}
+
+
+@register_op("c_identity")
+def _c_identity(ins, attrs, ctx):
+    # TP forward-identity/backward-allreduce boundary op
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("send_v2", differentiable=False)
+def _send_v2(ins, attrs, ctx):
+    # p2p pipeline send: modeled with ppermute at the pipeline composite level
+    # (parallel/pipeline.py); standalone send lowers to identity + ppermute pair
+    return {}
+
+
+@register_op("recv_v2", differentiable=False)
+def _recv_v2(ins, attrs, ctx):
+    raise NotImplementedError(
+        "p2p recv_v2 must be paired via parallel/pipeline.py stage composition")
+
+
+@register_op("partial_send", differentiable=False)
+def _partial_send(ins, attrs, ctx):
+    return {}
+
+
+@register_op("c_ppermute")
+def _c_ppermute(ins, attrs, ctx):
+    """Native ring shift (no reference analog — exposed for ring attention
+    and pipeline p2p).  attrs: shift (+1 = to next rank)."""
+    x = ins["X"][0]
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    shift = attrs.get("shift", 1)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": [lax.ppermute(x, axis, perm)]}
+
+
+@register_op("barrier", differentiable=False)
+def _barrier(ins, attrs, ctx):
+    x = ins["X"][0] if ins.get("X") else jnp.zeros((1,), jnp.float32)
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    # a psum over a zero token is a full synchronisation point
+    return {"Out": [x + lax.psum(jnp.zeros_like(x), axis_name=axis) * 0]}
+
+
+@register_op("c_sync_calc_stream", differentiable=False)
+def _sync_calc(ins, attrs, ctx):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("c_sync_comm_stream", differentiable=False)
+def _sync_comm(ins, attrs, ctx):
+    return {"Out": list(ins["X"])}
+
+
+for _t in ("c_gen_nccl_id", "c_comm_init", "c_comm_init_all",
+           "c_comm_init_multitrainer", "gen_nccl_id"):
+    register_op(_t, lambda ins, attrs, ctx: {}, differentiable=False)
+
+
+@register_op("c_embedding", nondiff_inputs=("Ids",))
+def _c_embedding(ins, attrs, ctx):
+    """Vocab-sharded (tensor-parallel) embedding: each rank owns rows
+    [start_index, start_index + local_vocab); out-of-range ids contribute
+    zeros which the following c_allreduce_sum fills in."""
+    w, ids = ins["W"][0], ins["Ids"][0].astype(jnp.int32)
+    start = attrs.get("start_index", 0)
+    local = ids - start
+    valid = (local >= 0) & (local < w.shape[0])
+    out = jnp.take(w, jnp.clip(local, 0, w.shape[0] - 1), axis=0)
+    return {"Out": [jnp.where(valid[..., None], out, 0.0)]}

@@ -1,0 +1,276 @@
+"""Loss + metric lowering rules.
+
+Reference: paddle/fluid/operators/{cross_entropy_op,softmax_with_cross_entropy_op,
+sigmoid_cross_entropy_with_logits_op,bce_loss_op,huber_loss_op,smooth_l1_loss_op,
+log_loss_op,kldiv_loss_op,nll_loss_op,label_smooth_op,...}.cc and
+operators/metrics/{accuracy_op,auc_op}.cc (SURVEY §2.5, A.1 Losses/metrics).
+Integer label inputs sit in nondiff slots; softmax_with_cross_entropy uses a
+custom grad (softmax - onehot) matching the fused reference kernel instead of
+differentiating through the log-softmax composition.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    return ins[slot][i]
+
+
+@register_op("cross_entropy", nondiff_inputs=("Label",))
+def _cross_entropy(ins, attrs, ctx):
+    x, label = _x(ins), _x(ins, "Label")
+    ignore_index = attrs.get("ignore_index", -100)
+    if attrs.get("soft_label", False):
+        out = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-12)), axis=-1,
+                       keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == x.ndim:
+            lbl = lbl.squeeze(-1)
+        picked = jnp.take_along_axis(x, lbl[..., None], axis=-1)
+        out = -jnp.log(jnp.clip(picked, 1e-12))
+        out = jnp.where(lbl[..., None] == ignore_index, 0.0, out)
+    return {"Y": [out]}
+
+
+def _softmax_xent_fwd(ins, attrs, ctx):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = attrs.get("axis", -1)
+    softmax = jax.nn.softmax(logits, axis=axis)
+    logsm = jax.nn.log_softmax(logits, axis=axis)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * logsm, axis=axis, keepdims=True)
+    else:
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == logits.ndim:
+            lbl = lbl.squeeze(axis)
+        loss = -jnp.take_along_axis(logsm, lbl[..., None], axis=axis)
+        ignore = attrs.get("ignore_index", -100)
+        loss = jnp.where(lbl[..., None] == ignore, 0.0, loss)
+    return {"Softmax": [softmax], "Loss": [loss]}
+
+
+def _softmax_xent_grad(ins, outs, out_grads, attrs, ctx):
+    # fused backward: d(loss)/d(logits) = softmax - onehot(label), matching
+    # operators/softmax_with_cross_entropy_op.cu's fused kernel
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    softmax = outs["Softmax"][0]
+    gloss = out_grads.get("Loss")
+    axis = attrs.get("axis", -1)
+    if gloss is None:
+        return {"Logits": [jnp.zeros_like(logits)]}
+    if attrs.get("soft_label", False):
+        grad = (softmax - label) * gloss
+    else:
+        lbl = label.astype(jnp.int32)
+        if lbl.ndim == logits.ndim:
+            lbl = lbl.squeeze(axis)
+        onehot = jax.nn.one_hot(lbl, logits.shape[axis], dtype=softmax.dtype,
+                                axis=axis)
+        ignore = attrs.get("ignore_index", -100)
+        mask = (lbl != ignore)[..., None].astype(softmax.dtype)
+        grad = (softmax - onehot) * gloss * mask
+    return {"Logits": [grad.astype(logits.dtype)]}
+
+
+register_op("softmax_with_cross_entropy", _softmax_xent_fwd,
+            nondiff_inputs=("Label",), nondiff_outputs=("Softmax",),
+            custom_grad=_softmax_xent_grad)
+
+
+@register_op("sigmoid_cross_entropy_with_logits", nondiff_inputs=("Label",))
+def _sce(ins, attrs, ctx):
+    x, label = _x(ins), _x(ins, "Label")
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore).astype(x.dtype)
+    loss = loss * mask
+    if attrs.get("normalize", False):
+        loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
+    return {"Out": [loss]}
+
+
+@register_op("bce_loss", nondiff_inputs=("Label",))
+def _bce(ins, attrs, ctx):
+    x, label = _x(ins), _x(ins, "Label")
+    xc = jnp.clip(x, 1e-12, 1.0 - 1e-7)
+    return {"Out": [-(label * jnp.log(xc) + (1 - label) * jnp.log1p(-xc))]}
+
+
+@register_op("log_loss", nondiff_inputs=("Labels",))
+def _log_loss(ins, attrs, ctx):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    return {"Loss": [-label * jnp.log(p + eps)
+                     - (1 - label) * jnp.log(1 - p + eps)]}
+
+
+@register_op("huber_loss", nondiff_inputs=("Y",))
+def _huber(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= d, 0.5 * r * r, d * (ar - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("smooth_l1_loss", nondiff_inputs=("Y", "InsideWeight", "OutsideWeight"))
+def _smooth_l1(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ins.get("OutsideWeight"):
+        loss = loss * ins["OutsideWeight"][0]
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                            keepdims=False).reshape(-1, 1)],
+            "Diff": [diff]}
+
+
+@register_op("mse_loss", nondiff_inputs=("Label",))
+def _mse(ins, attrs, ctx):
+    x, y = ins["Input"][0], ins["Label"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("kldiv_loss", nondiff_inputs=("Target",))
+def _kldiv(ins, attrs, ctx):
+    x, t = _x(ins), _x(ins, "Target")
+    loss = jnp.where(t > 0, t * (jnp.log(t) - x), 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    return {"Loss": [loss]}
+
+
+@register_op("nll_loss", nondiff_inputs=("Label", "Weight"))
+def _nll(ins, attrs, ctx):
+    x, label = _x(ins), ins["Label"][0].astype(jnp.int32)
+    w = ins["Weight"][0] if ins.get("Weight") else jnp.ones((x.shape[1],), x.dtype)
+    ignore = attrs.get("ignore_index", -100)
+    picked = jnp.take_along_axis(x, label[:, None], axis=1).squeeze(1)
+    wl = jnp.take(w, jnp.clip(label, 0), axis=0)
+    mask = (label != ignore).astype(x.dtype)
+    loss = -picked * wl * mask
+    red = attrs.get("reduction", "mean")
+    total_w = jnp.sum(wl * mask)
+    if red == "mean":
+        return {"Out": [jnp.sum(loss) / jnp.maximum(total_w, 1e-12)],
+                "Total_weight": [total_w]}
+    if red == "sum":
+        return {"Out": [jnp.sum(loss)], "Total_weight": [total_w]}
+    return {"Out": [loss], "Total_weight": [total_w]}
+
+
+@register_op("label_smooth", nondiff_inputs=("PriorDist",))
+def _label_smooth(ins, attrs, ctx):
+    x = _x(ins)
+    eps = attrs.get("epsilon", 0.0)
+    k = x.shape[-1]
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - eps) * x + eps * prior]}
+    return {"Out": [(1 - eps) * x + eps / k]}
+
+
+@register_op("hinge_loss", nondiff_inputs=("Labels",))
+def _hinge(ins, attrs, ctx):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)]}
+
+
+@register_op("rank_loss", nondiff_inputs=("Label",))
+def _rank_loss(ins, attrs, ctx):
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.log1p(jnp.exp(d)) - label * d]}
+
+
+@register_op("margin_rank_loss", nondiff_inputs=("Label",))
+def _margin_rank(ins, attrs, ctx):
+    label, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    m = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + m)
+    return {"Out": [out], "Activated": [(out > 0).astype(x1.dtype)]}
+
+
+@register_op("bpr_loss", nondiff_inputs=("Label",))
+def _bpr(ins, attrs, ctx):
+    x, label = _x(ins), ins["Label"][0].astype(jnp.int32)
+    pos = jnp.take_along_axis(x, label, axis=1)
+    diff = pos - x
+    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(diff) + 1e-8), axis=1, keepdims=True)
+    return {"Y": [loss]}
+
+
+# --- metrics ---------------------------------------------------------------
+@register_op("accuracy", differentiable=False)
+def _accuracy(ins, attrs, ctx):
+    pred_idx = ins["Indices"][0].astype(jnp.int64)
+    label = ins["Label"][0].astype(jnp.int64)
+    if label.ndim < pred_idx.ndim:
+        label = label[..., None]
+    correct = jnp.any(pred_idx == label, axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = correct.size
+    return {"Accuracy": [num_correct / total],
+            "Correct": [num_correct.astype(jnp.int32)],
+            "Total": [jnp.asarray(total, jnp.int32)]}
+
+
+@register_op("auc", differentiable=False)
+def _auc(ins, attrs, ctx):
+    """Streaming AUC (operators/metrics/auc_op.cc): histogram-bucketed
+    positive/negative counts carried as persistable state tensors."""
+    preds, labels = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresholds = attrs.get("num_thresholds", 4095)
+    p1 = preds[:, -1] if preds.ndim > 1 else preds
+    idx = jnp.clip((p1 * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    lbl = labels.reshape(-1).astype(jnp.float32)
+    pos_new = stat_pos.reshape(-1).at[idx].add(lbl)
+    neg_new = stat_neg.reshape(-1).at[idx].add(1.0 - lbl)
+    # trapezoid integration over thresholds (descending)
+    pos_c = jnp.cumsum(pos_new[::-1])
+    neg_c = jnp.cumsum(neg_new[::-1])
+    tp, fp = pos_c, neg_c
+    tot_pos, tot_neg = pos_c[-1], neg_c[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg + 1e-12), 0.0)
+    return {"AUC": [auc], "StatPosOut": [pos_new.reshape(stat_pos.shape)],
+            "StatNegOut": [neg_new.reshape(stat_neg.shape)]}
+
+
+@register_op("precision_recall", differentiable=False)
+def _precision_recall(ins, attrs, ctx):
+    raise NotImplementedError("precision_recall: use python metrics instead")
+
+
+@register_op("mean_iou", differentiable=False)
+def _mean_iou(ins, attrs, ctx):
+    pred = ins["Predictions"][0].astype(jnp.int32).reshape(-1)
+    label = ins["Labels"][0].astype(jnp.int32).reshape(-1)
+    n = attrs["num_classes"]
+    cm = jnp.zeros((n, n), jnp.float32).at[label, pred].add(1.0)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return {"OutMeanIou": [mean_iou], "OutWrong": [(cm.sum(1) - inter).astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}

@@ -1,0 +1,405 @@
+"""Dense math + elementwise + activation lowering rules.
+
+Reference inventory: paddle/fluid/operators/{activation_op,matmul_op,mul_op,
+elementwise/*,scale_op,clip_op,...}.cc (SURVEY §2.5, A.1).  Each CUDA kernel
+there becomes a jnp expression here; XLA fuses elementwise chains into the
+surrounding matmul/conv — the fusion passes of framework/ir (fc_fuse,
+fuse_elewise_add_act...) are intentionally absent because the compiler
+performs them (SURVEY §7 design stance).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    return ins[slot][i]
+
+
+# --------------------------------------------------------------------------
+# elementwise binary ops with axis-style numpy broadcasting
+# (operators/elementwise/elementwise_op_function.h semantics: `axis` names the
+# dim of X at which Y's shape aligns; -1 = trailing alignment)
+# --------------------------------------------------------------------------
+def _bcast(x, y, axis):
+    if axis is None or axis == -1 or x.ndim == y.ndim:
+        return x, y
+    # align y's dims starting at `axis` of x
+    expand = [1] * x.ndim
+    for i, d in enumerate(y.shape):
+        expand[axis + i] = d
+    return x, y.reshape(expand)
+
+
+def _ew(name, f):
+    def lower(ins, attrs, ctx):
+        x, y = _bcast(_x(ins), _x(ins, "Y"), attrs.get("axis", -1))
+        out = f(x, y)
+        scale = attrs.get("scale", None)
+        if scale is not None and scale != 1.0:
+            out = out * scale
+        return {"Out": [out]}
+    register_op(name, lower)
+
+
+_ew("elementwise_add", jnp.add)
+_ew("elementwise_sub", jnp.subtract)
+_ew("elementwise_mul", jnp.multiply)
+_ew("elementwise_div", jnp.divide)
+_ew("elementwise_min", jnp.minimum)
+_ew("elementwise_max", jnp.maximum)
+_ew("elementwise_pow", jnp.power)
+_ew("elementwise_mod", jnp.mod)
+_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+@register_op("sum")  # fluid sum op: variadic add (used for grad fan-in)
+def _sum(ins, attrs, ctx):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+# --------------------------------------------------------------------------
+# activations (operators/activation_op.cc — the full list)
+# --------------------------------------------------------------------------
+def _unary(name, f, extra_out=None):
+    def lower(ins, attrs, ctx):
+        out = f(_x(ins), attrs)
+        return {"Out": [out]}
+    register_op(name, lower)
+
+
+_unary("relu", lambda x, a: jax.nn.relu(x))
+_unary("relu6", lambda x, a: jnp.clip(x, 0.0, a.get("threshold", 6.0)))
+_unary("sigmoid", lambda x, a: jax.nn.sigmoid(x))
+_unary("logsigmoid", lambda x, a: jax.nn.log_sigmoid(x))
+_unary("tanh", lambda x, a: jnp.tanh(x))
+_unary("tanh_shrink", lambda x, a: x - jnp.tanh(x))
+_unary("gelu", lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", False)))
+_unary("erf", lambda x, a: lax.erf(x))
+_unary("exp", lambda x, a: jnp.exp(x))
+_unary("log", lambda x, a: jnp.log(x))
+_unary("log2", lambda x, a: jnp.log2(x))
+_unary("log10", lambda x, a: jnp.log10(x))
+_unary("log1p", lambda x, a: jnp.log1p(x))
+_unary("sqrt", lambda x, a: jnp.sqrt(x))
+_unary("rsqrt", lambda x, a: lax.rsqrt(x))
+_unary("square", lambda x, a: jnp.square(x))
+_unary("abs", lambda x, a: jnp.abs(x))
+_unary("ceil", lambda x, a: jnp.ceil(x))
+_unary("floor", lambda x, a: jnp.floor(x))
+_unary("round", lambda x, a: jnp.round(x))
+_unary("reciprocal", lambda x, a: jnp.reciprocal(x))
+_unary("sign", lambda x, a: jnp.sign(x))
+_unary("sin", lambda x, a: jnp.sin(x))
+_unary("cos", lambda x, a: jnp.cos(x))
+_unary("tan", lambda x, a: jnp.tan(x))
+_unary("asin", lambda x, a: jnp.arcsin(x))
+_unary("acos", lambda x, a: jnp.arccos(x))
+_unary("atan", lambda x, a: jnp.arctan(x))
+_unary("sinh", lambda x, a: jnp.sinh(x))
+_unary("cosh", lambda x, a: jnp.cosh(x))
+_unary("softplus", lambda x, a: jax.nn.softplus(x))
+_unary("softsign", lambda x, a: jax.nn.soft_sign(x))
+_unary("softshrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("lambda", 0.5),
+    x - jnp.sign(x) * a.get("lambda", 0.5), 0.0))
+_unary("hard_shrink", lambda x, a: jnp.where(
+    jnp.abs(x) > a.get("threshold", 0.5), x, 0.0))
+_unary("hard_sigmoid", lambda x, a: jnp.clip(
+    a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0))
+_unary("hard_swish", lambda x, a: x * jnp.clip(
+    x + a.get("offset", 3.0), 0.0, a.get("threshold", 6.0)) / a.get("scale", 6.0))
+_unary("swish", lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x))
+_unary("mish", lambda x, a: x * jnp.tanh(jax.nn.softplus(x)))
+_unary("selu", lambda x, a: a.get("scale", 1.0507009873554805) * jnp.where(
+    x > 0, x, a.get("alpha", 1.6732632423543772) * (jnp.exp(x) - 1)))
+_unary("elu", lambda x, a: jnp.where(
+    x > 0, x, a.get("alpha", 1.0) * (jnp.exp(x) - 1)))
+_unary("leaky_relu", lambda x, a: jnp.where(x > 0, x, a.get("alpha", 0.02) * x))
+_unary("brelu", lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)))
+_unary("thresholded_relu", lambda x, a: jnp.where(
+    x > a.get("threshold", 1.0), x, 0.0))
+_unary("stanh", lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+    a.get("scale_a", 0.67) * x))
+_unary("silu", lambda x, a: jax.nn.silu(x))
+_unary("logit", lambda x, a: jax.scipy.special.logit(
+    jnp.clip(x, a.get("eps", 1e-6), 1 - a.get("eps", 1e-6))))
+
+
+@register_op("prelu")
+def _prelu(ins, attrs, ctx):
+    x, alpha = _x(ins), _x(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and alpha.ndim < x.ndim:
+        shape = [1] * x.ndim
+        shape[1] = alpha.size
+        alpha = alpha.reshape(shape)
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op("pow")
+def _pow(ins, attrs, ctx):
+    f = ins.get("FactorTensor")
+    factor = f[0] if f else attrs.get("factor", 1.0)
+    return {"Out": [jnp.power(_x(ins), factor)]}
+
+
+@register_op("scale")
+def _scale(ins, attrs, ctx):
+    x = _x(ins)
+    s = ins.get("ScaleTensor")
+    scale = s[0] if s else attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * scale + bias]}
+    return {"Out": [(x + bias) * scale]}
+
+
+@register_op("clip")
+def _clip(ins, attrs, ctx):
+    return {"Out": [jnp.clip(_x(ins), attrs.get("min"), attrs.get("max"))]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ins, attrs, ctx):
+    x = _x(ins)
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [jnp.where(norm > max_norm, x * (max_norm / norm), x)]}
+
+
+# --------------------------------------------------------------------------
+# matmul family — the MXU path. bf16 inputs hit the systolic array natively;
+# preferred_element_type keeps fp32 accumulation (SURVEY §7: MXU guidance).
+# --------------------------------------------------------------------------
+def _acc_type(x):
+    return jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else None
+
+
+@register_op("matmul")
+def _matmul(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("matmul_v2")
+def _matmul_v2(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x))
+    return {"Out": [out.astype(x.dtype) if out.dtype != x.dtype else out]}
+
+
+@register_op("mul")  # operators/mul_op.cc: flatten then 2-D matmul
+def _mul(ins, attrs, ctx):
+    import numpy as np
+    x, y = _x(ins), _x(ins, "Y")
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), -1))
+    y2 = y.reshape((int(np.prod(ys[:yn])), -1))
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x))
+    out = out.astype(x.dtype) if out.dtype != x.dtype else out
+    return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
+
+
+@register_op("bmm")
+def _bmm(ins, attrs, ctx):
+    out = jnp.matmul(_x(ins), _x(ins, "Y"), preferred_element_type=_acc_type(_x(ins)))
+    return {"Out": [out.astype(_x(ins).dtype)]}
+
+
+@register_op("dot")
+def _dot(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=x.ndim > 1)]}
+
+
+@register_op("mv")
+def _mv(ins, attrs, ctx):
+    return {"Out": [jnp.matmul(_x(ins), _x(ins, "Vec"))]}
+
+
+@register_op("addmm")
+def _addmm(ins, attrs, ctx):
+    inp, x, y = _x(ins, "Input"), _x(ins), _x(ins, "Y")
+    return {"Out": [attrs.get("Beta", 1.0) * inp +
+                    attrs.get("Alpha", 1.0) * jnp.matmul(x, y)]}
+
+
+@register_op("kron")
+def _kron(ins, attrs, ctx):
+    return {"Out": [jnp.kron(_x(ins), _x(ins, "Y"))]}
+
+
+@register_op("cross")
+def _cross(ins, attrs, ctx):
+    return {"Out": [jnp.cross(_x(ins), _x(ins, "Y"),
+                              axis=attrs.get("dim", -1))]}
+
+
+@register_op("trace")
+def _trace(ins, attrs, ctx):
+    return {"Out": [jnp.trace(_x(ins, "Input"), offset=attrs.get("offset", 0),
+                              axis1=attrs.get("axis1", 0),
+                              axis2=attrs.get("axis2", 1))]}
+
+
+@register_op("cholesky")
+def _cholesky(ins, attrs, ctx):
+    L = jnp.linalg.cholesky(_x(ins))
+    if attrs.get("upper", False):
+        L = jnp.swapaxes(L, -1, -2)
+    return {"Out": [L]}
+
+
+@register_op("inverse")
+def _inverse(ins, attrs, ctx):
+    return {"Output": [jnp.linalg.inv(_x(ins, "Input"))]}
+
+
+@register_op("cumsum")
+def _cumsum(ins, attrs, ctx):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    if attrs.get("flatten", False):
+        x, axis = x.ravel(), 0
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * out.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)[tuple(
+            slice(0, -1) if i == axis % out.ndim else slice(None)
+            for i in range(out.ndim))]
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+@register_op("p_norm")
+def _p_norm(ins, attrs, ctx):
+    x = _x(ins)
+    p = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", -1)
+    keep = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) ** (1.0 / p)
+    return {"Out": [out]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ins, attrs, ctx):
+    return {"Out": [jnp.sum(jnp.abs(_x(ins)))]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ins, attrs, ctx):
+    return {"Out": [jnp.sum(jnp.square(_x(ins))).reshape(1)]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    sub = x - y
+    return {"sub_result": [sub],
+            "Out": [jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                            keepdims=False).reshape(-1, 1)]}
+
+
+@register_op("cos_sim")
+def _cos_sim(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    return {"Out": [jnp.sum(x * y, -1, keepdims=True) / (xn * yn)],
+            "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("dist")
+def _dist(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    p = attrs.get("p", 2.0)
+    d = jnp.abs(x - y)
+    if p == float("inf"):
+        return {"Out": [jnp.max(d)]}
+    if p == 0:
+        return {"Out": [jnp.sum(d != 0).astype(x.dtype)]}
+    return {"Out": [jnp.sum(d ** p) ** (1 / p)]}
+
+
+@register_op("logsumexp")
+def _logsumexp(ins, attrs, ctx):
+    axis = attrs.get("axis", None)
+    axis = tuple(axis) if axis else None
+    return {"Out": [jax.scipy.special.logsumexp(
+        _x(ins), axis=axis, keepdims=attrs.get("keepdim", False))]}
+
+
+# comparisons / logical (operators/controlflow/{compare_op,logical_op}.cc)
+def _cmp(name, f):
+    def lower(ins, attrs, ctx):
+        return {"Out": [f(_x(ins), _x(ins, "Y"))]}
+    register_op(name, lower, differentiable=False)
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+register_op("logical_not",
+            lambda ins, attrs, ctx: {"Out": [jnp.logical_not(_x(ins))]},
+            differentiable=False)
+
+
+@register_op("isfinite", differentiable=False)
+def _isfinite(ins, attrs, ctx):
+    # fluid isfinite: scalar "all finite" over the (possibly multi-)input
+    flags = [jnp.all(jnp.isfinite(x)) for x in ins["X"]]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return {"Out": [out]}
+
+
+register_op("isfinite_v2", lambda ins, a, c: {"Out": [jnp.isfinite(_x(ins))]},
+            differentiable=False)
+register_op("isinf_v2", lambda ins, a, c: {"Out": [jnp.isinf(_x(ins))]},
+            differentiable=False)
+register_op("isnan_v2", lambda ins, a, c: {"Out": [jnp.isnan(_x(ins))]},
+            differentiable=False)
+
+
+@register_op("allclose", differentiable=False)
+def _allclose(ins, attrs, ctx):
+    return {"Out": [jnp.allclose(_x(ins, "Input"), _x(ins, "Other"),
+                                 rtol=float(attrs.get("rtol", 1e-5)),
+                                 atol=float(attrs.get("atol", 1e-8)),
+                                 equal_nan=attrs.get("equal_nan", False))]}

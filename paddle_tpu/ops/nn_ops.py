@@ -1,0 +1,425 @@
+"""NN ops: conv/pool/norm/softmax/dropout/interpolate lowering rules.
+
+Reference: paddle/fluid/operators/{conv_op,conv_cudnn_op,pool_op,batch_norm_op,
+layer_norm_op,group_norm_op,instance_norm_op,softmax_op,dropout_op,
+interpolate_op,...}.cc|cu (SURVEY §2.5).  Convs lower to
+lax.conv_general_dilated which XLA tiles onto the MXU; there is no cuDNN-style
+algo search — the compiler picks the schedule.  batch_norm keeps fluid's
+running-stat update semantics by emitting the updated moving stats as extra
+outputs that the executor writes back to the scope (the analog of fluid's
+in-place MeanOut/VarianceOut aliasing).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    return ins[slot][i]
+
+
+def _conv_pad(padding, algorithm, ndim_sp):
+    if algorithm == "SAME":
+        return "SAME"
+    if algorithm == "VALID":
+        return "VALID"
+    p = list(padding)
+    if len(p) == ndim_sp:
+        return [(pi, pi) for pi in p]
+    if len(p) == 2 * ndim_sp:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(ndim_sp)]
+    return [(p[0], p[0])] * ndim_sp
+
+
+@register_op("conv2d")
+def _conv2d(ins, attrs, ctx):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt in ("NCHW", "AnyLayout"):
+        dn = ("NCHW", "OIHW", "NCHW")
+    else:
+        dn = ("NHWC", "OIHW", "NHWC")
+    groups = attrs.get("groups", 1)
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=attrs.get("strides", [1, 1]),
+        padding=_conv_pad(attrs.get("paddings", [0, 0]),
+                          attrs.get("padding_algorithm", "EXPLICIT"), 2),
+        rhs_dilation=attrs.get("dilations", [1, 1]),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ins, attrs, ctx):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    groups = attrs.get("groups", x.shape[1])
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=attrs.get("strides", [1, 1]),
+        padding=_conv_pad(attrs.get("paddings", [0, 0]),
+                          attrs.get("padding_algorithm", "EXPLICIT"), 2),
+        rhs_dilation=attrs.get("dilations", [1, 1]),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ins, attrs, ctx):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    strides = attrs.get("strides", [1, 1])
+    pads = _conv_pad(attrs.get("paddings", [0, 0]),
+                     attrs.get("padding_algorithm", "EXPLICIT"), 2)
+    # fluid filter layout for transpose is (in, out/groups, kh, kw) = IOHW
+    out = lax.conv_transpose(
+        x, w, strides, pads if isinstance(pads, str) else pads,
+        rhs_dilation=attrs.get("dilations", [1, 1]),
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("conv3d")
+def _conv3d(ins, attrs, ctx):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    out = lax.conv_general_dilated(
+        x, w, attrs.get("strides", [1, 1, 1]),
+        _conv_pad(attrs.get("paddings", [0, 0, 0]),
+                  attrs.get("padding_algorithm", "EXPLICIT"), 3),
+        rhs_dilation=attrs.get("dilations", [1, 1, 1]),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1))
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("pool2d")
+def _pool2d(ins, attrs, ctx):
+    x = _x(ins)
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        axis = (2, 3)
+        out = (jnp.max(x, axis, keepdims=True) if ptype == "max"
+               else jnp.mean(x, axis, keepdims=True))
+        return {"Out": [out]}
+    ks = attrs["ksize"]
+    st = attrs.get("strides", ks)
+    pd = attrs.get("paddings", [0, 0])
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        pads = "SAME"
+    elif len(pd) == 4:
+        pads = [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])]
+    else:
+        pads = [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])]
+    dims, strides = (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1])
+    if ptype == "max":
+        out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if attrs.get("exclusive", True) and pads != "SAME" and any(
+                p != (0, 0) for p in (pads if isinstance(pads, list) else [])):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+            out = summed / counts
+        else:
+            out = summed / (ks[0] * ks[1])
+    return {"Out": [out]}
+
+
+@register_op("adaptive_pool2d")
+def _adaptive_pool2d(ins, attrs, ctx):
+    x = _x(ins)
+    oh, ow = attrs["ksize"] if "ksize" in attrs else attrs["output_size"]
+    n, c, h, w = x.shape
+    # adaptive pooling with uniform bins (exact when divisible; fluid common case)
+    assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if attrs.get("pooling_type", "avg") == "avg":
+        return {"Out": [x.mean(axis=(3, 5))]}
+    return {"Out": [x.max(axis=(3, 5))]}
+
+
+@register_op("softmax")
+def _softmax(ins, attrs, ctx):
+    return {"Out": [jax.nn.softmax(_x(ins), axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ins, attrs, ctx):
+    return {"Out": [jax.nn.log_softmax(_x(ins), axis=attrs.get("axis", -1))]}
+
+
+@register_op("dropout", stateful_rng=True, nondiff_outputs=("Mask",))
+def _dropout(ins, attrs, ctx):
+    x = _x(ins)
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": [out], "Mask": [jnp.ones_like(x, dtype=jnp.uint8)]}
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("batch_norm",
+             nondiff_inputs=("Mean", "Variance"),
+             nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                              "SavedVariance"))
+def _batch_norm(ins, attrs, ctx):
+    x = _x(ins)
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    mean, var = _x(ins, "Mean"), _x(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    fmt = attrs.get("data_layout", "NCHW")
+    is_test = (attrs.get("is_test", False) or ctx.is_test
+               or attrs.get("use_global_stats", False))
+    ch_axis = 1 if fmt == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    if is_test:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=red_axes)
+        v = jnp.var(xf, axis=red_axes)
+        mean_out = momentum * mean + (1 - momentum) * m
+        var_out = momentum * var + (1 - momentum) * v
+    inv = lax.rsqrt(v.astype(jnp.float32) + eps)
+    out = ((x.astype(jnp.float32) - m.reshape(shape)) * inv.reshape(shape)
+           * scale.reshape(shape) + bias.reshape(shape)).astype(x.dtype)
+    return {"Y": [out], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [m], "SavedVariance": [inv]}
+
+
+@register_op("sync_batch_norm",
+             nondiff_inputs=("Mean", "Variance"),
+             nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                              "SavedVariance"))
+def _sync_batch_norm(ins, attrs, ctx):
+    """Cross-replica batch norm (operators/sync_batch_norm_op.cu).  Stats are
+    psum-reduced over the data-parallel mesh axis when running under
+    shard_map; falls back to local stats otherwise."""
+    x = _x(ins)
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    mean, var = _x(ins, "Mean"), _x(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    ch_axis = 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    axis_name = ctx.axis_for_ring(attrs.get("ring_id", 0)) or ctx.mesh_axes.get("dp")
+    if is_test:
+        m, v = mean, var
+        mean_out, var_out = mean, var
+    else:
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, axis=red_axes)
+        msq = jnp.mean(jnp.square(xf), axis=red_axes)
+        if axis_name is not None:
+            m = lax.pmean(m, axis_name)
+            msq = lax.pmean(msq, axis_name)
+        v = msq - jnp.square(m)
+        mean_out = momentum * mean + (1 - momentum) * m
+        var_out = momentum * var + (1 - momentum) * v
+    inv = lax.rsqrt(v + eps)
+    out = ((x.astype(jnp.float32) - m.reshape(shape)) * inv.reshape(shape)
+           * scale.reshape(shape) + bias.reshape(shape)).astype(x.dtype)
+    return {"Y": [out], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [m], "SavedVariance": [inv]}
+
+
+@register_op("layer_norm", nondiff_outputs=("Mean", "Variance"))
+def _layer_norm(ins, attrs, ctx):
+    x = _x(ins)
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - m) * lax.rsqrt(v + eps)
+    norm_shape = x.shape[begin:]
+    if ins.get("Scale"):
+        out = out * ins["Scale"][0].reshape(norm_shape)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(norm_shape)
+    return {"Y": [out.astype(x.dtype)],
+            "Mean": [m.reshape(x.shape[:begin])],
+            "Variance": [v.reshape(x.shape[:begin])]}
+
+
+@register_op("instance_norm", nondiff_outputs=("SavedMean", "SavedVariance"))
+def _instance_norm(ins, attrs, ctx):
+    x = _x(ins)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - m) * lax.rsqrt(v + eps)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        out = out * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(shape)
+    return {"Y": [out], "SavedMean": [jnp.squeeze(m)],
+            "SavedVariance": [jnp.squeeze(lax.rsqrt(v + eps))]}
+
+
+@register_op("group_norm", nondiff_outputs=("Mean", "Variance"))
+def _group_norm(ins, attrs, ctx):
+    x = _x(ins)
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=axes, keepdims=True)
+    v = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - m) * lax.rsqrt(v + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        out = out * ins["Scale"][0].reshape(shape)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(shape)
+    return {"Y": [out], "Mean": [m.reshape(n, g)],
+            "Variance": [v.reshape(n, g)]}
+
+
+@register_op("data_norm")
+def _data_norm(ins, attrs, ctx):
+    # CTR data_norm (operators/data_norm_op.cc): normalize by accumulated
+    # batch statistics stored as persistable vars
+    x = _x(ins)
+    size, sum_, sqsum = ins["BatchSize"][0], ins["BatchSum"][0], ins["BatchSquareSum"][0]
+    means = sum_ / size
+    scales = jnp.sqrt(size / sqsum)
+    return {"Y": [(x - means) * scales], "Means": [means], "Scales": [scales]}
+
+
+@register_op("l2_normalize")
+def _l2_normalize(ins, attrs, ctx):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+register_op("norm", lambda ins, a, c: _l2_normalize(ins, a, c))
+
+
+@register_op("lrn")
+def _lrn(ins, attrs, ctx):
+    x = _x(ins)
+    n = attrs.get("n", 5)
+    k, alpha, beta = attrs.get("k", 2.0), attrs.get("alpha", 1e-4), attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    pad = n // 2
+    sq_p = jnp.pad(sq, [(0, 0), (pad, pad), (0, 0), (0, 0)])
+    acc = sum(sq_p[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+@register_op("maxout")
+def _maxout(ins, attrs, ctx):
+    x = _x(ins)
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // g, g, h, w).max(axis=2)]}
+
+
+@register_op("interpolate_nearest")
+def _interp_nearest(ins, attrs, ctx):
+    raise NotImplementedError
+
+
+def _interp(ins, attrs, ctx, method):
+    x = _x(ins)
+    n, c, h, w = x.shape
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    if ins.get("OutSize"):
+        sz = np.asarray(ins["OutSize"][0])
+        oh, ow = int(sz[0]), int(sz[1])
+    elif oh <= 0:
+        scale = attrs.get("scale", 1.0)
+        oh, ow = int(h * scale), int(w * scale)
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+    return {"Out": [jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)]}
+
+
+register_op("nearest_interp", lambda ins, a, c: _interp(ins, a, c, "nearest"),
+            nondiff_inputs=("OutSize",))
+register_op("bilinear_interp", lambda ins, a, c: _interp(ins, a, c, "bilinear"),
+            nondiff_inputs=("OutSize",))
+register_op("bicubic_interp", lambda ins, a, c: _interp(ins, a, c, "bicubic"),
+            nondiff_inputs=("OutSize",))
+register_op("trilinear_interp", lambda ins, a, c: _interp(ins, a, c, "trilinear"),
+            nondiff_inputs=("OutSize",))
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ins, attrs, ctx):
+    x, grid = _x(ins), _x(ins, "Grid")
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx, wy = gx - x0, gy - y0
+    def sample(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        return jax.vmap(lambda img, Y, X: img[:, Y, X])(x, yy, xx)
+    v00, v01 = sample(y0, x0), sample(y0, x1)
+    v10, v11 = sample(y1, x0), sample(y1, x1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    out = (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy)
+           + v10 * (1 - wx) * wy + v11 * wx * wy)
+    return {"Output": [out]}
+
+
+@register_op("affine_channel")
+def _affine_channel(ins, attrs, ctx):
+    x, s, b = _x(ins), _x(ins, "Scale"), _x(ins, "Bias")
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    return {"Out": [x * s.reshape(shape) + b.reshape(shape)]}
+
+
+@register_op("temporal_shift")
+def _temporal_shift(ins, attrs, ctx):
+    x = _x(ins)
+    t = attrs["seg_num"]
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // t
+    x = x.reshape(n, t, c, h, w)
+    c1 = int(c * ratio)
+    fwd = jnp.pad(x[:, 1:, :c1], [(0, 0), (0, 1), (0, 0), (0, 0), (0, 0)])
+    bwd = jnp.pad(x[:, :-1, c1:2 * c1], [(0, 0), (1, 0), (0, 0), (0, 0), (0, 0)])
+    out = jnp.concatenate([fwd, bwd, x[:, :, 2 * c1:]], axis=2)
+    return {"Out": [out.reshape(nt, c, h, w)]}

@@ -1,0 +1,222 @@
+"""Optimizer update ops + AMP loss-scaling ops.
+
+Reference: paddle/fluid/operators/optimizers/{sgd,momentum,adam,adamw,lamb,
+adagrad,rmsprop,ftrl,lars_momentum,dpsgd}_op.cc (SURVEY §2.5) and
+operators/amp/{check_finite_and_unscale_op,update_loss_scaling_op}.cu.
+Each op consumes (param, grad, states...) and emits new values; the executor
+writes the outputs back to the scope — the functional analog of the
+reference's in-place ParamOut aliasing.  All are marked non-differentiable.
+XLA fuses the whole optimizer phase into a couple of elementwise kernels, the
+same effect as fuse_adam_op_pass/coalesce_grad_tensor_pass for free.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+@register_op("sgd", differentiable=False)
+def _sgd(ins, attrs, ctx):
+    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()) * g]}
+
+
+@register_op("momentum", differentiable=False)
+def _momentum(ins, attrs, ctx):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _p(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu", 0.9)
+    rd = attrs.get("regularization_coeff", 0.0)
+    if attrs.get("regularization_method", "") == "l2_decay" and rd:
+        g = g + rd * p
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("lars_momentum", differentiable=False)
+def _lars_momentum(ins, attrs, ctx):
+    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    lr = _p(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(pn > 0, jnp.where(
+        gn > 0, coeff * pn / (gn + decay * pn + eps), 1.0), 1.0)
+    v_new = mu * v + lr * local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_new], "VelocityOut": [v_new]}
+
+
+@register_op("adam", differentiable=False)
+def _adam(ins, attrs, ctx):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p, b2p = _p(ins, "Beta1Pow").reshape(()), _p(ins, "Beta2Pow").reshape(())
+    lr = _p(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    # reference adam_op.h: lr_t = lr * sqrt(1 - b2^t) / (1 - b1^t)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {"ParamOut": [p_new], "Moment1Out": [m_new], "Moment2Out": [v_new],
+            "Beta1PowOut": [(b1p * b1).reshape(1)],
+            "Beta2PowOut": [(b2p * b2).reshape(1)]}
+
+
+@register_op("adamw", differentiable=False)
+def _adamw(ins, attrs, ctx):
+    p = _p(ins, "Param")
+    coeff = attrs.get("coeff", 0.01)
+    lr = _p(ins, "LearningRate").reshape(())
+    out = _adam(ins, attrs, ctx)
+    if not attrs.get("with_decay", True):
+        return out
+    # decoupled weight decay applied against the pre-update param
+    out["ParamOut"] = [out["ParamOut"][0] - lr * coeff * p]
+    return out
+
+
+@register_op("adagrad", differentiable=False)
+def _adagrad(ins, attrs, ctx):
+    p, g, mom = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    eps = attrs.get("epsilon", 1e-6)
+    mom_new = mom + jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_new) + eps)],
+            "MomentOut": [mom_new]}
+
+
+@register_op("rmsprop", differentiable=False)
+def _rmsprop(ins, attrs, ctx):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    ms, mom = _p(ins, "MeanSquare"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg = _p(ins, "MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        mom_new = mu * mom + lr * g / denom
+        return {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new],
+                "MomentOut": [mom_new], "MeanGradOut": [mg_new]}
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new]}
+
+
+@register_op("lamb", differentiable=False)
+def _lamb(ins, attrs, ctx):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
+    b1p, b2p = _p(ins, "Beta1Pow").reshape(()), _p(ins, "Beta2Pow").reshape(())
+    lr = _p(ins, "LearningRate").reshape(())
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    m_hat = m_new / (1 - b1p)
+    v_hat = v_new / (1 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    return {"ParamOut": [p - lr * ratio * r], "Moment1Out": [m_new],
+            "Moment2Out": [v_new],
+            "Beta1PowOut": [(b1p * b1).reshape(1)],
+            "Beta2PowOut": [(b2p * b2).reshape(1)]}
+
+
+@register_op("ftrl", differentiable=False)
+def _ftrl(ins, attrs, ctx):
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    sq, lin = _p(ins, "SquaredAccumulator"), _p(ins, "LinearAccumulator")
+    lr = _p(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    sq_new = sq + jnp.square(g)
+    sigma = (jnp.power(sq_new, -power) - jnp.power(sq, -power)) / lr
+    lin_new = lin + g - sigma * p
+    quad = jnp.power(sq_new, -power) / lr + 2 * l2
+    pre = jnp.clip(lin_new, -l1, l1) - lin_new
+    p_new = jnp.where(jnp.abs(lin_new) > l1, pre / quad, 0.0)
+    return {"ParamOut": [p_new], "SquaredAccumOut": [sq_new],
+            "LinearAccumOut": [lin_new]}
+
+
+@register_op("dpsgd", differentiable=False)
+def _dpsgd(ins, attrs, ctx):
+    # differentially-private SGD (optimizers/dpsgd_op.cc): clip + noise
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    lr = _p(ins, "LearningRate").reshape(())
+    clip = attrs.get("clip", 10.0)
+    sigma = attrs.get("sigma", 1.0)
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(1.0, gn / clip)
+    key = ctx.key_for(attrs.get("op_seed", 0))
+    noise = jax.random.normal(key, g.shape, g.dtype) * sigma * clip
+    return {"ParamOut": [p - lr * (g + noise)]}
+
+
+@register_op("dgc_momentum", differentiable=False)
+def _dgc_momentum(ins, attrs, ctx):
+    # deep-gradient-compression momentum falls back to plain momentum on TPU:
+    # ICI bandwidth makes top-k sparsification counterproductive
+    return _momentum(ins, attrs, ctx)
+
+
+# ---------------------------------------------------------------------------
+# AMP dynamic loss scaling (operators/amp/*)
+# ---------------------------------------------------------------------------
+@register_op("check_finite_and_unscale", differentiable=False)
+def _check_finite_and_unscale(ins, attrs, ctx):
+    scale = _p(ins, "Scale").reshape(())
+    outs, found_inf = [], jnp.zeros((), jnp.bool_)
+    for x in ins["X"]:
+        finite = jnp.all(jnp.isfinite(x))
+        found_inf = jnp.logical_or(found_inf, jnp.logical_not(finite))
+        outs.append(x / scale)
+    return {"Out": outs, "FoundInfinite": [found_inf.reshape(1)]}
+
+
+@register_op("update_loss_scaling", differentiable=False)
+def _update_loss_scaling(ins, attrs, ctx):
+    found_inf = _p(ins, "FoundInfinite").reshape(())
+    scale = _p(ins, "PrevLossScaling").reshape(())
+    good = _p(ins, "InGoodSteps").reshape(())
+    bad = _p(ins, "InBadSteps").reshape(())
+    incr_every = attrs.get("incr_every_n_steps", 1000)
+    decr_every = attrs.get("decr_every_n_nan_or_inf", 2)
+    incr_ratio = attrs.get("incr_ratio", 2.0)
+    decr_ratio = attrs.get("decr_ratio", 0.5)
+
+    good_new = jnp.where(found_inf, 0, good + 1)
+    bad_new = jnp.where(found_inf, bad + 1, 0)
+    scale_up = jnp.where(good_new >= incr_every, scale * incr_ratio, scale)
+    good_new = jnp.where(good_new >= incr_every, 0, good_new)
+    scale_dn = jnp.where(bad_new >= decr_every,
+                         jnp.maximum(scale * decr_ratio, 1.0), scale_up)
+    bad_new = jnp.where(bad_new >= decr_every, 0, bad_new)
+    outs = [jnp.where(found_inf, jnp.zeros_like(x), x) for x in ins["X"]]
+    return {"Out": outs, "LossScaling": [scale_dn.reshape(1)],
+            "OutGoodSteps": [good_new.reshape(1)],
+            "OutBadSteps": [bad_new.reshape(1)]}

@@ -1,0 +1,124 @@
+"""Random-number ops over JAX's counter-based PRNG.
+
+Reference: paddle/fluid/operators/{uniform_random_op,gaussian_random_op,
+truncated_gaussian_random_op,randint_op,randperm_op,multinomial_op,
+bernoulli_op,...}.cc (SURVEY A.1 Random).  The reference threads a mutable
+Generator (framework/generator.cc); TPU-native randomness is functional: each
+op instance is assigned a static `op_seed` at graph-build time and derives its
+key as fold_in(step_key, op_seed) — reproducible, and identical between a
+forward pass and its vjp-recomputation (registry.LoweringContext.key_for).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _dtype(attrs, default="float32"):
+    from ..fluid.framework import convert_dtype
+    d = attrs.get("dtype", default)
+    return convert_dtype(d) if d not in (None, -1) else default
+
+
+def _shape(ins, attrs):
+    if ins.get("ShapeTensor"):
+        return tuple(int(d) for d in np.asarray(ins["ShapeTensor"][0]))
+    return tuple(attrs["shape"])
+
+
+@register_op("uniform_random", stateful_rng=True, differentiable=False)
+def _uniform_random(ins, attrs, ctx):
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    out = jax.random.uniform(key, _shape(ins, attrs), dtype=jnp.float32,
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": [out.astype(_dtype(attrs))]}
+
+
+@register_op("gaussian_random", stateful_rng=True, differentiable=False)
+def _gaussian_random(ins, attrs, ctx):
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    out = (jax.random.normal(key, _shape(ins, attrs), dtype=jnp.float32)
+           * attrs.get("std", 1.0) + attrs.get("mean", 0.0))
+    return {"Out": [out.astype(_dtype(attrs))]}
+
+
+@register_op("truncated_gaussian_random", stateful_rng=True, differentiable=False)
+def _truncated_gaussian(ins, attrs, ctx):
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    out = jax.random.truncated_normal(key, -2.0, 2.0, tuple(attrs["shape"]),
+                                      dtype=jnp.float32)
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": [out.astype(_dtype(attrs))]}
+
+
+@register_op("randint", stateful_rng=True, differentiable=False)
+def _randint(ins, attrs, ctx):
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    out = jax.random.randint(key, _shape(ins, attrs), attrs.get("low", 0),
+                             attrs.get("high"), dtype=jnp.int32)
+    return {"Out": [out.astype(_dtype(attrs, "int64"))]}
+
+
+@register_op("randperm", stateful_rng=True, differentiable=False)
+def _randperm(ins, attrs, ctx):
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    return {"Out": [jax.random.permutation(key, attrs["n"]).astype(
+        _dtype(attrs, "int64"))]}
+
+
+@register_op("bernoulli", stateful_rng=True, differentiable=False)
+def _bernoulli(ins, attrs, ctx):
+    x = ins["X"][0]
+    key = ctx.key_for(attrs.get("op_seed", 0))
+    return {"Out": [jax.random.bernoulli(key, x).astype(x.dtype)]}
+
+
+@register_op("multinomial", stateful_rng=True, differentiable=False)
+def _multinomial(ins, attrs, ctx):
+    x = ins["X"][0]
+    key = ctx.key_for(attrs.get("op_seed", 0))
+    n = attrs.get("num_samples", 1)
+    logits = jnp.log(jnp.clip(x, 1e-30))
+    out = jax.random.categorical(key, logits, axis=-1, shape=x.shape[:-1] + (n,))
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("sampling_id", stateful_rng=True, differentiable=False)
+def _sampling_id(ins, attrs, ctx):
+    x = ins["X"][0]
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    out = jax.random.categorical(key, jnp.log(jnp.clip(x, 1e-30)), axis=-1)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("shuffle_batch", stateful_rng=True, nondiff_outputs=("ShuffleIdx",))
+def _shuffle_batch(ins, attrs, ctx):
+    # qingshui CTR op (operators/shuffle_batch_op.cc): permute rows
+    x = ins["X"][0]
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("startup_seed", 0) or 0))
+    idx = jax.random.permutation(key, x.shape[0])
+    return {"Out": [jnp.take(x, idx, axis=0)],
+            "ShuffleIdx": [idx.astype(jnp.int64)],
+            "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+
+
+@register_op("random_crop", stateful_rng=True, differentiable=False)
+def _random_crop(ins, attrs, ctx):
+    x = ins["X"][0]
+    shape = attrs["shape"]
+    key = ctx.key_for(attrs.get("op_seed", 0))
+    starts = [jax.random.randint(jax.random.fold_in(key, i), (), 0,
+                                 x.shape[x.ndim - len(shape) + i] - s + 1)
+              for i, s in enumerate(shape)]
+    full = [0] * (x.ndim - len(shape)) + [int(s) for s in starts]
+    sizes = list(x.shape[:x.ndim - len(shape)]) + list(shape)
+    return {"Out": [jax.lax.dynamic_slice(x, full, sizes)]}
+
+
+@register_op("seed", differentiable=False)
+def _seed(ins, attrs, ctx):
+    return {"Out": [jnp.asarray([attrs.get("seed", 0)], jnp.int32)]}

@@ -1,0 +1,129 @@
+"""Reductions / argext / topk / sort lowering rules.
+
+Reference: paddle/fluid/operators/reduce_ops/ (cub-based CUDA reductions,
+SURVEY §2.5) plus arg_max/arg_min/top_k/argsort from the top-level catalog.
+XLA lowers jnp reductions to tree-reductions on the VPU natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    return ins[slot][i]
+
+
+def _axes(attrs, ndim):
+    dim = attrs.get("dim", [0])
+    if attrs.get("reduce_all", False) or dim is None or len(dim) == 0:
+        return None
+    return tuple(d % ndim for d in dim)
+
+
+def _reduce(name, f):
+    def lower(ins, attrs, ctx):
+        x = _x(ins)
+        return {"Out": [f(x, axis=_axes(attrs, x.ndim),
+                          keepdims=attrs.get("keep_dim", False))]}
+    register_op(name, lower)
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+register_op("reduce_all", lambda ins, a, c: {"Out": [
+    jnp.all(_x(ins), axis=_axes(a, _x(ins).ndim),
+            keepdims=a.get("keep_dim", False))]}, differentiable=False)
+register_op("reduce_any", lambda ins, a, c: {"Out": [
+    jnp.any(_x(ins), axis=_axes(a, _x(ins).ndim),
+            keepdims=a.get("keep_dim", False))]}, differentiable=False)
+
+
+@register_op("mean")
+def _mean(ins, attrs, ctx):
+    return {"Out": [jnp.mean(_x(ins))]}
+
+
+@register_op("arg_max", differentiable=False)
+def _arg_max(ins, attrs, ctx):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    out = jnp.argmax(x, axis=None if attrs.get("flatten", False) else axis)
+    if attrs.get("keepdims", False) and not attrs.get("flatten", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("arg_min", differentiable=False)
+def _arg_min(ins, attrs, ctx):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    out = jnp.argmin(x, axis=None if attrs.get("flatten", False) else axis)
+    if attrs.get("keepdims", False) and not attrs.get("flatten", False):
+        out = jnp.expand_dims(out, axis)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("top_k", nondiff_outputs=("Indices",))
+def _top_k(ins, attrs, ctx):
+    x = _x(ins)
+    k = int(ins["K"][0]) if ins.get("K") else attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k_v2", nondiff_outputs=("Indices",))
+def _top_k_v2(ins, attrs, ctx):
+    x = _x(ins)
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1) % x.ndim
+    largest = attrs.get("largest", True)
+    xm = jnp.moveaxis(x, axis, -1)
+    if not largest:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(xm, k)
+    return {"Out": [jnp.moveaxis(vals, -1, axis)],
+            "Indices": [jnp.moveaxis(idx, -1, axis).astype(jnp.int64)]}
+
+
+@register_op("argsort", nondiff_outputs=("Indices",))
+def _argsort(ins, attrs, ctx):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    desc = attrs.get("descending", False)
+    idx = jnp.argsort(-x if desc else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("kthvalue", nondiff_outputs=("Indices",))
+def _kthvalue(ins, attrs, ctx):
+    x = _x(ins)
+    k = attrs["k"]
+    axis = attrs.get("axis", -1)
+    s = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis)
+    out = jnp.take(s, k - 1, axis=axis)
+    idx = jnp.take(i, k - 1, axis=axis)
+    if attrs.get("keepdim", False):
+        out, idx = jnp.expand_dims(out, axis), jnp.expand_dims(idx, axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("max_pool2d_with_index", nondiff_outputs=("Mask",))
+def _max_pool2d_with_index(ins, attrs, ctx):
+    # pool_with_index: return both pooled values and argmax mask
+    x = _x(ins)
+    ks, st = attrs["ksize"], attrs.get("strides", attrs["ksize"])
+    pd = attrs.get("paddings", [0, 0])
+    out = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1]),
+        [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+    return {"Out": [out], "Mask": [jnp.zeros_like(out, dtype=jnp.int32)]}

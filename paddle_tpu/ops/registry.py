@@ -1,0 +1,89 @@
+"""Operator registry: the TPU-native analog of fluid's op/kernel registry.
+
+Reference design: paddle/fluid/framework/op_registry.h:256-304 registers an
+OperatorBase subclass plus per-device kernels per op type, and a GradOpDescMaker
+(grad_op_desc_maker.h) that emits grad OpDescs.  Here an op is a *pure JAX
+lowering rule* `fn(inputs, attrs, ctx) -> outputs`; the whole block is compiled
+by XLA (executor.py), so there is no per-device kernel dispatch — XLA is the
+kernel library.  Gradients come from one generic `jax.vjp`-based grad lowering
+(see backward.py), replacing 676 hand-written GradOpMakers; ops may still
+register a custom grad when vjp semantics are wrong (e.g. straight-through).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+# inputs/outputs are Dict[slot_name, List[jax.Array]] mirroring OpDesc's named
+# variadic slots (framework.proto:74 `OpDesc.Var { parameter, arguments }`).
+LoweringFn = Callable[..., Dict[str, Any]]
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    fn: LoweringFn                       # fn(ins, attrs, ctx) -> outs
+    # slots that are never differentiated (int indices, seeds, masks...)
+    nondiff_inputs: Sequence[str] = ()
+    # outputs that carry no cotangent (int outputs, saved state)
+    nondiff_outputs: Sequence[str] = ()
+    differentiable: bool = True          # False: treated as leaf (optimizer ops)
+    stateful_rng: bool = False           # needs a PRNG key (dropout, *_random)
+    custom_grad: Optional[Callable] = None  # (ins, outs, out_grads, attrs, ctx) -> in_grads
+    # optional shape/dtype inference for IR bookkeeping (advisory; XLA retraces)
+    infer: Optional[Callable] = None
+
+
+_OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(type: str, fn: LoweringFn = None, **kwargs):
+    """Register a lowering rule. Usable as decorator or direct call."""
+    def deco(f):
+        if type in _OP_REGISTRY:
+            raise ValueError(f"op '{type}' already registered")
+        _OP_REGISTRY[type] = OpDef(type=type, fn=f, **kwargs)
+        return f
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def get_op(type: str) -> OpDef:
+    if type not in _OP_REGISTRY:
+        raise NotImplementedError(
+            f"op '{type}' has no TPU lowering rule registered "
+            f"({len(_OP_REGISTRY)} ops available)")
+    return _OP_REGISTRY[type]
+
+
+def has_op(type: str) -> bool:
+    return type in _OP_REGISTRY
+
+
+def all_ops() -> List[str]:
+    return sorted(_OP_REGISTRY)
+
+
+class LoweringContext:
+    """Per-compilation context handed to lowering rules.
+
+    Carries the PRNG base key (random ops fold in their static `op_seed` attr
+    so forward and vjp-recomputed forward see identical randomness), the mesh
+    axis registry for collective ops (parallel/mesh.py), and mode flags.
+    """
+
+    def __init__(self, base_key=None, mesh_axes=None, is_test=False):
+        self.base_key = base_key
+        self.mesh_axes = mesh_axes or {}   # ring_id -> mesh axis name(s)
+        self.is_test = is_test
+
+    def key_for(self, op_seed: int):
+        import jax
+        if self.base_key is None:
+            import jax.random as jr
+            return jr.PRNGKey(int(op_seed))
+        return jax.random.fold_in(self.base_key, int(op_seed))
+
+    def axis_for_ring(self, ring_id: int):
+        return self.mesh_axes.get(int(ring_id), None)

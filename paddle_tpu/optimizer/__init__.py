@@ -1,0 +1,238 @@
+"""paddle.optimizer 2.0 namespace (reference python/paddle/optimizer/) —
+dygraph-friendly wrappers: step()/clear_grad() apply the SAME update op
+lowerings eagerly to ParamBase values."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..fluid import optimizer as F
+from ..ops.registry import get_op, LoweringContext
+from . import lr
+
+
+class _EagerOptimizer:
+    """Applies ops/optimizer_ops.py lowerings directly to parameters."""
+    op_type = "sgd"
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, **kw):
+        self._lr = learning_rate
+        self._parameters = list(parameters or [])
+        self._accum = {}
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._kw = kw
+        self._ctx = LoweringContext()
+
+    # -- shared machinery ---------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, lr.LRScheduler):
+            return self._lr()
+        return float(self._lr)
+
+    def set_lr(self, v):
+        self._lr = v
+
+    def _accs(self, p, names_and_init):
+        key = id(p)
+        if key not in self._accum:
+            self._accum[key] = {n: (jnp.full(shape, iv, jnp.float32)
+                                    if shape else jnp.full((1,), iv,
+                                                           jnp.float32))
+                                for n, (shape, iv) in names_and_init.items()}
+        return self._accum[key]
+
+    def step(self):
+        params_grads = [(p, p._grad) for p in self._parameters
+                        if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._clip_eager(params_grads)
+        lr_arr = jnp.asarray([self.get_lr()], jnp.float32)
+        for p, g in params_grads:
+            if self._weight_decay and not isinstance(self, AdamW):
+                g = g + float(self._weight_decay) * p._value
+            self._apply_one(p, g, lr_arr)
+
+    minimize = step
+
+    def _clip_eager(self, params_grads):
+        gc = self._grad_clip
+        from ..fluid.clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                                  GradientClipByValue)
+        if isinstance(gc, GradientClipByGlobalNorm):
+            total = sum(jnp.sum(jnp.square(g)) for _, g in params_grads)
+            norm = jnp.sqrt(total)
+            scale = jnp.minimum(1.0, gc.clip_norm / jnp.maximum(norm,
+                                                                gc.clip_norm))
+            scale = gc.clip_norm / jnp.maximum(norm, gc.clip_norm)
+            return [(p, g * scale) for p, g in params_grads]
+        if isinstance(gc, GradientClipByNorm):
+            out = []
+            for p, g in params_grads:
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                out.append((p, jnp.where(n > gc.clip_norm,
+                                         g * (gc.clip_norm / n), g)))
+            return out
+        if isinstance(gc, GradientClipByValue):
+            return [(p, jnp.clip(g, gc.min, gc.max)) for p, g in params_grads]
+        return params_grads
+
+    def _apply_one(self, p, g, lr_arr):
+        raise NotImplementedError
+
+    def clear_grad(self):
+        for p in self._parameters:
+            p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        out = {"lr": self.get_lr()}
+        for i, p in enumerate(self._parameters):
+            for n, v in self._accum.get(id(p), {}).items():
+                out[f"{p.name}.{n}"] = np.asarray(v)
+        return out
+
+    def set_state_dict(self, state):
+        pass  # accumulators rebuild lazily; lr restored by caller
+
+
+class SGD(_EagerOptimizer):
+    def _apply_one(self, p, g, lr_arr):
+        out = get_op("sgd").fn(
+            {"Param": [p._value], "Grad": [g], "LearningRate": [lr_arr]},
+            {}, self._ctx)
+        p._value = out["ParamOut"][0]
+
+
+class Momentum(_EagerOptimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._mu = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, g, lr_arr):
+        accs = self._accs(p, {"velocity": (p.shape, 0.0)})
+        out = get_op("momentum").fn(
+            {"Param": [p._value], "Grad": [g], "Velocity": [accs["velocity"]],
+             "LearningRate": [lr_arr]},
+            {"mu": self._mu, "use_nesterov": self._nesterov}, self._ctx)
+        p._value = out["ParamOut"][0]
+        accs["velocity"] = out["VelocityOut"][0]
+
+
+class Adam(_EagerOptimizer):
+    op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _attrs(self):
+        return {"beta1": self._b1, "beta2": self._b2, "epsilon": self._eps}
+
+    def _apply_one(self, p, g, lr_arr):
+        accs = self._accs(p, {"m1": (p.shape, 0.0), "m2": (p.shape, 0.0),
+                              "b1p": ((1,), self._b1), "b2p": ((1,), self._b2)})
+        out = get_op(self.op_type).fn(
+            {"Param": [p._value], "Grad": [g], "Moment1": [accs["m1"]],
+             "Moment2": [accs["m2"]], "Beta1Pow": [accs["b1p"]],
+             "Beta2Pow": [accs["b2p"]], "LearningRate": [lr_arr]},
+            self._attrs(), self._ctx)
+        p._value = out["ParamOut"][0]
+        accs["m1"], accs["m2"] = out["Moment1Out"][0], out["Moment2Out"][0]
+        accs["b1p"], accs["b2p"] = out["Beta1PowOut"][0], out["Beta2PowOut"][0]
+
+
+class AdamW(Adam):
+    op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, apply_decay_param_fun=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._decay_fun = apply_decay_param_fun
+
+    def _attrs(self):
+        return {**super()._attrs(),
+                "coeff": float(self._weight_decay or 0.0)}
+
+    def _apply_one(self, p, g, lr_arr):
+        if self._decay_fun is not None and not self._decay_fun(p.name):
+            saved = self._weight_decay
+            self._weight_decay = 0.0
+            coeff0 = {"beta1": self._b1, "beta2": self._b2,
+                      "epsilon": self._eps, "coeff": 0.0}
+            accs = self._accs(p, {"m1": (p.shape, 0.0), "m2": (p.shape, 0.0),
+                                  "b1p": ((1,), self._b1),
+                                  "b2p": ((1,), self._b2)})
+            out = get_op("adamw").fn(
+                {"Param": [p._value], "Grad": [g], "Moment1": [accs["m1"]],
+                 "Moment2": [accs["m2"]], "Beta1Pow": [accs["b1p"]],
+                 "Beta2Pow": [accs["b2p"]], "LearningRate": [lr_arr]},
+                coeff0, self._ctx)
+            p._value = out["ParamOut"][0]
+            accs["m1"], accs["m2"] = out["Moment1Out"][0], out["Moment2Out"][0]
+            accs["b1p"], accs["b2p"] = out["Beta1PowOut"][0], out["Beta2PowOut"][0]
+            self._weight_decay = saved
+            return
+        super()._apply_one(p, g, lr_arr)
+
+
+class Adagrad(_EagerOptimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, **kw):
+        super().__init__(learning_rate, parameters)
+        self._eps = epsilon
+
+    def _apply_one(self, p, g, lr_arr):
+        accs = self._accs(p, {"moment": (p.shape, 0.0)})
+        out = get_op("adagrad").fn(
+            {"Param": [p._value], "Grad": [g], "Moment": [accs["moment"]],
+             "LearningRate": [lr_arr]}, {"epsilon": self._eps}, self._ctx)
+        p._value = out["ParamOut"][0]
+        accs["moment"] = out["MomentOut"][0]
+
+
+class RMSProp(_EagerOptimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, **kw):
+        super().__init__(learning_rate, parameters)
+        self._rho, self._eps = rho, epsilon
+        self._mu, self._centered = momentum, centered
+
+    def _apply_one(self, p, g, lr_arr):
+        accs = self._accs(p, {"ms": (p.shape, 0.0), "mom": (p.shape, 0.0),
+                              "mg": (p.shape, 0.0)})
+        ins = {"Param": [p._value], "Grad": [g], "MeanSquare": [accs["ms"]],
+               "Moment": [accs["mom"]], "LearningRate": [lr_arr]}
+        if self._centered:
+            ins["MeanGrad"] = [accs["mg"]]
+        out = get_op("rmsprop").fn(
+            ins, {"decay": self._rho, "epsilon": self._eps,
+                  "momentum": self._mu, "centered": self._centered},
+            self._ctx)
+        p._value = out["ParamOut"][0]
+        accs["ms"], accs["mom"] = out["MeanSquareOut"][0], out["MomentOut"][0]
+        if self._centered:
+            accs["mg"] = out["MeanGradOut"][0]
+
+
+class Lamb(Adam):
+    op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters)
+        self._wd = lamb_weight_decay
+
+    def _attrs(self):
+        return {**super()._attrs(), "weight_decay": self._wd}
+
+
+# static-graph classes still available under this namespace
+Optimizer = _EagerOptimizer

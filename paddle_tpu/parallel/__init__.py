@@ -1,0 +1,6 @@
+"""Parallelism layer: mesh registry, SPMD wrappers, strategies
+(reference SURVEY §2.9 parallelism inventory)."""
+from .mesh import (build_mesh, build_data_parallel_mesh, current_mesh,
+                   set_current_mesh, register_ring, ring_axes, axis_size,
+                   RING_DP, RING_TP, RING_PP, RING_SP, RING_EP)
+from .api import wrap_with_mesh, shard_map_step, param_sharding

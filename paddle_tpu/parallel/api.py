@@ -1,0 +1,60 @@
+"""Mesh execution wrappers: how a compiled block runs SPMD.
+
+Two modes, mirroring the two ways the reference parallelises (SURVEY §2.9):
+
+* auto (GSPMD/pjit)   — the ParallelExecutor-DP analog.  Params carry
+  PartitionSpec annotations (replicated for pure DP, sharded for TP/ZeRO);
+  feeds shard on the batch axis; XLA's sharding propagation inserts the
+  gradient all-reduce that AllReduceOpHandle issued by hand.  Explicit
+  c_allreduce ops in the program lower to identity here (their ring has no
+  bound axis), so fleet-style programs stay correct without double-reducing.
+
+* explicit (shard_map) — the collective-op path.  ring_id -> axis bindings
+  are live, c_* ops lower to lax.psum/all_gather/ppermute on ICI.  Used for
+  tensor/sequence parallel layers and ring attention where communication
+  placement is the point.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_sharding(mesh: Mesh, program) -> Dict[str, NamedSharding]:
+    """Build per-parameter NamedShardings from Parameter.sharding specs."""
+    out = {}
+    for v in program.global_block().vars.values():
+        spec = getattr(v, "sharding", None)
+        if spec is not None:
+            out[v.name] = NamedSharding(mesh, P(*spec))
+    return out
+
+
+def wrap_with_mesh(fn, mesh: Mesh, program, batch_axis: str = "dp",
+                   donate: bool = True):
+    """Auto-mode wrapper for Executor step functions:
+    fn(mut_params, ro_params, feeds, key) -> (fetches, new_vals)."""
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P(batch_axis))
+    psh = param_sharding(mesh, program)
+
+    def shard_of(name):
+        return psh.get(name, repl)
+
+    def wrapped(mut_params, ro_params, feeds, key):
+        mut = {k: jax.device_put(v, shard_of(k)) for k, v in mut_params.items()}
+        ro = {k: jax.device_put(v, shard_of(k)) for k, v in ro_params.items()}
+        fd = {k: jax.device_put(v, data) for k, v in feeds.items()}
+        return _inner(mut, ro, fd, key)
+
+    _inner = jax.jit(fn, donate_argnums=(0,) if donate else ())
+    return wrapped
+
+
+def shard_map_step(fn, mesh: Mesh, in_specs, out_specs):
+    """Explicit-mode: shard_map with collective ops live on their axes."""
+    from jax.experimental.shard_map import shard_map
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False))

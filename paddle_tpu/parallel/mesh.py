@@ -1,0 +1,88 @@
+"""Device-mesh registry — the NCCLCommContext analog.
+
+Reference: paddle/fluid/platform/collective_helper.h:63 keeps a registry of
+NCCL communicators keyed by (ring_id, device); collective ops look their comm
+up by `ring_id` attr.  TPU-native: a communicator *is* a mesh axis.  This
+module maintains the process-wide `jax.sharding.Mesh` and the ring_id ->
+axis-name mapping that ops/collective_ops.py consults through
+LoweringContext.mesh_axes.  Axis conventions follow the scaling-book recipe:
+  dp  - data parallel        (gradient psum rides ICI)
+  tp  - tensor/model parallel (activation collectives)
+  pp  - pipeline stages       (ppermute neighbors)
+  sp  - sequence/context parallel (ring attention)
+  ep  - expert parallel       (MoE all-to-all)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+# well-known ring ids (the reference uses 0 for the global ring)
+RING_DP = 0
+RING_TP = 1
+RING_PP = 2
+RING_SP = 3
+RING_EP = 4
+
+_DEFAULT_RING_AXES = {RING_DP: "dp", RING_TP: "tp", RING_PP: "pp",
+                      RING_SP: "sp", RING_EP: "ep"}
+
+_current_mesh: Optional[Mesh] = None
+_ring_axes: Dict[int, str] = dict(_DEFAULT_RING_AXES)
+
+
+def build_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    """Create and install a Mesh with named axes, e.g. {"dp": 4, "tp": 2}."""
+    devices = devices if devices is not None else jax.devices()
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(shape)
+    mesh = Mesh(arr, tuple(axes.keys()))
+    set_current_mesh(mesh)
+    return mesh
+
+
+def build_data_parallel_mesh(places=None) -> Mesh:
+    devices = jax.devices()
+    if places is not None and not isinstance(places, int):
+        n = len(places)
+        devices = devices[:n]
+    elif isinstance(places, int):
+        devices = devices[:places]
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    set_current_mesh(mesh)
+    return mesh
+
+
+def set_current_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def register_ring(ring_id: int, axis_name: str):
+    """c_comm_init analog: bind a ring id to a mesh axis."""
+    _ring_axes[int(ring_id)] = axis_name
+
+
+def ring_axes() -> Dict[int, str]:
+    """Mapping consumed by LoweringContext.mesh_axes, filtered to axes that
+    actually exist on the current mesh."""
+    if _current_mesh is None:
+        return {}
+    names = set(_current_mesh.axis_names)
+    return {rid: ax for rid, ax in _ring_axes.items() if ax in names}
+
+
+def axis_size(axis: str) -> int:
+    if _current_mesh is None or axis not in _current_mesh.axis_names:
+        return 1
+    return _current_mesh.shape[axis]

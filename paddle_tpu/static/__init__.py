@@ -1,0 +1,15 @@
+"""paddle.static namespace (reference python/paddle/static/)."""
+from ..fluid import (Program, program_guard, default_main_program,
+                     default_startup_program, Executor, CompiledProgram,
+                     BuildStrategy, ExecutionStrategy)
+from ..fluid.layers import data
+from ..fluid.backward import append_backward, gradients
+from ..fluid.io import (save_inference_model, load_inference_model,
+                        save_persistables, load_persistables)
+from ..fluid.param_attr import ParamAttr
+from ..fluid import layers as nn
+
+
+def name_scope(name=None):
+    import contextlib
+    return contextlib.nullcontext()

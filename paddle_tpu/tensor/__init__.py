@@ -1,0 +1,29 @@
+"""paddle.tensor 2.0 namespace — thin functional wrappers over the shared
+op-builders (work in both static and dygraph modes)."""
+from ..fluid import layers as _L
+from ..fluid.layers import (concat, cast, zeros, ones, zeros_like, ones_like,
+                            argmax, argmin, argsort, linspace, increment)
+from ..fluid.layers.nn import (matmul, reshape, squeeze, unsqueeze, transpose,
+                               flatten, split, slice, gather, gather_nd,
+                               scatter, stack, unstack, expand, expand_as,
+                               clip, where, topk)
+from ..fluid.layers import nn as _nn
+
+def add(x, y): return _L.elementwise_add(x, y)
+def subtract(x, y): return _L.elementwise_sub(x, y)
+def multiply(x, y): return _L.elementwise_mul(x, y)
+def divide(x, y): return _L.elementwise_div(x, y)
+def pow(x, y): return _L.elementwise_pow(x, y)
+def maximum(x, y): return _L.elementwise_max(x, y)
+def minimum(x, y): return _L.elementwise_min(x, y)
+def sqrt(x): return _nn.sqrt(x)
+def square(x): return _nn.square(x)
+def exp(x): return _nn.exp(x)
+def log(x): return _nn.log(x)
+def abs(x): return _nn.abs(x)
+def tanh(x): return _nn.tanh(x)
+def mean(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_mean", x, axis, keepdim)
+def sum(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_sum", x, axis, keepdim)
+def max(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_max", x, axis, keepdim)
+def min(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_min", x, axis, keepdim)
+def prod(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_prod", x, axis, keepdim)

@@ -1,0 +1,4 @@
+"""paddle.text analog (reference python/paddle/text/): NLP datasets +
+model zoo entry points re-exported from models/."""
+from ..models.bert import BertModel, BertForPretraining, ErnieModel
+from ..models.transformer import TransformerModel

@@ -1,0 +1,4 @@
+"""paddle.vision analog (reference python/paddle/vision/)."""
+from . import datasets
+from . import models
+from . import transforms

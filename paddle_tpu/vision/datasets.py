@@ -1,0 +1,95 @@
+"""Datasets (reference python/paddle/vision/datasets + python/paddle/dataset).
+Zero-egress environment: loaders read from local files when present and fall
+back to deterministic synthetic data shaped exactly like the real dataset —
+enough for convergence tests and benchmarking."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, backend=None, synthetic_size=4096):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                _, n, r, c = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, 1, r, c).astype("float32") / 127.5 - 1.0
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8).astype("int64")
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = synthetic_size
+            self.labels = rng.randint(0, 10, n).astype("int64")
+            # class-dependent blobs so a model can actually fit them
+            self.images = rng.randn(n, 1, 28, 28).astype("float32") * 0.3
+            for i in range(n):
+                y = self.labels[i]
+                self.images[i, 0, y:y + 8, y:y + 8] += 2.0
+
+    def __getitem__(self, idx):
+        img, lbl = self.images[idx], self.labels[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray([lbl], "int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FakeImageNet(Dataset):
+    """Synthetic ImageNet-shaped data for ResNet benchmarking."""
+
+    def __init__(self, size=1024, image_shape=(3, 224, 224), num_classes=1000):
+        rng = np.random.RandomState(42)
+        self.images = rng.randn(size, *image_shape).astype("float32")
+        self.labels = rng.randint(0, num_classes, size).astype("int64")
+
+    def __getitem__(self, idx):
+        return self.images[idx], np.asarray([self.labels[idx]], "int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 synthetic_size=2048):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = synthetic_size
+        self.images = rng.randn(n, 3, 32, 32).astype("float32")
+        self.labels = rng.randint(0, 10, n).astype("int64")
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray([self.labels[idx]], "int64")
+
+    def __len__(self):
+        return len(self.images)
+
+
+def mnist_train_reader(batch=None):
+    ds = MNIST(mode="train")
+    def reader():
+        for i in range(len(ds)):
+            img, lbl = ds[i]
+            yield img, lbl
+    return reader

@@ -1,0 +1,37 @@
+"""Fixed-shape vision ops usable inside jit (batched_nms with static k)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def batched_nms(boxes, scores, iou_threshold=0.5, max_outputs=100):
+    """Static-shape NMS: returns (boxes[k], scores[k], valid_mask[k]).
+    Replaces multiclass_nms's dynamic output (XLA requires static shapes)."""
+    k = min(max_outputs, scores.shape[0])
+    order = jnp.argsort(-scores)
+    boxes = boxes[order]
+    scores = scores[order]
+
+    def iou(a, b):
+        lt = jnp.maximum(a[:2], b[:2])
+        rb = jnp.minimum(a[2:], b[2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[0] * wh[1]
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_b = (b[2] - b[0]) * (b[3] - b[1])
+        return inter / (area_a + area_b - inter + 1e-9)
+
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        def check(j, ok):
+            sup = (keep[j] & (iou(boxes[i], boxes[j]) > iou_threshold)
+                   & (j < i))
+            return ok & ~sup
+        ok = jax.lax.fori_loop(0, n, check, True)
+        return keep.at[i].set(ok)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    idx = jnp.argsort(~keep)  # kept first
+    return boxes[idx[:k]], scores[idx[:k]], keep[idx[:k]]

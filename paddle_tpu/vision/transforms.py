@@ -1,0 +1,65 @@
+"""Transforms (reference python/paddle/vision/transforms): numpy host ops."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW"):
+        self.mean = np.asarray(mean, "float32").reshape(-1, 1, 1)
+        self.std = np.asarray(std, "float32").reshape(-1, 1, 1)
+
+    def __call__(self, x):
+        return (np.asarray(x, "float32") - self.mean) / self.std
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, x):
+        import jax
+        import jax.numpy as jnp
+        c = x.shape[0]
+        return np.asarray(jax.image.resize(
+            jnp.asarray(x), (c,) + tuple(self.size), "bilinear"))
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return x[..., ::-1].copy()
+        return x
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, x):
+        if self.padding:
+            x = np.pad(x, [(0, 0), (self.padding,) * 2, (self.padding,) * 2])
+        h, w = x.shape[-2:]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return x[..., i:i + th, j:j + tw]
+
+
+class ToTensor:
+    def __call__(self, x):
+        return np.asarray(x, "float32")

@@ -1,0 +1,38 @@
+"""Test env: 8 virtual CPU devices so multi-chip sharding tests run without
+TPU hardware (SURVEY §4 implication: CPU-backend XLA simulation of a mesh)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+# the axon TPU plugin ignores JAX_PLATFORMS; force CPU explicitly
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs + scope (fluid global state)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import framework, core
+    prev_main = framework._main_program
+    prev_startup = framework._startup_program
+    prev_scope = core._global_scope
+    framework._main_program = framework.Program()
+    framework._startup_program = framework.Program()
+    core._global_scope = core.Scope()
+    framework.reset_unique_name()
+    yield
+    framework._main_program = prev_main
+    framework._startup_program = prev_startup
+    core._global_scope = prev_scope
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,102 @@
+"""OpTest harness — numpy-reference + numeric-gradient checking.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:226 — declare op
+type/inputs/attrs, `check_output` compares against a numpy reference,
+`check_grad` compares the analytic grad against finite differences
+(op_test.py:101 get_numeric_gradient).  Same contract here, driven directly
+through the lowering registry (no Program needed for op-level tests).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import get_op, LoweringContext
+from paddle_tpu.fluid.backward import _generic_grad
+
+
+def _wrap(inputs):
+    return {slot: [jnp.asarray(v) for v in (vals if isinstance(vals, list)
+                                            else [vals])]
+            for slot, vals in inputs.items()}
+
+
+def run_op(op_type: str, inputs: Dict, attrs: Dict = None,
+           is_test: bool = False):
+    opdef = get_op(op_type)
+    ctx = LoweringContext(base_key=jax.random.PRNGKey(0), is_test=is_test)
+    return opdef.fn(_wrap(inputs), attrs or {}, ctx)
+
+
+def check_output(op_type: str, inputs: Dict, expected: Dict,
+                 attrs: Dict = None, atol=1e-5, rtol=1e-5):
+    outs = run_op(op_type, inputs, attrs)
+    for slot, exp in expected.items():
+        exp_list = exp if isinstance(exp, list) else [exp]
+        got_list = outs[slot]
+        assert len(got_list) >= len(exp_list), \
+            f"{op_type}.{slot}: got {len(got_list)} outputs"
+        for got, want in zip(got_list, exp_list):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64)
+                if np.asarray(got).dtype != np.bool_ else np.asarray(got),
+                np.asarray(want, dtype=np.float64)
+                if np.asarray(want).dtype != np.bool_ else np.asarray(want),
+                atol=atol, rtol=rtol,
+                err_msg=f"{op_type} output {slot} mismatch")
+
+
+def check_grad(op_type: str, inputs: Dict, grad_slots: Sequence[str],
+               out_slot: str = "Out", attrs: Dict = None,
+               delta=1e-3, atol=5e-3, rtol=5e-3):
+    """Finite-difference gradient check of the generic vjp grad, f64 on CPU
+    (SURVEY §7 hard part #5)."""
+    attrs = attrs or {}
+    opdef = get_op(op_type)
+    ctx = LoweringContext(base_key=jax.random.PRNGKey(0))
+    ins = {s: [jnp.asarray(np.asarray(v, np.float32)) for v in
+               (vals if isinstance(vals, list) else [vals])]
+           if s in grad_slots else
+           [jnp.asarray(v) for v in (vals if isinstance(vals, list)
+                                     else [vals])]
+           for s, vals in inputs.items()}
+
+    outs = opdef.fn(ins, attrs, ctx)
+    out0 = outs[out_slot][0]
+    # scalar objective: sum(out * weights) for a generic cotangent
+    w = np.random.RandomState(0).randn(*np.asarray(out0).shape) \
+        .astype(np.float32)
+
+    def objective(slot, arr):
+        ins2 = dict(ins)
+        ins2[slot] = [jnp.asarray(arr)] + list(ins[slot][1:])
+        o = opdef.fn(ins2, attrs, ctx)[out_slot][0]
+        return float(np.sum(np.asarray(o, np.float64) * w))
+
+    # analytic grad through generic_grad
+    g_ins = {("I_" + s): vals for s, vals in ins.items()}
+    g_ins["G_" + out_slot] = [jnp.asarray(w)]
+    g_attrs = {"fwd_type": op_type, "fwd_attrs": attrs,
+               "in_slots": list(ins.keys()), "grad_slots": list(grad_slots)}
+    analytic = _generic_grad(g_ins, g_attrs, ctx)
+
+    for slot in grad_slots:
+        a = np.asarray(analytic["GI_" + slot][0], np.float64)
+        x0 = np.asarray(ins[slot][0], np.float64)
+        num = np.zeros_like(x0)
+        flat = x0.reshape(-1)
+        nf = num.reshape(-1)
+        for i in range(flat.size):
+            xp = flat.copy()
+            xp[i] += delta
+            xm = flat.copy()
+            xm[i] -= delta
+            fp = objective(slot, xp.reshape(x0.shape).astype(np.float32))
+            fm = objective(slot, xm.reshape(x0.shape).astype(np.float32))
+            nf[i] = (fp - fm) / (2 * delta)
+        np.testing.assert_allclose(
+            a, num, atol=atol, rtol=rtol,
+            err_msg=f"{op_type} grad w.r.t. {slot} mismatch")

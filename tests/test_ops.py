@@ -1,0 +1,372 @@
+"""Op-level correctness vs numpy references + finite-difference grad checks
+(the reference's OpTest tier, SURVEY §4)."""
+import numpy as np
+import pytest
+
+from op_test import check_output, check_grad, run_op
+
+
+class TestElementwise:
+    def test_add_broadcast_axis(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(3).astype("float32")
+        check_output("elementwise_add", {"X": x, "Y": y},
+                     {"Out": x + y.reshape(1, 3, 1)}, {"axis": 1})
+
+    def test_sub_mul_div(self, rng):
+        x = rng.randn(4, 5).astype("float32")
+        y = rng.rand(4, 5).astype("float32") + 0.5
+        check_output("elementwise_sub", {"X": x, "Y": y}, {"Out": x - y})
+        check_output("elementwise_mul", {"X": x, "Y": y}, {"Out": x * y})
+        check_output("elementwise_div", {"X": x, "Y": y}, {"Out": x / y})
+
+    def test_grad_add(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(3, 4).astype("float32")
+        check_grad("elementwise_add", {"X": x, "Y": y}, ["X", "Y"])
+
+    def test_grad_mul(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(3, 4).astype("float32")
+        check_grad("elementwise_mul", {"X": x, "Y": y}, ["X", "Y"])
+
+    def test_sum_fanin(self, rng):
+        xs = [rng.randn(2, 3).astype("float32") for _ in range(3)]
+        check_output("sum", {"X": xs}, {"Out": xs[0] + xs[1] + xs[2]})
+
+
+class TestActivations:
+    def test_relu(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_output("relu", {"X": x}, {"Out": np.maximum(x, 0)})
+
+    def test_sigmoid(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_output("sigmoid", {"X": x}, {"Out": 1 / (1 + np.exp(-x))})
+
+    def test_gelu_grad(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_grad("gelu", {"X": x}, ["X"])
+
+    def test_tanh_grad(self, rng):
+        x = rng.randn(2, 5).astype("float32")
+        check_grad("tanh", {"X": x}, ["X"])
+
+    def test_leaky_relu(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_output("leaky_relu", {"X": x},
+                     {"Out": np.where(x > 0, x, 0.1 * x)}, {"alpha": 0.1})
+
+
+class TestMatmul:
+    def test_matmul(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(4, 5).astype("float32")
+        check_output("matmul", {"X": x, "Y": y}, {"Out": x @ y}, atol=1e-4)
+
+    def test_matmul_transpose(self, rng):
+        x = rng.randn(4, 3).astype("float32")
+        y = rng.randn(5, 4).astype("float32")
+        check_output("matmul", {"X": x, "Y": y}, {"Out": x.T @ y.T},
+                     {"transpose_X": True, "transpose_Y": True}, atol=1e-4)
+
+    def test_matmul_grad(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(4, 2).astype("float32")
+        check_grad("matmul", {"X": x, "Y": y}, ["X", "Y"])
+
+    def test_mul_flatten(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(12, 5).astype("float32")
+        check_output("mul", {"X": x, "Y": y},
+                     {"Out": x.reshape(2, 12) @ y}, {"x_num_col_dims": 1},
+                     atol=1e-4)
+
+    def test_bmm(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        y = rng.randn(2, 4, 5).astype("float32")
+        check_output("bmm", {"X": x, "Y": y}, {"Out": x @ y}, atol=1e-4)
+
+
+class TestReductions:
+    def test_reduce_sum(self, rng):
+        x = rng.randn(3, 4, 5).astype("float32")
+        check_output("reduce_sum", {"X": x}, {"Out": x.sum(1)},
+                     {"dim": [1]}, atol=1e-4)
+        check_output("reduce_sum", {"X": x}, {"Out": x.sum()},
+                     {"reduce_all": True}, atol=1e-4)
+
+    def test_reduce_mean_grad(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_grad("reduce_mean", {"X": x}, ["X"], attrs={"dim": [0]})
+
+    def test_reduce_max(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_output("reduce_max", {"X": x}, {"Out": x.max(1)}, {"dim": [1]})
+
+    def test_topk(self, rng):
+        x = rng.randn(3, 10).astype("float32")
+        outs = run_op("top_k_v2", {"X": x}, {"k": 3})
+        want = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]), want,
+                                   rtol=1e-6)
+
+    def test_argmax(self, rng):
+        x = rng.randn(3, 7).astype("float32")
+        outs = run_op("arg_max", {"X": x}, {"axis": 1})
+        np.testing.assert_array_equal(np.asarray(outs["Out"][0]),
+                                      x.argmax(1))
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat(self, rng):
+        x = rng.randn(2, 12).astype("float32")
+        check_output("reshape2", {"X": x}, {"Out": x.reshape(2, 3, 4)},
+                     {"shape": [2, 3, 4]})
+        x2 = rng.randn(2, 3, 4).astype("float32")
+        check_output("transpose2", {"X": x2},
+                     {"Out": x2.transpose(0, 2, 1)}, {"axis": [0, 2, 1]})
+        a, b = (rng.randn(2, 3).astype("float32") for _ in range(2))
+        check_output("concat", {"X": [a, b]},
+                     {"Out": np.concatenate([a, b], 1)}, {"axis": 1})
+
+    def test_gather_grad(self, rng):
+        x = rng.randn(8, 4).astype("float32")
+        idx = np.array([1, 3, 5], np.int64)
+        check_output("gather", {"X": x, "Index": idx}, {"Out": x[idx]})
+        check_grad("gather", {"X": x, "Index": [idx]}, ["X"])
+
+    def test_slice(self, rng):
+        x = rng.randn(5, 6).astype("float32")
+        check_output("slice", {"Input": x}, {"Out": x[1:3, 2:5]},
+                     {"axes": [0, 1], "starts": [1, 2], "ends": [3, 5]})
+
+    def test_lookup_table_grad(self, rng):
+        w = rng.randn(10, 4).astype("float32")
+        ids = np.array([[1, 2], [3, 1]], np.int64)
+        check_output("lookup_table_v2", {"W": w, "Ids": ids},
+                     {"Out": w[ids]})
+        check_grad("lookup_table_v2", {"W": w, "Ids": [ids]}, ["W"])
+
+    def test_split_stack(self, rng):
+        x = rng.randn(4, 6).astype("float32")
+        outs = run_op("split", {"X": x}, {"num": 3, "axis": 1})
+        for got, want in zip(outs["Out"], np.split(x, 3, 1)):
+            np.testing.assert_allclose(np.asarray(got), want)
+
+    def test_cast_onehot(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        check_output("cast", {"X": x}, {"Out": x.astype("float64")},
+                     {"out_dtype": "float64"})
+        ids = np.array([1, 0, 3], np.int64)
+        out = run_op("one_hot_v2", {"X": ids}, {"depth": 4})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out), np.eye(4)[ids])
+
+
+class TestNN:
+    def test_softmax(self, rng):
+        x = rng.randn(3, 5).astype("float32")
+        e = np.exp(x - x.max(1, keepdims=True))
+        check_output("softmax", {"X": x}, {"Out": e / e.sum(1, keepdims=True)},
+                     atol=1e-5)
+
+    def test_softmax_grad(self, rng):
+        x = rng.randn(2, 4).astype("float32")
+        check_grad("softmax", {"X": x}, ["X"])
+
+    def test_layer_norm(self, rng):
+        x = rng.randn(2, 6).astype("float32")
+        s = rng.rand(6).astype("float32")
+        b = rng.randn(6).astype("float32")
+        m = x.mean(1, keepdims=True)
+        v = x.var(1, keepdims=True)
+        want = (x - m) / np.sqrt(v + 1e-5) * s + b
+        check_output("layer_norm", {"X": x, "Scale": s, "Bias": b},
+                     {"Y": want}, {"epsilon": 1e-5, "begin_norm_axis": 1},
+                     atol=1e-4)
+
+    def test_layer_norm_grad(self, rng):
+        x = rng.randn(2, 5).astype("float32")
+        s = rng.rand(5).astype("float32") + 0.5
+        b = rng.randn(5).astype("float32")
+        check_grad("layer_norm", {"X": x, "Scale": [s], "Bias": [b]},
+                   ["X", "Scale", "Bias"], out_slot="Y",
+                   attrs={"epsilon": 1e-5, "begin_norm_axis": 1})
+
+    def test_batch_norm_train_stats(self, rng):
+        x = rng.randn(4, 3, 2, 2).astype("float32")
+        scale = np.ones(3, "float32")
+        bias = np.zeros(3, "float32")
+        mean = np.zeros(3, "float32")
+        var = np.ones(3, "float32")
+        outs = run_op("batch_norm",
+                      {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var},
+                      {"momentum": 0.9, "epsilon": 1e-5})
+        m = x.mean((0, 2, 3))
+        v = x.var((0, 2, 3))
+        want = (x - m.reshape(1, 3, 1, 1)) / np.sqrt(
+            v.reshape(1, 3, 1, 1) + 1e-5)
+        np.testing.assert_allclose(np.asarray(outs["Y"][0]), want, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(outs["MeanOut"][0]),
+                                   0.9 * mean + 0.1 * m, atol=1e-5)
+
+    def test_conv2d(self, rng):
+        x = rng.randn(1, 1, 4, 4).astype("float32")
+        w = rng.randn(2, 1, 3, 3).astype("float32")
+        outs = run_op("conv2d", {"Input": x, "Filter": w},
+                      {"strides": [1, 1], "paddings": [0, 0],
+                       "dilations": [1, 1]})
+        # naive reference
+        want = np.zeros((1, 2, 2, 2), "float32")
+        for oc in range(2):
+            for i in range(2):
+                for j in range(2):
+                    want[0, oc, i, j] = np.sum(
+                        x[0, 0, i:i + 3, j:j + 3] * w[oc, 0])
+        np.testing.assert_allclose(np.asarray(outs["Output"][0]), want,
+                                   atol=1e-4)
+
+    def test_conv2d_grad(self, rng):
+        x = rng.randn(1, 2, 5, 5).astype("float32")
+        w = rng.randn(3, 2, 3, 3).astype("float32")
+        check_grad("conv2d", {"Input": x, "Filter": w}, ["Input", "Filter"],
+                   out_slot="Output",
+                   attrs={"strides": [1, 1], "paddings": [1, 1],
+                          "dilations": [1, 1]}, atol=1e-2, rtol=1e-2)
+
+    def test_pool2d(self, rng):
+        x = rng.randn(1, 1, 4, 4).astype("float32")
+        outs = run_op("pool2d", {"X": x},
+                      {"ksize": [2, 2], "strides": [2, 2],
+                       "pooling_type": "max"})
+        want = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]), want)
+
+    def test_dropout_modes(self, rng):
+        x = rng.randn(100, 100).astype("float32")
+        # test mode downgrade: out = x * (1 - p)
+        outs = run_op("dropout", {"X": x}, {"dropout_prob": 0.3,
+                                            "is_test": True})
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]), x * 0.7,
+                                   rtol=1e-6)
+        # train mode: keep ratio approximately 1-p
+        outs = run_op("dropout", {"X": np.ones_like(x)},
+                      {"dropout_prob": 0.3, "op_seed": 7})
+        keep = np.asarray(outs["Mask"][0]).mean()
+        assert abs(keep - 0.7) < 0.03
+
+
+class TestLosses:
+    def test_softmax_xent(self, rng):
+        logits = rng.randn(4, 5).astype("float32")
+        label = np.array([[0], [3], [2], [1]], np.int64)
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        sm = e / e.sum(1, keepdims=True)
+        want = -np.log(sm[np.arange(4), label.ravel()])[:, None]
+        check_output("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": label},
+                     {"Loss": want}, atol=1e-5)
+
+    def test_softmax_xent_custom_grad(self, rng):
+        """Custom fused grad must equal softmax - onehot."""
+        from paddle_tpu.fluid.backward import _generic_grad
+        from paddle_tpu.ops.registry import LoweringContext
+        import jax, jax.numpy as jnp
+        logits = rng.randn(3, 4).astype("float32")
+        label = np.array([[1], [0], [2]], np.int64)
+        g_ins = {"I_Logits": [jnp.asarray(logits)],
+                 "I_Label": [jnp.asarray(label)],
+                 "G_Loss": [jnp.ones((3, 1), jnp.float32)]}
+        attrs = {"fwd_type": "softmax_with_cross_entropy", "fwd_attrs": {},
+                 "in_slots": ["Logits", "Label"], "grad_slots": ["Logits"]}
+        out = _generic_grad(g_ins, attrs,
+                            LoweringContext(base_key=jax.random.PRNGKey(0)))
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        sm = e / e.sum(1, keepdims=True)
+        onehot = np.eye(4)[label.ravel()]
+        np.testing.assert_allclose(np.asarray(out["GI_Logits"][0]),
+                                   sm - onehot, atol=1e-5)
+
+    def test_cross_entropy(self, rng):
+        x = rng.rand(3, 4).astype("float32") + 0.1
+        x = x / x.sum(1, keepdims=True)
+        label = np.array([[1], [3], [0]], np.int64)
+        want = -np.log(x[np.arange(3), label.ravel()])[:, None]
+        check_output("cross_entropy", {"X": x, "Label": label}, {"Y": want},
+                     atol=1e-5)
+
+    def test_sigmoid_xent(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        lbl = (rng.rand(3, 4) > 0.5).astype("float32")
+        want = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+        check_output("sigmoid_cross_entropy_with_logits",
+                     {"X": x, "Label": lbl}, {"Out": want}, atol=1e-5)
+
+    def test_accuracy(self, rng):
+        logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32")
+        outs = run_op("top_k", {"X": logits}, {"k": 1})
+        out2 = run_op("accuracy",
+                      {"Out": [outs["Out"][0]], "Indices": [outs["Indices"][0]],
+                       "Label": [np.array([[1], [0], [0]], np.int64)]})
+        np.testing.assert_allclose(float(out2["Accuracy"][0]), 2.0 / 3,
+                                   rtol=1e-6)
+
+
+class TestOptimizers:
+    def test_sgd(self, rng):
+        p = rng.randn(4).astype("float32")
+        g = rng.randn(4).astype("float32")
+        outs = run_op("sgd", {"Param": p, "Grad": g,
+                              "LearningRate": np.array([0.1], "float32")})
+        np.testing.assert_allclose(np.asarray(outs["ParamOut"][0]),
+                                   p - 0.1 * g, rtol=1e-6)
+
+    def test_adam_matches_reference(self, rng):
+        p = rng.randn(4).astype("float32")
+        g = rng.randn(4).astype("float32")
+        m = np.zeros(4, "float32")
+        v = np.zeros(4, "float32")
+        outs = run_op("adam", {
+            "Param": p, "Grad": g, "Moment1": m, "Moment2": v,
+            "Beta1Pow": np.array([0.9], "float32"),
+            "Beta2Pow": np.array([0.999], "float32"),
+            "LearningRate": np.array([0.01], "float32")},
+            {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+        m2 = 0.1 * g
+        v2 = 0.001 * g * g
+        lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+        want = p - lr_t * m2 / (np.sqrt(v2) + 1e-8)
+        np.testing.assert_allclose(np.asarray(outs["ParamOut"][0]), want,
+                                   rtol=1e-5)
+
+    def test_momentum(self, rng):
+        p = rng.randn(4).astype("float32")
+        g = rng.randn(4).astype("float32")
+        v = rng.randn(4).astype("float32")
+        outs = run_op("momentum", {"Param": p, "Grad": g, "Velocity": v,
+                                   "LearningRate": np.array([0.1], "float32")},
+                      {"mu": 0.9})
+        v2 = 0.9 * v + g
+        np.testing.assert_allclose(np.asarray(outs["ParamOut"][0]),
+                                   p - 0.1 * v2, rtol=1e-5)
+
+
+class TestAmpOps:
+    def test_check_finite_and_unscale(self):
+        xs = [np.array([1.0, 2.0], "float32"), np.array([np.inf], "float32")]
+        outs = run_op("check_finite_and_unscale",
+                      {"X": xs, "Scale": np.array([2.0], "float32")})
+        assert bool(outs["FoundInfinite"][0][0])
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]), [0.5, 1.0])
+
+    def test_update_loss_scaling_decreases(self):
+        outs = run_op("update_loss_scaling", {
+            "X": [np.ones(3, "float32")],
+            "FoundInfinite": np.array([True]),
+            "PrevLossScaling": np.array([1024.0], "float32"),
+            "InGoodSteps": np.array([5], np.int32),
+            "InBadSteps": np.array([1], np.int32)},
+            {"decr_every_n_nan_or_inf": 2, "decr_ratio": 0.5})
+        assert float(outs["LossScaling"][0][0]) == 512.0
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]), 0.0)

@@ -1,0 +1,141 @@
+"""End-to-end static-graph tests — the reference's tests/book tier
+(test_recognize_digits.py, fit-a-line) running real convergence."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _blob_data(rng, n=64):
+    labels = rng.randint(0, 10, n).astype("int64")
+    images = rng.randn(n, 1, 28, 28).astype("float32") * 0.3
+    for i in range(n):
+        y = labels[i]
+        images[i, 0, y:y + 8, y:y + 8] += 2.0
+    return images, labels[:, None]
+
+
+def test_fit_a_line(rng):
+    x = fluid.data("x", [-1, 13])
+    y = fluid.data("y", [-1, 1])
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    w_true = rng.randn(13, 1).astype("float32")
+    xs = rng.randn(256, 13).astype("float32")
+    ys = xs @ w_true + 0.01 * rng.randn(256, 1).astype("float32")
+    losses = []
+    for step in range(100):
+        lv, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, losses[::20]
+
+
+def test_recognize_digits_lenet(rng):
+    img = fluid.data("img", [-1, 1, 28, 28])
+    label = fluid.data("label", [-1, 1], dtype="int64")
+    conv1 = fluid.layers.conv2d(img, 6, 3, padding=1, act="relu")
+    pool1 = fluid.layers.pool2d(conv1, 2, "max", 2)
+    conv2 = fluid.layers.conv2d(pool1, 16, 5, act="relu")
+    pool2 = fluid.layers.pool2d(conv2, 2, "max", 2)
+    fc1 = fluid.layers.fc(fluid.layers.flatten(pool2), 120, act="relu")
+    logits = fluid.layers.fc(fc1, 10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(logits, label)
+    fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    images, labels = _blob_data(rng)
+    for step in range(40):
+        lv, av = exe.run(feed={"img": images, "label": labels},
+                         fetch_list=[loss, acc])
+    assert float(lv) < 0.5
+    assert float(av) > 0.9
+
+
+def test_batch_norm_running_stats_update(rng):
+    x = fluid.data("x", [-1, 4, 3, 3])
+    out = fluid.layers.batch_norm(x, momentum=0.5)
+    loss = fluid.layers.mean(out)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    bn_mean_name = [n for n in scope.local_var_names() if ".w" in n or True]
+    data = rng.randn(8, 4, 3, 3).astype("float32") + 5.0
+    exe.run(feed={"x": data}, fetch_list=[loss])
+    # after one step the moving mean must move toward ~5
+    prog = fluid.default_main_program()
+    mean_vars = [v.name for v in prog.global_block().vars.values()
+                 if v.persistable and "batch_norm" in v.name]
+    moved = False
+    for n in mean_vars:
+        val = np.asarray(scope.find_var(n))
+        if val.shape == (4,) and np.abs(val).mean() > 0.5:
+            moved = True
+    assert moved, "moving mean did not update"
+
+
+def test_save_load_persistables(tmp_path, rng):
+    x = fluid.data("x", [-1, 8])
+    out = fluid.layers.fc(x, 4)
+    loss = fluid.layers.mean(out)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(4, 8).astype("float32")
+    exe.run(feed={"x": xs}, fetch_list=[loss])
+
+    scope = fluid.global_scope()
+    params = {n: np.asarray(scope.find_var(n))
+              for n in scope.local_var_names()}
+    fluid.save_persistables(exe, str(tmp_path))
+
+    # perturb then restore
+    for n in params:
+        scope.set_var(n, params[n] * 0 + 99.0)
+    fluid.load_persistables(exe, str(tmp_path))
+    for n, want in params.items():
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), want,
+                                   err_msg=n)
+
+
+def test_program_clone_for_test_drops_grads(rng):
+    x = fluid.data("x", [-1, 8])
+    out = fluid.layers.fc(x, 4)
+    loss = fluid.layers.mean(out)
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    prog = fluid.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "generic_grad" not in types
+    assert "sgd" not in types
+
+
+def test_exponential_decay_training(rng):
+    """Multiple optimizers with gradient clipping."""
+    x = fluid.data("x", [-1, 10])
+    y = fluid.data("y", [-1, 1])
+    h = fluid.layers.fc(x, 16, act="tanh")
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    from paddle_tpu.fluid.clip import GradientClipByGlobalNorm
+    opt = fluid.optimizer.MomentumOptimizer(
+        0.05, 0.9, grad_clip=GradientClipByGlobalNorm(1.0))
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(64, 10).astype("float32")
+    ys = (xs.sum(1, keepdims=True) > 0).astype("float32")
+    first = None
+    for step in range(50):
+        lv, = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+    assert float(lv) < first
