@@ -1,0 +1,129 @@
+"""Benchmark: BERT-base pretraining train-step throughput on one chip.
+
+BASELINE config #3 ("BERT-base pretraining — AMP/bf16") — the headline
+number.  Runs the flagship model through the dygraph->functional bridge as
+ONE jitted XLA program per step (forward + backward + Adam), bf16 compute
+via the framework AMP autocast, and reports tokens/sec/chip plus MFU.
+`vs_baseline` is measured MFU / 0.35 (the north-star ">=35% MFU" target in
+BASELINE.md).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_train_step(vocab, hidden, layers, heads, ffn, seq, batch, lr=1e-4):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.functional import functional_loss
+    from paddle_tpu.models.bert import BertForPretraining
+
+    dybase.enable_dygraph()
+    tracer = dybase._dygraph_tracer()
+    tracer._amp_enabled = True          # bf16 autocast on matmul/conv (MXU)
+    model = BertForPretraining(vocab_size=vocab, hidden_size=hidden,
+                               num_layers=layers, num_heads=heads,
+                               intermediate_size=ffn, max_position=seq)
+    model.train()
+
+    def loss_fn(input_ids, mlm_labels, nsp_labels):
+        mlm_logits, nsp_logits = model(input_ids)
+        return model.loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
+
+    param_values, lfn = functional_loss(model, loss_fn)
+
+    def train_step(params, opt_m, opt_v, t, input_ids, mlm_labels, nsp_labels):
+        loss, grads = jax.value_and_grad(lfn)(params, input_ids, mlm_labels,
+                                              nsp_labels)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        t = t + 1
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(params, grads, opt_m, opt_v):
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mh = m / (1 - b1 ** t)
+            vh = v / (1 - b2 ** t)
+            new_p.append((p.astype(jnp.float32)
+                          - lr * mh / (jnp.sqrt(vh) + eps)).astype(p.dtype))
+            new_m.append(m)
+            new_v.append(v)
+        return new_p, new_m, new_v, t, loss
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    opt_m = [jnp.zeros(p.shape, jnp.float32) for p in param_values]
+    opt_v = [jnp.zeros(p.shape, jnp.float32) for p in param_values]
+    n_params = sum(int(np.prod(p.shape)) for p in param_values)
+    return jstep, param_values, opt_m, opt_v, n_params
+
+
+def flops_per_token(hidden, layers, ffn, seq, vocab):
+    """fwd+bwd matmul FLOPs per token (Chinchilla-style accounting)."""
+    per_layer = 2 * (4 * hidden * hidden + 2 * hidden * ffn)   # qkvo + mlp
+    attn = 2 * 2 * seq * hidden                                # scores + av
+    head = 2 * hidden * vocab
+    fwd = layers * (per_layer + attn) + head
+    return 3 * fwd                                             # bwd = 2x fwd
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    quick = "--quick" in sys.argv
+    backend = jax.default_backend()
+    if quick or backend == "cpu":
+        vocab, hidden, layers, heads, ffn = 1000, 128, 2, 4, 512
+        seq, batch, steps, warmup = 128, 8, 5, 2
+    else:
+        vocab, hidden, layers, heads, ffn = 30522, 768, 12, 12, 3072
+        seq, batch, steps, warmup = 128, 64, 20, 3
+
+    jstep, params, opt_m, opt_v, n_params = build_train_step(
+        vocab, hidden, layers, heads, ffn, seq, batch)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    mlm = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    nsp = jnp.asarray(rng.randint(0, 2, (batch,)).astype("int32"))
+    t = jnp.zeros((), jnp.int32)
+
+    for _ in range(warmup):
+        params, opt_m, opt_v, t, loss = jstep(params, opt_m, opt_v, t,
+                                              ids, mlm, nsp)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_m, opt_v, t, loss = jstep(params, opt_m, opt_v, t,
+                                              ids, mlm, nsp)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * batch * seq / dt
+    fpt = flops_per_token(hidden, layers, ffn, seq, vocab)
+    achieved = tokens_per_sec * fpt
+    # bf16 peak: v5e 197 TF; MFU only meaningful on a known accelerator
+    peak = {"tpu": 197e12}.get(backend)
+    mfu = achieved / peak if peak else 0.0
+
+    print(json.dumps({
+        "metric": "bert_base_pretrain_throughput",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "backend": backend,
+        "mfu": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

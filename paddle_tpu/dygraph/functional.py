@@ -1,0 +1,61 @@
+"""Functionalize a dygraph Layer: (params, inputs) -> outputs pure function.
+
+This is the load-bearing bridge between the eager API and XLA whole-program
+compilation: a dygraph model's op stream IS a pure JAX trace once parameter
+values are passed as arguments, so `jax.jit` / `jax.value_and_grad` /
+`shard_map` apply directly.  It subsumes the reference's ProgramTranslator
+AST rewriting (dygraph_to_static/program_translator.py:729) — no source
+transforms are needed because the eager ops are already traceable lowerings.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+import jax
+
+from .base import VarBase, to_variable, no_grad_ctx
+
+
+def _unwrap(x):
+    if isinstance(x, VarBase):
+        return x.value()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def functionalize(model, method: Callable = None
+                  ) -> Tuple[List[jax.Array], Callable]:
+    """Return (param_values, fn) where fn(param_values, *arrays) re-binds the
+    parameters, runs `method` (default: model.__call__) eagerly, and restores
+    the original parameter values — pure and jit-traceable.  Inputs are raw
+    arrays; outputs are raw arrays/pytrees."""
+    params = model.parameters()
+    call = method if method is not None else model
+
+    def fn(param_values, *arrays):
+        if len(param_values) != len(params):
+            raise ValueError(
+                f"expected {len(params)} parameter values, got "
+                f"{len(param_values)}")
+        saved = [p._value for p in params]
+        try:
+            for p, v in zip(params, param_values):
+                p._value = v
+            with no_grad_ctx():
+                out = call(*[to_variable(a) for a in arrays])
+            return _unwrap(out)
+        finally:
+            # without this, jit tracing leaves tracers bound to the live
+            # model and later eager calls raise UnexpectedTracerError
+            for p, v in zip(params, saved):
+                p._value = v
+
+    return [p._value for p in params], fn
+
+
+def functional_loss(model, loss_fn) -> Tuple[List[jax.Array], Callable]:
+    """functionalize() with `loss_fn(*inputs) -> scalar loss` as the method
+    (loss_fn closes over the model) — the jax.value_and_grad target for a
+    whole-model training step."""
+    return functionalize(model, method=loss_fn)

@@ -14,3 +14,4 @@ from . import loss_ops        # noqa: F401  losses/metrics
 from . import random_ops      # noqa: F401  RNG ops
 from . import optimizer_ops   # noqa: F401  optimizer updates + AMP
 from . import collective_ops  # noqa: F401  ICI collectives
+from . import attention       # noqa: F401  fused attention (Pallas/XLA)

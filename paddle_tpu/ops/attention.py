@@ -44,9 +44,10 @@ def flash_attention(q, k, v, mask=None, scale=None, causal=False):
     if on_tpu and seq >= _PALLAS_MIN_SEQ and mask is None:
         try:
             from .pallas_kernels import flash_attention_tpu
+        except ImportError:
+            flash_attention_tpu = None
+        if flash_attention_tpu is not None:
             return flash_attention_tpu(q, k, v, scale=scale, causal=causal)
-        except Exception:
-            pass
     return _reference_attention(q, k, v, mask, scale, causal)
 
 

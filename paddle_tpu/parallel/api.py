@@ -55,6 +55,6 @@ def wrap_with_mesh(fn, mesh: Mesh, program, batch_axis: str = "dp",
 
 def shard_map_step(fn, mesh: Mesh, in_specs, out_specs):
     """Explicit-mode: shard_map with collective ops live on their axes."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=False))
+                             out_specs=out_specs, check_vma=False))
