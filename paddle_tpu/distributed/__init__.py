@@ -1,1 +1,5 @@
 """paddle.distributed analog: fleet, launch, collectives over process mesh."""
+from . import fleet
+from .fleet import DistributedStrategy
+
+__all__ = ["fleet", "DistributedStrategy"]

@@ -1,0 +1,45 @@
+"""paddle.distributed.fleet analog — unified distributed training API.
+
+Usage (same surface as the reference's fleet 2.0):
+
+    from paddle_tpu.distributed import fleet
+    fleet.init(is_collective=True)
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    opt = fleet.distributed_optimizer(optimizer, strategy)
+    opt.minimize(loss)
+"""
+from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import (Role, RoleMakerBase, PaddleCloudRoleMaker,
+                              UserDefinedRoleMaker)
+from .base.fleet_base import Fleet, fleet as _fleet_singleton
+from .base.strategy_compiler import StrategyCompiler
+from . import meta_optimizers
+
+# module-level delegation to the singleton (reference __init__.py binds the
+# same names: fleet_base.py bottom + fleet/__init__.py)
+init = _fleet_singleton.init
+is_first_worker = _fleet_singleton.is_first_worker
+worker_index = _fleet_singleton.worker_index
+worker_num = _fleet_singleton.worker_num
+is_worker = _fleet_singleton.is_worker
+worker_endpoints = _fleet_singleton.worker_endpoints
+server_num = _fleet_singleton.server_num
+server_index = _fleet_singleton.server_index
+server_endpoints = _fleet_singleton.server_endpoints
+is_server = _fleet_singleton.is_server
+barrier_worker = _fleet_singleton.barrier_worker
+init_worker = _fleet_singleton.init_worker
+init_server = _fleet_singleton.init_server
+run_server = _fleet_singleton.run_server
+stop_worker = _fleet_singleton.stop_worker
+distributed_optimizer = _fleet_singleton.distributed_optimizer
+save_inference_model = _fleet_singleton.save_inference_model
+save_persistables = _fleet_singleton.save_persistables
+minimize = _fleet_singleton.minimize
+
+__all__ = [
+    "DistributedStrategy", "Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker", "Fleet", "StrategyCompiler", "meta_optimizers",
+    "init", "distributed_optimizer", "minimize",
+]

@@ -1,0 +1,5 @@
+from .distributed_strategy import DistributedStrategy
+from .role_maker import (Role, RoleMakerBase, PaddleCloudRoleMaker,
+                         UserDefinedRoleMaker)
+from .fleet_base import Fleet, fleet
+from .strategy_compiler import StrategyCompiler

@@ -1,0 +1,125 @@
+"""DistributedStrategy — the Fleet 2.0 feature switchboard.
+
+Reference: paddle/fluid/framework/distributed_strategy.proto:112 (the
+`DistributedStrategy` message) with per-feature config sub-messages at
+:25-110 and Build/ExecutionStrategy mirrors at :78-96.  The reference
+stores this as a protobuf so it can ship across the RPC boundary to
+pservers; on TPU the strategy never leaves the host process, so a plain
+attribute bag with the same field names is the idiomatic equivalent.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+
+# defaults follow distributed_strategy.proto field defaults
+_FIELD_DEFAULTS: Dict[str, Any] = {
+    # communication / execution
+    "a_sync": False,
+    "auto": False,
+    "elastic": False,
+    "nccl_comm_num": 1,
+    "sync_nccl_allreduce": True,
+    "use_hierarchical_allreduce": False,
+    "hierarchical_allreduce_inter_nranks": 1,
+    "sync_batch_norm": False,
+    "fuse_all_reduce_ops": True,
+    "fuse_grad_size_in_MB": 32,
+    "fuse_grad_size_in_TFLOPS": 50.0,
+    "cudnn_exhaustive_search": False,
+    "conv_workspace_size_limit": 512,
+    "cudnn_batchnorm_spatial_persistent": False,
+    # feature toggles
+    "amp": False,
+    "recompute": False,
+    "localsgd": False,
+    "adaptive_localsgd": False,
+    "dgc": False,
+    "gradient_merge": False,
+    "lars": False,
+    "lamb": False,
+    "pipeline": False,
+    "sharding": False,
+    "fp16_allreduce": False,
+}
+
+_CONFIG_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    # proto:25-110 per-feature config messages
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.8,
+        "use_dynamic_loss_scaling": True,
+        "custom_white_list": [],
+        "custom_black_list": [],
+    },
+    "recompute_configs": {"checkpoints": []},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0,
+                     "exclude_from_weight_decay": []},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "pipeline_configs": {"micro_batch": 1},
+    "sharding_configs": {"fuse_broadcast_MB": 32.0, "hybrid_dp": False,
+                         "sharding_group_size": 8},
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16,
+                       "independent_recv_thread": False,
+                       "thread_pool_size": 1, "send_wait_times": 1,
+                       "runtime_split_send_recv": True, "launch_barrier": True,
+                       "geo_sgd_need_push_nums": 100},
+    "fp16_allreduce_configs": {},
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.__dict__["_fields"] = copy.deepcopy(_FIELD_DEFAULTS)
+        self.__dict__["_configs"] = copy.deepcopy(_CONFIG_DEFAULTS)
+        # strategy mirrors of BuildStrategy/ExecutionStrategy (proto :78-96)
+        from ....fluid.compiler import BuildStrategy, ExecutionStrategy
+        self.__dict__["build_strategy"] = BuildStrategy()
+        self.__dict__["execution_strategy"] = ExecutionStrategy()
+
+    def __getattr__(self, name):
+        fields = self.__dict__.get("_fields", {})
+        configs = self.__dict__.get("_configs", {})
+        if name in fields:
+            return fields[name]
+        if name in configs:
+            return configs[name]
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        if name in ("build_strategy", "execution_strategy"):
+            self.__dict__[name] = value
+            return
+        if name in self._fields:
+            self._fields[name] = value
+            return
+        if name in self._configs:
+            if not isinstance(value, dict):
+                raise TypeError(f"{name} expects a dict of config keys")
+            cfg = self._configs[name]
+            unknown = set(value) - set(cfg) if cfg else set()
+            if unknown:
+                raise ValueError(f"unknown {name} keys: {sorted(unknown)}")
+            cfg.update(value)
+            return
+        raise AttributeError(f"DistributedStrategy has no field {name!r}")
+
+    def _enabled_features(self):
+        return sorted(k for k, v in self._fields.items()
+                      if isinstance(v, bool) and v)
+
+    def __repr__(self):
+        on = ", ".join(self._enabled_features()) or "none"
+        return f"<DistributedStrategy enabled=[{on}]>"

@@ -1,0 +1,167 @@
+"""Fleet — the unified distributed-training facade.
+
+Reference: python/paddle/distributed/fleet/base/fleet_base.py:129 (`init`),
+:583 (`distributed_optimizer`), :978 (`minimize`).  The facade and its
+composition flow are kept; the underlying transports are TPU-native:
+`jax.distributed.initialize` is the gen_nccl_id rendezvous, the device mesh
+is the communicator, and the PS tier (a_sync) is served by the host-side
+embedding service (distributed/ps/).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy_compiler import StrategyCompiler
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._is_collective = False
+        self._user_defined_strategy: Optional[DistributedStrategy] = None
+        self._user_defined_optimizer = None
+        self._final_strategy: Optional[DistributedStrategy] = None
+        self._strategy_compiler: Optional[StrategyCompiler] = None
+        self._context = {}
+        self._runtime_handle = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        if isinstance(role_maker, bool):           # fleet.init(True) legacy
+            is_collective, role_maker = role_maker, None
+        self._is_collective = is_collective or (
+            role_maker is not None and getattr(role_maker, "_is_collective",
+                                               False))
+        if role_maker is None:
+            role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
+        self._role_maker = role_maker
+        self._role_maker._generate_role()
+        self._user_defined_strategy = strategy or DistributedStrategy()
+        self._strategy_compiler = StrategyCompiler()
+        # multi-process rendezvous (the c_gen_nccl_id analog): only when the
+        # launcher provided coordination env and jax isn't already set up
+        coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+        if coord:
+            import jax
+            if jax.process_count() == 1 and len(
+                    self._role_maker._get_trainer_endpoints()) > 1:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=self._role_maker._worker_num(),
+                    process_id=self._role_maker._worker_index())
+        return self
+
+    # -- role queries (fleet_base.py:240-420 surface) -----------------------
+    def is_first_worker(self):
+        return self._role_maker._is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker._worker_index()
+
+    def worker_num(self):
+        return self._role_maker._worker_num()
+
+    def is_worker(self):
+        return self._role_maker._is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker._get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker._server_num()
+
+    def server_index(self):
+        return self._role_maker._server_index()
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker._get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._role_maker._is_server()
+
+    def barrier_worker(self):
+        self._role_maker._barrier("worker")
+
+    # -- PS runtime ---------------------------------------------------------
+    def init_worker(self):
+        if self._runtime_handle is not None:
+            self._runtime_handle.init_worker()
+
+    def init_server(self, *args, **kwargs):
+        if self._runtime_handle is not None:
+            self._runtime_handle.init_server(*args, **kwargs)
+
+    def run_server(self):
+        if self._runtime_handle is not None:
+            self._runtime_handle.run_server()
+
+    def stop_worker(self):
+        if self._runtime_handle is not None:
+            self._runtime_handle.stop_worker()
+
+    def _set_runtime_handle(self, handle):
+        self._runtime_handle = handle
+
+    # -- save ---------------------------------------------------------------
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ....fluid import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor,
+                                       main_program=main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ....fluid import io
+        return io.save_persistables(executor, dirname,
+                                    main_program=main_program)
+
+    # -- the optimizer composition (fleet_base.py:583,978) ------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._user_defined_optimizer = optimizer
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return self
+
+    @property
+    def _applied_meta_list(self):
+        return self._strategy_compiler._get_applied_meta_list()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..meta_optimizers import (
+            AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
+            LambOptimizer, LarsOptimizer, LocalSGDOptimizer, DGCOptimizer,
+            FP16AllReduceOptimizer, ShardingOptimizer, PipelineOptimizer,
+            GraphExecutionOptimizer)
+        opt = self._user_defined_optimizer
+        if opt is None:
+            raise RuntimeError("call fleet.distributed_optimizer first")
+        strategy = self._user_defined_strategy
+        candidates = [cls(opt) for cls in (
+            AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
+            LambOptimizer, LarsOptimizer, LocalSGDOptimizer, DGCOptimizer,
+            FP16AllReduceOptimizer, ShardingOptimizer, PipelineOptimizer,
+            GraphExecutionOptimizer)]
+        for c in candidates:
+            c._set_basic_info(loss, self._role_maker, opt, strategy)
+
+        metas, graphs = self._strategy_compiler.generate_optimizer(
+            loss, self._role_maker, opt, strategy, candidates, [])
+        final = (metas + graphs)[-1] if (metas or graphs) else opt
+        self._final_strategy = strategy
+        ops, params_grads = final.minimize(loss, startup_program,
+                                           parameter_list, no_grad_set)
+        if strategy.a_sync and self._runtime_handle is None:
+            from ...ps.the_one_ps import TheOnePSRuntime
+            self._runtime_handle = TheOnePSRuntime(self._role_maker,
+                                                   strategy)
+        return ops, params_grads
+
+
+fleet = Fleet()

@@ -1,0 +1,137 @@
+"""Role makers — who am I in the cluster.
+
+Reference: python/paddle/distributed/fleet/base/role_maker.py:535
+(`PaddleCloudRoleMaker` reads PADDLE_TRAINER_ENDPOINTS / PADDLE_PORT /
+TRAINING_ROLE env) and `UserDefinedRoleMaker`.  TPU-native: the same env
+contract is honoured, plus the JAX multi-process env (`jax.process_index`)
+when `jax.distributed` has been initialised — the gen_nccl_id rendezvous
+analog (SURVEY §5 "Distributed communication backend").
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+        self._role_is_generated = False
+
+    def _generate_role(self):
+        self._role_is_generated = True
+
+    def _ensure(self):
+        if not self._role_is_generated:
+            self._generate_role()
+
+    def _is_worker(self):
+        self._ensure()
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        self._ensure()
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self):
+        return self._is_worker() and self._worker_index() == 0
+
+    def _worker_index(self):
+        self._ensure()
+        return self._current_id if self._role == Role.WORKER else -1
+
+    def _server_index(self):
+        self._ensure()
+        return self._current_id if self._role == Role.SERVER else -1
+
+    def _worker_num(self):
+        self._ensure()
+        return max(1, len(self._worker_endpoints))
+
+    def _server_num(self):
+        self._ensure()
+        return len(self._server_endpoints)
+
+    def _get_trainer_endpoints(self):
+        self._ensure()
+        return list(self._worker_endpoints)
+
+    def _get_pserver_endpoints(self):
+        self._ensure()
+        return list(self._server_endpoints)
+
+    def _barrier(self, comm_world="worker"):
+        # single-host fallback: nothing to sync.  Multi-process: an
+        # all-reduce over the DCN mesh is the Gloo-barrier analog.
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"fleet_barrier_{comm_world}")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-driven role maker (role_maker.py:535 contract)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+
+    def _generate_role(self):
+        if self._role_is_generated:
+            return
+        if self._is_collective:
+            self._role = Role.WORKER
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = [e for e in eps.split(",") if e]
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            if not self._worker_endpoints:
+                # JAX multi-process contract as the fallback
+                import jax
+                self._current_id = jax.process_index()
+                self._worker_endpoints = [
+                    f"proc:{i}" for i in range(jax.process_count())]
+        else:
+            role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+            ps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = [e for e in ps.split(",") if e]
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = [e for e in eps.split(",") if e]
+            if role in ("PSERVER", "SERVER"):
+                self._role = Role.SERVER
+                ip = os.environ.get("POD_IP", "127.0.0.1")
+                port = os.environ.get("PADDLE_PORT", "0")
+                me = f"{ip}:{port}"
+                self._current_id = (self._server_endpoints.index(me)
+                                    if me in self._server_endpoints else 0)
+            else:
+                self._role = Role.WORKER
+                self._current_id = int(
+                    os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._role_is_generated = True
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None, worker_endpoints=None,
+                 is_collective=False):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._is_collective = is_collective
+        self._server_endpoints = server_endpoints or []
+        self._worker_endpoints = (worker_endpoints or
+                                  [f"proc:{i}" for i in range(worker_num)])
+
+    def _generate_role(self):
+        self._role_is_generated = True

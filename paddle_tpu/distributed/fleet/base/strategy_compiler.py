@@ -1,0 +1,74 @@
+"""StrategyCompiler — selects and chains applicable meta-optimizers.
+
+Reference: python/paddle/distributed/fleet/base/strategy_compiler.py —
+`generate_optimizer` filters meta-optimizers by `_can_apply`, resolves
+mutual-exclusion via white/black lists, orders them so graph-level
+optimizers run last, and chains them by `_update_inner_optimizer`.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+def maximum_path_len_algo(optimizer_list):
+    """Pick the longest mutually-compatible chain (reference algorithm:
+    each candidate keeps the others only if they appear in its white list;
+    graph-out optimizers are forced to the tail)."""
+    if not optimizer_list:
+        return []
+    candidates = []
+    for opt in optimizer_list:
+        chain = [opt]
+        white = set(type(opt).meta_optimizers_white_list)
+        for other in optimizer_list:
+            if other is opt:
+                continue
+            if type(other).__name__ in white or other._is_graph_out():
+                chain.append(other)
+        candidates.append(chain)
+    best = max(candidates, key=len)
+    # chain order = wrapping order (first is innermost): optimizer-replacing
+    # metas (Lamb/Lars/DGC) must sit innermost so wrappers like AMP decorate
+    # the replacement, not the discarded user optimizer; graph-out
+    # (execution-level) optimizers wrap everything
+    best.sort(key=lambda o: (not getattr(o, "replaces_optimizer", False),
+                             o._is_graph_out()))
+    return best
+
+
+class StrategyCompilerBase:
+    pass
+
+
+class StrategyCompiler(StrategyCompilerBase):
+    def __init__(self):
+        self._meta_optimizers = []
+        self._graph_optimizers = []
+
+    def _get_applied_meta_list(self):
+        return [type(o).__name__ for o in self._meta_optimizers]
+
+    def _get_applied_graph_list(self):
+        return [type(o).__name__ for o in self._graph_optimizers]
+
+    def generate_optimizer(self, loss, role_maker, optimizer,
+                           user_defined_strategy, meta_optimizer_list,
+                           graph_optimizer_list):
+        applicable = [o for o in meta_optimizer_list if o._can_apply()]
+        chain = maximum_path_len_algo(applicable)
+        # disable strategy bits whose optimizer didn't make the chain, so
+        # the effective strategy reflects reality (reference behavior)
+        chosen = {id(o) for o in chain}
+        for o in meta_optimizer_list:
+            if id(o) not in chosen:
+                o._disable_strategy(user_defined_strategy)
+
+        self._meta_optimizers = [o for o in chain if not o._is_graph_out()]
+        self._graph_optimizers = [o for o in chain if o._is_graph_out()]
+
+        # chain: innermost = user optimizer, each meta wraps the previous
+        inner = optimizer
+        for o in self._meta_optimizers + self._graph_optimizers:
+            o._update_inner_optimizer(inner)
+            inner = o
+        return self._meta_optimizers, self._graph_optimizers

@@ -1,0 +1,19 @@
+from .meta_optimizer_base import MetaOptimizerBase
+from .amp_optimizer import AMPOptimizer
+from .recompute_optimizer import RecomputeOptimizer
+from .gradient_merge_optimizer import GradientMergeOptimizer
+from .lamb_optimizer import LambOptimizer
+from .lars_optimizer import LarsOptimizer
+from .localsgd_optimizer import LocalSGDOptimizer
+from .dgc_optimizer import DGCOptimizer
+from .fp16_allreduce_optimizer import FP16AllReduceOptimizer
+from .sharding_optimizer import ShardingOptimizer
+from .pipeline_optimizer import PipelineOptimizer
+from .graph_execution_optimizer import GraphExecutionOptimizer
+
+__all__ = [
+    "MetaOptimizerBase", "AMPOptimizer", "RecomputeOptimizer",
+    "GradientMergeOptimizer", "LambOptimizer", "LarsOptimizer",
+    "LocalSGDOptimizer", "DGCOptimizer", "FP16AllReduceOptimizer",
+    "ShardingOptimizer", "PipelineOptimizer", "GraphExecutionOptimizer",
+]

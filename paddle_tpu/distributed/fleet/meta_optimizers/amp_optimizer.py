@@ -1,0 +1,57 @@
+"""AMP meta-optimizer (reference: meta_optimizers/amp_optimizer.py).
+
+Delegates to the static AMP decorator (amp/static_amp.py), which rewrites
+the program to bf16 per black/white lists — the TPU-native counterpart of
+the reference's fp16 rewrite (contrib/mixed_precision/decorate:253).
+"""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    meta_optimizers_white_list = [
+        "LarsOptimizer", "LambOptimizer", "RecomputeOptimizer",
+        "LocalSGDOptimizer", "GradientMergeOptimizer",
+        "GraphExecutionOptimizer",
+    ]
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.wrapped_opt = None
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.amp)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.amp = False
+
+    def _enable_strategy(self, dist_strategy, context=None):
+        dist_strategy.amp = True
+
+    def _init_wrapped_opt(self):
+        if self.wrapped_opt is not None:
+            return
+        from ....amp import static_amp
+        cfg = self.user_defined_strategy.amp_configs
+        lists = static_amp.CustomOpLists(
+            custom_white_list=cfg["custom_white_list"],
+            custom_black_list=cfg["custom_black_list"])
+        self.wrapped_opt = static_amp.decorate(
+            self.inner_opt, amp_lists=lists,
+            init_loss_scaling=cfg["init_loss_scaling"],
+            incr_every_n_steps=cfg["incr_every_n_steps"],
+            decr_every_n_nan_or_inf=cfg["decr_every_n_nan_or_inf"],
+            incr_ratio=cfg["incr_ratio"], decr_ratio=cfg["decr_ratio"],
+            use_dynamic_loss_scaling=cfg["use_dynamic_loss_scaling"])
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        self._init_wrapped_opt()
+        return self.wrapped_opt.backward(loss)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        self._init_wrapped_opt()
+        return self.wrapped_opt.minimize(loss, startup_program,
+                                         parameter_list, no_grad_set)

@@ -1,0 +1,79 @@
+"""Shared helpers for meta-optimizers.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/common.py:68-106
+(`CollectiveHelper._init_communicator` appends c_gen_nccl_id/c_comm_init
+ops; `_insert_allreduce_ops` appends per-grad c_allreduce_sum + sync ops).
+TPU-native: comm bootstrap is `jax.distributed` + the mesh registry
+(parallel/mesh.py) — there is no nccl-id handshake to append ops for — and
+the allreduce ops lower to lax.psum on the `dp` mesh axis (identity under
+pjit auto-sharding, which inserts its own reduce; see parallel/api.py).
+"""
+from __future__ import annotations
+
+from ....fluid.framework import Program
+from ....parallel import mesh as mesh_registry
+
+OP_ROLE_KEY = "op_role"
+OpRole = type("OpRole", (), {"Forward": 0, "Backward": 1, "Optimize": 2,
+                             "RPC": 3, "Dist": 4, "LRSched": 16, "Loss": 256})
+
+
+def is_loss_grad_op(op):
+    return op.type == "fill_constant" and op.attrs.get(
+        OP_ROLE_KEY) == OpRole.Backward | OpRole.Loss
+
+
+def is_backward_op(op):
+    return op.attrs.get(OP_ROLE_KEY, 0) & OpRole.Backward
+
+
+def is_optimizer_op(op):
+    return op.attrs.get(OP_ROLE_KEY, 0) & OpRole.Optimize
+
+
+class CollectiveHelper:
+    """Registers the dp ring and inserts grad all-reduce ops."""
+
+    def __init__(self, role_maker, nrings=1, wait_port=None):
+        self.role_maker = role_maker
+        self.nrings = nrings
+
+    def update_startup_program(self, startup_program=None):
+        # c_gen_nccl_id/c_comm_init analog: bind ring 0 to the dp mesh axis
+        mesh_registry.register_ring(mesh_registry.RING_DP, "dp")
+
+
+def insert_allreduce_ops(block, params_grads, ring_id=0, average=True):
+    """Append a gradient all-reduce on every grad (common.py:68-106 shape).
+
+    The reference emits scale(1/nranks) + c_allreduce_sum because each
+    trainer's loss is a local-batch mean.  Here the averaging lives in the
+    collective itself (c_allreduce_avg): under explicit shard_map it lowers
+    to pmean of local-batch grads (≡ scale+sum), and under pjit
+    auto-sharding it lowers to identity — correct, because the program's
+    loss is a global-batch mean and GSPMD already inserts the reduction —
+    whereas a bare host-side 1/nranks scale would shrink grads.
+    """
+    op_type = "c_allreduce_avg" if average else "c_allreduce_sum"
+    # insert before the first grad-consuming op (loss-unscale or optimizer
+    # update) so synced grads feed the update — the reference achieves the
+    # same by op-role-aware insertion offsets (common.py:71)
+    grad_consumers = {"check_finite_and_unscale", "sgd", "momentum",
+                      "lars_momentum", "adam", "adamw", "lamb", "adagrad",
+                      "rmsprop", "ftrl", "dpsgd", "dgc_momentum"}
+    pos = len(block.ops)
+    for i, op in enumerate(block.ops):
+        if op.type in grad_consumers:
+            pos = i
+            break
+    new_pg = []
+    for p, g in params_grads:
+        op = block.append_op(
+            op_type, inputs={"X": [g]}, outputs={"Out": [g]},
+            attrs={"ring_id": ring_id, "use_calc_stream": True,
+                   OP_ROLE_KEY: OpRole.Backward})
+        block.ops.remove(op)
+        block.ops.insert(pos, op)
+        pos += 1
+        new_pg.append((p, g))
+    return new_pg

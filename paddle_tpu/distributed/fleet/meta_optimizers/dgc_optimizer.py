@@ -1,0 +1,50 @@
+"""DGC meta-optimizer (reference: meta_optimizers/dgc_optimizer.py) —
+swaps a Momentum inner optimizer for DGCMomentumOptimizer."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+from .common import CollectiveHelper
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    replaces_optimizer = True
+    meta_optimizers_white_list = ["AMPOptimizer", "RecomputeOptimizer"]
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.dgc_opt = None
+
+    def _can_apply(self):
+        if not self.user_defined_strategy.dgc:
+            return False
+        from ....fluid.optimizer import MomentumOptimizer
+        return isinstance(self.user_defined_optimizer, MomentumOptimizer)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.dgc = False
+
+    def _init_dgc(self):
+        if self.dgc_opt is not None:
+            return
+        from ....fluid.optimizer import DGCMomentumOptimizer
+        cfg = self.user_defined_strategy.dgc_configs
+        inner = self.user_defined_optimizer
+        self.dgc_opt = DGCMomentumOptimizer(
+            learning_rate=inner._learning_rate,
+            momentum=getattr(inner, "_momentum", 0.9),
+            rampup_begin_step=cfg["rampup_begin_step"],
+            rampup_step=cfg["rampup_step"],
+            sparsity=cfg["sparsity"],
+            grad_clip=inner._grad_clip)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        self._init_dgc()
+        CollectiveHelper(self.role_maker).update_startup_program(
+            startup_program)
+        return self.dgc_opt.minimize(loss, startup_program, parameter_list,
+                                     no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        self._init_dgc()
+        return self.dgc_opt.apply_gradients(params_grads)

@@ -1,0 +1,48 @@
+"""FP16-allreduce meta-optimizer (reference:
+meta_optimizers/fp16_allreduce_optimizer.py) — halves gradient allreduce
+bytes by casting grads to 16-bit before the collective.  bf16 on TPU (same
+wire width as fp16, no loss-scaling interaction)."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    meta_optimizers_white_list = [
+        "LocalSGDOptimizer", "GradientMergeOptimizer",
+        "GraphExecutionOptimizer", "RecomputeOptimizer", "AMPOptimizer",
+        "LarsOptimizer", "LambOptimizer",
+    ]
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.fp16_allreduce)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.fp16_allreduce = False
+
+    @staticmethod
+    def fp16_compression(params_grads):
+        """Cast grad -> bf16 before (implicit) allreduce, back after —
+        fp16_allreduce_optimizer.py:26 pattern, op-for-op."""
+        from ....fluid import layers
+        out = []
+        for p, g in params_grads:
+            if g is None or str(p.dtype) not in ("float32", "FP32"):
+                out.append((p, g))
+                continue
+            g16 = layers.cast(g, "bfloat16")
+            g32 = layers.cast(g16, "float32")
+            out.append((p, g32))
+        return out
+
+    def apply_gradients(self, params_grads):
+        return self.inner_opt.apply_gradients(
+            self.fp16_compression(params_grads))
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        pg = self.inner_opt.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        pg = self.fp16_compression(pg)
+        ops = self.inner_opt.apply_gradients(pg)
+        return ops, pg

@@ -1,0 +1,31 @@
+"""GradientMerge meta-optimizer (reference:
+meta_optimizers/gradient_merge_optimizer.py) — accumulate k micro-steps of
+gradients in persistable accumulators, apply every k-th step."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    meta_optimizers_white_list = [
+        "AMPOptimizer", "LarsOptimizer", "LambOptimizer",
+        "RecomputeOptimizer", "GraphExecutionOptimizer",
+    ]
+
+    def _can_apply(self):
+        if not self.user_defined_strategy.gradient_merge:
+            return False
+        return self.user_defined_strategy.gradient_merge_configs[
+            "k_steps"] > 1
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.gradient_merge = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....fluid.optimizer import GradientMergeOptimizer as FluidGM
+        cfg = self.user_defined_strategy.gradient_merge_configs
+        wrapped = FluidGM(self.inner_opt, k_steps=cfg["k_steps"],
+                          avg=cfg["avg"])
+        return wrapped.minimize(loss, startup_program, parameter_list,
+                                no_grad_set)

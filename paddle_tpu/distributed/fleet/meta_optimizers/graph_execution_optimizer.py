@@ -1,0 +1,46 @@
+"""GraphExecution meta-optimizer — the collective-DP default.
+
+Reference: meta_optimizers/graph_execution_optimizer.py:53-101 (sets up
+NCCL rings via gen_nccl_id ops, then compiles with ParallelExecutor).
+TPU-native: the "ring" is the dp axis of the device mesh; gradient
+all-reduce ops are appended per-grad (common.py insert_allreduce_ops, the
+exact program shape the reference builds) and the program is annotated with
+the mesh so the Executor jits it SPMD.  Under pjit auto-sharding the
+c_allreduce ops lower to identity and GSPMD inserts the reduction from the
+sharding propagation instead — both paths produce one psum over ICI.
+"""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+from .common import CollectiveHelper, insert_allreduce_ops
+
+
+class GraphExecutionOptimizer(MetaOptimizerBase):
+    def _can_apply(self):
+        # applies whenever fleet was initialised collectively
+        rm = self.role_maker
+        return bool(getattr(rm, "_is_collective", False))
+
+    def _disable_strategy(self, dist_strategy):
+        pass
+
+    def _is_graph_out(self):
+        return True
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        program = loss.block.program
+        CollectiveHelper(self.role_maker).update_startup_program(
+            startup_program)
+        nranks = self.role_maker._worker_num()
+        if nranks > 1:
+            insert_allreduce_ops(loss.block, params_grads, ring_id=0,
+                                 average=True)
+        # attach the dp mesh so Executor.run compiles SPMD
+        from ....parallel.mesh import build_data_parallel_mesh
+        import jax
+        if len(jax.devices()) > 1 or nranks > 1:
+            program._mesh = build_data_parallel_mesh()
+        return ops, params_grads

@@ -1,0 +1,58 @@
+"""LAMB meta-optimizer (reference: meta_optimizers/lamb_optimizer.py) —
+swaps an Adam inner optimizer for layer-adaptive LAMB."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LambOptimizer(MetaOptimizerBase):
+    replaces_optimizer = True
+    meta_optimizers_white_list = [
+        "AMPOptimizer", "RecomputeOptimizer", "GradientMergeOptimizer",
+        "GraphExecutionOptimizer",
+    ]
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.lamb_opt = None
+
+    def _can_apply(self):
+        if not self.user_defined_strategy.lamb:
+            return False
+        from ....fluid.optimizer import AdamOptimizer
+        return type(self.user_defined_optimizer) is AdamOptimizer or \
+            type(self.user_defined_optimizer).__name__ in ("Adam",
+                                                           "AdamOptimizer")
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.lamb = False
+
+    def _init_lamb(self):
+        if self.lamb_opt is not None:
+            return
+        from ....fluid.optimizer import LambOptimizer as FluidLamb
+        cfg = self.user_defined_strategy.lamb_configs
+        inner = self.user_defined_optimizer
+        self.lamb_opt = FluidLamb(
+            learning_rate=inner._learning_rate,
+            lamb_weight_decay=cfg["lamb_weight_decay"],
+            beta1=getattr(inner, "_beta1", 0.9),
+            beta2=getattr(inner, "_beta2", 0.999),
+            epsilon=getattr(inner, "_epsilon", 1e-6),
+            grad_clip=inner._grad_clip)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        self._init_lamb()
+        return self.lamb_opt.backward(loss, startup_program, parameter_list,
+                                      no_grad_set, callbacks)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        self._init_lamb()
+        return self.lamb_opt.minimize(loss, startup_program, parameter_list,
+                                      no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        self._init_lamb()
+        return self.lamb_opt.apply_gradients(params_grads)

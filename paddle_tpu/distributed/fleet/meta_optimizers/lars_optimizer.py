@@ -1,0 +1,55 @@
+"""LARS meta-optimizer (reference: meta_optimizers/lars_optimizer.py) —
+swaps a Momentum inner optimizer for layer-adaptive LARS momentum."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    replaces_optimizer = True
+    meta_optimizers_white_list = [
+        "AMPOptimizer", "RecomputeOptimizer", "GradientMergeOptimizer",
+        "GraphExecutionOptimizer",
+    ]
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.lars_opt = None
+
+    def _can_apply(self):
+        if not self.user_defined_strategy.lars:
+            return False
+        from ....fluid.optimizer import MomentumOptimizer
+        return isinstance(self.user_defined_optimizer, MomentumOptimizer)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.lars = False
+
+    def _init_lars(self):
+        if self.lars_opt is not None:
+            return
+        from ....fluid.optimizer import LarsMomentumOptimizer
+        cfg = self.user_defined_strategy.lars_configs
+        inner = self.user_defined_optimizer
+        self.lars_opt = LarsMomentumOptimizer(
+            learning_rate=inner._learning_rate,
+            momentum=getattr(inner, "_momentum", 0.9),
+            lars_coeff=cfg["lars_coeff"],
+            lars_weight_decay=cfg["lars_weight_decay"],
+            grad_clip=inner._grad_clip)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        self._init_lars()
+        return self.lars_opt.backward(loss, startup_program, parameter_list,
+                                      no_grad_set, callbacks)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        self._init_lars()
+        return self.lars_opt.minimize(loss, startup_program, parameter_list,
+                                      no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        self._init_lars()
+        return self.lars_opt.apply_gradients(params_grads)

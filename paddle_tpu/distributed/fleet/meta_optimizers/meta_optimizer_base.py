@@ -1,0 +1,68 @@
+"""MetaOptimizerBase — composable strategy-driven optimizer wrappers.
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/meta_optimizer_base.py
+(each meta-optimizer declares `_can_apply`, `_disable_strategy`, and
+`minimize_impl`; `StrategyCompiler` chains the applicable ones).  Kept
+verbatim as an architecture: the composition pattern is front-end level and
+carries over to TPU unchanged — only the mechanisms inside each optimizer
+become XLA-native (psum instead of NCCL, remat hints instead of program
+surgery, sharding annotations instead of broadcast ops).
+"""
+from __future__ import annotations
+
+
+class MetaOptimizerBase:
+    # subclasses list meta-optimizers they can wrap (by class name)
+    meta_optimizers_white_list: list = []
+    meta_optimizers_black_list: list = []
+
+    def __init__(self, optimizer):
+        self.inner_opt = optimizer
+        self.user_defined_optimizer = optimizer
+        self.user_defined_strategy = None
+        self.role_maker = None
+        self.loss = None
+
+    def _set_basic_info(self, loss, role_maker, user_defined_optimizer,
+                        user_defined_strategy):
+        self.loss = loss
+        self.role_maker = role_maker
+        self.user_defined_optimizer = user_defined_optimizer
+        self.user_defined_strategy = user_defined_strategy
+
+    def _update_inner_optimizer(self, optimizer):
+        self.inner_opt = optimizer
+
+    def _can_apply(self) -> bool:
+        return False
+
+    def _is_graph_out(self) -> bool:
+        return False
+
+    def _can_update(self, optimizer) -> bool:
+        return True
+
+    def _disable_strategy(self, dist_strategy):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement _disable_strategy")
+
+    def _enable_strategy(self, dist_strategy, context=None):
+        pass
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self.inner_opt.backward(loss, startup_program, parameter_list,
+                                       no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self.inner_opt.apply_gradients(params_grads)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.minimize_impl(loss, startup_program, parameter_list,
+                                  no_grad_set)

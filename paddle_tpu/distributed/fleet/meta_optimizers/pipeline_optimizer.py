@@ -1,0 +1,24 @@
+"""Pipeline meta-optimizer (reference: meta_optimizers/pipeline_optimizer.py)
+— wraps the fluid PipelineOptimizer (GPipe microbatching over pp mesh
+stages; see parallel/hybrid.py for the ppermute schedule)."""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    meta_optimizers_white_list = ["AMPOptimizer", "RecomputeOptimizer"]
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.pipeline)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.pipeline = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        from ....fluid.optimizer import PipelineOptimizer as FluidPipeline
+        micro = self.user_defined_strategy.pipeline_configs["micro_batch"]
+        wrapped = FluidPipeline(self.inner_opt, num_microbatches=micro)
+        return wrapped.minimize(loss, startup_program, parameter_list,
+                                no_grad_set)

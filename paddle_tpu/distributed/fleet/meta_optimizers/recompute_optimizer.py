@@ -1,0 +1,51 @@
+"""Recompute meta-optimizer (reference: meta_optimizers/recompute_optimizer.py).
+
+The fluid RecomputeOptimizer records checkpoint var names as program hints;
+the executor turns segments between checkpoints into jax.checkpoint
+(rematerialisation) boundaries — the XLA-native version of the reference's
+_append_backward_ops_with_checkpoints_ program surgery (backward.py:689).
+"""
+from __future__ import annotations
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    meta_optimizers_white_list = [
+        "LarsOptimizer", "LambOptimizer", "GradientMergeOptimizer",
+        "GraphExecutionOptimizer",
+    ]
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.wrapped_opt = None
+
+    def _can_apply(self):
+        if not self.user_defined_strategy.recompute:
+            return False
+        return len(self.user_defined_strategy.recompute_configs[
+            "checkpoints"]) > 0
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.recompute = False
+
+    def _init_wrapped_opt(self):
+        if self.wrapped_opt is not None:
+            return
+        from ....fluid.optimizer import RecomputeOptimizer as FluidRecompute
+        self.wrapped_opt = FluidRecompute(self.inner_opt)
+        self.wrapped_opt._set_checkpoints(
+            list(self.user_defined_strategy.recompute_configs["checkpoints"]))
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        self._init_wrapped_opt()
+        return self.wrapped_opt.backward(loss, startup_program,
+                                         parameter_list, no_grad_set,
+                                         callbacks)
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        self._init_wrapped_opt()
+        return self.wrapped_opt.minimize(loss, startup_program,
+                                         parameter_list, no_grad_set)

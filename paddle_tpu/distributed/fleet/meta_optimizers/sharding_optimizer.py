@@ -1,0 +1,64 @@
+"""Sharding (ZeRO) meta-optimizer (reference:
+meta_optimizers/sharding_optimizer.py:69 minimize_impl — segments the
+program, inserts broadcast/allreduce, prunes non-owned params per rank).
+
+TPU-native: optimizer-state sharding is a *sharding annotation*, not a
+program rewrite.  Every optimizer accumulator created by the inner
+optimizer gets a PartitionSpec over the dp axis; GSPMD then keeps one shard
+of each moment per device and inserts the reduce-scatter/all-gather pair
+that the reference builds by hand — the scaling-book ZeRO recipe.  Params
+stay replicated (hybrid_dp=False keeps full ZeRO-1 semantics).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .meta_optimizer_base import MetaOptimizerBase
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    meta_optimizers_white_list = ["AMPOptimizer", "LarsOptimizer",
+                                  "LambOptimizer", "RecomputeOptimizer",
+                                  "GraphExecutionOptimizer"]
+
+    def _can_apply(self):
+        return bool(self.user_defined_strategy.sharding)
+
+    def _disable_strategy(self, dist_strategy):
+        dist_strategy.sharding = False
+
+    def minimize_impl(self, loss, startup_program=None, parameter_list=None,
+                      no_grad_set=None):
+        ops, params_grads = self.inner_opt.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        program = loss.block.program
+        block = program.global_block()
+        param_names = {p.name for p, _ in params_grads}
+        # annotate every optimizer accumulator (persistable, non-param,
+        # same shape as some param) with a dp-sharded PartitionSpec on its
+        # largest divisible dim; parallel/api.param_sharding picks these up.
+        for name, var in block.vars.items():
+            if not getattr(var, "persistable", False) or name in param_names:
+                continue
+            shape = tuple(getattr(var, "shape", ()) or ())
+            if not shape or int(np.prod(shape)) <= 1:
+                continue
+            if not _is_accum(name):
+                continue
+            var.sharding = _spec_for(shape)
+        program._hints["sharding"] = True
+        return ops, params_grads
+
+
+def _is_accum(name: str) -> bool:
+    tags = ("moment", "velocity", "beta1_pow", "beta2_pow", "squared",
+            "avg_squared", "dgc_u", "dgc_v", "linear_", "_acc")
+    return any(t in name for t in tags)
+
+
+def _spec_for(shape):
+    """Shard dim 0 over dp when possible, else replicate."""
+    spec = [None] * len(shape)
+    if shape[0] > 1:
+        spec[0] = "dp"
+    return tuple(spec)

@@ -1,0 +1,144 @@
+"""Process launcher — `python -m paddle_tpu.distributed.launch train.py`.
+
+Reference: python/paddle/distributed/fleet/launch.py:196 (launch_collective
+— one proc per device, env wiring, child monitoring) and :248 (launch_ps).
+TPU-native: one process per *host* (a TPU host already owns all its local
+chips through one PJRT client — per-chip processes would fight over the
+runtime), with `PADDLE_TPU_COORDINATOR` carrying the jax.distributed
+rendezvous address the way gen_nccl_id carried the NCCL unique id.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes on this host (1 per host is the TPU norm)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", default="127.0.0.1:8571",
+                   help="coordinator address (host:port)")
+    p.add_argument("--ips", default=None,
+                   help="comma-separated node IPs, one per --nnodes "
+                        "(default: the master host for all nodes)")
+    p.add_argument("--server_num", type=int, default=0,
+                   help="launch_ps mode: number of parameter servers")
+    p.add_argument("--worker_num", type=int, default=0)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _spawn(cmd, env, log_dir, tag):
+    out = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"{tag}.log"), "w")
+    return subprocess.Popen(cmd, env=env, stdout=out,
+                            stderr=subprocess.STDOUT if out else None)
+
+
+def launch_collective(args):
+    nranks = args.nnodes * args.nproc_per_node
+    procs = []
+    base_port = int(args.master.rsplit(":", 1)[1])
+    master_host = args.master.rsplit(":", 1)[0]
+    node_ips = (args.ips.split(",") if args.ips
+                else [master_host] * args.nnodes)
+    if len(node_ips) != args.nnodes:
+        raise ValueError(f"--ips lists {len(node_ips)} hosts for "
+                         f"--nnodes={args.nnodes}")
+    endpoints = ",".join(
+        f"{node_ips[i // args.nproc_per_node]}:"
+        f"{base_port + 100 + i % args.nproc_per_node}"
+        for i in range(nranks))
+    for local in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+            "PADDLE_TRAINERS_NUM": str(nranks),
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TPU_COORDINATOR": args.master if nranks > 1 else "",
+        })
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        procs.append(_spawn(cmd, env, args.log_dir, f"trainer_{rank}"))
+    return _monitor(procs)
+
+
+def launch_ps(args):
+    host = args.master.rsplit(":", 1)[0]
+    base_port = int(args.master.rsplit(":", 1)[1])
+    server_eps = ",".join(f"{host}:{base_port + 10 + i}"
+                          for i in range(args.server_num))
+    worker_eps = ",".join(f"{host}:{base_port + 200 + i}"
+                          for i in range(args.worker_num))
+    procs = []
+    for i in range(args.server_num):
+        env = dict(os.environ)
+        env.update({"TRAINING_ROLE": "PSERVER",
+                    "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+                    "PADDLE_TRAINER_ENDPOINTS": worker_eps,
+                    "POD_IP": host,
+                    "PADDLE_PORT": str(base_port + 10 + i),
+                    "PADDLE_TRAINERS_NUM": str(args.worker_num)})
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        procs.append(_spawn(cmd, env, args.log_dir, f"server_{i}"))
+    for i in range(args.worker_num):
+        env = dict(os.environ)
+        env.update({"TRAINING_ROLE": "TRAINER",
+                    "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+                    "PADDLE_TRAINER_ENDPOINTS": worker_eps,
+                    "PADDLE_TRAINER_ID": str(i),
+                    "PADDLE_TRAINERS_NUM": str(args.worker_num)})
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        procs.append(_spawn(cmd, env, args.log_dir, f"worker_{i}"))
+    return _monitor(procs)
+
+
+def _monitor(procs):
+    """launch_utils.py watcher analog: any child dying tears down the pod."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                ret = p.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        return 1
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.server_num > 0:
+        return launch_ps(args)
+    return launch_collective(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Parameter-server tier: host-RAM sparse tables + runtime.
+
+Reference: paddle/fluid/distributed/ (next-gen PS: table abstractions +
+brpc service) and framework/fleet/fleet_wrapper.h (PSLib client).  On TPU
+the dense path is SPMD over the mesh; only the *sparse embedding* tier
+keeps the PS shape: sharded host-RAM tables with pull/push at the step
+boundary (SURVEY §7 step 8).
+"""
+from .the_one_ps import TheOnePSRuntime
+from . import table
+
+__all__ = ["TheOnePSRuntime", "table"]

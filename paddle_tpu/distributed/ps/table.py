@@ -1,0 +1,157 @@
+"""PS tables — host-RAM parameter storage with pull/push accessors.
+
+Reference: paddle/fluid/distributed/table/ (`CommonDenseTable`,
+`CommonSparseTable`, `SparseGeoTable`, `BarrierTable`) and the accessor
+config in ps.proto:53-124 (embedx_dim, learning-rate semantics live in the
+table, not the trainer).  TPU-native: the sparse tier stays on the host —
+unbounded vocab cannot live in HBM — and the dense compute path pulls rows
+into a padded device batch, pushes gradients back after the step.  The
+`GlobalShuffle`-era RPC plane is replaced by in-process sharding (one table
+shard per host process; cross-host goes over DCN via jax.distributed
+primitives when multi-process).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Initializer:
+    def __init__(self, kind="uniform", scale=0.07, seed=0):
+        self.kind = kind
+        self.scale = scale
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, n, dim):
+        if self.kind == "zeros":
+            return np.zeros((n, dim), np.float32)
+        if self.kind == "gaussian":
+            return (self.rng.randn(n, dim) * self.scale).astype(np.float32)
+        return self.rng.uniform(-self.scale, self.scale,
+                                (n, dim)).astype(np.float32)
+
+
+class CommonSparseTable:
+    """Unbounded id -> row table with per-row optimizer state
+    (large_scale_kv.h + common_sparse_table.cc semantics)."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, initializer=None,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = lr
+        self.init = initializer or Initializer()
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._rows: Dict[int, np.ndarray] = {}
+        self._m: Dict[int, np.ndarray] = {}
+        self._v: Dict[int, np.ndarray] = {}
+        self._t: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """PullSparse: gather rows, creating missing ids (fleet_wrapper.h:111)."""
+        ids = np.asarray(ids).reshape(-1)
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            missing = [i for i in set(ids.tolist()) if i not in self._rows]
+            if missing:
+                fresh = self.init(len(missing), self.dim)
+                for k, i in enumerate(missing):
+                    self._rows[i] = fresh[k]
+            for k, i in enumerate(ids.tolist()):
+                out[k] = self._rows[i]
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray):
+        """PushSparse: apply grads with the table's optimizer
+        (fleet_wrapper.h:200; duplicate ids sum like SelectedRows merge)."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads).reshape(len(ids), self.dim)
+        # merge duplicate ids (selected_rows_functor::MergeAdd)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        with self._lock:
+            for k, i in enumerate(uniq.tolist()):
+                g = merged[k]
+                row = self._rows.get(i)
+                if row is None:
+                    row = self.init(1, self.dim)[0]
+                if self.optimizer == "sgd":
+                    row = row - self.lr * g
+                elif self.optimizer == "adagrad":
+                    acc = self._v.get(i, np.zeros(self.dim, np.float32))
+                    acc = acc + g * g
+                    self._v[i] = acc
+                    row = row - self.lr * g / (np.sqrt(acc) + self.epsilon)
+                elif self.optimizer == "adam":
+                    m = self._m.get(i, np.zeros(self.dim, np.float32))
+                    v = self._v.get(i, np.zeros(self.dim, np.float32))
+                    t = self._t.get(i, 0) + 1
+                    m = self.beta1 * m + (1 - self.beta1) * g
+                    v = self.beta2 * v + (1 - self.beta2) * g * g
+                    mh = m / (1 - self.beta1 ** t)
+                    vh = v / (1 - self.beta2 ** t)
+                    row = row - self.lr * mh / (np.sqrt(vh) + self.epsilon)
+                    self._m[i], self._v[i], self._t[i] = m, v, t
+                else:
+                    raise ValueError(f"unknown accessor {self.optimizer}")
+                self._rows[i] = row
+
+    def size(self):
+        return len(self._rows)
+
+    def save(self, path):
+        with self._lock:
+            ids = np.array(sorted(self._rows), np.int64)
+            vals = np.stack([self._rows[i] for i in ids.tolist()]) \
+                if len(ids) else np.zeros((0, self.dim), np.float32)
+        np.savez(path, ids=ids, vals=vals, dim=self.dim)
+
+    def load(self, path):
+        data = np.load(path if str(path).endswith(".npz") else path + ".npz")
+        with self._lock:
+            self._rows = {int(i): v for i, v in
+                          zip(data["ids"], data["vals"])}
+
+
+class CommonDenseTable:
+    """Dense param mirror for the PS path (common_dense_table.cc)."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01):
+        self.value = np.zeros(shape, np.float32)
+        self.optimizer = optimizer
+        self.lr = lr
+        self._acc = np.zeros(shape, np.float32)
+        self._lock = threading.Lock()
+
+    def pull(self):
+        return self.value.copy()
+
+    def push(self, grad):
+        with self._lock:
+            if self.optimizer == "adagrad":
+                self._acc += grad * grad
+                self.value -= self.lr * grad / (np.sqrt(self._acc) + 1e-8)
+            else:
+                self.value -= self.lr * grad
+
+
+class BarrierTable:
+    """Worker-count barrier (barrier_table.cc) — in-process semaphore."""
+
+    def __init__(self, trainers=1):
+        self.trainers = trainers
+        self._cond = threading.Condition()
+        self._count = 0
+
+    def barrier(self):
+        with self._cond:
+            self._count += 1
+            if self._count >= self.trainers:
+                self._count = 0
+                self._cond.notify_all()
+            else:
+                self._cond.wait(timeout=60.0)

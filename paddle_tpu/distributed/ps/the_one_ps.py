@@ -1,0 +1,52 @@
+"""TheOnePSRuntime — the a_sync (parameter-server) runtime handle.
+
+Reference: python/paddle/distributed/fleet/runtime/the_one_ps.py (fleet's
+PS runtime: builds tables from the program, wires workers to servers).
+TPU-native single-host form: tables live in this process's host RAM
+(distributed/ps/table.py); multi-host sharding assigns table shards to
+server processes by id-hash the way RoundRobin/HashName dispatchers did.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .table import CommonSparseTable, CommonDenseTable, BarrierTable
+
+
+class TheOnePSRuntime:
+    def __init__(self, role_maker, strategy):
+        self._role_maker = role_maker
+        self._strategy = strategy
+        self._tables: Dict[str, CommonSparseTable] = {}
+        self._barrier = BarrierTable(role_maker._worker_num())
+        self._running = False
+
+    # -- table registry -----------------------------------------------------
+    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01):
+        if name not in self._tables:
+            self._tables[name] = CommonSparseTable(dim, optimizer, lr)
+        return self._tables[name]
+
+    def get_table(self, name):
+        return self._tables[name]
+
+    # -- fleet runtime protocol --------------------------------------------
+    def init_worker(self):
+        self._running = True
+
+    def init_server(self, *args, **kwargs):
+        self._running = True
+
+    def run_server(self):
+        # single-process mode: tables are served in-process; a dedicated
+        # server process would loop here on the RPC queue
+        self._running = True
+
+    def stop_worker(self):
+        self._running = False
+
+    def save_persistables(self, dirname):
+        import os
+        os.makedirs(dirname, exist_ok=True)
+        for name, t in self._tables.items():
+            t.save(os.path.join(dirname, f"{name}.sparse"))

@@ -511,4 +511,45 @@ class PipelineOptimizer:
                                         parameter_list, no_grad_set)
 
 
-DGCMomentumOptimizer = MomentumOptimizer  # DGC degenerates on ICI (see ops)
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (optimizer.py:1183,
+    operators/optimizers/dgc_momentum_op.cc).  Per-param state U (momentum
+    correction) and V (error feedback); top-k sparsified grads all-reduced
+    after rampup_begin_step.  See ops/optimizer_ops.py dgc_momentum for the
+    ICI semantics."""
+
+    def __init__(self, learning_rate, momentum=0.9, rampup_begin_step=0,
+                 rampup_step=1, sparsity=None, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = (sparsity or [0.999])[-1]
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+        self._step_var = layers.create_global_var(
+            [1], 0.0, "float32", persistable=True,
+            name=unique_name("dgc_step"))
+
+    def _append_optimize_op(self, param, grad):
+        u = self._get_accumulator("dgc_u", param)
+        v = self._get_accumulator("dgc_v", param)
+        return self.helper.append_op(
+            "dgc_momentum",
+            inputs={"Param": [param], "Grad": [grad], "U": [u], "V": [v],
+                    "LearningRate": [self._lr_var],
+                    "CurrentStep": [self._step_var]},
+            outputs={"ParamOut": [param], "UOut": [u], "VOut": [v]},
+            attrs={"mu": self._momentum, "sparsity": self._sparsity,
+                   "rampup_begin_step": float(self._rampup_begin_step),
+                   "use_nesterov": self._use_nesterov, "ring_id": 0})
+
+    def apply_gradients(self, params_grads):
+        ops = super().apply_gradients(params_grads)
+        self.helper.append_op("increment", inputs={"X": [self._step_var]},
+                              outputs={"Out": [self._step_var]},
+                              attrs={"step": 1.0})
+        return ops

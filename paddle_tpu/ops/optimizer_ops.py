@@ -177,13 +177,6 @@ def _dpsgd(ins, attrs, ctx):
     return {"ParamOut": [p - lr * (g + noise)]}
 
 
-@register_op("dgc_momentum", differentiable=False)
-def _dgc_momentum(ins, attrs, ctx):
-    # deep-gradient-compression momentum falls back to plain momentum on TPU:
-    # ICI bandwidth makes top-k sparsification counterproductive
-    return _momentum(ins, attrs, ctx)
-
-
 # ---------------------------------------------------------------------------
 # AMP dynamic loss scaling (operators/amp/*)
 # ---------------------------------------------------------------------------
@@ -220,3 +213,61 @@ def _update_loss_scaling(ins, attrs, ctx):
     return {"Out": outs, "LossScaling": [scale_dn.reshape(1)],
             "OutGoodSteps": [good_new.reshape(1)],
             "OutBadSteps": [bad_new.reshape(1)]}
+
+
+@register_op("dgc_momentum", differentiable=False)
+def _dgc_momentum(ins, attrs, ctx):
+    """Deep Gradient Compression momentum (operators/optimizers/
+    dgc_momentum_op.cc + operators/dgc_op.cc).  Momentum correction +
+    error-feedback top-k sparsification; the surviving gradient mass is
+    all-reduced.  On ICI the sparse NCCL encoding becomes a dense psum of
+    the masked tensor — bandwidth-optimal sparse collectives don't exist on
+    the mesh fabric, so the compression here preserves the *optimization*
+    semantics (momentum correction, masking, error feedback) rather than
+    wire format.  Before rampup_begin_step it is plain momentum."""
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    u, v = _p(ins, "U"), _p(ins, "V")
+    lr = _p(ins, "LearningRate").reshape(())
+    step = _p(ins, "CurrentStep").reshape(())
+    mu = attrs.get("mu", 0.9)
+    sparsity = attrs.get("sparsity", 0.999)
+    rampup = attrs.get("rampup_begin_step", 0.0)
+    use_nesterov = attrs.get("use_nesterov", False)
+
+    # --- DGC branch: local momentum correction + top-k masking ------------
+    u_corr = mu * u + g                       # momentum correction
+    v_acc = v + u_corr                        # error accumulation
+    flat = jnp.abs(v_acc).reshape(-1)
+    thr = jnp.quantile(flat.astype(jnp.float32), sparsity)
+    mask = (jnp.abs(v_acc) >= thr).astype(v_acc.dtype)
+    encoded = v_acc * mask
+    axis = ctx.axis_for_ring(attrs.get("ring_id", 0))
+    if axis is not None:
+        encoded = jax.lax.psum(encoded, axis_name=axis)
+    dgc_p = p - lr * encoded
+    dgc_u = u_corr * (1.0 - mask)
+    dgc_v = v_acc * (1.0 - mask)
+
+    # --- pre-rampup branch: vanilla (all-reduced) momentum ----------------
+    g_sync = jax.lax.psum(g, axis_name=axis) if axis is not None else g
+    v_mom = mu * u + g_sync
+    mom_p = p - lr * ((g_sync + mu * v_mom) if use_nesterov else v_mom)
+
+    use_dgc = step >= rampup
+    sel = lambda a, b: jnp.where(use_dgc, a, b)
+    return {"ParamOut": [sel(dgc_p, mom_p)], "UOut": [sel(dgc_u, v_mom)],
+            "VOut": [sel(dgc_v, jnp.zeros_like(v))]}
+
+
+@register_op("localsgd_select", differentiable=False)
+def _localsgd_select(ins, attrs, ctx):
+    """LocalSGD periodic parameter averaging gate (see
+    fleet/meta_optimizers/localsgd_optimizer.py): lands the pre-computed
+    ring average only on every k-th step after begin_step."""
+    p, avg = _p(ins, "Param"), _p(ins, "Avg")
+    step = _p(ins, "Step").reshape(())
+    k = attrs.get("k_steps", 1.0)
+    begin = attrs.get("begin_step", 1.0)
+    do_sync = jnp.logical_and(step >= begin,
+                              jnp.mod(step, jnp.maximum(k, 1.0)) == 0)
+    return {"ParamOut": [jnp.where(do_sync, avg, p)]}
