@@ -1,0 +1,94 @@
+// Go-style bounded MPMC channel.
+//
+// Reference: paddle/fluid/framework/channel.h — the dataset pipeline's
+// backbone (reader threads -> parse -> batch assembly all communicate over
+// channels).  Same shape here: blocking Put/Get with capacity back-pressure,
+// Close() drains writers and wakes readers.  Used by the TPU-native data
+// feed (data_feed.cc) whose output batches land in pinned host buffers ready
+// for device upload.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <utility>
+#include <vector>
+
+namespace ptnative {
+
+template <typename T>
+class Channel {
+ public:
+  explicit Channel(size_t capacity = 0) : capacity_(capacity) {}
+
+  // returns false iff channel is closed
+  bool Put(T&& item) {
+    std::unique_lock<std::mutex> lk(mu_);
+    send_cv_.wait(lk, [&] {
+      return closed_ || capacity_ == 0 || buf_.size() < capacity_;
+    });
+    if (closed_) return false;
+    buf_.emplace_back(std::move(item));
+    recv_cv_.notify_one();
+    return true;
+  }
+
+  // returns false iff closed AND drained
+  bool Get(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    recv_cv_.wait(lk, [&] { return closed_ || !buf_.empty(); });
+    if (buf_.empty()) return false;
+    *out = std::move(buf_.front());
+    buf_.pop_front();
+    send_cv_.notify_one();
+    return true;
+  }
+
+  // non-blocking batch read; returns number read (0 when closed+drained
+  // and *open is set false)
+  size_t GetUpTo(size_t n, std::vector<T>* out, bool* open) {
+    std::unique_lock<std::mutex> lk(mu_);
+    recv_cv_.wait(lk, [&] { return closed_ || !buf_.empty(); });
+    size_t got = 0;
+    while (got < n && !buf_.empty()) {
+      out->emplace_back(std::move(buf_.front()));
+      buf_.pop_front();
+      ++got;
+    }
+    *open = !(buf_.empty() && closed_);
+    send_cv_.notify_all();
+    return got;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    send_cv_.notify_all();
+    recv_cv_.notify_all();
+  }
+
+  void Reopen() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = false;
+    buf_.clear();
+  }
+
+  size_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return buf_.size();
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable send_cv_, recv_cv_;
+  std::deque<T> buf_;
+  size_t capacity_;
+  bool closed_ = false;
+};
+
+}  // namespace ptnative
