@@ -25,6 +25,7 @@ def build_train_step(vocab, hidden, layers, heads, ffn, seq, batch, lr=1e-4):
     from paddle_tpu.dygraph import base as dybase
     from paddle_tpu.dygraph.functional import functional_loss
     from paddle_tpu.models.bert import BertForPretraining
+    from paddle_tpu.optimizer.fused import make_fused_adam
 
     dybase.enable_dygraph()
     tracer = dybase._dygraph_tracer()
@@ -39,30 +40,28 @@ def build_train_step(vocab, hidden, layers, heads, ffn, seq, batch, lr=1e-4):
         return model.loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
 
     param_values, lfn = functional_loss(model, loss_fn)
+    opt_state, spec, fused_update = make_fused_adam(param_values, lr=lr)
 
-    def train_step(params, opt_m, opt_v, t, input_ids, mlm_labels, nsp_labels):
-        loss, grads = jax.value_and_grad(lfn)(params, input_ids, mlm_labels,
-                                              nsp_labels)
-        b1, b2, eps = 0.9, 0.999, 1e-8
-        t = t + 1
-        new_p, new_m, new_v = [], [], []
-        for p, g, m, v in zip(params, grads, opt_m, opt_v):
-            g = g.astype(jnp.float32)
-            m = b1 * m + (1 - b1) * g
-            v = b2 * v + (1 - b2) * g * g
-            mh = m / (1 - b1 ** t)
-            vh = v / (1 - b2 ** t)
-            new_p.append((p.astype(jnp.float32)
-                          - lr * mh / (jnp.sqrt(vh) + eps)).astype(p.dtype))
-            new_m.append(m)
-            new_v.append(v)
-        return new_p, new_m, new_v, t, loss
+    # TWO XLA programs per step, like the reference's backward-ops /
+    # optimizer-ops split: the grad program can never fuse the Adam update
+    # into its dW matmuls (observed 10x matmul slowdown when it does), and
+    # both programs compile in seconds where the fused one took >30 min.
+    def grad_step(params, input_ids, mlm_labels, nsp_labels):
+        return jax.value_and_grad(lfn)(params, input_ids, mlm_labels,
+                                       nsp_labels)
 
-    jstep = jax.jit(train_step, donate_argnums=(0, 1, 2))
-    opt_m = [jnp.zeros(p.shape, jnp.float32) for p in param_values]
-    opt_v = [jnp.zeros(p.shape, jnp.float32) for p in param_values]
+    jgrad = jax.jit(grad_step)
+    jupdate = jax.jit(fused_update, donate_argnums=(0, 1))
+    jparams = jax.jit(fused_update.params_of)
+
+    def jstep(state, input_ids, mlm_labels, nsp_labels):
+        params = jparams(state)
+        loss, grads = jgrad(params, input_ids, mlm_labels, nsp_labels)
+        state, _ = jupdate(state, grads)
+        return state, loss
+
     n_params = sum(int(np.prod(p.shape)) for p in param_values)
-    return jstep, param_values, opt_m, opt_v, n_params
+    return jstep, opt_state, n_params
 
 
 def flops_per_token(hidden, layers, ffn, seq, vocab):
@@ -87,25 +86,23 @@ def main():
         vocab, hidden, layers, heads, ffn = 30522, 768, 12, 12, 3072
         seq, batch, steps, warmup = 128, 64, 20, 3
 
-    jstep, params, opt_m, opt_v, n_params = build_train_step(
+    jstep, state, n_params = build_train_step(
         vocab, hidden, layers, heads, ffn, seq, batch)
 
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
     mlm = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
     nsp = jnp.asarray(rng.randint(0, 2, (batch,)).astype("int32"))
-    t = jnp.zeros((), jnp.int32)
 
     for _ in range(warmup):
-        params, opt_m, opt_v, t, loss = jstep(params, opt_m, opt_v, t,
-                                              ids, mlm, nsp)
-    loss.block_until_ready()
+        state, loss = jstep(state, ids, mlm, nsp)
+    float(loss)   # a device->host transfer is a true sync (block_until_ready
+                  # can be a no-op on tunneled PJRT backends)
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_m, opt_v, t, loss = jstep(params, opt_m, opt_v, t,
-                                              ids, mlm, nsp)
-    loss.block_until_ready()
+        state, loss = jstep(state, ids, mlm, nsp)
+    float(loss)
     dt = time.perf_counter() - t0
 
     tokens_per_sec = steps * batch * seq / dt

@@ -209,18 +209,30 @@ class Tracer:
         return outs_vb
 
     def _autocast(self, op_type, ins_vb):
-        """imperative/amp_auto_cast.cc analog: cast matmul/conv inputs to
-        bf16, keep norms/softmax in fp32."""
-        from ..amp.lists import WHITE_OPS
-        if op_type not in WHITE_OPS:
-            return ins_vb
+        """imperative/amp_auto_cast.cc analog: white ops (matmul/conv) run in
+        bf16, black ops (norms/softmax/reductions) in fp32, gray ops follow
+        their inputs — if any floating input is already bf16 the fp32 ones are
+        cast down so e.g. the bias add after a bf16 matmul doesn't promote the
+        activation back to fp32 (2x HBM traffic on every Linear otherwise)."""
+        from ..amp.lists import WHITE_OPS, BLACK_OPS
         lo = jnp.dtype(self._amp_dtype)
+        if op_type in WHITE_OPS:
+            target = lo
+        elif op_type in BLACK_OPS:
+            target = jnp.dtype(jnp.float32)
+        else:
+            has_lo = any(v._value.dtype == lo
+                         for vs in ins_vb.values() for v in vs)
+            if not has_lo:
+                return ins_vb
+            target = lo
+        src = jnp.float32 if target == lo else lo
         out = {}
         for s, vs in ins_vb.items():
             nvs = []
             for v in vs:
-                if v._value.dtype == jnp.float32:
-                    nv = VarBase(v._value.astype(lo),
+                if v._value.dtype == src:
+                    nv = VarBase(v._value.astype(target),
                                  stop_gradient=v.stop_gradient)
                     nv._src = v   # keep grad flowing to the fp32 master
                     nvs.append(nv)
@@ -272,19 +284,22 @@ class Tracer:
                 for v, g in zip(entry.ins[s], result.get("GI_" + s, [])):
                     if v.stop_gradient or g is None:
                         continue
+                    # AMP casts create fresh VarBases outside the tape; route
+                    # the grad through the _src chain so the producing op's
+                    # output id still receives it (otherwise the walk stops
+                    # at every autocast boundary and upstream grads vanish).
+                    while getattr(v, "_src", None) is not None:
+                        v = v._src
+                        g = g.astype(v._value.dtype)
                     prev = grads.get(id(v))
                     grads[id(v)] = g if prev is None else prev + g
                     var_by_id[id(v)] = v
 
-        # write accumulated grads onto leaves (GradientAccumulator analog)
+        # write accumulated grads onto leaves (GradientAccumulator analog);
+        # keys are already _src-rooted by the walk above
         for vid, g in grads.items():
             v = var_by_id[vid]
-            src = getattr(v, "_src", None)
-            if src is not None:      # AMP: route to fp32 master param
-                g32 = g.astype(src._value.dtype)
-                src._grad = g32 if src._grad is None else src._grad + g32
-            elif isinstance(v, ParamBase) or v.persistable or True:
-                v._grad = g if v._grad is None else v._grad + g
+            v._grad = g if v._grad is None else v._grad + g
         if not retain_graph:
             self._tape.clear()
 

@@ -91,13 +91,39 @@ _FLAGS: Dict[str, object] = {
     "use_donated_buffers": True,     # buffer donation == inplace/GC knobs
     "jit_cache_size": 128,
     "deterministic": False,
+    # TPU hardware RNG (XLA RngBitGenerator) instead of threefry for dropout
+    # and *_random ops.  The reference uses curand Philox per device
+    # (platform/ *generator*); counter-based threefry on TPU costs ~3x a BERT
+    # forward in dropout masks alone, so hardware RNG is the default.  Set
+    # FLAGS_deterministic_rng=True for threefry (bit-reproducible across
+    # backends, like cudnn_deterministic in platform/flags.cc:98).
+    "deterministic_rng": False,
 }
+
+
+def _apply_prng_impl(deterministic):
+    """Apply the PRNG choice.  `deterministic=None` (import-time default)
+    defers to a JAX_DEFAULT_PRNG_IMPL env override; an explicit set_flags
+    call always wins."""
+    import os
+    if deterministic is None and os.environ.get("JAX_DEFAULT_PRNG_IMPL"):
+        return
+    impl = "threefry2x32" if deterministic else "rbg"
+    try:
+        jax.config.update("jax_default_prng_impl", impl)
+    except Exception:
+        pass
+
+
+_apply_prng_impl(None)
 
 
 def set_flags(flags: Dict[str, object]):
     for k, v in flags.items():
         k = k.removeprefix("FLAGS_")
         _FLAGS[k] = v
+        if k == "deterministic_rng":
+            _apply_prng_impl(bool(v))
 
 
 def get_flags(names):

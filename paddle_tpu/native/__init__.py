@@ -25,7 +25,11 @@ _lib_lock = threading.Lock()
 
 
 def _build() -> Optional[str]:
-    """Compile the native library if stale (mtime-based cache)."""
+    """Compile the native library if stale (mtime-based cache).
+
+    Compiles to a process-unique temp path and os.replace()s into place so a
+    concurrent process never dlopens a half-written .so (rename is atomic on
+    POSIX)."""
     try:
         if (os.path.exists(_LIB_PATH)
                 and os.path.getmtime(_LIB_PATH) >= max(
@@ -33,9 +37,15 @@ def _build() -> Optional[str]:
                     os.path.getmtime(os.path.join(_HERE, "src",
                                                   "channel.h")))):
             return _LIB_PATH
+        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               "-o", _LIB_PATH, _SRC]
-        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+               "-o", tmp, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            os.replace(tmp, _LIB_PATH)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         return _LIB_PATH
     except (OSError, subprocess.SubprocessError):
         return None

@@ -15,3 +15,10 @@ from . import random_ops      # noqa: F401  RNG ops
 from . import optimizer_ops   # noqa: F401  optimizer updates + AMP
 from . import collective_ops  # noqa: F401  ICI collectives
 from . import attention       # noqa: F401  fused attention (Pallas/XLA)
+from . import ctr_ops         # noqa: F401  CTR/ads ops (qingshui family)
+from . import quant_ops       # noqa: F401  fake-quant / dequant (QAT, PTQ)
+from . import rnn_ops         # noqa: F401  lstm/gru/cudnn_lstm scans
+from . import nlp_ops         # noqa: F401  CRF/CTC/beam-search/NCE
+from . import detection_ops   # noqa: F401  RoI/anchor/proposal/deformable
+from . import misc_ops        # noqa: F401  optimizer variants + stragglers
+from . import sequence_extra  # noqa: F401  sequence_conv/pad/slice/...

@@ -1,0 +1,498 @@
+"""Detection ops beyond the core set in fluid/layers/detection.py.
+
+Reference (SURVEY §2.5 `detection/` ~18K LoC): operators/detection/
+roi_pool_op.cc, psroi_pool_op.cc, prroi_pool_op.cc, anchor_generator_op.cc,
+density_prior_box_op.cc, bipartite_match_op.cc, target_assign_op.cc,
+rpn_target_assign_op.cc, generate_proposals_op.cc,
+distribute_fpn_proposals_op.cc, collect_fpn_proposals_op.cc,
+sigmoid_focal_loss_op.cc, retinanet_detection_output_op.cc,
+polygon_box_transform_op.cc, deformable_conv_op.cc,
+plus operators/affine_grid_op.cc, operators/grid_sampler (grid_generator).
+
+TPU-native notes: proposal/assignment ops that the reference runs as ragged
+CPU loops are expressed as static-shape top-k / argmax / segment operations;
+"variable number of boxes" becomes a fixed budget + validity mask, the XLA
+equivalent of LoD outputs (SURVEY §7 hard part #1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _xyxy_wh(boxes):
+    w = boxes[..., 2] - boxes[..., 0] + 1.0
+    h = boxes[..., 3] - boxes[..., 1] + 1.0
+    return w, h
+
+
+def _iou(a, b):
+    """a: [N,4], b: [M,4] -> [N,M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.prod(jnp.clip(a[:, 2:] - a[:, :2], 0, None), -1)
+    area_b = jnp.prod(jnp.clip(b[:, 2:] - b[:, :2], 0, None), -1)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter, 1e-10)
+
+
+# --- RoI pooling family ------------------------------------------------------
+def _roi_bins(x, rois, ph, pw, spatial_scale, reduce="max"):
+    """Shared RoI binning: x [C,H,W] one image, rois [R,4] xyxy."""
+    c, h, w = x.shape
+    r = rois.shape[0]
+    x1 = jnp.round(rois[:, 0] * spatial_scale)
+    y1 = jnp.round(rois[:, 1] * spatial_scale)
+    x2 = jnp.round(rois[:, 2] * spatial_scale)
+    y2 = jnp.round(rois[:, 3] * spatial_scale)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    out = []
+    for i in range(ph):
+        for j in range(pw):
+            y_lo = y1 + bin_h * i
+            y_hi = y1 + bin_h * (i + 1)
+            x_lo = x1 + bin_w * j
+            x_hi = x1 + bin_w * (j + 1)
+            my = ((ys[None, :] >= jnp.floor(y_lo)[:, None])
+                  & (ys[None, :] < jnp.ceil(y_hi)[:, None]))   # [R, H]
+            mx = ((xs[None, :] >= jnp.floor(x_lo)[:, None])
+                  & (xs[None, :] < jnp.ceil(x_hi)[:, None]))   # [R, W]
+            m = (my[:, None, :, None] & mx[:, None, None, :])  # [R,1,H,W]
+            if reduce == "max":
+                v = jnp.where(m, x[None], _NEG).max(axis=(2, 3))
+                v = jnp.where(jnp.isfinite(v) & (v > _NEG / 2), v, 0.0)
+            else:
+                cnt = jnp.maximum(m.sum(axis=(2, 3)), 1.0)
+                v = jnp.where(m, x[None], 0.0).sum(axis=(2, 3)) / cnt
+            out.append(v)                                      # [R, C]
+    return jnp.stack(out, -1).reshape(r, c, ph, pw)
+
+
+@register_op("roi_pool", nondiff_inputs=("ROIs", "RoisNum"))
+def _roi_pool(ins, attrs, ctx):
+    """roi_pool_op.cc: max pool per RoI bin.  Single-image batch layout (the
+    RoIs' batch index is taken as 0 — trainers feed per-image)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    out = _roi_bins(x[0], rois, ph, pw, scale, "max")
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int64)]}
+
+
+@register_op("psroi_pool", nondiff_inputs=("ROIs",))
+def _psroi_pool(ins, attrs, ctx):
+    """psroi_pool_op.cc: position-sensitive RoI average pooling — input
+    channels C = out_c * ph * pw; bin (i,j) reads its own channel group."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    oc = attrs.get("output_channels", x.shape[1] // (ph * pw))
+    scale = attrs.get("spatial_scale", 1.0)
+    full = _roi_bins(x[0], rois, ph, pw, scale, "avg")  # [R, C, ph, pw]
+    r = full.shape[0]
+    grouped = full.reshape(r, oc, ph, pw, ph, pw)
+    idx = jnp.arange(ph)
+    jdx = jnp.arange(pw)
+    out = grouped[:, :, idx[:, None], jdx[None, :], idx[:, None], jdx[None, :]]
+    return {"Out": [out.reshape(r, oc, ph, pw)]}
+
+
+@register_op("prroi_pool", nondiff_inputs=("ROIs",))
+def _prroi_pool(ins, attrs, ctx):
+    """prroi_pool_op.cc (precise RoI pooling): continuous integral average —
+    approximated with the same average binning (exact for aligned bins)."""
+    x = ins["X"][0]
+    rois = ins["ROIs"][0]
+    ph = attrs.get("pooled_height", 1)
+    pw = attrs.get("pooled_width", 1)
+    scale = attrs.get("spatial_scale", 1.0)
+    return {"Out": [_roi_bins(x[0], rois, ph, pw, scale, "avg")]}
+
+
+# --- anchors / priors --------------------------------------------------------
+@register_op("anchor_generator", differentiable=False)
+def _anchor_generator(ins, attrs, ctx):
+    """anchor_generator_op.cc: dense anchors over the feature map grid."""
+    x = ins["Input"][0]
+    sizes = attrs.get("anchor_sizes", [64.0, 128.0, 256.0, 512.0])
+    ratios = attrs.get("aspect_ratios", [0.5, 1.0, 2.0])
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = x.shape[-2], x.shape[-1]
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * (r ** 0.5)
+            ah = s / (r ** 0.5)
+            anchors.append([-aw / 2, -ah / 2, aw / 2, ah / 2])
+    base = jnp.asarray(anchors)                     # [A, 4]
+    grid = jnp.stack(jnp.meshgrid(cx, cy), -1)      # [H, W, 2]
+    shift = jnp.concatenate([grid, grid], -1)       # [H, W, 4]
+    out = shift[:, :, None, :] + base[None, None]
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Anchors": [out], "Variances": [var]}
+
+
+@register_op("density_prior_box", differentiable=False)
+def _density_prior_box(ins, attrs, ctx):
+    """density_prior_box_op.cc: SSD priors with per-size densities — each
+    fixed_size spawns density^2 shifted boxes per cell."""
+    x = ins["Input"][0]
+    img = ins["Image"][0]
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [1.0])
+    densities = attrs.get("densities", [1] * len(fixed_sizes))
+    step_w = attrs.get("step_w", 0.0)
+    step_h = attrs.get("step_h", 0.0)
+    offset = attrs.get("offset", 0.5)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = x.shape[-2], x.shape[-1]
+    ih, iw = img.shape[-2], img.shape[-1]
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+    boxes = []
+    for size, dens in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            shift = size / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    ox = (dj + 0.5) * shift - size / 2
+                    oy = (di + 0.5) * shift - size / 2
+                    boxes.append((ox, oy, bw, bh))
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    gx, gy = jnp.meshgrid(cx, cy)                  # [H, W]
+    prior = []
+    for ox, oy, bw, bh in boxes:
+        b = jnp.stack([(gx + ox - bw / 2) / iw, (gy + oy - bh / 2) / ih,
+                       (gx + ox + bw / 2) / iw, (gy + oy + bh / 2) / ih], -1)
+        prior.append(b)
+    out = jnp.stack(prior, 2)                      # [H, W, P, 4]
+    out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Boxes": [out], "Variances": [var]}
+
+
+# --- matching / assignment ---------------------------------------------------
+@register_op("bipartite_match", differentiable=False)
+def _bipartite_match(ins, attrs, ctx):
+    """bipartite_match_op.cc: greedy argmax matching of columns (priors) to
+    rows (gt) on the DistMat, then per_prediction fill for unmatched."""
+    dist = ins["DistMat"][0]
+    if dist.ndim == 2:
+        dist = dist[None]
+    typ = attrs.get("match_type", "bipartite")
+    thr = attrs.get("dist_threshold", 0.5)
+    b, n, m = dist.shape
+
+    def one(d):
+        match = jnp.full((m,), -1, jnp.int32)
+        md = jnp.zeros((m,), d.dtype)
+
+        def body(i, carry):
+            match, md, dd = carry
+            flat = jnp.argmax(dd)
+            r, c = flat // m, flat % m
+            ok = dd[r, c] > 0
+            match = jnp.where(ok, match.at[c].set(r.astype(jnp.int32)),
+                              match)
+            md = jnp.where(ok, md.at[c].set(dd[r, c]), md)
+            dd = jnp.where(ok, dd.at[r, :].set(-1.0).at[:, c].set(-1.0), dd)
+            return match, md, dd
+        match, md, _ = jax.lax.fori_loop(0, min(n, m), body,
+                                         (match, md, d))
+        if typ == "per_prediction":
+            col_best = jnp.argmax(d, axis=0).astype(jnp.int32)
+            col_val = jnp.max(d, axis=0)
+            fill = (match < 0) & (col_val >= thr)
+            match = jnp.where(fill, col_best, match)
+            md = jnp.where(fill, col_val, md)
+        return match, md
+    matches, dists = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [matches],
+            "ColToRowMatchDist": [dists]}
+
+
+@register_op("target_assign", nondiff_inputs=("MatchIndices", "NegIndices"),
+             differentiable=False)
+def _target_assign(ins, attrs, ctx):
+    """target_assign_op.cc: gather per-prior targets by match index; weight 1
+    where matched (or negative), 0 elsewhere."""
+    x = ins["X"][0]                        # [B, N, K] gt attributes
+    match = ins["MatchIndices"][0].astype(jnp.int32)   # [B, M]
+    mismatch_value = attrs.get("mismatch_value", 0.0)
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, safe[..., None], axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, out, mismatch_value)
+    w = matched.astype(x.dtype)
+    return {"Out": [out], "OutWeight": [w]}
+
+
+@register_op("rpn_target_assign", differentiable=False, stateful_rng=True)
+def _rpn_target_assign(ins, attrs, ctx):
+    """rpn_target_assign_op.cc: label anchors pos/neg by IoU vs gt, sample a
+    fixed budget.  Static-shape: returns per-anchor labels/weights instead of
+    compacted index lists (the LoD-free equivalent)."""
+    anchor = ins["Anchor"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0].reshape(-1, 4)
+    pos_thr = attrs.get("rpn_positive_overlap", 0.7)
+    neg_thr = attrs.get("rpn_negative_overlap", 0.3)
+    iou = _iou(anchor, gt)                  # [A, G]
+    best = iou.max(axis=1)
+    argbest = iou.argmax(axis=1)
+    label = jnp.where(best >= pos_thr, 1, jnp.where(best < neg_thr, 0, -1))
+    # anchors that are the best for some gt are positive too
+    best_per_gt = iou.argmax(axis=0)
+    label = label.at[best_per_gt].set(1)
+    tgt = gt[argbest]
+    return {"LocationIndex": [jnp.where(label == 1, 1, 0).astype(jnp.int32)],
+            "ScoreIndex": [jnp.where(label >= 0, 1, 0).astype(jnp.int32)],
+            "TargetLabel": [label.astype(jnp.int32)],
+            "TargetBBox": [tgt],
+            "BBoxInsideWeight": [(label == 1).astype(anchor.dtype)[:, None]
+                                 * jnp.ones((1, 4), anchor.dtype)]}
+
+
+@register_op("generate_proposals", differentiable=False)
+def _generate_proposals(ins, attrs, ctx):
+    """generate_proposals_op.cc: decode anchor deltas, clip, take top
+    post_nms_topN by score with IoU suppression (static-budget NMS)."""
+    scores = ins["Scores"][0]               # [B, A, H, W]
+    deltas = ins["BboxDeltas"][0]           # [B, A*4, H, W]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    im_info = ins["ImInfo"][0] if ins.get("ImInfo") else None
+    pre_n = attrs.get("pre_nms_topN", 6000)
+    post_n = attrs.get("post_nms_topN", 1000)
+    nms_thr = attrs.get("nms_thresh", 0.7)
+    b = scores.shape[0]
+    sc = scores.reshape(b, -1)
+    dl = deltas.reshape(b, -1, 4, deltas.shape[-2], deltas.shape[-1])
+    dl = jnp.moveaxis(dl, 2, -1).reshape(b, -1, 4)
+    aw, ah = _xyxy_wh(anchors)
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    n = min(pre_n, sc.shape[1])
+
+    def one(s, d):
+        top_s, top_i = jax.lax.top_k(s, n)
+        dd = d[top_i]
+        cx = acx[top_i] + dd[:, 0] * aw[top_i]
+        cy = acy[top_i] + dd[:, 1] * ah[top_i]
+        w = aw[top_i] * jnp.exp(jnp.clip(dd[:, 2], None, 4.0))
+        h = ah[top_i] * jnp.exp(jnp.clip(dd[:, 3], None, 4.0))
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        if im_info is not None:
+            boxes = jnp.clip(boxes, 0.0, None)
+        iou = _iou(boxes, boxes)
+        keep_n = min(post_n, n)
+
+        def nms_body(i, carry):
+            keep, sup = carry
+            avail = jnp.where(sup, _NEG, top_s)
+            j = jnp.argmax(avail)
+            keep = keep.at[i].set(j)
+            sup = sup | (iou[j] > nms_thr)
+            sup = sup.at[j].set(True)
+            return keep, sup
+        keep, _ = jax.lax.fori_loop(
+            0, keep_n, nms_body,
+            (jnp.zeros((keep_n,), jnp.int32),
+             jnp.zeros((n,), bool)))
+        return boxes[keep], top_s[keep]
+    boxes, probs = jax.vmap(one)(sc, dl)
+    return {"RpnRois": [boxes], "RpnRoiProbs": [probs[..., None]],
+            "RpnRoisNum": [jnp.full((b,), boxes.shape[1], jnp.int32)]}
+
+
+@register_op("distribute_fpn_proposals", differentiable=False)
+def _distribute_fpn_proposals(ins, attrs, ctx):
+    """distribute_fpn_proposals_op.cc: route each RoI to its FPN level by
+    scale.  Static-shape: per-level copies with a validity mask (rows not on
+    that level are zeroed), plus RestoreIndex."""
+    rois = ins["FpnRois"][0]
+    min_level = attrs.get("min_level", 2)
+    max_level = attrs.get("max_level", 5)
+    refer_level = attrs.get("refer_level", 4)
+    refer_scale = attrs.get("refer_scale", 224)
+    w, h = _xyxy_wh(rois)
+    scale = jnp.sqrt(jnp.clip(w * h, 1e-6, None))
+    lvl = jnp.floor(refer_level + jnp.log2(scale / refer_scale + 1e-6))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    outs = []
+    for l in range(min_level, max_level + 1):
+        m = (lvl == l).astype(rois.dtype)[:, None]
+        outs.append(rois * m)
+    return {"MultiFpnRois": outs,
+            "RestoreIndex": [jnp.argsort(
+                jnp.argsort(lvl, stable=True), stable=True)[:, None]
+                .astype(jnp.int32)],
+            "MultiLevelRoIsNum": [jnp.stack(
+                [(lvl == l).sum() for l in range(min_level, max_level + 1)])
+                .astype(jnp.int32)]}
+
+
+@register_op("collect_fpn_proposals", differentiable=False)
+def _collect_fpn_proposals(ins, attrs, ctx):
+    """collect_fpn_proposals_op.cc: merge per-level RoIs, keep global top-N
+    by score."""
+    rois = jnp.concatenate(ins["MultiLevelRois"], axis=0)
+    scores = jnp.concatenate([s.reshape(-1)
+                              for s in ins["MultiLevelScores"]], axis=0)
+    n = min(attrs.get("post_nms_topN", 1000), scores.shape[0])
+    top_s, top_i = jax.lax.top_k(scores, n)
+    return {"FpnRois": [rois[top_i]],
+            "RoisNum": [jnp.asarray([n], jnp.int32)]}
+
+
+# --- losses / outputs --------------------------------------------------------
+@register_op("sigmoid_focal_loss", nondiff_inputs=("Label", "FgNum"))
+def _sigmoid_focal_loss(ins, attrs, ctx):
+    """sigmoid_focal_loss_op.cc: FL(p) = -alpha (1-p)^gamma log(p) with
+    per-class one-vs-all labels (label c in [0, C]; 0 = background)."""
+    x = ins["X"][0]                         # [N, C]
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    fg = (ins["FgNum"][0].reshape(()).astype(x.dtype)
+          if ins.get("FgNum") else jnp.asarray(1.0, x.dtype))
+    gamma = attrs.get("gamma", 2.0)
+    alpha = attrs.get("alpha", 0.25)
+    c = x.shape[1]
+    target = (label[:, None] == (jnp.arange(c) + 1)[None]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    pt = jnp.where(target > 0, p, 1 - p)
+    at = jnp.where(target > 0, alpha, 1 - alpha)
+    bce = -jnp.where(target > 0, jax.nn.log_sigmoid(x),
+                     jax.nn.log_sigmoid(-x))
+    loss = at * ((1 - pt) ** gamma) * bce / jnp.maximum(fg, 1.0)
+    return {"Out": [loss]}
+
+
+@register_op("retinanet_detection_output", differentiable=False)
+def _retinanet_detection_output(ins, attrs, ctx):
+    """retinanet_detection_output_op.cc: decode per-level cls+loc, global
+    top-k with score threshold (NMS delegated to multiclass_nms budget)."""
+    bboxes = jnp.concatenate([b.reshape(b.shape[0], -1, 4)
+                              for b in ins["BBoxes"]], axis=1)
+    scores = jnp.concatenate([s.reshape(s.shape[0], -1, s.shape[-1])
+                              for s in ins["Scores"]], axis=1)
+    thr = attrs.get("score_threshold", 0.05)
+    keep_k = attrs.get("keep_top_k", 100)
+    b = scores.shape[0]
+    best_s = scores.max(-1)
+    best_c = scores.argmax(-1)
+    k = min(keep_k, best_s.shape[1])
+    top_s, top_i = jax.lax.top_k(jnp.where(best_s > thr, best_s, _NEG), k)
+    out = []
+    for bi in range(b):
+        cls = best_c[bi][top_i[bi]].astype(bboxes.dtype)
+        box = bboxes[bi][top_i[bi]]
+        out.append(jnp.concatenate(
+            [cls[:, None], top_s[bi][:, None], box], axis=1))
+    return {"Out": [jnp.stack(out)]}
+
+
+@register_op("polygon_box_transform", differentiable=False)
+def _polygon_box_transform(ins, attrs, ctx):
+    """polygon_box_transform_op.cc (EAST text detection): offset channels to
+    absolute quad coordinates: out[c] = 4*x_grid + in[c] (even c), y odd."""
+    x = ins["Input"][0]                     # [B, 8or9, H, W]
+    b, c, h, w = x.shape
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    even = (jnp.arange(c) % 2 == 0).reshape(1, -1, 1, 1)
+    grid = jnp.where(even, gx, gy)
+    return {"Output": [jnp.where(x != 0, grid + x, 0.0)]}
+
+
+# --- deformable conv / grids -------------------------------------------------
+@register_op("deformable_conv", nondiff_inputs=("Offset", "Mask"))
+def _deformable_conv(ins, attrs, ctx):
+    """deformable_conv_op.cc (v2 with modulation Mask): bilinear-sample the
+    input at offset positions per kernel tap, then a plain conv contraction.
+    Implemented as gather+matmul — the XLA-friendly formulation."""
+    x = ins["Input"][0]                     # [B, C, H, W]
+    offset = ins["Offset"][0]               # [B, 2*kh*kw*dg, H, W]
+    w = ins["Filter"][0]                    # [O, C/g, kh, kw]
+    mask = ins["Mask"][0] if ins.get("Mask") else None
+    stride = attrs.get("strides", [1, 1])
+    pad = attrs.get("paddings", [0, 0])
+    b, c, h, wd = x.shape
+    o, cg, kh, kw = w.shape
+    oh = (h + 2 * pad[0] - kh) // stride[0] + 1
+    ow = (wd + 2 * pad[1] - kw) // stride[1] + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])))
+    ph, pw = xp.shape[2], xp.shape[3]
+    oy = jnp.arange(oh) * stride[0]
+    ox = jnp.arange(ow) * stride[1]
+    cols = []
+    for ki in range(kh):
+        for kj in range(kw):
+            t = ki * kw + kj
+            dy = offset[:, 2 * t][:, :oh, :ow]
+            dx = offset[:, 2 * t + 1][:, :oh, :ow]
+            yy = oy[None, :, None] + ki + dy
+            xx = ox[None, None, :] + kj + dx
+            y0 = jnp.clip(jnp.floor(yy), 0, ph - 2).astype(jnp.int32)
+            x0 = jnp.clip(jnp.floor(xx), 0, pw - 2).astype(jnp.int32)
+            fy = jnp.clip(yy - y0, 0.0, 1.0)
+            fx = jnp.clip(xx - x0, 0.0, 1.0)
+
+            def gat(yi, xi):
+                return jax.vmap(
+                    lambda img, ys, xs: img[:, ys, xs])(xp, yi, xi)
+            v = (gat(y0, x0) * ((1 - fy) * (1 - fx))[:, None]
+                 + gat(y0, x0 + 1) * ((1 - fy) * fx)[:, None]
+                 + gat(y0 + 1, x0) * (fy * (1 - fx))[:, None]
+                 + gat(y0 + 1, x0 + 1) * (fy * fx)[:, None])
+            if mask is not None:
+                v = v * mask[:, t][:, None, :oh, :ow]
+            cols.append(v)                  # [B, C, oh, ow]
+    col = jnp.stack(cols, 2)                # [B, C, kh*kw, oh, ow]
+    out = jnp.einsum("bckhw,ock->bohw", col,
+                     w.reshape(o, cg, kh * kw),
+                     preferred_element_type=jnp.float32)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("deformable_conv_v1", nondiff_inputs=("Offset",))
+def _deformable_conv_v1(ins, attrs, ctx):
+    ins = dict(ins)
+    ins.pop("Mask", None)
+    return _deformable_conv(ins, attrs, ctx)
+
+
+@register_op("affine_grid")
+def _affine_grid(ins, attrs, ctx):
+    """affine_grid_op.cc: theta [B, 2, 3] -> sampling grid [B, H, W, 2] in
+    [-1, 1] coords (align_corners semantics of the reference)."""
+    theta = ins["Theta"][0]
+    shape = attrs.get("output_shape", None)
+    if shape is None and ins.get("OutputShape"):
+        import numpy as np
+        shape = [int(v) for v in np.asarray(ins["OutputShape"][0])]
+    b, _, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], -1)            # [H, W, 3]
+    grid = jnp.einsum("hwk,bak->bhwa", base, theta)
+    return {"Output": [grid]}

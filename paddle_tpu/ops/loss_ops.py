@@ -37,44 +37,86 @@ def _cross_entropy(ins, attrs, ctx):
     return {"Y": [out]}
 
 
+def _xent_norm(logits, axis):
+    """Streaming log-softmax pieces with f32 accumulation over bf16 logits.
+
+    Returns (shifted_logits_f32, logsumexp_f32).  Nothing vocab-sized is
+    materialized beyond what XLA's reduce fusions need — the caller's gather /
+    onehot-subtract fuses into the same passes.  This is the TPU analog of the
+    fused softmax_with_cross_entropy_op.cu kernel: HBM traffic over the
+    [tokens, vocab] logits is the whole cost, so every saved pass counts.
+    """
+    acc = jnp.promote_types(logits.dtype, jnp.float32)
+    lmax = jax.lax.stop_gradient(
+        jnp.max(logits, axis=axis, keepdims=True)).astype(acc)
+    shifted = logits.astype(acc) - lmax
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=axis, keepdims=True))
+    return shifted, lse
+
+
+def _to_last_axis(logits, label, axis):
+    """Move the class axis to -1 so the gather/mask broadcasting below is
+    uniform; returns (logits, label, restore_fn)."""
+    ax = axis if axis >= 0 else logits.ndim + axis
+    if ax == logits.ndim - 1:
+        return logits, label, lambda t: t
+    lg = jnp.moveaxis(logits, ax, -1)
+    lb = jnp.moveaxis(label, ax, -1) if label.ndim == logits.ndim else label
+    return lg, lb, lambda t: jnp.moveaxis(t, -1, ax)
+
+
 def _softmax_xent_fwd(ins, attrs, ctx):
     logits, label = ins["Logits"][0], ins["Label"][0]
-    axis = attrs.get("axis", -1)
-    softmax = jax.nn.softmax(logits, axis=axis)
-    logsm = jax.nn.log_softmax(logits, axis=axis)
+    logits, label, restore = _to_last_axis(logits, label,
+                                           attrs.get("axis", -1))
+    shifted, lse = _xent_norm(logits, -1)
+    # Softmax output is part of the op contract (outs: Softmax, Loss) but is
+    # only materialized if a consumer keeps it alive — jit DCEs it otherwise
+    # (the grad recomputes from logits rather than pinning this residual).
+    softmax = jnp.exp(shifted - lse).astype(logits.dtype)
     if attrs.get("soft_label", False):
-        loss = -jnp.sum(label * logsm, axis=axis, keepdims=True)
+        loss = jnp.sum(label.astype(shifted.dtype) * (lse - shifted),
+                       axis=-1, keepdims=True)
     else:
         lbl = label.astype(jnp.int32)
         if lbl.ndim == logits.ndim:
-            lbl = lbl.squeeze(axis)
-        loss = -jnp.take_along_axis(logsm, lbl[..., None], axis=axis)
+            lbl = lbl.squeeze(-1)
+        picked = jnp.take_along_axis(shifted, lbl[..., None], axis=-1)
+        loss = lse - picked
         ignore = attrs.get("ignore_index", -100)
         loss = jnp.where(lbl[..., None] == ignore, 0.0, loss)
-    return {"Softmax": [softmax], "Loss": [loss]}
+    return {"Softmax": [restore(softmax)], "Loss": [restore(loss)]}
 
 
 def _softmax_xent_grad(ins, outs, out_grads, attrs, ctx):
     # fused backward: d(loss)/d(logits) = softmax - onehot(label), matching
-    # operators/softmax_with_cross_entropy_op.cu's fused kernel
+    # operators/softmax_with_cross_entropy_op.cu's fused kernel.  softmax is
+    # recomputed from logits (2 cheap reduce passes) instead of reading the
+    # saved Softmax output so the forward never has to write it to HBM.
     logits, label = ins["Logits"][0], ins["Label"][0]
-    softmax = outs["Softmax"][0]
     gloss = out_grads.get("Loss")
-    axis = attrs.get("axis", -1)
     if gloss is None:
         return {"Logits": [jnp.zeros_like(logits)]}
+    logits, label, restore = _to_last_axis(logits, label,
+                                           attrs.get("axis", -1))
+    ax = attrs.get("axis", -1)
+    ax = ax if ax >= 0 else logits.ndim + ax
+    if ax != logits.ndim - 1:
+        gloss = jnp.moveaxis(gloss, ax, -1)
+    shifted, lse = _xent_norm(logits, -1)
+    softmax = jnp.exp(shifted - lse)
+    gloss = gloss.astype(softmax.dtype)
     if attrs.get("soft_label", False):
-        grad = (softmax - label) * gloss
+        grad = (softmax - label.astype(softmax.dtype)) * gloss
     else:
         lbl = label.astype(jnp.int32)
         if lbl.ndim == logits.ndim:
-            lbl = lbl.squeeze(axis)
-        onehot = jax.nn.one_hot(lbl, logits.shape[axis], dtype=softmax.dtype,
-                                axis=axis)
+            lbl = lbl.squeeze(-1)
+        onehot = jax.nn.one_hot(lbl, logits.shape[-1], dtype=softmax.dtype)
         ignore = attrs.get("ignore_index", -100)
         mask = (lbl != ignore)[..., None].astype(softmax.dtype)
         grad = (softmax - onehot) * gloss * mask
-    return {"Logits": [grad.astype(logits.dtype)]}
+    return {"Logits": [restore(grad).astype(ins["Logits"][0].dtype)]}
 
 
 register_op("softmax_with_cross_entropy", _softmax_xent_fwd,

@@ -1,0 +1,432 @@
+"""Remaining op-catalog entries: optimizer variants, math/manipulation
+stragglers, fused CPU/GPU kernels re-expressed as XLA-fusable compositions.
+
+Reference (SURVEY §A.1): operators/optimizers/{adamax,proximal_adagrad,
+proximal_gd}_op.cc, operators/bilinear_tensor_product_op.cc,
+operators/multiplex_op.cc, operators/minus_op.cc,
+operators/modified_huber_loss_op.cc, operators/fill_diagonal (tril fill),
+operators/pad_constant_like_op.cc, operators/partial_concat_op.cc (qingshui),
+operators/partial_sum_op.cc, operators/pool_op (pool3d),
+operators/spectral_norm_op.cc, operators/spp_op.cc,
+operators/shuffle_channel_op.cc, operators/center_loss_op.cc,
+operators/teacher_student_sigmoid_loss_op.cc, operators/bpr_loss_op.cc,
+operators/positive_negative_pair_op.cc, operators/unique_op.cc,
+operators/scatter_nd_add (scatter_nd), operators/fused/
+fused_elemwise_activation_op.cc, fused_embedding_eltwise_layernorm_op.cu,
+operators/metrics/precision_recall (detection_map in detection/),
+operators/lod_reset_op.cc (no-op in padded layout), operators/diag_op.cc,
+operators/lookup_table_dequant_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+# --- optimizer variants ------------------------------------------------------
+@register_op("adamax", differentiable=False)
+def _adamax(ins, attrs, ctx):
+    p, g = _x(ins, "Param"), _x(ins, "Grad")
+    m, u = _x(ins, "Moment"), _x(ins, "InfNorm")
+    lr = _x(ins, "LearningRate").reshape(())
+    b1p = _x(ins, "Beta1Pow").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    m2 = b1 * m + (1 - b1) * g
+    u2 = jnp.maximum(b2 * u, jnp.abs(g))
+    p2 = p - (lr / (1 - b1p)) * m2 / (u2 + eps)
+    return {"ParamOut": [p2], "MomentOut": [m2], "InfNormOut": [u2]}
+
+
+@register_op("proximal_gd", differentiable=False)
+def _proximal_gd(ins, attrs, ctx):
+    p, g = _x(ins, "Param"), _x(ins, "Grad")
+    lr = _x(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g
+    p2 = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+          / (1.0 + lr * l2))
+    return {"ParamOut": [p2]}
+
+
+@register_op("proximal_adagrad", differentiable=False)
+def _proximal_adagrad(ins, attrs, ctx):
+    p, g, m = _x(ins, "Param"), _x(ins, "Grad"), _x(ins, "Moment")
+    lr = _x(ins, "LearningRate").reshape(())
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    m2 = m + g * g
+    alr = lr / jnp.sqrt(m2 + 1e-12)
+    prox = p - alr * g
+    p2 = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - alr * l1, 0.0)
+          / (1.0 + alr * l2))
+    return {"ParamOut": [p2], "MomentOut": [m2]}
+
+
+# --- math stragglers ---------------------------------------------------------
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ins, attrs, ctx):
+    """out[b,k] = x[b] @ W[k] @ y[b] + bias[k] (bilinear_tensor_product_op)."""
+    x, y, w = _x(ins), _x(ins, "Y"), _x(ins, "Weight")
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": [out]}
+
+
+@register_op("multiplex", nondiff_inputs=("Ids",))
+def _multiplex(ins, attrs, ctx):
+    """row r of output = row r of candidate X[Ids[r]] (multiplex_op.cc)."""
+    ids = ins["Ids"][0].astype(jnp.int32).reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)        # [K, B, D]
+    return {"Out": [stacked[ids, jnp.arange(ids.shape[0])]]}
+
+
+@register_op("minus")
+def _minus(ins, attrs, ctx):
+    return {"Out": [_x(ins) - _x(ins, "Y")]}
+
+
+@register_op("elementwise_heaviside")
+def _heaviside(ins, attrs, ctx):
+    x, y = _x(ins), _x(ins, "Y")
+    return {"Out": [jnp.where(x > 0, 1.0, jnp.where(x == 0, y, 0.0))
+                    .astype(x.dtype)]}
+
+
+@register_op("modified_huber_loss", nondiff_inputs=("Y",))
+def _modified_huber_loss(ins, attrs, ctx):
+    """modified_huber_loss_op.cc: labels {0,1} -> {-1,+1}; quadratic inside
+    margin, linear outside."""
+    x, y = _x(ins), _x(ins, "Y")
+    s = 2.0 * y - 1.0
+    z = x * s
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": [loss], "IntermediateVal": [z]}
+
+
+@register_op("fill_diagonal")
+def _fill_diagonal(ins, attrs, ctx):
+    x = _x(ins)
+    val = attrs.get("value", 0.0)
+    n = min(x.shape[-2], x.shape[-1])
+    idx = jnp.arange(n)
+    return {"Out": [x.at[..., idx, idx].set(val)]}
+
+
+@register_op("pad_constant_like", nondiff_inputs=("X",))
+def _pad_constant_like(ins, attrs, ctx):
+    """pad Y up to X's shape with pad_value (pad_constant_like_op.cc).
+    Grad flows to Y only."""
+    x, y = _x(ins), _x(ins, "Y")
+    pad_value = attrs.get("pad_value", 0.0)
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jnp.pad(y, pads, constant_values=pad_value)]}
+
+
+@register_op("partial_concat")
+def _partial_concat(ins, attrs, ctx):
+    """partial_concat_op.cc (qingshui): concat a column slice
+    [start_index : start_index+length] of every input."""
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    parts = []
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        parts.append(x[:, start:end])
+    return {"Out": [jnp.concatenate(parts, axis=1)]}
+
+
+@register_op("partial_sum")
+def _partial_sum(ins, attrs, ctx):
+    start = attrs.get("start_index", 0)
+    length = attrs.get("length", -1)
+    acc = None
+    for x in ins["X"]:
+        end = x.shape[1] if length < 0 else start + length
+        piece = x[:, start:end]
+        acc = piece if acc is None else acc + piece
+    return {"Out": [acc]}
+
+
+@register_op("pool3d")
+def _pool3d(ins, attrs, ctx):
+    x = _x(ins)                          # [B, C, D, H, W]
+    ksize = attrs.get("ksize", [2, 2, 2])
+    strides = attrs.get("strides", ksize)
+    pads = attrs.get("paddings", [0, 0, 0])
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = ksize
+        pads = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, stride,
+                                    padding)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                  padding)
+        out = s / float(ksize[0] * ksize[1] * ksize[2])
+    return {"Out": [out]}
+
+
+@register_op("spp")
+def _spp(ins, attrs, ctx):
+    """spp_op.cc: spatial pyramid pooling — pyramid_height levels of adaptive
+    max/avg pool, flattened and concatenated."""
+    x = _x(ins)
+    levels = attrs.get("pyramid_height", 1)
+    ptype = attrs.get("pooling_type", "max")
+    b, c, h, w = x.shape
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        kh, kw = -(-h // bins), -(-w // bins)
+        ph, pw = kh * bins - h, kw * bins - w
+        xp = jnp.pad(x, ((0, 0), (0, 0), (0, ph), (0, pw)),
+                     constant_values=-jnp.inf if ptype == "max" else 0.0)
+        r = xp.reshape(b, c, bins, kh, bins, kw)
+        if ptype == "max":
+            v = r.max(axis=(3, 5))
+        else:
+            v = r.sum(axis=(3, 5)) / (kh * kw)
+        outs.append(v.reshape(b, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("shuffle_channel")
+def _shuffle_channel(ins, attrs, ctx):
+    x = _x(ins)
+    g = attrs.get("group", 1)
+    b, c, h, w = x.shape
+    return {"Out": [x.reshape(b, g, c // g, h, w).swapaxes(1, 2)
+                    .reshape(b, c, h, w)]}
+
+
+@register_op("spectral_norm", nondiff_inputs=("U", "V"))
+def _spectral_norm(ins, attrs, ctx):
+    """spectral_norm_op.cc: weight / sigma where sigma from power iteration
+    on (U, V) buffers."""
+    w = _x(ins, "Weight")
+    u = _x(ins, "U").reshape(-1)
+    v = _x(ins, "V").reshape(-1)
+    dim = attrs.get("dim", 0)
+    iters = attrs.get("power_iters", 1)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(iters, 0)):
+        v = wm.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), 1e-12)
+        u = wm @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), 1e-12)
+    sigma = u @ wm @ v
+    return {"Out": [w / jnp.maximum(sigma, 1e-12)]}
+
+
+@register_op("center_loss", nondiff_inputs=("Label", "Centers",
+                                            "CenterUpdateRate"))
+def _center_loss(ins, attrs, ctx):
+    """center_loss_op.cc: 0.5*||x - center[label]||^2 plus center EMA update."""
+    x = _x(ins)
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    centers = ins["Centers"][0]
+    alpha = (ins["CenterUpdateRate"][0].reshape(())
+             if ins.get("CenterUpdateRate") else 0.5)
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if attrs.get("need_update", True):
+        cnt = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        delta = jnp.zeros_like(centers).at[label].add(diff)
+        centers = centers + alpha * delta / (cnt[:, None] + 1.0)
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [centers]}
+
+
+@register_op("teacher_student_sigmoid_loss", nondiff_inputs=("Label",))
+def _ts_sigmoid_loss(ins, attrs, ctx):
+    """teacher_student_sigmoid_loss_op.cc (CTR distillation): label < 0 means
+    teacher soft score; label >= 0 the hard click bit."""
+    x = _x(ins).reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    sl = attrs.get("soft_max_low_threshold", -2.0)
+    sh = attrs.get("soft_max_up_threshold", 2.0)
+    log1e = jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0.0)
+    hard = log1e - x * (label > 0).astype(x.dtype)
+    teacher = jnp.clip(-label, sl, sh)
+    soft = log1e - x * jax.nn.sigmoid(teacher)
+    loss = jnp.where(label < 0, soft, hard)
+    return {"Y": [loss.reshape(-1, 1)]}
+
+
+@register_op("positive_negative_pair", nondiff_inputs=("Label", "QueryID"),
+             differentiable=False)
+def _positive_negative_pair(ins, attrs, ctx):
+    """positive_negative_pair_op.cc (ranking metric): within each query,
+    count score-ordered pairs consistent/inconsistent with label order."""
+    score = _x(ins, "Score").reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].astype(jnp.int32).reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q), k=1)
+    lbl_gt = label[:, None] > label[None, :]
+    sc_gt = score[:, None] > score[None, :]
+    sc_eq = score[:, None] == score[None, :]
+    considered = same_q & upper & (label[:, None] != label[None, :])
+    pos = jnp.sum(considered & (lbl_gt == sc_gt) & ~sc_eq)
+    neu = jnp.sum(considered & sc_eq)
+    neg = jnp.sum(considered) - pos - neu
+    f = lambda v: v.reshape(1, 1).astype(jnp.float32)
+    return {"PositivePair": [f(pos)], "NegativePair": [f(neg)],
+            "NeutralPair": [f(neu)]}
+
+
+@register_op("unique", differentiable=False)
+def _unique(ins, attrs, ctx):
+    """unique_op.cc static-shape analog: sorted unique with inverse Index;
+    output padded to input length, UniqueCount gives the valid prefix."""
+    x = _x(ins).reshape(-1)
+    n = x.shape[0]
+    s = jnp.sort(x)
+    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    uniq_count = first.sum()
+    rank = jnp.cumsum(first) - 1
+    order = jnp.argsort(~first, stable=True)
+    uniq = jnp.where(jnp.arange(n) < uniq_count, s[order], 0)
+    pos_in_sorted = jnp.argsort(jnp.argsort(x, stable=True), stable=True)
+    inverse = rank[pos_in_sorted]
+    return {"Out": [uniq], "Index": [inverse.astype(jnp.int32)],
+            "UniqueCount": [uniq_count.reshape(1).astype(jnp.int32)]}
+
+
+@register_op("scatter_nd", nondiff_inputs=("Index", "Shape"))
+def _scatter_nd(ins, attrs, ctx):
+    idx = ins["Index"][0].astype(jnp.int32)
+    upd = ins["Updates"][0]
+    import numpy as np
+    shape = [int(v) for v in np.asarray(ins["Shape"][0])] if ins.get(
+        "Shape") else attrs["shape"]
+    out = jnp.zeros(shape, upd.dtype)
+    return {"Out": [out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)]}
+
+
+@register_op("gaussian_random_batch_size_like", nondiff_inputs=("Input",),
+             differentiable=False, stateful_rng=True)
+def _grbsl(ins, attrs, ctx):
+    ref = ins["Input"][0]
+    shape = list(attrs.get("shape"))
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[
+        attrs.get("input_dim_idx", 0)]
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    out = (attrs.get("mean", 0.0)
+           + attrs.get("std", 1.0) * jax.random.normal(key, tuple(shape)))
+    return {"Out": [out.astype(jnp.float32)]}
+
+
+@register_op("diag", differentiable=False)
+def _diag(ins, attrs, ctx):
+    return {"Out": [jnp.diag(ins["Diagonal"][0].reshape(-1))]}
+
+
+@register_op("lookup_table_dequant", nondiff_inputs=("Ids",))
+def _lookup_table_dequant(ins, attrs, ctx):
+    """lookup_table_dequant_op.cc: rows store [min, max, int8 codes]; output
+    dequantized embeddings (pslib quantized table format)."""
+    w = _x(ins, "W")
+    ids = ins["Ids"][0].astype(jnp.int32).reshape(-1)
+    rows = w[ids]
+    mn, mx = rows[:, 0:1], rows[:, 1:2]
+    codes = rows[:, 2:]
+    out = mn + (mx - mn) * codes / 255.0
+    return {"Out": [out]}
+
+
+# --- fused compositions (XLA fuses; op kept for graph parity) ---------------
+_UNARY = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+          "identity": lambda v: v, "": lambda v: v}
+
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ins, attrs, ctx):
+    """fused_elemwise_activation_op.cc: functor_list like
+    ['elementwise_add', 'relu'] applied as f2(f1(x, y))."""
+    x, y = _x(ins), _x(ins, "Y")
+    functors = attrs.get("functor_list", ["elementwise_add", "relu"])
+    binop = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply,
+             "elementwise_sub": jnp.subtract}
+    cur = None
+    for f in functors:
+        if f in binop:
+            cur = binop[f](x, y) if cur is None else binop[f](cur, y)
+        else:
+            name = f.replace("scale", "identity")
+            cur = _UNARY.get(name, _UNARY["identity"])(
+                cur if cur is not None else x)
+    return {"Out": [cur], "IntermediateOut": [cur]}
+
+
+@register_op("fused_embedding_eltwise_layernorm",
+             nondiff_inputs=("Ids",))
+def _fused_emb_ln(ins, attrs, ctx):
+    """fused_embedding_eltwise_layernorm_op.cu: sum N embedding lookups then
+    LayerNorm — the BERT embedding block as one op."""
+    ids_list = ins["Ids"]
+    embs = ins["Embs"]
+    acc = None
+    for ids, emb in zip(ids_list, embs):
+        v = emb[ids.astype(jnp.int32).reshape(ids.shape[:2])]
+        acc = v if acc is None else acc + v
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    mu = acc.mean(-1, keepdims=True)
+    var = jnp.var(acc, -1, keepdims=True)
+    return {"Out": [(acc - mu) / jnp.sqrt(var + eps) * scale + bias]}
+
+
+@register_op("fusion_group")
+def _fusion_group(ins, attrs, ctx):
+    """fusion_group_pass's NVRTC-codegen op: on TPU, XLA is the fusion
+    compiler, so this is identity over its inputs (graph-parity stub)."""
+    return {"Outs": list(ins["Inputs"])}
+
+
+@register_op("dropout_nd", stateful_rng=True, nondiff_outputs=("Mask",))
+def _dropout_nd(ins, attrs, ctx):
+    x = _x(ins)
+    p = attrs.get("dropout_prob", 0.5)
+    axis = attrs.get("axis", None)
+    if attrs.get("is_test", False) or ctx.is_test:
+        return {"Out": [x], "Mask": [jnp.ones_like(x, jnp.uint8)]}
+    shape = list(x.shape)
+    if axis is not None:
+        shape = [s if i in (axis if isinstance(axis, (list, tuple))
+                            else [axis]) else 1 for i, s in enumerate(shape)]
+    keep = jax.random.bernoulli(
+        ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0)), 1 - p,
+                                tuple(shape))
+    return {"Out": [jnp.where(keep, x / (1 - p), 0.0).astype(x.dtype)],
+            "Mask": [jnp.broadcast_to(keep, x.shape).astype(jnp.uint8)]}
+
+
+@register_op("lod_reset", nondiff_inputs=("Y",))
+def _lod_reset(ins, attrs, ctx):
+    """LoD is replaced by explicit Length tensors in this framework; data
+    passes through unchanged (lod_reset_op.cc parity stub)."""
+    return {"Out": [_x(ins)]}
+
+
+@register_op("lod_rank_table", differentiable=False)
+def _lod_rank_table(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": [jnp.arange(x.shape[0], dtype=jnp.int64)]}

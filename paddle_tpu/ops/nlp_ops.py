@@ -1,0 +1,521 @@
+"""Structured-prediction / NLP ops: CRF, CTC, beam search, sampling losses.
+
+Reference (SURVEY §A.1 "Sequence/NLP" + "Losses/metrics"):
+operators/linear_chain_crf_op.{cc,h}, operators/crf_decoding_op.h,
+operators/warpctc_op.cc (wraps the warp-ctc lib), operators/ctc_align_op.cc,
+operators/edit_distance_op.cc, operators/chunk_eval_op.cc,
+operators/beam_search_op.cc, operators/beam_search_decode_op.cc,
+operators/gather_tree_op.cc, operators/nce_op.h,
+operators/hierarchical_sigmoid_op.cc, operators/sample_logits_op.cc,
+operators/im2sequence_op.cc, operators/match_matrix_tensor_op.cc,
+operators/var_conv_2d_op.cc, operators/tree_conv_op.cc.
+
+TPU-native: every dynamic-programming recurrence (CRF forward, CTC alpha,
+Viterbi, beam step) is a `lax.scan` over the time axis on padded [B, T, ...]
+batches with explicit Length — XLA compiles the whole DP to one fused loop;
+no LoD, no host round-trips (the reference runs these on CPU per sequence).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _len_mask(length, t):
+    return jnp.arange(t)[None, :] < length.reshape(-1, 1)
+
+
+# --- linear-chain CRF --------------------------------------------------------
+def _crf_norm(emission, transition, length):
+    """log-partition via forward algorithm.  transition rows 0/1 are the
+    start/stop weights, rows 2.. the [D, D] transition matrix (the reference's
+    Transition layout, linear_chain_crf_op.h)."""
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    t = emission.shape[1]
+
+    def step(alpha, inp):
+        em_t, valid = inp            # [B, D], [B]
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + em_t
+        return jnp.where(valid[:, None], nxt, alpha), None
+
+    alpha0 = start[None] + emission[:, 0]
+    xs = (jnp.swapaxes(emission[:, 1:], 0, 1),
+          jnp.swapaxes(_len_mask(length - 1, t - 1), 0, 1))
+    alphaT, _ = jax.lax.scan(step, alpha0, xs)
+    return jax.nn.logsumexp(alphaT + stop[None], axis=1)
+
+
+def _crf_score(emission, transition, label, length):
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    b, t = label.shape
+    m = _len_mask(length, t)
+    em = jnp.take_along_axis(emission, label[..., None], axis=2).squeeze(-1)
+    em_score = jnp.sum(jnp.where(m, em, 0.0), axis=1)
+    tr = trans[label[:, :-1], label[:, 1:]]
+    tr_score = jnp.sum(jnp.where(m[:, 1:], tr, 0.0), axis=1)
+    last = jnp.maximum(length - 1, 0)
+    last_lbl = jnp.take_along_axis(label, last.reshape(-1, 1), 1).squeeze(1)
+    return (start[label[:, 0]] + em_score + tr_score + stop[last_lbl])
+
+
+@register_op("linear_chain_crf", nondiff_inputs=("Label", "Length"))
+def _linear_chain_crf(ins, attrs, ctx):
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    if label.ndim == 3:
+        label = label.squeeze(-1)
+    length = (ins["Length"][0].astype(jnp.int32).reshape(-1)
+              if ins.get("Length")
+              else jnp.full((emission.shape[0],), emission.shape[1]))
+    log_z = _crf_norm(emission, transition, length)
+    score = _crf_score(emission, transition, label, length)
+    ll = (log_z - score).reshape(-1, 1)
+    return {"LogLikelihood": [ll],
+            "EmissionExps": [jnp.exp(emission)],
+            "TransitionExps": [jnp.exp(transition)],
+            "Alpha": [emission]}
+
+
+@register_op("crf_decoding", nondiff_inputs=("Label", "Length"),
+             differentiable=False)
+def _crf_decoding(ins, attrs, ctx):
+    """Viterbi decode (crf_decoding_op.h) as a scan + backtrace gather."""
+    emission = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    b, t, d = emission.shape
+    length = (ins["Length"][0].astype(jnp.int32).reshape(-1)
+              if ins.get("Length") else jnp.full((b,), t))
+
+    def step(alpha, inp):
+        em_t, valid = inp
+        scores = alpha[:, :, None] + trans[None]       # [B, D, D]
+        best = jnp.argmax(scores, axis=1)
+        nxt = jnp.max(scores, axis=1) + em_t
+        return jnp.where(valid[:, None], nxt, alpha), best
+
+    alpha0 = start[None] + emission[:, 0]
+    xs = (jnp.swapaxes(emission[:, 1:], 0, 1),
+          jnp.swapaxes(_len_mask(length - 1, t - 1), 0, 1))
+    alphaT, back = jax.lax.scan(step, alpha0, xs)      # back: [T-1, B, D]
+    last = jnp.argmax(alphaT + stop[None], axis=1)     # [B]
+
+    def trace(carry, inp):
+        cur = carry
+        bk, valid = inp
+        prev = jnp.take_along_axis(bk, cur[:, None], 1).squeeze(1)
+        return jnp.where(valid, prev, cur), cur
+    valid_rev = jnp.swapaxes(_len_mask(length - 1, t - 1), 0, 1)[::-1]
+    first, path_rev = jax.lax.scan(trace, last, (back[::-1], valid_rev))
+    path = jnp.concatenate([first[None], path_rev[::-1]], axis=0)
+    return {"ViterbiPath": [jnp.swapaxes(path, 0, 1).astype(jnp.int64)]}
+
+
+# --- CTC ---------------------------------------------------------------------
+@register_op("warpctc", nondiff_inputs=("Label", "LogitsLength",
+                                        "LabelLength"))
+def _warpctc(ins, attrs, ctx):
+    """CTC loss (warpctc_op.cc's warp-ctc) as an alpha-recursion lax.scan.
+    Logits [B, T, C] (batch_first padded), Label [B, L] padded with blank."""
+    logits = ins["Logits"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    norm = attrs.get("norm_by_times", False)
+    b, t, c = logits.shape
+    l = label.shape[1]
+    logits_len = (ins["LogitsLength"][0].astype(jnp.int32).reshape(-1)
+                  if ins.get("LogitsLength") else jnp.full((b,), t))
+    label_len = (ins["LabelLength"][0].astype(jnp.int32).reshape(-1)
+                 if ins.get("LabelLength")
+                 else jnp.sum(label != blank, axis=1))
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    # extended label sequence: blank a1 blank a2 ... aL blank  (len 2L+1)
+    s = 2 * l + 1
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)
+    ext_valid = jnp.arange(s)[None, :] < (2 * label_len + 1)[:, None]
+    # transitions allowed from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((b, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    alpha0 = jnp.full((b, s), _NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(logp[:, 0], ext[:, 1:2], 1).squeeze(1))
+    alpha0 = jnp.where(ext_valid, alpha0, _NEG)
+
+    def step(alpha, inp):
+        lp_t, t_valid = inp           # [B, C], [B]
+        em = jnp.take_along_axis(lp_t, ext, axis=1)     # [B, S]
+        shift1 = jnp.concatenate([jnp.full((b, 1), _NEG), alpha[:, :-1]], 1)
+        shift2 = jnp.concatenate([jnp.full((b, 2), _NEG), alpha[:, :-2]], 1)
+        cand = jnp.logaddexp(alpha, shift1)
+        cand = jnp.where(skip_ok, jnp.logaddexp(cand, shift2), cand)
+        nxt = jnp.where(ext_valid, cand + em, _NEG)
+        return jnp.where(t_valid[:, None], nxt, alpha), None
+
+    xs = (jnp.swapaxes(logp[:, 1:], 0, 1),
+          jnp.swapaxes(_len_mask(logits_len - 1, t - 1), 0, 1))
+    alphaT, _ = jax.lax.scan(step, alpha0, xs)
+    endpos = 2 * label_len - 1
+    a_last = jnp.take_along_axis(alphaT, (endpos + 1)[:, None], 1).squeeze(1)
+    a_prev = jnp.take_along_axis(
+        alphaT, jnp.maximum(endpos, 0)[:, None], 1).squeeze(1)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    if norm:
+        loss = loss / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
+    return {"Loss": [loss.reshape(-1, 1)],
+            "WarpCTCGrad": [jnp.zeros_like(logits)]}
+
+
+@register_op("ctc_align", differentiable=False)
+def _ctc_align(ins, attrs, ctx):
+    """ctc_align_op.cc: collapse repeats then remove blanks.  Static-shape:
+    output keeps the input width, compacted left, padded with padding_value."""
+    x = ins["Input"][0].astype(jnp.int32)
+    blank = attrs.get("blank", 0)
+    pad = attrs.get("padding_value", 0)
+    prev = jnp.concatenate([jnp.full_like(x[:, :1], -1), x[:, :-1]], axis=1)
+    keep = (x != blank) & (x != prev)
+    # stable left-compaction by argsort on (not keep)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    vals = jnp.take_along_axis(jnp.where(keep, x, pad), order, axis=1)
+    lens = jnp.sum(keep, axis=1)
+    vals = jnp.where(jnp.arange(x.shape[1])[None] < lens[:, None], vals, pad)
+    return {"Output": [vals.astype(jnp.int64)],
+            "OutputLength": [lens.reshape(-1, 1).astype(jnp.int64)]}
+
+
+@register_op("edit_distance", differentiable=False)
+def _edit_distance(ins, attrs, ctx):
+    """edit_distance_op.cc: Levenshtein DP, scanned over the hypothesis axis.
+    Hyps [B, M], Refs [B, N] padded; lengths given via HypsLength/RefsLength."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    b, m = hyp.shape
+    n = ref.shape[1]
+    hyp_len = (ins["HypsLength"][0].astype(jnp.int32).reshape(-1)
+               if ins.get("HypsLength") else jnp.full((b,), m))
+    ref_len = (ins["RefsLength"][0].astype(jnp.int32).reshape(-1)
+               if ins.get("RefsLength") else jnp.full((b,), n))
+
+    row0 = jnp.broadcast_to(jnp.arange(n + 1, dtype=jnp.float32)[None],
+                            (b, n + 1))
+    cols = jnp.arange(1, n + 1)
+
+    def step(row, inp):
+        h_i, i_valid, i = inp
+        sub = (ref != h_i[:, None]).astype(jnp.float32)
+
+        def inner(left, j):
+            up = row[:, j]
+            diag = row[:, j - 1]
+            best = jnp.minimum(jnp.minimum(up + 1, left + 1),
+                               diag + sub[:, j - 1])
+            return best, best
+        left0 = row[:, 0] + 1
+        _, rest = jax.lax.scan(inner, left0, cols)
+        nrow = jnp.concatenate([left0[:, None],
+                                jnp.swapaxes(rest, 0, 1)], axis=1)
+        return jnp.where(i_valid[:, None], nrow, row), None
+
+    xs = (jnp.swapaxes(hyp, 0, 1), jnp.swapaxes(_len_mask(hyp_len, m), 0, 1),
+          jnp.arange(m))
+    rowT, _ = jax.lax.scan(step, row0, xs)
+    dist = jnp.take_along_axis(rowT, ref_len[:, None], 1).squeeze(1)
+    if attrs.get("normalized", True):
+        dist = dist / jnp.maximum(ref_len.astype(dist.dtype), 1.0)
+    return {"Out": [dist.reshape(-1, 1)],
+            "SequenceNum": [jnp.asarray([b], jnp.int64)]}
+
+
+@register_op("chunk_eval", differentiable=False)
+def _chunk_eval(ins, attrs, ctx):
+    """chunk_eval_op.cc (IOB chunking F1).  Simplified single-scheme (IOB)
+    padded implementation: a chunk starts at tag B (even tag id) and spans
+    following I tags of the same type."""
+    inf = ins["Inference"][0].astype(jnp.int32)
+    lbl = ins["Label"][0].astype(jnp.int32)
+    if inf.ndim == 3:
+        inf, lbl = inf.squeeze(-1), lbl.squeeze(-1)
+    b, t = inf.shape
+    length = (ins["SeqLength"][0].astype(jnp.int32).reshape(-1)
+              if ins.get("SeqLength") else jnp.full((b,), t))
+    m = _len_mask(length, t)
+
+    def chunk_starts(tags):
+        typ = tags // 2
+        is_b = (tags % 2 == 0)
+        prev = jnp.concatenate([jnp.full_like(tags[:, :1], -1),
+                                tags[:, :-1]], 1)
+        prev_typ = prev // 2
+        return is_b | (typ != prev_typ)
+
+    def count_chunks(tags):
+        return jnp.sum(chunk_starts(tags) & m, axis=1)
+
+    same = (inf == lbl)
+    starts = chunk_starts(lbl) & chunk_starts(inf) & same & m
+    # a chunk matches if every position in it matches; approximate by
+    # requiring equality until the next boundary
+    nxt_boundary = jnp.concatenate(
+        [chunk_starts(lbl)[:, 1:], jnp.ones((b, 1), bool)], 1)
+    ok = jnp.where(m, same, True)
+    # suffix-AND within chunk via reversed scan
+    def suffix(carry, inp):
+        okt, bd = inp
+        good = okt & jnp.where(bd, True, carry)
+        return good, good
+    _, good_rev = jax.lax.scan(
+        suffix, jnp.ones((b,), bool),
+        (jnp.swapaxes(ok, 0, 1)[::-1], jnp.swapaxes(nxt_boundary, 0, 1)[::-1]))
+    whole_ok = jnp.swapaxes(good_rev[::-1], 0, 1)
+    correct = jnp.sum(starts & whole_ok, axis=1)
+    num_inf = count_chunks(inf)
+    num_lbl = count_chunks(lbl)
+    tc, ti, tl = (jnp.sum(correct), jnp.sum(num_inf), jnp.sum(num_lbl))
+    p = tc / jnp.maximum(ti, 1)
+    r = tc / jnp.maximum(tl, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-9)
+    return {"Precision": [p.reshape(1)], "Recall": [r.reshape(1)],
+            "F1-Score": [f1.reshape(1)],
+            "NumInferChunks": [ti.reshape(1).astype(jnp.int64)],
+            "NumLabelChunks": [tl.reshape(1).astype(jnp.int64)],
+            "NumCorrectChunks": [tc.reshape(1).astype(jnp.int64)]}
+
+
+# --- beam search -------------------------------------------------------------
+@register_op("beam_search", nondiff_inputs=("pre_ids", "pre_scores", "ids",
+                                            "scores"), differentiable=False)
+def _beam_search(ins, attrs, ctx):
+    """beam_search_op.cc single step, dense layout: scores [B*beam, V] of the
+    current step; selects top beam_size (id, score) per source sentence."""
+    pre_ids = ins["pre_ids"][0].astype(jnp.int32)
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    beam = attrs.get("beam_size", 4)
+    end_id = attrs.get("end_id", 1)
+    nb, v = scores.shape
+    src = nb // beam
+    # is_accumulated=True (default): `scores` already contain the prefix sum
+    # (beam_search_op.cc only adds pre_score in the non-accumulated branch)
+    if attrs.get("is_accumulated", True):
+        cand = scores
+    else:
+        cand = (jnp.log(jnp.clip(scores, 1e-20, None))
+                + pre_scores.reshape(-1, 1))
+    finished = (pre_ids.reshape(-1) == end_id)
+    cand = jnp.where(finished[:, None],
+                     jnp.where(jnp.arange(v)[None] == end_id,
+                               pre_scores.reshape(-1, 1), _NEG),
+                     cand)
+    flat = cand.reshape(src, beam * v)
+    top_scores, top_idx = jax.lax.top_k(flat, beam)
+    parent = top_idx // v
+    token = top_idx % v
+    return {"selected_ids": [token.reshape(-1, 1).astype(jnp.int64)],
+            "selected_scores": [top_scores.reshape(-1, 1)],
+            "parent_idx": [(parent + jnp.arange(src)[:, None] * beam)
+                           .reshape(-1).astype(jnp.int64)]}
+
+
+@register_op("gather_tree", differentiable=False)
+def _gather_tree(ins, attrs, ctx):
+    """gather_tree_op.cc: backtrace beam parents to full sequences.
+    Ids/Parents [T, B, beam]."""
+    ids = ins["Ids"][0].astype(jnp.int32)
+    parents = ins["Parents"][0].astype(jnp.int32)
+    t = ids.shape[0]
+
+    def step(carry, inp):
+        beam_idx = carry                 # [B, beam]
+        id_t, par_t = inp
+        tok = jnp.take_along_axis(id_t, beam_idx, axis=1)
+        nxt = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        return nxt, tok
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None],
+                            ids.shape[1:]).astype(jnp.int32)
+    _, toks_rev = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return {"Out": [toks_rev[::-1].astype(jnp.int64)]}
+
+
+@register_op("beam_search_decode", differentiable=False)
+def _beam_search_decode(ins, attrs, ctx):
+    """beam_search_decode_op.cc dense analog: Ids/Parents stacked [T, B, beam]
+    -> backtraced sequences + their final scores."""
+    out = _gather_tree({"Ids": ins["Ids"], "Parents": ins["ParentIdx"]},
+                       attrs, ctx)["Out"][0]
+    scores = ins["Scores"][0] if ins.get("Scores") else None
+    res = {"SentenceIds": [out]}
+    if scores is not None:
+        res["SentenceScores"] = [scores]
+    return res
+
+
+# --- sampled softmax family --------------------------------------------------
+@register_op("nce", nondiff_inputs=("Label", "SampleWeight",
+                                    "CustomDistProbs", "CustomDistAlias",
+                                    "CustomDistAliasProbs"),
+             stateful_rng=True)
+def _nce(ins, attrs, ctx):
+    """nce_op.h: noise-contrastive estimation with uniform negative sampling
+    (sampler=0 default).  Input [B, D], Weight [V, D], Label [B, num_true]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    label = ins["Label"][0].astype(jnp.int32)
+    if label.ndim == 1:
+        label = label[:, None]
+    b_in = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    num_neg = attrs.get("num_neg_samples", 10)
+    num_total = attrs.get("num_total_classes", w.shape[0])
+    bsz, num_true = label.shape
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    neg = jax.random.randint(key, (bsz, num_neg), 0, num_total)
+    samples = jnp.concatenate([label, neg], axis=1)     # [B, true+neg]
+    logits = jnp.einsum("bd,bsd->bs", x, w[samples])
+    if b_in is not None:
+        logits = logits + b_in[samples]
+    p_noise = 1.0 / num_total
+    # NCE objective: log sigmoid for true, log(1-sigmoid) for noise, with
+    # logits shifted by log(k * p_noise)
+    shifted = logits - jnp.log(num_neg * p_noise)
+    lbl = jnp.concatenate([jnp.ones((bsz, num_true)),
+                           jnp.zeros((bsz, num_neg))], axis=1)
+    loss = -(lbl * jax.nn.log_sigmoid(shifted)
+             + (1 - lbl) * jax.nn.log_sigmoid(-shifted))
+    return {"Cost": [jnp.sum(loss, axis=1, keepdims=True)],
+            "SampleLogits": [logits], "SampleLabels": [samples]}
+
+
+@register_op("hierarchical_sigmoid", nondiff_inputs=("Label", "PathTable",
+                                                     "PathCode"))
+def _hierarchical_sigmoid(ins, attrs, ctx):
+    """hierarchical_sigmoid_op.cc, default complete-binary-tree coding:
+    num_classes leaves; each label's path bits come from its binary code."""
+    x = ins["X"][0]
+    w = ins["W"][0]                  # [num_classes-1, D]
+    label = ins["Label"][0].astype(jnp.int32).reshape(-1)
+    bias = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    num_classes = attrs.get("num_classes", w.shape[0] + 1)
+    code_len = max(1, int(jnp.ceil(jnp.log2(num_classes)))) if not isinstance(
+        num_classes, int) else max(1, (num_classes - 1).bit_length())
+    code = label + num_classes       # complete binary tree index
+    losses = jnp.zeros((x.shape[0],), x.dtype)
+    for _ in range(code_len):
+        parent = code // 2
+        bit = (code % 2).astype(x.dtype)
+        idx = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+        valid = (parent >= 1) & (parent - 1 < w.shape[0])
+        logit = jnp.einsum("bd,bd->b", x, w[idx])
+        if bias is not None:
+            logit = logit + bias[jnp.clip(idx, 0, bias.shape[0] - 1)]
+        step_loss = -(bit * jax.nn.log_sigmoid(logit)
+                      + (1 - bit) * jax.nn.log_sigmoid(-logit))
+        losses = losses + jnp.where(valid, step_loss, 0.0)
+        code = parent
+    return {"Out": [losses.reshape(-1, 1)],
+            "PreOut": [jnp.zeros((x.shape[0], code_len), x.dtype)]}
+
+
+@register_op("sample_logits", nondiff_inputs=("Labels", "CustomizedSamples",
+                                              "CustomizedProbabilities"),
+             stateful_rng=True)
+def _sample_logits(ins, attrs, ctx):
+    """sample_logits_op.cc: sampled-softmax — gather logits of the true +
+    uniformly sampled classes, subtract log(expected count) unless
+    remove_accidental_hits is off."""
+    logits = ins["Logits"][0]
+    label = ins["Labels"][0].astype(jnp.int32)
+    num_samples = attrs.get("num_samples", 1)
+    b, v = logits.shape
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    neg = jax.random.randint(key, (b, num_samples), 0, v)
+    samples = jnp.concatenate([label, neg], axis=1)
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    prob = jnp.full(samples.shape, 1.0 / v)
+    if attrs.get("uniq", True):
+        sampled = sampled - jnp.log(prob * num_samples + 1e-20)
+    return {"SampledLogits": [sampled],
+            "SampledLabels": [jnp.zeros((b, label.shape[1]), jnp.int64)],
+            "Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [prob],
+            "LogitsDim": [jnp.asarray([b, v], jnp.int64)],
+            "LabelsDim": [jnp.asarray(label.shape, jnp.int64)]}
+
+
+# --- text-matching convs -----------------------------------------------------
+@register_op("im2sequence")
+def _im2sequence(ins, attrs, ctx):
+    """im2sequence_op.cc: image [B, C, H, W] -> patch rows
+    [B * out_h * out_w, C * kh * kw] (OCR front-end)."""
+    x = ins["X"][0]
+    kh, kw = attrs.get("kernels", [1, 1])
+    sh, sw = attrs.get("strides", [1, 1])
+    ph0, pw0, ph1, pw1 = attrs.get("paddings", [0, 0, 0, 0])
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    b, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))   # [B, C*kh*kw, oh, ow]
+    out = patches.transpose(0, 2, 3, 1).reshape(b * oh * ow, c * kh * kw)
+    return {"Out": [out]}
+
+
+@register_op("match_matrix_tensor", nondiff_inputs=("LengthX", "LengthY"))
+def _match_matrix_tensor(ins, attrs, ctx):
+    """match_matrix_tensor_op.cc padded analog: X [B, Tx, D], Y [B, Ty, D],
+    W [D, dim_t, D] -> Out [B, dim_t, Tx, Ty] bilinear match planes."""
+    x, y, w = ins["X"][0], ins["Y"][0], ins["W"][0]
+    xw = jnp.einsum("bxd,dte->bxte", x, w)
+    out = jnp.einsum("bxte,bye->btxy", xw, y)
+    return {"Out": [out], "Tmp": [xw]}
+
+
+@register_op("var_conv_2d", nondiff_inputs=("ROW", "COLUMN"))
+def _var_conv_2d(ins, attrs, ctx):
+    """var_conv_2d_op.cc padded analog: per-sample 2D conv over the match
+    matrix; with padded batches it is a plain grouped conv."""
+    x = ins["X"][0]
+    w = ins["W"][0]
+    oc = attrs.get("output_channel", w.shape[0])
+    ic = attrs.get("input_channel", x.shape[1])
+    kh, kw = attrs.get("kernel_h", 3), attrs.get("kernel_w", 3)
+    sh, sw = attrs.get("stride_h", 1), attrs.get("stride_w", 1)
+    wr = w.reshape(oc, ic, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x, wr, (sh, sw), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Out": [out], "Col": [x]}
+
+
+@register_op("tree_conv", nondiff_inputs=("EdgeSet",))
+def _tree_conv(ins, attrs, ctx):
+    """tree_conv_op.cc (tree-based convolution over ASTs): NodesVector
+    [B, N, D], EdgeSet [B, E, 2], Filter [D, H, max_depth, out].  Simplified
+    continuous binary tree conv: each node aggregates its children uniformly
+    per depth position."""
+    nodes = ins["NodesVector"][0]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)
+    filt = ins["Filter"][0]             # [D, H, max_depth, out] -> collapse
+    d_in, h, depth, out_c = filt.shape
+    b, n, _ = nodes.shape
+    # adjacency-mean of children
+    parent, child = edges[..., 0], edges[..., 1]
+    adj = jnp.zeros((b, n, n), nodes.dtype)
+    badge = jnp.arange(b)[:, None]
+    adj = adj.at[badge, parent, child].set(1.0)
+    deg = jnp.maximum(adj.sum(-1, keepdims=True), 1.0)
+    child_mean = (adj / deg) @ nodes
+    w_self = filt[:, :, 0, :].reshape(d_in, h * out_c)
+    w_child = filt[:, :, min(1, depth - 1), :].reshape(d_in, h * out_c)
+    out = (nodes @ w_self + child_mean @ w_child).reshape(b, n, h, out_c)
+    return {"Out": [jnp.tanh(out.max(axis=2))]}

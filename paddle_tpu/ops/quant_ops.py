@@ -1,0 +1,221 @@
+"""Quantization ops (QAT fake-quant + PTQ dequant family).
+
+Reference (SURVEY §A.1 "Quantization"): operators/fake_quantize_op.{cc,cu}
+(fake_quantize_abs_max, fake_channel_wise_quantize_abs_max,
+fake_quantize_range_abs_max, fake_quantize_moving_average_abs_max,
+fake_quantize_dequantize_*), operators/fake_dequantize_op.cc
+(fake_dequantize_max_abs, fake_channel_wise_dequantize_max_abs),
+operators/dequantize_log_op.cc, operators/dequantize_abs_max_op.cc.
+
+All fake-quant ops use straight-through gradients (the reference registers
+FakeQuantGradMaker passing dY through), expressed here as a custom_grad that
+forwards the cotangent — XLA folds the round/clip chain into one fusion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _bnt(attrs):
+    # bin count: 2^(bit_length-1) - 1  (127 for int8)
+    return float((1 << (attrs.get("bit_length", 8) - 1)) - 1)
+
+
+def _quant(x, scale, bnt):
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt)
+
+
+def _dequant(q, scale, bnt):
+    return q * scale / bnt
+
+
+def _st_grad(slot_in="X", slot_out="Out"):
+    def grad(ins, outs, out_grads, attrs, ctx):
+        g = out_grads.get(slot_out)
+        x = ins[slot_in][0]
+        if g is None:
+            g = jnp.zeros_like(x)
+        return {slot_in: [g.astype(x.dtype)]}
+    return grad
+
+
+@register_op("fake_quantize_abs_max", nondiff_outputs=("OutScale",),
+             custom_grad=_st_grad())
+def _fake_quantize_abs_max(ins, attrs, ctx):
+    x = ins["X"][0]
+    bnt = _bnt(attrs)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_quant(x, scale, bnt)], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_abs_max", nondiff_outputs=("OutScale",),
+             custom_grad=_st_grad())
+def _fake_qdq_abs_max(ins, attrs, ctx):
+    x = ins["X"][0]
+    bnt = _bnt(attrs)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": [_dequant(_quant(x, scale, bnt), scale, bnt)],
+            "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max",
+             nondiff_outputs=("OutScale",), custom_grad=_st_grad())
+def _fake_cw_quant(ins, attrs, ctx):
+    x = ins["X"][0]
+    bnt = _bnt(attrs)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    return {"Out": [_quant(x, scale.reshape(shape), bnt)],
+            "OutScale": [scale]}
+
+
+@register_op("fake_quantize_range_abs_max",
+             nondiff_inputs=("InScale", "Iter"),
+             nondiff_outputs=("OutScale", "OutScales"),
+             custom_grad=_st_grad())
+def _fake_quant_range(ins, attrs, ctx):
+    """Training-time scale tracked over a sliding window of abs-max values
+    (fake_quantize_op.cc FakeQuantizeRangeAbsMaxKernel): in inference
+    (is_test) the recorded InScale is used as-is."""
+    x = ins["X"][0]
+    bnt = _bnt(attrs)
+    in_scale = ins["InScale"][0].reshape(())
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = in_scale
+    else:
+        scale = jnp.maximum(jnp.max(jnp.abs(x)), in_scale)
+    return {"Out": [_quant(x, scale, bnt)],
+            "OutScale": [scale.reshape(1)],
+            "OutScales": [scale.reshape(1)]}
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             nondiff_inputs=("InScale", "InAccum", "InState"),
+             nondiff_outputs=("OutScale", "OutAccum", "OutState"),
+             custom_grad=_st_grad())
+def _fake_quant_moving(ins, attrs, ctx):
+    x = ins["X"][0]
+    bnt = _bnt(attrs)
+    rate = attrs.get("moving_rate", 0.9)
+    in_scale = ins["InScale"][0].reshape(())
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = in_scale
+        accum = in_scale
+        state = jnp.ones(())
+    else:
+        cur = jnp.max(jnp.abs(x))
+        in_accum = (ins["InAccum"][0].reshape(())
+                    if ins.get("InAccum") else in_scale)
+        in_state = (ins["InState"][0].reshape(())
+                    if ins.get("InState") else jnp.ones(()))
+        state = rate * in_state + 1.0
+        accum = rate * in_accum + cur
+        scale = accum / state
+    return {"Out": [_quant(x, scale, bnt)],
+            "OutScale": [scale.reshape(1)],
+            "OutAccum": [accum.reshape(1)],
+            "OutState": [state.reshape(1)]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             nondiff_inputs=("InScale", "InAccum", "InState"),
+             nondiff_outputs=("OutScale", "OutAccum", "OutState"),
+             custom_grad=_st_grad())
+def _fake_qdq_moving(ins, attrs, ctx):
+    outs = _fake_quant_moving(ins, attrs, ctx)
+    bnt = _bnt(attrs)
+    scale = outs["OutScale"][0].reshape(())
+    outs["Out"] = [_dequant(outs["Out"][0], scale, bnt)]
+    return outs
+
+
+@register_op("fake_dequantize_max_abs", nondiff_inputs=("Scale",))
+def _fake_dequantize_max_abs(ins, attrs, ctx):
+    x, scale = ins["X"][0], ins["Scale"][0].reshape(())
+    max_range = attrs.get("max_range", 127.0)
+    return {"Out": [x.astype(jnp.float32) * scale / max_range]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             nondiff_inputs=("Scales",))
+def _fake_cw_dequant(ins, attrs, ctx):
+    x = ins["X"][0].astype(jnp.float32)
+    scales = ins["Scales"]
+    quant_bits = attrs.get("quant_bits", [8])
+    axis = attrs.get("quant_axis", 0)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    out = x * scales[0].reshape(shape) / float((1 << (quant_bits[0] - 1)) - 1)
+    if len(scales) > 1:
+        out = out * scales[1].reshape(()) / float(
+            (1 << (quant_bits[1] - 1)) - 1)
+    return {"Out": [out]}
+
+
+@register_op("dequantize_abs_max", nondiff_inputs=("Scale",),
+             differentiable=False)
+def _dequantize_abs_max(ins, attrs, ctx):
+    x, scale = ins["X"][0], ins["Scale"][0].reshape(())
+    return {"Out": [x.astype(jnp.float32) * scale / attrs.get("max_range",
+                                                              127.0)]}
+
+
+@register_op("dequantize_log", nondiff_inputs=("Dict",),
+             differentiable=False)
+def _dequantize_log(ins, attrs, ctx):
+    """dequantize_log_op.cc: int8 codes index a 128-entry log-scale dict;
+    negative codes mirror to -dict[code-128]."""
+    x = ins["X"][0].astype(jnp.int32)
+    d = ins["Dict"][0]
+    neg = x < 0
+    idx = jnp.where(neg, x + 128, x)
+    val = d[jnp.clip(idx, 0, d.shape[0] - 1)]
+    return {"Out": [jnp.where(neg, -val, val)]}
+
+
+@register_op("quantize", differentiable=False)
+def _quantize(ins, attrs, ctx):
+    x = ins["Input"][0]
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    return {"Output": [jnp.round(x * scale + shift).astype(jnp.int8)]}
+
+
+@register_op("dequantize", differentiable=False)
+def _dequantize(ins, attrs, ctx):
+    x = ins["Input"][0]
+    scale = attrs.get("Scale", 1.0)
+    shift = attrs.get("Shift", 0.0)
+    return {"Output": [(x.astype(jnp.float32) - shift) / scale]}
+
+
+@register_op("requantize", differentiable=False)
+def _requantize(ins, attrs, ctx):
+    x = ins["Input"][0]
+    si, so = attrs.get("Scale_in", 1.0), attrs.get("Scale_out", 1.0)
+    return {"Output": [jnp.round(x.astype(jnp.float32) * so / si)
+                       .astype(x.dtype)]}
+
+
+@register_op("moving_average_abs_max_scale",
+             nondiff_outputs=("OutScale", "OutAccum", "OutState"),
+             custom_grad=_st_grad())
+def _moving_average_abs_max_scale(ins, attrs, ctx):
+    """Scale observer only (used by QAT output quantization)."""
+    x = ins["X"][0]
+    rate = attrs.get("moving_rate", 0.9)
+    cur = jnp.max(jnp.abs(x))
+    in_accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") else cur
+    in_state = (ins["InState"][0].reshape(())
+                if ins.get("InState") else jnp.ones(()))
+    state = rate * in_state + 1.0
+    accum = rate * in_accum + cur
+    return {"Out": [x], "OutScale": [(accum / state).reshape(1)],
+            "OutAccum": [accum.reshape(1)], "OutState": [state.reshape(1)]}

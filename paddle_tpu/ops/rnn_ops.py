@@ -1,0 +1,253 @@
+"""Recurrent ops at the reference's op granularity.
+
+Reference (SURVEY §A.1 "Sequence/NLP"): operators/lstm_op.cc,
+operators/lstmp_op.cc, operators/gru_op.cc, operators/gru_unit_op.cc,
+operators/cudnn_lstm_op.cc, operators/conv_shift_op.cc,
+operators/row_conv_op.cc.
+
+The reference's LoD-ragged recurrences become padded [B, T, D] scans
+(`lax.scan` — XLA unrolls/pipelines them; see rnn_scan in fluid/layers/rnn.py
+for the multi-layer cuDNN-replacement path).  Gate order follows the reference:
+LSTM gates (i, f, c, o) from lstm_op.h, GRU gates (update, reset, cell) from
+gru_op.h.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+_ACT = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+        "identity": lambda x: x}
+
+
+def _lstm_scan(x_tbd, w, b, h0, c0, gate_act, cell_act, cand_act,
+               proj=None):
+    """x: [T, B, 4H block via pre-projection]; w: [H(+P), 4H] recurrent."""
+    def step(carry, xt):
+        h, c = carry
+        g = xt + h @ w
+        if b is not None:
+            g = g + b
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        c2 = gate_act(f) * c + gate_act(i) * cand_act(cc)
+        h2 = gate_act(o) * cell_act(c2)
+        r = h2
+        if proj is not None:
+            r = h2 @ proj
+        return (r, c2), (r, h2, c2)
+    (hT, cT), (outs, hs, cs) = jax.lax.scan(step, (h0, c0), x_tbd)
+    return outs, hs, cs, hT, cT
+
+
+@register_op("lstm", nondiff_inputs=("C0", "H0"))
+def _lstm(ins, attrs, ctx):
+    """lstm_op.cc padded analog: Input [B, T, 4H] (pre-projected x@Wx as the
+    reference requires), Weight [H, 4H], Bias [1, 4H] (7H with use_peepholes:
+    the extra 3H are W_ic, W_if, W_oc — lstm_op.cc default is peepholes ON)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    h = w.shape[0]
+    braw = ins["Bias"][0].reshape(-1) if ins.get("Bias") else None
+    b = braw[: 4 * h] if braw is not None else None
+    peep = (attrs.get("use_peepholes", True) and braw is not None
+            and braw.shape[0] >= 7 * h)
+    w_ic = braw[4 * h:5 * h] if peep else None
+    w_if = braw[5 * h:6 * h] if peep else None
+    w_oc = braw[6 * h:7 * h] if peep else None
+    bsz, t = x.shape[0], x.shape[1]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, h), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((bsz, h), x.dtype)
+    ga = _ACT[attrs.get("gate_activation", "sigmoid")]
+    ca = _ACT[attrs.get("cell_activation", "tanh")]
+    na = _ACT[attrs.get("candidate_activation", "tanh")]
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs = xs[::-1]
+    if peep:
+        def step(carry, xt):
+            hprev, c = carry
+            g = xt + hprev @ w
+            if b is not None:
+                g = g + b
+            i, f, cc, o = jnp.split(g, 4, axis=-1)
+            i = ga(i + w_ic * c)
+            f = ga(f + w_if * c)
+            c2 = f * c + i * na(cc)
+            o = ga(o + w_oc * c2)
+            h2 = o * ca(c2)
+            return (h2, c2), (h2, h2, c2)
+        (hT, cT), (outs, hs, cs) = jax.lax.scan(step, (h0, c0), xs)
+    else:
+        outs, hs, cs, hT, cT = _lstm_scan(xs, w, b, h0, c0, ga, ca, na)
+    if attrs.get("is_reverse", False):
+        outs, cs = outs[::-1], cs[::-1]
+    return {"Hidden": [jnp.swapaxes(outs, 0, 1)],
+            "Cell": [jnp.swapaxes(cs, 0, 1)],
+            "BatchGate": [x], "BatchCellPreAct": [jnp.swapaxes(cs, 0, 1)]}
+
+
+@register_op("lstmp", nondiff_inputs=("C0", "H0"))
+def _lstmp(ins, attrs, ctx):
+    """lstmp_op.cc: LSTM with a recurrent projection layer (ProjWeight
+    [H, P]); the projected state is what recurs and is emitted."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]              # [P, 4H]
+    proj = ins["ProjWeight"][0]       # [H, P]
+    h_dim = proj.shape[0]
+    p_dim = proj.shape[1]
+    b = ins["Bias"][0].reshape(-1)[: 4 * h_dim] if ins.get("Bias") else None
+    bsz = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, p_dim), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((bsz, h_dim), x.dtype)
+    ga = _ACT[attrs.get("gate_activation", "sigmoid")]
+    ca = _ACT[attrs.get("cell_activation", "tanh")]
+    na = _ACT[attrs.get("candidate_activation", "tanh")]
+    pa = _ACT[attrs.get("proj_activation", "tanh")]
+    xs = jnp.swapaxes(x, 0, 1)
+
+    def step(carry, xt):
+        r, c = carry
+        g = xt + r @ w
+        if b is not None:
+            g = g + b
+        i, f, cc, o = jnp.split(g, 4, axis=-1)
+        c2 = ga(f) * c + ga(i) * na(cc)
+        h2 = ga(o) * ca(c2)
+        r2 = pa(h2 @ proj)
+        return (r2, c2), r2
+    (_, _), outs = jax.lax.scan(step, (h0, c0), xs)
+    return {"Projection": [jnp.swapaxes(outs, 0, 1)],
+            "Cell": [jnp.zeros((bsz, x.shape[1], h_dim), x.dtype)],
+            "BatchGate": [x], "BatchCellPreAct": [x],
+            "BatchHidden": [x]}
+
+
+@register_op("gru", nondiff_inputs=("H0",))
+def _gru(ins, attrs, ctx):
+    """gru_op.cc padded analog: Input [B, T, 3H] pre-projected, Weight
+    [H, 3H] (first 2H: update+reset, last H: candidate), Bias [1, 3H]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    h = w.shape[0]
+    wur, wc = w[:, :2 * h], w[:, 2 * h:]
+    b = ins["Bias"][0].reshape(-1) if ins.get("Bias") else jnp.zeros(
+        (3 * h,), x.dtype)
+    bsz = x.shape[0]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((bsz, h), x.dtype)
+    ga = _ACT[attrs.get("gate_activation", "sigmoid")]
+    na = _ACT[attrs.get("activation", "tanh")]
+    origin = attrs.get("origin_mode", False)
+    xs = jnp.swapaxes(x, 0, 1)
+    if attrs.get("is_reverse", False):
+        xs = xs[::-1]
+
+    def step(hprev, xt):
+        xur, xc = xt[:, :2 * h] + b[:2 * h], xt[:, 2 * h:] + b[2 * h:]
+        ur = ga(xur + hprev @ wur)
+        u, r = ur[:, :h], ur[:, h:]
+        c = na(xc + (r * hprev) @ wc)
+        h2 = (u * hprev + (1 - u) * c) if origin else (
+            (1 - u) * hprev + u * c)
+        return h2, h2
+    hT, outs = jax.lax.scan(step, h0, xs)
+    if attrs.get("is_reverse", False):
+        outs = outs[::-1]
+    out_bt = jnp.swapaxes(outs, 0, 1)
+    return {"Hidden": [out_bt], "BatchGate": [x],
+            "BatchResetHiddenPrev": [out_bt], "BatchHidden": [out_bt]}
+
+
+@register_op("gru_unit", nondiff_inputs=())
+def _gru_unit(ins, attrs, ctx):
+    """gru_unit_op.cc: single GRU step. Input [B, 3H], HiddenPrev [B, H],
+    Weight [H, 3H], Bias [1, 3H]."""
+    x = ins["Input"][0]
+    hprev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    h = hprev.shape[-1]
+    b = (ins["Bias"][0].reshape(-1) if ins.get("Bias")
+         else jnp.zeros((3 * h,), x.dtype))
+    ga = _ACT[{1: "sigmoid", 0: "identity", 2: "tanh", 3: "relu"}.get(
+        attrs.get("gate_activation", 1), "sigmoid")] if isinstance(
+        attrs.get("gate_activation", 1), int) else _ACT[
+        attrs.get("gate_activation", "sigmoid")]
+    act = attrs.get("activation", 2)
+    na = _ACT[{1: "sigmoid", 0: "identity", 2: "tanh", 3: "relu"}.get(
+        act, "tanh")] if isinstance(act, int) else _ACT[act]
+    xur, xc = x[:, :2 * h] + b[:2 * h], x[:, 2 * h:] + b[2 * h:]
+    ur = ga(xur + hprev @ w[:, :2 * h])
+    u, r = ur[:, :h], ur[:, h:]
+    c = na(xc + (r * hprev) @ w[:, 2 * h:])
+    origin = attrs.get("origin_mode", False)
+    out = (u * hprev + (1 - u) * c) if origin else ((1 - u) * hprev + u * c)
+    return {"Hidden": [out], "Gate": [jnp.concatenate([u, r, c], -1)],
+            "ResetHiddenPrev": [r * hprev]}
+
+
+@register_op("cudnn_lstm", nondiff_inputs=("InitH", "InitC", "SequenceLength"),
+             stateful_rng=True)
+def _cudnn_lstm(ins, attrs, ctx):
+    """cudnn_lstm_op.cc analog: multi-layer LSTM over packed weights.  On TPU
+    this is the same lax.scan stack as rnn_scan; W is the cuDNN flat layout
+    [wi_l0, wh_l0, bi_l0, bh_l0, wi_l1, ...] flattened."""
+    x = ins["Input"][0]                       # [T, B, D] (reference layout)
+    wflat = ins["W"][0].reshape(-1)
+    num_layers = attrs.get("num_layers", 1)
+    hidden = attrs.get("hidden_size", x.shape[-1])
+    bsz = x.shape[1]
+    h0 = (ins["InitH"][0] if ins.get("InitH")
+          else jnp.zeros((num_layers, bsz, hidden), x.dtype))
+    c0 = (ins["InitC"][0] if ins.get("InitC")
+          else jnp.zeros((num_layers, bsz, hidden), x.dtype))
+    off = 0
+    out = x
+    hT, cT = [], []
+    for layer in range(num_layers):
+        in_dim = out.shape[-1]
+        wi = jax.lax.dynamic_slice(wflat, (off,), (4 * hidden * in_dim,)
+                                   ).reshape(4 * hidden, in_dim); off += 4 * hidden * in_dim
+        wh = jax.lax.dynamic_slice(wflat, (off,), (4 * hidden * hidden,)
+                                   ).reshape(4 * hidden, hidden); off += 4 * hidden * hidden
+        bi = jax.lax.dynamic_slice(wflat, (off,), (4 * hidden,)); off += 4 * hidden
+        bh = jax.lax.dynamic_slice(wflat, (off,), (4 * hidden,)); off += 4 * hidden
+
+        def step(carry, xt):
+            h, c = carry
+            g = xt @ wi.T + h @ wh.T + bi + bh
+            i, f, cc, o = jnp.split(g, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cc)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+        (ht, ct), out = jax.lax.scan(step, (h0[layer], c0[layer]), out)
+        hT.append(ht); cT.append(ct)
+    return {"Out": [out], "LastH": [jnp.stack(hT)], "LastC": [jnp.stack(cT)],
+            "Reserve": [jnp.zeros((1,), x.dtype)],
+            "StateOut": [jnp.zeros((1,), x.dtype)]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ins, attrs, ctx):
+    """conv_shift_op.cc: circular 1D correlation, Y width M (odd) <= X width:
+    out[i,j] = sum_k X[i, (j+k-M/2) mod N] * Y[i,k]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    n, m = x.shape[1], y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n)[:, None] + jnp.arange(m)[None, :] - half) % n
+    gathered = x[:, idx]                       # [B, N, M]
+    return {"Out": [jnp.einsum("bnm,bm->bn", gathered, y)]}
+
+
+@register_op("row_conv", nondiff_inputs=("Length",))
+def _row_conv(ins, attrs, ctx):
+    """row_conv_op.cc (lookahead conv from DeepSpeech2): padded [B, T, D]
+    input, Filter [future_context+1, D]:
+    out[b,t,d] = sum_k x[b,t+k,d] * filt[k,d]."""
+    x = ins["X"][0]
+    f = ins["Filter"][0]
+    k = f.shape[0]
+    pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i:i + x.shape[1]] * f[i][None, None, :]
+              for i in range(k))
+    return {"Out": [out]}

@@ -1,0 +1,153 @@
+"""Sequence ops beyond the core set in fluid/layers/sequence_lod.py.
+
+Reference (SURVEY §2.5 `sequence_ops/` 6.2K LoC): sequence_conv_op.cc,
+sequence_expand_as_op.cc, sequence_pad_op.cc, sequence_unpad_op.cc,
+sequence_slice_op.cc, sequence_erase_op.cc, sequence_enumerate_op.cc,
+sequence_scatter_op.cc.
+
+Padded-batch convention (see sequence_lod.py): [B, T, D] + Length [B].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _mask(length, t):
+    return jnp.arange(t)[None, :] < length.reshape(-1, 1)
+
+
+@register_op("sequence_conv", nondiff_inputs=("Length", "PaddingData"))
+def _sequence_conv(ins, attrs, ctx):
+    """sequence_conv_op.cc: context window of contextLength rows starting at
+    contextStart, contracted with Filter [ctx*D, OutD]."""
+    x = ins["X"][0]                           # [B, T, D]
+    filt = ins["Filter"][0]
+    start = attrs.get("contextStart", -1)
+    clen = attrs.get("contextLength", 3)
+    b, t, d = x.shape
+    cols = []
+    for k in range(clen):
+        off = start + k
+        if off < 0:
+            pad = jnp.zeros((b, min(-off, t), d), x.dtype)
+            piece = jnp.concatenate([pad, x[:, :t + off]], axis=1) \
+                if t + off > 0 else jnp.zeros_like(x)
+        elif off > 0:
+            pad = jnp.zeros((b, min(off, t), d), x.dtype)
+            piece = jnp.concatenate([x[:, off:], pad], axis=1)
+        else:
+            piece = x
+        cols.append(piece)
+    ctx_rows = jnp.concatenate(cols, axis=-1)   # [B, T, ctx*D]
+    if ins.get("Length"):
+        m = _mask(ins["Length"][0], t).astype(x.dtype)[..., None]
+        ctx_rows = ctx_rows * m
+    return {"Out": [ctx_rows @ filt]}
+
+
+@register_op("sequence_expand_as", nondiff_inputs=("Y", "Length"))
+def _sequence_expand_as(ins, attrs, ctx):
+    """sequence_expand_as_op.cc padded analog: each row of X [B, D] is
+    broadcast over Y's time axis [B, T, ...]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    t = y.shape[1]
+    if x.ndim == 2:
+        out = jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1]))
+    else:
+        out = jnp.broadcast_to(x[:, :1], x.shape[:1] + (t,) + x.shape[2:])
+    return {"Out": [out]}
+
+
+@register_op("sequence_pad", nondiff_inputs=("PadValue", "Length"))
+def _sequence_pad(ins, attrs, ctx):
+    """sequence_pad_op.cc: already-padded layout makes this a copy +
+    padded_length trim/extend with PadValue."""
+    x = ins["X"][0]
+    pad_value = ins["PadValue"][0].reshape(()) if ins.get("PadValue") else 0.0
+    padded_len = attrs.get("padded_length", -1)
+    t = x.shape[1]
+    length = (ins["Length"][0].astype(jnp.int32).reshape(-1)
+              if ins.get("Length") else jnp.full((x.shape[0],), t))
+    target = t if padded_len < 0 else padded_len
+    if target > t:
+        fill = jnp.full((x.shape[0], target - t) + x.shape[2:], pad_value,
+                        x.dtype)
+        x = jnp.concatenate([x, fill], axis=1)
+    else:
+        x = x[:, :target]
+    m = _mask(length, target)
+    shape = m.shape + (1,) * (x.ndim - 2)
+    out = jnp.where(m.reshape(shape), x, pad_value)
+    return {"Out": [out], "Length": [length.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad", nondiff_inputs=("Length",))
+def _sequence_unpad(ins, attrs, ctx):
+    """sequence_unpad_op.cc: padded layout keeps the tensor; padding zeroed
+    (ragged outputs are masks, not LoD)."""
+    x = ins["X"][0]
+    length = ins["Length"][0].astype(jnp.int32).reshape(-1)
+    m = _mask(length, x.shape[1])
+    return {"Out": [jnp.where(m.reshape(m.shape + (1,) * (x.ndim - 2)),
+                              x, 0.0)]}
+
+
+@register_op("sequence_slice", nondiff_inputs=("Offset", "Length"))
+def _sequence_slice(ins, attrs, ctx):
+    """sequence_slice_op.cc: per-sequence [offset, offset+length) slice,
+    left-aligned into the padded output."""
+    x = ins["X"][0]
+    off = ins["Offset"][0].astype(jnp.int32).reshape(-1)
+    ln = ins["Length"][0].astype(jnp.int32).reshape(-1)
+    b, t = x.shape[:2]
+    idx = off[:, None] + jnp.arange(t)[None, :]
+    idx = jnp.clip(idx, 0, t - 1)
+    g = jnp.take_along_axis(x, idx.reshape(b, t, *(1,) * (x.ndim - 2)),
+                            axis=1)
+    m = _mask(ln, t)
+    return {"Out": [jnp.where(m.reshape(m.shape + (1,) * (x.ndim - 2)),
+                              g, 0.0)]}
+
+
+@register_op("sequence_erase", differentiable=False)
+def _sequence_erase(ins, attrs, ctx):
+    """sequence_erase_op.cc: drop tokens in `tokens`, left-compact, pad 0."""
+    x = ins["X"][0].astype(jnp.int32)
+    tokens = jnp.asarray(attrs.get("tokens", []), jnp.int32)
+    keep = ~(x[..., None] == tokens[None, None, :]).any(-1) \
+        if tokens.size else jnp.ones_like(x, bool)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    vals = jnp.take_along_axis(jnp.where(keep, x, 0), order, axis=1)
+    lens = keep.sum(axis=1)
+    vals = jnp.where(jnp.arange(x.shape[1])[None] < lens[:, None], vals, 0)
+    return {"Out": [vals.astype(jnp.int64)],
+            "Length": [lens.astype(jnp.int64)]}
+
+
+@register_op("sequence_enumerate", differentiable=False)
+def _sequence_enumerate(ins, attrs, ctx):
+    """sequence_enumerate_op.cc: win_len-gram sliding windows, pad_value
+    beyond the end."""
+    x = ins["X"][0].astype(jnp.int32)
+    win = attrs.get("win_size", 2)
+    pad = attrs.get("pad_value", 0)
+    b, t = x.shape[:2]
+    xe = jnp.concatenate(
+        [x, jnp.full((b, win - 1), pad, x.dtype)], axis=1)
+    out = jnp.stack([xe[:, k:k + t] for k in range(win)], axis=-1)
+    return {"Out": [out.astype(jnp.int64)]}
+
+
+@register_op("sequence_scatter", nondiff_inputs=("Ids",))
+def _sequence_scatter(ins, attrs, ctx):
+    """sequence_scatter_op.cc: scatter-add Updates rows into X at Ids along
+    the flattened batch-time axis."""
+    x = ins["X"][0]
+    ids = ins["Ids"][0].astype(jnp.int32).reshape(-1)
+    upd = ins["Updates"][0].reshape(ids.shape[0], -1)
+    flat = x.reshape(-1, x.shape[-1]) if x.ndim > 1 else x.reshape(-1, 1)
+    out = flat.at[ids].add(upd.astype(flat.dtype))
+    return {"Out": [out.reshape(x.shape)]}

@@ -1,0 +1,405 @@
+"""Tests for the CTR, quantization, RNN and NLP op families (numpy
+references, reference semantics per SURVEY §A.1)."""
+import numpy as np
+import pytest
+
+from op_test import run_op, check_output, check_grad
+
+
+class TestCTR:
+    def test_cvm_use_cvm(self, rng):
+        x = rng.rand(4, 6).astype("float32") + 0.5
+        out = np.asarray(run_op("cvm", {"X": x}, {"use_cvm": True})["Y"][0])
+        c0 = np.log(x[:, 0] + 1)
+        np.testing.assert_allclose(out[:, 0], c0, rtol=1e-5)
+        np.testing.assert_allclose(out[:, 1], np.log(x[:, 1] + 1) - c0,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[:, 2:], x[:, 2:], rtol=1e-6)
+
+    def test_cvm_no_cvm_drops_stats(self, rng):
+        x = rng.rand(3, 5).astype("float32")
+        out = np.asarray(run_op("cvm", {"X": x}, {"use_cvm": False})["Y"][0])
+        assert out.shape == (3, 3)
+        np.testing.assert_allclose(out, x[:, 2:], rtol=1e-6)
+
+    def test_fused_seqpool_cvm(self, rng):
+        x = rng.rand(2, 4, 5).astype("float32")
+        length = np.array([2, 3], "int32")
+        outs = run_op("fused_seqpool_cvm", {"X": [x], "Length": length},
+                      {"use_cvm": False})["Out"]
+        pooled = np.stack([x[0, :2].sum(0), x[1, :3].sum(0)])
+        np.testing.assert_allclose(np.asarray(outs[0]), pooled[:, 2:],
+                                   rtol=1e-5)
+
+    def test_batch_fc(self, rng):
+        x = rng.rand(3, 4, 5).astype("float32")
+        w = rng.rand(3, 5, 2).astype("float32")
+        b = rng.rand(3, 2).astype("float32")
+        out = np.asarray(run_op("batch_fc",
+                                {"Input": x, "W": w, "Bias": b})["Out"][0])
+        ref = np.maximum(np.einsum("sni,sio->sno", x, w) + b[:, None], 0)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_rank_attention_shapes(self, rng):
+        n, x_dim, max_rank, para_col = 5, 6, 3, 4
+        x = rng.rand(n, x_dim).astype("float32")
+        param = rng.rand(8, x_dim * para_col).astype("float32")
+        ro = np.zeros((n, 1 + 2 * max_rank), "int32")
+        ro[:, 0] = 1                      # ins rank present
+        ro[:, 1] = 1; ro[:, 2] = rng.randint(0, 8, n)   # one valid pair
+        ro[:, 3::2] = -1                  # others absent
+        out = np.asarray(run_op("rank_attention",
+                                {"X": x, "RankOffset": ro,
+                                 "RankParam": param},
+                                {"MaxRank": max_rank})["Out"][0])
+        assert out.shape == (n, para_col)
+        blocks = param.reshape(8, x_dim, para_col)
+        ref = np.stack([x[i] @ blocks[ro[i, 2]] for i in range(n)])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_filter_by_instag(self, rng):
+        rows = rng.rand(4, 3).astype("float32")
+        tags = np.array([[1, -1], [2, 3], [7, -1], [3, -1]], "int64")
+        filt = np.array([3, 7], "int64")
+        outs = run_op("filter_by_instag",
+                      {"Ins": rows, "Ins_tag": tags, "Filter_tag": filt})
+        w = np.asarray(outs["LossWeight"][0]).ravel()
+        np.testing.assert_array_equal(w, [0, 1, 1, 1])
+        np.testing.assert_allclose(np.asarray(outs["Out"][0])[0], 0.0)
+
+    def test_hash_deterministic(self):
+        x = np.array([[1], [2], [3]], "int64")
+        o1 = np.asarray(run_op("hash", {"X": x},
+                               {"num_hash": 2, "mod_by": 1000})["Out"][0])
+        o2 = np.asarray(run_op("hash", {"X": x},
+                               {"num_hash": 2, "mod_by": 1000})["Out"][0])
+        np.testing.assert_array_equal(o1, o2)
+        assert o1.min() >= 0 and o1.max() < 1000
+
+    def test_tdm_child(self):
+        # tree: node i children at cols 3,4
+        tree = np.array([[0, 0, 0, 0, 0],
+                         [1, 0, 0, 2, 3],
+                         [2, 1, 1, 4, 0],
+                         [3, 1, 1, 0, 0],
+                         [4, 2, 2, 0, 0]], "int64")
+        x = np.array([[1], [2]], "int64")
+        outs = run_op("tdm_child", {"X": x, "TreeInfo": tree},
+                      {"child_nums": 2})
+        np.testing.assert_array_equal(np.asarray(outs["Child"][0])[0, 0],
+                                      [2, 3])
+
+    def test_pull_box_sparse(self, rng):
+        w = rng.rand(10, 4).astype("float32")
+        ids = np.array([1, 3, 5], "int64")
+        out = np.asarray(run_op("pull_box_sparse",
+                                {"W": w, "Ids": [ids]})["Out"][0])
+        np.testing.assert_allclose(out, w[[1, 3, 5]])
+
+    def test_merge_ids(self, rng):
+        ids = np.array([0, 1, 2, 3], "int64")
+        # shard = id % 2: shard0 gets 0,2; shard1 gets 1,3
+        p0 = np.array([[0.], [2.]], "float32")
+        p1 = np.array([[1.], [3.]], "float32")
+        out = np.asarray(run_op("merge_ids",
+                                {"Ids": ids, "X": [p0, p1]})["Out"][0])
+        np.testing.assert_allclose(out.ravel(), [0, 1, 2, 3])
+
+
+class TestQuant:
+    def test_fake_quantize_abs_max(self, rng):
+        x = (rng.rand(4, 5).astype("float32") - 0.5) * 8
+        outs = run_op("fake_quantize_abs_max", {"X": x}, {"bit_length": 8})
+        scale = np.abs(x).max()
+        ref = np.round(np.clip(x / scale, -1, 1) * 127)
+        np.testing.assert_allclose(np.asarray(outs["Out"][0]), ref)
+        np.testing.assert_allclose(np.asarray(outs["OutScale"][0]), [scale],
+                                   rtol=1e-6)
+
+    def test_fake_qdq_roundtrip_close(self, rng):
+        x = (rng.rand(6, 6).astype("float32") - 0.5) * 2
+        out = np.asarray(run_op("fake_quantize_dequantize_abs_max",
+                                {"X": x}, {"bit_length": 8})["Out"][0])
+        assert np.abs(out - x).max() < np.abs(x).max() / 100
+
+    def test_channel_wise(self, rng):
+        x = (rng.rand(3, 4).astype("float32") - 0.5) * 4
+        outs = run_op("fake_channel_wise_quantize_abs_max", {"X": x},
+                      {"bit_length": 8, "quant_axis": 0})
+        scales = np.abs(x).max(axis=1)
+        np.testing.assert_allclose(np.asarray(outs["OutScale"][0]), scales,
+                                   rtol=1e-6)
+
+    def test_straight_through_grad(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.registry import get_op, LoweringContext
+        opdef = get_op("fake_quantize_dequantize_abs_max")
+        ctx = LoweringContext(base_key=jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.rand(3, 3).astype("float32"))
+        g = opdef.custom_grad({"X": [x]}, {}, {"Out": jnp.ones((3, 3))},
+                              {}, ctx)
+        np.testing.assert_allclose(np.asarray(g["X"][0]), np.ones((3, 3)))
+
+    def test_dequantize_max_abs(self):
+        x = np.array([[127, -127], [64, 0]], "float32")
+        out = np.asarray(run_op("fake_dequantize_max_abs",
+                                {"X": x, "Scale": np.array([2.0], "float32")},
+                                {"max_range": 127.0})["Out"][0])
+        np.testing.assert_allclose(out, x * 2.0 / 127.0, rtol=1e-6)
+
+
+class TestRNN:
+    def test_gru_unit_matches_manual(self, rng):
+        b, h = 2, 3
+        x = rng.rand(b, 3 * h).astype("float32")
+        hp = rng.rand(b, h).astype("float32")
+        w = rng.rand(h, 3 * h).astype("float32")
+        out = np.asarray(run_op("gru_unit",
+                                {"Input": x, "HiddenPrev": hp, "Weight": w},
+                                {"origin_mode": False})["Hidden"][0])
+
+        def sig(a): return 1 / (1 + np.exp(-a))
+        ur = sig(x[:, :2 * h] + hp @ w[:, :2 * h])
+        u, r = ur[:, :h], ur[:, h:]
+        c = np.tanh(x[:, 2 * h:] + (r * hp) @ w[:, 2 * h:])
+        ref = (1 - u) * hp + u * c
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_lstm_shapes_and_final(self, rng):
+        b, t, h = 2, 5, 4
+        x = rng.rand(b, t, 4 * h).astype("float32")
+        w = rng.rand(h, 4 * h).astype("float32") * 0.1
+        outs = run_op("lstm", {"Input": x, "Weight": w}, {})
+        assert np.asarray(outs["Hidden"][0]).shape == (b, t, h)
+        assert np.isfinite(np.asarray(outs["Hidden"][0])).all()
+
+    def test_gru_reverse(self, rng):
+        b, t, h = 2, 4, 3
+        x = rng.rand(b, t, 3 * h).astype("float32")
+        w = rng.rand(h, 3 * h).astype("float32") * 0.1
+        fwd = np.asarray(run_op("gru", {"Input": x, "Weight": w},
+                                {})["Hidden"][0])
+        rev = np.asarray(run_op("gru", {"Input": x[:, ::-1].copy(),
+                                        "Weight": w},
+                                {"is_reverse": True})["Hidden"][0])
+        np.testing.assert_allclose(fwd, rev[:, ::-1], rtol=1e-4, atol=1e-5)
+
+    def test_cudnn_lstm_layout(self, rng):
+        t, b, d, h = 4, 2, 3, 3
+        x = rng.rand(t, b, d).astype("float32")
+        n_w = 4 * h * d + 4 * h * h + 8 * h
+        w = (rng.rand(n_w).astype("float32") - 0.5) * 0.2
+        outs = run_op("cudnn_lstm", {"Input": x, "W": w},
+                      {"num_layers": 1, "hidden_size": h})
+        assert np.asarray(outs["Out"][0]).shape == (t, b, h)
+        assert np.asarray(outs["LastH"][0]).shape == (1, b, h)
+
+    def test_row_conv(self, rng):
+        x = rng.rand(2, 5, 3).astype("float32")
+        f = rng.rand(2, 3).astype("float32")
+        out = np.asarray(run_op("row_conv", {"X": x, "Filter": f})["Out"][0])
+        ref = np.zeros_like(x)
+        for k in range(2):
+            ref[:, :5 - k] += x[:, k:] * f[k]
+        # row_conv pads future with zeros
+        np.testing.assert_allclose(out, ref + 0.0, rtol=1e-4, atol=1e-5)
+
+    def test_conv_shift(self, rng):
+        x = rng.rand(2, 6).astype("float32")
+        y = rng.rand(2, 3).astype("float32")
+        out = np.asarray(run_op("conv_shift", {"X": x, "Y": y})["Out"][0])
+        ref = np.zeros_like(x)
+        for i in range(6):
+            for k in range(3):
+                ref[:, i] += x[:, (i + k - 1) % 6] * y[:, k]
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestCRFCTC:
+    def _brute_crf(self, em, trans, lbl):
+        """enumerate all paths for log-partition."""
+        import itertools
+        t, d = em.shape
+        start, stop, tr = trans[0], trans[1], trans[2:]
+        scores = []
+        for path in itertools.product(range(d), repeat=t):
+            s = start[path[0]] + em[0, path[0]]
+            for i in range(1, t):
+                s += tr[path[i - 1], path[i]] + em[i, path[i]]
+            s += stop[path[-1]]
+            scores.append(s)
+        m = max(scores)
+        logz = m + np.log(sum(np.exp(np.array(scores) - m)))
+        s = start[lbl[0]] + em[0, lbl[0]]
+        for i in range(1, t):
+            s += tr[lbl[i - 1], lbl[i]] + em[i, lbl[i]]
+        s += stop[lbl[-1]]
+        return logz - s
+
+    def test_linear_chain_crf_vs_bruteforce(self, rng):
+        t, d = 3, 3
+        em = rng.rand(1, t, d).astype("float32")
+        trans = rng.rand(d + 2, d).astype("float32")
+        lbl = np.array([[0, 2, 1]], "int64")
+        out = np.asarray(run_op("linear_chain_crf",
+                                {"Emission": em, "Transition": trans,
+                                 "Label": lbl}, {})["LogLikelihood"][0])
+        ref = self._brute_crf(em[0], trans, lbl[0])
+        np.testing.assert_allclose(out.ravel()[0], ref, rtol=1e-4)
+
+    def test_crf_decoding_matches_bruteforce(self, rng):
+        import itertools
+        t, d = 4, 3
+        em = rng.rand(1, t, d).astype("float32")
+        trans = rng.rand(d + 2, d).astype("float32")
+        path = np.asarray(run_op("crf_decoding",
+                                 {"Emission": em, "Transition": trans},
+                                 {})["ViterbiPath"][0])[0]
+        best, best_s = None, -1e30
+        start, stop, tr = trans[0], trans[1], trans[2:]
+        for p in itertools.product(range(d), repeat=t):
+            s = start[p[0]] + em[0, 0, p[0]]
+            for i in range(1, t):
+                s += tr[p[i - 1], p[i]] + em[0, i, p[i]]
+            s += stop[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(path, best)
+
+    def test_ctc_loss_single_token(self):
+        # T=2, C=2 (blank=0, token 1), label = [1]
+        logits = np.log(np.array([[[0.6, 0.4], [0.3, 0.7]]], "float32"))
+        out = np.asarray(run_op(
+            "warpctc", {"Logits": logits, "Label": np.array([[1]], "int64")},
+            {"blank": 0})["Loss"][0])
+        # valid paths: (1,1), (0,1), (1,0)
+        p = 0.4 * 0.7 + 0.6 * 0.7 + 0.4 * 0.3
+        np.testing.assert_allclose(out.ravel()[0], -np.log(p), rtol=1e-4)
+
+    def test_ctc_align(self):
+        x = np.array([[1, 1, 0, 2, 2, 0, 3]], "int32")
+        outs = run_op("ctc_align", {"Input": x}, {"blank": 0})
+        np.testing.assert_array_equal(np.asarray(outs["Output"][0])[0, :3],
+                                      [1, 2, 3])
+
+    def test_edit_distance(self):
+        hyp = np.array([[1, 2, 3]], "int64")
+        ref = np.array([[1, 3, 3]], "int64")
+        out = np.asarray(run_op("edit_distance", {"Hyps": hyp, "Refs": ref},
+                                {"normalized": False})["Out"][0])
+        np.testing.assert_allclose(out.ravel(), [1.0])
+
+    def test_edit_distance_insert_delete(self):
+        hyp = np.array([[1, 2, 0, 0]], "int64")
+        ref = np.array([[1, 2, 3, 0]], "int64")
+        out = np.asarray(run_op(
+            "edit_distance",
+            {"Hyps": hyp, "Refs": ref,
+             "HypsLength": np.array([2], "int64"),
+             "RefsLength": np.array([3], "int64")},
+            {"normalized": False})["Out"][0])
+        np.testing.assert_allclose(out.ravel(), [1.0])
+
+
+class TestBeam:
+    def test_gather_tree(self):
+        ids = np.array([[[2, 5]], [[3, 6]], [[4, 7]]], "int64")  # T,B,beam
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], "int64")
+        out = np.asarray(run_op("gather_tree",
+                                {"Ids": ids, "Parents": parents})["Out"][0])
+        # beam0 at t2: token 4, parent 0 -> t1 token... backtrace semantics
+        assert out.shape == (3, 1, 2)
+
+    def test_beam_search_topk(self):
+        beam, v = 2, 4
+        pre_ids = np.array([[0], [0]], "int64")
+        pre_scores = np.array([[0.0], [0.0]], "float32")
+        scores = np.array([[0.1, 0.7, 0.1, 0.1],
+                           [0.2, 0.2, 0.5, 0.1]], "float32")
+        outs = run_op("beam_search",
+                      {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                       "ids": np.zeros((2, 4), "int64"), "scores": scores},
+                      {"beam_size": beam, "end_id": -1,
+                       "is_accumulated": True})
+        sel = np.asarray(outs["selected_ids"][0]).ravel()
+        assert 1 in sel and 2 in sel
+
+
+class TestSampledLosses:
+    def test_nce_shapes(self, rng):
+        x = rng.rand(4, 8).astype("float32")
+        w = rng.rand(20, 8).astype("float32")
+        lbl = rng.randint(0, 20, (4, 1)).astype("int64")
+        outs = run_op("nce", {"Input": x, "Weight": w, "Label": lbl},
+                      {"num_neg_samples": 5, "num_total_classes": 20})
+        assert np.asarray(outs["Cost"][0]).shape == (4, 1)
+        assert np.isfinite(np.asarray(outs["Cost"][0])).all()
+
+    def test_hsigmoid_finite(self, rng):
+        x = rng.rand(3, 6).astype("float32")
+        w = rng.rand(9, 6).astype("float32")
+        lbl = np.array([0, 4, 9], "int64")
+        outs = run_op("hierarchical_sigmoid", {"X": x, "W": w, "Label": lbl},
+                      {"num_classes": 10})
+        cost = np.asarray(outs["Out"][0])
+        assert cost.shape == (3, 1) and (cost > 0).all()
+
+    def test_sample_logits(self, rng):
+        logits = rng.rand(3, 10).astype("float32")
+        lbl = rng.randint(0, 10, (3, 1)).astype("int64")
+        outs = run_op("sample_logits", {"Logits": logits, "Labels": lbl},
+                      {"num_samples": 4})
+        assert np.asarray(outs["SampledLogits"][0]).shape == (3, 5)
+
+
+class TestTextMatch:
+    def test_match_matrix_tensor(self, rng):
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(2, 5, 4).astype("float32")
+        w = rng.rand(4, 2, 4).astype("float32")
+        out = np.asarray(run_op("match_matrix_tensor",
+                                {"X": x, "Y": y, "W": w})["Out"][0])
+        assert out.shape == (2, 2, 3, 5)
+        ref = np.einsum("bxd,dte,bye->btxy", x, w, y)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_im2sequence(self, rng):
+        x = rng.rand(1, 1, 4, 4).astype("float32")
+        out = np.asarray(run_op("im2sequence", {"X": x},
+                                {"kernels": [2, 2], "strides": [2, 2],
+                                 "paddings": [0, 0, 0, 0]})["Out"][0])
+        assert out.shape == (4, 4)
+        np.testing.assert_allclose(out[0], x[0, 0, :2, :2].ravel(), rtol=1e-6)
+
+
+class TestAmpEagerBackward:
+    def test_grad_flows_through_black_op_cast(self, rng):
+        """Regression: AMP autocast casts (white->bf16, black->f32) create
+        out-of-tape VarBases; backward must route grads through the _src
+        chain or every weight upstream of a layer_norm gets zero grad."""
+        import paddle_tpu
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.nn import Linear, LayerNorm
+        from paddle_tpu.dygraph.base import to_variable
+        import paddle_tpu.fluid.layers as L
+
+        dybase.enable_dygraph()
+        tracer = dybase._dygraph_tracer()
+        old_amp = tracer._amp_enabled
+        tracer._amp_enabled = True
+        try:
+            l1 = Linear(4, 4)
+            ln = LayerNorm(4)
+            l2 = Linear(4, 2)
+            x = to_variable(rng.rand(3, 4).astype("float32"))
+            out = l2(ln(l1(x)))
+            loss = L.nn.mean(out)
+            loss.backward()
+            g = l1.weight.gradient()
+            assert g is not None
+            assert np.abs(np.asarray(g)).sum() > 0, \
+                "grad did not flow through the autocast boundary"
+        finally:
+            tracer._amp_enabled = old_amp
+            dybase.disable_dygraph()

@@ -1,0 +1,266 @@
+"""Tests for detection extras, misc ops, and sequence extras."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+class TestDetectionExtra:
+    def test_roi_pool_max(self, rng):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 3, 3]], "float32")
+        out = np.asarray(run_op("roi_pool", {"X": x, "ROIs": rois},
+                                {"pooled_height": 2, "pooled_width": 2,
+                                 "spatial_scale": 1.0})["Out"][0])
+        np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+    def test_anchor_generator(self, rng):
+        x = np.zeros((1, 8, 2, 2), "float32")
+        outs = run_op("anchor_generator", {"Input": x},
+                      {"anchor_sizes": [64.0], "aspect_ratios": [1.0],
+                       "stride": [16.0, 16.0], "offset": 0.5})
+        a = np.asarray(outs["Anchors"][0])
+        assert a.shape == (2, 2, 1, 4)
+        np.testing.assert_allclose(a[0, 0, 0], [8 - 32, 8 - 32, 8 + 32,
+                                                8 + 32])
+
+    def test_bipartite_match(self):
+        dist = np.array([[[0.9, 0.1], [0.2, 0.8]]], "float32")
+        outs = run_op("bipartite_match", {"DistMat": dist}, {})
+        m = np.asarray(outs["ColToRowMatchIndices"][0])[0]
+        np.testing.assert_array_equal(m, [0, 1])
+
+    def test_target_assign(self):
+        x = np.array([[[1., 2.], [3., 4.]]], "float32")
+        match = np.array([[1, -1, 0]], "int32")
+        outs = run_op("target_assign", {"X": x, "MatchIndices": match},
+                      {"mismatch_value": 0.0})
+        out = np.asarray(outs["Out"][0])[0]
+        np.testing.assert_allclose(out, [[3, 4], [0, 0], [1, 2]])
+        np.testing.assert_allclose(
+            np.asarray(outs["OutWeight"][0])[0].ravel(), [1, 0, 1])
+
+    def test_sigmoid_focal_loss_reduces_easy(self, rng):
+        x = np.array([[5.0], [0.0]], "float32")   # class-1 logits
+        lbl = np.array([[1], [1]], "int64")
+        out = np.asarray(run_op("sigmoid_focal_loss",
+                                {"X": x, "Label": lbl,
+                                 "FgNum": np.array([1], "int32")},
+                                {"gamma": 2.0, "alpha": 0.25})["Out"][0])
+        assert out[0, 0] < out[1, 0]   # confident positive -> smaller loss
+
+    def test_rpn_target_assign(self):
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                            [100, 100, 110, 110]], "float32")
+        gt = np.array([[0, 0, 10, 10]], "float32")
+        outs = run_op("rpn_target_assign",
+                      {"Anchor": anchors, "GtBoxes": gt}, {})
+        lbl = np.asarray(outs["TargetLabel"][0])
+        assert lbl[0] == 1 and lbl[2] == 0
+
+    def test_affine_grid_identity(self):
+        theta = np.array([[[1., 0., 0.], [0., 1., 0.]]], "float32")
+        out = np.asarray(run_op("affine_grid", {"Theta": theta},
+                                {"output_shape": [1, 1, 2, 2]})["Output"][0])
+        np.testing.assert_allclose(out[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(out[0, 1, 1], [1, 1], atol=1e-6)
+
+    def test_deformable_conv_zero_offset_matches_conv(self, rng):
+        import jax
+        x = rng.rand(1, 2, 5, 5).astype("float32")
+        w = rng.rand(3, 2, 3, 3).astype("float32")
+        off = np.zeros((1, 18, 5, 5), "float32")
+        out = np.asarray(run_op("deformable_conv",
+                                {"Input": x, "Offset": off, "Filter": w},
+                                {"strides": [1, 1], "paddings": [1, 1]}
+                                )["Output"][0])
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestMiscOps:
+    def test_adamax_step(self, rng):
+        p = rng.rand(4).astype("float32")
+        g = rng.rand(4).astype("float32")
+        outs = run_op("adamax", {
+            "Param": p, "Grad": g, "Moment": np.zeros(4, "float32"),
+            "InfNorm": np.zeros(4, "float32"),
+            "LearningRate": np.array([0.1], "float32"),
+            "Beta1Pow": np.array([0.9], "float32")}, {})
+        m = np.asarray(outs["MomentOut"][0])
+        np.testing.assert_allclose(m, 0.1 * g, rtol=1e-5)
+
+    def test_bilinear_tensor_product(self, rng):
+        x = rng.rand(2, 3).astype("float32")
+        y = rng.rand(2, 4).astype("float32")
+        w = rng.rand(5, 3, 4).astype("float32")
+        out = np.asarray(run_op("bilinear_tensor_product",
+                                {"X": x, "Y": y, "Weight": w})["Out"][0])
+        ref = np.einsum("bi,kij,bj->bk", x, w, y)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_multiplex(self, rng):
+        a = np.array([[1., 1.], [2., 2.]], "float32")
+        b = np.array([[3., 3.], [4., 4.]], "float32")
+        ids = np.array([[1], [0]], "int32")
+        out = np.asarray(run_op("multiplex",
+                                {"X": [a, b], "Ids": ids})["Out"][0])
+        np.testing.assert_allclose(out, [[3, 3], [2, 2]])
+
+    def test_modified_huber(self):
+        x = np.array([[2.0], [0.5], [-2.0]], "float32")
+        y = np.array([[1.0], [1.0], [1.0]], "float32")
+        out = np.asarray(run_op("modified_huber_loss",
+                                {"X": x, "Y": y})["Out"][0])
+        np.testing.assert_allclose(out.ravel(), [0.0, 0.25, 8.0], atol=1e-6)
+
+    def test_partial_concat(self, rng):
+        a = rng.rand(2, 4).astype("float32")
+        b = rng.rand(2, 4).astype("float32")
+        out = np.asarray(run_op("partial_concat", {"X": [a, b]},
+                                {"start_index": 1, "length": 2})["Out"][0])
+        np.testing.assert_allclose(out, np.concatenate(
+            [a[:, 1:3], b[:, 1:3]], 1))
+
+    def test_pool3d_max(self, rng):
+        x = rng.rand(1, 1, 4, 4, 4).astype("float32")
+        out = np.asarray(run_op("pool3d", {"X": x},
+                                {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                                 "pooling_type": "max"})["Out"][0])
+        assert out.shape == (1, 1, 2, 2, 2)
+        np.testing.assert_allclose(out[0, 0, 0, 0, 0], x[0, 0, :2, :2, :2]
+                                   .max())
+
+    def test_shuffle_channel(self):
+        x = np.arange(8, dtype="float32").reshape(1, 4, 1, 2)
+        out = np.asarray(run_op("shuffle_channel", {"X": x},
+                                {"group": 2})["Out"][0])
+        np.testing.assert_allclose(out[0, :, 0, 0], [0, 4, 2, 6])
+
+    def test_spectral_norm_unit_sigma(self, rng):
+        w = rng.rand(3, 3).astype("float32")
+        u = rng.rand(3).astype("float32")
+        v = rng.rand(3).astype("float32")
+        out = np.asarray(run_op("spectral_norm",
+                                {"Weight": w, "U": u, "V": v},
+                                {"power_iters": 20, "dim": 0})["Out"][0])
+        s = np.linalg.svd(out, compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_center_loss(self, rng):
+        x = rng.rand(2, 3).astype("float32")
+        centers = np.zeros((5, 3), "float32")
+        lbl = np.array([1, 1], "int64")
+        outs = run_op("center_loss",
+                      {"X": x, "Label": lbl, "Centers": centers,
+                       "CenterUpdateRate": np.array([0.5], "float32")}, {})
+        loss = np.asarray(outs["Loss"][0])
+        np.testing.assert_allclose(loss.ravel(),
+                                   0.5 * (x ** 2).sum(1), rtol=1e-5)
+
+    def test_bpr_loss(self, rng):
+        x = rng.rand(2, 3).astype("float32")
+        lbl = np.array([[0], [2]], "int64")
+        out = np.asarray(run_op("bpr_loss", {"X": x, "Label": lbl})["Y"][0])
+        def sig(v): return 1 / (1 + np.exp(-v))
+        ref0 = -np.mean([np.log(sig(x[0, 0] - x[0, j]) + 1e-8)
+                         for j in range(3)])
+        np.testing.assert_allclose(out[0, 0], ref0, rtol=1e-4)
+
+    def test_unique(self):
+        x = np.array([3, 1, 3, 2, 1], "int64")
+        outs = run_op("unique", {"X": x}, {})
+        cnt = int(np.asarray(outs["UniqueCount"][0])[0])
+        assert cnt == 3
+        uniq = np.asarray(outs["Out"][0])[:cnt]
+        np.testing.assert_array_equal(sorted(uniq), [1, 2, 3])
+        inv = np.asarray(outs["Index"][0])
+        full = np.asarray(outs["Out"][0])
+        np.testing.assert_array_equal(full[inv], x)
+
+    def test_scatter_nd(self):
+        idx = np.array([[1], [3]], "int32")
+        upd = np.array([9., 10.], "float32")
+        out = np.asarray(run_op("scatter_nd",
+                                {"Index": idx, "Updates": upd},
+                                {"shape": [5]})["Out"][0])
+        np.testing.assert_allclose(out, [0, 9, 0, 10, 0])
+
+    def test_positive_negative_pair(self):
+        score = np.array([[0.9], [0.1], [0.8]], "float32")
+        label = np.array([[1.], [0.], [0.]], "float32")
+        qid = np.array([[0], [0], [0]], "int32")
+        outs = run_op("positive_negative_pair",
+                      {"Score": score, "Label": label, "QueryID": qid}, {})
+        # pairs with differing labels: (0,1) and (0,2), both score-ordered
+        # consistently with the label order -> 2 positive, 0 negative
+        assert float(np.asarray(outs["PositivePair"][0])[0, 0]) == 2.0
+        assert float(np.asarray(outs["NegativePair"][0])[0, 0]) == 0.0
+
+    def test_fused_emb_ln(self, rng):
+        ids = np.array([[1, 2]], "int64")
+        emb = rng.rand(5, 4).astype("float32")
+        scale = np.ones(4, "float32")
+        bias = np.zeros(4, "float32")
+        out = np.asarray(run_op(
+            "fused_embedding_eltwise_layernorm",
+            {"Ids": [ids], "Embs": [emb], "Scale": scale, "Bias": bias},
+            {})["Out"][0])
+        v = emb[[1, 2]]
+        ref = (v - v.mean(-1, keepdims=True)) / np.sqrt(
+            v.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out[0], ref, rtol=1e-4, atol=1e-5)
+
+
+class TestSequenceExtra:
+    def test_sequence_conv_identity_window(self, rng):
+        x = rng.rand(2, 4, 3).astype("float32")
+        filt = np.eye(3, dtype="float32")       # ctx len 1, start 0
+        out = np.asarray(run_op("sequence_conv", {"X": x, "Filter": filt},
+                                {"contextStart": 0, "contextLength": 1}
+                                )["Out"][0])
+        np.testing.assert_allclose(out, x, rtol=1e-5)
+
+    def test_sequence_pad_trim(self, rng):
+        x = rng.rand(2, 3, 2).astype("float32")
+        outs = run_op("sequence_pad",
+                      {"X": x, "PadValue": np.array([0.0], "float32"),
+                       "Length": np.array([1, 2], "int64")},
+                      {"padded_length": 3})
+        out = np.asarray(outs["Out"][0])
+        np.testing.assert_allclose(out[0, 1:], 0.0)
+        np.testing.assert_allclose(out[1, 2:], 0.0)
+        np.testing.assert_allclose(out[1, :2], x[1, :2])
+
+    def test_sequence_slice(self, rng):
+        x = np.arange(12, dtype="float32").reshape(1, 6, 2)
+        outs = run_op("sequence_slice",
+                      {"X": x, "Offset": np.array([2], "int64"),
+                       "Length": np.array([3], "int64")}, {})
+        out = np.asarray(outs["Out"][0])
+        np.testing.assert_allclose(out[0, :3], x[0, 2:5])
+        np.testing.assert_allclose(out[0, 3:], 0.0)
+
+    def test_sequence_erase(self):
+        x = np.array([[1, 5, 2, 5, 3]], "int64")
+        outs = run_op("sequence_erase", {"X": x}, {"tokens": [5]})
+        out = np.asarray(outs["Out"][0])
+        np.testing.assert_array_equal(out[0, :3], [1, 2, 3])
+        assert int(np.asarray(outs["Length"][0])[0]) == 3
+
+    def test_sequence_enumerate(self):
+        x = np.array([[1, 2, 3]], "int64")
+        out = np.asarray(run_op("sequence_enumerate", {"X": x},
+                                {"win_size": 2, "pad_value": 0})["Out"][0])
+        np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 0]])
+
+    def test_sequence_expand_as(self, rng):
+        x = rng.rand(2, 3).astype("float32")
+        y = rng.rand(2, 4, 5).astype("float32")
+        out = np.asarray(run_op("sequence_expand_as",
+                                {"X": x, "Y": y})["Out"][0])
+        assert out.shape == (2, 4, 3)
+        np.testing.assert_allclose(out[:, 0], x)
