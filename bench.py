@@ -53,11 +53,15 @@ def build_train_step(vocab, hidden, layers, heads, ffn, seq, batch, lr=1e-4):
     jgrad = jax.jit(grad_step)
     jupdate = jax.jit(fused_update, donate_argnums=(0, 1))
     jparams = jax.jit(fused_update.params_of)
+    cache = {"params": None}      # jupdate already returns fresh params —
+                                  # reuse them instead of re-unflattening
 
     def jstep(state, input_ids, mlm_labels, nsp_labels):
-        params = jparams(state)
+        params = cache["params"]
+        if params is None:
+            params = jparams(state)
         loss, grads = jgrad(params, input_ids, mlm_labels, nsp_labels)
-        state, _ = jupdate(state, grads)
+        state, cache["params"] = jupdate(state, grads)
         return state, loss
 
     n_params = sum(int(np.prod(p.shape)) for p in param_values)

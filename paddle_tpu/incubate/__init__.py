@@ -1,0 +1,3 @@
+"""paddle.fluid.incubate analog: auto-checkpoint, fleet utils (fs/hdfs)."""
+from . import checkpoint
+from . import fleet

@@ -1,0 +1,1 @@
+from .fs import FS, LocalFS, HDFSClient, ExecuteError

@@ -1,4 +1,4 @@
 """paddle.metric 2.0 (reference python/paddle/metric/)."""
-from ..fluid.metrics import Accuracy, Auc, CompositeMetric
-from ..fluid.metrics import MetricBase as Metric
+from .metrics import Metric, Accuracy, Precision, Recall, Auc
+from ..fluid.metrics import CompositeMetric
 from ..fluid.layers.metric_op import accuracy, auc

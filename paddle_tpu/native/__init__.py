@@ -19,6 +19,7 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "data_feed.cc")
+_SRCS = [_SRC, os.path.join(_HERE, "src", "memory.cc")]
 _LIB_PATH = os.path.join(_HERE, "libptnative.so")
 _lib = None
 _lib_lock = threading.Lock()
@@ -31,15 +32,14 @@ def _build() -> Optional[str]:
     concurrent process never dlopens a half-written .so (rename is atomic on
     POSIX)."""
     try:
+        deps = _SRCS + [os.path.join(_HERE, "src", "channel.h")]
         if (os.path.exists(_LIB_PATH)
                 and os.path.getmtime(_LIB_PATH) >= max(
-                    os.path.getmtime(_SRC),
-                    os.path.getmtime(os.path.join(_HERE, "src",
-                                                  "channel.h")))):
+                    os.path.getmtime(d) for d in deps)):
             return _LIB_PATH
         tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-               "-o", tmp, _SRC]
+               "-o", tmp] + _SRCS
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=300)
             os.replace(tmp, _LIB_PATH)
@@ -94,6 +94,17 @@ def _load():
         lib.pt_feed_memory_size.restype = ctypes.c_int64
         lib.pt_feed_memory_size.argtypes = [ctypes.c_void_p]
         lib.pt_feed_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_feed_global_shuffle.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_uint64]
+        lib.pt_arena_create.restype = ctypes.c_void_p
+        lib.pt_arena_create.argtypes = [ctypes.c_int64]
+        lib.pt_arena_alloc.restype = ctypes.c_void_p
+        lib.pt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pt_arena_free.restype = ctypes.c_int
+        lib.pt_arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.pt_arena_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_int64)] * 3
+        lib.pt_arena_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -283,6 +294,83 @@ class PyDataFeed:
             yield b
 
 
+def global_shuffle(feeds, seed=0):
+    """GlobalShuffle across a list of feeds (data_set.h:118 analog): records
+    are re-routed to feed hash(ids) % n then shuffled locally.  Works for
+    native feeds in one call; Python feeds are shuffled with the same
+    routing in numpy."""
+    natives = [f for f in feeds if isinstance(f, NativeDataFeed)]
+    if len(natives) == len(feeds) and natives:
+        arr = (ctypes.c_void_p * len(feeds))(
+            *[f._h for f in feeds])
+        natives[0]._lib.pt_feed_global_shuffle(arr, len(feeds), seed)
+        return
+    # python fallback: same content-hash routing
+    pools = [f._pool for f in feeds]
+    dest = [[] for _ in feeds]
+    for pool in pools:
+        for rec in pool:
+            h = 1469598103934665603
+            for slot in rec[0]:
+                for v in slot:
+                    h = ((h ^ hash(int(v))) * 1099511628211) & ((1 << 64) - 1)
+            dest[h % len(feeds)].append(rec)
+    for f, d in zip(feeds, dest):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(d)
+        f._pool = d
+
+
+class _ArenaView(np.ndarray):
+    """ndarray view that pins its owning Arena (prevents use-after-free)."""
+    _arena = None
+
+
+class Arena:
+    """Host staging arena (auto_growth_best_fit_allocator.cc analog)."""
+
+    def __init__(self, chunk_size=64 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.pt_arena_create(chunk_size)
+
+    def alloc(self, size) -> int:
+        p = self._lib.pt_arena_alloc(self._h, int(size))
+        if not p:
+            raise MemoryError(f"arena alloc of {size} failed")
+        return p
+
+    def free(self, ptr) -> bool:
+        return bool(self._lib.pt_arena_free(self._h, ptr))
+
+    def buffer(self, size):
+        """numpy uint8 view over a fresh allocation (zero-copy staging).
+        The view keeps the Arena alive (ndarray subclass holds a ref), so
+        dropping the Arena while views exist cannot scribble freed memory;
+        the caller must still not use the view after free(ptr)."""
+        p = self.alloc(size)
+        arr = np.ctypeslib.as_array(
+            ctypes.cast(p, ctypes.POINTER(ctypes.c_uint8)),
+            (size,)).view(_ArenaView)
+        arr._arena = self
+        return p, arr
+
+    @property
+    def stats(self):
+        a, r, c = ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64()
+        self._lib.pt_arena_stats(self._h, ctypes.byref(a), ctypes.byref(r),
+                                 ctypes.byref(c))
+        return {"allocated": a.value, "reserved": r.value, "chunks": c.value}
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        lib = getattr(self, "_lib", None)
+        if h and lib is not None:
+            lib.pt_arena_destroy(h)
+
+
 def make_data_feed(slots, batch_size, num_threads=4):
     """Factory: native feed when the toolchain exists, Python otherwise."""
     if native_available():
@@ -291,4 +379,4 @@ def make_data_feed(slots, batch_size, num_threads=4):
 
 
 __all__ = ["SlotDesc", "NativeDataFeed", "PyDataFeed", "make_data_feed",
-           "native_available"]
+           "native_available", "global_shuffle", "Arena"]

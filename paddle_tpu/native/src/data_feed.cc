@@ -155,6 +155,7 @@ class DataFeed {
   const std::vector<SlotMeta>& slots() const { return slots_; }
   const std::vector<int>& sparse_idx() const { return sparse_idx_; }
   const std::vector<int>& dense_idx() const { return dense_idx_; }
+  std::vector<Record>* pool() { return &pool_; }
 
  private:
   void ReadLoop() {
@@ -356,6 +357,39 @@ const float* pt_feed_dense(void* hv, int slot, int64_t* len) {
 
 int64_t pt_feed_memory_size(void* hv) {
   return static_cast<FeedHandle*>(hv)->feed->MemorySize();
+}
+
+// GlobalShuffle (data_set.h:118 / data_set.cc): the reference shuffles
+// records ACROSS nodes through fleet RPC — each record is routed to node
+// hash(record) % n, then each node shuffles locally.  The in-process analog
+// redistributes the loaded pools of n feeds (the trainers) the same way:
+// deterministic content-hash routing + per-feed local shuffle.  Multi-host
+// deployments route the same hash over the fleet allgather channel instead.
+void pt_feed_global_shuffle(void** handles, int n, uint64_t seed) {
+  if (n <= 1) {
+    if (n == 1)
+      static_cast<FeedHandle*>(handles[0])->feed->LocalShuffle(seed);
+    return;
+  }
+  std::vector<std::vector<Record>*> pools;
+  pools.reserve(n);
+  for (int i = 0; i < n; ++i)
+    pools.push_back(static_cast<FeedHandle*>(handles[i])->feed->pool());
+  std::vector<std::vector<Record>> dest(n);
+  std::hash<uint64_t> h64;
+  for (auto* pool : pools) {
+    for (auto& r : *pool) {
+      uint64_t h = 1469598103934665603ull;  // FNV over sparse ids
+      for (const auto& slot : r.sparse)
+        for (uint64_t v : slot) h = (h ^ h64(v)) * 1099511628211ull;
+      dest[h % n].emplace_back(std::move(r));
+    }
+    pool->clear();
+  }
+  for (int i = 0; i < n; ++i) {
+    *pools[i] = std::move(dest[i]);
+    static_cast<FeedHandle*>(handles[i])->feed->LocalShuffle(seed + i);
+  }
 }
 
 void pt_feed_destroy(void* hv) {

@@ -1,0 +1,149 @@
+// Host staging arena allocator.
+//
+// Reference: paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.cc
+// (the default `auto_growth` strategy, SURVEY §2.2): allocations are served
+// best-fit from free blocks carved out of malloc'd chunks; freeing coalesces
+// with neighbours; the arena grows by chunk_size when nothing fits.  On TPU
+// XLA owns HBM, so this allocator's job is the HOST side of the pipeline —
+// staging batch buffers and PS-tier scratch that would otherwise churn
+// malloc (the CUDAPinnedAllocator/NaiveBestFit role).
+//
+// C ABI (ctypes surface): pt_arena_create/alloc/free/stats/destroy.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <set>
+#include <unordered_map>
+#include <utility>
+#include <vector>
+
+namespace ptnative {
+
+namespace {
+constexpr size_t kAlign = 64;  // cacheline; the AlignedAllocator role
+
+size_t AlignUp(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+}  // namespace
+
+class Arena {
+ public:
+  explicit Arena(size_t chunk_size) : chunk_size_(AlignUp(chunk_size)) {}
+
+  ~Arena() {
+    for (void* c : chunks_) std::free(c);
+  }
+
+  void* Alloc(size_t size) {
+    size = AlignUp(size ? size : 1);
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_by_size_.lower_bound({size, nullptr});
+    if (it == free_by_size_.end()) {
+      if (!Grow(size)) return nullptr;
+      it = free_by_size_.lower_bound({size, nullptr});
+      if (it == free_by_size_.end()) return nullptr;
+    }
+    char* base = it->second;
+    size_t block = it->first;
+    free_by_size_.erase(it);
+    free_by_addr_.erase(base);
+    if (block > size + kAlign) {  // split the tail back into the free list
+      InsertFree(base + size, block - size);
+      block = size;
+    }
+    busy_[base] = block;
+    allocated_ += block;
+    return base;
+  }
+
+  bool Free(void* p) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = busy_.find(static_cast<char*>(p));
+    if (it == busy_.end()) return false;
+    char* base = it->first;
+    size_t size = it->second;
+    busy_.erase(it);
+    allocated_ -= size;
+    // coalesce with the next free neighbour
+    auto nxt = free_by_addr_.find(base + size);
+    if (nxt != free_by_addr_.end()) {
+      size += nxt->second;
+      free_by_size_.erase({nxt->second, nxt->first});
+      free_by_addr_.erase(nxt);
+    }
+    // coalesce with the previous free neighbour
+    if (!free_by_addr_.empty()) {
+      auto prv = free_by_addr_.lower_bound(base);
+      if (prv != free_by_addr_.begin()) {
+        --prv;
+        if (prv->first + prv->second == base) {
+          base = prv->first;
+          size += prv->second;
+          free_by_size_.erase({prv->second, prv->first});
+          free_by_addr_.erase(prv);
+        }
+      }
+    }
+    InsertFree(base, size);
+    return true;
+  }
+
+  void Stats(int64_t* allocated, int64_t* reserved, int64_t* n_chunks) {
+    std::lock_guard<std::mutex> g(mu_);
+    *allocated = static_cast<int64_t>(allocated_);
+    *reserved = static_cast<int64_t>(reserved_);
+    *n_chunks = static_cast<int64_t>(chunks_.size());
+  }
+
+ private:
+  void InsertFree(char* base, size_t size) {
+    free_by_size_.insert({size, base});
+    free_by_addr_[base] = size;
+  }
+
+  bool Grow(size_t min_size) {
+    size_t sz = std::max(chunk_size_, AlignUp(min_size));
+    void* c = nullptr;
+    if (posix_memalign(&c, kAlign, sz) != 0) return false;
+    chunks_.push_back(c);
+    reserved_ += sz;
+    InsertFree(static_cast<char*>(c), sz);
+    return true;
+  }
+
+  size_t chunk_size_;
+  std::mutex mu_;
+  std::vector<void*> chunks_;
+  std::set<std::pair<size_t, char*>> free_by_size_;
+  std::map<char*, size_t> free_by_addr_;
+  std::unordered_map<char*, size_t> busy_;
+  size_t allocated_ = 0;
+  size_t reserved_ = 0;
+};
+
+extern "C" {
+
+void* pt_arena_create(int64_t chunk_size) {
+  return new Arena(static_cast<size_t>(chunk_size));
+}
+
+void* pt_arena_alloc(void* h, int64_t size) {
+  return static_cast<Arena*>(h)->Alloc(static_cast<size_t>(size));
+}
+
+int pt_arena_free(void* h, void* p) {
+  return static_cast<Arena*>(h)->Free(p) ? 1 : 0;
+}
+
+void pt_arena_stats(void* h, int64_t* allocated, int64_t* reserved,
+                    int64_t* n_chunks) {
+  static_cast<Arena*>(h)->Stats(allocated, reserved, n_chunks);
+}
+
+void pt_arena_destroy(void* h) { delete static_cast<Arena*>(h); }
+
+}  // extern "C"
+
+}  // namespace ptnative

@@ -168,7 +168,12 @@ def _filter_by_instag(ins, attrs, ctx):
 
 # --- hashing -----------------------------------------------------------------
 def _xxhash_like(x, mod, seed):
-    h = x.astype(jnp.uint32) * jnp.uint32(2654435761) + jnp.uint32(seed)
+    # mix the high word first so full 64-bit ids keep their entropy
+    xu = x.astype(jnp.uint64)
+    lo = (xu & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (xu >> jnp.uint64(32)).astype(jnp.uint32)
+    h = (lo ^ (hi * jnp.uint32(2246822519))) * jnp.uint32(2654435761) \
+        + jnp.uint32(seed)
     h = h ^ (h >> 16)
     h = h * jnp.uint32(2246822519)
     h = h ^ (h >> 13)

@@ -170,6 +170,9 @@ def _warpctc(ins, attrs, ctx):
     a_prev = jnp.take_along_axis(
         alphaT, jnp.maximum(endpos, 0)[:, None], 1).squeeze(1)
     loss = -jnp.logaddexp(a_last, a_prev)
+    # empty label (label_len==0): the only path is all-blank, alphaT[:, 0];
+    # the two gathers above would alias it and double-count (+ln 2)
+    loss = jnp.where(label_len == 0, -alphaT[:, 0], loss)
     if norm:
         loss = loss / jnp.maximum(logits_len.astype(loss.dtype), 1.0)
     return {"Loss": [loss.reshape(-1, 1)],

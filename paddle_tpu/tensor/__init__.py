@@ -27,3 +27,144 @@ def sum(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_sum", x, 
 def max(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_max", x, axis, keepdim)
 def min(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_min", x, axis, keepdim)
 def prod(x, axis=None, keepdim=False): return _nn._reduce_layer("reduce_prod", x, axis, keepdim)
+
+
+
+
+# --- expanded 2.0 surface (python/paddle/tensor/* parity) -------------------
+# wrappers go through the same LayerHelper path as fluid.layers so they work
+# in both static and dygraph modes (layer_function_generator.py analog).
+from ..fluid.layers import fill_constant, assign, one_hot, eye
+from ..fluid.layers import range as arange
+from ..fluid.layers.nn import (_single_out, elementwise_op,
+                               floor, ceil, round, sign, sin, cos, rsqrt,
+                               reciprocal, sigmoid, log2, log10, log1p, sinh,
+                               cosh, tan, asin, acos, atan, logsumexp, erf)
+from ..fluid.layer_helper import LayerHelper as _LH
+from ..fluid.framework import in_dygraph_mode as _dy
+
+
+def _op(op_type, inputs, attrs=None, outs=("Out",), dtype=None):
+    ref = next(v for vs in inputs.values() for v in vs)
+    h = _LH(op_type)
+    outvars = {o: [h.create_variable_for_type_inference(
+        dtype=dtype or getattr(ref, "dtype", "float32"))] for o in outs}
+    r = h.append_op(op_type, inputs=inputs, outputs=outvars,
+                    attrs=attrs or {})
+    got = r if _dy() else outvars
+    res = [got[o][0] for o in outs]
+    return res[0] if len(res) == 1 else res
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor: eager VarBase in dygraph, constant var in static."""
+    import numpy as np
+    arr = np.asarray(data, dtype=dtype)
+    if _dy():
+        from ..dygraph.base import to_variable
+        v = to_variable(arr)
+        v.stop_gradient = stop_gradient
+        return v
+    return assign(arr)
+
+
+def full(shape, fill_value, dtype="float32"):
+    return fill_constant(shape, dtype, fill_value)
+
+
+def full_like(x, fill_value, dtype=None):
+    return _op("fill_any_like", {"X": [x]},
+               {"value": float(fill_value), "dtype": dtype})
+
+
+def cumsum(x, axis=None, dtype=None):
+    return _op("cumsum", {"X": [x]}, {"axis": -1 if axis is None else axis,
+                                      "flatten": axis is None})
+
+
+def cross(x, y, axis=None):
+    return _op("cross", {"X": [x], "Y": [y]},
+               {"dim": -1 if axis is None else axis})
+
+
+def dot(x, y): return _op("dot", {"X": [x], "Y": [y]})
+def kron(x, y): return _op("kron", {"X": [x], "Y": [y]})
+def bmm(x, y): return _op("matmul_v2", {"X": [x], "Y": [y]})
+def mv(x, v): return _op("mv", {"X": [x], "Vec": [v]})
+def trace(x, offset=0, axis1=0, axis2=1):
+    return _op("trace", {"Input": [x]}, {"offset": offset, "axis1": axis1,
+                                         "axis2": axis2})
+def tril(x, diagonal=0):
+    return _op("tril_triu", {"X": [x]}, {"diagonal": diagonal, "lower": True})
+def triu(x, diagonal=0):
+    return _op("tril_triu", {"X": [x]}, {"diagonal": diagonal, "lower": False})
+def cholesky(x, upper=False):
+    return _op("cholesky", {"X": [x]}, {"upper": upper})
+def inverse(x): return _op("inverse", {"Input": [x]}, outs=("Output",))
+def index_select(x, index, axis=0):
+    return _op("index_select", {"X": [x], "Index": [index]}, {"dim": axis})
+def index_sample(x, index):
+    return _op("index_sample", {"X": [x], "Index": [index]})
+def masked_select(x, mask):
+    return _op("masked_select", {"X": [x], "Mask": [mask]}, outs=("Y",))
+def roll(x, shifts, axis=None):
+    sh = shifts if isinstance(shifts, (list, tuple)) else [shifts]
+    ax = ([] if axis is None
+          else (axis if isinstance(axis, (list, tuple)) else [axis]))
+    return _op("roll", {"X": [x]}, {"shifts": list(sh), "axis": list(ax)})
+def flip(x, axis):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return _op("flip", {"X": [x]}, {"axis": list(ax)})
+def tile(x, repeat_times):
+    return _op("tile", {"X": [x]}, {"repeat_times": list(repeat_times)})
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    h = _LH("unbind")
+    outs = [h.create_variable_for_type_inference(
+        dtype=getattr(x, "dtype", "float32")) for _ in range(n)]
+    r = h.append_op("unbind", inputs={"X": [x]}, outputs={"Out": outs},
+                    attrs={"axis": axis})
+    return r["Out"] if _dy() else outs
+def meshgrid(*xs):
+    xs = list(xs[0]) if len(xs) == 1 and isinstance(
+        xs[0], (list, tuple)) else list(xs)
+    h = _LH("meshgrid")
+    outs = [h.create_variable_for_type_inference(
+        dtype=getattr(xs[0], "dtype", "float32")) for _ in xs]
+    r = h.append_op("meshgrid", inputs={"X": xs}, outputs={"Out": outs},
+                    attrs={})
+    return r["Out"] if _dy() else outs
+def logit(x, eps=None): return _op("logit", {"X": [x]}, {"eps": eps or 0.0})
+def dist(x, y, p=2):
+    return _op("dist", {"X": [x], "Y": [y]}, {"p": float(p)})
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _op("allclose", {"Input": [x], "Other": [y]},
+               {"rtol": str(rtol), "atol": str(atol),
+                "equal_nan": equal_nan})
+def isnan(x): return _op("isnan_v2", {"X": [x]})
+def isinf(x): return _op("isinf_v2", {"X": [x]})
+def isfinite(x): return _op("isfinite_v2", {"X": [x]})
+def norm(x, p=2, axis=None, keepdim=False):
+    return _op("p_norm", {"X": [x]},
+               {"porder": float(p), "axis": -1 if axis is None else axis,
+                "keepdim": keepdim, "asvector": axis is None})
+def mod(x, y): return _L.elementwise_mod(x, y)
+def floor_divide(x, y): return _L.elementwise_floordiv(x, y)
+def remainder(x, y): return _L.elementwise_mod(x, y)
+def equal(x, y): return _L.equal(x, y)
+def not_equal(x, y): return _op("not_equal", {"X": [x], "Y": [y]})
+def greater_than(x, y): return _op("greater_than", {"X": [x], "Y": [y]})
+def greater_equal(x, y): return _op("greater_equal", {"X": [x], "Y": [y]})
+def less_than(x, y): return _op("less_than", {"X": [x], "Y": [y]})
+def less_equal(x, y): return _op("less_equal", {"X": [x], "Y": [y]})
+def logical_and(x, y): return _op("logical_and", {"X": [x], "Y": [y]})
+def logical_or(x, y): return _op("logical_or", {"X": [x], "Y": [y]})
+def logical_not(x): return _op("logical_not", {"X": [x]})
+def logical_xor(x, y): return _op("logical_xor", {"X": [x], "Y": [y]})
+def all(x, axis=None, keepdim=False):
+    return _nn._reduce_layer("reduce_all", x, axis, keepdim)
+def any(x, axis=None, keepdim=False):
+    return _nn._reduce_layer("reduce_any", x, axis, keepdim)
+def numel(x):
+    import numpy as np
+    return int(np.prod(x.shape))

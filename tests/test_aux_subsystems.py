@@ -1,0 +1,119 @@
+"""Tests for auxiliary subsystems: auto-checkpoint, fs abstraction,
+nan/inf guard, profiler API surface (SURVEY §5)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        from paddle_tpu.incubate.fleet.utils.fs import LocalFS
+        fs = LocalFS()
+        d = str(tmp_path / "a" / "b")
+        fs.mkdirs(d)
+        assert fs.is_dir(d)
+        f = os.path.join(d, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        dirs, files = fs.ls_dir(str(tmp_path / "a"))
+        assert dirs == ["b"]
+        fs.rename(f, f + ".2")
+        assert fs.is_exist(f + ".2") and not fs.is_exist(f)
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_raises_without_hadoop(self):
+        from paddle_tpu.incubate.fleet.utils.fs import (HDFSClient,
+                                                        ExecuteError)
+        c = HDFSClient(time_out=5, sleep_inter=0)
+        with pytest.raises(ExecuteError):
+            c.mkdirs("/nope")
+
+
+class TestAutoCheckpoint:
+    def test_resume_after_interruption(self, tmp_path, monkeypatch):
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+        monkeypatch.setenv("PADDLE_AUTO_CHECKPOINT_PATH", str(tmp_path))
+        monkeypatch.setenv("PADDLE_JOB_ID", "job1")
+        state = {"w": np.zeros(3)}
+
+        def save_fn(d):
+            np.save(os.path.join(d, "w.npy"), state["w"])
+
+        def load_fn(d):
+            state["w"] = np.load(os.path.join(d, "w.npy"))
+
+        # first run: train 3 epochs then "preempt"
+        r = ac.train_epoch_range(5, save_checkpoint_inter=1)
+        r.set_state_hooks(save_fn, load_fn)
+        seen = []
+        for epoch in r:
+            state["w"] = state["w"] + 1
+            seen.append(epoch)
+            if epoch == 2:
+                break
+        assert seen == [0, 1, 2]
+        # epoch 2 was yielded but the range broke before its post-yield save;
+        # last completed save is epoch 1
+        meta = json.load(open(tmp_path / "job1" / "auto_ckpt_meta.json"))
+        assert meta["epoch"] == 1
+
+        # second run: resumes from epoch 2
+        state["w"] = np.zeros(3)     # fresh process
+        r2 = ac.train_epoch_range(5, save_checkpoint_inter=1)
+        r2.set_state_hooks(save_fn, load_fn)
+        seen2 = []
+        for epoch in r2:
+            state["w"] = state["w"] + 1
+            seen2.append(epoch)
+        assert seen2 == [2, 3, 4]
+        assert r2.restored_from == 1
+        # restored w==2 (epoch_1 snapshot) + one increment per resumed epoch
+        np.testing.assert_allclose(state["w"], 2 + len(seen2))
+
+    def test_atomic_save_keeps_only_latest(self, tmp_path, monkeypatch):
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+        monkeypatch.setenv("PADDLE_AUTO_CHECKPOINT_PATH", str(tmp_path))
+        monkeypatch.setenv("PADDLE_JOB_ID", "job2")
+        r = ac.train_epoch_range(3, save_checkpoint_inter=1)
+        r.set_state_hooks(lambda d: open(os.path.join(d, "s"), "w").close(),
+                          lambda d: None)
+        list(r)
+        names = sorted(os.listdir(tmp_path / "job2"))
+        assert names == ["auto_ckpt_meta.json", "epoch_2"]
+
+
+class TestNanInfGuard:
+    def test_executor_flags_nan(self, rng):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[2], dtype="float32")
+            y = fluid.layers.nn.log(x)     # log(-1) -> nan
+        exe = fluid.Executor()
+        core.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            with pytest.raises(Exception):
+                exe.run(main, feed={"x": np.array([-1.0, 1.0], "float32")},
+                        fetch_list=[y])
+        finally:
+            core.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestProfilerSurface:
+    def test_record_event_noop_safe(self):
+        from paddle_tpu.fluid.profiler import RecordEvent
+        with RecordEvent("span"):
+            pass
+
+    def test_timeline_tool_importable(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "timeline", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "timeline.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert callable(mod.extract)

@@ -1,0 +1,149 @@
+"""Tests for the paddle 2.0 namespace surface: paddle.tensor functions,
+paddle.metric classes, paddle.text datasets (reference python/paddle/
+{tensor,metric,text}/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def dygraph():
+    from paddle_tpu.dygraph import base as dybase
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+class TestTensorNamespace:
+    def test_elementwise_and_unary(self, dygraph, rng):
+        x = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+        y = paddle.to_tensor(rng.rand(3, 4).astype("float32"))
+        out = paddle.add(paddle.multiply(x, y), paddle.sqrt(x))
+        ref = np.asarray(x.numpy()) * y.numpy() + np.sqrt(x.numpy())
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+    def test_linalg(self, dygraph, rng):
+        a = rng.rand(3, 3).astype("float32")
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.trace(x).numpy(), np.trace(a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.tril(x).numpy(), np.tril(a),
+                                   rtol=1e-6)
+        spd = a @ a.T + 3 * np.eye(3, dtype="float32")
+        c = paddle.cholesky(paddle.to_tensor(spd)).numpy()
+        np.testing.assert_allclose(c @ c.T, spd, rtol=1e-3, atol=1e-4)
+
+    def test_manipulation(self, dygraph, rng):
+        a = rng.rand(2, 3).astype("float32")
+        x = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.flip(x, 0).numpy(), a[::-1],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.tile(x, [2, 1]).numpy(),
+                                   np.tile(a, (2, 1)), rtol=1e-6)
+        np.testing.assert_allclose(paddle.roll(x, 1, 1).numpy(),
+                                   np.roll(a, 1, 1), rtol=1e-6)
+
+    def test_cumsum_dot_cross(self, dygraph, rng):
+        a = rng.rand(4).astype("float32")
+        b = rng.rand(4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(a)).numpy(), np.cumsum(a),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.dot(paddle.to_tensor(a[None]),
+                       paddle.to_tensor(b[None])).numpy().ravel(),
+            [a @ b], rtol=1e-5)
+
+    def test_logic_reductions(self, dygraph):
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        y = paddle.to_tensor(np.array([[1.0, 0.0], [3.0, 4.0]], "float32"))
+        eq = paddle.equal(x, y).numpy()
+        np.testing.assert_array_equal(eq, [[True, False], [True, True]])
+        assert not bool(paddle.all(paddle.to_tensor(eq)).numpy())
+        assert bool(paddle.any(paddle.to_tensor(eq)).numpy())
+
+    def test_norm_isfinite(self, dygraph, rng):
+        a = rng.rand(5).astype("float32")
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(a)).numpy().ravel()[0],
+            np.linalg.norm(a), rtol=1e-5)
+        assert bool(paddle.isfinite(
+            paddle.to_tensor(a)).numpy().all())
+
+    def test_static_mode_tensor_fns(self, rng):
+        import paddle_tpu.fluid as fluid
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data(name="x", shape=[2, 3], dtype="float32")
+            out = paddle.add(paddle.cumsum(x, axis=1), x)
+            exe = fluid.Executor()
+            a = rng.rand(2, 3).astype("float32")
+            res = exe.run(main, feed={"x": a}, fetch_list=[out])[0]
+        np.testing.assert_allclose(res, np.cumsum(a, 1) + a, rtol=1e-5)
+
+
+class TestMetric20:
+    def test_accuracy_topk(self):
+        from paddle_tpu.metric.metrics import Accuracy
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], "float32")
+        label = np.array([1, 2], "int64")
+        m.update(m.compute(pred, label))
+        acc1, acc2 = m.accumulate()
+        assert acc1 == 0.5 and acc2 == 0.5
+
+    def test_precision_recall(self):
+        from paddle_tpu.metric.metrics import Precision, Recall
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.9, 0.1, 0.1])
+        labels = np.array([1, 0, 1, 0])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == 0.5    # 1 tp, 1 fp
+        assert r.accumulate() == 0.5    # 1 tp, 1 fn
+
+    def test_auc_perfect(self):
+        from paddle_tpu.metric.metrics import Auc
+        m = Auc()
+        preds = np.array([0.9, 0.8, 0.2, 0.1])
+        labels = np.array([1, 1, 0, 0])
+        m.update(preds, labels)
+        assert m.accumulate() > 0.99
+
+    def test_auc_random_is_half(self):
+        from paddle_tpu.metric.metrics import Auc
+        rng = np.random.RandomState(0)
+        m = Auc()
+        m.update(rng.rand(4000), rng.randint(0, 2, 4000))
+        assert abs(m.accumulate() - 0.5) < 0.05
+
+
+class TestTextDatasets:
+    def test_imdb_synthetic(self):
+        from paddle_tpu.text.datasets import Imdb
+        ds = Imdb(mode="train", size=32)
+        assert ds.synthetic and len(ds) == 32
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+
+    def test_uci_housing_split(self):
+        from paddle_tpu.text.datasets import UCIHousing
+        tr = UCIHousing(mode="train")
+        te = UCIHousing(mode="test")
+        assert len(tr) + len(te) == 506
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_wmt_schema(self):
+        from paddle_tpu.text.datasets import WMT14
+        ds = WMT14(size=8)
+        src, trg_in, trg_next = ds[0]
+        assert src[0] == 0 and src[-1] == 1       # <s> ... <e>
+        np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+
+    def test_movielens_rating_range(self):
+        from paddle_tpu.text.datasets import Movielens
+        ds = Movielens(size=16)
+        row = ds[0]
+        assert 1.0 <= row[-1] <= 5.0 and len(row) == 8

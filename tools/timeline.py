@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Timeline viewer prep (reference tools/timeline.py: profiler proto ->
+chrome://tracing JSON).
+
+The JAX profiler (fluid.profiler) already writes a gzipped Chrome trace in
+<logdir>/plugins/profile/<run>/*.trace.json.gz; this tool finds the newest
+run and extracts it to a plain .json loadable in chrome://tracing or
+https://ui.perfetto.dev.
+"""
+import argparse
+import glob
+import gzip
+import os
+import shutil
+import sys
+
+
+def extract(logdir, out):
+    pats = sorted(glob.glob(os.path.join(
+        logdir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not pats:
+        print(f"no trace found under {logdir}", file=sys.stderr)
+        return 1
+    src = pats[-1]
+    with gzip.open(src, "rb") as f, open(out, "wb") as o:
+        shutil.copyfileobj(f, o)
+    print(f"{src} -> {out}; open in chrome://tracing or ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", default="/tmp/paddle_tpu_profile")
+    ap.add_argument("--timeline_path", default="timeline.json")
+    a = ap.parse_args()
+    sys.exit(extract(a.profile_path, a.timeline_path))
