@@ -1,0 +1,4 @@
+from .quantization_pass import (QuantizationTransformPass,
+                                QuantizationFreezePass,
+                                quant_aware, convert)
+from .post_training_quantization import PostTrainingQuantization

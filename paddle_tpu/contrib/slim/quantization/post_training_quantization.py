@@ -1,0 +1,138 @@
+"""Post-training quantization (PTQ).
+
+Reference: python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py — run calibration batches through the fp32
+program, collect per-tensor activation ranges (abs_max or histogram/KL),
+compute weight scales, and emit a quantized inference program.
+
+TPU-native: calibration runs the already-compiled XLA program and fetches
+the quantizable ops' inputs/outputs; ranges accumulate host-side.  The
+result is the same program plus `_quant_scales` metadata (per-var scale)
+that the predictor uses to requantize weights to int8 ahead of serving.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .quantization_pass import QUANTIZABLE_OPS, _WEIGHT_SLOTS
+
+
+class PostTrainingQuantization:
+    def __init__(self, executor, program, feed_list, fetch_list,
+                 data_loader=None, batch_nums=10, algo="abs_max",
+                 weight_bits=8, activation_bits=8,
+                 quantizable_op_type=QUANTIZABLE_OPS, scope=None):
+        self.exe = executor
+        self.program = program
+        self.feed_list = feed_list
+        self.fetch_list = fetch_list
+        self.loader = data_loader
+        self.batch_nums = batch_nums
+        self.algo = algo
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.op_types = tuple(quantizable_op_type)
+        self.scope = scope
+        self._act_ranges = {}
+        self._hists = {}
+
+    # -- calibration ---------------------------------------------------------
+    def _observe_vars(self):
+        names = set()
+        for op in self.program.global_block().ops:
+            if op.type in self.op_types:
+                for slot, vs in op.inputs.items():
+                    if slot in ("X", "Input"):
+                        names.update(vs)
+                for vs in op.outputs.values():
+                    names.update(vs)
+        return sorted(names)
+
+    def _update_ranges(self, name, arr):
+        amax = float(np.abs(arr).max()) if arr.size else 0.0
+        if self.algo == "abs_max":
+            self._act_ranges[name] = max(self._act_ranges.get(name, 0.0),
+                                         amax)
+        else:  # histogram / KL: accumulate a 2048-bin histogram
+            hist, edges = np.histogram(np.abs(arr), bins=2048,
+                                       range=(0, max(amax, 1e-8)))
+            prev = self._hists.get(name)
+            if prev is None or prev[1][-1] < edges[-1]:
+                # re-bin previous into the new range
+                if prev is not None:
+                    old_centers = (prev[1][:-1] + prev[1][1:]) / 2
+                    add, _ = np.histogram(old_centers, bins=2048,
+                                          range=(0, edges[-1]),
+                                          weights=prev[0])
+                    hist = hist + add
+                self._hists[name] = (hist.astype(np.float64), edges)
+            else:
+                add, _ = np.histogram(np.abs(arr), bins=2048,
+                                      range=(0, prev[1][-1]))
+                self._hists[name] = (prev[0] + add, prev[1])
+
+    def _finalize_ranges(self):
+        if self.algo == "abs_max":
+            return dict(self._act_ranges)
+        out = {}
+        for name, (hist, edges) in self._hists.items():
+            # percentile-style cut: smallest range keeping 99.99% of mass
+            c = np.cumsum(hist)
+            if c[-1] <= 0:
+                out[name] = float(edges[-1])
+                continue
+            idx = int(np.searchsorted(c, 0.9999 * c[-1]))
+            out[name] = float(edges[min(idx + 1, len(edges) - 1)])
+        return out
+
+    def quantize(self):
+        observe = self._observe_vars()
+        block = self.program.global_block()
+        existing = {v for v in observe
+                    if block._find_var_recursive(v) is not None}
+        n = 0
+        for batch in self.loader():
+            fetches = self.exe.run(self.program, feed=batch,
+                                   fetch_list=sorted(existing))
+            for name, arr in zip(sorted(existing), fetches):
+                self._update_ranges(name, np.asarray(arr))
+            n += 1
+            if n >= self.batch_nums:
+                break
+        act_scales = self._finalize_ranges()
+
+        # weight scales straight from the parameter values
+        weight_scales = {}
+        from ....fluid import core
+        scope = self.scope or core.global_scope()
+        for op in block.ops:
+            if op.type in self.op_types:
+                wslot = _WEIGHT_SLOTS.get(op.type)
+                for name in op.inputs.get(wslot, []):
+                    w = scope.find_var(name)
+                    if w is not None:
+                        arr = np.asarray(w)
+                        axes = tuple(i for i in range(arr.ndim) if i != 0)
+                        weight_scales[name] = np.abs(arr).max(
+                            axis=axes if arr.ndim > 1 else None)
+        self.program._quant_scales = {"activations": act_scales,
+                                      "weights": weight_scales,
+                                      "weight_bits": self.weight_bits,
+                                      "activation_bits": self.activation_bits}
+        return self.program
+
+    def save_quantized_model(self, save_model_path, **kw):
+        import json
+        import os
+        os.makedirs(save_model_path, exist_ok=True)
+        meta = {
+            "activations": self.program._quant_scales["activations"],
+            "weights": {k: np.asarray(v).tolist() for k, v in
+                        self.program._quant_scales["weights"].items()},
+            "weight_bits": self.weight_bits,
+            "activation_bits": self.activation_bits,
+        }
+        with open(os.path.join(save_model_path, "quant_scales.json"),
+                  "w") as f:
+            json.dump(meta, f)
+        return save_model_path

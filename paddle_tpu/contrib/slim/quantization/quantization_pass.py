@@ -1,0 +1,130 @@
+"""QAT program rewriting (quantization-aware training).
+
+Reference: python/paddle/fluid/contrib/slim/quantization/quantization_pass.py
+— `QuantizationTransformPass` inserts fake_quantize/dequantize pairs on the
+inputs and weights of quantizable ops (conv2d, depthwise_conv2d, mul/matmul),
+`QuantizationFreezePass` folds the learned scales into inference attrs.
+
+TPU-native notes: the fake-quant ops lower to round/clip chains that XLA
+fuses into the surrounding computation, and their gradients are
+straight-through (ops/quant_ops.py) — training stays one compiled program.
+int8 MXU execution comes from XLA's int8 dot support at serving time; the
+freeze pass records per-tensor/per-channel scales as op attrs so the
+predictor can requantize weights ahead of time.
+"""
+from __future__ import annotations
+
+from ....fluid.framework import Program
+
+QUANTIZABLE_OPS = ("conv2d", "depthwise_conv2d", "mul", "matmul",
+                   "matmul_v2", "fc")
+_WEIGHT_SLOTS = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                 "mul": "Y", "matmul": "Y", "matmul_v2": "Y", "fc": "W"}
+
+
+class QuantizationTransformPass:
+    """Insert activation + weight fake-quant-dequant before quantizable ops."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 quantizable_op_type=QUANTIZABLE_OPS):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.op_types = tuple(quantizable_op_type)
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block()
+        new_ops = []
+        quanted = {}          # var name -> quant-dequant output name
+
+        def qdq(name, is_weight, pos):
+            key = (name, is_weight)
+            if key in quanted:
+                return quanted[key], []
+            out = f"{name}@QUANT_DEQUANT"
+            scale = f"{name}@QUANT_SCALE"
+            block.create_var(name=out, stop_gradient=False)
+            block.create_var(name=scale, stop_gradient=True)
+            bits = self.weight_bits if is_weight else self.activation_bits
+            if is_weight and self.weight_type == "channel_wise_abs_max":
+                # per-channel scale over axis 0 for Filter, axis 1 for Y/W
+                op_type = "fake_channel_wise_quantize_abs_max"
+                attrs = {"bit_length": bits,
+                         "quant_axis": 0 if pos == "Filter" else 1}
+            else:
+                op_type = "fake_quantize_dequantize_abs_max"
+                attrs = {"bit_length": bits}
+            op = block.append_op(op_type, inputs={"X": [name]},
+                                 outputs={"Out": [out],
+                                          "OutScale": [scale]},
+                                 attrs=attrs)
+            block.ops.pop()
+            quanted[key] = out
+            return out, [op]
+
+        for op in list(block.ops):
+            if op.type in self.op_types:
+                w_slot = _WEIGHT_SLOTS.get(op.type)
+                for slot, names in op.inputs.items():
+                    if slot not in ("X", "Input", w_slot):
+                        continue
+                    renamed = []
+                    for n in names:
+                        v = block._find_var_recursive(n)
+                        if v is None or getattr(v, "dtype", "float32") not in (
+                                "float32", None):
+                            renamed.append(n)
+                            continue
+                        out, qops = qdq(n, slot == w_slot, slot)
+                        new_ops.extend(qops)
+                        renamed.append(out)
+                    op.inputs[slot] = renamed
+            new_ops.append(op)
+        block.ops = new_ops
+        program._quant_bits = (self.weight_bits, self.activation_bits)
+        return program
+
+
+class QuantizationFreezePass:
+    """Fold fake-quant ops into scale attrs for inference.
+
+    Reference QuantizationFreezePass rewires the graph so conv/mul consume
+    int8 weights + dequantize outputs.  Here the pass (a) removes the
+    quant-dequant ops, (b) records `{var: scale_var}` in
+    program._quant_scales so the predictor can quantize weights offline.
+    """
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block()
+        scales = {}
+        keep = []
+        rename = {}
+        for op in block.ops:
+            if op.type.startswith(("fake_quantize", "fake_channel_wise",
+                                   "fake_quantize_dequantize")):
+                src = op.inputs["X"][0]
+                out = op.outputs["Out"][0]
+                rename[out] = src
+                scales[src] = op.outputs.get("OutScale", [None])[0]
+                continue
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [rename.get(n, n) for n in names]
+            keep.append(op)
+        block.ops = keep
+        program._quant_scales = scales
+        return program
+
+
+def quant_aware(program, weight_bits=8, activation_bits=8, **kw):
+    """paddleslim-style one-call QAT entry."""
+    return QuantizationTransformPass(
+        weight_bits=weight_bits, activation_bits=activation_bits,
+        **kw).apply(program)
+
+
+def convert(program):
+    """paddleslim-style freeze for inference."""
+    return QuantizationFreezePass().apply(program)
