@@ -77,9 +77,47 @@ def flops_per_token(hidden, layers, ffn, seq, vocab):
     return 3 * fwd                                             # bwd = 2x fwd
 
 
+def supervise():
+    """The axon TPU plugin is flaky at init — it can raise UNAVAILABLE *or
+    hang forever*, and a hang can strike any in-process jax call.  So the
+    real bench runs as a *watched child process*: first attempt on the
+    default (TPU) backend, and on crash/timeout a retry with the CPU
+    platform forced.  The supervisor ALWAYS prints exactly one JSON line
+    (round-1 lesson: rc=1 with no JSON costs the round its headline number).
+    """
+    import os
+    import subprocess
+
+    attempts = [({}, 360), ({"JAX_PLATFORMS": "cpu"}, 300)]
+    for extra_env, budget in attempts:
+        env = dict(os.environ, GRAFT_BENCH_CHILD="1", **extra_env)
+        label = extra_env.get("JAX_PLATFORMS", "default")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                env=env, capture_output=True, text=True, timeout=budget)
+            for line in reversed(r.stdout.splitlines()):
+                if line.startswith("{"):
+                    print(line)
+                    return
+            print(f"# child({label}) rc={r.returncode} no JSON; stderr tail: "
+                  f"{r.stderr.strip()[-500:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"# child({label}) hung >{budget}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "bert_base_pretrain_throughput", "value": 0.0,
+        "unit": "tokens/sec/chip", "vs_baseline": 0.0, "backend": "error",
+    }))
+
+
 def main():
+    import os
     import jax
     import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon TPU plugin ignores the env var alone; force in-process
+        jax.config.update("jax_platforms", "cpu")
 
     quick = "--quick" in sys.argv
     backend = jax.default_backend()
@@ -127,4 +165,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import os
+    if os.environ.get("GRAFT_BENCH_CHILD"):
+        main()
+    else:
+        supervise()

@@ -50,8 +50,11 @@ class QuantizationTransformPass:
             block.create_var(name=scale, stop_gradient=True)
             bits = self.weight_bits if is_weight else self.activation_bits
             if is_weight and self.weight_type == "channel_wise_abs_max":
-                # per-channel scale over axis 0 for Filter, axis 1 for Y/W
-                op_type = "fake_channel_wise_quantize_abs_max"
+                # per-channel scale over axis 0 for Filter, axis 1 for Y/W.
+                # Must be the quant-DEQUANT fused op: consumers need
+                # float-scale weights during training, not integer codes
+                # (reference inserts a matching channel-wise dequant).
+                op_type = "fake_channel_wise_quantize_dequantize_abs_max"
                 attrs = {"bit_length": bits,
                          "quant_axis": 0 if pos == "Filter" else 1}
             else:

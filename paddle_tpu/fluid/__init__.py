@@ -12,7 +12,7 @@ from .core import (CPUPlace, TPUPlace, CUDAPlace, TPUPinnedPlace, Scope,
 from .framework import (Program, Variable, Parameter, program_guard,
                         default_main_program, default_startup_program,
                         in_dygraph_mode, unique_name, convert_dtype,
-                        cpu_places)
+                        cpu_places, device_guard)
 from .executor import Executor
 from .backward import append_backward, gradients
 from . import initializer

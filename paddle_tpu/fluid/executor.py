@@ -66,11 +66,18 @@ class _CompiledBlock:
 
 
 def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
-                  stop_at: Optional[int] = None):
+                  stop_at: Optional[int] = None, ops=None,
+                  call_op=None):
     """Interpret the block's ops by invoking each lowering rule; under jit
-    this builds the jaxpr (trace-time loop — zero runtime dispatch cost)."""
+    this builds the jaxpr (trace-time loop — zero runtime dispatch cost).
+
+    `ops` restricts execution to an explicit op list (pipeline stages /
+    recompute segments); `call_op` overrides how a lowering rule is invoked
+    (the functional-autodiff path wraps custom_grad ops in jax.custom_vjp).
+    """
     from . import control_flow_impl
-    for i, op in enumerate(block.ops):
+    op_list = block.ops if ops is None else ops
+    for i, op in enumerate(op_list):
         if stop_at is not None and i >= stop_at:
             break
         if op.type in ("feed", "fetch"):
@@ -85,7 +92,10 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
             vals = [env[n] for n in names if n in env]
             if vals or names:
                 ins[slot] = vals
-        outs = opdef.fn(ins, op.attrs, ctx)
+        if call_op is not None:
+            outs = call_op(opdef, ins, op.attrs, ctx)
+        else:
+            outs = opdef.fn(ins, op.attrs, ctx)
         for slot, names in op.outputs.items():
             produced = outs.get(slot, [])
             for name, val in zip(names, produced):

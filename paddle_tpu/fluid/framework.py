@@ -223,6 +223,10 @@ class Block:
                 out[k] = [x.name if isinstance(x, Variable) else x for x in v]
             return out
         op = Operator(self, type, norm(inputs), norm(outputs), attrs)
+        if _current_device is not None and "op_device" not in op.attrs:
+            # device_guard annotation — consumed by the pipeline splitter
+            # (reference: operator.cc:1180 per-op `op_device` for pipeline)
+            op.attrs["op_device"] = _current_device
         self.ops.append(op)
         for names in op.outputs.values():
             for n in names:
@@ -359,6 +363,33 @@ _OPTIMIZER_OP_TYPES = frozenset({
     "sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop", "lamb",
     "lars_momentum", "ftrl", "dpsgd", "dgc_momentum",
 })
+
+# ---------------------------------------------------------------------------
+# device_guard: pipeline stage placement (fluid.device_guard analog —
+# python/paddle/fluid/framework.py device_guard; ops appended inside the
+# guard carry an `op_device` attr, consumed by PipelineOptimizer's splitter)
+# ---------------------------------------------------------------------------
+_current_device = None
+
+
+class device_guard:
+    """`with fluid.device_guard("tpu:1"):` — annotate appended ops with a
+    pipeline stage device."""
+
+    def __init__(self, device=None):
+        self.device = device
+        self._prev = None
+
+    def __enter__(self):
+        global _current_device
+        self._prev = _current_device
+        _current_device = self.device
+        return self
+
+    def __exit__(self, *a):
+        global _current_device
+        _current_device = self._prev
+        return False
 
 # ---------------------------------------------------------------------------
 # default program machinery (program_guard etc.)

@@ -66,10 +66,34 @@ class _EpochRange:
         return os.path.join(self.dir, _CKPT_META)
 
     def _load_meta(self):
-        if isinstance(self.fs, LocalFS) and os.path.exists(self._meta_path()):
-            with open(self._meta_path()) as f:
-                return json.load(f)
-        return None
+        if isinstance(self.fs, LocalFS):
+            if os.path.exists(self._meta_path()):
+                with open(self._meta_path()) as f:
+                    return json.load(f)
+            return None
+        # HDFS: download the meta file through the FS abstraction
+        try:
+            if not self.fs.is_exist(self._meta_path()):
+                return None
+            local = f"/tmp/acmeta_{os.getpid()}.json"
+            LocalFS().delete(local)
+            self.fs.download(self._meta_path(), local)
+            with open(local) as f:
+                meta = json.load(f)
+            LocalFS().delete(local)
+            return meta
+        except (ExecuteError, OSError, ValueError):
+            return None
+
+    def _fetch_state_dir(self, epoch):
+        """Return a local dir holding epoch state (downloads in HDFS mode)."""
+        remote = os.path.join(self.dir, f"epoch_{epoch}")
+        if isinstance(self.fs, LocalFS):
+            return remote
+        local = f"/tmp/acstate_{os.getpid()}_{epoch}"
+        LocalFS().delete(local)
+        self.fs.download(remote, local)
+        return local
 
     def __iter__(self):
         start = 0
@@ -78,8 +102,7 @@ class _EpochRange:
             start = meta["epoch"] + 1
             self.restored_from = meta["epoch"]
             if self._state_loader is not None:
-                self._state_loader(os.path.join(self.dir,
-                                                f"epoch_{meta['epoch']}"))
+                self._state_loader(self._fetch_state_dir(meta["epoch"]))
         for epoch in range(start, self.max_epoch_num):
             yield epoch
             if epoch % self.inter == 0:
@@ -114,6 +137,14 @@ class _EpochRange:
             self.fs.upload(local_tmp, os.path.join(self.dir,
                                                    f"epoch_{epoch}"))
             LocalFS().delete(local_tmp)
+            # persist the resume meta through the FS abstraction too —
+            # without it a preempted HDFS job silently restarts at epoch 0
+            meta_local = f"/tmp/acmeta_{os.getpid()}_{epoch}.json"
+            with open(meta_local, "w") as f:
+                json.dump({"epoch": epoch, "ts": time.time()}, f)
+            self.fs.delete(self._meta_path())
+            self.fs.upload(meta_local, self._meta_path())
+            LocalFS().delete(meta_local)
 
 
 _current_range = None

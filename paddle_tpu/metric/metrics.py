@@ -143,9 +143,11 @@ class Auc(Metric):
         tot_neg = self._stat_neg.sum()
         if tot_pos == 0 or tot_neg == 0:
             return 0.0
-        # trapezoidal area walking thresholds high->low
+        # trapezoidal area walking thresholds high->low, anchored at (0,0)
+        # so the first (highest-threshold) bucket's area is counted —
+        # all-one-bucket degenerate input then yields 0.5, not 0.0
         pos = np.cumsum(self._stat_pos[::-1])
         neg = np.cumsum(self._stat_neg[::-1])
-        tpr = pos / tot_pos
-        fpr = neg / tot_neg
+        tpr = np.concatenate([[0.0], pos / tot_pos])
+        fpr = np.concatenate([[0.0], neg / tot_neg])
         return float(np.trapezoid(tpr, fpr))

@@ -305,18 +305,36 @@ def global_shuffle(feeds, seed=0):
             *[f._h for f in feeds])
         natives[0]._lib.pt_feed_global_shuffle(arr, len(feeds), seed)
         return
-    # python fallback: same content-hash routing
+    if natives:
+        raise ValueError(
+            "global_shuffle: mixed native/python feed lists are not "
+            "supported — pass all-native or all-python feeds")
+    # python fallback: same content-hash routing (mix dense values and a
+    # record counter so dense-only schemas don't all hash to one feed)
     pools = [f._pool for f in feeds]
     dest = [[] for _ in feeds]
+    counter = 0
     for pool in pools:
         for rec in pool:
             h = 1469598103934665603
+            mixed = False
             for slot in rec[0]:
                 for v in slot:
                     h = ((h ^ hash(int(v))) * 1099511628211) & ((1 << 64) - 1)
+                    mixed = True
+            if not mixed:
+                for slot in rec[1] if len(rec) > 1 else ():
+                    for v in np.asarray(slot).reshape(-1)[:8]:
+                        h = ((h ^ hash(float(v))) * 1099511628211) \
+                            & ((1 << 64) - 1)
+                        mixed = True
+            if not mixed:
+                h = ((h ^ counter) * 1099511628211) & ((1 << 64) - 1)
+            counter += 1
             dest[h % len(feeds)].append(rec)
-    for f, d in zip(feeds, dest):
-        rng = np.random.RandomState(seed)
+    for i, (f, d) in enumerate(zip(feeds, dest)):
+        # per-feed seed offset matches the native path's seed+i
+        rng = np.random.RandomState(seed + i)
         rng.shuffle(d)
         f._pool = d
 

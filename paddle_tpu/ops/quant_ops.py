@@ -76,6 +76,23 @@ def _fake_cw_quant(ins, attrs, ctx):
             "OutScale": [scale]}
 
 
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             nondiff_outputs=("OutScale",), custom_grad=_st_grad())
+def _fake_cw_qdq(ins, attrs, ctx):
+    """Channel-wise quant->dequant in one op: consumers see float-scale
+    weights (the QAT training path; quantize-only codes are serving-side)."""
+    x = ins["X"][0]
+    bnt = _bnt(attrs)
+    axis = attrs.get("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red)
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    s = scale.reshape(shape)
+    return {"Out": [_dequant(_quant(x, s, bnt), s, bnt)],
+            "OutScale": [scale]}
+
+
 @register_op("fake_quantize_range_abs_max",
              nondiff_inputs=("InScale", "Iter"),
              nondiff_outputs=("OutScale", "OutScales"),

@@ -47,7 +47,10 @@ class Imdb(Dataset):
                     docs_raw.append((toks, 1 if "/pos/" in m.name else 0))
                     for t in toks:
                         freq[t] = freq.get(t, 0) + 1
-        vocab = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))[:cutoff]
+        # cutoff is a minimum word-frequency threshold (reference imdb.py
+        # build_dict keeps words with freq > cutoff), not a top-N vocab size
+        vocab = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                       key=lambda kv: (-kv[1], kv[0]))
         self.word_idx = {w: i + 2 for i, (w, _) in enumerate(vocab)}
         self.docs = [np.asarray([self.word_idx.get(t, 1) for t in toks],
                                 "int64") for toks, _ in docs_raw]
