@@ -134,7 +134,10 @@ class Executor:
             (k, tuple(np.shape(v)), str(np.asarray(v).dtype))
             for k, v in feed.items()))
         key = (_fingerprint(program), feed_sig, tuple(fetch_names),
-               id(scope), bool(program._hints.get("is_test")))
+               id(scope), bool(program._hints.get("is_test")),
+               tuple(program._hints.get("recompute_checkpoints") or ()),
+               program._hints.get("pipeline_microbatches"),
+               id(mesh) if mesh is not None else None)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._prepare(program, feed, fetch_names, scope, mesh)
@@ -168,6 +171,8 @@ class Executor:
                  mesh=None) -> _CompiledBlock:
         block = program.global_block()
         is_test = bool(program._hints.get("is_test"))
+        checkpoints = program._hints.get("recompute_checkpoints")
+        microbatches = program._hints.get("pipeline_microbatches")
 
         # vars read from the scope: persistables already materialised
         param_names = sorted(
@@ -180,6 +185,51 @@ class Executor:
              if n in persist or scope.find_var(n) is not None})
         # a persistable output only counts if its producing op will run
         mesh_axes = dict(getattr(program, "_mesh_axes", {}) or {})
+
+        # --- static pipeline path (PipelineOptimizer + device_guard) -------
+        if (microbatches and mesh is not None
+                and "pp" in getattr(mesh, "axis_names", ())
+                and mesh.shape["pp"] > 1):
+            from ..parallel.pipeline import classify_block, build_pipeline_step
+            plan = classify_block(block)
+            example_env = {}
+            for n in param_names:
+                v = scope.find_var(n)   # shape/dtype only — no host copy
+                example_env[n] = jax.ShapeDtypeStruct(
+                    tuple(np.shape(v)), np.dtype(getattr(v, "dtype", "f4")))
+            for k, v in feed.items():
+                shape = list(np.shape(v))
+                if shape and shape[0] % int(microbatches) == 0:
+                    shape[0] //= int(microbatches)
+                example_env[k] = jax.ShapeDtypeStruct(
+                    tuple(shape), np.asarray(v).dtype)
+            jfn = build_pipeline_step(
+                block, plan, mesh, microbatches, fetch_names, mesh_axes,
+                is_test, written_names, example_env, list(feed))
+            return _CompiledBlock(jfn, param_names, written_names,
+                                  fetch_names)
+
+        # --- recompute path (RecomputeOptimizer checkpoints) ---------------
+        if checkpoints:
+            from ..parallel.pipeline import (classify_block,
+                                             build_functional_step)
+            plan = classify_block(block)
+            # inference clones keep the hint but have no backward to
+            # rematerialise — fall through to the plain path
+            if plan.loss_name is not None:
+                fn = build_functional_step(block, plan, fetch_names,
+                                           mesh_axes, is_test, checkpoints,
+                                           written_names)
+                backend = self.place.jax_device().platform
+                donate = (core.get_flag("use_donated_buffers")
+                          and backend != "cpu")
+                if mesh is not None:
+                    from ..parallel.api import wrap_with_mesh
+                    jfn = wrap_with_mesh(fn, mesh, program)
+                else:
+                    jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+                return _CompiledBlock(jfn, param_names, written_names,
+                                      fetch_names)
 
         def fn(mut_params, ro_params, feeds, step_key):
             env = dict(mut_params)

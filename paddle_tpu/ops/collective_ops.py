@@ -137,15 +137,32 @@ def _c_identity(ins, attrs, ctx):
 
 @register_op("send_v2", differentiable=False)
 def _send_v2(ins, attrs, ctx):
-    # p2p pipeline send: modeled with ppermute at the pipeline composite level
-    # (parallel/pipeline.py); standalone send lowers to identity + ppermute pair
+    """p2p pipeline send (reference: operators/collective/send_v2_op.cc).
+
+    SPMD model: every rank executes both sides of the pair, so send stores
+    its value in the compilation-scoped mailbox and the matching recv_v2
+    applies the ring ppermute — together they are exactly the NCCL
+    ncclSend/ncclRecv pair, but scheduled by XLA.  The pipeline composite
+    path (parallel/pipeline.py) threads boundaries natively and doesn't
+    need these ops."""
+    ctx.p2p[int(attrs.get("ring_id", 0))] = ins["X"][0]
     return {}
 
 
 @register_op("recv_v2", differentiable=False)
 def _recv_v2(ins, attrs, ctx):
-    raise NotImplementedError(
-        "p2p recv_v2 must be paired via parallel/pipeline.py stage composition")
+    ring = int(attrs.get("ring_id", 0))
+    if ring not in ctx.p2p:
+        raise ValueError(
+            f"recv_v2(ring_id={ring}) has no matching send_v2 earlier in "
+            f"the block — p2p ops must be paired (send stores, recv shifts)")
+    x = ctx.p2p.pop(ring)   # consume: a second recv needs its own send
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": [x]}
+    n = lax.axis_size(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return {"Out": [lax.ppermute(x, axis, perm)]}
 
 
 @register_op("partial_send", differentiable=False)

@@ -77,6 +77,7 @@ class LoweringContext:
         self.base_key = base_key
         self.mesh_axes = mesh_axes or {}   # ring_id -> mesh axis name(s)
         self.is_test = is_test
+        self.p2p = {}                      # ring_id -> in-flight send_v2 value
 
     def key_for(self, op_seed: int):
         import jax

@@ -1,0 +1,391 @@
+"""Static-graph pipeline parallelism + recompute: the SectionWorker analog.
+
+Reference: python/paddle/fluid/optimizer.py:3693 `PipelineOptimizer` splits a
+user Program into per-device sections by each op's `op_device` attr
+(`device_guard`), and framework/section_worker.cc:44-112 runs the
+F-then-B microbatch schedule with send_v2/recv_v2 p2p ops between sections.
+Recompute reference: python/paddle/fluid/backward.py:689
+`_append_backward_ops_with_checkpoints_` re-emits forward ops between
+checkpoints inside the backward pass.
+
+TPU-native design — both features are *functional re-derivations* of the
+op-level program, not op-list rewrites:
+
+* The block's ops are classified into (forward, grad-machinery, post): the
+  grad machinery (per-op `generic_grad` ops + partial-sum ops appended by
+  backward.py) is REPLACED by one `jax.value_and_grad` over the composed
+  forward, which XLA differentiates whole-program.  Post ops (grad clip,
+  regularizers, optimizer ops) then run on the AD-produced gradients under
+  their original `@GRAD` names — user programs don't change.
+
+* Pipeline: forward ops are split into stages by `op_device`; the whole
+  GPipe schedule runs per-device inside `shard_map` over the mesh's `pp`
+  axis.  Stage dispatch is `lax.switch` on `lax.axis_index("pp")`; the
+  microbatch stream is threaded between neighbor stages with `lax.ppermute`
+  (the send_v2/recv_v2 analog); the backward pipeline falls out of AD — the
+  transpose of a ppermute is the reverse-direction ppermute, so the reverse
+  schedule of section_worker.cc is derived, not hand-written.
+
+* Recompute: the forward segment between two checkpoint vars becomes one
+  `jax.checkpoint`-wrapped function whose carried environment is liveness-
+  minimised, so segment-internal activations are rematerialised in the
+  backward pass instead of stored (jax.checkpoint == the TPU-native
+  _append_backward_ops_with_checkpoints_).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+GRAD = "@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# Op classification: forward / grad machinery / post
+# ---------------------------------------------------------------------------
+
+class BlockPlan:
+    """The split of a trained Program's global block around its backward."""
+
+    def __init__(self, fwd_ops, post_ops, loss_name, grad_of):
+        self.fwd_ops = fwd_ops          # ops before the loss-grad fill
+        self.post_ops = post_ops        # clip/regularizer/optimizer tail
+        self.loss_name = loss_name      # scalar loss var name (or None)
+        self.grad_of = grad_of          # param name -> final @GRAD var name
+
+
+def classify_block(block) -> BlockPlan:
+    """Split ops at the `fill_constant` that seeds loss@GRAD (the marker
+    append_backward emits first).  Grad-machinery ops (generic_grad, the
+    fill itself, pure-@GRAD partial sums) are dropped — AD replaces them;
+    every other op after the fill is a post op and still runs."""
+    fill_idx, loss_name = None, None
+    for i, op in enumerate(block.ops):
+        if (op.type == "fill_constant" and op.attr("op_role", 0) == 1):
+            outs = op.output_arg_names
+            if len(outs) == 1 and outs[0].endswith(GRAD):
+                fill_idx, loss_name = i, outs[0][: -len(GRAD)]
+                break
+    if fill_idx is None:                      # inference program: all forward
+        return BlockPlan(list(block.ops), [], None, {})
+
+    fwd_ops = list(block.ops[:fill_idx])
+    post_ops = []
+    for op in block.ops[fill_idx:]:
+        if op.type == "generic_grad":
+            continue
+        if op is block.ops[fill_idx]:
+            continue
+        if (op.type == "sum"
+                and all(GRAD in n for n in op.input_arg_names)
+                and all(GRAD in n for n in op.output_arg_names)):
+            continue                           # partial-grad fan-in sum
+        post_ops.append(op)
+
+    # final grad var per param: prefer the summed name over the raw one
+    names = {n for op in block.ops for n in op.output_arg_names}
+    grad_of = {}
+    for p in block.program.all_parameters():
+        if not p.trainable:
+            continue
+        for cand in (p.name + GRAD + "@SUM", p.name + GRAD):
+            if cand in names:
+                grad_of[p.name] = cand
+                break
+    return BlockPlan(fwd_ops, post_ops, loss_name, grad_of)
+
+
+def _consumed(ops) -> Set[str]:
+    return {n for op in ops for n in op.input_arg_names}
+
+
+def _produced(ops) -> Set[str]:
+    return {n for op in ops for n in op.output_arg_names}
+
+
+# ---------------------------------------------------------------------------
+# Recompute: checkpoint-segmented functional step
+# ---------------------------------------------------------------------------
+
+def split_segments(fwd_ops, checkpoints: Sequence[str]):
+    """Cut the forward op list after the op producing each checkpoint var."""
+    cuts = []
+    remaining = set(checkpoints)
+    for i, op in enumerate(fwd_ops):
+        hit = remaining.intersection(op.output_arg_names)
+        if hit:
+            remaining -= hit
+            cuts.append(i + 1)
+    segs, prev = [], 0
+    for c in cuts:
+        if c > prev:
+            segs.append(fwd_ops[prev:c])
+            prev = c
+    if prev < len(fwd_ops):
+        segs.append(fwd_ops[prev:])
+    return segs
+
+
+def build_functional_step(block, plan: BlockPlan, fetch_names,
+                          mesh_axes, is_test, checkpoints,
+                          written_names):
+    """Executor step fn with whole-forward AD and jax.checkpoint segments.
+
+    Same contract as Executor._prepare's fn:
+      fn(mut_params, ro_params, feeds, step_key) -> (fetches, new_vals)
+    """
+    from ..fluid.executor import run_block_ops
+    from ..ops.registry import LoweringContext
+
+    segs = split_segments(plan.fwd_ops, checkpoints or [])
+    trainables = sorted(plan.grad_of)
+
+    # liveness: what each segment must carry forward (consumed later)
+    later_needs: List[Set[str]] = []
+    need: Set[str] = set(fetch_names) | _consumed(plan.post_ops)
+    if plan.loss_name:
+        need = need | {plan.loss_name}
+    for seg in reversed(segs):
+        later_needs.append(set(need))
+        need = (need - _produced(seg)) | _consumed(seg)
+    later_needs.reverse()
+
+    def fn(mut_params, ro_params, feeds, step_key):
+        env0: Dict[str, Any] = {}
+        env0.update(mut_params)
+        env0.update(ro_params)
+        env0.update(feeds)
+        ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
+                              is_test=is_test)
+        pvals = {n: env0[n] for n in trainables if n in env0}
+        static_env = {n: v for n, v in env0.items() if n not in pvals}
+
+        def loss_fn(p):
+            env = dict(static_env)
+            env.update(p)
+            for seg, keep in zip(segs, later_needs):
+                seg_in = {n: v for n, v in env.items()
+                          if n in _consumed(seg) or n in keep}
+
+                def run_seg(e, _ops=tuple(seg)):
+                    e = dict(e)
+                    run_block_ops(block, e, ctx, ops=list(_ops))
+                    return e
+
+                out = jax.checkpoint(run_seg)(seg_in)
+                env.update(out)
+            loss = env[plan.loss_name]
+            return jnp.sum(loss), env
+
+        if pvals and plan.loss_name:
+            (loss, env), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(pvals)
+            for pname, g in grads.items():
+                env[plan.grad_of[pname]] = g
+        else:
+            _, env = loss_fn(pvals)
+        run_block_ops(block, env, ctx, ops=plan.post_ops)
+        fetches = [env[n] for n in fetch_names]
+        new_vals = {n: env[n] for n in written_names if n in env}
+        return fetches, new_vals
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Pipeline: stage split + GPipe schedule under shard_map
+# ---------------------------------------------------------------------------
+
+def _stage_of(op, current: int) -> int:
+    dev = op.attr("op_device", None) or op.attrs.get("device", None)
+    if not dev:
+        return current
+    if ":" in str(dev):
+        try:
+            return int(str(dev).rsplit(":", 1)[1])
+        except ValueError:
+            return current
+    return current
+
+
+def split_stages(fwd_ops) -> List[List[Any]]:
+    """Partition forward ops into pipeline sections by `op_device`
+    (optimizer.py:3693 `_split_program`).  Unannotated ops inherit the
+    stage of the preceding op."""
+    cur = 0
+    stages: Dict[int, List[Any]] = {}
+    order: List[int] = []
+    for op in fwd_ops:
+        cur = _stage_of(op, cur)
+        if cur not in stages:
+            stages[cur] = []
+            order.append(cur)
+        stages[cur].append(op)
+    idx = sorted(stages)
+    if idx != list(range(len(idx))):
+        raise ValueError(f"pipeline stages must be contiguous 0..S-1, got {idx}")
+    if sorted(order) != order:
+        raise ValueError("ops must be grouped by ascending pipeline stage "
+                         f"(device_guard order was {order})")
+    return [stages[i] for i in idx]
+
+
+def build_pipeline_step(block, plan: BlockPlan, mesh, microbatches: int,
+                        fetch_names, mesh_axes, is_test, written_names,
+                        example_env: Dict[str, Any], feed_names):
+    """Executor step fn running the GPipe schedule over the mesh's pp axis.
+
+    example_env maps var name -> array/ShapeDtypeStruct for params + ONE
+    microbatch of each feed (used to shape the cross-stage carry).
+    """
+    from ..fluid.executor import run_block_ops
+    from ..ops.registry import LoweringContext
+    from jax import shard_map
+
+    if "pp" not in mesh.axis_names:
+        raise ValueError("pipeline mesh needs a 'pp' axis")
+    S = mesh.shape["pp"]
+    M = int(microbatches)
+    stages = split_stages(plan.fwd_ops)
+    if len(stages) != S:
+        raise ValueError(f"program has {len(stages)} device_guard stages but "
+                         f"mesh pp={S}")
+    if plan.loss_name is None:
+        raise ValueError("pipeline execution needs a training program "
+                         "(append_backward/minimize must have run)")
+    trainables = sorted(plan.grad_of)
+
+    # ---- discover cross-stage boundary vars + their microbatch shapes ------
+    produced_by_stage = [_produced(s) for s in stages]
+    consumed_by_stage = [_consumed(s) for s in stages]
+    boundary: Set[str] = set()
+    for t in range(S - 1):
+        before = set().union(*produced_by_stage[: t + 1])
+        after = set().union(*consumed_by_stage[t + 1:])
+        cross = (before & after) - set(example_env)   # params/feeds are local
+        boundary |= cross
+    boundary_names = sorted(boundary)
+
+    dummy_key = jax.random.PRNGKey(0)
+
+    def _abstract_stage(s):
+        def f(env):
+            ctx = LoweringContext(base_key=dummy_key, mesh_axes={},
+                                  is_test=is_test)
+            env = dict(env)
+            run_block_ops(block, env, ctx, ops=stages[s])
+            return env
+        return f
+
+    env_struct = {n: jax.eval_shape(lambda v=v: jnp.asarray(v))
+                  if not isinstance(v, jax.ShapeDtypeStruct) else v
+                  for n, v in example_env.items()}
+    probe = dict(env_struct)
+    for s in range(S):
+        probe = jax.eval_shape(_abstract_stage(s), probe)
+    carry_struct = {n: jax.ShapeDtypeStruct(probe[n].shape, probe[n].dtype)
+                    for n in boundary_names}
+
+    # ---- per-device GPipe schedule ----------------------------------------
+    # pipeline fetches: only the loss, persistables/params and post-op
+    # outputs survive the schedule (forward activations are per-microbatch
+    # switch-internal) — fail at compile time with a clear message
+    fetchable = (set(example_env) | {plan.loss_name}
+                 | _produced(plan.post_ops)
+                 | set(plan.grad_of.values()))
+    bad = [n for n in fetch_names if n not in fetchable]
+    if bad:
+        raise ValueError(
+            f"pipeline execution cannot fetch forward intermediates {bad}; "
+            f"fetch the loss, persistable vars, or optimizer outputs")
+
+    def device_fn(mut_params, ro_params, feeds, step_key):
+        env0: Dict[str, Any] = {}
+        env0.update(mut_params)
+        env0.update(ro_params)
+        ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
+                              is_test=is_test)
+        stage_idx = lax.axis_index("pp")
+        pvals = {n: env0[n] for n in trainables if n in env0}
+        static_env = {n: v for n, v in env0.items() if n not in pvals}
+
+        # split feeds into M microbatches on axis 0
+        def mb_of(v, i):
+            b = v.shape[0]
+            if b % M:
+                raise ValueError(f"batch {b} not divisible by {M} microbatches")
+            return lax.dynamic_slice_in_dim(v, i * (b // M), b // M, 0)
+
+        def make_branch(s, step_ctx):
+            def branch(carry, mb_feeds, p):
+                env = dict(static_env)
+                env.update(p)
+                env.update(mb_feeds)
+                env.update({n: carry[n] for n in boundary_names})
+                run_block_ops(block, env, step_ctx, ops=stages[s])
+                new_carry = {
+                    n: (env[n].astype(carry[n].dtype) if n in env
+                        else carry[n])
+                    for n in boundary_names}
+                if s == S - 1:
+                    lc = jnp.sum(env[plan.loss_name]).astype(jnp.float32)
+                else:
+                    lc = jnp.float32(0.0)
+                return new_carry, lc
+            return branch
+
+        ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def loss_fn(p):
+            carry = {n: jnp.zeros(st.shape, st.dtype)
+                     for n, st in carry_struct.items()}
+            total = jnp.float32(0.0)
+            for step in range(M + S - 1):
+                # stage s processes microbatch (step - s): index feeds
+                # per-stage so e.g. the last stage's labels line up with
+                # the activations that just arrived (section_worker.cc
+                # keeps per-section scopes for the same reason)
+                i = jnp.clip(step - stage_idx, 0, M - 1)
+                mb_feeds = {k: mb_of(v, i) for k, v in feeds.items()}
+                # fresh RNG per schedule step so each microbatch draws its
+                # own dropout masks (SectionWorker draws per microbatch)
+                step_ctx = LoweringContext(
+                    base_key=jax.random.fold_in(step_key, 7919 + step),
+                    mesh_axes=mesh_axes, is_test=is_test)
+                branches = [make_branch(s, step_ctx) for s in range(S)]
+                carry, lc = lax.switch(stage_idx, branches, carry, mb_feeds, p)
+                if step >= S - 1:
+                    total = total + lc
+                if S > 1:
+                    carry = lax.ppermute(carry, "pp", ring)  # send/recv_v2
+            # return the LOCAL loss (nonzero only on the last stage): a psum
+            # here would double-count under per-device AD — the transpose of
+            # psum sums the per-rank cotangents, scaling grads by S
+            return total / M
+
+        local_loss, grads = jax.value_and_grad(loss_fn)(pvals)
+        loss = lax.psum(local_loss, "pp") if S > 1 else local_loss
+        if S > 1:   # each grad is nonzero only on its owning stage
+            grads = {k: lax.psum(g, "pp") for k, g in grads.items()}
+
+        env = dict(static_env)
+        env.update(pvals)
+        env.update(feeds)
+        env[plan.loss_name] = loss
+        for pname, g in grads.items():
+            env[plan.grad_of[pname]] = g
+        run_block_ops(block, env, ctx, ops=plan.post_ops)
+        fetches = [env[n] for n in fetch_names]
+        new_vals = {n: env[n] for n in written_names if n in env}
+        return fetches, new_vals
+
+    from jax.sharding import PartitionSpec as P
+    repl = P()
+    sharded = shard_map(device_fn, mesh=mesh,
+                        in_specs=(repl, repl, repl, repl),
+                        out_specs=(repl, repl), check_vma=False)
+    return jax.jit(sharded)
