@@ -95,7 +95,12 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
         if call_op is not None:
             outs = call_op(opdef, ins, op.attrs, ctx)
         else:
-            outs = opdef.fn(ins, op.attrs, ctx)
+            if "SkipUpdate" in ins:   # GradientMerge k-step gate
+                from ..ops.optimizer_ops import apply_skip_update
+                plain = {k: v for k, v in ins.items() if k != "SkipUpdate"}
+                outs = apply_skip_update(ins, opdef.fn(plain, op.attrs, ctx))
+            else:
+                outs = opdef.fn(ins, op.attrs, ctx)
         for slot, names in op.outputs.items():
             produced = outs.get(slot, [])
             for name, val in zip(names, produced):

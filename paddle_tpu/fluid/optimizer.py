@@ -393,18 +393,77 @@ class _EmaGuard:
 
 
 class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (optimizer.py:3132 +
+    operators/average_accumulates_op.h).  Construct AFTER the training
+    optimizer's minimize(): appends one `average_accumulates` op per param
+    to the main program; `apply()` swaps params for
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) and
+    `restore()` swaps back."""
+
     def __init__(self, average_window_rate, min_average_window=10000,
                  max_average_window=10000, **kw):
         super().__init__(0.0, **kw)
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._backup = {}
+        self._params = [p for p in default_main_program().all_parameters()
+                        if getattr(p, "do_model_average", None) is not False]
+        for p in self._params:
+            self._append_average_accumulate_op(p)
+
+    def _append_average_accumulate_op(self, param):
+        s1 = self._add_accumulator("sum_1", param)
+        s2 = self._add_accumulator("sum_2", param)
+        s3 = self._add_accumulator("sum_3", param)
+        na = self._add_accumulator("num_accumulates", param, 0.0, [1])
+        ona = self._add_accumulator("old_num_accumulates", param, 0.0, [1])
+        nu = self._add_accumulator("num_updates", param, 0.0, [1])
+        return self.helper.append_op(
+            "average_accumulates",
+            inputs={"param": [param], "in_sum_1": [s1], "in_sum_2": [s2],
+                    "in_sum_3": [s3], "in_num_accumulates": [na],
+                    "in_old_num_accumulates": [ona], "in_num_updates": [nu]},
+            outputs={"out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+                     "out_num_accumulates": [na],
+                     "out_old_num_accumulates": [ona],
+                     "out_num_updates": [nu]},
+            attrs={"average_window": self.average_window,
+                   "min_average_window": self.min_average_window,
+                   "max_average_window": self.max_average_window})
 
     def _append_optimize_op(self, param, grad):
         return None
 
     def apply(self, executor=None, need_restore=True):
-        return _EmaGuard(ExponentialMovingAverage())
+        from .core import global_scope
+        scope = global_scope()
+        for p in self._params:
+            cur = scope.find_var(p.name)
+            if cur is None:
+                continue
+            s = (np.asarray(scope.find_var(self._acc_name("sum_1", p)))
+                 + np.asarray(scope.find_var(self._acc_name("sum_2", p)))
+                 + np.asarray(scope.find_var(self._acc_name("sum_3", p))))
+            n = (np.asarray(scope.find_var(
+                    self._acc_name("num_accumulates", p))).reshape(-1)[0]
+                 + np.asarray(scope.find_var(
+                    self._acc_name("old_num_accumulates", p))).reshape(-1)[0])
+            if n > 0:
+                if need_restore:
+                    self._backup[p.name] = np.asarray(cur).copy()
+                scope.set_var(p.name, (s / n).astype(np.asarray(cur).dtype))
+        return _EmaGuard(self)   # no-op exit when nothing was backed up
+
+    def _acc_name(self, kind, param):
+        return self._accumulators[kind][param.name].name
 
     def restore(self, executor=None):
-        pass
+        from .core import global_scope
+        scope = global_scope()
+        for name, val in self._backup.items():
+            scope.set_var(name, val)
+        self._backup = {}
 
 
 class RecomputeOptimizer(Optimizer):
@@ -458,7 +517,6 @@ class GradientMergeOptimizer(Optimizer):
         helper.append_op("increment", inputs={"X": [step]},
                          outputs={"Out": [step]}, attrs={"step": 1.0})
         merged = []
-        do_apply = None
         for p, g in pg:
             acc = layers.create_global_var(list(p.shape), 0.0, p.dtype,
                                            persistable=True,
@@ -466,32 +524,70 @@ class GradientMergeOptimizer(Optimizer):
             gsum = layers.sums([acc, g])
             layers.assign(gsum, acc)
             merged.append((p, acc))
-        # apply every k steps: scaled grads, then reset accumulators
+        # apply every k steps: scaled accumulated grads; on the k-1 other
+        # steps the update ops are SKIPPED outright via the SkipUpdate
+        # gate (reference optimizer.py:4969 runs them under a conditional
+        # block) — feeding zero grads instead would still decay Adam's
+        # moments and advance beta powers on every step
         k_const = layers.fill_constant([1], "float32", float(self._k))
-        from .layers.control_flow import greater_equal
-        cond_v = greater_equal(step, k_const)
+        from .layers.control_flow import less_than
+        skip_v = less_than(step, k_const)
+        gate = 1.0 - layers.cast(skip_v, "float32")
         scale = 1.0 / self._k if self._avg else 1.0
         applied_pg = [(p, layers.scale(a, scale=scale)) for p, a in merged]
-        # mask update: param' = cond ? updated : param  — emulate by scaling
-        # the effective LR with the condition
-        gate = layers.cast(cond_v, "float32")
-        gated_pg = [(p, g * gate) for p, g in applied_pg]
-        ops = self._inner.apply_gradients(gated_pg)
+        ops = self._inner.apply_gradients(applied_pg)
+        for op in ops:
+            if op is not None and hasattr(op, "inputs"):
+                op.inputs["SkipUpdate"] = [skip_v.name]
         # reset: acc *= (1 - gate); step *= (1 - gate)
         for p, a in merged:
-            layers.assign(layers.scale(a, scale=1.0) * (1.0 - gate), a)
+            layers.assign(a * (1.0 - gate), a)
         layers.assign(step * (1.0 - gate), step)
-        return ops, gated_pg
+        return ops, applied_pg
 
 
 class LookaheadOptimizer:
+    """Lookahead (optimizer.py:5174): fast weights step every iteration;
+    every k steps the slow weights move toward the fast ones
+    (slow += alpha * (fast - slow)) and the fast weights reset to slow.
+    The k-step gate is branch-free: where(apply, new, old) on both copies."""
+
     def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        assert inner_optimizer is not None
+        assert 0.0 <= alpha <= 1.0
+        assert k >= 1
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
         self.k = k
 
     def minimize(self, loss, startup_program=None):
-        return self.inner_optimizer.minimize(loss, startup_program)
+        ops, pg = self.inner_optimizer.minimize(loss, startup_program)
+        step = layers.create_global_var([1], 0.0, "float32",
+                                        persistable=True,
+                                        name=unique_name("la_step"))
+        helper = LayerHelper("lookahead")
+        helper.append_op("increment", inputs={"X": [step]},
+                         outputs={"Out": [step]}, attrs={"step": 1.0})
+        k_const = layers.fill_constant([1], "float32", float(self.k))
+        from .layers.control_flow import greater_equal
+        apply_v = greater_equal(step, k_const)
+        gate = layers.cast(apply_v, "float32")     # 1.0 on sync steps
+        sb = default_startup_program().global_block()
+        for p, g in pg:
+            slow = layers.create_global_var(
+                list(p.shape), 0.0, p.dtype, persistable=True,
+                name=unique_name(p.name + "_la_slow"))
+            # slow weights start AT the initial params (reference lookahead
+            # startup assign), not at zero
+            sb.append_op("assign", inputs={"X": [p.name]},
+                         outputs={"Out": [slow.name]})
+            # slow' = slow + gate*alpha*(fast - slow); fast' = gated slow'
+            delta = layers.scale(p - slow, scale=self.alpha)
+            new_slow = slow + delta * gate
+            layers.assign(new_slow, slow)
+            layers.assign(p + (new_slow - p) * gate, p)
+        layers.assign(step * (1.0 - gate), step)
+        return ops, pg
 
 
 class PipelineOptimizer:

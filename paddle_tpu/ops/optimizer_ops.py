@@ -271,3 +271,73 @@ def _localsgd_select(ins, attrs, ctx):
     do_sync = jnp.logical_and(step >= begin,
                               jnp.mod(step, jnp.maximum(k, 1.0)) == 0)
     return {"ParamOut": [jnp.where(do_sync, avg, p)]}
+
+
+@register_op("average_accumulates", differentiable=False)
+def _average_accumulates(ins, attrs, ctx):
+    """Sliding-window parameter accumulation for ModelAverage.
+
+    Reference: paddle/fluid/operators/average_accumulates_op.h — sum_1
+    accumulates the param each step; once the window fills
+    (num_accumulates >= max(min_average_window,
+    min(max_average_window, num_updates * average_window_rate))) the sums
+    shift (sum_3 <- sum_2 <- sum_1 <- 0).  Branch-free via jnp.where so the
+    whole thing stays one fused XLA kernel."""
+    p = _p(ins, "param")
+    s1, s2, s3 = _p(ins, "in_sum_1"), _p(ins, "in_sum_2"), _p(ins, "in_sum_3")
+    na = _p(ins, "in_num_accumulates").reshape(()).astype(jnp.float32)
+    ona = _p(ins, "in_old_num_accumulates").reshape(()).astype(jnp.float32)
+    nu = _p(ins, "in_num_updates").reshape(()).astype(jnp.float32)
+    rate = attrs.get("average_window", 0.0)
+    min_w = attrs.get("min_average_window", 10000)
+    max_w = attrs.get("max_average_window", 10000)
+
+    s1 = s1 + p
+    na = na + 1.0
+    nu = nu + 1.0
+    # precision shuffle every 16384 updates (reference kMaxNumAccumulates)
+    shuffle = jnp.mod(nu, 16384.0) == 0
+    s2 = jnp.where(shuffle, s2 + s1, s2)
+    s1 = jnp.where(shuffle, jnp.zeros_like(s1), s1)
+    # window overflow: sum_3 REPLACED by the completed window (s1+s2)
+    window = jnp.minimum(jnp.float32(max_w), nu * rate)
+    shift = jnp.logical_and(na >= min_w, na >= window)
+    out_s1 = jnp.where(shift, jnp.zeros_like(s1), s1)
+    out_s2 = jnp.where(shift, jnp.zeros_like(s2), s2)
+    out_s3 = jnp.where(shift, s1 + s2, s3)
+    out_ona = jnp.where(shift, na, ona)
+    out_na = jnp.where(shift, jnp.float32(0.0), na)
+    one = lambda x: x.reshape(1)
+    return {"out_sum_1": [out_s1], "out_sum_2": [out_s2],
+            "out_sum_3": [out_s3], "out_num_accumulates": [one(out_na)],
+            "out_old_num_accumulates": [one(out_ona)],
+            "out_num_updates": [one(nu)]}
+
+
+# ---------------------------------------------------------------------------
+# SkipUpdate gating: GradientMergeOptimizer attaches a boolean SkipUpdate
+# input to the update ops it appends; on skip steps EVERY output (param,
+# moments, beta pows) keeps its old value — matching the reference, which
+# runs the optimizer ops only on the k-th step (optimizer.py:4969) instead
+# of feeding them zero grads (zero grads still decay Adam/momentum state).
+# Applied generically by the executor (run_block_ops) for any op carrying
+# a SkipUpdate input, so it works for every update-op family regardless of
+# registration order.
+# ---------------------------------------------------------------------------
+
+def apply_skip_update(ins, outs):
+    """where(skip, old, new) every 'XOut' output against its 'X' input."""
+    skip_in = ins.get("SkipUpdate")
+    if not skip_in:
+        return outs
+    skip = skip_in[0].reshape(()).astype(bool)
+    gated_outs = {}
+    for slot, vals in outs.items():
+        src = slot[:-3] if slot.endswith("Out") else None
+        olds = ins.get(src, []) if src else []
+        kept = []
+        for i, new in enumerate(vals):
+            old = olds[i] if i < len(olds) else None
+            kept.append(new if old is None else jnp.where(skip, old, new))
+        gated_outs[slot] = kept
+    return gated_outs
