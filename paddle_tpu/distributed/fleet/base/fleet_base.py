@@ -88,13 +88,24 @@ class Fleet:
         self._role_maker._barrier("worker")
 
     # -- PS runtime ---------------------------------------------------------
+    def _ensure_runtime(self):
+        """Servers never call minimize, so build the runtime handle lazily
+        (reference the_one_ps builds it from env in both roles)."""
+        if self._runtime_handle is None and self._role_maker is not None:
+            from ...ps.the_one_ps import TheOnePSRuntime
+            self._runtime_handle = TheOnePSRuntime(
+                self._role_maker, self._user_defined_strategy)
+        return self._runtime_handle
+
     def init_worker(self):
-        if self._runtime_handle is not None:
-            self._runtime_handle.init_worker()
+        handle = self._ensure_runtime()
+        if handle is not None:
+            handle.init_worker()
 
     def init_server(self, *args, **kwargs):
-        if self._runtime_handle is not None:
-            self._runtime_handle.init_server(*args, **kwargs)
+        handle = self._ensure_runtime()
+        if handle is not None:
+            handle.init_server(*args, **kwargs)
 
     def run_server(self):
         if self._runtime_handle is not None:

@@ -1,0 +1,397 @@
+"""PS RPC plane: TCP client/server for cross-process table access.
+
+Reference: paddle/fluid/distributed/service/brpc_ps_server.cc +
+brpc_ps_client.cc (the brpc dataplane serving PsService: PULL_SPARSE,
+PUSH_SPARSE, PULL_DENSE, PUSH_DENSE, BARRIER, SAVE/LOAD/STOP — ps.proto)
+and operators/distributed/grpc/.  TPU-native: the payloads are raw
+C-contiguous ndarray bytes behind a tiny JSON header (no protobuf/pickle on
+tensors — the wire cost is one memcpy per array each way), threaded
+blocking sockets (one connection per worker per server, the brpc
+channel analog), and id-sharding across servers by `id % n_servers`
+(RoundRobin dispatcher semantics).
+
+Frame format (both directions):
+    u32 header_len | header json utf-8 | raw array bytes...
+header = {"op": str, ...meta, "arrays": [{"dtype": str, "shape": [...]}]}
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .table import (BarrierTable, CommonDenseTable, CommonSparseTable,
+                    Initializer)
+
+_U32 = struct.Struct("!I")
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def _recv_into(sock, view: memoryview):
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+def send_msg(sock, header: dict, arrays: Sequence[np.ndarray] = ()):
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    header = dict(header)
+    header["arrays"] = [{"dtype": a.dtype.str, "shape": list(a.shape)}
+                        for a in arrays]
+    hb = json.dumps(header).encode()
+    parts = [_U32.pack(len(hb)), hb]
+    parts += [memoryview(a).cast("B") for a in arrays]
+    sock.sendall(b"".join(parts))
+
+
+def recv_msg(sock):
+    (hlen,) = _U32.unpack(_recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    arrays = []
+    for spec in header.pop("arrays", []):
+        # recv straight into the destination buffer: one traversal, owned
+        # and writable (the design's one-memcpy-per-array contract)
+        a = np.empty(tuple(spec["shape"]), np.dtype(spec["dtype"]))
+        _recv_into(sock, memoryview(a).cast("B"))
+        arrays.append(a)
+    return header, arrays
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class PsServer:
+    """One table shard server (brpc_ps_server.cc analog).
+
+    Owns the rows whose `id % n_servers == shard_idx`; ids arrive already
+    partitioned by the client, so tables here simply store what they're
+    given."""
+
+    def __init__(self, host="127.0.0.1", port=0, shard_idx=0, n_servers=1,
+                 n_trainers=1):
+        self.shard_idx = shard_idx
+        self.n_servers = n_servers
+        self.sparse: Dict[str, CommonSparseTable] = {}
+        self.dense: Dict[str, CommonDenseTable] = {}
+        self.barrier_table = BarrierTable(n_trainers)
+        self._stop = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        header, arrays = recv_msg(sock)
+                        try:
+                            reply, out = outer._dispatch(header, arrays)
+                        except Exception as e:   # noqa: BLE001 — report,
+                            # don't kill the connection on a bad request
+                            reply, out = {"ok": False,
+                                          "error": f"{type(e).__name__}: "
+                                                   f"{e}"}, []
+                        send_msg(sock, reply, out)
+                        if header.get("op") == "stop":
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self.endpoint = f"{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch(self, header, arrays):
+        op = header["op"]
+        if op == "create_sparse":
+            name = header["table"]
+            if name not in self.sparse:
+                # seed initializer per (table, shard) so shards don't
+                # duplicate row values but runs stay reproducible
+                init = Initializer(header.get("init_kind", "uniform"),
+                                   header.get("init_scale", 0.07),
+                                   seed=header.get("seed", 0) * 131
+                                   + self.shard_idx)
+                self.sparse[name] = CommonSparseTable(
+                    header["dim"], header.get("optimizer", "sgd"),
+                    header.get("lr", 0.01), initializer=init)
+            return {"ok": True}, []
+        if op == "create_dense":
+            name = header["table"]
+            if name not in self.dense:
+                self.dense[name] = CommonDenseTable(
+                    header["shape"], header.get("optimizer", "sgd"),
+                    header.get("lr", 0.01))
+            return {"ok": True}, []
+        if op == "pull_sparse":
+            t = self.sparse[header["table"]]
+            return {"ok": True}, [t.pull(arrays[0])]
+        if op == "push_sparse":
+            self.sparse[header["table"]].push(arrays[0], arrays[1])
+            return {"ok": True}, []
+        if op == "push_sparse_delta":
+            self.sparse[header["table"]].push_delta(arrays[0], arrays[1])
+            return {"ok": True}, []
+        if op == "pull_dense":
+            return {"ok": True}, [self.dense[header["table"]].pull()]
+        if op == "push_dense":
+            self.dense[header["table"]].push(arrays[0])
+            return {"ok": True}, []
+        if op == "push_dense_delta":
+            self.dense[header["table"]].push_delta(arrays[0])
+            return {"ok": True}, []
+        if op == "set_dense":
+            self.dense[header["table"]].set(arrays[0])
+            return {"ok": True}, []
+        if op == "barrier":
+            ok = self.barrier_table.barrier(header.get("timeout", 60.0))
+            return {"ok": ok}, []
+        if op == "save":
+            import os
+            d = header["dirname"]
+            os.makedirs(d, exist_ok=True)
+            for name, t in self.sparse.items():
+                t.save(os.path.join(
+                    d, f"{name}.shard{self.shard_idx}.sparse"))
+            for name, t in self.dense.items():
+                np.save(os.path.join(d, f"{name}.shard{self.shard_idx}.npy"),
+                        t.pull())
+            return {"ok": True}, []
+        if op == "size":
+            t = self.sparse[header["table"]]
+            return {"ok": True, "size": t.size()}, []
+        if op == "ping":
+            return {"ok": True, "shard": self.shard_idx}, []
+        if op == "stop":
+            self._stop.set()
+            return {"ok": True}, []
+        return {"ok": False, "error": f"unknown op {op}"}, []
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def wait(self):
+        """Block until a client sends `stop` (run_server serving loop)."""
+        self._stop.wait()
+        self._server.shutdown()
+
+    def stop(self):
+        self._stop.set()
+        self._server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class PsClient:
+    """Partitions ids over server shards and moves rows/grads on raw
+    sockets (brpc_ps_client.cc analog)."""
+
+    def __init__(self, endpoints: Sequence[str], timeout=60.0):
+        self.endpoints = list(endpoints)
+        self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
+        self._locks = [threading.Lock() for _ in endpoints]
+        self.timeout = timeout
+        self._sparse_dims: Dict[str, int] = {}
+
+    def _sock(self, i):
+        if self._socks[i] is None:
+            import time
+            host, port = self.endpoints[i].rsplit(":", 1)
+            deadline = time.monotonic() + self.timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=self.timeout)
+                    break
+                except OSError:
+                    # server process may still be starting (brpc clients
+                    # retry the channel the same way)
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.3)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, i, header, arrays=()):
+        with self._locks[i]:
+            try:
+                sock = self._sock(i)
+                send_msg(sock, header, arrays)
+                reply, out = recv_msg(sock)
+            except (OSError, ConnectionError):
+                # drop the poisoned socket so the next call reconnects
+                if self._socks[i] is not None:
+                    try:
+                        self._socks[i].close()
+                    except OSError:
+                        pass
+                    self._socks[i] = None
+                raise
+        if not reply.get("ok", False):
+            raise RuntimeError(f"ps rpc {header['op']} failed on "
+                               f"{self.endpoints[i]}: {reply}")
+        return reply, out
+
+    def _fanout(self, op_name, shard_fn, shards=None):
+        """Run shard_fn(i) on each shard index in parallel; raise if any
+        failed (the brpc parallel-channel pattern, shared by every
+        multi-shard op)."""
+        shards = range(len(self.endpoints)) if shards is None else shards
+        errs = []
+
+        def one(i):
+            try:
+                shard_fn(i)
+            except Exception as e:           # noqa: BLE001 — re-raised below
+                errs.append((self.endpoints[i], e))
+
+        ts = [threading.Thread(target=one, args=(i,)) for i in shards]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise RuntimeError(f"ps rpc {op_name} failed: {errs}")
+
+    def _call_all(self, header, arrays=()):
+        """Fan a request to every server in parallel."""
+        results = [None] * len(self.endpoints)
+
+        def one(i):
+            results[i] = self._call(i, header, arrays)
+
+        self._fanout(header["op"], one)
+        return results
+
+    # -- table management ---------------------------------------------------
+    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
+                            seed=0, init_kind="uniform", init_scale=0.07):
+        self._sparse_dims[name] = dim
+        self._call_all({"op": "create_sparse", "table": name, "dim": dim,
+                        "optimizer": optimizer, "lr": lr, "seed": seed,
+                        "init_kind": init_kind, "init_scale": init_scale})
+
+    def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01):
+        self._call_all({"op": "create_dense", "table": name,
+                        "shape": list(shape), "optimizer": optimizer,
+                        "lr": lr})
+
+    def _dense_owner(self, name) -> int:
+        # deterministic across processes (str hash is salted per process)
+        import zlib
+        return zlib.crc32(name.encode()) % len(self.endpoints)
+
+    # -- sparse -------------------------------------------------------------
+    def _partition(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        owner = ids % len(self.endpoints)
+        return ids, owner
+
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        ids, owner = self._partition(ids)
+        dim = self._sparse_dims.get(name, 0)
+        out = np.empty((len(ids), dim), np.float32)
+        lock = threading.Lock()
+
+        def one(s):
+            nonlocal out
+            sel = np.nonzero(owner == s)[0]
+            if not len(sel):
+                return
+            _, arrs = self._call(s, {"op": "pull_sparse", "table": name},
+                                 [ids[sel]])
+            with lock:
+                if out.shape[1] != arrs[0].shape[1]:
+                    out = np.empty((len(ids), arrs[0].shape[1]), np.float32)
+                out[sel] = arrs[0]
+
+        self._fanout(f"pull_sparse({name})", one)
+        return out
+
+    def push_sparse(self, name, ids, grads, delta=False):
+        ids, owner = self._partition(ids)
+        if not len(ids):
+            return
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        op = "push_sparse_delta" if delta else "push_sparse"
+
+        def one(s):
+            sel = np.nonzero(owner == s)[0]
+            if not len(sel):
+                return
+            self._call(s, {"op": op, "table": name}, [ids[sel], grads[sel]])
+
+        self._fanout(f"{op}({name})", one)
+
+    # -- dense --------------------------------------------------------------
+    def pull_dense(self, name) -> np.ndarray:
+        _, arrs = self._call(self._dense_owner(name),
+                             {"op": "pull_dense", "table": name})
+        return arrs[0]
+
+    def push_dense(self, name, grad, delta=False):
+        op = "push_dense_delta" if delta else "push_dense"
+        self._call(self._dense_owner(name), {"op": op, "table": name},
+                   [np.asarray(grad, np.float32)])
+
+    def set_dense(self, name, value):
+        self._call(self._dense_owner(name),
+                   {"op": "set_dense", "table": name},
+                   [np.asarray(value, np.float32)])
+
+    # -- control ------------------------------------------------------------
+    def barrier(self, timeout=60.0):
+        self._call_all({"op": "barrier", "timeout": timeout})
+
+    def save(self, dirname):
+        self._call_all({"op": "save", "dirname": dirname})
+
+    def stop_server(self):
+        try:
+            self._call_all({"op": "stop"})
+        except Exception:                    # noqa: BLE001 — teardown race
+            pass
+        self.close()
+
+    def ping(self):
+        return [r[0]["shard"] for r in self._call_all({"op": "ping"})]
+
+    def close(self):
+        for i, s in enumerate(self._socks):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+                self._socks[i] = None

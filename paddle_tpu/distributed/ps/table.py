@@ -5,10 +5,14 @@ Reference: paddle/fluid/distributed/table/ (`CommonDenseTable`,
 config in ps.proto:53-124 (embedx_dim, learning-rate semantics live in the
 table, not the trainer).  TPU-native: the sparse tier stays on the host —
 unbounded vocab cannot live in HBM — and the dense compute path pulls rows
-into a padded device batch, pushes gradients back after the step.  The
-`GlobalShuffle`-era RPC plane is replaced by in-process sharding (one table
-shard per host process; cross-host goes over DCN via jax.distributed
-primitives when multi-process).
+into a padded device batch, pushes gradients back after the step.
+
+Storage is vectorized: one contiguous value matrix (plus optimizer-state
+matrices) grown by doubling, with a Python dict as the id -> row-slot hash
+(the analog of large_scale_kv.h's shard maps).  All pull/push math is
+numpy-vectorized over the batch — no per-id Python loops — so CTR-scale
+vocabularies stream at memcpy speed.  Cross-process access goes through
+the RPC plane in rpc.py.
 """
 from __future__ import annotations
 
@@ -38,83 +42,148 @@ class CommonSparseTable:
     (large_scale_kv.h + common_sparse_table.cc semantics)."""
 
     def __init__(self, dim, optimizer="sgd", lr=0.01, initializer=None,
-                 beta1=0.9, beta2=0.999, epsilon=1e-8):
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, capacity=1024):
         self.dim = dim
         self.optimizer = optimizer
         self.lr = lr
         self.init = initializer or Initializer()
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
-        self._rows: Dict[int, np.ndarray] = {}
-        self._m: Dict[int, np.ndarray] = {}
-        self._v: Dict[int, np.ndarray] = {}
-        self._t: Dict[int, int] = {}
+        self._slot_of: Dict[int, int] = {}       # id -> row index
+        self._n = 0
+        self._vals = np.zeros((capacity, dim), np.float32)
+        self._m: Optional[np.ndarray] = None     # adam moment1
+        self._v: Optional[np.ndarray] = None     # adam moment2 / adagrad acc
+        self._t: Optional[np.ndarray] = None     # adam per-row step
         self._lock = threading.Lock()
 
+    # -- storage ------------------------------------------------------------
+    def _grow(self, need):
+        cap = len(self._vals)
+        if need <= cap:
+            return
+        while cap < need:
+            cap *= 2
+        grown = np.zeros((cap, self.dim), np.float32)
+        grown[: self._n] = self._vals[: self._n]
+        self._vals = grown
+        for attr in ("_m", "_v"):
+            arr = getattr(self, attr)
+            if arr is not None:
+                g = np.zeros((cap, self.dim), np.float32)
+                g[: self._n] = arr[: self._n]
+                setattr(self, attr, g)
+        if self._t is not None:
+            t = np.zeros(cap, np.int64)
+            t[: self._n] = self._t[: self._n]
+            self._t = t
+
+    def _slots(self, uniq_ids) -> np.ndarray:
+        """Map ids -> row slots, batch-creating missing rows."""
+        slots = np.empty(len(uniq_ids), np.int64)
+        missing = []
+        for k, i in enumerate(uniq_ids):
+            s = self._slot_of.get(i, -1)
+            if s < 0:
+                missing.append(k)
+            slots[k] = s
+        if missing:
+            self._grow(self._n + len(missing))
+            fresh = self.init(len(missing), self.dim)
+            for j, k in enumerate(missing):
+                s = self._n
+                self._n += 1
+                self._slot_of[uniq_ids[k]] = s
+                slots[k] = s
+                self._vals[s] = fresh[j]
+        return slots
+
+    def _ensure_state(self, want_t=False):
+        cap = len(self._vals)
+        if self._v is None:
+            self._v = np.zeros((cap, self.dim), np.float32)
+        if self.optimizer == "adam" and self._m is None:
+            self._m = np.zeros((cap, self.dim), np.float32)
+        if want_t and self._t is None:
+            self._t = np.zeros(cap, np.int64)
+
+    # -- accessor API -------------------------------------------------------
     def pull(self, ids: np.ndarray) -> np.ndarray:
         """PullSparse: gather rows, creating missing ids (fleet_wrapper.h:111)."""
         ids = np.asarray(ids).reshape(-1)
-        out = np.empty((len(ids), self.dim), np.float32)
         with self._lock:
-            missing = [i for i in set(ids.tolist()) if i not in self._rows]
-            if missing:
-                fresh = self.init(len(missing), self.dim)
-                for k, i in enumerate(missing):
-                    self._rows[i] = fresh[k]
-            for k, i in enumerate(ids.tolist()):
-                out[k] = self._rows[i]
-        return out
+            uniq, inv = np.unique(ids, return_inverse=True)
+            slots = self._slots(uniq.tolist())
+            return self._vals[slots][inv]
 
     def push(self, ids: np.ndarray, grads: np.ndarray):
         """PushSparse: apply grads with the table's optimizer
         (fleet_wrapper.h:200; duplicate ids sum like SelectedRows merge)."""
         ids = np.asarray(ids).reshape(-1)
-        grads = np.asarray(grads).reshape(len(ids), self.dim)
-        # merge duplicate ids (selected_rows_functor::MergeAdd)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
         uniq, inv = np.unique(ids, return_inverse=True)
         merged = np.zeros((len(uniq), self.dim), np.float32)
         np.add.at(merged, inv, grads)
         with self._lock:
-            for k, i in enumerate(uniq.tolist()):
-                g = merged[k]
-                row = self._rows.get(i)
-                if row is None:
-                    row = self.init(1, self.dim)[0]
-                if self.optimizer == "sgd":
-                    row = row - self.lr * g
-                elif self.optimizer == "adagrad":
-                    acc = self._v.get(i, np.zeros(self.dim, np.float32))
-                    acc = acc + g * g
-                    self._v[i] = acc
-                    row = row - self.lr * g / (np.sqrt(acc) + self.epsilon)
-                elif self.optimizer == "adam":
-                    m = self._m.get(i, np.zeros(self.dim, np.float32))
-                    v = self._v.get(i, np.zeros(self.dim, np.float32))
-                    t = self._t.get(i, 0) + 1
-                    m = self.beta1 * m + (1 - self.beta1) * g
-                    v = self.beta2 * v + (1 - self.beta2) * g * g
-                    mh = m / (1 - self.beta1 ** t)
-                    vh = v / (1 - self.beta2 ** t)
-                    row = row - self.lr * mh / (np.sqrt(vh) + self.epsilon)
-                    self._m[i], self._v[i], self._t[i] = m, v, t
-                else:
-                    raise ValueError(f"unknown accessor {self.optimizer}")
-                self._rows[i] = row
+            slots = self._slots(uniq.tolist())
+            if self.optimizer == "sgd":
+                self._vals[slots] -= self.lr * merged
+            elif self.optimizer == "adagrad":
+                self._ensure_state()
+                acc = self._v[slots] + merged * merged
+                self._v[slots] = acc
+                self._vals[slots] -= (self.lr * merged
+                                      / (np.sqrt(acc) + self.epsilon))
+            elif self.optimizer == "adam":
+                self._ensure_state(want_t=True)
+                t = self._t[slots] + 1
+                self._t[slots] = t
+                m = self.beta1 * self._m[slots] + (1 - self.beta1) * merged
+                v = (self.beta2 * self._v[slots]
+                     + (1 - self.beta2) * merged * merged)
+                self._m[slots], self._v[slots] = m, v
+                mh = m / (1 - self.beta1 ** t[:, None])
+                vh = v / (1 - self.beta2 ** t[:, None])
+                self._vals[slots] -= self.lr * mh / (np.sqrt(vh)
+                                                     + self.epsilon)
+            else:
+                raise ValueError(f"unknown accessor {self.optimizer}")
+
+    def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
+        """GEO-SGD merge: server adds trainer deltas (SparseGeoTable)."""
+        ids = np.asarray(ids).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, deltas)
+        with self._lock:
+            slots = self._slots(uniq.tolist())
+            self._vals[slots] += merged
 
     def size(self):
-        return len(self._rows)
+        return self._n
 
     def save(self, path):
         with self._lock:
-            ids = np.array(sorted(self._rows), np.int64)
-            vals = np.stack([self._rows[i] for i in ids.tolist()]) \
-                if len(ids) else np.zeros((0, self.dim), np.float32)
+            ids = np.array(sorted(self._slot_of), np.int64)
+            slots = np.array([self._slot_of[i] for i in ids.tolist()],
+                             np.int64)
+            vals = (self._vals[slots] if len(ids)
+                    else np.zeros((0, self.dim), np.float32))
         np.savez(path, ids=ids, vals=vals, dim=self.dim)
 
     def load(self, path):
         data = np.load(path if str(path).endswith(".npz") else path + ".npz")
+        ids, vals = data["ids"], data["vals"]
         with self._lock:
-            self._rows = {int(i): v for i, v in
-                          zip(data["ids"], data["vals"])}
+            self._slot_of = {}
+            self._n = 0
+            self._vals = np.zeros((max(1024, len(ids)), self.dim),
+                                  np.float32)
+            self._m = self._v = self._t = None
+            for k, i in enumerate(ids.tolist()):
+                self._slot_of[int(i)] = k
+            self._n = len(ids)
+            self._vals[: len(ids)] = vals
 
 
 class CommonDenseTable:
@@ -128,7 +197,8 @@ class CommonDenseTable:
         self._lock = threading.Lock()
 
     def pull(self):
-        return self.value.copy()
+        with self._lock:
+            return self.value.copy()
 
     def push(self, grad):
         with self._lock:
@@ -138,20 +208,41 @@ class CommonDenseTable:
             else:
                 self.value -= self.lr * grad
 
+    def set(self, value):
+        with self._lock:
+            self.value = np.asarray(value, np.float32).reshape(
+                self.value.shape)
+
+    def push_delta(self, delta):
+        with self._lock:
+            self.value += np.asarray(delta, np.float32).reshape(
+                self.value.shape)
+
 
 class BarrierTable:
-    """Worker-count barrier (barrier_table.cc) — in-process semaphore."""
+    """Worker-count barrier (barrier_table.cc) — condition variable that
+    also serves the RPC `barrier` op for cross-process sync."""
 
     def __init__(self, trainers=1):
         self.trainers = trainers
         self._cond = threading.Condition()
         self._count = 0
+        self._gen = 0
 
-    def barrier(self):
+    def barrier(self, timeout=60.0):
         with self._cond:
+            gen = self._gen
             self._count += 1
             if self._count >= self.trainers:
                 self._count = 0
+                self._gen += 1
                 self._cond.notify_all()
-            else:
-                self._cond.wait(timeout=60.0)
+                return True
+            while gen == self._gen:
+                if not self._cond.wait(timeout=timeout):
+                    # withdraw our arrival so later generations don't
+                    # release one participant short
+                    if gen == self._gen and self._count > 0:
+                        self._count -= 1
+                    return False
+        return True

@@ -1,16 +1,27 @@
 """TheOnePSRuntime — the a_sync (parameter-server) runtime handle.
 
 Reference: python/paddle/distributed/fleet/runtime/the_one_ps.py (fleet's
-PS runtime: builds tables from the program, wires workers to servers).
-TPU-native single-host form: tables live in this process's host RAM
-(distributed/ps/table.py); multi-host sharding assigns table shards to
-server processes by id-hash the way RoundRobin/HashName dispatchers did.
+PS runtime: builds tables from the program, wires workers to servers)
+backed by distributed/service/brpc_ps_server.cc.  Two modes:
+
+* in-process (no PADDLE_PSERVERS_IP_PORT_LIST): tables live in this
+  process's host RAM — the single-host dev loop.
+* multi-process: `run_server()` starts a PsServer shard on PADDLE_PORT and
+  BLOCKS serving pull/push RPCs until a worker sends stop;
+  `init_worker()` connects a PsClient to every server endpoint and hangs
+  a communicator (async/sync/geo per DistributedStrategy) off it.
 """
 from __future__ import annotations
 
-from typing import Dict
+import os
+from typing import Dict, Optional
 
-from .table import CommonSparseTable, CommonDenseTable, BarrierTable
+from .table import BarrierTable, CommonDenseTable, CommonSparseTable
+
+
+def _server_endpoints():
+    eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+    return [e for e in eps.split(",") if e]
 
 
 class TheOnePSRuntime:
@@ -20,9 +31,15 @@ class TheOnePSRuntime:
         self._tables: Dict[str, CommonSparseTable] = {}
         self._barrier = BarrierTable(role_maker._worker_num())
         self._running = False
+        self._server = None
+        self._client = None
+        self._communicator = None
 
-    # -- table registry -----------------------------------------------------
+    # -- table registry (in-process mode) -----------------------------------
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01):
+        if self._client is not None:
+            self._client.create_sparse_table(name, dim, optimizer, lr)
+            return None
         if name not in self._tables:
             self._tables[name] = CommonSparseTable(dim, optimizer, lr)
         return self._tables[name]
@@ -33,20 +50,83 @@ class TheOnePSRuntime:
     # -- fleet runtime protocol --------------------------------------------
     def init_worker(self):
         self._running = True
+        eps = _server_endpoints()
+        if not eps:
+            return                      # in-process mode
+        from .rpc import PsClient
+        from .communicator import make_communicator
+        self._client = PsClient(eps)
+        mode = "async"
+        cfg = {}
+        strat = self._strategy
+        if strat is not None and getattr(strat, "a_sync", False):
+            geo_k = (getattr(strat, "a_sync_configs", {}) or {}).get(
+                "k_steps", -1)
+            if geo_k and geo_k > 0:
+                mode = "geo"
+                cfg["push_nums"] = geo_k
+        elif strat is not None:
+            mode = "sync"
+        self._communicator = make_communicator(mode, self._client, **cfg)
+
+    @property
+    def client(self):
+        return self._client
+
+    @property
+    def communicator(self):
+        return self._communicator
 
     def init_server(self, *args, **kwargs):
         self._running = True
+        eps = _server_endpoints()
+        if not eps:
+            return                      # in-process mode
+        from .rpc import PsServer
+        port = int(os.environ.get("PADDLE_PORT", eps[0].rsplit(":", 1)[1]))
+        my_ep = f"{os.environ.get('POD_IP', '127.0.0.1')}:{port}"
+        if my_ep not in eps:
+            raise RuntimeError(
+                f"server endpoint {my_ep} (POD_IP:PADDLE_PORT) not in "
+                f"PADDLE_PSERVERS_IP_PORT_LIST {eps} — a silent shard_idx "
+                f"fallback would duplicate shard identities")
+        shard_idx = eps.index(my_ep)
+        self._server = PsServer(
+            host="0.0.0.0" if os.environ.get("POD_IP") else "127.0.0.1",
+            port=port, shard_idx=shard_idx, n_servers=len(eps),
+            n_trainers=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        self._server.start()
 
     def run_server(self):
-        # single-process mode: tables are served in-process; a dedicated
-        # server process would loop here on the RPC queue
         self._running = True
+        if self._server is not None:
+            self._server.wait()         # serve until a worker sends stop
+            self._running = False
 
     def stop_worker(self):
+        if self._communicator is not None and hasattr(self._communicator,
+                                                      "stop"):
+            self._communicator.stop()
+        if self._client is not None:
+            # all trainers rendezvous before any server goes down — async
+            # trainers finish at different step counts and a live push
+            # against a stopped server would crash them
+            try:
+                self._client.barrier(timeout=120.0)
+            except Exception:                # noqa: BLE001 — best effort
+                pass
+            is_first = self._role_maker._worker_index() == 0
+            if is_first:
+                self._client.stop_server()
+            else:
+                self._client.close()
         self._running = False
 
     def save_persistables(self, dirname):
-        import os
-        os.makedirs(dirname, exist_ok=True)
+        if self._client is not None:
+            self._client.save(dirname)
+            return
+        import os as _os
+        _os.makedirs(dirname, exist_ok=True)
         for name, t in self._tables.items():
-            t.save(os.path.join(dirname, f"{name}.sparse"))
+            t.save(_os.path.join(dirname, f"{name}.sparse"))

@@ -1,0 +1,225 @@
+"""Child script for the multi-process PS test: Wide&Deep CTR training with
+the sparse embedding served from parameter servers over the RPC plane.
+
+Roles (selected by env, mirroring launch_ps wiring):
+  TRAINING_ROLE=PSERVER  -> fleet.init_server(); fleet.run_server()
+  TRAINING_ROLE=TRAINER  -> pull dense+sparse, jax grads, push, barrier
+  PS_ORACLE=1            -> identical math in one process against an
+                            in-process table (the ground truth)
+
+Determinism contract so 2 trainers match the oracle bit-for-bit: zero-init
+embedding table, fixed RandomState dense init, disjoint half-batches, and
+a pull -> barrier -> grad -> push -> barrier choreography; SGD pushes
+commute (sequential -lr*g1 then -lr*g2 == -lr*(g1+g2)), so the server's
+parameter trajectory equals the oracle applying both shards' grads.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+LR = 0.1
+STEPS = 6
+BATCH = 16          # global; each trainer takes half
+NUM_SLOTS, VOCAB_PER_SLOT, EMBED_DIM, DENSE_DIM = 4, 250, 8, 4
+VOCAB = NUM_SLOTS * VOCAB_PER_SLOT
+EMB_TABLE = "embedding"
+
+
+def build_model():
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.functional import functional_loss
+    from paddle_tpu.models.ctr import WideDeep
+
+    dybase.enable_dygraph()
+    model = WideDeep(num_slots=NUM_SLOTS, vocab_per_slot=VOCAB_PER_SLOT,
+                     embed_dim=EMBED_DIM, dense_dim=DENSE_DIM, hidden=(16,))
+    params = model.parameters()
+    emb_idx = next(i for i, p in enumerate(params)
+                   if p is model.embed.weight)
+
+    # deterministic dense init shared by trainers and oracle
+    rng = np.random.RandomState(123)
+    values = []
+    for i, p in enumerate(params):
+        shape = np.shape(p._value)
+        if i == emb_idx:
+            values.append(jnp.zeros(shape, jnp.float32))
+        else:
+            values.append(jnp.asarray(
+                (rng.randn(*shape) * 0.1).astype(np.float32)))
+
+    def loss_fn(sparse_ids, dense, label):
+        pred = model(sparse_ids, dense)
+        from paddle_tpu.fluid import layers as L
+        return L.nn.mean(L.nn.square(pred - label))
+
+    _, lfn = functional_loss(model, loss_fn)
+    jgrad = jax.jit(jax.value_and_grad(lfn))
+    return values, emb_idx, jgrad
+
+
+def make_data():
+    rng = np.random.RandomState(7)
+    ids = np.stack([rng.randint(s * VOCAB_PER_SLOT,
+                                (s + 1) * VOCAB_PER_SLOT, BATCH)
+                    for s in range(NUM_SLOTS)], axis=1).astype("int64")
+    dense = rng.randn(BATCH, DENSE_DIM).astype("float32")
+    label = (rng.rand(BATCH, 1) > 0.5).astype("float32")
+    return ids, dense, label
+
+
+def train(pull_dense, push_dense, pull_sparse, push_sparse, barrier, shards):
+    """Shared loop. `shards` = list of (lo, hi): one entry per trainer role
+    this process emulates (trainers pass their own; the oracle passes all).
+    Returns (first-shard loss per step, emb_idx, n_params)."""
+    values, emb_idx, jgrad = build_model()
+    ids_all, dense_all, label_all = make_data()
+    losses = []
+    for step in range(STEPS):
+        vals = list(pull_dense(values, emb_idx))
+        flat = np.concatenate([ids_all[lo:hi].reshape(-1)
+                               for lo, hi in shards])
+        rows = pull_sparse(flat)
+        emb = np.zeros((VOCAB, EMBED_DIM), np.float32)
+        emb[flat] = rows        # only batch rows are touched by forward
+        vals[emb_idx] = jnp.asarray(emb)
+        barrier()               # everyone pulled before anyone pushes
+        shard_grads = []
+        for si, (lo, hi) in enumerate(shards):
+            loss, grads = jgrad(vals, jnp.asarray(ids_all[lo:hi]),
+                                jnp.asarray(dense_all[lo:hi]),
+                                jnp.asarray(label_all[lo:hi]))
+            if si == 0:
+                losses.append(float(loss))
+            shard_grads.append(grads)
+        for grads, (lo, hi) in zip(shard_grads, shards):
+            flat_s = ids_all[lo:hi].reshape(-1)
+            uniq = np.unique(flat_s)
+            push_sparse(uniq, np.asarray(grads[emb_idx])[uniq])
+            push_dense(grads, emb_idx)
+        barrier()               # all pushes landed before the next pull
+    return losses, emb_idx, len(values)
+
+
+def _save_result(out_path, losses, pull_dense_final, pull_sparse_final,
+                 n_params, emb_idx):
+    probe_ids = np.arange(0, VOCAB, 97, dtype=np.int64)
+    arrays = {"losses": np.array(losses),
+              "probe": pull_sparse_final(probe_ids)}
+    for i in range(n_params):
+        if i != emb_idx:
+            arrays[f"d{i}"] = np.asarray(pull_dense_final(i))
+    np.savez(out_path, **arrays)
+
+
+def run_worker(out_path):
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker,
+                                              DistributedStrategy)
+
+    fleet.init(PaddleCloudRoleMaker())
+    strategy = DistributedStrategy()
+    strategy.a_sync = True
+    fleet._fleet_singleton._user_defined_strategy = strategy
+    fleet.init_worker()
+    rt = fleet._fleet_singleton._runtime_handle
+    client = rt.client
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    client.create_sparse_table(EMB_TABLE, EMBED_DIM, optimizer="sgd",
+                               lr=LR, init_kind="zeros")
+    values, emb_idx, _ = build_model()
+    for i, v in enumerate(values):
+        if i != emb_idx:
+            client.create_dense_table(f"dense_{i}", list(np.shape(v)),
+                                      optimizer="sgd", lr=LR)
+            if tid == 0:
+                client.set_dense(f"dense_{i}", np.asarray(v))
+    client.barrier()
+
+    def pull_dense(vals, emb_idx):
+        out = list(vals)
+        for i in range(len(out)):
+            if i != emb_idx:
+                out[i] = jnp.asarray(
+                    client.pull_dense(f"dense_{i}")).reshape(out[i].shape)
+        return out
+
+    def push_dense(grads, emb_idx):
+        for i, g in enumerate(grads):
+            if i != emb_idx:
+                client.push_dense(f"dense_{i}", np.asarray(g))
+
+    half = BATCH // n_trainers
+    shard = [(tid * half, (tid + 1) * half)]
+    losses, emb_idx, n_params = train(
+        pull_dense, push_dense,
+        lambda ids: client.pull_sparse(EMB_TABLE, ids),
+        lambda ids, g: client.push_sparse(EMB_TABLE, ids, g),
+        client.barrier, shard)
+
+    if tid == 0:
+        _save_result(out_path, losses,
+                     lambda i: client.pull_dense(f"dense_{i}"),
+                     lambda ids: client.pull_sparse(EMB_TABLE, ids),
+                     n_params, emb_idx)
+    client.barrier()
+    fleet.stop_worker()
+
+
+def run_oracle(out_path):
+    from paddle_tpu.distributed.ps.table import (CommonSparseTable,
+                                                 Initializer)
+    table = CommonSparseTable(EMBED_DIM, "sgd", LR,
+                              initializer=Initializer("zeros"))
+    state = {}
+    init_done = {}
+
+    def pull_dense(vals, emb_idx):
+        out = list(vals)
+        for i in range(len(out)):
+            if i != emb_idx:
+                if i not in state:
+                    state[i] = np.asarray(out[i])
+                out[i] = jnp.asarray(state[i])
+        return out
+
+    def push_dense(grads, emb_idx):
+        for i, g in enumerate(grads):
+            if i != emb_idx:
+                state[i] = state[i] - LR * np.asarray(g)
+
+    half = BATCH // 2
+    losses, emb_idx, n_params = train(
+        pull_dense, push_dense, table.pull, table.push, lambda: None,
+        [(0, half), (half, BATCH)])
+    _save_result(out_path, losses, lambda i: state[i], table.pull,
+                 n_params, emb_idx)
+
+
+def main():
+    out = os.environ.get("PS_TEST_OUT", "/tmp/ps_test_out.npz")
+    if os.environ.get("PS_ORACLE"):
+        run_oracle(out)
+        return
+    role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+    if role in ("PSERVER", "SERVER"):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+        fleet.init(PaddleCloudRoleMaker())
+        fleet.init_server()
+        fleet.run_server()
+    else:
+        run_worker(out)
+
+
+if __name__ == "__main__":
+    main()
