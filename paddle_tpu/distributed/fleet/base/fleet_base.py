@@ -35,23 +35,29 @@ class Fleet:
         self._is_collective = is_collective or (
             role_maker is not None and getattr(role_maker, "_is_collective",
                                                False))
+        # multi-process rendezvous (the c_gen_nccl_id analog) — MUST run
+        # before anything that can initialise the XLA backend, including
+        # role generation (its collective fallback queries
+        # jax.process_index).  Participant identity therefore comes from
+        # the launcher env directly, and only TRAINER processes join
+        # (launch_ps servers inherit the parent env but never rendezvous).
+        coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+        role_env = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if coord and nranks > 1 and role_env == "TRAINER":
+            import jax
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nranks,
+                    process_id=int(os.environ.get("PADDLE_TRAINER_ID",
+                                                  "0")))
         if role_maker is None:
             role_maker = PaddleCloudRoleMaker(is_collective=is_collective)
         self._role_maker = role_maker
         self._role_maker._generate_role()
         self._user_defined_strategy = strategy or DistributedStrategy()
         self._strategy_compiler = StrategyCompiler()
-        # multi-process rendezvous (the c_gen_nccl_id analog): only when the
-        # launcher provided coordination env and jax isn't already set up
-        coord = os.environ.get("PADDLE_TPU_COORDINATOR")
-        if coord:
-            import jax
-            if jax.process_count() == 1 and len(
-                    self._role_maker._get_trainer_endpoints()) > 1:
-                jax.distributed.initialize(
-                    coordinator_address=coord,
-                    num_processes=self._role_maker._worker_num(),
-                    process_id=self._role_maker._worker_index())
         return self
 
     # -- role queries (fleet_base.py:240-420 surface) -----------------------

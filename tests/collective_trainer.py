@@ -1,0 +1,133 @@
+"""Child script for the multi-process collective test (TestDistBase
+analog, reference test_dist_base.py:642,834): 2 REAL processes joined by
+jax.distributed.initialize on the CPU backend, dygraph DataParallel
+training, loss/params compared against a single-process oracle.
+
+COLLECTIVE_ORACLE=1 -> single-process full-batch ground truth."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# one virtual CPU device per process: the two processes form the dp=2 world
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+LR = 0.1
+STEPS = 5
+BATCH = 16
+
+
+def build_model():
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.nn import Linear
+    from paddle_tpu.dygraph.layers import Layer
+    from paddle_tpu.nn.layer import ReLU
+
+    dybase.enable_dygraph()
+
+    class Net(Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = Linear(8, 16)
+            self.act = ReLU()
+            self.fc2 = Linear(16, 1)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    rng = np.random.RandomState(11)
+    for p in net.parameters():
+        shape = np.shape(p._value)
+        p._value = jnp.asarray((rng.randn(*shape) * 0.1).astype(np.float32))
+    return net
+
+
+def make_data():
+    rng = np.random.RandomState(5)
+    xs = rng.randn(BATCH, 8).astype("float32")
+    ys = (xs.sum(axis=1, keepdims=True) * 0.5).astype("float32")
+    return xs, ys
+
+
+def mse(pred, label):
+    from paddle_tpu.fluid import layers as L
+    return L.nn.mean(L.nn.square(pred - label))
+
+
+def run_trainer(out_path):
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.dygraph.base import to_variable
+    from paddle_tpu.dygraph.parallel import DataParallel
+
+    fleet.init(is_collective=True)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+    rank = jax.process_index()
+
+    net = build_model()
+    model = DataParallel(net)
+    xs, ys = make_data()
+    half = BATCH // 2
+    lo, hi = rank * half, (rank + 1) * half
+
+    losses = []
+    for step in range(STEPS):
+        pred = model(to_variable(xs[lo:hi]))
+        loss = mse(pred, to_variable(ys[lo:hi]))
+        losses.append(float(np.asarray(loss.value())))
+        scaled = model.scale_loss(loss)
+        scaled.backward()
+        model.apply_collective_grads()
+        for p in model.parameters():
+            if p._grad is not None:
+                p._value = p._value - LR * p._grad
+            p.clear_gradient()
+
+    if rank == 0:
+        np.savez(out_path, losses=np.array(losses),
+                 **{f"p{i}": np.asarray(p._value)
+                    for i, p in enumerate(model.parameters())})
+    # all processes must exit together (coordinator teardown)
+    jax.experimental.multihost_utils.sync_global_devices("done")
+
+
+def run_oracle(out_path):
+    from paddle_tpu.dygraph.base import to_variable
+
+    net = build_model()
+    xs, ys = make_data()
+    half = BATCH // 2
+    losses = []
+    for step in range(STEPS):
+        # rank-0's half loss, for comparison with the distributed run
+        pred0 = net(to_variable(xs[:half]))
+        losses.append(float(np.asarray(mse(pred0,
+                                           to_variable(ys[:half])).value())))
+        pred = net(to_variable(xs))
+        loss = mse(pred, to_variable(ys))
+        loss.backward()
+        for p in net.parameters():
+            if p._grad is not None:
+                p._value = p._value - LR * p._grad
+            p.clear_gradient()
+    np.savez(out_path, losses=np.array(losses),
+             **{f"p{i}": np.asarray(p._value)
+                for i, p in enumerate(net.parameters())})
+
+
+def main():
+    out = os.environ.get("COLLECTIVE_TEST_OUT", "/tmp/collective_out.npz")
+    if os.environ.get("COLLECTIVE_ORACLE"):
+        run_oracle(out)
+    else:
+        run_trainer(out)
+
+
+if __name__ == "__main__":
+    main()
