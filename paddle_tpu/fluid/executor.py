@@ -58,11 +58,13 @@ class _CompiledBlock:
     """The ExecutorPrepareContext analog: one jitted callable per
     (program, feed signature)."""
 
-    def __init__(self, fn, param_names, written_names, fetch_names):
+    def __init__(self, fn, param_names, written_names, fetch_names,
+                 n_ops=None):
         self.fn = fn
         self.param_names = param_names
         self.written_names = written_names
         self.fetch_names = fetch_names
+        self.n_ops = n_ops          # post-prune op count (introspection)
 
 
 def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
@@ -185,10 +187,13 @@ class Executor:
             if (v.persistable or scope.find_var(n) is not None)
             and scope.find_var(n) is not None and n not in feed)
         persist = {n for n, v in block.vars.items() if v.persistable}
+        # non-persistable vars the user seeded into the scope count as
+        # state too: their updates must survive pruning + be written back
+        scope_state = {n for op in block.ops for n in op.output_arg_names
+                       if n not in persist and scope.find_var(n) is not None}
         written_names = sorted(
             {n for op in block.ops for n in op.output_arg_names
-             if n in persist or scope.find_var(n) is not None})
-        # a persistable output only counts if its producing op will run
+             if n in persist or n in scope_state})
         mesh_axes = dict(getattr(program, "_mesh_axes", {}) or {})
 
         # --- static pipeline path (PipelineOptimizer + device_guard) -------
@@ -236,13 +241,25 @@ class Executor:
                 return _CompiledBlock(jfn, param_names, written_names,
                                       fetch_names)
 
+        # prune to fetch-reachable ops (framework/prune.cc analog):
+        # persistable/scope-state writes (optimizer, BN stats, user scope
+        # vars) always survive, so training semantics are unchanged while
+        # an eval fetch on the same program compiles a strictly smaller
+        # executable.  Pipeline/recompute paths above run the full block.
+        from .framework import prune_ops
+        run_ops = prune_ops(block, block.ops, targets=list(fetch_names),
+                            extra_state=scope_state)
+        written_names = sorted(
+            {n for op in run_ops for n in op.output_arg_names
+             if n in persist or n in scope_state})
+
         def fn(mut_params, ro_params, feeds, step_key):
             env = dict(mut_params)
             env.update(ro_params)
             env.update(feeds)
             ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
                                   is_test=is_test)
-            run_block_ops(block, env, ctx)
+            run_block_ops(block, env, ctx, ops=run_ops)
             fetches = [env[n] for n in fetch_names]
             new_vals = {n: env[n] for n in written_names if n in env}
             return fetches, new_vals
@@ -254,7 +271,8 @@ class Executor:
             jfn = wrap_with_mesh(fn, mesh, program)
         else:
             jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
-        return _CompiledBlock(jfn, param_names, written_names, fetch_names)
+        return _CompiledBlock(jfn, param_names, written_names, fetch_names,
+                              n_ops=len(run_ops))
 
     # -- Trainer/dataset path (executor.cc:139-173 analog) ------------------
     def train_from_dataset(self, program, dataset, scope=None, thread=0,

@@ -292,16 +292,41 @@ class Program:
 
     def clone(self, for_test: bool = False) -> "Program":
         """Structural clone; with for_test=True marks inference mode (dropout
-        and batch_norm switch to eval behaviour via ctx.is_test)."""
+        and batch_norm switch to eval behaviour via ctx.is_test), strips the
+        backward/optimizer tail, and dead-code-eliminates by reachability —
+        ops feeding only the removed tail (lr counters, grad-clip scratch)
+        go too (framework/prune.cc semantics, not just the op-role filter)."""
         import copy
         p = copy.deepcopy(self)
         if for_test:
             p._hints["is_test"] = True
+            p._hints.pop("recompute_checkpoints", None)
+            p._hints.pop("pipeline_microbatches", None)
+            # pass 1: strip the backward/optimizer tail from EVERY block
+            # first, so the parent-block reachability scan below never sees
+            # captures of sub-block grad ops that are about to be deleted
             for b in p.blocks:
                 b.ops = [op for op in b.ops
                          if op.attr("op_role", 0) == 0 and
                          not op.type.endswith("_grad") and
                          op.type not in _OPTIMIZER_OP_TYPES]
+            # pass 2: leaf-output seed; no state-write keep: eval must not
+            # run lr counters or other train-state updates
+            for b in p.blocks:
+                b.ops = prune_ops(b, b.ops, targets=None,
+                                  keep_state_writes=False)
+        return p
+
+    def _prune(self, targets) -> "Program":
+        """Program pruned to ops that `targets` (vars or names) depend on
+        (reference Program._prune -> framework/prune.cc)."""
+        import copy
+        names = [t.name if isinstance(t, Variable) else str(t)
+                 for t in (targets if isinstance(targets, (list, tuple))
+                           else [targets])]
+        p = copy.deepcopy(self)
+        b = p.global_block()
+        b.ops = prune_ops(b, b.ops, targets=names, keep_state_writes=False)
         return p
 
     def __repr__(self):
@@ -363,6 +388,76 @@ _OPTIMIZER_OP_TYPES = frozenset({
     "sgd", "momentum", "adam", "adamw", "adagrad", "rmsprop", "lamb",
     "lars_momentum", "ftrl", "dpsgd", "dgc_momentum",
 })
+
+# ops kept during pruning regardless of reachability: cross-device and
+# control-flow effects the dataflow scan can't see (select_input/output are
+# pure dataflow with declared slots — plain reachability covers them)
+_SIDE_EFFECT_OP_TYPES = frozenset({
+    "send_v2", "partial_send", "barrier", "c_sync_calc_stream",
+    "c_sync_comm_stream", "while", "conditional_block", "py_func", "print",
+})
+
+_SUB_BLOCK_ATTRS = ("sub_block", "cond_block", "true_block", "false_block")
+
+
+def _op_reads(block, op, _seen=None):
+    """All vars an op may read, INCLUDING captures of its control-flow
+    sub-blocks (cond/while bodies read outer vars that are not declared
+    as op inputs)."""
+    reads = list(op.input_arg_names)
+    _seen = _seen if _seen is not None else set()
+    prog = block.program
+    for attr in _SUB_BLOCK_ATTRS:
+        idx = op.attrs.get(attr)
+        if isinstance(idx, int) and 0 <= idx < len(prog.blocks) \
+                and idx not in _seen:
+            _seen.add(idx)
+            sub = prog.blocks[idx]
+            written = set()
+            for sop in sub.ops:
+                reads += [n for n in _op_reads(sub, sop, _seen)
+                          if n not in written]
+                written.update(sop.output_arg_names)
+    return reads
+
+
+def prune_ops(block, ops, targets=None, keep_state_writes=True,
+              extra_state=()):
+    """Backward-reachability prune (framework/prune.cc analog).
+
+    Keeps an op iff it (a) produces a var in the needed set, seeded from
+    `targets` (None = every NON-persistable leaf output — predictions,
+    losses, metrics; persistable leaves are training state whose updates
+    are exactly what a for_test clone must drop), (b) writes a persistable
+    or `extra_state` var while `keep_state_writes` (optimizer / BN-stats
+    updates must survive a fetch-only prune), or (c) has side effects the
+    dataflow can't see.  Kept ops contribute their reads — including
+    control-flow sub-block captures — to the needed set, one reverse pass."""
+    def persistable(n):
+        # resolve through parent blocks: sub-block ops write global-block
+        # counters (GradientMerge-style state updated inside while bodies)
+        v = block._find_var_recursive(n)
+        return v is not None and v.persistable
+
+    extra = set(extra_state)
+    if targets is None:
+        consumed = {n for op in ops for n in _op_reads(block, op)}
+        needed = {n for op in ops for n in op.output_arg_names
+                  if n not in consumed and not persistable(n)}
+    else:
+        needed = set(targets)
+    kept = []
+    for op in reversed(ops):
+        keep = (op.type in _SIDE_EFFECT_OP_TYPES
+                or any(n in needed for n in op.output_arg_names)
+                or (keep_state_writes
+                    and any(persistable(n) or n in extra
+                            for n in op.output_arg_names)))
+        if keep:
+            kept.append(op)
+            needed.update(_op_reads(block, op))
+    kept.reverse()
+    return kept
 
 # ---------------------------------------------------------------------------
 # device_guard: pipeline stage placement (fluid.device_guard analog —

@@ -1,0 +1,142 @@
+"""Program pruning + clone(for_test) reachability tests.
+
+Reference: framework/prune.cc (Prune keeps ops backward-reachable from
+targets), Program._prune / clone(for_test) in
+python/paddle/fluid/framework.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+def _net():
+    x = fluid.data("x", [-1, 8])
+    y = fluid.data("y", [-1, 1])
+    h = fluid.layers.fc(x, 16, act="relu")
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return x, y, pred, loss
+
+
+class TestExecutorPrune:
+    def test_eval_fetch_compiles_smaller(self, rng):
+        x, y, pred, loss = _net()
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(loss)
+        test_prog = fluid.default_main_program().clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(4, 8).astype("float32")
+        ys = rng.randn(4, 1).astype("float32")
+
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        train_ops = next(c.n_ops for c in exe._cache.values()
+                         if c.fetch_names == [loss.name])
+
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(test_prog, feed={"x": xs}, fetch_list=[pred])
+        eval_ops = next(c.n_ops for c in exe2._cache.values()
+                        if c.fetch_names == [pred.name])
+        assert eval_ops < train_ops
+        # pred fetch doesn't need the loss ops either
+        n_fwd = len(test_prog.global_block().ops)
+        assert eval_ops < n_fwd
+
+    def test_train_prune_keeps_optimizer_updates(self, rng):
+        """Fetching only the loss must NOT prune the parameter updates."""
+        x, y, pred, loss = _net()
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(16, 8).astype("float32")
+        ys = (xs.sum(1, keepdims=True)).astype("float32")
+        losses = [float(np.asarray(
+            exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.9
+
+    def test_program_prune_api(self):
+        x, y, pred, loss = _net()
+        full = len(fluid.default_main_program().global_block().ops)
+        pruned = fluid.default_main_program()._prune(pred)
+        kept = len(pruned.global_block().ops)
+        assert kept < full
+        names = {n for op in pruned.global_block().ops
+                 for n in op.output_arg_names}
+        assert pred.name in names
+        assert loss.name not in names
+
+
+class TestCloneForTest:
+    def test_drops_backward_and_dead_train_state(self):
+        x, y, pred, loss = _net()
+        opt = fluid.optimizer.AdamOptimizer(1e-3)
+        opt.minimize(loss)
+        prog = fluid.default_main_program()
+        test_prog = prog.clone(for_test=True)
+        ops = test_prog.global_block().ops
+        types = [op.type for op in ops]
+        assert "generic_grad" not in types
+        assert "adam" not in types
+        # the loss (a leaf output) survives
+        outs = {n for op in ops for n in op.output_arg_names}
+        assert loss.name in outs
+
+    def test_eval_matches_manual_forward(self, rng):
+        x, y, pred, loss = _net()
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        test_prog = fluid.default_main_program().clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(4, 8).astype("float32")
+        ys = rng.randn(4, 1).astype("float32")
+        # train-program forward == test-program forward (fresh params)
+        p1 = exe.run(test_prog, feed={"x": xs, "y": ys},
+                     fetch_list=[pred, loss])
+        p2 = exe.run(feed={"x": xs, "y": ys}, fetch_list=[pred])
+        np.testing.assert_allclose(np.asarray(p1[0]), np.asarray(p2[0]),
+                                   rtol=1e-6)
+
+
+class TestPruneDCE:
+    def test_clone_for_test_drops_train_state_ops(self, rng):
+        """GradientMerge appends op_role-0 counter/gate ops; for_test DCE
+        must drop them (they only feed persistable train state)."""
+        x, y, pred, loss = _net()
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGDOptimizer(0.1), k_steps=4)
+        opt.minimize(loss)
+        test_prog = fluid.default_main_program().clone(for_test=True)
+        types = [op.type for op in test_prog.global_block().ops]
+        assert "increment" not in types          # gm_step counter dropped
+        outs = {n for op in test_prog.global_block().ops
+                for n in op.output_arg_names}
+        assert loss.name in outs                 # loss survives
+
+    def test_eval_run_does_not_advance_train_counters(self, rng):
+        x, y, pred, loss = _net()
+        opt = fluid.optimizer.GradientMergeOptimizer(
+            fluid.optimizer.SGDOptimizer(0.1), k_steps=4)
+        opt.minimize(loss)
+        test_prog = fluid.default_main_program().clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        scope = fluid.global_scope()
+        step_name = [n for n in scope.local_var_names() if "gm_step" in n][0]
+        xs = rng.randn(4, 8).astype("float32")
+        ys = rng.randn(4, 1).astype("float32")
+        exe.run(test_prog, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        assert float(np.asarray(scope.find_var(step_name)).ravel()[0]) == 0.0
+
+    def test_prune_keeps_cond_subblock_captures(self, rng):
+        """A producer consumed only inside a cond branch must survive the
+        fetch prune (sub-block captures are undeclared op inputs)."""
+        from paddle_tpu.fluid import layers
+        x = fluid.data("x", [-1, 4])
+        b = layers.scale(x, scale=3.0)          # consumed only in-branch
+        flag = layers.fill_constant([1], "bool", True)
+        out = layers.cond(flag, lambda: b * 2.0, lambda: b + 1.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(2, 4).astype("float32")
+        got, = exe.run(feed={"x": xs}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(got), xs * 6.0, rtol=1e-6)
