@@ -1,13 +1,17 @@
 """dygraph-to-static bridge.
 
 Reference: python/paddle/fluid/dygraph/jit.py `@declarative:160` +
-ProgramTranslator (dygraph_to_static/program_translator.py:729) rewrite
-Python AST into a static Program.  TPU-native: a dygraph model is ALREADY a
-pure function of (params, inputs) once traced — `declarative` simply marks a
-function for jax.jit compilation of its eager op stream; TracedLayer captures
-(state_dict, callable) for inference export.  No AST rewriting is needed
-because data-dependent control flow must use layers.cond/while_loop anyway
-(XLA constraint), which trace correctly.
+ProgramTranslator (dygraph_to_static/program_translator.py:729), which
+AST-rewrites Python into a Program executed by RunProgramOp
+(operators/run_program_op.cc).  TPU-native: a dygraph model is ALREADY a
+pure function of (params, inputs) once traced, so `declarative` needs no
+AST rewriting — it captures the eager op stream under one `jax.jit` and
+dispatches calls through the `run_program` op (ops/misc: registered here)
+so the whole callable is ONE cached XLA executable, appears as ONE tape
+entry, and backward flows through `jax.vjp` of the compiled function —
+exactly RunProgramOp's forward/backward program pair, derived instead of
+constructed.  Data-dependent control flow must already use
+layers.cond/while_loop (XLA constraint), which trace correctly.
 """
 from __future__ import annotations
 
@@ -18,21 +22,7 @@ import numpy as np
 from .base import VarBase, to_variable
 
 
-def declarative(function=None):
-    """Mark a dygraph function as compilable.  Runs eagerly (each op is an
-    XLA call); end-to-end fusion comes from TracedLayer/jit_compile."""
-    def deco(fn):
-        @functools.wraps(fn)
-        def wrapper(*args, **kwargs):
-            return fn(*args, **kwargs)
-        wrapper.__declarative__ = True
-        return wrapper
-    if function is not None:
-        return deco(function)
-    return deco
-
-
-to_static = declarative
+from .jit_static import StaticFunction, declarative, to_static  # noqa: F401
 
 
 class TracedLayer:

@@ -1,0 +1,213 @@
+"""StaticFunction: the @declarative compiled wrapper (split from jit.py).
+
+Reference: dygraph_to_static/program_translator.py:729 StaticFunction +
+operators/run_program_op.cc.  The eager op stream is captured under ONE
+jax.jit; calls dispatch through the `run_program` op so the whole callable
+is one cached XLA executable and one tape entry, with backward derived by
+jax.vjp of the compiled function (RunProgramOp's backward program, derived
+instead of constructed).
+
+Capture contract:
+* params AND buffers are jit arguments, never baked constants — buffer
+  updates (BatchNorm moving stats) come back as extra nondiff outputs and
+  are written to the layer after each call;
+* the output treedef is recorded per input-shape signature (a structure
+  that varies with shape — e.g. unrolled lists — stays correct on cache
+  hits);
+* the live tracer is resolved at trace time, and RNG keys are threaded as
+  an argument, so dropout varies per call instead of freezing at the
+  first-trace mask;
+* caches live on the model instance (they die with it) keyed by param
+  names + static-arg spec + train mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import VarBase, to_variable
+
+
+def _register_run_program():
+    from ..ops.registry import register_op, has_op
+
+    if has_op("run_program"):
+        return
+
+    @register_op("run_program", nondiff_inputs=("Key", "Buffers"),
+                 nondiff_outputs=("BufOut",))
+    def _run_program(ins, attrs, ctx):
+        fn = attrs["__callable__"]
+        params = list(ins.get("Params", []))
+        bufs = list(ins.get("Buffers", []))
+        xs = list(ins.get("X", []))
+        key = ins["Key"][0] if ins.get("Key") else ctx.base_key
+        outs, new_bufs = fn(params, bufs, xs, key)
+        return {"Out": list(outs), "BufOut": list(new_bufs)}
+
+
+_register_run_program()
+
+
+def _shape_sig(arrays):
+    return tuple((tuple(np.shape(a)), str(np.asarray(a).dtype)
+                  if not hasattr(a, "dtype") else str(a.dtype))
+                 for a in arrays)
+
+
+class StaticFunction:
+    """One jax.jit per (instance params, train-mode, static args);
+    retracing on new input shapes is jax.jit's own cache."""
+
+    def __init__(self, fn):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._own_cache = {}           # for free functions (no instance)
+        self.__declarative__ = True
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return functools.partial(self.__call__, obj)
+
+    # -- arg splitting ------------------------------------------------------
+    @staticmethod
+    def _is_tensor(x):
+        import jax
+        return isinstance(x, (VarBase, np.ndarray, jax.Array))
+
+    def _split(self, args, kwargs, flat):
+        """Replace tensors with indices into `flat` (recursing through
+        lists/tuples/dicts); keep true statics inline."""
+        def scan(x):
+            if isinstance(x, VarBase):
+                flat.append(x)
+                return ("T", len(flat) - 1)
+            if self._is_tensor(x):
+                flat.append(to_variable(np.asarray(x)))
+                return ("T", len(flat) - 1)
+            if isinstance(x, (list, tuple)):
+                return ("L", type(x).__name__, tuple(scan(v) for v in x))
+            if isinstance(x, dict):
+                return ("D", tuple((k, scan(x[k])) for k in sorted(x)))
+            return ("S", x)
+        a_spec = tuple(scan(a) for a in args)
+        k_spec = tuple((k, scan(kwargs[k])) for k in sorted(kwargs))
+        return a_spec, k_spec
+
+    @staticmethod
+    def _rebuild(spec, vals):
+        t = spec[0]
+        if t == "T":
+            return vals[spec[1]]
+        if t == "L":
+            seq = [StaticFunction._rebuild(s, vals) for s in spec[2]]
+            return tuple(seq) if spec[1] == "tuple" else seq
+        if t == "D":
+            return {k: StaticFunction._rebuild(s, vals) for k, s in spec[1]}
+        return spec[1]
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        import jax
+        from .base import _dygraph_tracer
+        from .layers import Layer
+
+        tracer = _dygraph_tracer()
+        if tracer is None:
+            return self._fn(*args, **kwargs)
+
+        instance = None
+        if args and isinstance(args[0], Layer):
+            instance, args = args[0], args[1:]
+
+        flat = []
+        a_spec, k_spec = self._split(args, kwargs, flat)
+        params = list(instance.parameters()) if instance is not None else []
+        buffers = list(instance.buffers()) if instance is not None else []
+        pnames = tuple(getattr(p, "name", str(i))
+                       for i, p in enumerate(params))
+
+        if instance is not None:
+            store = instance.__dict__.setdefault("_declarative_caches", {})
+        else:
+            store = self._own_cache
+        cache_key = (self._fn.__qualname__, tracer._train_mode, pnames,
+                     len(buffers), repr((a_spec, k_spec)))
+        entry = store.get(cache_key)
+        if entry is None:
+            entry = self._build(instance, params, buffers, a_spec, k_spec)
+            store[cache_key] = entry
+
+        key_vb = VarBase(tracer.next_key(), stop_gradient=True)
+        ins = {"X": flat, "Key": [key_vb]}
+        if params:
+            ins["Params"] = params
+        if buffers:
+            ins["Buffers"] = buffers
+        out_slots = tracer.trace_op(
+            "run_program", ins, {"Out": [None], "BufOut": [None]},
+            {"__callable__": entry["jitted"]})
+        # write updated buffers (BatchNorm stats etc.) back to the layer
+        for b, nb in zip(buffers, out_slots.get("BufOut", [])):
+            b._value = nb._value
+        sig = _shape_sig([v._value for v in flat])
+        tree = entry["cell"]["trees"][sig]
+        return jax.tree_util.tree_unflatten(tree, out_slots["Out"])
+
+    def _build(self, instance, params, buffers, a_spec, k_spec):
+        import jax
+        from .base import no_grad_ctx, _dygraph_tracer
+
+        fn = self._fn
+        cell = {"trees": {}, "traces": 0}
+
+        def pure(param_vals, buf_vals, input_vals, key):
+            cell["traces"] += 1
+            tracer = _dygraph_tracer()
+            saved_p = [p._value for p in params]
+            saved_b = [b._value for b in buffers]
+            saved_key, saved_ctr = tracer._key, tracer._key_ctr
+            try:
+                for p, v in zip(params, param_vals):
+                    p._value = v
+                for b, v in zip(buffers, buf_vals):
+                    b._value = v
+                tracer._key, tracer._key_ctr = key, 0
+                vals = [to_variable(v) for v in input_vals]
+                call_args = [self._rebuild(s, vals) for s in a_spec]
+                call_kwargs = {k: self._rebuild(s, vals) for k, s in k_spec}
+                with no_grad_ctx():   # inner tape entries are subsumed by
+                    # the run_program entry's whole-function vjp
+                    if instance is not None:
+                        out = fn(instance, *call_args, **call_kwargs)
+                    else:
+                        out = fn(*call_args, **call_kwargs)
+                new_bufs = [b._value for b in buffers]
+                leaves, tree = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, VarBase))
+                cell["trees"][_shape_sig(input_vals)] = tree
+                return ([v._value if isinstance(v, VarBase) else v
+                         for v in leaves], new_bufs)
+            finally:
+                for p, v in zip(params, saved_p):
+                    p._value = v
+                for b, v in zip(buffers, saved_b):
+                    b._value = v
+                tracer._key, tracer._key_ctr = saved_key, saved_ctr
+
+        return {"jitted": jax.jit(pure), "cell": cell}
+
+
+def declarative(function=None):
+    """Compile a dygraph function/method into one cached XLA executable
+    (reference @declarative / @to_static)."""
+    def deco(fn):
+        return StaticFunction(fn)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+to_static = declarative
