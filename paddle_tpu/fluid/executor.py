@@ -138,7 +138,10 @@ class Executor:
         fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
 
         feed_sig = tuple(sorted(
-            (k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+            (k, tuple(np.shape(v)),
+             # avoid np.asarray on device arrays: it forces a D2H sync,
+             # serialising the prefetch pipeline
+             str(getattr(v, "dtype", None) or np.asarray(v).dtype))
             for k, v in feed.items()))
         key = (_fingerprint(program), feed_sig, tuple(fetch_names),
                id(scope), bool(program._hints.get("is_test")),

@@ -125,21 +125,10 @@ class GeneratorLoader:
     def __iter__(self):
         if self._generator is None:
             raise RuntimeError("DataLoader: no generator set")
-        q: queue.Queue = queue.Queue(maxsize=self._capacity)
-
-        def worker():
-            try:
-                for item in self._generator():
-                    q.put(item)
-            finally:
-                q.put(self._SENTINEL)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is self._SENTINEL:
-                return
+        from ..utils.prefetch import Prefetcher
+        # shared prefetcher: forwards producer exceptions instead of
+        # silently truncating the epoch, and cleans up on consumer break
+        for item in Prefetcher(self._generator(), capacity=self._capacity):
             if self._return_list:
                 yield [item[n] for n in self._feed_names]
             else:

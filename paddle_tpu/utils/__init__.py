@@ -1,0 +1,4 @@
+"""Shared runtime utilities."""
+from .prefetch import Prefetcher
+
+__all__ = ["Prefetcher"]

@@ -1,0 +1,99 @@
+"""Background-prefetch iterator shared by the trainer and DataLoader.
+
+Reference: operators/reader/buffered_reader.cc (double buffer thread) and
+framework/channel.h — one producer thread fills a bounded queue, the
+consumer drains it; producer exceptions are FORWARDED to the consumer (not
+swallowed into a truncated epoch), and cancellation unblocks a producer
+stuck on a full queue so no thread/device-buffer leaks survive an error."""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+class Prefetcher:
+    """Iterate `source` on a background thread through a bounded queue.
+
+    `stage` (optional) transforms each item on the producer side (e.g.
+    jax.device_put, so the H2D transfer of batch t+1 overlaps step t).
+    Use as an iterator; always closes cleanly — on consumer error/break the
+    producer is cancelled and joined."""
+
+    _STOP = object()
+
+    def __init__(self, source: Iterable, stage: Optional[Callable] = None,
+                 capacity: int = 2,
+                 on_produce: Optional[Callable[[float], None]] = None):
+        self._source = source
+        self._stage = stage
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, capacity))
+        self._cancel = threading.Event()
+        self._on_produce = on_produce
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._started = False
+
+    # -- producer -----------------------------------------------------------
+    def _put(self, item) -> bool:
+        while not self._cancel.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        import time
+        try:
+            t_last = time.perf_counter()
+            for item in self._source:
+                if self._stage is not None:
+                    item = self._stage(item)
+                if self._on_produce is not None:
+                    self._on_produce(time.perf_counter() - t_last)
+                if not self._put(item):
+                    return                   # cancelled
+                t_last = time.perf_counter()
+            self._put(self._STOP)
+        except BaseException as e:           # noqa: BLE001 — forwarded
+            self._put(e)
+
+    # -- consumer -----------------------------------------------------------
+    def __iter__(self) -> Iterator[Any]:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        try:
+            while True:
+                item = self._q.get()
+                if item is self._STOP:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            self.close()
+
+    def get(self):
+        """Blocking single fetch; returns Prefetcher._STOP at end."""
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        item = self._q.get()
+        if isinstance(item, BaseException):
+            self.close()
+            raise item
+        return item
+
+    def close(self):
+        """Cancel the producer and drain the queue (unblocks q.put) so the
+        thread exits and staged device buffers are dropped."""
+        self._cancel.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._started:
+            self._thread.join(timeout=10)
