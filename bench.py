@@ -77,6 +77,106 @@ def flops_per_token(hidden, layers, ffn, seq, vocab):
     return 3 * fwd                                             # bwd = 2x fwd
 
 
+def build_resnet_step(num_classes, lr=0.1):
+    """ResNet-50 training step (BASELINE config #2): SGD+momentum,
+    softmax cross-entropy, bf16 conv compute via AMP autocast."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.functional import functional_loss
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.fluid import layers as L
+
+    dybase.enable_dygraph()
+    tracer = dybase._dygraph_tracer()
+    tracer._amp_enabled = True
+    model = resnet50(num_classes=num_classes)
+    model.train()
+
+    def loss_fn(images, labels):
+        logits = model(images)
+        return L.nn.mean(L.softmax_with_cross_entropy(logits, labels))
+
+    param_values, lfn = functional_loss(model, loss_fn)
+
+    def sgd_momentum(params, vel, grads, mu=0.9):
+        new_v = [mu * v + g.astype(jnp.float32)
+                 for v, g in zip(vel, grads)]
+        new_p = [(p.astype(jnp.float32) - lr * v).astype(p.dtype)
+                 for p, v in zip(params, new_v)]
+        return new_p, new_v
+
+    jgrad = jax.jit(jax.value_and_grad(lfn))
+    jupd = jax.jit(sgd_momentum, donate_argnums=(0, 1))
+    state = {"p": param_values,
+             "v": [jax.numpy.zeros(p.shape, jax.numpy.float32)
+                   for p in param_values]}
+
+    def jstep(images, labels):
+        loss, grads = jgrad(state["p"], images, labels)
+        state["p"], state["v"] = jupd(state["p"], state["v"], grads)
+        return loss
+
+    return jstep
+
+
+def resnet50_flops_per_image(image=224):
+    """fwd conv+fc MACs*2 for ResNet-50 (~4.1 GFLOPs at 224); bwd = 2x."""
+    fwd = 4.1e9 * (image / 224.0) ** 2
+    return 3 * fwd
+
+
+def timed_run(step_fn, steps, warmup):
+    """Warmup, sync, timed loop, sync.  float(loss) is the sync: a
+    device->host transfer is a true barrier even on tunneled PJRT backends
+    where block_until_ready can be a no-op."""
+    for _ in range(warmup):
+        loss = step_fn()
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step_fn()
+    float(loss)
+    return time.perf_counter() - t0
+
+
+def report(metric, unit, rate, flops_rate, backend):
+    """One JSON line; vs_baseline = MFU / 0.35 (BASELINE.md north star).
+    bf16 peak: v5e 197 TF — MFU only meaningful on a known accelerator."""
+    peak = {"tpu": 197e12}.get(backend)
+    mfu = flops_rate / peak if peak else 0.0
+    print(json.dumps({
+        "metric": metric, "value": round(rate, 1), "unit": unit,
+        "vs_baseline": round(mfu / 0.35, 4), "backend": backend,
+        "mfu": round(mfu, 4),
+    }))
+
+
+def main_resnet():
+    import os
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    quick = "--quick" in sys.argv
+    backend = jax.default_backend()
+    if quick or backend == "cpu":
+        image, batch, classes, steps, warmup = 32, 4, 10, 3, 1
+    else:
+        image, batch, classes, steps, warmup = 224, 128, 1000, 20, 3
+
+    jstep = build_resnet_step(classes)
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.randn(batch, 3, image, image).astype("float32"))
+    lbls = jnp.asarray(rng.randint(0, classes, (batch, 1)).astype("int32"))
+
+    dt = timed_run(lambda: jstep(imgs, lbls), steps, warmup)
+    ips = steps * batch / dt
+    report("resnet50_train_throughput", "images/sec/chip", ips,
+           ips * resnet50_flops_per_image(image), backend)
+
+
 def supervise():
     """The axon TPU plugin is flaky at init — it can raise UNAVAILABLE *or
     hang forever*, and a hang can strike any in-process jax call.  So the
@@ -104,9 +204,13 @@ def supervise():
                   f"{r.stderr.strip()[-500:]}", file=sys.stderr)
         except subprocess.TimeoutExpired:
             print(f"# child({label}) hung >{budget}s", file=sys.stderr)
+    resnet = "--model" in sys.argv and "resnet50" in sys.argv
     print(json.dumps({
-        "metric": "bert_base_pretrain_throughput", "value": 0.0,
-        "unit": "tokens/sec/chip", "vs_baseline": 0.0, "backend": "error",
+        "metric": ("resnet50_train_throughput" if resnet
+                   else "bert_base_pretrain_throughput"),
+        "value": 0.0,
+        "unit": "images/sec/chip" if resnet else "tokens/sec/chip",
+        "vs_baseline": 0.0, "backend": "error",
     }))
 
 
@@ -136,37 +240,26 @@ def main():
     mlm = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
     nsp = jnp.asarray(rng.randint(0, 2, (batch,)).astype("int32"))
 
-    for _ in range(warmup):
-        state, loss = jstep(state, ids, mlm, nsp)
-    float(loss)   # a device->host transfer is a true sync (block_until_ready
-                  # can be a no-op on tunneled PJRT backends)
+    box = {"state": state}
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = jstep(state, ids, mlm, nsp)
-    float(loss)
-    dt = time.perf_counter() - t0
+    def one_step():
+        box["state"], loss = jstep(box["state"], ids, mlm, nsp)
+        return loss
 
+    dt = timed_run(one_step, steps, warmup)
     tokens_per_sec = steps * batch * seq / dt
-    fpt = flops_per_token(hidden, layers, ffn, seq, vocab)
-    achieved = tokens_per_sec * fpt
-    # bf16 peak: v5e 197 TF; MFU only meaningful on a known accelerator
-    peak = {"tpu": 197e12}.get(backend)
-    mfu = achieved / peak if peak else 0.0
-
-    print(json.dumps({
-        "metric": "bert_base_pretrain_throughput",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.35, 4),
-        "backend": backend,
-        "mfu": round(mfu, 4),
-    }))
+    report("bert_base_pretrain_throughput", "tokens/sec/chip",
+           tokens_per_sec,
+           tokens_per_sec * flops_per_token(hidden, layers, ffn, seq, vocab),
+           backend)
 
 
 if __name__ == "__main__":
     import os
     if os.environ.get("GRAFT_BENCH_CHILD"):
-        main()
+        if "--model" in sys.argv and "resnet50" in sys.argv:
+            main_resnet()
+        else:
+            main()
     else:
         supervise()

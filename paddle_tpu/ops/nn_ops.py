@@ -52,8 +52,10 @@ def _conv2d(ins, attrs, ctx):
                           attrs.get("padding_algorithm", "EXPLICIT"), 2),
         rhs_dilation=attrs.get("dilations", [1, 1]),
         dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
+    # no preferred_element_type: XLA already accumulates bf16 convs in f32
+    # on the MXU, and conv_general_dilated's transpose rule rejects mixed
+    # operand dtypes when the cotangent arrives in the accumulation type
     return {"Output": [out.astype(x.dtype)]}
 
 
