@@ -130,7 +130,7 @@ def timed_run(step_fn, steps, warmup):
     """Warmup, sync, timed loop, sync.  float(loss) is the sync: a
     device->host transfer is a true barrier even on tunneled PJRT backends
     where block_until_ready can be a no-op."""
-    for _ in range(warmup):
+    for _ in range(max(1, warmup)):     # >=1: compile outside the timing
         loss = step_fn()
     float(loss)
     t0 = time.perf_counter()

@@ -186,3 +186,73 @@ def scope_guard(scope: Scope):
         yield
     finally:
         _global_scope = prev
+
+
+# ---------------------------------------------------------------------------
+# custom-op loading (framework.py:5517 load_op_library + op_function_generator
+# analog).  TPU-native: a custom op is a lowering-rule plugin —
+#   * .py module: calls ops.registry.register_op directly (the first-class
+#     path; pallas kernels plug in here too)
+#   * .so library: C ABI kernels exposed through jax.pure_callback (host
+#     execution — arbitrary native code cannot run ON the TPU; the
+#     reference's custom CUDA kernels map to host callbacks or pallas)
+# ---------------------------------------------------------------------------
+
+def load_op_library(path: str):
+    """Load a custom-op plugin; returns the list of newly registered ops."""
+    import importlib.util
+    import os as _os
+    from ..ops import registry as _registry
+
+    before = set(_registry.all_ops())
+    if str(path).endswith(".py"):
+        name = f"paddle_tpu_custom_{_os.path.basename(path)[:-3]}"
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    elif str(path).endswith(".so"):
+        _load_native_op_library(path)
+    else:
+        raise ValueError(f"op library must be .py or .so, got {path}")
+    return sorted(set(_registry.all_ops()) - before)
+
+
+def _load_native_op_library(path: str):
+    """C-ABI convention: the .so exports `pt_op_names()` returning a
+    comma-separated op list, and per op `void <name>_run(const float* in,
+    float* out, int64_t n)` — an elementwise f32 kernel wrapped into a
+    lowering via jax.pure_callback."""
+    import ctypes
+    import jax
+    import numpy as _np
+    from ..ops.registry import register_op, has_op
+
+    lib = ctypes.CDLL(path)
+    lib.pt_op_names.restype = ctypes.c_char_p
+    names = lib.pt_op_names().decode().split(",")
+    for name in [n for n in names if n]:
+        fn = getattr(lib, f"{name}_run")
+        fn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                       ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+        def _host_kernel(x, _fn=fn):
+            x = _np.ascontiguousarray(x, _np.float32)
+            out = _np.empty_like(x)
+            _fn(x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                x.size)
+            return out
+
+        if has_op(name):
+            continue
+
+        def _lowering(ins, attrs, ctx, _k=_host_kernel):
+            import jax.numpy as jnp
+            x = ins["X"][0]
+            out = jax.pure_callback(
+                _k, jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                x.astype(jnp.float32))
+            return {"Out": [out]}
+
+        # pure_callback has no JVP/transpose rule — never differentiate
+        register_op(name, _lowering, differentiable=False)

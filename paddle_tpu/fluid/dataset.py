@@ -153,18 +153,30 @@ class InMemoryDataset(DatasetBase):
         self._global_shuffle_rpc(client, seed)
 
     def _global_shuffle_rpc(self, client, seed):
-        """Exchange record lines across trainers through a dense scratch
-        table is wasteful; instead each trainer re-reads its shard after a
-        deterministic permutation of the GLOBAL filelist (equivalent record
-        placement to the reference's id-hash re-routing for one pass)."""
+        """Cross-node shuffle at file granularity (data_set.h:118 reroutes
+        records over fleet RPC; files are the unit here because every
+        trainer already holds the GLOBAL filelist).  All trainers compute
+        the same seeded permutation, each takes the strided shard for its
+        trainer id — so records genuinely move between nodes — then
+        barrier via the PS plane and shuffle locally."""
+        import os as _os
         rng = np.random.RandomState(seed)
-        files = list(self.filelist)
+        # shard from the preserved GLOBAL list every time — resharding the
+        # previous shard would drop data on the second shuffle of a run
+        if not hasattr(self, "_global_filelist"):
+            self._global_filelist = list(self.filelist)
+        files = list(self._global_filelist)
         rng.shuffle(files)
-        n = max(1, int(getattr(client, "n_trainers", 0) or 0))
-        self.filelist = files
+        n = max(1, int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        tid = int(_os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.filelist = files[tid::n] if n > 1 else files
         self._feed = self._make_feed()
         self._feed.load_into_memory()
         self._feed.local_shuffle(seed)
+        try:
+            client.barrier()
+        except Exception:                    # noqa: BLE001 — shuffle is done;
+            pass                             # barrier is best-effort sync
 
     def release_memory(self):
         self._feed = None

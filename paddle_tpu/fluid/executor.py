@@ -79,6 +79,7 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
     """
     from . import control_flow_impl
     op_list = block.ops if ops is None else ops
+    debug_nan = getattr(ctx, "debug_nan", False)
     for i, op in enumerate(op_list):
         if stop_at is not None and i >= stop_at:
             break
@@ -94,20 +95,35 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
             vals = [env[n] for n in names if n in env]
             if vals or names:
                 ins[slot] = vals
-        if call_op is not None:
-            outs = call_op(opdef, ins, op.attrs, ctx)
-        else:
-            if "SkipUpdate" in ins:   # GradientMerge k-step gate
-                from ..ops.optimizer_ops import apply_skip_update
-                plain = {k: v for k, v in ins.items() if k != "SkipUpdate"}
-                outs = apply_skip_update(ins, opdef.fn(plain, op.attrs, ctx))
+        # named_scope: per-op spans in profiler traces / HLO metadata
+        # (platform/profiler.h:127 RecordEvent placement, operator.cc:1077)
+        with jax.named_scope(op.type):
+            if call_op is not None:
+                outs = call_op(opdef, ins, op.attrs, ctx)
             else:
-                outs = opdef.fn(ins, op.attrs, ctx)
+                if "SkipUpdate" in ins:   # GradientMerge k-step gate
+                    from ..ops.optimizer_ops import apply_skip_update
+                    plain = {k: v for k, v in ins.items()
+                             if k != "SkipUpdate"}
+                    outs = apply_skip_update(
+                        ins, opdef.fn(plain, op.attrs, ctx))
+                else:
+                    outs = opdef.fn(ins, op.attrs, ctx)
         for slot, names in op.outputs.items():
             produced = outs.get(slot, [])
             for name, val in zip(names, produced):
                 if val is not None:
                     env[name] = val
+                    if debug_nan and hasattr(val, "dtype") and \
+                            jnp.issubdtype(val.dtype, jnp.floating):
+                        # per-op-output NaN scan compiled into the program
+                        # (operator.cc:1149 CheckOpHasNanOrInf, XLA-native
+                        # via checkify so the failing OP NAME surfaces)
+                        from jax.experimental import checkify
+                        checkify.check(
+                            jnp.all(jnp.isfinite(val)),
+                            f"NaN/Inf in output '{name}' of op "
+                            f"'{op.type}'")
     return env
 
 
@@ -147,7 +163,8 @@ class Executor:
                id(scope), bool(program._hints.get("is_test")),
                tuple(program._hints.get("recompute_checkpoints") or ()),
                program._hints.get("pipeline_microbatches"),
-               id(mesh) if mesh is not None else None)
+               id(mesh) if mesh is not None else None,
+               bool(core.get_flag("check_nan_inf")))
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._prepare(program, feed, fetch_names, scope, mesh)
@@ -255,6 +272,9 @@ class Executor:
         written_names = sorted(
             {n for op in run_ops for n in op.output_arg_names
              if n in persist or n in scope_state})
+        # per-op checkify checks can't be staged under wrap_with_mesh's
+        # plain jit — mesh runs keep the post-hoc fetched-var scan instead
+        debug_nan = bool(core.get_flag("check_nan_inf")) and mesh is None
 
         def fn(mut_params, ro_params, feeds, step_key):
             env = dict(mut_params)
@@ -262,6 +282,7 @@ class Executor:
             env.update(feeds)
             ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
                                   is_test=is_test)
+            ctx.debug_nan = debug_nan
             run_block_ops(block, env, ctx, ops=run_ops)
             fetches = [env[n] for n in fetch_names]
             new_vals = {n: env[n] for n in written_names if n in env}
@@ -272,6 +293,17 @@ class Executor:
         if mesh is not None:
             from ..parallel.api import wrap_with_mesh
             jfn = wrap_with_mesh(fn, mesh, program)
+        elif debug_nan:
+            # debug recompile: every op output carries a compiled-in
+            # finite-check; err.throw() names the first failing op
+            from jax.experimental import checkify
+            checked = jax.jit(checkify.checkify(
+                fn, errors=checkify.user_checks))
+
+            def jfn(mut, ro, feeds, key):
+                err, out = checked(mut, ro, feeds, key)
+                err.throw()
+                return out
         else:
             jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
         return _CompiledBlock(jfn, param_names, written_names, fetch_names,

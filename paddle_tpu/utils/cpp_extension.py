@@ -1,0 +1,36 @@
+"""Custom C++ op builder — the paddle.utils.cpp_extension analog.
+
+Reference: python/paddle/utils/cpp_extension/extension_utils.py `load()`
+compiles user sources with setuptools/nvcc and imports the resulting
+module.  TPU-native: arbitrary native code cannot execute ON the TPU, so a
+custom C++ kernel becomes a HOST kernel behind jax.pure_callback (the
+py_func pattern), compiled with the baked-in g++ and registered through
+fluid.core.load_op_library's C-ABI convention.  Compute-path custom ops
+should be written as Python lowering rules (pallas for TPU kernels) and
+loaded from a .py plugin instead.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+
+def load(name: str, sources, extra_cxx_flags=(), build_directory=None,
+         verbose=False):
+    """Compile `sources` (C++ files following the pt custom-op ABI) into a
+    shared library and register the ops it exports.  Returns the list of
+    registered op names."""
+    build_dir = build_directory or tempfile.mkdtemp(prefix=f"ptop_{name}_")
+    so_path = os.path.join(build_dir, f"{name}.so")
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", so_path]
+    cmd += list(extra_cxx_flags)
+    cmd += [str(s) for s in (sources if isinstance(sources, (list, tuple))
+                             else [sources])]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        raise RuntimeError(f"custom op build failed:\n{r.stderr[-2000:]}")
+    if verbose:
+        print(f"[cpp_extension] built {so_path}")
+    from ..fluid.core import load_op_library
+    return load_op_library(so_path)

@@ -117,3 +117,55 @@ class TestProfilerSurface:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         assert callable(mod.extract)
+
+
+class TestPerOpNanCheck:
+    """Per-op NaN scanning (operator.cc:1149 analog via checkify)."""
+
+    def test_failing_op_is_named(self, rng):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        x = fluid.data("x", [-1, 4])
+        h = fluid.layers.log(x)            # negative input -> NaN here
+        out = fluid.layers.scale(h, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        core.set_flags({"check_nan_inf": True})
+        try:
+            with pytest.raises(Exception, match="log"):
+                exe.run(feed={"x": -np.ones((2, 4), "float32")},
+                        fetch_list=[out])
+        finally:
+            core.set_flags({"check_nan_inf": False})
+
+    def test_clean_run_passes(self, rng):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid import core
+        x = fluid.data("x", [-1, 4])
+        out = fluid.layers.scale(fluid.layers.exp(x), scale=0.5)
+        exe = fluid.Executor(fluid.CPUPlace())
+        core.set_flags({"check_nan_inf": True})
+        try:
+            got, = exe.run(feed={"x": np.zeros((2, 4), "float32")},
+                           fetch_list=[out])
+            np.testing.assert_allclose(np.asarray(got), 0.5)
+        finally:
+            core.set_flags({"check_nan_inf": False})
+
+
+class TestOpBenchHarness:
+    def test_bench_op_fwd_and_grad(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "op_bench", os.path.join(os.path.dirname(__file__), "..",
+                                     "tools", "op_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        res = mod.bench_op("softmax", {"X": ((8, 32), "float32")},
+                           steps=3, warmup=1, grad=True)
+        assert res["op"] == "softmax"
+        assert res["fwd_us"] > 0
+        assert res["bwd_us"] > 0
+        res2 = mod.bench_op("matmul_v2",
+                            {"X": ((16, 32), "float32"),
+                             "Y": ((32, 8), "float32")}, steps=3, warmup=1)
+        assert res2["fwd_us"] > 0

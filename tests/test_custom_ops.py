@@ -1,0 +1,99 @@
+"""Custom-op mechanism tests.
+
+Reference: python/paddle/fluid/framework.py:5517 load_op_library +
+python/paddle/utils/cpp_extension (user-extensible op registration)."""
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core
+
+
+class TestPyOpPlugin:
+    def test_load_py_plugin_and_run(self, tmp_path, rng):
+        plugin = tmp_path / "my_ops.py"
+        plugin.write_text(
+            "from paddle_tpu.ops.registry import register_op\n"
+            "import jax.numpy as jnp\n\n"
+            "@register_op('my_triple')\n"
+            "def _my_triple(ins, attrs, ctx):\n"
+            "    return {'Out': [ins['X'][0] * 3.0]}\n")
+        new = core.load_op_library(str(plugin))
+        assert new == ["my_triple"]
+
+        x = fluid.data("x", [-1, 4])
+        block = fluid.default_main_program().global_block()
+        block.append_op("my_triple", inputs={"X": [x]},
+                        outputs={"Out": ["tripled"]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        xs = rng.randn(2, 4).astype("float32")
+        got, = exe.run(feed={"x": xs}, fetch_list=["tripled"])
+        np.testing.assert_allclose(np.asarray(got), xs * 3.0, rtol=1e-6)
+
+    def test_bad_extension_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match=".py or .so"):
+            core.load_op_library(str(tmp_path / "plugin.txt"))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+class TestCppExtension:
+    SRC = r"""
+#include <cstdint>
+extern "C" {
+const char* pt_op_names() { return "my_negate"; }
+void my_negate_run(const float* in, float* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = -in[i];
+}
+}
+"""
+
+    def test_build_and_run_native_op(self, tmp_path, rng):
+        src = tmp_path / "my_negate.cc"
+        src.write_text(self.SRC)
+        from paddle_tpu.utils.cpp_extension import load
+        new = load("my_negate_lib", [str(src)],
+                   build_directory=str(tmp_path))
+        assert "my_negate" in new
+
+        x = fluid.data("xn", [-1, 3])
+        block = fluid.default_main_program().global_block()
+        block.append_op("my_negate", inputs={"X": [x]},
+                        outputs={"Out": ["negated"]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        xs = rng.randn(4, 3).astype("float32")
+        got, = exe.run(feed={"xn": xs}, fetch_list=["negated"])
+        np.testing.assert_allclose(np.asarray(got), -xs, rtol=1e-6)
+
+
+class TestGlobalShuffleSharding:
+    def test_two_trainers_repartition_files(self, tmp_path, monkeypatch):
+        rng = np.random.RandomState(0)
+        paths = []
+        for fi in range(6):
+            p = tmp_path / f"part-{fi}.txt"
+            p.write_text("1 %d\n" % fi)
+            paths.append(str(p))
+        ids = fluid.data("gids", [-1, 1], dtype="int64")
+
+        class FakeClient:
+            def barrier(self, *a, **k):
+                pass
+
+        shards = {}
+        for tid in range(2):
+            monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+            monkeypatch.setenv("PADDLE_TRAINER_ID", str(tid))
+            ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+            ds.set_batch_size(2)
+            ds.set_use_var([ids])
+            ds.set_filelist(paths)
+            ds.load_into_memory()
+            ds._global_shuffle_rpc(FakeClient(), seed=5)
+            shards[tid] = set(ds.filelist)
+        # disjoint shards covering every file => records moved across nodes
+        assert shards[0] | shards[1] == set(paths)
+        assert not (shards[0] & shards[1])
+        assert shards[0] != set(paths[0::2])   # permuted, not identity-strided
