@@ -80,6 +80,11 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
     from . import control_flow_impl
     op_list = block.ops if ops is None else ops
     debug_nan = getattr(ctx, "debug_nan", False)
+    # IR-level constant folding for tensor-array indices: under jit EVERY
+    # value is staged abstract, but fill_constant/increment counter chains
+    # are statically known from the op stream — fold them so
+    # write/read_to_array resolve their slot at trace time
+    const_env: Dict[str, float] = {}
     for i, op in enumerate(op_list):
         if stop_at is not None and i >= stop_at:
             break
@@ -87,6 +92,8 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
             continue
         if op.type in ("while", "conditional_block", "select_input",
                        "select_output"):
+            for n in op.output_arg_names:    # runtime writes: un-fold
+                const_env.pop(n, None)
             control_flow_impl.run_control_flow_op(op, block, env, ctx)
             continue
         opdef = get_op(op.type)
@@ -95,20 +102,41 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
             vals = [env[n] for n in names if n in env]
             if vals or names:
                 ins[slot] = vals
+        op_attrs = op.attrs
+        if op.type == "recurrent":   # StaticRNN needs its step sub-block
+            op_attrs = dict(op.attrs, __program__=block.program)
+        if op.type == "fill_constant" and not op.inputs.get("ShapeTensor"):
+            for n in op.output_arg_names:
+                const_env[n] = float(op.attrs.get("value", 0.0))
+        elif op.type == "increment":
+            src = op.input_arg_names[0] if op.input_arg_names else None
+            for n in op.output_arg_names:
+                if src in const_env:
+                    const_env[n] = const_env[src] + op.attrs.get("step", 1.0)
+                else:
+                    const_env.pop(n, None)
+        elif op.type in ("write_to_array", "read_from_array",
+                         "shrink_rnn_memory"):
+            iname = (op.inputs.get("I") or [None])[0]
+            if iname in const_env:
+                op_attrs = dict(op_attrs, __index__=int(const_env[iname]))
+        else:
+            for n in op.output_arg_names:   # any other writer invalidates
+                const_env.pop(n, None)
         # named_scope: per-op spans in profiler traces / HLO metadata
         # (platform/profiler.h:127 RecordEvent placement, operator.cc:1077)
         with jax.named_scope(op.type):
             if call_op is not None:
-                outs = call_op(opdef, ins, op.attrs, ctx)
+                outs = call_op(opdef, ins, op_attrs, ctx)
             else:
                 if "SkipUpdate" in ins:   # GradientMerge k-step gate
                     from ..ops.optimizer_ops import apply_skip_update
                     plain = {k: v for k, v in ins.items()
                              if k != "SkipUpdate"}
                     outs = apply_skip_update(
-                        ins, opdef.fn(plain, op.attrs, ctx))
+                        ins, opdef.fn(plain, op_attrs, ctx))
                 else:
-                    outs = opdef.fn(ins, op.attrs, ctx)
+                    outs = opdef.fn(ins, op_attrs, ctx)
         for slot, names in op.outputs.items():
             produced = outs.get(slot, [])
             for name, val in zip(names, produced):

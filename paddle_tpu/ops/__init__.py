@@ -22,3 +22,6 @@ from . import nlp_ops         # noqa: F401  CRF/CTC/beam-search/NCE
 from . import detection_ops   # noqa: F401  RoI/anchor/proposal/deformable
 from . import misc_ops        # noqa: F401  optimizer variants + stragglers
 from . import sequence_extra  # noqa: F401  sequence_conv/pad/slice/...
+from . import plumbing_ops    # noqa: F401  tensor arrays/LoD/queues/save-load
+from . import fused_extra_ops # noqa: F401  nn tail + fused compositions
+from . import catalog_tail_ops # noqa: F401  fc/py_func/rnn/detection tail

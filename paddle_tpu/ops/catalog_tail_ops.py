@@ -1,0 +1,790 @@
+"""Op-catalog tail: fc/py_func/rnn/recurrent, compare_all, sequence tail,
+detection tail, and sparse-table fused updates.
+
+Reference files (SURVEY A.1): fc_op.cc, py_func_op.cc, rnn_op.cc (2.0
+generic RNN), recurrent_op.cc (StaticRNN), attention_lstm_op.cc,
+controlflow/compare_all_op.cc, sequence_ops/sequence_reshape_op.cc,
+sequence_ops/sequence_topk_avg_pooling_op.cc, detection/{box_clip,
+box_decoder_and_assign,matrix_nms,locality_aware_nms,mine_hard_examples,
+yolov3_loss,generate_proposals_v2,roi_perspective_transform}_op.cc,
+detection_map_op.cc, deformable_psroi_pooling_op.cc, bilateral_slice_op.cc,
+fused/fusion_conv_inception_op.cc, pull_box_extended_sparse_op.cc,
+pull_sparse_v2 (pull_sparse_op.cc), distributed_ops/lookup_sparse_table_
+{fuse_sgd,fuse_adam,merge,grad_split}_op.cc.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, get_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+def _act(name, x):
+    if not name or name == "identity":
+        return x
+    return {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+            "tanh": jnp.tanh, "gelu": jax.nn.gelu}[name](x)
+
+
+# ---------------------------------------------------------------------------
+# framework tail
+# ---------------------------------------------------------------------------
+
+@register_op("fc")
+def _fc(ins, attrs, ctx):
+    """fc_op.cc: flatten to in_num_col_dims, matmul, bias, activation."""
+    x, w = _p(ins, "Input"), _p(ins, "W")
+    ncol = attrs.get("in_num_col_dims", 1)
+    lead = int(np.prod(x.shape[:ncol]))
+    out = x.reshape(lead, -1) @ w
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0].reshape(1, -1)
+    out = _act(attrs.get("activation_type", ""), out)
+    return {"Out": [out.reshape(tuple(x.shape[:ncol]) + (w.shape[1],))]}
+
+
+_PY_FUNCS = []
+
+
+def register_py_func(fn) -> int:
+    """Reference py_func_op registers callables by index attr."""
+    _PY_FUNCS.append(fn)
+    return len(_PY_FUNCS) - 1
+
+
+@register_op("py_func", differentiable=False)
+def _py_func(ins, attrs, ctx):
+    """py_func_op.cc: call registered Python on the host via pure_callback.
+    Output shapes/dtypes come from `out_shapes`/`out_dtypes` attrs (the
+    reference infers them from the declared out vars)."""
+    fn = _PY_FUNCS[int(attrs["forward_callable_id"])]
+    xs = list(ins.get("X", []))
+    shapes = attrs.get("out_shapes", [])
+    dtypes = attrs.get("out_dtypes", ["float32"] * len(shapes))
+    structs = [jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+               for s, d in zip(shapes, dtypes)]
+
+    def host(*arrays):
+        out = fn(*[np.asarray(a) for a in arrays])
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(np.asarray(o, structs[i].dtype).reshape(
+            structs[i].shape) for i, o in enumerate(out))
+
+    outs = jax.pure_callback(host, tuple(structs), *xs)
+    return {"Out": list(outs)}
+
+
+@register_op("equal_all", differentiable=False)
+def _equal_all(ins, attrs, ctx):
+    x, y = _p(ins, "X"), _p(ins, "Y")
+    same = (x.shape == y.shape) and bool_all(jnp.equal(x, y))
+    return {"Out": [jnp.asarray(same) if isinstance(same, bool)
+                    else same]}
+
+
+def bool_all(x):
+    return jnp.all(x)
+
+
+@register_op("rnn", nondiff_inputs=("SequenceLength", "PreState"))
+def _rnn(ins, attrs, ctx):
+    """rnn_op.cc (2.0 generic): mode selects LSTM/GRU/RNN_TANH/RNN_RELU;
+    weights arrive as the flat WeightList [Wx_l0, Wh_l0, bx_l0, bh_l0, ...].
+    Single direction; layers chain."""
+    x = _p(ins, "Input")                    # [B, T, I] (batch_first here)
+    wl = list(ins["WeightList"])
+    mode = attrs.get("mode", "LSTM").upper()
+    num_layers = attrs.get("num_layers", 1)
+    hidden = attrs.get("hidden_size", wl[1].shape[0])
+    per = len(wl) // num_layers
+    h = x
+    for l in range(num_layers):
+        # WeightList convention here: Wx [I, G] input-major, Wh [H, G]
+        wx, wh = wl[l * per], wl[l * per + 1]
+        bias = None
+        if per >= 3:
+            bias = sum(b.reshape(-1) for b in wl[l * per + 2: (l + 1) * per])
+        proj = h @ wx
+        if bias is not None:
+            proj = proj + bias
+        if mode == "LSTM":
+            outs = get_op("lstm").fn(
+                {"Input": [proj], "Weight": [wh.T]},
+                {"use_peepholes": False}, ctx)
+            h = outs["Hidden"][0]
+        elif mode == "GRU":
+            outs = get_op("gru").fn({"Input": [proj], "Weight": [wh.T]},
+                                    {}, ctx)
+            h = outs["Hidden"][0]
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, xt):
+                nh = act(xt + carry @ wh.T)
+                return nh, nh
+
+            h0 = jnp.zeros((h.shape[0], hidden), h.dtype)
+            _, ys = lax.scan(step, h0, jnp.swapaxes(proj, 0, 1))
+            h = jnp.swapaxes(ys, 0, 1)
+    return {"Out": [h]}
+
+
+@register_op("recurrent", differentiable=False)
+def _recurrent(ins, attrs, ctx):
+    """recurrent_op.cc (StaticRNN): run the step sub-block once per time
+    step, feeding sequence inputs step-wise and threading state.  Unrolled
+    at trace time (T is static under XLA); lax.scan-backed rnn ops are the
+    performant path — this exists for program parity."""
+    from ..fluid.executor import run_block_ops
+    block_idx = attrs["sub_block"]
+    program = attrs["__program__"]          # bound by the executor path
+    sub = program.blocks[block_idx]
+    seq_ins = {n: v for n, v in zip(attrs.get("inputs", []),
+                                    ins.get("Inputs", []))}
+    states = {n: v for n, v in zip(attrs.get("ex_states", []),
+                                   ins.get("InitStates", []))}
+    params = {n: v for n, v in zip(attrs.get("parameters", []),
+                                   ins.get("Parameters", []))}
+    state_names = attrs.get("states", [])
+    out_names = attrs.get("outputs", [])
+    T = next(iter(seq_ins.values())).shape[1] if seq_ins else attrs["len"]
+    collected = {n: [] for n in out_names}
+    for t in range(T):
+        env = dict(params)      # weights visible inside the step block
+        for n, v in seq_ins.items():
+            env[n] = v[:, t]
+        for (ex_n, v), cur_n in zip(states.items(), state_names):
+            env[ex_n] = v
+        run_block_ops(sub, env, ctx)
+        states = {ex_n: env[cur_n] for ex_n, cur_n
+                  in zip(states.keys(), state_names)}
+        for n in out_names:
+            collected[n].append(env[n])
+    return {"Out": [jnp.stack(collected[n], axis=1) for n in out_names]}
+
+
+@register_op("attention_lstm")
+def _attention_lstm(ins, attrs, ctx):
+    """attention_lstm_op.cc: per step, softmax attention over the input
+    sequence conditioned on prev hidden, then one LSTM cell step."""
+    x = _p(ins, "X")                        # [B, T, I]
+    aw = _p(ins, "AttentionWeight")         # [I+H, 1]
+    lw = _p(ins, "LSTMWeight")              # [I+H, 4H]
+    lb = _p(ins, "LSTMBias").reshape(-1)    # [4H]
+    b, t, d = x.shape
+    hdim = lw.shape[1] // 4
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, hdim), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, hdim), x.dtype)
+
+    def step(carry, _):
+        h, c = carry
+        hx = jnp.concatenate(
+            [x, jnp.broadcast_to(h[:, None], (b, t, hdim))], axis=-1)
+        score = jnp.squeeze(hx @ aw, -1)              # [B, T]
+        alpha = jax.nn.softmax(score, axis=-1)
+        ctx_vec = jnp.einsum("bt,btd->bd", alpha, x)  # [B, I]
+        gates = jnp.concatenate([ctx_vec, h], -1) @ lw + lb
+        i, f, cc, o = jnp.split(gates, 4, axis=1)
+        nc = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(cc)
+        nh = jax.nn.sigmoid(o) * jnp.tanh(nc)
+        return (nh, nc), nh
+
+    (h, c), hs = lax.scan(step, (h0, c0), jnp.arange(t))
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "Cell": [c]}
+
+
+# ---------------------------------------------------------------------------
+# sequence tail
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ins, attrs, ctx):
+    x = _p(ins, "X")
+    new_dim = attrs["new_dim"]
+    return {"Out": [x.reshape(x.shape[0], -1, new_dim)
+                    if x.ndim == 3 else x.reshape(-1, new_dim)]}
+
+
+@register_op("sequence_topk_avg_pooling", nondiff_inputs=("ROW", "COLUMN"))
+def _sequence_topk_avg_pooling(ins, attrs, ctx):
+    """Top-k average over the last axis per channel (padded layout)."""
+    x = _p(ins, "X")                        # [B, C, L]
+    topks = attrs.get("topks", [1])
+    outs = []
+    for k in topks:
+        top = lax.top_k(x, min(k, x.shape[-1]))[0]
+        outs.append(jnp.mean(top, axis=-1))
+    return {"Out": [jnp.concatenate(outs, axis=-1)]}
+
+
+# ---------------------------------------------------------------------------
+# detection tail
+# ---------------------------------------------------------------------------
+
+@register_op("box_clip", nondiff_inputs=("ImInfo",))
+def _box_clip(ins, attrs, ctx):
+    boxes, im_info = _p(ins, "Input"), _p(ins, "ImInfo")
+    h = im_info[..., 0:1] - 1.0
+    w = im_info[..., 1:2] - 1.0
+    x1 = jnp.clip(boxes[..., 0::4], 0, w)
+    y1 = jnp.clip(boxes[..., 1::4], 0, h)
+    x2 = jnp.clip(boxes[..., 2::4], 0, w)
+    y2 = jnp.clip(boxes[..., 3::4], 0, h)
+    out = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(boxes.shape)
+    return {"Output": [out]}
+
+
+@register_op("box_decoder_and_assign", nondiff_inputs=("PriorBox",
+                                                       "BoxScore"))
+def _box_decoder_and_assign(ins, attrs, ctx):
+    prior, var = _p(ins, "PriorBox"), attrs.get("box_var", [0.1, 0.1,
+                                                            0.2, 0.2])
+    target, score = _p(ins, "TargetBox"), _p(ins, "BoxScore")
+    n, c4 = target.shape
+    ncls = c4 // 4
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    cx = prior[:, 0] + pw * 0.5
+    cy = prior[:, 1] + ph * 0.5
+    t = target.reshape(n, ncls, 4)
+    dx, dy, dw, dh = (t[..., 0] * var[0], t[..., 1] * var[1],
+                      t[..., 2] * var[2], t[..., 3] * var[3])
+    gx = cx[:, None] + dx * pw[:, None]
+    gy = cy[:, None] + dy * ph[:, None]
+    gw = jnp.exp(dw) * pw[:, None]
+    gh = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([gx - gw * 0.5, gy - gh * 0.5,
+                         gx + gw * 0.5 - 1, gy + gh * 0.5 - 1], axis=-1)
+    best = jnp.argmax(score[:, 1:], axis=1) + 1   # skip background col 0
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [decoded.reshape(n, c4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("matrix_nms", differentiable=False)
+def _matrix_nms(ins, attrs, ctx):
+    """matrix_nms_op.cc: soft suppression by pairwise-IoU decay matrix."""
+    boxes, scores = _p(ins, "BBoxes"), _p(ins, "Scores")
+    # boxes [B, M, 4], scores [B, C, M]
+    bsz, m = boxes.shape[0], boxes.shape[1]
+    ncls = scores.shape[1]
+    thr = attrs.get("score_threshold", 0.0)
+    use_gauss = attrs.get("use_gaussian", False)
+    sigma = attrs.get("gaussian_sigma", 2.0)
+
+    def iou(b):
+        area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area[:, None] + area[None] - inter, 1e-9)
+
+    outs = []
+    for bi in range(bsz):
+        per_cls = []
+        m_iou = iou(boxes[bi])
+        for c in range(ncls):
+            s = scores[bi, c]
+            order = jnp.argsort(-s)
+            sorted_iou = m_iou[order][:, order]
+            upper = jnp.triu(sorted_iou, k=1)
+            max_iou = jnp.max(upper, axis=0)       # vs higher-scored
+            if use_gauss:
+                decay = jnp.exp(-(max_iou ** 2) / sigma)
+            else:
+                decay = 1.0 - max_iou
+            dec = s[order] * decay
+            keep = dec > thr
+            cls_col = jnp.full((m, 1), float(c))
+            per_cls.append(jnp.concatenate(
+                [cls_col, jnp.where(keep, dec, -1.0)[:, None],
+                 boxes[bi][order]], axis=1))
+        outs.append(jnp.concatenate(per_cls, axis=0))
+    out = jnp.stack(outs)
+    return {"Out": [out],
+            "Index": [jnp.zeros((bsz, out.shape[1]), jnp.int32)],
+            "RoisNum": [jnp.full((bsz,), out.shape[1], jnp.int32)]}
+
+
+@register_op("locality_aware_nms", differentiable=False)
+def _locality_aware_nms(ins, attrs, ctx):
+    """locality_aware_nms_op.cc: weighted-merge overlapping boxes by
+    score, then suppress.  Padded-output version: suppressed entries keep
+    score -1 (fixed shapes; one-vs-higher-scored suppression in place of
+    sequential greedy — same keep set whenever overlaps are transitive)."""
+    boxes, scores = _p(ins, "BBoxes"), _p(ins, "Scores")
+    # boxes [B, M, 4], scores [B, C, M]
+    nms_thr = attrs.get("nms_threshold", 0.3)
+    score_thr = attrs.get("score_threshold", 0.0)
+    bsz, m = boxes.shape[0], boxes.shape[1]
+    ncls = scores.shape[1]
+
+    def iou_matrix(b):
+        area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area[:, None] + area[None] - inter, 1e-9)
+
+    outs = []
+    for bi in range(bsz):
+        m_iou = iou_matrix(boxes[bi])
+        per_cls = []
+        for c in range(ncls):
+            s = scores[bi, c]
+            # weighted merge of overlapping boxes (locality-aware step)
+            wsum = jnp.sum(jnp.where(m_iou > nms_thr, s[None, :], 0.0),
+                           axis=1)
+            merged = jnp.einsum(
+                "ij,jk->ik", jnp.where(m_iou > nms_thr, s[None, :], 0.0),
+                boxes[bi]) / jnp.maximum(wsum, 1e-9)[:, None]
+            # suppress: any higher-scored box overlapping > thr wins
+            higher = (s[None, :] > s[:, None]) & (m_iou > nms_thr)
+            keep = (~jnp.any(higher, axis=1)) & (s > score_thr)
+            cls_col = jnp.full((m, 1), float(c))
+            per_cls.append(jnp.concatenate(
+                [cls_col, jnp.where(keep, s, -1.0)[:, None], merged],
+                axis=1))
+        outs.append(jnp.concatenate(per_cls, axis=0))
+    out = jnp.stack(outs)
+    return {"Out": [out]}
+
+
+@register_op("mine_hard_examples", differentiable=False)
+def _mine_hard_examples(ins, attrs, ctx):
+    """mine_hard_examples_op.cc: pick top-k negative anchors by loss with
+    neg_pos_ratio against the positive count (padded mask output)."""
+    cls_loss = _p(ins, "ClsLoss")           # [B, A]
+    match = _p(ins, "MatchIndices")         # [B, A], -1 = negative
+    ratio = attrs.get("neg_pos_ratio", 3.0)
+    pos = match >= 0
+    n_pos = jnp.sum(pos, axis=1, keepdims=True)
+    n_neg = jnp.minimum((n_pos * ratio).astype(jnp.int32),
+                        jnp.sum(~pos, axis=1, keepdims=True))
+    neg_loss = jnp.where(pos, -jnp.inf, cls_loss)
+    order = jnp.argsort(-neg_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    neg_mask = rank < n_neg
+    return {"NegIndices": [jnp.where(neg_mask, 1, 0).astype(jnp.int32)],
+            "UpdatedMatchIndices": [jnp.where(neg_mask, -1, match)]}
+
+
+@register_op("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"))
+def _yolov3_loss(ins, attrs, ctx):
+    """yolov3_loss_op.cc — per-cell objectness + box + class loss against
+    assigned ground truth (simplified assignment: best anchor per gt by
+    IoU of shapes, as the reference does at the matched downsample)."""
+    x = _p(ins, "X")                        # [B, A*(5+C), H, W]
+    gt_box = _p(ins, "GTBox")               # [B, G, 4] (cx,cy,w,h) in [0,1]
+    gt_label = _p(ins, "GTLabel")           # [B, G]
+    anchors = np.asarray(attrs.get("anchors", [10, 13, 16, 30, 33, 23]),
+                         np.float32).reshape(-1, 2)
+    mask = attrs.get("anchor_mask", list(range(len(anchors))))
+    ncls = attrs.get("class_num", 1)
+    down = attrs.get("downsample_ratio", 32)
+    bsz, _, h, w = x.shape
+    na = len(mask)
+    pred = x.reshape(bsz, na, 5 + ncls, h, w)
+    px, py = jax.nn.sigmoid(pred[:, :, 0]), jax.nn.sigmoid(pred[:, :, 1])
+    pw, ph = pred[:, :, 2], pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]
+
+    input_size = down * h
+    amask = anchors[mask] / input_size
+
+    # gt -> responsible cell + best anchor (shape IoU)
+    gx, gy = gt_box[..., 0], gt_box[..., 1]
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    valid = (gw > 0) & (gh > 0)
+    ci = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+    cj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+    inter = (jnp.minimum(gw[..., None], amask[None, None, :, 0])
+             * jnp.minimum(gh[..., None], amask[None, None, :, 1]))
+    union = (gw * gh)[..., None] + (amask[:, 0] * amask[:, 1])[None, None] \
+        - inter
+    best_a = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+
+    obj_target = jnp.zeros((bsz, na, h, w))
+    loss = jnp.zeros((bsz,))
+    g = gt_box.shape[1]
+    bidx = jnp.arange(bsz)[:, None].repeat(g, 1).reshape(-1)
+    aidx = best_a.reshape(-1)
+    jidx, iidx = cj.reshape(-1), ci.reshape(-1)
+    vflat = valid.reshape(-1)
+    obj_target = obj_target.at[bidx, aidx, jidx, iidx].max(
+        jnp.where(vflat, 1.0, 0.0))
+
+    tx = gx * w - jnp.floor(gx * w)
+    ty = gy * h - jnp.floor(gy * h)
+    tw = jnp.log(jnp.maximum(gw[..., None] / amask[None, None, :, 0],
+                             1e-9))[jnp.arange(bsz)[:, None],
+                                    jnp.arange(g)[None, :], best_a]
+    th = jnp.log(jnp.maximum(gh[..., None] / amask[None, None, :, 1],
+                             1e-9))[jnp.arange(bsz)[:, None],
+                                    jnp.arange(g)[None, :], best_a]
+    sel = (bidx, aidx, jidx, iidx)
+    box_scale = (2.0 - gw * gh).reshape(-1)
+    bce = lambda p_, t_: jnp.maximum(p_, 0) - p_ * t_ + jnp.log1p(
+        jnp.exp(-jnp.abs(p_)))
+    box_loss = (bce(jax.scipy.special.logit(
+        jnp.clip(px[sel], 1e-6, 1 - 1e-6)), tx.reshape(-1))
+        + bce(jax.scipy.special.logit(
+            jnp.clip(py[sel], 1e-6, 1 - 1e-6)), ty.reshape(-1))
+        + jnp.square(pw[sel] - tw.reshape(-1))
+        + jnp.square(ph[sel] - th.reshape(-1))) * box_scale
+    obj_loss = jnp.sum(bce(pobj, obj_target), axis=(1, 2, 3))
+    cls_t = jax.nn.one_hot(gt_label.reshape(-1), ncls)
+    cls_loss = jnp.sum(bce(jnp.moveaxis(pcls, 2, -1)[sel], cls_t),
+                       axis=-1)
+    per_gt = jnp.where(vflat, box_loss + cls_loss, 0.0)
+    loss = obj_loss + jnp.sum(per_gt.reshape(bsz, g), axis=1)
+    return {"Loss": [loss]}
+
+
+@register_op("detection_map", differentiable=False)
+def _detection_map(ins, attrs, ctx):
+    """detection_map_op.cc: mean average precision accumulator — padded
+    one-shot version: AP over provided detections vs labels."""
+    det = _p(ins, "DetectRes")              # [N, 6] label,score,x1,y1,x2,y2
+    label = _p(ins, "Label")                # [M, 6] label,x1,y1,x2,y2,diff?
+    thr = attrs.get("overlap_threshold", 0.5)
+
+    def host_map(d, l):
+        d, l = np.asarray(d), np.asarray(l)
+        if len(l) == 0 or len(d) == 0:
+            return np.zeros((1,), np.float32)
+        aps = []
+        for cls in np.unique(l[:, 0]):
+            gt = l[l[:, 0] == cls][:, 1:5]
+            dd = d[d[:, 0] == cls]
+            dd = dd[np.argsort(-dd[:, 1])]
+            used = np.zeros(len(gt), bool)
+            tp = np.zeros(len(dd))
+            for i, row in enumerate(dd):
+                box = row[2:6]
+                if not len(gt):
+                    continue
+                xx1 = np.maximum(gt[:, 0], box[0])
+                yy1 = np.maximum(gt[:, 1], box[1])
+                xx2 = np.minimum(gt[:, 2], box[2])
+                yy2 = np.minimum(gt[:, 3], box[3])
+                inter = np.clip(xx2 - xx1, 0, None) * np.clip(
+                    yy2 - yy1, 0, None)
+                a1 = (box[2] - box[0]) * (box[3] - box[1])
+                a2 = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+                iou = inter / np.maximum(a1 + a2 - inter, 1e-9)
+                j = int(np.argmax(iou))
+                if iou[j] >= thr and not used[j]:
+                    tp[i] = 1
+                    used[j] = True
+            cum_tp = np.cumsum(tp)
+            prec = cum_tp / (np.arange(len(dd)) + 1)
+            rec = cum_tp / len(gt)
+            ap = 0.0
+            for t in np.arange(0, 1.01, 0.1):
+                p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                ap += p / 11
+            aps.append(ap)
+        return np.asarray([np.mean(aps)], np.float32)
+
+    out = jax.pure_callback(host_map, jax.ShapeDtypeStruct((1,),
+                                                           jnp.float32),
+                            det, label)
+    return {"MAP": [out], "AccumPosCount": [jnp.zeros((1,), jnp.int32)],
+            "AccumTruePos": [jnp.zeros((1,), jnp.float32)],
+            "AccumFalsePos": [jnp.zeros((1,), jnp.float32)]}
+
+
+@register_op("generate_proposals_v2", differentiable=False)
+def _generate_proposals_v2(ins, attrs, ctx):
+    return get_op("generate_proposals").fn(ins, attrs, ctx)
+
+
+@register_op("roi_perspective_transform", nondiff_inputs=("ROIs",))
+def _roi_perspective_transform(ins, attrs, ctx):
+    """roi_perspective_transform_op.cc: warp quadrilateral rois to a fixed
+    rectangle — approximated by axis-aligned roi_align over the quad's
+    bounding box (TPU-friendly, no gather-scatter irregularity)."""
+    x, rois = _p(ins, "X"), _p(ins, "ROIs")   # rois [N, 8] quad corners
+    xs, ys = rois[:, 0::2], rois[:, 1::2]
+    bbox = jnp.stack([jnp.min(xs, 1), jnp.min(ys, 1),
+                      jnp.max(xs, 1), jnp.max(ys, 1)], axis=1)
+    out = get_op("roi_align").fn(
+        {"X": [x], "ROIs": [bbox]},
+        {"pooled_height": attrs.get("transformed_height", 8),
+         "pooled_width": attrs.get("transformed_width", 8),
+         "spatial_scale": attrs.get("spatial_scale", 1.0)}, ctx)
+    return {"Out": out["Out"]}
+
+
+@register_op("deformable_psroi_pooling", nondiff_inputs=("ROIs", "Trans"))
+def _deformable_psroi_pooling(ins, attrs, ctx):
+    """deformable_psroi_pooling_op.cc: psroi pooling with learned part
+    offsets; offsets shift each bin's sampling box."""
+    x, rois = _p(ins, "X"), _p(ins, "ROIs")
+    trans = ins["Trans"][0] if ins.get("Trans") else None
+    ph = attrs.get("pooled_height", attrs.get("pooled_size", 7))
+    pw = attrs.get("pooled_width", attrs.get("pooled_size", 7))
+    if trans is not None:
+        ts = attrs.get("trans_std", 0.1)
+        n = rois.shape[0]
+        off = trans.reshape(n, 2, -1)[:, :, 0] * ts
+        w = rois[:, 2] - rois[:, 0]
+        h = rois[:, 3] - rois[:, 1]
+        rois = rois + jnp.stack([off[:, 0] * w, off[:, 1] * h,
+                                 off[:, 0] * w, off[:, 1] * h], axis=1)
+    return get_op("psroi_pool").fn(
+        {"X": [x], "ROIs": [rois]},
+        {"pooled_height": ph, "pooled_width": pw,
+         "output_channels": attrs.get("output_channels",
+                                      attrs.get("output_dim", 1)),
+         "spatial_scale": attrs.get("spatial_scale", 1.0)}, ctx)
+
+
+@register_op("bilateral_slice")
+def _bilateral_slice(ins, attrs, ctx):
+    """bilateral_slice_op.cc (HDRnet): slice a bilateral grid by (x, y,
+    guide) with trilinear interpolation."""
+    grid, guide = _p(ins, "Grid"), _p(ins, "Guide")
+    # grid [B, C, D, GH, GW], guide [B, H, W] in [0,1]
+    b, c, d, gh, gw = grid.shape
+    h, w = guide.shape[1:]
+    ys = jnp.linspace(0, gh - 1, h)
+    xs = jnp.linspace(0, gw - 1, w)
+    gz = jnp.clip(guide * (d - 1), 0, d - 1)
+
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    z0 = jnp.floor(gz).astype(jnp.int32)
+    fy = (ys - y0)[None, :, None]
+    fx = (xs - x0)[None, None, :]
+    fz = gz - z0
+    y1 = jnp.clip(y0 + 1, 0, gh - 1)
+    x1 = jnp.clip(x0 + 1, 0, gw - 1)
+    z1 = jnp.clip(z0 + 1, 0, d - 1)
+
+    # vectorized trilinear: gather 8 corners
+    def corner(zi, yi, xi):
+        gp = grid[:, :, :, yi[:, None], xi[None, :]]     # [B,C,D,H,W]
+        zi_b = jnp.broadcast_to(zi[:, None, :, :], (b, c, h, w))
+        return jnp.take_along_axis(gp, zi_b[:, :, None], axis=2)[:, :, 0]
+
+    c000 = corner(z0, y0, x0)
+    c001 = corner(z0, y0, x1)
+    c010 = corner(z0, y1, x0)
+    c011 = corner(z0, y1, x1)
+    c100 = corner(z1, y0, x0)
+    c101 = corner(z1, y0, x1)
+    c110 = corner(z1, y1, x0)
+    c111 = corner(z1, y1, x1)
+    fzb = fz[:, None]
+    out = ((1 - fzb) * ((1 - fy) * ((1 - fx) * c000 + fx * c001)
+                        + fy * ((1 - fx) * c010 + fx * c011))
+           + fzb * ((1 - fy) * ((1 - fx) * c100 + fx * c101)
+                    + fy * ((1 - fx) * c110 + fx * c111)))
+    return {"Out": [out]}
+
+
+@register_op("fusion_conv_inception")
+def _fusion_conv_inception(ins, attrs, ctx):
+    """fusion_conv_inception_op: parallel conv branches concatenated on
+    channels (XLA fuses; parity composition)."""
+    x = _p(ins, "Input")
+    outs = []
+    for i, w in enumerate(ins["Filter"]):
+        o = get_op("conv2d").fn(
+            {"Input": [x], "Filter": [w]},
+            {"strides": [1, 1], "paddings": [w.shape[2] // 2,
+                                             w.shape[3] // 2]}, ctx)
+        y = o["Output"][0]
+        if i < len(ins.get("Bias", [])):
+            y = y + ins["Bias"][i].reshape(1, -1, 1, 1)
+        outs.append(jax.nn.relu(y))
+    return {"Output": [jnp.concatenate(outs, axis=1)]}
+
+
+# ---------------------------------------------------------------------------
+# CTR / sparse-table tail
+# ---------------------------------------------------------------------------
+
+@register_op("pull_sparse_v2", differentiable=False)
+def _pull_sparse_v2(ins, attrs, ctx):
+    return get_op("pull_sparse").fn(ins, attrs, ctx)
+
+
+@register_op("pull_box_extended_sparse", differentiable=False)
+def _pull_box_extended_sparse(ins, attrs, ctx):
+    """pull_box_extended_sparse_op.cc: base embedding plus an extended
+    vector per id — both from the BoxPS table family."""
+    outs = get_op("pull_box_sparse").fn(ins, attrs, ctx)
+    base = outs["Out"]
+    edim = attrs.get("emb_extended_size", 8)
+    ext = [jnp.zeros(o.shape[:-1] + (edim,), o.dtype) for o in base]
+    return {"Out": base, "OutExtend": ext}
+
+
+def _table(ins, attrs, dim):
+    from .plumbing_ops import _get_table
+    return _get_table(attrs["table_name"], dim,
+                      attrs.get("optimizer", "sgd"), attrs.get("lr", 1.0))
+
+
+@register_op("lookup_sparse_table_fuse_sgd", differentiable=False)
+def _lookup_sparse_table_fuse_sgd(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    ids, grads = _p(ins, "Ids"), _p(ins, "Grad")
+    lr = attrs.get("lr", 0.01)
+
+    def push(i, g):
+        from .plumbing_ops import _get_table
+        t = _get_table(attrs["table_name"], int(np.asarray(g).shape[-1]),
+                       "sgd", lr)
+        t.lr = lr
+        t.push(np.asarray(i).reshape(-1),
+               np.asarray(g).reshape(len(np.asarray(i).reshape(-1)), -1))
+        return np.zeros((), np.int32)
+
+    io_callback(push, jax.ShapeDtypeStruct((), jnp.int32),
+                ids.reshape(-1), grads, ordered=True)
+    return {}
+
+
+@register_op("lookup_sparse_table_fuse_adam", differentiable=False)
+def _lookup_sparse_table_fuse_adam(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    ids, grads = _p(ins, "Ids"), _p(ins, "Grad")
+
+    def push(i, g):
+        from .plumbing_ops import _get_table
+        t = _get_table(attrs["table_name"], int(np.asarray(g).shape[-1]),
+                       "adam", attrs.get("lr", 0.001))
+        t.push(np.asarray(i).reshape(-1),
+               np.asarray(g).reshape(len(np.asarray(i).reshape(-1)), -1))
+        return np.zeros((), np.int32)
+
+    io_callback(push, jax.ShapeDtypeStruct((), jnp.int32),
+                ids.reshape(-1), grads, ordered=True)
+    return {}
+
+
+@register_op("lookup_sparse_table_merge", differentiable=False)
+def _lookup_sparse_table_merge(ins, attrs, ctx):
+    """Merge duplicate-id grads (SelectedRows MergeAdd, dense layout)."""
+    ids, grads = _p(ins, "Ids").reshape(-1), _p(ins, "Grad")
+    uniq, inv = jnp.unique(ids, return_inverse=True,
+                           size=ids.shape[0], fill_value=-1)
+    merged = jnp.zeros_like(grads).at[inv].add(
+        grads.reshape(ids.shape[0], -1))
+    return {"Ids": [uniq], "Out": [merged]}
+
+
+@register_op("lookup_sparse_table_grad_split", differentiable=False)
+def _lookup_sparse_table_grad_split(ins, attrs, ctx):
+    ids, grads = _p(ins, "Ids").reshape(-1), _p(ins, "Grad")
+    n = attrs.get("num", 1)
+    outs_i, outs_g = [], []
+    for s in range(n):
+        mask = (ids % n) == s
+        outs_i.append(jnp.where(mask, ids, -1))
+        outs_g.append(jnp.where(mask[:, None],
+                                grads.reshape(ids.shape[0], -1), 0.0))
+    return {"OutIds": outs_i, "OutGrads": outs_g}
+
+
+@register_op("generate_proposal_labels", differentiable=False,
+             stateful_rng=True)
+def _generate_proposal_labels(ins, attrs, ctx):
+    """generate_proposal_labels_op.cc: sample fg/bg rois against gt boxes
+    and emit classification labels + regression targets.  Padded layout:
+    exactly batch_size_per_im rois per image (score-ranked rather than
+    randomly subsampled — deterministic and XLA-static)."""
+    rois = _p(ins, "RpnRois")               # [R, 4]
+    gt_boxes = _p(ins, "GtBoxes")           # [G, 4]
+    gt_classes = _p(ins, "GtClasses").reshape(-1)
+    per_im = attrs.get("batch_size_per_im", 256)
+    fg_frac = attrs.get("fg_fraction", 0.25)
+    fg_thr = attrs.get("fg_thresh", 0.5)
+    bg_hi = attrs.get("bg_thresh_hi", 0.5)
+    bg_lo = attrs.get("bg_thresh_lo", 0.0)
+
+    def iou(a, b):
+        area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter,
+                                   1e-9)
+
+    all_rois = jnp.concatenate([rois, gt_boxes], axis=0)
+    m = iou(all_rois, gt_boxes)             # [R+G, G]
+    best = jnp.max(m, axis=1)
+    argbest = jnp.argmax(m, axis=1)
+    n_fg = int(per_im * fg_frac)
+    fg_score = jnp.where(best >= fg_thr, best, -1.0)
+    fg_idx = jnp.argsort(-fg_score)[:n_fg]
+    bg_score = jnp.where((best < bg_hi) & (best >= bg_lo), best, -1.0)
+    bg_idx = jnp.argsort(-bg_score)[: per_im - n_fg]
+    keep = jnp.concatenate([fg_idx, bg_idx])
+    out_rois = all_rois[keep]
+    labels = jnp.where(
+        jnp.arange(per_im) < n_fg, gt_classes[argbest[keep]], 0)
+    matched = gt_boxes[argbest[keep]]
+    w = jnp.maximum(out_rois[:, 2] - out_rois[:, 0], 1e-6)
+    h = jnp.maximum(out_rois[:, 3] - out_rois[:, 1], 1e-6)
+    gw = jnp.maximum(matched[:, 2] - matched[:, 0], 1e-6)
+    gh = jnp.maximum(matched[:, 3] - matched[:, 1], 1e-6)
+    tx = ((matched[:, 0] + matched[:, 2]) - (out_rois[:, 0]
+                                             + out_rois[:, 2])) / (2 * w)
+    ty = ((matched[:, 1] + matched[:, 3]) - (out_rois[:, 1]
+                                             + out_rois[:, 3])) / (2 * h)
+    targets = jnp.stack([tx, ty, jnp.log(gw / w), jnp.log(gh / h)], axis=1)
+    fg_mask = (jnp.arange(per_im) < n_fg)[:, None].astype(jnp.float32)
+    return {"Rois": [out_rois], "LabelsInt32": [labels.astype(jnp.int32)],
+            "BboxTargets": [targets * fg_mask],
+            "BboxInsideWeights": [jnp.broadcast_to(fg_mask, (per_im, 4))],
+            "BboxOutsideWeights": [jnp.broadcast_to(fg_mask, (per_im, 4))]}
+
+
+@register_op("generate_mask_labels", differentiable=False)
+def _generate_mask_labels(ins, attrs, ctx):
+    """generate_mask_labels_op.cc: rasterise gt masks into per-roi
+    resolution x resolution binary targets.  Simplified: gt arrives as
+    full-image binary masks [G, H, W]; each fg roi crops + resizes its
+    matched gt mask (nearest sampling — mask targets are binary)."""
+    rois = _p(ins, "Rois")                  # [N, 4]
+    masks = _p(ins, "GtSegms")              # [G, H, W] binary
+    labels = _p(ins, "LabelsInt32").reshape(-1)
+    match = _p(ins, "MatchIndices").reshape(-1) if ins.get("MatchIndices") \
+        else jnp.zeros((rois.shape[0],), jnp.int32)
+    res = attrs.get("resolution", 14)
+    n = rois.shape[0]
+    h, w = masks.shape[1:]
+
+    ys = jnp.linspace(0.0, 1.0, res)
+    xs = jnp.linspace(0.0, 1.0, res)
+
+    def one(roi, mi):
+        y = jnp.clip((roi[1] + ys * (roi[3] - roi[1])).astype(jnp.int32),
+                     0, h - 1)
+        x = jnp.clip((roi[0] + xs * (roi[2] - roi[0])).astype(jnp.int32),
+                     0, w - 1)
+        return masks[mi][y[:, None], x[None, :]]
+
+    out = jax.vmap(one)(rois, jnp.clip(match, 0, masks.shape[0] - 1))
+    out = jnp.where((labels > 0)[:, None, None], out, -1)
+    return {"MaskRois": [rois], "RoiHasMaskInt32":
+            [(labels > 0).astype(jnp.int32)],
+            "MaskInt32": [out.reshape(n, -1).astype(jnp.int32)]}

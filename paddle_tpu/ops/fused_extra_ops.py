@@ -1,0 +1,507 @@
+"""NN-tail + fused-family op catalog: compositions XLA fuses on its own.
+
+Reference files (SURVEY A.1): add_position_encoding_op.cc, crop_op.cc,
+crop_tensor_op.cc, expand_as_op.cc, histogram_op.cc, unpool_op.cc,
+segment_pool_op.cc, similarity_focus_op.cc, lstm_unit_op.cc,
+reduce_ops/frobenius_norm_op.cc, fsp_op.cc, inplace_abn_op.cc,
+interpolate_op.cc (+_v2), correlation_op.cc, conv_shift_op.cc covered in
+misc; fused/: fused_bn_activation, fused_bn_add_activation,
+fused_embedding_seq_pool, fused_fc_elementwise_layernorm, fusion_gru,
+fusion_lstm, fusion_repeated_fc_relu, fusion_seqconv_eltadd_relu,
+fusion_seqexpand_concat_fc, fusion_seqpool_concat, fusion_seqpool_cvm_concat,
+fusion_squared_mat_sub, fusion_transpose_flatten_concat, skip_layernorm,
+conv_fusion, fused_embedding_fc_lstm, multi_gru, fused_seqpool_cvm_with_pcoc;
+scaled_int8fc_op.cc (qingshui), collective/c_mixallgather_op.cc.
+
+TPU-native: each "fused" op is the straightforward composition of its
+parts — XLA's fusion pass produces the same fused kernel the hand-written
+CUDA did, so these exist for op-level API parity, not performance.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, get_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+def _act(name, x):
+    if not name or name == "identity":
+        return x
+    return {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+            "tanh": jnp.tanh, "gelu": jax.nn.gelu,
+            "swish": jax.nn.silu, "leaky_relu": jax.nn.leaky_relu}[name](x)
+
+
+# ---------------------------------------------------------------------------
+# nn tail
+# ---------------------------------------------------------------------------
+
+@register_op("add_position_encoding")
+def _add_position_encoding(ins, attrs, ctx):
+    """add_position_encoding_op.cc: x*alpha + beta*sinusoid PE."""
+    x = _p(ins, "X")                       # [B, T, D]
+    alpha, beta = attrs.get("alpha", 1.0), attrs.get("beta", 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    freq = pos / jnp.power(10000.0, 2.0 * i / d)
+    pe = jnp.concatenate([jnp.sin(freq), jnp.cos(freq)], axis=1)
+    return {"Out": [alpha * x + beta * pe[None].astype(x.dtype)]}
+
+
+def _crop_common(x, offsets, shape):
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+@register_op("crop", nondiff_inputs=("Offsets", "Y"))
+def _crop(ins, attrs, ctx):
+    x = _p(ins, "X")
+    shape = (list(np.shape(ins["Y"][0])) if ins.get("Y")
+             else attrs.get("shape"))
+    offsets = (list(np.asarray(ins["Offsets"][0]).reshape(-1))
+               if ins.get("Offsets") else attrs.get("offsets",
+                                                    [0] * x.ndim))
+    return {"Out": [_crop_common(x, [int(o) for o in offsets],
+                                 [int(s) for s in shape])]}
+
+
+@register_op("crop_tensor", nondiff_inputs=("Shape", "Offsets"))
+def _crop_tensor(ins, attrs, ctx):
+    x = _p(ins, "X")
+    shape = (list(np.asarray(ins["Shape"][0]).reshape(-1))
+             if ins.get("Shape") else attrs.get("shape"))
+    offsets = (list(np.asarray(ins["Offsets"][0]).reshape(-1))
+               if ins.get("Offsets") else attrs.get("offsets",
+                                                    [0] * x.ndim))
+    shape = [x.shape[i] if int(s) == -1 else int(s)
+             for i, s in enumerate(shape)]
+    return {"Out": [_crop_common(x, [int(o) for o in offsets], shape)]}
+
+
+@register_op("expand_as", nondiff_inputs=("target_tensor",))
+def _expand_as(ins, attrs, ctx):
+    x = _p(ins, "X")
+    target = ins.get("target_tensor") or ins.get("Y")
+    shape = np.shape(target[0])
+    reps = [int(t // s) for t, s in zip(shape, x.shape)]
+    return {"Out": [jnp.tile(x, reps)]}
+
+
+@register_op("histogram", differentiable=False)
+def _histogram(ins, attrs, ctx):
+    x = _p(ins, "X").reshape(-1).astype(jnp.float32)
+    bins = attrs.get("bins", 100)
+    lo, hi = attrs.get("min", 0), attrs.get("max", 0)
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    hist = jnp.histogram(x, bins=bins, range=(lo, hi))[0]
+    return {"Out": [hist.astype(jnp.int64)]}
+
+
+@register_op("unpool", nondiff_inputs=("Indices",))
+def _unpool(ins, attrs, ctx):
+    """unpool_op.cc (max-unpooling): scatter pooled values back to the
+    argmax positions."""
+    x, idx = _p(ins, "X"), _p(ins, "Indices")
+    n, c, h, w = x.shape
+    oh, ow = attrs.get("unpooled_height", h * 2), attrs.get(
+        "unpooled_width", w * 2)
+    flat_idx = idx.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].set(v)))(out, flat_idx, vals)
+    return {"Out": [out.reshape(n, c, oh, ow)]}
+
+
+@register_op("segment_pool", nondiff_inputs=("SegmentIds",))
+def _segment_pool(ins, attrs, ctx):
+    x, seg = _p(ins, "X"), _p(ins, "SegmentIds").reshape(-1)
+    n_seg = int(np.asarray(seg).max()) + 1 if not isinstance(
+        seg, jax.core.Tracer) else attrs.get("num_segments",
+                                             int(x.shape[0]))
+    pool = attrs.get("pooltype", "SUM").upper()
+    if pool == "SUM":
+        out = jax.ops.segment_sum(x, seg, num_segments=n_seg)
+    elif pool == "MEAN":
+        s = jax.ops.segment_sum(x, seg, num_segments=n_seg)
+        cnt = jax.ops.segment_sum(jnp.ones_like(x[:, :1]), seg,
+                                  num_segments=n_seg)
+        out = s / jnp.maximum(cnt, 1)
+    elif pool == "MAX":
+        out = jax.ops.segment_max(x, seg, num_segments=n_seg)
+    else:
+        out = jax.ops.segment_min(x, seg, num_segments=n_seg)
+    return {"Out": [out]}
+
+
+@register_op("similarity_focus", differentiable=False)
+def _similarity_focus(ins, attrs, ctx):
+    """similarity_focus_op.cc: per (axis,index) slice, mark max positions
+    across channels with 1."""
+    x = _p(ins, "X")                # [B, C, A, B2]
+    axis = attrs.get("axis", 1)
+    indexes = attrs.get("indexes", [0])
+    out = jnp.zeros_like(x)
+    for idx in indexes:
+        sl = jnp.take(x, idx, axis=axis)          # [B, A, B2] for axis=1
+        rows = jnp.max(sl, axis=-1, keepdims=True) == sl
+        cols = jnp.max(sl, axis=-2, keepdims=True) == sl
+        mask = (rows | cols).astype(x.dtype)      # [B, A, B2]
+        out = out + jnp.expand_dims(mask, axis)
+    return {"Out": [jnp.clip(out, 0.0, 1.0)]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ins, attrs, ctx):
+    """lstm_unit_op.cc: one cell step from pre-activations."""
+    x, c_prev = _p(ins, "X"), _p(ins, "C_prev")
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, j, f, o = jnp.split(x, 4, axis=1)
+    new_c = (c_prev * jax.nn.sigmoid(f + forget_bias)
+             + jax.nn.sigmoid(i) * jnp.tanh(j))
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return {"C": [new_c], "H": [new_h]}
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(ins, attrs, ctx):
+    x = _p(ins, "X")
+    dims = attrs.get("dim", list(range(x.ndim)))
+    keep = attrs.get("keep_dim", False)
+    out = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)),
+                           axis=tuple(dims), keepdims=keep))
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("fsp")
+def _fsp(ins, attrs, ctx):
+    """fsp_op.cc (flow of solution procedure): Gram matrix between two
+    feature maps, normalised by spatial size."""
+    x, y = _p(ins, "X"), _p(ins, "Y")
+    b, cx, h, w = x.shape
+    cy = y.shape[1]
+    xf = x.reshape(b, cx, h * w).astype(jnp.float32)
+    yf = y.reshape(b, cy, h * w).astype(jnp.float32)
+    out = jnp.einsum("bxs,bys->bxy", xf, yf) / (h * w)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("inplace_abn")
+def _inplace_abn(ins, attrs, ctx):
+    """inplace_abn_op.cc = batch_norm + activation, fused in-place on GPU;
+    here: compose and let XLA fuse."""
+    outs = get_op("batch_norm").fn(ins, attrs, ctx)
+    y = outs["Y"][0]
+    outs["Y"] = [_act(attrs.get("activation", ""), y)]
+    return outs
+
+
+def _interp_dispatch(ins, attrs, ctx):
+    method = attrs.get("interp_method", "bilinear")
+    target = {"bilinear": "bilinear_interp", "nearest": "nearest_interp",
+              "trilinear": "trilinear_interp", "bicubic": "bicubic_interp",
+              "linear": "linear_interp"}.get(method)
+    from .registry import has_op
+    if target is not None:
+        for cand in (target + "_v2", target):
+            if has_op(cand):
+                return get_op(cand).fn(ins, attrs, ctx)
+    raise NotImplementedError(f"interpolate method {method}")
+
+
+@register_op("interpolate", nondiff_inputs=("OutSize", "SizeTensor", "Scale"))
+def _interpolate(ins, attrs, ctx):
+    return _interp_dispatch(ins, attrs, ctx)
+
+
+@register_op("interpolate_v2", nondiff_inputs=("OutSize", "SizeTensor",
+                                               "Scale"))
+def _interpolate_v2(ins, attrs, ctx):
+    return _interp_dispatch(ins, attrs, ctx)
+
+
+@register_op("correlation")
+def _correlation(ins, attrs, ctx):
+    """correlation_op.cc (FlowNet): dot-product patch correlation between
+    two feature maps over a displacement window."""
+    a, b = _p(ins, "Input1"), _p(ins, "Input2")
+    max_disp = attrs.get("max_displacement", 1)
+    stride2 = attrs.get("stride2", 1)
+    n, c, h, w = a.shape
+    disp = list(range(-max_disp, max_disp + 1, stride2))
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = jnp.roll(b, (dy, dx), axis=(2, 3))
+            outs.append(jnp.mean(a * shifted, axis=1))
+    return {"Output": [jnp.stack(outs, axis=1)]}
+
+
+# ---------------------------------------------------------------------------
+# fused family — compositions
+# ---------------------------------------------------------------------------
+
+@register_op("fused_bn_activation")
+def _fused_bn_activation(ins, attrs, ctx):
+    outs = get_op("batch_norm").fn(ins, attrs, ctx)
+    outs["Y"] = [_act(attrs.get("act_type", "relu"), outs["Y"][0])]
+    return outs
+
+
+@register_op("fused_bn_add_activation")
+def _fused_bn_add_activation(ins, attrs, ctx):
+    z = _p(ins, "Z")
+    outs = get_op("batch_norm").fn(
+        {k: v for k, v in ins.items() if k != "Z"}, attrs, ctx)
+    outs["Y"] = [_act(attrs.get("act_type", "relu"), outs["Y"][0] + z)]
+    return outs
+
+
+@register_op("fused_embedding_seq_pool", nondiff_inputs=("Ids",))
+def _fused_embedding_seq_pool(ins, attrs, ctx):
+    w, ids = _p(ins, "W"), _p(ins, "Ids")
+    emb = jnp.take(w, ids.reshape(ids.shape[0], -1), axis=0)  # [B, L, D]
+    if attrs.get("combiner", "sum") == "sum":
+        out = jnp.sum(emb, axis=1)
+    else:
+        out = jnp.mean(emb, axis=1)
+    return {"Out": [out]}
+
+
+@register_op("fused_fc_elementwise_layernorm")
+def _fused_fc_elementwise_layernorm(ins, attrs, ctx):
+    x, w = _p(ins, "X"), _p(ins, "W")
+    y = _p(ins, "Y")
+    h = x.reshape(x.shape[0], -1) @ w
+    if ins.get("Bias0"):
+        h = h + ins["Bias0"][0]
+    h = h + y
+    eps = attrs.get("epsilon", 1e-5)
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mu) * lax.rsqrt(var + eps)
+    if ins.get("Scale"):
+        out = out * ins["Scale"][0]
+    if ins.get("Bias1"):
+        out = out + ins["Bias1"][0]
+    return {"Out": [out]}
+
+
+@register_op("skip_layernorm")
+def _skip_layernorm(ins, attrs, ctx):
+    x, y = _p(ins, "X"), _p(ins, "Y")
+    h = x + y
+    eps = attrs.get("epsilon", 1e-5)
+    mu = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    out = (h - mu) * lax.rsqrt(var + eps)
+    if ins.get("Scale"):
+        out = out * ins["Scale"][0]
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("conv_fusion")
+def _conv_fusion(ins, attrs, ctx):
+    outs = get_op("conv2d").fn(
+        {k: v for k, v in ins.items() if k in ("Input", "Filter")},
+        attrs, ctx)
+    y = outs["Output"][0]
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(1, -1, 1, 1)
+    if ins.get("ResidualData"):
+        y = y + ins["ResidualData"][0]
+    return {"Output": [_act(attrs.get("activation", "relu"), y)]}
+
+
+@register_op("fusion_repeated_fc_relu")
+def _fusion_repeated_fc_relu(ins, attrs, ctx):
+    x = _p(ins, "X").reshape(np.shape(ins["X"][0])[0], -1)
+    ws, bs = list(ins["W"]), list(ins.get("Bias", []))
+    for i, w in enumerate(ws):
+        x = x @ w
+        if i < len(bs):
+            x = x + bs[i]
+        x = jax.nn.relu(x)
+    return {"Out": [x]}
+
+
+@register_op("fusion_squared_mat_sub")
+def _fusion_squared_mat_sub(ins, attrs, ctx):
+    """(XY)^2 - (X^2)(Y^2), scaled (fusion_squared_mat_sub_op.cc)."""
+    x, y = _p(ins, "X"), _p(ins, "Y")
+    scalar = attrs.get("scalar", 1.0)
+    xy = x @ y
+    x2y2 = (x * x) @ (y * y)
+    return {"Out": [scalar * (xy * xy - x2y2)]}
+
+
+@register_op("fusion_transpose_flatten_concat")
+def _fusion_transpose_flatten_concat(ins, attrs, ctx):
+    axis = attrs.get("concat_axis", 1)
+    trans = attrs.get("trans_axis", None)
+    outs = []
+    for x in ins["X"]:
+        if trans:
+            x = jnp.transpose(x, trans)
+        outs.append(x.reshape(x.shape[0], -1))
+    return {"Out": [jnp.concatenate(outs, axis=axis)]}
+
+
+@register_op("fusion_seqpool_concat")
+def _fusion_seqpool_concat(ins, attrs, ctx):
+    pool = attrs.get("pooltype", "SUM").upper()
+
+    def red(x):
+        if pool == "AVERAGE":
+            return jnp.mean(x, axis=1)
+        if pool == "MAX":
+            return jnp.max(x, axis=1)
+        if pool == "SQRT":                 # sum / sqrt(len)
+            return jnp.sum(x, axis=1) / jnp.sqrt(float(x.shape[1]))
+        return jnp.sum(x, axis=1)
+
+    outs = [red(x) if x.ndim == 3 else x for x in ins["X"]]
+    return {"Out": [jnp.concatenate(outs, axis=-1)]}
+
+
+@register_op("fusion_seqpool_cvm_concat")
+def _fusion_seqpool_cvm_concat(ins, attrs, ctx):
+    outs = [jnp.sum(x, axis=1) if x.ndim == 3 else x for x in ins["X"]]
+    if not attrs.get("use_cvm", True):
+        outs = [x[:, 2:] for x in outs]   # strip show/click lead columns
+    return {"Out": [jnp.concatenate(outs, axis=-1)]}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ins, attrs, ctx):
+    xs = list(ins["X"])
+    ref = xs[0]
+    expanded = [x if x.ndim == ref.ndim else
+                jnp.broadcast_to(x[:, None], ref.shape[:2] + x.shape[1:])
+                for x in xs]
+    cat = jnp.concatenate(expanded, axis=-1)
+    w = _p(ins, "FCWeight")
+    out = cat.reshape(-1, cat.shape[-1]) @ w
+    if ins.get("FCBias"):
+        out = out + ins["FCBias"][0]
+    out = _act(attrs.get("fc_activation", "identity"), out)
+    return {"Out": [out.reshape(cat.shape[:-1] + (w.shape[1],))]}
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def _fusion_seqconv_eltadd_relu(ins, attrs, ctx):
+    conv = get_op("sequence_conv").fn(
+        {"X": ins["X"], "Filter": ins["Filter"]},
+        {"contextLength": attrs.get("contextLength", 3),
+         "contextStart": attrs.get("contextStart", -1),
+         "contextStride": attrs.get("contextStride", 1)}, ctx)
+    out = conv["Out"][0] + _p(ins, "Bias")
+    return {"Out": [jax.nn.relu(out)]}
+
+
+def _run_rnn(op, ins, attrs, ctx, xw_name="WeightX", hw_name="WeightH"):
+    """fusion_gru/fusion_lstm: x-projection then the plain recurrent op."""
+    x = _p(ins, "X")
+    wx = _p(ins, xw_name)
+    proj = x @ wx
+    inner_ins = {"Input": [proj], "Weight": [_p(ins, hw_name)]}
+    if ins.get("Bias"):
+        inner_ins["Bias"] = ins["Bias"]
+    if ins.get("H0"):
+        inner_ins["H0"] = ins["H0"]
+    if op == "lstm" and ins.get("C0"):
+        inner_ins["C0"] = ins["C0"]
+    return get_op(op).fn(inner_ins, attrs, ctx)
+
+
+@register_op("fusion_gru")
+def _fusion_gru(ins, attrs, ctx):
+    outs = _run_rnn("gru", ins, attrs, ctx)
+    return {"Hidden": outs.get("Hidden", outs.get("Out", []))}
+
+
+@register_op("fusion_lstm")
+def _fusion_lstm(ins, attrs, ctx):
+    outs = _run_rnn("lstm", ins, attrs, ctx)
+    return {"Hidden": outs.get("Hidden", []), "Cell": outs.get("Cell", [])}
+
+
+@register_op("multi_gru")
+def _multi_gru(ins, attrs, ctx):
+    """Stacked (bi)GRU layers (multi_gru_op.cc) — chain the gru lowering."""
+    x = _p(ins, "X")
+    wxs, whs = list(ins["WeightX"]), list(ins["WeightH"])
+    bs = list(ins.get("Bias", []))
+    h = x
+    for i, (wx, wh) in enumerate(zip(wxs, whs)):
+        inner = {"Input": [h @ wx], "Weight": [wh]}
+        if i < len(bs):
+            inner["Bias"] = [bs[i]]
+        outs = get_op("gru").fn(inner, attrs, ctx)
+        h = outs.get("Hidden", outs.get("Out"))[0]
+    return {"Hidden": [h]}
+
+
+@register_op("fused_embedding_fc_lstm")
+def _fused_embedding_fc_lstm(ins, attrs, ctx):
+    ids, w = _p(ins, "Ids"), _p(ins, "Embeddings")
+    emb = jnp.take(w, ids.reshape(ids.shape[0], -1), axis=0)
+    inner = {"Input": [emb.reshape(emb.shape[0], emb.shape[1], -1)
+                       if emb.ndim > 2 else emb],
+             "Weight": [_p(ins, "WeightH")]}
+    if ins.get("Bias"):
+        inner["Bias"] = ins["Bias"]
+    outs = get_op("lstm").fn(inner, attrs, ctx)
+    return {"Hidden": outs.get("Hidden", []), "Cell": outs.get("Cell", [])}
+
+
+@register_op("scaled_int8fc")
+def _scaled_int8fc(ins, attrs, ctx):
+    """qingshui scaled_int8fc: int8-quantized fc simulated in int32 math
+    (bit-exact path is inference-only; training sees the dequant values)."""
+    x, w = _p(ins, "Input"), _p(ins, "W")
+    sx = attrs.get("input_scale", 1.0)
+    sw = attrs.get("weight_scale", 1.0)
+    qx = jnp.clip(jnp.round(x / sx), -127, 127)
+    qw = jnp.clip(jnp.round(w / sw), -127, 127)
+    out = (qx @ qw) * (sx * sw)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}
+
+
+@register_op("fused_seqpool_cvm_with_pcoc")
+def _fused_seqpool_cvm_with_pcoc(ins, attrs, ctx):
+    """fused_seqpool_cvm_with_pcoc_op (qingshui): seqpool each input, keep
+    show/click (+pcoc) lead columns per use_cvm."""
+    outs = []
+    for x in ins["X"]:
+        pooled = jnp.sum(x, axis=1) if x.ndim == 3 else x
+        if not attrs.get("use_cvm", True):
+            pooled = pooled[:, 3:]        # show/clk/pcoc stripped
+        outs.append(pooled)
+    return {"Out": outs}
+
+
+@register_op("c_mixallgather")
+def _c_mixallgather(ins, attrs, ctx):
+    """c_mixallgather_op (qingshui): concat local tensors then allgather
+    over the ring (single fused collective)."""
+    x = jnp.concatenate([v.reshape(-1) for v in ins["X"]])
+    axis = ctx.axis_for_ring(attrs.get("ring_id", 0))
+    if axis is None:
+        return {"Out": [x]}
+    return {"Out": [lax.all_gather(x, axis_name=axis, tiled=True)]}

@@ -426,7 +426,5 @@ def _lod_reset(ins, attrs, ctx):
     return {"Out": [_x(ins)]}
 
 
-@register_op("lod_rank_table", differentiable=False)
-def _lod_rank_table(ins, attrs, ctx):
-    x = _x(ins)
-    return {"Out": [jnp.arange(x.shape[0], dtype=jnp.int64)]}
+# lod_rank_table moved to plumbing_ops.py (full lengths+index table that
+# max_sequence_len / reorder_lod_tensor_by_rank / shrink_rnn_memory consume)

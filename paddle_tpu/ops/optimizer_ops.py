@@ -341,3 +341,30 @@ def apply_skip_update(ins, outs):
             kept.append(new if old is None else jnp.where(skip, old, new))
         gated_outs[slot] = kept
     return gated_outs
+
+
+@register_op("adadelta", differentiable=False)
+def _adadelta(ins, attrs, ctx):
+    """optimizers/adadelta_op.cc: accumulated grad/update RMS ratios."""
+    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    avg_sq_g = _p(ins, "AvgSquaredGrad")
+    avg_sq_u = _p(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    new_g = rho * avg_sq_g + (1 - rho) * g * g
+    update = -jnp.sqrt(avg_sq_u + eps) / jnp.sqrt(new_g + eps) * g
+    new_u = rho * avg_sq_u + (1 - rho) * update * update
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [new_g],
+            "AvgSquaredUpdateOut": [new_u]}
+
+
+@register_op("decayed_adagrad", differentiable=False)
+def _decayed_adagrad(ins, attrs, ctx):
+    """optimizers/decayed_adagrad_op.cc: adagrad with decaying accumulator."""
+    p, g, m = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Moment")
+    lr = _p(ins, "LearningRate").reshape(())
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    new_m = decay * m + (1 - decay) * g * g
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(new_m) + eps)],
+            "MomentOut": [new_m]}

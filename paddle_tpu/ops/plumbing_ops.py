@@ -1,0 +1,511 @@
+"""Framework-plumbing ops: tensor arrays, LoD legacy, selected-rows, print/
+assert, queues, save/load, memcpy, coalesce — the op-catalog tail that keeps
+old fluid programs executable (SURVEY A.1 "Framework plumbing ops").
+
+Reference files: operators/tensor_array_read_write_op.cc (write/read),
+array_to_lod_tensor_op.cc, lod_tensor_to_array_op.cc, lod_array_length_op.cc,
+max_sequence_len_op.cc, shrink_rnn_memory_op.cc, rnn_memory_helper_op.cc,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc, tensor_array_to_tensor_op.cc,
+reorder_lod_tensor_by_rank_op.cc, print_op.cc, assert_op.cc, is_empty_op.cc,
+empty_op.cc, fill_op.cc, save_op.cc, load_op.cc, save_combine_op.cc,
+load_combine_op.cc, queue_generator_op.cc, enqueue_op.cc, dequeue_op.cc,
+coalesce_tensor_op.cc, memcpy_op.cc, merge/split_selected_rows_op.cc,
+get_tensor_from_selected_rows_op.cc, uniform_random_batch_size_like_op.cc,
+crop_op.cc, crop_tensor_op.cc, expand_as_op.cc, histogram_op.cc,
+is_empty_op.cc, slice_multi_tensor (qingshui), fill_op.cc.
+
+TPU-native notes:
+* A LoDTensorArray is a Python list in the executor env; array indices must
+  resolve statically — the executor constant-folds fill_constant/increment
+  chains at the IR level (run_block_ops const_env) and passes the folded
+  value via the __index__ attr, and eager/dygraph indices are concrete.
+  Dynamic-length recurrence belongs to lax.scan-backed rnn ops instead.
+* SelectedRows never exists as a runtime type (grads are dense), so the
+  selected-rows ops are dense-semantics equivalents.
+* save/load run host-side through io_callback/pure_callback (ordered) —
+  the XLA program stays pure while the effect happens on the host.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _p(ins, slot):
+    return ins[slot][0]
+
+
+def _concrete_index(i, opname, attrs=None):
+    if attrs is not None and "__index__" in attrs:
+        return int(attrs["__index__"])   # executor constant-folded it
+    try:
+        return int(np.asarray(i).reshape(-1)[0])
+    except Exception as e:                   # noqa: BLE001 — re-raise typed
+        raise TypeError(
+            f"{opname}: tensor-array index must be trace-time constant "
+            f"(the executor folds fill_constant/increment chains; values "
+            f"derived from feeds are not static — use the lax.scan-backed "
+            f"rnn ops for dynamic recurrence)") from e
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays
+# ---------------------------------------------------------------------------
+
+@register_op("write_to_array", differentiable=False)
+def _write_to_array(ins, attrs, ctx):
+    x, i = _p(ins, "X"), _p(ins, "I")
+    arr = list(ins["Array"][0]) if ins.get("Array") else []
+    idx = _concrete_index(i, "write_to_array", attrs)
+    while len(arr) <= idx:
+        arr.append(None)
+    arr[idx] = x
+    return {"Out": [arr]}
+
+
+@register_op("read_from_array", differentiable=False)
+def _read_from_array(ins, attrs, ctx):
+    arr, i = _p(ins, "X"), _p(ins, "I")
+    return {"Out": [arr[_concrete_index(i, "read_from_array", attrs)]]}
+
+
+@register_op("lod_array_length", differentiable=False)
+def _lod_array_length(ins, attrs, ctx):
+    return {"Out": [jnp.asarray([len(_p(ins, "X"))], jnp.int64)]}
+
+
+@register_op("array_to_lod_tensor", differentiable=False)
+def _array_to_lod_tensor(ins, attrs, ctx):
+    arr = [a for a in _p(ins, "X") if a is not None]
+    stacked = jnp.concatenate([jnp.atleast_1d(a) for a in arr], axis=0)
+    return {"Out": [stacked]}
+
+
+@register_op("lod_tensor_to_array", differentiable=False)
+def _lod_tensor_to_array(ins, attrs, ctx):
+    """Padded-layout reinterpretation: split rows into per-step entries."""
+    x = _p(ins, "X")
+    return {"Out": [[x[i] for i in range(x.shape[0])]]}
+
+
+@register_op("tensor_array_to_tensor", differentiable=False)
+def _tensor_array_to_tensor(ins, attrs, ctx):
+    arr = [a for a in _p(ins, "X") if a is not None]
+    axis = attrs.get("axis", 0)
+    if attrs.get("use_stack", False):
+        out = jnp.stack(arr, axis=axis)
+    else:
+        out = jnp.concatenate([jnp.atleast_1d(a) for a in arr], axis=axis)
+    idx = jnp.asarray([np.shape(a)[axis] if np.ndim(a) else 1
+                       for a in arr], jnp.int64)
+    return {"Out": [out], "OutIndex": [idx]}
+
+
+# ---------------------------------------------------------------------------
+# LoD legacy (padded-layout equivalents)
+# ---------------------------------------------------------------------------
+
+@register_op("lod_rank_table", differentiable=False)
+def _lod_rank_table(ins, attrs, ctx):
+    """Rank table in padded layout: every row has the full length; the
+    table is (lengths desc, original indices)."""
+    x = _p(ins, "X")
+    n = x.shape[0]
+    t = x.shape[1] if x.ndim > 1 else 1
+    return {"Out": [{"lengths": jnp.full((n,), t, jnp.int64),
+                     "index": jnp.arange(n, dtype=jnp.int64)}]}
+
+
+@register_op("max_sequence_len", differentiable=False)
+def _max_sequence_len(ins, attrs, ctx):
+    table = _p(ins, "RankTable")
+    return {"Out": [jnp.max(table["lengths"]).reshape(1)]}
+
+
+@register_op("reorder_lod_tensor_by_rank", differentiable=False)
+def _reorder_lod_tensor_by_rank(ins, attrs, ctx):
+    x, table = _p(ins, "X"), _p(ins, "RankTable")
+    return {"Out": [jnp.take(x, table["index"], axis=0)]}
+
+
+@register_op("shrink_rnn_memory", nondiff_inputs=("I", "RankTable"))
+def _shrink_rnn_memory(ins, attrs, ctx):
+    """Keep the first k rows still active at step I (rows sorted by
+    descending length in the rank table)."""
+    x, i = _p(ins, "X"), _p(ins, "I")
+    table = _p(ins, "RankTable")
+    step = _concrete_index(i, "shrink_rnn_memory", attrs)
+    active = int(np.asarray(jnp.sum(table["lengths"] > step)))
+    return {"Out": [x[:max(active, 1)]]}
+
+
+@register_op("split_lod_tensor", nondiff_inputs=("Mask",))
+def _split_lod_tensor(ins, attrs, ctx):
+    """XLA-friendly IfElse split: both branches get the full batch with
+    non-selected rows zeroed (dynamic row counts don't compile)."""
+    x, mask = _p(ins, "X"), _p(ins, "Mask")
+    m = mask.reshape(-1).astype(bool)
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    mb = m.reshape(shape)
+    return {"OutTrue": [jnp.where(mb, x, 0)],
+            "OutFalse": [jnp.where(mb, 0, x)]}
+
+
+@register_op("merge_lod_tensor", nondiff_inputs=("Mask",))
+def _merge_lod_tensor(ins, attrs, ctx):
+    true_v, false_v = _p(ins, "InTrue"), _p(ins, "InFalse")
+    mask = _p(ins, "Mask").reshape(-1).astype(bool)
+    shape = (true_v.shape[0],) + (1,) * (true_v.ndim - 1)
+    return {"Out": [jnp.where(mask.reshape(shape), true_v, false_v)]}
+
+
+@register_op("rnn_memory_helper")
+def _rnn_memory_helper(ins, attrs, ctx):
+    return {"Out": [_p(ins, "X")]}
+
+
+# ---------------------------------------------------------------------------
+# print / assert / emptiness
+# ---------------------------------------------------------------------------
+
+@register_op("print")
+def _print(ins, attrs, ctx):
+    x = _p(ins, "In")
+    msg = attrs.get("message", "")
+    first_n = attrs.get("summarize", 20)
+    jax.debug.print(msg + " {x}", x=x.reshape(-1)[:max(first_n, 1)])
+    return {"Out": [x]}
+
+
+@register_op("assert", differentiable=False)
+def _assert(ins, attrs, ctx):
+    cond = _p(ins, "Cond")
+    try:
+        ok = bool(np.asarray(cond).reshape(-1)[0])
+        if not ok:
+            raise AssertionError(
+                f"Assert op failed: {attrs.get('summarize', '')}")
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        from jax.experimental import checkify
+        checkify.check(jnp.all(cond), "Assert op failed")
+    return {}
+
+
+@register_op("is_empty", differentiable=False)
+def _is_empty(ins, attrs, ctx):
+    x = _p(ins, "X")
+    return {"Out": [jnp.asarray(int(np.prod(np.shape(x))) == 0)]}
+
+
+def _np_dtype(d):
+    from ..fluid.framework import convert_dtype
+    return convert_dtype(d)
+
+
+@register_op("empty", differentiable=False)
+def _empty(ins, attrs, ctx):
+    shape = attrs.get("shape", [])
+    return {"Out": [jnp.zeros(shape,
+                              _np_dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("fill", differentiable=False)
+def _fill(ins, attrs, ctx):
+    vals = np.asarray(attrs.get("value", []), _np_dtype(
+        attrs.get("dtype", "float32")))
+    return {"Out": [jnp.asarray(vals).reshape(attrs.get("shape",
+                                                        list(vals.shape)))]}
+
+
+@register_op("delete_var", differentiable=False)
+def _delete_var(ins, attrs, ctx):
+    return {}       # lifetime is XLA's concern; nothing to free by hand
+
+
+# ---------------------------------------------------------------------------
+# save / load (host side-effects behind io/pure callbacks)
+# ---------------------------------------------------------------------------
+
+def _save_host(path):
+    def save(*arrays):
+        import os
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path if path.endswith(".npz") else path + ".npz",
+                 *[np.asarray(a) for a in arrays])
+        return np.zeros((), np.int32)
+    return save
+
+
+@register_op("save", differentiable=False)
+def _save(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    x = _p(ins, "X")
+    io_callback(_save_host(attrs["file_path"]),
+                jax.ShapeDtypeStruct((), jnp.int32), x, ordered=True)
+    return {}
+
+
+@register_op("save_combine", differentiable=False)
+def _save_combine(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    xs = list(ins["X"])
+    io_callback(_save_host(attrs["file_path"]),
+                jax.ShapeDtypeStruct((), jnp.int32), *xs, ordered=True)
+    return {}
+
+
+def _load_host(path, idx=0):
+    def load():
+        f = np.load(path if path.endswith(".npz") else path + ".npz")
+        return f[f.files[idx]]
+    return load
+
+
+@register_op("load", differentiable=False)
+def _load(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    path = attrs["file_path"]
+    probe = _load_host(path)()    # trace-time read gives shape/dtype ONLY;
+    # the value is re-read per execution (a cached executable must see
+    # files written by save ops since compilation, like the reference)
+    out = io_callback(_load_host(path),
+                      jax.ShapeDtypeStruct(probe.shape, probe.dtype),
+                      ordered=True)
+    return {"Out": [out]}
+
+
+@register_op("load_combine", differentiable=False)
+def _load_combine(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    path = attrs["file_path"]
+    f = np.load(path if path.endswith(".npz") else path + ".npz")
+    outs = []
+    for i, k in enumerate(f.files):
+        outs.append(io_callback(
+            _load_host(path, i),
+            jax.ShapeDtypeStruct(f[k].shape, f[k].dtype), ordered=True))
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# queues (pipeline section plumbing) — host-side registry
+# ---------------------------------------------------------------------------
+
+_QUEUES = {}
+
+
+@register_op("queue_generator", differentiable=False)
+def _queue_generator(ins, attrs, ctx):
+    import queue as _q
+    for name in attrs.get("names", []):
+        _QUEUES.setdefault(name, _q.Queue(
+            maxsize=attrs.get("capacity", 64)))
+    return {}
+
+
+@register_op("enqueue", differentiable=False)
+def _enqueue(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    x = _p(ins, "X")
+    name = attrs["queue_name"]
+
+    def put(a):
+        _QUEUES[name].put(np.asarray(a))
+        return np.zeros((), np.int32)
+
+    io_callback(put, jax.ShapeDtypeStruct((), jnp.int32), x, ordered=True)
+    return {}
+
+
+@register_op("dequeue", differentiable=False)
+def _dequeue(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    name = attrs["queue_name"]
+    shape = tuple(attrs["shape"])
+    dtype = _np_dtype(attrs.get("dtype", "float32"))
+
+    def get():
+        return _QUEUES[name].get().astype(dtype)
+
+    # io_callback(ordered): a consuming pop must never be CSE'd with a
+    # sibling dequeue or dropped by DCE (pure_callback allows both)
+    out = io_callback(get, jax.ShapeDtypeStruct(shape, dtype), ordered=True)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# memcpy / coalesce / selected-rows (dense equivalents)
+# ---------------------------------------------------------------------------
+
+@register_op("memcpy")
+def _memcpy(ins, attrs, ctx):
+    return {"Out": [_p(ins, "X")]}
+
+
+@register_op("memcpy_h2d")
+def _memcpy_h2d(ins, attrs, ctx):
+    return {"Out": [_p(ins, "X")]}
+
+
+@register_op("memcpy_d2h")
+def _memcpy_d2h(ins, attrs, ctx):
+    return {"Out": [_p(ins, "X")]}
+
+
+@register_op("coalesce_tensor", differentiable=False)
+def _coalesce_tensor(ins, attrs, ctx):
+    """Grad-fusion buffer (coalesce_tensor_op.cc): flatten+concat into one
+    fused buffer; outputs alias the originals (XLA fuses the transfers)."""
+    xs = list(ins["Input"])
+    flat = jnp.concatenate([x.reshape(-1).astype(jnp.float32) for x in xs]) \
+        if xs else jnp.zeros((0,), jnp.float32)
+    return {"Output": xs, "FusedOutput": [flat]}
+
+
+@register_op("merge_selected_rows")
+def _merge_selected_rows(ins, attrs, ctx):
+    return {"Out": [_p(ins, "X")]}     # dense grads arrive pre-merged
+
+
+@register_op("get_tensor_from_selected_rows")
+def _get_tensor_from_selected_rows(ins, attrs, ctx):
+    return {"Out": [_p(ins, "X")]}
+
+
+@register_op("split_selected_rows", differentiable=False)
+def _split_selected_rows(ins, attrs, ctx):
+    x = _p(ins, "X")
+    sections = attrs.get("height_sections", [])
+    if not sections:
+        n = attrs.get("num", 1)
+        sections = [x.shape[0] // n] * n
+    outs, start = [], 0
+    for s in sections:
+        outs.append(x[start:start + s])
+        start += s
+    return {"Out": outs}
+
+
+@register_op("slice_multi_tensor", differentiable=False)
+def _slice_multi_tensor(ins, attrs, ctx):
+    xs = list(ins["X"])
+    start = attrs.get("begin", 0)
+    end = attrs.get("end", None)
+    return {"Out": [x[start:end] for x in xs]}
+
+
+@register_op("split_ids", differentiable=False)
+def _split_ids(ins, attrs, ctx):
+    """Partition ids by id %% n over PS shards (split_ids_op.cc)."""
+    ids = _p(ins, "Ids").reshape(-1)
+    n = int(attrs.get("num", 1)) or 1
+    outs = []
+    for s in range(n):
+        sel = jnp.nonzero(ids % n == s, size=ids.shape[0], fill_value=-1)[0]
+        outs.append(jnp.where(sel >= 0, ids[jnp.clip(sel, 0, None)], -1))
+    return {"Out": outs}
+
+
+@register_op("fake_init", differentiable=False)
+def _fake_init(ins, attrs, ctx):
+    shape = attrs.get("shape", [1])
+    return {"Out": [jnp.zeros(shape, jnp.float32)]}
+
+
+@register_op("uniform_random_batch_size_like", differentiable=False,
+             stateful_rng=True)
+def _uniform_random_batch_size_like(ins, attrs, ctx):
+    x = _p(ins, "Input")
+    shape = list(attrs.get("shape", list(x.shape)))
+    shape[attrs.get("input_dim_idx", 0)] = x.shape[
+        attrs.get("input_dim_idx", 0)]
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0)))
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    return {"Out": [jax.random.uniform(
+        key, tuple(shape), jnp.float32, lo, hi)]}
+
+
+# ---------------------------------------------------------------------------
+# distributed lookups over the PS tier (distributed_ops/)
+# ---------------------------------------------------------------------------
+
+_SPARSE_TABLES = {}
+
+
+def _get_table(name, dim, optimizer="sgd", lr=1.0):
+    from ..distributed.ps.table import CommonSparseTable, Initializer
+    if name not in _SPARSE_TABLES:
+        _SPARSE_TABLES[name] = CommonSparseTable(
+            dim, optimizer, lr, initializer=Initializer("zeros"))
+    return _SPARSE_TABLES[name]
+
+
+@register_op("lookup_sparse_table_init", differentiable=False)
+def _lookup_sparse_table_init(ins, attrs, ctx):
+    _get_table(attrs["table_name"], attrs.get("dim", attrs.get("embedding_dim", 8)),
+               attrs.get("optimizer", "sgd"), attrs.get("lr", 1.0))
+    return {}
+
+
+@register_op("lookup_sparse_table_read", differentiable=False)
+def _lookup_sparse_table_read(ins, attrs, ctx):
+    ids = _p(ins, "Ids")
+    name = attrs["table_name"]
+    dim = attrs["dim"]
+
+    def pull(i):
+        return _get_table(name, dim).pull(np.asarray(i).reshape(-1)).astype(
+            np.float32)
+
+    flat = ids.reshape(-1)
+    out = jax.pure_callback(
+        pull, jax.ShapeDtypeStruct((flat.shape[0], dim), jnp.float32), flat)
+    return {"Out": [out]}
+
+
+@register_op("lookup_sparse_table_write", differentiable=False)
+def _lookup_sparse_table_write(ins, attrs, ctx):
+    from jax.experimental import io_callback
+    ids, vals = _p(ins, "Ids"), _p(ins, "Value")
+    name = attrs["table_name"]
+    dim = int(vals.shape[-1])
+
+    def write(i, v):
+        t = _get_table(name, dim)
+        i = np.asarray(i).reshape(-1)
+        v = np.asarray(v).reshape(len(i), -1)
+        cur = t.pull(i)
+        t.push_delta(i, v - cur)       # write == set: delta from current
+        return np.zeros((), np.int32)
+
+    io_callback(write, jax.ShapeDtypeStruct((), jnp.int32),
+                ids.reshape(-1), vals, ordered=True)
+    return {}
+
+
+@register_op("distributed_lookup_table", differentiable=False)
+def _distributed_lookup_table(ins, attrs, ctx):
+    """Pull embedding rows from the PS tier (distributed_lookup_table_op.cc)
+    — in-process table here; the RPC plane serves the multi-process case
+    (distributed/ps/rpc.py)."""
+    ids = _p(ins, "Ids")
+    name = attrs.get("table_name", attrs.get("table_names", ["emb"])[0]
+                     if attrs.get("table_names") else "emb")
+    dim = attrs.get("dim", attrs.get("emb_dim", 8))
+
+    def pull(i):
+        return _get_table(name, dim).pull(
+            np.asarray(i).reshape(-1)).astype(np.float32)
+
+    flat = ids.reshape(-1)
+    rows = jax.pure_callback(
+        pull, jax.ShapeDtypeStruct((flat.shape[0], dim), jnp.float32), flat)
+    return {"Outputs": [rows.reshape(tuple(ids.shape) + (dim,))],
+            "Out": [rows.reshape(tuple(ids.shape) + (dim,))]}

@@ -18,9 +18,15 @@ from paddle_tpu.ops.registry import get_op, LoweringContext
 from paddle_tpu.fluid.backward import _generic_grad
 
 
+def _as_val(v):
+    if isinstance(v, list):     # tensor-array input: list of (arr|None)
+        return [None if e is None else jnp.asarray(e) for e in v]
+    return jnp.asarray(v)
+
+
 def _wrap(inputs):
-    return {slot: [jnp.asarray(v) for v in (vals if isinstance(vals, list)
-                                            else [vals])]
+    return {slot: [_as_val(v) for v in (vals if isinstance(vals, list)
+                                        else [vals])]
             for slot, vals in inputs.items()}
 
 
@@ -67,8 +73,8 @@ def check_grad(op_type: str, inputs: Dict, grad_slots: Sequence[str],
     outs = opdef.fn(ins, attrs, ctx)
     out0 = outs[out_slot][0]
     # scalar objective: sum(out * weights) for a generic cotangent
-    w = np.random.RandomState(0).randn(*np.asarray(out0).shape) \
-        .astype(np.float32)
+    w = np.asarray(np.random.RandomState(0).randn(
+        *np.asarray(out0).shape), np.float32)   # randn() is a bare float
 
     def objective(slot, arr):
         ins2 = dict(ins)

@@ -1,0 +1,343 @@
+"""Tests for the op-catalog tail (plumbing/fused/detection/sequence ops).
+
+Reference semantics per the _op.cc files cited in ops/plumbing_ops.py,
+ops/fused_extra_ops.py, ops/catalog_tail_ops.py."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from op_test import run_op, check_output
+
+
+class TestTensorArrays:
+    def test_write_read_roundtrip(self):
+        arr = run_op("write_to_array",
+                     {"X": np.ones((2, 3), "float32"),
+                      "I": np.array([0], "int64")})["Out"][0]
+        arr = run_op("write_to_array",
+                     {"X": [np.full((2, 3), 2.0, "float32")],
+                      "I": [np.array([2], "int64")],
+                      "Array": [arr]})["Out"][0]
+        assert len(arr) == 3 and arr[1] is None
+        got = run_op("read_from_array", {"X": [arr],
+                                         "I": [np.array([2], "int64")]})
+        np.testing.assert_allclose(np.asarray(got["Out"][0]), 2.0)
+        n = run_op("lod_array_length", {"X": [arr]})["Out"][0]
+        assert int(np.asarray(n)[0]) == 3
+
+    def test_array_concat_stack(self):
+        arr = [np.ones((2, 2), "float32"), np.zeros((2, 2), "float32")]
+        cat = run_op("tensor_array_to_tensor", {"X": [arr]},
+                     {"axis": 0})["Out"][0]
+        assert cat.shape == (4, 2)
+        st = run_op("tensor_array_to_tensor", {"X": [arr]},
+                    {"axis": 0, "use_stack": True})["Out"][0]
+        assert st.shape == (2, 2, 2)
+
+
+class TestPlumbing:
+    def test_fill_and_empty(self):
+        out = run_op("fill", {}, {"value": [1.0, 2.0, 3.0, 4.0],
+                                  "shape": [2, 2]})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out), [[1, 2], [3, 4]])
+        z = run_op("empty", {}, {"shape": [3], "dtype": "float32"})["Out"][0]
+        assert z.shape == (3,)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        x = np.random.randn(3, 4).astype("float32")
+        path = str(tmp_path / "var")
+        run_op("save", {"X": x}, {"file_path": path})
+        import jax
+        jax.effects_barrier()
+        got = run_op("load", {}, {"file_path": path})["Out"][0]
+        np.testing.assert_allclose(np.asarray(got), x, rtol=1e-6)
+
+    def test_queue_roundtrip(self):
+        run_op("queue_generator", {}, {"names": ["q1"]})
+        x = np.arange(6, dtype="float32").reshape(2, 3)
+        run_op("enqueue", {"X": x}, {"queue_name": "q1"})
+        import jax
+        jax.effects_barrier()
+        got = run_op("dequeue", {}, {"queue_name": "q1", "shape": [2, 3],
+                                     "dtype": "float32"})["Out"][0]
+        np.testing.assert_allclose(np.asarray(got), x)
+
+    def test_coalesce_tensor(self):
+        xs = [np.ones((2, 2), "float32"), np.zeros((3,), "float32")]
+        out = run_op("coalesce_tensor", {"Input": xs})
+        assert out["FusedOutput"][0].shape == (7,)
+        assert len(out["Output"]) == 2
+
+    def test_split_selected_rows(self):
+        x = np.arange(12, dtype="float32").reshape(6, 2)
+        out = run_op("split_selected_rows", {"X": x},
+                     {"height_sections": [2, 4]})["Out"]
+        assert out[0].shape == (2, 2) and out[1].shape == (4, 2)
+
+    def test_merge_split_lod_tensor(self):
+        x = np.arange(8, dtype="float32").reshape(4, 2)
+        mask = np.array([1, 0, 1, 0], "bool")
+        parts = run_op("split_lod_tensor", {"X": x, "Mask": mask})
+        merged = run_op("merge_lod_tensor",
+                        {"InTrue": parts["OutTrue"],
+                         "InFalse": parts["OutFalse"],
+                         "Mask": [mask]})["Out"][0]
+        np.testing.assert_allclose(np.asarray(merged), x)
+
+
+class TestCatalogTail:
+    def test_fc_matches_numpy(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        w = rng.randn(4, 5).astype("float32")
+        b = rng.randn(5).astype("float32")
+        check_output("fc", {"Input": x, "W": w, "Bias": b},
+                     {"Out": np.maximum(x @ w + b, 0)},
+                     {"activation_type": "relu"})
+
+    def test_py_func(self):
+        from paddle_tpu.ops.catalog_tail_ops import register_py_func
+        fid = register_py_func(lambda a: a * 2 + 1)
+        x = np.ones((2, 2), "float32")
+        out = run_op("py_func", {"X": [x]},
+                     {"forward_callable_id": fid,
+                      "out_shapes": [[2, 2]],
+                      "out_dtypes": ["float32"]})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+
+    def test_equal_all(self):
+        x = np.ones((2, 2), "float32")
+        out = run_op("equal_all", {"X": x, "Y": x.copy()})["Out"][0]
+        assert bool(np.asarray(out))
+        out = run_op("equal_all", {"X": x, "Y": x * 2})["Out"][0]
+        assert not bool(np.asarray(out))
+
+    def test_rnn_tanh_matches_manual(self, rng):
+        b, t, i, h = 2, 3, 4, 4
+        x = rng.randn(b, t, i).astype("float32")
+        wx = rng.randn(h, i).astype("float32") * 0.1
+        wh = rng.randn(h, h).astype("float32") * 0.1
+        out = run_op("rnn", {"Input": x, "WeightList": [wx.T, wh]},
+                     {"mode": "RNN_TANH", "hidden_size": h,
+                      "num_layers": 1})["Out"][0]
+        hh = np.zeros((b, h), "float32")
+        ref = []
+        for step in range(t):
+            hh = np.tanh(x[:, step] @ wx.T + hh @ wh.T)
+            ref.append(hh)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.stack(ref, 1), rtol=1e-5)
+
+    def test_sequence_reshape(self):
+        x = np.arange(12, dtype="float32").reshape(2, 6)
+        out = run_op("sequence_reshape", {"X": x}, {"new_dim": 3})["Out"][0]
+        assert out.shape == (4, 3)
+
+    def test_attention_lstm_shapes(self, rng):
+        b, t, d, h = 2, 5, 4, 3
+        out = run_op("attention_lstm",
+                     {"X": rng.randn(b, t, d).astype("float32"),
+                      "AttentionWeight":
+                          rng.randn(d + h, 1).astype("float32") * 0.1,
+                      "LSTMWeight":
+                          rng.randn(d + h, 4 * h).astype("float32") * 0.1,
+                      "LSTMBias": np.zeros((4 * h,), "float32")})
+        assert out["Hidden"][0].shape == (b, t, h)
+        assert out["Cell"][0].shape == (b, h)
+
+
+class TestFusedFamily:
+    def test_skip_layernorm(self, rng):
+        x = rng.randn(2, 8).astype("float32")
+        y = rng.randn(2, 8).astype("float32")
+        out = run_op("skip_layernorm", {"X": x, "Y": y})["Out"][0]
+        h = x + y
+        ref = (h - h.mean(-1, keepdims=True)) / np.sqrt(
+            h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_embedding_seq_pool(self, rng):
+        w = rng.randn(10, 4).astype("float32")
+        ids = np.array([[1, 2], [3, 3]], "int64")
+        out = run_op("fused_embedding_seq_pool", {"W": w, "Ids": ids},
+                     {"combiner": "sum"})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out),
+                                   w[ids].sum(1), rtol=1e-6)
+
+    def test_fusion_squared_mat_sub(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        y = rng.randn(4, 2).astype("float32")
+        out = run_op("fusion_squared_mat_sub", {"X": x, "Y": y},
+                     {"scalar": 0.5})["Out"][0]
+        ref = 0.5 * ((x @ y) ** 2 - (x * x) @ (y * y))
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4)
+
+    def test_fused_bn_add_activation(self, rng):
+        x = rng.randn(4, 3, 2, 2).astype("float32")
+        z = rng.randn(4, 3, 2, 2).astype("float32")
+        out = run_op("fused_bn_add_activation",
+                     {"X": x, "Z": z,
+                      "Scale": np.ones((3,), "float32"),
+                      "Bias": np.zeros((3,), "float32"),
+                      "Mean": np.zeros((3,), "float32"),
+                      "Variance": np.ones((3,), "float32")},
+                     {"act_type": "relu", "is_test": True},)
+        assert out["Y"][0].shape == x.shape
+        assert np.asarray(out["Y"][0]).min() >= 0
+
+
+class TestDetectionTail:
+    def test_box_clip(self):
+        boxes = np.array([[-5.0, -5.0, 30.0, 30.0]], "float32")
+        info = np.array([[20.0, 20.0, 1.0]], "float32")
+        out = run_op("box_clip", {"Input": boxes, "ImInfo": info}
+                     )["Output"][0]
+        np.testing.assert_allclose(np.asarray(out), [[0, 0, 19, 19]])
+
+    def test_matrix_nms_suppresses_overlaps(self):
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                           [20, 20, 30, 30]]], "float32")
+        scores = np.array([[[0.9, 0.8, 0.7]]], "float32")
+        out = run_op("matrix_nms", {"BBoxes": boxes, "Scores": scores},
+                     {"score_threshold": 0.01})["Out"][0]
+        got = np.asarray(out)[0]
+        # duplicate box decayed to ~0 score; distinct box kept
+        kept = got[got[:, 1] > 0.5]
+        assert len(kept) == 2
+
+    def test_yolov3_loss_finite_and_sensitive(self, rng):
+        b, na, ncls, h = 1, 3, 2, 4
+        x = rng.randn(b, na * (5 + ncls), h, h).astype("float32")
+        gt = np.array([[[0.5, 0.5, 0.2, 0.3]]], "float32")
+        lbl = np.array([[1]], "int64")
+        out = run_op("yolov3_loss", {"X": x, "GTBox": gt, "GTLabel": lbl},
+                     {"anchors": [10, 13, 16, 30, 33, 23],
+                      "anchor_mask": [0, 1, 2], "class_num": ncls,
+                      "downsample_ratio": 32})["Loss"][0]
+        v = float(np.asarray(out)[0])
+        assert np.isfinite(v) and v > 0
+
+    def test_generate_proposal_labels_shapes(self, rng):
+        rois = np.abs(rng.randn(20, 4)).astype("float32").cumsum(-1)
+        gt = np.array([[0, 0, 5, 5], [10, 10, 20, 20]], "float32")
+        cls = np.array([1, 2], "int64")
+        out = run_op("generate_proposal_labels",
+                     {"RpnRois": rois, "GtBoxes": gt, "GtClasses": cls},
+                     {"batch_size_per_im": 16, "fg_fraction": 0.25})
+        assert out["Rois"][0].shape == (16, 4)
+        assert out["LabelsInt32"][0].shape == (16,)
+        assert out["BboxTargets"][0].shape == (16, 4)
+
+    def test_detection_map_perfect(self):
+        det = np.array([[1, 0.9, 0, 0, 10, 10]], "float32")
+        lbl = np.array([[1, 0, 0, 10, 10, 0]], "float32")
+        out = run_op("detection_map", {"DetectRes": det, "Label": lbl}
+                     )["MAP"][0]
+        assert float(np.asarray(out)[0]) > 0.99
+
+
+class TestSparseTableOps:
+    def test_lookup_read_write_sgd(self):
+        import jax
+        ids = np.array([3, 7], "int64")
+        out = run_op("lookup_sparse_table_read", {"Ids": ids},
+                     {"table_name": "t_test", "dim": 4})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+        run_op("lookup_sparse_table_fuse_sgd",
+               {"Ids": ids, "Grad": np.ones((2, 4), "float32")},
+               {"table_name": "t_test", "lr": 0.5})
+        jax.effects_barrier()
+        out = run_op("lookup_sparse_table_read", {"Ids": ids},
+                     {"table_name": "t_test", "dim": 4})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out), -0.5)
+
+    def test_distributed_lookup_table(self):
+        ids = np.array([[1], [2]], "int64")
+        out = run_op("distributed_lookup_table", {"Ids": ids},
+                     {"table_name": "t_dist", "dim": 3})
+        assert out["Out"][0].shape == (2, 1, 3)
+
+
+class TestGradSweep:
+    """check_grad coverage for families that previously had only
+    check_output (VERDICT next #6): one representative per family."""
+
+    @pytest.mark.parametrize("op,inputs,grad_slots,out_slot,attrs", [
+        # nn tail
+        ("fc", {"Input": "r(3,4)", "W": "r(4,5)"}, ["Input", "W"],
+         "Out", {}),
+        ("add_position_encoding", {"X": "r(2,5,8)"}, ["X"], "Out", {}),
+        ("frobenius_norm", {"X": "r(3,4)"}, ["X"], "Out",
+         {"dim": [0, 1]}),
+        ("fsp", {"X": "r(2,3,4,4)", "Y": "r(2,5,4,4)"}, ["X", "Y"],
+         "Out", {}),
+        ("lstm_unit", {"X": "r(3,8)", "C_prev": "r(3,2)"},
+         ["X", "C_prev"], "H", {}),
+        # fused family
+        ("skip_layernorm", {"X": "r(3,6)", "Y": "r(3,6)"}, ["X", "Y"],
+         "Out", {}),
+        ("fusion_squared_mat_sub", {"X": "r(3,4)", "Y": "r(4,2)"},
+         ["X", "Y"], "Out", {"scalar": 1.0}),
+        ("fused_embedding_seq_pool", {"W": "r(10,4)",
+                                      "Ids": np.array([[1, 2], [3, 0]],
+                                                      "int64")},
+         ["W"], "Out", {"combiner": "sum"}),
+        # sequence tail
+        ("sequence_topk_avg_pooling", {"X": "r(2,3,6)"}, ["X"], "Out",
+         {"topks": [2]}),
+        # detection tail
+        ("fusion_repeated_fc_relu", {"X": "r(3,4)",
+                                     "W": ["r(4,6)", "r(6,2)"]},
+         ["X"], "Out", {}),
+    ])
+    def test_grad(self, op, inputs, grad_slots, out_slot, attrs, rng):
+        from op_test import check_grad
+
+        def mk(v):
+            if isinstance(v, str) and v.startswith("r("):
+                shape = tuple(int(d) for d in v[2:-1].split(","))
+                return (rng.randn(*shape) * 0.5).astype("float32")
+            if isinstance(v, list):
+                return [mk(e) for e in v]
+            return v
+
+        check_grad(op, {k: mk(v) for k, v in inputs.items()},
+                   grad_slots, out_slot=out_slot, attrs=attrs)
+
+
+class TestReviewFixes:
+    def test_locality_aware_nms_suppresses(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 10, 10],
+                           [20, 20, 30, 30]]], "float32")
+        scores = np.array([[[0.9, 0.6, 0.8]]], "float32")
+        out = run_op("locality_aware_nms",
+                     {"BBoxes": boxes, "Scores": scores},
+                     {"nms_threshold": 0.5})["Out"][0]
+        got = np.asarray(out)[0]
+        kept = got[got[:, 1] > 0]
+        assert len(kept) == 2               # overlap suppressed
+
+    def test_fusion_seqpool_sqrt(self, rng):
+        x = rng.randn(2, 4, 3).astype("float32")
+        out = run_op("fusion_seqpool_concat", {"X": [x]},
+                     {"pooltype": "SQRT"})["Out"][0]
+        np.testing.assert_allclose(np.asarray(out), x.sum(1) / 2.0,
+                                   rtol=1e-5)
+
+    def test_load_reflects_new_file_contents(self, tmp_path):
+        """load must re-read per execution, not bake trace-time values."""
+        import jax
+        path = str(tmp_path / "v")
+        a = np.ones((2, 2), "float32")
+        b = np.full((2, 2), 7.0, "float32")
+        np.savez(path + ".npz", a)
+        fn = jax.jit(lambda: run_op("load", {},
+                                    {"file_path": path})["Out"][0])
+        np.testing.assert_allclose(np.asarray(fn()), a)
+        np.savez(path + ".npz", b)
+        np.testing.assert_allclose(np.asarray(fn()), b)   # fresh read
+
+    def test_interpolate_unknown_method(self):
+        with pytest.raises(NotImplementedError, match="area"):
+            run_op("interpolate", {"X": np.zeros((1, 1, 4, 4), "float32")},
+                   {"interp_method": "area"})

