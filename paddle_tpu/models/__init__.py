@@ -5,3 +5,4 @@ from .bert import (BertModel, BertForPretraining,
 from .transformer import TransformerModel
 from .ctr import WideDeep, DeepFM
 from ..vision.models import LeNet, ResNet, resnet50
+from .language import SkipGram, PtbLm

@@ -1,0 +1,94 @@
+"""Classic language-model families: word2vec skip-gram and the PTB LSTM LM.
+
+Reference workloads: Paddle's word2vec book chapter / distributed word2vec
+benchmark (python/paddle/fluid/tests/book/test_word2vec.py — skip-gram with
+hierarchical-sigmoid/NCE over a host-scale vocab) and the PTB LSTM language
+model (tests/book/test_rnn_encoder_decoder / models repo ptb_lm).  TPU-native
+notes: skip-gram scores caller-supplied negative samples (sampled-softmax
+style; sample_negatives() draws them); the LM's recurrence is the lax.scan-backed LSTM layer, so the whole
+sentence step is one XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dygraph.layers import Layer
+from ..dygraph.nn import Embedding, Linear, Dropout
+from ..nn.layer import LSTM
+from ..fluid import layers as L
+
+
+class SkipGram(Layer):
+    """word2vec skip-gram with sampled-softmax style negative sampling."""
+
+    def __init__(self, vocab_size, embed_dim=64, neg_num=5):
+        super().__init__()
+        self.emb_in = Embedding([vocab_size, embed_dim])
+        self.emb_out = Embedding([vocab_size, embed_dim])
+        self.vocab_size = vocab_size
+        self.neg_num = neg_num
+
+    def sample_negatives(self, batch, rng=None):
+        """Draw [batch, neg_num] uniform negative ids (host-side; the
+        unigram^0.75 table of the reference is a data-pipeline concern)."""
+        rng = rng or np.random
+        return rng.randint(0, self.vocab_size,
+                           (batch, self.neg_num)).astype("int64")
+
+    def forward(self, center, context, negatives):
+        """center/context: [B] int64; negatives: [B, K] int64.
+        Returns the sampled-softmax (NCE-style) loss."""
+        c = self.emb_in(center)                    # [B, D]
+        pos = self.emb_out(context)                # [B, D]
+        neg = self.emb_out(negatives)              # [B, K, D]
+        pos_logit = L.reduce_sum(c * pos, dim=-1)            # [B]
+        neg_logit = L.reduce_sum(
+            L.unsqueeze(c, [1]) * neg, dim=-1)                  # [B, K]
+        pos_loss = L.loss.sigmoid_cross_entropy_with_logits(
+            pos_logit, L.ones_like(pos_logit))
+        neg_loss = L.reduce_sum(
+            L.loss.sigmoid_cross_entropy_with_logits(
+                neg_logit, L.zeros_like(neg_logit)), dim=-1)
+        return L.mean(pos_loss + neg_loss)
+
+    def most_similar(self, word_id, k=5):
+        import jax.numpy as jnp
+        w = self.emb_in.weight._value
+        v = w[word_id]
+        sims = (w @ v) / (jnp.linalg.norm(w, axis=1)
+                          * jnp.linalg.norm(v) + 1e-9)
+        # drop the query word itself (cosine 1.0, rank 0)
+        return np.asarray(jnp.argsort(-sims)[1: k + 1])
+
+
+class PtbLm(Layer):
+    """PTB LSTM language model: embed -> multi-layer LSTM -> tied logits."""
+
+    def __init__(self, vocab_size=10000, hidden_size=200, num_layers=2,
+                 dropout=0.0):
+        super().__init__()
+        self.embedding = Embedding([vocab_size, hidden_size])
+        self.lstm = LSTM(hidden_size, hidden_size, num_layers=num_layers)
+        self.dropout = Dropout(dropout)
+        self.fc = Linear(hidden_size, vocab_size)
+        self.vocab_size = vocab_size
+
+    def forward(self, ids):
+        emb = self.dropout(self.embedding(ids))    # [B, T, H]
+        out = self.lstm(emb)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        return self.fc(self.dropout(out))          # [B, T, V]
+
+    def loss(self, logits, labels):
+        """Per-token CE; labels [B, T] int64."""
+        flat = L.reshape(logits, [-1, self.vocab_size])
+        lbl = L.reshape(labels, [-1, 1])
+        ce = L.softmax_with_cross_entropy(flat, lbl)
+        return L.mean(ce)
+
+    def perplexity(self, logits, labels):
+        import jax.numpy as jnp
+        loss = self.loss(logits, labels)
+        return float(jnp.exp(loss.value() if hasattr(loss, "value")
+                             else loss))
