@@ -30,10 +30,12 @@ class SkipGram(Layer):
 
     def sample_negatives(self, batch, rng=None):
         """Draw [batch, neg_num] uniform negative ids (host-side; the
-        unigram^0.75 table of the reference is a data-pipeline concern)."""
+        unigram^0.75 table of the reference is a data-pipeline concern).
+        Accepts legacy RandomState or modern Generator objects."""
         rng = rng or np.random
-        return rng.randint(0, self.vocab_size,
-                           (batch, self.neg_num)).astype("int64")
+        draw = getattr(rng, "integers", None) or rng.randint
+        return np.asarray(draw(0, self.vocab_size,
+                               (batch, self.neg_num))).astype("int64")
 
     def forward(self, center, context, negatives):
         """center/context: [B] int64; negatives: [B, K] int64.
@@ -57,8 +59,10 @@ class SkipGram(Layer):
         v = w[word_id]
         sims = (w @ v) / (jnp.linalg.norm(w, axis=1)
                           * jnp.linalg.norm(v) + 1e-9)
-        # drop the query word itself (cosine 1.0, rank 0)
-        return np.asarray(jnp.argsort(-sims)[1: k + 1])
+        # mask the query by ID (rank-based self-exclusion breaks when a
+        # neighbor is near-collinear with the query)
+        sims = sims.at[word_id].set(-jnp.inf)
+        return np.asarray(jnp.argsort(-sims)[:k])
 
 
 class PtbLm(Layer):
@@ -68,16 +72,24 @@ class PtbLm(Layer):
                  dropout=0.0):
         super().__init__()
         self.embedding = Embedding([vocab_size, hidden_size])
-        self.lstm = LSTM(hidden_size, hidden_size, num_layers=num_layers)
+        # per-layer LSTMs with explicit inter-layer dropout (the reference
+        # ptb_lm applies dropout between stacked layers; _RNNBase doesn't)
+        self.lstms = [LSTM(hidden_size, hidden_size, num_layers=1)
+                      for _ in range(num_layers)]
+        for i, l in enumerate(self.lstms):
+            setattr(self, f"lstm_{i}", l)
         self.dropout = Dropout(dropout)
         self.fc = Linear(hidden_size, vocab_size)
         self.vocab_size = vocab_size
 
     def forward(self, ids):
-        emb = self.dropout(self.embedding(ids))    # [B, T, H]
-        out = self.lstm(emb)
-        if isinstance(out, (list, tuple)):
-            out = out[0]
+        out = self.dropout(self.embedding(ids))    # [B, T, H]
+        for i, lstm in enumerate(self.lstms):
+            out = lstm(out)
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            if i < len(self.lstms) - 1:
+                out = self.dropout(out)            # inter-layer dropout
         return self.fc(self.dropout(out))          # [B, T, V]
 
     def loss(self, logits, labels):

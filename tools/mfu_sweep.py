@@ -1,0 +1,136 @@
+"""MFU attribution sweep for the BERT bench (run on a real TPU chip).
+
+Measured so far (v5e chip, 2026-07-29): 91.5k tok/s = 30.9% MFU at
+batch 64 / seq 128; throughput is invariant to batch (64 vs 128), so the
+gap to the 35% target is per-token work, not under-batching.  Pure-matmul
+step time would be ~28ms vs 90ms measured — this sweep isolates where the
+other ~60ms lives by ablating one suspect at a time:
+
+  baseline      the exact bench configuration
+  nodrop        dropout off (RNG + mask traffic cost)
+  seq512        sequence 512 (attention/matmul ratio shifts, bigger tiles)
+  nohead        MLM head replaced by mean pooling (vocab-matmul +
+                softmax-xent cost)
+  b256          batch 256 (MXU tiling at larger leading dim)
+  profile       baseline + jax.profiler trace to /tmp/mfu_trace
+
+Usage:  python tools/mfu_sweep.py [case ...]   (default: all non-profile)
+Prints one JSON line per case.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run_case(case, steps=20, warmup=3):
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # the axon TPU plugin ignores the env var alone; force in-process
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import bench
+    from paddle_tpu.fluid import core
+
+    vocab, hidden, layers, heads, ffn = 30522, 768, 12, 12, 3072
+    seq, batch = (512, 16) if case == "seq512" else (128, 64)
+    if case == "b256":
+        batch = 256
+    if os.environ.get("MFU_SWEEP_TINY"):    # CPU smoke of the harness
+        vocab, hidden, layers, heads, ffn = 500, 64, 2, 4, 128
+        seq, batch, steps, warmup = 32, 4, 2, 1
+
+    if case == "nodrop":
+        import paddle_tpu.dygraph.layers as dl
+        dl.Layer.train = dl.Layer.eval          # dropout off everywhere
+
+    if case == "nohead":
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.functional import functional_loss
+        from paddle_tpu.models.bert import BertModel
+        from paddle_tpu.fluid import layers as L
+
+        dybase.enable_dygraph()
+        tracer = dybase._dygraph_tracer()
+        tracer._amp_enabled = True
+        model = BertModel(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=heads,
+                          intermediate_size=ffn, max_position=seq)
+        model.train()
+
+        def loss_fn(ids):
+            seq_out, _ = model(ids)
+            return L.mean(seq_out)
+
+        values, lfn = functional_loss(model, loss_fn)
+        jgrad = jax.jit(jax.value_and_grad(lfn))
+        state = {"v": values}
+
+        def jstep(_s, ids, _m, _n):
+            loss, grads = jgrad(state["v"], ids)
+            state["v"] = [v - 1e-6 * g for v, g in zip(state["v"], grads)]
+            return _s, loss
+        n_params = sum(int(np.prod(v.shape)) for v in values)
+        opt_state = None
+    else:
+        jstep, opt_state, n_params = bench.build_train_step(
+            vocab, hidden, layers, heads, ffn, seq, batch)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    mlm = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int32"))
+    nsp = jnp.asarray(rng.randint(0, 2, (batch,)).astype("int32"))
+
+    st = opt_state
+    for _ in range(warmup):
+        st, loss = jstep(st, ids, mlm, nsp)
+    float(loss)
+
+    if case == "profile":
+        import jax.profiler
+        jax.profiler.start_trace("/tmp/mfu_trace")
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        st, loss = jstep(st, ids, mlm, nsp)
+    float(loss)
+    dt = time.perf_counter() - t0
+    if case == "profile":
+        jax.profiler.stop_trace()
+
+    tok_s = steps * batch * seq / dt
+    fpt = bench.flops_per_token(hidden, layers, ffn, seq, vocab)
+    if case == "nohead":
+        fpt -= 3 * 2 * hidden * vocab      # head ablated: honest FLOPs
+    mfu = tok_s * fpt / 197e12
+    print(json.dumps({"case": case, "tok_s": round(tok_s, 1),
+                      "step_ms": round(dt / steps * 1e3, 2),
+                      "mfu": round(mfu, 4), "seq": seq, "batch": batch}))
+
+
+def main():
+    cases = sys.argv[1:] or ["baseline", "nodrop", "nohead", "b256",
+                             "seq512"]
+    for case in cases:
+        # each case in a fresh process: monkeypatches + jit caches isolate
+        if os.environ.get("MFU_SWEEP_CHILD"):
+            run_case(case)
+            return
+        import subprocess
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), case],
+            env=dict(os.environ, MFU_SWEEP_CHILD="1"),
+            capture_output=True, text=True, timeout=900)
+        out = [l for l in r.stdout.splitlines() if l.startswith("{")]
+        print(out[-1] if out else
+              f'{{"case": "{case}", "error": "rc={r.returncode}: '
+              f'{r.stderr[-200:].strip()}"}}')
+
+
+if __name__ == "__main__":
+    main()
