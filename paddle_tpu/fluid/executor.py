@@ -192,7 +192,9 @@ class Executor:
                tuple(program._hints.get("recompute_checkpoints") or ()),
                program._hints.get("pipeline_microbatches"),
                id(mesh) if mesh is not None else None,
-               bool(core.get_flag("check_nan_inf")))
+               bool(core.get_flag("check_nan_inf")),
+               bool(program._hints.get("inference_no_prune")),
+               bool(program._hints.get("donate_buffers")))
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._prepare(program, feed, fetch_names, scope, mesh)
@@ -295,8 +297,13 @@ class Executor:
         # an eval fetch on the same program compiles a strictly smaller
         # executable.  Pipeline/recompute paths above run the full block.
         from .framework import prune_ops
-        run_ops = prune_ops(block, block.ops, targets=list(fetch_names),
-                            extra_state=scope_state)
+        if program._hints.get("inference_no_prune"):
+            # AnalysisConfig.switch_ir_optim(False): run the full block
+            run_ops = [op for op in block.ops
+                       if op.type not in ("feed", "fetch")]
+        else:
+            run_ops = prune_ops(block, block.ops, targets=list(fetch_names),
+                                extra_state=scope_state)
         written_names = sorted(
             {n for op in run_ops for n in op.output_arg_names
              if n in persist or n in scope_state})
@@ -317,7 +324,9 @@ class Executor:
             return fetches, new_vals
 
         backend = self.place.jax_device().platform
-        donate = (core.get_flag("use_donated_buffers") and backend != "cpu")
+        donate = ((core.get_flag("use_donated_buffers")
+                   or program._hints.get("donate_buffers"))
+                  and backend != "cpu")
         if mesh is not None:
             from ..parallel.api import wrap_with_mesh
             jfn = wrap_with_mesh(fn, mesh, program)

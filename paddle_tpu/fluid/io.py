@@ -90,7 +90,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     """Export program(pickled IR) + params — io.py:1198 analog."""
     main_program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
-    infer_prog = main_program.clone(for_test=True)
+    # clone(for_test) strips the backward tail; _prune then cuts to the
+    # target-reachable subgraph (reference io.py:1198 prunes + optimizes —
+    # an exported model must not carry loss/metric ops)
+    infer_prog = main_program.clone(for_test=True)._prune(
+        [v.name for v in target_vars])
     manifest = {
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name for v in target_vars],

@@ -4,7 +4,18 @@ analysis_predictor.cc, SURVEY §3.5).
 AnalysisPredictor analog: load exported model -> clone for_test (the
 OptimizeInferenceProgram role — fusion is XLA's) -> AOT-compile the block
 once (NaiveExecutor binds ops once, here jit caches the executable) ->
-ZeroCopyRun = one device-program launch."""
+ZeroCopyRun = one device-program launch.
+
+Config knobs with REAL effects on TPU:
+* switch_ir_optim(False)  -> disable fetch-reachability pruning (the
+  pass-pipeline switch; pruning is this build's ir-optim)
+* enable_memory_optim()   -> buffer donation for the compiled step
+* precision Half/Bf16     -> weights cast to bf16 at load (MXU path); the
+  reference's TRT/int8 engines map to XLA + fake-quant ops instead
+* enable_profile()        -> jax.profiler trace around runs
+Everything mkldnn/TensorRT-specific is accepted for API parity and
+ignored — XLA is the engine.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -14,14 +25,35 @@ from ..fluid.executor import Executor
 from ..fluid.io import load_inference_model
 
 
+class PrecisionType:
+    # integer values match the reference paddle_analysis_config.h:89
+    # Precision {kFloat32=0, kInt8=1, kHalf=2}; Bfloat16 is this build's
+    # native half type (TPU MXU)
+    Float32 = 0
+    Int8 = 1
+    Half = 2
+    Bfloat16 = 3
+
+
 class AnalysisConfig:
+    Precision = PrecisionType
+
     def __init__(self, model_dir=None, prog_file=None, params_file=None):
         self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
         self._use_tpu = True
         self._mem_pool_mb = 0
+        self._ir_optim = True
+        self._memory_optim = False
+        self._precision = PrecisionType.Float32
+        self._profile = False
+        self._cpu_math_threads = 1
 
+    # -- device ------------------------------------------------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._use_tpu = True
+        self._use_tpu = True                 # accelerator == TPU here
+        self._mem_pool_mb = memory_pool_init_size_mb
 
     def enable_use_tpu(self, device_id=0):
         self._use_tpu = True
@@ -29,14 +61,71 @@ class AnalysisConfig:
     def disable_gpu(self):
         self._use_tpu = False
 
+    def use_gpu(self):
+        return self._use_tpu
+
+    # -- optimisation knobs (honored) ---------------------------------------
     def switch_ir_optim(self, flag=True):
-        pass
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
 
     def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def enable_profile(self):
+        self._profile = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = int(n)
+
+    def cpu_math_library_num_threads(self):
+        return self._cpu_math_threads
+
+    # -- precision ----------------------------------------------------------
+    def enable_tensorrt_engine(self, workspace_size=1 << 30,
+                               max_batch_size=1, min_subgraph_size=3,
+                               precision_mode=PrecisionType.Float32,
+                               use_static=False, use_calib_mode=False):
+        # TRT has no meaning on TPU; honor the precision request via bf16
+        if precision_mode in (PrecisionType.Half, PrecisionType.Bfloat16):
+            self._precision = PrecisionType.Bfloat16
+
+    def enable_mkldnn(self):
+        pass                                  # XLA is the CPU engine too
+
+    def set_precision(self, precision):
+        self._precision = precision
+
+    def precision(self):
+        return self._precision
+
+    # -- misc parity ---------------------------------------------------------
+    def switch_use_feed_fetch_ops(self, flag=False):
+        pass                                  # feed/fetch are never ops here
+
+    def switch_specify_input_names(self, flag=True):
         pass
 
-    def enable_tensorrt_engine(self, **kw):
-        pass  # TRT has no meaning on TPU; XLA is the engine
+    def pass_builder(self):
+        return _PassBuilder()
+
+
+class _PassBuilder:
+    """XLA owns the pass pipeline; expose an inert builder for parity."""
+
+    def __init__(self):
+        self._passes = ["xla-fusion (implicit)"]
+
+    def all_passes(self):
+        return list(self._passes)
+
+    def delete_pass(self, name):
+        pass
+
+    def insert_pass(self, idx, name):
+        pass
 
 
 Config = AnalysisConfig
@@ -57,6 +146,11 @@ class _ZeroCopyTensor:
     def reshape(self, shape):
         pass
 
+    def shape(self):
+        store = self._p._feed if self._is_input else self._p._results
+        v = store.get(self._name)
+        return list(np.shape(v)) if v is not None else []
+
 
 class AnalysisPredictor:
     def __init__(self, config: AnalysisConfig):
@@ -64,12 +158,52 @@ class AnalysisPredictor:
         place = (core.TPUPlace(0) if config._use_tpu
                  and core.is_compiled_with_tpu() else core.CPUPlace())
         self._exe = Executor(place)
+        model_dir = config.model_dir
+        model_file = params_file = None
+        if model_dir is None and config.prog_file:
+            # combined form: AnalysisConfig(prog_file=..., params_file=...)
+            import os as _os
+            model_dir = _os.path.dirname(config.prog_file) or "."
+            model_file = _os.path.basename(config.prog_file)
+            if config.params_file:
+                params_file = _os.path.basename(config.params_file)
+        if model_dir is None:
+            raise ValueError("AnalysisConfig needs model_dir or prog_file")
         self._program, self._feed_names, self._fetch_vars = \
-            load_inference_model(config.model_dir, self._exe)
+            load_inference_model(model_dir, self._exe,
+                                 model_filename=model_file,
+                                 params_filename=params_file)
         self._fetch_names = [v.name for v in self._fetch_vars]
+        if not config._ir_optim:
+            # pass pipeline off == no fetch-reachability pruning
+            self._program._hints["inference_no_prune"] = True
+        if config._memory_optim:
+            self._program._hints["donate_buffers"] = True
+        if config._precision in (PrecisionType.Half,
+                                 PrecisionType.Bfloat16):
+            self._cast_params_bf16()
         self._feed = {}
         self._results = {}
 
+    def _cast_params_bf16(self):
+        """Half/bf16 precision: THIS model's persistable float params
+        stored bf16 so matmuls/convs run on the MXU's native dtype (only
+        vars of the loaded program — other models/optimizer state in the
+        shared scope stay untouched)."""
+        import jax.numpy as jnp
+        from ..fluid.core import global_scope
+        scope = global_scope()
+        for var in self._program.global_block().vars.values():
+            if not var.persistable:
+                continue
+            v = scope.find_var(var.name)
+            if v is None:
+                continue
+            arr = np.asarray(v)
+            if arr.dtype == np.float32:
+                scope.set_var(var.name, jnp.asarray(arr, jnp.bfloat16))
+
+    # -- API ----------------------------------------------------------------
     def get_input_names(self):
         return list(self._feed_names)
 
@@ -87,12 +221,33 @@ class AnalysisPredictor:
     get_output_handle = get_output_tensor
 
     def zero_copy_run(self):
-        outs = self._exe.run(self._program, feed=self._feed,
-                             fetch_list=self._fetch_names)
+        profiling = self._config._profile
+        feed = self._feed
+        if self._config._precision in (PrecisionType.Half,
+                                       PrecisionType.Bfloat16):
+            import jax.numpy as jnp
+            feed = {k: (jnp.asarray(v, jnp.bfloat16)
+                        if np.asarray(v).dtype == np.float32 else v)
+                    for k, v in feed.items()}
+        if profiling:
+            import jax.profiler
+            jax.profiler.start_trace("/tmp/paddle_tpu_infer_trace")
+        try:
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_names)
+        finally:
+            if profiling:
+                import jax.profiler
+                jax.profiler.stop_trace()
         self._results = dict(zip(self._fetch_names, outs))
 
     ZeroCopyRun = zero_copy_run
     run = zero_copy_run
+
+    def compiled_op_count(self):
+        """Ops in the compiled executable (introspection for ir_optim)."""
+        compiled = list(self._exe._cache.values())
+        return compiled[-1].n_ops if compiled else None
 
 
 def create_paddle_predictor(config):
@@ -100,3 +255,21 @@ def create_paddle_predictor(config):
 
 
 create_predictor = create_paddle_predictor
+
+
+class PredictorPool:
+    """paddle_infer.PredictorPool: N handles over ONE loaded model —
+    the program, weights, and the jit-compile cache are shared; each
+    handle keeps its own feed/result buffers."""
+
+    def __init__(self, config, size=1):
+        base = AnalysisPredictor(config)
+        self._predictors = [base]
+        import copy
+        for _ in range(max(1, size) - 1):
+            clone = copy.copy(base)           # share program/exe/config
+            clone._feed, clone._results = {}, {}
+            self._predictors.append(clone)
+
+    def retrieve(self, idx):
+        return self._predictors[idx]

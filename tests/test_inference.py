@@ -1,0 +1,84 @@
+"""Inference tier tests: save_inference_model -> AnalysisPredictor with
+honored config knobs (reference analysis_predictor.cc + analysis_config.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.inference.predictor import (AnalysisConfig, PrecisionType,
+                                            AnalysisPredictor,
+                                            create_predictor, PredictorPool)
+
+
+def _train_and_export(tmp_path, rng):
+    x = fluid.data("x", [-1, 8])
+    y = fluid.data("y", [-1, 1])
+    h = fluid.layers.fc(x, 16, act="relu")
+    pred = fluid.layers.fc(h, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(32, 8).astype("float32")
+    ys = (xs.sum(1, keepdims=True) * 0.3).astype("float32")
+    for _ in range(5):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+    ref, = exe.run(fluid.default_main_program().clone(for_test=True),
+                   feed={"x": xs[:4]}, fetch_list=[pred])
+    return model_dir, xs[:4], np.asarray(ref)
+
+
+class TestAnalysisPredictor:
+    def test_matches_training_forward(self, tmp_path, rng):
+        model_dir, xs, ref = _train_and_export(tmp_path, rng)
+        predictor = create_predictor(AnalysisConfig(model_dir))
+        name = predictor.get_input_names()[0]
+        predictor.get_input_handle(name).copy_from_cpu(xs)
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+    def test_ir_optim_switch_controls_pruning(self, tmp_path, rng):
+        model_dir, xs, ref = _train_and_export(tmp_path, rng)
+
+        def run_with(ir_optim):
+            cfg = AnalysisConfig(model_dir)
+            cfg.switch_ir_optim(ir_optim)
+            p = create_predictor(cfg)
+            p.get_input_handle(p.get_input_names()[0]).copy_from_cpu(xs)
+            p.run()
+            return p
+
+        p_opt = run_with(True)
+        p_raw = run_with(False)
+        assert p_opt.compiled_op_count() <= p_raw.compiled_op_count()
+        # both produce the same numbers
+        o1 = p_opt.get_output_handle(p_opt.get_output_names()[0]).copy_to_cpu()
+        o2 = p_raw.get_output_handle(p_raw.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-6)
+
+    def test_bf16_precision(self, tmp_path, rng):
+        model_dir, xs, ref = _train_and_export(tmp_path, rng)
+        cfg = AnalysisConfig(model_dir)
+        cfg.enable_tensorrt_engine(precision_mode=PrecisionType.Half)
+        assert cfg.precision() == PrecisionType.Bfloat16
+        p = create_predictor(cfg)
+        p.get_input_handle(p.get_input_names()[0]).copy_from_cpu(xs)
+        p.run()
+        out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+        # bf16 weights: looser tolerance, but clearly the same function
+        np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                                   rtol=0.05, atol=0.05)
+
+    def test_predictor_pool(self, tmp_path, rng):
+        model_dir, xs, ref = _train_and_export(tmp_path, rng)
+        pool = PredictorPool(AnalysisConfig(model_dir), size=2)
+        for i in range(2):
+            p = pool.retrieve(i)
+            p.get_input_handle(p.get_input_names()[0]).copy_from_cpu(xs)
+            p.run()
+            out = p.get_output_handle(
+                p.get_output_names()[0]).copy_to_cpu()
+            np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
