@@ -1,0 +1,5 @@
+"""fluid.contrib analog: slim (quantization), memory usage estimation."""
+from . import slim
+from .memory_usage_calc import compiled_memory_stats, memory_usage
+
+__all__ = ["slim", "memory_usage", "compiled_memory_stats"]

@@ -1,0 +1,88 @@
+"""Estimate device memory for a Program at a given batch size.
+
+Reference: python/paddle/fluid/contrib/memory_usage_calc.py:46 — walks the
+global block's op outputs, multiplies shapes (batch_size for the -1 dim) by
+dtype size, and returns a (lower, upper, unit) estimate.  TPU-native
+addition: ``compiled_memory_stats`` reads XLA's own memory analysis off a
+jitted executable — exact numbers instead of a shape-sum heuristic —
+which is how HBM-fit questions (SURVEY §7 hard part #6) should be answered.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["memory_usage", "compiled_memory_stats"]
+
+_DTYPE_SIZE = {
+    "float64": 8, "float32": 4, "float16": 2, "bfloat16": 2,
+    "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """Shape-sum estimate over every op output in the global block.
+
+    Returns (lower, upper, unit_str); the 5%-10% headroom band mirrors the
+    reference.  XLA's actual footprint is usually lower (fusion avoids many
+    intermediates) — use compiled_memory_stats for ground truth.
+    """
+    from ..fluid.framework import Program
+
+    if not isinstance(program, Program):
+        raise TypeError("Calculating Memory Usage requires Program as its "
+                        f"Parameter. But you passed in {type(program)}")
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    total = 0.0
+    seen = set()
+    block = program.global_block()
+    for op in block.ops:
+        for name in op.output_arg_names:
+            if name in seen:
+                continue
+            seen.add(name)
+            var = block.vars.get(name)
+            if var is None or var.shape is None:
+                continue
+            count = 1
+            neg_dims = 0
+            for x in var.shape:
+                if x < 0:
+                    neg_dims += 1
+                    if neg_dims > 1:
+                        raise ValueError(
+                            f"Var {name} has more than one negative dim.")
+                    count *= batch_size * (-x)
+                else:
+                    count *= x
+            total += count * _DTYPE_SIZE.get(str(var.dtype), 4)
+
+    unit = "B"
+    for next_unit in ("KB", "MB"):
+        if total > 1024:
+            total /= 1024
+            unit = next_unit
+    return total * 1.05, total * 1.1, unit
+
+
+def compiled_memory_stats(jitted_fn, *example_args):
+    """Exact per-executable memory from XLA's memory analysis.
+
+    Lowers+compiles `jitted_fn` for the example args and returns a dict with
+    argument/output/temp/generated-code sizes in bytes (the TPU answer to
+    "does this fit in HBM at batch B").
+    """
+    import jax
+
+    compiled = jax.jit(jitted_fn).lower(*example_args).compile()
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return None
+    return {
+        "argument_size_in_bytes": ma.argument_size_in_bytes,
+        "output_size_in_bytes": ma.output_size_in_bytes,
+        "temp_size_in_bytes": ma.temp_size_in_bytes,
+        "generated_code_size_in_bytes": ma.generated_code_size_in_bytes,
+        "alias_size_in_bytes": ma.alias_size_in_bytes,
+    }

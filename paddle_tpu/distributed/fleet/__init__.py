@@ -15,6 +15,7 @@ from .base.role_maker import (Role, RoleMakerBase, PaddleCloudRoleMaker,
 from .base.fleet_base import Fleet, fleet as _fleet_singleton
 from .base.strategy_compiler import StrategyCompiler
 from . import meta_optimizers
+from . import metrics
 
 # module-level delegation to the singleton (reference __init__.py binds the
 # same names: fleet_base.py bottom + fleet/__init__.py)
@@ -41,5 +42,5 @@ minimize = _fleet_singleton.minimize
 __all__ = [
     "DistributedStrategy", "Role", "RoleMakerBase", "PaddleCloudRoleMaker",
     "UserDefinedRoleMaker", "Fleet", "StrategyCompiler", "meta_optimizers",
-    "init", "distributed_optimizer", "minimize",
+    "metrics", "init", "distributed_optimizer", "minimize",
 ]

@@ -78,6 +78,26 @@ class RoleMakerBase:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices(f"fleet_barrier_{comm_world}")
 
+    def _all_reduce(self, input, mode="sum"):
+        """Cross-trainer host-side reduce (role_maker.py _all_reduce /
+        GlooWrapper::AllReduce analog, gloo_wrapper.h:151).  Reduces a host
+        numpy array over all processes via the DCN allgather; identity in a
+        single process."""
+        import numpy as np
+        import jax
+        arr = np.asarray(input)
+        if jax.process_count() <= 1:
+            return arr.copy()
+        from jax.experimental import multihost_utils
+        gathered = np.asarray(multihost_utils.process_allgather(arr))
+        if mode == "sum":
+            return gathered.sum(axis=0)
+        if mode == "max":
+            return gathered.max(axis=0)
+        if mode == "min":
+            return gathered.min(axis=0)
+        raise ValueError(f"unknown all_reduce mode {mode!r}")
+
 
 class PaddleCloudRoleMaker(RoleMakerBase):
     """Env-driven role maker (role_maker.py:535 contract)."""

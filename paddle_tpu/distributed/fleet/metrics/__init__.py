@@ -1,0 +1,4 @@
+from . import metric
+from .metric import acc, auc, mae, max, min, mse, rmse, sum
+
+__all__ = ["metric", "sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]

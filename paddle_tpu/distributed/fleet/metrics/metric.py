@@ -1,0 +1,112 @@
+"""Distributed (cross-trainer) metric reduction.
+
+Reference: python/paddle/distributed/fleet/metrics/metric.py — each helper
+all-reduces a locally-accumulated statistic over every trainer (Gloo/MPI in
+the reference) and returns the global value.  TPU-native: the reduce rides
+the DCN allgather via ``RoleMakerBase._all_reduce`` (jax multihost), and is
+the identity in a single process, so the same training script works in both
+layouts.
+
+Inputs may be numpy arrays, framework Variables, or var names resolved in a
+Scope — the same contract as the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc"]
+
+_builtin_sum, _builtin_max, _builtin_min = sum, max, min
+
+
+def _role_maker():
+    from ..base.fleet_base import fleet
+    rm = fleet._role_maker
+    if rm is None:
+        from ..base.role_maker import RoleMakerBase
+        rm = RoleMakerBase()          # single-process fallback
+    return rm
+
+
+def _to_array(x, scope):
+    if isinstance(x, np.ndarray):
+        return x
+    if isinstance(x, (int, float)):
+        return np.array([x], dtype=np.float64)
+    from ....fluid import framework
+    if scope is None:
+        from ....fluid.core import global_scope
+        scope = global_scope()
+    name = x.name if isinstance(x, framework.Variable) else x
+    val = scope.find_var(name)
+    if val is None:
+        raise ValueError(f"metric input {name!r} not found in scope")
+    return np.asarray(val)
+
+
+def _reduce(x, scope, mode="sum"):
+    arr = np.asarray(_to_array(x, scope), dtype=np.float64)
+    return _role_maker()._all_reduce(arr.reshape(-1), mode).reshape(arr.shape)
+
+
+def sum(input, scope=None):
+    """Global sum of a local statistic across all trainers."""
+    return _reduce(input, scope, "sum")
+
+
+def max(input, scope=None):
+    """Global elementwise max across all trainers."""
+    return _reduce(input, scope, "max")
+
+
+def min(input, scope=None):
+    """Global elementwise min across all trainers."""
+    return _reduce(input, scope, "min")
+
+
+def auc(stat_pos, stat_neg, scope=None):
+    """Global AUC from per-trainer threshold-bucket counts.
+
+    ``stat_pos``/``stat_neg`` are the bucketed positive/negative counts
+    produced by ``fluid.layers.auc`` (num_thresholds buckets).  Buckets are
+    summed across trainers, then the ROC area is integrated over the
+    cumulative counts walking from the highest threshold down, anchored at
+    (0, 0) so the first bucket's trapezoid is included.
+    """
+    pos = _reduce(stat_pos, scope, "sum").reshape(-1)
+    neg = _reduce(stat_neg, scope, "sum").reshape(-1)
+    # walk buckets from the most-confident end; cumulative (neg, pos) trace
+    # out the un-normalised ROC curve
+    pos_c = np.concatenate([[0.0], np.cumsum(pos[::-1])])
+    neg_c = np.concatenate([[0.0], np.cumsum(neg[::-1])])
+    area = float(np.trapezoid(pos_c, neg_c))
+    tot_pos, tot_neg = pos_c[-1], neg_c[-1]
+    if tot_pos * tot_neg == 0:
+        return 0.5
+    return area / (tot_pos * tot_neg)
+
+
+def mae(abserr, total_ins_num, scope=None):
+    """Global mean absolute error: sum(|err|) / sum(instances)."""
+    err = float(_reduce(abserr, scope, "sum").sum())
+    total = float(_reduce(total_ins_num, scope, "sum").sum())
+    return err / _builtin_max(total, 1.0)
+
+
+def mse(sqrerr, total_ins_num, scope=None):
+    """Global mean squared error: sum(err^2) / sum(instances)."""
+    err = float(_reduce(sqrerr, scope, "sum").sum())
+    total = float(_reduce(total_ins_num, scope, "sum").sum())
+    return err / _builtin_max(total, 1.0)
+
+
+def rmse(sqrerr, total_ins_num, scope=None):
+    """Global root mean squared error."""
+    return float(np.sqrt(mse(sqrerr, total_ins_num, scope)))
+
+
+def acc(correct, total, scope=None):
+    """Global accuracy: sum(correct) / sum(total)."""
+    c = float(_reduce(correct, scope, "sum").sum())
+    t = float(_reduce(total, scope, "sum").sum())
+    return c / _builtin_max(t, 1.0)

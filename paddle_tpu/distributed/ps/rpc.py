@@ -92,6 +92,10 @@ class PsServer:
         self.sparse: Dict[str, CommonSparseTable] = {}
         self.dense: Dict[str, CommonDenseTable] = {}
         self.barrier_table = BarrierTable(n_trainers)
+        # blob mailbox for trainer↔trainer record exchange (the fleet-RPC
+        # channel DatasetImpl::GlobalShuffle routes over, data_set.h:118)
+        self._mailbox: Dict[tuple, List[np.ndarray]] = {}
+        self._mailbox_lock = threading.Lock()
         self._stop = threading.Event()
         outer = self
 
@@ -170,6 +174,17 @@ class PsServer:
         if op == "barrier":
             ok = self.barrier_table.barrier(header.get("timeout", 60.0))
             return {"ok": ok}, []
+        if op == "put_blob":
+            key = (int(header["dest"]), str(header.get("tag", "")))
+            with self._mailbox_lock:
+                self._mailbox.setdefault(key, []).append(
+                    arrays[0] if arrays else np.zeros(0, np.uint8))
+            return {"ok": True}, []
+        if op == "take_blobs":
+            key = (int(header["rank"]), str(header.get("tag", "")))
+            with self._mailbox_lock:
+                blobs = self._mailbox.pop(key, [])
+            return {"ok": True, "count": len(blobs)}, blobs
         if op == "save":
             import os
             d = header["dirname"]
@@ -369,6 +384,33 @@ class PsClient:
         self._call(self._dense_owner(name),
                    {"op": "set_dense", "table": name},
                    [np.asarray(value, np.float32)])
+
+    # -- trainer↔trainer blob mailbox (GlobalShuffle transport) -------------
+    def put_blob(self, dest: int, blob: bytes, tag: str = ""):
+        """Deposit a byte blob for trainer `dest`; it lands on the server
+        owning that rank's mailbox (dest % n_servers)."""
+        arr = np.frombuffer(blob, np.uint8) if blob else np.zeros(0, np.uint8)
+        self._call(dest % len(self.endpoints),
+                   {"op": "put_blob", "dest": dest, "tag": tag}, [arr])
+
+    def put_blobs(self, blobs_by_dest: Dict[int, bytes], tag: str = ""):
+        """Deposit blobs for many ranks with the parallel fan-out the other
+        multi-shard ops use — the deposits land on distinct servers over
+        distinct sockets, so serial round-trips would waste (n-1)x the
+        exchange time."""
+        dests = list(blobs_by_dest)
+
+        def one(i):
+            self.put_blob(dests[i], blobs_by_dest[dests[i]], tag)
+
+        self._fanout("put_blobs", one, shards=range(len(dests)))
+
+    def take_blobs(self, rank: int, tag: str = "") -> List[bytes]:
+        """Collect (and clear) every blob deposited for `rank`.  Callers
+        barrier() between put and take so all peers have deposited."""
+        _, arrs = self._call(rank % len(self.endpoints),
+                             {"op": "take_blobs", "rank": rank, "tag": tag})
+        return [a.tobytes() for a in arrs]
 
     # -- control ------------------------------------------------------------
     def barrier(self, timeout=60.0):

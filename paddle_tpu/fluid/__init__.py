@@ -25,6 +25,7 @@ from . import clip
 from .layers.tensor import data
 from . import io
 from .io import save_persistables, load_persistables, save_params, load_params
+from . import nets
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import dygraph
 from .data_feeder import DataFeeder
@@ -32,7 +33,10 @@ from . import metrics
 from . import dataset
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from . import profiler
+from . import monitor
 from .reader import DataLoader
+
+core.init_signal_handlers()
 
 
 def name_scope(prefix=None):

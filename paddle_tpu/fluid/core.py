@@ -110,9 +110,16 @@ def _apply_prng_impl(deterministic):
         return
     impl = "threefry2x32" if deterministic else "rbg"
     try:
+        import jax
         jax.config.update("jax_default_prng_impl", impl)
-    except Exception:
-        pass
+    except Exception as e:                   # noqa: BLE001 — never block import,
+        # but NEVER silently: a swallowed error here once left dropout on
+        # threefry and cost ~25% MFU for a full round (see STATUS.md)
+        import sys
+        print(f"paddle_tpu: WARNING: could not set PRNG impl {impl!r}: "
+              f"{type(e).__name__}: {e} — dropout/random ops will use the "
+              f"jax default (threefry), which is ~3x slower on TPU",
+              file=sys.stderr)
 
 
 _apply_prng_impl(None)
@@ -134,6 +141,33 @@ def get_flags(names):
 
 def get_flag(name: str, default=None):
     return _FLAGS.get(name, default)
+
+
+# ---------------------------------------------------------------------------
+# crash/stuck diagnostics (platform/init.cc:257 InitGLOG signal-handler
+# analog).  The reference installs glog's FailureSignalHandler to dump C++
+# stacks on SIGSEGV/SIGABRT; here faulthandler dumps every thread's Python
+# stack on fatal signals, and SIGUSR1 gives a live dump for hung runs
+# (stuck collective, wedged TPU tunnel) without killing the process.
+# ---------------------------------------------------------------------------
+_signal_handlers_installed = False
+
+
+def init_signal_handlers():
+    global _signal_handlers_installed
+    if _signal_handlers_installed:
+        return
+    import faulthandler
+    import signal
+    import sys
+    try:
+        faulthandler.enable(file=sys.stderr, all_threads=True)
+        if hasattr(signal, "SIGUSR1") and hasattr(faulthandler, "register"):
+            faulthandler.register(signal.SIGUSR1, file=sys.stderr,
+                                  all_threads=True, chain=True)
+        _signal_handlers_installed = True
+    except (ValueError, OSError, RuntimeError):
+        pass        # non-main thread or exotic embedding: run without dumps
 
 
 class Scope:

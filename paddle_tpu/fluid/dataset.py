@@ -152,14 +152,50 @@ class InMemoryDataset(DatasetBase):
             return
         self._global_shuffle_rpc(client, seed)
 
-    def _global_shuffle_rpc(self, client, seed):
-        """Cross-node shuffle at file granularity (data_set.h:118 reroutes
-        records over fleet RPC; files are the unit here because every
-        trainer already holds the GLOBAL filelist).  All trainers compute
-        the same seeded permutation, each takes the strided shard for its
-        trainer id — so records genuinely move between nodes — then
-        barrier via the PS plane and shuffle locally."""
+    def _global_shuffle_rpc(self, client, seed, n_trainers=None,
+                            trainer_id=None):
+        """Cross-node record-level shuffle (data_set.h:118): every record is
+        content-hash-routed to trainer hash(record) % n; records bound for
+        remote ranks are extracted from the local pool and exchanged through
+        the PS RPC blob mailbox, then each trainer ingests its share and
+        shuffles locally.  Falls back to file-granularity resharding for
+        feeds without extract/ingest."""
         import os as _os
+        n = (max(1, int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+             if n_trainers is None else n_trainers)
+        tid = (int(_os.environ.get("PADDLE_TRAINER_ID", "0"))
+               if trainer_id is None else trainer_id)
+        if n <= 1:
+            self._feed.local_shuffle(seed)
+            return
+        # contract (matches the documented file-granularity behavior):
+        # EVERY trainer holds the GLOBAL filelist.  Step 1 reshards it
+        # disjointly (same seeded permutation on all trainers, strided
+        # shard per tid) and reloads, so no record exists on two trainers.
+        self._reshard_files_and_reload(seed, n, tid)
+        if hasattr(self._feed, "extract_shards"):
+            tag = f"gshuffle:{seed}"
+            # step 2: content-hash record exchange — one pool pass buckets
+            # all destinations (O(pool), not O(n*pool)), deposits fan out
+            # in parallel over the mailbox servers
+            shards = self._feed.extract_shards(n, tid)
+            client.put_blobs({d: shards[d] for d in range(n) if d != tid},
+                             tag)
+            client.barrier()                 # all deposits visible
+            for blob in client.take_blobs(tid, tag):
+                self._feed.ingest(blob)
+        self._feed.local_shuffle(seed + tid)
+        try:
+            client.barrier()                 # nobody proceeds mid-exchange
+        except Exception:                    # noqa: BLE001 — shuffle is done;
+            pass                             # barrier is best-effort sync
+
+    def _reshard_files_and_reload(self, seed, n, tid):
+        """All trainers compute the same seeded permutation of the GLOBAL
+        filelist and take their strided shard, then reload memory from it —
+        records move between nodes at file resolution and, crucially, the
+        resulting pools are DISJOINT (a global list loaded on every trainer
+        would otherwise duplicate each record n times post-exchange)."""
         rng = np.random.RandomState(seed)
         # shard from the preserved GLOBAL list every time — resharding the
         # previous shard would drop data on the second shuffle of a run
@@ -167,16 +203,9 @@ class InMemoryDataset(DatasetBase):
             self._global_filelist = list(self.filelist)
         files = list(self._global_filelist)
         rng.shuffle(files)
-        n = max(1, int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")))
-        tid = int(_os.environ.get("PADDLE_TRAINER_ID", "0"))
         self.filelist = files[tid::n] if n > 1 else files
         self._feed = self._make_feed()
         self._feed.load_into_memory()
-        self._feed.local_shuffle(seed)
-        try:
-            client.barrier()
-        except Exception:                    # noqa: BLE001 — shuffle is done;
-            pass                             # barrier is best-effort sync
 
     def release_memory(self):
         self._feed = None

@@ -26,9 +26,10 @@ from .metric_op import accuracy, auc
 from .control_flow import (cond, while_loop, array_write, array_read,
                            array_length, create_array, less_than, equal,
                            greater_than, increment as cf_increment, Switch)
-from .sequence_lod import (sequence_pool, sequence_softmax, sequence_expand,
+from .sequence_lod import (sequence_conv, sequence_pool, sequence_softmax, sequence_expand,
                            sequence_mask, sequence_reverse, sequence_pad,
                            sequence_unpad)
 from .collective import _c_allreduce, _c_allgather, _c_broadcast, _allreduce
 from .rnn import lstm_unit, gru_unit, dynamic_lstm_unit  # noqa: F401
 from .detection import *  # noqa: F401,F403
+from . import distributions  # noqa: F401

@@ -1,0 +1,181 @@
+"""Probability distributions composed from layer ops.
+
+Reference: python/paddle/fluid/layers/distributions.py (Uniform, Normal,
+Categorical, MultivariateNormalDiag — each method emits graph ops, so
+sample/entropy/log_prob/kl_divergence all participate in the one-XLA-program
+compile like any layer).  Same public surface; math written against this
+framework's layer API.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import nn, tensor
+
+__all__ = ["Uniform", "Normal", "Categorical", "MultivariateNormalDiag"]
+
+
+def _to_var(value, dtype="float32"):
+    from ..framework import Variable
+    if isinstance(value, Variable):
+        return value
+    import jax
+    if isinstance(value, (jax.Array,)):
+        return value
+    arr = np.asarray(value, dtype)
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    return tensor.assign(arr)
+
+
+class Distribution:
+    """Abstract base (distributions.py:30)."""
+
+    def sample(self, shape, seed=0):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high), elementwise-broadcastable bounds."""
+
+    def __init__(self, low, high):
+        self.low = _to_var(low)
+        self.high = _to_var(high)
+
+    def sample(self, shape, seed=0):
+        u = nn.uniform_random(list(shape), min=0.0, max=1.0, seed=seed)
+        width = nn.elementwise_sub(self.high, self.low)
+        return nn.elementwise_add(nn.elementwise_mul(u, width), self.low)
+
+    def entropy(self):
+        return nn.log(nn.elementwise_sub(self.high, self.low))
+
+    def log_prob(self, value):
+        from .control_flow import less_than
+        value = _to_var(value)
+        lb = tensor.cast(less_than(self.low, value), "float32")
+        ub = tensor.cast(less_than(value, self.high), "float32")
+        inside = nn.elementwise_mul(lb, ub)
+        return nn.elementwise_sub(
+            nn.log(inside),
+            nn.log(nn.elementwise_sub(self.high, self.low)))
+
+
+class Normal(Distribution):
+    """N(loc, scale), elementwise."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def sample(self, shape, seed=0):
+        z = nn.gaussian_random(list(shape), mean=0.0, std=1.0, seed=seed)
+        return nn.elementwise_add(nn.elementwise_mul(z, self.scale),
+                                  self.loc)
+
+    def entropy(self):
+        # 0.5 + 0.5*log(2*pi) + log(scale)
+        half_log_2pi = 0.5 + 0.5 * math.log(2 * math.pi)
+        return nn.scale(nn.log(self.scale), scale=1.0, bias=half_log_2pi)
+
+    def log_prob(self, value):
+        value = _to_var(value)
+        var = nn.elementwise_mul(self.scale, self.scale)
+        diff = nn.elementwise_sub(value, self.loc)
+        quad = nn.elementwise_div(nn.elementwise_mul(diff, diff),
+                                  nn.scale(var, scale=2.0))
+        return nn.scale(
+            nn.elementwise_add(quad, nn.log(self.scale)),
+            scale=-1.0, bias=-0.5 * math.log(2 * math.pi))
+
+    def kl_divergence(self, other):
+        """KL(self || other) = log(s2/s1) + (s1^2 + (m1-m2)^2)/(2 s2^2) - 1/2."""
+        if not isinstance(other, Normal):
+            raise TypeError("kl_divergence requires another Normal")
+        var2 = nn.elementwise_mul(other.scale, other.scale)
+        diff = nn.elementwise_sub(self.loc, other.loc)
+        num = nn.elementwise_add(nn.elementwise_mul(self.scale, self.scale),
+                                 nn.elementwise_mul(diff, diff))
+        ratio = nn.elementwise_div(num, nn.scale(var2, scale=2.0))
+        log_ratio = nn.elementwise_sub(nn.log(other.scale),
+                                       nn.log(self.scale))
+        return nn.scale(nn.elementwise_add(log_ratio, ratio), scale=1.0,
+                        bias=-0.5)
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of `logits`."""
+
+    def __init__(self, logits):
+        self.logits = _to_var(logits)
+
+    def _log_normalized(self):
+        z = nn.elementwise_sub(
+            self.logits, nn.reduce_max(self.logits, dim=-1, keep_dim=True))
+        log_norm = nn.log(nn.reduce_sum(nn.exp(z), dim=-1, keep_dim=True))
+        return nn.elementwise_sub(z, log_norm)        # log-probs
+
+    def entropy(self):
+        logp = self._log_normalized()
+        p = nn.exp(logp)
+        return nn.scale(nn.reduce_sum(nn.elementwise_mul(p, logp), dim=-1),
+                        scale=-1.0)
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            raise TypeError("kl_divergence requires another Categorical")
+        logp = self._log_normalized()
+        logq = other._log_normalized()
+        p = nn.exp(logp)
+        return nn.reduce_sum(
+            nn.elementwise_mul(p, nn.elementwise_sub(logp, logq)), dim=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale)) with `scale` given as a diagonal matrix
+    (distributions.py:531 contract)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_var(loc)
+        self.scale = _to_var(scale)
+
+    def _diag(self):
+        # extract the diagonal as a vector: sum over rows of the diag matrix
+        return nn.reduce_sum(self.scale, dim=-1)
+
+    def entropy(self):
+        k = float(self.loc.shape[-1])
+        log_det = nn.reduce_sum(nn.log(self._diag()), dim=-1)
+        const = 0.5 * k * (1.0 + math.log(2 * math.pi))
+        return nn.scale(log_det, scale=1.0, bias=const)
+
+    def kl_divergence(self, other):
+        """Diagonal-covariance KL: 0.5*(tr(S2^-1 S1) + (m2-m1)^T S2^-1
+        (m2-m1) - k + log det S2 - log det S1), all in vector form."""
+        if not isinstance(other, MultivariateNormalDiag):
+            raise TypeError("kl_divergence requires MultivariateNormalDiag")
+        k = float(self.loc.shape[-1])
+        s1 = self._diag()
+        s2 = other._diag()
+        var1 = nn.elementwise_mul(s1, s1)
+        var2 = nn.elementwise_mul(s2, s2)
+        tr = nn.reduce_sum(nn.elementwise_div(var1, var2), dim=-1)
+        diff = nn.elementwise_sub(other.loc, self.loc)
+        quad = nn.reduce_sum(
+            nn.elementwise_div(nn.elementwise_mul(diff, diff), var2), dim=-1)
+        log_det = nn.elementwise_sub(
+            nn.reduce_sum(nn.log(var2), dim=-1),
+            nn.reduce_sum(nn.log(var1), dim=-1))
+        inner = nn.elementwise_add(nn.elementwise_add(tr, quad), log_det)
+        return nn.scale(inner, scale=0.5, bias=-0.5 * k)

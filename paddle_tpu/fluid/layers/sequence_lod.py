@@ -141,6 +141,39 @@ sequence_softmax = _seq_layer("sequence_softmax")
 sequence_reverse = _seq_layer("sequence_reverse", "Y")
 
 
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, param_attr=None,
+                  bias_attr=None, act=None, length=None):
+    """Context-window convolution over the time axis (sequence_conv_op.cc).
+    input [B, T, D] padded-batch; filter [filter_size*D, num_filters]."""
+    if filter_stride != 1:
+        # same restriction as the reference sequence_conv (stride is part
+        # of the op signature but only 1 is implemented) — raise rather
+        # than silently compute a stride-1 result
+        raise ValueError("sequence_conv only supports filter_stride=1")
+    helper = LayerHelper("sequence_conv")
+    d = input.shape[-1]
+    w = helper.create_parameter(param_attr, [filter_size * d, num_filters],
+                                input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    if padding_start is None:
+        padding_start = -int(filter_size // 2)
+    inputs = {"X": [input], "Filter": [w]}
+    if length is not None:
+        inputs["Length"] = [length]
+    op = helper.append_op(
+        "sequence_conv", inputs=inputs, outputs={"Out": [out]},
+        attrs={"contextStart": padding_start,
+               "contextLength": filter_size,
+               "contextStride": filter_stride})
+    pre_bias = op["Out"][0] if in_dygraph_mode() else out
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, [num_filters],
+                                    pre_bias.dtype, is_bias=True)
+        pre_bias = helper.append_bias_op(pre_bias, b, axis=2)
+    return helper.append_activation(pre_bias, act)
+
+
 def sequence_expand(x, y, ref_level=-1):
     helper = LayerHelper("sequence_expand")
     out = helper.create_variable_for_type_inference(dtype=x.dtype)

@@ -1,0 +1,87 @@
+"""Global stat counters — the platform/monitor.h analog.
+
+Reference: platform/monitor.h:31,43,129 — ``StatValue`` int counters in a
+process-wide ``StatRegistry``, bumped via ``STAT_ADD``/``STAT_SUB`` macros
+(BoxPS memory stats, dataset ingest counters).  TPU-native: the counters
+live host-side (device-side counts belong in the profiler); thread-safe so
+data-feed worker threads can bump them.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+
+class StatValue:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increase(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    def decrease(self, n: int = 1) -> int:
+        return self.increase(-n)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+    def get(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class StatRegistry:
+    """Process-wide registry; ``StatRegistry.instance()`` mirrors the
+    reference singleton."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._stats: Dict[str, StatValue] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StatRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def get(self, name: str) -> StatValue:
+        with self._lock:
+            stat = self._stats.get(name)
+            if stat is None:
+                stat = self._stats[name] = StatValue(name)
+            return stat
+
+    def stats(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return sorted((n, s.get()) for n, s in self._stats.items())
+
+
+def stat_add(name: str, n: int = 1) -> int:
+    """STAT_ADD macro analog."""
+    return StatRegistry.instance().get(name).increase(n)
+
+
+def stat_sub(name: str, n: int = 1) -> int:
+    """STAT_SUB macro analog."""
+    return StatRegistry.instance().get(name).decrease(n)
+
+
+def stat_get(name: str) -> int:
+    return StatRegistry.instance().get(name).get()
+
+
+def print_stats() -> str:
+    """Render all counters, one per line (monitor dump format)."""
+    return "\n".join(f"{n} = {v}"
+                     for n, v in StatRegistry.instance().stats())

@@ -96,6 +96,19 @@ def _load():
         lib.pt_feed_destroy.argtypes = [ctypes.c_void_p]
         lib.pt_feed_global_shuffle.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_uint64]
+        lib.pt_feed_extract_shard.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.pt_feed_extract_shard.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_feed_extract_shards.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.pt_feed_free_blob.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.pt_feed_ingest.restype = ctypes.c_int64
+        lib.pt_feed_ingest.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint8),
+                                       ctypes.c_int64]
         lib.pt_arena_create.restype = ctypes.c_void_p
         lib.pt_arena_create.argtypes = [ctypes.c_int64]
         lib.pt_arena_alloc.restype = ctypes.c_void_p
@@ -170,6 +183,42 @@ class NativeDataFeed:
     def memory_size(self) -> int:
         return int(self._lib.pt_feed_memory_size(self._h))
 
+    def extract_shard(self, dest: int, world: int) -> bytes:
+        """Remove and serialize the in-memory records content-hash-routed to
+        rank `dest` of `world` (the node-local half of the cross-process
+        GlobalShuffle, data_set.h:118)."""
+        ln = ctypes.c_int64()
+        ptr = self._lib.pt_feed_extract_shard(self._h, dest, world,
+                                              ctypes.byref(ln))
+        try:
+            return ctypes.string_at(ptr, ln.value)
+        finally:
+            self._lib.pt_feed_free_blob(ptr)
+
+    def extract_shards(self, world: int, self_rank: int) -> list:
+        """Single-pass bucketing: one pool traversal yields the blob for
+        every remote rank (entry self_rank is empty; those records stay)."""
+        ptrs = (ctypes.POINTER(ctypes.c_uint8) * world)()
+        lens = (ctypes.c_int64 * world)()
+        self._lib.pt_feed_extract_shards(self._h, world, self_rank,
+                                         ptrs, lens)
+        out = []
+        for d in range(world):
+            out.append(ctypes.string_at(ptrs[d], lens[d]))
+            self._lib.pt_feed_free_blob(ptrs[d])
+        return out
+
+    def ingest(self, blob: bytes) -> int:
+        """Append records serialized by extract_shard (any process) to the
+        in-memory pool; returns the record count."""
+        if not blob:
+            return 0
+        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        n = int(self._lib.pt_feed_ingest(self._h, buf, len(blob)))
+        if n < 0:
+            raise ValueError("corrupt global-shuffle blob")
+        return n
+
     def next(self):
         """Returns dict name->array(s) or None at end of pass."""
         n = self._lib.pt_feed_next(self._h)
@@ -205,6 +254,34 @@ class NativeDataFeed:
         lib = getattr(self, "_lib", None)
         if h and lib is not None:
             lib.pt_feed_destroy(h)
+
+
+_U64 = (1 << 64) - 1
+
+
+def _route_hash(sparse, dense) -> int:
+    """Record→rank routing hash, bit-identical to the C++ RouteHash
+    (FNV-1a over sparse ids, dense float bits for dense-only records,
+    murmur3 finalizer) so native and Python-fallback processes in one
+    cluster route records consistently."""
+    import struct
+    h = 1469598103934665603
+    mixed = False
+    for slot in sparse:
+        for v in slot:
+            h = ((h ^ (int(v) & _U64)) * 1099511628211) & _U64
+            mixed = True
+    if not mixed:
+        for slot in dense:
+            for f in slot:
+                (bits,) = struct.unpack("<I", struct.pack("<f", f))
+                h = ((h ^ bits) * 1099511628211) & _U64
+    h ^= h >> 33
+    h = (h * 0xFF51AFD7ED558CCD) & _U64
+    h ^= h >> 33
+    h = (h * 0xC4CEB9FE1A85EC53) & _U64
+    h ^= h >> 33
+    return h
 
 
 class PyDataFeed:
@@ -266,6 +343,78 @@ class PyDataFeed:
     def memory_size(self):
         return len(self._pool)
 
+    @staticmethod
+    def _serialize(records) -> bytes:
+        import struct
+        parts = [struct.pack("<Q", len(records))]
+        for sparse, dense in records:
+            parts.append(struct.pack("<I", len(sparse)))
+            for slot in sparse:
+                a = np.asarray(slot, "<u8")
+                parts.append(struct.pack("<Q", a.size))
+                parts.append(a.tobytes())
+            parts.append(struct.pack("<I", len(dense)))
+            for slot in dense:
+                a = np.asarray(slot, "<f4")
+                parts.append(struct.pack("<Q", a.size))
+                parts.append(a.tobytes())
+        return b"".join(parts)
+
+    def extract_shard(self, dest: int, world: int) -> bytes:
+        """Same wire format as NativeDataFeed.extract_shard (see
+        data_feed.cc pt_feed_extract_shard) — the two interoperate."""
+        keep, out = [], []
+        for rec in self._pool:
+            (out if _route_hash(rec[0], rec[1]) % world == dest
+             else keep).append(rec)
+        self._pool = keep
+        return self._serialize(out)
+
+    def extract_shards(self, world: int, self_rank: int) -> list:
+        """Single-pass bucketing across all ranks (self_rank stays local)."""
+        buckets = [[] for _ in range(world)]
+        keep = []
+        for rec in self._pool:
+            d = _route_hash(rec[0], rec[1]) % world
+            (keep if d == self_rank else buckets[d]).append(rec)
+        self._pool = keep
+        return [self._serialize(b) for b in buckets]
+
+    def ingest(self, blob: bytes) -> int:
+        """Raises ValueError on corrupt blobs (native-parity) and stages
+        records so a mid-stream failure never leaves a partial shard."""
+        import struct
+        if not blob:
+            return 0
+        staged = []
+        try:
+            pos = 8
+            (n,) = struct.unpack_from("<Q", blob, 0)
+            for _ in range(n):
+                (ns,) = struct.unpack_from("<I", blob, pos)
+                pos += 4
+                sparse = []
+                for _s in range(ns):
+                    (ln,) = struct.unpack_from("<Q", blob, pos)
+                    pos += 8
+                    vals = np.frombuffer(blob, "<u8", ln, pos)
+                    sparse.append([int(v) for v in vals])
+                    pos += 8 * ln
+                (nd,) = struct.unpack_from("<I", blob, pos)
+                pos += 4
+                dense = []
+                for _d in range(nd):
+                    (ln,) = struct.unpack_from("<Q", blob, pos)
+                    pos += 8
+                    vals = np.frombuffer(blob, "<f4", ln, pos)
+                    dense.append([float(v) for v in vals])
+                    pos += 4 * ln
+                staged.append((sparse, dense))
+        except (struct.error, ValueError) as e:
+            raise ValueError(f"corrupt global-shuffle blob: {e}") from e
+        self._pool.extend(staged)
+        return len(staged)
+
     def next(self):
         recs = []
         for r in self._iter:
@@ -309,29 +458,12 @@ def global_shuffle(feeds, seed=0):
         raise ValueError(
             "global_shuffle: mixed native/python feed lists are not "
             "supported — pass all-native or all-python feeds")
-    # python fallback: same content-hash routing (mix dense values and a
-    # record counter so dense-only schemas don't all hash to one feed)
+    # python fallback: identical content-hash routing to the native path
     pools = [f._pool for f in feeds]
     dest = [[] for _ in feeds]
-    counter = 0
     for pool in pools:
         for rec in pool:
-            h = 1469598103934665603
-            mixed = False
-            for slot in rec[0]:
-                for v in slot:
-                    h = ((h ^ hash(int(v))) * 1099511628211) & ((1 << 64) - 1)
-                    mixed = True
-            if not mixed:
-                for slot in rec[1] if len(rec) > 1 else ():
-                    for v in np.asarray(slot).reshape(-1)[:8]:
-                        h = ((h ^ hash(float(v))) * 1099511628211) \
-                            & ((1 << 64) - 1)
-                        mixed = True
-            if not mixed:
-                h = ((h ^ counter) * 1099511628211) & ((1 << 64) - 1)
-            counter += 1
-            dest[h % len(feeds)].append(rec)
+            dest[_route_hash(rec[0], rec[1]) % len(feeds)].append(rec)
     for i, (f, d) in enumerate(zip(feeds, dest)):
         # per-feed seed offset matches the native path's seed+i
         rng = np.random.RandomState(seed + i)

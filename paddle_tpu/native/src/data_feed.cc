@@ -22,6 +22,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <memory>
@@ -284,6 +285,73 @@ struct FeedHandle {
   Batch current;
 };
 
+// content hash that routes a record to a destination feed/node.  FNV-1a
+// over sparse ids (dense bytes for dense-only schemas) + a murmur3
+// finalizer: libstdc++ std::hash<uint64_t> is the identity, so without
+// avalanching `h % n` sees only the low bits (n=2 reads one float's
+// mantissa LSB → total skew).
+static uint64_t RouteHash(const Record& r) {
+  std::hash<uint64_t> h64;
+  uint64_t h = 1469598103934665603ull;
+  bool any_sparse = false;
+  for (const auto& slot : r.sparse)
+    for (uint64_t v : slot) {
+      h = (h ^ h64(v)) * 1099511628211ull;
+      any_sparse = true;
+    }
+  if (!any_sparse) {
+    for (const auto& slot : r.dense)
+      for (float f : slot) {
+        uint32_t bits;
+        std::memcpy(&bits, &f, sizeof(bits));
+        h = (h ^ h64(bits)) * 1099511628211ull;
+      }
+  }
+  h ^= h >> 33;
+  h *= 0xff51afd7ed558ccdull;
+  h ^= h >> 33;
+  h *= 0xc4ceb9fe1a85ec53ull;
+  h ^= h >> 33;
+  return h;
+}
+
+// serialization helpers for the cross-process shuffle wire format
+template <typename T>
+static void AppendPod(std::vector<uint8_t>* buf, T v) {
+  const auto* p = reinterpret_cast<const uint8_t*>(&v);
+  buf->insert(buf->end(), p, p + sizeof(T));
+}
+
+template <typename T>
+static void AppendVec(std::vector<uint8_t>* buf, const std::vector<T>& v) {
+  AppendPod<uint64_t>(buf, v.size());
+  const auto* p = reinterpret_cast<const uint8_t*>(v.data());
+  buf->insert(buf->end(), p, p + v.size() * sizeof(T));
+}
+
+template <typename T>
+static bool ReadPod(const uint8_t** p, const uint8_t* end, T* v) {
+  if (*p + sizeof(T) > end) return false;
+  std::memcpy(v, *p, sizeof(T));
+  *p += sizeof(T);
+  return true;
+}
+
+template <typename T>
+static bool ReadVec(const uint8_t** p, const uint8_t* end,
+                    std::vector<T>* v) {
+  uint64_t n;
+  if (!ReadPod(p, end, &n)) return false;
+  // divide, don't multiply: n * sizeof(T) can wrap for hostile/corrupt
+  // wire-provided counts, and an oversized resize() would throw through
+  // the extern "C" boundary instead of returning the -1 error
+  if (n > static_cast<uint64_t>(end - *p) / sizeof(T)) return false;
+  v->resize(n);
+  std::memcpy(v->data(), *p, n * sizeof(T));
+  *p += n * sizeof(T);
+  return true;
+}
+
 extern "C" {
 
 void* pt_feed_create(const char* schema, int batch_size, int num_threads) {
@@ -376,20 +444,116 @@ void pt_feed_global_shuffle(void** handles, int n, uint64_t seed) {
   for (int i = 0; i < n; ++i)
     pools.push_back(static_cast<FeedHandle*>(handles[i])->feed->pool());
   std::vector<std::vector<Record>> dest(n);
-  std::hash<uint64_t> h64;
   for (auto* pool : pools) {
-    for (auto& r : *pool) {
-      uint64_t h = 1469598103934665603ull;  // FNV over sparse ids
-      for (const auto& slot : r.sparse)
-        for (uint64_t v : slot) h = (h ^ h64(v)) * 1099511628211ull;
-      dest[h % n].emplace_back(std::move(r));
-    }
+    for (auto& r : *pool) dest[RouteHash(r) % n].emplace_back(std::move(r));
     pool->clear();
   }
   for (int i = 0; i < n; ++i) {
     *pools[i] = std::move(dest[i]);
     static_cast<FeedHandle*>(handles[i])->feed->LocalShuffle(seed + i);
   }
+}
+
+// ---- cross-process shuffle plumbing (data_set.h:118 GlobalShuffle over
+// fleet RPC).  The node-local half: extract the records routed to a remote
+// rank as one contiguous blob (removed from the pool), and ingest blobs
+// received from peers.  Wire format, little-endian:
+//   u64 n_records, then per record:
+//     u32 n_sparse { u64 len, len*u64 ids }  u32 n_dense { u64 len, len*f32 }
+
+static void SerializeRecord(std::vector<uint8_t>* buf, const Record& r) {
+  AppendPod<uint32_t>(buf, static_cast<uint32_t>(r.sparse.size()));
+  for (const auto& slot : r.sparse) AppendVec(buf, slot);
+  AppendPod<uint32_t>(buf, static_cast<uint32_t>(r.dense.size()));
+  for (const auto& slot : r.dense) AppendVec(buf, slot);
+}
+
+static uint8_t* BlobFromBuf(std::vector<uint8_t>* buf, uint64_t count,
+                            int64_t* out_len) {
+  std::memcpy(buf->data(), &count, sizeof(uint64_t));
+  auto* out = static_cast<uint8_t*>(std::malloc(buf->size()));
+  std::memcpy(out, buf->data(), buf->size());
+  *out_len = static_cast<int64_t>(buf->size());
+  return out;
+}
+
+uint8_t* pt_feed_extract_shard(void* hv, int dest, int world,
+                               int64_t* out_len) {
+  auto* pool = static_cast<FeedHandle*>(hv)->feed->pool();
+  std::vector<Record> keep;
+  keep.reserve(pool->size());
+  std::vector<uint8_t> buf(sizeof(uint64_t), 0);  // n_records patched below
+  uint64_t count = 0;
+  for (auto& r : *pool) {
+    if (static_cast<int>(RouteHash(r) % world) != dest) {
+      keep.emplace_back(std::move(r));
+      continue;
+    }
+    ++count;
+    SerializeRecord(&buf, r);
+  }
+  *pool = std::move(keep);
+  return BlobFromBuf(&buf, count, out_len);
+}
+
+// single-pass variant: bucket every record by RouteHash % world in ONE pool
+// traversal (records routed to self_rank stay in the pool; out_ptrs[self]
+// is an empty blob).  extract_shard-per-dest is O(world * pool); this is
+// O(pool) — the difference matters at CTR scale with tens of trainers.
+void pt_feed_extract_shards(void* hv, int world, int self_rank,
+                            uint8_t** out_ptrs, int64_t* out_lens) {
+  auto* pool = static_cast<FeedHandle*>(hv)->feed->pool();
+  std::vector<Record> keep;
+  keep.reserve(pool->size());
+  std::vector<std::vector<uint8_t>> bufs(world);
+  std::vector<uint64_t> counts(world, 0);
+  for (int d = 0; d < world; ++d) bufs[d].resize(sizeof(uint64_t), 0);
+  for (auto& r : *pool) {
+    int dest = static_cast<int>(RouteHash(r) % world);
+    if (dest == self_rank) {
+      keep.emplace_back(std::move(r));
+      continue;
+    }
+    ++counts[dest];
+    SerializeRecord(&bufs[dest], r);
+  }
+  *pool = std::move(keep);
+  for (int d = 0; d < world; ++d)
+    out_ptrs[d] = BlobFromBuf(&bufs[d], counts[d], &out_lens[d]);
+}
+
+void pt_feed_free_blob(uint8_t* p) { std::free(p); }
+
+int64_t pt_feed_ingest(void* hv, const uint8_t* data, int64_t len) {
+  // parse into a staging vector and splice only on full success: a blob
+  // corrupted mid-stream must not leave a partial shard in the pool (the
+  // caller may retry the ingest, which would duplicate the prefix)
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t n;
+  if (!ReadPod(&p, end, &n)) return -1;
+  std::vector<Record> staged;
+  for (uint64_t i = 0; i < n; ++i) {
+    Record r;
+    uint32_t ns, nd;
+    // every slot costs >= 8 wire bytes (its u64 length), so a count
+    // exceeding remaining/8 is corrupt — reject before resize() can throw
+    if (!ReadPod(&p, end, &ns)) return -1;
+    if (ns > static_cast<uint64_t>(end - p) / sizeof(uint64_t)) return -1;
+    r.sparse.resize(ns);
+    for (uint32_t s = 0; s < ns; ++s)
+      if (!ReadVec(&p, end, &r.sparse[s])) return -1;
+    if (!ReadPod(&p, end, &nd)) return -1;
+    if (nd > static_cast<uint64_t>(end - p) / sizeof(uint64_t)) return -1;
+    r.dense.resize(nd);
+    for (uint32_t d = 0; d < nd; ++d)
+      if (!ReadVec(&p, end, &r.dense[d])) return -1;
+    staged.emplace_back(std::move(r));
+  }
+  auto* pool = static_cast<FeedHandle*>(hv)->feed->pool();
+  pool->insert(pool->end(), std::make_move_iterator(staged.begin()),
+               std::make_move_iterator(staged.end()));
+  return static_cast<int64_t>(n);
 }
 
 void pt_feed_destroy(void* hv) {

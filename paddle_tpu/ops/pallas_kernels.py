@@ -1,0 +1,149 @@
+"""Pallas TPU kernels for the ops where XLA fusion leaves perf on the table.
+
+Two hot spots (measured with tools/mfu_sweep.py on BERT-base, v5e):
+
+* flash attention — at seq>=256 XLA materialises the [B, H, T, T] score
+  tensor; the pallas kernel streams K/V blocks through VMEM (SURVEY §7
+  step 3: "Pallas kernels only where XLA fusion falls short, e.g. fused
+  attention").  Wraps jax's production TPU kernel.
+* fused dropout — the jax.random path costs ~15ms/step on BERT-base
+  (sweep case `nodrop`): per-element uniforms + a bool mask residual both
+  round-trip HBM.  Here the mask is derived from the on-core hardware PRNG
+  (pltpu.prng_random_bits) and the backward pass RE-SEEDS the same PRNG to
+  regenerate it — zero mask bytes written, zero residuals saved.
+
+Everything degrades gracefully: CPU/interpret backends take the jnp path in
+the callers (ops/attention.py, ops/nn_ops.py gate on backend).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention_tpu", "fused_dropout_tpu"]
+
+
+# ---------------------------------------------------------------------------
+# flash attention: thin wrapper over jax's production pallas kernel
+# ---------------------------------------------------------------------------
+
+def flash_attention_tpu(q, k, v, scale=None, causal=False):
+    """q/k/v: [B, H, T, D].  Falls back by raising ImportError-like None
+    handling in the caller if shapes are unsupported."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _fa)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _fa(q, k, v, causal=causal, sm_scale=float(scale))
+
+
+# ---------------------------------------------------------------------------
+# fused dropout with mask regeneration in backward
+# ---------------------------------------------------------------------------
+
+def _pick_block_rows(m: int, n: int) -> int:
+    """Largest power-of-two row count that divides m and keeps a block
+    under ~2MB of VMEM at 4B/elem."""
+    cap = max(1, (2 << 20) // (n * 4))
+    bm = 1
+    while bm * 2 <= cap and m % (bm * 2) == 0:
+        bm *= 2
+    return bm
+
+
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, threshold, scale):
+    # distinct stream per grid block: hardware PRNG seeded from (seed, block)
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    bits = pltpu.bitcast(pltpu.prng_random_bits(x_ref.shape), jnp.uint32)
+    keep = bits >= jnp.uint32(threshold)
+    x = x_ref[:]
+    o_ref[:] = jnp.where(keep, x * x.dtype.type(scale),
+                         x.dtype.type(0.0))
+
+
+def _dropout_mask_kernel(seed_ref, o_ref, *, threshold):
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    bits = pltpu.bitcast(pltpu.prng_random_bits(o_ref.shape), jnp.uint32)
+    o_ref[:] = (bits >= jnp.uint32(threshold)).astype(jnp.uint8)
+
+
+def _run_dropout(x2d, seed, threshold, scale):
+    m, n = x2d.shape
+    bm = _pick_block_rows(m, n)
+    return pl.pallas_call(
+        functools.partial(_dropout_kernel, threshold=threshold, scale=scale),
+        grid=(m // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec((bm, n), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x2d.dtype),
+    )(seed, x2d)
+
+
+def _threshold_for(rate: float) -> int:
+    # P(bits >= threshold) == 1 - rate over uint32
+    return min(int(rate * 4294967296.0), 4294967295)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fused_dropout(x2d, seed, rate, upscale):
+    scale = 1.0 / (1.0 - rate) if upscale else 1.0
+    return _run_dropout(x2d, seed, _threshold_for(rate), scale)
+
+
+def _fused_dropout_fwd(x2d, seed, rate, upscale):
+    return _fused_dropout(x2d, seed, rate, upscale), seed
+
+
+def _fused_dropout_bwd(rate, upscale, seed, g):
+    # the SAME seed regenerates the SAME mask — no residual mask in HBM
+    scale = 1.0 / (1.0 - rate) if upscale else 1.0
+    return _run_dropout(g, seed, _threshold_for(rate), scale), None
+
+
+_fused_dropout.defvjp(_fused_dropout_fwd, _fused_dropout_bwd)
+
+
+def _seed_from_key(key):
+    return jax.random.bits(key, (1,), "uint32").astype(jnp.int32)
+
+
+def fused_dropout_supported(x) -> bool:
+    """Static shape check: last dim lane-aligned, total a multiple of it."""
+    if x.ndim == 0 or x.size == 0:
+        return False
+    n = x.shape[-1]
+    return n % 128 == 0 and (x.size // n) >= 1
+
+
+def fused_dropout_tpu(x, key, rate, upscale_in_train):
+    """Dropout with on-core PRNG mask, regenerated in backward.
+
+    Returns (out, mask_fn) where mask_fn() materialises the uint8 keep-mask
+    with a second kernel from the same seed — called only if the consumer
+    actually fetches the Mask output, so XLA DCEs it otherwise.
+    """
+    seed = _seed_from_key(key)
+    shape = x.shape
+    n = shape[-1]
+    x2d = x.reshape(-1, n)
+    out = _fused_dropout(x2d, seed, float(rate), bool(upscale_in_train))
+
+    def mask_fn():
+        m = x2d.shape[0]
+        bm = _pick_block_rows(m, n)
+        mask = pl.pallas_call(
+            functools.partial(_dropout_mask_kernel,
+                              threshold=_threshold_for(float(rate))),
+            grid=(m // bm,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((m, n), jnp.uint8),
+        )(seed)
+        return mask.reshape(shape)
+
+    return out.reshape(shape), mask_fn

@@ -169,3 +169,65 @@ class TestOpBenchHarness:
                             {"X": ((16, 32), "float32"),
                              "Y": ((32, 8), "float32")}, steps=3, warmup=1)
         assert res2["fwd_us"] > 0
+
+
+class TestMonitorStats:
+    def test_stat_registry_counters(self):
+        from paddle_tpu.fluid import monitor
+        monitor.StatRegistry.instance().get("test/ingest").reset()
+        monitor.stat_add("test/ingest", 5)
+        monitor.stat_add("test/ingest", 2)
+        monitor.stat_sub("test/ingest", 1)
+        assert monitor.stat_get("test/ingest") == 6
+        assert "test/ingest = 6" in monitor.print_stats()
+
+    def test_thread_safety(self):
+        import threading
+        from paddle_tpu.fluid import monitor
+        monitor.StatRegistry.instance().get("test/mt").reset()
+        ts = [threading.Thread(
+            target=lambda: [monitor.stat_add("test/mt") for _ in range(500)])
+            for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert monitor.stat_get("test/mt") == 2000
+
+
+class TestSignalHandlers:
+    def test_faulthandler_installed(self):
+        import faulthandler
+        import paddle_tpu  # noqa: F401 — import installs the handlers
+        assert faulthandler.is_enabled()
+
+
+class TestFleetMetrics:
+    def test_scalar_reduce_single_process(self):
+        import numpy as np
+        from paddle_tpu.distributed.fleet import metrics
+        assert float(metrics.sum(np.array([3.0, 4.0])).sum()) == 7.0
+        assert float(metrics.max(np.array([3.0, 9.0])).max()) == 9.0
+        assert metrics.acc(np.array([8.0]), np.array([10.0])) == 0.8
+        assert abs(metrics.mae(np.array([5.0]), 10.0) - 0.5) < 1e-12
+        assert abs(metrics.rmse(np.array([40.0]), 10.0) - 2.0) < 1e-12
+
+    def test_auc_from_buckets(self):
+        import numpy as np
+        from paddle_tpu.distributed.fleet import metrics
+        # perfectly separable: all positives in the top bucket
+        pos = np.array([0.0, 0.0, 0.0, 10.0])
+        neg = np.array([10.0, 0.0, 0.0, 0.0])
+        assert abs(metrics.auc(pos, neg) - 1.0) < 1e-12
+        # identical scores: single shared bucket -> 0.5
+        pos1 = np.array([0.0, 5.0, 0.0, 0.0])
+        neg1 = np.array([0.0, 5.0, 0.0, 0.0])
+        assert abs(metrics.auc(pos1, neg1) - 0.5) < 1e-12
+        # no data -> 0.5 by convention
+        assert metrics.auc(np.zeros(4), np.zeros(4)) == 0.5
+
+    def test_scope_lookup(self):
+        import numpy as np
+        from paddle_tpu.fluid.core import Scope
+        from paddle_tpu.distributed.fleet import metrics
+        sc = Scope()
+        sc.set_var("stat", np.array([1.0, 2.0]))
+        assert float(metrics.sum("stat", scope=sc).sum()) == 3.0

@@ -68,15 +68,89 @@ void my_negate_run(const float* in, float* out, int64_t n) {
         np.testing.assert_allclose(np.asarray(got), -xs, rtol=1e-6)
 
 
+class _MailboxClient:
+    """In-memory stand-in for PsClient's mailbox+barrier surface."""
+
+    def __init__(self, n_parties):
+        import threading
+        self._mail = {}
+        self._lock = threading.Lock()
+        self._barrier = threading.Barrier(n_parties)
+
+    def put_blob(self, dest, blob, tag=""):
+        with self._lock:
+            self._mail.setdefault((dest, tag), []).append(blob)
+
+    def put_blobs(self, blobs_by_dest, tag=""):
+        for dest, blob in blobs_by_dest.items():
+            self.put_blob(dest, blob, tag)
+
+    def take_blobs(self, rank, tag=""):
+        with self._lock:
+            return self._mail.pop((rank, tag), [])
+
+    def barrier(self, *a, **k):
+        self._barrier.wait(timeout=30)
+
+
 class TestGlobalShuffleSharding:
-    def test_two_trainers_repartition_files(self, tmp_path, monkeypatch):
-        rng = np.random.RandomState(0)
+    def _make_dataset(self, paths):
+        ids = fluid.data("gids", [-1, 1], dtype="int64")
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(2)
+        ds.set_use_var([ids])
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        return ds
+
+    def _drain_ids(self, ds):
+        out = []
+        for batch in ds._iter_batches():
+            arr, lod = batch["gids"] if isinstance(batch["gids"], tuple) \
+                else (batch["gids"], None)
+            out.extend(int(v) for v in np.asarray(arr).reshape(-1))
+        return out
+
+    def test_two_trainers_record_exchange(self, tmp_path):
+        import threading
+        paths = []
+        for fi in range(4):
+            p = tmp_path / f"part-{fi}.txt"
+            p.write_text("".join(f"1 {fi * 20 + j}\n" for j in range(20)))
+            paths.append(str(p))
+        client = _MailboxClient(2)
+        # the documented contract: EVERY trainer holds the GLOBAL filelist;
+        # the shuffle reshards it disjointly before the record exchange, so
+        # no record may come out duplicated
+        datasets = {0: self._make_dataset(paths),
+                    1: self._make_dataset(paths)}
+
+        def run(tid):
+            datasets[tid]._global_shuffle_rpc(client, seed=5, n_trainers=2,
+                                              trainer_id=tid)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        [t.start() for t in ts]
+        [t.join(30) for t in ts]
+        got = {tid: self._drain_ids(ds) for tid, ds in datasets.items()}
+        # nothing lost or duplicated, and records moved BETWEEN trainers
+        assert sorted(got[0] + got[1]) == list(range(80))
+        for tid in (0, 1):
+            assert any(v < 40 for v in got[tid])
+            assert any(v >= 40 for v in got[tid])
+
+    def test_file_fallback_repartitions(self, tmp_path, monkeypatch):
+        """Feeds without extract/ingest reshard the global filelist."""
+        from paddle_tpu import native as ptnative
+        for attr in ("extract_shard", "extract_shards"):
+            monkeypatch.delattr(ptnative.NativeDataFeed, attr,
+                                raising=False)
+            monkeypatch.delattr(ptnative.PyDataFeed, attr, raising=False)
         paths = []
         for fi in range(6):
             p = tmp_path / f"part-{fi}.txt"
             p.write_text("1 %d\n" % fi)
             paths.append(str(p))
-        ids = fluid.data("gids", [-1, 1], dtype="int64")
 
         class FakeClient:
             def barrier(self, *a, **k):
@@ -84,14 +158,9 @@ class TestGlobalShuffleSharding:
 
         shards = {}
         for tid in range(2):
-            monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
-            monkeypatch.setenv("PADDLE_TRAINER_ID", str(tid))
-            ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
-            ds.set_batch_size(2)
-            ds.set_use_var([ids])
-            ds.set_filelist(paths)
-            ds.load_into_memory()
-            ds._global_shuffle_rpc(FakeClient(), seed=5)
+            ds = self._make_dataset(paths)
+            ds._global_shuffle_rpc(FakeClient(), seed=5, n_trainers=2,
+                                   trainer_id=tid)
             shards[tid] = set(ds.filelist)
         # disjoint shards covering every file => records moved across nodes
         assert shards[0] | shards[1] == set(paths)

@@ -191,3 +191,110 @@ class TestGlobalShuffle:
                 ids, lod = batch["uid"]
                 seen.update(int(v) for v in ids)
         assert seen == set(range(100))
+
+    def test_dense_only_records_spread(self, tmp_path):
+        """Records with no sparse ids must hash on dense bytes, not all
+        collapse onto the FNV offset basis (= one feed)."""
+        from paddle_tpu.native import (SlotDesc, make_data_feed,
+                                       global_shuffle, native_available)
+        if not native_available():
+            import pytest
+            pytest.skip("no toolchain")
+        files = []
+        for i in range(2):
+            f = tmp_path / f"dense{i}.txt"
+            lines = [f"1 {i * 50 + j + 0.25}" for j in range(50)]
+            f.write_text("\n".join(lines))
+            files.append(str(f))
+        slots = [SlotDesc("d", is_dense=True, dim=1)]
+        feeds = [make_data_feed(slots, batch_size=8) for _ in range(2)]
+        total = 0
+        for fd, path in zip(feeds, files):
+            fd.add_file(path)
+            total += fd.load_into_memory()
+        assert total == 100
+        global_shuffle(feeds, seed=3)
+        sizes = [fd.memory_size for fd in feeds]
+        assert sum(sizes) == 100
+        assert all(s > 0 for s in sizes), f"dense-only skew: {sizes}"
+
+
+class TestExtractIngest:
+    def _load(self, tmp_path, n=30, dense=True):
+        from paddle_tpu.native import SlotDesc, make_data_feed
+        f = tmp_path / "recs.txt"
+        f.write_text("\n".join(f"1 {j} 1 {j}.5" for j in range(n)))
+        slots = [SlotDesc("uid"), SlotDesc("d", is_dense=True, dim=1)]
+        fd = make_data_feed(slots, batch_size=8)
+        fd.add_file(str(f))
+        fd.load_into_memory()
+        return fd, slots
+
+    def test_extract_shards_matches_per_dest(self, tmp_path):
+        from paddle_tpu.native import SlotDesc, make_data_feed
+        fd1, slots = self._load(tmp_path)
+        fd2 = make_data_feed(slots, batch_size=8)
+        f2 = tmp_path / "recs.txt"
+        fd2.add_file(str(f2))
+        fd2.load_into_memory()
+        world = 3
+        # single-pass on fd1
+        shards = fd1.extract_shards(world, self_rank=1)
+        # per-dest on fd2 (same content, same hashes)
+        per_dest = {d: fd2.extract_shard(d, world)
+                    for d in range(world) if d != 1}
+        assert shards[0] == per_dest[0]
+        assert shards[2] == per_dest[2]
+        assert fd1.memory_size == fd2.memory_size    # same records kept
+
+    def test_corrupt_blob_rejected_not_crash(self, tmp_path):
+        import struct
+        fd, _ = self._load(tmp_path, n=5)
+        before = fd.memory_size
+        # huge record count with no payload
+        bad1 = struct.pack("<Q", 1 << 62)
+        # huge slot-length field that would overflow n * sizeof(T)
+        bad2 = (struct.pack("<Q", 1) + struct.pack("<I", 1)
+                + struct.pack("<Q", 0x2000000000000001))
+        # huge slot COUNT (resize would throw before any length check)
+        bad3 = (struct.pack("<Q", 1) + struct.pack("<I", 0xFFFFFFFF))
+        for bad in (bad1, bad2, bad3):
+            import pytest as _pytest
+            with _pytest.raises(ValueError):
+                fd.ingest(bad)
+        assert fd.memory_size >= before              # process alive, pool sane
+
+
+class TestIngestAtomicity:
+    def _blob_two_records_second_truncated(self):
+        import struct
+        # record: 1 sparse slot [7], 1 dense slot [0.5]
+        rec = (struct.pack("<I", 1) + struct.pack("<Q", 1)
+               + struct.pack("<Q", 7)
+               + struct.pack("<I", 1) + struct.pack("<Q", 1)
+               + struct.pack("<f", 0.5))
+        return struct.pack("<Q", 2) + rec + rec[:6]   # 2nd record cut short
+
+    @pytest.mark.parametrize("cls", _feed_classes())
+    def test_midstream_corruption_leaves_pool_untouched(self, cls):
+        feed = cls([SlotDesc("uid"), SlotDesc("d", is_dense=True, dim=1)],
+                   batch_size=4)
+        before = feed.memory_size
+        with pytest.raises(ValueError):
+            feed.ingest(self._blob_two_records_second_truncated())
+        # the valid first record must NOT have been appended — a retry
+        # after the error would otherwise duplicate it
+        assert feed.memory_size == before
+
+    @pytest.mark.parametrize("cls", _feed_classes())
+    def test_valid_blob_round_trips(self, cls):
+        import struct
+        rec = (struct.pack("<I", 1) + struct.pack("<Q", 2)
+               + struct.pack("<QQ", 3, 4)[:16]
+               + struct.pack("<I", 1) + struct.pack("<Q", 1)
+               + struct.pack("<f", 1.5))
+        blob = struct.pack("<Q", 1) + rec
+        feed = cls([SlotDesc("uid"), SlotDesc("d", is_dense=True, dim=1)],
+                   batch_size=4)
+        assert feed.ingest(blob) == 1
+        assert feed.memory_size == 1

@@ -1,0 +1,180 @@
+"""Tests for fluid.nets composites, layers.distributions, and
+contrib.memory_usage (reference: nets.py, layers/distributions.py,
+contrib/memory_usage_calc.py)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers
+
+
+def _run(feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetch)
+
+
+class TestNets:
+    def test_simple_img_conv_pool(self, rng):
+        x = fluid.data("img", [-1, 3, 8, 8])
+        out = fluid.nets.simple_img_conv_pool(
+            x, num_filters=4, filter_size=3, pool_size=2, pool_stride=2,
+            conv_padding=1, act="relu")
+        got, = _run({"img": rng.randn(2, 3, 8, 8).astype("float32")}, [out])
+        assert np.asarray(got).shape == (2, 4, 4, 4)
+        assert np.asarray(got).min() >= 0.0          # relu applied
+
+    def test_img_conv_group_with_bn_dropout(self, rng):
+        x = fluid.data("imgs", [-1, 3, 8, 8])
+        out = fluid.nets.img_conv_group(
+            x, conv_num_filter=[4, 4], pool_size=2, pool_stride=2,
+            conv_padding=1, conv_act="relu",
+            conv_with_batchnorm=[True, False],
+            conv_batchnorm_drop_rate=[0.0, 0.0])
+        got, = _run({"imgs": rng.randn(2, 3, 8, 8).astype("float32")}, [out])
+        assert np.asarray(got).shape == (2, 4, 4, 4)
+
+    def test_sequence_conv_pool(self, rng):
+        x = fluid.data("seq", [-1, 6, 5])
+        out = fluid.nets.sequence_conv_pool(x, num_filters=7, filter_size=3,
+                                            act="sigmoid", pool_type="max")
+        got, = _run({"seq": rng.randn(3, 6, 5).astype("float32")}, [out])
+        assert np.asarray(got).shape == (3, 7)
+
+    def test_glu_halves_feature_dim(self, rng):
+        x = fluid.data("g", [-1, 6, 4])
+        out = fluid.nets.glu(x, dim=1)
+        xs = rng.randn(2, 6, 4).astype("float32")
+        got, = _run({"g": xs}, [out])
+        a, b = xs[:, :3], xs[:, 3:]
+        np.testing.assert_allclose(np.asarray(got),
+                                   a * (1.0 / (1.0 + np.exp(-b))),
+                                   rtol=2e-5)
+
+    def test_scaled_dot_product_attention(self, rng):
+        q = fluid.data("q", [-1, 4, 8])
+        k = fluid.data("k", [-1, 6, 8])
+        v = fluid.data("v", [-1, 6, 8])
+        out = fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=2)
+        got, = _run({"q": rng.randn(2, 4, 8).astype("float32"),
+                     "k": rng.randn(2, 6, 8).astype("float32"),
+                     "v": rng.randn(2, 6, 8).astype("float32")}, [out])
+        assert np.asarray(got).shape == (2, 4, 8)
+
+    def test_attention_single_head_matches_numpy(self, rng):
+        q = fluid.data("q1", [-1, 3, 4])
+        k = fluid.data("k1", [-1, 3, 4])
+        v = fluid.data("v1", [-1, 3, 4])
+        out = fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=1)
+        qs = rng.randn(1, 3, 4).astype("float32")
+        ks = rng.randn(1, 3, 4).astype("float32")
+        vs = rng.randn(1, 3, 4).astype("float32")
+        got, = _run({"q1": qs, "k1": ks, "v1": vs}, [out])
+        scores = (qs / 2.0) @ ks.transpose(0, 2, 1)
+        w = np.exp(scores - scores.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(got), w @ vs, rtol=2e-5)
+
+
+class TestDistributions:
+    def test_normal_entropy_log_prob(self):
+        D = layers.distributions
+        n = D.Normal(0.0, 2.0)
+        ent, = _run({}, [n.entropy()])
+        assert abs(float(np.asarray(ent)[0]) -
+                   (0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0))) < 1e-5
+        lp, = _run({}, [n.log_prob(np.array([0.0], "float32"))])
+        expect = -0.5 * math.log(2 * math.pi) - math.log(2.0)
+        assert abs(float(np.asarray(lp)[0]) - expect) < 1e-5
+
+    def test_normal_kl_zero_for_identical(self):
+        D = layers.distributions
+        a = D.Normal(1.0, 3.0)
+        b = D.Normal(1.0, 3.0)
+        kl, = _run({}, [a.kl_divergence(b)])
+        assert abs(float(np.asarray(kl)[0])) < 1e-6
+
+    def test_normal_sample_moments(self):
+        D = layers.distributions
+        n = D.Normal(5.0, 0.5)
+        s, = _run({}, [n.sample([20000], seed=3)])
+        arr = np.asarray(s)
+        assert abs(arr.mean() - 5.0) < 0.05
+        assert abs(arr.std() - 0.5) < 0.05
+
+    def test_uniform(self):
+        D = layers.distributions
+        u = D.Uniform(1.0, 3.0)
+        ent, = _run({}, [u.entropy()])
+        assert abs(float(np.asarray(ent)[0]) - math.log(2.0)) < 1e-6
+        s, = _run({}, [u.sample([10000], seed=1)])
+        arr = np.asarray(s)
+        assert arr.min() >= 1.0 and arr.max() <= 3.0
+        lp, = _run({}, [u.log_prob(np.array([2.0], "float32"))])
+        assert abs(float(np.asarray(lp)[0]) + math.log(2.0)) < 1e-6
+
+    def test_categorical_entropy_and_kl(self):
+        D = layers.distributions
+        logits = np.log(np.array([[0.5, 0.25, 0.25]], "float32"))
+        c = D.Categorical(logits)
+        ent, = _run({}, [c.entropy()])
+        expect = -(0.5 * math.log(0.5) + 2 * 0.25 * math.log(0.25))
+        assert abs(float(np.asarray(ent)[0]) - expect) < 1e-5
+        kl, = _run({}, [c.kl_divergence(D.Categorical(logits))])
+        assert abs(float(np.asarray(kl)[0])) < 1e-6
+
+    def test_mvn_diag_entropy_kl(self):
+        D = layers.distributions
+        loc = np.zeros((2,), "float32")
+        scale = np.diag([1.0, 2.0]).astype("float32")
+        m = D.MultivariateNormalDiag(loc, scale)
+        ent, = _run({}, [m.entropy()])
+        expect = 0.5 * 2 * (1 + math.log(2 * math.pi)) + math.log(2.0)
+        assert abs(float(np.asarray(ent)) - expect) < 1e-5
+        kl, = _run({}, [m.kl_divergence(
+            D.MultivariateNormalDiag(loc, scale))])
+        assert abs(float(np.asarray(kl))) < 1e-6
+
+
+class TestMemoryUsage:
+    def test_program_estimate(self):
+        from paddle_tpu.contrib import memory_usage
+        x = fluid.data("mx", [-1, 64])
+        y = layers.fc(x, size=32)
+        low, high, unit = memory_usage(fluid.default_main_program(),
+                                       batch_size=16)
+        assert low > 0 and high > low
+        assert unit in ("B", "KB", "MB")
+
+    def test_rejects_non_program(self):
+        from paddle_tpu.contrib import memory_usage
+        with pytest.raises(TypeError):
+            memory_usage("nope", 4)
+        x = fluid.data("mz", [-1, 4])
+        with pytest.raises(ValueError):
+            memory_usage(fluid.default_main_program(), 0)
+
+    def test_compiled_memory_stats(self):
+        from paddle_tpu.contrib import compiled_memory_stats
+        import jax.numpy as jnp
+        stats = compiled_memory_stats(lambda a: (a * 2).sum(),
+                                      jnp.ones((8, 8)))
+        if stats is not None:       # backend may not expose the analysis
+            assert stats["argument_size_in_bytes"] >= 8 * 8 * 4
+
+
+class TestReviewRegressions:
+    def test_dropout_prob_one_all_dropped(self, rng):
+        x = fluid.data("dp1", [-1, 8])
+        out = layers.dropout(x, dropout_prob=1.0,
+                             dropout_implementation="upscale_in_train")
+        got, = _run({"dp1": rng.randn(4, 8).astype("float32")}, [out])
+        np.testing.assert_array_equal(np.asarray(got), 0.0)
+
+    def test_sequence_conv_rejects_stride(self):
+        x = fluid.data("scs", [-1, 6, 5])
+        with pytest.raises(ValueError, match="filter_stride"):
+            layers.sequence_conv(x, num_filters=4, filter_size=3,
+                                 filter_stride=2)

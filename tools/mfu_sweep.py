@@ -1,10 +1,10 @@
 """MFU attribution sweep for the BERT bench (run on a real TPU chip).
 
-Measured so far (v5e chip, 2026-07-29): 91.5k tok/s = 30.9% MFU at
-batch 64 / seq 128; throughput is invariant to batch (64 vs 128), so the
-gap to the 35% target is per-token work, not under-batching.  Pure-matmul
-step time would be ~28ms vs 90ms measured — this sweep isolates where the
-other ~60ms lives by ablating one suspect at a time:
+History: the 2026-07-29 run at 91.5k tok/s / 30.9% MFU was attributed by
+this sweep to dropout (`nodrop` = 55.5% vs baseline 31.7%): the rbg
+hardware-RNG default silently never applied (fluid/core.py NameError,
+fixed 2026-07-30), so masks used threefry.  Post-fix baseline: 125.4k
+tok/s = 42.3% MFU.  The sweep ablates one suspect at a time:
 
   baseline      the exact bench configuration
   nodrop        dropout off (RNG + mask traffic cost)
