@@ -1,5 +1,13 @@
 """paddle.distributed analog: fleet, launch, collectives over process mesh."""
 from . import fleet
 from .fleet import DistributedStrategy
+from .spawn import spawn
+from . import collective
+from .collective import (ReduceOp, all_gather, all_reduce, barrier,
+                         broadcast, get_rank, get_world_size,
+                         init_parallel_env, reduce, scatter)
 
-__all__ = ["fleet", "DistributedStrategy"]
+__all__ = ["fleet", "DistributedStrategy", "spawn", "collective",
+           "ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce",
+           "scatter", "barrier", "get_rank", "get_world_size",
+           "init_parallel_env"]

@@ -1,0 +1,168 @@
+"""paddle.distributed functional collectives — distributed/collective.py
+analog (broadcast:99, all_reduce:155, reduce:229, all_gather:311,
+scatter:384, barrier:455) plus get_rank/get_world_size/init_parallel_env
+from distributed/parallel.py.
+
+TPU-native semantics: the reference's functions imperatively launch NCCL
+kernels; under XLA a device collective only exists inside a sharded trace.
+So each helper picks the right mechanism for its context:
+
+* inside a ``shard_map``/``pmap`` trace (an axis name is bound) —
+  ``lax.psum``/``all_gather``/``ppermute`` over that axis, i.e. the real
+  ICI collective compiled into the program;
+* eager with multiple processes — host-level reduce over DCN via
+  ``jax.experimental.multihost_utils`` (the Gloo path analog);
+* eager single-process — identity (world of one).
+
+Group/ring ids map to mesh axis names through the same registry the c_*
+ops use (parallel/mesh.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+_OP_NAMES = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+             ReduceOp.MIN: "min", ReduceOp.PROD: "prod"}
+
+
+def _bound_axis(group):
+    """Mesh axis name for this group (ring id), or None when no mesh/axis
+    is registered.  Used only when the tensor is a tracer, i.e. inside a
+    shard_map/pmap body where the axis name is bound."""
+    from ..parallel.mesh import ring_axes
+    return ring_axes().get(int(group) if group else 0)
+
+
+def get_rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    import jax
+    return jax.process_count()
+
+
+def init_parallel_env():
+    """distributed/parallel.py:57 analog: rendezvous via jax.distributed
+    when the launcher env is present (the gen_nccl_id bootstrap)."""
+    import os
+    import jax
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nranks > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nranks, process_id=rank)
+    from ..dygraph.parallel import ParallelEnv
+    return ParallelEnv()
+
+
+def _eager_hosts_reduce(value, mode):
+    import jax
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+    arr = np.asarray(value)
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    if mode == "sum":
+        return gathered.sum(axis=0)
+    if mode == "max":
+        return gathered.max(axis=0)
+    if mode == "min":
+        return gathered.min(axis=0)
+    return gathered.prod(axis=0)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=0):
+    """In-trace: lax.psum/pmax/pmin over the group's mesh axis.  Eager:
+    host all-reduce over processes (identity for world size 1)."""
+    import jax
+    from jax import lax
+    mode = _OP_NAMES[op]
+    axis = _bound_axis(group)
+    if axis is not None and isinstance(tensor, jax.core.Tracer):
+        fn = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}.get(mode)
+        if fn is None:
+            raise ValueError("PROD all_reduce is not supported in-trace")
+        return fn(tensor, axis)
+    return _eager_hosts_reduce(tensor, mode)
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=0):
+    """Reference reduce: result valid on dst, undefined elsewhere — the
+    all-reduce result everywhere is a valid (stronger) implementation."""
+    return all_reduce(tensor, op, group)
+
+
+def broadcast(tensor, src, group=0):
+    import jax
+    axis = _bound_axis(group)
+    if axis is not None and isinstance(tensor, jax.core.Tracer):
+        from jax import lax
+        # select src's value on every member: gather then index is the
+        # portable XLA formulation (compiles to an ICI broadcast)
+        return lax.all_gather(tensor, axis)[src]
+    if jax.process_count() <= 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    arr = np.asarray(tensor)
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    return gathered[src]
+
+
+def all_gather(tensor_list, tensor, group=0):
+    """Appends every rank's tensor to tensor_list (reference contract)."""
+    import jax
+    axis = _bound_axis(group)
+    if axis is not None and isinstance(tensor, jax.core.Tracer):
+        from jax import lax
+        stacked = lax.all_gather(tensor, axis)
+        tensor_list.extend([stacked[i] for i in range(stacked.shape[0])])
+        return tensor_list
+    if jax.process_count() <= 1:
+        tensor_list.append(tensor)
+        return tensor_list
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(
+        multihost_utils.process_allgather(np.asarray(tensor)))
+    tensor_list.extend([gathered[i] for i in range(gathered.shape[0])])
+    return tensor_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=0):
+    """Rank r receives tensor_list[r] held by src."""
+    import jax
+    axis = _bound_axis(group)
+    if axis is not None and isinstance(tensor, jax.core.Tracer):
+        from jax import lax
+        # in-trace: every member traces the same stack; each takes its row
+        stacked = jax.numpy.stack(list(tensor_list))
+        return lax.dynamic_index_in_dim(stacked, lax.axis_index(axis),
+                                        keepdims=False)
+    if jax.process_count() <= 1:
+        return tensor_list[0] if tensor_list else tensor
+    from jax.experimental import multihost_utils
+    is_src = get_rank() == src
+    stacked = (np.stack([np.asarray(t) for t in tensor_list])
+               if is_src and tensor_list
+               else np.zeros((get_world_size(),) + np.shape(tensor),
+                             np.asarray(tensor).dtype))
+    # ship src's stack to everyone, then each rank takes its row
+    out = multihost_utils.broadcast_one_to_all(stacked, is_source=is_src)
+    return np.asarray(out)[get_rank()]
+
+
+def barrier(group=0):
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"pd_barrier_{group}")

@@ -16,6 +16,8 @@ from .base.fleet_base import Fleet, fleet as _fleet_singleton
 from .base.strategy_compiler import StrategyCompiler
 from . import meta_optimizers
 from . import metrics
+from . import dataset
+from .dataset import InMemoryDataset, QueueDataset
 
 # module-level delegation to the singleton (reference __init__.py binds the
 # same names: fleet_base.py bottom + fleet/__init__.py)

@@ -1,0 +1,8 @@
+"""fleet dataset namespace — python/paddle/distributed/fleet/dataset
+re-exports the dataset tier (the reference's distributed/__init__.py does
+`from paddle.distributed.fleet.dataset import *`)."""
+from ...fluid.dataset import (DatasetBase, DatasetFactory, InMemoryDataset,
+                              QueueDataset)
+
+__all__ = ["DatasetBase", "DatasetFactory", "InMemoryDataset",
+           "QueueDataset"]

@@ -1,0 +1,98 @@
+"""Function-based multi-process launcher — python/paddle/distributed/spawn.py
+analog.
+
+``spawn(func, args=(), nprocs=...)`` forks N processes that each run
+``func(*args)`` with the full collective env contract set
+(PADDLE_TRAINER_ID/ENDPOINTS/TRAINERS_NUM + the jax.distributed coordinator
+address in PADDLE_TPU_COORDINATOR, the gen_nccl_id analog) — the same wiring
+``paddle_tpu.distributed.launch`` gives script-based children, so
+``fleet.init(is_collective=True)`` / ``init_parallel_env`` work identically
+under either launcher.
+
+Uses the multiprocessing *spawn* start method: children must NOT inherit an
+initialized JAX/PJRT runtime from the parent (a forked TPU client hangs), and
+env must be set before the child imports jax — the module-level
+``_child_main`` sets env first, then calls the pickled target.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import socket
+from typing import Optional, Sequence
+
+
+class SpawnContext:
+    """Handle over the spawned processes (reference spawn.py returns the
+    same shape: .processes + .join())."""
+
+    def __init__(self, processes):
+        self.processes = processes
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every child; on failure OR timeout, terminate the rest
+        (the launch_utils watcher semantics — never leave orphans behind a
+        False return).  Returns True only if all exited 0."""
+        for p in self.processes:
+            p.join(timeout)
+        ok = all(p.exitcode == 0 for p in self.processes)
+        if not ok:
+            for p in self.processes:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(5)
+        return ok
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_main(env, func, args):
+    os.environ.update(env)              # before any jax import in the child
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          master_port: Optional[int] = None, backend: Optional[str] = None,
+          **options) -> SpawnContext:
+    """Run ``func(*args)`` in ``nprocs`` collective worker processes.
+
+    nprocs=-1 spawns one process per visible device-host (defaults to 1 —
+    on TPU one process per host owns all local chips; use the launch module
+    for multi-host pods).  With ``join=True`` (default) blocks until all
+    children exit and raises RuntimeError if any failed.
+    """
+    if nprocs <= 0:
+        nprocs = 1
+    port = master_port or _free_port()
+    endpoints = ",".join(f"127.0.0.1:{port + 100 + i}"
+                         for i in range(nprocs))
+    coordinator = f"127.0.0.1:{port}" if nprocs > 1 else ""
+
+    ctx = multiprocessing.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank],
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "TRAINING_ROLE": "TRAINER",
+            "PADDLE_TPU_COORDINATOR": coordinator,
+        }
+        if backend:
+            env["JAX_PLATFORMS"] = backend
+        p = ctx.Process(target=_child_main, args=(env, func, tuple(args)),
+                        daemon=False)
+        p.start()
+        procs.append(p)
+
+    context = SpawnContext(procs)
+    if join:
+        if not context.join():
+            codes = [p.exitcode for p in procs]
+            raise RuntimeError(f"spawned workers failed, exit codes {codes}")
+    return context

@@ -1,3 +1,4 @@
 """High-level API (reference python/paddle/hapi/model.py)."""
 from .model import Model, Input
 from . import callbacks
+from .flops import flops

@@ -1,3 +1,4 @@
 """Inference API (reference paddle/fluid/inference/, SURVEY §2.7)."""
 from .predictor import (AnalysisConfig, AnalysisPredictor,
                         create_paddle_predictor, Config, create_predictor)
+from .aot import AotPredictor, load_aot_model, save_aot_model

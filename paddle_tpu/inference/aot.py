@@ -1,0 +1,117 @@
+"""AOT-serialized inference artifacts — the TPU-native deployment format.
+
+Reference analog: the `__model__` ProgramDesc + params files that
+`save_inference_model` (io.py:1198) writes for AnalysisPredictor and the C
+API/TRT engine caches consume.  On TPU the deployable unit is a compiled
+XLA program, so the artifact here is **serialized StableHLO** via
+``jax.export``: the loaded Program's op stream is traced once with the
+weights closed over (baked into the module as constants — one
+self-contained file) and shipped with a JSON sidecar naming feeds/fetches.
+A consumer needs jax (any language binding over PJRT), NOT this framework
+or the model's Python code — the capi/go-client story, solved the XLA way.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Sequence
+
+import numpy as np
+
+__all__ = ["save_aot_model", "load_aot_model", "AotPredictor"]
+
+_ARTIFACT = "model.stablehlo"
+_META = "aot_meta.json"
+
+
+def save_aot_model(dirname: str, predictor, example_feed: Dict[str, np.ndarray]):
+    """Export `predictor`'s loaded model as a serialized StableHLO artifact.
+
+    example_feed supplies shapes/dtypes for tracing (values unused).  Shapes
+    are baked statically — export one artifact per served batch shape, the
+    same contract as AnalysisPredictor's shape-keyed compile cache.
+    """
+    import jax
+    from jax import export as jexport
+
+    from ..fluid.core import global_scope
+    from ..fluid.executor import run_block_ops
+    from ..ops.registry import LoweringContext
+    from ..fluid.framework import prune_ops
+
+    program = predictor._program
+    missing = [n for n in predictor._feed_names if n not in example_feed]
+    if missing:
+        raise ValueError(f"example_feed missing inputs: {missing}")
+    feed_names = list(predictor._feed_names)   # artifact bakes the full list
+    fetch_names = list(predictor._fetch_names)
+    block = program.global_block()
+    scope = global_scope()
+
+    params = {}
+    for name, var in block.vars.items():
+        v = scope.find_var(name)
+        if v is not None and name not in example_feed:
+            params[name] = np.asarray(v)
+
+    run_ops = prune_ops(block, block.ops, targets=fetch_names,
+                        extra_state=set())
+
+    def fn(*feeds):
+        env = dict(params)                 # weights baked in as constants
+        env.update(zip(feed_names, feeds))
+        ctx = LoweringContext(base_key=None, mesh_axes={}, is_test=True)
+        run_block_ops(block, env, ctx, ops=run_ops)
+        return [env[n] for n in fetch_names]
+
+    specs = [jax.ShapeDtypeStruct(np.shape(example_feed[n]),
+                                  np.asarray(example_feed[n]).dtype)
+             for n in feed_names]
+    exported = jexport.export(jax.jit(fn))(*specs)
+    blob = exported.serialize()
+
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, _ARTIFACT), "wb") as f:
+        f.write(blob)
+    meta = {
+        "feed_names": feed_names,
+        "fetch_names": fetch_names,
+        "input_shapes": {n: list(np.shape(example_feed[n]))
+                         for n in feed_names},
+        "input_dtypes": {n: str(np.asarray(example_feed[n]).dtype)
+                         for n in feed_names},
+        "platforms": list(exported.platforms),
+    }
+    with open(os.path.join(dirname, _META), "w") as f:
+        json.dump(meta, f, indent=1)
+    return meta
+
+
+class AotPredictor:
+    """Serve a saved StableHLO artifact: __call__(feed dict) -> fetch dict.
+    No Program, no op registry — just the deserialized executable."""
+
+    def __init__(self, dirname: str):
+        from jax import export as jexport
+        with open(os.path.join(dirname, _ARTIFACT), "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(os.path.join(dirname, _META)) as f:
+            self._meta = json.load(f)
+
+    def get_input_names(self) -> Sequence[str]:
+        return list(self._meta["feed_names"])
+
+    def get_output_names(self) -> Sequence[str]:
+        return list(self._meta["fetch_names"])
+
+    def __call__(self, feed: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        args = [feed[n] for n in self._meta["feed_names"]]
+        outs = self._exported.call(*args)
+        return dict(zip(self._meta["fetch_names"],
+                        [np.asarray(o) for o in outs]))
+
+    run = __call__
+
+
+def load_aot_model(dirname: str) -> AotPredictor:
+    return AotPredictor(dirname)

@@ -291,15 +291,16 @@ def _instance_norm(ins, attrs, ctx):
     x = _x(ins)
     eps = attrs.get("epsilon", 1e-5)
     axes = tuple(range(2, x.ndim))
-    m = jnp.mean(x, axis=axes, keepdims=True)
-    v = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - m) * lax.rsqrt(v + eps)
+    xf = x.astype(jnp.float32)     # f32 stats with bf16 I/O (AMP-gray norm)
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - m) * lax.rsqrt(v + eps)
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
     if ins.get("Scale"):
         out = out * ins["Scale"][0].reshape(shape)
     if ins.get("Bias"):
         out = out + ins["Bias"][0].reshape(shape)
-    return {"Y": [out], "SavedMean": [jnp.squeeze(m)],
+    return {"Y": [out.astype(x.dtype)], "SavedMean": [jnp.squeeze(m)],
             "SavedVariance": [jnp.squeeze(lax.rsqrt(v + eps))]}
 
 
@@ -309,8 +310,8 @@ def _group_norm(ins, attrs, ctx):
     g = attrs.get("groups", 1)
     eps = attrs.get("epsilon", 1e-5)
     n, c = x.shape[:2]
-    xg = x.reshape((n, g, c // g) + x.shape[2:])
-    axes = tuple(range(2, xg.ndim))
+    xg = x.astype(jnp.float32).reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))     # f32 stats with bf16 I/O (AMP-gray)
     m = jnp.mean(xg, axis=axes, keepdims=True)
     v = jnp.var(xg, axis=axes, keepdims=True)
     out = ((xg - m) * lax.rsqrt(v + eps)).reshape(x.shape)
@@ -319,7 +320,7 @@ def _group_norm(ins, attrs, ctx):
         out = out * ins["Scale"][0].reshape(shape)
     if ins.get("Bias"):
         out = out + ins["Bias"][0].reshape(shape)
-    return {"Y": [out], "Mean": [m.reshape(n, g)],
+    return {"Y": [out.astype(x.dtype)], "Mean": [m.reshape(n, g)],
             "Variance": [v.reshape(n, g)]}
 
 

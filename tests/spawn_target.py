@@ -1,0 +1,22 @@
+"""Targets for test_spawn.py — must be module-level (pickled by spawn)."""
+import json
+import os
+import sys
+
+
+def write_rank_info(out_dir):
+    from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    rm._generate_role()
+    info = {"rank": rm._worker_index(), "nranks": rm._worker_num(),
+            "endpoint": os.environ.get("PADDLE_CURRENT_ENDPOINT"),
+            "coordinator": os.environ.get("PADDLE_TPU_COORDINATOR")}
+    with open(os.path.join(out_dir, f"rank{rm._worker_index()}.json"),
+              "w") as f:
+        json.dump(info, f)
+
+
+def fail_if_rank_one(out_dir):
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank == 1:
+        sys.exit(3)

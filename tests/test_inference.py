@@ -82,3 +82,32 @@ class TestAnalysisPredictor:
             out = p.get_output_handle(
                 p.get_output_names()[0]).copy_to_cpu()
             np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+class TestAotExport:
+    """StableHLO AOT artifact (jax.export) — the TPU deployment format."""
+
+    def test_save_load_roundtrip_matches(self, tmp_path, rng):
+        model_dir, xs, ref = _train_and_export(tmp_path, rng)
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot")
+        meta = save_aot_model(aot_dir, p, {"x": xs})
+        assert meta["feed_names"] == ["x"]
+        import os
+        assert os.path.exists(os.path.join(aot_dir, "model.stablehlo"))
+
+        served = load_aot_model(aot_dir)
+        assert served.get_input_names() == ["x"]
+        out = served({"x": xs})
+        got = out[served.get_output_names()[0]]
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_missing_feed_rejected(self, tmp_path, rng):
+        model_dir, xs, _ = _train_and_export(tmp_path, rng)
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model)
+        p = create_predictor(AnalysisConfig(model_dir))
+        with pytest.raises(ValueError, match="missing inputs"):
+            save_aot_model(str(tmp_path / "aot2"), p, {})

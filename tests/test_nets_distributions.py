@@ -178,3 +178,37 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="filter_stride"):
             layers.sequence_conv(x, num_filters=4, filter_size=3,
                                  filter_stride=2)
+
+
+class TestFlops:
+    def test_lenet_flops_from_xla_cost_analysis(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.vision.models import LeNet
+        dybase.enable_dygraph()
+        try:
+            net = LeNet()
+            net.eval()
+            total = paddle.flops(net, [1, 1, 28, 28])
+            assert 1e5 < total < 1e8       # ~0.7 MFLOP fwd
+            # batch scales linearly
+            total4 = paddle.flops(net, [4, 1, 28, 28])
+            assert 3.5 * total < total4 < 4.5 * total
+        finally:
+            dybase.disable_dygraph()
+
+    def test_static_built_net_never_crashes(self):
+        """A net built outside dygraph either raises the explanatory
+        TypeError or degrades to a 0.0 count — never an opaque crash."""
+        import paddle_tpu as paddle
+        from paddle_tpu.dygraph import base as dybase
+        assert dybase._dygraph_tracer() is None
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()                      # built in static mode
+        try:
+            total = paddle.flops(net, [1, 1, 28, 28])
+            assert isinstance(total, float)
+        except TypeError as e:
+            assert "dygraph-built" in str(e)
+        finally:
+            dybase.disable_dygraph()       # flops() may have enabled it

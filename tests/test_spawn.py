@@ -1,0 +1,53 @@
+"""paddle.distributed.spawn analog tests (reference spawn.py contract)."""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.distributed import spawn
+from tests.spawn_target import fail_if_rank_one, write_rank_info
+
+
+class TestSpawn:
+    def test_two_procs_get_collective_env(self, tmp_path):
+        ctx = spawn(write_rank_info, args=(str(tmp_path),), nprocs=2,
+                    backend="cpu")
+        infos = {}
+        for r in range(2):
+            with open(tmp_path / f"rank{r}.json") as f:
+                infos[r] = json.load(f)
+        assert infos[0]["rank"] == 0 and infos[1]["rank"] == 1
+        assert infos[0]["nranks"] == infos[1]["nranks"] == 2
+        assert infos[0]["endpoint"] != infos[1]["endpoint"]
+        assert infos[0]["coordinator"]          # rendezvous address set
+        assert all(p.exitcode == 0 for p in ctx.processes)
+
+    def test_single_proc_no_coordinator(self, tmp_path):
+        spawn(write_rank_info, args=(str(tmp_path),), nprocs=1,
+              backend="cpu")
+        with open(tmp_path / "rank0.json") as f:
+            info = json.load(f)
+        assert info["nranks"] == 1
+        assert not info["coordinator"]          # single proc: no rendezvous
+
+    def test_failed_child_raises(self, tmp_path):
+        with pytest.raises(RuntimeError, match="exit codes"):
+            spawn(fail_if_rank_one, args=(str(tmp_path),), nprocs=2,
+                  backend="cpu")
+
+
+def _sleep_forever(out_dir):
+    import time
+    time.sleep(600)
+
+
+class TestJoinTimeout:
+    def test_timeout_terminates_children(self, tmp_path):
+        from tests.spawn_target import write_rank_info
+        ctx = spawn(_sleep_forever, args=(str(tmp_path),), nprocs=2,
+                    join=False, backend="cpu")
+        ok = ctx.join(timeout=2)
+        assert ok is False
+        # no orphans: every child is dead after the failed join
+        for p in ctx.processes:
+            assert not p.is_alive()
