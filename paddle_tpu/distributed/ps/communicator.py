@@ -172,6 +172,32 @@ class GeoCommunicator:
         return self._step % self.push_nums == 0
 
 
+class HeartBeater:
+    """Background liveness pings to every server shard (the trainer half
+    of heart_beat_monitor.cc).  Attached to a communicator by the PS
+    runtime; failures are ignored — a dying server must not take the
+    trainer down with it, the monitor's job is the reverse."""
+
+    def __init__(self, client: PsClient, rank: int, interval: float = 2.0):
+        self.client = client
+        self.rank = int(rank)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._beat, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.client.heartbeat(self.rank)
+            except Exception:                # noqa: BLE001 — see class doc
+                pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
 def make_communicator(mode: str, client: PsClient, **kw):
     mode = (mode or "async").lower()
     if mode in ("async", "a_sync"):

@@ -91,11 +91,20 @@ class PsServer:
         self.n_servers = n_servers
         self.sparse: Dict[str, CommonSparseTable] = {}
         self.dense: Dict[str, CommonDenseTable] = {}
+        self.n_trainers = n_trainers
         self.barrier_table = BarrierTable(n_trainers)
         # blob mailbox for trainer↔trainer record exchange (the fleet-RPC
         # channel DatasetImpl::GlobalShuffle routes over, data_set.h:118)
         self._mailbox: Dict[tuple, List[np.ndarray]] = {}
         self._mailbox_lock = threading.Lock()
+        # worker liveness (operators/distributed/heart_beat_monitor.cc):
+        # rank -> monotonic last-heartbeat; only ranks that have ever
+        # beaten are monitored
+        self._heartbeats: Dict[int, float] = {}
+        self._hb_lock = threading.Lock()
+        self._hb_monitor: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self.dead_ranks: set = set()
         self._stop = threading.Event()
         outer = self
 
@@ -199,12 +208,60 @@ class PsServer:
         if op == "size":
             t = self.sparse[header["table"]]
             return {"ok": True, "size": t.size()}, []
+        if op == "heartbeat":
+            import time
+            with self._hb_lock:
+                self._heartbeats[int(header["rank"])] = time.monotonic()
+            return {"ok": True}, []
         if op == "ping":
             return {"ok": True, "shard": self.shard_idx}, []
         if op == "stop":
             self._stop.set()
             return {"ok": True}, []
         return {"ok": False, "error": f"unknown op {op}"}, []
+
+    # -- worker liveness ----------------------------------------------------
+    def dead_workers(self, timeout: float) -> List[int]:
+        """Ranks that heartbeated at least once and then went silent for
+        longer than `timeout` seconds."""
+        import time
+        now = time.monotonic()
+        with self._hb_lock:
+            return sorted(r for r, t in self._heartbeats.items()
+                          if now - t > timeout)
+
+    def start_heartbeat_monitor(self, timeout: float = 120.0,
+                                interval: float = 2.0):
+        """heart_beat_monitor.cc analog: watch trainer liveness; when every
+        known trainer has gone silent, stop serving so the pod tears down
+        instead of hanging on a dead job.  Individual deaths are recorded
+        in `dead_ranks` and logged."""
+        import sys
+        import time
+
+        def watch():
+            while not self._hb_stop.wait(interval):
+                dead = set(self.dead_workers(timeout))
+                with self._hb_lock:
+                    known = set(self._heartbeats)
+                for r in sorted(dead - self.dead_ranks):
+                    print(f"ps shard {self.shard_idx}: trainer {r} missed "
+                          f"heartbeats for >{timeout}s — marking dead",
+                          file=sys.stderr)
+                self.dead_ranks = dead
+                # "all dead" needs the full expected pod to have checked in
+                # once — a late-starting trainer that never beat must not
+                # count as dead, or a healthy job gets torn down
+                if (known and dead == known
+                        and len(known) >= self.n_trainers):
+                    print(f"ps shard {self.shard_idx}: ALL trainers dead — "
+                          f"shutting down", file=sys.stderr)
+                    self._stop.set()
+                    return
+
+        self._hb_monitor = threading.Thread(target=watch, daemon=True)
+        self._hb_monitor.start()
+        return self
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
@@ -219,6 +276,7 @@ class PsServer:
         self._server.shutdown()
 
     def stop(self):
+        self._hb_stop.set()
         self._stop.set()
         self._server.shutdown()
 
@@ -411,6 +469,10 @@ class PsClient:
         _, arrs = self._call(rank % len(self.endpoints),
                              {"op": "take_blobs", "rank": rank, "tag": tag})
         return [a.tobytes() for a in arrs]
+
+    def heartbeat(self, rank: int):
+        """Tell every server shard this trainer is alive."""
+        self._call_all({"op": "heartbeat", "rank": int(rank)})
 
     # -- control ------------------------------------------------------------
     def barrier(self, timeout=60.0):

@@ -34,6 +34,7 @@ class TheOnePSRuntime:
         self._server = None
         self._client = None
         self._communicator = None
+        self._heartbeater = None
 
     # -- table registry (in-process mode) -----------------------------------
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01):
@@ -54,8 +55,13 @@ class TheOnePSRuntime:
         if not eps:
             return                      # in-process mode
         from .rpc import PsClient
-        from .communicator import make_communicator
+        from .communicator import HeartBeater, make_communicator
         self._client = PsClient(eps)
+        hb_interval = float(os.environ.get("PADDLE_PS_HEARTBEAT_INTERVAL",
+                                           "2.0"))
+        if hb_interval > 0:                 # <=0 disables, like the
+            self._heartbeater = HeartBeater(  # server-side timeout knob
+                self._client, self._role_maker._worker_index(), hb_interval)
         mode = "async"
         cfg = {}
         strat = self._strategy
@@ -96,6 +102,10 @@ class TheOnePSRuntime:
             port=port, shard_idx=shard_idx, n_servers=len(eps),
             n_trainers=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
         self._server.start()
+        hb_timeout = float(os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT",
+                                          "120"))
+        if hb_timeout > 0:
+            self._server.start_heartbeat_monitor(timeout=hb_timeout)
 
     def run_server(self):
         self._running = True
@@ -104,6 +114,8 @@ class TheOnePSRuntime:
             self._running = False
 
     def stop_worker(self):
+        if getattr(self, "_heartbeater", None) is not None:
+            self._heartbeater.stop()
         if self._communicator is not None and hasattr(self._communicator,
                                                       "stop"):
             self._communicator.stop()

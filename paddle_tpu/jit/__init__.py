@@ -1,0 +1,106 @@
+"""paddle.jit — the 2.0 dygraph-to-static namespace.
+
+Reference: python/paddle/fluid/dygraph/jit.py (`@declarative`/`to_static`,
+`TracedLayer`) and 2.0's `paddle.jit.save/load` (TranslatedLayer).
+TPU-native: `to_static` captures the eager op stream as ONE cached XLA
+executable (dygraph/jit_static.py); `save` serializes that callable as
+StableHLO via jax.export with the weights baked in, plus a state-dict
+sidecar, and `load` returns a `TranslatedLayer` that serves the artifact —
+same deployment unit as inference/aot.py, addressed by model path.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from ..dygraph.jit import TracedLayer
+from ..dygraph.jit_static import StaticFunction, declarative, to_static
+
+__all__ = ["to_static", "declarative", "TracedLayer", "save", "load",
+           "TranslatedLayer"]
+
+_ARTIFACT = "model.stablehlo"
+_META = "jit_meta.json"
+_STATE = "state.npz"
+
+
+def save(layer, path, input_spec):
+    """Export a dygraph Layer for deployment.
+
+    input_spec: example inputs (arrays, or objects with .shape/.dtype)
+    fixing the traced signature — one artifact per served shape, like the
+    predictor's shape-keyed compile cache.  `path` is a directory.
+    """
+    import jax
+    from jax import export as jexport
+
+    from ..dygraph.base import VarBase
+    from ..dygraph.functional import functionalize
+
+    net = getattr(layer, "network", layer)
+    examples = []
+    for spec in (input_spec if isinstance(input_spec, (list, tuple))
+                 else [input_spec]):
+        if isinstance(spec, VarBase):
+            spec = spec._value
+        examples.append(np.zeros(tuple(int(d) for d in spec.shape),
+                                 np.dtype(spec.dtype).name)
+                        if not isinstance(spec, np.ndarray)
+                        else np.asarray(spec))
+
+    values, fn = functionalize(net)
+
+    def serving_fn(*xs):
+        return fn(values, *xs)           # weights closed over as constants
+
+    specs = [jax.ShapeDtypeStruct(e.shape, e.dtype) for e in examples]
+    exported = jexport.export(jax.jit(serving_fn))(*specs)
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _ARTIFACT), "wb") as f:
+        f.write(exported.serialize())
+    state = {k: np.asarray(v._value)
+             for k, v in net.named_parameters()}
+    np.savez(os.path.join(path, _STATE), **state)
+    with open(os.path.join(path, _META), "w") as f:
+        json.dump({"input_shapes": [list(e.shape) for e in examples],
+                   "input_dtypes": [str(e.dtype) for e in examples],
+                   "layer_type": type(net).__name__}, f)
+
+
+class TranslatedLayer:
+    """Loaded serving callable (2.0 TranslatedLayer analog).  Runs the
+    deserialized XLA executable; `state_dict()` exposes the saved weights
+    for inspection or warm-starting a fresh Python model."""
+
+    def __init__(self, path):
+        from jax import export as jexport
+        with open(os.path.join(path, _ARTIFACT), "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(os.path.join(path, _META)) as f:
+            self._meta = json.load(f)
+        self._state = dict(np.load(os.path.join(path, _STATE)))
+
+    def __call__(self, *inputs):
+        from ..dygraph.base import VarBase
+        arrs = [x._value if isinstance(x, VarBase) else np.asarray(x)
+                for x in inputs]
+        out = self._exported.call(*arrs)
+        if isinstance(out, (list, tuple)):
+            outs = [VarBase(np.asarray(o)) for o in out]
+            return outs if len(outs) > 1 else outs[0]
+        return VarBase(np.asarray(out))
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return dict(self._state)
+
+
+def load(path) -> TranslatedLayer:
+    return TranslatedLayer(path)

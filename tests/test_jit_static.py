@@ -192,3 +192,34 @@ class TestDeclarativeCapture:
         y1 = np.asarray(model(x).value())
         y2 = np.asarray(model(x).value())
         assert not np.allclose(y1, y2)   # fresh mask each call
+
+
+class TestJitSaveLoad:
+    """paddle.jit.save/load (2.0 TranslatedLayer) over the StableHLO
+    artifact — deployment round trip without the Python model class."""
+
+    def test_round_trip_matches_eager(self, tmp_path):
+        import paddle_tpu as paddle
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.base import to_variable
+        dybase.enable_dygraph()
+        try:
+            from paddle_tpu.vision.models import LeNet
+            net = LeNet()
+            net.eval()
+            x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(
+                "float32")
+            ref = np.asarray(net(to_variable(x))._value)
+            d = str(tmp_path / "jit_model")
+            paddle.jit.save(net, d, input_spec=[x])
+            served = paddle.jit.load(d)
+            out = np.asarray(served(x)._value)
+            np.testing.assert_allclose(out, ref, rtol=1e-5)
+            assert len(served.state_dict()) == len(
+                dict(net.named_parameters()))
+        finally:
+            dybase.disable_dygraph()
+
+    def test_to_static_alias_exported(self):
+        import paddle_tpu as paddle
+        assert paddle.jit.to_static is paddle.jit.declarative

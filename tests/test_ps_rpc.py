@@ -371,3 +371,56 @@ class TestGlobalShuffleRpc:
         nat2 = NativeDataFeed(slots, batch_size=8)
         assert nat2.ingest(py_blob) == n
         assert nat2.memory_size == n
+
+
+class TestHeartbeat:
+    """heart_beat_monitor.cc analog: trainer liveness on the PS plane."""
+
+    def test_heartbeat_tracks_and_expires(self, cluster):
+        c = cluster.client()
+        c.heartbeat(0)
+        c.heartbeat(1)
+        srv = cluster.servers[0]
+        assert srv.dead_workers(timeout=30.0) == []
+        time.sleep(0.3)
+        assert srv.dead_workers(timeout=0.1) == [0, 1]   # silent too long
+        c.heartbeat(0)
+        # generous liveness window for rank 0; rank 1's last beat is pinned
+        # >0.3s in the past, far outside nothing — use a window between
+        # the two so the check is robust on a loaded machine
+        assert srv.dead_workers(timeout=30.0) == []
+        with srv._hb_lock:
+            t0, t1 = srv._heartbeats[0], srv._heartbeats[1]
+        assert t0 > t1                                   # 0 came back
+        c.close()
+
+    def test_monitor_stops_server_when_all_dead(self):
+        cl = _Cluster(n_trainers=1)
+        try:
+            c = cl.client()
+            c.heartbeat(0)
+            srv = cl.servers[0]
+            srv.start_heartbeat_monitor(timeout=0.3, interval=0.1)
+            # trainer goes silent -> monitor flags it and stops the server
+            deadline = time.time() + 5
+            while not srv._stop.is_set() and time.time() < deadline:
+                time.sleep(0.1)
+            assert srv._stop.is_set()
+            assert srv.dead_ranks == {0}
+            c.close()
+        finally:
+            cl.stop()
+
+    def test_heartbeater_thread_keeps_worker_alive(self, cluster):
+        from paddle_tpu.distributed.ps.communicator import HeartBeater
+        c = cluster.client()
+        hb = HeartBeater(c, rank=7, interval=0.1)
+        try:
+            time.sleep(0.5)
+            for srv in cluster.servers:
+                assert srv.dead_workers(timeout=5.0) == []
+                with srv._hb_lock:
+                    assert 7 in srv._heartbeats
+        finally:
+            hb.stop()
+            c.close()
