@@ -4,3 +4,6 @@ from .mesh import (build_mesh, build_data_parallel_mesh, current_mesh,
                    set_current_mesh, register_ring, ring_axes, axis_size,
                    RING_DP, RING_TP, RING_PP, RING_SP, RING_EP)
 from .api import wrap_with_mesh, shard_map_step, param_sharding
+from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
+from .moe import init_moe_params, moe_ffn, top1_routing

@@ -57,6 +57,11 @@ class TransformerConfig:
     remat: bool = True         # jax.checkpoint each layer (recompute analog)
     lr: float = 1e-3
     microbatches: int = 2      # GPipe microbatches per pp stage
+    sp_mode: str = "ring"      # "ring" (O(T/n) memory, ppermute overlap)
+                               # or "ulysses" (all-to-all head re-shard;
+                               # needs the LOCAL head count divisible by
+                               # sp — i.e. (n_heads / tp) % sp == 0, since
+                               # heads are already tp-sharded in _layer)
 
 
 def mesh_axes_for(n_devices: int) -> Dict[str, int]:
@@ -151,7 +156,14 @@ def _layer(x, lp, cfg: TransformerConfig, sp_live: bool, tp_live: bool):
     k = jnp.einsum("btd,dhe->bhte", h, lp["wk"])
     v = jnp.einsum("btd,dhe->bhte", h, lp["wv"])
     if sp_live:
-        a = ring_attention(q, k, v, "sp", causal=cfg.causal)
+        if cfg.sp_mode == "ulysses":
+            from .ulysses import ulysses_attention
+            a = ulysses_attention(q, k, v, "sp", causal=cfg.causal)
+        elif cfg.sp_mode == "ring":
+            a = ring_attention(q, k, v, "sp", causal=cfg.causal)
+        else:
+            raise ValueError(
+                f"unknown sp_mode {cfg.sp_mode!r}: use 'ring' or 'ulysses'")
     else:
         from ..ops.attention import flash_attention
         a = flash_attention(q, k, v, causal=cfg.causal)
