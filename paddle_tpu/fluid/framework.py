@@ -28,8 +28,20 @@ _dtype_aliases = {
 }
 
 
+# framework.proto VarType.Type enum values (framework.proto:104) — cast-op
+# attrs and saved OpDescs carry these ints, not strings
+_PROTO_DTYPE = {0: "bool", 1: "int16", 2: "int32", 3: "int64",
+                4: "float16", 5: "float32", 6: "float64", 20: "uint8",
+                21: "int8", 22: "bfloat16"}
+
+
 def convert_dtype(dtype) -> str:
     """Normalise a user dtype spec to a canonical string name."""
+    if isinstance(dtype, (int, np.integer)) \
+            and not isinstance(dtype, bool) and int(dtype) in _PROTO_DTYPE:
+        # numpy ints must hit this branch too: np.int64(5) would otherwise
+        # fall through to np.dtype() and silently resolve as 'int64'
+        return _PROTO_DTYPE[int(dtype)]
     if isinstance(dtype, str) and dtype in _dtype_aliases:
         return _dtype_aliases[dtype]
     if dtype in _dtype_aliases:

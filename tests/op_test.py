@@ -76,9 +76,11 @@ def check_grad(op_type: str, inputs: Dict, grad_slots: Sequence[str],
     w = np.asarray(np.random.RandomState(0).randn(
         *np.asarray(out0).shape), np.float32)   # randn() is a bare float
 
-    def objective(slot, arr):
+    def objective(slot, idx, arr):
         ins2 = dict(ins)
-        ins2[slot] = [jnp.asarray(arr)] + list(ins[slot][1:])
+        vals = list(ins[slot])
+        vals[idx] = jnp.asarray(arr)
+        ins2[slot] = vals
         o = opdef.fn(ins2, attrs, ctx)[out_slot][0]
         return float(np.sum(np.asarray(o, np.float64) * w))
 
@@ -90,19 +92,25 @@ def check_grad(op_type: str, inputs: Dict, grad_slots: Sequence[str],
     analytic = _generic_grad(g_ins, g_attrs, ctx)
 
     for slot in grad_slots:
-        a = np.asarray(analytic["GI_" + slot][0], np.float64)
-        x0 = np.asarray(ins[slot][0], np.float64)
-        num = np.zeros_like(x0)
-        flat = x0.reshape(-1)
-        nf = num.reshape(-1)
-        for i in range(flat.size):
-            xp = flat.copy()
-            xp[i] += delta
-            xm = flat.copy()
-            xm[i] -= delta
-            fp = objective(slot, xp.reshape(x0.shape).astype(np.float32))
-            fm = objective(slot, xm.reshape(x0.shape).astype(np.float32))
-            nf[i] = (fp - fm) / (2 * delta)
-        np.testing.assert_allclose(
-            a, num, atol=atol, rtol=rtol,
-            err_msg=f"{op_type} grad w.r.t. {slot} mismatch")
+        # EVERY element of a list slot gets its own finite-difference
+        # check — concat/stack-style multi-input ops would otherwise have
+        # untested gradients beyond element 0
+        for idx in range(len(ins[slot])):
+            a = np.asarray(analytic["GI_" + slot][idx], np.float64)
+            x0 = np.asarray(ins[slot][idx], np.float64)
+            num = np.zeros_like(x0)
+            flat = x0.reshape(-1)
+            nf = num.reshape(-1)
+            for i in range(flat.size):
+                xp = flat.copy()
+                xp[i] += delta
+                xm = flat.copy()
+                xm[i] -= delta
+                fp = objective(slot, idx,
+                               xp.reshape(x0.shape).astype(np.float32))
+                fm = objective(slot, idx,
+                               xm.reshape(x0.shape).astype(np.float32))
+                nf[i] = (fp - fm) / (2 * delta)
+            np.testing.assert_allclose(
+                a, num, atol=atol, rtol=rtol,
+                err_msg=f"{op_type} grad w.r.t. {slot}[{idx}] mismatch")

