@@ -35,7 +35,7 @@ from . import inference
 from .hapi import Model
 from .hapi.flops import flops
 from . import jit
-from .dygraph.base import to_variable, no_grad
+from .dygraph.base import to_variable, no_grad, grad
 from .dygraph import save_dygraph as save, load_dygraph as load
 from .dygraph.base import enable_dygraph as disable_static
 from .dygraph.base import disable_dygraph as enable_static

@@ -151,13 +151,15 @@ class ParamBase(VarBase):
 
 
 class _TapeEntry:
-    __slots__ = ("op_type", "ins", "outs", "attrs")
+    __slots__ = ("op_type", "ins", "outs", "attrs", "key")
 
-    def __init__(self, op_type, ins, outs, attrs):
+    def __init__(self, op_type, ins, outs, attrs, key=None):
         self.op_type = op_type
         self.ins = ins          # slot -> [VarBase]
         self.outs = outs        # slot -> [VarBase]
         self.attrs = attrs
+        self.key = key          # fwd RNG base key: backward re-derives the
+                                # SAME stream (dropout masks must match)
 
 
 class Tracer:
@@ -196,7 +198,8 @@ class Tracer:
         ins_arr = {s: [v._value for v in vs] for s, vs in ins_vb.items()}
         if opdef.stateful_rng and "op_seed" not in attrs:
             attrs["op_seed"] = int(np.random.randint(0, 2**31 - 1))
-        outs_arr = opdef.fn(ins_arr, attrs, self._ctx())
+        ctx = self._ctx()
+        outs_arr = opdef.fn(ins_arr, attrs, ctx)
 
         outs_vb: Dict[str, List[VarBase]] = {}
         requires = (not self._no_grad and opdef.differentiable and any(
@@ -205,7 +208,8 @@ class Tracer:
             outs_vb[slot] = [
                 VarBase(a, stop_gradient=not requires) for a in arrs]
         if requires:
-            self._tape.append(_TapeEntry(op_type, ins_vb, outs_vb, attrs))
+            self._tape.append(_TapeEntry(op_type, ins_vb, outs_vb, attrs,
+                                         key=ctx.base_key))
         return outs_vb
 
     def _autocast(self, op_type, ins_vb):
@@ -279,7 +283,13 @@ class Tracer:
             attrs = {"fwd_type": entry.op_type, "fwd_attrs": entry.attrs,
                      "in_slots": list(entry.ins.keys()),
                      "grad_slots": grad_slots}
-            result = _generic_grad(g_ins, attrs, self._ctx())
+            # replay under the entry's OWN forward key: a stateful op's
+            # vjp re-runs the forward, and a fresh key would regenerate a
+            # DIFFERENT dropout mask than the one the forward applied
+            ctx = (LoweringContext(base_key=entry.key,
+                                   is_test=not self._train_mode)
+                   if entry.key is not None else self._ctx())
+            result = _generic_grad(g_ins, attrs, ctx)
             for s in grad_slots:
                 for v, g in zip(entry.ins[s], result.get("GI_" + s, [])):
                     if v.stop_gradient or g is None:
@@ -302,6 +312,176 @@ class Tracer:
             v._grad = g if v._grad is None else v._grad + g
         if not retain_graph:
             self._tape.clear()
+
+
+def _src_root(v):
+    while getattr(v, "_src", None) is not None:
+        v = v._src
+    return v
+
+
+def _tape_replay_fn(tape, inputs, outputs, train_mode):
+    """Build a pure function input_values -> output_values by re-executing
+    the recorded op stream (each entry under its OWN forward RNG key, so
+    dropout masks match the original forward exactly).  A bound input's
+    value always wins over a replayed producer — grads w.r.t. INTERMEDIATE
+    variables would otherwise be silently zero (the producer would clobber
+    the binding and vjp would see a constant function)."""
+    bound = {id(v) for v in inputs}
+
+    def replay(*input_vals):
+        env = {id(v): val for v, val in zip(inputs, input_vals)}
+
+        def look(v):
+            u = v
+            while u is not None:
+                if id(u) in env:
+                    val = env[id(u)]
+                    return (val.astype(v._value.dtype)
+                            if val.dtype != v._value.dtype else val)
+                u = getattr(u, "_src", None)
+            return v._value
+
+        for entry in tape:
+            ins_arr = {s: [look(v) for v in vs]
+                       for s, vs in entry.ins.items()}
+            ctx = LoweringContext(
+                base_key=entry.key if entry.key is not None
+                else jax.random.PRNGKey(0),
+                is_test=not train_mode)
+            outs = get_op(entry.op_type).fn(ins_arr, entry.attrs, ctx)
+            for s, vs in entry.outs.items():
+                for v, a in zip(vs, outs.get(s, [])):
+                    if id(v) not in bound:
+                        env[id(v)] = a
+        return tuple(look(o) for o in outputs)
+
+    return replay
+
+
+def _slice_tape(tape, outputs):
+    """Keep only the entries that are ancestors of the outputs — grad()
+    must not replay the whole session tape (a training loop calling grad
+    each step would otherwise do quadratic total work)."""
+    anc = {id(_src_root(o)) for o in outputs} | {id(o) for o in outputs}
+    keep = []
+    for entry in reversed(tape):
+        if any(id(v) in anc or id(_src_root(v)) in anc
+               for vs in entry.outs.values() for v in vs):
+            keep.append(entry)
+            anc.update(id(v) for vs in entry.ins.values() for v in vs)
+            anc.update(id(_src_root(v))
+                       for vs in entry.ins.values() for v in vs)
+    keep.reverse()
+    return keep
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — the PartialGradEngine analog
+    (imperative/partial_grad_engine.cc): d(outputs)/d(inputs) WITHOUT
+    touching any .grad accumulator.
+
+    TPU-native mechanics: the recorded tape segment is replayed as a pure
+    jax function and differentiated with jax.vjp.  With
+    ``create_graph=True`` the gradient computation is itself recorded as
+    one taped op whose vjp is the second derivative via jax — double
+    backward (gradient penalties) comes from the AD system, not a
+    hand-built double-grad op graph.
+    """
+    tracer = _dygraph_tracer()
+    if tracer is None:
+        raise RuntimeError("paddle.grad() outside dygraph mode")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    no_grad_ids = {id(_src_root(v))
+                   for v in (no_grad_vars or [])}
+    if any(id(_src_root(v)) in no_grad_ids for v in inputs):
+        raise ValueError("a variable cannot be in both inputs and "
+                         "no_grad_vars")
+    tape = _slice_tape(list(tracer._tape), outputs)
+
+    # an input is "used" iff some kept (ancestor-of-output) entry consumed
+    # it — kept entries feed the outputs by construction
+    consumed = {id(_src_root(u))
+                for entry in tape for vs in entry.ins.values() for u in vs}
+    used = [id(_src_root(v)) in consumed or id(v) in
+            {id(w) for entry in tape
+             for vs in entry.outs.values() for w in vs}
+            for v in inputs]
+    if not allow_unused and not all(used):
+        bad = [i for i, u in enumerate(used) if not u]
+        raise RuntimeError(
+            f"inputs at positions {bad} are unreachable from outputs; "
+            f"pass allow_unused=True to get None for them")
+
+    if grad_outputs is None:
+        seeds = [jnp.ones_like(o._value) for o in outputs]
+    else:
+        gos = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+            else [grad_outputs]
+        seeds = [jnp.ones_like(o._value) if g is None else g._value
+                 for o, g in zip(outputs, gos)]
+
+    if create_graph:
+        # every differentiable leaf the tape consumed must ride through the
+        # op as an input — otherwise d(grad)/d(other_param) is silently
+        # zero because the replay baked it in as a constant.  no_grad_vars
+        # stay OUT of the ride-through list: they are frozen constants.
+        produced = {id(v) for entry in tape
+                    for vs in entry.outs.values() for v in vs}
+        seen = {id(v) for v in inputs}
+        params = []
+        for entry in tape:
+            for vs in entry.ins.values():
+                for v in vs:
+                    r = _src_root(v)
+                    # LEAVES only: binding an intermediate would shadow its
+                    # producer in the replay and cut the chain to `inputs`
+                    if (not r.stop_gradient and id(r) not in seen
+                            and id(r) not in produced
+                            and id(r) not in no_grad_ids):
+                        seen.add(id(r))
+                        params.append(r)
+        bind = list(inputs) + params
+        replay = _tape_replay_fn(tape, bind, outputs, tracer._train_mode)
+        outs_vb = tracer.trace_op(
+            "__partial_grad__", {"X": list(inputs), "Params": params},
+            {"Out": [None] * len(inputs)},
+            {"__replay__": replay, "__seeds__": seeds,
+             "__n_inputs__": len(inputs)})["Out"]
+        result = list(outs_vb)
+    else:
+        replay = _tape_replay_fn(tape, inputs, outputs, tracer._train_mode)
+        _, vjp = jax.vjp(replay, *[v._value for v in inputs])
+        gs = vjp(tuple(seeds))
+        result = [VarBase(g, stop_gradient=True) for g in gs]
+
+    # reference default: retain_graph = create_graph — the graph is freed
+    # after a plain grad() call, so per-step grad() loops stay O(step)
+    if retain_graph is None:
+        retain_graph = create_graph
+    if not retain_graph:
+        tracer._tape.clear()
+    return [r if u else None for r, u in zip(result, used)] \
+        if allow_unused else result
+
+
+def _register_partial_grad_op():
+    from ..ops.registry import register_op
+
+    @register_op("__partial_grad__", differentiable=True)
+    def _partial_grad(ins, attrs, ctx):
+        replay = attrs["__replay__"]
+        seeds = attrs["__seeds__"]
+        n = attrs.get("__n_inputs__", len(ins["X"]))
+        bind_vals = list(ins["X"]) + list(ins.get("Params", []))
+        _, vjp = jax.vjp(replay, *bind_vals)
+        return {"Out": list(vjp(tuple(seeds)))[:n]}
+
+
+_register_partial_grad_op()
 
 
 def materialize_initializer(init, shape, dtype, key):

@@ -1,0 +1,126 @@
+"""paddle.grad (PartialGradEngine analog) + eager-backward RNG
+consistency.  Reference: imperative/partial_grad_engine.cc, paddle.grad
+with create_graph for double backward (gradient penalties)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.fluid import layers as L
+
+
+@pytest.fixture(autouse=True)
+def dygraph_mode():
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+class TestEagerDropoutConsistency:
+    def test_backward_mask_matches_forward(self):
+        x = dybase.to_variable(np.ones((4, 64), "float32"))
+        x.stop_gradient = False
+        y = L.dropout(x, dropout_prob=0.5)
+        L.reduce_sum(y).backward()
+        out = np.asarray(y._value)
+        g = np.asarray(x.grad)
+        assert ((out != 0) == (g != 0)).all()
+
+
+class TestPartialGrad:
+    def test_first_order_matches_analytic(self):
+        x = dybase.to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(L.square(x))          # dy/dx = 2x
+        (gx,) = paddle.grad([y], [x])
+        np.testing.assert_allclose(np.asarray(gx._value),
+                                   2 * np.asarray(x._value), rtol=1e-6)
+        assert x.grad is None                  # accumulators untouched
+
+    def test_grad_outputs_seed(self):
+        x = dybase.to_variable(np.ones((2, 2), "float32"))
+        x.stop_gradient = False
+        y = L.scale(x, scale=3.0)
+        seed = dybase.to_variable(np.full((2, 2), 2.0, "float32"))
+        (gx,) = paddle.grad([y], [x], grad_outputs=[seed])
+        np.testing.assert_allclose(np.asarray(gx._value), 6.0)
+
+    def test_unused_input_raises_unless_allowed(self):
+        x = dybase.to_variable(np.ones((2,), "float32"))
+        z = dybase.to_variable(np.ones((2,), "float32"))
+        x.stop_gradient = False
+        z.stop_gradient = False
+        y = L.reduce_sum(L.square(x))
+        with pytest.raises(RuntimeError, match="unreachable"):
+            paddle.grad([y], [x, z])
+        gx, gz = paddle.grad([y], [x, z], allow_unused=True)
+        assert gz is None
+        np.testing.assert_allclose(np.asarray(gx._value), 2.0)
+
+    def test_double_backward_gradient_penalty(self):
+        """create_graph=True: ||dy/dx||^2 is differentiable again —
+        d/dx sum((2x)^2) = 8x."""
+        x = dybase.to_variable(np.array([[1.0, -2.0]], "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(L.square(x))
+        (gx,) = paddle.grad([y], [x], create_graph=True)
+        penalty = L.reduce_sum(L.square(gx))
+        (ggx,) = paddle.grad([penalty], [x])
+        np.testing.assert_allclose(np.asarray(ggx._value),
+                                   8 * np.asarray(x._value), rtol=1e-6)
+
+    def test_double_backward_via_backward(self):
+        """create_graph grads also flow through plain .backward()."""
+        x = dybase.to_variable(np.array([2.0], "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(x * x * x)            # y = x^3
+        (gx,) = paddle.grad([y], [x], create_graph=True)   # 3x^2
+        L.reduce_sum(L.square(gx)).backward()  # d/dx (3x^2)^2 = 36x^3
+        np.testing.assert_allclose(np.asarray(x.grad), 36 * 8.0, rtol=1e-5)
+
+    def test_penalty_gradient_flows_to_other_params(self):
+        """WGAN-GP shape: d(||df/dx||^2)/dw must be nonzero — params other
+        than the grad() inputs ride through the taped partial-grad op."""
+        w = dybase.to_variable(np.array([[2.0], [3.0]], "float32"))
+        w.stop_gradient = False
+        x = dybase.to_variable(np.ones((4, 2), "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(L.matmul(x, w))
+        (gx,) = paddle.grad([y], [x], create_graph=True)   # = w^T rows
+        penalty = L.reduce_mean(L.square(gx))
+        penalty.backward()
+        gw = np.asarray(w.gradient_var)
+        # penalty = mean over 4 rows of (w0^2 + w1^2) -> d/dw = 2w * (2/2)?
+        # per-row grad is [w0, w1]; mean of squares over 8 elems = ||w||^2/2
+        np.testing.assert_allclose(gw, np.asarray(w._value), rtol=1e-5)
+
+    def test_grad_wrt_intermediate(self):
+        """Non-leaf inputs: grad of y=h^2 wrt h=square(x) is 2h, not 0
+        (a replayed producer must not clobber the input binding)."""
+        x = dybase.to_variable(np.array([2.0], "float32"))
+        x.stop_gradient = False
+        h = L.square(x)                  # h = 4
+        y = L.reduce_sum(L.square(h))    # y = h^2
+        (gh,) = paddle.grad([y], [h], retain_graph=True)
+        np.testing.assert_allclose(np.asarray(gh._value), 8.0, rtol=1e-6)
+
+    def test_no_grad_vars_frozen(self):
+        w = dybase.to_variable(np.array([[3.0]], "float32"))
+        w.stop_gradient = False
+        x = dybase.to_variable(np.ones((2, 1), "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(L.matmul(x, w))
+        (gx,) = paddle.grad([y], [x], create_graph=True, no_grad_vars=[w])
+        L.reduce_sum(L.square(gx)).backward()
+        assert w.gradient_var is None      # frozen: nothing flows to w
+        with pytest.raises(ValueError, match="no_grad_vars"):
+            paddle.grad([y], [x], no_grad_vars=[x])
+
+    def test_default_frees_graph(self):
+        tracer = dybase._dygraph_tracer()
+        x = dybase.to_variable(np.ones((2,), "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(L.square(x))
+        assert len(tracer._tape) > 0
+        paddle.grad([y], [x])              # retain_graph defaults to False
+        assert len(tracer._tape) == 0
