@@ -320,14 +320,17 @@ def _src_root(v):
     return v
 
 
-def _tape_replay_fn(tape, inputs, outputs, train_mode):
+def _tape_replay_fn(tape, inputs, outputs, train_mode, no_grad_ids=()):
     """Build a pure function input_values -> output_values by re-executing
     the recorded op stream (each entry under its OWN forward RNG key, so
     dropout masks match the original forward exactly).  A bound input's
     value always wins over a replayed producer — grads w.r.t. INTERMEDIATE
     variables would otherwise be silently zero (the producer would clobber
-    the binding and vjp would see a constant function)."""
+    the binding and vjp would see a constant function).  Values whose root
+    is in `no_grad_ids` are wrapped in stop_gradient — the reference
+    PartialGradEngine treats no_grad_vars as constants even mid-graph."""
     bound = {id(v) for v in inputs}
+    no_grad_ids = set(no_grad_ids)
 
     def replay(*input_vals):
         env = {id(v): val for v, val in zip(inputs, input_vals)}
@@ -352,8 +355,12 @@ def _tape_replay_fn(tape, inputs, outputs, train_mode):
             outs = get_op(entry.op_type).fn(ins_arr, entry.attrs, ctx)
             for s, vs in entry.outs.items():
                 for v, a in zip(vs, outs.get(s, [])):
-                    if id(v) not in bound:
-                        env[id(v)] = a
+                    if id(v) in bound:
+                        continue
+                    if (id(v) in no_grad_ids
+                            or id(_src_root(v)) in no_grad_ids):
+                        a = jax.lax.stop_gradient(a)
+                    env[id(v)] = a
         return tuple(look(o) for o in outputs)
 
     return replay
@@ -406,9 +413,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     # it — kept entries feed the outputs by construction
     consumed = {id(_src_root(u))
                 for entry in tape for vs in entry.ins.values() for u in vs}
-    used = [id(_src_root(v)) in consumed or id(v) in
-            {id(w) for entry in tape
-             for vs in entry.outs.values() for w in vs}
+    produced_out = {id(w) for entry in tape
+                    for vs in entry.outs.values() for w in vs}
+    used = [id(_src_root(v)) in consumed or id(v) in produced_out
             for v in inputs]
     if not allow_unused and not all(used):
         bad = [i for i, u in enumerate(used) if not u]
@@ -421,6 +428,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     else:
         gos = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
             else [grad_outputs]
+        if len(gos) != len(outputs):
+            raise ValueError(
+                f"grad_outputs has {len(gos)} entries but outputs has "
+                f"{len(outputs)} — lengths must match")
         seeds = [jnp.ones_like(o._value) if g is None else g._value
                  for o, g in zip(outputs, gos)]
 
@@ -445,7 +456,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                         seen.add(id(r))
                         params.append(r)
         bind = list(inputs) + params
-        replay = _tape_replay_fn(tape, bind, outputs, tracer._train_mode)
+        replay = _tape_replay_fn(tape, bind, outputs, tracer._train_mode,
+                                 no_grad_ids)
         outs_vb = tracer.trace_op(
             "__partial_grad__", {"X": list(inputs), "Params": params},
             {"Out": [None] * len(inputs)},
@@ -453,17 +465,20 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
              "__n_inputs__": len(inputs)})["Out"]
         result = list(outs_vb)
     else:
-        replay = _tape_replay_fn(tape, inputs, outputs, tracer._train_mode)
+        replay = _tape_replay_fn(tape, inputs, outputs, tracer._train_mode,
+                                 no_grad_ids)
         _, vjp = jax.vjp(replay, *[v._value for v in inputs])
         gs = vjp(tuple(seeds))
         result = [VarBase(g, stop_gradient=True) for g in gs]
 
-    # reference default: retain_graph = create_graph — the graph is freed
-    # after a plain grad() call, so per-step grad() loops stay O(step)
+    # reference default: retain_graph = create_graph.  Free ONLY the
+    # entries this call replayed — unrelated graphs recorded on the same
+    # tape (and the __partial_grad__ entry appended above) must survive.
     if retain_graph is None:
         retain_graph = create_graph
     if not retain_graph:
-        tracer._tape.clear()
+        dead = {id(e) for e in tape}
+        tracer._tape = [e for e in tracer._tape if id(e) not in dead]
     return [r if u else None for r, u in zip(result, used)] \
         if allow_unused else result
 

@@ -300,7 +300,54 @@ def _auc(ins, attrs, ctx):
 
 @register_op("precision_recall", differentiable=False)
 def _precision_recall(ins, attrs, ctx):
-    raise NotImplementedError("precision_recall: use python metrics instead")
+    """precision_recall_op.cc: per-class TP/FP/TN/FN from (argmax Indices,
+    Labels[, Weights]) -> [macro-P, macro-R, macro-F1, micro-P, micro-R,
+    micro-F1] for the batch and for the running accumulated states."""
+    idx = ins["Indices"][0].astype(jnp.int32).reshape(-1)
+    label = ins["Labels"][0].astype(jnp.int32).reshape(-1)
+    n_cls = int(attrs["class_number"])
+    w = (ins["Weights"][0].astype(jnp.float32).reshape(-1)
+         if ins.get("Weights") else jnp.ones_like(idx, jnp.float32))
+
+    pred_1h = jax.nn.one_hot(idx, n_cls, dtype=jnp.float32)
+    true_1h = jax.nn.one_hot(label, n_cls, dtype=jnp.float32)
+    hit = (idx == label).astype(jnp.float32) * w
+    tp = jnp.einsum("n,nc->c", hit, true_1h)
+    fp = jnp.einsum("n,nc->c", w, pred_1h) - tp
+    fn = jnp.einsum("n,nc->c", w, true_1h) - tp
+    total = jnp.sum(w)
+    tn = total - tp - fp - fn
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)       # [C, 4]
+
+    accum_states = batch_states
+    if ins.get("StatesInfo"):
+        accum_states = accum_states + ins["StatesInfo"][0].astype(
+            jnp.float32)
+
+    def metrics(states):
+        # reference precision_recall_op.h semantics: a class with an empty
+        # denominator contributes P/R = 1.0 (CalcPrecision/CalcRecall), and
+        # macro F1 is F1 OF the macro-averaged P and R (:161), not the mean
+        # of per-class F1s
+        tp_, fp_, _tn, fn_ = (states[:, 0], states[:, 1], states[:, 2],
+                              states[:, 3])
+        p = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 1.0)
+        r = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 1.0)
+
+        def f1_of(pp, rr):
+            return jnp.where(pp + rr > 0, 2 * pp * rr / (pp + rr + 1e-12),
+                             0.0)
+
+        macro_p, macro_r = p.mean(), r.mean()
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(tps + fps > 0, tps / (tps + fps + 1e-12), 1.0)
+        mr = jnp.where(tps + fns > 0, tps / (tps + fns + 1e-12), 1.0)
+        return jnp.stack([macro_p, macro_r, f1_of(macro_p, macro_r),
+                          mp, mr, f1_of(mp, mr)])
+
+    return {"BatchMetrics": [metrics(batch_states)],
+            "AccumMetrics": [metrics(accum_states)],
+            "AccumStatesInfo": [accum_states]}
 
 
 @register_op("mean_iou", differentiable=False)

@@ -368,11 +368,6 @@ def _maxout(ins, attrs, ctx):
     return {"Out": [x.reshape(n, c // g, g, h, w).max(axis=2)]}
 
 
-@register_op("interpolate_nearest")
-def _interp_nearest(ins, attrs, ctx):
-    raise NotImplementedError
-
-
 def _interp(ins, attrs, ctx, method):
     x = _x(ins)
     n, c, h, w = x.shape

@@ -124,3 +124,43 @@ class TestPartialGrad:
         assert len(tracer._tape) > 0
         paddle.grad([y], [x])              # retain_graph defaults to False
         assert len(tracer._tape) == 0
+
+    def test_plain_grad_preserves_unrelated_graphs(self):
+        a = dybase.to_variable(np.ones((2,), "float32"))
+        a.stop_gradient = False
+        x = dybase.to_variable(np.ones((2,), "float32"))
+        x.stop_gradient = False
+        y1 = L.reduce_sum(L.square(a))
+        y2 = L.reduce_sum(L.square(x))
+        paddle.grad([y2], [x])            # frees ONLY y2's subgraph
+        y1.backward()
+        np.testing.assert_allclose(np.asarray(a.grad), 2.0)
+
+    def test_create_graph_with_free_keeps_partial_grad_entry(self):
+        x = dybase.to_variable(np.array([2.0], "float32"))
+        x.stop_gradient = False
+        y = L.reduce_sum(L.square(x))
+        (gx,) = paddle.grad([y], [x], create_graph=True, retain_graph=False)
+        L.reduce_sum(L.square(gx)).backward()   # d/dx (2x)^2 = 8x
+        np.testing.assert_allclose(np.asarray(x.grad), 16.0, rtol=1e-5)
+
+    def test_no_grad_vars_blocks_intermediate(self):
+        """Freezing an INTERMEDIATE stops the chain through it."""
+        x = dybase.to_variable(np.array([2.0], "float32"))
+        x.stop_gradient = False
+        u = L.square(x)
+        y = L.reduce_sum(L.square(u))
+        (gx,) = paddle.grad([y], [x], no_grad_vars=[u], allow_unused=True,
+                            retain_graph=True)
+        np.testing.assert_allclose(np.asarray(gx._value), 0.0)
+        (gx2,) = paddle.grad([y], [x])    # unfrozen: full chain 4x^3
+        np.testing.assert_allclose(np.asarray(gx2._value), 32.0, rtol=1e-5)
+
+    def test_grad_outputs_length_mismatch_rejected(self):
+        x = dybase.to_variable(np.ones((2,), "float32"))
+        x.stop_gradient = False
+        y1 = L.reduce_sum(L.square(x))
+        y2 = L.reduce_sum(x)
+        seed = dybase.to_variable(np.ones((), "float32"))
+        with pytest.raises(ValueError, match="lengths must match"):
+            paddle.grad([y1, y2], [x], grad_outputs=[seed])

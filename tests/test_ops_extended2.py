@@ -264,3 +264,56 @@ class TestSequenceExtra:
                                 {"X": x, "Y": y})["Out"][0])
         assert out.shape == (2, 4, 3)
         np.testing.assert_allclose(out[:, 0], x)
+
+
+class TestPrecisionRecall:
+    def test_batch_and_accum_metrics(self):
+        import numpy as np
+        from tests.op_test import run_op
+        # 3 classes; preds [0,1,2,0], labels [0,2,2,1]
+        idx = np.array([0, 1, 2, 0], "int64").reshape(-1, 1)
+        lbl = np.array([0, 2, 2, 1], "int64").reshape(-1, 1)
+        out = run_op("precision_recall",
+                     {"Indices": [idx], "Labels": [lbl],
+                      "MaxProbs": [np.ones((4, 1), "float32")]},
+                     {"class_number": 3})
+        bm = np.asarray(out["BatchMetrics"][0])
+        states = np.asarray(out["AccumStatesInfo"][0])
+        # class 0: TP=1 FP=1 FN=0; class 1: TP=0 FP=1 FN=1; class 2: TP=1 FP=0 FN=1
+        np.testing.assert_allclose(states[:, 0], [1, 0, 1])   # TP
+        np.testing.assert_allclose(states[:, 1], [1, 1, 0])   # FP
+        np.testing.assert_allclose(states[:, 3], [0, 1, 1])   # FN
+        # micro precision = recall = 2/4
+        np.testing.assert_allclose(bm[3], 0.5, rtol=1e-5)
+        np.testing.assert_allclose(bm[4], 0.5, rtol=1e-5)
+        # macro precision = mean(1/2, 0, 1) = 0.5
+        np.testing.assert_allclose(bm[0], 0.5, rtol=1e-5)
+
+    def test_states_accumulate(self):
+        import numpy as np
+        from tests.op_test import run_op
+        idx = np.array([1], "int64").reshape(-1, 1)
+        lbl = np.array([1], "int64").reshape(-1, 1)
+        prev = np.zeros((2, 4), "float32")
+        prev[1, 0] = 5.0                       # 5 prior TPs for class 1
+        out = run_op("precision_recall",
+                     {"Indices": [idx], "Labels": [lbl],
+                      "MaxProbs": [np.ones((1, 1), "float32")],
+                      "StatesInfo": [prev]},
+                     {"class_number": 2})
+        acc = np.asarray(out["AccumStatesInfo"][0])
+        np.testing.assert_allclose(acc[1, 0], 6.0)
+
+    def test_untouched_class_counts_as_perfect(self):
+        """Reference CalcPrecision/CalcRecall: empty denominator -> 1.0,
+        so a class absent from the batch doesn't drag macro metrics."""
+        import numpy as np
+        from tests.op_test import run_op
+        idx = np.array([0, 1], "int64").reshape(-1, 1)
+        lbl = np.array([0, 1], "int64").reshape(-1, 1)
+        out = run_op("precision_recall",
+                     {"Indices": [idx], "Labels": [lbl],
+                      "MaxProbs": [np.ones((2, 1), "float32")]},
+                     {"class_number": 3})
+        bm = np.asarray(out["BatchMetrics"][0])
+        np.testing.assert_allclose(bm, 1.0, rtol=1e-6)   # all perfect
