@@ -68,42 +68,184 @@ class ModelCheckpoint(Callback):
             self.model.save(f"{self.save_dir}/{epoch}")
 
 
+class LRScheduler(Callback):
+    """Step the optimizer's LRScheduler during training
+    (hapi/callbacks.py:595).  by_step steps every batch, else per epoch."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step and not by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        sched = getattr(opt, "_lr", None)
+        return sched if hasattr(sched, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if s is not None and self.by_step:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if s is not None and not self.by_step:
+            s.step()
+
+
 class EarlyStopping(Callback):
-    def __init__(self, monitor="loss", patience=0, mode="min",
-                 min_delta=0, baseline=None):
+    """Stop when a monitored metric stops improving
+    (hapi/callbacks.py:685)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0,
+                 verbose=1, min_delta=0, baseline=None,
+                 save_best_model=True, save_dir=None):
         self.monitor = monitor
         self.patience = patience
-        self.mode = mode
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+        if mode == "max" or (mode == "auto" and ("acc" in monitor
+                                                 or "auc" in monitor)):
+            self.greater = True
+        else:
+            self.greater = False
         self.best = None
         self.wait = 0
         self.stopped_epoch = 0
 
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        return (value > self.best + self.min_delta if self.greater
+                else value < self.best - self.min_delta)
+
+    def on_train_begin(self, logs=None):
+        self.best = self.baseline
+        self.wait = 0
+
     def on_epoch_end(self, epoch, logs=None):
-        v = (logs or {}).get(self.monitor)
-        if v is None:
+        value = (logs or {}).get(self.monitor)
+        if value is None:
             return
-        better = self.best is None or (v < self.best if self.mode == "min"
-                                       else v > self.best)
-        if better:
-            self.best = v
+        if self._improved(value):
+            self.best = value
             self.wait = 0
+            if self.save_best_model and self.save_dir:
+                self.model.save(f"{self.save_dir}/best_model")
         else:
             self.wait += 1
+            if self.wait > self.patience:
+                self.stopped_epoch = epoch
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {epoch}: early stopping "
+                          f"(best {self.monitor}={self.best})")
 
 
-class LRScheduler(Callback):
-    def __init__(self, by_step=True, by_epoch=False):
-        self.by_step = by_step
-        self.by_epoch = by_epoch
+class ReduceLROnPlateau(Callback):
+    """Shrink the LR when a metric plateaus (hapi/callbacks.py:951)."""
 
-    def on_train_batch_end(self, step, logs=None):
-        if self.by_step and hasattr(self.model._optimizer, "_lr"):
-            lr = self.model._optimizer._lr
-            if hasattr(lr, "step"):
-                lr.step()
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.greater = mode == "max" or (mode == "auto"
+                                         and ("acc" in monitor
+                                              or "auc" in monitor))
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        return (value > self.best + self.min_delta if self.greater
+                else value < self.best - self.min_delta)
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.by_epoch and hasattr(self.model._optimizer, "_lr"):
-            lr = self.model._optimizer._lr
-            if hasattr(lr, "step"):
-                lr.step()
+        value = (logs or {}).get(self.monitor)
+        opt = getattr(self.model, "_optimizer", None)
+        if value is None or opt is None:
+            return
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+            return
+        if self.cooldown_counter > 0:
+            # inside the cooldown window nothing counts toward patience
+            # and no further reduction may fire
+            self.cooldown_counter -= 1
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            from ..optimizer import lr as lrmod
+            if isinstance(getattr(opt, "_lr", None), lrmod.LRScheduler):
+                if self.verbose:
+                    print("ReduceLROnPlateau: optimizer lr is scheduler-"
+                          "driven; skipping reduction")
+                return
+            old = float(opt.get_lr())
+            new = max(old * self.factor, self.min_lr)
+            if old - new > 1e-12:
+                opt.set_lr(new)
+                if self.verbose:
+                    print(f"Epoch {epoch}: reducing lr to {new:.6g}")
+            self.cooldown_counter = self.cooldown
+            self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (hapi/callbacks.py:836).  The visualization
+    service is out of scope on this stack; scalars append to
+    <log_dir>/scalars.jsonl — one JSON record per metric per step/epoch —
+    readable by any dashboard."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        self.log_dir = log_dir
+        self._step = 0
+        self._fh = None
+
+    def on_train_begin(self, logs=None):
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def _write(self, tag, value, step):
+        import json
+        import os
+        if self._fh is None:                  # used outside fit(): degrade
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"),
+                            "a")
+        self._fh.write(json.dumps({"tag": tag, "value": float(value),
+                                   "step": int(step)}) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"train/{k}", np.ravel(v)[0], self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"epoch/{k}", np.ravel(v)[0], epoch)
+            except (TypeError, ValueError):
+                pass

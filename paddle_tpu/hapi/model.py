@@ -92,6 +92,7 @@ class Model:
         cbs.set_model(self)
         cbs.on_train_begin()
         history = []
+        self.stop_training = False          # EarlyStopping contract
         for epoch in range(epochs):
             cbs.on_epoch_begin(epoch)
             losses = []
@@ -108,6 +109,8 @@ class Model:
             cbs.on_epoch_end(epoch, logs)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
+            if self.stop_training:
+                break
         cbs.on_train_end()
         return history
 

@@ -32,6 +32,13 @@ class _EagerOptimizer:
         return float(self._lr)
 
     def set_lr(self, v):
+        if isinstance(self._lr, lr.LRScheduler):
+            # reference Optimizer.set_lr raises when the lr is scheduler-
+            # driven — silently replacing the scheduler with a float would
+            # freeze the schedule for the rest of training
+            raise RuntimeError(
+                "cannot set_lr on a scheduler-driven optimizer; adjust the "
+                "LRScheduler instead")
         self._lr = v
 
     def _accs(self, p, names_and_init):

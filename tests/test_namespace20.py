@@ -147,3 +147,133 @@ class TestTextDatasets:
         ds = Movielens(size=16)
         row = ds[0]
         assert 1.0 <= row[-1] <= 5.0 and len(row) == 8
+
+
+class TestCallbacks:
+    """hapi callbacks beyond ProgBar/Checkpoint: LRScheduler,
+    EarlyStopping, ReduceLROnPlateau, VisualDL scalars."""
+
+    @pytest.fixture(autouse=True)
+    def _dygraph(self):
+        from paddle_tpu.dygraph import base as dybase
+        dybase.enable_dygraph()
+        yield
+        dybase.disable_dygraph()
+
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.nn import Linear
+        dybase.enable_dygraph()
+        net = Linear(4, 1)
+        model = paddle.Model(net)
+        return model, net
+
+    def test_early_stopping_stops_fit(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import callbacks as C
+        from paddle_tpu import optimizer as opt
+        model, net = self._model()
+        model.prepare(optimizer=opt.SGD(0.0, parameters=net.parameters()),
+                      loss=lambda p, y: paddle.fluid.layers.reduce_mean(
+                          paddle.fluid.layers.square(p - y)))
+        xs = np.random.RandomState(0).randn(16, 4).astype("float32")
+        ys = np.zeros((16, 1), "float32")
+        # lr=0 -> loss constant -> no improvement -> stops after patience+1
+        hist = model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=8,
+                         epochs=10, verbose=0,
+                         callbacks=[C.EarlyStopping(monitor="loss",
+                                                    patience=1, verbose=0,
+                                                    min_delta=1.0)])
+        # any sub-1.0 drift counts as no improvement -> stop at patience+2
+        assert len(hist) <= 4                  # stopped long before 10
+
+    def test_reduce_lr_on_plateau(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import callbacks as C
+        from paddle_tpu import optimizer as opt
+        model, net = self._model()
+        o = opt.SGD(0.5, parameters=net.parameters())
+        model.prepare(optimizer=o,
+                      loss=lambda p, y: paddle.fluid.layers.reduce_mean(
+                          paddle.fluid.layers.square(p - y)))
+        o.set_lr(0.0)                          # freeze so loss plateaus
+        xs = np.random.RandomState(0).randn(8, 4).astype("float32")
+        ys = np.zeros((8, 1), "float32")
+        model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=8,
+                  epochs=6, verbose=0,
+                  callbacks=[C.ReduceLROnPlateau(monitor="loss",
+                                                 factor=0.5, patience=0,
+                                                 verbose=0)])
+        assert float(o.get_lr()) < 0.5
+
+    def test_lr_scheduler_callback_steps(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import callbacks as C
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.optimizer import lr as lrmod
+        model, net = self._model()
+        sched = lrmod.StepDecay(learning_rate=1.0, step_size=1, gamma=0.5)
+        o = opt.SGD(sched, parameters=net.parameters())
+        model.prepare(optimizer=o,
+                      loss=lambda p, y: paddle.fluid.layers.reduce_mean(
+                          paddle.fluid.layers.square(p - y)))
+        xs = np.random.RandomState(0).randn(8, 4).astype("float32")
+        ys = np.zeros((8, 1), "float32")
+        lr0 = float(o.get_lr())
+        model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=4,
+                  epochs=1, verbose=0,
+                  callbacks=[C.LRScheduler(by_step=True)])
+        assert float(o.get_lr()) < lr0         # stepped during the epoch
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        import json
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import callbacks as C
+        from paddle_tpu import optimizer as opt
+        model, net = self._model()
+        model.prepare(optimizer=opt.SGD(0.1, parameters=net.parameters()),
+                      loss=lambda p, y: paddle.fluid.layers.reduce_mean(
+                          paddle.fluid.layers.square(p - y)))
+        xs = np.random.RandomState(0).randn(8, 4).astype("float32")
+        ys = np.zeros((8, 1), "float32")
+        d = str(tmp_path / "vdl")
+        model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=4, epochs=2,
+                  verbose=0, callbacks=[C.VisualDL(log_dir=d)])
+        lines = open(f"{d}/scalars.jsonl").read().splitlines()
+        recs = [json.loads(l) for l in lines]
+        assert any(r["tag"] == "epoch/loss" for r in recs)
+        assert any(r["tag"].startswith("train/") for r in recs)
+
+    def test_reduce_lr_cooldown_suppresses_reductions(self):
+        from paddle_tpu.hapi import callbacks as C
+
+        class FakeOpt:
+            def __init__(self): self._lr = 1.0
+            def get_lr(self): return self._lr
+            def set_lr(self, v): self._lr = v
+
+        class FakeModel:
+            pass
+
+        cb = C.ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0,
+                                 cooldown=3, verbose=0)
+        m = FakeModel(); m._optimizer = FakeOpt()
+        cb.set_model(m)
+        for epoch in range(6):                # constant loss: plateau
+            cb.on_epoch_end(epoch, {"loss": 1.0})
+        # epoch0 sets best; epoch1 reduces (1.0->0.5); epochs 2-4 cooldown;
+        # epoch5 reduces again (0.5->0.25).  Without cooldown it would be
+        # halved every epoch down to 0.03125.
+        assert abs(m._optimizer.get_lr() - 0.25) < 1e-9
+
+    def test_set_lr_rejected_on_scheduler(self):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.optimizer import lr as lrmod
+        o = opt.SGD(lrmod.StepDecay(learning_rate=1.0, step_size=1))
+        with pytest.raises(RuntimeError, match="scheduler"):
+            o.set_lr(0.1)
