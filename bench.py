@@ -178,6 +178,127 @@ def main_resnet():
            ips * resnet50_flops_per_image(image), backend)
 
 
+def main_nmt():
+    """Transformer NMT dygraph training step (BASELINE config #4)."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.functional import functional_loss
+    from paddle_tpu.models.transformer import TransformerModel
+    from paddle_tpu.fluid import layers as L
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    quick = "--quick" in sys.argv
+    backend = jax.default_backend()
+    if quick or backend == "cpu":
+        vocab, d_model, heads, layers_n, ffn = 500, 64, 2, 2, 128
+        seq, batch, steps, warmup = 16, 4, 3, 1
+    else:
+        # Transformer-big-ish at trainable single-chip scale
+        vocab, d_model, heads, layers_n, ffn = 32000, 1024, 16, 6, 4096
+        seq, batch, steps, warmup = 64, 32, 20, 3
+
+    dybase.enable_dygraph()
+    tracer = dybase._dygraph_tracer()
+    tracer._amp_enabled = True
+    model = TransformerModel(src_vocab=vocab, tgt_vocab=vocab,
+                             d_model=d_model, nhead=heads,
+                             num_encoder_layers=layers_n,
+                             num_decoder_layers=layers_n,
+                             dim_feedforward=ffn, dropout=0.1,
+                             max_len=seq + 1)
+    model.train()
+
+    def loss_fn(src, tgt_in, tgt_out):
+        logits = model(src, tgt_in)
+        return L.mean(L.softmax_with_cross_entropy(
+            L.reshape(logits, [-1, vocab]), L.reshape(tgt_out, [-1, 1])))
+
+    values, lfn = functional_loss(model, loss_fn)
+    jg = jax.jit(jax.value_and_grad(lfn))
+    state = {"v": values}
+    rng = np.random.RandomState(0)
+    src = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int64"))
+    tin = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int64"))
+    tout = jnp.asarray(rng.randint(0, vocab, (batch, seq)).astype("int64"))
+
+    def one_step():
+        loss, grads = jg(state["v"], src, tin, tout)
+        state["v"] = [v - 1e-4 * g for v, g in zip(state["v"], grads)]
+        return loss
+
+    dt = timed_run(one_step, steps, warmup)
+    tok_s = steps * batch * seq / dt
+    # per-token fwd matmul flops.  Encoder layer: qkvo (4 d^2 MACs) + MLP;
+    # decoder layer: self-attn qkvo + CROSS-attn qkvo (8 d^2) + MLP; score/
+    # context matmuls (2*2*seq*d) count PER attention, per layer.
+    d2 = d_model * d_model
+    enc_layer = 2 * (4 * d2 + 2 * d_model * ffn) + 2 * 2 * seq * d_model
+    dec_layer = (2 * (8 * d2 + 2 * d_model * ffn)
+                 + 2 * (2 * 2 * seq * d_model))
+    head = 2 * d_model * vocab
+    fwd = layers_n * (enc_layer + dec_layer) + head
+    report("transformer_nmt_train_throughput", "tokens/sec/chip",
+           tok_s, tok_s * 3 * fwd, backend)
+
+
+def main_ctr():
+    """Wide&Deep CTR training throughput (BASELINE config #5) — embedding
+    gather + dense step on one chip; examples/sec is the metric (CTR is
+    lookup-bound, MFU is not meaningful)."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.functional import functional_loss
+    from paddle_tpu.models.ctr import WideDeep
+    from paddle_tpu.fluid import layers as L
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    quick = "--quick" in sys.argv
+    backend = jax.default_backend()
+    if quick or backend == "cpu":
+        slots, vocab, dim, batch, steps, warmup = 6, 1000, 8, 64, 3, 1
+    else:
+        slots, vocab, dim, batch, steps, warmup = 26, 100000, 16, 4096, 20, 3
+
+    dybase.enable_dygraph()
+    model = WideDeep(num_slots=slots, vocab_per_slot=vocab, embed_dim=dim)
+    model.train()
+
+    def loss_fn(ids, dense, label):
+        prob = model(ids, dense)               # WideDeep emits probabilities
+        eps = 1e-7
+        prob = L.clip(prob, eps, 1.0 - eps)
+        return L.mean(-(label * L.log(prob)
+                        + (1.0 - label) * L.log(1.0 - prob)))
+
+    values, lfn = functional_loss(model, loss_fn)
+    jg = jax.jit(jax.value_and_grad(lfn))
+    state = {"v": values}
+    rng = np.random.RandomState(0)
+    # pre-offset ids into each slot's vocab range (the model contract)
+    base = np.arange(slots, dtype="int64")[None, :] * vocab
+    ids = jnp.asarray(rng.randint(0, vocab, (batch, slots)) + base)
+    dense = jnp.asarray(rng.randn(batch, 13).astype("float32"))
+    label = jnp.asarray(rng.randint(0, 2, (batch, 1)).astype("float32"))
+
+    def one_step():
+        loss, grads = jg(state["v"], ids, dense, label)
+        state["v"] = [v - 1e-3 * g for v, g in zip(state["v"], grads)]
+        return loss
+
+    dt = timed_run(one_step, steps, warmup)
+    ex_s = steps * batch / dt
+    print(json.dumps({
+        "metric": "wide_deep_ctr_train_throughput", "value": round(ex_s, 1),
+        "unit": "examples/sec/chip", "vs_baseline": 0.0, "backend": backend,
+    }))
+
+
 def supervise():
     """The axon TPU plugin is flaky at init — it can raise UNAVAILABLE *or
     hang forever*, and a hang can strike any in-process jax call.  So the
@@ -209,14 +330,18 @@ def supervise():
                   f"{r.stderr.strip()[-500:]}", file=sys.stderr)
         except subprocess.TimeoutExpired:
             print(f"# child({label}) hung >{budget}s", file=sys.stderr)
-    resnet = "--model" in sys.argv and "resnet50" in sys.argv
-    print(json.dumps({
-        "metric": ("resnet50_train_throughput" if resnet
-                   else "bert_base_pretrain_throughput"),
-        "value": 0.0,
-        "unit": "images/sec/chip" if resnet else "tokens/sec/chip",
-        "vs_baseline": 0.0, "backend": "error",
-    }))
+    names = {
+        "resnet50": ("resnet50_train_throughput", "images/sec/chip"),
+        "nmt": ("transformer_nmt_train_throughput", "tokens/sec/chip"),
+        "wide_deep": ("wide_deep_ctr_train_throughput",
+                      "examples/sec/chip"),
+    }
+    metric, unit = "bert_base_pretrain_throughput", "tokens/sec/chip"
+    for key, (m, u) in names.items():
+        if "--model" in sys.argv and key in sys.argv:
+            metric, unit = m, u
+    print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
+                      "vs_baseline": 0.0, "backend": "error"}))
 
 
 def main():
@@ -264,6 +389,10 @@ if __name__ == "__main__":
     if os.environ.get("GRAFT_BENCH_CHILD"):
         if "--model" in sys.argv and "resnet50" in sys.argv:
             main_resnet()
+        elif "--model" in sys.argv and "nmt" in sys.argv:
+            main_nmt()
+        elif "--model" in sys.argv and "wide_deep" in sys.argv:
+            main_ctr()
         else:
             main()
     else:

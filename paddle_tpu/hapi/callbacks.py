@@ -125,6 +125,10 @@ class EarlyStopping(Callback):
     def on_train_begin(self, logs=None):
         self.best = self.baseline
         self.wait = 0
+        if self.save_best_model and not self.save_dir and self.verbose:
+            # reference raises here; keep running but say so once
+            print("EarlyStopping: save_best_model needs save_dir — "
+                  "best-model checkpointing disabled")
 
     def on_epoch_end(self, epoch, logs=None):
         value = (logs or {}).get(self.monitor)
@@ -137,7 +141,7 @@ class EarlyStopping(Callback):
                 self.model.save(f"{self.save_dir}/best_model")
         else:
             self.wait += 1
-            if self.wait > self.patience:
+            if self.wait >= max(1, self.patience):   # reference: >= patience
                 self.stopped_epoch = epoch
                 self.model.stop_training = True
                 if self.verbose:
@@ -175,23 +179,28 @@ class ReduceLROnPlateau(Callback):
         opt = getattr(self.model, "_optimizer", None)
         if value is None or opt is None:
             return
+        # cooldown ticks down EVERY epoch (Keras/paddle semantics) — an
+        # improving metric during cooldown must not freeze the counter
+        in_cooldown = self.cooldown_counter > 0
+        if in_cooldown:
+            self.cooldown_counter -= 1
+            self.wait = 0
         if self._improved(value):
             self.best = value
             self.wait = 0
             return
-        if self.cooldown_counter > 0:
-            # inside the cooldown window nothing counts toward patience
-            # and no further reduction may fire
-            self.cooldown_counter -= 1
-            self.wait = 0
-            return
-        self.wait += 1
+        if in_cooldown:
+            return                       # plateau epochs inside cooldown
+        self.wait += 1                   # don't count toward patience
         if self.wait > self.patience:
             from ..optimizer import lr as lrmod
             if isinstance(getattr(opt, "_lr", None), lrmod.LRScheduler):
-                if self.verbose:
+                if self.verbose and not getattr(self, "_sched_warned",
+                                                False):
+                    self._sched_warned = True
                     print("ReduceLROnPlateau: optimizer lr is scheduler-"
                           "driven; skipping reduction")
+                self.wait = 0
                 return
             old = float(opt.get_lr())
             new = max(old * self.factor, self.min_lr)

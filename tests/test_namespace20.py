@@ -199,15 +199,17 @@ class TestCallbacks:
         model.prepare(optimizer=o,
                       loss=lambda p, y: paddle.fluid.layers.reduce_mean(
                           paddle.fluid.layers.square(p - y)))
-        o.set_lr(0.0)                          # freeze so loss plateaus
         xs = np.random.RandomState(0).randn(8, 4).astype("float32")
         ys = np.zeros((8, 1), "float32")
+        # huge min_delta: every epoch counts as a plateau, so the callback
+        # MUST fire (lr stays 0.5 forever if it doesn't)
         model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=8,
                   epochs=6, verbose=0,
                   callbacks=[C.ReduceLROnPlateau(monitor="loss",
                                                  factor=0.5, patience=0,
+                                                 min_delta=1e6,
                                                  verbose=0)])
-        assert float(o.get_lr()) < 0.5
+        assert float(o.get_lr()) <= 0.5 * 0.5 + 1e-9
 
     def test_lr_scheduler_callback_steps(self):
         import numpy as np
