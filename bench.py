@@ -40,32 +40,37 @@ def build_train_step(vocab, hidden, layers, heads, ffn, seq, batch, lr=1e-4):
         return model.loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels)
 
     param_values, lfn = functional_loss(model, loss_fn)
-    opt_state, spec, fused_update = make_fused_adam(param_values, lr=lr)
+    jstep, opt_state = make_two_program_step(param_values, lfn, lr)
+    n_params = sum(int(np.prod(p.shape)) for p in param_values)
+    return jstep, opt_state, n_params
 
-    # TWO XLA programs per step, like the reference's backward-ops /
-    # optimizer-ops split: the grad program can never fuse the Adam update
-    # into its dW matmuls (observed 10x matmul slowdown when it does), and
-    # both programs compile in seconds where the fused one took >30 min.
-    def grad_step(params, input_ids, mlm_labels, nsp_labels):
-        return jax.value_and_grad(lfn)(params, input_ids, mlm_labels,
-                                       nsp_labels)
 
-    jgrad = jax.jit(grad_step)
+def make_two_program_step(param_values, lfn, lr):
+    """TWO XLA programs per step, like the reference's backward-ops /
+    optimizer-ops split: the grad program can never fuse the Adam update
+    into its dW matmuls (observed 10x matmul slowdown when it does), and
+    both programs compile in seconds where the fused one took >30 min.
+    Shared by the bench and tools/mfu_sweep.py so the sweep always measures
+    EXACTLY the bench's step."""
+    import jax
+    from paddle_tpu.optimizer.fused import make_fused_adam
+
+    opt_state, _spec, fused_update = make_fused_adam(param_values, lr=lr)
+    jgrad = jax.jit(lambda params, *xs: jax.value_and_grad(lfn)(params, *xs))
     jupdate = jax.jit(fused_update, donate_argnums=(0, 1))
     jparams = jax.jit(fused_update.params_of)
     cache = {"params": None}      # jupdate already returns fresh params —
                                   # reuse them instead of re-unflattening
 
-    def jstep(state, input_ids, mlm_labels, nsp_labels):
+    def jstep(state, *xs):
         params = cache["params"]
         if params is None:
             params = jparams(state)
-        loss, grads = jgrad(params, input_ids, mlm_labels, nsp_labels)
+        loss, grads = jgrad(params, *xs)
         state, cache["params"] = jupdate(state, grads)
         return state, loss
 
-    n_params = sum(int(np.prod(p.shape)) for p in param_values)
-    return jstep, opt_state, n_params
+    return jstep, opt_state
 
 
 def flops_per_token(hidden, layers, ffn, seq, vocab):

@@ -59,7 +59,9 @@ def init_parallel_env():
     coord = os.environ.get("PADDLE_TPU_COORDINATOR")
     nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    if coord and nranks > 1 and jax.process_count() == 1:
+    # NOTE: do not probe jax.process_count() here — it would initialise
+    # the XLA backend and make the subsequent initialize() illegal
+    if coord and nranks > 1 and not jax.distributed.is_initialized():
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=nranks, process_id=rank)
     from ..dygraph.parallel import ParallelEnv

@@ -1,0 +1,42 @@
+"""Worker for test_collective_multiproc eager-collective case: each
+process all_reduces / all_gathers / broadcasts host arrays over the DCN
+(multihost) path of paddle_tpu.distributed.collective."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.distributed as dist
+
+    env = dist.init_parallel_env()
+    rank = dist.get_rank()
+    ws = dist.get_world_size()
+
+    s = dist.all_reduce(np.array([float(rank + 1)]), op=dist.ReduceOp.SUM)
+    m = dist.all_reduce(np.array([float(rank + 1)]), op=dist.ReduceOp.MAX)
+    lst = []
+    dist.all_gather(lst, np.array([rank, rank * 10], np.int64))
+    b = dist.broadcast(np.array([rank * 100.0]), src=1)
+    sc = dist.scatter(np.zeros(2), tensor_list=[
+        np.full(2, float(i)) for i in range(ws)], src=0)
+    dist.barrier()
+
+    out = os.environ["COLLECTIVE_API_OUT"].replace("RANK", str(rank))
+    with open(out, "w") as f:
+        json.dump({"rank": rank, "ws": ws,
+                   "sum": float(np.asarray(s)[0]),
+                   "max": float(np.asarray(m)[0]),
+                   "gathered": [np.asarray(a).tolist() for a in lst],
+                   "bcast": float(np.asarray(b)[0]),
+                   "scatter": np.asarray(sc).tolist()}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -60,3 +60,45 @@ class TestCollectiveMultiProcess:
                 np.testing.assert_allclose(dist[k], oracle[k],
                                            rtol=1e-4, atol=1e-6)
         assert dist["losses"][-1] < dist["losses"][0]
+
+
+class TestEagerCollectivesMultiProcess:
+    """The DCN (host allgather) path of paddle.distributed.collective,
+    across 2 REAL processes."""
+
+    def test_functional_collectives_two_procs(self, tmp_path):
+        script = os.path.join(os.path.dirname(__file__),
+                              "collective_api_worker.py")
+        out_tpl = str(tmp_path / "out_RANK.json")
+        env = dict(os.environ, COLLECTIVE_API_OUT=out_tpl)
+        for k in ("TRAINING_ROLE", "PADDLE_TPU_COORDINATOR"):
+            env.pop(k, None)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2",
+             "--master", f"127.0.0.1:{_free_port()}",
+             "--log_dir", str(tmp_path / "logs"), script],
+            env=env, capture_output=True, text=True, timeout=420,
+            cwd=os.path.dirname(os.path.dirname(script)))
+        logs = ""
+        logdir = tmp_path / "logs"
+        if logdir.exists():
+            for f in sorted(os.listdir(logdir)):
+                logs += f"\n--- {f} ---\n" + open(logdir / f).read()[-2000:]
+        assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-1000:]
+                                   + logs)
+
+        import json
+        results = {}
+        for rank in range(2):
+            path = out_tpl.replace("RANK", str(rank))
+            assert os.path.exists(path), f"rank {rank} wrote no output{logs}"
+            with open(path) as f:
+                results[rank] = json.load(f)
+        for rank, res in results.items():
+            assert res["ws"] == 2
+            assert res["sum"] == 3.0            # (0+1) + (1+1)
+            assert res["max"] == 2.0
+            assert res["gathered"] == [[0, 0], [1, 10]]
+            assert res["bcast"] == 100.0        # src=1's value everywhere
+            assert res["scatter"] == [float(rank)] * 2

@@ -69,15 +69,14 @@ def run_case(case, steps=20, warmup=3):
             return L.mean(seq_out)
 
         values, lfn = functional_loss(model, loss_fn)
-        jgrad = jax.jit(jax.value_and_grad(lfn))
-        state = {"v": values}
+        # EXACTLY the bench's fused-Adam two-program step — an unjitted
+        # per-param python update here once made `nohead` SLOWER than
+        # baseline and wrecked the attribution
+        step2, opt_state = bench.make_two_program_step(values, lfn, 1e-6)
 
-        def jstep(_s, ids, _m, _n):
-            loss, grads = jgrad(state["v"], ids)
-            state["v"] = [v - 1e-6 * g for v, g in zip(state["v"], grads)]
-            return _s, loss
+        def jstep(state, ids, _m, _n):
+            return step2(state, ids)
         n_params = sum(int(np.prod(v.shape)) for v in values)
-        opt_state = None
     else:
         jstep, opt_state, n_params = bench.build_train_step(
             vocab, hidden, layers, heads, ffn, seq, batch)
