@@ -18,13 +18,19 @@ from jax import lax
 
 
 def _block_reads_writes(block):
+    """Reads/writes of a block INCLUDING nested control-flow ops' sub-
+    blocks — an inner conditional_block's dependencies live in BLOCK attrs,
+    not its input/output arg lists, and must still ride the outer closure.
+    The per-op analysis (sub-block recursion + the pass-through false
+    path's prior-value reads) is framework._op_reads — ONE shared
+    implementation with the pruner, so the two can't drift."""
+    from .framework import _op_reads
     reads, writes = [], set()
     for op in block.ops:
-        for n in op.input_arg_names:
+        for n in _op_reads(block, op):
             if n not in writes and n not in reads:
                 reads.append(n)
-        for n in op.output_arg_names:
-            writes.add(n)
+        writes.update(op.output_arg_names)
     return reads, sorted(writes)
 
 
@@ -74,6 +80,16 @@ def run_control_flow_op(op, block, env: Dict[str, Any], ctx):
         t_reads, _ = _block_reads_writes(true_block)
         reads = [n for n in t_reads if n in env]
         t_outs = op.attr("true_outs")
+        if false_idx < 0:
+            # no false block: the false path passes PRIOR values of the
+            # outputs through, so they must ride in the closure even when
+            # the true block never reads them (e.g. a pure assign body)
+            missing = [n for n in t_outs if n not in env]
+            if missing:
+                raise KeyError(
+                    f"conditional_block outputs {missing} have no prior "
+                    f"value — define them before the conditional")
+            reads = sorted(set(reads) | set(t_outs))
         if false_idx >= 0:
             false_block = program.blocks[false_idx]
             f_reads, _ = _block_reads_writes(false_block)

@@ -417,6 +417,10 @@ def _op_reads(block, op, _seen=None):
     sub-blocks (cond/while bodies read outer vars that are not declared
     as op inputs)."""
     reads = list(op.input_arg_names)
+    if (op.type == "conditional_block"
+            and op.attrs.get("false_block", -1) < 0):
+        # pass-through false path READS the outputs' prior values
+        reads += list(op.attrs.get("true_outs", ()))
     _seen = _seen if _seen is not None else set()
     prog = block.program
     for attr in _SUB_BLOCK_ATTRS:

@@ -14,7 +14,7 @@ from ..framework import (Variable, default_main_program, in_dygraph_mode,
                          unique_name)
 from ..layer_helper import LayerHelper
 from . import nn as _nn
-from .tensor import fill_constant
+from .tensor import assign, fill_constant
 
 
 def less_than(x, y, force_cpu=None, cond=None):
@@ -189,25 +189,99 @@ class _WhileCtx:
         return False
 
 
+class _SwitchCase:
+    """One `with switch.case(pred)` / `switch.default()` body: captures the
+    appended ops into a sub-block (the conditional_block pattern cond()
+    uses) and records which PRE-EXISTING vars the body writes."""
+
+    def __init__(self, switch, condition):
+        self._switch = switch
+        self._condition = condition
+
+    def __enter__(self):
+        prog = self._switch._prog
+        self._block = prog._create_block(self._switch._parent)
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        prog = self._switch._prog
+        prog.current_block_idx = self._switch._parent
+        if exc_type is not None:
+            return False
+        parent = prog.blocks[self._switch._parent]
+        written = []
+        for op in self._block.ops:
+            for n in op.output_arg_names:
+                # resolve through ancestor blocks: a Switch nested inside a
+                # cond/While body must still see writes to outer vars
+                if (parent._find_var_recursive(n) is not None
+                        and n not in written):
+                    written.append(n)
+        self._switch._cases.append(
+            (self._condition, self._block, written))
+        return False
+
+
 class Switch:
-    """fluid layers.Switch — sugar over nested cond."""
+    """Reference layers/control_flow.py Switch: first matching case's body
+    runs, else the default body (used by piecewise lr decay).  TPU-native:
+    each body becomes a conditional_block whose effective predicate is
+    `case_pred AND none-of-the-earlier-preds`, so the whole construct
+    compiles into nested lax.cond — writes to pre-existing vars select
+    between the body's value and the prior value."""
 
     def __init__(self, name=None):
-        self.cases = []
-        self.default_ops = None
+        if in_dygraph_mode():
+            raise RuntimeError(
+                "Switch is a static-graph construct (case bodies would run "
+                "eagerly before predicates are known) — use plain Python "
+                "control flow in dygraph")
+        self._prog = default_main_program()
+        self._parent = self._prog.current_block_idx
+        self._cases = []                 # (pred|None, block, written names)
 
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        return False
-
     def case(self, condition):
-        raise NotImplementedError(
-            "Switch: use layers.cond / piecewise_decay instead on TPU")
+        return _SwitchCase(self, condition)
 
     def default(self):
-        raise NotImplementedError
+        return _SwitchCase(self, None)
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is not None:
+            return False
+        helper = LayerHelper("switch")
+        not_matched = None               # bool var: no earlier case fired
+        for condition, block, written in self._cases:
+            if condition is None:        # default arm
+                effective = not_matched
+                if effective is None:
+                    effective = fill_constant([1], "bool", True)
+            elif not_matched is None:
+                effective = condition
+            else:
+                effective = logical_and(not_matched, condition)
+            parent_blk = self._prog.blocks[self._parent]
+            outs = [helper.create_variable_for_type_inference(
+                dtype=parent_blk._find_var_recursive(n).dtype)
+                for n in written]
+            helper.append_op(
+                "conditional_block",
+                inputs={"Cond": [effective]},
+                outputs={"Out": outs},
+                attrs={"true_block": block.idx, "false_block": -1,
+                       "true_outs": list(written),
+                       "false_outs": list(written)})
+            # the selected values REPLACE the outer vars from here on
+            for n, o in zip(written, outs):
+                assign(o, parent_blk._find_var_recursive(n))
+            if condition is not None:
+                not_c = logical_not(condition)
+                not_matched = (not_c if not_matched is None
+                               else logical_and(not_matched, not_c))
+        return False
 
 
 # --- tensor array (LoDTensorArray replacement) ------------------------------

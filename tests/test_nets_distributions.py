@@ -212,3 +212,103 @@ class TestFlops:
             assert "dygraph-built" in str(e)
         finally:
             dybase.disable_dygraph()       # flops() may have enabled it
+
+
+class TestSwitch:
+    """layers.Switch (reference control_flow.py Switch — first matching
+    case's body runs; the piecewise-lr pattern)."""
+
+    def _build(self):
+        step = fluid.data("step", [1], dtype="float32")
+        lr = layers.fill_constant([1], "float32", 0.0)
+        b1 = layers.fill_constant([1], "float32", 10.0)
+        b2 = layers.fill_constant([1], "float32", 20.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant([1], "float32", 0.1), lr)
+            with switch.case(layers.less_than(step, b2)):
+                layers.assign(layers.fill_constant([1], "float32", 0.01),
+                              lr)
+            with switch.default():
+                layers.assign(layers.fill_constant([1], "float32", 0.001),
+                              lr)
+        return lr
+
+    def test_piecewise_selection(self):
+        lr = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        for s, want in [(5.0, 0.1), (15.0, 0.01), (25.0, 0.001),
+                        (9.99, 0.1), (10.0, 0.01), (20.0, 0.001)]:
+            got, = exe.run(feed={"step": np.array([s], "float32")},
+                           fetch_list=[lr])
+            v = float(np.asarray(got).reshape(-1)[0])
+            assert abs(v - want) < 1e-6, (s, v, want)
+
+    def test_first_matching_case_wins(self):
+        """Both cases true -> only the FIRST body applies."""
+        x = fluid.data("xsw", [1], dtype="float32")
+        out = layers.fill_constant([1], "float32", -1.0)
+        big = layers.fill_constant([1], "float32", 100.0)
+        with fluid.layers.Switch() as sw:
+            with sw.case(layers.less_than(x, big)):      # true for x=1
+                layers.assign(layers.fill_constant([1], "float32", 1.0),
+                              out)
+            with sw.case(layers.less_than(x, big)):      # also true
+                layers.assign(layers.fill_constant([1], "float32", 2.0),
+                              out)
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(feed={"xsw": np.array([1.0], "float32")},
+                       fetch_list=[out])
+        assert abs(float(np.asarray(got).reshape(-1)[0]) - 1.0) < 1e-6
+
+    def test_undefined_output_fails_loudly(self):
+        """A case body assigning to a declared-but-never-computed var must
+        raise the explanatory KeyError, not silently produce garbage."""
+        x = fluid.data("xs2", [1], dtype="float32")
+        blk = fluid.default_main_program().global_block()
+        target = blk.create_var(name="never_defined", dtype="float32")
+        with fluid.layers.Switch() as sw:
+            with sw.case(layers.less_than(
+                    x, layers.fill_constant([1], "float32", 0.0))):
+                layers.assign(
+                    layers.fill_constant([1], "float32", 1.0), target)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(KeyError, match="no prior value"):
+            exe.run(feed={"xs2": np.array([1.0], "float32")},
+                    fetch_list=["never_defined"])
+
+    def test_switch_nested_inside_cond(self):
+        """A Switch one block deep still updates the OUTER var (writes
+        resolve through ancestor blocks)."""
+        step = fluid.data("stepn", [1], dtype="float32")
+        lr = layers.fill_constant([1], "float32", 0.0)
+
+        def body():
+            with fluid.layers.Switch() as sw:
+                with sw.case(layers.less_than(
+                        step, layers.fill_constant([1], "float32", 10.0))):
+                    layers.assign(
+                        layers.fill_constant([1], "float32", 0.1), lr)
+                with sw.default():
+                    layers.assign(
+                        layers.fill_constant([1], "float32", 0.01), lr)
+            return lr
+
+        always = layers.less_than(
+            layers.fill_constant([1], "float32", 0.0),
+            layers.fill_constant([1], "float32", 1.0))
+        out = layers.cond(always, body, body)
+        exe = fluid.Executor(fluid.CPUPlace())
+        for s, want in [(5.0, 0.1), (15.0, 0.01)]:
+            got, = exe.run(feed={"stepn": np.array([s], "float32")},
+                           fetch_list=[out])
+            assert abs(float(np.asarray(got).reshape(-1)[0]) - want) < 1e-6
+
+    def test_switch_rejected_in_dygraph(self):
+        from paddle_tpu.dygraph import base as dybase
+        dybase.enable_dygraph()
+        try:
+            with pytest.raises(RuntimeError, match="static-graph"):
+                fluid.layers.Switch()
+        finally:
+            dybase.disable_dygraph()
