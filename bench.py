@@ -73,6 +73,15 @@ def make_two_program_step(param_values, lfn, lr):
     return jstep, opt_state
 
 
+def backend_name():
+    """Normalised backend for the report: the axon TPU plugin may register
+    its platform under a non-'tpu' name, but it IS the one v5e chip — MFU
+    peak lookup must not zero out on the plugin's naming."""
+    import jax
+    b = jax.default_backend()
+    return b if b in ("cpu", "gpu") else "tpu"
+
+
 def flops_per_token(hidden, layers, ffn, seq, vocab):
     """fwd+bwd matmul FLOPs per token (Chinchilla-style accounting)."""
     per_layer = 2 * (4 * hidden * hidden + 2 * hidden * ffn)   # qkvo + mlp
@@ -166,7 +175,7 @@ def main_resnet():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     quick = "--quick" in sys.argv
-    backend = jax.default_backend()
+    backend = backend_name()
     if quick or backend == "cpu":
         image, batch, classes, steps, warmup = 32, 4, 10, 3, 1
     else:
@@ -196,7 +205,7 @@ def main_nmt():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     quick = "--quick" in sys.argv
-    backend = jax.default_backend()
+    backend = backend_name()
     if quick or backend == "cpu":
         vocab, d_model, heads, layers_n, ffn = 500, 64, 2, 2, 128
         seq, batch, steps, warmup = 16, 4, 3, 1
@@ -264,7 +273,7 @@ def main_ctr():
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     quick = "--quick" in sys.argv
-    backend = jax.default_backend()
+    backend = backend_name()
     if quick or backend == "cpu":
         slots, vocab, dim, batch, steps, warmup = 6, 1000, 8, 64, 3, 1
     else:
@@ -304,49 +313,143 @@ def main_ctr():
     }))
 
 
-def supervise():
-    """The axon TPU plugin is flaky at init — it can raise UNAVAILABLE *or
-    hang forever*, and a hang can strike any in-process jax call.  So the
-    real bench runs as a *watched child process*: first attempt on the
-    default (TPU) backend, and on crash/timeout a retry with the CPU
-    platform forced.  The supervisor ALWAYS prints exactly one JSON line
-    (round-1 lesson: rc=1 with no JSON costs the round its headline number).
-    """
+def _scan_json(stdout):
+    """Last parseable JSON line of a child's stdout, or None."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode("utf-8", "replace")
+    for line in reversed((stdout or "").splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _run_child(extra_env, budget, label):
+    """One watched bench-child attempt.  Returns the parsed JSON dict on
+    success, None on crash/hang/no-JSON; diagnostics go to stderr only."""
     import os
     import subprocess
 
+    env = dict(os.environ, GRAFT_BENCH_CHILD="1", **extra_env)
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, capture_output=True, text=True, timeout=budget)
+        stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+    except subprocess.TimeoutExpired as e:
+        # the child may have printed its JSON and hung at teardown (PJRT
+        # client exit is a jax call too) — the result is still good
+        stdout, stderr, rc = e.stdout, e.stderr, "hang"
+    dt = time.perf_counter() - t0
+    out = _scan_json(stdout)
+    if out is not None:
+        print(f"# attempt({label}) {rc=} in {dt:.0f}s: "
+              f"backend={out.get('backend')} value={out.get('value')}",
+              file=sys.stderr)
+        return out
+    tail = (stderr or b"" if isinstance(stderr, bytes) else stderr or "")
+    if isinstance(tail, bytes):
+        tail = tail.decode("utf-8", "replace")
+    print(f"# attempt({label}) {rc=} in {dt:.0f}s, no JSON; "
+          f"stderr tail: {tail.strip()[-500:]}", file=sys.stderr)
+    return None
+
+
+def supervise():
+    """The axon TPU plugin is flaky at init — it can raise UNAVAILABLE *or
+    hang forever*, and a hang can strike any in-process jax call.  So the
+    real bench runs as a *watched child process* with MULTIPLE TPU attempts
+    (a hang is usually transient tunnel state, so a fresh process with a
+    bigger budget often succeeds where the first one froze):
+
+      1. TPU with escalating budgets (two attempts),
+      2. a CPU run to SECURE a fallback number,
+      3. one more TPU attempt with the largest budget,
+
+    and it ALWAYS prints exactly one JSON line — the first TPU success, or
+    the secured CPU number, or an error record (round-1 lesson: rc=1 with
+    no JSON costs the round its headline number; round-2 lesson: one TPU
+    attempt is not enough against a flaky-at-init backend).
+    """
+    import os
+    import signal
+
+    def error_record():
+        names = {
+            "resnet50": ("resnet50_train_throughput", "images/sec/chip"),
+            "nmt": ("transformer_nmt_train_throughput", "tokens/sec/chip"),
+            "wide_deep": ("wide_deep_ctr_train_throughput",
+                          "examples/sec/chip"),
+        }
+        metric, unit = "bert_base_pretrain_throughput", "tokens/sec/chip"
+        for key, (m, u) in names.items():
+            if "--model" in sys.argv and key in sys.argv:
+                metric, unit = m, u
+        return {"metric": metric, "value": 0.0, "unit": unit,
+                "vs_baseline": 0.0, "backend": "error"}
+
+    state = {"secured": None, "done": False}
+
+    def emit(out):
+        """The single exit: exactly one JSON line ever reaches stdout."""
+        if state["done"]:
+            return
+        state["done"] = True
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        print(json.dumps(out), flush=True)
+
+    def _on_term(signum, frame):
+        # the driver may cap total bench wall time; if it TERMs us mid-
+        # sequence, emit the best number we hold rather than dying JSON-less
+        emit(state["secured"] if state["secured"] is not None
+             else error_record())
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass                    # non-main thread / platform quirk
+
     resnet_run = "--model" in sys.argv and "resnet50" in sys.argv
     # conv-heavy HLO compiles much slower than the BERT graph; give the
-    # TPU attempt room before declaring it hung
-    tpu_budget = 900 if resnet_run else 360
-    attempts = [({}, tpu_budget), ({"JAX_PLATFORMS": "cpu"}, 300)]
-    for extra_env, budget in attempts:
-        env = dict(os.environ, GRAFT_BENCH_CHILD="1", **extra_env)
-        label = extra_env.get("JAX_PLATFORMS", "default")
+    # TPU attempts room before declaring them hung
+    b = [600, 900, 1200] if resnet_run else [300, 600, 900]
+    if os.environ.get("GRAFT_BENCH_TPU_BUDGETS"):     # harness self-test
         try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
-                env=env, capture_output=True, text=True, timeout=budget)
-            for line in reversed(r.stdout.splitlines()):
-                if line.startswith("{"):
-                    print(line)
-                    return
-            print(f"# child({label}) rc={r.returncode} no JSON; stderr tail: "
-                  f"{r.stderr.strip()[-500:]}", file=sys.stderr)
-        except subprocess.TimeoutExpired:
-            print(f"# child({label}) hung >{budget}s", file=sys.stderr)
-    names = {
-        "resnet50": ("resnet50_train_throughput", "images/sec/chip"),
-        "nmt": ("transformer_nmt_train_throughput", "tokens/sec/chip"),
-        "wide_deep": ("wide_deep_ctr_train_throughput",
-                      "examples/sec/chip"),
-    }
-    metric, unit = "bert_base_pretrain_throughput", "tokens/sec/chip"
-    for key, (m, u) in names.items():
-        if "--model" in sys.argv and key in sys.argv:
-            metric, unit = m, u
-    print(json.dumps({"metric": metric, "value": 0.0, "unit": unit,
-                      "vs_baseline": 0.0, "backend": "error"}))
+            b = [int(x) for x in
+                 os.environ["GRAFT_BENCH_TPU_BUDGETS"].split(",")
+                 if x.strip()] or b
+        except ValueError:
+            pass
+        while len(b) < 3:
+            b.append(b[-1])
+
+    first_tpu = True
+    for kind, budget in [("tpu", b[0]), ("tpu", b[1]), ("cpu", 300),
+                         ("tpu", b[2])]:
+        if kind == "cpu":
+            if state["secured"] is None:    # secure a fallback number
+                state["secured"] = _run_child({"JAX_PLATFORMS": "cpu"},
+                                              budget, f"cpu@{budget}s")
+            continue
+        if not first_tpu:
+            time.sleep(10)                  # let the tunnel settle
+        first_tpu = False
+        out = _run_child({}, budget, f"tpu@{budget}s")
+        if out is not None:
+            if out.get("backend") not in ("cpu", "error"):
+                emit(out)                   # the driver-captured TPU number
+                return
+            if state["secured"] is None:    # jax fell back in-process
+                state["secured"] = out
+    emit(state["secured"] if state["secured"] is not None
+         else error_record())
 
 
 def main():
@@ -359,7 +462,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
 
     quick = "--quick" in sys.argv
-    backend = jax.default_backend()
+    backend = backend_name()
     if quick or backend == "cpu":
         vocab, hidden, layers, heads, ffn = 1000, 128, 2, 4, 512
         seq, batch, steps, warmup = 128, 8, 5, 2
