@@ -149,16 +149,47 @@ class Fleet:
     def _applied_meta_list(self):
         return self._strategy_compiler._get_applied_meta_list()
 
+    def _in_ps_mode(self):
+        """PS mode: a_sync requested, or server roles configured while not
+        collective (reference fleet_base.py:1020 chooses the_one_ps the
+        same way)."""
+        if self._is_collective:
+            return False
+        strat = self._user_defined_strategy
+        if strat is not None and getattr(strat, "a_sync", False):
+            return True
+        try:
+            return (self._role_maker is not None
+                    and self._role_maker._server_num() > 0)
+        except Exception:                    # noqa: BLE001 — role w/o servers
+            return False
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        opt0 = self._user_defined_optimizer
+        if opt0 is None:
+            raise RuntimeError("call fleet.distributed_optimizer first")
+        if self._in_ps_mode():
+            # transpiler path (distribute_transpiler.py:256 analog): rewrite
+            # sparse lookups to PS pulls, append backward WITHOUT optimizer
+            # ops — the server table applies updates (program_pass.py)
+            from ...ps.program_pass import apply_ps_pass
+            from ...ps.the_one_ps import TheOnePSRuntime
+            strategy = self._user_defined_strategy
+            if self._runtime_handle is None:
+                self._runtime_handle = TheOnePSRuntime(self._role_maker,
+                                                       strategy)
+            params_grads, plan = apply_ps_pass(
+                loss, startup_program, opt0, strategy, self._role_maker)
+            self._runtime_handle._ps_plan = plan
+            self._final_strategy = strategy
+            return [], params_grads
         from ..meta_optimizers import (
             AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
             LambOptimizer, LarsOptimizer, LocalSGDOptimizer, DGCOptimizer,
             FP16AllReduceOptimizer, ShardingOptimizer, PipelineOptimizer,
             GraphExecutionOptimizer)
-        opt = self._user_defined_optimizer
-        if opt is None:
-            raise RuntimeError("call fleet.distributed_optimizer first")
+        opt = opt0
         strategy = self._user_defined_strategy
         candidates = [cls(opt) for cls in (
             AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,

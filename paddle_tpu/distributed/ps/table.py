@@ -189,11 +189,17 @@ class CommonSparseTable:
 class CommonDenseTable:
     """Dense param mirror for the PS path (common_dense_table.cc)."""
 
-    def __init__(self, shape, optimizer="sgd", lr=0.01):
+    def __init__(self, shape, optimizer="sgd", lr=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unknown dense accessor {optimizer}")
         self.value = np.zeros(shape, np.float32)
         self.optimizer = optimizer
         self.lr = lr
-        self._acc = np.zeros(shape, np.float32)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self._acc = np.zeros(shape, np.float32)   # adagrad acc / adam m
+        self._v = np.zeros(shape, np.float32)
+        self._t = 0
         self._lock = threading.Lock()
 
     def pull(self):
@@ -201,16 +207,27 @@ class CommonDenseTable:
             return self.value.copy()
 
     def push(self, grad):
+        grad = np.asarray(grad, np.float32).reshape(self.value.shape)
         with self._lock:
             if self.optimizer == "adagrad":
                 self._acc += grad * grad
                 self.value -= self.lr * grad / (np.sqrt(self._acc) + 1e-8)
+            elif self.optimizer == "adam":
+                self._t += 1
+                self._acc = self.beta1 * self._acc + (1 - self.beta1) * grad
+                self._v = (self.beta2 * self._v
+                           + (1 - self.beta2) * grad * grad)
+                mh = self._acc / (1 - self.beta1 ** self._t)
+                vh = self._v / (1 - self.beta2 ** self._t)
+                self.value -= self.lr * mh / (np.sqrt(vh) + self.epsilon)
             else:
                 self.value -= self.lr * grad
 
     def set(self, value):
         with self._lock:
-            self.value = np.asarray(value, np.float32).reshape(
+            # np.array (copy) not asarray: a zero-copy view of a jax array
+            # is read-only and would break the in-place optimizer updates
+            self.value = np.array(value, np.float32).reshape(
                 self.value.shape)
 
     def push_delta(self, delta):

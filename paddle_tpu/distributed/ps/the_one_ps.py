@@ -29,6 +29,8 @@ class TheOnePSRuntime:
         self._role_maker = role_maker
         self._strategy = strategy
         self._tables: Dict[str, CommonSparseTable] = {}
+        self._dense_tables: Dict[str, CommonDenseTable] = {}
+        self._ps_tables_ready: set = set()   # table names (program_pass)
         self._barrier = BarrierTable(role_maker._worker_num())
         self._running = False
         self._server = None
@@ -37,16 +39,76 @@ class TheOnePSRuntime:
         self._heartbeater = None
 
     # -- table registry (in-process mode) -----------------------------------
-    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01):
+    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
+                            init_kind="uniform", init_scale=0.07):
         if self._client is not None:
-            self._client.create_sparse_table(name, dim, optimizer, lr)
+            self._client.create_sparse_table(name, dim, optimizer, lr,
+                                             init_kind=init_kind,
+                                             init_scale=init_scale)
             return None
         if name not in self._tables:
-            self._tables[name] = CommonSparseTable(dim, optimizer, lr)
+            from .table import Initializer
+            self._tables[name] = CommonSparseTable(
+                dim, optimizer, lr,
+                initializer=Initializer(init_kind, init_scale))
         return self._tables[name]
+
+    def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01):
+        if self._client is not None:
+            self._client.create_dense_table(name, shape, optimizer, lr)
+            return None
+        if name not in self._dense_tables:
+            self._dense_tables[name] = CommonDenseTable(shape, optimizer, lr)
+        return self._dense_tables[name]
 
     def get_table(self, name):
         return self._tables[name]
+
+    # -- program-path accessors (downpour_worker pull/push surface) ---------
+    # Dispatch client-mode calls through the communicator when it adds
+    # semantics (async queueing); in-process mode hits the host tables.
+    def ps_pull_sparse(self, table, ids):
+        if self._client is not None:
+            acc = self._communicator or self._client
+            return acc.pull_sparse(table, ids)
+        return self._tables[table].pull(ids)
+
+    def ps_push_sparse(self, table, ids, grads):
+        if self._client is not None:
+            acc = self._communicator or self._client
+            acc.push_sparse(table, ids, grads)
+            return
+        self._tables[table].push(ids, grads)
+
+    def ps_pull_dense(self, name):
+        if self._client is not None:
+            acc = self._communicator or self._client
+            return acc.pull_dense(name)
+        return self._dense_tables[name].pull()
+
+    def ps_push_dense(self, name, grad):
+        if self._client is not None:
+            acc = self._communicator or self._client
+            acc.push_dense(name, grad)
+            return
+        self._dense_tables[name].push(grad)
+
+    def ps_set_dense(self, name, value):
+        if self._client is not None:
+            self._client.set_dense(name, value)
+            return
+        self._dense_tables[name].set(value)
+
+    def ps_barrier(self):
+        if self._client is not None:
+            self._client.barrier()
+
+    def ps_step(self):
+        comm = self._communicator
+        if comm is not None and hasattr(comm, "step"):
+            comm.step()
+        elif self._client is not None:
+            self._client.barrier()
 
     # -- fleet runtime protocol --------------------------------------------
     def init_worker(self):

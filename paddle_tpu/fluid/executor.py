@@ -177,6 +177,14 @@ class Executor:
         if hasattr(program, "_program"):   # CompiledProgram
             mesh = getattr(program, "_mesh", None) or mesh
             program = program._program
+        if (program._hints.get("ps_plan") is not None
+                and not getattr(self, "_in_ps_run", False)):
+            # PS-served program: the pull -> device step -> push loop
+            # (downpour_worker.cc analog) wraps this very run()
+            from ..distributed.ps.program_pass import run_program_with_ps
+            return run_program_with_ps(self, program, feed, fetch_list,
+                                       scope, return_numpy,
+                                       use_program_cache)
         scope = scope or global_scope()
         feed = feed or {}
         fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]

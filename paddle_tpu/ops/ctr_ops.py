@@ -321,3 +321,24 @@ def _merge_ids(ins, attrs, ctx):
                                 jnp.int32))])
     return {"Out": [stacked[base[shard] + offsets].reshape(
         ids.shape + (dim,))]}
+
+
+@register_op("ps_lookup_rows", nondiff_inputs=("Ids",))
+def _ps_lookup_rows(ins, attrs, ctx):
+    """Device half of a PS-served embedding lookup: `Rows` is the per-batch
+    host feed of rows pulled for each (flattened) id position — the XLA
+    analog of DownpourWorker FillSparseValue (downpour_worker.cc:183)
+    writing pulled values into the lookup output.  The vjp w.r.t. Rows is
+    exactly the per-position row gradient the trainer pushes back
+    (downpour_worker.cc:765); padding_idx positions are zeroed so their
+    pushed grad is zero.  Emitted by distributed/ps/program_pass.py."""
+    rows = ins["Rows"][0]
+    ids = ins["Ids"][0]
+    if attrs.get("v1") and ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])   # lookup_table squeezes [.., 1]
+    dim = rows.shape[-1]
+    out = rows.reshape(tuple(ids.shape) + (dim,))
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad >= 0:
+        out = jnp.where((ids == pad)[..., None], 0.0, out)
+    return {"Out": [out]}

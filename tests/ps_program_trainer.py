@@ -1,0 +1,146 @@
+"""Child for the PS *program path* test: CTR training written as a NORMAL
+fluid program — `fleet.minimize` rewrites the sparse embedding into PS
+pulls/pushes (distributed/ps/program_pass.py); NO hand-wired RPC anywhere.
+This is the transpiler-equivalent flow the reference drives through
+distribute_transpiler.py:256 + downpour_worker.cc:739/765.
+
+Roles (env, launch_ps wiring):
+  TRAINING_ROLE=PSERVER  -> fleet.init_server(); fleet.run_server()
+  TRAINING_ROLE=TRAINER  -> sync-mode program-path training, half batch
+  PS_PROGRAM_ORACLE=1    -> single process, FULL batch, lr*2: with SGD the
+        server applying both trainers' half-batch mean grads equals one
+        full-batch mean grad at twice the lr, so the parameter trajectory
+        is bit-comparable (same pull->grad->push math, floats modulo
+        summation order).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+LR = 0.05
+STEPS = 6
+BATCH = 16          # global; each trainer takes half
+NUM_SLOTS, VOCAB_PER_SLOT, EMBED_DIM, DENSE_DIM = 4, 250, 8, 4
+VOCAB = NUM_SLOTS * VOCAB_PER_SLOT
+EMB = "emb_w"
+DENSE_PARAMS = ("fc1_w", "fc1_b", "fc2_w", "fc2_b")
+
+
+def build_program():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers as L
+    from paddle_tpu.fluid.param_attr import ParamAttr
+    from paddle_tpu.fluid.initializer import ConstantInitializer
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = L.data("ids", [-1, NUM_SLOTS], dtype="int64")
+        dense = L.data("dense", [-1, DENSE_DIM])
+        label = L.data("label", [-1, 1])
+        emb = L.embedding(ids, (VOCAB, EMBED_DIM), is_sparse=True,
+                          param_attr=ParamAttr(
+                              name=EMB,
+                              initializer=ConstantInitializer(0.0)))
+        flat = L.reshape(emb, [-1, NUM_SLOTS * EMBED_DIM])
+        x = L.concat([flat, dense], axis=1)
+        h = L.fc(x, 16, act="relu", param_attr=ParamAttr(name="fc1_w"),
+                 bias_attr=ParamAttr(name="fc1_b"))
+        pred = L.fc(h, 1, param_attr=ParamAttr(name="fc2_w"),
+                    bias_attr=ParamAttr(name="fc2_b"))
+        loss = L.mean(L.square(pred - label))
+    return main, startup, loss
+
+
+def seed_dense_params(scope):
+    """Deterministic dense init shared by every process: trainer 0 seeds
+    the server tables from these values, the oracle uses them directly."""
+    rng = np.random.RandomState(123)
+    for name in DENSE_PARAMS:
+        cur = scope.find_var(name)
+        assert cur is not None, f"startup did not init {name}"
+        scope.set_var(name, (rng.randn(*np.shape(cur)) * 0.1)
+                      .astype(np.float32))
+
+
+def make_data():
+    rng = np.random.RandomState(7)
+    ids = np.stack([rng.randint(s * VOCAB_PER_SLOT,
+                                (s + 1) * VOCAB_PER_SLOT, BATCH)
+                    for s in range(NUM_SLOTS)], axis=1).astype("int64")
+    dense = rng.randn(BATCH, DENSE_DIM).astype("float32")
+    label = (rng.rand(BATCH, 1) > 0.5).astype("float32")
+    return ids, dense, label
+
+
+def _save(out_path, losses, rt):
+    probe_ids = np.arange(0, VOCAB, 97, dtype=np.int64)
+    arrays = {"losses": np.array(losses),
+              "probe": np.asarray(rt.ps_pull_sparse(EMB, probe_ids))}
+    for name in DENSE_PARAMS:
+        arrays[name] = np.asarray(rt.ps_pull_dense(name))
+    np.savez(out_path, **arrays)
+
+
+def _train(lr, a_sync, shard, out_path=None, save=True):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.core import global_scope
+    import paddle_tpu.distributed.fleet as fleet
+
+    fleet.init(fleet.PaddleCloudRoleMaker())
+    strategy = fleet.DistributedStrategy()
+    strategy.a_sync = a_sync
+    main, startup, loss = build_program()
+    opt = fluid.optimizer.SGDOptimizer(lr)
+    fleet.distributed_optimizer(opt, strategy)
+    fleet.minimize(loss, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    seed_dense_params(global_scope())
+    fleet.init_worker()
+
+    ids, dense, label = make_data()
+    lo, hi = shard
+    losses = []
+    for _ in range(STEPS):
+        lv, = exe.run(main,
+                      feed={"ids": ids[lo:hi], "dense": dense[lo:hi],
+                            "label": label[lo:hi]},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    rt = fleet._fleet_singleton._runtime_handle
+    if save and out_path:
+        _save(out_path, losses, rt)
+    fleet.stop_worker()
+    return losses
+
+
+def main():
+    out = os.environ.get("PS_TEST_OUT", "/tmp/ps_program_out.npz")
+    if os.environ.get("PS_PROGRAM_ORACLE"):
+        # single process == one "trainer" holding the whole batch; 2x lr
+        # stands in for the two sync trainers' summed pushes (SGD linearity)
+        _train(2 * LR, a_sync=True, shard=(0, BATCH), out_path=out)
+        return
+    role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+    if role in ("PSERVER", "SERVER"):
+        import paddle_tpu.distributed.fleet as fleet
+        fleet.init(fleet.PaddleCloudRoleMaker())
+        fleet.init_server()
+        fleet.run_server()
+        return
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n = int(os.environ["PADDLE_TRAINERS_NUM"])
+    half = BATCH // n
+    _train(LR, a_sync=False, shard=(tid * half, (tid + 1) * half),
+           out_path=out, save=tid == 0)
+
+
+if __name__ == "__main__":
+    main()

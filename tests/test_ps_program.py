@@ -1,0 +1,235 @@
+"""PS program-path tests: a NORMAL fluid program with a sparse embedding
+trains against the PS tier purely through `fleet.minimize` +
+`executor.run` — the transpiler-equivalent integration
+(distribute_transpiler.py:256; downpour_worker.cc:739,765,183 analogs in
+distributed/ps/program_pass.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import ps_program_trainer as T
+
+
+def _reset_fleet():
+    import paddle_tpu.distributed.fleet as fleet
+    fleet._fleet_singleton._runtime_handle = None
+    fleet._fleet_singleton._user_defined_optimizer = None
+
+
+class TestPsProgramInProcess:
+    """Single process, in-process host tables: the PS path must reproduce
+    plain SGD training exactly (server-side -lr*sum(grads) == the sgd op)."""
+
+    def _baseline(self):
+        import paddle_tpu.fluid as fluid
+        from paddle_tpu.fluid.core import global_scope
+
+        main, startup, loss = T.build_program()
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.SGDOptimizer(T.LR).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        T.seed_dense_params(global_scope())
+        ids, dense, label = T.make_data()
+        losses = []
+        for _ in range(T.STEPS):
+            lv, = exe.run(main, feed={"ids": ids, "dense": dense,
+                                      "label": label}, fetch_list=[loss])
+            losses.append(float(lv))
+        scope = global_scope()
+        params = {n: np.asarray(scope.find_var(n)) for n in T.DENSE_PARAMS}
+        w = np.asarray(scope.find_var(T.EMB))
+        return losses, params, w
+
+    def test_matches_plain_sgd(self):
+        base_losses, base_params, base_w = self._baseline()
+
+        _reset_fleet()
+        import paddle_tpu.distributed.fleet as fleet
+        losses = T._train(T.LR, a_sync=True, shard=(0, T.BATCH), save=False)
+        rt = fleet._fleet_singleton._runtime_handle
+
+        np.testing.assert_allclose(losses, base_losses, rtol=1e-5,
+                                   atol=1e-7)
+        for name in T.DENSE_PARAMS:
+            np.testing.assert_allclose(
+                np.asarray(rt.ps_pull_dense(name)).reshape(
+                    base_params[name].shape),
+                base_params[name], rtol=1e-5, atol=1e-7)
+        probe = np.arange(0, T.VOCAB, 7, dtype=np.int64)
+        np.testing.assert_allclose(rt.ps_pull_sparse(T.EMB, probe),
+                                   base_w[probe], rtol=1e-5, atol=1e-7)
+        assert losses[-1] < losses[0]
+
+    def test_trainer_has_no_vocab_sized_table(self):
+        """The point of the tier: the trainer never materialises W.  The
+        startup program must not initialise it and the scope must not hold
+        it after training."""
+        _reset_fleet()
+        from paddle_tpu.fluid.core import global_scope
+        T._train(T.LR, a_sync=True, shard=(0, T.BATCH), save=False)
+        assert global_scope().find_var(T.EMB) is None
+
+    def test_infer_clone_pulls_without_pushing(self):
+        """A for_test clone of a PS program serves predictions from the
+        tables (pull-only): no grads fetched, table rows unchanged."""
+        _reset_fleet()
+        import paddle_tpu.fluid as fluid
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.fluid.core import global_scope
+
+        fleet.init(fleet.PaddleCloudRoleMaker())
+        strategy = fleet.DistributedStrategy()
+        strategy.a_sync = True
+        main, startup, loss = T.build_program()
+        opt = fluid.optimizer.SGDOptimizer(T.LR)
+        fleet.distributed_optimizer(opt, strategy)
+        fleet.minimize(loss, startup)
+        test_prog = main.clone(for_test=True)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        T.seed_dense_params(global_scope())
+        fleet.init_worker()
+        ids, dense, label = T.make_data()
+        feed = {"ids": ids, "dense": dense, "label": label}
+        lv1, = exe.run(main, feed=feed, fetch_list=[loss])       # one train
+        rt = fleet._fleet_singleton._runtime_handle
+        probe = np.unique(ids.reshape(-1))
+        before = np.asarray(rt.ps_pull_sparse(T.EMB, probe)).copy()
+        lv_eval, = exe.run(test_prog, feed=feed, fetch_list=[loss.name])
+        after = np.asarray(rt.ps_pull_sparse(T.EMB, probe))
+        np.testing.assert_array_equal(before, after)   # eval did not push
+        assert np.isfinite(float(lv_eval))
+        fleet.stop_worker()
+
+
+class TestPsProgramDataset:
+    """train_from_dataset over a PS-served program: the Dataset/Trainer tier
+    drives the same pull->step->push loop per batch (DownpourWorker +
+    DistMultiTrainer flow, device_worker.h analog)."""
+
+    def test_train_from_dataset_ps(self, tmp_path):
+        _reset_fleet()
+        import paddle_tpu.fluid as fluid
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.fluid.core import global_scope
+        from paddle_tpu.fluid.param_attr import ParamAttr
+        from paddle_tpu.fluid.initializer import ConstantInitializer
+
+        rng = np.random.RandomState(11)
+        paths = []
+        for i in range(2):
+            rows = []
+            for _ in range(32):
+                sid = rng.randint(0, 50)
+                feat = rng.randn(4)
+                label = float(feat.sum() > 0)
+                rows.append("1 %d 4 %f %f %f %f 1 %f"
+                            % (sid, *feat.tolist(), label))
+            p = tmp_path / f"part{i}.txt"
+            p.write_text("\n".join(rows) + "\n")
+            paths.append(str(p))
+
+        fleet.init(fleet.PaddleCloudRoleMaker())
+        strategy = fleet.DistributedStrategy()
+        strategy.a_sync = True
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.data("ids", [-1, 1], dtype="int64")
+            feat = fluid.data("feat", [-1, 4])
+            label = fluid.data("label", [-1, 1])
+            emb = fluid.layers.embedding(
+                ids, size=[50, 4], is_sparse=True,
+                param_attr=ParamAttr(name="ds_emb",
+                                     initializer=ConstantInitializer(0.0)))
+            emb = fluid.layers.reshape(emb, [-1, 4])
+            h = fluid.layers.concat([emb, feat], axis=1)
+            pred = fluid.layers.fc(h, 1, act="sigmoid")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+        opt = fluid.optimizer.SGDOptimizer(0.5)
+        fleet.distributed_optimizer(opt, strategy)
+        fleet.minimize(loss, startup)
+
+        dataset = fluid.DatasetFactory().create_dataset("QueueDataset")
+        dataset.set_batch_size(8)
+        dataset.set_use_var([ids, feat, label])
+        dataset.set_filelist(paths)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fleet.init_worker()
+
+        first = last = None
+        for _ in range(6):
+            res = exe.train_from_dataset(main, dataset, fetch_list=[loss],
+                                         print_period=1000)
+            lv = float(np.asarray(res[0][0]).ravel()[0])
+            first = lv if first is None else first
+            last = lv
+        assert exe._last_trainer_stats.steps == 8
+        assert last < first
+        rt = fleet._fleet_singleton._runtime_handle
+        assert rt.get_table("ds_emb").size() > 0      # rows live in the PS
+        assert global_scope().find_var("ds_emb") is None
+        fleet.stop_worker()
+
+
+class TestPsProgramMultiProcess:
+    """2 real servers + 2 real trainers via launch_ps; the trainers run the
+    *program path* in sync mode; final parameters must match the oracle
+    (single process, full batch, 2x lr — see ps_program_trainer docstring)."""
+
+    def test_two_server_two_trainer_matches_oracle(self, tmp_path):
+        script = os.path.join(os.path.dirname(__file__),
+                              "ps_program_trainer.py")
+        out_dist = str(tmp_path / "dist.npz")
+        out_oracle = str(tmp_path / "oracle.npz")
+
+        env = dict(os.environ, PS_PROGRAM_ORACLE="1",
+                   PS_TEST_OUT=out_oracle)
+        env.pop("TRAINING_ROLE", None)
+        r = subprocess.run([sys.executable, script], env=env,
+                           capture_output=True, text=True, timeout=240)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        base_port = s.getsockname()[1]
+        s.close()
+
+        env = dict(os.environ, PS_TEST_OUT=out_dist)
+        env.pop("TRAINING_ROLE", None)
+        env.pop("PS_PROGRAM_ORACLE", None)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--server_num", "2", "--worker_num", "2",
+             "--master", f"127.0.0.1:{base_port}",
+             "--log_dir", str(tmp_path / "logs"), script],
+            env=env, capture_output=True, text=True, timeout=420,
+            cwd=os.path.dirname(os.path.dirname(script)))
+        logs = ""
+        logdir = tmp_path / "logs"
+        if logdir.exists():
+            for f in sorted(os.listdir(logdir)):
+                logs += f"\n--- {f} ---\n"
+                logs += open(logdir / f).read()[-2000:]
+        assert r.returncode == 0, (r.stdout[-1000:], r.stderr[-1000:], logs)
+        assert os.path.exists(out_dist), logs
+
+        dist = np.load(out_dist)
+        oracle = np.load(out_oracle)
+        # final parameters: probed sparse rows + every dense tower param
+        np.testing.assert_allclose(dist["probe"], oracle["probe"],
+                                   rtol=1e-4, atol=1e-6)
+        for name in T.DENSE_PARAMS:
+            np.testing.assert_allclose(dist[name], oracle[name],
+                                       rtol=1e-4, atol=1e-6)
+        # and training made progress on the trainer's own half batch
+        assert dist["losses"][-1] < dist["losses"][0]
