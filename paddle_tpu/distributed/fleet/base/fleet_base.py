@@ -180,7 +180,8 @@ class Fleet:
                 self._runtime_handle = TheOnePSRuntime(self._role_maker,
                                                        strategy)
             params_grads, plan = apply_ps_pass(
-                loss, startup_program, opt0, strategy, self._role_maker)
+                loss, startup_program, opt0, strategy, self._role_maker,
+                parameter_list=parameter_list, no_grad_set=no_grad_set)
             self._runtime_handle._ps_plan = plan
             self._final_strategy = strategy
             return [], params_grads
@@ -205,10 +206,6 @@ class Fleet:
         self._final_strategy = strategy
         ops, params_grads = final.minimize(loss, startup_program,
                                            parameter_list, no_grad_set)
-        if strategy.a_sync and self._runtime_handle is None:
-            from ...ps.the_one_ps import TheOnePSRuntime
-            self._runtime_handle = TheOnePSRuntime(self._role_maker,
-                                                   strategy)
         return ops, params_grads
 
 

@@ -1,0 +1,125 @@
+"""BoxPS analog: host-RAM embedding storage with an HBM working-set cache.
+
+Reference: paddle/fluid/framework/fleet/box_wrapper.h — `PullSparse` (:141)
+serves lookups from a GPU replica cache, `PushSparseGrad` (:282) trains it,
+`BeginPass`/`EndPass` (:339-366) move the pass's feasign working set between
+the host store and device memory.  The table's id space (and its total
+materialised size) can exceed HBM arbitrarily; only the current pass's
+unique ids live on device.
+
+TPU-native redesign: instead of custom GPU kernels around a replica cache,
+the cache IS a normal framework parameter — a `[C, dim]` device array the
+program's `pull_box_sparse` op gathers from and the ordinary sgd op
+updates in the SAME jitted XLA step (scatter-add vjp + fused update, no
+host round-trip per batch).  The ONLY per-batch host work is a vectorized
+id -> cache-slot translation (np.searchsorted over the pass's sorted
+unique ids).  Pass boundaries do the tiering:
+
+  begin_pass(ids)  pull the pass's unique rows from the host table, pad to
+                   a power-of-two C (bounds XLA recompiles across passes),
+                   stage as the cache value.
+  slots_of(ids)    translate raw (up to 64-bit) ids to cache slots.
+  end_pass(cache)  write trained rows back into the host table.
+
+Driven by executor.train_from_dataset via program._hints['box_plan']
+(distributed/trainer.py) or manually for custom loops.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .table import CommonSparseTable, Initializer
+
+
+class BoxPSWrapper:
+    """One embedding table's host store + per-pass HBM cache state."""
+
+    def __init__(self, dim: int, init_kind: str = "uniform",
+                 init_scale: float = 0.07, seed: int = 0,
+                 table: Optional[CommonSparseTable] = None):
+        self.dim = int(dim)
+        # host store holds VALUES only — training happens on-device in the
+        # cache, so the table's accessor never runs (lr irrelevant)
+        self.host = table or CommonSparseTable(
+            self.dim, "sgd", 0.0,
+            initializer=Initializer(init_kind, init_scale, seed))
+        self._pass_ids: Optional[np.ndarray] = None   # sorted unique
+        self._cache_rows = 0                          # padded C
+
+    # -- pass lifecycle -----------------------------------------------------
+    def begin_pass(self, ids) -> np.ndarray:
+        """Stage the pass working set; returns the [C, dim] cache value
+        (padded with zero rows) to seed the cache parameter."""
+        uniq = np.unique(np.asarray(ids).reshape(-1))
+        if len(uniq) == 0:
+            raise ValueError("begin_pass: empty id set")
+        rows = self.host.pull(uniq)
+        c = 1 << int(np.ceil(np.log2(max(1, len(uniq)))))
+        cache = np.zeros((c, self.dim), np.float32)
+        cache[: len(uniq)] = rows
+        self._pass_ids = uniq
+        self._cache_rows = c
+        return cache
+
+    def slots_of(self, ids) -> np.ndarray:
+        """Raw ids -> cache slots.  Every id must belong to the pass set
+        (BeginFeedPass enumerated exactly the pass's feasigns)."""
+        if self._pass_ids is None:
+            raise RuntimeError("slots_of before begin_pass")
+        flat = np.asarray(ids)
+        pos = np.searchsorted(self._pass_ids, flat)
+        pos = np.minimum(pos, len(self._pass_ids) - 1)
+        if not np.array_equal(self._pass_ids[pos], flat):
+            missing = flat[self._pass_ids[pos] != flat]
+            raise KeyError(
+                f"ids outside the current pass working set (first few: "
+                f"{missing.reshape(-1)[:5].tolist()}) — begin_pass must see "
+                f"every id the pass will train on")
+        return pos.astype(np.int64)
+
+    def end_pass(self, cache_value):
+        """Write the trained cache rows back to the host store."""
+        if self._pass_ids is None:
+            raise RuntimeError("end_pass before begin_pass")
+        vals = np.asarray(cache_value, np.float32)[: len(self._pass_ids)]
+        self.host.set_rows(self._pass_ids, vals)
+        self._pass_ids = None
+        self._cache_rows = 0
+
+    def abandon_pass(self):
+        """Close a pull-only pass (inference sweep): no writeback."""
+        self._pass_ids = None
+        self._cache_rows = 0
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def pass_size(self) -> int:
+        return 0 if self._pass_ids is None else len(self._pass_ids)
+
+    @property
+    def cache_rows(self) -> int:
+        return self._cache_rows
+
+    def host_rows(self) -> int:
+        return self.host.size()
+
+
+_wrappers: Dict[str, BoxPSWrapper] = {}
+
+
+def get_box_wrapper(name: str = "default_box", dim: Optional[int] = None,
+                    **kw) -> BoxPSWrapper:
+    """Named singleton registry (BoxWrapper::GetInstance analog)."""
+    w = _wrappers.get(name)
+    if w is None:
+        if dim is None:
+            raise KeyError(f"box wrapper '{name}' not created yet — pass "
+                           f"dim on first use")
+        w = _wrappers[name] = BoxPSWrapper(dim, **kw)
+    return w
+
+
+def reset_box_wrappers():
+    _wrappers.clear()

@@ -101,7 +101,8 @@ def _startup_init_kind(startup_program, w_name):
     return kind, scale
 
 
-def apply_ps_pass(loss, startup_program, optimizer, strategy, role_maker):
+def apply_ps_pass(loss, startup_program, optimizer, strategy, role_maker,
+                  parameter_list=None, no_grad_set=None):
     """Rewrite the program for PS-served training.  Returns
     (params_grads, plan).  Called from fleet.minimize in PS mode INSTEAD of
     optimizer.minimize: backward ops are appended, optimizer ops are not
@@ -168,7 +169,8 @@ def apply_ps_pass(loss, startup_program, optimizer, strategy, role_maker):
                     f"(is_sparse=False)")
 
     # -- 2. backward only (no optimizer ops on the trainer) -----------------
-    params_grads = optimizer.backward(loss, startup_program)
+    params_grads = optimizer.backward(loss, startup_program, parameter_list,
+                                      no_grad_set)
     params_grads = [(p, g) for p, g in params_grads
                     if p.name not in sparse_params]
     for s in plan.sparse:

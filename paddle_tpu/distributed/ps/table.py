@@ -148,6 +148,16 @@ class CommonSparseTable:
             else:
                 raise ValueError(f"unknown accessor {self.optimizer}")
 
+    def set_rows(self, ids: np.ndarray, values: np.ndarray):
+        """Overwrite rows (BoxPS EndPass writeback: the HBM cache trained
+        the values on-device, the host table is plain storage for them —
+        box_wrapper.h:339 EndPass semantics)."""
+        ids = np.asarray(ids).reshape(-1)
+        values = np.asarray(values, np.float32).reshape(len(ids), self.dim)
+        with self._lock:
+            slots = self._slots(ids.tolist())
+            self._vals[slots] = values
+
     def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
         """GEO-SGD merge: server adds trainer deltas (SparseGeoTable)."""
         ids = np.asarray(ids).reshape(-1)
@@ -211,7 +221,8 @@ class CommonDenseTable:
         with self._lock:
             if self.optimizer == "adagrad":
                 self._acc += grad * grad
-                self.value -= self.lr * grad / (np.sqrt(self._acc) + 1e-8)
+                self.value -= (self.lr * grad
+                               / (np.sqrt(self._acc) + self.epsilon))
             elif self.optimizer == "adam":
                 self._t += 1
                 self._acc = self.beta1 * self._acc + (1 - self.beta1) * grad

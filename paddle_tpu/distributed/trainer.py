@@ -38,6 +38,51 @@ class TrainerStats:
                 "stage_fallbacks": self.stage_fallbacks}
 
 
+def _box_pass(program, dataset, train):
+    """BoxPS pass lifecycle around a dataset sweep (box_wrapper.h:339-366
+    BeginPass/EndPass): enumerate the pass's unique feasigns, stage the HBM
+    cache parameter, translate raw ids to cache slots per batch, and (for
+    training) write trained rows back at the end.  Returns
+    (batch_transform, finish) — identity pair when the program has no box
+    plan."""
+    plan = getattr(program, "_hints", {}).get("box_plan")
+    if not plan:
+        return (lambda feed: feed), (lambda: None)
+    from ..distributed.ps.box import get_box_wrapper
+    from ..fluid.core import global_scope
+
+    box = get_box_wrapper(plan["table"], dim=plan["dim"])
+    # pass enumeration sweep (BeginFeedPass analog).  Per-batch unique
+    # BEFORE accumulating keeps the working memory at O(unique), not
+    # O(records); for streaming QueueDatasets this re-reads the filelist
+    # once — InMemoryDataset (the BoxPS-scale tier) iterates its pool.
+    ids_all = []
+    for batch in dataset._iter_batches():
+        for k in plan["ids"]:
+            ids_all.append(np.unique(np.asarray(batch[k])))
+    if not ids_all:
+        return (lambda feed: feed), (lambda: None)
+    cache = box.begin_pass(np.concatenate(ids_all))
+    scope = global_scope()
+    scope.set_var(plan["cache"], cache)
+
+    def transform(feed):
+        out = dict(feed)
+        for k in plan["ids"]:
+            if k in out:
+                raw = np.asarray(out[k])
+                out[k] = box.slots_of(raw.reshape(-1)).reshape(raw.shape)
+        return out
+
+    def finish():
+        if train:
+            box.end_pass(scope.find_var(plan["cache"]))
+        else:
+            box.abandon_pass()            # pull-only pass: no writeback
+
+    return transform, finish
+
+
 def run_from_dataset(executor, program, dataset, fetch_list=None,
                      print_period=100, train=True, prefetch=2):
     from ..utils.prefetch import Prefetcher
@@ -45,6 +90,7 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
     fetch_list = fetch_list or []
     fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
     stats = TrainerStats()
+    box_transform, box_finish = _box_pass(program, dataset, train)
 
     def stage(feed):
         # async H2D: device_put returns immediately, so the transfer of
@@ -52,6 +98,7 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
         # only dtype/shape conversion problems fall back to host — runtime
         # failures (OOM, backend down) must surface, not silently degrade
         import jax
+        feed = box_transform(feed)      # id -> cache-slot translation
         out = {}
         for k, v in feed.items():
             try:
@@ -89,6 +136,7 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
         # on error: cancel + drain so the producer thread and its staged
         # device buffers never leak, and stats still publish
         pf.close()
+        box_finish()
         stats.steps = step
         stats.total_s = time.perf_counter() - t0
         executor._last_trainer_stats = stats

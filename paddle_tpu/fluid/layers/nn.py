@@ -150,6 +150,59 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     return op["Out"][0] if in_dygraph_mode() else out
 
 
+def pull_box_sparse(input, size, table_name="default_box", dtype="float32"):
+    """layers.pull_box_sparse (pull_box_sparse_op.cc) — embedding lookups
+    served by the BoxPS tier (distributed/ps/box.py): the host table can
+    exceed HBM; the op gathers from the per-pass HBM cache PARAMETER, whose
+    rows begin_pass stages and end_pass writes back.  The ids the program
+    sees are cache slots — the trainer's box plan translates raw feasign
+    ids per batch (BoxWrapper::PullSparse:141 analog, with the GPU replica
+    cache redesigned as a normal donated XLA buffer trained by the regular
+    optimizer ops)."""
+    from ..framework import default_main_program
+
+    helper = LayerHelper("pull_box_sparse")
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    main = default_main_program()
+    gb = main.global_block()
+    cache_name = f"{table_name}@HBMCACHE"
+    # validate BEFORE mutating the program: a half-appended op on error
+    # would leave the graph corrupted
+    prior = main._hints.get("box_plan")
+    if prior is not None:
+        if prior["table"] != table_name:
+            raise ValueError(
+                "one box table per program (reference BoxWrapper is a "
+                f"singleton); got a second table '{table_name}' vs "
+                f"'{prior['table']}'")
+        if prior["dim"] != int(size):
+            raise ValueError(
+                f"box table '{table_name}' used with size {size} but was "
+                f"first declared with size {prior['dim']}")
+    if gb.has_var(cache_name):
+        w = gb.var(cache_name)
+    else:
+        # no startup init op on purpose: begin_pass seeds the scope value
+        w = gb.create_parameter(name=cache_name, shape=(-1, int(size)),
+                                dtype=dtype)
+    outs = [helper.create_variable_for_type_inference(dtype=dtype)
+            for _ in inputs]
+    helper.append_op("pull_box_sparse",
+                     inputs={"W": [w], "Ids": inputs},
+                     outputs={"Out": outs},
+                     attrs={"size": int(size)})
+    plan = main._hints.setdefault(
+        "box_plan", {"table": table_name, "cache": cache_name,
+                     "dim": int(size), "ids": []})
+    for v in inputs:
+        n = v.name if hasattr(v, "name") else str(v)
+        if n not in plan["ids"]:
+            plan["ids"].append(n)
+    if isinstance(input, (list, tuple)):
+        return outs
+    return outs[0]
+
+
 def cos_sim(X, Y, name=None):
     """Cosine similarity along the last axis (cos_sim_op.cc)."""
     helper = LayerHelper("cos_sim", name=name)
