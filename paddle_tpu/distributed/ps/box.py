@@ -118,6 +118,9 @@ def get_box_wrapper(name: str = "default_box", dim: Optional[int] = None,
             raise KeyError(f"box wrapper '{name}' not created yet — pass "
                            f"dim on first use")
         w = _wrappers[name] = BoxPSWrapper(dim, **kw)
+    elif dim is not None and w.dim != int(dim):
+        raise ValueError(f"box wrapper '{name}' exists with dim {w.dim}, "
+                         f"requested dim {dim}")
     return w
 
 

@@ -149,9 +149,17 @@ class PsServer:
                                    header.get("init_scale", 0.07),
                                    seed=header.get("seed", 0) * 131
                                    + self.shard_idx)
-                self.sparse[name] = CommonSparseTable(
-                    header["dim"], header.get("optimizer", "sgd"),
-                    header.get("lr", 0.01), initializer=init)
+                acc = header.get("accessor")
+                if acc is not None:        # CTR accessor table (ps.proto)
+                    from .table import CtrAccessorConfig, CtrSparseTable
+                    self.sparse[name] = CtrSparseTable(
+                        CtrAccessorConfig.from_dict(acc),
+                        header.get("optimizer", "sgd"),
+                        header.get("lr", 0.01), initializer=init)
+                else:
+                    self.sparse[name] = CommonSparseTable(
+                        header["dim"], header.get("optimizer", "sgd"),
+                        header.get("lr", 0.01), initializer=init)
             return {"ok": True}, []
         if op == "create_dense":
             name = header["table"]
@@ -164,7 +172,23 @@ class PsServer:
             t = self.sparse[header["table"]]
             return {"ok": True}, [t.pull(arrays[0])]
         if op == "push_sparse":
-            self.sparse[header["table"]].push(arrays[0], arrays[1])
+            t = self.sparse[header["table"]]
+            if len(arrays) >= 4 and hasattr(t, "end_day"):
+                # FeaturePushValue: +show/click (accessor tables only —
+                # plain tables drop the stats rather than crash mid-train)
+                t.push(arrays[0], arrays[1], shows=arrays[2],
+                       clicks=arrays[3])
+            else:
+                t.push(arrays[0], arrays[1])
+            return {"ok": True}, []
+        if op == "shrink":
+            t = self.sparse[header["table"]]
+            n = t.shrink() if hasattr(t, "shrink") else 0
+            return {"ok": True, "evicted": int(n)}, []
+        if op == "end_day":
+            t = self.sparse[header["table"]]
+            if hasattr(t, "end_day"):
+                t.end_day()
             return {"ok": True}, []
         if op == "push_sparse_delta":
             self.sparse[header["table"]].push_delta(arrays[0], arrays[1])
@@ -369,11 +393,13 @@ class PsClient:
 
     # -- table management ---------------------------------------------------
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
-                            seed=0, init_kind="uniform", init_scale=0.07):
+                            seed=0, init_kind="uniform", init_scale=0.07,
+                            accessor=None):
         self._sparse_dims[name] = dim
         self._call_all({"op": "create_sparse", "table": name, "dim": dim,
                         "optimizer": optimizer, "lr": lr, "seed": seed,
-                        "init_kind": init_kind, "init_scale": init_scale})
+                        "init_kind": init_kind, "init_scale": init_scale,
+                        "accessor": accessor})
 
     def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01):
         self._call_all({"op": "create_dense", "table": name,
@@ -412,20 +438,45 @@ class PsClient:
         self._fanout(f"pull_sparse({name})", one)
         return out
 
-    def push_sparse(self, name, ids, grads, delta=False):
+    def push_sparse(self, name, ids, grads, delta=False, shows=None,
+                    clicks=None):
         ids, owner = self._partition(ids)
         if not len(ids):
             return
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         op = "push_sparse_delta" if delta else "push_sparse"
+        stats = shows is not None or clicks is not None
+        if stats:
+            shows = (np.ones(len(ids), np.float32) if shows is None
+                     else np.asarray(shows, np.float32).reshape(-1))
+            clicks = (np.zeros(len(ids), np.float32) if clicks is None
+                      else np.asarray(clicks, np.float32).reshape(-1))
 
         def one(s):
             sel = np.nonzero(owner == s)[0]
             if not len(sel):
                 return
-            self._call(s, {"op": op, "table": name}, [ids[sel], grads[sel]])
+            arrays = [ids[sel], grads[sel]]
+            if stats:
+                arrays += [shows[sel], clicks[sel]]
+            self._call(s, {"op": op, "table": name}, arrays)
 
         self._fanout(f"{op}({name})", one)
+
+    def shrink(self, name) -> int:
+        """Evict cold features on every shard; returns total evicted."""
+        evicted = [0] * len(self.endpoints)
+
+        def one(s):
+            hdr, _ = self._call(s, {"op": "shrink", "table": name})
+            evicted[s] = int(hdr.get("evicted", 0))
+
+        self._fanout(f"shrink({name})", one)
+        return sum(evicted)
+
+    def end_day(self, name):
+        """Decay show/click stats + age unseen counters on every shard."""
+        self._call_all({"op": "end_day", "table": name})
 
     # -- dense --------------------------------------------------------------
     def pull_dense(self, name) -> np.ndarray:

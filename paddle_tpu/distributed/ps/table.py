@@ -121,32 +121,36 @@ class CommonSparseTable:
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
         uniq, inv = np.unique(ids, return_inverse=True)
-        merged = np.zeros((len(uniq), self.dim), np.float32)
-        np.add.at(merged, inv, grads)
         with self._lock:
             slots = self._slots(uniq.tolist())
-            if self.optimizer == "sgd":
-                self._vals[slots] -= self.lr * merged
-            elif self.optimizer == "adagrad":
-                self._ensure_state()
-                acc = self._v[slots] + merged * merged
-                self._v[slots] = acc
-                self._vals[slots] -= (self.lr * merged
-                                      / (np.sqrt(acc) + self.epsilon))
-            elif self.optimizer == "adam":
-                self._ensure_state(want_t=True)
-                t = self._t[slots] + 1
-                self._t[slots] = t
-                m = self.beta1 * self._m[slots] + (1 - self.beta1) * merged
-                v = (self.beta2 * self._v[slots]
-                     + (1 - self.beta2) * merged * merged)
-                self._m[slots], self._v[slots] = m, v
-                mh = m / (1 - self.beta1 ** t[:, None])
-                vh = v / (1 - self.beta2 ** t[:, None])
-                self._vals[slots] -= self.lr * mh / (np.sqrt(vh)
-                                                     + self.epsilon)
-            else:
-                raise ValueError(f"unknown accessor {self.optimizer}")
+            self._apply_grads_locked(slots, inv, grads)
+
+    def _apply_grads_locked(self, slots, inv, grads):
+        """Optimizer step for pre-resolved slots; caller holds the lock."""
+        merged = np.zeros((len(slots), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        if self.optimizer == "sgd":
+            self._vals[slots] -= self.lr * merged
+        elif self.optimizer == "adagrad":
+            self._ensure_state()
+            acc = self._v[slots] + merged * merged
+            self._v[slots] = acc
+            self._vals[slots] -= (self.lr * merged
+                                  / (np.sqrt(acc) + self.epsilon))
+        elif self.optimizer == "adam":
+            self._ensure_state(want_t=True)
+            t = self._t[slots] + 1
+            self._t[slots] = t
+            m = self.beta1 * self._m[slots] + (1 - self.beta1) * merged
+            v = (self.beta2 * self._v[slots]
+                 + (1 - self.beta2) * merged * merged)
+            self._m[slots], self._v[slots] = m, v
+            mh = m / (1 - self.beta1 ** t[:, None])
+            vh = v / (1 - self.beta2 ** t[:, None])
+            self._vals[slots] -= self.lr * mh / (np.sqrt(vh)
+                                                 + self.epsilon)
+        else:
+            raise ValueError(f"unknown accessor {self.optimizer}")
 
     def set_rows(self, ids: np.ndarray, values: np.ndarray):
         """Overwrite rows (BoxPS EndPass writeback: the HBM cache trained
@@ -194,6 +198,158 @@ class CommonSparseTable:
                 self._slot_of[int(i)] = k
             self._n = len(ids)
             self._vals[: len(ids)] = vals
+
+
+class CtrAccessorConfig:
+    """DownpourCtrAccessor knobs (ps.proto:53-124 CtrAccessorParameter):
+    feature lifetime is governed by show/click statistics, not just
+    gradients."""
+
+    def __init__(self, embedx_dim=8, embedx_threshold=10,
+                 show_click_decay_rate=0.98, delete_threshold=0.8,
+                 delete_after_unseen_days=30, nonclk_coeff=0.1,
+                 click_coeff=1.0):
+        self.embedx_dim = int(embedx_dim)
+        self.embedx_threshold = float(embedx_threshold)
+        self.show_click_decay_rate = float(show_click_decay_rate)
+        self.delete_threshold = float(delete_threshold)
+        self.delete_after_unseen_days = int(delete_after_unseen_days)
+        self.nonclk_coeff = float(nonclk_coeff)
+        self.click_coeff = float(click_coeff)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{k: v for k, v in (d or {}).items()
+                      if k in cls().__dict__})
+
+
+class CtrSparseTable(CommonSparseTable):
+    """CTR accessor table (large_scale_kv.h feature layout +
+    DownpourCtrAccessor semantics): each row is [w | embedx] where the
+    1-dim `w` trains from first touch but the embedx_dim extension is only
+    ADMITTED — lazily initialised and trained — once the feature's
+    show/click score passes `embedx_threshold`.  Per-row show/click decay
+    daily (`end_day`), and `shrink` evicts rows whose score fell below
+    `delete_threshold` or that were unseen too long — real ad-vocab churn
+    (features are born hot and die cold) without unbounded growth."""
+
+    def __init__(self, accessor: CtrAccessorConfig = None, optimizer="sgd",
+                 lr=0.01, initializer=None, **kw):
+        self.cfg = accessor or CtrAccessorConfig()
+        super().__init__(1 + self.cfg.embedx_dim, optimizer, lr,
+                         initializer=initializer, **kw)
+        cap = len(self._vals)
+        self._show = np.zeros(cap, np.float32)
+        self._click = np.zeros(cap, np.float32)
+        self._unseen = np.zeros(cap, np.int32)
+        self._admitted = np.zeros(cap, bool)
+
+    # -- storage hooks ------------------------------------------------------
+    def _grow(self, need):
+        old_cap = len(self._vals)
+        super()._grow(need)
+        cap = len(self._vals)
+        if cap != old_cap:
+            for attr, dt in (("_show", np.float32), ("_click", np.float32),
+                             ("_unseen", np.int32), ("_admitted", bool)):
+                arr = getattr(self, attr)
+                g = np.zeros(cap, dt)
+                g[: self._n] = arr[: self._n]
+                setattr(self, attr, g)
+
+    def _slots(self, uniq_ids):
+        slots = super()._slots(uniq_ids)
+        # fresh rows: only w trains until admission — zero the embedx part
+        # the base initializer may have seeded
+        fresh = ~self._admitted[slots] & (self._show[slots] == 0)
+        if fresh.any():
+            self._vals[slots[fresh], 1:] = 0.0
+        return slots
+
+    def _score(self, slots):
+        show, click = self._show[slots], self._click[slots]
+        return (self.cfg.nonclk_coeff * (show - click)
+                + self.cfg.click_coeff * click)
+
+    # -- accessor API -------------------------------------------------------
+    def pull(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            uniq, inv = np.unique(ids, return_inverse=True)
+            slots = self._slots(uniq.tolist())
+            rows = self._vals[slots].copy()
+            rows[~self._admitted[slots], 1:] = 0.0   # cold: w only
+            return rows[inv]
+
+    def push(self, ids, grads, shows=None, clicks=None):
+        """FeaturePushValue: grads plus per-position show/click deltas.
+        Stats land first, then admission is (re)evaluated, then the
+        optimizer trains w always and embedx only where admitted."""
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        shows = (np.ones(len(ids), np.float32) if shows is None
+                 else np.asarray(shows, np.float32).reshape(-1))
+        clicks = (np.zeros(len(ids), np.float32) if clicks is None
+                  else np.asarray(clicks, np.float32).reshape(-1))
+        uniq, inv = np.unique(ids, return_inverse=True)
+        with self._lock:        # one slot resolve, stats+admission+train
+            slots = self._slots(uniq.tolist())
+            np.add.at(self._show, slots[inv], shows)
+            np.add.at(self._click, slots[inv], clicks)
+            self._unseen[slots] = 0
+            newly = (~self._admitted[slots]
+                     & (self._score(slots) >= self.cfg.embedx_threshold))
+            if newly.any():
+                init = self.init(int(newly.sum()), self.dim - 1)
+                self._vals[slots[newly], 1:] = init
+                self._admitted[slots[newly]] = True
+            grads = grads.copy()
+            grads[~self._admitted[slots][inv], 1:] = 0.0   # cold embedx
+            self._apply_grads_locked(slots, inv, grads)
+
+    def end_day(self):
+        """Daily stat decay + unseen aging (DownpourCtrAccessor
+        show_click_decay_rate; heart of the churn model)."""
+        with self._lock:
+            n = self._n
+            self._show[:n] *= self.cfg.show_click_decay_rate
+            self._click[:n] *= self.cfg.show_click_decay_rate
+            self._unseen[:n] += 1
+
+    def shrink(self):
+        """Evict cold features (Table::Shrink): score below the delete
+        threshold or unseen beyond the horizon.  Compacts storage and
+        returns the number evicted."""
+        with self._lock:
+            n = self._n
+            slots = np.arange(n)
+            keep = ((self._score(slots) >= self.cfg.delete_threshold)
+                    & (self._unseen[:n]
+                       <= self.cfg.delete_after_unseen_days))
+            if keep.all():
+                return 0
+            kept_slots = slots[keep]
+            remap = {int(s): k for k, s in enumerate(kept_slots)}
+            self._slot_of = {i: remap[s] for i, s in self._slot_of.items()
+                             if s in remap}
+            m = len(kept_slots)
+            self._vals[:m] = self._vals[kept_slots]
+            self._vals[m:n] = 0.0     # freed tail: no stale state may leak
+            for attr in ("_show", "_click", "_unseen", "_admitted"):
+                arr = getattr(self, attr)
+                arr[:m] = arr[kept_slots]
+                arr[m:n] = 0
+            for attr in ("_m", "_v"):
+                arr = getattr(self, attr)
+                if arr is not None:
+                    arr[:m] = arr[kept_slots]
+                    arr[m:n] = 0.0
+            if self._t is not None:
+                self._t[:m] = self._t[kept_slots]
+                self._t[m:n] = 0
+            evicted = n - m
+            self._n = m
+            return evicted
 
 
 class CommonDenseTable:

@@ -342,3 +342,59 @@ def _ps_lookup_rows(ins, attrs, ctx):
     if pad is not None and pad >= 0:
         out = jnp.where((ids == pad)[..., None], 0.0, out)
     return {"Out": [out]}
+
+
+@register_op("data_norm",
+             nondiff_inputs=("BatchSize", "BatchSum", "BatchSquareSum"),
+             nondiff_outputs=("Means", "Scales", "BatchSizeOut",
+                              "BatchSumOut", "BatchSquareSumOut"))
+def _data_norm(ins, attrs, ctx):
+    """CTR feature normalization with PERSISTABLE summary statistics
+    (operators/data_norm_op.cc:292-303 forward; :650-698 stat
+    accumulation).  Unlike batch_norm, the normalizer comes from the
+    running summary (means = batch_sum/batch_size, scales =
+    sqrt(batch_size/batch_square_sum)) and the backward treats it as a
+    constant — d_x = d_y * scales falls out of the vjp because the stats
+    are nondiff inputs.  TPU-native: the reference routes stat deltas
+    through grad-op outputs + a PS summary accessor; here the op itself
+    emits the decayed running update (summary_decay_rate) as write-back
+    outputs, which the executor persists — one mechanism for single-chip
+    and PS runs.  slot_dim > 0 replicates the show!=0 gating: instances
+    whose slot's first element (the show count) is ~zero are skipped in
+    the stat update (:655-663)."""
+    x = ins["X"][0]
+    bsize, bsum, bsq = (ins["BatchSize"][0], ins["BatchSum"][0],
+                        ins["BatchSquareSum"][0])
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x - means) * scales
+    if ins.get("ScaleW"):
+        y = y * ins["ScaleW"][0] + ins["Bias"][0]
+    outs = {"Y": [y], "Means": [means], "Scales": [scales]}
+    if getattr(ctx, "is_test", False):
+        return outs
+    eps = attrs.get("epsilon", 1e-4)
+    decay = attrs.get("summary_decay_rate", 0.9999999)
+    slot_dim = int(attrs.get("slot_dim", -1))
+    n, c = x.shape[0], x.shape[-1]
+    if slot_dim > 0 and c % slot_dim == 0:
+        xm = x.reshape(n, c // slot_dim, slot_dim)
+        live = (jnp.abs(xm[:, :, 0]) > 1e-7)[..., None]      # show != 0
+        cnt_s = live.sum(0).astype(x.dtype)                  # [slots, 1]
+        cnt = jnp.broadcast_to(cnt_s, (c // slot_dim, slot_dim)).reshape(c)
+        ssum = (xm * live).sum(0).reshape(c)
+        ssq = (((xm - means.reshape(c // slot_dim, slot_dim)) ** 2)
+               * live).sum(0).reshape(c)
+        # per-batch normalization to size 1 (data_norm_op.cc:672-683)
+        safe = jnp.maximum(cnt, 1.0)
+        d_size = jnp.where(cnt >= 1, 1.0, 0.0)
+        d_sum = jnp.where(cnt >= 1, ssum / safe, 0.0)
+        d_sq = jnp.where(cnt >= 1, ssq / safe + cnt * eps, 0.0)
+    else:
+        d_size = jnp.full((c,), float(n), x.dtype)
+        d_sum = x.reshape(-1, c).sum(0)
+        d_sq = ((x - means) ** 2).reshape(-1, c).sum(0) + n * eps
+    outs["BatchSizeOut"] = [decay * bsize + d_size]
+    outs["BatchSumOut"] = [decay * bsum + d_sum]
+    outs["BatchSquareSumOut"] = [decay * bsq + d_sq]
+    return outs

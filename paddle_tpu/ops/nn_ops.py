@@ -324,15 +324,9 @@ def _group_norm(ins, attrs, ctx):
             "Variance": [v.reshape(n, g)]}
 
 
-@register_op("data_norm")
-def _data_norm(ins, attrs, ctx):
-    # CTR data_norm (operators/data_norm_op.cc): normalize by accumulated
-    # batch statistics stored as persistable vars
-    x = _x(ins)
-    size, sum_, sqsum = ins["BatchSize"][0], ins["BatchSum"][0], ins["BatchSquareSum"][0]
-    means = sum_ / size
-    scales = jnp.sqrt(size / sqsum)
-    return {"Y": [(x - means) * scales], "Means": [means], "Scales": [scales]}
+# data_norm (CTR summary-stat normalization) lives in ctr_ops.py: the full
+# semantics — persistable stat accumulation, slot show-gating, decay — are
+# CTR machinery, not a norm-family variant.
 
 
 @register_op("l2_normalize")

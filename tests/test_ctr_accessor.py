@@ -1,0 +1,198 @@
+"""CTR accessor table tests: embedx admission, daily decay, shrink
+eviction — DownpourCtrAccessor semantics (ps.proto:53-124
+CtrAccessorParameter, large_scale_kv.h feature layout)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps.table import (CtrAccessorConfig,
+                                             CtrSparseTable, Initializer)
+from paddle_tpu.distributed.ps.rpc import PsServer, PsClient
+
+
+def make_table(**cfg):
+    defaults = dict(embedx_dim=4, embedx_threshold=3.0,
+                    show_click_decay_rate=0.5, delete_threshold=0.2,
+                    delete_after_unseen_days=2, nonclk_coeff=0.1,
+                    click_coeff=1.0)
+    defaults.update(cfg)
+    return CtrSparseTable(CtrAccessorConfig(**defaults), "sgd", 1.0,
+                          initializer=Initializer("gaussian", 0.1, seed=1))
+
+
+class TestAdmission:
+    def test_embedx_gated_until_threshold(self):
+        t = make_table()          # threshold: score >= 3 (clicks count 1.0)
+        g = np.ones((1, 5), np.float32)
+        # 2 clicks: score 2.0 < 3 -> embedx stays zero, w trains
+        t.push([7], g, shows=[1.0], clicks=[1.0])
+        t.push([7], g, shows=[1.0], clicks=[1.0])
+        row = t.pull([7])[0]
+        assert row[0] != 0.0                   # w trained from first touch
+        np.testing.assert_array_equal(row[1:], 0)
+        # third click crosses the threshold: embedx admitted + initialised
+        t.push([7], g, shows=[1.0], clicks=[1.0])
+        row = t.pull([7])[0]
+        assert np.any(row[1:] != 0)            # init - lr*grad
+        # and from now on embedx trains
+        before = t.pull([7])[0][1:].copy()
+        t.push([7], g, shows=[1.0], clicks=[0.0])
+        after = t.pull([7])[0][1:]
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+
+    def test_cold_feature_never_trains_embedx(self):
+        t = make_table(embedx_threshold=1e9)
+        w0 = t.pull([3])[0][0]                 # initializer's w
+        g = np.ones((1, 5), np.float32)
+        for _ in range(10):
+            t.push([3], g)
+        row = t.pull([3])[0]
+        np.testing.assert_array_equal(row[1:], 0)
+        np.testing.assert_allclose(row[0], w0 - 10.0, rtol=1e-6)
+
+
+class TestDecayAndShrink:
+    def test_unseen_eviction(self):
+        t = make_table()
+        g = np.ones((1, 5), np.float32)
+        t.push([1], g, shows=[5.0], clicks=[5.0])   # hot feature
+        t.push([2], g, shows=[5.0], clicks=[5.0])
+        t.end_day(); t.end_day(); t.end_day()       # unseen 3 > horizon 2
+        t.push([1], g, shows=[5.0], clicks=[5.0])   # id 1 seen again
+        assert t.shrink() == 1                       # id 2 evicted
+        assert 2 not in t._slot_of and 1 in t._slot_of
+
+    def test_score_decay_eviction(self):
+        t = make_table(delete_threshold=1.0, delete_after_unseen_days=99)
+        g = np.ones((1, 5), np.float32)
+        t.push([4], g, shows=[2.0], clicks=[2.0])    # score 2.0
+        assert t.shrink() == 0
+        t.end_day(); t.end_day()                     # score 2*0.25=0.5 < 1
+        assert t.shrink() == 1
+        assert t.size() == 0
+
+    def test_shrink_compacts_and_preserves_survivors(self):
+        t = make_table(delete_threshold=0.5, delete_after_unseen_days=99)
+        g = np.zeros((1, 5), np.float32)
+        for i in range(20):
+            clicks = [5.0] if i % 2 == 0 else [0.1]
+            t.push([i], g, shows=clicks, clicks=clicks)
+        hot_rows = {i: t.pull([i])[0].copy() for i in range(0, 20, 2)}
+        evicted = t.shrink()
+        assert evicted == 10
+        assert t.size() == 10
+        for i, row in hot_rows.items():
+            np.testing.assert_array_equal(t.pull([i])[0], row)
+
+
+class TestDataNorm:
+    """data_norm: persistable summary stats, NOT a batch-norm variant
+    (data_norm_op.cc; kills the OP_COVERAGE '?' entry)."""
+
+    def _build(self, slot_dim=-1, n=8, c=6):
+        import paddle_tpu.fluid as fluid
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("dn_x", [-1, c])
+            y = fluid.layers.data_norm(x, name="dn", slot_dim=slot_dim,
+                                       summary_decay_rate=1.0)
+            loss = fluid.layers.mean(y)
+            fluid.optimizer.SGDOptimizer(0.0).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe, main, y, loss
+
+    def test_forward_uses_summary_not_batch(self):
+        from paddle_tpu.fluid.core import global_scope
+        exe, main, y, loss = self._build()
+        rng = np.random.RandomState(0)
+        x = (rng.randn(8, 6) * 3 + 5).astype("float32")
+        yv, = exe.run(main, feed={"dn_x": x}, fetch_list=[y])
+        # init stats: mean 0/1e4=0, scale sqrt(1e4/1e4)=1 -> y == x
+        np.testing.assert_allclose(yv, x, rtol=1e-5)
+        # stats accumulated: batch_size 1e4+8, batch_sum += col sums
+        s = global_scope()
+        np.testing.assert_allclose(np.asarray(s.find_var("dn.batch_size")),
+                                   1e4 + 8, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(s.find_var("dn.batch_sum")),
+                                   x.sum(0), rtol=1e-5)
+        # second run normalizes with the UPDATED summary
+        yv2, = exe.run(main, feed={"dn_x": x}, fetch_list=[y])
+        mean = x.sum(0) / (1e4 + 8)
+        sq = 1e4 + ((x - 0.0) ** 2).sum(0) + 8 * 1e-4
+        scale = np.sqrt((1e4 + 8) / sq)
+        np.testing.assert_allclose(yv2, (x - mean) * scale, rtol=1e-4)
+
+    def test_eval_clone_freezes_stats(self):
+        from paddle_tpu.fluid.core import global_scope
+        import paddle_tpu.fluid as fluid
+        exe, main, y, loss = self._build()
+        test_prog = main.clone(for_test=True)
+        x = np.random.RandomState(1).randn(4, 6).astype("float32")
+        exe.run(test_prog, feed={"dn_x": x}, fetch_list=[y.name])
+        np.testing.assert_allclose(
+            np.asarray(global_scope().find_var("dn.batch_size")), 1e4)
+
+    def test_slot_dim_skips_zero_show(self):
+        from paddle_tpu.fluid.core import global_scope
+        exe, main, y, loss = self._build(slot_dim=3, c=6)
+        x = np.ones((4, 6), np.float32)
+        x[2, 0] = 0.0          # instance 2, slot 0: show == 0 -> skipped
+        exe.run(main, feed={"dn_x": x}, fetch_list=[y])
+        bsum = np.asarray(global_scope().find_var("dn.batch_sum"))
+        # slot 0 cols: mean of 3 live instances (normalized to size 1)
+        np.testing.assert_allclose(bsum[:3], [1.0, 1.0, 1.0], rtol=1e-6)
+        np.testing.assert_allclose(bsum[3:], [1.0, 1.0, 1.0], rtol=1e-6)
+        bsize = np.asarray(global_scope().find_var("dn.batch_size"))
+        np.testing.assert_allclose(bsize, 1e4 + 1.0, rtol=1e-6)
+
+    def test_grad_is_dy_times_scales(self):
+        """Backward treats the summary as a constant (d_x = d_y * scales,
+        data_norm_op.cc:614) — the stat snapshot keeps this exact even
+        though the op also writes the updated stats."""
+        import paddle_tpu.fluid as fluid
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("g_x", [-1, 4])
+            x.stop_gradient = False
+            y = fluid.layers.data_norm(x, name="gdn",
+                                       param_attr={"batch_square": 4e4})
+            loss = fluid.layers.reduce_sum(y)
+            grads = fluid.backward.gradients(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(2).randn(5, 4).astype("float32")
+        gv, = exe.run(main, feed={"g_x": xv}, fetch_list=[grads[0]])
+        # scales = sqrt(1e4/4e4) = 0.5; d loss/d y = 1
+        np.testing.assert_allclose(gv, 0.5 * np.ones_like(xv), rtol=1e-6)
+
+
+class TestAccessorOverRpc:
+    def test_rpc_accessor_lifecycle(self):
+        servers = [PsServer(port=0, shard_idx=i, n_servers=2,
+                            n_trainers=1).start() for i in range(2)]
+        try:
+            c = PsClient([s.endpoint for s in servers])
+            c.create_sparse_table(
+                "ctr", 5, lr=1.0, init_kind="zeros",
+                accessor={"embedx_dim": 4, "embedx_threshold": 2.0,
+                          "show_click_decay_rate": 0.5,
+                          "delete_threshold": 0.4,
+                          "delete_after_unseen_days": 99})
+            ids = np.array([10, 11], np.int64)     # lands on both shards
+            g = np.ones((2, 5), np.float32)
+            c.push_sparse("ctr", ids, g, shows=[1.0, 1.0],
+                          clicks=[1.0, 1.0])
+            rows = c.pull_sparse("ctr", ids)
+            np.testing.assert_array_equal(rows[:, 1:], 0)   # not admitted
+            c.push_sparse("ctr", ids, g, shows=[1.0, 1.0],
+                          clicks=[1.0, 1.0])                # score hits 2.0
+            rows = c.pull_sparse("ctr", ids)
+            np.testing.assert_allclose(rows[:, 1:], -1.0)   # zeros - lr*g
+            c.end_day("ctr"); c.end_day("ctr")   # decay 2.0 -> 0.5 >= 0.4
+            assert c.shrink("ctr") == 0
+            c.end_day("ctr")                     # 0.25 < 0.4
+            assert c.shrink("ctr") == 2
+            c.close()
+        finally:
+            for s in servers:
+                s.stop()

@@ -37,7 +37,6 @@ NOT_APPLICABLE = {
     "dgc_clip_by_norm": "folded into dgc_momentum lowering",
     "allreduce": "legacy alias of c_allreduce_sum",
     "broadcast": "legacy alias of c_broadcast",
-    "data_norm": "covered via batch/instance norm family?",
 }
 
 
