@@ -84,19 +84,38 @@ def _eager_hosts_reduce(value, mode):
     return gathered.prod(axis=0)
 
 
+def _unwrap(tensor):
+    """Framework VarBase -> raw value (eager collectives operate on it)."""
+    return tensor._value if hasattr(tensor, "_value") else tensor
+
+
+def _writeback(tensor, result):
+    """Reference paddle.distributed contract: eager collectives mutate
+    `tensor` IN PLACE (collective.py:all_reduce writes to the input var),
+    so reference-style call sites that discard the return value must see
+    the reduced data.  VarBases get the result written back; plain arrays
+    are immutable here, so the caller must use the return value."""
+    if hasattr(tensor, "_value"):
+        import jax.numpy as jnp
+        tensor._value = jnp.asarray(result)
+    return result
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=0):
     """In-trace: lax.psum/pmax/pmin over the group's mesh axis.  Eager:
-    host all-reduce over processes (identity for world size 1)."""
+    host all-reduce over processes (identity for world size 1), written
+    back into a framework VarBase input."""
     import jax
     from jax import lax
     mode = _OP_NAMES[op]
     axis = _bound_axis(group)
-    if axis is not None and isinstance(tensor, jax.core.Tracer):
+    value = _unwrap(tensor)
+    if axis is not None and isinstance(value, jax.core.Tracer):
         fn = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}.get(mode)
         if fn is None:
             raise ValueError("PROD all_reduce is not supported in-trace")
-        return fn(tensor, axis)
-    return _eager_hosts_reduce(tensor, mode)
+        return fn(value, axis)
+    return _writeback(tensor, _eager_hosts_reduce(value, mode))
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=0):
@@ -108,17 +127,18 @@ def reduce(tensor, dst, op=ReduceOp.SUM, group=0):
 def broadcast(tensor, src, group=0):
     import jax
     axis = _bound_axis(group)
-    if axis is not None and isinstance(tensor, jax.core.Tracer):
+    value = _unwrap(tensor)
+    if axis is not None and isinstance(value, jax.core.Tracer):
         from jax import lax
         # select src's value on every member: gather then index is the
         # portable XLA formulation (compiles to an ICI broadcast)
-        return lax.all_gather(tensor, axis)[src]
+        return lax.all_gather(value, axis)[src]
     if jax.process_count() <= 1:
         return tensor
     from jax.experimental import multihost_utils
-    arr = np.asarray(tensor)
+    arr = np.asarray(value)
     gathered = np.asarray(multihost_utils.process_allgather(arr))
-    return gathered[src]
+    return _writeback(tensor, gathered[src])
 
 
 def all_gather(tensor_list, tensor, group=0):
@@ -144,23 +164,25 @@ def scatter(tensor, tensor_list=None, src=0, group=0):
     """Rank r receives tensor_list[r] held by src."""
     import jax
     axis = _bound_axis(group)
-    if axis is not None and isinstance(tensor, jax.core.Tracer):
+    value = _unwrap(tensor)
+    if axis is not None and isinstance(value, jax.core.Tracer):
         from jax import lax
         # in-trace: every member traces the same stack; each takes its row
-        stacked = jax.numpy.stack(list(tensor_list))
+        stacked = jax.numpy.stack([_unwrap(t) for t in tensor_list])
         return lax.dynamic_index_in_dim(stacked, lax.axis_index(axis),
                                         keepdims=False)
     if jax.process_count() <= 1:
-        return tensor_list[0] if tensor_list else tensor
+        result = _unwrap(tensor_list[0]) if tensor_list else value
+        return _writeback(tensor, result)
     from jax.experimental import multihost_utils
     is_src = get_rank() == src
-    stacked = (np.stack([np.asarray(t) for t in tensor_list])
+    stacked = (np.stack([np.asarray(_unwrap(t)) for t in tensor_list])
                if is_src and tensor_list
-               else np.zeros((get_world_size(),) + np.shape(tensor),
-                             np.asarray(tensor).dtype))
+               else np.zeros((get_world_size(),) + np.shape(value),
+                             np.asarray(value).dtype))
     # ship src's stack to everyone, then each rank takes its row
     out = multihost_utils.broadcast_one_to_all(stacked, is_source=is_src)
-    return np.asarray(out)[get_rank()]
+    return _writeback(tensor, np.asarray(out)[get_rank()])
 
 
 def barrier(group=0):

@@ -256,13 +256,37 @@ def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
     multiproc = rt.client is not None
 
     # -- pull phase ---------------------------------------------------------
+    # capture EVERY slot's original ids first: slots may share one ids var,
+    # and the device remap below must never leak into another slot's pull
+    # or into the push phase (full-width ids only)
+    flat_ids = {}                   # slot rows-name -> ORIGINAL flat ids
     for s in plan.sparse:
         if s["ids"] not in feed:
             raise KeyError(f"PS run: feed missing ids var '{s['ids']}'")
-        flat = np.asarray(feed[s["ids"]]).reshape(-1)
-        rows = rt.ps_pull_sparse(s["table"], flat)
+        flat_ids[s["rows"]] = np.asarray(feed[s["ids"]]).reshape(-1).copy()
+    remaps = {}
+    for s in plan.sparse:
+        flat = flat_ids[s["rows"]]
+        ids = flat.reshape(np.shape(feed[s["ids"]]))
+        rows = rt.ps_pull_sparse(s["table"], flat)   # full-width host pull
         feed[s["rows"]] = np.asarray(rows, np.float32).reshape(
             len(flat), s["dim"])
+        if ids.dtype in (np.int64, np.uint64) and ids.size \
+                and ids.max(initial=0) > 2 ** 31 - 1:
+            # the DEVICE only reads ids for shape + padding positions (the
+            # rows feed is positional); wide feasigns must not truncate on
+            # staging, so remap to a safe int32 pattern preserving ==pad
+            pad = -1
+            for op in program.global_block().ops:
+                if op.type == "ps_lookup_rows" \
+                        and op.input("Ids") == [s["ids"]]:
+                    pad = int(op.attr("padding_idx", -1))
+            safe_val = 0 if pad == 1 else 1     # never collide with pad
+            safe = (np.where(ids == pad, pad, safe_val).astype(np.int64)
+                    if pad >= 0
+                    else np.full_like(ids, safe_val, dtype=np.int64))
+            remaps[s["ids"]] = safe
+    feed.update(remaps)             # after ALL pulls read the originals
     for d in plan.dense:
         val = rt.ps_pull_dense(d["param"])
         scope.set_var(d["param"],
@@ -287,7 +311,7 @@ def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
         grads = outs[len(user_fetch):]
         k = 0
         for s in plan.sparse:
-            flat = np.asarray(feed[s["ids"]]).reshape(-1)
+            flat = flat_ids[s["rows"]]
             rt.ps_push_sparse(s["table"], flat,
                               np.asarray(grads[k]).reshape(len(flat),
                                                            s["dim"]))

@@ -371,7 +371,12 @@ class PsClient:
             try:
                 shard_fn(i)
             except Exception as e:           # noqa: BLE001 — re-raised below
-                errs.append((self.endpoints[i], e))
+                # i may exceed the endpoint list (put_blobs fans out over
+                # DEST ranks, not server shards) — never let the error
+                # handler itself throw, or the failure is silently lost
+                ep = (self.endpoints[i] if 0 <= i < len(self.endpoints)
+                      else f"shard{i}")
+                errs.append((ep, e))
 
         ts = [threading.Thread(target=one, args=(i,)) for i in shards]
         for t in ts:

@@ -98,10 +98,13 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
         # only dtype/shape conversion problems fall back to host — runtime
         # failures (OOM, backend down) must surface, not silently degrade
         import jax
+        from ..fluid.executor import check_feed_width
         feed = box_transform(feed)      # id -> cache-slot translation
         out = {}
         for k, v in feed.items():
             try:
+                check_feed_width(k, np.asarray(v) if not hasattr(v, "dtype")
+                                 else v)
                 out[k] = jax.device_put(v)
             except (TypeError, ValueError):
                 stats.stage_fallbacks += 1

@@ -98,6 +98,12 @@ _FLAGS: Dict[str, object] = {
     # FLAGS_deterministic_rng=True for threefry (bit-reproducible across
     # backends, like cudnn_deterministic in platform/flags.cc:98).
     "deterministic_rng": False,
+    # 64-bit integer feeds on device.  Off by default (jax x64 mode also
+    # promotes float64, hurting TPU perf); the framework's CTR paths keep
+    # full-width uint64 feasigns HOST-side (PS/Box tiers translate ids to
+    # indices in numpy), so device programs rarely need 64-bit ints.  The
+    # executor raises on silently-truncating feeds instead of corrupting.
+    "enable_x64": False,
 }
 
 
@@ -131,6 +137,9 @@ def set_flags(flags: Dict[str, object]):
         _FLAGS[k] = v
         if k == "deterministic_rng":
             _apply_prng_impl(bool(v))
+        elif k == "enable_x64":
+            import jax
+            jax.config.update("jax_enable_x64", bool(v))
 
 
 def get_flags(names):

@@ -42,6 +42,28 @@ def _fetch_name(f):
     return f.name if isinstance(f, Variable) else str(f)
 
 
+_I32_MAX, _I32_MIN = 2 ** 31 - 1, -(2 ** 31)
+
+
+def check_feed_width(name, v):
+    """Without x64, jax canonicalizes int64/uint64 feeds to 32 bits — for
+    CTR feasigns that is silent data corruption (2^32 collisions on real ad
+    ids).  Fail loudly instead; host-side numpy inputs only (device arrays
+    were staged by a path that already checked)."""
+    import jax
+    if jax.config.jax_enable_x64 or not isinstance(v, np.ndarray):
+        return
+    if v.dtype not in (np.int64, np.uint64) or v.size == 0:
+        return
+    if v.max(initial=0) > _I32_MAX or v.min(initial=0) < _I32_MIN:
+        raise OverflowError(
+            f"feed '{name}' holds 64-bit integers outside the int32 range; "
+            f"they would be silently truncated on device (x64 is off).  "
+            f"Route wide feasign ids through the PS/Box embedding tiers — "
+            f"ids are translated host-side at full width — or opt in with "
+            f"fluid.core.set_flags({{'FLAGS_enable_x64': True}})")
+
+
 def _fingerprint(program: Program) -> str:
     h = hashlib.sha1()
     for b in program.blocks:
@@ -213,6 +235,9 @@ class Executor:
                if n in compiled.written_names}
         ro = {n: scope.find_var(n) for n in compiled.param_names
               if n not in compiled.written_names}
+        for k, v in feed.items():
+            check_feed_width(k, np.asarray(v)
+                             if isinstance(v, (list, tuple)) else v)
         feeds = {k: jnp.asarray(v) for k, v in feed.items()}
         seed = program.random_seed if program.random_seed is not None else 0
         step_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)

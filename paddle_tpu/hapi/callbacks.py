@@ -141,12 +141,15 @@ class EarlyStopping(Callback):
                 self.model.save(f"{self.save_dir}/best_model")
         else:
             self.wait += 1
-            if self.wait >= max(1, self.patience):   # reference: >= patience
-                self.stopped_epoch = epoch
-                self.model.stop_training = True
-                if self.verbose:
-                    print(f"Epoch {epoch}: early stopping "
-                          f"(best {self.monitor}={self.best})")
+        # reference (hapi/callbacks.py EarlyStopping) checks the stop
+        # condition UNCONDITIONALLY after every eval: patience=0 stops
+        # after the first evaluation even if it improved
+        if self.wait >= self.patience:
+            self.stopped_epoch = epoch
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"Epoch {epoch}: early stopping "
+                      f"(best {self.monitor}={self.best})")
 
 
 class ReduceLROnPlateau(Callback):
@@ -192,7 +195,7 @@ class ReduceLROnPlateau(Callback):
         if in_cooldown:
             return                       # plateau epochs inside cooldown
         self.wait += 1                   # don't count toward patience
-        if self.wait > self.patience:
+        if self.wait >= self.patience:   # reference fires AT patience
             from ..optimizer import lr as lrmod
             if isinstance(getattr(opt, "_lr", None), lrmod.LRScheduler):
                 if self.verbose and not getattr(self, "_sched_warned",

@@ -1,0 +1,127 @@
+"""Full-width 64-bit feasign ids: no silent truncation anywhere.
+
+Reference CTR ids are uint64 (framework/data_feed.h SlotRecord); without
+x64, jax canonicalizes 64-bit feeds to 32 bits — 2^32 collisions on real
+ad ids is data corruption, not a warning.  The framework's contract: wide
+ids stay HOST-side (PS/Box tiers translate them in numpy at full width),
+device-bound feeds that would truncate raise loudly, and x64 is an opt-in
+flag."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.distributed.ps.box import BoxPSWrapper, reset_box_wrappers
+from paddle_tpu.distributed.ps.table import CommonSparseTable, Initializer
+
+WIDE = 2 ** 35          # any id > int32 range
+
+
+class TestHostTablesFullWidth:
+    def test_high_word_ids_are_distinct_rows(self):
+        """ids differing ONLY in the high 32 bits must not collide."""
+        t = CommonSparseTable(4, "sgd", 1.0,
+                              initializer=Initializer("zeros"))
+        lo, hi = 7, 7 + 2 ** 33
+        g = np.ones((1, 4), np.float32)
+        t.push([lo], g)
+        np.testing.assert_allclose(t.pull([lo])[0], -1.0)
+        np.testing.assert_allclose(t.pull([hi])[0], 0.0)   # untouched
+        assert t.size() == 2
+
+    def test_box_tier_full_width(self):
+        reset_box_wrappers()
+        box = BoxPSWrapper(2, init_kind="zeros")
+        ids = np.array([5, 5 + 2 ** 40], np.int64)
+        cache = box.begin_pass(ids)
+        slots = box.slots_of(ids)
+        assert slots[0] != slots[1]            # distinct working-set rows
+        trained = np.asarray(cache)
+        trained[slots[0]] = [1.0, 1.0]
+        box.end_pass(trained)
+        assert box.host_rows() == 2
+        np.testing.assert_allclose(
+            box.begin_pass(np.array([5 + 2 ** 40], np.int64))[0], 0.0)
+
+
+class TestDeviceFeedGuard:
+    def test_wide_feed_raises_instead_of_truncating(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("wide_x", [-1, 2], dtype="int64")
+            y = fluid.layers.cast(x, "float32")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        wide = np.array([[1, WIDE]], np.int64)
+        with pytest.raises(OverflowError, match="PS/Box"):
+            exe.run(main, feed={"wide_x": wide}, fetch_list=[y])
+        # in-range int64 feeds stay fine (labels, lengths, small vocabs)
+        ok = np.array([[1, 2]], np.int64)
+        out, = exe.run(main, feed={"wide_x": ok}, fetch_list=[y])
+        np.testing.assert_allclose(out, [[1.0, 2.0]])
+
+    def test_x64_flag_lifts_the_guard(self):
+        from paddle_tpu.fluid import core
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x64_x", [-1, 1], dtype="int64")
+            y = fluid.layers.cast(x, "float32")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        try:
+            core.set_flags({"FLAGS_enable_x64": True})
+            out, = exe.run(main, feed={"x64_x": np.array([[WIDE]],
+                                                         np.int64)},
+                           fetch_list=[y])
+            assert float(out[0][0]) == float(WIDE)
+        finally:
+            core.set_flags({"FLAGS_enable_x64": False})
+
+
+class TestPsProgramWideIds:
+    def test_ps_program_trains_wide_feasigns(self):
+        """The PS program path serves 2^40-spaced ids end-to-end: pulls are
+        host-side full width; the device sees only positional rows."""
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.fluid.core import global_scope
+        from paddle_tpu.fluid.param_attr import ParamAttr
+        from paddle_tpu.fluid.initializer import ConstantInitializer
+
+        fleet._fleet_singleton._runtime_handle = None
+        fleet.init(fleet.PaddleCloudRoleMaker())
+        strategy = fleet.DistributedStrategy()
+        strategy.a_sync = True
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.data("wids", [-1, 2], dtype="int64")
+            label = fluid.data("wlabel", [-1, 1])
+            # declared size[0] is notional under PS (the host table hashes
+            # the full 64-bit id space; no bounds check) — wide feasigns
+            # flow regardless of the declared vocab
+            emb = fluid.layers.embedding(
+                ids, (1000, 4), is_sparse=True,
+                param_attr=ParamAttr(name="wide_emb",
+                                     initializer=ConstantInitializer(0.0)))
+            emb = fluid.layers.reshape(emb, [-1, 8])
+            pred = fluid.layers.fc(emb, 1)
+            loss = fluid.layers.mean(fluid.layers.square(pred - label))
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+        fleet.distributed_optimizer(opt, strategy)
+        fleet.minimize(loss, startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fleet.init_worker()
+
+        rng = np.random.RandomState(3)
+        base = rng.randint(0, 2 ** 40, (8, 2)).astype(np.int64)
+        label_v = rng.rand(8, 1).astype("float32")
+        for _ in range(3):
+            lv, = exe.run(main, feed={"wids": base, "wlabel": label_v},
+                          fetch_list=[loss])
+        rt = fleet._fleet_singleton._runtime_handle
+        tbl = rt.get_table("wide_emb")
+        assert tbl.size() == len(np.unique(base))    # full-width rows
+        rows = rt.ps_pull_sparse("wide_emb", np.unique(base))
+        assert np.any(rows != 0)                     # trained
+        fleet.stop_worker()
