@@ -76,16 +76,57 @@ def _depthwise_conv2d(ins, attrs, ctx):
 
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ins, attrs, ctx):
+    """Transposed conv (conv2d_transpose_op.cc) as a dilated conv: fluid
+    filter layout (C_in, C_out/groups, kh, kw) maps directly onto IOHW with
+    the kernel spatially flipped, lhs_dilation = strides, and padding
+    (k_eff - 1 - p) — the exact adjoint of the conv2d lowering (verified by
+    <conv(x,w), y> == <x, convT(y,w)> in test_op_grads_auto)."""
     x, w = _x(ins, "Input"), _x(ins, "Filter")
-    strides = attrs.get("strides", [1, 1])
-    pads = _conv_pad(attrs.get("paddings", [0, 0]),
-                     attrs.get("padding_algorithm", "EXPLICIT"), 2)
-    # fluid filter layout for transpose is (in, out/groups, kh, kw) = IOHW
-    out = lax.conv_transpose(
-        x, w, strides, pads if isinstance(pads, str) else pads,
-        rhs_dilation=attrs.get("dilations", [1, 1]),
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True)
+    s = list(attrs.get("strides", [1, 1]))
+    d = list(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    kh = (w.shape[2] - 1) * d[0] + 1
+    kw = (w.shape[3] - 1) * d[1] + 1
+    p = list(attrs.get("paddings", [0, 0]))
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "VALID":
+        p = [0, 0, 0, 0]
+    elif algo == "SAME":
+        # out == in * stride exactly: total crop per dim = k_eff - s,
+        # remainder on the high side (may be negative when k < s)
+        p = [(kh - s[0]) // 2, (kh - s[0]) - (kh - s[0]) // 2,
+             (kw - s[1]) // 2, (kw - s[1]) - (kw - s[1]) // 2]
+    if len(p) == 2:                    # symmetric [ph, pw]
+        p = [p[0], p[0], p[1], p[1]]
+    # default out = (in-1)*s - (p_lo+p_hi) + k_eff; output_size (absolute)
+    # or output_padding (extra) add rows on the high edge for stride > 1
+    extra = [0, 0]
+    osize = attrs.get("output_size")
+    opad = attrs.get("output_padding")
+    if osize:
+        dh = (x.shape[2] - 1) * s[0] - p[0] - p[1] + kh
+        dw = (x.shape[3] - 1) * s[1] - p[2] - p[3] + kw
+        extra = [int(osize[0]) - dh, int(osize[1]) - dw]
+    elif opad:
+        extra = [int(opad[0]), int(opad[1])]
+    pad = [(kh - 1 - p[0], kh - 1 - p[1] + extra[0]),
+           (kw - 1 - p[2], kw - 1 - p[3] + extra[1])]
+    if groups > 1:
+        # (Cin, Cout/g, kh, kw) -> grouped IOHW expects I = Cin/g per group
+        # with O totalling Cout: split, run per group, concat (XLA fuses)
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        outs = [lax.conv_general_dilated(
+            xi, jnp.flip(wi, (2, 3)), window_strides=(1, 1), padding=pad,
+            lhs_dilation=s, rhs_dilation=d,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"))
+            for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = lax.conv_general_dilated(
+            x, jnp.flip(w, (2, 3)), window_strides=(1, 1), padding=pad,
+            lhs_dilation=s, rhs_dilation=d,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"))
     return {"Output": [out.astype(x.dtype)]}
 
 
