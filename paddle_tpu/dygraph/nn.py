@@ -7,11 +7,14 @@ from __future__ import annotations
 import numpy as np
 
 from ..fluid import layers as L
-from ..fluid.framework import _dygraph_tracer
+from ..fluid.framework import _dygraph_tracer, in_dygraph_mode
 from ..fluid.initializer import ConstantInitializer, XavierInitializer, \
     NormalInitializer
 from ..fluid.layer_helper import LayerHelper
 from .layers import Layer
+
+
+from ..fluid.layer_helper import emit_op as _emit
 
 
 class Linear(Layer):
@@ -60,12 +63,12 @@ class Conv2D(Layer):
             if bias_attr is not False else None
 
     def forward(self, x):
-        tracer = _dygraph_tracer()
-        out = tracer.trace_op(
-            "conv2d", {"Input": [x], "Filter": [self.weight]},
-            {"Output": [None]},
+        out = _emit(
+            "conv2d", "conv2d", {"Input": [x], "Filter": [self.weight]},
+            ("Output",),
             {"strides": self._stride, "paddings": self._padding,
-             "dilations": self._dilation, "groups": self._groups})["Output"][0]
+             "dilations": self._dilation,
+             "groups": self._groups})["Output"][0]
         if self.bias is not None:
             out = L.elementwise_add(out, self.bias, axis=1)
         if self._act:
@@ -109,20 +112,56 @@ class BatchNorm(Layer):
         self._act = act
 
     def forward(self, x):
-        tracer = _dygraph_tracer()
-        outs = tracer.trace_op(
-            "batch_norm",
-            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
-             "Mean": [self._mean], "Variance": [self._variance]},
-            {"Y": [None]},
-            {"momentum": self._momentum, "epsilon": self._epsilon,
-             "is_test": not self.training,
-             "data_layout": self._data_layout,
-             "use_global_stats": self._use_global_stats})
-        # write back moving stats (in-place aliasing analog)
-        self._mean.set_value(outs["MeanOut"][0]._value)
-        self._variance.set_value(outs["VarianceOut"][0]._value)
-        out = outs["Y"][0]
+        attrs = {"momentum": self._momentum, "epsilon": self._epsilon,
+                 "is_test": not self.training,
+                 "data_layout": self._data_layout,
+                 "use_global_stats": self._use_global_stats}
+        if in_dygraph_mode():
+            outs = _dygraph_tracer().trace_op(
+                "batch_norm",
+                {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+                 "Mean": [self._mean], "Variance": [self._variance]},
+                {"Y": [None]}, attrs)
+            # write back moving stats (in-place aliasing analog)
+            self._mean.set_value(outs["MeanOut"][0]._value)
+            self._variance.set_value(outs["VarianceOut"][0]._value)
+            out = outs["Y"][0]
+        else:
+            # static mode: moving stats are persistable vars updated via
+            # the in-place MeanOut/VarianceOut outputs (fluid layout)
+            if getattr(self, "_static_stats", None) is None:
+                helper = LayerHelper("batch_norm")
+                from ..fluid.param_attr import ParamAttr
+                from ..fluid.initializer import NumpyArrayInitializer
+                # buffers may be VarBase-wrapped: unwrap — np.asarray on a
+                # VarBase iterates __getitem__ without end
+                mean_np = np.asarray(getattr(self._mean, "_value",
+                                             self._mean))
+                var_np = np.asarray(getattr(self._variance, "_value",
+                                            self._variance))
+                c = [int(mean_np.shape[0])]
+                # seed from the layer's buffers, so stats loaded through
+                # set_dict/dygraph checkpoints reach static execution
+                mean = helper.create_parameter(
+                    ParamAttr(initializer=NumpyArrayInitializer(mean_np),
+                              trainable=False), c, self._dtype)
+                var = helper.create_parameter(
+                    ParamAttr(initializer=NumpyArrayInitializer(var_np),
+                              trainable=False), c, self._dtype)
+                mean.stop_gradient = var.stop_gradient = True
+                self._static_stats = (mean, var)
+            mean, var = self._static_stats
+            helper = LayerHelper("batch_norm")
+            y = helper.create_variable_for_type_inference()
+            helper.append_op(
+                "batch_norm",
+                inputs={"X": [x], "Scale": [self.weight],
+                        "Bias": [self.bias], "Mean": [mean],
+                        "Variance": [var]},
+                outputs={"Y": [y], "MeanOut": [mean],
+                         "VarianceOut": [var]},
+                attrs=attrs)
+            out = y
         if self._act:
             out = getattr(L, self._act)(out)
         return out
@@ -137,11 +176,9 @@ class Embedding(Layer):
         self._padding_idx = -1 if padding_idx is None else padding_idx
 
     def forward(self, ids):
-        tracer = _dygraph_tracer()
-        return tracer.trace_op(
-            "lookup_table_v2", {"W": [self.weight], "Ids": [ids]},
-            {"Out": [None]},
-            {"padding_idx": self._padding_idx})["Out"][0]
+        return _emit("embedding", "lookup_table_v2",
+                     {"W": [self.weight], "Ids": [ids]}, ("Out",),
+                     {"padding_idx": self._padding_idx})["Out"][0]
 
 
 class LayerNorm(Layer):
@@ -163,16 +200,15 @@ class LayerNorm(Layer):
         self._nshape = normalized_shape
 
     def forward(self, x):
-        tracer = _dygraph_tracer()
         ins = {"X": [x]}
         if self.weight is not None:
             ins["Scale"] = [self.weight]
         if self.bias is not None:
             ins["Bias"] = [self.bias]
         begin = len(x.shape) - len(self._nshape)
-        out = tracer.trace_op("layer_norm", ins, {"Y": [None]},
-                              {"epsilon": self._epsilon,
-                               "begin_norm_axis": begin})["Y"][0]
+        out = _emit("layer_norm", "layer_norm", ins, ("Y",),
+                    {"epsilon": self._epsilon,
+                     "begin_norm_axis": begin})["Y"][0]
         if self._act:
             out = getattr(L, self._act)(out)
         return out
@@ -228,8 +264,6 @@ class PRelu(Layer):
         self._mode = mode
 
     def forward(self, x):
-        tracer = _dygraph_tracer()
-        return tracer.trace_op("prelu",
-                               {"X": [x], "Alpha": [self.weight]},
-                               {"Out": [None]},
-                               {"mode": self._mode})["Out"][0]
+        return _emit("prelu", "prelu",
+                     {"X": [x], "Alpha": [self.weight]}, ("Out",),
+                     {"mode": self._mode})["Out"][0]

@@ -101,3 +101,18 @@ class LayerHelper:
                             inputs={"X": [out], "Y": [bias]},
                             outputs={"Out": [tmp]}, attrs={"axis": axis})
         return tmp if not in_dygraph_mode() else op["Out"][0]
+
+
+def emit_op(layer_type, op_type, ins, out_slots, attrs):
+    """Mode-agnostic op emission for layer classes: tracer in dygraph,
+    static append otherwise — the property that lets hapi's static adapter
+    build programs from the same network object (hapi/model.py:808)."""
+    from .framework import _dygraph_tracer
+    if in_dygraph_mode():
+        return _dygraph_tracer().trace_op(
+            op_type, ins, {s: [None] for s in out_slots}, attrs)
+    helper = LayerHelper(layer_type)
+    outs = {s: [helper.create_variable_for_type_inference()]
+            for s in out_slots}
+    helper.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs)
+    return outs

@@ -1,7 +1,10 @@
 """hapi.Model — Keras-like fit/evaluate/predict (reference
-python/paddle/hapi/model.py:808, fit:1296).  Dygraph-backed: the wrapped
-network is a dygraph Layer; fit() iterates the DataLoader, runs
-forward/backward eagerly (each op an XLA call), steps the optimizer."""
+python/paddle/hapi/model.py:808, fit:1296).  BOTH modes, like the
+reference: under dygraph the network runs eagerly (each op an XLA call);
+under static graph a _StaticAdapter builds train/eval/predict Programs
+ONCE from the same network object (the layer classes are mode-agnostic,
+see fluid/layer_helper.py emit_op) and every batch is one compiled
+whole-block executable."""
 from __future__ import annotations
 
 import time
@@ -22,6 +25,201 @@ class Input:
         self.name = name
 
 
+class _StaticAdapter:
+    """StaticGraphAdapter analog (reference hapi/model.py:808).  The
+    network's Parameters were created in the construction-time default
+    program; each mode's program adopts them by name, the construction
+    startup program (pruned to this network's params) seeds the scope,
+    and batches run through the whole-block Executor."""
+
+    def __init__(self, model: "Model"):
+        from ..fluid import framework as fw
+        self.model = model
+        self._orig_main = fw.default_main_program()
+        self._startup = fw.default_startup_program()
+        self._progs = {}
+        self._exe = None
+        self._startup_done = False
+        self._startup_nprogs = -1
+        self._startup_ran = set()
+
+    # -- plumbing -----------------------------------------------------------
+    def _executor(self):
+        if self._exe is None:
+            from ..fluid.executor import Executor
+            self._exe = Executor()
+        return self._exe
+
+    def _build(self, mode):
+        if mode in self._progs:
+            return self._progs[mode]
+        from ..fluid.framework import Program, program_guard
+        from ..fluid import layers as FL
+        m = self.model
+        in_specs = _as_list(m._inputs)
+        lb_specs = _as_list(m._labels)
+        if not in_specs:
+            raise ValueError(
+                "static-mode Model needs inputs=[hapi.Input(shape, dtype)] "
+                "specs — shapes cannot be inferred without eager tensors "
+                "(reference hapi/model.py Input contract)")
+        prog = Program()
+        with program_guard(prog, self._startup):
+            gb = prog.global_block()
+            for p in self._orig_main.all_parameters():
+                gb.vars[p.name] = p     # adopt construction-time params
+            ins = [FL.data(s.name or f"hapi_x{i}", s.shape, dtype=s.dtype)
+                   for i, s in enumerate(in_specs)]
+            lbs = [FL.data(s.name or f"hapi_y{i}", s.shape, dtype=s.dtype)
+                   for i, s in enumerate(lb_specs)]
+            if mode == "train":
+                m.network.train()
+            else:
+                m.network.eval()
+            outs = _as_list(m.network(*ins))
+            if mode == "predict":
+                fetch = [o.name for o in outs]
+            else:
+                loss = m._loss(*outs, *lbs) if m._loss else outs[0]
+                if loss.shape not in ((), (1,), None):
+                    loss = FL.mean(loss)
+                if mode == "train":
+                    _static_optimizer(m._optimizer).minimize(loss)
+                fetch = [loss.name] + [o.name for o in outs]
+        entry = {"prog": prog, "ins": [v.name for v in ins],
+                 "lbs": [v.name for v in lbs], "fetch": fetch}
+        self._progs[mode] = entry
+        return entry
+
+    def _ensure_startup(self):
+        """Incremental startup: initialise vars needed by the programs
+        built SO FAR (params at first batch, optimizer state when the
+        train program lands).  Pruned to this adapter's vars — the
+        process-global default startup may hold unrelated init ops — and
+        never clobbers values the user already loaded (Model.load before
+        the first batch, reference load-then-fit flow)."""
+        if self._startup_done and len(self._progs) == self._startup_nprogs:
+            return                  # hot path: nothing new to initialise
+        import copy
+        from ..fluid.core import global_scope
+        names = set()
+        for e in self._progs.values():
+            names.update(e["prog"].global_block().vars.keys())
+        scope = global_scope()
+        done = self._startup_ran
+
+        def key(op):
+            return (op.type, tuple(sorted(op.output_arg_names)))
+
+        sp = copy.deepcopy(self._startup)
+        b = sp.global_block()
+        todo = [op for op in b.ops
+                if key(op) not in done
+                and any(n in names for n in op.output_arg_names)
+                and any(scope.find_var(n) is None
+                        for n in op.output_arg_names)]
+        if todo:
+            b.ops = todo
+            self._executor().run(sp)
+            done.update(key(op) for op in todo)
+        self._startup_done = True
+        self._startup_nprogs = len(self._progs)
+
+    def _run(self, mode, inputs, labels):
+        entry = self._build(mode)
+        self._ensure_startup()
+        feed = {}
+        for name, arr in zip(entry["ins"], _as_list(inputs)):
+            feed[name] = np.asarray(arr)
+        for name, arr in zip(entry["lbs"], _as_list(labels)):
+            feed[name] = np.asarray(arr)
+        return entry, self._executor().run(entry["prog"], feed=feed,
+                                           fetch_list=entry["fetch"])
+
+    # -- Model surface ------------------------------------------------------
+    def _loss_and_metrics(self, mode, inputs, labels):
+        _, outs = self._run(mode, inputs, labels)
+        loss = float(np.asarray(outs[0]).reshape(-1)[0])
+        metrics = [self._np_metric(outs[1], labels)
+                   for _ in self.model._metrics]
+        return [loss] + metrics
+
+    def train_batch(self, inputs, labels=None):
+        return self._loss_and_metrics("train", inputs, labels)
+
+    def eval_batch(self, inputs, labels=None):
+        return self._loss_and_metrics("eval", inputs, labels)
+
+    def predict_batch(self, inputs):
+        _, outs = self._run("predict", inputs, [])
+        return [np.asarray(o) for o in outs]
+
+    def _np_metric(self, logits, labels):
+        try:
+            lbl = np.asarray(_as_list(labels)[0]).reshape(-1)
+            pred = np.argmax(np.asarray(logits), axis=-1).reshape(-1)
+            return float((pred == lbl).mean())
+        except Exception:               # noqa: BLE001 — metric best effort
+            return 0.0
+
+    def _all_params(self):
+        """Construction-time params PLUS vars created lazily at build time
+        (BatchNorm static moving stats, optimizer accumulators live in the
+        mode programs' blocks)."""
+        seen = {}
+        for p in self._orig_main.all_parameters():
+            seen[p.name] = p
+        for e in self._progs.values():
+            for p in e["prog"].all_parameters():
+                seen.setdefault(p.name, p)
+        return list(seen.values())
+
+    def state_dict(self):
+        from ..fluid.core import global_scope
+        scope = global_scope()
+        out = {}
+        for p in self._all_params():
+            v = scope.find_var(p.name)
+            if v is not None:
+                out[p.name] = np.asarray(v)
+        return out
+
+    def set_state_dict(self, state):
+        from ..fluid.core import global_scope
+        scope = global_scope()
+        for k, v in state.items():
+            scope.set_var(k, np.asarray(v))
+
+    def parameters(self):
+        return self._all_params()
+
+
+def _static_optimizer(opt):
+    """Accept fluid optimizers directly; map 2.0 eager optimizers onto
+    their fluid counterparts (the reference's 2.0 optimizers carry both
+    modes in one class; ours split eager/static implementations)."""
+    if opt is None:
+        raise ValueError("Model.prepare(optimizer=...) required for fit")
+    from ..fluid import optimizer as fopt
+    if isinstance(opt, fopt.Optimizer):
+        return opt
+    name = type(opt).__name__
+    lr = opt.get_lr() if hasattr(opt, "get_lr") else 0.001
+    table = {"SGD": lambda: fopt.SGDOptimizer(lr),
+             "Momentum": lambda: fopt.MomentumOptimizer(
+                 lr, getattr(opt, "_momentum", 0.9)),
+             "Adam": lambda: fopt.AdamOptimizer(lr),
+             "AdamW": lambda: fopt.AdamWOptimizer(
+                 lr, weight_decay=getattr(opt, "_weight_decay", 0.01)
+                 or 0.01),
+             "Adagrad": lambda: fopt.AdagradOptimizer(lr),
+             "RMSProp": lambda: fopt.RMSPropOptimizer(lr)}
+    if name not in table:
+        raise ValueError(f"no static mapping for optimizer {name}; pass a "
+                         f"fluid.optimizer.* instance in static mode")
+    return table[name]()
+
+
 class Model:
     def __init__(self, network: Layer, inputs=None, labels=None):
         self.network = network
@@ -30,6 +228,9 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        # mode picked at construction, like the reference (model.py:1012
+        # fluid.in_dygraph_mode() chooses the adapter)
+        self._adapter = None if in_dygraph_mode() else _StaticAdapter(self)
 
     def prepare(self, optimizer=None, loss=None, metrics=None):
         self._optimizer = optimizer
@@ -40,6 +241,8 @@ class Model:
 
     # -- core steps ----------------------------------------------------------
     def train_batch(self, inputs, labels=None):
+        if self._adapter is not None:
+            return self._adapter.train_batch(inputs, labels)
         self.network.train()
         ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
         lbs = [to_variable(np.asarray(x)) for x in _as_list(labels)]
@@ -57,6 +260,8 @@ class Model:
         return [float(np.asarray(final.numpy()).reshape(-1)[0])] + metrics
 
     def eval_batch(self, inputs, labels=None):
+        if self._adapter is not None:
+            return self._adapter.eval_batch(inputs, labels)
         self.network.eval()
         ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
         lbs = [to_variable(np.asarray(x)) for x in _as_list(labels)]
@@ -68,6 +273,8 @@ class Model:
         return [lv] + metrics
 
     def predict_batch(self, inputs):
+        if self._adapter is not None:
+            return self._adapter.predict_batch(inputs)
         self.network.eval()
         ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
         outs = _as_list(self.network(*ins))
@@ -143,15 +350,24 @@ class Model:
 
     # -- persistence ---------------------------------------------------------
     def save(self, path, training=True):
+        if self._adapter is not None:
+            np.savez(path + ".pdparams.npz", **self._adapter.state_dict())
+            return
         from ..dygraph.checkpoint import save_dygraph
         save_dygraph(self.network.state_dict(), path)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        if self._adapter is not None:
+            data = np.load(path + ".pdparams.npz")
+            self._adapter.set_state_dict({k: data[k] for k in data.files})
+            return
         from ..dygraph.checkpoint import load_dygraph
         params, _ = load_dygraph(path)
         self.network.set_dict(params)
 
     def parameters(self):
+        if self._adapter is not None:
+            return self._adapter.parameters()
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):

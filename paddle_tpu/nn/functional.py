@@ -80,18 +80,25 @@ def adaptive_avg_pool2d(x, output_size):
 
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,
                momentum=0.9, epsilon=1e-5, data_format="NCHW"):
-    from ..fluid.framework import _dygraph_tracer
-    return _dygraph_tracer().trace_op(
-        "batch_norm",
-        {"X": [x], "Scale": [weight], "Bias": [bias],
-         "Mean": [running_mean], "Variance": [running_var]},
-        {"Y": [None]},
-        {"momentum": momentum, "epsilon": epsilon,
-         "is_test": not training, "data_layout": data_format})["Y"][0]
+    from ..fluid.framework import in_dygraph_mode, _dygraph_tracer
+    from ..fluid.layer_helper import LayerHelper
+    ins = {"X": [x], "Scale": [weight], "Bias": [bias],
+           "Mean": [running_mean], "Variance": [running_var]}
+    attrs = {"momentum": momentum, "epsilon": epsilon,
+             "is_test": not training, "data_layout": data_format}
+    if in_dygraph_mode():
+        return _dygraph_tracer().trace_op(
+            "batch_norm", ins, {"Y": [None]}, attrs)["Y"][0]
+    helper = LayerHelper("batch_norm")
+    y = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("batch_norm", inputs=ins,
+                     outputs={"Y": [y], "MeanOut": [running_mean],
+                              "VarianceOut": [running_var]}, attrs=attrs)
+    return y
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
-    from ..fluid.framework import _dygraph_tracer
+    from ..fluid.layer_helper import emit_op
     shape = ([normalized_shape] if isinstance(normalized_shape, int)
              else list(normalized_shape))
     ins = {"X": [x]}
@@ -100,6 +107,5 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     if bias is not None:
         ins["Bias"] = [bias]
     begin = len(x.shape) - len(shape)
-    return _dygraph_tracer().trace_op(
-        "layer_norm", ins, {"Y": [None]},
-        {"epsilon": epsilon, "begin_norm_axis": begin})["Y"][0]
+    return emit_op("layer_norm", "layer_norm", ins, ("Y",),
+                   {"epsilon": epsilon, "begin_norm_axis": begin})["Y"][0]

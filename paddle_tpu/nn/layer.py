@@ -58,8 +58,9 @@ class Flatten(Layer):
         self._start, self._stop = start_axis, stop_axis
 
     def forward(self, x):
-        return _dygraph_tracer().trace_op(
-            "flatten_contiguous_range", {"X": [x]}, {"Out": [None]},
+        from ..fluid.layer_helper import emit_op
+        return emit_op(
+            "flatten", "flatten_contiguous_range", {"X": [x]}, ("Out",),
             {"start_axis": self._start, "stop_axis": self._stop})["Out"][0]
 
 
@@ -82,9 +83,11 @@ class Conv2DTranspose(Layer):
             if bias_attr is not False else None
 
     def forward(self, x):
-        out = _dygraph_tracer().trace_op(
-            "conv2d_transpose", {"Input": [x], "Filter": [self.weight]},
-            {"Output": [None]}, self._attrs)["Output"][0]
+        from ..fluid.layer_helper import emit_op
+        out = emit_op(
+            "conv2d_transpose", "conv2d_transpose",
+            {"Input": [x], "Filter": [self.weight]}, ("Output",),
+            self._attrs)["Output"][0]
         if self.bias is not None:
             out = L.elementwise_add(out, self.bias, axis=1)
         return out

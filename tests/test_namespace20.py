@@ -279,3 +279,146 @@ class TestCallbacks:
         o = opt.SGD(lrmod.StepDecay(learning_rate=1.0, step_size=1))
         with pytest.raises(RuntimeError, match="scheduler"):
             o.set_lr(0.1)
+
+
+class TestStaticModel:
+    """Static-graph hapi Model (reference hapi/model.py:808 runs in both
+    modes via adapters): the same LeNet fits in dygraph and static mode to
+    the same loss trajectory."""
+
+    def _net(self):
+        from paddle_tpu import nn
+        return nn.Sequential(
+            nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2),
+            nn.Flatten(), nn.Linear(4 * 4 * 4, 10))
+
+    def _data(self):
+        rng = np.random.RandomState(42)
+        xs = rng.randn(32, 1, 8, 8).astype("float32") * 0.3
+        ys = rng.randint(0, 10, (32, 1)).astype("int64")
+        for i in range(32):
+            xs[i, 0, ys[i, 0] % 8, ys[i, 0] % 8] += 2.0
+        return [(x, y) for x, y in zip(xs, ys)]
+
+    def _fit(self, static, init_state=None):
+        from paddle_tpu import nn, optimizer as opt
+        from paddle_tpu import hapi
+        from paddle_tpu.dygraph import base as dybase
+        import paddle_tpu.fluid as fluid
+
+        if static:
+            dybase.disable_dygraph()
+            # fresh default programs so unrelated test state can't leak in
+            fluid.framework._main_program = fluid.Program()
+            fluid.framework._startup_program = fluid.Program()
+        else:
+            dybase.enable_dygraph()
+        try:
+            net = self._net()
+            model = paddle.Model(
+                net, inputs=[hapi.Input([-1, 1, 8, 8])],
+                labels=[hapi.Input([-1, 1], "int64")])
+            model.prepare(
+                optimizer=opt.SGD(0.1, parameters=model.parameters()),
+                loss=nn.CrossEntropyLoss())
+            if init_state is not None:
+                # transfer by construction order (names differ per mode)
+                if static:
+                    params = model.parameters()
+                    mapping = {p.name: v for p, v in zip(params,
+                                                         init_state)}
+                    model._adapter.set_state_dict(mapping)
+                    model._adapter._startup_done = True
+                else:
+                    for p, v in zip(net.parameters(), init_state):
+                        p.set_value(np.asarray(v))
+            hist = model.fit(self._data(), batch_size=8, epochs=3,
+                             verbose=0, shuffle=False)
+            if static:
+                state = [np.asarray(model._adapter.state_dict()[p.name])
+                         for p in model.parameters()]
+            else:
+                state = [np.asarray(p.numpy()) for p in net.parameters()]
+            return [h["loss"] for h in hist], state
+        finally:
+            dybase.disable_dygraph()
+
+    def test_same_lenet_same_trajectory_both_modes(self):
+        # deterministic shared init: one fixed RandomState by param order
+        from paddle_tpu.dygraph import base as dybase
+        dybase.enable_dygraph()
+        shapes = [np.shape(p._value) for p in self._net().parameters()]
+        dybase.disable_dygraph()
+        rng = np.random.RandomState(9)
+        init = [(rng.randn(*s) * 0.05).astype("float32") for s in shapes]
+
+        static_losses, static_final = self._fit(True, init)
+        dy_losses, dy_final = self._fit(False, init)
+        np.testing.assert_allclose(static_losses, dy_losses, rtol=1e-3,
+                                   atol=1e-5)
+        for a, b in zip(static_final, dy_final):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+        assert static_losses[-1] < static_losses[0]
+
+    def test_static_predict_and_save_load(self, tmp_path):
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu import hapi, nn, optimizer as opt
+        import paddle_tpu.fluid as fluid
+        dybase.disable_dygraph()
+        fluid.framework._main_program = fluid.Program()
+        fluid.framework._startup_program = fluid.Program()
+        net = self._net()
+        model = paddle.Model(net, inputs=[hapi.Input([-1, 1, 8, 8])],
+                             labels=[hapi.Input([-1, 1], "int64")])
+        model.prepare(optimizer=opt.SGD(0.1,
+                                        parameters=model.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        x = np.random.RandomState(0).randn(4, 1, 8, 8).astype("float32")
+        out1 = model.predict_batch([x])[0]
+        assert out1.shape == (4, 10)
+        model.save(str(tmp_path / "m"))
+        # mutate then reload restores predictions
+        model._adapter.set_state_dict(
+            {p.name: np.zeros(np.asarray(
+                model._adapter.state_dict()[p.name]).shape, "float32")
+             for p in model.parameters()})
+        out_zero = model.predict_batch([x])[0]
+        assert not np.allclose(out_zero, out1)
+        model.load(str(tmp_path / "m"))
+        out2 = model.predict_batch([x])[0]
+        np.testing.assert_allclose(out2, out1, rtol=1e-5)
+
+    def test_static_batchnorm_stats_saved(self, tmp_path):
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu import hapi, nn, optimizer as opt
+        import paddle_tpu.fluid as fluid
+        dybase.disable_dygraph()
+        fluid.framework._main_program = fluid.Program()
+        fluid.framework._startup_program = fluid.Program()
+        net = nn.Sequential(nn.Conv2D(1, 3, 3, padding=1),
+                            nn.BatchNorm(3), nn.Flatten(),
+                            nn.Linear(3 * 4 * 4, 2))
+        model = paddle.Model(net, inputs=[hapi.Input([-1, 1, 4, 4])],
+                             labels=[hapi.Input([-1, 1], "int64")])
+        model.prepare(optimizer=opt.SGD(0.05,
+                                        parameters=model.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        rng = np.random.RandomState(1)
+        xs = (rng.randn(16, 1, 4, 4) * 2 + 1).astype("float32")
+        ys = rng.randint(0, 2, (16, 1)).astype("int64")
+        model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=8,
+                  epochs=2, verbose=0, shuffle=False)
+        state = model._adapter.state_dict()
+        # the moving stats were trained away from their 0/1 init AND are
+        # part of the persisted state (BatchNorm static stats)
+        stats = [k for k in state
+                 if np.shape(state[k]) == (3,)
+                 and not np.allclose(state[k], state[k][0])]
+        means = [k for k in state if np.shape(state[k]) == (3,)]
+        assert len(means) >= 4          # scale, bias, mean, variance
+        x = xs[:4]
+        out1 = model.predict_batch([x])[0]
+        model.save(str(tmp_path / "bn"))
+        model2_state = np.load(str(tmp_path / "bn") + ".pdparams.npz")
+        for k in state:
+            np.testing.assert_array_equal(model2_state[k], state[k])
