@@ -105,6 +105,20 @@ class VarBase:
     def __truediv__(self, o): return self._binary("elementwise_div", o)
     def __rtruediv__(self, o): return self._binary("elementwise_div", o, True)
     def __pow__(self, o): return self._binary("elementwise_pow", o)
+    def __gt__(self, o): return self._binary("greater_than", o)
+    def __lt__(self, o): return self._binary("less_than", o)
+    def __ge__(self, o): return self._binary("greater_equal", o)
+    def __le__(self, o): return self._binary("less_equal", o)
+
+    def __bool__(self):
+        # reference VarBase truthiness: scalar value, loud error otherwise
+        # (under a trace this raises jax's concretization error, which the
+        # dygraph_to_static converters exist to avoid)
+        if self._value.size != 1:
+            raise ValueError(
+                "The truth value of a multi-element VarBase is ambiguous; "
+                "use .any()/.all() reductions")
+        return bool(self._value.reshape(()))
     def __matmul__(self, o):
         return _dygraph_tracer().trace_op(
             "matmul", {"X": [self], "Y": [o]}, {"Out": [None]}, {})["Out"][0]

@@ -1,0 +1,13 @@
+"""AST-based dygraph-to-static (ProgramTranslator analog).
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:729 + convert_operators.py — Python source is
+rewritten so data-dependent `if`/`while`/`for range` become convert_*
+calls that dispatch at RUNTIME: plain Python predicates keep Python
+control flow; tensor predicates lower to structured control flow.
+TPU-native difference: the lowering target is jax.lax.cond/while_loop
+inside the @declarative jit trace (compiler-friendly control flow on
+device), not a ProgramDesc of cond/while ops.
+"""
+from .ast_transformer import ast_to_static          # noqa: F401
+from . import convert_operators                      # noqa: F401

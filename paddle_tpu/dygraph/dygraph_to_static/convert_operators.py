@@ -1,0 +1,185 @@
+"""Runtime dispatchers for converted control flow.
+
+Reference: dygraph_to_static/convert_operators.py (convert_ifelse:?,
+convert_while_loop) — every rewritten site calls these; the TENSOR case
+lowers to lax.cond / lax.while_loop so the trace stays one XLA program
+with real device-side control flow, the Python case executes the original
+semantics untouched.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Undefined:
+    """Placeholder for names not yet bound when a converted region starts
+    (the reference's __undefined_var).  Escaping through a TENSOR branch is
+    an error; through a Python branch it just stays unbound."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEFINED = _Undefined()
+
+
+def defined(thunk):
+    """True when `thunk()` (a lambda closing over a local) is bound."""
+    try:
+        thunk()
+        return True
+    except (NameError, UnboundLocalError):
+        return False
+
+
+def undefined():
+    return UNDEFINED
+
+
+def _is_tracer(v):
+    import jax
+    return isinstance(v, jax.core.Tracer)
+
+
+def _raw(v):
+    from ..base import VarBase
+    return v._value if isinstance(v, VarBase) else v
+
+
+def _pred_value(pred):
+    p = _raw(pred)
+    if hasattr(p, "reshape") and getattr(p, "size", 1) == 1:
+        p = p.reshape(())
+    return p
+
+
+def _promote(name, v, where):
+    """Carry leaf for lax control flow: tensors pass through, Python
+    numerics promote to arrays, anything else cannot cross a traced
+    region boundary."""
+    import jax
+    import jax.numpy as jnp
+    from ..base import VarBase
+    if v is UNDEFINED:
+        raise ValueError(
+            f"dygraph-to-static: variable '{name}' may be undefined after "
+            f"the tensor-dependent {where}; bind it before the branch")
+    if isinstance(v, VarBase):
+        return v._value
+    if isinstance(v, (jax.Array, np.ndarray)) or _is_tracer(v):
+        return v
+    if isinstance(v, (bool, int, float, np.integer, np.floating)):
+        return jnp.asarray(v)
+    raise TypeError(
+        f"dygraph-to-static: variable '{name}' ({type(v).__name__}) is "
+        f"assigned inside a tensor-dependent {where}; only tensors and "
+        f"numeric scalars can flow through device control flow")
+
+
+def _rewrap(template, value):
+    from ..base import VarBase
+    if isinstance(template, VarBase) or not isinstance(
+            template, (bool, int, float, np.integer, np.floating,
+                       type(None))):
+        return VarBase(value, stop_gradient=True) \
+            if not isinstance(template, VarBase) else VarBase(
+                value, stop_gradient=template.stop_gradient)
+    return VarBase(value, stop_gradient=True)
+
+
+def convert_ifelse(pred, true_fn, false_fn, names, args):
+    """Rewritten `if`: Python predicate -> Python branch; traced tensor
+    predicate -> lax.cond over both branches (inputs ride the closure,
+    outputs are the branch-assigned variables)."""
+    p = _pred_value(pred)
+    if not _is_tracer(p):
+        outs = true_fn(*args) if bool(np.asarray(p)) else false_fn(*args)
+        return outs
+    from jax import lax
+
+    def run(fn):
+        def g(_):
+            outs = fn(*args)
+            return tuple(_promote(n, o, "branch")
+                         for n, o in zip(names, outs))
+        return g
+
+    try:
+        res = lax.cond(p.astype(bool), run(true_fn), run(false_fn), None)
+    except TypeError as e:
+        raise TypeError(
+            f"dygraph-to-static: the two branches of a tensor-dependent "
+            f"`if` must produce matching shapes/dtypes for "
+            f"{list(names)}: {e}") from None
+    return tuple(_rewrap(a, r) for a, r in zip(args, res))
+
+
+def range_cond(i, stop, step):
+    """Bound check for the for->while desugar, sign-aware in both the
+    Python and the traced case."""
+    import jax.numpy as jnp
+    ri, rstop, rstep = _raw(i), _raw(stop), _raw(step)
+    if not (_is_tracer(ri) or _is_tracer(rstop) or _is_tracer(rstep)):
+        import numpy as _np
+        s = float(_np.asarray(rstep))
+        return (_np.asarray(ri) < _np.asarray(rstop) if s > 0
+                else _np.asarray(ri) > _np.asarray(rstop))
+    from ..base import VarBase
+    out = jnp.where(jnp.asarray(rstep) > 0,
+                    jnp.asarray(ri) < jnp.asarray(rstop),
+                    jnp.asarray(ri) > jnp.asarray(rstop))
+    return VarBase(out, stop_gradient=True)
+
+
+def convert_while_loop(cond_fn, body_fn, names, args):
+    """Rewritten `while`: Python condition -> Python loop; traced tensor
+    condition -> lax.while_loop with the loop variables as the carry."""
+    first = cond_fn(*args)
+    p = _pred_value(first)
+    if not _is_tracer(p):
+        cur = p
+        while bool(np.asarray(cur)):
+            args = body_fn(*args)
+            cur = _pred_value(cond_fn(*args))
+        return args
+    from jax import lax
+
+    # live/dead split: names UNBOUND before the loop are body-local temps
+    # (first use is a write, or Python itself would have raised) — they
+    # recompute every iteration and cannot escape the traced loop.  The
+    # carry holds only the live variables (the reference's loop-vars
+    # analysis, done at runtime instead of on the AST).
+    live = [i for i, a in enumerate(args) if a is not UNDEFINED]
+    carry0 = tuple(_promote(names[i], args[i], "while loop") for i in live)
+
+    def merge(c):
+        vals = list(args)
+        for k, i in enumerate(live):
+            vals[i] = _rewrap(args[i], c[k])
+        return vals
+
+    def cond_w(c):
+        return _pred_value(cond_fn(*merge(c))).astype(bool)
+
+    def body_w(c):
+        outs = body_fn(*merge(c))
+        return tuple(_promote(names[i], outs[i], "while loop body")
+                     for i in live)
+
+    try:
+        res = lax.while_loop(cond_w, body_w, carry0)
+    except TypeError as e:
+        raise TypeError(
+            f"dygraph-to-static: tensor-dependent `while` must keep "
+            f"{list(names)} at fixed shapes/dtypes across iterations: "
+            f"{e}") from None
+    final = list(args)
+    for k, i in enumerate(live):
+        final[i] = _rewrap(args[i], res[k])
+    return tuple(final)

@@ -63,8 +63,19 @@ class StaticFunction:
     def __init__(self, fn):
         functools.update_wrapper(self, fn)
         self._fn = fn
+        self._converted = None         # lazily AST-converted body
         self._own_cache = {}           # for free functions (no instance)
         self.__declarative__ = True
+
+    def _traced_fn(self):
+        """The function whose ops land in the jit trace: the AST-converted
+        body (tensor-dependent if/while/for range become lax.cond /
+        lax.while_loop — dygraph_to_static/) when conversion applies,
+        the original otherwise (ProgramTranslator fallback)."""
+        if self._converted is None:
+            from .dygraph_to_static import ast_to_static
+            self._converted = ast_to_static(self._fn) or self._fn
+        return self._converted
 
     def __get__(self, obj, objtype=None):
         if obj is None:
@@ -160,7 +171,7 @@ class StaticFunction:
         import jax
         from .base import no_grad_ctx, _dygraph_tracer
 
-        fn = self._fn
+        fn = self._traced_fn()
         cell = {"trees": {}, "traces": 0}
 
         def pure(param_vals, buf_vals, input_vals, key):

@@ -223,3 +223,171 @@ class TestJitSaveLoad:
     def test_to_static_alias_exported(self):
         import paddle_tpu as paddle
         assert paddle.jit.to_static is paddle.jit.declarative
+
+
+class TestAstControlFlow:
+    """AST-based dygraph-to-static (dygraph_to_static/): tensor-dependent
+    if/while/for lower to lax.cond/while_loop inside ONE compiled
+    executable — both branches reachable from one trace, iteration counts
+    decided by data (the trace-based capture silently baked one path)."""
+
+    def test_tensor_if_both_branches_one_executable(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+        from paddle_tpu.fluid import layers as L
+
+        @declarative
+        def f(x):
+            if L.reduce_mean(x) > 0:
+                y = x * 2.0
+            else:
+                y = x + 10.0
+            return y
+
+        pos = to_variable(np.full((2, 3), 1.0, "float32"))
+        neg = to_variable(np.full((2, 3), -1.0, "float32"))
+        np.testing.assert_allclose(f(pos).numpy(), np.full((2, 3), 2.0))
+        np.testing.assert_allclose(f(neg).numpy(), np.full((2, 3), 9.0))
+        # same shapes -> ONE trace served BOTH branches (lax.cond inside
+        # one executable; the trace-based capture would have baked one)
+        entry = next(iter(f._own_cache.values()))
+        assert entry["cell"]["traces"] == 1
+
+    def test_tensor_while_data_dependent_iterations(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+
+        @declarative
+        def grow(s):
+            n = 0.0
+            while s < 100.0:
+                s = s * 2.0
+                n = n + 1.0
+            return s, n
+
+        s1, n1 = grow(to_variable(np.float32(1.0)))
+        assert float(n1.numpy()) == 7.0          # 1 -> 128
+        s2, n2 = grow(to_variable(np.float32(60.0)))
+        assert float(n2.numpy()) == 1.0          # 60 -> 120
+        assert float(s2.numpy()) == 120.0
+
+    def test_for_over_tensor_range(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+        from paddle_tpu.fluid import layers as L
+
+        @declarative
+        def repeat_sum(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        x = to_variable(np.ones((2, 2), "float32"))
+        n = to_variable(np.int32(3))
+        np.testing.assert_allclose(repeat_sum(x, n).numpy(),
+                                   np.full((2, 2), 3.0))
+        n5 = to_variable(np.int32(5))
+        np.testing.assert_allclose(repeat_sum(x, n5).numpy(),
+                                   np.full((2, 2), 5.0))
+
+    def test_python_predicates_keep_python_semantics(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+
+        @declarative
+        def f(x, flag=True):
+            if flag:                      # plain python predicate
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            k = 0
+            while k < 3:                  # plain python while
+                y = y + 1.0
+                k = k + 1
+            return y
+
+        x = to_variable(np.zeros((2,), "float32"))
+        np.testing.assert_allclose(f(x).numpy(), [3.0, 3.0])
+        np.testing.assert_allclose(f(x, flag=False).numpy(), [2.0, 2.0])
+
+    def test_greedy_decode_matches_eager(self, dygraph_mode):
+        """Beam-search-style decode: the next step consumes the previous
+        argmax — the loop count and the token path are data-dependent."""
+        from paddle_tpu.dygraph.jit_static import declarative
+        from paddle_tpu.fluid import layers as L
+
+        rng = np.random.RandomState(0)
+        table = rng.randn(6, 6).astype("float32")
+
+        def step_eager(tok, steps):
+            w = to_variable(table)
+            out = []
+            t = tok
+            for _ in range(steps):
+                logits = L.gather(w, t)
+                t = L.argmax(logits, axis=-1)
+                out.append(int(np.asarray(t.numpy()).ravel()[0]))
+            return out
+
+        @declarative
+        def decode(tok, w, n):
+            i = 0.0
+            while i < n:
+                logits = L.gather(w, tok)
+                tok = L.argmax(logits, axis=-1)
+                i = i + 1.0
+            return tok
+
+        tok0 = to_variable(np.array([2], "int64"))
+        w = to_variable(table)
+        n = to_variable(np.float32(4.0))
+        final = decode(tok0, w, n)
+        eager_path = step_eager(to_variable(np.array([2], "int64")), 4)
+        assert int(np.asarray(final.numpy()).ravel()[0]) == eager_path[-1]
+
+    def test_while_condition_with_call(self, dygraph_mode):
+        """Loop-invariant names in the condition (modules, functions) ride
+        the closure, not the carry."""
+        from paddle_tpu.dygraph.jit_static import declarative
+        from paddle_tpu.fluid import layers as L
+
+        @declarative
+        def f(s):
+            while L.reduce_mean(s) < 8.0:
+                s = s * 2.0
+            return s
+
+        out = f(to_variable(np.full((2,), 1.0, "float32")))
+        np.testing.assert_allclose(out.numpy(), [8.0, 8.0])
+
+    def test_negative_step_range(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+
+        @declarative
+        def f(x):
+            acc = x * 0.0
+            for i in range(5, 0, -1):
+                acc = acc + x * float(i)
+            return acc
+
+        out = f(to_variable(np.ones((2,), "float32")))
+        np.testing.assert_allclose(out.numpy(), [15.0, 15.0])
+
+    def test_nested_if_inside_tensor_if(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+        from paddle_tpu.fluid import layers as L
+
+        @declarative
+        def f(x):
+            if L.reduce_mean(x) > 0:
+                if L.reduce_max(x) > 2.0:
+                    y = x * 10.0
+                else:
+                    y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        big = to_variable(np.full((2,), 3.0, "float32"))
+        small = to_variable(np.full((2,), 1.0, "float32"))
+        neg = to_variable(np.full((2,), -1.0, "float32"))
+        np.testing.assert_allclose(f(big).numpy(), [30.0, 30.0])
+        np.testing.assert_allclose(f(small).numpy(), [2.0, 2.0])
+        np.testing.assert_allclose(f(neg).numpy(), [-2.0, -2.0])
