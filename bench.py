@@ -91,9 +91,14 @@ def flops_per_token(hidden, layers, ffn, seq, vocab):
     return 3 * fwd                                             # bwd = 2x fwd
 
 
-def build_resnet_step(num_classes, lr=0.1):
+def build_resnet_step(num_classes, lr=0.1, data_format="NHWC"):
     """ResNet-50 training step (BASELINE config #2): SGD+momentum,
-    softmax cross-entropy, bf16 conv compute via AMP autocast."""
+    softmax cross-entropy, bf16 conv compute via AMP autocast.  NHWC is
+    the default layout: channels-last puts C on the 128-lane minor
+    dimension, which is what the v5e vector/matrix units want — the
+    round-2 attribution showed the NCHW step bandwidth-bound at ~98% of
+    HBM (STATUS.md), and layout is the lever for a bandwidth-bound conv
+    step."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.dygraph import base as dybase
@@ -104,7 +109,7 @@ def build_resnet_step(num_classes, lr=0.1):
     dybase.enable_dygraph()
     tracer = dybase._dygraph_tracer()
     tracer._amp_enabled = True
-    model = resnet50(num_classes=num_classes)
+    model = resnet50(num_classes=num_classes, data_format=data_format)
     model.train()
 
     def loss_fn(images, labels):
@@ -180,10 +185,13 @@ def main_resnet():
         image, batch, classes, steps, warmup = 32, 4, 10, 3, 1
     else:
         image, batch, classes, steps, warmup = 224, 128, 1000, 20, 3
+    fmt = "NCHW" if "--layout=nchw" in sys.argv else "NHWC"
 
-    jstep = build_resnet_step(classes)
+    jstep = build_resnet_step(classes, data_format=fmt)
     rng = np.random.RandomState(0)
-    imgs = jnp.asarray(rng.randn(batch, 3, image, image).astype("float32"))
+    shape = ((batch, 3, image, image) if fmt == "NCHW"
+             else (batch, image, image, 3))
+    imgs = jnp.asarray(rng.randn(*shape).astype("float32"))
     lbls = jnp.asarray(rng.randint(0, classes, (batch, 1)).astype("int32"))
 
     dt = timed_run(lambda: jstep(imgs, lbls), steps, warmup)

@@ -154,6 +154,21 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return self._n
 
     # -- shared pieces ------------------------------------------------------
+    def _unbind_undefined(self, names):
+        """`if x is _jst.UNDEFINED: del x` — a name untouched by the taken
+        Python branch must stay UNBOUND after the region (NameError on
+        later reads, exactly as in the original source)."""
+        out = []
+        for n in names:
+            test = ast.Compare(left=_name(n), ops=[ast.Is()],
+                               comparators=[_jst_attr("UNDEFINED")])
+            out.append(ast.If(test=test,
+                              body=[ast.Delete(
+                                  targets=[ast.Name(id=n,
+                                                    ctx=ast.Del())])],
+                              orelse=[]))
+        return out
+
     def _ensure_bound(self, names):
         """x = x if _jst.defined(lambda: x) else _jst.undefined()"""
         out = []
@@ -205,6 +220,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if names:
             stmts.append(ast.Assign(targets=[_tuple(names, ast.Store())],
                                     value=call))
+            stmts.extend(self._unbind_undefined(names))
         else:
             stmts.append(ast.Expr(value=call))
         return stmts
@@ -241,6 +257,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if names:
             stmts.append(ast.Assign(targets=[_tuple(names, ast.Store())],
                                     value=call))
+            stmts.extend(self._unbind_undefined(names))
         else:
             stmts.append(ast.Expr(value=call))
         return stmts
@@ -261,21 +278,27 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         stop = a[1] if len(a) >= 2 else (a[0] if a else _const(0))
         step = a[2] if len(a) >= 3 else _const(1)
         i = node.target.id
+        # internal counter (prefix "_d2s": carried, unlike "_jst_" helper
+        # names) so `i` keeps Python semantics: it holds the LAST USED
+        # value after the loop and stays unbound on empty ranges
+        it = f"_d2s_it_{u}"
         stop_v, step_v = f"_jst_stop_{u}", f"_jst_step_{u}"
-        pre = [ast.Assign(targets=[_name(i, ast.Store())], value=start),
+        pre = [ast.Assign(targets=[_name(it, ast.Store())], value=start),
                ast.Assign(targets=[_name(stop_v, ast.Store())],
                           value=stop),
                ast.Assign(targets=[_name(step_v, ast.Store())],
                           value=step)]
         # step-sign-aware bound check (negative ranges must iterate)
         test = ast.Call(func=_jst_attr("range_cond"),
-                        args=[_name(i), _name(stop_v), _name(step_v)],
+                        args=[_name(it), _name(stop_v), _name(step_v)],
                         keywords=[])
-        incr = ast.AugAssign(target=_name(i, ast.Store()), op=ast.Add(),
+        bind = ast.Assign(targets=[_name(i, ast.Store())], value=_name(it))
+        incr = ast.AugAssign(target=_name(it, ast.Store()), op=ast.Add(),
                              value=_name(step_v))
-        w = ast.While(test=test, body=list(node.body) + [incr], orelse=[])
-        out = pre + self.visit_While(w)
-        return out if isinstance(out, list) else pre + [out]
+        w = ast.While(test=test, body=[bind] + list(node.body) + [incr],
+                      orelse=[])
+        out = self.visit_While(w)
+        return pre + (out if isinstance(out, list) else [out])
 
 
 def ast_to_static(fn):
@@ -286,6 +309,10 @@ def ast_to_static(fn):
     try:
         closure_ns = {}
         if fn.__code__.co_freevars:
+            if "__class__" in fn.__code__.co_freevars:
+                return None     # zero-arg super() needs a real cell; a
+                # snapshotted global raises at CALL time, past the
+                # fallback — so fall back to tracing here
             # recompiling drops the closure; snapshot the cell values into
             # the namespace (bound-at-transform-time semantics — fine for
             # the usual captured modules/layers, the reference's converted

@@ -84,13 +84,9 @@ def _promote(name, v, where):
 
 def _rewrap(template, value):
     from ..base import VarBase
-    if isinstance(template, VarBase) or not isinstance(
-            template, (bool, int, float, np.integer, np.floating,
-                       type(None))):
-        return VarBase(value, stop_gradient=True) \
-            if not isinstance(template, VarBase) else VarBase(
-                value, stop_gradient=template.stop_gradient)
-    return VarBase(value, stop_gradient=True)
+    return VarBase(value,
+                   stop_gradient=template.stop_gradient
+                   if isinstance(template, VarBase) else True)
 
 
 def convert_ifelse(pred, true_fn, false_fn, names, args):

@@ -44,7 +44,8 @@ FC = Linear
 class Conv2D(Layer):
     def __init__(self, num_channels, num_filters, filter_size, stride=1,
                  padding=0, dilation=1, groups=1, param_attr=None,
-                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32",
+                 data_format="NCHW"):
         super().__init__(dtype=dtype)
         helper = LayerHelper("conv2d")
         fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
@@ -53,6 +54,7 @@ class Conv2D(Layer):
         self._dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
         self._groups = groups
         self._act = act
+        self._data_format = data_format
         import math
         fan_in = (num_channels // groups) * fs[0] * fs[1]
         self.weight = helper.create_parameter(
@@ -67,10 +69,12 @@ class Conv2D(Layer):
             "conv2d", "conv2d", {"Input": [x], "Filter": [self.weight]},
             ("Output",),
             {"strides": self._stride, "paddings": self._padding,
-             "dilations": self._dilation,
-             "groups": self._groups})["Output"][0]
+             "dilations": self._dilation, "groups": self._groups,
+             "data_format": self._data_format})["Output"][0]
         if self.bias is not None:
-            out = L.elementwise_add(out, self.bias, axis=1)
+            out = L.elementwise_add(
+                out, self.bias,
+                axis=1 if self._data_format == "NCHW" else -1)
         if self._act:
             out = getattr(L, self._act)(out)
         return out

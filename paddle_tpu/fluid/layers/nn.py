@@ -379,17 +379,20 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
         attrs={"ksize": pool_size, "pooling_type": pool_type,
                "strides": pool_stride, "paddings": pool_padding,
                "global_pooling": global_pooling, "exclusive": exclusive,
-               "ceil_mode": ceil_mode})
+               "ceil_mode": ceil_mode, "data_format": data_format})
     return op["Out"][0] if in_dygraph_mode() else out
 
 
-def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None,
+                    data_format="NCHW"):
     helper = LayerHelper("adaptive_pool2d", name=name)
     pool_size = [pool_size, pool_size] if isinstance(pool_size, int) else list(pool_size)
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     op = helper.append_op("adaptive_pool2d", inputs={"X": [input]},
                           outputs={"Out": [out]},
-                          attrs={"ksize": pool_size, "pooling_type": pool_type})
+                          attrs={"ksize": pool_size,
+                                 "pooling_type": pool_type,
+                                 "data_format": data_format})
     return op["Out"][0] if in_dygraph_mode() else out
 
 

@@ -94,32 +94,41 @@ class Conv2DTranspose(Layer):
 
 
 class MaxPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NCHW"):
         super().__init__()
         self._k, self._s, self._p = kernel_size, stride or kernel_size, padding
+        self._fmt = data_format
 
     def forward(self, x):
-        return L.pool2d(x, self._k, "max", self._s, self._p)
+        return L.pool2d(x, self._k, "max", self._s, self._p,
+                        data_format=self._fmt)
 
 
 class AvgPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NCHW"):
         super().__init__()
         self._k, self._s, self._p = kernel_size, stride or kernel_size, padding
+        self._fmt = data_format
 
     def forward(self, x):
-        return L.pool2d(x, self._k, "avg", self._s, self._p)
+        return L.pool2d(x, self._k, "avg", self._s, self._p,
+                        data_format=self._fmt)
 
 
 class AdaptiveAvgPool2D(Layer):
-    def __init__(self, output_size):
+    def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
         self._size = output_size
+        self._fmt = data_format
 
     def forward(self, x):
         if self._size in (1, (1, 1), [1, 1]):
-            return L.pool2d(x, global_pooling=True, pool_type="avg")
-        return L.adaptive_pool2d(x, self._size, "avg")
+            return L.pool2d(x, global_pooling=True, pool_type="avg",
+                            data_format=self._fmt)
+        return L.adaptive_pool2d(x, self._size, "avg",
+                                 data_format=self._fmt)
 
 
 class BatchNorm2D(BatchNorm):

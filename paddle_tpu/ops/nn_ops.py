@@ -62,14 +62,17 @@ def _conv2d(ins, attrs, ctx):
 @register_op("depthwise_conv2d")
 def _depthwise_conv2d(ins, attrs, ctx):
     x, w = _x(ins, "Input"), _x(ins, "Filter")
-    groups = attrs.get("groups", x.shape[1])
+    fmt = attrs.get("data_format", "NCHW")
+    nhwc = fmt == "NHWC"
+    groups = attrs.get("groups", x.shape[-1] if nhwc else x.shape[1])
+    dn = ("NHWC", "OIHW", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
     out = lax.conv_general_dilated(
         x, w,
         window_strides=attrs.get("strides", [1, 1]),
         padding=_conv_pad(attrs.get("paddings", [0, 0]),
                           attrs.get("padding_algorithm", "EXPLICIT"), 2),
         rhs_dilation=attrs.get("dilations", [1, 1]),
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        dimension_numbers=dn,
         feature_group_count=groups)
     return {"Output": [out.astype(x.dtype)]}
 
@@ -147,8 +150,9 @@ def _conv3d(ins, attrs, ctx):
 def _pool2d(ins, attrs, ctx):
     x = _x(ins)
     ptype = attrs.get("pooling_type", "max")
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
     if attrs.get("global_pooling", False):
-        axis = (2, 3)
+        axis = (1, 2) if nhwc else (2, 3)
         out = (jnp.max(x, axis, keepdims=True) if ptype == "max"
                else jnp.mean(x, axis, keepdims=True))
         return {"Out": [out]}
@@ -156,13 +160,18 @@ def _pool2d(ins, attrs, ctx):
     st = attrs.get("strides", ks)
     pd = attrs.get("paddings", [0, 0])
     algo = attrs.get("padding_algorithm", "EXPLICIT")
+    sp_pads = ([(pd[0], pd[1]), (pd[2], pd[3])] if len(pd) == 4
+               else [(pd[0], pd[0]), (pd[1], pd[1])])
     if algo == "SAME":
         pads = "SAME"
-    elif len(pd) == 4:
-        pads = [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])]
+    elif nhwc:
+        pads = [(0, 0)] + sp_pads + [(0, 0)]
     else:
-        pads = [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])]
-    dims, strides = (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1])
+        pads = [(0, 0), (0, 0)] + sp_pads
+    if nhwc:
+        dims, strides = (1, ks[0], ks[1], 1), (1, st[0], st[1], 1)
+    else:
+        dims, strides = (1, 1, ks[0], ks[1]), (1, 1, st[0], st[1])
     if ptype == "max":
         out = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
     else:
@@ -181,13 +190,22 @@ def _pool2d(ins, attrs, ctx):
 def _adaptive_pool2d(ins, attrs, ctx):
     x = _x(ins)
     oh, ow = attrs["ksize"] if "ksize" in attrs else attrs["output_size"]
-    n, c, h, w = x.shape
+    nhwc = attrs.get("data_format", "NCHW") == "NHWC"
+    if nhwc:
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
     # adaptive pooling with uniform bins (exact when divisible; fluid common case)
     assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
-    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if nhwc:
+        x = x.reshape(n, oh, h // oh, ow, w // ow, c)
+        red = (2, 4)
+    else:
+        x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        red = (3, 5)
     if attrs.get("pooling_type", "avg") == "avg":
-        return {"Out": [x.mean(axis=(3, 5))]}
-    return {"Out": [x.max(axis=(3, 5))]}
+        return {"Out": [x.mean(axis=red)]}
+    return {"Out": [x.max(axis=red)]}
 
 
 @register_op("softmax")

@@ -33,12 +33,13 @@ class LeNet(Layer):
 
 
 class ConvBNLayer(Layer):
-    def __init__(self, cin, cout, ksize, stride=1, groups=1, act=None):
+    def __init__(self, cin, cout, ksize, stride=1, groups=1, act=None,
+                 data_format="NCHW"):
         super().__init__()
         self.conv = Conv2D(cin, cout, ksize, stride=stride,
                            padding=(ksize - 1) // 2, groups=groups,
-                           bias_attr=False)
-        self.bn = BatchNorm(cout, act=act)
+                           bias_attr=False, data_format=data_format)
+        self.bn = BatchNorm(cout, act=act, data_layout=data_format)
 
     def forward(self, x):
         return self.bn(self.conv(x))
@@ -47,13 +48,17 @@ class ConvBNLayer(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, cin, cout, stride=1, shortcut=True):
+    def __init__(self, cin, cout, stride=1, shortcut=True,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv0 = ConvBNLayer(cin, cout, 1, act="relu")
-        self.conv1 = ConvBNLayer(cout, cout, 3, stride=stride, act="relu")
-        self.conv2 = ConvBNLayer(cout, cout * 4, 1)
+        fmt = data_format
+        self.conv0 = ConvBNLayer(cin, cout, 1, act="relu", data_format=fmt)
+        self.conv1 = ConvBNLayer(cout, cout, 3, stride=stride, act="relu",
+                                 data_format=fmt)
+        self.conv2 = ConvBNLayer(cout, cout * 4, 1, data_format=fmt)
         if not shortcut:
-            self.short = ConvBNLayer(cin, cout * 4, 1, stride=stride)
+            self.short = ConvBNLayer(cin, cout * 4, 1, stride=stride,
+                                     data_format=fmt)
         self.shortcut = shortcut
 
     def forward(self, x):
@@ -65,12 +70,16 @@ class BottleneckBlock(Layer):
 class BasicBlock(Layer):
     expansion = 1
 
-    def __init__(self, cin, cout, stride=1, shortcut=True):
+    def __init__(self, cin, cout, stride=1, shortcut=True,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv0 = ConvBNLayer(cin, cout, 3, stride=stride, act="relu")
-        self.conv1 = ConvBNLayer(cout, cout, 3)
+        fmt = data_format
+        self.conv0 = ConvBNLayer(cin, cout, 3, stride=stride, act="relu",
+                                 data_format=fmt)
+        self.conv1 = ConvBNLayer(cout, cout, 3, data_format=fmt)
         if not shortcut:
-            self.short = ConvBNLayer(cin, cout, 1, stride=stride)
+            self.short = ConvBNLayer(cin, cout, 1, stride=stride,
+                                     data_format=fmt)
         self.shortcut = shortcut
 
     def forward(self, x):
@@ -88,11 +97,14 @@ class ResNet(Layer):
            101: (BottleneckBlock, [3, 4, 23, 3]),
            152: (BottleneckBlock, [3, 8, 36, 3])}
 
-    def __init__(self, depth=50, num_classes=1000, with_pool=True):
+    def __init__(self, depth=50, num_classes=1000, with_pool=True,
+                 data_format="NCHW"):
         super().__init__()
         block, layers_cfg = self.cfg[depth]
-        self.stem = ConvBNLayer(3, 64, 7, stride=2, act="relu")
-        self.pool1 = MaxPool2D(3, 2, 1)
+        fmt = data_format
+        self.stem = ConvBNLayer(3, 64, 7, stride=2, act="relu",
+                                data_format=fmt)
+        self.pool1 = MaxPool2D(3, 2, 1, data_format=fmt)
         cin = 64
         blocks = []
         for i, n in enumerate(layers_cfg):
@@ -100,12 +112,13 @@ class ResNet(Layer):
             for j in range(n):
                 stride = 2 if j == 0 and i > 0 else 1
                 shortcut = not (j == 0)
-                blocks.append(block(cin, cout, stride, shortcut))
+                blocks.append(block(cin, cout, stride, shortcut,
+                                    data_format=fmt))
                 cin = cout * block.expansion
         self.blocks = LayerList(blocks)
         self.with_pool = with_pool
         if with_pool:
-            self.avgpool = AdaptiveAvgPool2D(1)
+            self.avgpool = AdaptiveAvgPool2D(1, data_format=fmt)
         self.out_dim = cin
         if num_classes > 0:
             self.flatten = Flatten()

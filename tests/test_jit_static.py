@@ -391,3 +391,48 @@ class TestAstControlFlow:
         np.testing.assert_allclose(f(big).numpy(), [30.0, 30.0])
         np.testing.assert_allclose(f(small).numpy(), [2.0, 2.0])
         np.testing.assert_allclose(f(neg).numpy(), [-2.0, -2.0])
+
+    def test_loop_var_python_semantics_after_loop(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+
+        @declarative
+        def f(x):
+            for i in range(3):
+                x = x + 1.0
+            return x * float(i)          # python: i ends at 2
+
+        out = f(to_variable(np.ones((2,), "float32")))
+        np.testing.assert_allclose(out.numpy(), [8.0, 8.0])
+
+    def test_one_branch_binding_stays_unbound(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+
+        @declarative
+        def f(x, flag=False):
+            if flag:
+                y = x * 2.0
+            return y                     # python: UnboundLocalError
+
+        with pytest.raises((NameError, UnboundLocalError)):
+            f(to_variable(np.ones((2,), "float32")), False)
+
+    def test_super_method_falls_back_to_tracing(self, dygraph_mode):
+        from paddle_tpu.dygraph.jit_static import declarative
+        from paddle_tpu.fluid import layers as L
+
+        class Base(Layer):
+            def forward(self, x):
+                return x + 1.0
+
+        class Child(Base):
+            @declarative
+            def forward(self, x, flag=True):
+                if flag:                 # convertible region + super()
+                    y = super().forward(x)
+                else:
+                    y = x
+                return y
+
+        m = Child()
+        out = m(to_variable(np.zeros((2,), "float32")))
+        np.testing.assert_allclose(out.numpy(), [1.0, 1.0])

@@ -1,0 +1,113 @@
+"""NHWC (channels-last) layout parity: the TPU-preferred layout must be
+numerically identical to NCHW across conv/pool/bn and the ResNet zoo
+(BASELINE config #2 runs NHWC end-to-end; layout is the lever for a
+bandwidth-bound conv step)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.registry import _OP_REGISTRY, LoweringContext
+
+
+def _ctx():
+    return LoweringContext(base_key=jax.random.PRNGKey(0))
+
+
+class TestOpLayoutParity:
+    def test_conv2d(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32")
+        a = {"strides": [2, 2], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1}
+        fn = _OP_REGISTRY["conv2d"].fn
+        out_nchw = fn({"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+                      a, _ctx())["Output"][0]
+        out_nhwc = fn({"Input": [jnp.asarray(x.transpose(0, 2, 3, 1))],
+                       "Filter": [jnp.asarray(w)]},
+                      dict(a, data_format="NHWC"), _ctx())["Output"][0]
+        np.testing.assert_allclose(np.asarray(out_nhwc),
+                                   np.asarray(out_nchw).transpose(0, 2, 3,
+                                                                  1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_conv2d(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4, 6, 6).astype("float32")
+        w = rng.randn(4, 1, 3, 3).astype("float32")
+        a = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 4}
+        fn = _OP_REGISTRY["depthwise_conv2d"].fn
+        o1 = fn({"Input": [jnp.asarray(x)], "Filter": [jnp.asarray(w)]},
+                a, _ctx())["Output"][0]
+        o2 = fn({"Input": [jnp.asarray(x.transpose(0, 2, 3, 1))],
+                 "Filter": [jnp.asarray(w)]},
+                dict(a, data_format="NHWC"), _ctx())["Output"][0]
+        np.testing.assert_allclose(np.asarray(o2),
+                                   np.asarray(o1).transpose(0, 2, 3, 1),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("ptype", ["max", "avg"])
+    def test_pool2d(self, ptype):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        a = {"pooling_type": ptype, "ksize": [3, 3], "strides": [2, 2],
+             "paddings": [1, 1]}
+        fn = _OP_REGISTRY["pool2d"].fn
+        o1 = fn({"X": [jnp.asarray(x)]}, a, _ctx())["Out"][0]
+        o2 = fn({"X": [jnp.asarray(x.transpose(0, 2, 3, 1))]},
+                dict(a, data_format="NHWC"), _ctx())["Out"][0]
+        np.testing.assert_allclose(np.asarray(o2),
+                                   np.asarray(o1).transpose(0, 2, 3, 1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_global_and_adaptive_pool(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 3, 8, 8).astype("float32")
+        fn = _OP_REGISTRY["pool2d"].fn
+        o1 = fn({"X": [jnp.asarray(x)]},
+                {"pooling_type": "avg", "global_pooling": True,
+                 "ksize": [1, 1]}, _ctx())["Out"][0]
+        o2 = fn({"X": [jnp.asarray(x.transpose(0, 2, 3, 1))]},
+                {"pooling_type": "avg", "global_pooling": True,
+                 "ksize": [1, 1], "data_format": "NHWC"}, _ctx())["Out"][0]
+        np.testing.assert_allclose(np.asarray(o2).transpose(0, 3, 1, 2),
+                                   np.asarray(o1), rtol=1e-5)
+        afn = _OP_REGISTRY["adaptive_pool2d"].fn
+        a1 = afn({"X": [jnp.asarray(x)]},
+                 {"ksize": [2, 2], "pooling_type": "avg"}, _ctx())["Out"][0]
+        a2 = afn({"X": [jnp.asarray(x.transpose(0, 2, 3, 1))]},
+                 {"ksize": [2, 2], "pooling_type": "avg",
+                  "data_format": "NHWC"}, _ctx())["Out"][0]
+        np.testing.assert_allclose(np.asarray(a2),
+                                   np.asarray(a1).transpose(0, 2, 3, 1),
+                                   rtol=1e-5)
+
+
+class TestResNetLayoutParity:
+    def test_resnet18_same_logits_both_layouts(self):
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.base import to_variable
+        from paddle_tpu.vision.models import ResNet
+
+        dybase.enable_dygraph()
+        try:
+            m1 = ResNet(18, num_classes=8)
+            m2 = ResNet(18, num_classes=8, data_format="NHWC")
+            m1.eval()
+            m2.eval()
+            # identical weights: filters are OIHW in both layouts, BN/fc
+            # params are per-channel — positional transfer is exact
+            for p1, p2 in zip(m1.parameters(), m2.parameters()):
+                assert p1.shape == p2.shape
+                p2._value = p1._value
+            rng = np.random.RandomState(5)
+            x = rng.randn(2, 3, 32, 32).astype("float32")
+            y1 = np.asarray(m1(to_variable(x)).numpy())
+            y2 = np.asarray(m2(to_variable(
+                x.transpose(0, 2, 3, 1).copy())).numpy())
+            np.testing.assert_allclose(y2, y1, rtol=1e-3, atol=1e-4)
+        finally:
+            dybase.disable_dygraph()
