@@ -64,6 +64,7 @@ def rewrite_program_bf16(program: Program, amp_lists: CustomOpLists = None,
                 op.inputs[slot] = new_names
         new_ops.append(op)
     block.ops = new_ops
+    program._bump_version()
     program._amp_enabled = True
     program._amp_dtype = dtype
     return program

@@ -87,6 +87,7 @@ class QuantizationTransformPass:
                     op.inputs[slot] = renamed
             new_ops.append(op)
         block.ops = new_ops
+        program._bump_version()
         program._quant_bits = (self.weight_bits, self.activation_bits)
         return program
 
@@ -117,6 +118,7 @@ class QuantizationFreezePass:
                 op.inputs[slot] = [rename.get(n, n) for n in names]
             keep.append(op)
         block.ops = keep
+        program._bump_version()
         program._quant_scales = scales
         return program
 

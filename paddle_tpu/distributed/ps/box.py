@@ -26,6 +26,7 @@ Driven by executor.train_from_dataset via program._hints['box_plan']
 """
 from __future__ import annotations
 
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
 import numpy as np
@@ -34,7 +35,16 @@ from .table import CommonSparseTable, Initializer
 
 
 class BoxPSWrapper:
-    """One embedding table's host store + per-pass HBM cache state."""
+    """One embedding table's host store + per-pass HBM cache state.
+
+    Pass N+1's host work overlaps pass N's device training
+    (box_wrapper.h:339 BeginFeedPass runs ahead of the training pass;
+    trainer.h:163 HeterXpuTrainer overlaps host sparse work with device
+    dense compute): `begin_pass_async` runs the unique-sweep and the
+    host-store pull on a worker thread while the chip trains, ids shared
+    with the in-flight pass are patched from the trained values at
+    commit, and `end_pass_async` writes back in the background (the next
+    pull waits on the write future, never on the trainer thread)."""
 
     def __init__(self, dim: int, init_kind: str = "uniform",
                  init_scale: float = 0.07, seed: int = 0,
@@ -47,20 +57,80 @@ class BoxPSWrapper:
             initializer=Initializer(init_kind, init_scale, seed))
         self._pass_ids: Optional[np.ndarray] = None   # sorted unique
         self._cache_rows = 0                          # padded C
+        self._pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="boxps")
+        self._wb_future: Optional[Future] = None      # in-flight writeback
+        self._last_trained = None                     # (ids, vals) of it
 
     # -- pass lifecycle -----------------------------------------------------
     def begin_pass(self, ids) -> np.ndarray:
         """Stage the pass working set; returns the [C, dim] cache value
-        (padded with zero rows) to seed the cache parameter."""
-        uniq = np.unique(np.asarray(ids).reshape(-1))
-        if len(uniq) == 0:
+        (padded with zero rows) to seed the cache parameter.  Synchronous
+        form of begin_pass_async + begin_pass_commit."""
+        cache = self.begin_pass_commit(self.begin_pass_async(ids))
+        if cache is None:
             raise ValueError("begin_pass: empty id set")
-        rows = self.host.pull(uniq)
-        c = 1 << int(np.ceil(np.log2(max(1, len(uniq)))))
-        cache = np.zeros((c, self.dim), np.float32)
-        cache[: len(uniq)] = rows
+        return cache
+
+    def begin_pass_async(self, ids) -> Future:
+        """Start staging the NEXT pass on a worker thread while the
+        current pass trains.  `ids` is an array OR a zero-arg callable
+        producing one (so the dataset enumeration sweep itself runs on
+        the worker too).  The heavy host work (sweep + store pull) runs
+        concurrently with device compute; ids that belong to the
+        still-training current pass are left as placeholders and patched
+        from the trained values at commit time."""
+        cur_ids = self._pass_ids                     # snapshot: may train now
+        wb = self._wb_future
+
+        def work():
+            raw = ids() if callable(ids) else ids
+            uniq = np.unique(np.asarray(raw).reshape(-1))
+            if len(uniq) == 0:
+                return None, None, None     # empty pass: commit -> None
+            if wb is not None:
+                wb.result()          # prior pass's writeback must land
+            if cur_ids is not None and len(cur_ids):
+                pos = np.searchsorted(cur_ids, uniq)
+                pos = np.minimum(pos, len(cur_ids) - 1)
+                stale = cur_ids[pos] == uniq         # in-flight on device
+            else:
+                stale = np.zeros(len(uniq), bool)
+            rows = np.zeros((len(uniq), self.dim), np.float32)
+            fresh = ~stale
+            if fresh.any():
+                rows[fresh] = self.host.pull(uniq[fresh])
+            c = 1 << int(np.ceil(np.log2(max(1, len(uniq)))))
+            cache = np.zeros((c, self.dim), np.float32)
+            cache[: len(uniq)] = rows
+            return uniq, cache, stale
+
+        return self._pool.submit(work)
+
+    def begin_pass_commit(self, fut: Future) -> np.ndarray:
+        """Make the prefetched pass current.  Call AFTER end_pass[_async]
+        of the previous pass: stale rows (ids shared with that pass) are
+        patched here from its trained values, so the prefetch never
+        observes half-trained state."""
+        uniq, cache, stale = fut.result()
+        if uniq is None:
+            return None          # empty pass: a no-op, state untouched
+        if stale.any():
+            idx = np.flatnonzero(stale)
+            sids = uniq[idx]
+            if self._last_trained is not None:
+                tids, tvals = self._last_trained
+                pos = np.searchsorted(tids, sids)
+                pos = np.minimum(pos, len(tids) - 1)
+                hit = tids[pos] == sids
+                cache[idx[hit]] = tvals[pos[hit]]
+                idx, sids = idx[~hit], sids[~hit]
+            if len(idx):
+                # previous pass was abandoned (eval): store is the truth
+                self.wait_writeback()
+                cache[idx] = self.host.pull(sids)
         self._pass_ids = uniq
-        self._cache_rows = c
+        self._cache_rows = len(cache)
         return cache
 
     def slots_of(self, ids) -> np.ndarray:
@@ -81,12 +151,26 @@ class BoxPSWrapper:
 
     def end_pass(self, cache_value):
         """Write the trained cache rows back to the host store."""
+        self.end_pass_async(cache_value)
+        self._wb_future.result()
+
+    def end_pass_async(self, cache_value):
+        """Fetch the trained rows now (the one D2H sync), write them back
+        on a worker thread: the store write overlaps the NEXT pass's
+        training; begin_pass_async chains on the future, and the trained
+        values stay in memory to patch a prefetched pass's shared ids."""
         if self._pass_ids is None:
             raise RuntimeError("end_pass before begin_pass")
-        vals = np.asarray(cache_value, np.float32)[: len(self._pass_ids)]
-        self.host.set_rows(self._pass_ids, vals)
+        ids = self._pass_ids
+        vals = np.asarray(cache_value, np.float32)[: len(ids)].copy()
+        self._last_trained = (ids, vals)
+        self._wb_future = self._pool.submit(self.host.set_rows, ids, vals)
         self._pass_ids = None
         self._cache_rows = 0
+
+    def wait_writeback(self):
+        if self._wb_future is not None:
+            self._wb_future.result()
 
     def abandon_pass(self):
         """Close a pull-only pass (inference sweep): no writeback."""

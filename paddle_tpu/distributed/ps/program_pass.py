@@ -98,6 +98,7 @@ def _startup_init_kind(startup_program, w_name):
                 hi = float(op.attr("max", 0.07))
                 kind, scale = "uniform", max(abs(lo), abs(hi))
         b.ops = [op for op in b.ops if w_name not in op.output_arg_names]
+        b.program._bump_version()
     return kind, scale
 
 
@@ -276,11 +277,20 @@ def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
             # the DEVICE only reads ids for shape + padding positions (the
             # rows feed is positional); wide feasigns must not truncate on
             # staging, so remap to a safe int32 pattern preserving ==pad
-            pad = -1
-            for op in program.global_block().ops:
-                if op.type == "ps_lookup_rows" \
-                        and op.input("Ids") == [s["ids"]]:
-                    pad = int(op.attr("padding_idx", -1))
+            pads = {int(op.attr("padding_idx", -1))
+                    for op in program.global_block().ops
+                    if op.type == "ps_lookup_rows"
+                    and op.input("Ids") == [s["ids"]]}
+            pads.discard(-1)        # -1 = no padding: insensitive to remap
+            if len(pads) > 1:
+                # one int32 remap pattern serves every lookup reading this
+                # ids var; conflicting pads would zero the wrong rows
+                raise ValueError(
+                    f"PS run: ids var '{s['ids']}' is read by "
+                    f"ps_lookup_rows ops with conflicting padding_idx "
+                    f"values {sorted(pads)}; feed each lookup a separate "
+                    f"ids var or align their padding_idx")
+            pad = pads.pop() if pads else -1
             safe_val = 0 if pad == 1 else 1     # never collide with pad
             safe = (np.where(ids == pad, pad, safe_val).astype(np.int64)
                     if pad >= 0

@@ -38,34 +38,20 @@ class TrainerStats:
                 "stage_fallbacks": self.stage_fallbacks}
 
 
-def _box_pass(program, dataset, train):
-    """BoxPS pass lifecycle around a dataset sweep (box_wrapper.h:339-366
-    BeginPass/EndPass): enumerate the pass's unique feasigns, stage the HBM
-    cache parameter, translate raw ids to cache slots per batch, and (for
-    training) write trained rows back at the end.  Returns
-    (batch_transform, finish) — identity pair when the program has no box
-    plan."""
-    plan = getattr(program, "_hints", {}).get("box_plan")
-    if not plan:
-        return (lambda feed: feed), (lambda: None)
-    from ..distributed.ps.box import get_box_wrapper
-    from ..fluid.core import global_scope
-
-    box = get_box_wrapper(plan["table"], dim=plan["dim"])
-    # pass enumeration sweep (BeginFeedPass analog).  Per-batch unique
-    # BEFORE accumulating keeps the working memory at O(unique), not
-    # O(records); for streaming QueueDatasets this re-reads the filelist
-    # once — InMemoryDataset (the BoxPS-scale tier) iterates its pool.
+def _enumerate_pass_ids(plan, dataset):
+    """Pass enumeration sweep (BeginFeedPass analog).  Per-batch unique
+    BEFORE accumulating keeps the working memory at O(unique), not
+    O(records); for streaming QueueDatasets this re-reads the filelist
+    once — InMemoryDataset (the BoxPS-scale tier) iterates its pool."""
     ids_all = []
     for batch in dataset._iter_batches():
         for k in plan["ids"]:
             ids_all.append(np.unique(np.asarray(batch[k])))
-    if not ids_all:
-        return (lambda feed: feed), (lambda: None)
-    cache = box.begin_pass(np.concatenate(ids_all))
-    scope = global_scope()
-    scope.set_var(plan["cache"], cache)
+    return (np.concatenate(ids_all) if ids_all
+            else np.zeros(0, np.int64))
 
+
+def _slot_transform(plan, box):
     def transform(feed):
         out = dict(feed)
         for k in plan["ids"]:
@@ -73,6 +59,30 @@ def _box_pass(program, dataset, train):
                 raw = np.asarray(out[k])
                 out[k] = box.slots_of(raw.reshape(-1)).reshape(raw.shape)
         return out
+    return transform
+
+
+def _box_pass(program, dataset, train):
+    """BoxPS pass lifecycle around a dataset sweep (box_wrapper.h:339-366
+    BeginPass/EndPass): enumerate the pass's unique feasigns, stage the HBM
+    cache parameter, translate raw ids to cache slots per batch, and (for
+    training) write trained rows back at the end.  Returns
+    (batch_transform, finish) — identity pair when the program has no box
+    plan.  Multi-pass jobs should use `train_passes`, which overlaps this
+    host work with device training."""
+    plan = getattr(program, "_hints", {}).get("box_plan")
+    if not plan:
+        return (lambda feed: feed), (lambda: None)
+    from ..distributed.ps.box import get_box_wrapper
+    from ..fluid.core import global_scope
+
+    box = get_box_wrapper(plan["table"], dim=plan["dim"])
+    ids = _enumerate_pass_ids(plan, dataset)
+    if not len(ids):
+        return (lambda feed: feed), (lambda: None)
+    cache = box.begin_pass(ids)
+    scope = global_scope()
+    scope.set_var(plan["cache"], cache)
 
     def finish():
         if train:
@@ -80,17 +90,66 @@ def _box_pass(program, dataset, train):
         else:
             box.abandon_pass()            # pull-only pass: no writeback
 
-    return transform, finish
+    return _slot_transform(plan, box), finish
+
+
+def train_passes(executor, program, datasets, fetch_list=None,
+                 print_period=100, train=True, prefetch=2):
+    """Double-buffered BoxPS pass driver (box_wrapper.h:339 BeginFeedPass
+    runs AHEAD of the train pass; trainer.h:163 heter overlap): while pass
+    N trains on device, pass N+1's dataset sweep + host-store pull run on
+    the box worker thread, and pass N's writeback overlaps pass N+1's
+    training.  `datasets` is the ordered list of per-pass datasets; the
+    trained cache rows land in the host store exactly as the serial
+    begin/end loop would place them."""
+    plan = getattr(program, "_hints", {}).get("box_plan")
+    if not plan:
+        raise ValueError("train_passes needs a program with a box_plan "
+                         "hint (pull_box_sparse in the graph)")
+    from ..distributed.ps.box import get_box_wrapper
+    from ..fluid.core import global_scope
+
+    box = get_box_wrapper(plan["table"], dim=plan["dim"])
+    scope = global_scope()
+    results = []
+    datasets = list(datasets)
+    if not datasets:
+        return results
+    fut = box.begin_pass_async(
+        lambda ds=datasets[0]: _enumerate_pass_ids(plan, ds))
+    for i, ds in enumerate(datasets):
+        cache = box.begin_pass_commit(fut)
+        if cache is not None:
+            scope.set_var(plan["cache"], cache)
+        if i + 1 < len(datasets):
+            # next pass's sweep+pull starts NOW, overlapping this train
+            fut = box.begin_pass_async(
+                lambda nxt=datasets[i + 1]: _enumerate_pass_ids(plan, nxt))
+        if cache is None:
+            # empty pass (no batches): a no-op, matching the serial path
+            results.append([])
+            continue
+        results.append(run_from_dataset(
+            executor, program, ds, fetch_list, print_period=print_period,
+            train=train, prefetch=prefetch,
+            _box=(_slot_transform(plan, box),
+                  (lambda: box.end_pass_async(
+                      scope.find_var(plan["cache"]))) if train
+                  else box.abandon_pass)))
+    box.wait_writeback()
+    return results
 
 
 def run_from_dataset(executor, program, dataset, fetch_list=None,
-                     print_period=100, train=True, prefetch=2):
+                     print_period=100, train=True, prefetch=2, _box=None):
     from ..utils.prefetch import Prefetcher
 
     fetch_list = fetch_list or []
     fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
     stats = TrainerStats()
-    box_transform, box_finish = _box_pass(program, dataset, train)
+    # _box: (transform, finish) injected by train_passes, which manages
+    # the pass lifecycle itself (double-buffered begin/end)
+    box_transform, box_finish = _box or _box_pass(program, dataset, train)
 
     def stage(feed):
         # async H2D: device_put returns immediately, so the transfer of

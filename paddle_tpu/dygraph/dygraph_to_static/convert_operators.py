@@ -29,6 +29,43 @@ class _Undefined:
 UNDEFINED = _Undefined()
 
 
+class _UnboundGuard:
+    """Stands in for a name that was unbound when a traced region started:
+    any USE inside the region raises a clear UnboundLocalError instead of
+    an obscure TypeError on the raw UNDEFINED sentinel (a body that only
+    WRITES the name never touches the guard)."""
+
+    def __init__(self, name, where):
+        object.__setattr__(
+            self, "_msg",
+            f"dygraph-to-static: variable '{name}' may be unbound when the "
+            f"converted {where} body runs (it was not assigned before the "
+            f"{where}); bind it before the {where} or make the first use "
+            f"inside the body a write")
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(object.__getattribute__(self, "_msg"))
+
+    __getattr__ = _raise
+
+    def __repr__(self):
+        return "<unbound-in-traced-region>"
+
+
+for _d in ("add radd sub rsub mul rmul truediv rtruediv floordiv rfloordiv "
+           "mod rmod pow rpow matmul rmatmul neg pos abs invert lt le gt ge "
+           "eq ne bool len getitem setitem delitem call iter contains "
+           "and rand or ror xor rxor lshift rlshift rshift rrshift "
+           "int float index").split():
+    setattr(_UnboundGuard, f"__{_d}__", _UnboundGuard._raise)
+
+
+def _guarded(args, names, where):
+    """args with UNDEFINED entries replaced by per-name use guards."""
+    return [a if a is not UNDEFINED else _UnboundGuard(n, where)
+            for n, a in zip(names, args)]
+
+
 def defined(thunk):
     """True when `thunk()` (a lambda closing over a local) is bound."""
     try:
@@ -66,10 +103,12 @@ def _promote(name, v, where):
     import jax
     import jax.numpy as jnp
     from ..base import VarBase
-    if v is UNDEFINED:
+    if v is UNDEFINED or isinstance(v, _UnboundGuard):
+        # a guard escaping untouched means the branch/body never assigned
+        # the name — surface the unbound diagnostic, not a type mismatch
         raise ValueError(
             f"dygraph-to-static: variable '{name}' may be undefined after "
-            f"the tensor-dependent {where}; bind it before the branch")
+            f"the tensor-dependent {where}; bind it before the {where}")
     if isinstance(v, VarBase):
         return v._value
     if isinstance(v, (jax.Array, np.ndarray)) or _is_tracer(v):
@@ -99,9 +138,11 @@ def convert_ifelse(pred, true_fn, false_fn, names, args):
         return outs
     from jax import lax
 
+    gargs = _guarded(args, names, "branch")
+
     def run(fn):
         def g(_):
-            outs = fn(*args)
+            outs = fn(*gargs)
             return tuple(_promote(n, o, "branch")
                          for n, o in zip(names, outs))
         return g
@@ -135,7 +176,14 @@ def range_cond(i, stop, step):
 
 def convert_while_loop(cond_fn, body_fn, names, args):
     """Rewritten `while`: Python condition -> Python loop; traced tensor
-    condition -> lax.while_loop with the loop variables as the carry."""
+    condition -> lax.while_loop with the loop variables as the carry.
+
+    Known divergence from eager Python: names unbound BEFORE the loop are
+    body-local temps — they cannot escape a traced loop, so after a
+    `for i in range(t)` with a tensor bound the loop variable stays unbound
+    post-loop, where eager Python would leave the last value bound.  Reads
+    of such a name before its first in-body write raise UnboundLocalError
+    via _UnboundGuard instead of silently computing with a sentinel."""
     first = cond_fn(*args)
     p = _pred_value(first)
     if not _is_tracer(p):
@@ -153,9 +201,10 @@ def convert_while_loop(cond_fn, body_fn, names, args):
     # analysis, done at runtime instead of on the AST).
     live = [i for i, a in enumerate(args) if a is not UNDEFINED]
     carry0 = tuple(_promote(names[i], args[i], "while loop") for i in live)
+    guarded = _guarded(args, names, "while loop")
 
     def merge(c):
-        vals = list(args)
+        vals = list(guarded)
         for k, i in enumerate(live):
             vals[i] = _rewrap(args[i], c[k])
         return vals

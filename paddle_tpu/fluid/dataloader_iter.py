@@ -1,0 +1,236 @@
+"""Multiprocess DataLoader workers.
+
+Reference: python/paddle/fluid/dataloader/dataloader_iter.py
+(_DataLoaderIterMultiProcess: worker processes fed per-worker index
+queues, one shared data queue, out-of-order completion reordered for
+determinism, exceptions forwarded, sentinel shutdown) and
+reader.py:789 (multiprocess generator path).
+
+TPU-native re-design: workers are pure numpy/python processes — they
+never touch jax (the child inherits the parent's live client state but
+only runs dataset/transform code over numpy + mp queues).  Workers FORK
+by default — zero-copy dataset inheritance, no picklability demands on
+datasets/closures — which is the reference's (and torch's) linux
+default; forking a process whose jax client is already live carries a
+documented deadlock risk in exotic transform code, so
+PADDLE_TPU_WORKER_START=spawn opts the map-style pool into spawn (then
+dataset/collate_fn/worker_init_fn must be picklable top-level objects).
+The generator path must close over arbitrary user state, so it always
+forks.  The parent overlaps H2D staging via utils.prefetch on top of
+this pool, and the bounded in-flight window doubles as back-pressure so
+a slow consumer never accumulates the whole epoch in the data queue.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import queue as _queue
+import time
+import traceback
+
+import numpy as np
+
+_FORK = mp.get_context("fork")      # args ride the fork, no pickling of
+                                    # datasets/closures required (linux)
+
+
+def _map_ctx():
+    method = os.environ.get("PADDLE_TPU_WORKER_START", "fork")
+    return mp.get_context(method) if method != "fork" else _FORK
+
+
+def _drain_get(data_q, workers, timeout, what):
+    """queue.get honoring paddle timeout semantics: timeout==0 blocks
+    indefinitely but still detects a dead pool (all workers exited with
+    the queue empty) instead of hanging forever."""
+    deadline = time.monotonic() + timeout if timeout else None
+    while True:
+        try:
+            return data_q.get(timeout=min(30.0, timeout) if timeout
+                              else 30.0)
+        except _queue.Empty:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise WorkerError(
+                    f"DataLoader {what} produced nothing for "
+                    f"{timeout:.0f}s (timeout); dead worker or a "
+                    f"transform deadlock") from None
+            if workers and not any(p.is_alive() for p in workers):
+                try:        # a result may have raced the liveness check
+                    return data_q.get(timeout=1.0)
+                except _queue.Empty:
+                    raise WorkerError(
+                        f"DataLoader {what}: all worker processes exited "
+                        f"without delivering the next batch") from None
+
+
+class WorkerError(RuntimeError):
+    """A dataset/transform error inside a worker process, carrying the
+    worker's formatted traceback."""
+
+
+class _Err:
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+        self.tb = traceback.format_exc()
+
+
+def _map_worker_loop(dataset, collate_fn, index_q, data_q, worker_id,
+                     init_fn, base_seed):
+    """One map-style worker: pull (batch_idx, indices), push
+    (batch_idx, collated batch)."""
+    # per-worker deterministic RNG stream for random transforms
+    np.random.seed((base_seed + worker_id) % (2 ** 32))
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+    except Exception:
+        data_q.put((-1, _Err(worker_id)))
+        return
+    while True:
+        task = index_q.get()
+        if task is None:
+            return
+        bidx, indices = task
+        try:
+            batch = collate_fn([dataset[int(i)] for i in indices])
+            data_q.put((bidx, batch))
+        except Exception:
+            data_q.put((bidx, _Err(worker_id)))
+            return
+
+
+def _gen_worker_loop(gen_factory, data_q):
+    """Generator path: ONE streamer process runs the whole generator (a
+    generator has serial semantics; the win is moving its python/numpy
+    work off the trainer process, reader.py use_multiprocess)."""
+    try:
+        for i, item in enumerate(gen_factory()):
+            data_q.put((i, item))
+        data_q.put((-1, None))                     # clean end
+    except Exception:
+        data_q.put((-1, _Err(0)))
+
+
+def _raise_worker(err):
+    raise WorkerError(
+        f"DataLoader worker {err.worker_id} failed:\n{err.tb}")
+
+
+class MultiprocessMapIter:
+    """Iterator over a map-style dataset using a fork worker pool.
+
+    Batches are dispatched round-robin to per-worker index queues with a
+    bounded in-flight window (num_workers * prefetch_factor) and yielded
+    IN ORDER via a reorder buffer, so `num_workers` changes throughput,
+    never the stream."""
+
+    def __init__(self, batches, dataset, collate_fn, num_workers,
+                 worker_init_fn=None, timeout=0, prefetch_factor=2):
+        self._batches = list(batches)      # list of index arrays
+        self._timeout = timeout or 0      # 0 = wait indefinitely (paddle)
+        self._nw = num_workers
+        ctx = _map_ctx()
+        self._data_q = ctx.Queue()
+        self._index_qs = [ctx.Queue() for _ in range(num_workers)]
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        self._workers = [
+            ctx.Process(
+                target=_map_worker_loop,
+                args=(dataset, collate_fn, self._index_qs[w], self._data_q,
+                      w, worker_init_fn, base_seed),
+                daemon=True)
+            for w in range(num_workers)]
+        for p in self._workers:
+            p.start()
+        self._window = max(2, num_workers * prefetch_factor)
+        self._next_dispatch = 0
+        self._next_yield = 0
+        self._reorder = {}
+        self._closed = False
+
+    def _dispatch(self):
+        while (self._next_dispatch < len(self._batches)
+               and self._next_dispatch - self._next_yield < self._window):
+            b = self._next_dispatch
+            self._index_qs[b % self._nw].put((b, self._batches[b]))
+            self._next_dispatch += 1
+
+    def __iter__(self):
+        try:
+            while self._next_yield < len(self._batches):
+                self._dispatch()
+                while self._next_yield not in self._reorder:
+                    bidx, payload = _drain_get(
+                        self._data_q, self._workers, self._timeout,
+                        f"workers (batch {self._next_yield})")
+                    if isinstance(payload, _Err):
+                        _raise_worker(payload)
+                    self._reorder[bidx] = payload
+                yield self._reorder.pop(self._next_yield)
+                self._next_yield += 1
+        finally:
+            self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for q in self._index_qs:
+            try:
+                q.put(None)
+            except (OSError, ValueError):
+                pass
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        # release queue feeder threads
+        for q in self._index_qs + [self._data_q]:
+            try:
+                q.close()
+            except (OSError, ValueError):
+                pass
+
+    def __del__(self):
+        self.close()
+
+
+class MultiprocessGenIter:
+    """Iterator running a batch generator in one streamer process."""
+
+    def __init__(self, gen_factory, timeout=0, capacity=8):
+        self._timeout = timeout or 0
+        self._data_q = _FORK.Queue(maxsize=capacity)
+        self._proc = _FORK.Process(target=_gen_worker_loop,
+                                   args=(gen_factory, self._data_q),
+                                   daemon=True)
+        self._proc.start()
+        self._closed = False
+
+    def __iter__(self):
+        try:
+            while True:
+                i, item = _drain_get(self._data_q, [self._proc],
+                                     self._timeout, "generator worker")
+                if i == -1:
+                    if isinstance(item, _Err):
+                        _raise_worker(item)
+                    return
+                yield item
+        finally:
+            self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._proc.is_alive():
+            self._proc.terminate()
+        self._proc.join(timeout=5)
+        try:
+            self._data_q.close()
+        except (OSError, ValueError):
+            pass
+
+    def __del__(self):
+        self.close()

@@ -65,6 +65,17 @@ def check_feed_width(name, v):
 
 
 def _fingerprint(program: Program) -> str:
+    """Structural SHA-1 of the program, cached on the Program and
+    invalidated by mutation (the _ExecutorCache amortisation: reference
+    executor.py:1110 prepares once, not per step).  The cache key is the
+    program's mutation version (bumped by append_op and the graph passes)
+    plus per-block op counts as a safety net against a pass that swaps
+    `block.ops` wholesale without bumping."""
+    shape = (getattr(program, "_version", None),
+             tuple(len(b.ops) for b in program.blocks))
+    cached = getattr(program, "_fp_cache", None)
+    if cached is not None and cached[0] == shape:
+        return cached[1]
     h = hashlib.sha1()
     for b in program.blocks:
         for op in b.ops:
@@ -73,7 +84,9 @@ def _fingerprint(program: Program) -> str:
             h.update(repr(sorted(op.outputs.items())).encode())
             h.update(repr(sorted((k, str(v)) for k, v in op.attrs.items()))
                      .encode())
-    return h.hexdigest()
+    digest = h.hexdigest()
+    program._fp_cache = (shape, digest)
+    return digest
 
 
 class _CompiledBlock:
@@ -393,6 +406,15 @@ class Executor:
         from ..distributed.trainer import run_from_dataset
         return run_from_dataset(self, program, dataset, fetch_list,
                                 print_period, train=False)
+
+    def train_passes(self, program, datasets, fetch_list=None,
+                     print_period=100):
+        """Multi-pass BoxPS training with pass N+1's host staging and
+        pass N's writeback overlapped against device compute
+        (box_wrapper.h BeginFeedPass/EndPass double buffering)."""
+        from ..distributed.trainer import train_passes
+        return train_passes(self, program, datasets, fetch_list,
+                            print_period, train=True)
 
     def close(self):
         self._cache.clear()

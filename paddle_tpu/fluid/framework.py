@@ -240,6 +240,7 @@ class Block:
             # (reference: operator.cc:1180 per-op `op_device` for pipeline)
             op.attrs["op_device"] = _current_device
         self.ops.append(op)
+        self.program._bump_version()
         for names in op.outputs.values():
             for n in names:
                 if self._find_var_recursive(n) is None:
@@ -273,6 +274,14 @@ class Program:
         self._amp_enabled = False
         self._amp_dtype = "bfloat16"
         self._hints: Dict[str, Any] = {}
+        # executor fingerprint cache: bumped on every op mutation so the
+        # per-step SHA-1 recompute is amortised away (executor._fingerprint)
+        self._version = 0
+        self._fp_cache = None
+
+    def _bump_version(self):
+        self._version += 1
+        self._fp_cache = None
 
     def global_block(self) -> Block:
         return self.blocks[0]
@@ -327,6 +336,7 @@ class Program:
             for b in p.blocks:
                 b.ops = prune_ops(b, b.ops, targets=None,
                                   keep_state_writes=False)
+        p._bump_version()
         return p
 
     def _prune(self, targets) -> "Program":
@@ -339,6 +349,7 @@ class Program:
         p = copy.deepcopy(self)
         b = p.global_block()
         b.ops = prune_ops(b, b.ops, targets=names, keep_state_writes=False)
+        p._bump_version()
         return p
 
     def __repr__(self):

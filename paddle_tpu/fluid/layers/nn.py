@@ -520,6 +520,48 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
     return op["Out"][0] if in_dygraph_mode() else out
 
 
+def _fused_dropout_attrs(helper, dropout_prob, is_test, seed,
+                         dropout_implementation):
+    attrs = {"dropout_prob": dropout_prob, "is_test": is_test,
+             "dropout_implementation": dropout_implementation}
+    if not in_dygraph_mode():
+        attrs["op_seed"] = seed or helper.main_program.next_op_seed()
+    else:
+        attrs["op_seed"] = seed or 0
+    return attrs
+
+
+def fused_dropout_add(x, residual, dropout_prob, is_test=False, seed=None,
+                      name=None, dropout_implementation="upscale_in_train"):
+    """dropout(x) + residual as ONE op: on TPU a single pallas kernel
+    (no HBM pass for the add at the kernel boundary), mask regenerated in
+    backward.  The transformer residual epilogue
+    (fused_dropout_helper.h analog, TPU-first shape)."""
+    helper = LayerHelper("fused_dropout_add", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    attrs = _fused_dropout_attrs(helper, dropout_prob, is_test, seed,
+                                 dropout_implementation)
+    op = helper.append_op("fused_dropout_add",
+                          inputs={"X": [x], "Residual": [residual]},
+                          outputs={"Out": [out]}, attrs=attrs)
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def fused_act_dropout(x, act="gelu", dropout_prob=0.0, is_test=False,
+                      seed=None, name=None,
+                      dropout_implementation="upscale_in_train"):
+    """dropout(act(x)) as ONE op (MLP mid-epilogue); backward fuses
+    act'(x) with the regenerated mask."""
+    helper = LayerHelper("fused_act_dropout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    attrs = _fused_dropout_attrs(helper, dropout_prob, is_test, seed,
+                                 dropout_implementation)
+    attrs["act"] = act
+    op = helper.append_op("fused_act_dropout", inputs={"X": [x]},
+                          outputs={"Out": [out]}, attrs=attrs)
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
 def softmax(input, axis=-1, use_cudnn=False, name=None):
     return _single_out("softmax", input, {"axis": axis})
 

@@ -6,8 +6,10 @@ batches onto the GPU with cuda events.  TPU-native: a background thread
 pipeline that (a) runs the user generator, (b) converts to numpy, and
 (c) jax.device_put's the NEXT batch while the current step runs — the
 double-buffer prefetch analog (device transfer overlaps compute because XLA
-dispatch is async).  Multiprocess workers (dataloader_iter.py) are
-implemented with a process pool when num_workers > 0.
+dispatch is async).  `num_workers > 0` runs dataset/transform work in a
+fork worker-process pool (dataloader_iter.py: per-worker index queues,
+shared data queue, in-order reorder buffer); `use_multiprocess=True` on
+the generator path moves the whole generator into a streamer process.
 """
 from __future__ import annotations
 
@@ -25,7 +27,8 @@ class DataLoader:
                        iterable=True, return_list=False,
                        use_multiprocess=False, drop_last=True):
         return GeneratorLoader(feed_list, capacity, use_double_buffer,
-                               iterable, return_list, drop_last)
+                               iterable, return_list, drop_last,
+                               use_multiprocess=use_multiprocess)
 
     @staticmethod
     def from_dataset(dataset, places=None, drop_last=True):
@@ -35,7 +38,7 @@ class DataLoader:
                  return_list=False, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, timeout=0,
-                 worker_init_fn=None):
+                 worker_init_fn=None, prefetch_factor=2):
         # map-style dataset path (2.0 DataLoader)
         self.dataset = dataset
         self.batch_size = batch_size
@@ -44,17 +47,31 @@ class DataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.return_list = return_list
         self.feed_list = feed_list
+        self.num_workers = int(num_workers)
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
 
-    def __iter__(self):
+    def _index_batches(self):
         idx = np.arange(len(self.dataset))
         if self.shuffle:
             np.random.shuffle(idx)
         n = len(idx)
         bs = self.batch_size
         end = n - n % bs if self.drop_last else n
-        for i in range(0, end, bs):
-            batch = [self.dataset[int(j)] for j in idx[i:i + bs]]
-            yield self.collate_fn(batch)
+        return [idx[i:i + bs] for i in range(0, end, bs)]
+
+    def __iter__(self):
+        batches = self._index_batches()
+        if self.num_workers > 0:
+            from .dataloader_iter import MultiprocessMapIter
+            yield from MultiprocessMapIter(
+                batches, self.dataset, self.collate_fn, self.num_workers,
+                worker_init_fn=self.worker_init_fn, timeout=self.timeout,
+                prefetch_factor=self.prefetch_factor)
+            return
+        for b in batches:
+            yield self.collate_fn([self.dataset[int(j)] for j in b])
 
     def __len__(self):
         n = len(self.dataset)
@@ -77,7 +94,8 @@ class GeneratorLoader:
     _SENTINEL = object()
 
     def __init__(self, feed_list, capacity=64, use_double_buffer=True,
-                 iterable=True, return_list=False, drop_last=True):
+                 iterable=True, return_list=False, drop_last=True,
+                 use_multiprocess=False):
         self._feed_names = [v if isinstance(v, str) else v.name
                             for v in (feed_list or [])]
         self._capacity = capacity
@@ -85,6 +103,7 @@ class GeneratorLoader:
         self._return_list = return_list
         self._generator: Optional[Callable] = None
         self._places = None
+        self._use_multiprocess = use_multiprocess
 
     # -- wiring -------------------------------------------------------------
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -125,10 +144,17 @@ class GeneratorLoader:
     def __iter__(self):
         if self._generator is None:
             raise RuntimeError("DataLoader: no generator set")
+        if self._use_multiprocess:
+            # whole generator runs in a streamer process (reader.py:789)
+            from .dataloader_iter import MultiprocessGenIter
+            source = MultiprocessGenIter(self._generator,
+                                         capacity=self._capacity)
+        else:
+            source = self._generator()
         from ..utils.prefetch import Prefetcher
         # shared prefetcher: forwards producer exceptions instead of
         # silently truncating the epoch, and cleans up on consumer break
-        for item in Prefetcher(self._generator(), capacity=self._capacity):
+        for item in Prefetcher(source, capacity=self._capacity):
             if self._return_list:
                 yield [item[n] for n in self._feed_names]
             else:

@@ -120,6 +120,7 @@ class _StaticAdapter:
                         for n in op.output_arg_names)]
         if todo:
             b.ops = todo
+            sp._bump_version()
             self._executor().run(sp)
             done.update(key(op) for op in todo)
         self._startup_done = True
@@ -350,19 +351,22 @@ class Model:
 
     # -- persistence ---------------------------------------------------------
     def save(self, path, training=True):
-        if self._adapter is not None:
-            np.savez(path + ".pdparams.npz", **self._adapter.state_dict())
-            return
+        """Both modes serialize through the same `.pdparams` container
+        (save_dygraph), so a checkpoint saved in static mode loads in
+        dygraph mode and vice versa (reference hapi/model.py: one format
+        regardless of mode)."""
         from ..dygraph.checkpoint import save_dygraph
+        if self._adapter is not None:
+            save_dygraph(self._adapter.state_dict(), path)
+            return
         save_dygraph(self.network.state_dict(), path)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
-        if self._adapter is not None:
-            data = np.load(path + ".pdparams.npz")
-            self._adapter.set_state_dict({k: data[k] for k in data.files})
-            return
         from ..dygraph.checkpoint import load_dygraph
         params, _ = load_dygraph(path)
+        if self._adapter is not None:
+            self._adapter.set_state_dict(params)
+            return
         self.network.set_dict(params)
 
     def parameters(self):

@@ -373,29 +373,44 @@ class TransformerEncoderLayer(Layer):
         self.norm1 = LayerNorm(d_model)
         self.norm2 = LayerNorm(d_model)
         self._dropout = dropout
+        self._act_dropout = act_dropout if act_dropout is not None \
+            else dropout
         self._act = activation
         self._pre_norm = normalize_before
 
-    def _drop(self, x):
+    def _drop_add(self, x, residual):
+        """residual epilogue as ONE fused op (pallas on TPU): the add no
+        longer costs an extra HBM pass at the dropout kernel boundary."""
         if self._dropout:
-            return L.dropout(x, self._dropout, is_test=not self.training,
-                             dropout_implementation="upscale_in_train")
-        return x
+            return L.fused_dropout_add(x, residual, self._dropout,
+                                       is_test=not self.training)
+        return residual + x
+
+    def _mlp_mid(self, x):
+        if self._act in ("gelu", "relu"):
+            return L.fused_act_dropout(
+                x, act=self._act, dropout_prob=(
+                    self._act_dropout if self.training else 0.0),
+                is_test=not self.training)
+        a = getattr(L.nn, self._act)(x)
+        if self._act_dropout and self.training:
+            a = L.dropout(a, self._act_dropout, is_test=False,
+                          dropout_implementation="upscale_in_train")
+        return a
 
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         if self._pre_norm:
             src = self.norm1(src)
         src = self.self_attn(src, src, src, src_mask)
-        src = residual + self._drop(src)
+        src = self._drop_add(src, residual)
         if not self._pre_norm:
             src = self.norm1(src)
         residual = src
         if self._pre_norm:
             src = self.norm2(src)
-        src = self.linear2(self._drop(getattr(L.nn, self._act)(
-            self.linear1(src))))
-        src = residual + self._drop(src)
+        src = self.linear2(self._mlp_mid(self.linear1(src)))
+        src = self._drop_add(src, residual)
         if not self._pre_norm:
             src = self.norm2(src)
         return src
@@ -434,35 +449,35 @@ class TransformerDecoderLayer(Layer):
         self.norm2 = LayerNorm(d_model)
         self.norm3 = LayerNorm(d_model)
         self._dropout = dropout
+        self._act_dropout = act_dropout if act_dropout is not None \
+            else dropout
         self._act = activation
         self._pre_norm = normalize_before
 
-    def _drop(self, x):
-        if self._dropout:
-            return L.dropout(x, self._dropout, is_test=not self.training,
-                             dropout_implementation="upscale_in_train")
-        return x
+    _drop_add = TransformerEncoderLayer._drop_add
+    _mlp_mid = TransformerEncoderLayer._mlp_mid
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
         residual = tgt
         if self._pre_norm:
             tgt = self.norm1(tgt)
-        tgt = residual + self._drop(self.self_attn(tgt, tgt, tgt, tgt_mask))
+        tgt = self._drop_add(self.self_attn(tgt, tgt, tgt, tgt_mask),
+                             residual)
         if not self._pre_norm:
             tgt = self.norm1(tgt)
         residual = tgt
         if self._pre_norm:
             tgt = self.norm2(tgt)
-        tgt = residual + self._drop(
-            self.cross_attn(tgt, memory, memory, memory_mask))
+        tgt = self._drop_add(
+            self.cross_attn(tgt, memory, memory, memory_mask), residual)
         if not self._pre_norm:
             tgt = self.norm2(tgt)
         residual = tgt
         if self._pre_norm:
             tgt = self.norm3(tgt)
-        tgt = residual + self._drop(self.linear2(self._drop(
-            getattr(L.nn, self._act)(self.linear1(tgt)))))
+        tgt = self._drop_add(self.linear2(self._mlp_mid(self.linear1(tgt))),
+                             residual)
         if not self._pre_norm:
             tgt = self.norm3(tgt)
         return tgt

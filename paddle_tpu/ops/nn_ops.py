@@ -252,6 +252,69 @@ def _dropout(ins, attrs, ctx):
     return {"Out": [out], "Mask": [keep.astype(jnp.uint8)]}
 
 
+def _dropout_common(attrs, ctx):
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    upscale = (attrs.get("dropout_implementation", "upscale_in_train")
+               == "upscale_in_train")
+    return p, is_test, upscale
+
+
+@register_op("fused_dropout_add", stateful_rng=True)
+def _fused_dropout_add_op(ins, attrs, ctx):
+    """out = dropout(X) + Residual, one fused kernel on TPU (the residual
+    add no longer costs an HBM pass at the pallas boundary); backward
+    regenerates the mask.  No reference op of this exact shape — it exists
+    because pallas calls are opaque to XLA fusion; the reference's
+    analogous fusion tier is operators/fused/fused_dropout_helper.h."""
+    x, r = _x(ins), _x(ins, "Residual")
+    p, is_test, upscale = _dropout_common(attrs, ctx)
+    if p <= 0.0:
+        return {"Out": [x + r]}
+    if is_test:
+        return {"Out": [(x if upscale else x * (1.0 - p)) + r]}
+    if p >= 1.0:
+        return {"Out": [r]}
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import (fused_dropout_add_tpu,
+                                     fused_dropout_supported)
+        if fused_dropout_supported(x) and x.shape == r.shape:
+            return {"Out": [fused_dropout_add_tpu(x, r, key, p, upscale)]}
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    scale = 1.0 / (1.0 - p) if upscale else 1.0
+    return {"Out": [(jnp.where(keep, x * scale, 0.0).astype(x.dtype)
+                     + r)]}
+
+
+@register_op("fused_act_dropout", stateful_rng=True)
+def _fused_act_dropout_op(ins, attrs, ctx):
+    """out = dropout(act(X)) — the MLP mid-epilogue — fused so the
+    activation does not cost its own HBM pass next to the pallas dropout;
+    backward fuses act'(x) with the regenerated mask."""
+    x = _x(ins)
+    act = attrs.get("act", "gelu")
+    p, is_test, upscale = _dropout_common(attrs, ctx)
+    act_jnp = {"gelu": lambda v: jax.nn.gelu(v, approximate=False),
+               "relu": jax.nn.relu}[act]
+    if is_test or p <= 0.0:
+        a = act_jnp(x)
+        return {"Out": [a if upscale or p <= 0.0 else a * (1.0 - p)]}
+    if p >= 1.0:
+        return {"Out": [jnp.zeros_like(x)]}
+    key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import (fused_act_dropout_tpu,
+                                     fused_dropout_supported)
+        if fused_dropout_supported(x):
+            return {"Out": [fused_act_dropout_tpu(x, key, p, upscale,
+                                                  act)]}
+    a = act_jnp(x)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    scale = 1.0 / (1.0 - p) if upscale else 1.0
+    return {"Out": [jnp.where(keep, a * scale, 0.0).astype(x.dtype)]}
+
+
 @register_op("batch_norm",
              nondiff_inputs=("Mean", "Variance"),
              nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",

@@ -28,6 +28,10 @@ class OpDef:
     # outputs that carry no cotangent (int outputs, saved state)
     nondiff_outputs: Sequence[str] = ()
     differentiable: bool = True          # False: treated as leaf (optimizer ops)
+    # why a differentiable=False op is excluded from the grad sweep
+    # (populated from ops/nondiff_reasons.py; test_op_grads_auto enforces
+    # that every non-differentiable op carries one)
+    nondiff_reason: Optional[str] = None
     stateful_rng: bool = False           # needs a PRNG key (dropout, *_random)
     custom_grad: Optional[Callable] = None  # (ins, outs, out_grads, attrs, ctx) -> in_grads
     # optional shape/dtype inference for IR bookkeeping (advisory; XLA retraces)

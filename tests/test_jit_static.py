@@ -288,6 +288,22 @@ class TestAstControlFlow:
         np.testing.assert_allclose(repeat_sum(x, n5).numpy(),
                                    np.full((2, 2), 5.0))
 
+    def test_unbound_read_in_traced_loop_raises_clearly(self, dygraph_mode):
+        """A name unbound before a traced while that the body READS before
+        writing must raise a clear UnboundLocalError (not an obscure
+        TypeError on the UNDEFINED sentinel)."""
+        from paddle_tpu.dygraph.jit_static import declarative
+
+        @declarative
+        def bad(s):
+            while s < 10.0:
+                t = t + 1.0          # noqa: F821 — read-before-write
+                s = s + t
+            return s
+
+        with pytest.raises(UnboundLocalError, match="may be unbound"):
+            bad(to_variable(np.float32(1.0)))
+
     def test_python_predicates_keep_python_semantics(self, dygraph_mode):
         from paddle_tpu.dygraph.jit_static import declarative
 

@@ -419,6 +419,7 @@ class TestStaticModel:
         x = xs[:4]
         out1 = model.predict_batch([x])[0]
         model.save(str(tmp_path / "bn"))
-        model2_state = np.load(str(tmp_path / "bn") + ".pdparams.npz")
+        from paddle_tpu.dygraph.checkpoint import load_dygraph
+        model2_state, _ = load_dygraph(str(tmp_path / "bn"))
         for k in state:
             np.testing.assert_array_equal(model2_state[k], state[k])

@@ -95,6 +95,17 @@ def build_specs():
                              attrs={"max_norm": 0.8}),
         "prelu": dict(inputs={"X": _away(2, 3), "Alpha": _x(1)},
                       grad_slots=["X", "Alpha"], attrs={"mode": "all"}),
+        "logit": dict(inputs={"X": _x(2, 3) * 0.3 + 0.2},   # (0.2, 0.62)
+                      grad_slots=["X"], attrs={"eps": 0.0}),
+        # fused dropout epilogues: fixed op_seed makes the mask a
+        # deterministic function of nothing but the key, so FD is valid
+        "fused_dropout_add": dict(
+            inputs={"X": _sym(4, 6), "Residual": _sym(4, 6)},
+            grad_slots=["X", "Residual"],
+            attrs={"dropout_prob": 0.4, "op_seed": 7}),
+        "fused_act_dropout": dict(
+            inputs={"X": _away(4, 6)}, grad_slots=["X"],
+            attrs={"act": "gelu", "dropout_prob": 0.3, "op_seed": 7}),
         "fill_diagonal": dict(inputs={"X": _sym(3, 3)}, grad_slots=["X"],
                               attrs={"value": 0.0}),
         # -- casts / shape manipulation ------------------------------------
@@ -706,3 +717,27 @@ def test_coverage_accounting():
     for op, reason in SKIPS.items():
         assert isinstance(reason, str) and len(reason) >= 8, op
         assert op in _OP_REGISTRY, f"stale skip entry {op}"
+
+
+def test_full_registry_accounting():
+    """511/511 closure (round-3 verdict #6): EVERY registered op is either
+    (a) finite-difference swept, (b) SKIPped with a justification, or
+    (c) non-differentiable with a recorded category reason
+    (ops/nondiff_reasons.py) — no op can land outside the audit."""
+    from paddle_tpu.ops.nondiff_reasons import (CATEGORIES, REASONS,
+                                                apply_reasons)
+    apply_reasons()       # late-registered modules (backward, vision ops)
+    unaccounted = []
+    for t, d in sorted(_OP_REGISTRY.items()):
+        if d.differentiable:
+            if t not in SKIPS and t not in TESTED_OPS:
+                unaccounted.append(t)
+        elif not d.nondiff_reason:
+            unaccounted.append(t)
+    assert not unaccounted, (len(unaccounted), unaccounted)
+    # reasons reference real categories, and stale entries are flagged
+    for op, cat in REASONS.items():
+        assert cat in CATEGORIES, (op, cat)
+    stale = [op for op in REASONS
+             if op in _OP_REGISTRY and _OP_REGISTRY[op].differentiable]
+    assert not stale, f"REASONS entries for differentiable ops: {stale}"
