@@ -172,12 +172,27 @@ class BatchNorm(Layer):
 
 
 class Embedding(Layer):
-    def __init__(self, size, is_sparse=False, is_distributed=False,
-                 padding_idx=None, param_attr=None, dtype="float32"):
+    """Both calling conventions: fluid `Embedding([vocab, dim])` and 2.0
+    `Embedding(num_embeddings, embedding_dim)` (reference
+    python/paddle/nn/layer/common.py:Embedding)."""
+
+    def __init__(self, size, embedding_dim=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None, sparse=False,
+                 param_attr=None, weight_attr=None, dtype="float32",
+                 name=None):
         super().__init__(dtype=dtype)
         helper = LayerHelper("embedding")
-        self.weight = helper.create_parameter(param_attr, list(size), dtype)
-        self._padding_idx = -1 if padding_idx is None else padding_idx
+        if embedding_dim is not None and isinstance(size, int):
+            size = [size, embedding_dim]        # 2.0 form
+        self.weight = helper.create_parameter(param_attr or weight_attr,
+                                              list(size), dtype)
+        if padding_idx is None:
+            self._padding_idx = -1              # internal no-padding flag
+        else:
+            # negative indices count from the end (reference common.py:
+            # padding_idx normalized to num_embeddings + padding_idx)
+            self._padding_idx = (padding_idx if padding_idx >= 0
+                                 else int(size[0]) + int(padding_idx))
 
     def forward(self, ids):
         return _emit("embedding", "lookup_table_v2",

@@ -47,49 +47,78 @@ def dynamic_lstm_unit(*args, **kwargs):
         "LoD dynamic_lstm is replaced by padded scan RNN (rnn op)")
 
 
+def _scan_one_direction(mode, wi, wh, bi, bh, h_init, c_init, seq,
+                        reverse=False):
+    """One (layer, direction) scan over [T, B, D].  Returns
+    (out [T, B, H], hT, cT-or-None)."""
+    if mode == "LSTM":
+        def step(carry, xt):
+            h, c = carry
+            g = xt @ wi.T + h @ wh.T + bi + bh
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+            return (h2, c2), h2
+        (hT, cT), out = jax.lax.scan(step, (h_init, c_init), seq,
+                                     reverse=reverse)
+        return out, hT, cT
+    if mode == "GRU":
+        def step(carry, xt):
+            h = carry
+            gi = xt @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iu, ic = jnp.split(gi, 3, axis=-1)
+            hr, hu, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            u = jax.nn.sigmoid(iu + hu)
+            c = jnp.tanh(ic + r * hc)
+            h2 = u * h + (1 - u) * c
+            return h2, h2
+        hT, out = jax.lax.scan(step, h_init, seq, reverse=reverse)
+        return out, hT, None
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+    def step(carry, xt):
+        h2 = act(xt @ wi.T + bi + carry @ wh.T + bh)
+        return h2, h2
+    hT, out = jax.lax.scan(step, h_init, seq, reverse=reverse)
+    return out, hT, None
+
+
 @register_op("rnn_scan", nondiff_inputs=("SequenceLength",))
 def _rnn_scan(ins, attrs, ctx):
-    """Padded multi-layer unidirectional LSTM/GRU over time with lax.scan
-    (replacing cudnn_lstm_op).  WeightList packs per-layer (wi, wh, bi, bh)."""
+    """Padded multi-layer LSTM/GRU/simple-RNN over time with lax.scan
+    (replacing cudnn_lstm_op; rnn_op.cc modes LSTM/GRU/RNN_TANH/RNN_RELU).
+    WeightList packs (wi, wh, bi, bh) per (layer, direction) — forward
+    then reverse when `bidirectional`; a reverse direction scans the SAME
+    padded sequence with lax.scan(reverse=True), and each deeper layer
+    consumes the concat of both directions' outputs."""
     x = ins["Input"][0]                      # [B, T, D] batch_first
     mode = attrs.get("mode", "LSTM")
     ws = ins["WeightList"]
-    h0 = ins["PreState"][0]
+    h0 = ins["PreState"][0]                  # [L*ndir, B, H]
     c0 = ins["PreState"][1] if len(ins.get("PreState", [])) > 1 else None
     num_layers = attrs.get("num_layers", 1)
+    ndir = 2 if attrs.get("bidirectional", False) else 1
 
     out = jnp.swapaxes(x, 0, 1)              # [T, B, D]
     h_fin, c_fin = [], []
     for layer in range(num_layers):
-        wi, wh, bi, bh = ws[4 * layer: 4 * layer + 4]
-        h_init = h0[layer]
-        c_init = c0[layer] if c0 is not None else jnp.zeros_like(h_init)
-
-        if mode == "LSTM":
-            def step(carry, xt):
-                h, c = carry
-                g = xt @ wi.T + h @ wh.T + bi + bh
-                i, f, gg, o = jnp.split(g, 4, axis=-1)
-                c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
-                h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
-                return (h2, c2), h2
-            (hT, cT), out = jax.lax.scan(step, (h_init, c_init), out)
+        dir_outs = []
+        for d in range(ndir):
+            k = 4 * (layer * ndir + d)
+            wi, wh, bi, bh = ws[k: k + 4]
+            h_init = h0[layer * ndir + d]
+            c_init = (c0[layer * ndir + d] if c0 is not None
+                      else jnp.zeros_like(h_init))
+            o, hT, cT = _scan_one_direction(mode, wi, wh, bi, bh, h_init,
+                                            c_init, out, reverse=(d == 1))
+            dir_outs.append(o)
             h_fin.append(hT)
-            c_fin.append(cT)
-        else:  # GRU
-            def step(carry, xt):
-                h = carry
-                gi = xt @ wi.T + bi
-                gh = h @ wh.T + bh
-                ir, iu, ic = jnp.split(gi, 3, axis=-1)
-                hr, hu, hc = jnp.split(gh, 3, axis=-1)
-                r = jax.nn.sigmoid(ir + hr)
-                u = jax.nn.sigmoid(iu + hu)
-                c = jnp.tanh(ic + r * hc)
-                h2 = u * h + (1 - u) * c
-                return h2, h2
-            hT, out = jax.lax.scan(step, h_init, out)
-            h_fin.append(hT)
+            if cT is not None:
+                c_fin.append(cT)
+        out = (dir_outs[0] if ndir == 1
+               else jnp.concatenate(dir_outs, axis=-1))
     outs = {"Out": [jnp.swapaxes(out, 0, 1)],
             "State": [jnp.stack(h_fin)]}
     if mode == "LSTM":

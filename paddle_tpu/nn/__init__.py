@@ -11,6 +11,13 @@ from .layer import (ReLU, GELU, Sigmoid, Tanh, Softmax, LeakyReLU, SiLU,
                     KLDivLoss, SmoothL1Loss, MultiHeadAttention,
                     TransformerEncoderLayer, TransformerEncoder,
                     TransformerDecoderLayer, TransformerDecoder, Transformer,
-                    LSTM, GRU, SimpleRNN, Pad2D, Upsample, Flatten)
+                    LSTM, GRU, SimpleRNN, RNN, BiRNN, SimpleRNNCell,
+                    LSTMCell, GRUCell, Pad2D, Upsample, Flatten)
+# 2.0 gradient-clip classes (reference python/paddle/nn/clip.py aliases
+# the fluid implementations under ClipGradBy* names; optimizers take them
+# via grad_clip=)
+from ..fluid.clip import (GradientClipByValue as ClipGradByValue,
+                          GradientClipByNorm as ClipGradByNorm,
+                          GradientClipByGlobalNorm as ClipGradByGlobalNorm)
 
 Conv2d = Conv2D  # historical alias

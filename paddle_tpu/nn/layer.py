@@ -524,6 +524,9 @@ class Transformer(Layer):
 
 # --- RNN ---------------------------------------------------------------------
 class _RNNBase(Layer):
+    """Whole-sequence RNN over the fused lax.scan lowering (rnn_scan;
+    rnn_op.cc modes).  `direction='bidirect'` runs a reverse scan per
+    layer and concats both directions (cuDNN bidirectional layout)."""
     MODE = "LSTM"
     GATES = 4
 
@@ -536,30 +539,37 @@ class _RNNBase(Layer):
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
+        self.time_major = time_major
+        self.ndir = 2 if direction in ("bidirect", "bidirectional") else 1
         self._weights = []
         for l in range(num_layers):
-            in_d = input_size if l == 0 else hidden_size
+            in_d = input_size if l == 0 else hidden_size * self.ndir
             g = self.GATES
-            wi = helper.create_parameter(weight_ih_attr,
-                                         [g * hidden_size, in_d], "float32")
-            wh = helper.create_parameter(weight_hh_attr,
-                                         [g * hidden_size, hidden_size],
-                                         "float32")
-            bi = helper.create_parameter(bias_ih_attr, [g * hidden_size],
-                                         "float32", is_bias=True)
-            bh = helper.create_parameter(bias_hh_attr, [g * hidden_size],
-                                         "float32", is_bias=True)
-            for i, w in enumerate((wi, wh, bi, bh)):
-                self.add_parameter(f"l{l}_{i}", w)
-            self._weights += [wi, wh, bi, bh]
+            for d in range(self.ndir):
+                wi = helper.create_parameter(weight_ih_attr,
+                                             [g * hidden_size, in_d],
+                                             "float32")
+                wh = helper.create_parameter(weight_hh_attr,
+                                             [g * hidden_size, hidden_size],
+                                             "float32")
+                bi = helper.create_parameter(bias_ih_attr, [g * hidden_size],
+                                             "float32", is_bias=True)
+                bh = helper.create_parameter(bias_hh_attr, [g * hidden_size],
+                                             "float32", is_bias=True)
+                for i, w in enumerate((wi, wh, bi, bh)):
+                    self.add_parameter(f"l{l}d{d}_{i}", w)
+                self._weights += [wi, wh, bi, bh]
 
     def forward(self, inputs, initial_states=None):
         import jax.numpy as jnp
         from ..dygraph.base import VarBase
+        if self.time_major:
+            inputs = L.transpose(inputs, [1, 0, 2])
         b = inputs.shape[0]
         if initial_states is None:
-            z = VarBase(jnp.zeros((self.num_layers, b, self.hidden_size),
-                                  jnp.float32), stop_gradient=True)
+            z = VarBase(jnp.zeros((self.num_layers * self.ndir, b,
+                                   self.hidden_size), jnp.float32),
+                        stop_gradient=True)
             states = [z, z.clone()] if self.MODE == "LSTM" else [z]
         else:
             states = (list(initial_states)
@@ -571,8 +581,11 @@ class _RNNBase(Layer):
             {"Input": [inputs], "WeightList": self._weights,
              "PreState": states},
             {"Out": [None]},
-            {"mode": self.MODE, "num_layers": self.num_layers})
+            {"mode": self.MODE, "num_layers": self.num_layers,
+             "bidirectional": self.ndir == 2})
         out = outs["Out"][0]
+        if self.time_major:
+            out = L.transpose(out, [1, 0, 2])
         st = outs["State"]
         if self.MODE == "LSTM":
             return out, (st[0], st[1])
@@ -590,5 +603,140 @@ class GRU(_RNNBase):
 
 
 class SimpleRNN(_RNNBase):
-    MODE = "GRU"   # simple RNN via GRU machinery
+    GATES = 1
+
+    # positional order matches the reference nn.SimpleRNN: activation
+    # comes BEFORE direction (a swapped order would silently treat
+    # SimpleRNN(16, 32, 2, 'relu') as direction='relu')
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 activation="tanh", direction="forward", time_major=False,
+                 dropout=0.0, **kw):
+        self.MODE = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         dropout, time_major, **kw)
+
+
+# --- RNN cells + generic wrapper (reference python/paddle/nn/layer/rnn.py:
+# RNNCellBase subclasses and the `RNN` runner) -------------------------------
+class _CellBase(Layer):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        helper = LayerHelper(type(self).__name__.lower())
+        g = self.GATES
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.weight_ih = helper.create_parameter(
+            weight_ih_attr, [g * hidden_size, input_size], "float32")
+        self.weight_hh = helper.create_parameter(
+            weight_hh_attr, [g * hidden_size, hidden_size], "float32")
+        self.bias_ih = helper.create_parameter(
+            bias_ih_attr, [g * hidden_size], "float32", is_bias=True)
+        self.bias_hh = helper.create_parameter(
+            bias_hh_attr, [g * hidden_size], "float32", is_bias=True)
+
+    def get_initial_states(self, batch_ref):
+        from ..dygraph.base import VarBase
+        import jax.numpy as jnp
+        b = batch_ref.shape[0]
+        z = VarBase(jnp.zeros((b, self.hidden_size), jnp.float32),
+                    stop_gradient=True)
+        return (z, z.clone()) if isinstance(self, LSTMCell) else z
+
+    def _gates(self, x, h):
+        gi = L.matmul(x, self.weight_ih, transpose_y=True) + self.bias_ih
+        gh = L.matmul(h, self.weight_hh, transpose_y=True) + self.bias_hh
+        return gi, gh
+
+
+class SimpleRNNCell(_CellBase):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, **kw)
+        self._act = activation
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None \
+            else self.get_initial_states(inputs)
+        gi, gh = self._gates(inputs, h)
+        out = (L.relu(gi + gh) if self._act == "relu"
+               else L.tanh(gi + gh))
+        return out, out
+
+
+class LSTMCell(_CellBase):
+    GATES = 4
+
+    def forward(self, inputs, states=None):
+        h, c = states if states is not None \
+            else self.get_initial_states(inputs)
+        gi, gh = self._gates(inputs, h)
+        g = gi + gh
+        i, f, gg, o = L.split(g, 4, dim=-1)
+        c2 = L.sigmoid(f) * c + L.sigmoid(i) * L.tanh(gg)
+        h2 = L.sigmoid(o) * L.tanh(c2)
+        return h2, (h2, c2)
+
+
+class GRUCell(_CellBase):
     GATES = 3
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None \
+            else self.get_initial_states(inputs)
+        gi, gh = self._gates(inputs, h)
+        ir, iu, ic = L.split(gi, 3, dim=-1)
+        hr, hu, hc = L.split(gh, 3, dim=-1)
+        r = L.sigmoid(ir + hr)
+        u = L.sigmoid(iu + hu)
+        c = L.tanh(ic + r * hc)
+        h2 = u * h + (1.0 - u) * c
+        return h2, h2
+
+
+class RNN(Layer):
+    """Run any cell over time (reference nn.RNN).  Eager python loop —
+    the semantics tier for custom cells; the fused LSTM/GRU/SimpleRNN
+    classes are the lax.scan performance tier."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        if self.time_major:
+            inputs = L.transpose(inputs, [1, 0, 2])
+        T = inputs.shape[1]
+        states = initial_states if initial_states is not None \
+            else self.cell.get_initial_states(inputs)
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        for t in steps:
+            xt = L.squeeze(L.slice(inputs, axes=[1], starts=[t],
+                                   ends=[t + 1]), [1])
+            outs[t], states = self.cell(xt, states)
+        out = L.stack(outs, axis=1)
+        if self.time_major:
+            out = L.transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    """Two cells, forward + reverse, outputs concatenated."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None):
+        fw_states, bw_states = (initial_states if initial_states is not None
+                                else (None, None))
+        out_f, st_f = self.rnn_fw(inputs, fw_states)
+        out_b, st_b = self.rnn_bw(inputs, bw_states)
+        # both runners restore batch-first layout: features are axis 2
+        return L.concat([out_f, out_b], axis=2), (st_f, st_b)

@@ -1,0 +1,146 @@
+"""nn namespace breadth: RNN cell classes + generic RNN/BiRNN runners,
+bidirectional fused RNNs, ClipGradBy* classes, dataset cache contract.
+
+Reference surfaces matched: python/paddle/nn/layer/rnn.py (RNNCellBase,
+SimpleRNNCell/LSTMCell/GRUCell, RNN, BiRNN, direction='bidirect'),
+python/paddle/nn/clip.py (ClipGradBy*), python/paddle/vision/datasets/
+(cifar/flowers with the download-cache pattern)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.dygraph.base import to_variable
+
+
+@pytest.fixture
+def dygraph():
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+def _x(b=2, t=5, d=8, seed=0):
+    return to_variable(np.random.RandomState(seed)
+                       .randn(b, t, d).astype("float32"))
+
+
+class TestRNNCells:
+    def test_cell_runner_matches_fused_simple_rnn(self, dygraph):
+        from paddle_tpu.nn import SimpleRNNCell, RNN, SimpleRNN
+        cell = SimpleRNNCell(8, 16)
+        fused = SimpleRNN(8, 16)
+        for w_f, w_c in zip(fused._weights,
+                            [cell.weight_ih, cell.weight_hh,
+                             cell.bias_ih, cell.bias_hh]):
+            w_f.set_value(w_c.numpy())
+        x = _x()
+        o_cell, _ = RNN(cell)(x)
+        o_fused, _ = fused(x)
+        np.testing.assert_allclose(o_cell.numpy(), o_fused.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_lstm_cell_runner_matches_fused(self, dygraph):
+        from paddle_tpu.nn import LSTMCell, RNN, LSTM
+        cell = LSTMCell(8, 16)
+        fused = LSTM(8, 16)
+        for w_f, w_c in zip(fused._weights,
+                            [cell.weight_ih, cell.weight_hh,
+                             cell.bias_ih, cell.bias_hh]):
+            w_f.set_value(w_c.numpy())
+        x = _x(seed=1)
+        o_cell, (h_c, c_c) = RNN(cell)(x)
+        o_fused, (h_f, c_f) = fused(x)
+        np.testing.assert_allclose(o_cell.numpy(), o_fused.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_c.numpy(), h_f.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(c_c.numpy(), c_f.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bidirect_fused_matches_birnn_cells(self, dygraph):
+        from paddle_tpu.nn import GRUCell, BiRNN, GRU
+        fused = GRU(8, 16, direction="bidirect")
+        cf, cb = GRUCell(8, 16), GRUCell(8, 16)
+        # fused weight order: layer0 fwd (wi, wh, bi, bh), layer0 rev
+        for w_f, w_c in zip(fused._weights[:4],
+                            [cf.weight_ih, cf.weight_hh, cf.bias_ih,
+                             cf.bias_hh]):
+            w_c.set_value(w_f.numpy())
+        for w_f, w_c in zip(fused._weights[4:8],
+                            [cb.weight_ih, cb.weight_hh, cb.bias_ih,
+                             cb.bias_hh]):
+            w_c.set_value(w_f.numpy())
+        x = _x(seed=2)
+        o_fused, st = fused(x)
+        o_cells, _ = BiRNN(cf, cb)(x)
+        assert o_fused.shape == (2, 5, 32)
+        assert st.shape == (2, 2, 16)       # [L*ndir, B, H]
+        np.testing.assert_allclose(o_fused.numpy(), o_cells.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_simple_rnn_relu_mode(self, dygraph):
+        from paddle_tpu.nn import SimpleRNN
+        m = SimpleRNN(4, 6, activation="relu")
+        out, _ = m(_x(d=4, seed=3))
+        assert np.all(out.numpy() >= 0)     # relu states
+
+    def test_bidirectional_grad_flows(self, dygraph):
+        import paddle_tpu.fluid.layers as L
+        from paddle_tpu.nn import LSTM
+        m = LSTM(8, 8, num_layers=2, direction="bidirect")
+        out, _ = m(_x(seed=4))
+        L.reduce_mean(out).backward()
+        for w in m._weights:
+            g = w.gradient()
+            assert g is not None and np.all(np.isfinite(g))
+
+
+class TestClipGradClasses:
+    def test_clip_by_global_norm_via_optimizer(self, dygraph):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer as opt
+        net = nn.Linear(4, 4)
+        o = opt.SGD(0.1, parameters=net.parameters(),
+                    grad_clip=nn.ClipGradByGlobalNorm(0.01))
+        x = to_variable(np.ones((2, 4), "float32") * 10)
+        loss = paddle.nn.functional.mse_loss(
+            net(x), to_variable(np.zeros((2, 4), "float32")))
+        loss.backward()
+        before = [p.numpy().copy() for p in net.parameters()]
+        o.step()
+        # the applied update is bounded by lr * clip_norm
+        for b, p in zip(before, net.parameters()):
+            delta = np.abs(p.numpy() - b).max()
+            assert delta <= 0.1 * 0.01 + 1e-6, delta
+
+    def test_clip_classes_exported(self):
+        from paddle_tpu import nn
+        for name in ("ClipGradByValue", "ClipGradByNorm",
+                     "ClipGradByGlobalNorm"):
+            assert hasattr(nn, name)
+
+
+class TestDatasetCacheContract:
+    def test_flowers_synthetic_fallback(self):
+        from paddle_tpu.vision.datasets import Flowers
+        ds = Flowers(mode="test")
+        img, lbl = ds[0]
+        assert img.shape == (3, 64, 64)
+        assert 0 <= int(lbl[0]) < 102
+
+    def test_cached_npz_is_served(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+        imgs = np.ones((4, 3, 32, 32), "float32") * 7
+        lbls = np.arange(4, dtype="int64")
+        np.savez(tmp_path / "cifar10_train.npz", images=imgs, labels=lbls)
+        from paddle_tpu.vision.datasets import Cifar10
+        ds = Cifar10(mode="train")
+        assert len(ds) == 4
+        img, lbl = ds[2]
+        np.testing.assert_array_equal(img, imgs[2])
+        assert int(lbl[0]) == 2
+
+    def test_cifar100_classes(self):
+        from paddle_tpu.vision.datasets import Cifar100
+        ds = Cifar100(mode="train", synthetic_size=64)
+        assert max(int(ds[i][1][0]) for i in range(64)) > 10

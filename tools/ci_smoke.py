@@ -1,0 +1,115 @@
+"""CI smoke gate: import, 5-step MNIST static train, dygraph step,
+op-sweep subset, DataLoader workers, bench child on CPU.
+
+Run: python tools/ci_smoke.py      (exit 0 = healthy)
+Kept minutes-cheap so it can gate every commit; the full suite
+(`pytest tests/`) is the nightly tier."""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)       # runnable as `python tools/ci_smoke.py`
+
+
+def step(name):
+    print(f"[smoke] {name}", flush=True)
+
+
+def main():
+    t0 = time.time()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    step("import + version")
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+    assert paddle.__version__
+
+    step("static 5-step MNIST-shaped train (loss falls)")
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.data("x", [-1, 1, 8, 8])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.fc(fluid.layers.reshape(x, [-1, 64]), 32,
+                            act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 1, 8, 8).astype("float32")
+    ys = rng.randint(0, 10, (64, 1)).astype("int64")
+    for i in range(64):
+        xs[i, 0, ys[i, 0] % 8, :] += 2.0
+    losses = []
+    for i in range(5):
+        lv, = exe.run(main_p, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0], losses
+
+    step("dygraph train step + backward")
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu import nn, optimizer as opt
+    dybase.enable_dygraph()
+    try:
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        o = opt.Adam(1e-3, parameters=net.parameters())
+        xb = dybase.to_variable(rng.randn(8, 16).astype("float32"))
+        out = net(xb)
+        l2 = paddle.nn.functional.mse_loss(
+            out, dybase.to_variable(np.zeros((8, 4), "float32")))
+        l2.backward()
+        o.step()
+        assert np.isfinite(float(l2.numpy()))
+    finally:
+        dybase.disable_dygraph()
+
+    step("DataLoader worker pool")
+    from paddle_tpu.fluid.reader import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return np.full((4,), float(i), "float32"), np.int64(i)
+
+    n = sum(1 for _ in DataLoader(DS(), batch_size=8, num_workers=2))
+    assert n == 4, n
+
+    step("op-sweep subset (grad checks)")
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_op_grads_auto.py::test_full_registry_accounting",
+         "tests/test_op_grads_auto.py::test_grad[matmul]",
+         "tests/test_op_grads_auto.py::test_grad[softmax]",
+         "tests/test_op_grads_auto.py::test_grad[conv2d]",
+         "tests/test_op_grads_auto.py::test_grad[layer_norm]",
+         "tests/test_op_grads_auto.py::test_grad[fused_dropout_add]"],
+        cwd=_ROOT)
+    assert r.returncode == 0, "op-sweep subset failed"
+
+    step("bench child emits one JSON line (cpu)")
+    import os
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--quick"],
+        env=dict(os.environ, GRAFT_BENCH_CHILD="1", JAX_PLATFORMS="cpu"),
+        cwd=_ROOT, capture_output=True, text=True,
+        timeout=600)
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, r.stdout
+
+    print(f"[smoke] OK in {time.time() - t0:.0f}s")
+
+
+if __name__ == "__main__":
+    main()
