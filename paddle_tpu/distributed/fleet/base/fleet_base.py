@@ -158,6 +158,8 @@ class Fleet:
         strat = self._user_defined_strategy
         if strat is not None and getattr(strat, "a_sync", False):
             return True
+        if strat is not None and getattr(strat, "_force_ps_mode", False):
+            return True     # legacy transpiler/pslib entry points are PS
         try:
             return (self._role_maker is not None
                     and self._role_maker._server_num() > 0)

@@ -232,10 +232,9 @@ def _ensure_tables(rt, plan: PsPlan, scope):
         client.barrier()            # inits visible before anyone pulls
 
 
-def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
-                        use_program_cache):
-    """Executor.run delegate when program._hints['ps_plan'] is set: the
-    downpour_worker.cc:739/765 loop around one XLA device step."""
+def _ps_setup(program, scope):
+    """Shared preamble: resolve plan/runtime, validate mode, ensure
+    tables.  Returns (plan, rt, comm, scope, train, multiproc)."""
     from ...fluid.core import global_scope
 
     plan: PsPlan = program._hints["ps_plan"]
@@ -251,12 +250,15 @@ def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
         raise NotImplementedError("program path does not drive a "
                                   "GeoCommunicator (see plan.mode note)")
     scope = scope or global_scope()
-    feed = dict(feed or {})
     _ensure_tables(rt, plan, scope)
     train = not bool(program._hints.get("is_test"))
-    multiproc = rt.client is not None
+    return plan, rt, comm, scope, train, rt.client is not None
 
-    # -- pull phase ---------------------------------------------------------
+
+def _ps_pull_phase(rt, plan, program, feed, scope):
+    """Host sparse/dense pull for ONE batch (downpour PULL_SPARSE stage).
+    Mutates `feed` in place (rows vars + wide-id remaps) and returns the
+    original full-width flat ids for the push phase."""
     # capture EVERY slot's original ids first: slots may share one ids var,
     # and the device remap below must never leak into another slot's pull
     # or into the push phase (full-width ids only)
@@ -301,36 +303,136 @@ def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
         val = rt.ps_pull_dense(d["param"])
         scope.set_var(d["param"],
                       np.asarray(val, np.float32).reshape(d["shape"]))
-    if train and plan.mode == "sync" and multiproc:
-        rt.ps_barrier()             # everyone pulled before anyone pushes
+    return flat_ids
 
-    # -- device step --------------------------------------------------------
-    user_fetch = list(fetch_list or [])
+
+def _ps_push_phase(rt, plan, comm, grads, flat_ids, sync_multiproc):
+    """Host sparse/dense grad push for ONE batch (PUSH_GRAD stage)."""
+    k = 0
+    for s in plan.sparse:
+        flat = flat_ids[s["rows"]]
+        rt.ps_push_sparse(s["table"], flat,
+                          np.asarray(grads[k]).reshape(len(flat),
+                                                       s["dim"]))
+        k += 1
+    for d in plan.dense:
+        rt.ps_push_dense(d["param"], np.asarray(grads[k]))
+        k += 1
+    if sync_multiproc:
+        rt.ps_step()                # pushes land before the next pull
+    elif comm is not None and hasattr(comm, "step"):
+        comm.step()                 # half-async per-step flush
+
+
+def _ps_device_step(exe, program, feed, user_fetch, plan, train, scope,
+                    return_numpy, use_program_cache):
     extra = ([s["grad"] for s in plan.sparse]
              + [d["grad"] for d in plan.dense]) if train else []
     exe._in_ps_run = True
     try:
-        outs = exe.run(program, feed=feed, fetch_list=user_fetch + extra,
+        return exe.run(program, feed=feed, fetch_list=user_fetch + extra,
                        scope=scope, return_numpy=return_numpy,
                        use_program_cache=use_program_cache)
     finally:
         exe._in_ps_run = False
 
-    # -- push phase ---------------------------------------------------------
+
+def run_program_with_ps(exe, program, feed, fetch_list, scope, return_numpy,
+                        use_program_cache):
+    """Executor.run delegate when program._hints['ps_plan'] is set: the
+    downpour_worker.cc:739/765 loop around one XLA device step."""
+    plan, rt, comm, scope, train, multiproc = _ps_setup(program, scope)
+    feed = dict(feed or {})
+
+    flat_ids = _ps_pull_phase(rt, plan, program, feed, scope)
+    if train and plan.mode == "sync" and multiproc:
+        rt.ps_barrier()             # everyone pulled before anyone pushes
+
+    user_fetch = list(fetch_list or [])
+    outs = _ps_device_step(exe, program, feed, user_fetch, plan, train,
+                           scope, return_numpy, use_program_cache)
+
     if train:
-        grads = outs[len(user_fetch):]
-        k = 0
-        for s in plan.sparse:
-            flat = flat_ids[s["rows"]]
-            rt.ps_push_sparse(s["table"], flat,
-                              np.asarray(grads[k]).reshape(len(flat),
-                                                           s["dim"]))
-            k += 1
-        for d in plan.dense:
-            rt.ps_push_dense(d["param"], np.asarray(grads[k]))
-            k += 1
-        if plan.mode == "sync" and multiproc:
-            rt.ps_step()            # pushes land before the next pull
-        elif comm is not None and hasattr(comm, "step"):
-            comm.step()             # half-async per-step flush
+        _ps_push_phase(rt, plan, comm, outs[len(user_fetch):], flat_ids,
+                       sync_multiproc=(plan.mode == "sync" and multiproc))
     return outs[:len(user_fetch)]
+
+
+def train_ps_pipelined(exe, program, feeds, fetch_list=None, scope=None,
+                       depth=2, return_numpy=True):
+    """Heter-worker-style overlap for ASYNC PS programs
+    (heter_service.h:73 task pipeline PULL_SPARSE -> OP_RUN -> PUSH_GRAD;
+    trainer.h:163 HeterXpuTrainer overlaps the host sparse plane with
+    device compute): batch t+1's host pulls run on a prefetch thread and
+    batch t's grad pushes drain on a dedicated push thread while the
+    device computes batch t.  Requires mode='async' — async SGD already
+    tolerates the one-batch staleness this pipeline introduces; sync mode
+    has a barrier between pull and push, so overlap would change its
+    semantics and is refused.
+
+    `feeds` is an iterable of feed dicts; returns the per-batch fetch
+    values (push of the final batch is joined before returning)."""
+    import queue as _q
+    import threading
+
+    plan, rt, comm, scope, train, multiproc = _ps_setup(program, scope)
+    if plan.mode != "async":
+        raise ValueError(
+            "train_ps_pipelined requires an async-mode plan; sync mode "
+            "barriers between pull and push (use Executor.run per batch)")
+    user_fetch = list(fetch_list or [])
+
+    from ...utils.prefetch import Prefetcher
+
+    def pulled():
+        for f in feeds:
+            f = dict(f)
+            flat_ids = _ps_pull_phase(rt, plan, program, f, scope)
+            yield f, flat_ids
+
+    push_q: "_q.Queue" = _q.Queue(maxsize=max(1, depth))
+    push_err = []
+
+    def pusher():
+        while True:
+            item = push_q.get()
+            if item is None:
+                return
+            grads, flat_ids = item
+            try:
+                _ps_push_phase(rt, plan, comm, grads, flat_ids,
+                               sync_multiproc=False)
+            except BaseException as e:          # noqa: BLE001 — forwarded
+                push_err.append(e)
+                return
+
+    push_thread = threading.Thread(target=pusher, daemon=True)
+    push_thread.start()
+    results = []
+    pf = Prefetcher(pulled(), capacity=max(1, depth))
+    try:
+        for f, flat_ids in pf:
+            if push_err:
+                raise push_err[0]
+            outs = _ps_device_step(exe, program, f, user_fetch, plan,
+                                   train, scope, return_numpy, True)
+            if train:
+                push_q.put((outs[len(user_fetch):], flat_ids))
+            results.append(outs[:len(user_fetch)])
+    finally:
+        pf.close()
+        try:
+            push_q.put_nowait(None)
+        except _q.Full:
+            # pusher died with the queue full: drain so the sentinel fits
+            # (a blocking put here would hang forever with no consumer)
+            try:
+                while True:
+                    push_q.get_nowait()
+            except _q.Empty:
+                pass
+            push_q.put_nowait(None)
+        push_thread.join(timeout=30)
+    if push_err:
+        raise push_err[0]
+    return results

@@ -91,9 +91,15 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        params_grads = self.backward(loss, startup_program, parameter_list,
-                                     no_grad_set)
-        ops = self.apply_gradients(params_grads)
+        # ops append to the LOSS's program even when the caller is outside
+        # program_guard (reference optimizer.py minimize wraps
+        # program_guard(program, startup_program) the same way — without
+        # it, update ops silently land in the global default program)
+        from .framework import program_guard
+        with program_guard(loss.block.program, startup_program):
+            params_grads = self.backward(loss, startup_program,
+                                         parameter_list, no_grad_set)
+            ops = self.apply_gradients(params_grads)
         return ops, params_grads
 
     # -- hooks for subclasses ----------------------------------------------

@@ -1,1 +1,4 @@
-from . import utils
+from . import utils  # noqa: F401
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401

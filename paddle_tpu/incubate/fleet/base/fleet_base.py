@@ -1,0 +1,112 @@
+"""Fleet 1.x base surface (reference fluid/incubate/fleet/base/
+fleet_base.py:42 Fleet, :273 DistributedOptimizer): the legacy
+`fleet.distributed_optimizer(opt, strategy).minimize(loss)` calling
+convention adapted onto the 2.0 facade, which owns the actual PS/
+collective runtime."""
+from __future__ import annotations
+
+
+class Mode:
+    """fleet_base.py:30 — training mode constants."""
+    TRANSPILER = 1
+    PSLIB = 2
+    COLLECTIVE = 3
+
+
+class DistributedOptimizer:
+    """1.x wrapper: holds (optimizer, strategy); minimize() routes into
+    the 2.0 fleet singleton with a translated DistributedStrategy."""
+
+    def __init__(self, optimizer, strategy=None, force_ps=False):
+        self._optimizer = optimizer
+        self._strategy = strategy
+        # the transpiler/pslib modules ARE the PS entry points: their
+        # sync strategy must still route into the PS pass even without
+        # server roles configured (single-process, in-process tables)
+        self._force_ps = force_ps
+
+    def _strategy20(self):
+        from ....distributed.fleet import DistributedStrategy
+        s = DistributedStrategy()
+        if self._force_ps:       # private flag: bypass field validation
+            object.__setattr__(s, "_force_ps_mode", True)
+        legacy = self._strategy
+        if legacy is None:
+            return s
+        if isinstance(legacy, DistributedStrategy):
+            if self._force_ps:
+                object.__setattr__(legacy, "_force_ps_mode", True)
+            return legacy
+        # attribute-bag translation (transpiler DistributedStrategy /
+        # collective DistributedStrategy both are plain attr objects)
+        if getattr(legacy, "geo_sgd_mode", False) or \
+                getattr(legacy, "_is_geo", False):
+            s.a_sync = True
+            s.a_sync_configs = {
+                "k_steps": int(getattr(legacy, "geo_sgd_need_push_nums",
+                                       getattr(legacy, "k_steps", 100)))}
+        elif getattr(legacy, "sync_mode", None) is False or \
+                getattr(legacy, "_is_async", False):
+            s.a_sync = True
+        elif getattr(legacy, "sync_mode", None) is True or \
+                getattr(legacy, "_is_sync", False):
+            s.a_sync = False
+        if getattr(legacy, "forward_recompute", False):
+            s.recompute = True
+            s.recompute_configs = {
+                "checkpoints": list(getattr(legacy, "recompute_checkpoints",
+                                            []) or [])}
+        if getattr(legacy, "use_amp", False):
+            s.amp = True
+        return s
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ....distributed import fleet as fleet20
+        fleet20.distributed_optimizer(self._optimizer, self._strategy20())
+        return fleet20.minimize(loss, startup_program)
+
+
+class LegacyFleetAdapter:
+    """Module-level `fleet` object of the 1.x packages.  Delegates every
+    role/worker/server call to the 2.0 singleton; distributed_optimizer
+    returns the 1.x DistributedOptimizer wrapper."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self._opt = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def init(self, role_maker=None):
+        from ....distributed import fleet as fleet20
+        collective = self.mode == Mode.COLLECTIVE
+        if role_maker is None:
+            role_maker = fleet20.PaddleCloudRoleMaker(
+                is_collective=collective)
+        return fleet20.init(role_maker, is_collective=collective)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._opt = DistributedOptimizer(
+            optimizer, strategy,
+            force_ps=self.mode in (Mode.TRANSPILER, Mode.PSLIB))
+        return self._opt
+
+    # -- delegated surface ---------------------------------------------------
+    def __getattr__(self, name):
+        from ....distributed import fleet as fleet20
+        try:
+            return getattr(fleet20, name)
+        except AttributeError:
+            raise AttributeError(
+                f"fleet 1.x adapter: no attribute '{name}'") from None
+
+
+Fleet = LegacyFleetAdapter

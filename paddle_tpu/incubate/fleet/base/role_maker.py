@@ -1,0 +1,10 @@
+"""Fleet 1.x role makers (reference fluid/incubate/fleet/base/
+role_maker.py) — the 2.0 role makers serve both eras; these names are
+the legacy import surface."""
+from ....distributed.fleet.base.role_maker import (   # noqa: F401
+    Role, RoleMakerBase, PaddleCloudRoleMaker, UserDefinedRoleMaker)
+
+# 1.x MPI-era names: environment-driven role discovery replaces MPI rank
+# negotiation on TPU pods, but the symbols must import
+MPISymetricRoleMaker = PaddleCloudRoleMaker
+GeneralRoleMaker = PaddleCloudRoleMaker

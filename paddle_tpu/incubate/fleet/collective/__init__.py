@@ -1,0 +1,42 @@
+"""Fleet 1.x collective entry point (reference fluid/incubate/fleet/
+collective/__init__.py:249 CollectiveOptimizer): legacy scripts do
+
+    from paddle.fluid.incubate.fleet.collective import fleet
+    fleet.init(role)
+    opt = fleet.distributed_optimizer(optimizer, strategy)
+    opt.minimize(loss)
+
+The adapter routes this onto the 2.0 collective path (meta-optimizers +
+ICI collectives)."""
+from ..base.fleet_base import (DistributedOptimizer, LegacyFleetAdapter,
+                               Mode)
+
+
+class DistributedStrategy:
+    """1.x collective strategy attr-bag (collective/__init__.py:37)."""
+
+    def __init__(self):
+        self.sync_mode = None
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.nccl_comm_num = 1
+        self.use_local_sgd = False
+        self.use_dgc = False
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """collective/__init__.py:249 — identical calling convention; the
+    strategy's recompute/amp knobs translate into the 2.0 strategy."""
+
+    def __init__(self, optimizer, strategy=None):
+        if strategy is None:
+            strategy = DistributedStrategy()
+        if not isinstance(strategy.recompute_checkpoints, list):
+            raise ValueError(
+                "DistStrategy.recompute_checkpoints should be a List")
+        super().__init__(optimizer, strategy)
+
+
+fleet = LegacyFleetAdapter(Mode.COLLECTIVE)

@@ -1,0 +1,2 @@
+from . import distribute_transpiler  # noqa: F401
+from . import pslib  # noqa: F401

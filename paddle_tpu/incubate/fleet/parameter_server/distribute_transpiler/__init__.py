@@ -1,0 +1,19 @@
+"""Fleet 1.x transpiler-mode entry point (reference fluid/incubate/
+fleet/parameter_server/distribute_transpiler/__init__.py): the legacy PS
+workflow
+
+    from ...distribute_transpiler import fleet
+    from ...distribute_transpiler.distributed_strategy import \
+        StrategyFactory
+    fleet.init(role)
+    opt = fleet.distributed_optimizer(optimizer,
+                                      StrategyFactory.create_sync_strategy())
+    opt.minimize(loss)
+    # then fleet.init_server()/run_server() or init_worker()/exe.run
+
+routed onto the PS program pass (distributed/ps/program_pass.py)."""
+from ...base.fleet_base import LegacyFleetAdapter, Mode
+from . import distributed_strategy  # noqa: F401
+from .distributed_strategy import StrategyFactory  # noqa: F401
+
+fleet = LegacyFleetAdapter(Mode.TRANSPILER)

@@ -1,0 +1,61 @@
+"""1.x StrategyFactory (reference .../distribute_transpiler/
+distributed_strategy.py): sync/async/geo/half-async strategy objects the
+legacy API passes to distributed_optimizer."""
+from __future__ import annotations
+
+
+class TrainerRuntimeConfig:
+    def __init__(self):
+        self.runtime_configs = {}
+
+
+class _Strategy:
+    def __init__(self, sync=None, is_async=False, geo=False, k_steps=100):
+        self.sync_mode = sync
+        self._is_sync = sync is True
+        self._is_async = is_async
+        self._is_geo = geo
+        self.geo_sgd_mode = geo
+        self.geo_sgd_need_push_nums = k_steps
+        self.trainer_runtime_config = TrainerRuntimeConfig()
+
+    def get_trainer_runtime_config(self):
+        return self.trainer_runtime_config
+
+
+class SyncStrategy(_Strategy):
+    def __init__(self):
+        super().__init__(sync=True)
+
+
+class AsyncStrategy(_Strategy):
+    def __init__(self):
+        super().__init__(sync=False, is_async=True)
+
+
+class HalfAsyncStrategy(_Strategy):
+    def __init__(self):
+        super().__init__(sync=False, is_async=True)
+
+
+class GeoStrategy(_Strategy):
+    def __init__(self, update_frequency=100):
+        super().__init__(sync=False, geo=True, k_steps=update_frequency)
+
+
+class StrategyFactory:
+    @staticmethod
+    def create_sync_strategy():
+        return SyncStrategy()
+
+    @staticmethod
+    def create_async_strategy():
+        return AsyncStrategy()
+
+    @staticmethod
+    def create_half_async_strategy():
+        return HalfAsyncStrategy()
+
+    @staticmethod
+    def create_geo_strategy(update_frequency=100):
+        return GeoStrategy(update_frequency)

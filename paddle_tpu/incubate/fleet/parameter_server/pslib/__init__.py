@@ -1,0 +1,16 @@
+"""Fleet 1.x pslib entry point (reference fluid/incubate/fleet/
+parameter_server/pslib/__init__.py + optimizer_factory.py): the ads/CTR
+tier's legacy API.  The Downpour/DistributedAdam factory maps onto the
+PS program pass with an async plan — the TPU-native runtime trains
+sparse tables server-side exactly as the 2.0 path does."""
+from ...base.fleet_base import DistributedOptimizer, LegacyFleetAdapter, \
+    Mode
+from . import optimizer_factory  # noqa: F401
+from .optimizer_factory import DistributedAdam  # noqa: F401
+
+fleet = LegacyFleetAdapter(Mode.PSLIB)
+
+
+class PSLib(LegacyFleetAdapter):
+    def __init__(self):
+        super().__init__(Mode.PSLIB)

@@ -1,0 +1,37 @@
+"""pslib optimizer factory (reference .../pslib/optimizer_factory.py:44
+DistributedOptimizerImplBase, :71 DistributedAdam): translates a user
+optimizer into server-side table optimizers + the trainer program.  Here
+the PS program pass already does that translation; the factory validates
+and routes with an async strategy (pslib is the async ads tier)."""
+from __future__ import annotations
+
+
+class DistributedOptimizerImplBase:
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._learning_rate = getattr(optimizer, "_learning_rate", None)
+
+    def minimize(self, losses, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        raise NotImplementedError
+
+
+class DistributedAdam(DistributedOptimizerImplBase):
+    """optimizer_factory.py:71 — sparse tables train server-side with
+    the table accessor; dense params ride the same async plan."""
+
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self.supported_embedding_types = ["lookup_table", "lookup_table_v2",
+                                          "pull_sparse", "pull_box_sparse"]
+
+    def minimize(self, losses, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .....distributed import fleet as fleet20
+        loss = losses[0] if isinstance(losses, (list, tuple)) else losses
+        strategy = fleet20.DistributedStrategy()
+        strategy.a_sync = True
+        fleet20.distributed_optimizer(self._optimizer, strategy)
+        return fleet20.minimize(loss, startup_program)
+
+    _minimize = minimize

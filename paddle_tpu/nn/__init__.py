@@ -5,10 +5,17 @@ from ..dygraph.nn import (Linear, Conv2D, Pool2D, BatchNorm, Embedding,
                           LayerNorm, Dropout, PRelu)
 from . import functional
 from .layer import (ReLU, GELU, Sigmoid, Tanh, Softmax, LeakyReLU, SiLU,
-                    Conv2DTranspose, MaxPool2D, AvgPool2D,
+                    ELU, SELU, Softplus, Softsign, Softshrink, Hardshrink,
+                    Tanhshrink, Hardsigmoid, Swish, ReLU6, LogSigmoid,
+                    Hardtanh, PReLU, GLU, Mish, Hardswish,
+                    Conv1D, Conv3D, Conv2DTranspose, MaxPool2D, AvgPool2D,
+                    MaxPool1D, AvgPool1D, MaxPool3D, AvgPool3D,
                     AdaptiveAvgPool2D, BatchNorm2D, GroupNorm, InstanceNorm2D,
+                    Dropout2D,
                     CrossEntropyLoss, MSELoss, L1Loss, BCELoss, NLLLoss,
-                    KLDivLoss, SmoothL1Loss, MultiHeadAttention,
+                    KLDivLoss, SmoothL1Loss, BCEWithLogitsLoss,
+                    MarginRankingLoss, CTCLoss, CosineSimilarity,
+                    PairwiseDistance, MultiHeadAttention,
                     TransformerEncoderLayer, TransformerEncoder,
                     TransformerDecoderLayer, TransformerDecoder, Transformer,
                     LSTM, GRU, SimpleRNN, RNN, BiRNN, SimpleRNNCell,

@@ -1,11 +1,18 @@
 """paddle.nn.functional (reference python/paddle/nn/functional/) — mode-
-agnostic functional ops delegating to the shared op-builders."""
+agnostic functional ops delegating to the shared op-builders; thin
+wrappers adapt 2.0 calling conventions (training flags, reductions,
+int-or-tuple sizes) onto the fluid-era builders and raw lowerings."""
 from __future__ import annotations
 
+import numpy as np
+
 from ..fluid import layers as L
+from ..fluid.layer_helper import emit_op
 from ..fluid.layers import nn as _nn
 
+# -- activations -------------------------------------------------------------
 relu = _nn.relu
+relu6 = _nn.relu6
 gelu = _nn.gelu
 sigmoid = _nn.sigmoid
 tanh = _nn.tanh
@@ -14,24 +21,164 @@ leaky_relu = _nn.leaky_relu
 elu = _nn.elu
 selu = _nn.selu
 softplus = _nn.softplus
+softsign = _nn.softsign
+softshrink = _nn.softshrink
+hardshrink = _nn.hard_shrink
+tanhshrink = _nn.tanh_shrink
+thresholded_relu = _nn.thresholded_relu
 hardswish = _nn.hard_swish
 hardsigmoid = _nn.hard_sigmoid
 mish = _nn.mish
 swish = _nn.swish
+log_sigmoid = _nn.logsigmoid
 softmax = L.softmax
 log_softmax = L.log_softmax
-dropout = L.dropout
-embedding = L.embedding
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return L.clip(x, min, max)
+
+
+def prelu(x, weight):
+    n = int(np.prod(weight.shape)) if hasattr(weight, "shape") else 1
+    # one alpha -> mode 'all'; per-channel alpha must broadcast along C
+    mode = "all" if n == 1 else "channel"
+    return emit_op("prelu", "prelu", {"X": [x], "Alpha": [weight]},
+                   ("Out",), {"mode": mode})["Out"][0]
+
+
+def glu(x, axis=-1):
+    a, b = L.split(x, 2, dim=axis)
+    return a * L.sigmoid(b)
+
+
+# -- regularization / normalization ------------------------------------------
+embedding_fluid = L.embedding
 one_hot = L.one_hot
 pad = L.pad
 label_smooth = L.label_smooth
+normalize = L.l2_normalize
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """2.0 signature: `training` flag + mode names (reference
+    functional/common.py dropout)."""
+    impl = ("upscale_in_train" if mode == "upscale_in_train"
+            else "downgrade_in_infer")
+    return L.dropout(x, p, is_test=not training,
+                     dropout_implementation=impl, name=name)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    """Whole-channel dropout: mask shaped [N, C, 1, 1] (reference
+    functional/common.py dropout2d semantics) via broadcast."""
+    if not training or p <= 0.0:
+        return x
+    n, c = (x.shape[0], x.shape[1]) if data_format == "NCHW" \
+        else (x.shape[0], x.shape[-1])
+    shape = [n, c, 1, 1] if data_format == "NCHW" else [n, 1, 1, c]
+    ones = L.ones(shape, x.dtype)
+    mask = L.dropout(ones, p, is_test=False,
+                     dropout_implementation="upscale_in_train")
+    return x * mask
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """2.0 functional embedding: lookup into a given weight tensor."""
+    pad_i = -1 if padding_idx is None else int(padding_idx)
+    if pad_i < -1:
+        pad_i = int(weight.shape[0]) + pad_i
+    return emit_op("embedding", "lookup_table_v2",
+                   {"W": [weight], "Ids": [x]}, ("Out",),
+                   {"padding_idx": pad_i})["Out"][0]
+
+
+# -- losses ------------------------------------------------------------------
 cross_entropy = L.softmax_with_cross_entropy
 square_error_cost = L.square_error_cost
 sigmoid_cross_entropy_with_logits = L.sigmoid_cross_entropy_with_logits
 binary_cross_entropy = L.loss.log_loss
 kl_div = L.kldiv_loss
 mse_loss = L.mse_loss
-normalize = L.l2_normalize
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    """2.0 signature (reference functional/common.py): reduce over `axis`
+    — NOT the fluid cos_sim, which fixes the last axis."""
+    num = L.reduce_sum(x1 * x2, dim=axis)
+    den = L.sqrt(L.reduce_sum(L.square(x1), dim=axis)
+                 * L.reduce_sum(L.square(x2), dim=axis) + eps)
+    return num / den
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return L.reduce_mean(loss)
+    if reduction == "sum":
+        return L.reduce_sum(loss)
+    return loss
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(L.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    return _reduce(emit_op("huber_loss", "huber_loss",
+                           {"X": [input], "Y": [label]}, ("Out",),
+                           {"delta": float(delta)})["Out"][0], reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,
+             reduction="mean"):
+    """Delegates reduction to the lowering: its 'mean' is the weighted
+    mean sum(w*loss)/sum(w*mask) over non-ignored elements (a plain
+    element mean would mis-scale gradients under class weights or
+    ignore_index hits)."""
+    ins = {"X": [input], "Label": [label]}
+    if weight is not None:
+        ins["Weight"] = [weight]
+    return emit_op("nll_loss", "nll_loss", ins, ("Out",),
+                   {"reduction": reduction,
+                    "ignore_index": ignore_index})["Out"][0]
+
+
+def binary_cross_entropy_with_logits(logit, label, reduction="mean"):
+    return _reduce(
+        L.sigmoid_cross_entropy_with_logits(logit, label), reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    out = L.relu(margin - label * (input - other))
+    return _reduce(out, reduction)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean"):
+    loss = emit_op("warpctc", "warpctc",
+                   {"Logits": [log_probs], "Label": [labels],
+                    "LogitsLength": [input_lengths],
+                    "LabelLength": [label_lengths]}, ("Loss",),
+                   {"blank": blank, "norm_by_times": False})["Loss"][0]
+    if reduction == "mean":
+        # reference functional/loss.py ctc_loss: mean(loss / label_len) —
+        # without it long label sequences dominate gradients
+        loss = loss / L.cast(label_lengths, "float32")
+    return _reduce(loss, reduction)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    d = x - y
+    if p == 2.0:
+        return L.sqrt(L.reduce_sum(L.square(d), dim=-1,
+                                   keep_dim=keepdim) + epsilon)
+    # epsilon inside the root on the general path too: |d|^p sums to 0 on
+    # identical pairs and 0^(1/p) has an infinite derivative
+    out = L.reduce_sum(L.elementwise_pow(
+        L.abs(d), L.fill_constant([1], x.dtype, p)), dim=-1,
+        keep_dim=keepdim) + epsilon
+    return L.elementwise_pow(out, L.fill_constant([1], x.dtype, 1.0 / p))
 
 
 def linear(x, weight, bias=None):
@@ -98,7 +245,6 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
-    from ..fluid.layer_helper import emit_op
     shape = ([normalized_shape] if isinstance(normalized_shape, int)
              else list(normalized_shape))
     ins = {"X": [x]}
@@ -109,3 +255,137 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     begin = len(x.shape) - len(shape)
     return emit_op("layer_norm", "layer_norm", ins, ("Y",),
                    {"epsilon": epsilon, "begin_norm_axis": begin})["Y"][0]
+
+
+# -- 1d/3d conv + pool over the 2d/Nd lowerings ------------------------------
+def _tolist(v, n):
+    return [v] * n if isinstance(v, int) else list(v)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NCL"):
+    """[N, C, L] conv as a width-1 conv2d — the MXU sees the same GEMM
+    (reference functional/conv.py conv1d lowers through conv2d too)."""
+    x4 = L.unsqueeze(x, [2])                      # [N, C, 1, L]
+    w4 = L.unsqueeze(weight, [2])                 # [O, I, 1, K]
+    s, p, d = (_tolist(stride, 1), _tolist(padding, 1),
+               _tolist(dilation, 1))
+    out = emit_op("conv2d", "conv2d",
+                  {"Input": [x4], "Filter": [w4]}, ("Output",),
+                  {"strides": [1] + s, "paddings": [0] + p,
+                   "dilations": [1] + d, "groups": groups})["Output"][0]
+    out = L.squeeze(out, [2])
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=1)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NCDHW"):
+    out = emit_op("conv3d", "conv3d",
+                  {"Input": [x], "Filter": [weight]}, ("Output",),
+                  {"strides": _tolist(stride, 3),
+                   "paddings": _tolist(padding, 3),
+                   "dilations": _tolist(dilation, 3),
+                   "groups": groups})["Output"][0]
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=1)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    out = emit_op("conv2d_transpose", "conv2d_transpose",
+                  {"Input": [x], "Filter": [weight]}, ("Output",),
+                  {"strides": _tolist(stride, 2),
+                   "paddings": _tolist(padding, 2),
+                   "dilations": _tolist(dilation, 2),
+                   "groups": groups})["Output"][0]
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=1)
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    x4 = L.unsqueeze(x, [2])
+    out = L.pool2d(x4, [1] + _tolist(kernel_size, 1), "max",
+                   [1] + _tolist(stride or kernel_size, 1),
+                   [0] + _tolist(padding, 1))
+    return L.squeeze(out, [2])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    x4 = L.unsqueeze(x, [2])
+    out = L.pool2d(x4, [1] + _tolist(kernel_size, 1), "avg",
+                   [1] + _tolist(stride or kernel_size, 1),
+                   [0] + _tolist(padding, 1))
+    return L.squeeze(out, [2])
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    return emit_op("pool3d", "pool3d", {"X": [x]}, ("Out",),
+                   {"pooling_type": "max",
+                    "ksize": _tolist(kernel_size, 3),
+                    "strides": _tolist(stride or kernel_size, 3),
+                    "paddings": _tolist(padding, 3)})["Out"][0]
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0):
+    return emit_op("pool3d", "pool3d", {"X": [x]}, ("Out",),
+                   {"pooling_type": "avg",
+                    "ksize": _tolist(kernel_size, 3),
+                    "strides": _tolist(stride or kernel_size, 3),
+                    "paddings": _tolist(padding, 3)})["Out"][0]
+
+
+def adaptive_max_pool2d(x, output_size):
+    return L.adaptive_pool2d(x, output_size, "max")
+
+
+# -- vision / sampling -------------------------------------------------------
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    return emit_op("pixel_shuffle", "pixel_shuffle", {"X": [x]}, ("Out",),
+                   {"upscale_factor": upscale_factor,
+                    "data_format": data_format})["Out"][0]
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    return emit_op("grid_sampler", "grid_sampler",
+                   {"X": [x], "Grid": [grid]}, ("Output",),
+                   {"mode": mode, "padding_mode": padding_mode,
+                    "align_corners": align_corners})["Output"][0]
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    return emit_op("affine_grid", "affine_grid", {"Theta": [theta]},
+                   ("Output",),
+                   {"output_shape": list(out_shape),
+                    "align_corners": align_corners})["Output"][0]
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    return emit_op("unfold", "unfold", {"X": [x]}, ("Y",),
+                   {"kernel_sizes": _tolist(kernel_sizes, 2),
+                    "strides": _tolist(strides, 2),
+                    "paddings": _tolist(paddings, 2),
+                    "dilations": _tolist(dilations, 2)})["Y"][0]
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    """resize via the interp lowerings (reference functional/common.py
+    interpolate -> {nearest,bilinear}_interp_v2)."""
+    op = {"nearest": "nearest_interp", "bilinear": "bilinear_interp",
+          "bicubic": "bicubic_interp"}[mode]
+    attrs = {"data_layout": data_format, "align_corners": align_corners}
+    if size is not None:
+        attrs["out_h"], attrs["out_w"] = int(size[0]), int(size[1])
+    else:
+        s = (scale_factor if isinstance(scale_factor, (list, tuple))
+             else [scale_factor, scale_factor])
+        attrs["scale"] = [float(v) for v in s]
+    return emit_op("interpolate", op, {"X": [x]}, ("Out",), attrs)["Out"][0]
+
+
+upsample = interpolate

@@ -13,7 +13,7 @@ from ..dygraph.nn import Linear, Conv2D, BatchNorm, Embedding, LayerNorm, \
 from ..fluid import layers as L
 from ..fluid.framework import _dygraph_tracer
 from ..fluid.layer_helper import LayerHelper
-from ..fluid.initializer import ConstantInitializer
+from ..fluid.initializer import ConstantInitializer, NormalInitializer
 
 
 # --- activations -------------------------------------------------------------
@@ -34,6 +34,19 @@ Mish = _act_layer("mish")
 Hardswish = _act_layer("hard_swish")
 
 
+ELU = _act_layer("elu")
+SELU = _act_layer("selu")
+Softplus = _act_layer("softplus")
+Softsign = _act_layer("softsign")
+Softshrink = _act_layer("softshrink")
+Hardshrink = _act_layer("hard_shrink")
+Tanhshrink = _act_layer("tanh_shrink")
+Hardsigmoid = _act_layer("hard_sigmoid")
+Swish = _act_layer("swish")
+ReLU6 = _act_layer("relu6")
+LogSigmoid = _act_layer("logsigmoid")
+
+
 class LeakyReLU(Layer):
     def __init__(self, negative_slope=0.01):
         super().__init__()
@@ -41,6 +54,39 @@ class LeakyReLU(Layer):
 
     def forward(self, x):
         return L.nn.leaky_relu(x, alpha=self._slope)
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0):
+        super().__init__()
+        self._min, self._max = min, max
+
+    def forward(self, x):
+        from . import functional as F
+        return F.hardtanh(x, self._min, self._max)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None):
+        super().__init__()
+        helper = LayerHelper("prelu")
+        self.weight = helper.create_parameter(
+            weight_attr, [num_parameters], "float32",
+            default_initializer=ConstantInitializer(init))
+
+    def forward(self, x):
+        from . import functional as F
+        return F.prelu(x, self.weight)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from . import functional as F
+        return F.glu(x, self._axis)
 
 
 class Softmax(Layer):
@@ -520,6 +566,142 @@ class Transformer(Layer):
                 memory_mask=None):
         memory = self.encoder(src, src_mask)
         return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+
+# --- 1d/3d conv + pool classes over the functional tier ---------------------
+class _ConvNd(Layer):
+    ND = 1
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        import math
+        helper = LayerHelper(f"conv{self.ND}d")
+        ks = ([kernel_size] * self.ND if isinstance(kernel_size, int)
+              else list(kernel_size))
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        fan_in = (in_channels // groups) * int(np.prod(ks))
+        self.weight = helper.create_parameter(
+            weight_attr, [out_channels, in_channels // groups] + ks,
+            "float32",
+            default_initializer=NormalInitializer(
+                0., math.sqrt(2. / fan_in)))
+        self.bias = helper.create_parameter(
+            bias_attr, [out_channels], "float32", is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from . import functional as F
+        fn = {1: F.conv1d, 3: F.conv3d}[self.ND]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class Conv1D(_ConvNd):
+    ND = 1
+
+
+class Conv3D(_ConvNd):
+    ND = 3
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x):
+        from . import functional as F
+        return F.max_pool1d(x, self._k, self._s, self._p)
+
+
+class AvgPool1D(MaxPool1D):
+    def forward(self, x):
+        from . import functional as F
+        return F.avg_pool1d(x, self._k, self._s, self._p)
+
+
+class MaxPool3D(MaxPool1D):
+    def forward(self, x):
+        from . import functional as F
+        return F.max_pool3d(x, self._k, self._s, self._p)
+
+
+class AvgPool3D(MaxPool1D):
+    def forward(self, x):
+        from . import functional as F
+        return F.avg_pool3d(x, self._k, self._s, self._p)
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW"):
+        super().__init__()
+        self._p, self._fmt = p, data_format
+
+    def forward(self, x):
+        from . import functional as F
+        return F.dropout2d(x, self._p, training=self.training,
+                           data_format=self._fmt)
+
+
+# --- loss classes over the functional tier ----------------------------------
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, logit, label):
+        from . import functional as F
+        return F.binary_cross_entropy_with_logits(logit, label,
+                                                  self._reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self._margin, self._reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        from . import functional as F
+        return F.margin_ranking_loss(input, other, label, self._margin,
+                                     self._reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        from . import functional as F
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self._blank, self._reduction)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis, self._eps = axis, eps
+
+    def forward(self, x1, x2):
+        num = L.reduce_sum(x1 * x2, dim=self._axis)
+        den = L.sqrt(L.reduce_sum(L.square(x1), dim=self._axis)
+                     * L.reduce_sum(L.square(x2), dim=self._axis)
+                     + self._eps)
+        return num / den
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self._p, self._eps, self._keep = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from . import functional as F
+        return F.pairwise_distance(x, y, self._p, self._eps, self._keep)
 
 
 # --- RNN ---------------------------------------------------------------------

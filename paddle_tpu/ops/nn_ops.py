@@ -486,7 +486,11 @@ def _maxout(ins, attrs, ctx):
 
 def _interp(ins, attrs, ctx, method):
     x = _x(ins)
-    n, c, h, w = x.shape
+    nhwc = attrs.get("data_layout", "NCHW") == "NHWC"
+    if nhwc:
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
     oh = attrs.get("out_h", -1)
     ow = attrs.get("out_w", -1)
     if ins.get("OutSize"):
@@ -494,10 +498,29 @@ def _interp(ins, attrs, ctx, method):
         oh, ow = int(sz[0]), int(sz[1])
     elif oh <= 0:
         scale = attrs.get("scale", 1.0)
-        oh, ow = int(h * scale), int(w * scale)
-    xt = jnp.transpose(x, (0, 2, 3, 1))
-    out = jax.image.resize(xt, (n, oh, ow, c), method=method)
-    return {"Out": [jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)]}
+        sh, sw = ((scale[0], scale[1])
+                  if isinstance(scale, (list, tuple)) else (scale, scale))
+        oh, ow = int(h * sh), int(w * sw)
+    xt = x if nhwc else jnp.transpose(x, (0, 2, 3, 1))
+    if attrs.get("align_corners", False) and method == "bilinear" \
+            and oh > 1 and ow > 1:
+        # jax.image.resize has half-pixel-centres semantics; align_corners
+        # maps output corners onto input corners — build the grid by hand
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 2)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 2)
+        fy = (ys - y0)[None, :, None, None]
+        fx = (xs - x0)[None, None, :, None]
+        g = lambda yy, xx: xt[:, yy][:, :, xx]
+        out = ((1 - fy) * (1 - fx) * g(y0, x0)
+               + (1 - fy) * fx * g(y0, x0 + 1)
+               + fy * (1 - fx) * g(y0 + 1, x0)
+               + fy * fx * g(y0 + 1, x0 + 1))
+    else:
+        out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+    out = out.astype(x.dtype)
+    return {"Out": [out if nhwc else jnp.transpose(out, (0, 3, 1, 2))]}
 
 
 register_op("nearest_interp", lambda ins, a, c: _interp(ins, a, c, "nearest"),

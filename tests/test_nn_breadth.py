@@ -95,6 +95,46 @@ class TestRNNCells:
             assert g is not None and np.all(np.isfinite(g))
 
 
+class TestLayerClassBreadth:
+    """Thin class façades over the functional tier (reference
+    python/paddle/nn/layer/): shapes + trainability, math pinned by
+    test_nn_functional."""
+
+    def test_conv_pool_nd_classes(self, dygraph):
+        from paddle_tpu import nn
+        import paddle_tpu.fluid.layers as L
+        x1 = to_variable(
+            np.random.RandomState(0).randn(2, 3, 8).astype("float32"))
+        c1 = nn.Conv1D(3, 5, 3, padding=1)
+        out = c1(x1)
+        assert out.shape == (2, 5, 8)
+        L.reduce_mean(out).backward()
+        assert np.all(np.isfinite(c1.weight.gradient()))
+        x3 = to_variable(np.random.RandomState(1)
+                         .randn(1, 2, 4, 6, 6).astype("float32"))
+        assert nn.Conv3D(2, 4, 2)(x3).shape == (1, 4, 3, 5, 5)
+        assert nn.MaxPool1D(2)(x1).shape == (2, 3, 4)
+        assert nn.AvgPool3D(2)(x3).shape == (1, 2, 2, 3, 3)
+
+    def test_activation_and_loss_classes_exported(self):
+        from paddle_tpu import nn
+        for name in ("ELU", "SELU", "Softplus", "Hardtanh", "PReLU",
+                     "GLU", "ReLU6", "LogSigmoid", "Tanhshrink",
+                     "Hardshrink", "Softshrink", "Softsign", "Swish",
+                     "Hardsigmoid", "Dropout2D", "BCEWithLogitsLoss",
+                     "MarginRankingLoss", "CTCLoss", "CosineSimilarity",
+                     "PairwiseDistance", "Conv1D", "Conv3D", "MaxPool1D",
+                     "AvgPool1D", "MaxPool3D", "AvgPool3D"):
+            assert hasattr(nn, name), name
+
+    def test_dropout2d_eval_is_identity(self, dygraph):
+        from paddle_tpu import nn
+        d = nn.Dropout2D(0.9)
+        d.eval()
+        x = to_variable(np.ones((2, 4, 3, 3), "float32"))
+        np.testing.assert_allclose(d(x).numpy(), 1.0)
+
+
 class TestClipGradClasses:
     def test_clip_by_global_norm_via_optimizer(self, dygraph):
         import paddle_tpu as paddle

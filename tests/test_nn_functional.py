@@ -1,0 +1,175 @@
+"""paddle.nn.functional breadth (reference python/paddle/nn/functional/):
+2.0 calling conventions over the shared op-builders — activations,
+losses with reductions, 1d/3d conv+pool, vision sampling, dropout
+training flag, functional embedding."""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.dygraph.base import to_variable
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture(autouse=True)
+def dygraph():
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+def t(a):
+    return to_variable(np.asarray(a, "float32"))
+
+
+def rnd(*s, seed=0):
+    return np.random.RandomState(seed).randn(*s).astype("float32")
+
+
+class TestActivations:
+    def test_hardtanh_prelu_glu(self):
+        x = rnd(2, 6)
+        np.testing.assert_allclose(F.hardtanh(t(x)).numpy(),
+                                   np.clip(x, -1, 1), rtol=1e-6)
+        alpha = np.array([0.2], "float32")
+        np.testing.assert_allclose(
+            F.prelu(t(x), t(alpha)).numpy(),
+            np.where(x > 0, x, 0.2 * x), rtol=1e-5)
+        g = F.glu(t(x), axis=-1)
+        a, b = x[:, :3], x[:, 3:]
+        np.testing.assert_allclose(g.numpy(), a / (1 + np.exp(-b)),
+                                   rtol=1e-5)
+
+    def test_log_sigmoid(self):
+        x = rnd(3, 4, seed=1)
+        np.testing.assert_allclose(F.log_sigmoid(t(x)).numpy(),
+                                   np.log(1 / (1 + np.exp(-x))), rtol=1e-4,
+                                   atol=1e-6)
+
+
+class TestLosses:
+    def test_l1_and_smooth_l1(self):
+        a, b = rnd(4, 3, seed=2), rnd(4, 3, seed=3)
+        np.testing.assert_allclose(F.l1_loss(t(a), t(b)).numpy(),
+                                   np.abs(a - b).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.l1_loss(t(a), t(b), reduction="sum").numpy(),
+            np.abs(a - b).sum(), rtol=1e-5)
+        d = a - b
+        huber = np.where(np.abs(d) <= 1.0, 0.5 * d * d,
+                         np.abs(d) - 0.5)
+        np.testing.assert_allclose(
+            F.smooth_l1_loss(t(a), t(b)).numpy(), huber.mean(), rtol=1e-4)
+
+    def test_margin_ranking_loss(self):
+        x1, x2 = rnd(5, 1, seed=4), rnd(5, 1, seed=5)
+        lbl = np.sign(rnd(5, 1, seed=6)) + 0.0
+        got = F.margin_ranking_loss(t(x1), t(x2), t(lbl),
+                                    margin=0.1).numpy()
+        ref = np.maximum(0, 0.1 - lbl * (x1 - x2)).mean()
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_bce_with_logits_and_pairwise(self):
+        z = rnd(4, 2, seed=7)
+        y = (rnd(4, 2, seed=8) > 0).astype("float32")
+        ref = (np.maximum(z, 0) - z * y + np.log1p(np.exp(-np.abs(z))))
+        np.testing.assert_allclose(
+            F.binary_cross_entropy_with_logits(t(z), t(y)).numpy(),
+            ref.mean(), rtol=1e-5)
+        a, b = rnd(3, 4, seed=9), rnd(3, 4, seed=10)
+        np.testing.assert_allclose(
+            F.pairwise_distance(t(a), t(b)).numpy(),
+            np.sqrt(((a - b) ** 2).sum(-1) + 1e-6), rtol=1e-5)
+
+    def test_nll_loss(self):
+        logp = np.log(np.random.RandomState(11).dirichlet(
+            np.ones(5), 6).astype("float32"))
+        lbl = np.random.RandomState(12).randint(0, 5, (6,)).astype("int64")
+        got = F.nll_loss(t(logp), to_variable(lbl)).numpy()
+        np.testing.assert_allclose(got, -logp[np.arange(6), lbl].mean(),
+                                   rtol=1e-5)
+
+
+class TestConvPool:
+    def test_conv1d_matches_manual(self):
+        x = rnd(2, 3, 8, seed=13)
+        w = rnd(4, 3, 3, seed=14)
+        out = F.conv1d(t(x), t(w), padding=1).numpy()
+        assert out.shape == (2, 4, 8)
+        # spot-check one position against the direct correlation
+        ref = sum(x[0, c, 2:5] * w[1, c] for c in range(3)).sum()
+        np.testing.assert_allclose(out[0, 1, 3], ref, rtol=1e-4)
+
+    def test_conv3d_shape_and_grad(self):
+        x = to_variable(rnd(1, 2, 4, 6, 6, seed=15))
+        w = to_variable(rnd(3, 2, 2, 2, 2, seed=16))
+        x.stop_gradient = False
+        out = F.conv3d(x, w)
+        assert out.shape == (1, 3, 3, 5, 5)
+        import paddle_tpu.fluid.layers as L
+        L.reduce_mean(out).backward()
+        assert np.all(np.isfinite(x.gradient()))
+
+    def test_pools_1d_3d(self):
+        x = rnd(2, 3, 8, seed=17)
+        m = F.max_pool1d(t(x), 2).numpy()
+        assert m.shape == (2, 3, 4)
+        np.testing.assert_allclose(
+            m, x.reshape(2, 3, 4, 2).max(-1), rtol=1e-6)
+        a = F.avg_pool1d(t(x), 2).numpy()
+        np.testing.assert_allclose(
+            a, x.reshape(2, 3, 4, 2).mean(-1), rtol=1e-6)
+        x3 = rnd(1, 2, 4, 4, 4, seed=18)
+        assert F.max_pool3d(t(x3), 2).numpy().shape == (1, 2, 2, 2, 2)
+        np.testing.assert_allclose(
+            F.avg_pool3d(t(x3), 2).numpy()[0, 0, 0, 0, 0],
+            x3[0, 0, :2, :2, :2].mean(), rtol=1e-5)
+
+
+class TestMisc:
+    def test_dropout_training_flag(self):
+        x = rnd(64, 128, seed=19) + 1.0
+        out_eval = F.dropout(t(x), 0.5, training=False).numpy()
+        np.testing.assert_allclose(out_eval, x, rtol=1e-6)
+        out_train = F.dropout(t(x), 0.5, training=True).numpy()
+        zeros = (out_train == 0).mean()
+        assert 0.4 < zeros < 0.6
+
+    def test_dropout2d_drops_whole_channels(self):
+        x = np.ones((8, 16, 4, 4), "float32")
+        out = F.dropout2d(t(x), 0.5).numpy()
+        per_ch = out.reshape(8, 16, -1)
+        for n in range(8):
+            for c in range(16):
+                v = per_ch[n, c]
+                assert np.all(v == 0) or np.allclose(v, v[0])
+
+    def test_functional_embedding_with_padding(self):
+        w = rnd(6, 4, seed=20)
+        ids = np.array([[0, 2, 5]], "int64")
+        out = F.embedding(to_variable(ids), t(w), padding_idx=2).numpy()
+        np.testing.assert_allclose(out[0, 0], w[0], rtol=1e-6)
+        np.testing.assert_allclose(out[0, 1], np.zeros(4), atol=1e-7)
+        np.testing.assert_allclose(out[0, 2], w[5], rtol=1e-6)
+
+    def test_interpolate_nearest(self):
+        x = rnd(1, 2, 3, 3, seed=21)
+        out = F.interpolate(t(x), scale_factor=2, mode="nearest").numpy()
+        assert out.shape == (1, 2, 6, 6)
+        np.testing.assert_allclose(out[0, 0, ::2, ::2], x[0, 0], rtol=1e-6)
+
+    def test_pixel_shuffle_and_unfold(self):
+        x = rnd(1, 4, 3, 3, seed=22)
+        assert F.pixel_shuffle(t(x), 2).numpy().shape == (1, 1, 6, 6)
+        u = F.unfold(t(rnd(1, 2, 4, 4, seed=23)), [2, 2]).numpy()
+        assert u.shape == (1, 2 * 2 * 2, 9)
+
+    def test_ctc_loss_finite(self):
+        logits = rnd(2, 4, 5, seed=24)        # [B, T, C]
+        labels = np.array([[1, 2], [3, 1]], "int64")
+        out = F.ctc_loss(t(logits), to_variable(labels),
+                         to_variable(np.array([4, 4], "int64")),
+                         to_variable(np.array([2, 2], "int64")))
+        assert np.isfinite(float(out.numpy()))
