@@ -22,6 +22,7 @@ from .fluid.reader import batch, shuffle
 from .fluid import layers as _fl_layers
 
 from . import nn
+from . import io
 from . import tensor
 from .tensor import *  # noqa: F401,F403
 from . import optimizer

@@ -75,9 +75,11 @@ class _Err:
 
 
 def _map_worker_loop(dataset, collate_fn, index_q, data_q, worker_id,
-                     init_fn, base_seed):
+                     init_fn, base_seed, num_workers=1):
     """One map-style worker: pull (batch_idx, indices), push
     (batch_idx, collated batch)."""
+    os.environ["PADDLE_TPU_WORKER_ID"] = str(worker_id)  # get_worker_info
+    os.environ["PADDLE_TPU_NUM_WORKERS"] = str(num_workers)
     # per-worker deterministic RNG stream for random transforms
     np.random.seed((base_seed + worker_id) % (2 ** 32))
     try:
@@ -137,7 +139,7 @@ class MultiprocessMapIter:
             ctx.Process(
                 target=_map_worker_loop,
                 args=(dataset, collate_fn, self._index_qs[w], self._data_q,
-                      w, worker_init_fn, base_seed),
+                      w, worker_init_fn, base_seed, num_workers),
                 daemon=True)
             for w in range(num_workers)]
         for p in self._workers:

@@ -47,12 +47,26 @@ class DataLoader:
         self.collate_fn = collate_fn or _default_collate
         self.return_list = return_list
         self.feed_list = feed_list
+        self.batch_sampler = batch_sampler
+        if batch_sampler is not None and (shuffle or drop_last
+                                          or batch_size != 1):
+            # reference DataLoader asserts the same: the sampler OWNS
+            # batching — a silently ignored drop_last would hand a ragged
+            # final batch to a fixed-shape jit step
+            raise ValueError(
+                "DataLoader: batch_sampler is mutually exclusive with "
+                "batch_size/shuffle/drop_last — configure them on the "
+                "sampler")
         self.num_workers = int(num_workers)
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.prefetch_factor = prefetch_factor
 
     def _index_batches(self):
+        if self.batch_sampler is not None:
+            # paddle.io sampler algebra decides the batches (incl.
+            # DistributedBatchSampler rank sharding)
+            return [np.asarray(b) for b in self.batch_sampler]
         idx = np.arange(len(self.dataset))
         if self.shuffle:
             np.random.shuffle(idx)
@@ -74,6 +88,8 @@ class DataLoader:
             yield self.collate_fn([self.dataset[int(j)] for j in b])
 
     def __len__(self):
+        if self.batch_sampler is not None:
+            return len(self.batch_sampler)
         n = len(self.dataset)
         return n // self.batch_size if self.drop_last else \
             (n + self.batch_size - 1) // self.batch_size

@@ -1,0 +1,255 @@
+"""paddle.io (reference python/paddle/io/__init__.py): datasets,
+samplers, and the 2.0 DataLoader.  The DataLoader itself is
+fluid.reader.DataLoader (worker-pool + prefetch); this namespace adds
+the dataset/sampler algebra around it."""
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+from ..fluid.reader import DataLoader  # noqa: F401
+
+
+class Dataset:
+    """Map-style dataset ABC (reference io/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset:
+    """Stream-style dataset ABC: iterate, no random access."""
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence):
+        arrays = [np.asarray(getattr(t, "numpy", lambda: t)())
+                  if hasattr(t, "numpy") else np.asarray(t)
+                  for t in tensors]
+        n = len(arrays[0])
+        if any(len(a) != n for a in arrays):
+            raise ValueError("TensorDataset tensors must share dim 0")
+        self.tensors = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets: sample i is the concatenation of each dataset's
+    sample i (reference io/dataset.py ComposeDataset)."""
+
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        if any(len(d) != n for d in self.datasets):
+            raise ValueError("ComposeDataset datasets must share length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else [s])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    """Concatenate iterable datasets end to end."""
+
+    def __init__(self, datasets: Sequence):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        self._cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self._cum[-1]) if len(self._cum) else 0
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        k = int(np.searchsorted(self._cum, idx, side="right"))
+        prev = int(self._cum[k - 1]) if k else 0
+        return self.datasets[k][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset: Dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset: Dataset, lengths: Sequence[int], generator=None):
+    """Split into non-overlapping subsets (reference io/dataset.py
+    random_split)."""
+    if sum(lengths) != len(dataset):
+        raise ValueError("sum of lengths must equal dataset length")
+    rng = generator or np.random
+    perm = rng.permutation(len(dataset))
+    out, ofs = [], 0
+    for n in lengths:
+        out.append(Subset(dataset, perm[ofs:ofs + n].tolist()))
+        ofs += n
+    return out
+
+
+# -- samplers ----------------------------------------------------------------
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self.num_samples = num_samples or len(data_source)
+        self.generator = generator
+
+    def __iter__(self):
+        rng = self.generator or np.random
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Groups sampler indices into batches (reference io/batch_sampler.py:
+    either (dataset, shuffle) or an explicit sampler)."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        super().__init__(dataset)
+        if sampler is None:
+            sampler = (RandomSampler(dataset) if shuffle
+                       else SequenceSampler(dataset))
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        return (n // self.batch_size if self.drop_last
+                else (n + self.batch_size - 1) // self.batch_size)
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards batches across data-parallel ranks (reference
+    io/dataloader/batch_sampler.py DistributedBatchSampler): each rank
+    sees len(dataset)/nranks samples, padded so every rank steps the
+    same count (collective steps must stay in lockstep)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        super().__init__(dataset, None, shuffle, batch_size, drop_last)
+        if num_replicas is None or rank is None:
+            from ..distributed import fleet as _fleet
+            try:
+                num_replicas = (num_replicas
+                                or _fleet._fleet_singleton.worker_num())
+                rank = rank if rank is not None \
+                    else _fleet._fleet_singleton.worker_index()
+            except Exception:       # noqa: BLE001 — not initialised
+                num_replicas, rank = num_replicas or 1, rank or 0
+        self.nranks = int(num_replicas)
+        self.rank = int(rank)
+        self.shuffle = shuffle
+        self.epoch = 0
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)     # reshuffle deterministically per epoch
+
+    def __iter__(self):
+        n = len(self.data_source)
+        idx = np.arange(n)
+        if self.shuffle:
+            idx = np.random.RandomState(self.epoch).permutation(n)
+        per = int(np.ceil(n / self.nranks))
+        pad = per * self.nranks - n
+        if pad:
+            idx = np.concatenate([idx, idx[:pad]])   # pad from the front
+        local = idx[self.rank::self.nranks]
+        batch = []
+        for i in local.tolist():
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        per = int(np.ceil(len(self.data_source) / self.nranks))
+        return (per // self.batch_size if self.drop_last
+                else (per + self.batch_size - 1) // self.batch_size)
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: describes the worker for
+    per-worker sharding (reference io/dataloader/worker.py WorkerInfo —
+    the canonical use is `islice(it, info.id, None, info.num_workers)`).
+    Returns None in the main process."""
+    import os
+    wid = os.environ.get("PADDLE_TPU_WORKER_ID")
+    if wid is None:
+        return None
+
+    class _Info:
+        id = int(wid)
+        num_workers = int(os.environ.get("PADDLE_TPU_NUM_WORKERS", "1"))
+        dataset = None          # fork workers inherit it; not re-exposed
+    return _Info()

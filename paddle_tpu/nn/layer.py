@@ -407,6 +407,14 @@ class MultiHeadAttention(Layer):
         return self.out_proj(out)
 
 
+def _unfused():
+    """Ablation switch for tools/mfu_sweep.py case `unfused`: measure
+    what the fused epilogues buy by reverting to separate
+    dropout/act/add ops (shared by encoder AND decoder layers)."""
+    import os
+    return bool(os.environ.get("PADDLE_TPU_UNFUSED_EPILOGUE"))
+
+
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1,
                  activation="relu", attn_dropout=None, act_dropout=None,
@@ -427,13 +435,16 @@ class TransformerEncoderLayer(Layer):
     def _drop_add(self, x, residual):
         """residual epilogue as ONE fused op (pallas on TPU): the add no
         longer costs an extra HBM pass at the dropout kernel boundary."""
-        if self._dropout:
+        if self._dropout and not _unfused():
             return L.fused_dropout_add(x, residual, self._dropout,
                                        is_test=not self.training)
+        if self._dropout:
+            x = L.dropout(x, self._dropout, is_test=not self.training,
+                          dropout_implementation="upscale_in_train")
         return residual + x
 
     def _mlp_mid(self, x):
-        if self._act in ("gelu", "relu"):
+        if self._act in ("gelu", "relu") and not _unfused():
             return L.fused_act_dropout(
                 x, act=self._act, dropout_prob=(
                     self._act_dropout if self.training else 0.0),

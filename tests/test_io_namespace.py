@@ -1,0 +1,134 @@
+"""paddle.io namespace (reference python/paddle/io/): dataset algebra,
+samplers, DistributedBatchSampler rank sharding, DataLoader
+batch_sampler integration."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, ChainDataset, ComposeDataset,
+                           ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, RandomSampler,
+                           SequenceSampler, Subset, TensorDataset,
+                           random_split)
+
+
+class Squares(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i * i), np.int64(i)
+
+
+class TestDatasets:
+    def test_tensor_dataset(self):
+        a = np.arange(6).reshape(6, 1).astype("float32")
+        b = np.arange(6).astype("int64")
+        ds = TensorDataset([a, b])
+        assert len(ds) == 6
+        x, y = ds[3]
+        assert float(x[0]) == 3.0 and int(y) == 3
+        with pytest.raises(ValueError):
+            TensorDataset([a, b[:4]])
+
+    def test_compose_concat_chain_subset(self):
+        d1, d2 = Squares(4), Squares(4)
+        comp = ComposeDataset([d1, d2])
+        assert len(comp[0]) == 4                # 2 fields per dataset
+        cat = ConcatDataset([Squares(3), Squares(2)])
+        assert len(cat) == 5
+        assert float(cat[3][0]) == 0.0          # second dataset's idx 0
+        assert float(cat[4][0]) == 1.0
+        ch = list(ChainDataset([iter([1, 2]), iter([3])]))
+        assert ch == [1, 2, 3]
+        sub = Subset(Squares(10), [2, 5])
+        assert len(sub) == 2 and float(sub[1][0]) == 25.0
+
+    def test_random_split_partitions(self):
+        parts = random_split(Squares(10), [7, 3])
+        assert [len(p) for p in parts] == [7, 3]
+        seen = sorted(int(p[i][1]) for p in parts
+                      for i in range(len(p)))
+        assert seen == list(range(10))          # disjoint + complete
+        with pytest.raises(ValueError):
+            random_split(Squares(10), [5, 4])
+
+
+class TestSamplers:
+    def test_sequence_and_random(self):
+        ds = Squares(8)
+        assert list(SequenceSampler(ds)) == list(range(8))
+        r = list(RandomSampler(ds))
+        assert sorted(r) == list(range(8))
+        rr = list(RandomSampler(ds, replacement=True, num_samples=20))
+        assert len(rr) == 20
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(Squares(10), batch_size=4)
+        batches = list(bs)
+        assert [len(b) for b in batches] == [4, 4, 2]
+        assert len(bs) == 3
+        bs = BatchSampler(Squares(10), batch_size=4, drop_last=True)
+        assert len(list(bs)) == 2 == len(bs)
+
+    def test_distributed_batch_sampler_shards_and_pads(self):
+        ds = Squares(10)
+        all_idx = []
+        for rank in range(3):
+            s = DistributedBatchSampler(ds, batch_size=2, num_replicas=3,
+                                        rank=rank)
+            got = [i for b in s for i in b]
+            assert len(got) == 4                # ceil(10/3) padded to 4
+            all_idx.extend(got)
+        assert set(all_idx) == set(range(10))   # full cover (with pads)
+        # same epoch -> same shuffle on every rank; set_epoch reshuffles
+        s0 = DistributedBatchSampler(ds, 2, 3, 0, shuffle=True)
+        s0b = DistributedBatchSampler(ds, 2, 3, 0, shuffle=True)
+        assert [i for b in s0 for i in b] == [i for b in s0b for i in b]
+        s0b.set_epoch(5)
+        assert [i for b in s0 for i in b] != [i for b in s0b for i in b]
+
+
+class TestLoaderIntegration:
+    def test_batch_sampler_drives_loader(self):
+        ds = Squares(12)
+        bs = BatchSampler(ds, batch_size=5)
+        loader = DataLoader(ds, batch_sampler=bs)
+        assert len(loader) == 3                 # sampler owns batching
+        out = list(loader)
+        assert [len(o[1]) for o in out] == [5, 5, 2]
+        np.testing.assert_array_equal(out[0][1], np.arange(5))
+
+    def test_batch_sampler_conflicts_rejected(self):
+        ds = Squares(12)
+        bs = BatchSampler(ds, batch_size=5)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            DataLoader(ds, batch_sampler=bs, batch_size=4)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            DataLoader(ds, batch_sampler=bs, drop_last=True)
+
+    def test_get_worker_info_in_workers(self):
+        from paddle_tpu.io import get_worker_info
+        assert get_worker_info() is None        # main process
+
+        class Probe(Squares):
+            def __getitem__(self, i):
+                info = get_worker_info()
+                return (np.float32(info.id),
+                        np.int64(info.num_workers))
+
+        out = list(DataLoader(Probe(8), batch_size=4, num_workers=2))
+        ids = {int(v) for o in out for v in o[0]}
+        assert ids <= {0, 1}
+        assert all(int(v) == 2 for o in out for v in o[1])
+
+    def test_distributed_sampler_with_workers(self):
+        ds = Squares(16)
+        s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2,
+                                    rank=1)
+        out = list(DataLoader(ds, batch_sampler=s, num_workers=2))
+        got = sorted(int(v) for o in out for v in o[1])
+        assert got == list(range(1, 16, 2))     # rank-1 shard

@@ -6,7 +6,9 @@ hardware-RNG default silently never applied (fluid/core.py NameError,
 fixed 2026-07-30), so masks used threefry.  Post-fix baseline: 125.4k
 tok/s = 42.3% MFU.  The sweep ablates one suspect at a time:
 
-  baseline      the exact bench configuration
+  baseline      the exact bench configuration (fused dropout epilogues)
+  unfused       fused dropout+add / act+dropout epilogues reverted to
+                separate ops (what the round-4 fusion buys)
   nodrop        dropout off (RNG + mask traffic cost)
   seq512        sequence 512 (attention/matmul ratio shifts, bigger tiles)
   nohead        MLM head replaced by mean pooling (vocab-matmul +
@@ -49,6 +51,9 @@ def run_case(case, steps=20, warmup=3):
     if case == "nodrop":
         import paddle_tpu.dygraph.layers as dl
         dl.Layer.train = dl.Layer.eval          # dropout off everywhere
+
+    if case == "unfused":
+        os.environ["PADDLE_TPU_UNFUSED_EPILOGUE"] = "1"
 
     if case == "nohead":
         from paddle_tpu.dygraph import base as dybase
@@ -113,8 +118,8 @@ def run_case(case, steps=20, warmup=3):
 
 
 def main():
-    cases = sys.argv[1:] or ["baseline", "nodrop", "nohead", "b256",
-                             "seq512"]
+    cases = sys.argv[1:] or ["baseline", "unfused", "nodrop", "nohead",
+                             "b256", "seq512"]
     for case in cases:
         # each case in a fresh process: monkeypatches + jit caches isolate
         if os.environ.get("MFU_SWEEP_CHILD"):
