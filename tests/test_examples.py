@@ -1,0 +1,24 @@
+"""examples/ smoke: every example script runs to completion on CPU.
+They are the user-facing entry documentation — a broken example is a
+broken front door."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = ["mnist_static.py", "bert_dygraph.py", "ctr_boxps.py",
+            "multi_chip.py"]
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.pop("EXAMPLES_ON_TPU", None)
+    env.pop("XLA_FLAGS", None)      # each script owns its device config
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "loss" in r.stdout or "saved" in r.stdout
