@@ -63,3 +63,152 @@ class RandomCrop:
 class ToTensor:
     def __call__(self, x):
         return np.asarray(x, "float32")
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, x):
+        h, w = x.shape[-2:]
+        th, tw = self.size
+        if th > h or tw > w:
+            raise ValueError(
+                f"CenterCrop size {self.size} exceeds image {(h, w)}")
+        i, j = (h - th) // 2, (w - tw) // 2
+        return x[..., i:i + th, j:j + tw]
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, x):
+        if np.random.rand() < self.prob:
+            return x[..., ::-1, :].copy()
+        return x
+
+
+class RandomResizedCrop:
+    """Crop a random area/aspect patch, resize to `size` (the ImageNet
+    training transform; reference transforms.py RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale, self.ratio = scale, ratio
+        self._resize = Resize(self.size)     # hot path: one object
+
+    def __call__(self, x):
+        h, w = x.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                patch = x[..., i:i + th, j:j + tw]
+                return self._resize(patch)
+        return self._resize(CenterCrop(min(h, w))(x))
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        if isinstance(padding, int):
+            self.padding = (padding,) * 4
+        else:
+            p = tuple(padding)
+            if len(p) == 2:              # (pad_lr, pad_tb) reference form
+                p = (p[0], p[1], p[0], p[1])
+            if len(p) != 4:
+                raise ValueError(
+                    "Pad expects an int, (lr, tb), or (l, t, r, b)")
+            self.padding = p             # (left, top, right, bottom)
+        self.fill = fill
+
+    def __call__(self, x):
+        l, t, r, b = self.padding
+        return np.pad(x, [(0, 0), (t, b), (l, r)], constant_values=self.fill)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, x):
+        if x.shape[0] == 3:
+            g = (0.299 * x[0] + 0.587 * x[1] + 0.114 * x[2])[None]
+        else:
+            g = x[:1]
+        return np.repeat(g, self.n, axis=0) if self.n > 1 else g
+
+
+def _jitter_alpha(value):
+    # reference samples alpha in [max(0, 1-v), 1+v]: never negative, so
+    # a large jitter value can darken to black but not invert the image
+    return np.random.uniform(max(0.0, 1.0 - value), 1.0 + value)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, x):
+        return np.asarray(x, "float32") * _jitter_alpha(self.value)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, x):
+        alpha = _jitter_alpha(self.value)
+        x = np.asarray(x, "float32")
+        return (x - x.mean()) * alpha + x.mean()
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, x):
+        alpha = _jitter_alpha(self.value)
+        x = np.asarray(x, "float32")
+        gray = Grayscale(x.shape[0])(x)
+        return x * alpha + gray * (1.0 - alpha)
+
+
+class ColorJitter:
+    """Brightness/contrast/saturation jitter (reference transforms.py
+    ColorJitter).  Hue needs an HSV round-trip; a nonzero hue raises
+    rather than silently weakening a ported augmentation recipe."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        if hue:
+            raise NotImplementedError(
+                "ColorJitter hue is not implemented (needs HSV "
+                "conversion); use brightness/contrast/saturation")
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+
+    def __call__(self, x):
+        for t in np.random.permutation(self.ts):
+            x = t(x)
+        return x
+
+
+class Transpose:
+    """HWC -> CHW (reference transforms.py Transpose)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, x):
+        return np.transpose(np.asarray(x), self.order)

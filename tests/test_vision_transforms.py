@@ -1,0 +1,91 @@
+"""vision.transforms breadth (reference python/paddle/vision/transforms):
+host-side numpy transforms feeding the DataLoader worker pool."""
+import numpy as np
+
+from paddle_tpu.vision import transforms as T
+
+
+def img(seed=0):
+    return np.random.RandomState(seed).rand(3, 32, 32).astype("float32")
+
+
+class TestShapes:
+    def test_crops_and_pad(self):
+        x = img()
+        assert T.CenterCrop(24)(x).shape == (3, 24, 24)
+        assert T.RandomCrop(20)(x).shape == (3, 20, 20)
+        assert T.RandomResizedCrop(16)(x).shape == (3, 16, 16)
+        assert T.Pad(2)(x).shape == (3, 36, 36)
+        assert T.Pad((1, 2, 3, 4))(x).shape == (3, 38, 36)
+
+    def test_flips_deterministic_at_p1(self):
+        x = img(1)
+        np.testing.assert_allclose(T.RandomVerticalFlip(1.0)(x),
+                                   x[:, ::-1, :])
+        np.testing.assert_allclose(T.RandomHorizontalFlip(1.0)(x),
+                                   x[:, :, ::-1])
+
+    def test_grayscale(self):
+        x = img(2)
+        g = T.Grayscale()(x)
+        assert g.shape == (1, 32, 32)
+        np.testing.assert_allclose(
+            g[0], 0.299 * x[0] + 0.587 * x[1] + 0.114 * x[2], rtol=1e-5)
+        assert T.Grayscale(3)(x).shape == (3, 32, 32)
+
+    def test_color_jitter_and_compose(self):
+        x = img(3)
+        out = T.ColorJitter(brightness=0.4, contrast=0.4)(x)
+        assert out.shape == x.shape and np.isfinite(out).all()
+        pipeline = T.Compose([T.RandomResizedCrop(16),
+                              T.RandomHorizontalFlip(),
+                              T.Normalize(mean=[0.5] * 3, std=[0.5] * 3)])
+        assert pipeline(x).shape == (3, 16, 16)
+
+    def test_transpose_hwc_to_chw(self):
+        assert T.Transpose()(np.zeros((8, 8, 3))).shape == (3, 8, 8)
+
+    def test_pad_two_tuple_and_bad_input(self):
+        import pytest
+        x = img(4)
+        assert T.Pad((1, 2))(x).shape == (3, 36, 34)    # (lr, tb)
+        with pytest.raises(ValueError, match="Pad expects"):
+            T.Pad((1, 2, 3))
+
+    def test_center_crop_oversize_raises(self):
+        import pytest
+        with pytest.raises(ValueError, match="exceeds"):
+            T.CenterCrop(48)(img(5))
+
+    def test_jitter_alpha_never_negative(self):
+        x = np.ones((3, 4, 4), "float32")
+        for _ in range(50):
+            out = T.BrightnessTransform(5.0)(x)
+            assert out.min() >= 0.0     # alpha clamped at 0
+
+    def test_saturation_and_hue_contract(self):
+        import pytest
+        x = img(6)
+        out = T.ColorJitter(saturation=0.5)(x)
+        assert out.shape == x.shape and np.isfinite(out).all()
+        with pytest.raises(NotImplementedError, match="hue"):
+            T.ColorJitter(hue=0.1)
+
+    def test_transforms_through_worker_pool(self):
+        """The canonical deployment: a transform-bearing dataset under
+        DataLoader(num_workers>0) — per-worker RNG streams, stable
+        shapes."""
+        from paddle_tpu.fluid.reader import DataLoader
+
+        class DS:
+            t = T.Compose([T.RandomCrop(28), T.RandomHorizontalFlip()])
+
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return self.t(img(i)), np.int64(i % 4)
+
+        out = list(DataLoader(DS(), batch_size=4, num_workers=2))
+        assert len(out) == 4
+        assert all(o[0].shape == (4, 3, 28, 28) for o in out)
