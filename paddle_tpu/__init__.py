@@ -23,6 +23,10 @@ from .fluid import layers as _fl_layers
 
 from . import nn
 from . import io
+from . import dataset
+from . import distribution
+from . import regularizer
+from . import utils
 from . import tensor
 from .tensor import *  # noqa: F401,F403
 from . import optimizer

@@ -1,0 +1,9 @@
+"""paddle.dataset — the classic reader-creator tier (reference
+python/paddle/dataset/): `mnist.train()` returns a zero-arg callable
+yielding samples, composable with paddle.batch/shuffle.  Served by the
+same dataset classes as paddle.vision/text (cache contract or synthetic
+fallback), so the book-era examples run unchanged."""
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401

@@ -1,0 +1,33 @@
+"""paddle.dataset.cifar (reference dataset/cifar.py): reader creators
+yielding (flat float32 [3072], int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _reader(cls_name, mode):
+    from ..vision import datasets as V
+
+    def reader():
+        ds = getattr(V, cls_name)(mode=mode)
+        for i in range(len(ds)):
+            img, lbl = ds[i]
+            yield np.asarray(img, "float32").reshape(-1), \
+                int(np.asarray(lbl).ravel()[0])
+    return reader
+
+
+def train10():
+    return _reader("Cifar10", "train")
+
+
+def test10():
+    return _reader("Cifar10", "test")
+
+
+def train100():
+    return _reader("Cifar100", "train")
+
+
+def test100():
+    return _reader("Cifar100", "test")

@@ -1,0 +1,47 @@
+"""paddle.dataset.imdb (reference dataset/imdb.py): word_dict() + reader
+creators yielding (token_ids, 0/1 label)."""
+from __future__ import annotations
+
+import numpy as np
+
+_CACHE = {}
+
+
+def _ds(mode):
+    from ..text.datasets import Imdb
+    if mode not in _CACHE:
+        _CACHE[mode] = Imdb(mode=mode)
+    return _CACHE[mode]
+
+
+def word_dict():
+    """token -> id mapping (reference imdb.py word_dict)."""
+    return dict(_ds("train").word_idx)
+
+
+def _reader(mode, word_idx=None):
+    def reader():
+        ds = _ds(mode)
+        if word_idx is None:
+            keep = None
+        else:
+            # honor a caller-pruned dict (the classic vocab-cutoff
+            # recipe): ids outside it map to UNK == len(word_idx), so an
+            # embedding sized len(word_idx)+1 is always in range
+            keep = set(word_idx.values())
+            unk = len(word_idx)
+        for i in range(len(ds)):
+            doc, lbl = ds[i]
+            ids = [int(t) for t in np.asarray(doc).ravel()]
+            if keep is not None:
+                ids = [t if t in keep else unk for t in ids]
+            yield ids, int(np.asarray(lbl).ravel()[0])
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train", word_idx)
+
+
+def test(word_idx=None):
+    return _reader("test", word_idx)

@@ -1,0 +1,27 @@
+"""paddle.dataset.mnist (reference dataset/mnist.py): reader creators
+yielding (image float32 [784] scaled to [-1, 1], int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _reader(mode):
+    from ..vision.datasets import MNIST
+
+    def reader():
+        ds = MNIST(mode=mode)
+        for i in range(len(ds)):
+            img, lbl = ds[i]
+            # vision.MNIST already serves classic scale: real gz data is
+            # /127.5-1.0 at load, synthetic blobs are generated in-range
+            yield np.asarray(img, "float32").reshape(-1), \
+                int(np.asarray(lbl).ravel()[0])
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

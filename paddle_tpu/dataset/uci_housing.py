@@ -1,0 +1,25 @@
+"""paddle.dataset.uci_housing (reference dataset/uci_housing.py):
+reader creators yielding (features float32 [13], target float32 [1])."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _reader(mode):
+    from ..text.datasets import UCIHousing
+
+    def reader():
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, "float32"), \
+                np.asarray(y, "float32").reshape(1)
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

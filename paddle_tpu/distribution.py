@@ -1,0 +1,4 @@
+"""paddle.distribution (reference python/paddle/distribution.py): the
+2.0 names over the fluid distributions implementations."""
+from .fluid.layers.distributions import (  # noqa: F401
+    Categorical, Distribution, MultivariateNormalDiag, Normal, Uniform)

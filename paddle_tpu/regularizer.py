@@ -1,0 +1,6 @@
+"""paddle.regularizer (reference python/paddle/regularizer.py): 2.0
+names over the fluid regularizers (one binding site — the fluid module
+already defines the L1Decay/L2Decay aliases)."""
+from .fluid.regularizer import (  # noqa: F401
+    L1Decay, L2Decay, L1DecayRegularizer, L2DecayRegularizer,
+    WeightDecayRegularizer)

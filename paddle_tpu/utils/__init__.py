@@ -1,4 +1,83 @@
-"""Shared runtime utilities."""
+"""paddle.utils (reference python/paddle/utils/): deprecation decorator,
+install check, download entry (zero-egress: resolves through the
+dataset cache contract) — plus the repo's shared runtime utilities."""
+from __future__ import annotations
+
+import functools
+import warnings
+
 from .prefetch import Prefetcher
 
-__all__ = ["Prefetcher"]
+__all__ = ["Prefetcher", "deprecated", "run_check", "download"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    """Mark an API deprecated (reference utils/deprecated.py): warns at
+    the call site with the replacement."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__qualname__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if reason:
+                msg += f": {reason}"
+            if update_to:
+                msg += f"; use '{update_to}' instead"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Install check (reference utils/install_check.py): one tiny train
+    step on the current backend, prints the device inventory."""
+    import jax
+    import numpy as np
+    import paddle_tpu.fluid as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("check_x", [-1, 4])
+        y = fluid.data("check_y", [-1, 1])
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(x, 1), y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    lv, = exe.run(main, feed={"check_x": rng.randn(4, 4).astype("float32"),
+                              "check_y": rng.randn(4, 1).astype("float32")},
+                  fetch_list=[loss])
+    devs = jax.devices()
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} device(s): {[d.platform for d in devs]}; "
+          f"train-step loss {float(np.asarray(lv).ravel()[0]):.4f}")
+    return True
+
+
+def download(url, module_name="misc", md5sum=None, save_name=None):
+    """Zero-egress download stub: serves the file if it already exists in
+    the dataset cache (PADDLE_TPU_DATA_HOME), else raises with the
+    contract (this environment has no network egress)."""
+    import os
+    home = os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "dataset"))
+    name = save_name or url.rstrip("/").rsplit("/", 1)[-1]
+    path = os.path.join(home, module_name, name)
+    if os.path.exists(path):
+        if md5sum:
+            import hashlib
+            with open(path, "rb") as f:
+                got = hashlib.md5(f.read()).hexdigest()
+            if got != md5sum:
+                raise RuntimeError(
+                    f"pre-placed file {path} fails md5 check "
+                    f"(got {got}, want {md5sum}) — replace it")
+        return path
+    raise RuntimeError(
+        f"no network egress: pre-place '{name}' at {path} "
+        f"(PADDLE_TPU_DATA_HOME contract) instead of downloading {url}")

@@ -130,7 +130,10 @@ class Pad:
 
     def __call__(self, x):
         l, t, r, b = self.padding
-        return np.pad(x, [(0, 0), (t, b), (l, r)], constant_values=self.fill)
+        # rank-agnostic like the sibling transforms: pad the trailing
+        # (H, W) axes whatever the leading rank is
+        width = [(0, 0)] * (x.ndim - 2) + [(t, b), (l, r)]
+        return np.pad(x, width, constant_values=self.fill)
 
 
 class Grayscale:
@@ -148,6 +151,8 @@ class Grayscale:
 def _jitter_alpha(value):
     # reference samples alpha in [max(0, 1-v), 1+v]: never negative, so
     # a large jitter value can darken to black but not invert the image
+    if value < 0:
+        raise ValueError(f"jitter value must be non-negative, got {value}")
     return np.random.uniform(max(0.0, 1.0 - value), 1.0 + value)
 
 

@@ -1,0 +1,98 @@
+"""Namespace tail (reference python/paddle/{dataset,distribution,
+regularizer,utils}): classic reader creators, 2.0 regularizer names,
+distribution aliases, deprecation/install-check utilities."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestClassicDatasetReaders:
+    def test_mnist_reader_format(self):
+        r = paddle.dataset.mnist.train()
+        img, lbl = next(iter(r()))
+        assert img.shape == (784,) and img.dtype == np.float32
+        # classic scale: roughly [-1, 1] (synthetic fallback is gaussian
+        # around that range; REAL cached uint8 data is rescaled exactly)
+        assert -4.0 <= float(img.min()) and float(img.max()) <= 4.0
+        assert isinstance(lbl, int) and 0 <= lbl <= 9
+
+    def test_cifar_and_uci_and_imdb(self):
+        img, lbl = next(iter(paddle.dataset.cifar.train10()()))
+        assert img.shape == (3072,)
+        x, y = next(iter(paddle.dataset.uci_housing.train()()))
+        assert x.shape == (13,) and y.shape == (1,)
+        doc, l = next(iter(paddle.dataset.imdb.train()()))
+        assert isinstance(doc, list) and l in (0, 1)
+        wd = paddle.dataset.imdb.word_dict()
+        assert len(wd) > 100
+
+    def test_composes_with_paddle_batch(self):
+        batched = paddle.batch(paddle.dataset.mnist.train(), 32)
+        first = next(iter(batched()))
+        assert len(first) == 32
+
+
+class TestRegularizerAndDistribution:
+    def test_l2decay_shrinks_weights(self):
+        import paddle_tpu.fluid as fluid
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("xr", [-1, 4])
+            pred = fluid.layers.fc(x, 2)
+            loss = fluid.layers.mean(pred * 0.0)    # reg is the only force
+            fluid.optimizer.SGDOptimizer(
+                0.5, regularization=paddle.regularizer.L2Decay(0.1)
+            ).minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        from paddle_tpu.fluid.core import global_scope
+        w0 = None
+        for name in list(global_scope()._vars):
+            if name.startswith("fc") and name.endswith(".w_0"):
+                w0 = name
+        before = np.abs(np.asarray(global_scope().find_var(w0))).sum()
+        for _ in range(3):
+            exe.run(main, feed={"xr": np.ones((2, 4), "float32")},
+                    fetch_list=[loss])
+        after = np.abs(np.asarray(global_scope().find_var(w0))).sum()
+        assert after < before
+
+    def test_distribution_namespace(self):
+        from paddle_tpu.dygraph import base as dybase
+        dybase.enable_dygraph()
+        try:
+            n = paddle.distribution.Normal(0.0, 1.0)
+            s = n.sample([64])
+            assert np.asarray(s.numpy()).shape[0] == 64
+        finally:
+            dybase.disable_dygraph()
+
+
+class TestUtils:
+    def test_deprecated_warns(self):
+        @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+        def old_api():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_api() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+        assert any("paddle.new_api" in str(x.message) for x in w)
+
+    def test_run_check(self, capsys):
+        assert paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_download_contract(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+        with pytest.raises(RuntimeError, match="no network egress"):
+            paddle.utils.download("http://x/y/file.tgz")
+        d = tmp_path / "misc"
+        d.mkdir()
+        (d / "file.tgz").write_bytes(b"data")
+        assert paddle.utils.download("http://x/y/file.tgz") == \
+            str(d / "file.tgz")
