@@ -1,15 +1,20 @@
 """paddle.dataset.cifar (reference dataset/cifar.py): reader creators
-yielding (flat float32 [3072], int label)."""
+yielding (flat float32 [3072], int label).  The vision classes already
+honor the npz cache contract; the per-process dataset cache keeps
+epoch-over-epoch reader re-invocation free."""
 from __future__ import annotations
 
 import numpy as np
+
+from .common import cached_dataset
 
 
 def _reader(cls_name, mode):
     from ..vision import datasets as V
 
     def reader():
-        ds = getattr(V, cls_name)(mode=mode)
+        ds = cached_dataset(("cifar", cls_name, mode),
+                            lambda: getattr(V, cls_name)(mode=mode))
         for i in range(len(ds)):
             img, lbl = ds[i]
             yield np.asarray(img, "float32").reshape(-1), \

@@ -1,0 +1,24 @@
+"""Shared plumbing for the classic reader tier (reference
+dataset/common.py): cache-home resolution + per-process dataset cache so
+re-invoking a reader creator each epoch doesn't rebuild the dataset."""
+from __future__ import annotations
+
+import os
+
+from ..utils import data_home  # noqa: F401  (re-export: classic name)
+
+_DS_CACHE = {}
+
+
+def cached_dataset(key, builder):
+    """One dataset instance per (reader, mode) per process — reader
+    creators are re-invoked every epoch."""
+    if key not in _DS_CACHE:
+        _DS_CACHE[key] = builder()
+    return _DS_CACHE[key]
+
+
+def cache_file(*parts):
+    """Path under the data-home contract if it exists, else None."""
+    p = os.path.join(data_home(), *parts)
+    return p if os.path.exists(p) else None

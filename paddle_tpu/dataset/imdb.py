@@ -4,14 +4,15 @@ from __future__ import annotations
 
 import numpy as np
 
-_CACHE = {}
+from .common import cache_file, cached_dataset
 
 
 def _ds(mode):
     from ..text.datasets import Imdb
-    if mode not in _CACHE:
-        _CACHE[mode] = Imdb(mode=mode)
-    return _CACHE[mode]
+    return cached_dataset(
+        ("imdb", mode),
+        lambda: Imdb(data_file=cache_file("imdb", "aclImdb_v1.tar.gz"),
+                     mode=mode))
 
 
 def word_dict():

@@ -1,18 +1,35 @@
 """paddle.dataset.mnist (reference dataset/mnist.py): reader creators
-yielding (image float32 [784] scaled to [-1, 1], int label)."""
+yielding (image float32 [784] scaled to [-1, 1], int label).  Real data
+is served when the idx-ubyte gz files sit under the cache contract
+(<data_home>/mnist/{train,t10k}-{images-idx3,labels-idx1}-ubyte.gz);
+otherwise the deterministic synthetic fallback."""
 from __future__ import annotations
 
 import numpy as np
 
+from .common import cache_file, cached_dataset
+
+_FILES = {"train": ("train-images-idx3-ubyte.gz",
+                    "train-labels-idx1-ubyte.gz"),
+          "test": ("t10k-images-idx3-ubyte.gz",
+                   "t10k-labels-idx1-ubyte.gz")}
+
+
+def _dataset(mode):
+    from ..vision.datasets import MNIST
+    img_gz, lbl_gz = _FILES[mode]
+    return cached_dataset(
+        ("mnist", mode),
+        lambda: MNIST(image_path=cache_file("mnist", img_gz),
+                      label_path=cache_file("mnist", lbl_gz), mode=mode))
+
 
 def _reader(mode):
-    from ..vision.datasets import MNIST
-
     def reader():
-        ds = MNIST(mode=mode)
+        ds = _dataset(mode)
         for i in range(len(ds)):
             img, lbl = ds[i]
-            # vision.MNIST already serves classic scale: real gz data is
+            # vision.MNIST serves classic scale already: real gz data is
             # /127.5-1.0 at load, synthetic blobs are generated in-range
             yield np.asarray(img, "float32").reshape(-1), \
                 int(np.asarray(lbl).ravel()[0])

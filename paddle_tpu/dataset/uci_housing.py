@@ -1,15 +1,26 @@
 """paddle.dataset.uci_housing (reference dataset/uci_housing.py):
-reader creators yielding (features float32 [13], target float32 [1])."""
+reader creators yielding (features float32 [13], target float32 [1]).
+Real data is served from <data_home>/uci_housing/housing.data under the
+cache contract."""
 from __future__ import annotations
 
 import numpy as np
 
+from .common import cache_file, cached_dataset
+
+
+def _dataset(mode):
+    from ..text.datasets import UCIHousing
+    return cached_dataset(
+        ("uci_housing", mode),
+        lambda: UCIHousing(
+            data_file=cache_file("uci_housing", "housing.data"),
+            mode=mode))
+
 
 def _reader(mode):
-    from ..text.datasets import UCIHousing
-
     def reader():
-        ds = UCIHousing(mode=mode)
+        ds = _dataset(mode)
         for i in range(len(ds)):
             x, y = ds[i]
             yield np.asarray(x, "float32"), \

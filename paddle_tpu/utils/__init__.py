@@ -8,7 +8,18 @@ import warnings
 
 from .prefetch import Prefetcher
 
-__all__ = ["Prefetcher", "deprecated", "run_check", "download"]
+__all__ = ["Prefetcher", "deprecated", "run_check", "download",
+           "data_home"]
+
+
+def data_home():
+    """THE cache directory of the zero-egress data contract: every
+    dataset loader and download() resolve through this one helper."""
+    import os
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "dataset"))
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = ""):
@@ -62,10 +73,7 @@ def download(url, module_name="misc", md5sum=None, save_name=None):
     the dataset cache (PADDLE_TPU_DATA_HOME), else raises with the
     contract (this environment has no network egress)."""
     import os
-    home = os.environ.get(
-        "PADDLE_TPU_DATA_HOME",
-        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
-                     "dataset"))
+    home = data_home()
     name = save_name or url.rstrip("/").rsplit("/", 1)[-1]
     path = os.path.join(home, module_name, name)
     if os.path.exists(path):

@@ -81,11 +81,8 @@ def _cached_arrays(name, mode, data_file=None):
         raise FileNotFoundError(
             f"dataset file '{data_file}' does not exist (the synthetic "
             f"fallback only applies to the default cache path)")
-    path = data_file or os.path.join(
-        os.environ.get("PADDLE_TPU_DATA_HOME",
-                       os.path.join(os.path.expanduser("~"), ".cache",
-                                    "paddle_tpu", "dataset")),
-        f"{name}_{mode}.npz")
+    from ..utils import data_home
+    path = data_file or os.path.join(data_home(), f"{name}_{mode}.npz")
     if path and os.path.exists(path):
         z = np.load(path)
         return np.asarray(z["images"], "float32"), \

@@ -158,6 +158,40 @@ class TestBoxProgramPath:
         assert losses[-1] < losses[0] * 0.9
 
 
+class TestBoxPSOptimizer:
+    """fluid.optimizer.BoxPSOptimizer facade (reference optimizer.py:5194
+    pipeline sectioning): accepts the legacy signature, records hints,
+    delegates minimize — the device section is one XLA step here."""
+
+    def test_minimize_through_box_path(self):
+        from paddle_tpu.fluid.core import global_scope
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.data("ids_bpo", [-1, 2], dtype="int64")
+            label = fluid.data("label_bpo", [-1, 1])
+            get_box_wrapper("t_bpo", dim=4, init_kind="zeros")
+            emb = fluid.layers.pull_box_sparse(ids, 4, table_name="t_bpo")
+            pred = fluid.layers.fc(fluid.layers.reshape(emb, [-1, 8]), 1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+            fluid.optimizer.BoxPSOptimizer(
+                fluid.optimizer.SGDOptimizer(0.1),
+                cut_list=[[emb], [loss]]).minimize(loss)
+        assert main._hints["boxps_pipeline"]["cuts"] == 2
+        exe = fluid.Executor()
+        exe.run(startup)
+        box = get_box_wrapper("t_bpo")
+        idv = np.array([[1, 2], [3, 4]], np.int64)
+        cache = box.begin_pass(idv)
+        global_scope().set_var("t_bpo@HBMCACHE", cache)
+        feed = {"ids_bpo": box.slots_of(idv.reshape(-1)).reshape(2, 2),
+                "label_bpo": np.ones((2, 1), "float32")}
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        l1, = exe.run(main, feed=feed, fetch_list=[loss])
+        box.end_pass(global_scope().find_var("t_bpo@HBMCACHE"))
+        assert float(np.asarray(l1)) < float(np.asarray(l0))
+
+
 class TestPipelinedPasses:
     """Double-buffered pass driver (trainer.train_passes): pass N+1's
     sweep+pull and pass N's writeback overlap device compute
