@@ -25,11 +25,34 @@ from .loss import (cross_entropy, softmax_with_cross_entropy,
 from .metric_op import accuracy, auc
 from .control_flow import (cond, while_loop, array_write, array_read,
                            array_length, create_array, less_than, equal,
-                           greater_than, increment as cf_increment, Switch)
+                           greater_than, increment as cf_increment, Switch,
+                           Print, Assert, is_empty, case, switch_case,
+                           IfElse, StaticRNN, DynamicRNN,
+                           reorder_lod_tensor_by_rank,
+                           logical_and, logical_or, logical_not)
 from .sequence_lod import (sequence_conv, sequence_pool, sequence_softmax, sequence_expand,
                            sequence_mask, sequence_reverse, sequence_pad,
+                           sequence_concat, sequence_first_step,
+                           sequence_last_step, sequence_slice,
+                           sequence_expand_as, sequence_reshape,
+                           sequence_scatter, sequence_enumerate,
                            sequence_unpad)
 from .collective import _c_allreduce, _c_allgather, _c_broadcast, _allreduce
-from .rnn import lstm_unit, gru_unit, dynamic_lstm_unit  # noqa: F401
+from .rnn import (lstm_unit, gru_unit, dynamic_lstm_unit,  # noqa: F401
+                  dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm,
+                  RNNCell, LSTMCell, GRUCell, rnn, birnn,
+                  Decoder, DecodeHelper, TrainingHelper,
+                  GreedyEmbeddingHelper, SampleEmbeddingHelper,
+                  BasicDecoder, dynamic_decode, BeamSearchDecoder)
 from .detection import *  # noqa: F401,F403
 from . import distributions  # noqa: F401
+from . import extras
+from .extras import *  # noqa: F401,F403  fluid.layers parity tail
+
+# scrub module objects leaked by star imports from helper modules: they
+# are not API (fluid.layers.np shadowing numpy confuses callers)
+import types as _types
+for _n in ("np", "jax", "jnp", "sys", "itertools", "annotations"):
+    if isinstance(globals().get(_n), (_types.ModuleType,)) or _n == "annotations":
+        globals().pop(_n, None)
+del _types

@@ -124,3 +124,554 @@ def _rnn_scan(ins, attrs, ctx):
     if mode == "LSTM":
         outs["State"].append(jnp.stack(c_fin))
     return outs
+
+
+# --- rnn __all__ parity tail (reference layers/rnn.py) ----------------------
+from ..framework import in_dygraph_mode
+
+
+def _rnn_one(op, ins, out_slots, **attrs):
+    helper = LayerHelper(op)
+    outs = {s: [helper.create_variable_for_type_inference()]
+            for s in out_slots}
+    got = helper.append_op(op, inputs=ins, outputs=outs, attrs=attrs)
+    src = got if in_dygraph_mode() else outs
+    vals = tuple(src[s][0] for s in out_slots)
+    return vals if len(vals) > 1 else vals[0]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """lstm_op.cc padded analog: input [B, T, 4H] pre-projected
+    (LoD-free; ragged tails ride the Length convention of the sequence
+    tier)."""
+    helper = LayerHelper("dynamic_lstm", name=name)
+    h = size // 4
+    w = helper.create_parameter(param_attr, [h, 4 * h], dtype)
+    b_width = 7 * h if use_peepholes else 4 * h
+    b = helper.create_parameter(bias_attr, [1, b_width], dtype,
+                                is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    hidden, cell = _rnn_one("lstm", ins, ("Hidden", "Cell"),
+                            use_peepholes=use_peepholes,
+                            is_reverse=is_reverse,
+                            gate_activation=gate_activation,
+                            cell_activation=cell_activation,
+                            candidate_activation=candidate_activation)
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=True,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", dtype="float32", name=None):
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    h = size // 4
+    w = helper.create_parameter(param_attr, [proj_size, 4 * h], dtype)
+    proj_w = helper.create_parameter(None, [h, proj_size], dtype)
+    b = helper.create_parameter(bias_attr,
+                                [1, 7 * h if use_peepholes else 4 * h],
+                                dtype, is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "ProjWeight": [proj_w],
+           "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    proj, cell = _rnn_one("lstmp", ins, ("Projection", "Cell"),
+                          use_peepholes=use_peepholes,
+                          is_reverse=is_reverse,
+                          gate_activation=gate_activation,
+                          cell_activation=cell_activation,
+                          candidate_activation=candidate_activation,
+                          proj_activation=proj_activation)
+    return proj, cell
+
+
+def dynamic_gru(input, size, h_0=None, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", origin_mode=False,
+                dtype="float32", name=None):
+    """gru_op.cc padded analog: input [B, T, 3H] pre-projected."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    w = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+    b = helper.create_parameter(bias_attr, [1, 3 * size], dtype,
+                                is_bias=True)
+    ins = {"Input": [input], "Weight": [w], "Bias": [b]}
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    return _rnn_one("gru", ins, ("Hidden",), is_reverse=is_reverse,
+                    gate_activation=gate_activation,
+                    activation=candidate_activation,
+                    origin_mode=origin_mode)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """cudnn_lstm analog (layers/rnn.py lstm): multi-layer LSTM over the
+    packed-weight scan lowering.  `input` is TIME-MAJOR [T, B, D], the
+    reference cudnn layout."""
+    helper = LayerHelper("lstm", name=name)
+    if is_bidirec:
+        raise NotImplementedError(
+            "layers.lstm(is_bidirec=True): use paddle.nn.LSTM("
+            "direction='bidirect'), the bidirectional scan tier")
+    d_in = input.shape[-1]
+    ndir = 2 if is_bidirec else 1
+    n_w = 0
+    for layer in range(num_layers):
+        li = d_in if layer == 0 else hidden_size * ndir
+        n_w += ndir * (4 * hidden_size * li + 4 * hidden_size *
+                       hidden_size + 8 * hidden_size)
+    w = helper.create_parameter(None, [n_w], "float32",
+                                default_initializer=default_initializer)
+    out, last_h, last_c = _rnn_one(
+        "cudnn_lstm",
+        {"Input": [input], "W": [w], "InitH": [init_h],
+         "InitC": [init_c]},
+        ("Out", "LastH", "LastC"), hidden_size=hidden_size,
+        num_layers=num_layers, is_bidirec=is_bidirec,
+        dropout_prob=dropout_prob, is_test=is_test)
+    return out, last_h, last_c
+
+
+class RNNCell:
+    """Base cell (layers/rnn.py RNNCell): call(inputs, states) ->
+    (out, new_states); parameters are created lazily on first call so
+    the input size is inferred (the reference infers from the first
+    step too)."""
+
+    def call(self, inputs, states):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        return self.call(inputs, states)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        from .tensor import fill_constant
+        b = batch_ref.shape[batch_dim_idx]
+        shape = list(shape or [self.hidden_size])
+        return fill_constant([b] + shape, dtype, init_value)
+
+
+class LSTMCell(RNNCell):
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, forget_bias=1.0,
+                 dtype="float32", name="LSTMCell"):
+        self.hidden_size = hidden_size
+        self._param_attr, self._bias_attr = param_attr, bias_attr
+        self._forget_bias = forget_bias
+        self._dtype = dtype
+        self._w = None
+
+    def _params(self, d_in):
+        if self._w is None:
+            helper = LayerHelper("lstm_cell")
+            self._w = helper.create_parameter(
+                self._param_attr, [d_in + self.hidden_size,
+                                   4 * self.hidden_size], self._dtype)
+            self._b = helper.create_parameter(
+                self._bias_attr, [4 * self.hidden_size], self._dtype,
+                is_bias=True)
+        return self._w, self._b
+
+    def call(self, inputs, states):
+        from . import nn as _n
+        from .tensor import concat
+        h, c = states
+        w, b = self._params(inputs.shape[-1])
+        gates = _n.matmul(concat([inputs, h], axis=1), w) + b
+        i, f, g, o = _n.split(gates, 4, dim=-1)
+        c2 = _n.sigmoid(f + self._forget_bias) * c + \
+            _n.sigmoid(i) * _n.tanh(g)
+        h2 = _n.sigmoid(o) * _n.tanh(c2)
+        return h2, [h2, c2]
+
+    def get_initial_states(self, batch_ref, **kw):
+        z = super().get_initial_states(batch_ref, **kw)
+        z2 = super().get_initial_states(batch_ref, **kw)
+        return [z, z2]
+
+
+class GRUCell(RNNCell):
+    def __init__(self, hidden_size, param_attr=None, bias_attr=None,
+                 gate_activation=None, activation=None, dtype="float32",
+                 name="GRUCell"):
+        self.hidden_size = hidden_size
+        self._param_attr, self._bias_attr = param_attr, bias_attr
+        self._dtype = dtype
+        self._w = None
+
+    def _params(self, d_in):
+        if self._w is None:
+            helper = LayerHelper("gru_cell")
+            h = self.hidden_size
+            self._wg = helper.create_parameter(
+                self._param_attr, [d_in + h, 2 * h], self._dtype)
+            self._wc = helper.create_parameter(
+                self._param_attr, [d_in + h, h], self._dtype)
+            self._bg = helper.create_parameter(
+                self._bias_attr, [2 * h], self._dtype, is_bias=True)
+            self._bc = helper.create_parameter(
+                self._bias_attr, [h], self._dtype, is_bias=True)
+            self._w = True
+        return self._wg, self._wc, self._bg, self._bc
+
+    def call(self, inputs, states):
+        from . import nn as _n
+        from .tensor import concat
+        h = states
+        wg, wc, bg, bc = self._params(inputs.shape[-1])
+        gates = _n.sigmoid(_n.matmul(concat([inputs, h], axis=1), wg) + bg)
+        u, r = _n.split(gates, 2, dim=-1)
+        c = _n.tanh(_n.matmul(concat([inputs, r * h], axis=1), wc) + bc)
+        h2 = u * h + (1.0 - u) * c
+        return h2, h2
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kw):
+    """Generic cell runner (layers/rnn.py rnn): python-unrolled over the
+    padded time axis; compiled as one XLA program by the executor.
+    `sequence_length` masks ragged tails: outputs beyond a sample's
+    length are zero and its state stops advancing (reference rnn() mask
+    semantics)."""
+    from . import nn as _n
+    from .tensor import concat, assign
+    import numpy as _np
+    if time_major:
+        inputs = _n.transpose(inputs, [1, 0, 2])
+    T = inputs.shape[1]
+    states = initial_states if initial_states is not None \
+        else cell.get_initial_states(inputs)
+    seq_len = None
+    if sequence_length is not None:
+        seq_len = (sequence_length if hasattr(sequence_length, "shape")
+                   else assign(_np.asarray(sequence_length, "float32")))
+        seq_len = _n.reshape(_n.cast(seq_len, "float32"), [-1, 1])
+
+    def blend(new, old, m):
+        if isinstance(new, (list, tuple)):
+            return [blend(n, o, m) for n, o in zip(new, old)]
+        return new * m + old * (1.0 - m)
+
+    steps = range(T - 1, -1, -1) if is_reverse else range(T)
+    outs = [None] * T
+    for t in steps:
+        xt = _n.squeeze(_n.slice(inputs, axes=[1], starts=[t],
+                                 ends=[t + 1]), [1])
+        out_t, new_states = cell(xt, states)
+        if seq_len is not None:
+            from .control_flow import less_than
+            from .tensor import fill_constant
+            m = _n.cast(less_than(
+                fill_constant([1], "float32", float(t)), seq_len),
+                "float32")                       # [B, 1] valid mask
+            out_t = out_t * m
+            states = blend(new_states, states, m)
+        else:
+            states = new_states
+        outs[t] = out_t
+    out = concat([_n.unsqueeze(o, [1]) for o in outs], axis=1)
+    if time_major:
+        out = _n.transpose(out, [1, 0, 2])
+    return out, states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kw):
+    from .tensor import concat
+    fw_states, bw_states = (initial_states
+                            if initial_states is not None
+                            else (None, None))
+    out_f, st_f = rnn(cell_fw, inputs, fw_states,
+                      sequence_length=sequence_length,
+                      time_major=time_major)
+    out_b, st_b = rnn(cell_bw, inputs, bw_states,
+                      sequence_length=sequence_length,
+                      time_major=time_major, is_reverse=True)
+    return concat([out_f, out_b], axis=2), (st_f, st_b)
+
+
+# --- decode framework (layers/rnn.py Decoder/dynamic_decode) ----------------
+class Decoder:
+    """Base decoder contract: initialize() -> (inputs, states, finished);
+    step() -> (outputs, states, next_inputs, finished)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kw):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class DecodeHelper:
+    """Sampling strategy plugged into BasicDecoder."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: read the next step from the provided targets."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        from . import nn as _n
+        self._inputs = (_n.transpose(inputs, [1, 0, 2])
+                        if time_major else inputs)
+        self._seq_len = sequence_length
+
+    def initialize(self):
+        from . import nn as _n
+        import numpy as _np
+        first = _n.squeeze(_n.slice(self._inputs, axes=[1], starts=[0],
+                                    ends=[1]), [1])
+        return first, _np.array(False)
+
+    def sample(self, time, outputs, states):
+        from . import tensor as _t
+        return _t.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        from . import nn as _n
+        T = self._inputs.shape[1]
+        nxt_t = min(time + 1, T - 1)
+        nxt = _n.squeeze(_n.slice(self._inputs, axes=[1], starts=[nxt_t],
+                                  ends=[nxt_t + 1]), [1])
+        finished = (time + 1) >= T
+        return finished, nxt, states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed back the embedding of the argmax token."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self._embed = embedding_fn
+        self._start = start_tokens
+        self._end = int(end_token)
+
+    def initialize(self):
+        import numpy as _np
+        return self._embed(self._start), _np.array(False)
+
+    def sample(self, time, outputs, states):
+        from . import tensor as _t
+        return _t.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        import numpy as _np
+        nxt = self._embed(sample_ids)
+        done = _np.asarray((sample_ids.numpy()
+                            if hasattr(sample_ids, "numpy")
+                            else sample_ids) == self._end).all()
+        return done, nxt, states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling instead of argmax."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self._seed = seed
+
+    def sample(self, time, outputs, states):
+        from ..layer_helper import emit_op
+        from . import nn as _n
+        probs = _n.softmax(outputs)
+        return emit_op("sampling_id", "sampling_id", {"X": [probs]},
+                       ("Out",), {"op_seed": self._seed or 0})["Out"][0]
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + optional output layer (layers/rnn.py
+    BasicDecoder); emits (cell_outputs, sample_ids) per step."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self._cell = cell
+        self._helper = helper
+        self._output_fn = output_fn
+
+    def initialize(self, inits):
+        first, finished = self._helper.initialize()
+        return first, inits, finished
+
+    def step(self, time, inputs, states, **kw):
+        out, next_states = self._cell(inputs, states)
+        if self._output_fn is not None:
+            out = self._output_fn(out)
+        sample_ids = self._helper.sample(time, out, next_states)
+        finished, nxt, next_states = self._helper.next_inputs(
+            time, out, next_states, sample_ids)
+        return (out, sample_ids), next_states, nxt, finished
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, **kw):
+    """Run a Decoder to completion (layers/rnn.py dynamic_decode):
+    eager python loop — each step's tensors are device arrays; the loop
+    ends on the decoder's finished flag or max_step_num."""
+    from . import nn as _n
+    from .tensor import concat
+    import numpy as _np
+    inputs, states, finished = decoder.initialize(inits)
+    outputs, samples = [], []
+    t = 0
+    max_steps = max_step_num or 256
+    while t < max_steps:
+        (out, sids), states, inputs, finished = decoder.step(
+            t, inputs, states)
+        outputs.append(out)
+        samples.append(sids)
+        t += 1
+        if bool(_np.asarray(finished).all()):
+            break
+    out_seq = concat([_n.unsqueeze(o, [1]) for o in outputs], axis=1)
+    sample_seq = concat([_n.unsqueeze(s, [1]) for s in samples], axis=1)
+    if output_time_major:
+        out_seq = _n.transpose(out_seq, [1, 0, 2])
+    return (out_seq, sample_seq), states, t
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decode (layers/rnn.py BeamSearchDecoder): implements
+    the full Decoder contract so `dynamic_decode(BeamSearchDecoder(...))`
+    — the reference's primary pattern — runs; `decode()` remains as the
+    convenience loop returning stacked token ids."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self._cell = cell
+        self._start, self._end = start_token, int(end_token)
+        self._beam = beam_size
+        self._embed = embedding_fn
+        self._output_fn = output_fn
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+    def _tile(self, x):
+        from . import nn as _n
+        e = _n.unsqueeze(x, [1])
+        e = _n.expand(e, expand_times=[1, self._beam]
+                      + [1] * (len(x.shape) - 1))
+        return _n.reshape(e, [-1] + list(x.shape[1:]))
+
+    def initialize(self, inits):
+        import numpy as _np
+        from ...dygraph.base import to_variable
+        states = ([self._tile(s) for s in inits]
+                  if isinstance(inits, (list, tuple))
+                  else self._tile(inits))
+        b = (inits[0] if isinstance(inits, (list, tuple))
+             else inits).shape[0]
+        tokens = to_variable(_np.full((b * self._beam,), self._start,
+                                      "int64"))
+        scores = to_variable(_np.tile(
+            _np.array([0.] + [-1e9] * (self._beam - 1), "float32"),
+            b).reshape(-1))
+        return tokens, (states, scores, b), _np.array(False)
+
+    def step(self, time, inputs, states, **kw):
+        import numpy as _np
+        from . import nn as _n
+        from ...dygraph.base import to_variable
+        cell_states, scores, b = states
+        emb = self._embed(inputs) if self._embed is not None else inputs
+        out, cell_states = self._cell(emb, cell_states)
+        if self._output_fn is not None:
+            out = self._output_fn(out)
+        logp = _n.log(_n.softmax(out))
+        v = logp.shape[-1]
+        total = _n.reshape(scores, [-1, 1]) + logp
+        total = _n.reshape(total, [b, self._beam * v])
+        top_v, top_i = _n.topk(total, self._beam)
+        parent = _np.asarray(top_i.numpy()) // v
+        tok = _np.asarray(top_i.numpy()) % v
+        scores = _n.reshape(top_v, [-1])
+        flat_parent = (parent + _np.arange(b)[:, None]
+                       * self._beam).reshape(-1)
+        idx = to_variable(flat_parent.astype("int64"))
+        if isinstance(cell_states, (list, tuple)):
+            cell_states = [_n.gather(s, idx) for s in cell_states]
+        else:
+            cell_states = _n.gather(cell_states, idx)
+        tokens = to_variable(tok.reshape(-1).astype("int64"))
+        finished = _np.asarray((tok == self._end).all())
+        sample_ids = to_variable(tok.astype("int64"))     # [B, beam]
+        return (sample_ids, sample_ids), (cell_states, scores, b), \
+            tokens, finished
+
+    def decode(self, init_states, max_step_num=32):
+        """Eager beam decode loop returning [B, beam, T] token ids."""
+        from . import nn as _n
+        from .tensor import concat
+        import numpy as _np
+        import numpy as np
+
+        # tile initial states beam-wise: [B, ...] -> [B*beam, ...]
+        def tile(x):
+            e = _n.unsqueeze(x, [1])
+            e = _n.expand(e, expand_times=[1, self._beam] +
+                          [1] * (len(x.shape) - 1))
+            return _n.reshape(e, [-1] + list(x.shape[1:]))
+
+        states = [tile(s) for s in init_states] \
+            if isinstance(init_states, (list, tuple)) \
+            else tile(init_states)
+        b = (init_states[0] if isinstance(init_states, (list, tuple))
+             else init_states).shape[0]
+        tokens = _np.full((b * self._beam,), self._start, "int64")
+        from ...dygraph.base import to_variable
+        scores = to_variable(
+            _np.tile(_np.array([0.] + [-1e9] * (self._beam - 1),
+                               "float32"), b).reshape(-1))
+        all_tokens = []
+        for t in range(max_step_num):
+            cur = to_variable(tokens) if not hasattr(tokens, "numpy") \
+                else tokens
+            emb = self._embed(cur) if self._embed is not None else cur
+            out, states = self._cell(emb, states)
+            if self._output_fn is not None:
+                out = self._output_fn(out)
+            logp = _n.log(_n.softmax(out))          # [B*beam, V]
+            v = logp.shape[-1]
+            total = _n.reshape(scores, [-1, 1]) + logp
+            total = _n.reshape(total, [b, self._beam * v])
+            from . import tensor as _t
+            top_v, top_i = _n.topk(total, self._beam)
+            parent = _np.asarray(top_i.numpy()) // v    # [B, beam]
+            tok = _np.asarray(top_i.numpy()) % v
+            scores = _n.reshape(top_v, [-1])
+            tokens = tok.reshape(-1).astype("int64")
+            # reorder states by parent beam
+            flat_parent = (parent + _np.arange(b)[:, None]
+                           * self._beam).reshape(-1)
+            idx = to_variable(flat_parent.astype("int64"))
+            if isinstance(states, (list, tuple)):
+                states = [_n.gather(s, idx) for s in states]
+            else:
+                states = _n.gather(states, idx)
+            all_tokens.append(tok.copy())
+            if (tok == self._end).all():
+                break
+        return _np.stack(all_tokens, axis=-1)       # [B, beam, T]

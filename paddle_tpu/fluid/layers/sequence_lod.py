@@ -199,3 +199,71 @@ def sequence_pad(x, pad_value, maxlen=None):
 
 def sequence_unpad(x, length):
     return x
+
+
+# --- sequence __all__ parity tail (reference layers/sequence_lod.py) --------
+def sequence_concat(input, name=None):
+    """Concat along TIME (LoD cat -> padded: concat on axis 1 requires
+    equal batch; ragged tails ride the Length convention)."""
+    helper = LayerHelper("sequence_concat")
+    out = helper.create_variable_for_type_inference(
+        dtype=input[0].dtype)
+    op = helper.append_op("sequence_concat", inputs={"X": list(input)},
+                          outputs={"Out": [out]}, attrs={})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("sequence_slice",
+                          inputs={"X": [input], "Offset": [offset],
+                                  "Length": [length]},
+                          outputs={"Out": [out]}, attrs={})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("sequence_expand_as",
+                          inputs={"X": [x], "Y": [y]},
+                          outputs={"Out": [out]}, attrs={})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("sequence_reshape", inputs={"X": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"new_dim": new_dim})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("sequence_scatter",
+                          inputs={"X": [input], "Ids": [index],
+                                  "Updates": [updates]},
+                          outputs={"Out": [out]}, attrs={})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("sequence_enumerate", inputs={"X": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"win_size": win_size,
+                                 "pad_value": pad_value})
+    return op["Out"][0] if in_dygraph_mode() else out

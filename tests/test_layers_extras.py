@@ -1,0 +1,198 @@
+"""fluid.layers parity tail (fluid/layers/extras.py): the reference
+__all__ entries whose lowerings existed but whose python builders
+didn't.  Shape/value smoke per builder; op math is pinned by the grad
+sweep and check_output tiers."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.dygraph.base import to_variable
+
+
+@pytest.fixture(autouse=True)
+def dygraph():
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+R = np.random.RandomState(0)
+
+
+def t(a):
+    return to_variable(np.asarray(a, "float32"))
+
+
+def ti(a):
+    return to_variable(np.asarray(a, "int64"))
+
+
+class TestConvPool3D:
+    def test_conv3d_pool3d_adaptive(self):
+        x5 = t(R.randn(1, 2, 4, 6, 6))
+        assert L.conv3d(x5, 3, 2).shape == (1, 3, 3, 5, 5)
+        assert L.pool3d(x5, 2, "avg", 2).shape == (1, 2, 2, 3, 3)
+        assert L.adaptive_pool3d(x5, 2, "avg").shape == (1, 2, 2, 2, 2)
+        assert L.pool3d(x5, 2, "max", global_pooling=True).shape \
+            == (1, 2, 1, 1, 1)
+
+
+class TestSpatial:
+    def test_vision_builders(self):
+        x4 = t(R.randn(2, 4, 8, 8))
+        assert L.maxout(x4, 2).shape == (2, 2, 8, 8)
+        assert L.lrn(x4).shape == x4.shape
+        assert L.pixel_shuffle(x4, 2).shape == (2, 1, 16, 16)
+        assert L.space_to_depth(x4, 2).shape == (2, 16, 4, 4)
+        assert L.shuffle_channel(x4, 2).shape == x4.shape
+        assert L.temporal_shift(x4, 2).shape == x4.shape
+        assert L.image_resize(x4, (16, 16)).shape == (2, 4, 16, 16)
+        assert L.resize_nearest(x4, (4, 4)).shape == (2, 4, 4, 4)
+        g = L.affine_grid(t(R.randn(2, 2, 3)), [2, 4, 8, 8])
+        assert g.shape == (2, 8, 8, 2)
+        assert L.grid_sampler(x4, g).shape == (2, 4, 8, 8)
+        assert L.affine_channel(x4, t(np.ones(4)),
+                                t(np.zeros(4))).shape == x4.shape
+        assert L.psroi_pool(t(R.randn(1, 8, 8, 8)),
+                            t([[0.5, 0.5, 6.5, 6.5]]), 2, 1.0, 2, 2,
+                            rois_num=ti([1])).shape[1:] == (2, 2, 2)
+
+
+class TestManipulationTail:
+    def test_shape_introspection(self):
+        x4 = t(R.randn(2, 4, 8, 8))
+        assert tuple(np.asarray(L.shape(x4).numpy())) == (2, 4, 8, 8)
+        assert int(L.rank(x4).numpy()) == 4
+        assert int(L.size(x4).numpy()) == 512
+
+    def test_scatter_slice_unbind(self):
+        assert L.strided_slice(t(R.randn(2, 4, 8, 8)), [2], [0], [8],
+                               [2]).shape == (2, 4, 4, 8)
+        outs = L.unbind(t(R.randn(3, 4)), axis=0)
+        assert len(outs) == 3 and outs[0].shape == (4,)
+        assert L.scatter_nd_add(t(R.randn(5, 3)),
+                                ti([[1], [2]]),
+                                t(R.randn(2, 3))).shape == (5, 3)
+        assert L.scatter_nd(ti([[1], [2]]), t(R.randn(2, 3)),
+                            [5, 3]).shape == (5, 3)
+        x = t(R.randn(2, 3))
+        assert L.multiplex([x, x], ti(np.zeros((2, 1)))).shape == (2, 3)
+        assert L.reverse(x, 1).shape == (2, 3)
+        u, idx = L.unique(ti([1, 1, 2]))
+        assert len(np.asarray(idx.numpy())) == 3
+
+    def test_math_tail(self):
+        x = t(R.randn(2, 3))
+        np.testing.assert_allclose(L.pow(x, 2.0).numpy(),
+                                   x.numpy() ** 2, rtol=1e-5)
+        np.testing.assert_allclose(L.sum([x, x]).numpy(), 2 * x.numpy(),
+                                   rtol=1e-6)
+        assert L.soft_relu(x).shape == (2, 3)
+        assert L.prelu(x, "all").shape == (2, 3)
+        assert bool(L.has_nan(t([1.0, float("nan")])).numpy())
+        assert not bool(L.has_inf(t([1.0, 2.0])).numpy())
+
+    def test_random_and_ids(self):
+        assert L.uniform_random_batch_size_like(
+            t(R.randn(3, 2)), [0, 5]).shape == (3, 5)
+        assert L.gaussian_random_batch_size_like(
+            t(R.randn(3, 2)), [0, 5]).shape == (3, 5)
+        assert np.asarray(L.sampling_id(
+            t(np.abs(R.rand(3, 4)))).numpy()).shape[0] == 3
+        assert L.hash(ti(R.randint(0, 100, (3, 2))), 50).shape[0] == 3
+        assert L.shard_index(ti(R.randint(0, 20, (3, 1))), 20, 2,
+                             0).shape == (3, 1)
+        assert L.random_crop(t(R.randn(2, 4, 8, 8)),
+                             [2, 4, 4, 4]).shape[2:] == (4, 4)
+
+
+class TestLossTail:
+    def test_ranking_and_distill(self):
+        lbl = t(np.ones((3, 1)))
+        a, b = t(R.randn(3, 1)), t(R.randn(3, 1))
+        assert L.rank_loss(lbl, a, b).shape[0] == 3
+        assert L.margin_rank_loss(lbl, a, b).shape[0] == 3
+        assert L.teacher_student_sigmoid_loss(
+            t(R.randn(3, 1)), t(R.rand(3, 1))).shape[0] == 3
+        assert L.bpr_loss(t(np.abs(R.rand(3, 4)) + 0.1),
+                          ti(R.randint(0, 4, (3, 1)))).shape[0] == 3
+        assert L.center_loss(t(R.randn(3, 4)),
+                             ti(R.randint(0, 5, (3, 1))), 5,
+                             0.1).shape[0] == 3
+        assert L.dice_loss(
+            t(np.abs(R.rand(2, 4))),
+            to_variable((R.rand(2, 4) > 0.5)
+                        .astype("float32"))).shape == ()
+
+    def test_sampled_families(self):
+        x = t(R.randn(3, 4))
+        lbl = ti(R.randint(0, 6, (3, 1)))
+        assert np.isfinite(float(L.nce(x, lbl, 6).numpy().sum()))
+        assert L.hsigmoid(x, lbl, 6).shape[0] == 3
+        assert L.sampled_softmax_with_cross_entropy(
+            t(R.randn(3, 6)), lbl, 4).shape[0] == 3
+
+    def test_ctc_and_edit(self):
+        w = L.warpctc(t(R.randn(2, 4, 5)), ti(R.randint(1, 4, (2, 2))),
+                      input_length=ti([4, 4]), label_length=ti([2, 2]))
+        assert w.shape[0] == 2 and np.isfinite(w.numpy()).all()
+        d, n = L.edit_distance(ti(R.randint(1, 4, (2, 3))),
+                               ti(R.randint(1, 4, (2, 3))))
+        assert d.shape[0] == 2
+        dec = L.ctc_greedy_decoder(t(R.randn(2, 5, 4)), blank=0)
+        assert np.asarray(dec.numpy()).shape[0] == 2
+
+
+class TestCrfAndDecode:
+    def test_crf_train_decode(self):
+        emis = t(R.rand(2, 4, 3))
+        ll = L.linear_chain_crf(emis, ti(R.randint(0, 3, (2, 4))),
+                                length=ti([4, 3]))
+        assert ll.shape[0] == 2 and np.isfinite(ll.numpy()).all()
+        path = L.crf_decoding(emis, length=ti([4, 3]))
+        assert path.shape == (2, 4)
+        pr = L.chunk_eval(ti(R.randint(0, 5, (2, 4))),
+                          ti(R.randint(0, 5, (2, 4))), "IOB", 2)
+        assert len(pr) == 6
+
+    def test_gather_tree(self):
+        ids = ti(R.randint(0, 5, (3, 2, 2)))
+        assert L.gather_tree(ids, ti(np.zeros((3, 2, 2)))).shape \
+            == (3, 2, 2)
+
+
+class TestSeqAndMisc:
+    def test_sequence_misc(self):
+        assert L.im2sequence(t(R.randn(1, 2, 4, 4)), 2, 2).shape[-1] == 8
+        assert L.row_conv(t(R.randn(2, 5, 3)), 2).shape == (2, 5, 3)
+        assert L.spectral_norm(t(R.randn(3, 4))).shape == (3, 4)
+        assert L.inplace_abn(t(R.randn(2, 3, 4, 4))).shape \
+            == (2, 3, 4, 4)
+        assert L.add_position_encoding(
+            t(R.randn(2, 4, 8))).shape == (2, 4, 8)
+        assert L.bilinear_tensor_product(
+            t(R.randn(2, 3)), t(R.randn(2, 4)), 5).shape == (2, 5)
+        assert L.fsp_matrix(t(R.randn(2, 3, 4, 4)),
+                            t(R.randn(2, 5, 4, 4))).shape == (2, 3, 5)
+        assert L.mean_iou(ti(R.randint(0, 3, (4, 4))),
+                          ti(R.randint(0, 3, (4, 4))), 3)[0].shape == ()
+        assert L.pad_constant_like(t(np.zeros((4, 5))),
+                                   t(R.randn(2, 3))).shape == (4, 5)
+        assert L.crop_tensor(t(R.randn(4, 4)), [2, 2],
+                             [1, 1]).shape == (2, 2)
+
+    def test_py_func(self):
+        import paddle_tpu.fluid as fluid
+        dybase.disable_dygraph()        # static-graph construct
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("pf_x", [2, 3])
+            out = main.current_block().create_var(
+                name="pf_out", shape=[2, 3], dtype="float32")
+            res = L.py_func(lambda a: a * 2.0, x, out)
+        exe = fluid.Executor()
+        exe.run(startup)
+        v, = exe.run(main, feed={"pf_x": np.ones((2, 3), "float32")},
+                     fetch_list=[res])
+        np.testing.assert_allclose(np.asarray(v), 2.0)

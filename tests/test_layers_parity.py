@@ -1,0 +1,265 @@
+"""fluid.layers FULL __all__ parity vs the reference (the sweep that
+drove fluid/layers/{extras,detection,rnn,sequence_lod,control_flow}
+additions): every public name in the reference's layer modules resolves
+here, and the non-trivial new tiers execute."""
+import ast
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.dygraph.base import to_variable
+
+REF = "/root/reference/python/paddle/fluid/layers"
+
+
+def _ref_all(mod):
+    try:
+        tree = ast.parse(open(f"{REF}/{mod}.py").read())
+    except OSError:
+        return []
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__":
+                    return [getattr(e, "value", None)
+                            for e in node.value.elts]
+    return []
+
+
+@pytest.mark.parametrize("mod", ["nn", "tensor", "control_flow",
+                                 "sequence_lod", "loss", "detection",
+                                 "rnn", "metric_op", "io",
+                                 "distributions"])
+def test_reference_all_resolves(mod):
+    """Line-by-line API closure: every reference __all__ name exists."""
+    missing = [n for n in _ref_all(mod) if n
+               and not hasattr(L, n)
+               and not hasattr(getattr(L, mod, object), n)]
+    assert not missing, f"{mod}: {missing}"
+
+
+@pytest.fixture
+def dygraph():
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+def t(a):
+    return to_variable(np.asarray(a, "float32"))
+
+
+def ti(a):
+    return to_variable(np.asarray(a, "int64"))
+
+
+R = np.random.RandomState(0)
+
+
+class TestRnnTier:
+    def test_dynamic_rnn_builders(self, dygraph):
+        h, c = L.dynamic_lstm(t(R.randn(2, 5, 16)), 16)
+        assert h.shape == (2, 5, 4)
+        assert L.dynamic_gru(t(R.randn(2, 5, 12)), 4).shape == (2, 5, 4)
+        pj, _ = L.dynamic_lstmp(t(R.randn(2, 5, 16)), 16, 3)
+        assert pj.shape == (2, 5, 3)
+        out, lh, lc = L.lstm(t(R.randn(5, 2, 8)),
+                             t(np.zeros((1, 2, 4))),
+                             t(np.zeros((1, 2, 4))), 5, 4, 1)
+        assert out.shape[0] == 5
+
+    def test_cells_and_runners(self, dygraph):
+        out, st = L.rnn(L.LSTMCell(6), t(R.randn(2, 4, 3)))
+        assert out.shape == (2, 4, 6)
+        bo, _ = L.birnn(L.GRUCell(5), L.GRUCell(5), t(R.randn(2, 4, 3)))
+        assert bo.shape == (2, 4, 10)
+
+    def test_dynamic_decode_and_beam(self, dygraph):
+        import paddle_tpu.fluid.layers.nn as NN
+        emb_w = t(R.randn(7, 6))
+        proj_w = t(R.randn(6, 7))
+
+        def embed(ids):
+            return NN.gather(emb_w, ids)
+
+        cell = L.GRUCell(6)
+        helper = L.GreedyEmbeddingHelper(
+            embed, to_variable(np.zeros(2, "int64")), end_token=1)
+        dec = L.BasicDecoder(cell, helper,
+                             output_fn=lambda o: NN.matmul(o, proj_w))
+        batch_ref = t(np.zeros((2, 1)))
+        (outs, sids), st, steps = L.dynamic_decode(
+            dec, cell.get_initial_states(batch_ref, shape=[6]),
+            max_step_num=5)
+        assert outs.shape == (2, steps, 7)
+        assert sids.shape == (2, steps)
+        bs = L.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                 beam_size=3, embedding_fn=embed,
+                                 output_fn=lambda o: NN.matmul(o, proj_w))
+        toks = bs.decode(to_variable(np.zeros((2, 6), "float32")),
+                         max_step_num=4)
+        assert toks.shape[:2] == (2, 3)
+
+
+class TestControlFlowSugar:
+    def test_case_switch_static(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("cfx", [1])
+            two = L.fill_constant([1], "float32", 2.0)
+            out = L.case([(L.less_than(x, two), lambda: x * 10.0)],
+                         default=lambda: x - 1.0)
+            idx = fluid.data("cfi", [1], dtype="int64")
+            sw = L.switch_case(idx, {0: lambda: x + 100.0,
+                                     2: lambda: x + 200.0},
+                               default=lambda: x * 0.0)
+            emp = L.is_empty(x)
+        exe = fluid.Executor()
+        exe.run(startup)
+        o, s, e = exe.run(main, feed={"cfx": np.array([1.5], "float32"),
+                                      "cfi": np.array([2], "int64")},
+                          fetch_list=[out, sw, emp])
+        assert float(np.asarray(o)[0]) == 15.0
+        assert float(np.asarray(s)[0]) == 201.5
+        o2, = exe.run(main, feed={"cfx": np.array([3.0], "float32"),
+                                  "cfi": np.array([9], "int64")},
+                      fetch_list=[out])
+        assert float(np.asarray(o2)[0]) == 2.0
+
+    def test_print_assert_identity(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("px", [2])
+            out = L.Print(x, message="dbg")
+        exe = fluid.Executor()
+        exe.run(startup)
+        v, = exe.run(main, feed={"px": np.array([1., 2.], "float32")},
+                     fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(v), [1., 2.])
+
+
+class TestSequenceTail:
+    def test_sequence_builders(self, dygraph):
+        x = t(R.randn(2, 4, 3))
+        assert L.sequence_first_step(x).shape == (2, 3)
+        assert L.sequence_last_step(
+            x, length=ti([3, 4])).shape == (2, 3)
+        assert L.sequence_reshape(t(R.randn(4, 6)),
+                                  new_dim=3).shape[-1] == 3
+        e = L.sequence_enumerate(ti(R.randint(0, 9, (2, 4))), 2)
+        assert np.asarray(e.numpy()).shape[-1] == 2
+
+
+class TestDetectionTier:
+    def test_match_assign_pipeline(self, dygraph):
+        gt = t([[0., 0., .5, .5], [.2, .2, .9, .9]])
+        pri = t(R.rand(6, 4))
+        m, d = L.bipartite_match(L.iou_similarity(gt, pri))
+        tgt, w = L.target_assign(gt, m)
+        assert tgt.shape[-1] == 4
+        ssd = L.ssd_loss(t(R.randn(6, 4) * .1), t(R.randn(6, 3)), gt,
+                         ti([[1], [2]]), pri)
+        assert np.isfinite(np.asarray(ssd.numpy())).all()
+
+    def test_heads_and_nms(self, dygraph):
+        fm = t(R.randn(1, 8, 4, 4))
+        img = t(R.randn(1, 3, 32, 32))
+        a, v = L.anchor_generator(fm, [32., 64.], [0.5, 1.0],
+                                  stride=[8., 8.])
+        assert a.shape[:2] == (4, 4)
+        locs, confs, boxes, vars_ = L.multi_box_head(
+            [fm, t(R.randn(1, 8, 2, 2))], img, 32, 3,
+            [[1.0], [1.0, 2.0]])
+        assert locs.shape[-1] == 4 and confs.shape[-1] == 3
+        out = L.matrix_nms(t(R.rand(1, 6, 4)),
+                           t(np.abs(R.rand(1, 2, 6))), 0.0, 0.0, 4, 4)
+        assert len(out) == 2
+
+    def test_yolo_and_fpn(self, dygraph):
+        loss = L.yolov3_loss(
+            t(R.randn(1, 12, 4, 4)), t(np.clip(R.rand(1, 2, 4), .1, .9)),
+            ti(R.randint(0, 1, (1, 2))), [10, 14, 23, 27], [0, 1], 1,
+            0.7, 8)
+        assert np.isfinite(float(np.asarray(loss.numpy()).sum()))
+        fpn = L.distribute_fpn_proposals(t(R.rand(8, 4) * 16), 2, 4, 3,
+                                         16)
+        assert len(fpn[0]) == 3
+
+
+class TestReviewRegressions:
+    """Pinned behaviors from the parity-tail review pass."""
+
+    def test_create_parameter_and_affine_defaults(self, dygraph):
+        p = L.create_parameter([3, 4], "float32")
+        assert p.shape == (3, 4)
+        x = t(R.randn(2, 4, 8, 8))
+        np.testing.assert_allclose(L.affine_channel(x).numpy(),
+                                   x.numpy(), rtol=1e-6)
+
+    def test_retinanet_six_outputs(self, dygraph):
+        gt = t([[0., 0., .5, .5], [.2, .2, .9, .9]])
+        outs = L.retinanet_target_assign(None, None, t(R.rand(6, 4)),
+                                         None, gt, None)
+        assert len(outs) == 6
+        assert int(np.asarray(outs[-1].numpy())) >= 1   # fg_num
+
+    def test_rnn_sequence_length_masks(self, dygraph):
+        cell = L.GRUCell(4)
+        x = t(R.randn(2, 5, 3))
+        out, st = L.rnn(cell, x, sequence_length=[2, 5])
+        assert np.allclose(out.numpy()[0, 2:], 0)
+        assert not np.allclose(out.numpy()[1, 2:], 0)
+        out_r, _ = L.rnn(cell, x, sequence_length=[2, 5],
+                         is_reverse=True)
+        assert np.allclose(out_r.numpy()[0, 2:], 0)
+
+    def test_beam_decoder_decoder_contract(self, dygraph):
+        import paddle_tpu.fluid.layers.nn as NN
+        emb_w, proj_w = t(R.randn(7, 6)), t(R.randn(6, 7))
+        bsd = L.BeamSearchDecoder(
+            L.GRUCell(6), start_token=0, end_token=1, beam_size=3,
+            embedding_fn=lambda ids: NN.gather(emb_w, ids),
+            output_fn=lambda o: NN.matmul(o, proj_w))
+        (outs, sids), st, steps = L.dynamic_decode(
+            bsd, t(np.zeros((2, 6))), max_step_num=4)
+        assert np.asarray(sids.numpy()).shape[0] == 2
+
+    def test_tensor_array_index_sizes(self, dygraph):
+        arr = L.create_array("float32")
+        arr._array_items = [t(R.randn(2, 2)), t(R.randn(2, 3))]
+        out, idx = L.tensor_array_to_tensor(arr, axis=1)
+        np.testing.assert_array_equal(np.asarray(idx.numpy()), [2, 3])
+
+    def test_py_reader_unique_names_and_no_np_leak(self):
+        r1 = L.py_reader(4, [[2, 3]], ["float32"])
+        r2 = L.py_reader(4, [[2, 3]], ["float32"])
+        assert r1._feed_vars[0].name != r2._feed_vars[0].name
+        import types
+        assert not isinstance(getattr(L, "np", None), types.ModuleType)
+
+
+class TestNnIoTail:
+    def test_conv3d_transpose(self, dygraph):
+        x = t(R.randn(1, 2, 3, 4, 4))
+        out = L.conv3d_transpose(x, 3, filter_size=2)
+        assert out.shape == (1, 3, 4, 5, 5)
+
+    def test_deformable_conv(self, dygraph):
+        x = t(R.randn(1, 2, 5, 5))
+        off = t(R.randn(1, 2 * 2 * 2, 4, 4) * 0.1)
+        mask = t(np.abs(R.rand(1, 2 * 2, 4, 4)))
+        out = L.deformable_conv(x, off, mask, 3, 2)
+        assert out.shape[1] == 3
+
+    def test_misc_passthroughs(self, dygraph):
+        x = t(R.randn(2, 3))
+        assert L.lod_reset(x) is x
+        assert L.merge_selected_rows(x) is x
+        assert L.double_buffer("reader") == "reader"
+        r = L.image_resize_short(t(R.randn(1, 2, 8, 16)), 4)
+        assert min(r.shape[2:]) == 4
+        l1 = L.resize_linear(t(R.randn(1, 2, 8)), out_shape=[16])
+        assert l1.shape == (1, 2, 16)
