@@ -49,6 +49,13 @@ class VarBase:
     def numpy(self):
         return np.asarray(self._value)
 
+    def __array__(self, dtype=None, copy=None):
+        # numpy protocol: one D2H transfer.  Without this np.asarray
+        # falls back to the SEQUENCE protocol — one __getitem__ gather
+        # dispatch per element, pathological on device arrays
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
     def value(self):
         return self._value
 

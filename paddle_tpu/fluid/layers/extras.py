@@ -676,18 +676,30 @@ from ...ops.registry import register_op as _register_op
 @_register_op("conv3d_transpose")
 def _conv3d_transpose_lowering(ins, attrs, ctx):
     """conv3d_transpose_op.cc via lax.conv_transpose (NCDHW).  Paddle's
-    deconv output is (D-1)*s + K - 2p; lax applies `padding` directly to
-    the dilated-input conv, so each dim pads (K-1-p) on both sides."""
+    deconv output is (D-1)*s + K_eff - 2p + output_padding; lax applies
+    `padding` directly to the dilated-input conv, so each dim pads
+    (K_eff-1-p) low and (K_eff-1-p+opad) high.  Groups split channels
+    (lax.conv_transpose has no feature_group_count)."""
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = tuple(attrs.get("strides", [1, 1, 1]))
     pads = attrs.get("paddings", [0, 0, 0])
-    ks = w.shape[2:]
-    padding = [(k - 1 - p, k - 1 - p) for k, p in zip(ks, pads)]
-    # paddle filter layout [C_in, C_out/g, D, H, W]; lax wants IODHW spec
-    out = _jax.lax.conv_transpose(
-        x, w, strides, padding,
-        dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
-    return {"Output": [out]}
+    dil = attrs.get("dilations", [1, 1, 1])
+    opad = attrs.get("output_padding") or [0, 0, 0]
+    groups = attrs.get("groups", 1)
+    ks = [(k - 1) * d + 1 for k, d in zip(w.shape[2:], dil)]
+    padding = [(k - 1 - p, k - 1 - p + o)
+               for k, p, o in zip(ks, pads, opad)]
+
+    def one(xg, wg):
+        return _jax.lax.conv_transpose(
+            xg, wg, strides, padding, rhs_dilation=tuple(dil),
+            dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+    if groups == 1:
+        return {"Output": [one(x, w)]}
+    cg = x.shape[1] // groups
+    outs = [one(x[:, g * cg:(g + 1) * cg], w[g * cg:(g + 1) * cg])
+            for g in range(groups)]
+    return {"Output": [_jnp.concatenate(outs, axis=1)]}
 
 
 def conv3d_transpose(input, num_filters, output_size=None,

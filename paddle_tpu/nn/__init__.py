@@ -19,7 +19,17 @@ from .layer import (ReLU, GELU, Sigmoid, Tanh, Softmax, LeakyReLU, SiLU,
                     TransformerEncoderLayer, TransformerEncoder,
                     TransformerDecoderLayer, TransformerDecoder, Transformer,
                     LSTM, GRU, SimpleRNN, RNN, BiRNN, SimpleRNNCell,
-                    LSTMCell, GRUCell, Pad2D, Upsample, Flatten)
+                    LSTMCell, GRUCell, Pad2D, Upsample, Flatten,
+                    LogSoftmax, ThresholdedReLU, Maxout, AlphaDropout,
+                    Dropout3D, AdaptiveAvgPool1D, AdaptiveMaxPool1D,
+                    AdaptiveMaxPool2D, AdaptiveAvgPool3D,
+                    AdaptiveMaxPool3D, Conv1DTranspose, Conv3DTranspose,
+                    Bilinear, BilinearTensorProduct, HSigmoidLoss,
+                    InstanceNorm1D, InstanceNorm3D, LocalResponseNorm,
+                    PixelShuffle, Pad1D, Pad3D, RowConv, SpectralNorm,
+                    SyncBatchNorm, UpsamplingBilinear2D,
+                    UpsamplingNearest2D, BatchNorm1D, BatchNorm3D,
+                    RNNCellBase)
 # 2.0 gradient-clip classes (reference python/paddle/nn/clip.py aliases
 # the fluid implementations under ClipGradBy* names; optimizers take them
 # via grad_clip=)

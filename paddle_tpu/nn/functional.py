@@ -389,3 +389,198 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
 
 upsample = interpolate
+
+
+# -- 2.0 parity tail (reference python/paddle/nn/functional/*) ---------------
+def _adaptive_1d(x, output_size, mode):
+    x4 = L.unsqueeze(x, [2])
+    out = L.adaptive_pool2d(x4, [1, int(output_size)], mode)
+    return L.squeeze(out, [2])
+
+
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_1d(x, output_size, "avg")
+
+
+def adaptive_max_pool1d(x, output_size):
+    return _adaptive_1d(x, output_size, "max")
+
+
+def adaptive_avg_pool3d(x, output_size):
+    from ..fluid.layers.extras import adaptive_pool3d
+    return adaptive_pool3d(x, output_size, "avg")
+
+
+def adaptive_max_pool3d(x, output_size):
+    from ..fluid.layers.extras import adaptive_pool3d
+    return adaptive_pool3d(x, output_size, "max")
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (reference functional/common.py
+    alpha_dropout): dropped units take alpha' and the output is affine-
+    rescaled so mean/variance are preserved under SELU statistics."""
+    import math
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    if p >= 1.0:                      # everything dropped: constant out
+        return L.zeros(list(x.shape), "float32") + 0.0 * x
+    a = ((1 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    ones = L.ones(list(x.shape), "float32")
+    keep = L.dropout(ones, p, is_test=False,
+                     dropout_implementation="upscale_in_train") * (1 - p)
+    return a * (x * keep + alpha_p * (1.0 - keep)) + b
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    if not training or p <= 0.0:
+        return x
+    n = x.shape[0]
+    c = x.shape[1] if data_format == "NCDHW" else x.shape[-1]
+    shape = ([n, c, 1, 1, 1] if data_format == "NCDHW"
+             else [n, 1, 1, 1, c])
+    ones = L.ones(shape, x.dtype)
+    mask = L.dropout(ones, p, is_test=False,
+                     dropout_implementation="upscale_in_train")
+    return x * mask
+
+
+def assign(x, output=None):
+    return L.assign(x)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    ins = {"X": [x1], "Y": [x2], "Weight": [weight]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return emit_op("bilinear", "bilinear_tensor_product", ins,
+                   ("Out",), {})["Out"][0]
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    x4 = L.unsqueeze(x, [2])
+    w4 = L.unsqueeze(weight, [2])
+    s, p, d = _tolist(stride, 1), _tolist(padding, 1), _tolist(dilation, 1)
+    op_ = _tolist(output_padding, 1)
+    out = emit_op("conv2d_transpose", "conv2d_transpose",
+                  {"Input": [x4], "Filter": [w4]}, ("Output",),
+                  {"strides": [1] + s, "paddings": [0] + p,
+                   "dilations": [1] + d, "groups": groups,
+                   "output_padding": [0] + op_})["Output"][0]
+    out = L.squeeze(out, [2])
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=1)
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    out = emit_op("conv3d_transpose", "conv3d_transpose",
+                  {"Input": [x], "Filter": [weight]}, ("Output",),
+                  {"strides": _tolist(stride, 3),
+                   "paddings": _tolist(padding, 3),
+                   "dilations": _tolist(dilation, 3),
+                   "output_padding": _tolist(output_padding, 3),
+                   "groups": groups})["Output"][0]
+    if bias is not None:
+        out = L.elementwise_add(out, bias, axis=1)
+    return out
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return emit_op("diag_embed", "diag_embed", {"Input": [input]},
+                   ("Out",), {"offset": offset, "dim1": dim1,
+                              "dim2": dim2})["Out"][0]
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, eps=1e-5, momentum=0.9, use_input_stats=True,
+                  data_format="NCHW"):
+    ins = {"X": [x]}
+    if weight is not None:
+        ins["Scale"] = [weight]
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return emit_op("instance_norm", "instance_norm", ins, ("Y",),
+                   {"epsilon": eps})["Y"][0]
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    from ..fluid.layers.extras import lrn
+    return lrn(x, n=size, k=k, alpha=alpha, beta=beta)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, **kw):
+    ins = {"X": [input], "W": [weight], "Label": [label]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return emit_op("hsigmoid_loss", "hierarchical_sigmoid", ins,
+                   ("Out",), {"num_classes": num_classes})["Out"][0]
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    from ..fluid.layers.extras import dice_loss as _dl
+    return _dl(input, label, epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return emit_op("log_loss", "log_loss",
+                   {"Predicted": [input], "Labels": [label]}, ("Loss",),
+                   {"epsilon": epsilon})["Loss"][0]
+
+
+def maxout(x, groups, axis=1):
+    from ..fluid.layers.extras import maxout as _m
+    return _m(x, groups, axis=axis)
+
+
+def row_conv(x, weight, act=None):
+    out = emit_op("row_conv", "row_conv",
+                  {"X": [x], "Filter": [weight]}, ("Out",), {})["Out"][0]
+    return getattr(L.nn, act)(out) if act else out
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    """2.0 signature (reference functional/loss.py sigmoid_focal_loss):
+    one-hot float labels, optional normalizer, reduction."""
+    p = L.sigmoid(logit)
+    ce = L.sigmoid_cross_entropy_with_logits(logit, label)
+    p_t = p * label + (1.0 - p) * (1.0 - label)
+    a_t = alpha * label + (1.0 - alpha) * (1.0 - label)
+    loss = a_t * L.elementwise_pow(
+        1.0 - p_t, L.fill_constant([1], "float32", gamma)) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference functional/loss.py npair_loss): cross-
+    entropy over anchor·positiveᵀ similarities + L2 on the embeddings."""
+    l2 = l2_reg * (L.reduce_sum(L.square(anchor))
+                   + L.reduce_sum(L.square(positive))) * 0.25
+    sim = L.matmul(anchor, positive, transpose_y=True)
+    n = sim.shape[0]
+    lbl = L.reshape(labels, [-1, 1])
+    same = L.cast(L.equal(lbl, L.reshape(labels, [1, -1])), "float32")
+    tgt = same / L.reduce_sum(same, dim=1, keep_dim=True)
+    ce = cross_entropy(sim, tgt, soft_label=True)
+    return L.reduce_mean(ce) + l2
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    from ..fluid.layers.loss import softmax_with_cross_entropy as _swce
+    # full delegation: the fluid builder already honors ignore_index,
+    # axis, and emits the softmax from the SAME op (no recompute)
+    return _swce(logits, label, soft_label=soft_label,
+                 ignore_index=ignore_index, axis=axis,
+                 return_softmax=return_softmax)

@@ -933,3 +933,349 @@ class BiRNN(Layer):
         out_b, st_b = self.rnn_bw(inputs, bw_states)
         # both runners restore batch-first layout: features are axis 2
         return L.concat([out_f, out_b], axis=2), (st_f, st_b)
+
+
+# --- 2.0 class parity tail (reference python/paddle/nn/layer/*) -------------
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return L.log_softmax(x, axis=self._axis)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0):
+        super().__init__()
+        self._t = threshold
+
+    def forward(self, x):
+        return L.nn.thresholded_relu(x, threshold=self._t)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1):
+        super().__init__()
+        self._groups, self._axis = groups, axis
+
+    def forward(self, x):
+        from . import functional as F
+        return F.maxout(x, self._groups, self._axis)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        from . import functional as F
+        return F.alpha_dropout(x, self._p, training=self.training)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW"):
+        super().__init__()
+        self._p, self._fmt = p, data_format
+
+    def forward(self, x):
+        from . import functional as F
+        return F.dropout3d(x, self._p, training=self.training,
+                           data_format=self._fmt)
+
+
+class _AdaptivePoolNd(Layer):
+    MODE = "avg"
+    ND = 2
+
+    def __init__(self, output_size, data_format=None, return_mask=False):
+        super().__init__()
+        self._size = output_size
+        self._return_mask = return_mask
+        if return_mask and not (self.MODE == "max" and self.ND == 2):
+            raise NotImplementedError(
+                "return_mask is supported for AdaptiveMaxPool2D only "
+                "(the unpool use case); avg/1d/3d have no mask")
+
+    def forward(self, x):
+        from . import functional as F
+        fn = {("avg", 1): F.adaptive_avg_pool1d,
+              ("max", 1): F.adaptive_max_pool1d,
+              ("avg", 2): F.adaptive_avg_pool2d,
+              ("max", 2): F.adaptive_max_pool2d,
+              ("avg", 3): F.adaptive_avg_pool3d,
+              ("max", 3): F.adaptive_max_pool3d}[(self.MODE, self.ND)]
+        out = fn(x, self._size)
+        if not self._return_mask:
+            return out
+        # flat-HW argmax indices of each bin (max_pool2d_with_index
+        # contract): recompute per-bin argmax via the reshape trick
+        from ..fluid.layer_helper import emit_op
+        oh, ow = ((self._size, self._size)
+                  if isinstance(self._size, int) else self._size)
+        mask = emit_op("max_pool2d_with_index", "max_pool2d_with_index",
+                       {"X": [x]}, ("Out", "Mask"),
+                       {"ksize": [x.shape[2] // oh, x.shape[3] // ow],
+                        "strides": [x.shape[2] // oh, x.shape[3] // ow],
+                        "paddings": [0, 0],
+                        "adaptive": True})["Mask"][0]
+        return out, mask
+
+
+class AdaptiveAvgPool1D(_AdaptivePoolNd):
+    MODE, ND = "avg", 1
+
+
+class AdaptiveMaxPool1D(_AdaptivePoolNd):
+    MODE, ND = "max", 1
+
+
+class AdaptiveMaxPool2D(_AdaptivePoolNd):
+    MODE, ND = "max", 2
+
+
+class AdaptiveAvgPool3D(_AdaptivePoolNd):
+    MODE, ND = "avg", 3
+
+
+class AdaptiveMaxPool3D(_AdaptivePoolNd):
+    MODE, ND = "max", 3
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        helper = LayerHelper("conv1d_transpose")
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self._cfg = (stride, padding, dilation, groups)
+        self.weight = helper.create_parameter(
+            weight_attr, [in_channels, out_channels // groups, k],
+            "float32")
+        self.bias = helper.create_parameter(
+            bias_attr, [out_channels], "float32", is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from . import functional as F
+        s, p, d, g = self._cfg
+        return F.conv1d_transpose(x, self.weight, self.bias, stride=s,
+                                  padding=p, dilation=d, groups=g)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        helper = LayerHelper("conv3d_transpose")
+        ks = [kernel_size] * 3 if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        self._cfg = (stride, padding, groups)
+        self.weight = helper.create_parameter(
+            weight_attr, [in_channels, out_channels // groups] + ks,
+            "float32")
+        self.bias = helper.create_parameter(
+            bias_attr, [out_channels], "float32", is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from . import functional as F
+        s, p, g = self._cfg
+        return F.conv3d_transpose(x, self.weight, self.bias, stride=s,
+                                  padding=p, groups=g)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        helper = LayerHelper("bilinear")
+        self.weight = helper.create_parameter(
+            weight_attr, [out_features, in1_features, in2_features],
+            "float32")
+        self.bias = helper.create_parameter(
+            bias_attr, [1, out_features], "float32", is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x1, x2):
+        from . import functional as F
+        return F.bilinear(x1, x2, self.weight, self.bias)
+
+
+class BilinearTensorProduct(Bilinear):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 param_attr=None, bias_attr=None):
+        super().__init__(input1_dim, input2_dim, output_dim,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, **kw):
+        super().__init__()
+        helper = LayerHelper("hsigmoid_loss")
+        self._num_classes = num_classes
+        self.weight = helper.create_parameter(
+            weight_attr, [num_classes - 1, feature_size], "float32")
+        self.bias = helper.create_parameter(
+            bias_attr, [1, num_classes - 1], "float32", is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input, label):
+        from . import functional as F
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        helper = LayerHelper("instance_norm")
+        self._eps = epsilon
+        self.weight = helper.create_parameter(
+            weight_attr, [num_features], "float32",
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(
+            bias_attr, [num_features], "float32", is_bias=True)
+
+    def forward(self, x):
+        from . import functional as F
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self._eps)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._cfg = (size, alpha, beta, k)
+
+    def forward(self, x):
+        from . import functional as F
+        s, a, b, k = self._cfg
+        return F.local_response_norm(x, s, a, b, k)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW"):
+        super().__init__()
+        self._factor = upscale_factor
+
+    def forward(self, x):
+        from . import functional as F
+        return F.pixel_shuffle(x, self._factor)
+
+
+class Pad1D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL"):
+        super().__init__()
+        p = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._pad, self._mode, self._value = p, mode, value
+
+    def forward(self, x):
+        x4 = L.unsqueeze(x, [2])
+        out = L.pad2d(x4, paddings=[0, 0] + self._pad, mode=self._mode,
+                      pad_value=self._value)
+        return L.squeeze(out, [2])
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW"):
+        super().__init__()
+        p = [padding] * 6 if isinstance(padding, int) else list(padding)
+        self._pad, self._mode, self._value = p, mode, value
+
+    def forward(self, x):
+        from ..fluid.layer_helper import emit_op
+        return emit_op("pad3d", "pad3d", {"X": [x]}, ("Out",),
+                       {"paddings": self._pad, "mode": self._mode,
+                        "value": self._value})["Out"][0]
+
+
+class RowConv(Layer):
+    def __init__(self, num_channels, future_context_size, param_attr=None):
+        super().__init__()
+        helper = LayerHelper("row_conv")
+        self.weight = helper.create_parameter(
+            param_attr, [future_context_size + 1, num_channels],
+            "float32")
+
+    def forward(self, x):
+        from . import functional as F
+        return F.row_conv(x, self.weight)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        helper = LayerHelper("spectral_norm")
+        import numpy as _np
+        h = weight_shape[dim]
+        w = int(_np.prod(weight_shape)) // h
+        self._cfg = (dim, power_iters, eps)
+        self.weight_u = helper.create_parameter(None, [h], "float32")
+        self.weight_v = helper.create_parameter(None, [w], "float32")
+
+    def forward(self, weight):
+        from ..fluid.layer_helper import emit_op
+        dim, it, eps = self._cfg
+        return emit_op("spectral_norm", "spectral_norm",
+                       {"Weight": [weight], "U": [self.weight_u],
+                        "V": [self.weight_v]}, ("Out",),
+                       {"dim": dim, "power_iters": it,
+                        "eps": eps})["Out"][0]
+
+
+class SyncBatchNorm(BatchNorm2D):
+    """Cross-replica batch norm: statistics allreduce over the dp axis
+    inside pjit (sync_batch_norm lowering); single-process it equals
+    BatchNorm (reference nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Recursively convert BatchNorm layers, carrying params AND
+        running-stat buffers + eps/momentum (reference classmethod copies
+        all state — stats left behind would wreck eval-mode outputs)."""
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            new = cls(layer.weight.shape[0],
+                      momentum=getattr(layer, "_momentum", 0.9),
+                      epsilon=getattr(layer, "_epsilon", 1e-5))
+            new.weight, new.bias = layer.weight, layer.bias
+            new._mean, new._variance = layer._mean, layer._variance
+            return new
+        for name, sub in getattr(layer, "_sub_layers", {}).items():
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__()
+        self._size, self._scale = size, scale_factor
+
+    def forward(self, x):
+        from . import functional as F
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale, mode="bilinear",
+                             align_corners=True)
+
+
+class UpsamplingNearest2D(UpsamplingBilinear2D):
+    def forward(self, x):
+        from . import functional as F
+        return F.interpolate(x, size=self._size,
+                             scale_factor=self._scale, mode="nearest")
+
+
+# BatchNorm1D/3D aliases live at their original site (near BatchNorm2D)
+RNNCellBase = _CellBase

@@ -36,3 +36,12 @@ def fresh_programs():
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+# opt-in hang watchdog: HANG_DEBUG=1 dumps every thread's traceback and
+# exits if any single test runs >300s (how the VarBase sequence-protocol
+# hang was caught)
+import faulthandler as _fh
+import os as _os
+if _os.environ.get("HANG_DEBUG"):
+    _fh.dump_traceback_later(300, exit=True)
