@@ -425,7 +425,15 @@ register_op("one_hot_v2", lambda ins, a, c: {"Out": [
 
 @register_op("diag_v2", differentiable=False)
 def _diag_v2(ins, attrs, ctx):
-    return {"Out": [jnp.diag(_x(ins), k=attrs.get("offset", 0))]}
+    x = _x(ins)
+    k = attrs.get("offset", 0)
+    out = jnp.diag(x, k=k)
+    pad = attrs.get("padding_value", 0)
+    if x.ndim == 1 and pad:
+        # off-diagonal fill (tensor/creation.py diag padding_value)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=k)
+        out = jnp.where(mask, out, jnp.asarray(pad, out.dtype))
+    return {"Out": [out]}
 
 
 @register_op("diag_embed")

@@ -83,7 +83,14 @@ def _multinomial(ins, attrs, ctx):
     key = ctx.key_for(attrs.get("op_seed", 0))
     n = attrs.get("num_samples", 1)
     logits = jnp.log(jnp.clip(x, 1e-30))
-    out = jax.random.categorical(key, logits, axis=-1, shape=x.shape[:-1] + (n,))
+    if attrs.get("replacement", False):
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=x.shape[:-1] + (n,))
+    else:
+        # without replacement: Gumbel-top-k — argtop of logits + gumbel
+        # noise samples k distinct categories with the right law
+        g = jax.random.gumbel(key, logits.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, n)
     return {"Out": [out.astype(jnp.int64)]}
 
 

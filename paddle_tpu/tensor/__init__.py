@@ -168,3 +168,249 @@ def any(x, axis=None, keepdim=False):
 def numel(x):
     import numpy as np
     return int(np.prod(x.shape))
+
+
+# --- 2.0 tensor __all__ parity tail (reference python/paddle/tensor/*) ------
+from ..fluid.layers import (rank, shape, reverse, strided_slice, unique,  # noqa: F401
+                            multiplex, scatter_nd, scatter_nd_add,
+                            is_empty, shard_index, sum as add_n)
+from ..fluid.layers.nn import scale, stanh  # noqa: F401
+
+
+def mm(input, mat2):
+    return matmul(input, mat2)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    """paddle.mul is the MATMUL-flattening mul op (fluid mul_op), not
+    elementwise multiply — ported fluid code depends on that."""
+    from ..fluid.layers.nn import mul as _fluid_mul
+    return _fluid_mul(x, y, x_num_col_dims, y_num_col_dims)
+
+
+def t(input):
+    """Transpose a 0/1/2-D tensor (reference tensor/linalg.py t)."""
+    nd = len(input.shape)
+    if nd <= 1:
+        return input
+    if nd != 2:
+        raise ValueError("paddle.t only supports tensors up to rank 2; "
+                         "use transpose for higher ranks")
+    return transpose(input, [1, 0])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("addmm", "addmm",
+                   {"Input": [input], "X": [x], "Y": [y]}, ("Out",),
+                   {"Beta": beta, "Alpha": alpha})["Out"][0]
+
+
+def chunk(x, chunks, axis=0):
+    from ..fluid.layers.nn import split as _split
+    return _split(x, chunks, dim=axis)
+
+
+def broadcast_to(x, shape):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("expand_v2", "expand_v2", {"X": [x]}, ("Out",),
+                   {"shape": list(shape)})["Out"][0]
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def nonzero(x, as_tuple=False):
+    from ..fluid.layer_helper import emit_op
+    out = emit_op("where_index", "where_index", {"Condition": [x]},
+                  ("Out",), {})["Out"][0]
+    if not as_tuple:
+        return out
+    n = len(x.shape)
+    from ..fluid.layers.nn import split as _split
+    return tuple(_split(out, n, dim=1)) if n > 1 else (out,)
+
+
+def median(x, axis=None, keepdim=False):
+    """Median via sort (reference tensor/stat.py median: mean of the two
+    middle values for even counts)."""
+    if axis is None:
+        flat = reshape(x, [-1])
+        return median(flat, axis=0, keepdim=keepdim)
+    n = int(x.shape[axis])
+    if n < 0:
+        raise ValueError("paddle.median needs a static size along axis")
+    from ..fluid.layers.tensor import argsort as _argsort
+    srt, _ = _argsort(x, axis=axis)
+    lo, hi = (n - 1) // 2, n // 2
+    sl_lo = _slice_axis(srt, axis, lo)
+    sl_hi = _slice_axis(srt, axis, hi)
+    out = (sl_lo + sl_hi) / 2.0
+    if not keepdim:
+        out = squeeze(out, [axis])
+    return out
+
+
+def _slice_axis(x, axis, idx):
+    from ..fluid.layers.nn import slice as _sl
+    return _sl(x, axes=[axis], starts=[idx], ends=[idx + 1])
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    from ..fluid.layers.nn import sqrt as _sqrt
+    return _sqrt(var(x, axis=axis, unbiased=unbiased, keepdim=keepdim))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    from ..fluid.layers.nn import (reduce_mean as _rm,
+                                   reduce_sum as _rs, square as _sq)
+    import numpy as _np
+    dims = (list(range(len(x.shape))) if axis is None
+            else ([axis] if isinstance(axis, int) else list(axis)))
+    sizes = [x.shape[d] for d in dims]
+    # NB: this module exports a tensor `any` — use builtins explicitly
+    import builtins
+    if builtins.any(int(v) < 0 for v in sizes):
+        raise ValueError(
+            "paddle.var/std need static sizes along the reduced dims "
+            f"(got {sizes}); reshape with concrete shapes first")
+    mean = _rm(x, dim=dims, keep_dim=True)
+    sq = _sq(x - mean)
+    n = int(_np.prod(sizes))
+    s = _rs(sq, dim=dims, keep_dim=keepdim)
+    return s / (n - 1 if unbiased and n > 1 else n)
+
+
+# -- creation / random --------------------------------------------------------
+def empty(shape, dtype="float32"):
+    return fill_constant(list(shape), dtype, 0.0)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x) if dtype is None else cast(zeros_like(x), dtype)
+
+
+def diag(x, offset=0, padding_value=0):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("diag_v2", "diag_v2", {"X": [x]}, ("Out",),
+                   {"offset": offset,
+                    "padding_value": padding_value})["Out"][0]
+
+
+def _op_seed(seed=None):
+    """Static programs derive per-op seeds (two paddle.rand calls must
+    NOT share a PRNG stream — fluid/layers/nn.py:515 convention); the
+    dygraph tracer randomizes per call when the seed is 0."""
+    if seed:
+        return seed
+    if not _dy():
+        from ..fluid.framework import default_main_program
+        return default_main_program().next_op_seed()
+    return 0
+
+
+def _rand_op(op, shape, dtype, seed=None, **attrs):
+    from ..fluid.layer_helper import emit_op
+    attrs["op_seed"] = _op_seed(seed)
+    attrs["shape"] = list(shape)
+    attrs["dtype"] = dtype
+    return emit_op(op, op, {}, ("Out",), attrs)["Out"][0]
+
+
+def rand(shape, dtype="float32"):
+    return _rand_op("uniform_random", shape, dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    return _rand_op("uniform_random", shape, dtype, seed=seed,
+                    min=min, max=max)
+
+
+def randn(shape, dtype="float32"):
+    return _rand_op("gaussian_random", shape, dtype, mean=0.0, std=1.0)
+
+
+def standard_normal(shape, dtype="float32"):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    return _rand_op("gaussian_random", shape or [1], "float32",
+                    mean=float(mean), std=float(std))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return _rand_op("randint", shape, dtype, low=low, high=high)
+
+
+def randperm(n, dtype="int64"):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("randperm", "randperm", {}, ("Out",),
+                   {"n": n, "dtype": dtype,
+                    "op_seed": _op_seed()})["Out"][0]
+
+
+def bernoulli(x):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("bernoulli", "bernoulli", {"X": [x]}, ("Out",),
+                   {"op_seed": _op_seed()})["Out"][0]
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("multinomial", "multinomial", {"X": [x]}, ("Out",),
+                   {"num_samples": num_samples,
+                    "replacement": replacement,
+                    "op_seed": _op_seed()})["Out"][0]
+
+
+def histogram(input, bins=100, min=0, max=0):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("histogram", "histogram", {"X": [input]}, ("Out",),
+                   {"bins": bins, "min": min, "max": max})["Out"][0]
+
+
+def equal_all(x, y):
+    from ..fluid.layer_helper import emit_op
+    return emit_op("equal_all", "equal_all", {"X": [x], "Y": [y]},
+                   ("Out",), {})["Out"][0]
+
+
+def floor_mod(x, y):
+    from ..fluid.layers.nn import elementwise_mod
+    return elementwise_mod(x, y)
+
+
+def sort(x, axis=-1, descending=False):
+    from ..fluid.layers.tensor import argsort as _argsort
+    return _argsort(x, axis=axis, descending=descending)[0]
+
+
+def is_tensor(x):
+    from ..dygraph.base import VarBase
+    from ..fluid.framework import Variable
+    return isinstance(x, (VarBase, Variable))
+
+
+_PRINT_OPTIONS = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+                  "linewidth": 80}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     linewidth=None, sci_mode=None):
+    """Display options for tensor printing (reference tensor/to_string.py)
+    applied to the numpy views our repr paths produce; sci_mode maps to
+    numpy suppress (False suppresses scientific notation)."""
+    import numpy as _np
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("linewidth", linewidth)):
+        if v is not None:
+            _PRINT_OPTIONS[k] = v
+    kw = {k: _PRINT_OPTIONS[k] for k in _PRINT_OPTIONS}
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
