@@ -31,4 +31,11 @@ class ParamAttr:
         raise TypeError(f"cannot convert {attr!r} to ParamAttr")
 
 
-WeightNormParamAttr = ParamAttr
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalised parameter attr (reference param_attr.py): the
+    reparameterisation is applied by nn.SpectralNorm / weight-norm
+    utilities at the layer tier; the attr carries `dim` for them."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

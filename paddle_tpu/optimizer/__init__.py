@@ -9,6 +9,10 @@ import jax.numpy as jnp
 from ..fluid import optimizer as F
 from ..ops.registry import get_op, LoweringContext
 from . import lr
+from .lr import (LRScheduler, NoamDecay, ExponentialDecay,  # noqa: F401
+                 NaturalExpDecay, InverseTimeDecay, PolynomialDecay,
+                 PiecewiseDecay, CosineAnnealingDecay, LinearWarmup,
+                 StepDecay, MultiStepDecay, ReduceOnPlateau, LambdaDecay)
 
 
 class _EagerOptimizer:
@@ -243,3 +247,56 @@ class Lamb(Adam):
 
 # static-graph classes still available under this namespace
 Optimizer = _EagerOptimizer
+
+
+class Adadelta(_EagerOptimizer):
+    """optimizer.py AdadeltaOptimizer (2.0 name): per-param avg-squared
+    grad + avg-squared update accumulators via the adadelta op.  Uses
+    the shared _accs/_accum store so state_dict() checkpoints the
+    accumulators like every sibling optimizer."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _apply_one(self, p, g, lr_arr=None):
+        accs = self._accs(p, {"avg_sq": (p.shape, 0.0),
+                              "avg_upd": (p.shape, 0.0)})
+        outs = get_op("adadelta").fn(
+            {"Param": [p._value], "Grad": [g],
+             "AvgSquaredGrad": [accs["avg_sq"]],
+             "AvgSquaredUpdate": [accs["avg_upd"]]},
+            {"epsilon": self._epsilon, "rho": self._rho}, self._ctx)
+        p._value = outs["ParamOut"][0]
+        accs["avg_sq"] = outs["AvgSquaredGradOut"][0]
+        accs["avg_upd"] = outs["AvgSquaredUpdateOut"][0]
+
+
+class Adamax(_EagerOptimizer):
+    """optimizer.py AdamaxOptimizer (2.0 name): infinity-norm Adam via
+    the adamax op; the beta1-power bias correction rides a per-param
+    accumulator so minimize (= base step) and step stay consistent."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, p, g, lr_arr):
+        accs = self._accs(p, {"moment": (p.shape, 0.0),
+                              "inf_norm": (p.shape, 0.0),
+                              "b1p": ((1,), self._b1)})
+        outs = get_op("adamax").fn(
+            {"Param": [p._value], "Grad": [g],
+             "Moment": [accs["moment"]], "InfNorm": [accs["inf_norm"]],
+             "LearningRate": [lr_arr], "Beta1Pow": [accs["b1p"]]},
+            {"beta1": self._b1, "beta2": self._b2,
+             "epsilon": self._eps}, self._ctx)
+        p._value = outs["ParamOut"][0]
+        accs["moment"] = outs["MomentOut"][0]
+        accs["inf_norm"] = outs["InfNormOut"][0]
+        accs["b1p"] = accs["b1p"] * self._b1

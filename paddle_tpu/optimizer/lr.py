@@ -195,3 +195,16 @@ class ReduceOnPlateau(LRScheduler):
             self.last_lr = max(self.last_lr * self.factor, self.min_lr)
             self.cooldown_ctr = self.cooldown
             self.num_bad = 0
+
+
+class LambdaDecay(LRScheduler):
+    """lr = base_lr * lr_lambda(epoch) (reference optimizer/lr.py
+    LambdaDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.lr_lambda(self.last_epoch)

@@ -13,3 +13,140 @@ from ..fluid import layers as nn
 def name_scope(name=None):
     import contextlib
     return contextlib.nullcontext()
+
+
+# --- 2.0 static __all__ parity tail (reference python/paddle/static/) -------
+from ..fluid.core import global_scope, CPUPlace  # noqa: F401
+from ..fluid.layers import Print, py_func  # noqa: F401
+
+
+class InputSpec:
+    """Declarative input signature (reference static/input.py InputSpec):
+    consumed by paddle.jit.save / to_static input binding and by hapi
+    Input (same triple: shape, dtype, name)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(list(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class ParallelExecutor:
+    """Legacy ParallelExecutor facade (reference parallel_executor.py):
+    the whole-block XLA executor already compiles and runs the program;
+    data parallelism rides CompiledProgram.with_data_parallel."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        from ..fluid import Executor, default_main_program
+        self._exe = Executor()
+        self._program = main_program or default_main_program()
+        self._scope = scope
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+
+from ..fluid.core import scope_guard  # noqa: F401  (one implementation)
+
+
+def cpu_places(device_count=None):
+    from ..fluid import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """TPU build: accelerator places map to the devices jax exposes
+    (default: one place per visible device)."""
+    from ..fluid import TPUPlace
+    if device_ids is None:
+        import jax
+        device_ids = list(range(len(jax.devices())))
+    return [TPUPlace(i) for i in device_ids]
+
+
+from ..fluid.param_attr import WeightNormParamAttr  # noqa: F401
+
+
+# -- program/persistable serialization (reference static/io.py) --------------
+def serialize_program(feed_vars, fetch_vars, program=None):
+    import pickle
+    from ..fluid import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps(prog)
+
+
+def deserialize_program(data):
+    import pickle
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None):
+    import pickle
+    import numpy as _np
+    from ..fluid import default_main_program
+    from ..fluid.core import global_scope as _gs
+    prog = program or default_main_program()
+    state = {}
+    for v in prog.list_vars():
+        if getattr(v, "persistable", False):
+            val = _gs().find_var(v.name)
+            if val is not None:
+                state[v.name] = _np.asarray(val)
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    from ..fluid.core import global_scope as _gs
+    state = pickle.loads(data)
+    for name, val in state.items():
+        _gs().set_var(name, val)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    """Read a persistables dump into a dict (reference static/io.py
+    load_program_state)."""
+    import os
+    import pickle
+    p = model_path if os.path.exists(model_path) else model_path + ".pdparams"
+    with open(p, "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    from ..fluid.core import global_scope as _gs
+    import numpy as _np
+    for name, val in state_dict.items():
+        _gs().set_var(name, _np.asarray(val))

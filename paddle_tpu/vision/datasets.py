@@ -149,3 +149,109 @@ def mnist_train_reader(batch=None):
             img, lbl = ds[i]
             yield img, lbl
     return reader
+
+
+class FashionMNIST(MNIST):
+    """Same idx-ubyte format as MNIST (reference datasets/mnist.py
+    FashionMNIST subclass); cache files under fashion_mnist/."""
+
+
+class VOC2012(_ArrayDataset):
+    """VOC2012 segmentation pairs (reference datasets/voc2012.py): cache
+    contract serves (images, labels=masks); synthetic fallback emits
+    image/mask pairs."""
+    NAME = "voc2012"
+    SHAPE = (3, 64, 64)
+    CLASSES = 21
+    SYN = 256
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        lbl = self.labels[idx]
+        if np.ndim(lbl) >= 2:
+            return img, np.asarray(lbl, "int64")   # real cached mask
+        # synthetic fallback: coarse class blocks derived from the id
+        rng = np.random.RandomState(int(np.asarray(lbl).ravel()[0]))
+        mask = rng.randint(0, self.CLASSES,
+                           (self.SHAPE[1] // 8, self.SHAPE[2] // 8))
+        mask = np.kron(mask, np.ones((8, 8), "int64"))
+        return img, mask
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class loader (reference datasets/folder.py): files
+    under <root>/<class_name>/* are samples; `loader` reads one file
+    (default: npy/npz arrays, this framework's zero-egress image
+    substitute)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions or (".npy", ".npz"))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"DatasetFolder: no class dirs under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for f in sorted(os.listdir(d)):
+                path = os.path.join(d, f)
+                ok = (is_valid_file(path) if is_valid_file
+                      else f.lower().endswith(exts))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"DatasetFolder: no samples under {root}")
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npz"):
+            z = np.load(path)
+            return np.asarray(z[z.files[0]], "float32")
+        return np.asarray(np.load(path), "float32")
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray([label], "int64")
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    """Unlabeled flat-folder variant (reference folder.py ImageFolder):
+    every file directly under root is a sample; returns [img]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        exts = tuple(extensions or (".npy", ".npz"))
+        self.samples = []
+        for f in sorted(os.listdir(root)):
+            path = os.path.join(root, f)
+            if not os.path.isfile(path):
+                continue
+            ok = (is_valid_file(path) if is_valid_file
+                  else f.lower().endswith(exts))
+            if ok:
+                self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"ImageFolder: no samples under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]

@@ -10,7 +10,7 @@ from ..fluid import layers as L
 
 __all__ = ["LeNet", "ResNet", "resnet18", "resnet34", "resnet50",
            "resnet101", "resnet152", "VGG", "vgg16", "vgg19",
-           "MobileNetV1", "MobileNetV2"]
+           "MobileNetV1", "MobileNetV2", "vgg11", "vgg13", "mobilenet_v1", "mobilenet_v2"]
 
 
 class LeNet(Layer):
@@ -157,7 +157,8 @@ def resnet152(pretrained=False, **kw):
 
 
 class VGG(Layer):
-    cfgs = {16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}
+    cfgs = {11: [1, 1, 2, 2, 2], 13: [2, 2, 2, 2, 2],
+            16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}
 
     def __init__(self, depth=16, num_classes=1000):
         super().__init__()
@@ -263,3 +264,19 @@ class MobileNetV2(Layer):
         for b in self.blocks:
             x = b(x)
         return self.fc(self.flatten(self.pool(self.head(x))))
+
+
+def vgg11(pretrained=False, **kw):
+    return VGG(11, **kw)
+
+
+def vgg13(pretrained=False, **kw):
+    return VGG(13, **kw)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)

@@ -186,15 +186,11 @@ class SaturationTransform:
 
 
 class ColorJitter:
-    """Brightness/contrast/saturation jitter (reference transforms.py
-    ColorJitter).  Hue needs an HSV round-trip; a nonzero hue raises
-    rather than silently weakening a ported augmentation recipe."""
+    """Brightness/contrast/saturation/hue jitter (reference
+    transforms.py ColorJitter); hue rides the YIQ rotation in
+    adjust_hue."""
 
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
-        if hue:
-            raise NotImplementedError(
-                "ColorJitter hue is not implemented (needs HSV "
-                "conversion); use brightness/contrast/saturation")
         self.ts = []
         if brightness:
             self.ts.append(BrightnessTransform(brightness))
@@ -202,6 +198,8 @@ class ColorJitter:
             self.ts.append(ContrastTransform(contrast))
         if saturation:
             self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
 
     def __call__(self, x):
         for t in np.random.permutation(self.ts):
@@ -217,3 +215,130 @@ class Transpose:
 
     def __call__(self, x):
         return np.transpose(np.asarray(x), self.order)
+
+
+# --- functional transform tier (reference vision/transforms/functional.py) --
+def hflip(img):
+    return np.asarray(img)[..., ::-1].copy()
+
+
+def vflip(img):
+    return np.asarray(img)[..., ::-1, :].copy()
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[..., top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(np.asarray(img))
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill)(np.asarray(img))
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size)(np.asarray(img))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, "float32")
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    elif data_format == "HWC":
+        shape = (1, 1, -1)
+    else:
+        raise ValueError(f"normalize: unsupported data_format "
+                         f"'{data_format}' (CHW or HWC)")
+    m = np.asarray(mean, "float32").reshape(shape)
+    s = np.asarray(std, "float32").reshape(shape)
+    return (img - m) / s
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(np.asarray(img))
+
+
+def adjust_brightness(img, brightness_factor):
+    return np.asarray(img, "float32") * float(brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    img = np.asarray(img, "float32")
+    mean = img.mean()
+    return (img - mean) * float(contrast_factor) + mean
+
+
+def adjust_hue(img, hue_factor):
+    """Hue rotation via the RGB-space YIQ approximation (reference
+    functional.py adjust_hue rotates hue in HSV; the YIQ rotation is the
+    standard linear approximation of the same operation)."""
+    if abs(hue_factor) > 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    img = np.asarray(img, "float32")
+    t = 2.0 * np.pi * hue_factor
+    cos, sin = np.cos(t), np.sin(t)
+    tyiq = np.array([[0.299, 0.587, 0.114],
+                     [0.596, -0.274, -0.321],
+                     [0.211, -0.523, 0.311]], "float32")
+    ityiq = np.linalg.inv(tyiq)
+    rot = np.array([[1, 0, 0], [0, cos, -sin], [0, sin, cos]], "float32")
+    m = ityiq @ rot @ tyiq
+    flat = img.reshape(3, -1)
+    return (m @ flat).reshape(img.shape)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    """Rotate by angle degrees about the centre (nearest-neighbour
+    inverse mapping; reference functional.py rotate)."""
+    img = np.asarray(img, "float32")
+    h, w = img.shape[-2:]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else center
+    t = np.deg2rad(angle)
+    cos, sin = np.cos(t), np.sin(t)
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # inverse rotation: source coords for each destination pixel
+    sy = cos * (ys - cy) + sin * (xs - cx) + cy
+    sx = -sin * (ys - cy) + cos * (xs - cx) + cx
+    syi = np.round(sy).astype(int)
+    sxi = np.round(sx).astype(int)
+    valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+    syi, sxi = np.clip(syi, 0, h - 1), np.clip(sxi, 0, w - 1)
+    out = img[..., syi, sxi]
+    return np.where(valid, out, np.asarray(fill, img.dtype))
+
+
+class BaseTransform:
+    """Transform base (reference transforms.py BaseTransform): subclass
+    implements _apply_image; __call__ dispatches."""
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value):
+        if value < 0 or value > 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = ((-degrees, degrees) if np.isscalar(degrees)
+                        else tuple(degrees))
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)

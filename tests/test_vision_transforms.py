@@ -64,12 +64,16 @@ class TestShapes:
             assert out.min() >= 0.0     # alpha clamped at 0
 
     def test_saturation_and_hue_contract(self):
-        import pytest
         x = img(6)
         out = T.ColorJitter(saturation=0.5)(x)
         assert out.shape == x.shape and np.isfinite(out).all()
-        with pytest.raises(NotImplementedError, match="hue"):
-            T.ColorJitter(hue=0.1)
+        # hue implemented via the YIQ rotation (adjust_hue)
+        out_h = T.ColorJitter(hue=0.1)(x)
+        assert out_h.shape == x.shape and np.isfinite(out_h).all()
+        gray = np.repeat(img(7)[:1], 3, axis=0)
+        # hue rotation leaves grayscale images (approximately) unchanged
+        np.testing.assert_allclose(T.adjust_hue(gray, 0.4), gray,
+                                   atol=1e-4)
 
     def test_transforms_through_worker_pool(self):
         """The canonical deployment: a transform-bearing dataset under
