@@ -188,6 +188,20 @@ def fused_dropout_add_tpu(x, residual, key, rate, upscale_in_train):
     return out.reshape(shape)
 
 
+def _erf(x):
+    """In-kernel erf: Abramowitz & Stegun 7.1.26 (|err| <= 1.5e-7).
+    lax.erf has no Mosaic/Pallas-TPU lowering (KernelType.TC rejects it);
+    this uses only mul/add/exp, all of which lower."""
+    a1, a2, a3, a4, a5 = (0.254829592, -0.284496736, 1.421413741,
+                          -1.453152027, 1.061405429)
+    p = 0.3275911
+    s = jnp.sign(x)
+    ax = jnp.abs(x)
+    t = 1.0 / (1.0 + p * ax)
+    poly = ((((a5 * t + a4) * t + a3) * t + a2) * t + a1) * t
+    return s * (1.0 - poly * jnp.exp(-ax * ax))
+
+
 def _act_fns(act):
     import math
     if act == "relu":
@@ -199,11 +213,11 @@ def _act_fns(act):
 
         def f(x):
             xf = x.astype(jnp.float32)
-            return (0.5 * xf * (1.0 + jax.lax.erf(xf * c))).astype(x.dtype)
+            return (0.5 * xf * (1.0 + _erf(xf * c))).astype(x.dtype)
 
         def df(x):
             xf = x.astype(jnp.float32)
-            phi = 0.5 * (1.0 + jax.lax.erf(xf * c))
+            phi = 0.5 * (1.0 + _erf(xf * c))
             return (phi + xf * cpdf * jnp.exp(-0.5 * xf * xf)) \
                 .astype(x.dtype)
         return f, df

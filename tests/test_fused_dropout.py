@@ -164,6 +164,24 @@ class TestPallasParity:
         np.testing.assert_allclose(np.asarray(gr),
                                    np.ones(gr.shape, "float32"))
 
+    def test_act_dropout_gelu_matches_exact_erf(self):
+        # rate=0 keeps everything: the kernel's polynomial erf must match
+        # lax.erf-based gelu (poly |err| <= 1.5e-7) in fwd AND bwd — this
+        # is the path that broke on-chip (lax.erf has no Mosaic lowering)
+        from paddle_tpu.ops.pallas_kernels import fused_act_dropout_tpu
+        key = jax.random.PRNGKey(3)
+        x = jnp.asarray(rand((128, 256), 13) * 3.0)
+        out = fused_act_dropout_tpu(x, key, 0.0, True, "gelu")
+        ref = 0.5 * x * (1.0 + jax.lax.erf(x / np.sqrt(2.0)))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6, rtol=1e-5)
+        g = jax.grad(lambda v: fused_act_dropout_tpu(
+            v, key, 0.0, True, "gelu").sum())(x)
+        gref = jax.grad(lambda v: (0.5 * v * (1.0 + jax.lax.erf(
+            v / np.sqrt(2.0)))).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                                   atol=2e-6, rtol=1e-5)
+
     def test_act_dropout_fwd_bwd_mask_identity(self):
         from paddle_tpu.ops.pallas_kernels import fused_act_dropout_tpu
         key = jax.random.PRNGKey(1)

@@ -241,6 +241,14 @@ def build_specs():
                                          "Filter": _sym(2, 3, 2, 2)},
                                  grad_slots=["Input", "Filter"],
                                  attrs=conv_attrs, out_slot="Output"),
+        "conv3d_transpose": dict(inputs={"Input": _sym(1, 2, 3, 3, 3),
+                                         "Filter": _sym(2, 3, 2, 2, 2)},
+                                 grad_slots=["Input", "Filter"],
+                                 attrs={"strides": [1, 1, 1],
+                                        "paddings": [0, 0, 0],
+                                        "dilations": [1, 1, 1],
+                                        "groups": 1},
+                                 out_slot="Output"),
         "conv3d": dict(inputs={"Input": _sym(1, 2, 3, 4, 4),
                                "Filter": _sym(3, 2, 2, 2, 2)},
                        grad_slots=["Input", "Filter"],
