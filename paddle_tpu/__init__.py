@@ -63,3 +63,89 @@ def is_compiled_with_cuda():
 
 def is_compiled_with_xpu():
     return False
+
+
+# -- top-level 2.0 namespace closure (reference python/paddle/__init__.py) --
+
+from .fluid.core import TPUPinnedPlace as CUDAPinnedPlace  # noqa: E402
+from .fluid.core import TPUPlace as XPUPlace               # noqa: E402
+from .dygraph import DataParallel                          # noqa: E402
+from .dygraph.base import VarBase as Tensor                # noqa: E402
+from .hapi import callbacks                                # noqa: E402
+from . import onnx                                         # noqa: E402
+from .fluid.framework import (set_default_dtype,           # noqa: E402
+                              get_default_dtype)
+from .fluid.layers import create_parameter                 # noqa: E402
+from .fluid.layers import crop_tensor as crop              # noqa: E402
+from .fluid import in_dygraph_mode as in_dynamic_mode      # noqa: E402
+
+__git_commit__ = "0" * 40      # filled by the wheel build (tools/ci_smoke)
+
+
+def get_cudnn_version():
+    """No cuDNN on this stack; the reference returns None when CUDA is
+    absent (python/paddle/device.py get_cudnn_version)."""
+    return None
+
+
+def seed(value: int):
+    """Seed every framework RNG stream: the dygraph tracer's op-seed
+    source, and the default programs' random_seed (reference
+    python/paddle/framework/random.py seed)."""
+    import numpy as _np
+    value = int(value)
+    _np.random.seed(value & 0x7FFFFFFF)
+    for prog in (default_main_program(), default_startup_program()):
+        prog.random_seed = value
+    return value
+
+
+def get_cuda_rng_state():
+    """Device-RNG snapshot.  TPU redesign: dygraph op seeds are drawn from
+    the numpy global stream (dygraph/base.py trace_op) and static programs
+    carry their own random_seed, so the restorable state is (numpy state,
+    program seeds)."""
+    import numpy as _np
+    return [_np.random.get_state(),
+            default_main_program().random_seed,
+            default_startup_program().random_seed]
+
+
+def set_cuda_rng_state(state):
+    import numpy as _np
+    np_state, main_seed, startup_seed = state
+    _np.random.set_state(np_state)
+    default_main_program().random_seed = main_seed
+    default_startup_program().random_seed = startup_seed
+
+
+def monkey_patch_variable():
+    """Math dunders live directly on Variable (fluid/framework.py) rather
+    than being patched in post-hoc; kept as a callable for reference API
+    parity (python/paddle/fluid/layers/math_op_patch.py) and validates the
+    surface is present."""
+    from .fluid.framework import Variable as _V
+    assert hasattr(_V, "__add__") and hasattr(_V, "__mul__")
+
+
+def monkey_patch_math_varbase():
+    """Same for VarBase (dygraph/base.py numpy-protocol + math dunders)."""
+    from .dygraph.base import VarBase as _VB
+    assert hasattr(_VB, "__add__") and hasattr(_VB, "numpy")
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Standalone paddle.summary (reference python/paddle/hapi/
+    model_summary.py): per-parameter table + totals for a Layer."""
+    import numpy as _np
+    lines = [f"Layer: {type(net).__name__}"]
+    total = trainable = 0
+    for name, p in net.named_parameters():
+        n = int(_np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        lines.append(f"  {name:50s} {str(p.shape):20s} {n}")
+    lines.append(f"Total params: {total:,}  (trainable {trainable:,})")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -6,8 +6,11 @@ from . import collective
 from .collective import (ReduceOp, all_gather, all_reduce, barrier,
                          broadcast, get_rank, get_world_size,
                          init_parallel_env, reduce, scatter)
+from . import launch
+from ..dygraph.parallel import ParallelEnv   # DEFINE_ALIAS
+                                             # (reference distributed/__init__.py:23)
 
 __all__ = ["fleet", "DistributedStrategy", "spawn", "collective",
            "ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce",
            "scatter", "barrier", "get_rank", "get_world_size",
-           "init_parallel_env"]
+           "init_parallel_env", "launch", "ParallelEnv"]

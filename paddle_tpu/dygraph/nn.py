@@ -19,7 +19,9 @@ from ..fluid.layer_helper import emit_op as _emit
 
 class Linear(Layer):
     def __init__(self, input_dim, output_dim, param_attr=None,
-                 bias_attr=None, act=None, dtype="float32"):
+                 bias_attr=None, act=None, dtype=None):
+        from ..fluid.framework import get_default_dtype
+        dtype = dtype or get_default_dtype()
         super().__init__(dtype=dtype)
         helper = LayerHelper("linear")
         self.weight = helper.create_parameter(param_attr,

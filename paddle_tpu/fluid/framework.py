@@ -260,6 +260,30 @@ class Block:
                 if isinstance(v, Parameter)]
 
 
+_DEFAULT_DTYPE = "float32"
+
+_FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+
+
+def set_default_dtype(d) -> None:
+    """paddle.set_default_dtype analog (reference
+    python/paddle/framework/framework.py:20): the dtype layers use for
+    parameters created without an explicit dtype."""
+    global _DEFAULT_DTYPE
+    try:
+        name = convert_dtype(d)
+    except (TypeError, ValueError):
+        name = str(d)
+    if name not in _FLOAT_DTYPES:
+        raise TypeError(
+            f"set_default_dtype only supports {_FLOAT_DTYPES}, got {name!r}")
+    _DEFAULT_DTYPE = name
+
+
+def get_default_dtype() -> str:
+    return _DEFAULT_DTYPE
+
+
 class Program:
     """ProgramDesc analog.  fluid's two-program idiom is kept: layer calls
     append compute ops to the *main* program and parameter-initialisation ops

@@ -35,11 +35,14 @@ class LayerHelper:
         return self.main_program.current_block()
 
     # ------------------------------------------------------------------
-    def create_parameter(self, attr, shape, dtype="float32",
+    def create_parameter(self, attr, shape, dtype=None,
                          is_bias=False, default_initializer=None):
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
+        if dtype is None:           # paddle.set_default_dtype contract
+            from .framework import get_default_dtype
+            dtype = get_default_dtype()
         name = attr.name or unique_name(
             f"{self.kwargs.get('name') or self.layer_type}.w"
             if not is_bias else

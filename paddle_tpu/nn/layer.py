@@ -71,7 +71,7 @@ class PReLU(Layer):
         super().__init__()
         helper = LayerHelper("prelu")
         self.weight = helper.create_parameter(
-            weight_attr, [num_parameters], "float32",
+            weight_attr, [num_parameters], None,
             default_initializer=ConstantInitializer(init))
 
     def forward(self, x):
@@ -123,9 +123,9 @@ class Conv2DTranspose(Layer):
                        "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
                        "groups": groups}
         self.weight = helper.create_parameter(
-            weight_attr, [in_channels, out_channels // groups] + ks, "float32")
+            weight_attr, [in_channels, out_channels // groups] + ks, None)
         self.bias = helper.create_parameter(bias_attr, [out_channels],
-                                            "float32", is_bias=True) \
+                                            None, is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, x):
@@ -195,10 +195,10 @@ class GroupNorm(Layer):
         super().__init__()
         helper = LayerHelper("group_norm")
         self.weight = helper.create_parameter(
-            weight_attr, [num_channels], "float32",
+            weight_attr, [num_channels], None,
             default_initializer=ConstantInitializer(1.0))
         self.bias = helper.create_parameter(bias_attr, [num_channels],
-                                            "float32", is_bias=True)
+                                            None, is_bias=True)
         self._groups, self._eps = num_groups, epsilon
 
     def forward(self, x):
@@ -215,10 +215,10 @@ class InstanceNorm2D(Layer):
         super().__init__()
         helper = LayerHelper("instance_norm")
         self.weight = helper.create_parameter(
-            weight_attr, [num_features], "float32",
+            weight_attr, [num_features], None,
             default_initializer=ConstantInitializer(1.0))
         self.bias = helper.create_parameter(bias_attr, [num_features],
-                                            "float32", is_bias=True)
+                                            None, is_bias=True)
         self._eps = epsilon
 
     def forward(self, x):
@@ -596,11 +596,11 @@ class _ConvNd(Layer):
         fan_in = (in_channels // groups) * int(np.prod(ks))
         self.weight = helper.create_parameter(
             weight_attr, [out_channels, in_channels // groups] + ks,
-            "float32",
+            None,
             default_initializer=NormalInitializer(
                 0., math.sqrt(2. / fan_in)))
         self.bias = helper.create_parameter(
-            bias_attr, [out_channels], "float32", is_bias=True) \
+            bias_attr, [out_channels], None, is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, x):
@@ -741,14 +741,14 @@ class _RNNBase(Layer):
             for d in range(self.ndir):
                 wi = helper.create_parameter(weight_ih_attr,
                                              [g * hidden_size, in_d],
-                                             "float32")
+                                             None)
                 wh = helper.create_parameter(weight_hh_attr,
                                              [g * hidden_size, hidden_size],
-                                             "float32")
+                                             None)
                 bi = helper.create_parameter(bias_ih_attr, [g * hidden_size],
-                                             "float32", is_bias=True)
+                                             None, is_bias=True)
                 bh = helper.create_parameter(bias_hh_attr, [g * hidden_size],
-                                             "float32", is_bias=True)
+                                             None, is_bias=True)
                 for i, w in enumerate((wi, wh, bi, bh)):
                     self.add_parameter(f"l{l}d{d}_{i}", w)
                 self._weights += [wi, wh, bi, bh]
@@ -821,13 +821,13 @@ class _CellBase(Layer):
         g = self.GATES
         self.input_size, self.hidden_size = input_size, hidden_size
         self.weight_ih = helper.create_parameter(
-            weight_ih_attr, [g * hidden_size, input_size], "float32")
+            weight_ih_attr, [g * hidden_size, input_size], None)
         self.weight_hh = helper.create_parameter(
-            weight_hh_attr, [g * hidden_size, hidden_size], "float32")
+            weight_hh_attr, [g * hidden_size, hidden_size], None)
         self.bias_ih = helper.create_parameter(
-            bias_ih_attr, [g * hidden_size], "float32", is_bias=True)
+            bias_ih_attr, [g * hidden_size], None, is_bias=True)
         self.bias_hh = helper.create_parameter(
-            bias_hh_attr, [g * hidden_size], "float32", is_bias=True)
+            bias_hh_attr, [g * hidden_size], None, is_bias=True)
 
     def get_initial_states(self, batch_ref):
         from ..dygraph.base import VarBase
@@ -1053,9 +1053,9 @@ class Conv1DTranspose(Layer):
         self._cfg = (stride, padding, dilation, groups)
         self.weight = helper.create_parameter(
             weight_attr, [in_channels, out_channels // groups, k],
-            "float32")
+            None)
         self.bias = helper.create_parameter(
-            bias_attr, [out_channels], "float32", is_bias=True) \
+            bias_attr, [out_channels], None, is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, x):
@@ -1076,9 +1076,9 @@ class Conv3DTranspose(Layer):
         self._cfg = (stride, padding, groups)
         self.weight = helper.create_parameter(
             weight_attr, [in_channels, out_channels // groups] + ks,
-            "float32")
+            None)
         self.bias = helper.create_parameter(
-            bias_attr, [out_channels], "float32", is_bias=True) \
+            bias_attr, [out_channels], None, is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, x):
@@ -1095,9 +1095,9 @@ class Bilinear(Layer):
         helper = LayerHelper("bilinear")
         self.weight = helper.create_parameter(
             weight_attr, [out_features, in1_features, in2_features],
-            "float32")
+            None)
         self.bias = helper.create_parameter(
-            bias_attr, [1, out_features], "float32", is_bias=True) \
+            bias_attr, [1, out_features], None, is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, x1, x2):
@@ -1119,9 +1119,9 @@ class HSigmoidLoss(Layer):
         helper = LayerHelper("hsigmoid_loss")
         self._num_classes = num_classes
         self.weight = helper.create_parameter(
-            weight_attr, [num_classes - 1, feature_size], "float32")
+            weight_attr, [num_classes - 1, feature_size], None)
         self.bias = helper.create_parameter(
-            bias_attr, [1, num_classes - 1], "float32", is_bias=True) \
+            bias_attr, [1, num_classes - 1], None, is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, input, label):
@@ -1137,10 +1137,10 @@ class InstanceNorm1D(Layer):
         helper = LayerHelper("instance_norm")
         self._eps = epsilon
         self.weight = helper.create_parameter(
-            weight_attr, [num_features], "float32",
+            weight_attr, [num_features], None,
             default_initializer=ConstantInitializer(1.0))
         self.bias = helper.create_parameter(
-            bias_attr, [num_features], "float32", is_bias=True)
+            bias_attr, [num_features], None, is_bias=True)
 
     def forward(self, x):
         from . import functional as F
@@ -1208,7 +1208,7 @@ class RowConv(Layer):
         helper = LayerHelper("row_conv")
         self.weight = helper.create_parameter(
             param_attr, [future_context_size + 1, num_channels],
-            "float32")
+            None)
 
     def forward(self, x):
         from . import functional as F
@@ -1223,8 +1223,8 @@ class SpectralNorm(Layer):
         h = weight_shape[dim]
         w = int(_np.prod(weight_shape)) // h
         self._cfg = (dim, power_iters, eps)
-        self.weight_u = helper.create_parameter(None, [h], "float32")
-        self.weight_v = helper.create_parameter(None, [w], "float32")
+        self.weight_u = helper.create_parameter(None, [h], None)
+        self.weight_v = helper.create_parameter(None, [w], None)
 
     def forward(self, weight):
         from ..fluid.layer_helper import emit_op

@@ -1,0 +1,24 @@
+"""paddle.onnx analog (reference python/paddle/onnx/__init__.py: export via
+paddle2onnx).  The onnx toolchain is not part of this environment, so the
+entry point is gated: it raises a clear error unless the `onnx` package is
+importable.  The TPU-native interchange format is the StableHLO AOT artifact
+(inference/aot.py), which serves the same "run the model outside the
+framework" role."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise RuntimeError(
+            "paddle.onnx.export requires the `onnx` package, which is not "
+            "available in this environment.  Use paddle_tpu.inference.aot "
+            "to export a StableHLO artifact servable without the framework "
+            "(the TPU-native equivalent)."
+        ) from e
+    raise NotImplementedError(
+        "onnx graph emission is not implemented; export a StableHLO "
+        "artifact via paddle_tpu.inference.aot instead")
