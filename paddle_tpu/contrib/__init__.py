@@ -1,5 +1,33 @@
-"""fluid.contrib analog: slim (quantization), memory usage estimation."""
-from . import slim
+"""fluid.contrib analog (reference python/paddle/fluid/contrib/
+__init__.py): the full contrib surface — search-ads/CTR layer tier,
+legacy decoder framework, mixed precision, quantize transpiler, reader
+sharding, HDFS utils, model stats, op frequency, contrib Momentum."""
+from . import decoder
+from .decoder import *               # noqa: F401,F403
+from . import memory_usage_calc
 from .memory_usage_calc import compiled_memory_stats, memory_usage
+from . import op_frequence
+from .op_frequence import *          # noqa: F401,F403
+from . import quantize
+from .quantize import *              # noqa: F401,F403
+from . import reader
+from .reader import *                # noqa: F401,F403
+from . import slim
+from . import utils
+from .utils import *                 # noqa: F401,F403
+from . import extend_optimizer
+from .extend_optimizer import *      # noqa: F401,F403
+from . import model_stat
+from .model_stat import *            # noqa: F401,F403
+from . import mixed_precision
+from .mixed_precision import *       # noqa: F401,F403
+from . import layers
+from .layers import *                # noqa: F401,F403
+from . import optimizer
 
-__all__ = ["slim", "memory_usage", "compiled_memory_stats"]
+__all__ = (["slim", "memory_usage", "compiled_memory_stats",
+            "mixed_precision", "optimizer"]
+           + list(decoder.__all__) + list(op_frequence.__all__)
+           + list(quantize.__all__) + list(reader.__all__)
+           + list(utils.__all__) + list(extend_optimizer.__all__)
+           + list(model_stat.__all__) + list(layers.__all__))

@@ -1,0 +1,45 @@
+"""fluid.contrib.extend_optimizer analog: decoupled weight decay mixin
+(reference extend_optimizer_with_weight_decay.py)."""
+from __future__ import annotations
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Return a subclass of `base_optimizer` whose constructor takes
+    `coeff` and whose apply step subtracts `lr * coeff * param` from every
+    parameter AFTER the base update — AdamW-style decoupling rather than
+    L2-in-gradient (reference DecoupledWeightDecay)."""
+    from ...fluid.optimizer import Optimizer
+
+    if not issubclass(base_optimizer, Optimizer):
+        raise TypeError("base_optimizer must be an Optimizer subclass")
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        def __init__(self, weight_decay=0.0, *args, **kwargs):
+            self._decoupled_coeff = weight_decay
+            super().__init__(*args, **kwargs)
+
+        def _append_optimize_op(self, param, grad):
+            # hook point shared by BOTH execution modes (static
+            # apply_gradients and dygraph _minimize_dygraph): decay the
+            # parameter AFTER the base update, decoupled from the gradient
+            op = super()._append_optimize_op(param, grad)
+            if self._decoupled_coeff:
+                factor = 1.0 - self._current_lr() * self._decoupled_coeff
+                from ...fluid.framework import in_dygraph_mode
+                if in_dygraph_mode():
+                    param._value = param._value * factor
+                else:
+                    from ...fluid import layers as L
+                    L.assign(param * factor, output=param)
+            return op
+
+        def _current_lr(self):
+            lr = getattr(self, "_learning_rate", 0.0)
+            lr = lr() if callable(lr) else lr
+            return float(getattr(lr, "_value", lr))
+
+    OptimizerWithDecoupledWeightDecay.__name__ = (
+        f"Decoupled{base_optimizer.__name__}")
+    return OptimizerWithDecoupledWeightDecay

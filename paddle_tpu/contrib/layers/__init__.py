@@ -1,0 +1,10 @@
+"""fluid.contrib.layers analog (reference contrib/layers/__init__.py)."""
+from . import nn
+from .nn import *            # noqa: F401,F403
+from . import metric_op
+from .metric_op import *     # noqa: F401,F403
+from . import rnn_impl
+from .rnn_impl import *      # noqa: F401,F403
+
+__all__ = list(nn.__all__) + list(metric_op.__all__) + \
+    list(rnn_impl.__all__)

@@ -1,0 +1,20 @@
+"""fluid.contrib.layers.metric_op analog (reference contrib/layers/
+metric_op.py ctr_metric_bundle): local CTR metric sums — squared error,
+abs error, predicted ctr, q value — as in-graph accumulations the caller
+(or fleet.metrics) all-reduces and normalises by instance count."""
+from __future__ import annotations
+
+from ...fluid import layers as L
+
+__all__ = ["ctr_metric_bundle"]
+
+
+def ctr_metric_bundle(input, label):
+    lab = L.cast(label, "float32")
+    err = input - lab
+    local_sqrerr = L.reduce_sum(L.square(err))
+    local_abserr = L.reduce_sum(L.abs(err))
+    local_prob = L.reduce_sum(input)
+    # q = sum(prediction on positives)
+    local_q = L.reduce_sum(input * lab)
+    return local_sqrerr, local_abserr, local_prob, local_q

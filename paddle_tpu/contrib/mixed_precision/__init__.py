@@ -1,0 +1,87 @@
+"""fluid.contrib.mixed_precision analog (reference contrib/
+mixed_precision/{decorator,fp16_lists,fp16_utils,amp_nn}.py).
+
+TPU redesign: the fast dtype is bfloat16, so the black/white-list program
+rewrite targets bf16 (amp/static_amp.py) and loss scaling is optional
+(bf16 shares fp32's exponent range).  The fp16-named entry points are kept
+as the reference API surface over the bf16 machinery."""
+from __future__ import annotations
+
+from ...amp.static_amp import (decorate, CustomOpLists,
+                               rewrite_program_bf16,
+                               OptimizerWithMixedPrecision)
+from ...fluid.layer_helper import LayerHelper
+from ...fluid.framework import in_dygraph_mode
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "cast_model_to_fp16", "cast_parameters_to_fp16",
+           "check_finite_and_unscale", "update_loss_scaling"]
+
+AutoMixedPrecisionLists = CustomOpLists
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
+    """Whole-program low-precision rewrite (reference fp16_utils.py:
+    cast_model_to_fp16) — bf16 on this stack."""
+    rewrite_program_bf16(program, amp_lists)
+    return program
+
+
+def cast_parameters_to_fp16(place, program, scope=None, to_fp16_var_names=None):
+    """Parameters stay fp32 masters on TPU: the executor feeds bf16 casts
+    at op boundaries per the rewritten program, so there is nothing to do
+    destructively — kept for API parity (reference fp16_utils.py)."""
+    return None
+
+
+def _emit(op_type, ins, out_slots, attrs):
+    helper = LayerHelper(op_type)
+    outs = {s: [helper.create_variable_for_type_inference()]
+            for s in out_slots}
+    op = helper.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs)
+    got = op if in_dygraph_mode() else outs
+    vals = tuple(got[s][0] for s in out_slots)
+    return vals if len(vals) > 1 else vals[0]
+
+
+def check_finite_and_unscale(x, scale, name=None):
+    """amp_nn.check_finite_and_unscale: out_i = x_i / scale and a bool
+    FoundInfinite reduced over all inputs."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper = LayerHelper("check_finite_and_unscale")
+    outs = {"Out": [helper.create_variable_for_type_inference()
+                    for _ in xs],
+            "FoundInfinite": [helper.create_variable_for_type_inference(
+                dtype="bool")]}
+    op = helper.append_op("check_finite_and_unscale",
+                          inputs={"X": list(xs), "Scale": [scale]},
+                          outputs=outs, attrs={})
+    got = op if in_dygraph_mode() else outs
+    return list(got["Out"]), got["FoundInfinite"][0]
+
+
+def update_loss_scaling(x, found_inf, prev_loss_scaling, num_good_steps,
+                        num_bad_steps, incr_every_n_steps,
+                        decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                        name=None):
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    helper = LayerHelper("update_loss_scaling")
+    outs = {"Out": [helper.create_variable_for_type_inference()
+                    for _ in xs],
+            "LossScaling": [helper.create_variable_for_type_inference()],
+            "OutGoodSteps": [helper.create_variable_for_type_inference(
+                dtype="int32")],
+            "OutBadSteps": [helper.create_variable_for_type_inference(
+                dtype="int32")]}
+    op = helper.append_op(
+        "update_loss_scaling",
+        inputs={"X": list(xs), "FoundInfinite": [found_inf],
+                "PrevLossScaling": [prev_loss_scaling],
+                "InGoodSteps": [num_good_steps],
+                "InBadSteps": [num_bad_steps]},
+        outputs=outs,
+        attrs={"incr_every_n_steps": incr_every_n_steps,
+               "decr_every_n_nan_or_inf": decr_every_n_nan_or_inf,
+               "incr_ratio": incr_ratio, "decr_ratio": decr_ratio})
+    got = op if in_dygraph_mode() else outs
+    return list(got["Out"]), got["LossScaling"][0]

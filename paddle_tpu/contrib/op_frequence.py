@@ -1,0 +1,22 @@
+"""fluid.contrib.op_frequence analog: per-op-type frequency statistics over
+a Program (reference op_frequence.py op_freq_statistic)."""
+from __future__ import annotations
+
+from collections import Counter
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Return (uni_op_freq, adj_2_op_freq): single-op counts and adjacent
+    op-pair counts over the program's blocks."""
+    uni = Counter()
+    adj = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj[f"{prev}->{op.type}"] += 1
+            prev = op.type
+    return uni, adj

@@ -1,0 +1,19 @@
+"""fluid.contrib.optimizer analog (reference contrib/optimizer.py):
+contrib Momentum — the momentum optimizer with the regularization fused
+into the op (here: the standard MomentumOptimizer, whose lowering already
+applies regularization before the velocity update, which is exactly the
+fused semantic)."""
+from ..fluid.optimizer import MomentumOptimizer
+
+__all__ = ["Momentum"]
+
+
+class Momentum(MomentumOptimizer):
+    def __init__(self, learning_rate, momentum, parameter_list=None,
+                 use_nesterov=False, regularization=None, grad_clip=None,
+                 multi_precision=False, rescale_grad=1.0, name=None):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         parameter_list=parameter_list,
+                         use_nesterov=use_nesterov,
+                         regularization=regularization,
+                         grad_clip=grad_clip, name=name)

@@ -1,0 +1,32 @@
+"""fluid.contrib.quantize analog (reference contrib/quantize/
+quantize_transpiler.py): the pre-slim QuantizeTranspiler entry point,
+served by the slim quantization pass tier."""
+from __future__ import annotations
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    """Legacy QAT transpiler facade over contrib.slim.quantization: training
+    rewrites insert fake-quant/dequant around weighted ops; freeze folds the
+    learned scales for inference."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._window = window_size
+
+    def training_transpile(self, program=None, startup_program=None):
+        from ..slim.quantization import quant_aware
+        from ...fluid.framework import default_main_program
+        return quant_aware(program or default_main_program(),
+                           weight_bits=self._wbits,
+                           activation_bits=self._abits)
+
+    def freeze_program(self, program, place=None, fuse_bn=False):
+        from ..slim.quantization import convert
+        return convert(program)

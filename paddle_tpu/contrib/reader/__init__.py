@@ -1,0 +1,23 @@
+"""fluid.contrib.reader analog (reference contrib/reader/
+distributed_reader.py): shard a batch reader across trainers by
+round-robin on batch index."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Each trainer keeps every `trainer_num`-th batch starting at its id
+    (reference distributed_batch_reader) — env-driven like the reference
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM)."""
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    trainer_num = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    def decorated():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainer_num == trainer_id:
+                yield batch
+
+    return decorated

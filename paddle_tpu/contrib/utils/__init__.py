@@ -1,0 +1,62 @@
+"""fluid.contrib.utils analog: HDFS helpers + lookup-table model utils
+(reference contrib/utils/{hdfs_utils,lookup_table_utils}.py)."""
+from __future__ import annotations
+
+import os
+
+from ...incubate.fleet.utils.fs import HDFSClient, LocalFS
+
+__all__ = ["HDFSClient", "multi_download", "multi_upload",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference",
+           "convert_dist_to_sparse_program"]
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Download this trainer's round-robin shard of the files under
+    hdfs_path (reference hdfs_utils.multi_download)."""
+    files = sorted(client.ls_dir(hdfs_path)[1]) \
+        if hasattr(client, "ls_dir") else []
+    mine = [f for i, f in enumerate(files) if i % trainers == trainer_id]
+    os.makedirs(local_path, exist_ok=True)
+    out = []
+    for f in mine:
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(os.path.join(hdfs_path, f), dst)
+        out.append(dst)
+    return out
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False, sync=True):
+    out = []
+    for root, _dirs, files in os.walk(local_path):
+        for f in files:
+            src = os.path.join(root, f)
+            rel = os.path.relpath(src, local_path)
+            client.upload(src, os.path.join(hdfs_path, rel))
+            out.append(rel)
+    return out
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var, lookup_table_var_path):
+    """Continue-training load: persistables + the big lookup table from its
+    own path (reference lookup_table_utils).  The PS tier stores tables via
+    its sharded save RPC; here both live in the io.py persistable format."""
+    from ...fluid import io
+    io.load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name):
+    from ...fluid import io
+    io.load_persistables(executor, dirname, main_program=program)
+
+
+def convert_dist_to_sparse_program(program):
+    """The reference rewrites dense lookup_table vars into SelectedRows for
+    the distributed path; the TPU build's PS pass (ps/program_pass.py) does
+    this rewrite at minimize() time, so the program is returned as-is."""
+    return program

@@ -5,7 +5,10 @@ from .base import (VarBase, ParamBase, Tracer, guard, enable_dygraph,
 from .layers import Layer, Sequential, LayerList, ParameterList
 from . import nn
 from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
-                 Dropout, GRUUnit, PRelu)
+                 Dropout, GRUUnit, PRelu, Conv2DTranspose, Conv3D,
+                 Conv3DTranspose, InstanceNorm, GroupNorm, SpectralNorm,
+                 BilinearTensorProduct, SequenceConv, RowConv, NCE, TreeConv,
+                 Flatten)
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, declarative

@@ -112,6 +112,11 @@ class VarBase:
     def __truediv__(self, o): return self._binary("elementwise_div", o)
     def __rtruediv__(self, o): return self._binary("elementwise_div", o, True)
     def __pow__(self, o): return self._binary("elementwise_pow", o)
+    def __floordiv__(self, o): return self._binary("elementwise_floordiv", o)
+    def __rfloordiv__(self, o):
+        return self._binary("elementwise_floordiv", o, True)
+    def __mod__(self, o): return self._binary("elementwise_mod", o)
+    def __rmod__(self, o): return self._binary("elementwise_mod", o, True)
     def __gt__(self, o): return self._binary("greater_than", o)
     def __lt__(self, o): return self._binary("less_than", o)
     def __ge__(self, o): return self._binary("greater_equal", o)

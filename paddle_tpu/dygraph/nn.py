@@ -288,3 +288,330 @@ class PRelu(Layer):
         return _emit("prelu", "prelu",
                      {"X": [x], "Alpha": [self.weight]}, ("Out",),
                      {"mode": self._mode})["Out"][0]
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 output_size=None, padding=0, stride=1, dilation=1,
+                 groups=1, param_attr=None, bias_attr=None, use_cudnn=True,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("conv2d_transpose")
+        fs = [filter_size] * 2 if isinstance(filter_size, int) \
+            else list(filter_size)
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups}
+        if output_size is not None:
+            self._attrs["output_size"] = (
+                [output_size] * 2 if isinstance(output_size, int)
+                else list(output_size))
+        self._act = act
+        self.weight = helper.create_parameter(
+            param_attr, [num_channels, num_filters // groups] + fs, dtype)
+        self.bias = helper.create_parameter(bias_attr, [num_filters], dtype,
+                                            is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        out = _emit("conv2d_transpose", "conv2d_transpose",
+                    {"Input": [x], "Filter": [self.weight]}, ("Output",),
+                    self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = L.elementwise_add(out, self.bias, axis=1)
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+class Conv3D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("conv3d")
+        fs = [filter_size] * 3 if isinstance(filter_size, int) \
+            else list(filter_size)
+        self._attrs = {
+            "strides": [stride] * 3 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups}
+        self._act = act
+        self.weight = helper.create_parameter(
+            param_attr, [num_filters, num_channels // groups] + fs, dtype)
+        self.bias = helper.create_parameter(bias_attr, [num_filters], dtype,
+                                            is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        out = _emit("conv3d", "conv3d",
+                    {"Input": [x], "Filter": [self.weight]}, ("Output",),
+                    self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = L.elementwise_add(out, self.bias, axis=1)
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size,
+                 padding=0, stride=1, dilation=1, groups=1,
+                 param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("conv3d_transpose")
+        fs = [filter_size] * 3 if isinstance(filter_size, int) \
+            else list(filter_size)
+        self._attrs = {
+            "strides": [stride] * 3 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int)
+            else list(dilation),
+            "groups": groups}
+        self._act = act
+        self.weight = helper.create_parameter(
+            param_attr, [num_channels, num_filters // groups] + fs, dtype)
+        self.bias = helper.create_parameter(bias_attr, [num_filters], dtype,
+                                            is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        out = _emit("conv3d_transpose", "conv3d_transpose",
+                    {"Input": [x], "Filter": [self.weight]}, ("Output",),
+                    self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = L.elementwise_add(out, self.bias, axis=1)
+        if self._act:
+            out = getattr(L, self._act)(out)
+        return out
+
+
+class InstanceNorm(Layer):
+    def __init__(self, num_channels, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("instance_norm")
+        self.scale = helper.create_parameter(
+            param_attr, [num_channels], dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [num_channels],
+                                            dtype, is_bias=True)
+        self._eps = epsilon
+
+    def forward(self, x):
+        return _emit("instance_norm", "instance_norm",
+                     {"X": [x], "Scale": [self.scale], "Bias": [self.bias]},
+                     ("Y",), {"epsilon": self._eps})["Y"][0]
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("group_norm")
+        self.weight = helper.create_parameter(
+            param_attr, [channels], dtype,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = helper.create_parameter(bias_attr, [channels], dtype,
+                                            is_bias=True)
+        self._groups, self._eps, self._act = groups, epsilon, act
+
+    def forward(self, x):
+        out = _emit("group_norm", "group_norm",
+                    {"X": [x], "Scale": [self.weight],
+                     "Bias": [self.bias]}, ("Y",),
+                    {"groups": self._groups,
+                     "epsilon": self._eps})["Y"][0]
+        return getattr(L, self._act)(out) if self._act else out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("spectral_norm")
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = helper.create_parameter(
+            None, [h], dtype, default_initializer=NormalInitializer(0., 1.))
+        self.weight_v = helper.create_parameter(
+            None, [w], dtype, default_initializer=NormalInitializer(0., 1.))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+        self._cfg = (dim, power_iters, eps)
+
+    def forward(self, weight):
+        # the op runs power iteration FROM the layer's persistent (u, v);
+        # in dygraph the iterated vectors are written back so estimates
+        # compound across steps like the reference kernel's in-place U/V
+        dim, iters, eps = self._cfg
+        out = _emit("spectral_norm", "spectral_norm",
+                    {"Weight": [weight], "U": [self.weight_u],
+                     "V": [self.weight_v]}, ("Out",),
+                    {"dim": dim, "power_iters": iters,
+                     "eps": eps})["Out"][0]
+        if in_dygraph_mode():
+            import jax.numpy as jnp
+            wv = weight._value if hasattr(weight, "_value") else weight
+            wm = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+            u = self.weight_u._value
+            for _ in range(max(self._cfg[1], 0)):
+                v = wm.T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = wm @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            self.weight_u.set_value(u)
+            self.weight_v.set_value(v)
+        return out
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("bilinear_tensor_product")
+        self.weight = helper.create_parameter(
+            param_attr, [output_dim, input1_dim, input2_dim], dtype)
+        self.bias = helper.create_parameter(bias_attr, [1, output_dim],
+                                            dtype, is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        out = _emit("bilinear_tensor_product", "bilinear_tensor_product",
+                    {"X": [x], "Y": [y], "Weight": [self.weight],
+                     "Bias": [self.bias]}, ("Out",), {})["Out"][0]
+        return getattr(L, self._act)(out) if self._act else out
+
+
+class SequenceConv(Layer):
+    """Sequence (1D context-window) conv over padded [B, T, D] input
+    (reference dygraph SequenceConv over LoD; padded analog)."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None):
+        super().__init__()
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._built = False
+
+    def forward(self, x):
+        if not self._built:
+            helper = LayerHelper("sequence_conv")
+            d = int(x.shape[-1])
+            self.weight = helper.create_parameter(
+                self._param_attr, [self._filter_size * d,
+                                   self._num_filters], "float32")
+            self.bias = helper.create_parameter(
+                self._bias_attr, [self._num_filters], "float32",
+                is_bias=True) if self._bias_attr is not False else None
+            self._built = True
+        out = _emit("sequence_conv", "sequence_conv",
+                    {"X": [x], "Filter": [self.weight]}, ("Out",),
+                    {"contextLength": self._filter_size,
+                     "contextStart": -(self._filter_size // 2),
+                     "contextStride": 1})["Out"][0]
+        if self.bias is not None:
+            out = out + self.bias
+        return getattr(L, self._act)(out) if self._act else out
+
+
+class RowConv(Layer):
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None):
+        super().__init__()
+        self._future = future_context_size
+        self._param_attr = param_attr
+        self._act = act
+        self._built = False
+
+    def forward(self, x):
+        if not self._built:
+            helper = LayerHelper("row_conv")
+            d = int(x.shape[-1])
+            self.weight = helper.create_parameter(
+                self._param_attr, [self._future + 1, d], "float32")
+            self._built = True
+        out = _emit("row_conv", "row_conv",
+                    {"X": [x], "Filter": [self.weight]}, ("Out",),
+                    {})["Out"][0]
+        return getattr(L, self._act)(out) if self._act else out
+
+
+class NCE(Layer):
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=None,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("nce")
+        self.weight = helper.create_parameter(
+            param_attr, [num_total_classes, dim], dtype)
+        self.bias = helper.create_parameter(
+            bias_attr, [num_total_classes, 1], dtype, is_bias=True)
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples or 10,
+                       "seed": seed, "sampler": 0}
+
+    def forward(self, input, label, sample_weight=None):
+        outs = _emit("nce", "nce",
+                     {"Input": [input], "Label": [label],
+                      "Weight": [self.weight], "Bias": [self.bias]},
+                     ("Cost", "SampleLogits", "SampleLabels"),
+                     self._attrs)
+        return outs["Cost"][0]
+
+
+class TreeConv(Layer):
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        helper = LayerHelper("tree_conv")
+        self.weight = helper.create_parameter(
+            param_attr, [feature_size, 3, output_size, num_filters], dtype)
+        self.bias = helper.create_parameter(
+            bias_attr, [num_filters], dtype, is_bias=True) \
+            if bias_attr is not False else None
+        self._attrs = {"max_depth": max_depth, "output_size": output_size,
+                       "num_filters": num_filters}
+        self._act = act
+
+    def forward(self, nodes_vector, edge_set):
+        out = _emit("tree_conv", "tree_conv",
+                    {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                     "Filter": [self.weight]}, ("Out",),
+                    self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = out + self.bias
+        return getattr(L, self._act)(out) if self._act else out
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start = start_axis
+        self._stop = stop_axis
+
+    def forward(self, x):
+        nd = len(x.shape)
+        start = self._start % nd
+        stop = self._stop % nd
+        shape = (list(x.shape[:start]) + [-1]
+                 + list(x.shape[stop + 1:]))
+        return L.reshape(x, shape)

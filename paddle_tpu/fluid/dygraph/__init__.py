@@ -4,7 +4,11 @@ from ...dygraph import *  # noqa: F401,F403
 from ...dygraph import (guard, to_variable, no_grad, Layer, Sequential,
                         LayerList, ParameterList, Linear, FC, Conv2D, Pool2D,
                         BatchNorm, Embedding, LayerNorm, Dropout, GRUUnit,
-                        PRelu, DataParallel, ParallelEnv, prepare_context,
-                        save_dygraph, load_dygraph, TracedLayer, declarative,
-                        enable_dygraph, disable_dygraph)
+                        PRelu, Conv2DTranspose, Conv3D, Conv3DTranspose,
+                        InstanceNorm, GroupNorm, SpectralNorm,
+                        BilinearTensorProduct, SequenceConv, RowConv, NCE,
+                        TreeConv, Flatten, DataParallel, ParallelEnv,
+                        prepare_context, save_dygraph, load_dygraph,
+                        TracedLayer, declarative, enable_dygraph,
+                        disable_dygraph)
 from ...dygraph import nn  # noqa: F401

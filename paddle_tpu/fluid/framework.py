@@ -107,6 +107,11 @@ class Variable:
     def __truediv__(self, o): return self._binary("elementwise_div", o)
     def __rtruediv__(self, o): return self._binary("elementwise_div", o, True)
     def __pow__(self, o): return self._binary("elementwise_pow", o)
+    def __floordiv__(self, o): return self._binary("elementwise_floordiv", o)
+    def __rfloordiv__(self, o):
+        return self._binary("elementwise_floordiv", o, True)
+    def __mod__(self, o): return self._binary("elementwise_mod", o)
+    def __rmod__(self, o): return self._binary("elementwise_mod", o, True)
     def __neg__(self):
         from ..fluid import layers
         return layers.scale(self, scale=-1.0)

@@ -196,6 +196,15 @@ def _multiclass_nms(ins, attrs, ctx):
         "dygraph eager mode")
 
 
+@register_op("multiclass_nms2", differentiable=False)
+def _multiclass_nms2(ins, attrs, ctx):
+    # same dynamic-shape contract as multiclass_nms, plus an Index output
+    raise NotImplementedError(
+        "multiclass_nms2 has dynamic output shape; use "
+        "paddle_tpu.vision.ops.batched_nms (fixed-k) inside jit, or run in "
+        "dygraph eager mode")
+
+
 def _layer2(op_type, in_map, out_slots, attrs=None, name=None):
     helper = LayerHelper(op_type, name=name)
     outs = {s: [helper.create_variable_for_type_inference(dtype="float32")]

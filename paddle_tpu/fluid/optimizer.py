@@ -19,6 +19,32 @@ from .layer_helper import LayerHelper
 from . import layers
 
 
+class _EagerOptHelper:
+    """LayerHelper stand-in for dygraph minimize: runs an optimizer op's
+    lowering eagerly and writes every produced output back into the VarBase
+    passed in that output slot (in-place update semantics)."""
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        from ..ops.registry import get_op
+        from ..dygraph.base import _dygraph_tracer
+        ins_arr = {s: [getattr(v, "_value", v) for v in vs]
+                   for s, vs in (inputs or {}).items() if vs}
+        ctx = _dygraph_tracer()._ctx()
+        outs = get_op(type).fn(ins_arr, attrs or {}, ctx)
+        for slot, vbs in (outputs or {}).items():
+            arrs = outs.get(slot)
+            if not arrs:
+                continue
+            for vb, arr in zip(vbs, arrs):
+                if vb is not None and hasattr(vb, "_value"):
+                    # never let a promoting lowering flip the param/acc
+                    # dtype (bf16 param + f32 lr would otherwise widen)
+                    if arr.dtype != vb._value.dtype:
+                        arr = arr.astype(vb._value.dtype)
+                    vb._value = arr
+        return outs
+
+
 class Optimizer:
     _accumulator_defaults: Dict[str, float] = {}
 
@@ -56,6 +82,17 @@ class Optimizer:
     # -- accumulators -------------------------------------------------------
     def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
                          dtype=None):
+        if in_dygraph_mode():
+            accs = self._accumulators.setdefault(name, {})
+            if param.name not in accs:
+                import jax.numpy as jnp
+                from ..dygraph.base import VarBase
+                acc_dtype = jnp.dtype(dtype) if dtype is not None \
+                    else param._value.dtype
+                accs[param.name] = VarBase(
+                    jnp.full(tuple(shape or param.shape), fill_value,
+                             acc_dtype), stop_gradient=True)
+            return accs[param.name]
         key = f"{self._name}_{name}_{param.name}"
         acc = layers.create_global_var(
             shape or list(param.shape), fill_value, dtype or param.dtype,
@@ -91,6 +128,8 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        if in_dygraph_mode():
+            return self._minimize_dygraph(loss, parameter_list)
         # ops append to the LOSS's program even when the caller is outside
         # program_guard (reference optimizer.py minimize wraps
         # program_guard(program, startup_program) the same way — without
@@ -101,6 +140,79 @@ class Optimizer:
                                          parameter_list, no_grad_set)
             ops = self.apply_gradients(params_grads)
         return ops, params_grads
+
+    def _minimize_dygraph(self, loss, parameter_list=None):
+        """Dygraph minimize (reference optimizer.py:907 imperative branch):
+        collect tape gradients for the parameter list, then run each
+        subclass's update op EAGERLY — the same `_append_optimize_op`
+        declaration executes through an eager helper that calls the op
+        lowering and writes ParamOut/…Out back into the passed VarBases
+        (the aliasing the static executor gets from shared var names)."""
+        import jax.numpy as jnp
+        from ..dygraph.base import VarBase
+        from .regularizer import L1DecayRegularizer
+
+        params = list(parameter_list or self._parameter_list or [])
+        if not params:
+            raise ValueError(
+                "fluid Optimizer.minimize in dygraph mode needs parameters: "
+                "construct the optimizer with parameter_list=layer"
+                ".parameters()")
+        if all(p.gradient_var is None for p in params):
+            loss.backward()
+        params_grads = []
+        for p in params:
+            g = p.gradient_var
+            if g is None or not getattr(p, "trainable", True):
+                continue
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None and hasattr(reg, "_coeff"):
+                if isinstance(reg, L1DecayRegularizer):
+                    g = g + reg._coeff * jnp.sign(p._value)
+                else:
+                    g = g + reg._coeff * p._value
+            params_grads.append((p, VarBase(g, stop_gradient=True)))
+        if self._grad_clip is not None:
+            params_grads = self._clip_eager(params_grads)
+
+        lr = self._learning_rate
+        lr = lr() if callable(lr) else lr
+        lr = float(getattr(lr, "_value", lr))
+        saved_helper, saved_lr = self.helper, self._lr_var
+        self.helper = _EagerOptHelper()
+        self._lr_var = VarBase(jnp.asarray([lr], jnp.float32),
+                               stop_gradient=True)
+        try:
+            self._create_accumulators([p for p, _ in params_grads])
+            for p, g in params_grads:
+                self._append_optimize_op(p, g)
+        finally:
+            self.helper, self._lr_var = saved_helper, saved_lr
+        return None, params_grads
+
+    def _clip_eager(self, params_grads):
+        import jax.numpy as jnp
+        from ..dygraph.base import VarBase
+        from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                           GradientClipByValue)
+        gc = self._grad_clip
+        arrs = [(p, g._value) for p, g in params_grads]
+        if isinstance(gc, GradientClipByGlobalNorm):
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for _, g in arrs))
+            scale = gc.clip_norm / jnp.maximum(norm, gc.clip_norm)
+            arrs = [(p, g * scale) for p, g in arrs]
+        elif isinstance(gc, GradientClipByNorm):
+            out = []
+            for p, g in arrs:
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                out.append((p, jnp.where(n > gc.clip_norm,
+                                         g * (gc.clip_norm / n), g)))
+            arrs = out
+        elif isinstance(gc, GradientClipByValue):
+            arrs = [(p, jnp.clip(g, gc.min, gc.max)) for p, g in arrs]
+        else:
+            return params_grads
+        return [(p, VarBase(g, stop_gradient=True)) for p, g in arrs]
 
     # -- hooks for subclasses ----------------------------------------------
     def _create_accumulators(self, params):
@@ -127,9 +239,14 @@ class Optimizer:
     def state_dict(self):
         state = {}
         from .core import global_scope
-        for accs in self._accumulators.values():
-            for name_param, var in accs.items():
-                state[var.name] = np.asarray(global_scope().find_var(var.name))
+        for acc_name, accs in self._accumulators.items():
+            for param_name, var in accs.items():
+                if hasattr(var, "_value"):      # dygraph VarBase accumulator
+                    state[f"{self._name}_{acc_name}_{param_name}"] = \
+                        np.asarray(var._value)
+                else:
+                    state[var.name] = np.asarray(
+                        global_scope().find_var(var.name))
         return state
 
 

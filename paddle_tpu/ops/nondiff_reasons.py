@@ -101,7 +101,7 @@ REASONS = {
         "empty")},
     # -- detection post-processing -------------------------------------------
     **{op: "detection_post" for op in (
-        "multiclass_nms", "matrix_nms", "locality_aware_nms", "prior_box",
+        "multiclass_nms", "multiclass_nms2", "matrix_nms", "locality_aware_nms", "prior_box",
         "density_prior_box", "anchor_generator", "bipartite_match",
         "generate_proposals", "generate_proposals_v2",
         "generate_proposal_labels", "generate_mask_labels",

@@ -1,0 +1,312 @@
+"""fluid.contrib closure + behavior (reference python/paddle/fluid/contrib/):
+the qingshui/search-ads layer tier, legacy decoder framework, rnn_impl,
+extend_optimizer, mixed_precision fp16-named surface, misc tools — plus the
+fluid.dygraph.nn class tail and the dygraph fluid-Optimizer.minimize path
+these exercises depend on."""
+import ast
+import glob
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+import paddle_tpu.fluid as fluid
+import paddle_tpu.fluid.layers as L
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.dygraph.base import to_variable as tv
+
+C = fluid.contrib
+
+
+@pytest.fixture
+def dygraph():
+    dybase.enable_dygraph()
+    yield
+    dybase.disable_dygraph()
+
+
+def rand(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+class TestContribClosure:
+    """Every __all__ name in the reference contrib tree resolves."""
+
+    def test_contrib_all_resolves(self):
+        names = set()
+        for f in glob.glob(
+                "/root/reference/python/paddle/fluid/contrib/**/*.py",
+                recursive=True):
+            if "/tests/" in f or "/slim/" in f:
+                continue
+            try:
+                tree = ast.parse(open(f).read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and any(
+                        getattr(t, "id", "") == "__all__"
+                        for t in node.targets):
+                    try:
+                        names.update(ast.literal_eval(node.value))
+                    except ValueError:
+                        pass
+        sub = {"layers": C.layers, "decoder": C.decoder,
+               "mixed_precision": C.mixed_precision, "utils": C.utils,
+               "quantize": C.quantize, "reader": C.reader,
+               "optimizer": C.optimizer}
+        missing = sorted(
+            n for n in names
+            if not hasattr(C, n) and not any(hasattr(m, n)
+                                             for m in sub.values()))
+        assert not missing, missing
+
+    def test_dygraph_nn_class_tail(self):
+        ref = ast.parse(open("/root/reference/python/paddle/fluid/"
+                             "dygraph/nn.py").read())
+        classes = [n.name for n in ref.body
+                   if isinstance(n, ast.ClassDef)]
+        missing = [c for c in classes
+                   if not hasattr(fluid.dygraph, c)]
+        assert not missing, missing
+
+
+class TestContribLayersExecute:
+    def test_ctr_tier(self, dygraph):
+        r = np.random.RandomState(0)
+        x = tv(rand((4, 6)))
+        assert C.layers.fused_elemwise_activation(
+            x, tv(rand((4, 6), 1)), ["elementwise_add", "relu"]
+        ).shape == (4, 6)
+        assert C.layers.shuffle_batch(x).shape == (4, 6)
+        assert C.layers.partial_concat([x, x], 0, 3).shape == (4, 6)
+        assert C.layers.partial_sum([x, x], 0, 3).shape == (4, 3)
+        assert C.layers.batch_fc(tv(rand((3, 4, 8))), [3, 8, 5], None,
+                                 [3, 5], None).shape == (3, 4, 5)
+        ro = np.zeros((4, 7), "int32")
+        ro[:, 0] = 1
+        ro[:, 2] = np.arange(4)
+        assert C.layers.rank_attention(x, tv(ro), [8, 30], None,
+                                       max_rank=3).shape == (4, 5)
+        assert C.layers.cross_norm_layer_hadamard(
+            tv(rand((4, 12))), fields_num=2, embed_dim=3).shape == (4, 18)
+        assert C.layers.scaled_fc(x, 5, 1.0, 1.0, 1.0).shape == (4, 5)
+        assert C.layers.scaled_int8fc(x, 5, 0.1, 0.1).shape == (4, 5)
+        ids = tv(r.randint(0, 50, (4, 3)).astype("int64"))
+        assert C.layers.fused_embedding_seq_pool(
+            ids, [50, 16]).shape == (4, 16)
+        cvm = tv(np.ones((4, 2), "float32"))
+        outs = C.layers.fused_seqpool_cvm([tv(rand((4, 5, 8)))], "sum", cvm)
+        assert outs[0].shape == (4, 8)
+
+    def test_text_match_tier(self, dygraph):
+        xx, yy = tv(rand((2, 5, 8))), tv(rand((2, 7, 8), 1))
+        mm, _tmp = C.layers.match_matrix_tensor(xx, yy, channel_num=3)
+        assert mm.shape == (2, 3, 5, 7)
+        row = tv(np.zeros((2, 5), "float32"))
+        col = tv(np.zeros((2, 7), "float32"))
+        vc = C.layers.var_conv_2d(mm, row, col, input_channel=3,
+                                  output_channel=4, filter_size=3)
+        assert vc.shape == (2, 4, 5, 7)
+        tp = C.layers.sequence_topk_avg_pooling(tv(rand((2, 3, 9))), row,
+                                                col, topks=[1, 3],
+                                                channel_num=3)
+        assert tp.shape[0] == 2
+        ph = C.layers.search_pyramid_hash(
+            tv(np.arange(6).reshape(3, 2).astype("int64")), num_emb=16,
+            space_len=64, pyramid_layer=2, rand_len=16,
+            drop_out_percent=0, is_training=True, use_filter=False,
+            white_list_len=0, black_list_len=0, seed=0, lr=1.0)
+        assert ph.shape == (3, 16)
+
+    def test_tdm_tier(self, dygraph):
+        x = tv(np.arange(3).reshape(3, 1).astype("int64"))
+        child, mask = C.layers.tdm_child(x, node_nums=8, child_nums=2)
+        assert child.shape == (3, 1, 2) and mask.shape == (3, 1, 2)
+        out, labels, m = C.layers.tdm_sampler(x, [1, 1], [2, 4], 8)
+        assert out.shape == labels.shape == m.shape == (3, 4, 1)
+
+    def test_vision_tier(self, dygraph):
+        img, z = tv(rand((2, 3, 8, 8))), tv(rand((2, 3, 8, 8), 1))
+        out = C.layers.fused_bn_add_act(img, z, act="relu")
+        assert out.shape == (2, 3, 8, 8)
+        assert float(np.min(out.numpy())) >= 0.0
+        a, b = tv(rand((1, 2, 6, 6))), tv(rand((1, 2, 6, 6), 1))
+        assert C.layers.correlation(a, b, 1, 1, 1, 1, 1).shape[0] == 1
+        grid = tv(np.random.RandomState(0).rand(1, 4, 3, 4, 4)
+                  .astype("float32"))
+        guide = tv(np.random.RandomState(1).rand(1, 8, 8)
+                   .astype("float32"))
+        xb = tv(rand((1, 3, 8, 8)))
+        assert C.layers.bilateral_slice(xb, guide, grid).shape == \
+            (1, 4, 8, 8)
+
+    def test_ctr_metric_bundle(self, dygraph):
+        pred = tv(np.array([[0.2], [0.8]], "float32"))
+        lab = tv(np.array([[0.0], [1.0]], "float32"))
+        sq, ab, pr, q = C.layers.ctr_metric_bundle(pred, lab)
+        np.testing.assert_allclose(float(sq.numpy()), 0.08, rtol=1e-5)
+        np.testing.assert_allclose(float(ab.numpy()), 0.4, rtol=1e-5)
+        np.testing.assert_allclose(float(pr.numpy()), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(float(q.numpy()), 0.8, rtol=1e-5)
+
+
+class TestRnnImpl:
+    def test_basic_gru_lstm(self, dygraph):
+        seq = tv(rand((2, 5, 8)))
+        out, h = C.layers.basic_gru(seq, None, hidden_size=6, num_layers=2)
+        assert out.shape == (2, 5, 6) and h.shape == (2, 2, 6)
+        out, h, c = C.layers.basic_lstm(seq, None, None, hidden_size=6,
+                                        bidirectional=True)
+        assert out.shape == (2, 5, 12)
+        assert h.shape == c.shape == (2, 2, 6)
+
+    def test_units(self, dygraph):
+        u = C.layers.BasicGRUUnit("g", 8)
+        nh = u(tv(rand((2, 8))), tv(rand((2, 8), 1)))
+        assert nh.shape == (2, 8)
+        lu = C.layers.BasicLSTMUnit("l", 8)
+        nh, nc = lu(tv(rand((2, 8))), tv(rand((2, 8), 1)),
+                    tv(rand((2, 8), 2)))
+        assert nh.shape == nc.shape == (2, 8)
+
+
+class TestDecoderFramework:
+    def _cell(self, h0):
+        cell = C.decoder.StateCell(
+            inputs={"x": None},
+            states={"h": C.decoder.InitState(init=h0)}, out_state="h")
+        gru = C.layers.BasicGRUUnit("gru", 8)
+
+        @cell.state_updater
+        def up(c):
+            c.set_state("h", gru(c.get_input("x"), c.get_state("h")))
+        return cell
+
+    def test_training_decoder(self, dygraph):
+        cell = self._cell(tv(rand((2, 8))))
+        seq = tv(rand((2, 4, 8), 1))
+        dec = C.decoder.TrainingDecoder(cell)
+        with dec.block():
+            x0 = dec.step_input(seq)
+            cell.compute_state({"x": x0})
+            dec.output(cell.out_state())
+        assert dec().shape[0] == 2
+
+    def test_beam_search_decoder(self, dygraph):
+        cell = self._cell(tv(rand((3, 8))))
+        bsd = C.decoder.BeamSearchDecoder(
+            cell, tv(np.zeros((3, 1), "int64")),
+            tv(np.zeros((3, 1), "float32")), target_dict_dim=12,
+            word_dim=8, max_len=5, beam_size=2, end_id=1)
+        ids, scores = bsd()
+        assert ids.shape == (3, 2, 5) and scores.shape == (3, 2, 5)
+        s = scores.numpy()
+        # lane 0 is the argmax lane after every step's top-k
+        assert np.all(s[:, 0, -1] >= s[:, 1, -1])
+
+
+class TestDygraphNnTail:
+    def test_conv_family(self, dygraph):
+        v = tv(rand((2, 3, 6, 6, 6)))
+        assert fluid.dygraph.Conv3D(3, 4, 3)(v).shape == (2, 4, 4, 4, 4)
+        assert fluid.dygraph.Conv3DTranspose(3, 4, 3)(v).shape == \
+            (2, 4, 8, 8, 8)
+        x4 = tv(rand((2, 4, 8, 8)))
+        assert fluid.dygraph.Conv2DTranspose(4, 5, 3)(x4).shape == \
+            (2, 5, 10, 10)
+
+    def test_norm_and_misc(self, dygraph):
+        x4 = tv(rand((2, 4, 8, 8)))
+        assert fluid.dygraph.InstanceNorm(4)(x4).shape == (2, 4, 8, 8)
+        assert fluid.dygraph.GroupNorm(4, 2)(x4).shape == (2, 4, 8, 8)
+        assert fluid.dygraph.Flatten()(x4).shape == (2, 256)
+        assert fluid.dygraph.BilinearTensorProduct(5, 4, 3)(
+            tv(rand((2, 5))), tv(rand((2, 4), 1))).shape == (2, 3)
+        assert fluid.dygraph.SequenceConv("sc", 7)(
+            tv(rand((2, 5, 8)))).shape == (2, 5, 7)
+        assert fluid.dygraph.RowConv("rc", 2)(
+            tv(rand((2, 5, 8)))).shape == (2, 5, 8)
+        assert fluid.dygraph.SpectralNorm([6, 8])(
+            tv(rand((6, 8)))).shape == (6, 8)
+        cost = fluid.dygraph.NCE(20, 8)(
+            tv(rand((4, 8))),
+            tv(np.random.RandomState(0).randint(0, 20, (4, 1))
+               .astype("int64")))
+        assert cost.shape == (4, 1)
+
+
+class TestDygraphFluidOptimizer:
+    """fluid Optimizer.minimize works in dygraph mode for every family
+    (reference optimizer.py:907 imperative branch)."""
+
+    @pytest.mark.parametrize("mk", [
+        lambda p: fluid.optimizer.SGDOptimizer(0.1, parameter_list=p),
+        lambda p: fluid.optimizer.MomentumOptimizer(0.05, 0.9,
+                                                    parameter_list=p),
+        lambda p: fluid.optimizer.AdamOptimizer(0.05, parameter_list=p),
+        lambda p: fluid.optimizer.AdagradOptimizer(0.1, parameter_list=p),
+        lambda p: fluid.optimizer.RMSPropOptimizer(0.05, parameter_list=p),
+    ], ids=["sgd", "momentum", "adam", "adagrad", "rmsprop"])
+    def test_minimize_converges(self, dygraph, mk):
+        from paddle_tpu import nn
+        lin = nn.Linear(4, 1)
+        opt = mk(lin.parameters())
+        x = tv(np.ones((8, 4), "float32"))
+        y = tv(np.zeros((8, 1), "float32"))
+        l0 = None
+        for _ in range(12):
+            loss = L.reduce_mean(L.square(lin(x) - y))
+            loss.backward()
+            opt.minimize(loss)
+            lin.clear_gradients()
+            if l0 is None:
+                l0 = float(loss.numpy())
+        assert float(loss.numpy()) < l0
+
+    def test_decoupled_weight_decay_shrinks_params(self, dygraph):
+        from paddle_tpu import nn
+        Dec = C.extend_with_decoupled_weight_decay(
+            fluid.optimizer.SGDOptimizer)
+        lin = nn.Linear(4, 1)
+        w0 = np.linalg.norm(lin.weight.numpy())
+        opt = Dec(weight_decay=0.5, learning_rate=0.1,
+                  parameter_list=lin.parameters())
+        x = tv(np.zeros((4, 4), "float32"))
+        y = tv(np.zeros((4, 1), "float32"))
+        for _ in range(5):
+            loss = L.reduce_mean(L.square(lin(x) - y))  # zero weight grad
+            loss.backward()
+            opt.minimize(loss)
+            lin.clear_gradients()
+        w1 = np.linalg.norm(lin.weight.numpy())
+        np.testing.assert_allclose(w1 / w0, 0.95 ** 5, rtol=1e-4)
+
+
+class TestContribMisc:
+    def test_op_freq_and_model_stat(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            d = fluid.data("x", [-1, 4])
+            L.fc(d, 3)
+        uni, adj = C.op_freq_statistic(main)
+        assert uni["mul"] >= 1 or uni.get("matmul", 0) >= 1 or \
+            sum(uni.values()) >= 1
+        total, n_ops = C.model_stat.summary(main)
+        assert total >= 4 * 3 and n_ops >= 1
+
+    def test_distributed_batch_reader(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+        rd = C.distributed_batch_reader(lambda: iter(range(10)))
+        assert list(rd()) == [1, 3, 5, 7, 9]
+
+    def test_mixed_precision_surface(self):
+        assert C.mixed_precision.AutoMixedPrecisionLists is not None
+        assert callable(C.mixed_precision.decorate)
+        assert callable(C.mixed_precision.cast_model_to_fp16)
+
+    def test_floordiv_mod_dunders(self, dygraph):
+        a = tv(np.array([7, 9], "int32"))
+        np.testing.assert_array_equal((a // 2).numpy(), [3, 4])
+        np.testing.assert_array_equal((a % 4).numpy(), [3, 1])
