@@ -94,21 +94,27 @@ class StateCell:
 
 
 class TrainingDecoder:
-    """Teacher-forced decoder: the block body is captured once and replayed
-    per time step over the padded step input (reference TrainingDecoder:384
-    — a DynamicRNN while loop; here a build-time unroll)."""
+    """Teacher-forced decoder (reference TrainingDecoder:384 — a
+    DynamicRNN while loop).
+
+    TPU re-design: the `with decoder.block():` body executes ONCE as the
+    t=0 trace, which fixes the protocol — which tensors are step inputs,
+    which cell-input slot each feeds, and which cell states are emitted as
+    outputs.  __call__ then replays the RECURRENCE (the state cell's
+    registered updater) over t=1..T-1 and stacks the per-step states.
+    Outputs must therefore be cell states (the reference pattern:
+    `decoder.output(state_cell.get_state(...))`); arbitrary post-state
+    expressions need the functional `training_decoder()` below."""
 
     BEFORE, IN, AFTER = range(3)
 
     def __init__(self, state_cell, name=None):
         self._state_cell = state_cell
         self._status = TrainingDecoder.BEFORE
-        self._step_inputs = []
+        self._step_inputs = []        # [B, T, ...] tensors, in call order
         self._static_inputs = []
-        self._outputs = []
-        self._steps = []          # recorded (kind, payload) calls per step
-        self._body = None
-        self._t = 0
+        self._out_states = []         # state names emitted as outputs
+        self._first_outputs = []      # t=0 state values
         self._T = None
 
     @contextlib.contextmanager
@@ -120,13 +126,12 @@ class TrainingDecoder:
         self._status = TrainingDecoder.AFTER
 
     def step_input(self, x):
-        """Register a [B, T, ...] input; returns the current step's slice."""
+        """Register a [B, T, ...] input; returns the t=0 slice."""
         if self._status != TrainingDecoder.IN:
             raise ValueError("step_input must be called inside block()")
         self._step_inputs.append(x)
         self._T = int(x.shape[1]) if self._T is None else self._T
-        return L.squeeze(L.slice(x, axes=[1], starts=[self._t],
-                                 ends=[self._t + 1]), [1])
+        return L.squeeze(L.slice(x, axes=[1], starts=[0], ends=[1]), [1])
 
     def static_input(self, x):
         self._static_inputs.append(x)
@@ -135,29 +140,43 @@ class TrainingDecoder:
     def output(self, *outputs):
         if self._status != TrainingDecoder.IN:
             raise ValueError("output must be called inside block()")
-        self._outputs.append(list(outputs))
+        cell = self._state_cell
+        for v in outputs:
+            name = next((k for k, s in cell._cur_states.items()
+                         if s is v), None)
+            if name is None:
+                raise ValueError(
+                    "TrainingDecoder.output must receive current cell "
+                    "states (state_cell.get_state/out_state) so the "
+                    "recurrence can be replayed for t>0; for arbitrary "
+                    "per-step expressions use the functional "
+                    "training_decoder(state_cell, step_input, step_fn)")
+            self._out_states.append(name)
+            self._first_outputs.append(v)
+
+    def _slot_of_input(self, i):
+        # step_input call order maps onto the cell's declared input slots
+        slots = list(self._state_cell._inputs.keys())
+        return slots[i] if i < len(slots) else f"x{i}"
 
     def __call__(self):
-        """Replay the captured step over the remaining time steps and stack
-        outputs to [B, T, ...].  The first step already ran while tracing
-        the block; the block body must be re-entered for t=1..T-1, which
-        the python-unrolled design achieves by the caller building the
-        block inside a function — see decode() below for the pattern; for
-        the common single-expression block the recorded outputs are the
-        first step's, so re-run via the state cell."""
         if self._status != TrainingDecoder.AFTER:
             raise ValueError("call the decoder after its block")
-        if not self._outputs:
+        if not self._out_states:
             raise ValueError("decoder block produced no output")
-        n_out = len(self._outputs[0])
-        per_t = [list(o) for o in self._outputs]
-        # outputs recorded once per executed step; single-trace blocks hold
-        # t=0 only — a limitation made explicit rather than silent
-        outs = []
-        for i in range(n_out):
-            steps = [per_t[t][i] for t in range(len(per_t))]
-            outs.append(L.stack(steps, axis=1))
-        return outs[0] if n_out == 1 else tuple(outs)
+        per_out = [[v] for v in self._first_outputs]
+        cell = self._state_cell
+        for t in range(1, self._T or 1):
+            feed = {}
+            for i, x in enumerate(self._step_inputs):
+                feed[self._slot_of_input(i)] = L.squeeze(
+                    L.slice(x, axes=[1], starts=[t], ends=[t + 1]), [1])
+            cell.compute_state(feed)
+            cell.update_states()
+            for j, name in enumerate(self._out_states):
+                per_out[j].append(cell.get_state(name))
+        outs = [L.stack(steps, axis=1) for steps in per_out]
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def training_decoder(state_cell, step_input, step_fn):
@@ -203,6 +222,18 @@ class BeamSearchDecoder:
     def early_stop(self):
         pass
 
+    def _ensure_proj(self, hidden_size):
+        """ONE vocab projection, created on the first step and reused
+        across all steps (exposed, like embedding_weight, so a caller can
+        bind trained weights via .set_value before decode())."""
+        if getattr(self, "proj_weight", None) is None:
+            from ...fluid.layer_helper import LayerHelper
+            helper = LayerHelper("beam_search_decoder")
+            self.proj_weight = helper.create_parameter(
+                None, [hidden_size, self._target_dict_dim], "float32")
+            self.proj_bias = helper.create_parameter(
+                None, [self._target_dict_dim], "float32", is_bias=True)
+
     def decode(self):
         import numpy as np
         beam, V = self._beam_size, self._target_dict_dim
@@ -221,19 +252,22 @@ class BeamSearchDecoder:
         scores = L.expand(scores, [ids.shape[0], 1])          # [B, bm]
         finished = L.cast(L.zeros_like(scores), "bool")
         step_ids, step_scores = [], []
+        from ...fluid.layer_helper import LayerHelper
+        if getattr(self, "embedding_weight", None) is None:
+            self.embedding_weight = LayerHelper(
+                "beam_search_decoder").create_parameter(
+                None, [V, self._word_dim], "float32")
         for t in range(self._max_len):
             flat_ids = L.reshape(ids, [-1])                   # [B*bm]
-            emb = L.embedding(L.reshape(flat_ids, [-1, 1]),
-                              size=[V, self._word_dim],
-                              is_sparse=self._sparse_emb,
-                              param_attr=None)
-            emb = L.reshape(emb, [-1, self._word_dim])
+            emb = L.gather(self.embedding_weight, flat_ids)   # [B*bm, D]
             feed = {"x": emb}
             feed.update(self._input_var_dict)
             self._state_cell.compute_state(inputs=feed)
             self._state_cell.update_states()
             out = self._state_cell.out_state()                # [B*bm, H]
-            logp = L.log(L.softmax(L.fc(out, size=V)) + 1e-12)  # [B*bm, V]
+            self._ensure_proj(int(out.shape[-1]))
+            logits = L.matmul(out, self.proj_weight) + self.proj_bias
+            logp = L.log(L.softmax(logits) + 1e-12)           # [B*bm, V]
             logp = L.reshape(logp, [-1, beam, V])
             # frozen lanes only extend with end_id at zero cost
             mask = L.cast(finished, "float32")                # [B, bm]

@@ -26,12 +26,17 @@ def extend_with_decoupled_weight_decay(base_optimizer):
             # parameter AFTER the base update, decoupled from the gradient
             op = super()._append_optimize_op(param, grad)
             if self._decoupled_coeff:
-                factor = 1.0 - self._current_lr() * self._decoupled_coeff
                 from ...fluid.framework import in_dygraph_mode
                 if in_dygraph_mode():
+                    factor = 1.0 - self._current_lr() * \
+                        self._decoupled_coeff
                     param._value = param._value * factor
                 else:
+                    # self._lr_var tracks the live schedule (a Variable),
+                    # so the decay follows lr decay like the reference's
+                    # DecoupledWeightDecay
                     from ...fluid import layers as L
+                    factor = 1.0 - self._lr_var * self._decoupled_coeff
                     L.assign(param * factor, output=param)
             return op
 

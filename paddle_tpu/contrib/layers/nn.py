@@ -5,7 +5,7 @@ in the op catalog (ops/{ctr,misc,nlp,random,fused_extra,catalog_tail}_ops.py);
 padded/segment layouts replace LoD per the SURVEY §7 LoD design stance."""
 from __future__ import annotations
 
-from ...fluid.layer_helper import LayerHelper
+from ...fluid.layer_helper import LayerHelper, emit_op
 from ...fluid.framework import in_dygraph_mode
 from ...fluid import layers as L
 
@@ -22,12 +22,19 @@ __all__ = [
 
 
 def _emit(op_type, ins, out_slots, attrs=None, dtype=None):
-    helper = LayerHelper(op_type)
-    outs = {s: [helper.create_variable_for_type_inference(dtype=dtype)]
-            for s in out_slots}
-    op = helper.append_op(op_type, inputs=ins, outputs=outs,
-                          attrs=attrs or {})
-    got = op if in_dygraph_mode() else outs
+    """Tuple-unpacking sugar over the shared mode-agnostic emit_op
+    (fluid/layer_helper.py) — one op-emission implementation for the whole
+    framework.  `dtype` annotates the created output vars in static mode
+    (int-output ops like tdm_child)."""
+    if dtype is not None and not in_dygraph_mode():
+        helper = LayerHelper(op_type)
+        outs = {s: [helper.create_variable_for_type_inference(dtype=dtype)]
+                for s in out_slots}
+        helper.append_op(op_type, inputs=ins, outputs=outs,
+                         attrs=attrs or {})
+        got = outs
+    else:
+        got = emit_op(op_type, op_type, ins, out_slots, attrs or {})
     vals = tuple(got[s][0] for s in out_slots)
     return vals if len(vals) > 1 else vals[0]
 

@@ -52,10 +52,27 @@ class BasicLSTMUnit(LSTMCell):
         return h, c
 
 
+def _layer_init(init_h, init_c, idx, is_lstm):
+    """Slice layer `idx`'s initial state out of the stacked
+    [num_layers(*dirs), B, H] init tensors (None -> cell zeros)."""
+    if init_h is None:
+        return None
+    h = L.squeeze(L.slice(init_h, axes=[0], starts=[idx],
+                          ends=[idx + 1]), [0])
+    if not is_lstm:
+        return h
+    c = L.squeeze(L.slice(init_c, axes=[0], starts=[idx],
+                          ends=[idx + 1]), [0]) if init_c is not None \
+        else L.zeros_like(h)
+    return (h, c)
+
+
 def _stacked(cell_cls, input, hidden_size, num_layers, bidirectional,
-             batch_first, dropout_prob, is_lstm):
+             batch_first, dropout_prob, is_lstm, init_hidden=None,
+             init_cell=None):
     """Shared multi-layer runner for basic_gru/basic_lstm on padded
-    [B, T, D] (batch_first) or [T, B, D] input."""
+    [B, T, D] (batch_first) or [T, B, D] input.  init_hidden/init_cell:
+    [num_layers * num_directions, B, H] stacked like the outputs."""
     x = input if batch_first else L.transpose(input, [1, 0, 2])
     last_h, last_c = [], []
     for layer in range(num_layers):
@@ -63,11 +80,18 @@ def _stacked(cell_cls, input, hidden_size, num_layers, bidirectional,
         if bidirectional:
             fw = cell_cls(in_size, hidden_size)
             bw = cell_cls(in_size, hidden_size)
-            x, states = BiRNN(fw, bw)(x)
+            init = None
+            if init_hidden is not None:
+                init = (_layer_init(init_hidden, init_cell, 2 * layer,
+                                    is_lstm),
+                        _layer_init(init_hidden, init_cell, 2 * layer + 1,
+                                    is_lstm))
+            x, states = BiRNN(fw, bw)(x, init)
             sts = list(states)
         else:
             cell = cell_cls(in_size, hidden_size)
-            x, st = RNN(cell)(x)
+            x, st = RNN(cell)(x, _layer_init(init_hidden, init_cell,
+                                             layer, is_lstm))
             sts = [st]
         for st in sts:
             if is_lstm:
@@ -91,7 +115,8 @@ def basic_gru(input, init_hidden, hidden_size, num_layers=1,
               gate_activation=None, activation=None, dtype="float32",
               name="basic_gru"):
     return _stacked(GRUCell, input, hidden_size, num_layers, bidirectional,
-                    batch_first, dropout_prob, is_lstm=False)
+                    batch_first, dropout_prob, is_lstm=False,
+                    init_hidden=init_hidden)
 
 
 def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
@@ -100,4 +125,5 @@ def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
                gate_activation=None, activation=None, forget_bias=1.0,
                dtype="float32", name="basic_lstm"):
     return _stacked(LSTMCell, input, hidden_size, num_layers, bidirectional,
-                    batch_first, dropout_prob, is_lstm=True)
+                    batch_first, dropout_prob, is_lstm=True,
+                    init_hidden=init_hidden, init_cell=init_cell)

@@ -34,16 +34,6 @@ def cast_parameters_to_fp16(place, program, scope=None, to_fp16_var_names=None):
     return None
 
 
-def _emit(op_type, ins, out_slots, attrs):
-    helper = LayerHelper(op_type)
-    outs = {s: [helper.create_variable_for_type_inference()]
-            for s in out_slots}
-    op = helper.append_op(op_type, inputs=ins, outputs=outs, attrs=attrs)
-    got = op if in_dygraph_mode() else outs
-    vals = tuple(got[s][0] for s in out_slots)
-    return vals if len(vals) > 1 else vals[0]
-
-
 def check_finite_and_unscale(x, scale, name=None):
     """amp_nn.check_finite_and_unscale: out_i = x_i / scale and a bool
     FoundInfinite reduced over all inputs."""
@@ -64,15 +54,15 @@ def update_loss_scaling(x, found_inf, prev_loss_scaling, num_good_steps,
                         num_bad_steps, incr_every_n_steps,
                         decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
                         name=None):
+    """In-place contract like the reference op (amp_nn.py): the scale and
+    the good/bad step counters are UPDATED — the outputs are wired onto
+    the input vars so the dynamic schedule advances across iterations."""
     xs = x if isinstance(x, (list, tuple)) else [x]
     helper = LayerHelper("update_loss_scaling")
-    outs = {"Out": [helper.create_variable_for_type_inference()
-                    for _ in xs],
-            "LossScaling": [helper.create_variable_for_type_inference()],
-            "OutGoodSteps": [helper.create_variable_for_type_inference(
-                dtype="int32")],
-            "OutBadSteps": [helper.create_variable_for_type_inference(
-                dtype="int32")]}
+    outs = {"Out": list(xs),
+            "LossScaling": [prev_loss_scaling],
+            "OutGoodSteps": [num_good_steps],
+            "OutBadSteps": [num_bad_steps]}
     op = helper.append_op(
         "update_loss_scaling",
         inputs={"X": list(xs), "FoundInfinite": [found_inf],
@@ -83,5 +73,11 @@ def update_loss_scaling(x, found_inf, prev_loss_scaling, num_good_steps,
         attrs={"incr_every_n_steps": incr_every_n_steps,
                "decr_every_n_nan_or_inf": decr_every_n_nan_or_inf,
                "incr_ratio": incr_ratio, "decr_ratio": decr_ratio})
-    got = op if in_dygraph_mode() else outs
-    return list(got["Out"]), got["LossScaling"][0]
+    if in_dygraph_mode():
+        # eager: write the produced values back into the passed VarBases
+        for vb, nv in zip(xs, op["Out"]):
+            vb.set_value(nv._value)
+        prev_loss_scaling.set_value(op["LossScaling"][0]._value)
+        num_good_steps.set_value(op["OutGoodSteps"][0]._value)
+        num_bad_steps.set_value(op["OutBadSteps"][0]._value)
+    return list(xs), prev_loss_scaling

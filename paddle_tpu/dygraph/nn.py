@@ -462,12 +462,13 @@ class SpectralNorm(Layer):
                      "V": [self.weight_v]}, ("Out",),
                     {"dim": dim, "power_iters": iters,
                      "eps": eps})["Out"][0]
-        if in_dygraph_mode():
+        if in_dygraph_mode() and iters > 0:
             import jax.numpy as jnp
             wv = weight._value if hasattr(weight, "_value") else weight
             wm = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
             u = self.weight_u._value
-            for _ in range(max(self._cfg[1], 0)):
+            v = self.weight_v._value
+            for _ in range(iters):
                 v = wm.T @ u
                 v = v / jnp.maximum(jnp.linalg.norm(v), eps)
                 u = wm @ v

@@ -30,6 +30,9 @@ from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import dygraph
 from ..contrib import memory_usage_calc as _muc  # noqa: F401 (cycle guard)
 from .. import contrib                            # fluid.contrib alias
+from . import transpiler
+from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
+                         memory_optimize, release_memory)
 from .data_feeder import DataFeeder
 from . import metrics
 from . import dataset

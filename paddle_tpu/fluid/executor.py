@@ -212,6 +212,12 @@ class Executor:
         if hasattr(program, "_program"):   # CompiledProgram
             mesh = getattr(program, "_mesh", None) or mesh
             program = program._program
+        if program._hints.get("ps_server") is not None:
+            # pserver program from DistributeTranspiler.get_pserver_program:
+            # running it IS the server loop (listen_and_serv_op role) —
+            # blocks until the trainers send stop
+            from .transpiler.distribute_transpiler import serve_ps_program
+            return serve_ps_program(program._hints["ps_server"])
         if (program._hints.get("ps_plan") is not None
                 and not getattr(self, "_in_ps_run", False)):
             # PS-served program: the pull -> device step -> push loop

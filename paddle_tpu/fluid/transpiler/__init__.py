@@ -1,0 +1,11 @@
+"""fluid.transpiler namespace (reference python/paddle/fluid/transpiler/)."""
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+from .geo_sgd_transpiler import GeoSgdTranspiler
+from .ps_dispatcher import PSDispatcher, HashName, RoundRobin
+from .memory_optimization_transpiler import memory_optimize, release_memory
+from . import collective
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "GeoSgdTranspiler", "PSDispatcher", "HashName", "RoundRobin",
+           "memory_optimize", "release_memory", "collective"]

@@ -1,0 +1,92 @@
+"""fluid.transpiler.collective analog (reference transpiler/collective.py):
+program rewriters that make a single-device program data-parallel by
+inserting collective ops — the GradAllReduce / LocalSGD tier under the
+1.x collective fleet (incubate/fleet/collective uses these).
+
+TPU design: c_allreduce_sum ops lower to lax.psum over the mesh axis
+registered for their ring_id (ops/collective_ops.py), so "insert
+c_allreduce on every grad" is the whole transform — bucketing/fusion and
+stream ordering are XLA's job."""
+from __future__ import annotations
+
+from ..framework import _OPTIMIZER_OP_TYPES
+
+__all__ = ["GradAllReduce", "LocalSGD"]
+
+
+class Collective:
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        self.startup_program = startup_program
+        self.main_program = main_program
+        self.rank = rank
+        self.nranks = len(endpoints.split(",")
+                          if isinstance(endpoints, str) else endpoints)
+        self._transpile_main_program()
+        return main_program
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    """Insert scale(1/nranks) + c_allreduce_sum on every gradient consumed
+    by an optimizer op (multi_devices_graph_pass AllReduce mode analog)."""
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        grads = []
+        for op in block.ops:
+            if op.type in _OPTIMIZER_OP_TYPES:
+                g = op.input("Grad")
+                if g:
+                    grads.append(g[0])
+        if not grads:
+            raise ValueError("GradAllReduce: no optimizer ops found — "
+                             "transpile after optimizer.minimize")
+        first_opt = next(i for i, op in enumerate(block.ops)
+                         if op.type in _OPTIMIZER_OP_TYPES)
+        n_before = len(block.ops)
+        for name in grads:
+            block.append_op("scale", {"X": [name]}, {"Out": [name]},
+                            {"scale": 1.0 / max(self.nranks, 1),
+                             "op_role": 1})
+            block.append_op("c_allreduce_sum", {"X": [name]},
+                            {"Out": [name]},
+                            {"ring_id": 0, "use_calc_stream": True,
+                             "op_role": 1})
+        # the new ops must run after backward but BEFORE the updates
+        new_ops = block.ops[n_before:]
+        del block.ops[n_before:]
+        block.ops[first_opt:first_opt] = new_ops
+        self.main_program._bump_version()
+
+
+class LocalSGD(Collective):
+    """Every k steps, average the PARAMETERS across ranks instead of the
+    per-step gradients (localsgd_optimizer.py concept).  The rewrite
+    appends scale + c_allreduce_sum on each param after its optimizer op;
+    step-gating lives in the LocalSGD meta-optimizer tier."""
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        params = []
+        for op in block.ops:
+            if op.type in _OPTIMIZER_OP_TYPES:
+                p = op.input("Param")
+                if p:
+                    params.append(p[0])
+        if not params:
+            raise ValueError("LocalSGD: no optimizer ops found")
+        for name in params:
+            block.append_op("scale", {"X": [name]}, {"Out": [name]},
+                            {"scale": 1.0 / max(self.nranks, 1),
+                             "op_role": 1})
+            block.append_op("c_allreduce_sum", {"X": [name]},
+                            {"Out": [name]},
+                            {"ring_id": 0, "use_calc_stream": True,
+                             "op_role": 1})
+        self.main_program._bump_version()

@@ -1,0 +1,274 @@
+"""fluid.transpiler.distribute_transpiler analog (reference transpiler/
+distribute_transpiler.py DistributeTranspiler:256).
+
+The reference rewrites the program into send/recv ops against
+listen_and_serv pserver programs.  The TPU build's PS runtime
+(distributed/ps/) replaces that op plumbing with a pull -> device-step ->
+push loop driven by a PsPlan carried in program._hints, served by the TCP
+RPC table tier.  This shim keeps the 1.x user flow:
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id, pservers=..., trainers=N, sync_mode=...)
+    # pserver process:
+    ps_prog = t.get_pserver_program(ep)       # blocks inside exe.run
+    exe.run(t.get_startup_program(ep, ps_prog))
+    exe.run(ps_prog)
+    # trainer process:
+    exe.run(startup); exe.run(t.get_trainer_program(), feed=..., ...)
+
+by translating transpile() arguments into the same PsPlan the fleet 2.0
+pass produces (optimizer ops stripped from the trainer, sparse lookups
+swapped to ps_lookup_rows, accessor kind + lr lifted from the optimizer
+ops), and into the env contract the PS runtime reads."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..framework import (default_main_program, default_startup_program,
+                         Parameter, _OPTIMIZER_OP_TYPES)
+from .ps_dispatcher import RoundRobin
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """Reference DistributeTranspilerConfig (transpiler knobs).  Block
+    slicing (slice_var_up/min_block_size) has no analog: the TPU-side
+    tables shard by feasign hash, not by param block."""
+    slice_var_up = True
+    split_method = RoundRobin
+    min_block_size = 8192
+    enable_dc_asgd = False
+    sync_mode = None
+    runtime_split_send_recv = False
+    half_async = False
+    completely_not_async = False
+    # GEO knobs (geo_sgd_transpiler reads them)
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+
+
+_ACCESSOR_OF_OP = {"sgd": "sgd", "momentum": "sgd", "adagrad": "adagrad",
+                   "adam": "adam", "adamw": "adam", "lamb": "adam",
+                   "rmsprop": "adagrad", "ftrl": "sgd", "dpsgd": "sgd",
+                   "lars_momentum": "sgd", "dgc_momentum": "sgd"}
+
+
+def _lr_value_of(program, startup, lr_name, default=0.01):
+    """The lr var is seeded by a fill op in one of the two programs (the
+    create_global_var pattern); read its value."""
+    for prog in (startup, program):
+        if prog is None:
+            continue
+        for b in prog.blocks:
+            for op in b.ops:
+                if op.type == "fill_constant" and \
+                        lr_name in op.output_arg_names:
+                    return float(op.attr("value", default))
+    return default
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._plan = None
+        self._program = None
+        self._startup = None
+        self._eps = []
+        self._trainers = 1
+        self._trainer_id = 0
+
+    # -- the rewrite ---------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        from ...distributed.ps.program_pass import (PsPlan,
+                                                    _startup_init_kind,
+                                                    ROWS_SUFFIX, GRAD_SUFFIX,
+                                                    _SPARSE_LOOKUP_TYPES)
+
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        self._program, self._startup = program, startup
+        self._eps = [e.strip() for e in pservers.split(",") if e.strip()]
+        self._trainers, self._trainer_id = int(trainers), int(trainer_id)
+
+        mode = "sync" if sync_mode else "async"
+        if getattr(self.config, "geo_sgd_mode", False):
+            mode = "geo"
+        block = program.global_block()
+
+        # 0. the 1.x contract is minimize-then-transpile, but the swap
+        #    below changes what the backward must differentiate (W-grad
+        #    becomes pulled-rows-grad) — so lift the optimizer facts, find
+        #    the loss from its grad seed, strip backward+optimizer ops,
+        #    and re-derive backward AFTER the swap
+        accessor, lr, loss_name = None, None, None
+        for b in program.blocks:
+            for op in b.ops:
+                if (loss_name is None and op.type == "fill_constant"
+                        and op.attr("op_role", 0) == 1):
+                    out = op.output_arg_names[0]
+                    if out.endswith("@GRAD"):
+                        loss_name = out[:-len("@GRAD")]
+                if accessor is None and op.type in _OPTIMIZER_OP_TYPES:
+                    accessor = _ACCESSOR_OF_OP.get(op.type, "sgd")
+                    lr_in = op.input("LearningRate")
+                    lr = _lr_value_of(program, startup,
+                                      lr_in[0] if lr_in else "")
+        if accessor is None:
+            raise ValueError(
+                "transpile() found no optimizer ops — call "
+                "optimizer.minimize(loss) before transpiling (the 1.x flow)")
+        for b in program.blocks:
+            b.ops = [op for op in b.ops
+                     if op.attr("op_role", 0) == 0
+                     and op.type != "generic_grad"
+                     and not op.type.endswith("_grad")
+                     and op.type not in _OPTIMIZER_OP_TYPES]
+            b.program._bump_version()
+
+        # 1. sparse lookups -> ps_lookup_rows (same in-place swap as
+        #    apply_ps_pass)
+        plan_sparse = []
+        sparse_params = set()
+        for op in block.ops:
+            if op.type not in _SPARSE_LOOKUP_TYPES:
+                continue
+            w_name = op.input("W")[0]
+            w = block._find_var_recursive(w_name)
+            if not isinstance(w, Parameter):
+                continue
+            if not (op.attr("is_sparse") or op.attr("is_distributed")
+                    or getattr(w, "is_distributed", False)):
+                continue
+            ids_name = op.input("Ids")[0]
+            dim = int(w.shape[-1])
+            k = len(plan_sparse)
+            rows_name = f"{w_name}{ROWS_SUFFIX}{k}"
+            rows = block.create_var(name=rows_name, shape=(-1, dim),
+                                    dtype=w.dtype, is_data=True)
+            rows.stop_gradient = False
+            is_v1 = op.type == "lookup_table"
+            pad = op.attr("padding_idx", -1)
+            op.type = "ps_lookup_rows"
+            op.inputs = {"Rows": [rows_name], "Ids": [ids_name]}
+            op.attrs = {"padding_idx": pad, "v1": is_v1, "op_role": 0}
+            init_kind, init_scale = _startup_init_kind(startup, w_name)
+            plan_sparse.append({
+                "table": w_name, "dim": dim, "ids": ids_name,
+                "rows": rows_name, "grad": rows_name + GRAD_SUFFIX,
+                "init_kind": init_kind, "init_scale": init_scale})
+            sparse_params.add(w_name)
+
+        # 2. re-derive backward on the swapped program: dense params get
+        #    their grads back, the pulled rows get rows@GRAD (the tensors
+        #    the push phase ships to the tables); NO optimizer ops — the
+        #    server table IS the optimizer
+        from ..backward import append_backward
+        if loss_name is None:
+            raise ValueError("transpile(): could not locate the loss "
+                             "gradient seed in the minimized program")
+        loss_var = block._find_var_recursive(loss_name)
+        params_grads = append_backward(loss_var)
+        plan_dense = []
+        for p, g in params_grads:
+            if p.name in sparse_params or g is None:
+                continue
+            plan_dense.append({"param": p.name, "grad": g.name,
+                               "shape": list(p.shape)})
+
+        plan = PsPlan(mode, accessor, lr)
+        plan.sparse = plan_sparse
+        plan.dense = plan_dense
+        self._plan = plan
+        program._hints["ps_plan"] = plan
+
+        # 3. env contract the PS runtime reads (rpc endpoints + role)
+        os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(self._eps)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(self._trainers)
+        return program
+
+    # -- role programs -------------------------------------------------------
+    def _init_fleet(self, role, current_endpoint=None):
+        from ...distributed import fleet
+        from ...distributed.fleet.base.role_maker import (UserDefinedRoleMaker,
+                                                          Role)
+        from ...distributed.fleet import DistributedStrategy
+        rm = UserDefinedRoleMaker(
+            current_id=(self._eps.index(current_endpoint)
+                        if role == Role.SERVER and current_endpoint in
+                        self._eps else self._trainer_id),
+            role=role, worker_num=self._trainers,
+            server_endpoints=self._eps)
+        strat = DistributedStrategy()
+        strat.a_sync = self._plan.mode != "sync"
+        if self._plan.mode == "geo":
+            strat.a_sync_configs = {"k_steps": getattr(
+                self.config, "geo_sgd_need_push_nums", 100)}
+        fleet.init(rm, strategy=strat)
+        fleet._fleet_singleton._user_defined_strategy = strat
+        return fleet
+
+    def get_trainer_program(self, wait_port=True):
+        """The rewritten main program; also brings up the worker runtime so
+        a bare `exe.run(program)` drives the pull/step/push loop."""
+        from ...distributed.fleet.base.role_maker import Role
+        fleet = self._init_fleet(Role.WORKER)
+        fleet.init_worker()
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        """A server program: running it in an Executor starts the table
+        server for `endpoint` and blocks until trainers send stop (the
+        listen_and_serv_op role, executor-hooked via the ps_server hint)."""
+        from ..framework import Program
+        prog = Program()
+        prog._hints["ps_server"] = {
+            "endpoint": endpoint,
+            "eps": list(self._eps),
+            "trainers": self._trainers,
+            "mode": self._plan.mode if self._plan else "sync",
+            "geo_k": getattr(self.config, "geo_sgd_need_push_nums", 100),
+        }
+        return prog
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), \
+            self.get_startup_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        """Server-side startup: tables initialise lazily on first pull, so
+        this is an empty program kept for flow parity."""
+        from ..framework import Program
+        return Program()
+
+
+def serve_ps_program(hints):
+    """Executor entry for a get_pserver_program() Program: bring up the
+    table server for this endpoint and block until trainers send stop."""
+    from ...distributed import fleet
+    from ...distributed.fleet.base.role_maker import (UserDefinedRoleMaker,
+                                                      Role)
+    from ...distributed.fleet import DistributedStrategy
+    ep = hints["endpoint"]
+    eps = hints["eps"]
+    host, port = ep.rsplit(":", 1)
+    os.environ["POD_IP"] = host
+    os.environ["PADDLE_PORT"] = port
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(eps)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(hints.get("trainers", 1))
+    rm = UserDefinedRoleMaker(
+        current_id=eps.index(ep) if ep in eps else 0, role=Role.SERVER,
+        worker_num=int(hints.get("trainers", 1)), server_endpoints=eps)
+    strat = DistributedStrategy()
+    strat.a_sync = hints.get("mode", "sync") != "sync"
+    if hints.get("mode") == "geo":
+        strat.a_sync_configs = {"k_steps": hints.get("geo_k", 100)}
+    fleet.init(rm, strategy=strat)
+    fleet._fleet_singleton._user_defined_strategy = strat
+    fleet.init_server()
+    fleet.run_server()
+    return []

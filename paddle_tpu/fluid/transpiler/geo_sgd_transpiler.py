@@ -1,0 +1,17 @@
+"""fluid.transpiler.geo_sgd_transpiler analog (reference transpiler/
+geo_sgd_transpiler.py): GEO-SGD — trainers step locally, push deltas
+every k steps; here the plan mode is "geo" and the GeoCommunicator
+(distributed/ps/communicator.py) batches the delta pushes."""
+from __future__ import annotations
+
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+
+__all__ = ["GeoSgdTranspiler"]
+
+
+class GeoSgdTranspiler(DistributeTranspiler):
+    def __init__(self, config=None):
+        config = config or DistributeTranspilerConfig()
+        config.geo_sgd_mode = True
+        super().__init__(config)

@@ -1,0 +1,27 @@
+"""fluid.transpiler.memory_optimization_transpiler analog.
+
+The reference's var-reuse rewriting (memory_optimization_transpiler.py)
+was already deprecated in 1.8 in favor of build-strategy passes; on this
+stack XLA owns buffer liveness and reuse outright (SURVEY §2.2 TPU note),
+so both entry points are contract-keeping no-ops that warn once."""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    warnings.warn(
+        "memory_optimize is a no-op on the TPU build: XLA performs buffer "
+        "sharing/reuse during compilation (the reference deprecated this "
+        "pass in 1.8 as well)", DeprecationWarning, stacklevel=2)
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    warnings.warn(
+        "release_memory is a no-op on the TPU build: XLA owns HBM "
+        "lifetime", DeprecationWarning, stacklevel=2)
+    return None

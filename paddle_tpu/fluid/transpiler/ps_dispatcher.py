@@ -1,0 +1,46 @@
+"""fluid.transpiler.ps_dispatcher analog (reference transpiler/
+ps_dispatcher.py): assign parameter blocks to parameter-server
+endpoints."""
+from __future__ import annotations
+
+__all__ = ["PSDispatcher", "HashName", "RoundRobin"]
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eplist = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eplist(self):
+        return self._eplist
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """Endpoint by hash of the var name — crc32, so the assignment is
+    stable across PROCESSES (python's builtin hash is salted per run and
+    would route the same param to different servers on each trainer)."""
+
+    def dispatch(self, varlist):
+        import zlib
+        out = []
+        for var in varlist:
+            name = getattr(var, "name", var)
+            idx = zlib.crc32(name.encode("utf-8")) % len(self._eplist)
+            out.append(self._eplist[idx])
+        return out
+
+
+class RoundRobin(PSDispatcher):
+    def dispatch(self, varlist):
+        out = []
+        for _var in varlist:
+            out.append(self._eplist[self._step % len(self._eplist)])
+            self._step += 1
+        return out

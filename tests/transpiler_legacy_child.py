@@ -1,0 +1,108 @@
+"""Child for the legacy DistributeTranspiler flow test (reference
+transpiler/distribute_transpiler.py usage):
+
+  ROLE=PSERVER  -> exe.run(t.get_pserver_program(ep))     # blocks serving
+  ROLE=TRAINER  -> t.transpile(...); exe.run(t.get_trainer_program())
+  ROLE=LOCAL    -> same model WITHOUT transpiling (plain SGD oracle)
+
+The trainer prints one JSON line {"losses": [...], "fc_w": [...]} so the
+parent can compare trajectories against the oracle."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+LR = 0.1
+STEPS = 5
+BATCH = 8
+VOCAB, DIM = 60, 4
+
+
+def build(seeded_w):
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers as L
+    from paddle_tpu.fluid.param_attr import ParamAttr
+    from paddle_tpu.fluid.initializer import (ConstantInitializer,
+                                              NumpyArrayInitializer)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = L.data("ids", [-1, 2], dtype="int64")
+        label = L.data("label", [-1, 1])
+        emb = L.embedding(ids, (VOCAB, DIM), is_sparse=True,
+                          param_attr=ParamAttr(
+                              name="legacy_emb",
+                              initializer=ConstantInitializer(0.0)))
+        flat = L.reshape(emb, [-1, 2 * DIM])
+        pred = L.fc(flat, 1,
+                    param_attr=ParamAttr(
+                        name="legacy_fc_w",
+                        initializer=NumpyArrayInitializer(seeded_w)),
+                    bias_attr=ParamAttr(
+                        name="legacy_fc_b",
+                        initializer=ConstantInitializer(0.0)))
+        loss = L.mean(L.square(pred - label))
+        fluid.optimizer.SGDOptimizer(LR).minimize(loss)
+    return main, startup, loss
+
+
+def batches():
+    r = np.random.RandomState(7)
+    for _ in range(STEPS):
+        yield {"ids": r.randint(0, VOCAB, (BATCH, 2)).astype("int64"),
+               "label": r.randn(BATCH, 1).astype("float32")}
+
+
+def main():
+    import paddle_tpu.fluid as fluid
+
+    role = os.environ["ROLE"]
+    eps = os.environ.get("EPS", "")
+    seeded_w = (np.random.RandomState(3).randn(2 * DIM, 1) * 0.1
+                ).astype("float32")
+
+    if role == "PSERVER":
+        t = fluid.DistributeTranspiler()
+        main_p, startup, loss = build(seeded_w)
+        t.transpile(0, program=main_p, pservers=eps, trainers=1,
+                    sync_mode=False, startup_program=startup)
+        exe = fluid.Executor()
+        exe.run(t.get_startup_program(eps))
+        exe.run(t.get_pserver_program(eps))       # blocks until stop
+        return
+
+    main_p, startup, loss = build(seeded_w)
+    exe = fluid.Executor()
+    if role == "TRAINER":
+        t = fluid.DistributeTranspiler()
+        t.transpile(0, program=main_p, pservers=eps, trainers=1,
+                    sync_mode=False, startup_program=startup)
+        train_prog = t.get_trainer_program()
+    else:                                          # LOCAL oracle
+        train_prog = main_p
+
+    exe.run(startup)
+    losses = []
+    for feed in batches():
+        lv, = exe.run(train_prog, feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(())))
+
+    if role == "TRAINER":
+        import paddle_tpu.distributed.fleet as fleet
+        rt = fleet._fleet_singleton._runtime_handle
+        fc_w = np.asarray(rt.ps_pull_dense("legacy_fc_w")).reshape(-1)
+        fleet.stop_worker()
+    else:
+        from paddle_tpu.fluid.core import global_scope
+        fc_w = np.asarray(global_scope().find_var("legacy_fc_w")).reshape(-1)
+    print(json.dumps({"losses": losses, "fc_w": fc_w.tolist()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
