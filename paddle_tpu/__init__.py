@@ -149,3 +149,8 @@ def summary(net, input_size=None, dtypes=None):
     lines.append(f"Total params: {total:,}  (trainable {trainable:,})")
     print("\n".join(lines))
     return {"total_params": total, "trainable_params": trainable}
+
+from . import compat     # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import reader     # noqa: E402,F401
+from . import hapi       # noqa: E402,F401

@@ -1,0 +1,36 @@
+"""paddle.compat analog (reference python/paddle/compat.py): py2/py3
+string+arithmetic helpers the reference API still exports."""
+from __future__ import annotations
+
+__all__ = ["long_type", "to_text", "to_bytes", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, list):
+        return [to_text(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_text(o, encoding) for o in obj}
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return str(obj) if not isinstance(obj, str) else obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if isinstance(obj, list):
+        return [to_bytes(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_bytes(o, encoding) for o in obj}
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return obj
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -1,0 +1,107 @@
+"""Quantized layer twins + fake-quant helpers (reference imperative/
+quant_nn.py): simulate int8 storage in the forward while training in
+float (STE gradients come free from the straight-through round vjp of
+the fake_quantize lowering family)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .....dygraph.layers import Layer
+
+__all__ = ["FakeQuantMovingAverage", "FakeQuantAbsMax",
+           "FakeChannelWiseQuantDequantAbsMax",
+           "MovingAverageAbsMaxScale", "QuantizedConv2D",
+           "QuantizedLinear"]
+
+
+def _fake_quant(x, bits, scale):
+    import jax.numpy as jnp
+    v = getattr(x, "_value", x)
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, jnp.float32), 1e-9)
+    q = jnp.clip(jnp.round(v / s * qmax), -qmax, qmax) * s / qmax
+    from .....dygraph.base import VarBase
+    out = VarBase(q, stop_gradient=getattr(x, "stop_gradient", True))
+    return out
+
+
+class FakeQuantAbsMax(Layer):
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._bits = quant_bits
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        scale = jnp.abs(getattr(x, "_value", x)).max()
+        return _fake_quant(x, self._bits, scale)
+
+
+class FakeQuantMovingAverage(Layer):
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32"):
+        super().__init__()
+        self._bits = quant_bits
+        self._rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        cur = float(jnp.abs(getattr(x, "_value", x)).max())
+        self._scale = cur if self._scale is None else \
+            self._rate * self._scale + (1 - self._rate) * cur
+        return _fake_quant(x, self._bits, self._scale)
+
+
+class FakeChannelWiseQuantDequantAbsMax(Layer):
+    def __init__(self, name=None, quant_bits=8, quant_axis=0,
+                 dtype="float32"):
+        super().__init__()
+        self._bits = quant_bits
+        self._axis = quant_axis
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        v = getattr(x, "_value", x)
+        axes = tuple(i for i in range(v.ndim) if i != self._axis)
+        scale = jnp.abs(v).max(axis=axes, keepdims=True)
+        return _fake_quant(x, self._bits, scale)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._rate = moving_rate
+        self.scale = None
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        cur = float(jnp.abs(getattr(x, "_value", x)).max())
+        self.scale = cur if self.scale is None else \
+            self._rate * self.scale + (1 - self._rate) * cur
+        return x
+
+
+class _QuantizedWrapper(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self._inner = layer
+        self._w_quant = FakeQuantAbsMax(quant_bits=weight_bits)
+        self._a_quant = FakeQuantMovingAverage(quant_bits=activation_bits)
+
+    def forward(self, x):
+        x = self._a_quant(x)
+        w_orig = self._inner.weight
+        self._inner.weight = self._w_quant(w_orig)
+        try:
+            out = self._inner(x)
+        finally:
+            self._inner.weight = w_orig
+        return out
+
+
+class QuantizedConv2D(_QuantizedWrapper):
+    pass
+
+
+class QuantizedLinear(_QuantizedWrapper):
+    pass

@@ -133,3 +133,80 @@ def quant_aware(program, weight_bits=8, activation_bits=8, **kw):
 def convert(program):
     """paddleslim-style freeze for inference."""
     return QuantizationFreezePass().apply(program)
+
+
+class ConvertToInt8Pass:
+    """reference quantization_pass.py ConvertToInt8Pass: persist weights
+    as int8 after freeze.  The artifact tier stores the int8 payload +
+    scale sidecar (slim convert() embeds scales); this pass records the
+    intent on the program."""
+
+    def __init__(self, scope=None, place=None, quantizable_op_type=None):
+        self._scope = scope
+
+    def apply(self, graph_or_program):
+        p = getattr(graph_or_program, "_program", graph_or_program)
+        p._hints["int8_weights"] = True
+        return graph_or_program
+
+
+class TransformForMobilePass:
+    """Mobile-runtime op renaming has no TPU analog; the pass is the
+    identity, kept for pipeline parity."""
+
+    def apply(self, graph_or_program):
+        return graph_or_program
+
+
+class OutScaleForTrainingPass:
+    """Record output-scale EMA vars for every quantizable activation
+    (reference OutScaleForTrainingPass): the static AMP/quant rewrite
+    consumes program hints."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9):
+        self._rate = moving_rate
+
+    def apply(self, graph_or_program):
+        p = getattr(graph_or_program, "_program", graph_or_program)
+        p._hints.setdefault("out_scales", {})["moving_rate"] = self._rate
+        return graph_or_program
+
+
+class OutScaleForInferencePass:
+    def __init__(self, scope=None):
+        pass
+
+    def apply(self, graph_or_program):
+        return graph_or_program
+
+
+class AddQuantDequantPass:
+    """Insert fake quant-dequant around extra op types (reference
+    AddQuantDequantPass) — delegates to the shared rewrite."""
+
+    def __init__(self, scope=None, place=None, moving_rate=0.9,
+                 quant_bits=8, skip_pattern="skip_quant",
+                 quantizable_op_type=None):
+        self._bits = quant_bits
+        self._ops = quantizable_op_type or ["elementwise_add", "pool2d"]
+
+    def apply(self, graph_or_program):
+        p = getattr(graph_or_program, "_program", graph_or_program)
+        quant_aware(p, weight_bits=self._bits, activation_bits=self._bits,
+                    quantizable_op_type=self._ops)
+        return graph_or_program
+
+
+class Quant2Int8MkldnnPass:
+    """mkldnn int8 deployment pass — N/A on TPU (no mkldnn backend);
+    kept as identity for API parity, the StableHLO AOT artifact is the
+    deployment path."""
+
+    def __init__(self, *a, **kw):
+        pass
+
+    def apply(self, graph_or_program):
+        return graph_or_program
+
+
+QuantInt8MkldnnPass = Quant2Int8MkldnnPass

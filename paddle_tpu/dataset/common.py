@@ -22,3 +22,48 @@ def cache_file(*parts):
     """Path under the data-home contract if it exists, else None."""
     p = os.path.join(data_home(), *parts)
     return p if os.path.exists(p) else None
+
+
+DATA_HOME = data_home()
+
+
+def md5file(fname):
+    import hashlib
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Zero-egress cache contract: resolve the file in the data home or
+    raise with the path to pre-seed (reference dataset/common.py would
+    fetch `url`)."""
+    fname = save_name or os.path.basename(url.split("?")[0])
+    path = os.path.join(data_home(), module_name, fname)
+    if not os.path.exists(path):
+        raise RuntimeError(
+            f"dataset file not cached at {path}; this environment has no "
+            f"network egress — pre-seed it (reference would download "
+            f"{url})")
+    if md5sum and md5file(path) != md5sum:
+        raise RuntimeError(f"md5 mismatch for {path}")
+    return path
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Round-robin shard files across trainers (common.py analog)."""
+    import glob as _glob
+    import pickle
+
+    def reader():
+        flist = sorted(_glob.glob(files_pattern))
+        mine = [f for i, f in enumerate(flist)
+                if i % trainer_count == trainer_id]
+        for fn in mine:
+            with open(fn, "rb") as f:
+                lines = (loader or pickle.load)(f)
+            yield from lines
+    return reader

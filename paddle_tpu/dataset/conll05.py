@@ -1,0 +1,35 @@
+"""dataset.conll05 classic readers (reference dataset/conll05.py) over
+the text Conll05st dataset tier."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached_dataset
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+
+def _ds():
+    from ..text.datasets import Conll05st
+    return cached_dataset(("conll05", "test"), lambda: Conll05st())
+
+
+def get_dict():
+    ds = _ds()
+    return (getattr(ds, "word_dict", {}), getattr(ds, "verb_dict", {}),
+            getattr(ds, "label_dict", {}))
+
+
+def get_embedding():
+    word_dict = get_dict()[0]
+    n = max(len(word_dict), 1)
+    rng = np.random.RandomState(0)
+    return rng.randn(n, 32).astype("float32")
+
+
+def test():
+    def reader():
+        ds = _ds()
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader

@@ -1,0 +1,34 @@
+"""dataset.flowers classic readers (reference dataset/flowers.py) over
+the vision Flowers dataset tier."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached_dataset
+
+__all__ = ["train", "test", "valid"]
+
+
+def _reader(mode):
+    def create():
+        from ..vision.datasets import Flowers
+        return cached_dataset(("flowers", mode),
+                              lambda: Flowers(mode=mode))
+    def reader():
+        ds = create()
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            yield np.asarray(img), int(np.asarray(lab).ravel()[0])
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    return _reader("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=False, cycle=False):
+    return _reader("test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=False):
+    return _reader("valid")

@@ -1,0 +1,95 @@
+"""dataset.image analog (reference dataset/image.py): numpy image
+transforms for the classic reader tier (CHW convention)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_image_bytes", "load_image", "resize_short", "to_chw",
+           "center_crop", "random_crop", "left_right_flip",
+           "simple_transform", "load_and_transform",
+           "batch_images_from_tar"]
+
+
+def load_image(file, is_color=True):
+    from ..vision.image import image_load
+    img = np.asarray(image_load(file, backend="numpy"))
+    if not is_color and img.ndim == 3:
+        img = img.mean(axis=2).astype(img.dtype)
+    return img
+
+
+def load_image_bytes(bytes_data, is_color=True):
+    import io
+    try:
+        from PIL import Image
+        img = np.asarray(Image.open(io.BytesIO(bytes_data)))
+    except ImportError:
+        img = np.load(io.BytesIO(bytes_data))
+    if not is_color and img.ndim == 3:
+        img = img.mean(axis=2).astype(img.dtype)
+    return img
+
+
+def _hwc(img):
+    return img if img.ndim == 3 else img[:, :, None]
+
+
+def resize_short(im, size):
+    im = _hwc(im)
+    h, w = im.shape[:2]
+    scale = size / min(h, w)
+    nh, nw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    ys = (np.arange(nh) * h / nh).astype(int)
+    xs = (np.arange(nw) * w / nw).astype(int)
+    return im[ys][:, xs]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return _hwc(im).transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    im = _hwc(im)
+    h, w = im.shape[:2]
+    sh, sw = max(0, (h - size) // 2), max(0, (w - size) // 2)
+    return im[sh:sh + size, sw:sw + size]
+
+
+def random_crop(im, size, is_color=True):
+    im = _hwc(im)
+    h, w = im.shape[:2]
+    sh = np.random.randint(0, max(h - size, 0) + 1)
+    sw = np.random.randint(0, max(w - size, 0) + 1)
+    return im[sh:sh + size, sw:sw + size]
+
+
+def left_right_flip(im, is_color=True):
+    return _hwc(im)[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size)
+        if np.random.randint(2):
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype("float32")
+    if mean is not None:
+        im -= np.asarray(mean).reshape(-1, 1, 1)
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    raise NotImplementedError(
+        "tar batching requires the raw archive; pre-seed the data home "
+        "and read via the dataset classes instead")

@@ -46,3 +46,8 @@ def train(word_idx=None):
 
 def test(word_idx=None):
     return _reader("test", word_idx)
+
+
+def build_dict(pattern=None, cutoff=150):
+    """reference dataset/imdb.py build_dict: the word index of the tier."""
+    return dict(word_dict())

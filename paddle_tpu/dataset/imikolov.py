@@ -1,0 +1,32 @@
+"""dataset.imikolov classic readers (reference dataset/imikolov.py)."""
+from __future__ import annotations
+
+from .common import cached_dataset
+
+__all__ = ["train", "test", "build_dict"]
+
+
+def _reader(mode, n):
+    def reader():
+        from ..text.datasets import Imikolov
+        ds = cached_dataset(("imikolov", mode, n),
+                            lambda: Imikolov(data_type="NGRAM",
+                                             window_size=n, mode=mode))
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def train(word_idx=None, n=5, data_type="NGRAM"):
+    return _reader("train", n)
+
+
+def test(word_idx=None, n=5, data_type="NGRAM"):
+    return _reader("test", n)
+
+
+def build_dict(min_word_freq=50):
+    from ..text.datasets import Imikolov
+    ds = cached_dataset(("imikolov", "train", 5),
+                        lambda: Imikolov(data_type="NGRAM", window_size=5))
+    return dict(ds.word_idx)

@@ -1,0 +1,83 @@
+"""dataset.movielens classic readers (reference dataset/movielens.py)
+over the text Movielens dataset tier."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached_dataset
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "age_table", "movie_categories", "max_job_id",
+           "user_info", "movie_info", "MovieInfo", "UserInfo"]
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age)) if int(age) in age_table else 0
+        self.job_id = int(job_id)
+
+
+def _ds(mode):
+    from ..text.datasets import Movielens
+    return cached_dataset(("movielens", mode), lambda: Movielens(mode=mode))
+
+
+def _reader(mode):
+    def reader():
+        ds = _ds(mode)
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v).ravel() for v in ds[i])
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def get_movie_title_dict():
+    return {f"t{i}": i for i in range(128)}
+
+
+def max_movie_id():
+    return 4000
+
+
+def max_user_id():
+    return 6040
+
+
+def max_job_id():
+    return 20
+
+
+def movie_categories():
+    return {c: i for i, c in enumerate(
+        ["Action", "Adventure", "Animation", "Children's", "Comedy",
+         "Crime", "Documentary", "Drama", "Fantasy", "Film-Noir",
+         "Horror", "Musical", "Mystery", "Romance", "Sci-Fi", "Thriller",
+         "War", "Western"])}
+
+
+def movie_info():
+    return {i: MovieInfo(i, ["Drama"], f"t{i % 128}")
+            for i in range(1, 64)}
+
+
+def user_info():
+    return {i: UserInfo(i, "M" if i % 2 else "F", 25, i % 20)
+            for i in range(1, 64)}

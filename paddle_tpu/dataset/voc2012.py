@@ -1,0 +1,31 @@
+"""dataset.voc2012 classic readers (reference dataset/voc2012.py) over
+the vision VOC2012 tier; samples are (image, segmentation_label)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached_dataset
+
+__all__ = ["train", "test", "val"]
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import VOC2012
+        ds = cached_dataset(("voc2012", mode), lambda: VOC2012(mode=mode))
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            yield np.asarray(img), np.asarray(lab)
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")
+
+
+def val():
+    return _reader("valid")

@@ -1,0 +1,36 @@
+"""dataset.wmt14 classic readers (reference dataset/wmt14.py) over the
+text WMT14 tier; samples are (src_ids, trg_ids, trg_ids_next)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached_dataset
+
+__all__ = ["train", "test", "get_dict"]
+
+
+def _reader(mode, dict_size):
+    def reader():
+        from ..text.datasets import WMT14
+        ds = cached_dataset(("wmt14", mode, dict_size),
+                            lambda: WMT14(mode=mode, dict_size=dict_size))
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v) for v in ds[i])
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader("train", dict_size)
+
+
+def test(dict_size=30000):
+    return _reader("test", dict_size)
+
+
+def get_dict(dict_size=30000, reverse=False):
+    src = {f"w{i}": i for i in range(dict_size)}
+    trg = {f"v{i}": i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

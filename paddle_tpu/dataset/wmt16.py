@@ -1,0 +1,42 @@
+"""dataset.wmt16 classic readers (reference dataset/wmt16.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import cached_dataset
+
+__all__ = ["train", "test", "validation", "get_dict", "fetch"]
+
+
+def _reader(mode, src_dict_size, trg_dict_size):
+    def reader():
+        from ..text.datasets import WMT16
+        ds = cached_dataset(
+            ("wmt16", mode, src_dict_size, trg_dict_size),
+            lambda: WMT16(mode=mode, src_dict_size=src_dict_size,
+                          trg_dict_size=trg_dict_size))
+        for i in range(len(ds)):
+            yield tuple(np.asarray(v) for v in ds[i])
+    return reader
+
+
+def train(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader("train", src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader("test", src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size=10000, trg_dict_size=10000, src_lang="en"):
+    return _reader("val", src_dict_size, trg_dict_size)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {f"{lang}{i}": i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d
+
+
+def fetch():
+    """Zero-egress: the cache contract serves files; nothing to fetch."""
+    return None

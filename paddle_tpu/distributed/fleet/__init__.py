@@ -46,3 +46,7 @@ __all__ = [
     "UserDefinedRoleMaker", "Fleet", "StrategyCompiler", "meta_optimizers",
     "metrics", "init", "distributed_optimizer", "minimize",
 ]
+from .base.util_factory import UtilBase  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from ...incubate.data_generator import (  # noqa: E402,F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator)

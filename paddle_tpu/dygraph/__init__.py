@@ -12,3 +12,13 @@ from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding, LayerNorm,
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
 from .jit import TracedLayer, declarative
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import (NoamDecay, PiecewiseDecay,
+    NaturalExpDecay, ExponentialDecay, InverseTimeDecay,
+    PolynomialDecay, CosineDecay, LinearLrWarmup, ReduceLROnPlateau,
+    StepDecay, MultiStepDecay, LambdaDecay)
+from . import rnn
+from .base import enabled, no_grad_
+from .. import amp
+from ..amp import amp_guard, AmpScaler
+

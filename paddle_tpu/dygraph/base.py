@@ -112,6 +112,7 @@ class VarBase:
     def __truediv__(self, o): return self._binary("elementwise_div", o)
     def __rtruediv__(self, o): return self._binary("elementwise_div", o, True)
     def __pow__(self, o): return self._binary("elementwise_pow", o)
+    def __rpow__(self, o): return self._binary("elementwise_pow", o, True)
     def __floordiv__(self, o): return self._binary("elementwise_floordiv", o)
     def __rfloordiv__(self, o):
         return self._binary("elementwise_floordiv", o, True)
@@ -623,3 +624,11 @@ def no_grad(fn=None):
         with no_grad_ctx():
             return fn(*a, **k)
     return wrapper
+
+
+def enabled():
+    """reference dygraph/base.py enabled() — alias of in_dygraph_mode."""
+    return _dygraph_tracer() is not None
+
+
+no_grad_ = no_grad      # reference dygraph/base.py no_grad_ alias

@@ -11,3 +11,21 @@ device), not a ProgramDesc of cond/while ops.
 """
 from .ast_transformer import ast_to_static          # noqa: F401
 from . import convert_operators                      # noqa: F401
+
+from . import logging_utils  # noqa: E402,F401
+from .logging_utils import (TranslatorLogger, set_verbosity,  # noqa: E402,F401
+                            set_code_level)
+from . import program_translator  # noqa: E402,F401
+from .program_translator import (ProgramTranslator,  # noqa: E402,F401
+                                 convert_to_static)
+from .internal_transformers import (  # noqa: E402,F401
+    DygraphToStaticAst, BreakContinueTransformer, LoopTransformer,
+    NameVisitor, ReturnTransformer, RETURN_NO_VALUE_MAGIC_NUM,
+    RETURN_NO_VALUE_VAR_NAME, AstNodeWrapper, NodeVarType,
+    StaticAnalysisVisitor)
+from ...jit.dy2static.convert_call_func import convert_call  # noqa: E402,F401
+from ...jit.dy2static import variable_trans_func  # noqa: E402,F401
+from ...jit.dy2static.variable_trans_func import (  # noqa: E402,F401
+    create_bool_as_type, create_fill_constant_node,
+    create_static_variable_gast_node, data_layer_not_check,
+    to_static_variable, to_static_variable_gast_node)

@@ -228,3 +228,115 @@ def convert_while_loop(cond_fn, body_fn, names, args):
     for k, i in enumerate(live):
         final[i] = _rewrap(args[i], res[k])
     return tuple(final)
+
+
+# ---------------------------------------------------------------------------
+# reference convert_operators.py surface: the runtime helpers the rewritten
+# AST calls.  Tensor-aware where it matters; python passthrough otherwise.
+# ---------------------------------------------------------------------------
+
+def cast_bool_if_necessary(var):
+    if _is_tracer(var) and str(getattr(var, "dtype", "")) != "bool":
+        from ...fluid import layers as L
+        return L.cast(var, "bool")
+    return var
+
+
+def convert_logical_and(x_func, y_func):
+    x = x_func() if callable(x_func) else x_func
+    if _is_tracer(x):
+        from ...fluid import layers as L
+        y = y_func() if callable(y_func) else y_func
+        return L.logical_and(cast_bool_if_necessary(x),
+                             cast_bool_if_necessary(y))
+    return x and (y_func() if callable(y_func) else y_func)
+
+
+def convert_logical_or(x_func, y_func):
+    x = x_func() if callable(x_func) else x_func
+    if _is_tracer(x):
+        from ...fluid import layers as L
+        y = y_func() if callable(y_func) else y_func
+        return L.logical_or(cast_bool_if_necessary(x),
+                            cast_bool_if_necessary(y))
+    return x or (y_func() if callable(y_func) else y_func)
+
+
+def convert_logical_not(x):
+    if _is_tracer(x):
+        from ...fluid import layers as L
+        return L.logical_not(cast_bool_if_necessary(x))
+    return not x
+
+
+def convert_len(var):
+    if _is_tracer(var):
+        shape = getattr(var, "shape", None)
+        if shape and isinstance(shape[0], int) and shape[0] >= 0:
+            return shape[0]
+        from ...fluid import layers as L
+        return L.shape(var)[0]
+    return len(var)
+
+
+def convert_assert(cond, message=""):
+    if _is_tracer(cond):
+        from ...fluid import layers as L
+        return L.Assert(cond) if hasattr(L, "Assert") else None
+    assert cond, message
+
+
+def convert_print(*args):
+    out = []
+    for a in args:
+        if _is_tracer(a):
+            from ...fluid import layers as L
+            a = L.Print(a) if hasattr(L, "Print") else a
+        out.append(a)
+    print(*out)
+
+
+def convert_pop(target, *args):
+    if _is_tracer(target):
+        raise TypeError("cannot pop() from a traced tensor; convert the "
+                        "list before tracing")
+    return target.pop(*args)
+
+
+def convert_var_dtype(var, dtype):
+    if _is_tracer(var):
+        from ...fluid import layers as L
+        return L.cast(var, dtype)
+    return {"bool": bool, "int": int, "float": float}[dtype](var)
+
+
+def convert_var_shape(x, idx=None):
+    shape = getattr(x, "shape", None)
+    if shape is None:
+        raise AttributeError("object has no shape")
+    return shape if idx is None else shape[idx]
+
+
+def convert_shape_compare(left, *args):
+    """chained comparison: left op1 v1 op2 v2 ... — tensor-aware: traced
+    operands combine with logical_and instead of python bool()."""
+    import operator as op
+    ops = {"<": op.lt, "<=": op.le, ">": op.gt, ">=": op.ge,
+           "==": op.eq, "!=": op.ne}
+    cur = left
+    result = None
+    for i in range(0, len(args), 2):
+        o, nxt = args[i], args[i + 1]
+        piece = ops[o](cur, nxt)
+        if _is_tracer(piece) or _is_tracer(result):
+            from ...fluid import layers as L
+            piece = cast_bool_if_necessary(piece)
+            result = piece if result is None else \
+                L.logical_and(cast_bool_if_necessary(result), piece)
+        else:
+            piece = bool(piece)
+            result = piece if result is None else (result and piece)
+            if not result:
+                return False
+        cur = nxt
+    return True if result is None else result

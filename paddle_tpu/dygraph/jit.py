@@ -76,3 +76,19 @@ class TracedLayer:
         os.makedirs(dirname, exist_ok=True)
         with open(f"{dirname}/traced_layer.pkl", "wb") as f:
             pickle.dump({"state": self._layer.state_dict()}, f)
+
+
+def dygraph_to_static_func(function):
+    """reference dygraph/jit.py dygraph_to_static_func: convert for use
+    inside a STATIC program build (declarative's static-mode sibling)."""
+    from .dygraph_to_static.program_translator import convert_to_static
+    return convert_to_static(function)
+
+
+from .dygraph_to_static.logging_utils import (set_code_level,  # noqa: E402
+                                              set_verbosity)
+
+
+def not_to_static(func=None):
+    from ..jit import not_to_static as _n
+    return _n(func)

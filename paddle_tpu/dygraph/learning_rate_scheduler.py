@@ -1,0 +1,90 @@
+"""fluid.dygraph.learning_rate_scheduler analog (reference dygraph/
+learning_rate_scheduler.py): the 1.x dygraph LR decay classes.  Each is
+the corresponding 2.0 LRScheduler with the fluid-era constructor
+signature; `__call__` returns the current lr and the fluid optimizers
+consume them as callables (Optimizer._create_global_learning_rate /
+_minimize_dygraph treat a callable lr as a live schedule)."""
+from __future__ import annotations
+
+from ..optimizer import lr as _lr
+
+__all__ = ["NoamDecay", "PiecewiseDecay", "NaturalExpDecay",
+           "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+           "CosineDecay", "LinearLrWarmup", "ReduceLROnPlateau",
+           "StepDecay", "MultiStepDecay", "LambdaDecay"]
+
+
+class NoamDecay(_lr.NoamDecay):
+    def __init__(self, d_model, warmup_steps, begin=1, step=1, dtype=None,
+                 learning_rate=1.0):
+        super().__init__(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+class PiecewiseDecay(_lr.PiecewiseDecay):
+    def __init__(self, boundaries, values, begin=0, step=1, dtype=None):
+        super().__init__(boundaries, values)
+
+
+class NaturalExpDecay(_lr.NaturalExpDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype=None):
+        # fluid form: lr * exp(-rate * floor-or-frac(step/decay_steps));
+        # per-epoch gamma equals decay_rate/decay_steps in the 2.0 class
+        super().__init__(learning_rate, decay_rate / float(decay_steps))
+
+
+class ExponentialDecay(_lr.ExponentialDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype=None):
+        super().__init__(learning_rate,
+                         decay_rate ** (1.0 / float(decay_steps)))
+
+
+class InverseTimeDecay(_lr.InverseTimeDecay):
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype=None):
+        super().__init__(learning_rate, decay_rate / float(decay_steps))
+
+
+class PolynomialDecay(_lr.PolynomialDecay):
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1, dtype=None):
+        super().__init__(learning_rate, decay_steps,
+                         end_lr=end_learning_rate, power=power, cycle=cycle)
+
+
+class CosineDecay(_lr.CosineAnnealingDecay):
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype=None):
+        super().__init__(learning_rate, T_max=epochs)
+
+
+class LinearLrWarmup(_lr.LinearWarmup):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype=None):
+        super().__init__(learning_rate, warmup_steps, start_lr, end_lr)
+
+
+class ReduceLROnPlateau(_lr.ReduceOnPlateau):
+    def __init__(self, learning_rate, mode="min", decay_rate=0.1,
+                 patience=10, verbose=False, threshold=1e-4,
+                 threshold_mode="rel", cooldown=0, min_lr=0, eps=1e-8,
+                 dtype=None):
+        super().__init__(learning_rate, mode=mode, factor=decay_rate,
+                         patience=patience, threshold=threshold,
+                         cooldown=cooldown, min_lr=min_lr)
+
+
+class StepDecay(_lr.StepDecay):
+    def __init__(self, learning_rate, step_size, decay_rate=0.1):
+        super().__init__(learning_rate, step_size, gamma=decay_rate)
+
+
+class MultiStepDecay(_lr.MultiStepDecay):
+    def __init__(self, learning_rate, milestones, decay_rate=0.1):
+        super().__init__(learning_rate, milestones, gamma=decay_rate)
+
+
+class LambdaDecay(_lr.LambdaDecay):
+    def __init__(self, learning_rate, lr_lambda):
+        super().__init__(learning_rate, lr_lambda)

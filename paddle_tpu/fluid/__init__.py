@@ -11,7 +11,7 @@ from .core import (CPUPlace, TPUPlace, CUDAPlace, TPUPinnedPlace, Scope,
                    is_compiled_with_cuda, is_compiled_with_tpu)
 from .framework import (Program, Variable, Parameter, program_guard,
                         default_main_program, default_startup_program,
-                        in_dygraph_mode, unique_name, convert_dtype,
+                        in_dygraph_mode, convert_dtype,
                         cpu_places, device_guard)
 from .executor import Executor
 from .backward import append_backward, gradients
@@ -30,6 +30,7 @@ from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import dygraph
 from ..contrib import memory_usage_calc as _muc  # noqa: F401 (cycle guard)
 from .. import contrib                            # fluid.contrib alias
+from .. import incubate                           # fluid.incubate alias
 from . import transpiler
 from .transpiler import (DistributeTranspiler, DistributeTranspilerConfig,
                          memory_optimize, release_memory)
@@ -51,3 +52,36 @@ def name_scope(prefix=None):
 
 embedding = layers.embedding
 one_hot = layers.one_hot
+
+# --- reference fluid module surface (round-4 global __all__ closure) ---
+from . import average                 # noqa: E402,F401
+from . import communicator            # noqa: E402,F401
+from . import data_feed_desc          # noqa: E402,F401
+from .data_feed_desc import DataFeedDesc  # noqa: E402,F401
+from . import dataloader              # noqa: E402,F401
+from . import default_scope_funcs     # noqa: E402,F401
+from . import device_worker           # noqa: E402,F401
+from . import trainer_desc            # noqa: E402,F401
+from . import trainer_factory         # noqa: E402,F401
+from . import entry_attr              # noqa: E402,F401
+from .entry_attr import ProbabilityEntry, CountFilterEntry  # noqa: E402,F401
+from . import evaluator               # noqa: E402,F401
+from . import generator               # noqa: E402,F401
+from .generator import Generator      # noqa: E402,F401
+from . import install_check           # noqa: E402,F401
+from . import layer_helper_base       # noqa: E402,F401
+from .layer_helper_base import LayerHelperBase  # noqa: E402,F401
+from . import lod_tensor              # noqa: E402,F401
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: E402,F401
+from . import log_helper              # noqa: E402,F401
+from . import parallel_executor       # noqa: E402,F401
+from .parallel_executor import ParallelExecutor  # noqa: E402,F401
+from . import unique_name             # noqa: E402,F401
+from . import wrapped_decorator       # noqa: E402,F401
+from . import distributed             # noqa: E402,F401
+from .average import WeightedAverage  # noqa: E402,F401
+from .communicator import Communicator, LargeScaleKV  # noqa: E402,F401
+from .framework import (cuda_places, cpu_places,  # noqa: E402,F401
+                        cuda_pinned_places, require_version,
+                        load_op_library)
+from .initializer import set_global_initializer  # noqa: E402,F401

@@ -1,0 +1,25 @@
+"""fluid.average analog (reference python/paddle/fluid/average.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = 0.0
+        self.denominator = 0.0
+
+    def add(self, value, weight):
+        value = np.asarray(value, dtype="float64")
+        self.numerator += float(value.sum()) * float(weight)
+        self.denominator += float(weight) * value.size
+
+    def eval(self):
+        if self.denominator == 0.0:
+            raise ValueError("WeightedAverage.eval before any add()")
+        return self.numerator / self.denominator

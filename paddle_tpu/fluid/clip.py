@@ -49,3 +49,9 @@ class GradientClipByGlobalNorm(GradientClipBase):
 # legacy API names
 set_gradient_clip = None
 ErrorClipByValue = GradientClipByValue
+
+
+# 2.0 names for the same classes (reference clip.py __all__ carries both)
+ClipGradByValue = GradientClipByValue
+ClipGradByNorm = GradientClipByNorm
+ClipGradByGlobalNorm = GradientClipByGlobalNorm

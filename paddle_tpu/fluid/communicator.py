@@ -1,0 +1,55 @@
+"""fluid.communicator analog (reference communicator.py over
+operators/distributed/communicator.h): the async/geo gradient
+communicator facade + the LargeScaleKV store handle."""
+from __future__ import annotations
+
+__all__ = ["Communicator", "LargeScaleKV"]
+
+
+class Communicator:
+    def __init__(self, program=None, mode=None, kwargs=None, envs=None):
+        self._mode = mode
+        self._running = False
+        self._comm = None
+
+    def _runtime(self):
+        from ..distributed import fleet
+        return fleet._fleet_singleton._runtime_handle
+
+    def start(self):
+        rt = self._runtime()
+        self._comm = getattr(rt, "communicator", None) if rt else None
+        if self._comm is not None and hasattr(self._comm, "start"):
+            self._comm.start()
+        self._running = True
+
+    def stop(self):
+        if self._comm is not None and hasattr(self._comm, "stop"):
+            self._comm.stop()
+        self._running = False
+
+    def is_running(self):
+        return self._running
+
+
+class LargeScaleKV:
+    """Host-RAM unbounded sparse KV (large_scale_kv.h analog): a thin
+    handle over the PS sparse table tier."""
+
+    def __init__(self, dim=1):
+        from ..distributed.ps.table import CommonSparseTable
+        self._table = CommonSparseTable(dim=dim)
+
+    def save(self, name, dirname=None):
+        import os
+        path = name if dirname is None else os.path.join(dirname, name)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._table.save(path)
+
+    def load(self, name, dirname=None):
+        import os
+        path = name if dirname is None else os.path.join(dirname, name)
+        self._table.load(path)
+
+    def size(self, name=None):
+        return self._table.size()

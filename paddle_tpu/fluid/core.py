@@ -257,7 +257,10 @@ def load_op_library(path: str):
         _load_native_op_library(path)
     else:
         raise ValueError(f"op library must be .py or .so, got {path}")
-    return sorted(set(_registry.all_ops()) - before)
+    new = sorted(set(_registry.all_ops()) - before)
+    for t in new:                      # plugin ops sit outside the
+        _registry.get_op(t).custom = True   # catalog/grad-audit contract
+    return new
 
 
 def _load_native_op_library(path: str):

@@ -1,0 +1,54 @@
+"""fluid.data_feed_desc analog (reference data_feed_desc.py over
+data_feed.proto): slot schema for the C++ DataFeed tier."""
+from __future__ import annotations
+
+__all__ = ["DataFeedDesc"]
+
+
+class DataFeedDesc:
+    def __init__(self, proto_file=None):
+        self._name = "MultiSlotDataFeed"
+        self._batch_size = 32
+        self._slots = []           # {name, type, is_dense, is_used, dim}
+        if proto_file:
+            self._parse(proto_file)
+
+    def _parse(self, path):
+        # minimal prototxt reader for the reference's data_feed.proto files
+        import re
+        text = open(path).read()
+        for m in re.finditer(r"slots\s*\{([^}]*)\}", text):
+            body = m.group(1)
+            def _f(key, default=None):
+                mm = re.search(rf'{key}:\s*"?([\w.]+)"?', body)
+                return mm.group(1) if mm else default
+            self._slots.append({
+                "name": _f("name"), "type": _f("type", "uint64"),
+                "is_dense": _f("is_dense", "false") == "true",
+                "is_used": _f("is_used", "true") == "true"})
+        m = re.search(r"batch_size:\s*(\d+)", text)
+        if m:
+            self._batch_size = int(m.group(1))
+
+    def set_batch_size(self, n):
+        self._batch_size = int(n)
+
+    def set_dense_slots(self, names):
+        for s in self._slots:
+            if s["name"] in names:
+                s["is_dense"] = True
+
+    def set_use_slots(self, names):
+        for s in self._slots:
+            s["is_used"] = s["name"] in names
+
+    def desc(self):
+        lines = [f'name: "{self._name}"',
+                 f"batch_size: {self._batch_size}"]
+        for s in self._slots:
+            lines.append(
+                "slots { name: \"%s\" type: \"%s\" is_dense: %s "
+                "is_used: %s }" % (s["name"], s["type"],
+                                   str(s["is_dense"]).lower(),
+                                   str(s["is_used"]).lower()))
+        return "\n".join(lines)

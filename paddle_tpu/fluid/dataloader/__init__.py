@@ -1,0 +1,18 @@
+"""fluid.dataloader namespace (reference fluid/dataloader/): dataset and
+sampler algebra + worker plumbing — one implementation lives in
+paddle_tpu.io; these modules re-export it under the fluid paths."""
+from . import dataset
+from .dataset import (Dataset, IterableDataset, TensorDataset,
+                      ComposeDataset, ChainDataset, random_split, Subset)
+from . import batch_sampler
+from .batch_sampler import BatchSampler, DistributedBatchSampler
+from . import sampler
+from .sampler import (Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler)
+from . import dataloader_iter
+from .dataloader_iter import get_worker_info
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "random_split", "Subset", "BatchSampler",
+           "DistributedBatchSampler", "Sampler", "SequenceSampler",
+           "RandomSampler", "WeightedRandomSampler", "get_worker_info"]

@@ -1,0 +1,3 @@
+from ...io import BatchSampler, DistributedBatchSampler
+
+__all__ = ["BatchSampler", "DistributedBatchSampler"]

@@ -1,0 +1,3 @@
+from ...io import get_worker_info
+
+__all__ = ["get_worker_info"]

@@ -1,0 +1,5 @@
+from ...io import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                   ChainDataset, random_split, Subset)
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "random_split", "Subset"]

@@ -1,0 +1,5 @@
+from ...io import (Sampler, SequenceSampler, RandomSampler,
+                   WeightedRandomSampler)
+
+__all__ = ["Sampler", "SequenceSampler", "RandomSampler",
+           "WeightedRandomSampler"]

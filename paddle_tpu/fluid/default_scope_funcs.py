@@ -1,0 +1,41 @@
+"""fluid.default_scope_funcs analog: thread-wide scope stack helpers
+over the core Scope (reference default_scope_funcs.py)."""
+from __future__ import annotations
+
+from .core import Scope, global_scope
+
+__all__ = ["get_cur_scope", "enter_local_scope", "leave_local_scope",
+           "var", "find_var", "scoped_function"]
+
+_scope_stack = []
+
+
+def get_cur_scope():
+    return _scope_stack[-1] if _scope_stack else global_scope()
+
+
+def enter_local_scope():
+    _scope_stack.append(get_cur_scope().new_scope()
+                        if hasattr(get_cur_scope(), "new_scope")
+                        else Scope())
+
+
+def leave_local_scope():
+    if _scope_stack:
+        _scope_stack.pop()
+
+
+def var(name):
+    return get_cur_scope().var(name)
+
+
+def find_var(name):
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(func):
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

@@ -1,0 +1,42 @@
+"""fluid.device_worker analog (reference device_worker.py): per-thread
+worker configs paired with TrainerDesc."""
+from __future__ import annotations
+
+__all__ = ["DeviceWorker", "Hogwild", "DownpourSGD", "DownpourSGDOPT",
+           "Section", "BoxPSWorker"]
+
+
+class DeviceWorker:
+    def __init__(self):
+        self._infer = False
+        self._fleet_desc = None
+        self._program = None
+
+    def _set_infer(self, infer=False):
+        self._infer = bool(infer)
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+
+class Hogwild(DeviceWorker):
+    """Lock-free per-thread SGD loop (hogwild_worker.cc:194)."""
+
+
+class DownpourSGD(DeviceWorker):
+    """PS pull->compute->push worker (downpour_worker.cc:739)."""
+
+
+class DownpourSGDOPT(DownpourSGD):
+    pass
+
+
+class Section(DeviceWorker):
+    """Pipeline stage worker (section_worker.cc:44)."""
+
+
+class BoxPSWorker(DeviceWorker):
+    """BoxPS pass-based worker (device_worker.h:619)."""

@@ -1,0 +1,3 @@
+"""fluid.distributed namespace (reference fluid/distributed/: the
+pre-fleet downpour python tier) — served by the incubate fleet shims."""
+from .fleet import Fleet  # noqa: F401

@@ -1,0 +1,5 @@
+"""fluid.distributed.fleet analog (reference fluid/distributed/fleet.py
+Fleet) — the oldest PS facade, aliasing the incubate fleet adapter."""
+from ...incubate.fleet.base.fleet_base import LegacyFleetAdapter as Fleet
+
+__all__ = ["Fleet"]

@@ -12,3 +12,11 @@ from ...dygraph import (guard, to_variable, no_grad, Layer, Sequential,
                         TracedLayer, declarative, enable_dygraph,
                         disable_dygraph)
 from ...dygraph import nn  # noqa: F401
+from . import io  # noqa: E402,F401
+from ...dygraph import jit  # noqa: E402,F401
+from ...dygraph import dygraph_to_static  # noqa: E402,F401
+from ...dygraph import learning_rate_scheduler  # noqa: E402,F401
+from ...dygraph.jit import (dygraph_to_static_func,  # noqa: E402,F401
+                            set_code_level, set_verbosity,
+                            not_to_static)
+from . import profiler  # noqa: E402,F401

@@ -1,0 +1,5 @@
+"""fluid.dygraph.io namespace (reference dygraph/io.py): the loaded
+inference-artifact layer."""
+from ...jit import TranslatedLayer
+
+__all__ = ["TranslatedLayer"]

@@ -1,0 +1,4 @@
+"""fluid.dygraph.profiler namespace (reference dygraph/profiler.py)."""
+from ..profiler import start_gperf_profiler, stop_gperf_profiler  # noqa: F401
+
+__all__ = ["start_gperf_profiler", "stop_gperf_profiler"]

@@ -1,0 +1,33 @@
+"""fluid.entry_attr analog (reference entry_attr.py): admission policies
+for large-scale sparse tables — the CTR accessor tier
+(distributed/ps/table.py) consumes these thresholds."""
+from __future__ import annotations
+
+__all__ = ["ProbabilityEntry", "CountFilterEntry"]
+
+
+class EntryAttr:
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(EntryAttr):
+    def __init__(self, probability):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self._name = "probability_entry"
+        self._probability = float(probability)
+
+    def _to_attr(self):
+        return f"{self._name}:{self._probability}"
+
+
+class CountFilterEntry(EntryAttr):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._name = "count_filter_entry"
+        self._count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"{self._name}:{self._count_filter}"

@@ -1,0 +1,6 @@
+"""fluid.evaluator analog (reference evaluator.py — the pre-metrics
+evaluator tier, deprecated in the reference in favor of fluid.metrics):
+the classes ARE the metrics implementations."""
+from .metrics import ChunkEvaluator, EditDistance, DetectionMAP
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]

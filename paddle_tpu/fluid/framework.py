@@ -107,6 +107,7 @@ class Variable:
     def __truediv__(self, o): return self._binary("elementwise_div", o)
     def __rtruediv__(self, o): return self._binary("elementwise_div", o, True)
     def __pow__(self, o): return self._binary("elementwise_pow", o)
+    def __rpow__(self, o): return self._binary("elementwise_pow", o, True)
     def __floordiv__(self, o): return self._binary("elementwise_floordiv", o)
     def __rfloordiv__(self, o):
         return self._binary("elementwise_floordiv", o, True)
@@ -586,6 +587,48 @@ def _dygraph_tracer():
     return _dygraph_tracer_
 
 
-def cpu_places(count=1):
+def cuda_places(device_ids=None):
+    """Accelerator places (framework.py cuda_places): TPU chips here."""
+    from .core import TPUPlace
+    import jax
+    if device_ids is None:
+        try:
+            device_ids = range(len(jax.devices()))
+        except RuntimeError:
+            device_ids = [0]
+    return [TPUPlace(int(i)) for i in device_ids]
+
+
+def cpu_places(device_count=None, count=None):
+    """count= kept as the historical keyword of this build's first
+    signature; device_count= matches the reference."""
     from .core import CPUPlace
-    return [CPUPlace() for _ in range(count)]
+    import os
+    n = device_count or count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_pinned_places(device_count=None):
+    from .core import TPUPinnedPlace
+    n = device_count or 1
+    return [TPUPinnedPlace() for _ in range(n)]
+
+
+def require_version(min_version, max_version=None):
+    """framework.py require_version analog over the build's version."""
+    from .. import __version__
+
+    def parse(v):
+        return [int(x) for x in str(v).split(".")[:3] if x.isdigit()]
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+
+
+def load_op_library(path):
+    from .core import load_op_library as _l
+    return _l(path)

@@ -1,0 +1,38 @@
+"""fluid.generator analog (reference generator.py / framework
+generator.cc): per-device RNG state handle."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Generator"]
+
+
+class Generator:
+    """RNG state owner.  TPU design: the framework's stateful-op seeds are
+    drawn from the numpy global stream (dygraph) and program random_seed
+    (static) — this handle manages a dedicated numpy Generator for code
+    written against the reference API."""
+
+    def __init__(self, place=None):
+        self._rng = np.random.RandomState()
+        self._seed = None
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._rng = np.random.RandomState(self._seed & 0x7FFFFFFF)
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def initial_seed(self):
+        return self._seed
+
+    def random(self, shape=(1,)):
+        return self._rng.random_sample(shape)
+
+    def get_state(self):
+        return self._rng.get_state()
+
+    def set_state(self, state):
+        self._rng.set_state(state)

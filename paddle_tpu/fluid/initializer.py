@@ -161,3 +161,21 @@ def _to_initializer(x, default=None):
     if isinstance(x, (int, float)):
         return ConstantInitializer(float(x))
     raise TypeError(f"cannot convert {x!r} to an Initializer")
+
+
+_global_weight_initializer = None
+_global_bias_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference initializer.py set_global_initializer: the default
+    initializer for parameters created WITHOUT an explicit one (per-param
+    attr.initializer still wins).  Pass None to reset."""
+    global _global_weight_initializer, _global_bias_initializer
+    _global_weight_initializer = weight_init
+    _global_bias_initializer = bias_init
+
+
+def _global_initializer(is_bias):
+    return _global_bias_initializer if is_bias \
+        else _global_weight_initializer

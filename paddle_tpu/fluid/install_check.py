@@ -1,0 +1,11 @@
+"""fluid.install_check analog (reference install_check.py run_check):
+a tiny end-to-end train step proving the install works."""
+from __future__ import annotations
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    from ..utils import run_check as _rc
+    _rc()
+    print("Your Paddle Fluid is installed successfully!")

@@ -47,8 +47,11 @@ class LayerHelper:
             f"{self.kwargs.get('name') or self.layer_type}.w"
             if not is_bias else
             f"{self.kwargs.get('name') or self.layer_type}.b")
-        init = attr.initializer or default_initializer or (
-            ConstantInitializer(0.0) if is_bias else XavierInitializer())
+        from .initializer import _global_initializer
+        init = attr.initializer or default_initializer or \
+            _global_initializer(is_bias) or (
+                ConstantInitializer(0.0) if is_bias
+                else XavierInitializer())
         init = _to_initializer(init)
 
         if in_dygraph_mode():

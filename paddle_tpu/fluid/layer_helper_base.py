@@ -1,0 +1,5 @@
+"""fluid.layer_helper_base analog: the LayerHelper base surface
+(reference layer_helper_base.py) — one class serves both tiers here."""
+from .layer_helper import LayerHelper as LayerHelperBase
+
+__all__ = ["LayerHelperBase"]

@@ -56,3 +56,10 @@ for _n in ("np", "jax", "jnp", "sys", "itertools", "annotations"):
     if isinstance(globals().get(_n), (_types.ModuleType,)) or _n == "annotations":
         globals().pop(_n, None)
 del _types
+
+from . import learning_rate_scheduler  # noqa: E402,F401
+from .learning_rate_scheduler import (  # noqa: E402,F401
+    exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, noam_decay, cosine_decay,
+    linear_lr_warmup)
+from . import layer_function_generator  # noqa: E402,F401

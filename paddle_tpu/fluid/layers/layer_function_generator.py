@@ -1,0 +1,50 @@
+"""fluid.layers.layer_function_generator analog (reference
+layer_function_generator.py): factories that build layer functions from
+registered op types (the reference reads OpProto; here the op registry)."""
+from __future__ import annotations
+
+import functools
+
+from ..layer_helper import LayerHelper
+from ..framework import in_dygraph_mode
+
+__all__ = ["generate_layer_fn", "generate_activation_fn", "autodoc",
+           "templatedoc", "add_sample_code"]
+
+
+def generate_layer_fn(op_type):
+    from ...ops.registry import get_op
+    get_op(op_type)                      # loud if unknown
+
+    def func(*args, **kwargs):
+        helper = LayerHelper(op_type, **kwargs)
+        x = args[0] if args else kwargs.pop("x", kwargs.pop("input", None))
+        name = kwargs.pop("name", None)
+        out = helper.create_variable_for_type_inference()
+        op = helper.append_op(op_type, inputs={"X": [x]},
+                              outputs={"Out": [out]}, attrs=kwargs)
+        return op["Out"][0] if in_dygraph_mode() else out
+
+    func.__name__ = op_type
+    return func
+
+
+def generate_activation_fn(op_type):
+    return generate_layer_fn(op_type)
+
+
+def autodoc(comment=""):
+    def decorator(func):
+        func.__doc__ = (func.__doc__ or "") + comment
+        return func
+    return decorator
+
+
+def templatedoc(op_type=None):
+    def decorator(func):
+        return func
+    return decorator
+
+
+def add_sample_code(func, code):
+    func.__doc__ = (func.__doc__ or "") + code

@@ -1,0 +1,44 @@
+"""fluid.lod_tensor analog (reference python/paddle/fluid/lod_tensor.py).
+
+LoD design note (SURVEY §7 hard part #1): ragged batches travel as padded
+arrays + per-row lengths on this stack; a "LoDTensor" here is a numpy
+array carrying `recursive_sequence_lengths` metadata so the reference's
+creation helpers keep their contract."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+class _LoDArray(np.ndarray):
+    def recursive_sequence_lengths(self):
+        return self._rec_lens
+
+    def lod(self):
+        offs = [0]
+        for ln in self._rec_lens[0]:
+            offs.append(offs[-1] + ln)
+        return [offs]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    if isinstance(data, list):
+        flat = np.concatenate([np.asarray(d).reshape(-1, 1) for d in data])
+        recursive_seq_lens = [[len(np.asarray(d)) for d in data]]
+        data = flat
+    arr = np.asarray(data).view(_LoDArray)
+    total = sum(recursive_seq_lens[-1])
+    if total != arr.shape[0]:
+        raise ValueError(
+            f"sum of sequence lengths {total} != rows {arr.shape[0]}")
+    arr._rec_lens = [list(l) for l in recursive_seq_lens]
+    return arr
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    rows = sum(recursive_seq_lens[-1])
+    data = np.random.randint(low, high + 1,
+                             size=[rows] + list(base_shape)).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

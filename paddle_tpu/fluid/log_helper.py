@@ -1,0 +1,18 @@
+"""fluid.log_helper analog."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(name, level, fmt=None):
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        if fmt:
+            h.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(h)
+    logger.propagate = False
+    return logger

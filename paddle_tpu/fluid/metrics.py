@@ -99,3 +99,97 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision over accumulated predictions (metrics.py:Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def reset(self):
+        self.tp = self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int64").ravel()
+        labels = np.asarray(labels).astype("int64").ravel()
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def reset(self):
+        self.tp = self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype("int64").ravel()
+        labels = np.asarray(labels).astype("int64").ravel()
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class EditDistance(MetricBase):
+    """Average edit distance + instance error rate (metrics.py:EditDistance);
+    pairs with layers.edit_distance outputs."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, "float32").ravel()
+        self.total_distance += float(d.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(d > 0))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("EditDistance.eval before any update")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class DetectionMAP(MetricBase):
+    """mean Average Precision accumulator (metrics.py:DetectionMAP); the
+    in-graph companion op is layers.detection_map."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self._vals = []
+
+    def update(self, value, weight=1):
+        self._vals.append((float(np.asarray(value).ravel()[0]),
+                           float(weight)))
+
+    def get_map_var(self):
+        return None
+
+    def eval(self):
+        if not self._vals:
+            raise ValueError("DetectionMAP.eval before any update")
+        num = sum(v * w for v, w in self._vals)
+        den = sum(w for _, w in self._vals)
+        return num / max(den, 1e-12)

@@ -1,0 +1,39 @@
+"""fluid.parallel_executor analog (reference parallel_executor.py over
+framework/parallel_executor.cc).
+
+TPU design: ParallelExecutor's SSA-graph replication + AllReduce op
+handles are replaced outright by XLA GSPMD — CompiledProgram
+.with_data_parallel carries the mesh and the executor jits the whole
+block over it (fluid/compiler.py).  This class keeps the reference's
+construct-then-run API over that machinery."""
+from __future__ import annotations
+
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from .executor import Executor
+from .framework import default_main_program
+
+__all__ = ["ParallelExecutor", "BuildStrategy", "ExecutionStrategy"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        program = main_program or default_main_program()
+        self._compiled = CompiledProgram(program).with_data_parallel(
+            loss_name=loss_name, build_strategy=build_strategy,
+            exec_strategy=exec_strategy,
+            share_vars_from=getattr(share_vars_from, "_compiled", None))
+        self._exe = Executor()
+        self._scope = scope
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._compiled, feed=feed,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        pass                        # XLA owns buffers; nothing to drop

@@ -56,3 +56,22 @@ record_event = RecordEvent
 @contextlib.contextmanager
 def cuda_profiler(*a, **k):  # API parity; no CUDA on TPU
     yield
+
+
+def reset_profiler():
+    """Clear accumulated profile events (profiler.py reset_profiler)."""
+    import jax
+    try:
+        jax.profiler.stop_trace()
+    except RuntimeError:
+        pass                          # no trace running
+
+
+def start_gperf_profiler():
+    """dygraph/profiler.py analog — gperftools has no TPU role; the JAX
+    trace profiler (start_profiler) is the supported path."""
+    start_profiler()
+
+
+def stop_gperf_profiler():
+    stop_profiler()

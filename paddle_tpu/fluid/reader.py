@@ -234,3 +234,7 @@ def shuffle(reader, buf_size):
         np.random.shuffle(buf)
         yield from buf
     return shuffled
+
+
+# reference reader.py exports the default batch-collation function
+default_collate_fn = _default_collate

@@ -1,0 +1,67 @@
+"""fluid.trainer_desc analog (reference trainer_desc.py over
+trainer_desc.proto): pure-config descriptions of the trainer/worker pair
+used by train_from_dataset.  On this stack the executor's dataset path
+(fluid/executor.py train_from_dataset + distributed/trainer.py) reads
+these as plain attributes — there is no proto round-trip to C++."""
+from __future__ import annotations
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer", "HeterXpuTrainer", "HeterBoxWorker",
+           "BoxPSTrainer"]
+
+
+class TrainerDesc:
+    def __init__(self):
+        self._thread_num = 1
+        self._device_worker = None
+        self._fleet_desc = None
+        self._program = None
+        self._infer = False
+
+    def set_thread(self, n):
+        self._thread_num = int(n)
+
+    def set_device_worker(self, dw):
+        self._device_worker = dw
+
+    def set_fleet_desc(self, d):
+        self._fleet_desc = d
+
+    def set_program(self, p):
+        self._program = p
+
+    def set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _desc(self):
+        return {"class": type(self).__name__,
+                "thread_num": self._thread_num,
+                "device_worker": type(self._device_worker).__name__
+                if self._device_worker else None,
+                "infer": self._infer}
+
+
+class MultiTrainer(TrainerDesc):
+    pass
+
+
+class DistMultiTrainer(TrainerDesc):
+    pass
+
+
+class PipelineTrainer(TrainerDesc):
+    pass
+
+
+class HeterXpuTrainer(TrainerDesc):
+    """CPU<->accelerator heterogeneous trainer config (trainer.h:163).
+    The runtime analog is the heter-style batch pipeline
+    (distributed/ps/program_pass.py train_ps_pipelined)."""
+
+
+class BoxPSTrainer(TrainerDesc):
+    pass
+
+
+class HeterBoxWorker(TrainerDesc):
+    """qingshui HeterBox trainer tier (heterbox_trainer.cc:32)."""

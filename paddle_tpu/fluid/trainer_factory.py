@@ -1,0 +1,71 @@
+"""fluid.trainer_factory analog (reference trainer_factory.py):
+TrainerDesc construction from an opt_info dict + fetch monitoring."""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from . import trainer_desc as _td
+from . import device_worker as _dw
+
+__all__ = ["TrainerFactory", "FetchHandler", "FetchHandlerMonitor"]
+
+
+class TrainerFactory:
+    def _create_trainer(self, opt_info=None):
+        opt_info = opt_info or {}
+        trainer_name = opt_info.get("trainer", "MultiTrainer")
+        worker_name = opt_info.get("device_worker", "Hogwild")
+        trainer = getattr(_td, trainer_name, _td.MultiTrainer)()
+        worker = getattr(_dw, worker_name, _dw.Hogwild)()
+        trainer.set_device_worker(worker)
+        if "thread_num" in opt_info:
+            trainer.set_thread(opt_info["thread_num"])
+        if "fleet_desc" in opt_info:
+            trainer.set_fleet_desc(opt_info["fleet_desc"])
+        return trainer
+
+
+class FetchHandler:
+    def __init__(self, var_dict=None, period_secs=60):
+        self.var_dict = var_dict or {}
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        for k, v in res_dict.items():
+            if v is not None:
+                print(f"{k}: {np.asarray(v).ravel()[:8]}")
+
+    @staticmethod
+    def help():
+        print("FetchHandler: subclass and override handler(res_dict); "
+              "var_dict maps names to scope vars, polled every "
+              "period_secs during train_from_dataset")
+
+
+class FetchHandlerMonitor:
+    """Polls scope vars on a timer thread while a dataset-trainer runs."""
+
+    def __init__(self, scope, handler):
+        self._scope = scope
+        self._handler = handler
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        def loop():
+            while not self._stop.wait(self._handler.period_secs):
+                res = {}
+                for name in self._handler.var_dict:
+                    v = self._scope.find_var(name)
+                    res[name] = None if v is None else np.asarray(v)
+                self._handler.handler(res)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -90,3 +90,13 @@ class LocalSGD(Collective):
                             {"ring_id": 0, "use_calc_stream": True,
                              "op_role": 1})
         self.main_program._bump_version()
+
+
+class MultiThread(GradAllReduce):
+    """Reference collective.py MultiThread: multi-ring/multi-thread
+    allreduce.  Ring scheduling is XLA's job on TPU; the rewrite is the
+    same GradAllReduce insertion."""
+
+    def __init__(self, nrings=1, trans_mode="all_reduce"):
+        super().__init__(nrings)
+        self.mode = trans_mode

@@ -1,0 +1,32 @@
+"""fluid.unique_name module analog (reference unique_name.py):
+generate/switch/guard over the same counter the framework's internal
+unique_name() function uses."""
+from __future__ import annotations
+
+import contextlib
+
+from . import framework as _fw
+
+__all__ = ["generate", "switch", "guard"]
+
+
+def generate(key):
+    return _fw.unique_name(key)
+
+
+def switch(new_generator=None):
+    old = dict(_fw._name_counters)
+    _fw._name_counters.clear()
+    if new_generator:
+        _fw._name_counters.update(new_generator)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        _fw._name_counters.clear()
+        _fw._name_counters.update(old)

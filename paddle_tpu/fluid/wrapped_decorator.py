@@ -1,0 +1,19 @@
+"""fluid.wrapped_decorator analog: signature-preserving decorator
+helpers (the reference wraps `decorator.decorator`; functools does the
+same job without the dependency)."""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+__all__ = ["wrap_decorator", "signature_safe_contextmanager"]
+
+
+def wrap_decorator(decorator_func):
+    @functools.wraps(decorator_func)
+    def __impl__(func):
+        return functools.wraps(func)(decorator_func(func))
+    return __impl__
+
+
+signature_safe_contextmanager = contextlib.contextmanager

@@ -2,3 +2,5 @@
 from .model import Model, Input
 from . import callbacks
 from .flops import flops
+from . import progressbar  # noqa: F401
+from .progressbar import ProgressBar  # noqa: F401

@@ -1,3 +1,4 @@
 """paddle.fluid.incubate analog: auto-checkpoint, fleet utils (fs/hdfs)."""
 from . import checkpoint
 from . import fleet
+from . import data_generator  # noqa: F401

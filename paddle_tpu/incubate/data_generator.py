@@ -1,0 +1,67 @@
+"""fluid.incubate.data_generator analog (reference incubate/
+data_generator/__init__.py): user-subclassed generators emitting
+MultiSlot-format lines for the Dataset/DataFeed tier."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "subclasses implement generate_sample(line) returning an "
+            "iterator of (name, value-list) pair lists")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            for out in self._emit(line):
+                sys.stdout.write(out)
+
+    def run_from_memory(self):
+        """Return the formatted lines instead of writing stdout — used by
+        the in-process Dataset feed path and the tests."""
+        raise NotImplementedError
+
+    def _emit(self, line):
+        it = self.generate_sample(line)
+        for record in it():
+            yield self._gen_str(record)
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Wire format: `slot_num_0 v0 v1 ... slot_num_1 ...` ints/floats
+    (data_feed.proto MultiSlot)."""
+
+    def _gen_str(self, record):
+        parts = []
+        for _name, values in record:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _gen_str(self, record):
+        parts = []
+        for _name, values in record:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

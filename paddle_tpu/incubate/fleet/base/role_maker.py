@@ -8,3 +8,13 @@ from ....distributed.fleet.base.role_maker import (   # noqa: F401
 # negotiation on TPU pods, but the symbols must import
 MPISymetricRoleMaker = PaddleCloudRoleMaker
 GeneralRoleMaker = PaddleCloudRoleMaker
+
+
+class UserDefinedCollectiveRoleMaker(UserDefinedRoleMaker):
+    """reference role_maker.py UserDefinedCollectiveRoleMaker: explicit
+    worker endpoints, collective mode (no servers)."""
+
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__(current_id=current_id,
+                         worker_num=len(worker_endpoints or ["w0"]))
+        self._worker_endpoints = list(worker_endpoints or [])

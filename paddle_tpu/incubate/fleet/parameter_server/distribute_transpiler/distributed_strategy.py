@@ -59,3 +59,7 @@ class StrategyFactory:
     @staticmethod
     def create_geo_strategy(update_frequency=100):
         return GeoStrategy(update_frequency)
+
+
+# reference distributed_strategy.py exports the base too
+DistributedStrategy = _Strategy

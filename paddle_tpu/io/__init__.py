@@ -157,6 +157,32 @@ class RandomSampler(Sampler):
         return self.num_samples
 
 
+class WeightedRandomSampler(Sampler):
+    """Sample indices with given per-element weights (reference
+    fluid/dataloader/sampler.py WeightedRandomSampler)."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        if num_samples <= 0:
+            raise ValueError("num_samples must be positive")
+        if not replacement and num_samples > len(weights):
+            raise ValueError("cannot draw more samples than weights "
+                             "without replacement")
+        self.weights = np.asarray(weights, dtype="float64")
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        self.num_samples = int(num_samples)
+        self.replacement = bool(replacement)
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), size=self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
 class BatchSampler(Sampler):
     """Groups sampler indices into batches (reference io/batch_sampler.py:
     either (dataset, shuffle) or an explicit sampler)."""

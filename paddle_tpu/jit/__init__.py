@@ -104,3 +104,18 @@ class TranslatedLayer:
 
 def load(path) -> TranslatedLayer:
     return TranslatedLayer(path)
+
+
+# reference jit/__init__.py tail: translator controls + dy2static
+from . import dy2static                                 # noqa: E402,F401
+from ..dygraph.dygraph_to_static import (               # noqa: E402,F401
+    ProgramTranslator, set_code_level, set_verbosity)
+
+
+def not_to_static(func=None):
+    """Mark a function excluded from dygraph-to-static conversion
+    (reference jit/api.py not_to_static)."""
+    if func is None:
+        return not_to_static
+    func._already_converted = True      # convert_call passes it through
+    return func

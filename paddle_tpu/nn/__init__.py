@@ -33,8 +33,12 @@ from .layer import (ReLU, GELU, Sigmoid, Tanh, Softmax, LeakyReLU, SiLU,
 # 2.0 gradient-clip classes (reference python/paddle/nn/clip.py aliases
 # the fluid implementations under ClipGradBy* names; optimizers take them
 # via grad_clip=)
-from ..fluid.clip import (GradientClipByValue as ClipGradByValue,
-                          GradientClipByNorm as ClipGradByNorm,
-                          GradientClipByGlobalNorm as ClipGradByGlobalNorm)
 
 Conv2d = Conv2D  # historical alias
+
+from . import initializer   # noqa: E402,F401
+from . import clip          # noqa: E402,F401
+from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: E402,F401
+                   ClipGradByGlobalNorm)
+from . import decode        # noqa: E402,F401
+from . import utils         # noqa: E402,F401

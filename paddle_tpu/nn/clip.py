@@ -1,0 +1,11 @@
+"""paddle.nn.clip namespace (reference nn/clip.py aliases)."""
+from ..fluid.clip import (ClipGradByValue, ClipGradByNorm,
+                          ClipGradByGlobalNorm, GradientClipByValue,
+                          GradientClipByNorm, GradientClipByGlobalNorm,
+                          ErrorClipByValue, set_gradient_clip)
+from ..fluid.layers import clip_by_norm
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "ErrorClipByValue",
+           "set_gradient_clip", "clip_by_norm"]

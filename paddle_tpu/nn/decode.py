@@ -1,0 +1,5 @@
+"""paddle.nn.decode namespace (reference nn/decode.py): the rnn decode
+framework aliases."""
+from ..fluid.layers import BeamSearchDecoder, dynamic_decode
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]

@@ -1,0 +1,16 @@
+"""paddle.nn.initializer namespace (reference python/paddle/nn/
+initializer/): 2.0 initializer classes over the fluid initializer tier,
+plus set_global_initializer."""
+from . import assign, constant, kaiming, normal, uniform, xavier
+from .assign import Assign
+from .constant import Constant
+from .kaiming import KaimingNormal, KaimingUniform
+from .normal import Normal, TruncatedNormal
+from .uniform import Uniform
+from .xavier import XavierNormal, XavierUniform
+from ...fluid.initializer import (set_global_initializer,
+                                  Bilinear)
+
+__all__ = ["Assign", "Constant", "KaimingNormal", "KaimingUniform",
+           "Normal", "TruncatedNormal", "Uniform", "XavierNormal",
+           "XavierUniform", "Bilinear", "set_global_initializer"]

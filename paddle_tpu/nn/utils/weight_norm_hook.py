@@ -1,0 +1,86 @@
+"""paddle.nn.utils.weight_norm_hook analog (reference nn/utils/
+weight_norm_hook.py): reparameterise a layer's weight as
+g * v / ||v|| with (g, v) the trainable parameters."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...fluid import layers as L
+from ...fluid.layer_helper import LayerHelper
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except(v, dim):
+    """L2 norm over all axes except `dim` (paddle keeps dim's extent)."""
+    nd = len(v.shape)
+    if dim is None:
+        return L.sqrt(L.reduce_sum(L.square(v)))
+    axes = [i for i in range(nd) if i != dim]
+    return L.sqrt(L.reduce_sum(L.square(v), dim=axes, keep_dim=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Replace `layer.<name>` with a property computed from new params
+    `<name>_g` / `<name>_v` each forward (pre-forward hook analog: the
+    recompute happens on attribute access, which every forward does)."""
+    w = getattr(layer, name)
+    helper = LayerHelper("weight_norm")
+    from ...fluid.framework import in_dygraph_mode
+    if in_dygraph_mode():
+        import jax.numpy as jnp
+        v0 = w._value
+        nd = v0.ndim
+        axes = tuple(i for i in range(nd) if i != dim) if dim is not None \
+            else None
+        g0 = jnp.sqrt(jnp.sum(jnp.square(v0), axis=axes, keepdims=dim
+                              is not None))
+        from ...dygraph.base import ParamBase
+        g = ParamBase(g0, name=w.name + "_g")
+        v = ParamBase(v0, name=w.name + "_v")
+    else:
+        raise ValueError("weight_norm hooks are a dygraph-layer feature; "
+                         "in static mode compose the expression directly")
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    wn_state = {"name": name, "dim": dim}
+    layer.__dict__["_weight_norm_state"] = wn_state
+
+    cls = type(layer)
+    if not getattr(cls, "_wn_patched", False):
+        orig_forward = cls.forward
+
+        def forward(self, *a, **kw):
+            st = self.__dict__.get("_weight_norm_state")
+            if st is not None:
+                gg = getattr(self, st["name"] + "_g")
+                vv = getattr(self, st["name"] + "_v")
+                norm = _norm_except(vv, st["dim"])
+                setattr(self, st["name"], vv * (gg / norm))
+            return orig_forward(self, *a, **kw)
+
+        cls.forward = forward
+        cls._wn_patched = True
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    st = layer.__dict__.pop("_weight_norm_state", None)
+    if st is None:
+        return layer
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    norm = _norm_except(v, st["dim"])
+    w = v * (g / norm)
+    from ...dygraph.base import ParamBase
+    p = ParamBase(w._value if hasattr(w, "_value") else np.asarray(w),
+                  name=getattr(layer, name).name
+                  if hasattr(getattr(layer, name, None), "name") else name)
+    for k in (name + "_g", name + "_v"):
+        if k in layer._parameters:
+            del layer._parameters[k]
+    layer.add_parameter(name, p)
+    return layer

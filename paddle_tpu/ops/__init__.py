@@ -30,3 +30,10 @@ from . import catalog_tail_ops # noqa: F401  fc/py_func/rnn/detection tail
 # (test_op_grads_auto.py enforces full coverage of the audit)
 from .nondiff_reasons import apply_reasons as _apply_nondiff_reasons
 _apply_nondiff_reasons()
+
+def builtin_ops():
+    """The framework's op catalog: everything registered except user
+    custom-op plugins, which load_op_library marks OpDef.custom and the
+    catalog/grad-audit sweeps exclude."""
+    from .registry import _OP_REGISTRY
+    return frozenset(t for t, d in _OP_REGISTRY.items() if not d.custom)

@@ -36,6 +36,9 @@ class OpDef:
     custom_grad: Optional[Callable] = None  # (ins, outs, out_grads, attrs, ctx) -> in_grads
     # optional shape/dtype inference for IR bookkeeping (advisory; XLA retraces)
     infer: Optional[Callable] = None
+    # True for user plugin ops (load_op_library) — outside the framework's
+    # catalog/grad-audit contract
+    custom: bool = False
 
 
 _OP_REGISTRY: Dict[str, OpDef] = {}

@@ -1,0 +1,7 @@
+"""paddle.reader namespace (reference python/paddle/reader/)."""
+from . import decorator
+from .decorator import (cache, map_readers, buffered, compose, chain,
+                        shuffle, ComposeNotAligned, firstn, xmap_readers,
+                        multiprocess_reader)
+
+__all__ = list(decorator.__all__)

@@ -1,0 +1,187 @@
+"""paddle.reader.decorator analog (reference python/paddle/reader/
+decorator.py): composable reader transforms for the 1.x reader pipeline."""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "ComposeNotAligned", "firstn", "xmap_readers",
+           "multiprocess_reader"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    all_data = []
+
+    def creator():
+        if not all_data:
+            all_data.extend(reader())
+        return iter(all_data)
+    return creator
+
+
+def map_readers(func, *readers):
+    def creator():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+    return creator
+
+
+def shuffle(reader, buf_size):
+    def creator():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return creator
+
+
+def chain(*readers):
+    def creator():
+        return itertools.chain(*[r() for r in readers])
+    return creator
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def creator():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+    return creator
+
+
+def buffered(reader, size):
+    class _End:
+        pass
+
+    def creator():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            for d in reader():
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+    return creator
+
+
+def firstn(reader, n):
+    def creator():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+    return creator
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Threaded map over a reader (the reference uses threads too)."""
+    def creator():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        end = object()
+
+        def feed():
+            for i, d in enumerate(reader()):
+                in_q.put((i, d))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, d = item
+                out_q.put((i, mapper(d)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            import heapq
+            heap, want = [], 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                heapq.heappush(heap, item)
+                while heap and heap[0][0] == want:
+                    yield heapq.heappop(heap)[1]
+                    want += 1
+            while heap:
+                yield heapq.heappop(heap)[1]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+    return creator
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run several readers in worker PROCESSES feeding one queue
+    (reference decorator.py multiprocess_reader)."""
+    import multiprocessing as mp
+
+    def creator():
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(queue_size)
+
+        def work(r):
+            for d in r():
+                q.put(d)
+            q.put(None)
+
+        procs = [ctx.Process(target=work, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            d = q.get()
+            if d is None:
+                finished += 1
+                continue
+            yield d
+        for p in procs:
+            p.join(timeout=5)
+    return creator

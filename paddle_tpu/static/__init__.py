@@ -7,7 +7,7 @@ from ..fluid.backward import append_backward, gradients
 from ..fluid.io import (save_inference_model, load_inference_model,
                         save_persistables, load_persistables)
 from ..fluid.param_attr import ParamAttr
-from ..fluid import layers as nn
+# static.nn: real submodule imported at the end of this file
 
 
 def name_scope(name=None):
@@ -150,3 +150,5 @@ def set_program_state(program, state_dict):
     import numpy as _np
     for name, val in state_dict.items():
         _gs().set_var(name, _np.asarray(val))
+
+from . import nn  # noqa: E402,F401  (static.nn builder namespace)

@@ -1,0 +1,21 @@
+"""paddle.static.nn namespace (reference python/paddle/static/nn/): the
+2.0 static-graph layer builders — the fluid.layers implementations under
+their 2.0 home."""
+import sys
+
+from ...fluid.layers import (
+    fc, batch_norm, embedding, bilinear_tensor_product, case, cond,
+    conv2d, conv2d_transpose, conv3d, conv3d_transpose, create_parameter,
+    crf_decoding, data_norm, group_norm, instance_norm, layer_norm,
+    multi_box_head, nce, prelu, py_func, row_conv, spectral_norm,
+    switch_case, while_loop)
+from ...fluid.layers import deformable_conv as deform_conv2d
+
+__all__ = ["fc", "batch_norm", "embedding", "bilinear_tensor_product",
+           "case", "cond", "conv2d", "conv2d_transpose", "conv3d",
+           "conv3d_transpose", "create_parameter", "crf_decoding",
+           "data_norm", "deform_conv2d", "group_norm", "instance_norm",
+           "layer_norm", "multi_box_head", "nce", "prelu", "py_func",
+           "row_conv", "spectral_norm", "switch_case", "while_loop"]
+
+common = sys.modules[__name__]      # static.nn.common alias (same surface)

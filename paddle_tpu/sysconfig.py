@@ -1,0 +1,17 @@
+"""paddle.sysconfig analog: include/lib dirs for building extensions
+against the framework (reference sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_ROOT, "native", "include")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "native", "lib")

@@ -1,6 +1,7 @@
 """paddle.text analog (reference python/paddle/text/): NLP datasets +
 model zoo entry points re-exported from models/."""
 from . import datasets
-from .datasets import Imdb, UCIHousing, Conll05st, Movielens, WMT14, WMT16
+from .datasets import (Imdb, UCIHousing, Conll05st, Movielens, WMT14,
+                       WMT16, Imikolov)
 from ..models.bert import BertModel, BertForPretraining, ErnieModel
 from ..models.transformer import TransformerModel

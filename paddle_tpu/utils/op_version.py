@@ -1,0 +1,19 @@
+"""paddle.utils.op_version analog over the op registry."""
+from __future__ import annotations
+
+__all__ = ["OpLastCheckpointChecker"]
+
+
+class OpLastCheckpointChecker:
+    """Reference checks op version checkpoints from C++; here every op is
+    at version 1 of the JAX lowering registry."""
+
+    def __init__(self):
+        from ..ops.registry import all_ops
+        self._ops = set(all_ops())
+
+    def check_modify(self, op_name, attr_name=None):
+        return []
+
+    def check_add(self, op_name, attr_name=None):
+        return []

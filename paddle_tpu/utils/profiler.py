@@ -1,0 +1,65 @@
+"""paddle.utils.profiler analog (reference utils/profiler.py):
+env/option-driven profiler wrapper over the fluid profiler plane."""
+from __future__ import annotations
+
+import os
+
+from ..fluid import profiler as _prof
+
+__all__ = ["ProfilerOptions", "Profiler", "get_profiler"]
+
+
+class ProfilerOptions:
+    def __init__(self, options=None):
+        self._options = {
+            "batch_range": [10, 20], "state": "All",
+            "sorted_key": "total", "tracer_option": "Default",
+            "profile_path": "/tmp/paddle_tpu_profile",
+            "timer_only": False}
+        if options:
+            self._options.update(options)
+
+    def __getitem__(self, name):
+        return self._options[name]
+
+
+class Profiler:
+    def __init__(self, options=None):
+        self._options = options or ProfilerOptions()
+        self._batch = 0
+        self._running = False
+
+    def start(self):
+        if not self._options["timer_only"]:
+            _prof.start_profiler(self._options["state"],
+                                 self._options["tracer_option"])
+            self._running = True
+
+    def stop(self):
+        if self._running:
+            _prof.stop_profiler(self._options["sorted_key"],
+                                self._options["profile_path"])
+            self._running = False
+
+    def step(self):
+        lo, hi = self._options["batch_range"]
+        if self._batch == lo:
+            self.start()
+        elif self._batch == hi:
+            self.stop()
+        self._batch += 1
+
+
+_profiler = None
+
+
+def get_profiler():
+    global _profiler
+    if _profiler is None:
+        opts = None
+        env = os.environ.get("FLAGS_profile_options")
+        if env:
+            kv = dict(p.split("=", 1) for p in env.split(";") if "=" in p)
+            opts = ProfilerOptions(kv)
+        _profiler = Profiler(opts)
+    return _profiler

@@ -2,3 +2,7 @@
 from . import datasets
 from . import models
 from . import transforms
+
+from . import ops  # noqa: E402,F401
+from . import image  # noqa: E402,F401
+from .image import set_image_backend, get_image_backend, image_load  # noqa: E402,F401

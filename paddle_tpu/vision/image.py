@@ -1,0 +1,39 @@
+"""paddle.vision.image analog: image backend selection + loading."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _backend
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(
+            f"backend must be pil/cv2/tensor/numpy, got {backend!r}")
+    _backend = backend
+
+
+def get_image_backend():
+    return _backend
+
+
+def image_load(path, backend=None):
+    backend = backend or _backend
+    if backend == "cv2":
+        try:
+            import cv2
+            return cv2.imread(path)
+        except ImportError as e:
+            raise RuntimeError("cv2 backend requested but OpenCV is not "
+                               "installed; use the pil backend") from e
+    try:
+        from PIL import Image
+        img = Image.open(path)
+        return img if backend == "pil" else np.asarray(img)
+    except ImportError:
+        # zero-dependency fallback: raw npy/npz files
+        arr = np.load(path)
+        return arr

@@ -737,6 +737,9 @@ def test_full_registry_accounting():
     apply_reasons()       # late-registered modules (backward, vision ops)
     unaccounted = []
     for t, d in sorted(_OP_REGISTRY.items()):
+        if d.custom:
+            continue      # user custom-op plugin registered by another
+            # test (load_op_library) — not part of the catalog contract
         if d.differentiable:
             if t not in SKIPS and t not in TESTED_OPS:
                 unaccounted.append(t)
