@@ -25,25 +25,51 @@ class PiecewiseDecay(_lr.PiecewiseDecay):
         super().__init__(boundaries, values)
 
 
-class NaturalExpDecay(_lr.NaturalExpDecay):
+class _FluidDecayMixin:
+    """Fluid-form step ratio: step/decay_steps, floored when staircase —
+    installed as `_ratio()` so each subclass's get_lr matches the fluid
+    formula exactly (incl. staircase=True's stepped schedule)."""
+
+    def _init_fluid(self, decay_steps, decay_rate, staircase):
+        self._decay_steps = float(decay_steps)
+        self._decay_rate = decay_rate
+        self._staircase = staircase
+
+    def _ratio(self):
+        import math
+        r = self.last_epoch / self._decay_steps
+        return math.floor(r) if self._staircase else r
+
+
+class NaturalExpDecay(_FluidDecayMixin, _lr.LRScheduler):
     def __init__(self, learning_rate, decay_steps, decay_rate,
                  staircase=False, begin=0, step=1, dtype=None):
-        # fluid form: lr * exp(-rate * floor-or-frac(step/decay_steps));
-        # per-epoch gamma equals decay_rate/decay_steps in the 2.0 class
-        super().__init__(learning_rate, decay_rate / float(decay_steps))
+        self._init_fluid(decay_steps, decay_rate, staircase)
+        super().__init__(learning_rate)
+
+    def get_lr(self):
+        import math
+        return self.base_lr * math.exp(-self._decay_rate * self._ratio())
 
 
-class ExponentialDecay(_lr.ExponentialDecay):
+class ExponentialDecay(_FluidDecayMixin, _lr.LRScheduler):
     def __init__(self, learning_rate, decay_steps, decay_rate,
                  staircase=False, begin=0, step=1, dtype=None):
-        super().__init__(learning_rate,
-                         decay_rate ** (1.0 / float(decay_steps)))
+        self._init_fluid(decay_steps, decay_rate, staircase)
+        super().__init__(learning_rate)
+
+    def get_lr(self):
+        return self.base_lr * self._decay_rate ** self._ratio()
 
 
-class InverseTimeDecay(_lr.InverseTimeDecay):
+class InverseTimeDecay(_FluidDecayMixin, _lr.LRScheduler):
     def __init__(self, learning_rate, decay_steps, decay_rate,
                  staircase=False, begin=0, step=1, dtype=None):
-        super().__init__(learning_rate, decay_rate / float(decay_steps))
+        self._init_fluid(decay_steps, decay_rate, staircase)
+        super().__init__(learning_rate)
+
+    def get_lr(self):
+        return self.base_lr / (1.0 + self._decay_rate * self._ratio())
 
 
 class PolynomialDecay(_lr.PolynomialDecay):

@@ -50,7 +50,9 @@ def weight_norm(layer, name="weight", dim=0):
     layer.__dict__["_weight_norm_state"] = wn_state
 
     cls = type(layer)
-    if not getattr(cls, "_wn_patched", False):
+    # per-CLASS guard via __dict__: an inherited flag from a patched base
+    # would skip wrapping a subclass's own forward override
+    if "_wn_patched" not in cls.__dict__:
         orig_forward = cls.forward
 
         def forward(self, *a, **kw):

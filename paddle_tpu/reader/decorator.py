@@ -75,23 +75,34 @@ def compose(*readers, **kwargs):
     return creator
 
 
-def buffered(reader, size):
-    class _End:
-        pass
+class _EndSignal:
+    """Terminator sentinel carrying a worker exception if one occurred
+    (reference XmapEndSignal error flag): consumers re-raise instead of
+    deadlocking on a dead producer."""
 
+    def __init__(self, exc=None):
+        self.exc = exc
+
+
+def buffered(reader, size):
     def creator():
         q = queue.Queue(maxsize=size)
 
         def fill():
-            for d in reader():
-                q.put(d)
-            q.put(_End)
+            try:
+                for d in reader():
+                    q.put(d)
+                q.put(_EndSignal())
+            except BaseException as e:   # noqa: BLE001 — forwarded
+                q.put(_EndSignal(e))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
         while True:
             e = q.get()
-            if e is _End:
+            if isinstance(e, _EndSignal):
+                if e.exc is not None:
+                    raise e.exc
                 break
             yield e
     return creator
@@ -114,19 +125,28 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         end = object()
 
         def feed():
-            for i, d in enumerate(reader()):
-                in_q.put((i, d))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+                for _ in range(process_num):
+                    in_q.put(end)
+            except BaseException as e:   # noqa: BLE001 — forwarded
+                for _ in range(process_num):
+                    in_q.put(_EndSignal(e))
 
         def work():
             while True:
                 item = in_q.get()
-                if item is end:
-                    out_q.put(end)
+                if item is end or isinstance(item, _EndSignal):
+                    out_q.put(item if isinstance(item, _EndSignal)
+                              else end)
                     return
                 i, d = item
-                out_q.put((i, mapper(d)))
+                try:
+                    out_q.put((i, mapper(d)))
+                except BaseException as e:   # noqa: BLE001 — forwarded
+                    out_q.put(_EndSignal(e))
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
@@ -138,6 +158,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             heap, want = [], 0
             while finished < process_num:
                 item = out_q.get()
+                if isinstance(item, _EndSignal):
+                    raise item.exc
                 if item is end:
                     finished += 1
                     continue
@@ -150,6 +172,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         else:
             while finished < process_num:
                 item = out_q.get()
+                if isinstance(item, _EndSignal):
+                    raise item.exc
                 if item is end:
                     finished += 1
                     continue
@@ -167,9 +191,13 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         q = ctx.Queue(queue_size)
 
         def work(r):
-            for d in r():
-                q.put(d)
-            q.put(None)
+            try:
+                for d in r():
+                    q.put(d)
+                q.put(None)
+            except BaseException as e:   # noqa: BLE001 — forwarded as a
+                q.put(("__reader_error__", repr(e)))   # picklable marker
+                q.put(None)
 
         procs = [ctx.Process(target=work, args=(r,), daemon=True)
                  for r in readers]
@@ -181,6 +209,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             if d is None:
                 finished += 1
                 continue
+            if isinstance(d, tuple) and len(d) == 2 and \
+                    d[0] == "__reader_error__":
+                raise RuntimeError(f"multiprocess reader failed: {d[1]}")
             yield d
         for p in procs:
             p.join(timeout=5)

@@ -17,7 +17,14 @@ class ProfilerOptions:
             "profile_path": "/tmp/paddle_tpu_profile",
             "timer_only": False}
         if options:
-            self._options.update(options)
+            for k, v in dict(options).items():
+                if isinstance(v, str):       # env-string coercion
+                    if k == "batch_range":
+                        v = [int(x) for x in
+                             v.strip("[]() ").split(",") if x.strip()]
+                    elif k == "timer_only":
+                        v = v.strip().lower() in ("1", "true", "yes")
+                self._options[k] = v
 
     def __getitem__(self, name):
         return self._options[name]

@@ -82,6 +82,8 @@ def batched_nms(boxes, scores, iou_threshold=0.5, top_k=100):
     boxes = jnp.asarray(boxes)
     scores = jnp.asarray(scores)
     n = boxes.shape[0]
+    if n == 0:                      # no detections: all-pad, contract kept
+        return jnp.full((top_k,), -1, jnp.int32)
     order = jnp.argsort(-scores)
     boxes_s = boxes[order]
 
