@@ -136,7 +136,9 @@ def monkey_patch_math_varbase():
 
 def summary(net, input_size=None, dtypes=None):
     """Standalone paddle.summary (reference python/paddle/hapi/
-    model_summary.py): per-parameter table + totals for a Layer."""
+    model_summary.py): per-parameter table + totals; with `input_size` a
+    probe forward runs under per-layer post hooks to record every
+    sublayer's output shape, like the reference's hook-driven table."""
     import numpy as _np
     lines = [f"Layer: {type(net).__name__}"]
     total = trainable = 0
@@ -146,9 +148,41 @@ def summary(net, input_size=None, dtypes=None):
         if getattr(p, "trainable", True):
             trainable += n
         lines.append(f"  {name:50s} {str(p.shape):20s} {n}")
+    out_shapes = {}
+    if input_size is not None:
+        from .dygraph.base import to_variable as _tv
+
+        def _shape_of(o):
+            o = o[0] if isinstance(o, (list, tuple)) and o else o
+            return tuple(getattr(o, "shape", ()))
+
+        handles = [
+            layer.register_forward_post_hook(
+                lambda l, i, o, nm=name:
+                out_shapes.__setitem__(nm, _shape_of(o)))
+            for name, layer in net.named_sublayers()]
+        try:
+            sizes = input_size if isinstance(input_size, (list, tuple)) \
+                and input_size and isinstance(input_size[0],
+                                              (list, tuple)) \
+                else [input_size]
+            dts = list(dtypes) if isinstance(dtypes, (list, tuple)) \
+                else [dtypes or "float32"] * len(sizes)
+            if len(dts) < len(sizes):      # broadcast a short dtype list
+                dts += [dts[-1] if dts else "float32"] * \
+                    (len(sizes) - len(dts))
+            probes = [_tv(_np.zeros(tuple(sz), dt))
+                      for sz, dt in zip(sizes, dts)]
+            net(*probes)
+            for nm, shp in out_shapes.items():
+                lines.append(f"  {nm:50s} -> output {shp}")
+        finally:
+            for h in handles:
+                h.remove()
     lines.append(f"Total params: {total:,}  (trainable {trainable:,})")
     print("\n".join(lines))
-    return {"total_params": total, "trainable_params": trainable}
+    return {"total_params": total, "trainable_params": trainable,
+            "output_shapes": out_shapes}
 
 from . import compat     # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401

@@ -10,6 +10,22 @@ from ..fluid.framework import unique_name, _dygraph_tracer
 from .base import VarBase, ParamBase, to_variable
 
 
+class _HookRemoveHelper:
+    """Handle returned by register_forward_*_hook; .remove() detaches
+    (reference HookRemoveHelper)."""
+
+    _next_id = 0
+
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._id = _HookRemoveHelper._next_id
+        _HookRemoveHelper._next_id += 1
+        hooks[self._id] = hook
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self._full_name = unique_name(name_scope or
@@ -18,6 +34,8 @@ class Layer:
         self._parameters: "OrderedDict[str, ParamBase]" = OrderedDict()
         self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
         self._buffers: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._forward_pre_hooks: "OrderedDict[int, object]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, object]" = OrderedDict()
         self.training = True
 
     # -- parameter/sublayer registration (via attribute protocol) ----------
@@ -150,7 +168,23 @@ class Layer:
 
     # -- call ---------------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        return self.forward(*args, **kwargs)
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, args)
+            if out is not None:
+                args = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*args, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            out = hook(self, args, outputs)
+            if out is not None:
+                outputs = out
+        return outputs
+
+    # -- forward hooks (reference dygraph/layers.py register_forward_*) ----
+    def register_forward_pre_hook(self, hook):
+        return _HookRemoveHelper(self._forward_pre_hooks, hook)
+
+    def register_forward_post_hook(self, hook):
+        return _HookRemoveHelper(self._forward_post_hooks, hook)
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError

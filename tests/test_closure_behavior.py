@@ -195,3 +195,58 @@ class TestQatAndWeightNorm:
         np.testing.assert_allclose(lin(x).numpy(), ref, rtol=1e-5)
         remove_weight_norm(lin, "weight")
         np.testing.assert_allclose(lin(x).numpy(), ref, rtol=1e-5)
+
+
+class TestLayerHooksAndSummary:
+    def test_forward_hooks(self, dygraph):
+        from paddle_tpu import nn
+        lin = nn.Linear(4, 3)
+        seen = []
+        h = lin.register_forward_post_hook(
+            lambda l, i, o: seen.append(tuple(o.shape)))
+        lin(tv(np.zeros((2, 4), "float32")))
+        assert seen == [(2, 3)]
+        h.remove()
+        lin(tv(np.zeros((2, 4), "float32")))
+        assert len(seen) == 1          # removed hook never fires again
+        pre = lin.register_forward_pre_hook(lambda l, i: (i[0] * 2.0,))
+        b = lin.bias.numpy()
+        o1 = lin(tv(np.ones((1, 4), "float32"))).numpy()
+        pre.remove()
+        o2 = lin(tv(np.ones((1, 4), "float32"))).numpy()
+        np.testing.assert_allclose(o1 - b, 2 * (o2 - b), rtol=1e-5)
+
+    def test_summary_output_shapes(self, dygraph):
+        from paddle_tpu import nn
+
+        class Net(paddle_tpu.dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l1 = nn.Linear(8, 16)
+                self.l2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.l2(self.l1(x))
+
+        r = paddle_tpu.summary(Net(), input_size=(2, 8))
+        assert r["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+        assert r["output_shapes"]["l1"] == (2, 16)
+        assert r["output_shapes"]["l2"] == (2, 4)
+
+    def test_calc_out_scale_records(self, dygraph):
+        from paddle_tpu import nn
+        from paddle_tpu.contrib.slim.quantization import \
+            ImperativeCalcOutScale
+
+        class Net(paddle_tpu.dygraph.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(4, 3)
+
+            def forward(self, x):
+                return self.lin(x)
+
+        net = Net()
+        ImperativeCalcOutScale().calc_out_scale(net)
+        net(tv(np.random.RandomState(0).randn(2, 4).astype("float32")))
+        assert any(hasattr(l, "_out_threshold") for l in net.sublayers())
