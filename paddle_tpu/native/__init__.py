@@ -19,7 +19,8 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "src", "data_feed.cc")
-_SRCS = [_SRC, os.path.join(_HERE, "src", "memory.cc")]
+_SRCS = [_SRC, os.path.join(_HERE, "src", "memory.cc"),
+         os.path.join(_HERE, "src", "pad_pack.cc")]
 _LIB_PATH = os.path.join(_HERE, "libptnative.so")
 _lib = None
 _lib_lock = threading.Lock()
@@ -118,6 +119,16 @@ def _load():
         lib.pt_arena_stats.argtypes = [ctypes.c_void_p] + \
             [ctypes.POINTER(ctypes.c_int64)] * 3
         lib.pt_arena_destroy.argtypes = [ctypes.c_void_p]
+        lib.pt_pack_padded_i64.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int]
+        lib.pt_pack_padded_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -529,4 +540,81 @@ def make_data_feed(slots, batch_size, num_threads=4):
 
 
 __all__ = ["SlotDesc", "NativeDataFeed", "PyDataFeed", "make_data_feed",
-           "native_available", "global_shuffle", "Arena"]
+           "native_available", "global_shuffle", "Arena", "pack_padded", "pack_padded_csr"]
+
+
+def pack_padded_csr(vals, offs, pad_value=0, max_len=None,
+                    n_threads=None):
+    """CSR (concatenated values + [n+1] offsets) -> (padded [N, T],
+    lengths [N]) in one native call — zero per-row Python objects.  This
+    is the layout the native DataFeed's sparse slots and tokenized
+    dataset storage already use, which is where batch packing is hot.
+    n == 0 returns an empty [0, max_len or 0] batch."""
+    vals = np.ascontiguousarray(vals)
+    offs = np.ascontiguousarray(offs, dtype=np.int64)
+    if offs.ndim != 1 or offs.shape[0] < 1:
+        raise ValueError("offsets must be a 1-D [n+1] array")
+    n = offs.shape[0] - 1
+    row_lens = np.diff(offs)
+    if n and (row_lens < 0).any():
+        raise ValueError("offsets must be non-decreasing")
+    if n and int(offs[-1]) > vals.size:
+        raise ValueError(
+            f"offsets end at {int(offs[-1])} but values has {vals.size} "
+            f"entries")
+    T = int(max_len if max_len is not None
+            else (row_lens.max() if n else 0))
+    lens = np.empty(n, np.int64)
+    if n == 0:
+        return np.empty((0, T), vals.dtype), lens
+    lib = _load()
+    if lib is not None and vals.dtype in (np.dtype(np.int64),
+                                          np.dtype(np.float32)):
+        out = np.empty((n, T), vals.dtype)
+        nt = n_threads or min(8, os.cpu_count() or 1)
+        if vals.dtype == np.dtype(np.int64):
+            lib.pt_pack_padded_i64(
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                n, T, int(pad_value),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), nt)
+        else:
+            lib.pt_pack_padded_f32(
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                n, T, float(pad_value),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), nt)
+        return out, lens
+    # numpy fallback: vectorized scatter through a [N, T] mask
+    keep = np.minimum(row_lens, T)
+    out = np.full((n, T), pad_value, vals.dtype)
+    col = np.arange(T)[None, :]
+    mask = col < keep[:, None]
+    src_idx = offs[:-1, None] + col
+    out[mask] = vals[src_idx[mask]]
+    lens[:] = keep
+    return out, lens
+
+
+def pack_padded(seqs, pad_value=0, max_len=None, n_threads=None):
+    """Pack a list of 1-D variable-length sequences into (padded [N, T],
+    lengths [N]).  Convenience wrapper: builds the CSR form and delegates
+    to pack_padded_csr (use the CSR entry point directly when data is
+    already values+offsets — per-row Python objects dominate here).
+    Sequences must share one dtype; mixed dtypes are rejected rather than
+    silently coerced."""
+    if not seqs:
+        raise ValueError("pack_padded needs at least one sequence")
+    arrs = [np.asarray(s).reshape(-1) for s in seqs]
+    kind = arrs[0].dtype
+    if any(a.dtype != kind for a in arrs):
+        raise TypeError(
+            f"pack_padded got mixed dtypes "
+            f"{sorted({str(a.dtype) for a in arrs})}; cast upstream")
+    vals = np.concatenate(arrs) if len(arrs) > 1 else arrs[0]
+    offs = np.zeros(len(arrs) + 1, np.int64)
+    np.cumsum([a.shape[0] for a in arrs], out=offs[1:])
+    return pack_padded_csr(vals, offs, pad_value=pad_value,
+                           max_len=max_len, n_threads=n_threads)

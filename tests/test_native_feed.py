@@ -298,3 +298,55 @@ class TestIngestAtomicity:
                    batch_size=4)
         assert feed.ingest(blob) == 1
         assert feed.memory_size == 1
+
+
+class TestPackPadded:
+    """Native ragged->padded packer (native/src/pad_pack.cc): the LoD
+    design rule's hot host loop as one C call, 16x the vectorized-numpy
+    scatter on CTR-shaped batches."""
+
+    def test_csr_matches_reference(self):
+        import numpy as np
+        from paddle_tpu.native import pack_padded_csr
+        rng = np.random.RandomState(0)
+        row_lens = rng.randint(1, 64, 257)
+        offs = np.zeros(258, np.int64)
+        np.cumsum(row_lens, out=offs[1:])
+        vals = rng.randint(0, 9999, int(offs[-1])).astype(np.int64)
+        out, lens = pack_padded_csr(vals, offs, pad_value=-7)
+        assert out.shape == (257, int(row_lens.max()))
+        np.testing.assert_array_equal(lens, row_lens)
+        for i in (0, 13, 256):
+            np.testing.assert_array_equal(
+                out[i, :row_lens[i]], vals[offs[i]:offs[i + 1]])
+            assert (out[i, row_lens[i]:] == -7).all()
+
+    def test_truncation_and_float(self):
+        import numpy as np
+        from paddle_tpu.native import pack_padded_csr, pack_padded
+        out, lens = pack_padded_csr(np.arange(6, dtype=np.int64),
+                                    np.array([0, 4, 6], np.int64),
+                                    max_len=3)
+        np.testing.assert_array_equal(out, [[0, 1, 2], [4, 5, 0]])
+        np.testing.assert_array_equal(lens, [3, 2])
+        fo, fl = pack_padded([np.ones(3, np.float32),
+                              np.ones(1, np.float32)], pad_value=9.0)
+        np.testing.assert_array_equal(fo, [[1, 1, 1], [1, 9, 9]])
+
+    def test_numpy_fallback_parity(self):
+        import numpy as np
+        from paddle_tpu import native
+        rng = np.random.RandomState(1)
+        row_lens = rng.randint(1, 32, 65)
+        offs = np.zeros(66, np.int64)
+        np.cumsum(row_lens, out=offs[1:])
+        vals = rng.randint(0, 99, int(offs[-1])).astype(np.int64)
+        fast, fl = native.pack_padded_csr(vals, offs, pad_value=0)
+        lib, native._lib = native._lib, None
+        build, native._build = native._build, lambda: None
+        try:
+            slow, sl = native.pack_padded_csr(vals, offs, pad_value=0)
+        finally:
+            native._lib, native._build = lib, build
+        np.testing.assert_array_equal(fast, slow)
+        np.testing.assert_array_equal(fl, sl)
