@@ -94,6 +94,10 @@ def main():
         if canary():
             print(f"[watch] probe {n}: TPU UP — sweeping benches",
                   flush=True)
+            # 1. cheapest first: a --quick BERT child compiles in seconds
+            #    and record_evidence()s a backend=tpu row — even a 2-min
+            #    up-window leaves committed on-chip proof
+            run_child(["--quick"], 240)
             if not parity_done:        # once per up-window, not per probe
                 run_pallas_parity()
                 parity_done = True
