@@ -17,3 +17,16 @@ class Momentum(MomentumOptimizer):
                          use_nesterov=use_nesterov,
                          regularization=regularization,
                          grad_clip=grad_clip, name=name)
+        # multi_precision is the TPU default already (fp32 masters, bf16
+        # compute via AMP); rescale_grad is honored below
+        self._rescale_grad = float(rescale_grad)
+
+    def _append_optimize_op(self, param, grad):
+        if self._rescale_grad != 1.0:
+            from ..fluid.framework import in_dygraph_mode
+            if in_dygraph_mode():
+                grad.set_value(grad._value * self._rescale_grad)
+            else:
+                from ..fluid import layers as L
+                grad = L.scale(grad, scale=self._rescale_grad)
+        return super()._append_optimize_op(param, grad)

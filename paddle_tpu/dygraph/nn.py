@@ -565,9 +565,13 @@ class NCE(Layer):
             param_attr, [num_total_classes, dim], dtype)
         self.bias = helper.create_parameter(
             bias_attr, [num_total_classes, 1], dtype, is_bias=True)
+        samplers = {"uniform": 0, "log_uniform": 1, "custom_dist": 2}
+        if sampler not in samplers:
+            raise ValueError(f"NCE sampler must be one of "
+                             f"{sorted(samplers)}, got {sampler!r}")
         self._attrs = {"num_total_classes": num_total_classes,
                        "num_neg_samples": num_neg_samples or 10,
-                       "seed": seed, "sampler": 0}
+                       "seed": seed, "sampler": samplers[sampler]}
 
     def forward(self, input, label, sample_weight=None):
         outs = _emit("nce", "nce",
