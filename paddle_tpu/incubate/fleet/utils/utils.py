@@ -12,17 +12,18 @@ __all__ = ["load_program", "save_program", "program_type_trans",
 
 
 def load_program(model_filename, is_text=False):
-    from ....fluid import io as fio
-    from ....fluid.framework import Program
-    return fio._load_program_desc(model_filename) \
-        if hasattr(fio, "_load_program_desc") \
-        else fio.load_inference_model_program(model_filename)
+    """Load a serialized Program (static.serialize_program container)."""
+    from ....static import deserialize_program
+    with open(model_filename, "rb") as f:
+        return deserialize_program(f.read())
 
 
 def save_program(program, model_filename, is_text=False):
-    from ....fluid import io as fio
-    fio.save_program_desc(program, model_filename) \
-        if hasattr(fio, "save_program_desc") else None
+    from ....static import serialize_program
+    blob = serialize_program(None, None, program=program)
+    with open(model_filename, "wb") as f:
+        f.write(blob)
+    return model_filename
 
 
 def program_type_trans(prog_dir, prog_fn, is_text):
