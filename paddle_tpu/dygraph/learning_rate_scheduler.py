@@ -79,10 +79,26 @@ class PolynomialDecay(_lr.PolynomialDecay):
                          end_lr=end_learning_rate, power=power, cycle=cycle)
 
 
-class CosineDecay(_lr.CosineAnnealingDecay):
+class CosineDecay(_lr.LRScheduler):
+    """Fluid dygraph cosine decay (reference fluid/dygraph/
+    learning_rate_scheduler.py:571-577): lr * 0.5 *
+    (cos(floor(step / step_each_epoch) * pi / epochs) + 1) — the epoch
+    counter advances every step_each_epoch batch steps and the cosine
+    period is epochs, so the schedule decays over the whole run.  (The
+    reference's own docstring formula omits the floor/epochs; the
+    implementation is authoritative.)"""
+
     def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
                  step=1, dtype=None):
-        super().__init__(learning_rate, T_max=epochs)
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+        super().__init__(learning_rate)
+
+    def get_lr(self):
+        import math
+        cur_epoch = math.floor(self.last_epoch / self.step_each_epoch)
+        return self.base_lr * 0.5 * (
+            math.cos(cur_epoch * math.pi / self.epochs) + 1)
 
 
 class LinearLrWarmup(_lr.LinearWarmup):

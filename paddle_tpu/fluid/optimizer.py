@@ -165,15 +165,24 @@ class Optimizer:
             g = p.gradient_var
             if g is None or not getattr(p, "trainable", True):
                 continue
-            reg = getattr(p, "regularizer", None) or self.regularization
-            if reg is not None and hasattr(reg, "_coeff"):
-                if isinstance(reg, L1DecayRegularizer):
-                    g = g + reg._coeff * jnp.sign(p._value)
-                else:
-                    g = g + reg._coeff * p._value
             params_grads.append((p, VarBase(g, stop_gradient=True)))
+        # Reference order (fluid/optimizer.py:825-831): clip the raw tape
+        # gradients FIRST, then append regularization — so weight decay is
+        # NOT included in the clipped norm (same as apply_gradients).
         if self._grad_clip is not None:
             params_grads = self._clip_eager(params_grads)
+        regged = []
+        for p, g in params_grads:
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if reg is not None and hasattr(reg, "_coeff"):
+                gv = g._value
+                if isinstance(reg, L1DecayRegularizer):
+                    gv = gv + reg._coeff * jnp.sign(p._value)
+                else:
+                    gv = gv + reg._coeff * p._value
+                g = VarBase(gv, stop_gradient=True)
+            regged.append((p, g))
+        params_grads = regged
 
         lr = self._learning_rate
         lr = lr() if callable(lr) else lr

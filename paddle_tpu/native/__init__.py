@@ -558,6 +558,8 @@ def pack_padded_csr(vals, offs, pad_value=0, max_len=None,
     row_lens = np.diff(offs)
     if n and (row_lens < 0).any():
         raise ValueError("offsets must be non-decreasing")
+    if n and int(offs[0]) < 0:
+        raise ValueError("offsets must start at a non-negative index")
     if n and int(offs[-1]) > vals.size:
         raise ValueError(
             f"offsets end at {int(offs[-1])} but values has {vals.size} "

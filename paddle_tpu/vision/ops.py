@@ -71,10 +71,17 @@ class DeformConv2D(Layer):
         return out
 
 
-def batched_nms(boxes, scores, iou_threshold=0.5, top_k=100):
+def batched_nms(boxes, scores, iou_threshold=0.5, top_k=100,
+                max_outputs=None):
     """Fixed-k NMS usable under jit (static shapes): returns the top_k
     surviving box indices padded with -1 — the TPU-native answer to the
-    dynamic-shape multiclass_nms family."""
+    dynamic-shape multiclass_nms family.
+
+    ``max_outputs`` is the pre-round-4 keyword for ``top_k``, kept as an
+    alias; the old (boxes, scores, mask) tuple return became the single
+    -1-padded index array (see PARITY.md)."""
+    if max_outputs is not None:
+        top_k = max_outputs
     import jax.numpy as jnp
 
     boxes = getattr(boxes, "_value", boxes)

@@ -1,0 +1,85 @@
+"""Regression tests for the round-4 advisor findings.
+
+1. dygraph fluid Optimizer.minimize: grad clip runs on the RAW tape
+   gradients, then regularization is appended (reference
+   fluid/optimizer.py:825-831 order, same as static apply_gradients).
+2. fluid dygraph CosineDecay period is step_each_epoch (reference
+   fluid/dygraph/learning_rate_scheduler.py cosine_decay formula).
+3. native.pack_padded_csr rejects a negative first offset (would drive a
+   native memcpy from vals + negative offset).
+4. vision.ops.batched_nms keeps max_outputs as an accepted alias.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+
+
+class TestDygraphClipBeforeRegularization:
+    def test_decay_excluded_from_clipped_norm(self):
+        from paddle_tpu.dygraph.base import guard, to_variable
+        from paddle_tpu.fluid.clip import GradientClipByGlobalNorm
+        from paddle_tpu.fluid.regularizer import L2DecayRegularizer
+
+        w0 = np.array([3.0, 4.0], np.float32)       # |w| = 5
+        coeff, clip_norm, lr = 0.5, 1.0, 1.0
+        with guard():
+            w = to_variable(w0.copy())
+            w.stop_gradient = False
+            loss = fluid.layers.reduce_sum(
+                w * to_variable(np.array([1.0, 1.0], np.float32)))
+            opt = fluid.optimizer.SGDOptimizer(
+                learning_rate=lr, parameter_list=[w],
+                regularization=L2DecayRegularizer(coeff),
+                grad_clip=GradientClipByGlobalNorm(clip_norm))
+            opt.minimize(loss)
+            got = np.asarray(w._value)
+        # raw grad g = [1,1]; clip first: |g|=sqrt(2)>1 -> g/sqrt(2);
+        # then + coeff*w.  Wrong order would clip (g + coeff*w) instead.
+        g = np.array([1.0, 1.0], np.float32)
+        g_clipped = g / np.sqrt(2.0)
+        expect = w0 - lr * (g_clipped + coeff * w0)
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+
+class TestFluidCosineDecay:
+    def test_matches_reference_floor_formula(self):
+        # reference learning_rate_scheduler.py:571-577:
+        # lr * 0.5 * (cos(floor(step/step_each_epoch) * pi / epochs) + 1)
+        from paddle_tpu.dygraph.learning_rate_scheduler import CosineDecay
+        base, spe, epochs = 0.1, 100, 3
+        sched = CosineDecay(base, step_each_epoch=spe, epochs=epochs)
+        for want_step in (0, 25, 100, 150, 250):
+            while sched.last_epoch < want_step:
+                sched.step()
+            want = base * 0.5 * (
+                math.cos(math.floor(want_step / spe) * math.pi / epochs) + 1)
+            assert sched.get_lr() == pytest.approx(want, rel=1e-6), want_step
+        # mid-epoch the lr is constant (epoch counter is floored) and the
+        # decay only bottoms out at the end of the full run
+        assert sched.get_lr() > 0
+
+
+
+class TestPackPaddedCsrValidation:
+    def test_negative_first_offset_rejected(self):
+        from paddle_tpu import native
+        vals = np.arange(6, dtype=np.int64)
+        offs = np.array([-2, 1, 3], np.int64)       # diffs non-negative
+        with pytest.raises(ValueError):
+            native.pack_padded_csr(vals, offs)
+
+
+class TestBatchedNmsAlias:
+    def test_max_outputs_keyword(self):
+        from paddle_tpu.vision.ops import batched_nms
+        boxes = np.array([[0, 0, 1, 1], [0, 0, 1, 1], [5, 5, 6, 6]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        idx = np.asarray(batched_nms(boxes, scores, iou_threshold=0.5,
+                                     max_outputs=2))
+        assert idx.shape == (2,)
+        # top box kept; duplicate suppressed; second slot is the far box
+        assert idx[0] == 0 and idx[1] == 2
