@@ -1,0 +1,115 @@
+"""Offline Mosaic-lowering pre-flight for Pallas TPU kernels.
+
+Round-3's one hardware up-window was burned discovering that ``lax.erf``
+has no Mosaic lowering rule — the kernel traced fine, interpret mode ran
+fine, and the failure only surfaced on the real chip.  This module makes
+that class of failure a CPU-testable property: trace a function that
+contains ``pl.pallas_call``s, walk every kernel jaxpr (recursing through
+scan/cond/jit/custom-vjp sub-jaxprs), and reject any primitive the Mosaic
+TensorCore lowering registry has no rule for.
+
+The registry is read from jax's own
+``jax._src.pallas.mosaic.lowering.lowering_rules`` (the dict Mosaic
+consults at lowering time, keyed by kernel type — TC is the TensorCore
+set), so the check can't drift from what the compiler actually supports.
+Reference analog: the per-op kernel-availability check in
+``paddle/fluid/framework/operator.cc:1161`` (ChooseKernel raises before
+launch when no kernel is registered for the place) — here the "place" is
+the Mosaic TC target and the check runs at test time instead of on chip.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["mosaic_tc_primitives", "find_unlowerable",
+           "assert_mosaic_lowerable", "MosaicLoweringError"]
+
+
+class MosaicLoweringError(RuntimeError):
+    """A pallas kernel uses a primitive Mosaic cannot lower."""
+
+
+def mosaic_tc_primitives() -> frozenset:
+    """Names of primitives the Mosaic TensorCore backend can lower."""
+    from jax._src.pallas.mosaic import lowering as _ml
+    rules = _ml.lowering_rules
+    # keyed by KernelType since jax 0.8; TC (TensorCore) is what
+    # pl.pallas_call targets on TPU
+    tc_key = next((k for k in rules if getattr(k, "name", "") == "TC"
+                   or str(k).endswith("TC")), None)
+    if tc_key is None:
+        raise MosaicLoweringError(
+            f"could not locate the TensorCore rule set in jax's Mosaic "
+            f"lowering registry (keys: {list(rules)}) — jax internals "
+            f"moved; update mosaic_tc_primitives()")
+    return frozenset(p.name for p in rules[tc_key])
+
+
+def _sub_jaxprs(eqn):
+    """Yield every Jaxpr/ClosedJaxpr reachable from an eqn's params."""
+    from jax._src import core as jcore
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        for item in vs:
+            if isinstance(item, jcore.ClosedJaxpr):
+                yield item.jaxpr
+            elif isinstance(item, jcore.Jaxpr):
+                yield item
+
+
+def _walk_kernel(jaxpr, allowed, bad, kernel_name):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name not in allowed:
+            bad.append((kernel_name, name))
+        for sub in _sub_jaxprs(eqn):
+            _walk_kernel(sub, allowed, bad, kernel_name)
+
+
+def _find_pallas_calls(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            kernel = eqn.params.get("jaxpr")
+            kname = eqn.params.get("name_and_src_info", None)
+            out.append((str(kname) if kname is not None else "<kernel>",
+                        kernel))
+        else:
+            for sub in _sub_jaxprs(eqn):
+                _find_pallas_calls(sub, out)
+
+
+def find_unlowerable(fn, *args, **kwargs):
+    """Trace ``fn(*args, **kwargs)`` (no execution, works on any backend)
+    and return ``(bad, n_kernels)``: ``bad`` is a list of (kernel_name,
+    primitive_name) pairs for every primitive inside a pallas kernel that
+    Mosaic TC cannot lower (empty = all lowerable), ``n_kernels`` the
+    number of pallas_call sites found."""
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    calls = []
+    _find_pallas_calls(closed.jaxpr, calls)
+    allowed = mosaic_tc_primitives()
+    bad = []
+    for kname, kernel in calls:
+        if kernel is None:
+            continue
+        from jax._src import core as jcore
+        if isinstance(kernel, jcore.ClosedJaxpr):
+            kernel = kernel.jaxpr
+        _walk_kernel(kernel, allowed, bad, kname)
+    return bad, len(calls)
+
+
+def assert_mosaic_lowerable(fn, *args, require_kernels=True, **kwargs):
+    """Raise MosaicLoweringError naming the offending (kernel, primitive)
+    pairs; with require_kernels, also fail if NO pallas_call was found
+    (the sweep would silently pass on a refactor that drops the kernel)."""
+    bad, n_calls = find_unlowerable(fn, *args, **kwargs)
+    if require_kernels and n_calls == 0:
+        raise MosaicLoweringError(
+            "no pallas_call found in traced function — preflight entry is "
+            "not exercising a kernel")
+    if bad:
+        lines = ", ".join(f"{k}: '{p}'" for k, p in bad)
+        raise MosaicLoweringError(
+            f"pallas kernel uses primitives with no Mosaic TC lowering "
+            f"rule (would fail at compile time on real TPU): {lines}")

@@ -1,0 +1,114 @@
+"""Mosaic-lowering pre-flight (ops/pallas_preflight.py): every pallas
+kernel in the repo must use only primitives the Mosaic TC backend can
+lower — checked by tracing on CPU, so the `lax.erf` class of failure
+(round 3: traced + interpreted fine, died at compile time in the one
+3-minute hardware window) is caught by the suite, not by the chip.
+
+The rejection test reconstructs exactly that failure: a dropout-gelu
+kernel written with `lax.erf` must be refused, while the shipped A&S
+polynomial version must pass."""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops import pallas_kernels as pk
+from paddle_tpu.ops.pallas_preflight import (MosaicLoweringError,
+                                             assert_mosaic_lowerable,
+                                             find_unlowerable,
+                                             mosaic_tc_primitives)
+
+
+def _x(shape=(8, 256), seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape)
+                       .astype("float32"))
+
+
+KEY = jax.random.PRNGKey(0)
+
+
+class TestRegistry:
+    def test_registry_is_nonempty_and_has_core_prims(self):
+        prims = mosaic_tc_primitives()
+        assert len(prims) > 50
+        for p in ("dot_general", "exp", "tanh", "prng_random_bits",
+                  "prng_seed", "scan", "while", "cond"):
+            assert p in prims, p
+
+    def test_erf_still_missing(self):
+        """If jax grows an erf rule this starts failing — then the A&S
+        polynomial in pallas_kernels._erf can be retired."""
+        assert "erf" not in mosaic_tc_primitives()
+
+
+class TestRejection:
+    def test_erf_kernel_rejected(self):
+        # round-3's failing kernel shape: gelu-via-lax.erf inside pallas
+        def bad_kernel(x_ref, o_ref):
+            x = x_ref[...]
+            o_ref[...] = 0.5 * x * (1.0 + jax.lax.erf(x / np.sqrt(2.0)))
+
+        def run(x):
+            return pl.pallas_call(
+                bad_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+        with pytest.raises(MosaicLoweringError, match="'erf'"):
+            assert_mosaic_lowerable(run, _x())
+
+    def test_no_kernel_rejected_by_default(self):
+        with pytest.raises(MosaicLoweringError, match="no pallas_call"):
+            assert_mosaic_lowerable(lambda x: x + 1, _x())
+
+    def test_plain_fn_ok_when_kernels_not_required(self):
+        bad, n = find_unlowerable(lambda x: jnp.tanh(x) + 1, _x())
+        assert bad == [] and n == 0
+
+
+class TestRepoKernels:
+    """Forward AND backward of every shipped pallas entry point."""
+
+    def test_fused_dropout_fwd_bwd(self):
+        f = lambda x: pk.fused_dropout_tpu(x, KEY, 0.3, True)[0].sum()
+        assert_mosaic_lowerable(lambda x: pk.fused_dropout_tpu(
+            x, KEY, 0.3, True)[0], _x())
+        assert_mosaic_lowerable(jax.grad(f), _x())
+
+    def test_fused_dropout_mask_kernel(self):
+        assert_mosaic_lowerable(
+            lambda x: pk.fused_dropout_tpu(x, KEY, 0.3, True)[1](), _x())
+
+    def test_fused_dropout_add_fwd_bwd(self):
+        def f(x, r):
+            return pk.fused_dropout_add_tpu(x, r, KEY, 0.3, True)
+        assert_mosaic_lowerable(f, _x(), _x(seed=1))
+        assert_mosaic_lowerable(
+            jax.grad(lambda x, r: f(x, r).sum(), argnums=(0, 1)),
+            _x(), _x(seed=1))
+
+    @pytest.mark.parametrize("act", ["gelu", "relu"])
+    def test_fused_act_dropout_fwd_bwd(self, act):
+        def f(x):
+            return pk.fused_act_dropout_tpu(x, KEY, 0.3, True, act)
+        assert_mosaic_lowerable(f, _x())
+        assert_mosaic_lowerable(jax.grad(lambda x: f(x).sum()), _x())
+
+    def test_flash_attention(self):
+        q = _x((1, 2, 256, 64))
+        k = _x((1, 2, 256, 64), 1)
+        v = _x((1, 2, 256, 64), 2)
+        assert_mosaic_lowerable(
+            lambda q, k, v: pk.flash_attention_tpu(q, k, v), q, k, v)
+
+    def test_flash_attention_bwd(self):
+        q = _x((1, 2, 256, 64))
+        k = _x((1, 2, 256, 64), 1)
+        v = _x((1, 2, 256, 64), 2)
+        g = jax.grad(lambda q, k, v: pk.flash_attention_tpu(q, k, v).sum(),
+                     argnums=(0, 1, 2))
+        assert_mosaic_lowerable(g, q, k, v)
