@@ -1,16 +1,22 @@
 """Checkpoint save/load + inference model export.
 
 Reference: python/paddle/fluid/io.py — save_vars:238, save_persistables:620,
-load_persistables:994, save/load_inference_model:1198,1411.  TPU-native
-format: one .npz per save (vars as named numpy arrays) plus a JSON program
-manifest for inference models — functionally equivalent to the reference's
-`__model__` ProgramDesc + per-var files, without protobuf coupling.
+load_persistables:994, save/load_inference_model:1198,1411.
+
+Model format: `__model__` is the ProgramDesc protobuf (the reference's own
+wire format, re-specified in proto/framework.proto), with feed/fetch ops
+spliced in exactly as the reference does (io.py:1151,1179) and an
+OpVersionMap pinning op semantics (fluid/op_version_registry.py).  Params
+are one .npz per save on the native path (fast, safe), and the loader also
+reads the reference's binary formats (per-var LoDTensor files and
+save_combine concatenations) so artifacts produced by the reference load
+directly.  The pre-round-5 pickled-IR format is refused with a re-export
+message — pickle is not a deployment contract.
 """
 from __future__ import annotations
 
 import json
 import os
-import pickle
 from typing import List, Optional
 
 import numpy as np
@@ -83,11 +89,38 @@ def load_params(executor, dirname, main_program=None, filename=None):
     return load_vars(executor, dirname, main_program, filename=filename)
 
 
+def _splice_feed_fetch(program: Program, feed_names, fetch_names) -> None:
+    """Add reference-style feed/fetch holder vars + ops (io.py:1151,1179):
+    feed ops write each input var from the FEED_MINIBATCH holder, fetch
+    ops read each target into the FETCH_LIST holder, `col` = position."""
+    block = program.global_block()
+    feed_var = block.create_var(name="feed", dtype=None)
+    feed_var.proto_var_type = "feed"
+    feed_var.persistable = True
+    fetch_var = block.create_var(name="fetch", dtype=None)
+    fetch_var.proto_var_type = "fetch"
+    fetch_var.persistable = True
+    from .framework import Operator
+    feed_ops = [Operator(block, "feed", {"X": ["feed"]}, {"Out": [name]},
+                         {"col": i})
+                for i, name in enumerate(feed_names)]
+    fetch_ops = [Operator(block, "fetch", {"X": [name]},
+                          {"Out": ["fetch"]}, {"col": i})
+                 for i, name in enumerate(fetch_names)]
+    block.ops[:0] = feed_ops
+    block.ops.extend(fetch_ops)
+    program._bump_version()
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          program_only=False):
-    """Export program(pickled IR) + params — io.py:1198 analog."""
+    """Export `__model__` (ProgramDesc protobuf) + params — io.py:1198
+    analog.  With params_filename the params are ALSO written in the
+    reference save_combine binary format next to the native npz, so the
+    artifact is consumable by reference tooling."""
+    from . import proto_serde
     main_program = main_program or default_main_program()
     os.makedirs(dirname, exist_ok=True)
     # clone(for_test) strips the backward tail; _prune then cuts to the
@@ -99,26 +132,91 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_names": list(feeded_var_names),
         "fetch_names": [v.name for v in target_vars],
     }
+    _splice_feed_fetch(infer_prog, manifest["feed_names"],
+                       manifest["fetch_names"])
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump(manifest, f)
-    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
-        pickle.dump(infer_prog, f)
+    with open(os.path.join(dirname, model_filename or "__model__"),
+              "wb") as f:
+        f.write(proto_serde.program_to_proto_bytes(infer_prog))
     if not program_only:
-        save_persistables(executor, dirname, main_program,
-                          filename=params_filename)
+        save_persistables(executor, dirname, main_program)
+        if params_filename:
+            scope = global_scope()
+            arrays = {}
+            for v in _vars_to_save(infer_prog):
+                if getattr(v, "proto_var_type", None) in ("feed", "fetch"):
+                    continue
+                val = scope.find_var(v.name)
+                if val is None:
+                    # the combined format is positional (sorted names); a
+                    # gap would shift every later tensor onto the wrong var
+                    raise ValueError(
+                        f"persistable var '{v.name}' has no value in the "
+                        f"scope — run the startup program before exporting")
+                arrays[v.name] = np.asarray(val)
+            proto_serde.save_combined_params(
+                os.path.join(dirname, params_filename), arrays)
     return manifest["fetch_names"]
+
+
+def _load_reference_params(dirname, program, params_filename=None):
+    """Read params saved in the reference's binary formats: one combined
+    save_combine file, or one LoDTensor file per persistable var."""
+    from . import proto_serde
+    import jax.numpy as jnp
+    scope = global_scope()
+    names = [v.name for v in program.global_block().vars.values()
+             if v.persistable
+             and getattr(v, "proto_var_type", None) not in ("feed", "fetch")]
+    if params_filename:
+        arrays = proto_serde.load_combined_params(
+            os.path.join(dirname, params_filename), names)
+        for name, arr in arrays.items():
+            scope.set_var(name, jnp.asarray(arr))
+        return
+    for name in names:
+        path = os.path.join(dirname, name)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"no param file for persistable var '{name}' in {dirname}")
+        with open(path, "rb") as f:
+            arr, _lod, _ = proto_serde.deserialize_lod_tensor(f.read())
+        scope.set_var(name, jnp.asarray(arr))
 
 
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
-    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
-        program = pickle.load(f)
-    with open(os.path.join(dirname, "__model__.json")) as f:
-        manifest = json.load(f)
-    load_persistables(executor, dirname, program, filename=params_filename)
-    fetch_vars = [program.global_block().var(n)
-                  for n in manifest["fetch_names"]]
-    return program, manifest["feed_names"], fetch_vars
+    """Load a `__model__` ProgramDesc (this framework's OR the
+    reference's) + params (native npz, reference combined file, or
+    reference per-var files) — io.py:1411 analog."""
+    from . import proto_serde
+    path = os.path.join(dirname, model_filename or "__model__")
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:2] == b"\x80\x04" or data[:2] == b"\x80\x03":
+        raise RuntimeError(
+            f"{path} is a legacy pickled-IR artifact; re-export it with "
+            f"save_inference_model — the model format is now the "
+            f"ProgramDesc protobuf")
+    program = proto_serde.program_from_proto_bytes(data)
+    feed_names, fetch_names = proto_serde.strip_feed_fetch_ops(program)
+    manifest_path = os.path.join(dirname, "__model__.json")
+    if not fetch_names and os.path.exists(manifest_path):
+        # program had no feed/fetch ops (program_only legacy export)
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        feed_names = manifest["feed_names"]
+        fetch_names = manifest["fetch_names"]
+    if params_filename:
+        # an explicit params file always wins over a sibling params.npz
+        _load_reference_params(dirname, program, params_filename)
+    elif os.path.exists(os.path.join(dirname, "params.npz")):
+        load_persistables(executor, dirname, program)
+    else:
+        _load_reference_params(dirname, program, None)
+    fetch_vars = [program.global_block().var(n) for n in fetch_names]
+    return program, feed_names, fetch_vars
 
 
 def get_program_persistable_vars(program):

@@ -1,0 +1,97 @@
+"""Op semantic-version registry — what a saved op's attrs MEAN.
+
+Reference analog: paddle/fluid/framework/op_version_registry.h (each op
+registers a version; saved programs carry an OpVersionMap; loaders use it
+for compatibility decisions).  Here the registry does two jobs:
+
+* on SAVE, `snapshot()` records the current version of every op type that
+  appears in the program into ProgramDesc.op_version_map;
+* on LOAD, `check_and_convert()` compares each saved op's version with the
+  running registry: older versions are upgraded through registered
+  attr-level converters (applied in sequence v, v+1, ... current-1), a
+  NEWER version than the runtime knows is a hard error (the attrs could
+  silently mean something else), and an op absent from the saved map is
+  treated as version 0 (pre-versioning save).
+
+Register a version bump together with its converter so old artifacts keep
+loading:
+
+    register_op_version("dropout", 1)
+
+    @register_converter("dropout", from_version=0)
+    def _(attrs):  # mutate attrs in place to version-1 meaning
+        attrs.setdefault("dropout_implementation", "downgrade_in_infer")
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+__all__ = ["register_op_version", "register_converter", "current_version",
+           "snapshot", "check_and_convert", "OpVersionError"]
+
+
+class OpVersionError(RuntimeError):
+    """Saved op version is ahead of what this runtime understands."""
+
+
+_VERSIONS: Dict[str, int] = {}
+_CONVERTERS: Dict[Tuple[str, int], Callable] = {}
+
+
+def register_op_version(op_type: str, version: int) -> None:
+    if version < 0:
+        raise ValueError("op version must be >= 0")
+    _VERSIONS[op_type] = max(version, _VERSIONS.get(op_type, 0))
+
+
+def register_converter(op_type: str, from_version: int):
+    """Decorator: register fn(attrs_dict) upgrading `op_type` attrs from
+    `from_version` to `from_version + 1` semantics (mutates in place)."""
+    def deco(fn):
+        _CONVERTERS[(op_type, from_version)] = fn
+        return fn
+    return deco
+
+
+def current_version(op_type: str) -> int:
+    return _VERSIONS.get(op_type, 0)
+
+
+def snapshot(op_types) -> Dict[str, int]:
+    """Current version of every op type in the iterable (for save)."""
+    return {t: current_version(t) for t in set(op_types)}
+
+
+def check_and_convert(op_type: str, attrs: dict, saved_version: int) -> None:
+    """Upgrade `attrs` in place from saved_version to the current version.
+
+    Raises OpVersionError only for ops THIS registry tracks when the
+    artifact is ahead of the known history — for untracked ops any saved
+    version is accepted, because real reference exports pin versions for
+    many ops (their registry, op_version_registry.h) whose current
+    semantics are exactly what this framework implements; refusing those
+    would reject every genuine reference model."""
+    cur = current_version(op_type)
+    if saved_version > cur:
+        if op_type in _VERSIONS:
+            raise OpVersionError(
+                f"op '{op_type}' was saved at version {saved_version} but "
+                f"this runtime only understands version {cur}; upgrade "
+                f"paddle_tpu or re-export the model")
+        return  # untracked op: implementation follows the reference head
+    for v in range(saved_version, cur):
+        conv = _CONVERTERS.get((op_type, v))
+        if conv is not None:
+            conv(attrs)
+
+
+# --- registered version history -------------------------------------------
+# dropout v1: `dropout_implementation` attr became load-bearing (upscale vs
+# downgrade semantics, reference dropout_op.cc); v0 saves predate the attr
+# and meant the historical default.
+register_op_version("dropout", 1)
+
+
+@register_converter("dropout", from_version=0)
+def _dropout_v0_to_v1(attrs):
+    attrs.setdefault("dropout_implementation", "downgrade_in_infer")

@@ -1,0 +1,9 @@
+"""Generated protobuf bindings for the ProgramDesc wire format.
+
+`framework_pb2.py` is checked in (generated from `framework.proto`, see
+that file for the interop contract); regenerate with:
+
+    protoc --python_out=paddle_tpu/fluid/proto \
+        -I paddle_tpu/fluid/proto paddle_tpu/fluid/proto/framework.proto
+"""
+from . import framework_pb2  # noqa: F401

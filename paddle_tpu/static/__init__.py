@@ -88,23 +88,30 @@ from ..fluid.param_attr import WeightNormParamAttr  # noqa: F401
 
 
 # -- program/persistable serialization (reference static/io.py) --------------
+# Formats: programs are ProgramDesc protobuf bytes (the `__model__` wire
+# contract, proto/framework.proto); persistables are a JSON name header +
+# concatenated reference-format LoDTensor streams (self-describing, no
+# pickle anywhere in the deployment contract).
+
 def serialize_program(feed_vars, fetch_vars, program=None):
-    import pickle
     from ..fluid import default_main_program
+    from ..fluid import proto_serde
     prog = program or default_main_program()
-    return pickle.dumps(prog)
+    return proto_serde.program_to_proto_bytes(prog)
 
 
 def deserialize_program(data):
-    import pickle
-    return pickle.loads(data)
+    from ..fluid import proto_serde
+    return proto_serde.program_from_proto_bytes(data)
 
 
 def serialize_persistables(feed_vars, fetch_vars, executor=None,
                            program=None):
-    import pickle
+    import json
+    import struct
     import numpy as _np
     from ..fluid import default_main_program
+    from ..fluid import proto_serde
     from ..fluid.core import global_scope as _gs
     prog = program or default_main_program()
     state = {}
@@ -113,15 +120,31 @@ def serialize_persistables(feed_vars, fetch_vars, executor=None,
             val = _gs().find_var(v.name)
             if val is not None:
                 state[v.name] = _np.asarray(val)
-    return pickle.dumps(state)
+    header = json.dumps({"names": sorted(state)}).encode()
+    out = [struct.pack("<I", len(header)), header]
+    for name in sorted(state):
+        out.append(proto_serde.serialize_lod_tensor(state[name]))
+    return b"".join(out)
 
 
 def deserialize_persistables(program, data, executor=None):
-    import pickle
+    import json
+    import struct
+    from ..fluid import proto_serde
     from ..fluid.core import global_scope as _gs
-    state = pickle.loads(data)
-    for name, val in state.items():
-        _gs().set_var(name, val)
+    if data[:2] in (b"\x80\x03", b"\x80\x04"):
+        raise RuntimeError(
+            "this persistables blob is a legacy pickle dump; re-export it "
+            "with serialize_persistables — the format is now a JSON name "
+            "header + binary LoDTensor streams")
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    header = json.loads(data[4:4 + hlen].decode())
+    offset = 4 + hlen
+    state = {}
+    for name in header["names"]:
+        arr, _lod, offset = proto_serde.deserialize_lod_tensor(data, offset)
+        state[name] = arr
+        _gs().set_var(name, arr)
     return state
 
 

@@ -1,0 +1,143 @@
+"""Generate the tiny reference-layout `__model__` fixture.
+
+Builds the artifact EXACTLY as the reference's save_inference_model lays
+it out (python/paddle/fluid/io.py:1198 + prepend_feed_ops:1151 +
+append_fetch_ops:1179) for a one-layer fc+softmax net:
+
+    out = softmax(x @ w + b)
+
+using the raw protobuf bindings directly — deliberately NOT this repo's
+Program serializer — so the fixture is an independent statement of the
+wire contract: vars x (LOD_TENSOR, need_check_feed) / w, b (persistable)
+/ feed, fetch holders; ops feed -> mul -> elementwise_add -> softmax ->
+fetch with the reference's attr sets; params as one binary LoDTensor
+stream per var (lod_tensor.cc:243 format).
+
+Deterministic: fixed param values, no RNG.  Run as a script to (re)write
+tests/fixtures/ref_fc_model/.
+"""
+import os
+import struct
+
+import numpy as np
+
+from paddle_tpu.fluid.proto import framework_pb2 as fp
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "ref_fc_model")
+
+# fixed tiny params: w [4, 3], b [3]
+W = (np.arange(12, dtype=np.float32).reshape(4, 3) - 5.0) / 7.0
+B = np.array([0.1, -0.2, 0.3], np.float32)
+
+
+def _add_lod_var(block, name, dims, persistable=False,
+                 need_check_feed=False):
+    v = block.vars.add()
+    v.name = name
+    v.type.type = fp.VarType.LOD_TENSOR
+    v.type.lod_tensor.tensor.data_type = fp.VarType.FP32
+    v.type.lod_tensor.tensor.dims.extend(dims)
+    if persistable:
+        v.persistable = True
+    if need_check_feed:
+        v.need_check_feed = True
+    return v
+
+
+def _add_op(block, op_type, inputs, outputs, attrs):
+    op = block.ops.add()
+    op.type = op_type
+    for slot, args in inputs:
+        pv = op.inputs.add()
+        pv.parameter = slot
+        pv.arguments.extend(args)
+    for slot, args in outputs:
+        pv = op.outputs.add()
+        pv.parameter = slot
+        pv.arguments.extend(args)
+    for name, atype, value in attrs:
+        a = op.attrs.add()
+        a.name = name
+        a.type = atype
+        if atype == fp.INT:
+            a.i = value
+        elif atype == fp.FLOAT:
+            a.f = value
+        elif atype == fp.STRING:
+            a.s = value
+        elif atype == fp.BOOLEAN:
+            a.b = value
+    return op
+
+
+def build_model_bytes() -> bytes:
+    pb = fp.ProgramDesc()
+    block = pb.blocks.add()
+    block.idx = 0
+    block.parent_idx = -1
+
+    hv = block.vars.add()
+    hv.name = "feed"
+    hv.type.type = fp.VarType.FEED_MINIBATCH
+    hv.persistable = True
+    hv = block.vars.add()
+    hv.name = "fetch"
+    hv.type.type = fp.VarType.FETCH_LIST
+    hv.persistable = True
+
+    _add_lod_var(block, "x", [-1, 4], need_check_feed=True)
+    _add_lod_var(block, "w", [4, 3], persistable=True)
+    _add_lod_var(block, "b", [3], persistable=True)
+    _add_lod_var(block, "mul_out", [-1, 3])
+    _add_lod_var(block, "add_out", [-1, 3])
+    _add_lod_var(block, "softmax_out", [-1, 3])
+
+    _add_op(block, "feed", [("X", ["feed"])], [("Out", ["x"])],
+            [("col", fp.INT, 0)])
+    _add_op(block, "mul", [("X", ["x"]), ("Y", ["w"])],
+            [("Out", ["mul_out"])],
+            [("x_num_col_dims", fp.INT, 1), ("y_num_col_dims", fp.INT, 1)])
+    _add_op(block, "elementwise_add",
+            [("X", ["mul_out"]), ("Y", ["b"])], [("Out", ["add_out"])],
+            [("axis", fp.INT, -1)])
+    _add_op(block, "softmax", [("X", ["add_out"])],
+            [("Out", ["softmax_out"])], [("axis", fp.INT, -1)])
+    _add_op(block, "fetch", [("X", ["softmax_out"])],
+            [("Out", ["fetch"])], [("col", fp.INT, 0)])
+    return pb.SerializeToString()
+
+
+def param_stream(arr: np.ndarray) -> bytes:
+    """Reference LoDTensor stream, written with raw struct packing (the
+    lod_tensor.cc:243 layout) — independent of proto_serde."""
+    desc = fp.VarType.TensorDesc()
+    desc.data_type = fp.VarType.FP32
+    desc.dims.extend(arr.shape)
+    desc_bytes = desc.SerializeToString()
+    return (struct.pack("<I", 0)                 # LoDTensor version
+            + struct.pack("<Q", 0)               # no lod levels
+            + struct.pack("<I", 0)               # Tensor version
+            + struct.pack("<i", len(desc_bytes)) + desc_bytes
+            + np.ascontiguousarray(arr).tobytes())
+
+
+def expected_output(x: np.ndarray) -> np.ndarray:
+    z = x @ W + B
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def write_fixture(dirname=FIXTURE_DIR) -> str:
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__model__"), "wb") as f:
+        f.write(build_model_bytes())
+    with open(os.path.join(dirname, "w"), "wb") as f:
+        f.write(param_stream(W))
+    with open(os.path.join(dirname, "b"), "wb") as f:
+        f.write(param_stream(B))
+    return dirname
+
+
+if __name__ == "__main__":
+    print(write_fixture())

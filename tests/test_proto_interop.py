@@ -1,0 +1,266 @@
+"""ProgramDesc protobuf interop (fluid/proto_serde.py + op_version_registry).
+
+The model format contract: `__model__` is the reference's ProgramDesc wire
+format (re-specified in proto/framework.proto), params are readable in the
+reference's binary LoDTensor formats.  The fixture in
+tests/fixtures/ref_fc_model is built with raw protobuf (reference
+io.py:1198 layout, independent of this repo's serializer) and must load
+and run through the full inference path.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import proto_serde
+from paddle_tpu.fluid import op_version_registry as opver
+from paddle_tpu.fluid.proto import framework_pb2 as fp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "fixtures"))
+import gen_ref_fc_model as fixture  # noqa: E402
+
+FIXTURE_DIR = fixture.FIXTURE_DIR
+
+
+def _build_program():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.data("x", [-1, 4])
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.fc(h, 3, act="softmax")
+    return prog, startup, out
+
+
+class TestProgramRoundTrip:
+    def test_ops_vars_attrs_survive(self):
+        prog, _, _ = _build_program()
+        data = proto_serde.program_to_proto_bytes(prog)
+        prog2 = proto_serde.program_from_proto_bytes(data)
+        b1, b2 = prog.global_block(), prog2.global_block()
+        assert [op.type for op in b1.ops] == [op.type for op in b2.ops]
+        for op1, op2 in zip(b1.ops, b2.ops):
+            assert op1.inputs == op2.inputs
+            assert op1.outputs == op2.outputs
+            for k, v in op1.attrs.items():
+                if v is None:
+                    continue
+                got = op2.attrs[k]
+                if isinstance(v, float):
+                    assert got == pytest.approx(v, rel=1e-6)
+                elif isinstance(v, (list, tuple)) \
+                        and v and isinstance(v[0], float):
+                    np.testing.assert_allclose(got, v, rtol=1e-6)
+                else:
+                    assert got == v or list(got) == list(v), k
+        for name, v in b1.vars.items():
+            v2 = b2.vars[name]
+            assert v2.persistable == v.persistable, name
+            if v.shape is not None:
+                assert tuple(v2.shape) == tuple(v.shape), name
+
+    def test_executes_identically_after_round_trip(self):
+        prog, startup, out = _build_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        x = np.random.RandomState(0).randn(5, 4).astype("float32")
+        (y1,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        prog2 = proto_serde.program_from_proto_bytes(
+            proto_serde.program_to_proto_bytes(prog))
+        (y2,) = exe.run(prog2, feed={"x": x},
+                        fetch_list=[out.name])
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   rtol=1e-6)
+
+    def test_control_flow_block_attrs_survive(self):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.data("x", [1])
+            cond = fluid.layers.greater_than(
+                fluid.layers.reduce_sum(x),
+                fluid.layers.fill_constant([1], "float32", 0.0))
+            out = fluid.layers.cond(cond, lambda: x * 2.0,
+                                    lambda: x - 1.0)
+        data = proto_serde.program_to_proto_bytes(prog)
+        prog2 = proto_serde.program_from_proto_bytes(data)
+        assert len(prog2.blocks) == len(prog.blocks)
+        # the conditional op's block refs point at real blocks
+        cond_ops = [op for op in prog2.global_block().ops
+                    if "true_block" in op.attrs]
+        assert cond_ops
+        for op in cond_ops:
+            tb = op.attrs["true_block"]
+            assert 0 < tb < len(prog2.blocks)
+        pb = fp.ProgramDesc()
+        pb.ParseFromString(data)
+        block_attrs = [a for b in pb.blocks for o in b.ops
+                       for a in o.attrs if a.type == fp.BLOCK]
+        assert block_attrs, "block refs must be typed BLOCK on the wire"
+
+
+class TestInferenceModelFormat:
+    def test_save_load_run(self, tmp_path):
+        prog, startup, out = _build_program()
+        exe = fluid.Executor()
+        exe.run(startup)
+        x = np.random.RandomState(1).randn(4, 4).astype("float32")
+        (want,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        d = str(tmp_path / "model")
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=prog)
+        # __model__ parses with plain protobuf (the wire contract)
+        pb = fp.ProgramDesc()
+        with open(os.path.join(d, "__model__"), "rb") as f:
+            pb.ParseFromString(f.read())
+        types = [op.type for op in pb.blocks[0].ops]
+        assert types[0] == "feed" and types[-1] == "fetch"
+        assert pb.op_version_map.pair  # versions recorded
+        prog2, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        assert feeds == ["x"]
+        (got,) = exe.run(prog2, feed={"x": x},
+                         fetch_list=[fetches[0].name])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+
+    def test_legacy_pickle_refused(self, tmp_path):
+        import pickle
+        d = tmp_path / "legacy"
+        d.mkdir()
+        with open(d / "__model__", "wb") as f:
+            pickle.dump({"not": "a model"}, f)
+        with pytest.raises(RuntimeError, match="pickle"):
+            fluid.io.load_inference_model(str(d), fluid.Executor())
+
+
+class TestReferenceFixture:
+    """A __model__ + per-var params laid out by the REFERENCE's save path
+    loads and runs end-to-end."""
+
+    def test_fixture_is_deterministic(self):
+        with open(os.path.join(FIXTURE_DIR, "__model__"), "rb") as f:
+            assert f.read() == fixture.build_model_bytes()
+
+    def test_loads_and_runs(self):
+        exe = fluid.Executor()
+        prog, feeds, fetches = fluid.io.load_inference_model(
+            FIXTURE_DIR, exe)
+        assert feeds == ["x"]
+        x = np.random.RandomState(2).randn(6, 4).astype("float32")
+        (got,) = exe.run(prog, feed={"x": x},
+                         fetch_list=[fetches[0].name])
+        np.testing.assert_allclose(np.asarray(got),
+                                   fixture.expected_output(x), rtol=1e-5)
+
+    def test_predictor_serves_fixture(self):
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+        cfg = AnalysisConfig(FIXTURE_DIR)
+        pred = create_predictor(cfg)
+        x = np.random.RandomState(3).randn(2, 4).astype("float32")
+        names = pred.get_input_names()
+        h = pred.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0])
+        np.testing.assert_allclose(out.copy_to_cpu(),
+                                   fixture.expected_output(x), rtol=1e-5)
+
+
+class TestTensorStreams:
+    def test_lod_tensor_round_trip(self):
+        arr = np.random.RandomState(0).randn(5, 7).astype("float32")
+        lod = [[0, 2, 5]]
+        buf = proto_serde.serialize_lod_tensor(arr, lod)
+        got, got_lod, end = proto_serde.deserialize_lod_tensor(buf)
+        assert end == len(buf)
+        np.testing.assert_array_equal(got, arr)
+        assert got_lod == [[0, 2, 5]]
+
+    @pytest.mark.parametrize("dtype", ["float32", "float64", "int32",
+                                       "int64", "uint8", "bool"])
+    def test_dtypes(self, dtype):
+        arr = (np.random.RandomState(1).rand(3, 4) * 10).astype(dtype)
+        got, _, _ = proto_serde.deserialize_lod_tensor(
+            proto_serde.serialize_lod_tensor(arr))
+        np.testing.assert_array_equal(got, arr)
+
+    def test_combined_params_round_trip(self, tmp_path):
+        arrays = {"b": np.arange(3, dtype=np.float32),
+                  "a": np.ones((2, 2), np.float32),
+                  "c": np.zeros((1, 5), np.int64)}
+        p = str(tmp_path / "params")
+        proto_serde.save_combined_params(p, arrays)
+        got = proto_serde.load_combined_params(p, list(arrays))
+        for k in arrays:
+            np.testing.assert_array_equal(got[k], arrays[k])
+
+    def test_combined_trailing_bytes_detected(self, tmp_path):
+        p = str(tmp_path / "params")
+        proto_serde.save_combined_params(
+            p, {"a": np.ones(2, np.float32), "b": np.ones(2, np.float32)})
+        with pytest.raises(ValueError, match="trailing"):
+            proto_serde.load_combined_params(p, ["a"])
+
+
+class TestOpVersionMap:
+    def test_old_version_converted(self):
+        pb = fp.ProgramDesc()
+        block = pb.blocks.add()
+        block.idx, block.parent_idx = 0, -1
+        op = block.ops.add()
+        op.type = "dropout"
+        pv = op.inputs.add(); pv.parameter = "X"; pv.arguments.append("x")
+        pv = op.outputs.add(); pv.parameter = "Out"
+        pv.arguments.append("y")
+        a = op.attrs.add()
+        a.name, a.type, a.f = "dropout_prob", fp.FLOAT, 0.5
+        pair = pb.op_version_map.pair.add()
+        pair.op_name = "dropout"
+        pair.op_version.version = 0
+        prog = proto_serde.program_from_proto(pb)
+        (dp,) = [o for o in prog.global_block().ops
+                 if o.type == "dropout"]
+        # v0->v1 converter injected the historical default
+        assert dp.attrs["dropout_implementation"] == "downgrade_in_infer"
+
+    def test_absent_map_treated_as_v0(self):
+        pb = fp.ProgramDesc()
+        block = pb.blocks.add()
+        block.idx, block.parent_idx = 0, -1
+        op = block.ops.add()
+        op.type = "dropout"
+        prog = proto_serde.program_from_proto(pb)
+        assert prog.global_block().ops[0].attrs[
+            "dropout_implementation"] == "downgrade_in_infer"
+
+    def test_untracked_op_any_version_accepted(self):
+        # real reference exports pin versions for many ops this registry
+        # doesn't track — those must load, not raise
+        pb = fp.ProgramDesc()
+        block = pb.blocks.add()
+        block.idx, block.parent_idx = 0, -1
+        op = block.ops.add()
+        op.type = "elementwise_add"
+        pair = pb.op_version_map.pair.add()
+        pair.op_name = "elementwise_add"
+        pair.op_version.version = 1
+        prog = proto_serde.program_from_proto(pb)
+        assert prog.global_block().ops[0].type == "elementwise_add"
+
+    def test_empty_list_attr_is_ints_on_wire(self):
+        pb_attr = fp.OpDesc.Attr()
+        assert proto_serde._set_attr(pb_attr, "axes", [], "squeeze")
+        assert pb_attr.type == fp.INTS
+
+    def test_future_version_refused(self):
+        pb = fp.ProgramDesc()
+        block = pb.blocks.add()
+        block.idx, block.parent_idx = 0, -1
+        op = block.ops.add()
+        op.type = "dropout"
+        pair = pb.op_version_map.pair.add()
+        pair.op_name = "dropout"
+        pair.op_version.version = 99
+        with pytest.raises(opver.OpVersionError, match="version 99"):
+            proto_serde.program_from_proto(pb)
