@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Framework-free consumer of a paddle_tpu AOT artifact.
+
+Proves the deployment claim of inference/aot.py: serving a saved
+`model.stablehlo` + `aot_meta.json` needs ONLY the pinned jax.export
+deserialize interface over PJRT — not paddle_tpu, not the model's Python
+code, not its op registry.  This script never imports paddle_tpu (and
+asserts so); it is the capi/go-client analog (reference
+paddle/fluid/inference/capi/) for the XLA deployment story: the same two
+files can be served from any language with a PJRT binding, and
+`--dump-mlir` shows the artifact is open compiler IR, not a framework
+blob.
+
+Usage:
+    python examples/aot_serve.py MODEL_DIR --input x=INPUT.npy ...
+    python examples/aot_serve.py MODEL_DIR --random     # meta-shaped RNG
+    python examples/aot_serve.py MODEL_DIR --dump-mlir  # print StableHLO
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("model_dir")
+    ap.add_argument("--input", action="append", default=[],
+                    metavar="NAME=FILE.npy",
+                    help="bind a feed by name to a .npy file")
+    ap.add_argument("--random", action="store_true",
+                    help="feed RNG data shaped per the sidecar meta")
+    ap.add_argument("--dump-mlir", action="store_true",
+                    help="print the StableHLO module text and exit")
+    args = ap.parse_args(argv)
+
+    # honor JAX_PLATFORMS in-process: some PJRT plugins ignore the env var
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    from jax import export as jexport
+
+    with open(os.path.join(args.model_dir, "model.stablehlo"), "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(os.path.join(args.model_dir, "aot_meta.json")) as f:
+        meta = json.load(f)
+
+    if args.dump_mlir:
+        print(exported.mlir_module())
+        return 0
+
+    feeds = {}
+    for spec in args.input:
+        name, path = spec.split("=", 1)
+        feeds[name] = np.load(path)
+    if args.random:
+        rng = np.random.RandomState(0)
+        for name in meta["feed_names"]:
+            if name not in feeds:
+                shape = meta["input_shapes"][name]
+                dtype = np.dtype(meta["input_dtypes"][name])
+                if dtype.kind in "iu":
+                    feeds[name] = rng.randint(0, 2, shape).astype(dtype)
+                else:
+                    feeds[name] = rng.randn(*shape).astype(dtype)
+    missing = [n for n in meta["feed_names"] if n not in feeds]
+    if missing:
+        ap.error(f"missing inputs: {missing} (use --input or --random)")
+
+    outs = exported.call(*[feeds[n] for n in meta["feed_names"]])
+    for name, out in zip(meta["fetch_names"], outs):
+        arr = np.asarray(out)
+        print(f"{name}: shape={arr.shape} dtype={arr.dtype} "
+              f"mean={arr.mean():.6f}")
+        np.save(os.path.join(args.model_dir, f"out_{name}.npy"), arr)
+
+    assert "paddle_tpu" not in sys.modules, \
+        "consumer must stay framework-free"
+    print("served without paddle_tpu")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,6 +134,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     }
     _splice_feed_fetch(infer_prog, manifest["feed_names"],
                        manifest["fetch_names"])
+    # deliberate human-readable sidecar (feed/fetch are authoritative in
+    # the protobuf's feed/fetch ops; this is for quick shell inspection)
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         json.dump(manifest, f)
     with open(os.path.join(dirname, model_filename or "__model__"),

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .framework import (Block, Operator, Parameter, Program, Variable,
-                        _PROTO_DTYPE)
+                        _PROTO_DTYPE, _SUB_BLOCK_ATTRS)
 from . import op_version_registry as opver
 from .proto import framework_pb2 as fp
 
@@ -42,9 +42,8 @@ __all__ = ["program_to_proto_bytes", "program_from_proto_bytes",
 
 _DTYPE_TO_PROTO = {name: code for code, name in _PROTO_DTYPE.items()}
 
-# attr names whose int value is a block index (fluid/framework.py
-# _SUB_BLOCK_ATTRS); written with AttrType.BLOCK
-_BLOCK_ATTRS = ("sub_block", "cond_block", "true_block", "false_block")
+# attr names whose int value is a block index; written with AttrType.BLOCK
+_BLOCK_ATTRS = _SUB_BLOCK_ATTRS
 
 _INT32_MIN, _INT32_MAX = -(2 ** 31), 2 ** 31 - 1
 
@@ -212,14 +211,19 @@ def program_from_proto(pb: "fp.ProgramDesc") -> Program:
     prog = Program()
     saved_vers = {pair.op_name: pair.op_version.version
                   for pair in pb.op_version_map.pair}
-    # allocate blocks first so parent links and block-attrs resolve
+    # allocate blocks first so parent links and block-attrs resolve;
+    # place by idx — the repeated field may arrive in any order
+    n_blocks = max((b.idx for b in pb.blocks), default=0) + 1
+    prog.blocks.extend(None for _ in range(n_blocks - 1))
     for pb_block in pb.blocks:
         if pb_block.idx == 0:
-            block = prog.blocks[0]
-            block.parent_idx = pb_block.parent_idx
+            prog.blocks[0].parent_idx = pb_block.parent_idx
         else:
-            block = Block(prog, pb_block.idx, pb_block.parent_idx)
-            prog.blocks.append(block)
+            prog.blocks[pb_block.idx] = Block(prog, pb_block.idx,
+                                              pb_block.parent_idx)
+    missing = [i for i, b in enumerate(prog.blocks) if b is None]
+    if missing:
+        raise ValueError(f"ProgramDesc has gaps in block indices: {missing}")
     for pb_block in pb.blocks:
         block = prog.blocks[pb_block.idx]
         for pb_var in pb_block.vars:

@@ -104,6 +104,39 @@ class TestAotExport:
         got = out[served.get_output_names()[0]]
         np.testing.assert_allclose(got, ref, rtol=1e-5)
 
+    def test_framework_free_consumer(self, tmp_path, rng):
+        """examples/aot_serve.py serves the artifact in a fresh process
+        WITHOUT importing paddle_tpu — the capi/go-client replacement
+        claim (inference/aot.py docstring), made checkable."""
+        import os
+        import subprocess
+        import sys
+        model_dir, xs, ref = _train_and_export(tmp_path, rng)
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model)
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot_ext")
+        save_aot_model(aot_dir, p, {"x": xs})
+        np.save(str(tmp_path / "x.npy"), xs)
+        script = os.path.join(os.path.dirname(__file__), "..", "examples",
+                              "aot_serve.py")
+        r = subprocess.run(
+            [sys.executable, script, aot_dir, "--input",
+             f"x={tmp_path / 'x.npy'}"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr
+        assert "served without paddle_tpu" in r.stdout
+        out_name = p.get_output_names()[0]
+        got = np.load(os.path.join(aot_dir, f"out_{out_name}.npy"))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+        # --dump-mlir shows open compiler IR
+        r2 = subprocess.run(
+            [sys.executable, script, aot_dir, "--dump-mlir"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r2.returncode == 0 and "stablehlo" in r2.stdout
+
     def test_missing_feed_rejected(self, tmp_path, rng):
         model_dir, xs, _ = _train_and_export(tmp_path, rng)
         from paddle_tpu.inference import (AnalysisConfig, create_predictor,

@@ -98,8 +98,29 @@ def main():
         cwd=_ROOT)
     assert r.returncode == 0, "op-sweep subset failed"
 
+    step("AOT artifact served framework-free (examples/aot_serve.py)")
+    import tempfile
+    from paddle_tpu.fluid import io as fio
+    from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                      save_aot_model)
+    with tempfile.TemporaryDirectory() as td:
+        mdir = os.path.join(td, "m")
+        test_p = main_p.clone(for_test=True)
+        fio.save_inference_model(mdir, ["x"], [logits], exe,
+                                 main_program=test_p)
+        pred = create_predictor(AnalysisConfig(mdir))
+        adir = os.path.join(td, "aot")
+        save_aot_model(adir, pred, {"x": xs[:4]})
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "examples",
+                                          "aot_serve.py"),
+             adir, "--random"],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr
+        assert "served without paddle_tpu" in r.stdout
+
     step("bench child emits one JSON line (cpu)")
-    import os
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],
         env=dict(os.environ, GRAFT_BENCH_CHILD="1", JAX_PLATFORMS="cpu"),
