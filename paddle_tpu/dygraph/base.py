@@ -66,7 +66,8 @@ class VarBase:
         return VarBase(self._value, stop_gradient=self.stop_gradient)
 
     def astype(self, dtype):
-        return VarBase(self._value.astype(convert_dtype(dtype)),
+        from ..fluid.framework import device_dtype
+        return VarBase(self._value.astype(device_dtype(dtype)),
                        stop_gradient=self.stop_gradient)
 
     # --- autograd ----------------------------------------------------------

@@ -56,6 +56,22 @@ def convert_dtype(dtype) -> str:
         raise ValueError(f"unsupported dtype: {dtype!r}")
 
 
+_64_TO_32 = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
+def device_dtype(dtype) -> str:
+    """Canonical dtype name as it will exist ON DEVICE: 64-bit names map
+    to their 32-bit counterparts when jax x64 mode is off (an explicit
+    choice — requesting the 64-bit dtype would produce the same array
+    plus a truncation warning per call).  Op lowerings use this for any
+    dtype request that came from program attrs."""
+    import jax
+    name = convert_dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        return _64_TO_32.get(name, name)
+    return name
+
+
 _name_counters: Dict[str, itertools.count] = {}
 
 

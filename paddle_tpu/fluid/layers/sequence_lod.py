@@ -30,8 +30,8 @@ def _sequence_mask(ins, attrs, ctx):
         import numpy as np
         maxlen = int(np.asarray(x).max()) if not isinstance(
             x, jax.core.Tracer) else x.shape[-1]
-    from ..framework import convert_dtype
-    dt = convert_dtype(attrs.get("out_dtype", "int64"))
+    from ..framework import device_dtype
+    dt = device_dtype(attrs.get("out_dtype", "int64"))
     return {"Y": [(jnp.arange(maxlen)[None, :] <
                    x.reshape(-1, 1)).astype(dt)]}
 

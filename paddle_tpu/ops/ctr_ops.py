@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _x(ins, slot="X"):
@@ -168,16 +168,22 @@ def _filter_by_instag(ins, attrs, ctx):
 
 # --- hashing -----------------------------------------------------------------
 def _xxhash_like(x, mod, seed):
-    # mix the high word first so full 64-bit ids keep their entropy
-    xu = x.astype(jnp.uint64)
-    lo = (xu & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
-    hi = (xu >> jnp.uint64(32)).astype(jnp.uint32)
-    h = (lo ^ (hi * jnp.uint32(2246822519))) * jnp.uint32(2654435761) \
-        + jnp.uint32(seed)
+    import jax
+    if jax.config.jax_enable_x64:
+        # mix the high word first so full 64-bit ids keep their entropy
+        xu = x.astype(jnp.uint64)
+        lo = (xu & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (xu >> jnp.uint64(32)).astype(jnp.uint32)
+        lo = lo ^ (hi * jnp.uint32(2246822519))
+    else:
+        # x64 off: ids are at most 32-bit on device (the executor refuses
+        # truncating int64 feeds), so hash the one word we actually have
+        lo = x.astype(jnp.uint32)
+    h = lo * jnp.uint32(2654435761) + jnp.uint32(seed)
     h = h ^ (h >> 16)
     h = h * jnp.uint32(2246822519)
     h = h ^ (h >> 13)
-    return (h % jnp.uint32(mod)).astype(jnp.int64)
+    return (h % jnp.uint32(mod)).astype(wide_int())
 
 
 @register_op("hash", differentiable=False)
@@ -195,7 +201,7 @@ def _pyramid_hash(ins, attrs, ctx):
     """pyramid_hash_op.cc: hash n-gram windows of token ids into an embedding
     table (search-ads text matching).  Padded [B, T] ids; sums the embeddings
     of all (space_len) n-grams per sequence."""
-    x = _x(ins).astype(jnp.int64)
+    x = _x(ins).astype(wide_int())
     w = _x(ins, "W")
     num_emb = attrs.get("num_emb", w.shape[1])
     space_len = attrs.get("space_len", w.shape[0])

@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 _NEG = -1e30
 
@@ -88,7 +88,7 @@ def _roi_pool(ins, attrs, ctx):
     pw = attrs.get("pooled_width", 1)
     scale = attrs.get("spatial_scale", 1.0)
     out = _roi_bins(x[0], rois, ph, pw, scale, "max")
-    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, jnp.int64)]}
+    return {"Out": [out], "Argmax": [jnp.zeros(out.shape, wide_int())]}
 
 
 @register_op("psroi_pool", nondiff_inputs=("ROIs",))

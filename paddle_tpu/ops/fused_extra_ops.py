@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op, get_op
+from .registry import register_op, get_op, wide_int
 
 
 def _p(ins, slot):
@@ -105,7 +105,7 @@ def _histogram(ins, attrs, ctx):
     if lo == 0 and hi == 0:
         lo, hi = jnp.min(x), jnp.max(x)
     hist = jnp.histogram(x, bins=bins, range=(lo, hi))[0]
-    return {"Out": [hist.astype(jnp.int64)]}
+    return {"Out": [hist.astype(wide_int())]}
 
 
 @register_op("unpool", nondiff_inputs=("Indices",))

@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _x(ins, slot="X", i=0):
@@ -261,8 +261,8 @@ def _bpr(ins, attrs, ctx):
 # --- metrics ---------------------------------------------------------------
 @register_op("accuracy", differentiable=False)
 def _accuracy(ins, attrs, ctx):
-    pred_idx = ins["Indices"][0].astype(jnp.int64)
-    label = ins["Label"][0].astype(jnp.int64)
+    pred_idx = ins["Indices"][0].astype(wide_int())
+    label = ins["Label"][0].astype(wide_int())
     if label.ndim < pred_idx.ndim:
         label = label[..., None]
     correct = jnp.any(pred_idx == label, axis=-1)

@@ -11,7 +11,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _x(ins, slot="X", i=0):
@@ -324,26 +324,26 @@ def _pad3d(ins, attrs, ctx):
 
 @register_op("cast")
 def _cast(ins, attrs, ctx):
-    from ..fluid.framework import convert_dtype
-    return {"Out": [_x(ins).astype(convert_dtype(attrs["out_dtype"]))]}
+    from ..fluid.framework import device_dtype
+    return {"Out": [_x(ins).astype(device_dtype(attrs["out_dtype"]))]}
 
 
 @register_op("fill_constant", differentiable=False)
 def _fill_constant(ins, attrs, ctx):
-    from ..fluid.framework import convert_dtype
+    from ..fluid.framework import device_dtype
     shape = attrs.get("shape", [])
     if ins.get("ShapeTensor"):
         shape = [int(d) for d in np.asarray(ins["ShapeTensor"][0])]
-    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    dtype = device_dtype(attrs.get("dtype", "float32"))
     return {"Out": [jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)]}
 
 
 @register_op("fill_any_like")
 def _fill_any_like(ins, attrs, ctx):
-    from ..fluid.framework import convert_dtype
+    from ..fluid.framework import device_dtype
     dt = attrs.get("dtype", None)
     x = _x(ins)
-    dtype = convert_dtype(dt) if dt not in (None, -1) else x.dtype
+    dtype = device_dtype(dt) if dt not in (None, -1) else x.dtype
     return {"Out": [jnp.full_like(x, attrs.get("value", 0.0), dtype=dtype)]}
 
 
@@ -358,22 +358,30 @@ def _assign(ins, attrs, ctx):
 
 @register_op("assign_value", differentiable=False)
 def _assign_value(ins, attrs, ctx):
-    from ..fluid.framework import convert_dtype
-    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    from ..fluid.framework import device_dtype
+    dtype = device_dtype(attrs.get("dtype", "float32"))
     for key in ("fp32_values", "int32_values", "int64_values", "bool_values"):
         if attrs.get(key):
             vals = attrs[key]
             break
     else:
         vals = []
-    return {"Out": [jnp.asarray(np.array(vals).reshape(attrs["shape"]), dtype=dtype)]}
+    arr = np.array(vals).reshape(attrs["shape"])
+    if dtype == "int32" and arr.dtype == np.int64 \
+            and arr.size and np.abs(arr).max() > np.iinfo(np.int32).max:
+        # same contract as the executor's feed guard: 64-bit ids must not
+        # wrap silently when x64 is off
+        raise ValueError(
+            "assign_value carries int64 constants exceeding int32 range "
+            "and x64 is off; enable FLAGS_enable_x64 to keep them exact")
+    return {"Out": [jnp.asarray(arr, dtype=dtype)]}
 
 
 register_op("shape", lambda ins, a, c:
             {"Out": [jnp.asarray(ins["Input"][0].shape, jnp.int32)]},
             differentiable=False)
 register_op("size", lambda ins, a, c:
-            {"Out": [jnp.asarray(ins["Input"][0].size, jnp.int64)]},
+            {"Out": [jnp.asarray(ins["Input"][0].size, wide_int())]},
             differentiable=False)
 register_op("rank", lambda ins, a, c:
             {"Out": [jnp.asarray(ins["Input"][0].ndim, jnp.int32)]},
@@ -382,18 +390,18 @@ register_op("rank", lambda ins, a, c:
 
 @register_op("eye", differentiable=False)
 def _eye(ins, attrs, ctx):
-    from ..fluid.framework import convert_dtype
+    from ..fluid.framework import device_dtype
     n = attrs["num_rows"]
     m = attrs.get("num_columns", n)
     return {"Out": [jnp.eye(n, m if m > 0 else n,
-                            dtype=convert_dtype(attrs.get("dtype", "float32")))]}
+                            dtype=device_dtype(attrs.get("dtype", "float32")))]}
 
 
 @register_op("linspace", differentiable=False)
 def _linspace(ins, attrs, ctx):
     start, stop, num = ins["Start"][0], ins["Stop"][0], ins["Num"][0]
-    from ..fluid.framework import convert_dtype
-    dtype = convert_dtype(attrs.get("dtype", "float32"))
+    from ..fluid.framework import device_dtype
+    dtype = device_dtype(attrs.get("dtype", "float32"))
     return {"Out": [jnp.linspace(start.reshape(()), stop.reshape(()),
                                  int(num), dtype=dtype)]}
 
@@ -539,9 +547,9 @@ def _unfold(ins, attrs, ctx):
 
 @register_op("fill_constant_batch_size_like", differentiable=False)
 def _fill_constant_bsl(ins, attrs, ctx):
-    from ..fluid.framework import convert_dtype
+    from ..fluid.framework import device_dtype
     ref = ins["Input"][0]
     shape = list(attrs["shape"])
     shape[attrs.get("output_dim_idx", 0)] = ref.shape[attrs.get("input_dim_idx", 0)]
     return {"Out": [jnp.full(shape, attrs.get("value", 0.0),
-                             dtype=convert_dtype(attrs.get("dtype", "float32")))]}
+                             dtype=device_dtype(attrs.get("dtype", "float32")))]}

@@ -20,7 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 _NEG = -1e30
 
@@ -114,7 +114,7 @@ def _crf_decoding(ins, attrs, ctx):
     valid_rev = jnp.swapaxes(_len_mask(length - 1, t - 1), 0, 1)[::-1]
     first, path_rev = jax.lax.scan(trace, last, (back[::-1], valid_rev))
     path = jnp.concatenate([first[None], path_rev[::-1]], axis=0)
-    return {"ViterbiPath": [jnp.swapaxes(path, 0, 1).astype(jnp.int64)]}
+    return {"ViterbiPath": [jnp.swapaxes(path, 0, 1).astype(wide_int())]}
 
 
 # --- CTC ---------------------------------------------------------------------
@@ -193,8 +193,8 @@ def _ctc_align(ins, attrs, ctx):
     vals = jnp.take_along_axis(jnp.where(keep, x, pad), order, axis=1)
     lens = jnp.sum(keep, axis=1)
     vals = jnp.where(jnp.arange(x.shape[1])[None] < lens[:, None], vals, pad)
-    return {"Output": [vals.astype(jnp.int64)],
-            "OutputLength": [lens.reshape(-1, 1).astype(jnp.int64)]}
+    return {"Output": [vals.astype(wide_int())],
+            "OutputLength": [lens.reshape(-1, 1).astype(wide_int())]}
 
 
 @register_op("edit_distance", differentiable=False)
@@ -237,7 +237,7 @@ def _edit_distance(ins, attrs, ctx):
     if attrs.get("normalized", True):
         dist = dist / jnp.maximum(ref_len.astype(dist.dtype), 1.0)
     return {"Out": [dist.reshape(-1, 1)],
-            "SequenceNum": [jnp.asarray([b], jnp.int64)]}
+            "SequenceNum": [jnp.asarray([b], wide_int())]}
 
 
 @register_op("chunk_eval", differentiable=False)
@@ -290,9 +290,9 @@ def _chunk_eval(ins, attrs, ctx):
     f1 = 2 * p * r / jnp.maximum(p + r, 1e-9)
     return {"Precision": [p.reshape(1)], "Recall": [r.reshape(1)],
             "F1-Score": [f1.reshape(1)],
-            "NumInferChunks": [ti.reshape(1).astype(jnp.int64)],
-            "NumLabelChunks": [tl.reshape(1).astype(jnp.int64)],
-            "NumCorrectChunks": [tc.reshape(1).astype(jnp.int64)]}
+            "NumInferChunks": [ti.reshape(1).astype(wide_int())],
+            "NumLabelChunks": [tl.reshape(1).astype(wide_int())],
+            "NumCorrectChunks": [tc.reshape(1).astype(wide_int())]}
 
 
 # --- beam search -------------------------------------------------------------
@@ -324,10 +324,10 @@ def _beam_search(ins, attrs, ctx):
     top_scores, top_idx = jax.lax.top_k(flat, beam)
     parent = top_idx // v
     token = top_idx % v
-    return {"selected_ids": [token.reshape(-1, 1).astype(jnp.int64)],
+    return {"selected_ids": [token.reshape(-1, 1).astype(wide_int())],
             "selected_scores": [top_scores.reshape(-1, 1)],
             "parent_idx": [(parent + jnp.arange(src)[:, None] * beam)
-                           .reshape(-1).astype(jnp.int64)]}
+                           .reshape(-1).astype(wide_int())]}
 
 
 @register_op("gather_tree", differentiable=False)
@@ -347,7 +347,7 @@ def _gather_tree(ins, attrs, ctx):
     init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None],
                             ids.shape[1:]).astype(jnp.int32)
     _, toks_rev = jax.lax.scan(step, init, (ids[::-1], parents[::-1]))
-    return {"Out": [toks_rev[::-1].astype(jnp.int64)]}
+    return {"Out": [toks_rev[::-1].astype(wide_int())]}
 
 
 @register_op("beam_search_decode", differentiable=False)
@@ -447,11 +447,11 @@ def _sample_logits(ins, attrs, ctx):
     if attrs.get("uniq", True):
         sampled = sampled - jnp.log(prob * num_samples + 1e-20)
     return {"SampledLogits": [sampled],
-            "SampledLabels": [jnp.zeros((b, label.shape[1]), jnp.int64)],
-            "Samples": [samples.astype(jnp.int64)],
+            "SampledLabels": [jnp.zeros((b, label.shape[1]), wide_int())],
+            "Samples": [samples.astype(wide_int())],
             "Probabilities": [prob],
-            "LogitsDim": [jnp.asarray([b, v], jnp.int64)],
-            "LabelsDim": [jnp.asarray(label.shape, jnp.int64)]}
+            "LogitsDim": [jnp.asarray([b, v], wide_int())],
+            "LabelsDim": [jnp.asarray(label.shape, wide_int())]}
 
 
 # --- text-matching convs -----------------------------------------------------

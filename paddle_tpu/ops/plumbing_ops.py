@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _p(ins, slot):
@@ -77,7 +77,7 @@ def _read_from_array(ins, attrs, ctx):
 
 @register_op("lod_array_length", differentiable=False)
 def _lod_array_length(ins, attrs, ctx):
-    return {"Out": [jnp.asarray([len(_p(ins, "X"))], jnp.int64)]}
+    return {"Out": [jnp.asarray([len(_p(ins, "X"))], wide_int())]}
 
 
 @register_op("array_to_lod_tensor", differentiable=False)
@@ -103,7 +103,7 @@ def _tensor_array_to_tensor(ins, attrs, ctx):
     else:
         out = jnp.concatenate([jnp.atleast_1d(a) for a in arr], axis=axis)
     idx = jnp.asarray([np.shape(a)[axis] if np.ndim(a) else 1
-                       for a in arr], jnp.int64)
+                       for a in arr], wide_int())
     return {"Out": [out], "OutIndex": [idx]}
 
 
@@ -118,8 +118,8 @@ def _lod_rank_table(ins, attrs, ctx):
     x = _p(ins, "X")
     n = x.shape[0]
     t = x.shape[1] if x.ndim > 1 else 1
-    return {"Out": [{"lengths": jnp.full((n,), t, jnp.int64),
-                     "index": jnp.arange(n, dtype=jnp.int64)}]}
+    return {"Out": [{"lengths": jnp.full((n,), t, wide_int()),
+                     "index": jnp.arange(n, dtype=wide_int())}]}
 
 
 @register_op("max_sequence_len", differentiable=False)
@@ -205,8 +205,8 @@ def _is_empty(ins, attrs, ctx):
 
 
 def _np_dtype(d):
-    from ..fluid.framework import convert_dtype
-    return convert_dtype(d)
+    from ..fluid.framework import device_dtype
+    return device_dtype(d)
 
 
 @register_op("empty", differentiable=False)

@@ -14,13 +14,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _dtype(attrs, default="float32"):
-    from ..fluid.framework import convert_dtype
+    from ..fluid.framework import device_dtype
     d = attrs.get("dtype", default)
-    return convert_dtype(d) if d not in (None, -1) else default
+    return device_dtype(d) if d not in (None, -1) else default
 
 
 def _shape(ins, attrs):
@@ -91,7 +91,7 @@ def _multinomial(ins, attrs, ctx):
         # noise samples k distinct categories with the right law
         g = jax.random.gumbel(key, logits.shape, logits.dtype)
         _, out = jax.lax.top_k(logits + g, n)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(wide_int())]}
 
 
 @register_op("sampling_id", stateful_rng=True, differentiable=False)
@@ -99,7 +99,7 @@ def _sampling_id(ins, attrs, ctx):
     x = ins["X"][0]
     key = ctx.key_for(attrs.get("op_seed", attrs.get("seed", 0) or 0))
     out = jax.random.categorical(key, jnp.log(jnp.clip(x, 1e-30)), axis=-1)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(wide_int())]}
 
 
 @register_op("shuffle_batch", stateful_rng=True, nondiff_outputs=("ShuffleIdx",))
@@ -109,8 +109,8 @@ def _shuffle_batch(ins, attrs, ctx):
     key = ctx.key_for(attrs.get("op_seed", attrs.get("startup_seed", 0) or 0))
     idx = jax.random.permutation(key, x.shape[0])
     return {"Out": [jnp.take(x, idx, axis=0)],
-            "ShuffleIdx": [idx.astype(jnp.int64)],
-            "SeedOut": [jnp.zeros((1,), jnp.int64)]}
+            "ShuffleIdx": [idx.astype(wide_int())],
+            "SeedOut": [jnp.zeros((1,), wide_int())]}
 
 
 @register_op("random_crop", stateful_rng=True, differentiable=False)

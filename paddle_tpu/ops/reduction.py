@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _x(ins, slot="X", i=0):
@@ -56,7 +56,7 @@ def _arg_max(ins, attrs, ctx):
     out = jnp.argmax(x, axis=None if attrs.get("flatten", False) else axis)
     if attrs.get("keepdims", False) and not attrs.get("flatten", False):
         out = jnp.expand_dims(out, axis)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(wide_int())]}
 
 
 @register_op("arg_min", differentiable=False)
@@ -66,7 +66,7 @@ def _arg_min(ins, attrs, ctx):
     out = jnp.argmin(x, axis=None if attrs.get("flatten", False) else axis)
     if attrs.get("keepdims", False) and not attrs.get("flatten", False):
         out = jnp.expand_dims(out, axis)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(wide_int())]}
 
 
 @register_op("top_k", nondiff_outputs=("Indices",))
@@ -74,7 +74,7 @@ def _top_k(ins, attrs, ctx):
     x = _x(ins)
     k = int(ins["K"][0]) if ins.get("K") else attrs["k"]
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(wide_int())]}
 
 
 @register_op("top_k_v2", nondiff_outputs=("Indices",))
@@ -90,7 +90,7 @@ def _top_k_v2(ins, attrs, ctx):
     else:
         vals, idx = jax.lax.top_k(xm, k)
     return {"Out": [jnp.moveaxis(vals, -1, axis)],
-            "Indices": [jnp.moveaxis(idx, -1, axis).astype(jnp.int64)]}
+            "Indices": [jnp.moveaxis(idx, -1, axis).astype(wide_int())]}
 
 
 @register_op("argsort", nondiff_outputs=("Indices",))
@@ -100,7 +100,7 @@ def _argsort(ins, attrs, ctx):
     desc = attrs.get("descending", False)
     idx = jnp.argsort(-x if desc else x, axis=axis)
     out = jnp.take_along_axis(x, idx, axis=axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(wide_int())]}
 
 
 @register_op("kthvalue", nondiff_outputs=("Indices",))
@@ -114,7 +114,7 @@ def _kthvalue(ins, attrs, ctx):
     idx = jnp.take(i, k - 1, axis=axis)
     if attrs.get("keepdim", False):
         out, idx = jnp.expand_dims(out, axis), jnp.expand_dims(idx, axis)
-    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [out], "Indices": [idx.astype(wide_int())]}
 
 
 @register_op("max_pool2d_with_index", nondiff_outputs=("Mask",))

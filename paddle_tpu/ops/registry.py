@@ -19,6 +19,23 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 LoweringFn = Callable[..., Dict[str, Any]]
 
 
+def wide_int():
+    """The dtype for index/length/id outputs the reference declares int64.
+
+    An EXPLICIT choice, not a silent truncation: int64 when jax x64 mode is
+    on (FLAGS_enable_x64), else int32 — requesting jnp.int64 with x64 off
+    would produce int32 anyway, plus a per-call TracerWarning.  True 64-bit
+    id paths (feasigns) are guarded separately: the executor refuses
+    silently-truncating int64 feeds (executor.py _check_feed_dtypes), the
+    assign_value lowering rejects over-range int64 constants, and the PS
+    tier keeps ids host-side in real int64.  Single source of truth for the
+    64->32 policy is framework.device_dtype.
+    """
+    import jax.numpy as jnp
+    from ..fluid.framework import device_dtype
+    return jnp.int64 if device_dtype("int64") == "int64" else jnp.int32
+
+
 @dataclasses.dataclass
 class OpDef:
     type: str

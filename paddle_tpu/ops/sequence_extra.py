@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import register_op
+from .registry import register_op, wide_int
 
 
 def _mask(length, t):
@@ -81,7 +81,7 @@ def _sequence_pad(ins, attrs, ctx):
     m = _mask(length, target)
     shape = m.shape + (1,) * (x.ndim - 2)
     out = jnp.where(m.reshape(shape), x, pad_value)
-    return {"Out": [out], "Length": [length.astype(jnp.int64)]}
+    return {"Out": [out], "Length": [length.astype(wide_int())]}
 
 
 @register_op("sequence_unpad", nondiff_inputs=("Length",))
@@ -123,8 +123,8 @@ def _sequence_erase(ins, attrs, ctx):
     vals = jnp.take_along_axis(jnp.where(keep, x, 0), order, axis=1)
     lens = keep.sum(axis=1)
     vals = jnp.where(jnp.arange(x.shape[1])[None] < lens[:, None], vals, 0)
-    return {"Out": [vals.astype(jnp.int64)],
-            "Length": [lens.astype(jnp.int64)]}
+    return {"Out": [vals.astype(wide_int())],
+            "Length": [lens.astype(wide_int())]}
 
 
 @register_op("sequence_enumerate", differentiable=False)
@@ -138,7 +138,7 @@ def _sequence_enumerate(ins, attrs, ctx):
     xe = jnp.concatenate(
         [x, jnp.full((b, win - 1), pad, x.dtype)], axis=1)
     out = jnp.stack([xe[:, k:k + t] for k in range(win)], axis=-1)
-    return {"Out": [out.astype(jnp.int64)]}
+    return {"Out": [out.astype(wide_int())]}
 
 
 @register_op("sequence_scatter", nondiff_inputs=("Ids",))

@@ -16,8 +16,12 @@ REF = "/root/reference/python/paddle/fluid/layers"
 
 
 def _ref_all(mod):
+    import warnings
     try:
-        tree = ast.parse(open(f"{REF}/{mod}.py").read())
+        with warnings.catch_warnings():
+            # the reference's own docstrings carry invalid escapes
+            warnings.simplefilter("ignore", SyntaxWarning)
+            tree = ast.parse(open(f"{REF}/{mod}.py").read())
     except OSError:
         return []
     for node in tree.body:
