@@ -367,8 +367,9 @@ def _assign_value(ins, attrs, ctx):
     else:
         vals = []
     arr = np.array(vals).reshape(attrs["shape"])
-    if dtype == "int32" and arr.dtype == np.int64 \
-            and arr.size and np.abs(arr).max() > np.iinfo(np.int32).max:
+    if dtype == "int32" and arr.dtype == np.int64 and arr.size \
+            and (arr.max() > np.iinfo(np.int32).max
+                 or arr.min() < np.iinfo(np.int32).min):
         # same contract as the executor's feed guard: 64-bit ids must not
         # wrap silently when x64 is off
         raise ValueError(

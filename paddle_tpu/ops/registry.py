@@ -26,7 +26,7 @@ def wide_int():
     on (FLAGS_enable_x64), else int32 — requesting jnp.int64 with x64 off
     would produce int32 anyway, plus a per-call TracerWarning.  True 64-bit
     id paths (feasigns) are guarded separately: the executor refuses
-    silently-truncating int64 feeds (executor.py _check_feed_dtypes), the
+    silently-truncating int64 feeds (executor.py check_feed_width), the
     assign_value lowering rejects over-range int64 constants, and the PS
     tier keeps ids host-side in real int64.  Single source of truth for the
     64->32 policy is framework.device_dtype.

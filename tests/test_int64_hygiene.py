@@ -100,6 +100,15 @@ class TestOpPathsWarningFree:
             self._run("assign_value", {},
                       {"shape": [1], "dtype": 3,
                        "int64_values": [2 ** 40]})
+        with pytest.raises(ValueError, match="int64 constants"):
+            self._run("assign_value", {},
+                      {"shape": [1], "dtype": 3,
+                       "int64_values": [-2 ** 63]})
+        # INT32_MIN itself is representable: must NOT raise
+        out = self._run("assign_value", {},
+                        {"shape": [1], "dtype": 3,
+                         "int64_values": [-2 ** 31]})
+        assert int(np.asarray(out["Out"][0])[0]) == -2 ** 31
 
     def test_lod_array_length(self):
         with no_truncation_warnings():
