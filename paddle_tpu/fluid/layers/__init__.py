@@ -36,7 +36,7 @@ from .sequence_lod import (sequence_conv, sequence_pool, sequence_softmax, seque
                            sequence_last_step, sequence_slice,
                            sequence_expand_as, sequence_reshape,
                            sequence_scatter, sequence_enumerate,
-                           sequence_unpad)
+                           sequence_unpad, sequence_erase)
 from .collective import _c_allreduce, _c_allgather, _c_broadcast, _allreduce
 from .rnn import (lstm_unit, gru_unit, dynamic_lstm_unit,  # noqa: F401
                   dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm,

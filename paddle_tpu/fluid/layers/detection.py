@@ -84,15 +84,20 @@ def _box_coder(ins, attrs, ctx):
     pvar = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else None
     target = ins["TargetBox"][0]
     code_type = attrs.get("code_type", "encode_center_size")
-    pw = prior[:, 2] - prior[:, 0]
-    ph = prior[:, 3] - prior[:, 1]
+    # box_coder_op.h: non-normalized pixel boxes are inclusive — widths
+    # carry a +1 and decoded maxima a -1 (`(normalized ? 0 : 1)`)
+    off = 0.0 if attrs.get("box_normalized", True) else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
     pcx = prior[:, 0] + pw / 2
     pcy = prior[:, 1] + ph / 2
     if "encode" in code_type:
-        tw = target[:, 2] - target[:, 0]
-        th = target[:, 3] - target[:, 1]
-        tcx = target[:, 0] + tw / 2
-        tcy = target[:, 1] + th / 2
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        # target center is the plain midpoint (box_coder_op.h:66-69),
+        # unlike the prior center which folds the +1 width in
+        tcx = (target[:, 0] + target[:, 2]) / 2
+        tcy = (target[:, 1] + target[:, 3]) / 2
         dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
         dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
         dw = jnp.log(tw[:, None] / pw[None, :])
@@ -109,8 +114,8 @@ def _box_coder(ins, attrs, ctx):
         cy = dy * ph + pcy
         w = jnp.exp(dw) * pw
         h = jnp.exp(dh) * ph
-        out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
-                        axis=-1)
+        out = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - off, cy + h / 2 - off], axis=-1)
     return {"OutputBox": [out]}
 
 
@@ -246,7 +251,8 @@ def box_coder(prior_box, prior_box_var, target_box,
     if prior_box_var is not None:
         ins["PriorBoxVar"] = [prior_box_var]
     return _layer2("box_coder", ins, ["OutputBox"],
-                   {"code_type": code_type, "axis": axis}, name)
+                   {"code_type": code_type, "axis": axis,
+                    "box_normalized": box_normalized}, name)
 
 
 def iou_similarity(x, y, name=None):

@@ -419,15 +419,17 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
 
 
 def dice_loss(input, label, epsilon=1e-5):
-    """1 - 2|A∩B|/(|A|+|B|) over the trailing axes (layers/nn.py
-    dice_loss formula)."""
-    label = _nn.cast(label, "float32")
+    """1 - 2|A∩B|/(|A|+|B|+eps) with the int label ONE-HOT to the input
+    depth (reference layers/nn.py:7160-7167: one_hot after squeezing the
+    trailing 1; epsilon lives in the denominator only)."""
+    if len(label.shape) and label.shape[-1] == 1:
+        label = _nn.squeeze(label, [-1])
+    label = _nn.one_hot(label, input.shape[-1])
     reduce_dims = list(range(1, len(input.shape)))
     inse = _nn.reduce_sum(input * label, dim=reduce_dims)
-    dice = (2.0 * inse + epsilon) / (
-        _nn.reduce_sum(input, dim=reduce_dims)
-        + _nn.reduce_sum(label, dim=reduce_dims) + epsilon)
-    return _nn.reduce_mean(1.0 - dice)
+    denom = (_nn.reduce_sum(input, dim=reduce_dims)
+             + _nn.reduce_sum(label, dim=reduce_dims))
+    return _nn.reduce_mean(1.0 - inse * 2.0 / (denom + epsilon))
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None):

@@ -192,13 +192,47 @@ def sequence_mask(x, maxlen=None, dtype="int64"):
     return op["Y"][0] if in_dygraph_mode() else out
 
 
-def sequence_pad(x, pad_value, maxlen=None):
-    # inputs are already padded in this design; identity + lengths
-    return x, None
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Padded-layout sequence_pad (sequence_pad_op.cc): trim/extend the
+    time axis to `maxlen` and write `pad_value` into every position past
+    each row's `length`.  Returns (Out, Length) like the reference."""
+    helper = LayerHelper("sequence_pad")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    len_out = helper.create_variable_for_type_inference(
+        dtype="int64", stop_gradient=True)
+    inputs = {"X": [x], "PadValue": [pad_value]}
+    if length is not None:
+        inputs["Length"] = [length]
+    op = helper.append_op(
+        "sequence_pad", inputs=inputs,
+        outputs={"Out": [out], "Length": [len_out]},
+        attrs={"padded_length": -1 if maxlen is None else maxlen})
+    if in_dygraph_mode():
+        return op["Out"][0], op["Length"][0]
+    return out, len_out
 
 
-def sequence_unpad(x, length):
-    return x
+def sequence_unpad(x, length, name=None):
+    """Inverse of sequence_pad under the padded convention: positions at
+    or past each row's length are zeroed (ragged outputs are masks, not
+    LoD — sequence_unpad_op.cc analog)."""
+    helper = LayerHelper("sequence_unpad")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    op = helper.append_op("sequence_unpad",
+                          inputs={"X": [x], "Length": [length]},
+                          outputs={"Out": [out]}, attrs={})
+    return op["Out"][0] if in_dygraph_mode() else out
+
+
+def sequence_erase(input, tokens=None, name=None):
+    """sequence_erase_op.cc: drop every token in `tokens`, left-compact
+    each row, zero-pad the tail."""
+    helper = LayerHelper("sequence_erase")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    op = helper.append_op("sequence_erase", inputs={"X": [input]},
+                          outputs={"Out": [out]},
+                          attrs={"tokens": list(tokens or [])})
+    return op["Out"][0] if in_dygraph_mode() else out
 
 
 # --- sequence __all__ parity tail (reference layers/sequence_lod.py) --------

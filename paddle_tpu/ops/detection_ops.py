@@ -376,12 +376,15 @@ def _sigmoid_focal_loss(ins, attrs, ctx):
     alpha = attrs.get("alpha", 0.25)
     c = x.shape[1]
     target = (label[:, None] == (jnp.arange(c) + 1)[None]).astype(x.dtype)
+    # label -1 rows are ignored entirely (sigmoid_focal_loss_op.h:53
+    # c_neg gates on g != -1)
+    valid = (label[:, None] != -1).astype(x.dtype)
     p = jax.nn.sigmoid(x)
     pt = jnp.where(target > 0, p, 1 - p)
     at = jnp.where(target > 0, alpha, 1 - alpha)
     bce = -jnp.where(target > 0, jax.nn.log_sigmoid(x),
                      jax.nn.log_sigmoid(-x))
-    loss = at * ((1 - pt) ** gamma) * bce / jnp.maximum(fg, 1.0)
+    loss = valid * at * ((1 - pt) ** gamma) * bce / jnp.maximum(fg, 1.0)
     return {"Out": [loss]}
 
 
@@ -411,15 +414,18 @@ def _retinanet_detection_output(ins, attrs, ctx):
 
 @register_op("polygon_box_transform", differentiable=False)
 def _polygon_box_transform(ins, attrs, ctx):
-    """polygon_box_transform_op.cc (EAST text detection): offset channels to
-    absolute quad coordinates: out[c] = 4*x_grid + in[c] (even c), y odd."""
+    """polygon_box_transform_op.cc:40-48 (EAST text detection): offsets to
+    absolute quad coords, out = 4*x_grid - in on even planes, 4*y_grid - in
+    on odd — plane parity is (batch*C + channel) % 2 exactly as the
+    reference's flat id_n loop computes it."""
     x = ins["Input"][0]                     # [B, 8or9, H, W]
     b, c, h, w = x.shape
     gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
     gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
-    even = (jnp.arange(c) % 2 == 0).reshape(1, -1, 1, 1)
+    plane = (jnp.arange(b)[:, None] * c + jnp.arange(c)[None, :])
+    even = (plane % 2 == 0)[:, :, None, None]
     grid = jnp.where(even, gx, gy)
-    return {"Output": [jnp.where(x != 0, grid + x, 0.0)]}
+    return {"Output": [grid - x]}
 
 
 # --- deformable conv / grids -------------------------------------------------

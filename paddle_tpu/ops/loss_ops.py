@@ -251,10 +251,16 @@ def _margin_rank(ins, attrs, ctx):
 
 @register_op("bpr_loss", nondiff_inputs=("Label",))
 def _bpr(ins, attrs, ctx):
+    """bpr_loss_op.h:61-78: -sum_{j != label} log(sigmoid(x_label - x_j))
+    / (C - 1) — the label column is EXCLUDED and the mean is over the
+    C-1 negatives."""
     x, label = _x(ins), ins["Label"][0].astype(jnp.int32)
+    c = x.shape[1]
     pos = jnp.take_along_axis(x, label, axis=1)
-    diff = pos - x
-    loss = -jnp.mean(jnp.log(jax.nn.sigmoid(diff) + 1e-8), axis=1, keepdims=True)
+    term = jnp.log(jax.nn.sigmoid(pos - x) + 1e-8)
+    is_label = (jnp.arange(c)[None, :] == label).astype(x.dtype)
+    loss = -(term * (1.0 - is_label)).sum(axis=1, keepdims=True) \
+        / max(c - 1, 1)
     return {"Y": [loss]}
 
 

@@ -66,7 +66,13 @@ def _sequence_pad(ins, attrs, ctx):
     """sequence_pad_op.cc: already-padded layout makes this a copy +
     padded_length trim/extend with PadValue."""
     x = ins["X"][0]
-    pad_value = ins["PadValue"][0].reshape(()) if ins.get("PadValue") else 0.0
+    if ins.get("PadValue"):
+        pv = ins["PadValue"][0]
+        # scalar OR one-time-step shaped (sequence_pad_op.cc supports
+        # both); a step-shaped value broadcasts over batch and time
+        pad_value = pv.reshape(()) if pv.size == 1 else pv
+    else:
+        pad_value = 0.0
     padded_len = attrs.get("padded_length", -1)
     t = x.shape[1]
     length = (ins["Length"][0].astype(jnp.int32).reshape(-1)

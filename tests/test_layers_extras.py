@@ -120,10 +120,10 @@ class TestLossTail:
         assert L.center_loss(t(R.randn(3, 4)),
                              ti(R.randint(0, 5, (3, 1))), 5,
                              0.1).shape[0] == 3
+        # reference contract: int class labels, one-hotted internally
         assert L.dice_loss(
             t(np.abs(R.rand(2, 4))),
-            to_variable((R.rand(2, 4) > 0.5)
-                        .astype("float32"))).shape == ()
+            ti(R.randint(0, 4, (2, 1)))).shape == ()
 
     def test_sampled_families(self):
         x = t(R.randn(3, 4))

@@ -166,8 +166,9 @@ class TestMiscOps:
         lbl = np.array([[0], [2]], "int64")
         out = np.asarray(run_op("bpr_loss", {"X": x, "Label": lbl})["Y"][0])
         def sig(v): return 1 / (1 + np.exp(-v))
+        # bpr_loss_op.h: j == label excluded, normalized by C-1
         ref0 = -np.mean([np.log(sig(x[0, 0] - x[0, j]) + 1e-8)
-                         for j in range(3)])
+                         for j in range(3) if j != 0])
         np.testing.assert_allclose(out[0, 0], ref0, rtol=1e-4)
 
     def test_unique(self):
