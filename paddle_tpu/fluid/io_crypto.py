@@ -1,0 +1,206 @@
+"""Model encryption — analog of the reference's crypto tier
+(paddle/fluid/framework/io/crypto/: cipher.h Cipher/CipherFactory,
+aes_cipher.cc AES modes, cipher_utils.h GenKey/config loading).
+
+Same surface, Python-native: a Cipher with encrypt/decrypt (+ file
+variants), a factory keyed by cipher_name with `AES_CTR_NoPadding` as the
+reference's default, and key/config utilities.  Backed by the
+`cryptography` package's AES (CTR and GCM modes); artifact layout is
+iv || ciphertext (CTR) or iv || ciphertext || tag (GCM), with sizes from
+the config exactly like the reference's iv_size/tag_size knobs.
+
+`encrypt_inference_model` / `decrypt_inference_model` apply it to the
+`__model__` + params artifact produced by fluid.io.save_inference_model,
+giving the at-rest protection the reference's inference deployment path
+uses.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+__all__ = ["Cipher", "AESCipher", "CipherFactory", "CipherUtils",
+           "encrypt_inference_model", "decrypt_inference_model"]
+
+_AES_DEFAULT_IV_SIZE = 128          # bits, cipher_utils.h
+_AES_DEFAULT_TAG_SIZE = 128
+
+
+class Cipher:
+    """cipher.h Cipher interface."""
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes,
+                        filename: str) -> None:
+        with open(filename, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class AESCipher(Cipher):
+    """aes_cipher.h analog: AES in CTR (stream, no padding — the
+    reference default) or GCM (authenticated) mode."""
+
+    def __init__(self, cipher_name: str = "AES_CTR_NoPadding",
+                 iv_size: int = _AES_DEFAULT_IV_SIZE,
+                 tag_size: int = _AES_DEFAULT_TAG_SIZE):
+        if "AES" not in cipher_name:
+            raise ValueError(f"not an AES cipher: {cipher_name}")
+        self.name = cipher_name
+        # fail fast on sizes the backend cannot serve, naming the knob:
+        # CTR needs a full 16-byte counter block; our iv||ct||tag layout
+        # needs the full 16-byte GCM tag to split unambiguously
+        if "GCM" in cipher_name:
+            if iv_size % 8 or not 64 <= iv_size <= 128:
+                raise ValueError(
+                    f"iv_size {iv_size} unsupported for GCM (use 64-128 "
+                    f"bits in byte multiples)")
+            if tag_size != 128:
+                raise ValueError(
+                    f"tag_size {tag_size} unsupported: the artifact "
+                    f"layout requires the full 128-bit GCM tag")
+        elif iv_size != 128:
+            raise ValueError(
+                f"iv_size {iv_size} unsupported for CTR (the counter "
+                f"block is 128 bits)")
+        self.iv_bytes = iv_size // 8
+        self.tag_bytes = tag_size // 8
+
+    def _check_key(self, key: bytes) -> bytes:
+        key = bytes(key)
+        if len(key) not in (16, 24, 32):
+            raise ValueError(
+                f"AES key must be 16/24/32 bytes, got {len(key)}")
+        return key
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import (Cipher as _C,
+                                                            algorithms,
+                                                            modes)
+        key = self._check_key(key)
+        iv = os.urandom(self.iv_bytes)
+        if "GCM" in self.name:
+            enc = _C(algorithms.AES(key), modes.GCM(iv)).encryptor()
+            ct = enc.update(bytes(plaintext)) + enc.finalize()
+            return iv + ct + enc.tag[:self.tag_bytes]
+        enc = _C(algorithms.AES(key), modes.CTR(iv)).encryptor()
+        return iv + enc.update(bytes(plaintext)) + enc.finalize()
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers import (Cipher as _C,
+                                                            algorithms,
+                                                            modes)
+        key = self._check_key(key)
+        ciphertext = bytes(ciphertext)
+        iv, rest = ciphertext[:self.iv_bytes], ciphertext[self.iv_bytes:]
+        if "GCM" in self.name:
+            ct, tag = rest[:-self.tag_bytes], rest[-self.tag_bytes:]
+            dec = _C(algorithms.AES(key), modes.GCM(iv, tag)).decryptor()
+            return dec.update(ct) + dec.finalize()
+        dec = _C(algorithms.AES(key), modes.CTR(iv)).decryptor()
+        return dec.update(rest) + dec.finalize()
+
+
+class CipherFactory:
+    """cipher.cc CipherFactory::CreateCipher: name + iv/tag sizes from a
+    config file of `key: value` lines, AES_CTR_NoPadding when
+    unconfigured."""
+
+    @staticmethod
+    def create_cipher(config_file: str = "") -> Cipher:
+        name, iv, tag = "AES_CTR_NoPadding", None, None
+        if config_file:
+            cfg = CipherUtils.load_config(config_file)
+            name = cfg.get("cipher_name", name)
+            iv = int(cfg["iv_size"]) if "iv_size" in cfg else None
+            tag = int(cfg["tag_size"]) if "tag_size" in cfg else None
+        if "AES" not in name:
+            raise ValueError(
+                f"invalid cipher name {name!r}: only AES modes exist")
+        return AESCipher(name, iv or _AES_DEFAULT_IV_SIZE,
+                         tag or _AES_DEFAULT_TAG_SIZE)
+
+
+class CipherUtils:
+    """cipher_utils.h: key generation + config parsing."""
+
+    @staticmethod
+    def gen_key(length_bits: int) -> bytes:
+        if length_bits % 8:
+            raise ValueError("key length must be a multiple of 8 bits")
+        return os.urandom(length_bits // 8)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, filename: str) -> bytes:
+        key = CipherUtils.gen_key(length_bits)
+        with open(filename, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return f.read()
+
+    @staticmethod
+    def load_config(filename: str) -> Dict[str, str]:
+        out = {}
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or ":" not in line:
+                    continue
+                k, v = line.split(":", 1)
+                out[k.strip()] = v.strip()
+        return out
+
+
+_ENC_SUFFIX = ".encrypted"
+
+
+def encrypt_inference_model(dirname: str, key: bytes,
+                            cipher: Optional[Cipher] = None,
+                            files=("__model__", "params.npz")) -> list:
+    """Encrypt the artifact files in place (original removed, `.encrypted`
+    written) — the deployment-side at-rest protection step."""
+    cipher = cipher or CipherFactory.create_cipher()
+    done = []
+    for name in files:
+        path = os.path.join(dirname, name)
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            cipher.encrypt_to_file(f.read(), key, path + _ENC_SUFFIX)
+        os.remove(path)
+        done.append(name)
+    if not done:
+        raise FileNotFoundError(f"no artifact files found in {dirname}")
+    return done
+
+
+def decrypt_inference_model(dirname: str, key: bytes,
+                            cipher: Optional[Cipher] = None) -> list:
+    """Restore the plaintext artifact files from their `.encrypted`
+    siblings (loader-side)."""
+    cipher = cipher or CipherFactory.create_cipher()
+    done = []
+    for fn in sorted(os.listdir(dirname)):
+        if not fn.endswith(_ENC_SUFFIX):
+            continue
+        plain = cipher.decrypt_from_file(
+            key, os.path.join(dirname, fn))
+        out = os.path.join(dirname, fn[:-len(_ENC_SUFFIX)])
+        with open(out, "wb") as f:
+            f.write(plain)
+        done.append(fn[:-len(_ENC_SUFFIX)])
+    if not done:
+        raise FileNotFoundError(f"no .encrypted files in {dirname}")
+    return done
