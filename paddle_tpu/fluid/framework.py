@@ -357,6 +357,31 @@ class Program:
     def all_parameters(self):
         return self.global_block().all_parameters()
 
+    @property
+    def desc(self):
+        """ProgramDesc protobuf snapshot (reference Program.desc is a live
+        C++ wrapper; here the proto is regenerated from the IR on access
+        — `program.desc.SerializeToString()` is the `__model__` bytes)."""
+        from . import proto_serde
+        return proto_serde.program_to_proto(self)
+
+    def to_string(self, throw_on_error=True, with_details=False):
+        """Debug string (reference framework.py:4655 Program.to_string):
+        the protobuf text format of the ProgramDesc."""
+        from google.protobuf import text_format
+        return text_format.MessageToString(self.desc)
+
+    def __str__(self):
+        return self.to_string(True, False)
+
+    @staticmethod
+    def parse_from_string(binary_str: bytes) -> "Program":
+        """Deserialize a Program from ProgramDesc protobuf bytes
+        (reference framework.py:4657; parameters come back as plain
+        persistable vars — values live in the scope, not the IR)."""
+        from . import proto_serde
+        return proto_serde.program_from_proto_bytes(binary_str)
+
     def clone(self, for_test: bool = False) -> "Program":
         """Structural clone; with for_test=True marks inference mode (dropout
         and batch_norm switch to eval behaviour via ctx.is_test), strips the

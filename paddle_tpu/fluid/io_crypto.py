@@ -141,7 +141,10 @@ class CipherUtils:
     @staticmethod
     def gen_key_to_file(length_bits: int, filename: str) -> bytes:
         key = CipherUtils.gen_key(length_bits)
-        with open(filename, "wb") as f:
+        # key material: owner-only regardless of umask
+        fd = os.open(filename, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                     0o600)
+        with os.fdopen(fd, "wb") as f:
             f.write(key)
         return key
 
@@ -168,10 +171,17 @@ _ENC_SUFFIX = ".encrypted"
 
 def encrypt_inference_model(dirname: str, key: bytes,
                             cipher: Optional[Cipher] = None,
-                            files=("__model__", "params.npz")) -> list:
+                            files=None) -> list:
     """Encrypt the artifact files in place (original removed, `.encrypted`
-    written) — the deployment-side at-rest protection step."""
+    written) — the deployment-side at-rest protection step.  By default
+    EVERY regular file in the directory is encrypted (model, params in
+    any format, manifest, per-var reference files) so no sibling
+    plaintext survives; pass `files` to restrict."""
     cipher = cipher or CipherFactory.create_cipher()
+    if files is None:
+        files = [fn for fn in sorted(os.listdir(dirname))
+                 if os.path.isfile(os.path.join(dirname, fn))
+                 and not fn.endswith(_ENC_SUFFIX)]
     done = []
     for name in files:
         path = os.path.join(dirname, name)

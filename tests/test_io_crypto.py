@@ -85,7 +85,10 @@ class TestEncryptedModel:
         key = CipherUtils.gen_key(256)
         done = encrypt_inference_model(d, key)
         assert "__model__" in done
-        assert not os.path.exists(os.path.join(d, "__model__"))
+        # NO sibling plaintext survives (manifest, params in any format)
+        leftover = [fn for fn in os.listdir(d)
+                    if not fn.endswith(".encrypted")]
+        assert not leftover, leftover
         with pytest.raises(FileNotFoundError):
             fluid.io.load_inference_model(d, exe)
 

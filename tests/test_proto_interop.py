@@ -100,6 +100,18 @@ class TestProgramRoundTrip:
         assert block_attrs, "block refs must be typed BLOCK on the wire"
 
 
+class TestProgramProtoApi:
+    def test_desc_to_string_parse(self):
+        prog, _, out = _build_program()
+        blob = prog.desc.SerializeToString()
+        prog2 = fluid.Program.parse_from_string(blob)
+        assert [o.type for o in prog2.global_block().ops] \
+            == [o.type for o in prog.global_block().ops]
+        text = prog.to_string(True)
+        assert "blocks" in text and "ops" in text  # proto text format
+        assert str(prog) == text
+
+
 class TestInferenceModelFormat:
     def test_save_load_run(self, tmp_path):
         prog, startup, out = _build_program()
