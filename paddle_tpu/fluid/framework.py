@@ -367,9 +367,17 @@ class Program:
 
     def to_string(self, throw_on_error=True, with_details=False):
         """Debug string (reference framework.py:4655 Program.to_string):
-        the protobuf text format of the ProgramDesc."""
+        the protobuf text format of the ProgramDesc.  With
+        throw_on_error=False a serialization failure becomes part of the
+        debug output instead of raising (the reference contract)."""
         from google.protobuf import text_format
-        return text_format.MessageToString(self.desc)
+        try:
+            return text_format.MessageToString(self.desc)
+        except ValueError:
+            if throw_on_error:
+                raise
+            return f"<Program: not fully serializable " \
+                   f"({len(self.blocks)} blocks)>"
 
     def __str__(self):
         return self.to_string(True, False)

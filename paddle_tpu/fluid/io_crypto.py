@@ -141,9 +141,12 @@ class CipherUtils:
     @staticmethod
     def gen_key_to_file(length_bits: int, filename: str) -> bytes:
         key = CipherUtils.gen_key(length_bits)
-        # key material: owner-only regardless of umask
+        # key material: owner-only regardless of umask; fchmod covers
+        # rotation into a pre-existing (possibly wider-mode) file, where
+        # the open() mode argument is ignored
         fd = os.open(filename, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
                      0o600)
+        os.fchmod(fd, 0o600)
         with os.fdopen(fd, "wb") as f:
             f.write(key)
         return key
@@ -169,6 +172,14 @@ class CipherUtils:
 _ENC_SUFFIX = ".encrypted"
 
 
+def _looks_like_key_material(fn: str) -> bool:
+    """Never self-encrypt key/config files living next to the model —
+    encrypting the key with itself makes the artifact unrecoverable."""
+    low = fn.lower()
+    return (fn.startswith(".") or low == "key" or low.endswith(".key")
+            or low.endswith(".pem") or low.endswith(".conf"))
+
+
 def encrypt_inference_model(dirname: str, key: bytes,
                             cipher: Optional[Cipher] = None,
                             files=None) -> list:
@@ -181,7 +192,8 @@ def encrypt_inference_model(dirname: str, key: bytes,
     if files is None:
         files = [fn for fn in sorted(os.listdir(dirname))
                  if os.path.isfile(os.path.join(dirname, fn))
-                 and not fn.endswith(_ENC_SUFFIX)]
+                 and not fn.endswith(_ENC_SUFFIX)
+                 and not _looks_like_key_material(fn)]
     done = []
     for name in files:
         path = os.path.join(dirname, name)

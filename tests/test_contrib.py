@@ -313,7 +313,12 @@ class TestDygraphFluidOptimizer:
         lambda p: fluid.optimizer.RMSPropOptimizer(0.05, parameter_list=p),
     ], ids=["sgd", "momentum", "adam", "adagrad", "rmsprop"])
     def test_minimize_converges(self, dygraph, mk):
+        import paddle_tpu as paddle
         from paddle_tpu import nn
+        # deterministic init: with ambient RNG state the first loss can
+        # start near zero, where Adam's constant-magnitude early steps
+        # jitter above it and the < l0 assert order-flakes
+        paddle.seed(1234)
         lin = nn.Linear(4, 1)
         opt = mk(lin.parameters())
         x = tv(np.ones((8, 4), "float32"))

@@ -82,12 +82,17 @@ class TestEncryptedModel:
         prog1, _, f1 = fluid.io.load_inference_model(d, exe)
         (want,) = exe.run(prog1, feed={"x": xs}, fetch_list=[f1[0].name])
 
-        key = CipherUtils.gen_key(256)
+        key = CipherUtils.gen_key_to_file(256, os.path.join(d, ".key"))
         done = encrypt_inference_model(d, key)
         assert "__model__" in done
+        # the key file next to the model is NEVER self-encrypted
+        assert os.path.exists(os.path.join(d, ".key"))
+        assert CipherUtils.read_key_from_file(
+            os.path.join(d, ".key")) == key
         # NO sibling plaintext survives (manifest, params in any format)
+        # — only the deliberately-excluded key file
         leftover = [fn for fn in os.listdir(d)
-                    if not fn.endswith(".encrypted")]
+                    if not fn.endswith(".encrypted") and fn != ".key"]
         assert not leftover, leftover
         with pytest.raises(FileNotFoundError):
             fluid.io.load_inference_model(d, exe)
