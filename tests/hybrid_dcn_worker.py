@@ -1,0 +1,85 @@
+"""Child for the multi-host hybrid-mesh test: 2 REAL processes, each
+with 4 virtual CPU devices, joined by jax.distributed.initialize into an
+8-device world.  A Mesh {dp: 2, tp: 4} is laid out so the dp axis spans
+PROCESSES (the DCN hop — cross-host allreduce) and the tp axis spans each
+process's local devices (the ICI analog) — the reference's multi-node
+NCCL topology (hierarchical rings, build_strategy.h:152) expressed as a
+mesh.  Runs pjit-sharded training steps: activations tensor-parallel over
+tp, gradients data-parallel over dp; writes per-rank losses for the
+parent to compare."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+STEPS = 4
+BATCH = 8          # per dp shard
+DIN, DHID = 16, 32
+
+
+def main():
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nranks, process_id=rank)
+    devs = jax.devices()
+    assert len(devs) == 4 * nranks, devs
+    # dp (first axis) spans processes: rows of the device grid are the
+    # two hosts; tp spans the 4 devices local to each host
+    grid = np.array(devs).reshape(nranks, 4)
+    for r in range(nranks):
+        assert all(d.process_index == r for d in grid[r]), \
+            "dp axis must cross processes (DCN), tp stay local (ICI)"
+    mesh = Mesh(grid, ("dp", "tp"))
+
+    rng = np.random.RandomState(3)
+    w1 = jnp.asarray(rng.randn(DIN, DHID).astype("float32") * 0.1)
+    w2 = jnp.asarray(rng.randn(DHID, 1).astype("float32") * 0.1)
+    xs = rng.randn(nranks * BATCH, DIN).astype("float32")
+    ys = xs.sum(-1, keepdims=True).astype("float32") * 0.3
+
+    w1_s = jax.device_put(w1, NamedSharding(mesh, P(None, "tp")))
+    w2_s = jax.device_put(w2, NamedSharding(mesh, P("tp", None)))
+
+    @jax.jit
+    def step(w1, w2, x, y):
+        def loss_fn(w1, w2):
+            h = jax.nn.relu(x @ w1)        # [B, DHID/tp] sharded
+            pred = h @ w2                  # tp-partial -> psum by XLA
+            return jnp.mean((pred - y) ** 2)
+        loss, (g1, g2) = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            w1, w2)
+        return w1 - 0.05 * g1, w2 - 0.05 * g2, loss
+
+    losses = []
+    with mesh:
+        # fixed batch: the loss sequence must be monotone evidence of
+        # the update actually applying across both hosts
+        x = jax.device_put(jnp.asarray(xs),
+                           NamedSharding(mesh, P("dp", None)))
+        y = jax.device_put(jnp.asarray(ys),
+                           NamedSharding(mesh, P("dp", None)))
+        for _ in range(STEPS):
+            w1_s, w2_s, loss = step(w1_s, w2_s, x, y)
+            losses.append(float(loss))
+
+    out = os.environ["HYBRID_DCN_OUT"].replace("RANK", str(rank))
+    with open(out, "w") as f:
+        json.dump({"rank": rank, "losses": losses,
+                   "w1_sum": float(jnp.sum(w1_s)),
+                   "n_devices": len(devs)}, f)
+    print(f"rank {rank} done: losses={losses}")
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@
 processes, fleet.init runs jax.distributed.initialize (the gen_nccl_id
 rendezvous), dygraph DataParallel allreduces grads across processes, and
 the loss/params must match single-process full-batch training."""
+import json
 import os
 import socket
 import subprocess
@@ -19,6 +20,28 @@ def _free_port():
     return p
 
 
+def _launch_two_procs(script_name, env_extra, tmp_path):
+    """Run a worker under the real launcher with 2 processes; returns
+    (result, logs) with per-rank log tails gathered for assertions."""
+    script = os.path.join(os.path.dirname(__file__), script_name)
+    env = dict(os.environ, **env_extra)
+    for k in ("TRAINING_ROLE", "PADDLE_TPU_COORDINATOR"):
+        env.pop(k, None)
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2",
+         "--master", f"127.0.0.1:{_free_port()}",
+         "--log_dir", str(tmp_path / "logs"), script],
+        env=env, capture_output=True, text=True, timeout=420,
+        cwd=os.path.dirname(os.path.dirname(script)))
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.exists():
+        for f in sorted(os.listdir(logdir)):
+            logs += f"\n--- {f} ---\n" + open(logdir / f).read()[-2000:]
+    return r, logs
+
+
 class TestCollectiveMultiProcess:
     def test_two_process_dp_matches_single(self, tmp_path):
         script = os.path.join(os.path.dirname(__file__),
@@ -33,21 +56,9 @@ class TestCollectiveMultiProcess:
                            capture_output=True, text=True, timeout=240)
         assert r.returncode == 0, r.stderr[-2000:]
 
-        env = dict(os.environ, COLLECTIVE_TEST_OUT=out_dist)
-        for k in ("TRAINING_ROLE", "PADDLE_TPU_COORDINATOR"):
-            env.pop(k, None)
-        r = subprocess.run(
-            [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--nproc_per_node", "2",
-             "--master", f"127.0.0.1:{_free_port()}",
-             "--log_dir", str(tmp_path / "logs"), script],
-            env=env, capture_output=True, text=True, timeout=420,
-            cwd=os.path.dirname(os.path.dirname(script)))
-        logs = ""
-        logdir = tmp_path / "logs"
-        if logdir.exists():
-            for f in sorted(os.listdir(logdir)):
-                logs += f"\n--- {f} ---\n" + open(logdir / f).read()[-2000:]
+        r, logs = _launch_two_procs("collective_trainer.py",
+                                    {"COLLECTIVE_TEST_OUT": out_dist},
+                                    tmp_path)
         assert r.returncode == 0, (r.stdout[-500:], r.stderr[-500:], logs)
         assert os.path.exists(out_dist), logs
 
@@ -62,37 +73,51 @@ class TestCollectiveMultiProcess:
         assert dist["losses"][-1] < dist["losses"][0]
 
 
+class TestHybridDcnIciMesh:
+    """Multi-host hybrid mesh: 2 REAL processes x 4 virtual devices = an
+    8-device world with dp spanning processes (DCN) and tp local (ICI) —
+    the reference's hierarchical multi-node topology
+    (build_strategy.h:152) as a jax Mesh, training under pjit."""
+
+    def test_two_host_hybrid_mesh_trains(self, tmp_path):
+        out_tpl = str(tmp_path / "out_RANK.json")
+        r, logs = _launch_two_procs("hybrid_dcn_worker.py",
+                                    {"HYBRID_DCN_OUT": out_tpl}, tmp_path)
+        assert r.returncode == 0, (r.stdout[-500:], r.stderr[-500:],
+                                   logs)
+        outs = []
+        for rank in (0, 1):
+            p = out_tpl.replace("RANK", str(rank))
+            assert os.path.exists(p), logs
+            with open(p) as f:
+                outs.append(json.load(f))
+        # both hosts saw the full 8-device world and agreed on the
+        # globally-reduced loss and updated weights
+        assert all(o["n_devices"] == 8 for o in outs)
+        np.testing.assert_allclose(outs[0]["losses"], outs[1]["losses"],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(outs[0]["w1_sum"], outs[1]["w1_sum"],
+                                   rtol=1e-6)
+        assert outs[0]["losses"][-1] < outs[0]["losses"][0]
+
+
 class TestEagerCollectivesMultiProcess:
     """The DCN (host allgather) path of paddle.distributed.collective,
     across 2 REAL processes."""
 
     def test_functional_collectives_two_procs(self, tmp_path):
-        script = os.path.join(os.path.dirname(__file__),
-                              "collective_api_worker.py")
         out_tpl = str(tmp_path / "out_RANK.json")
-        env = dict(os.environ, COLLECTIVE_API_OUT=out_tpl)
-        for k in ("TRAINING_ROLE", "PADDLE_TPU_COORDINATOR"):
-            env.pop(k, None)
-        r = subprocess.run(
-            [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--nproc_per_node", "2",
-             "--master", f"127.0.0.1:{_free_port()}",
-             "--log_dir", str(tmp_path / "logs"), script],
-            env=env, capture_output=True, text=True, timeout=420,
-            cwd=os.path.dirname(os.path.dirname(script)))
-        logs = ""
-        logdir = tmp_path / "logs"
-        if logdir.exists():
-            for f in sorted(os.listdir(logdir)):
-                logs += f"\n--- {f} ---\n" + open(logdir / f).read()[-2000:]
+        r, logs = _launch_two_procs("collective_api_worker.py",
+                                    {"COLLECTIVE_API_OUT": out_tpl},
+                                    tmp_path)
         assert r.returncode == 0, (r.stdout[-500:] + r.stderr[-1000:]
                                    + logs)
 
-        import json
         results = {}
         for rank in range(2):
             path = out_tpl.replace("RANK", str(rank))
-            assert os.path.exists(path), f"rank {rank} wrote no output{logs}"
+            assert os.path.exists(path), \
+                f"rank {rank} wrote no output{logs}"
             with open(path) as f:
                 results[rank] = json.load(f)
         for rank, res in results.items():
