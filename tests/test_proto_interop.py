@@ -179,6 +179,77 @@ class TestReferenceFixture:
                                    fixture.expected_output(x), rtol=1e-5)
 
 
+class TestReferenceControlFlowLayout:
+    """A reference-layout ProgramDesc with a while sub-block and a
+    SELECTED_ROWS var parses into a structurally faithful Program —
+    nested blocks, BLOCK-typed attrs, and var kinds survive the wire."""
+
+    def _build(self):
+        pb = fp.ProgramDesc()
+        b0 = pb.blocks.add()
+        b0.idx, b0.parent_idx = 0, -1
+        b1 = pb.blocks.add()
+        b1.idx, b1.parent_idx = 1, 0
+
+        v = b0.vars.add()
+        v.name = "i"
+        v.type.type = fp.VarType.LOD_TENSOR
+        v.type.lod_tensor.tensor.data_type = fp.VarType.INT64
+        v.type.lod_tensor.tensor.dims.extend([1])
+        sr = b0.vars.add()
+        sr.name = "emb_grad"
+        sr.type.type = fp.VarType.SELECTED_ROWS
+        sr.type.selected_rows.data_type = fp.VarType.FP32
+        sr.type.selected_rows.dims.extend([100, 8])
+
+        wop = b0.ops.add()
+        wop.type = "while"
+        pv = wop.inputs.add()
+        pv.parameter = "X"
+        pv.arguments.append("i")
+        pv = wop.outputs.add()
+        pv.parameter = "Out"
+        pv.arguments.append("i")
+        a = wop.attrs.add()
+        a.name, a.type, a.block_idx = "sub_block", fp.BLOCK, 1
+
+        inc = b1.ops.add()
+        inc.type = "increment"
+        pv = inc.inputs.add()
+        pv.parameter = "X"
+        pv.arguments.append("i")
+        pv = inc.outputs.add()
+        pv.parameter = "Out"
+        pv.arguments.append("i")
+        return pb
+
+    def test_structure_round_trips(self):
+        pb = self._build()
+        prog = proto_serde.program_from_proto(pb)
+        assert len(prog.blocks) == 2
+        assert prog.blocks[1].parent_idx == 0
+        (wop,) = prog.blocks[0].ops
+        assert wop.type == "while" and wop.attrs["sub_block"] == 1
+        assert prog.blocks[1].ops[0].type == "increment"
+        sr = prog.global_block().vars["emb_grad"]
+        assert tuple(sr.shape) == (100, 8) and sr.dtype == "float32"
+        # write side: block refs stay BLOCK-typed on the wire
+        pb2 = proto_serde.program_to_proto(prog)
+        battrs = [a for o in pb2.blocks[0].ops for a in o.attrs
+                  if a.type == fp.BLOCK]
+        assert battrs and battrs[0].block_idx == 1
+
+    def test_out_of_order_blocks(self):
+        pb = self._build()
+        # serialize blocks out of idx order (legal protobuf)
+        blocks = list(pb.blocks)
+        del pb.blocks[:]
+        pb.blocks.extend([blocks[1], blocks[0]])
+        prog = proto_serde.program_from_proto(pb)
+        assert prog.blocks[0].ops[0].type == "while"
+        assert prog.blocks[1].ops[0].type == "increment"
+
+
 class TestTensorStreams:
     def test_lod_tensor_round_trip(self):
         arr = np.random.RandomState(0).randn(5, 7).astype("float32")
