@@ -224,7 +224,10 @@ def program_from_proto(pb: "fp.ProgramDesc") -> Program:
     missing = [i for i, b in enumerate(prog.blocks) if b is None]
     if missing:
         raise ValueError(f"ProgramDesc has gaps in block indices: {missing}")
-    for pb_block in pb.blocks:
+    # fill vars/ops in INDEX order: parent-block vars must exist before a
+    # child block's ops resolve names, or a shadow var appears in the
+    # child (wire order is arbitrary for repeated fields)
+    for pb_block in sorted(pb.blocks, key=lambda b: b.idx):
         block = prog.blocks[pb_block.idx]
         for pb_var in pb_block.vars:
             _var_from_proto(pb_var, block)

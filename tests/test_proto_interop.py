@@ -248,6 +248,10 @@ class TestReferenceControlFlowLayout:
         prog = proto_serde.program_from_proto(pb)
         assert prog.blocks[0].ops[0].type == "while"
         assert prog.blocks[1].ops[0].type == "increment"
+        # no shadow var: the sub-block's 'i' must resolve to block 0's
+        # loop counter, not a freshly created block-1 local
+        assert "i" not in prog.blocks[1].vars
+        assert "i" in prog.blocks[0].vars
 
 
 class TestTensorStreams:

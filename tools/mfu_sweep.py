@@ -112,9 +112,17 @@ def run_case(case, steps=20, warmup=3):
     if case == "nohead":
         fpt -= 3 * 2 * hidden * vocab      # head ablated: honest FLOPs
     mfu = tok_s * fpt / 197e12
-    print(json.dumps({"case": case, "tok_s": round(tok_s, 1),
-                      "step_ms": round(dt / steps * 1e3, 2),
-                      "mfu": round(mfu, 4), "seq": seq, "batch": batch}))
+    row = {"case": case, "tok_s": round(tok_s, 1),
+           "step_ms": round(dt / steps * 1e3, 2),
+           "mfu": round(mfu, 4), "seq": seq, "batch": batch}
+    backend = bench.backend_name()
+    if backend not in ("cpu", "error") \
+            and not os.environ.get("MFU_SWEEP_TINY"):
+        # ablation rows are evidence too (they justify the bench config)
+        # — but never the TINY smoke model's numbers
+        bench.record_evidence(dict(row, metric=f"mfu_sweep:{case}",
+                                   backend=backend))
+    print(json.dumps(row))
 
 
 def main():
@@ -126,14 +134,21 @@ def main():
             run_case(case)
             return
         import subprocess
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), case],
-            env=dict(os.environ, MFU_SWEEP_CHILD="1"),
-            capture_output=True, text=True, timeout=900)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), case],
+                env=dict(os.environ, MFU_SWEEP_CHILD="1"),
+                capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            # one hung case (tunnel stall, giant compile) must not kill
+            # the remaining ablations
+            print(f'{{"case": "{case}", "error": "timeout 900s"}}',
+                  flush=True)
+            continue
         out = [l for l in r.stdout.splitlines() if l.startswith("{")]
         print(out[-1] if out else
               f'{{"case": "{case}", "error": "rc={r.returncode}: '
-              f'{r.stderr[-200:].strip()}"}}')
+              f'{r.stderr[-200:].strip()}"}}', flush=True)
 
 
 if __name__ == "__main__":

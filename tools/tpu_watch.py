@@ -61,6 +61,28 @@ def run_child(args, budget, extra_env=None, _retried=False):
         return False
 
 
+def run_sweep(cases, budget=3000):
+    """MFU ablation cases; budget exceeds the callee's worst case
+    (len(cases) x 900s inner timeout) so partial results still print."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "tools/mfu_sweep.py"] + cases,
+            cwd=_ROOT, capture_output=True, text=True, timeout=budget)
+        lines = [ln for ln in (r.stdout or "").splitlines()
+                 if ln.startswith("{")]
+        for ln in lines:
+            print(f"[watch] sweep {ln}", flush=True)
+        if not lines or r.returncode != 0:
+            tail = (r.stderr or "").strip().splitlines()[-3:]
+            print(f"[watch] mfu_sweep rc={r.returncode}; "
+                  f"stderr: {' | '.join(tail)}", flush=True)
+    except subprocess.TimeoutExpired as e:
+        for ln in (e.stdout or b"").decode(errors="ignore").splitlines():
+            if ln.startswith("{"):
+                print(f"[watch] sweep {ln}", flush=True)
+        print(f"[watch] mfu_sweep: timeout {budget}s", flush=True)
+
+
 def run_pallas_parity(budget=600):
     """On-chip pallas kernel parity tests first: cheap, and a committed
     PASS here is test evidence the judge can read even if the tunnel
@@ -106,6 +128,11 @@ def main():
             run_child(["--model", "resnet50", "--layout=nchw"], 900)
             run_child(["--model", "nmt"], 900)
             run_child(["--model", "wide_deep"], 600)
+            if ok:
+                # operating-point ablation while the window lasts: does a
+                # bigger batch / longer seq beat the headline config?
+                # rows land in BENCH_evidence.json via record_evidence
+                run_sweep(["baseline", "b256", "seq512"], budget=3000)
             if ok:
                 print("[watch] sweep complete — evidence recorded",
                       flush=True)
