@@ -22,3 +22,16 @@ def test_example_runs(script):
         capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
     assert "loss" in r.stdout or "saved" in r.stdout
+
+
+def test_serve_reference_model_example():
+    """The migration example serves the reference-layout fixture."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "examples", "serve_reference_model.py"),
+         os.path.join(ROOT, "tests", "fixtures", "ref_fc_model")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    assert "softmax_out" in r.stdout
