@@ -1,0 +1,176 @@
+"""Second tranche of OpTest-style numeric contracts: metrics, the
+fake-quantize family, and the affine/grid vision math — the remaining
+closure families that were execution-tested but not pinned to numpy
+references (reference test strategy, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.ops.registry import LoweringContext, get_op
+
+
+def run_op(op_type, ins, attrs=None):
+    ctx = LoweringContext(base_key=jax.random.PRNGKey(0), mesh_axes={},
+                          is_test=False)
+    packed = {k: [jax.numpy.asarray(a) for a in
+                  (v if isinstance(v, list) else [v])]
+              for k, v in ins.items()}
+    return get_op(op_type).fn(packed, attrs or {}, ctx)
+
+
+class TestMetricsNumeric:
+    def test_accuracy(self):
+        # accuracy_op.h: fraction of rows whose top-k Indices contain label
+        idx = np.array([[2], [0], [1]], np.int64)
+        label = np.array([[2], [1], [1]], np.int64)
+        out = run_op("accuracy", {"Out": idx.astype(np.float32),
+                                  "Indices": idx, "Label": label})
+        np.testing.assert_allclose(np.asarray(out["Accuracy"][0]),
+                                   2.0 / 3.0, rtol=1e-6)
+
+    def test_auc(self):
+        # auc_op.cc: streaming ROC AUC over StatPos/StatNeg buckets.
+        # Perfectly separable scores -> 1.0; anti-separated -> 0.0
+        nt = 200
+        zeros = np.zeros((nt + 1,), np.float32)
+        preds = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8],
+                          [0.1, 0.9]], np.float32)
+        labels = np.array([[0], [0], [1], [1]], np.int64)
+        out = run_op("auc", {"Predict": preds, "Label": labels,
+                             "StatPos": zeros, "StatNeg": zeros},
+                     {"num_thresholds": nt})
+        np.testing.assert_allclose(float(np.asarray(out["AUC"][0])), 1.0,
+                                   atol=5e-3)
+        out2 = run_op("auc", {"Predict": preds[::-1], "Label": labels,
+                              "StatPos": zeros, "StatNeg": zeros},
+                      {"num_thresholds": nt})
+        np.testing.assert_allclose(float(np.asarray(out2["AUC"][0])),
+                                   0.0, atol=5e-3)
+        # streaming: feeding the state back accumulates counts
+        out3 = run_op("auc", {"Predict": preds, "Label": labels,
+                              "StatPos": np.asarray(out["StatPosOut"][0]),
+                              "StatNeg": np.asarray(out["StatNegOut"][0])},
+                      {"num_thresholds": nt})
+        assert float(np.asarray(out3["StatPosOut"][0]).sum()) == 4.0
+
+    def test_precision_recall(self):
+        # precision_recall_op.cc macro metrics, 2 classes
+        idx = np.array([[0], [0], [1], [1]], np.int64)
+        label = np.array([[0], [1], [1], [1]], np.int64)
+        out = run_op("precision_recall",
+                     {"MaxProbs": np.ones((4, 1), np.float32),
+                      "Indices": idx, "Labels": label},
+                     {"class_number": 2})
+        metrics = np.asarray(out["BatchMetrics"][0]).ravel()
+        # class0: tp=1 fp=1 fn=0 -> p=.5 r=1; class1: tp=2 fp=0 fn=1 ->
+        # p=1 r=2/3; macro p=.75, macro r=5/6
+        np.testing.assert_allclose(metrics[0], 0.75, rtol=1e-5)
+        np.testing.assert_allclose(metrics[1], 5.0 / 6.0, rtol=1e-5)
+
+
+class TestQuantNumeric:
+    def test_fake_quantize_abs_max(self):
+        # fake_quantize_op.cc: scale = max|x|, quantize to int range
+        x = np.array([[0.5, -1.0, 0.25]], np.float32)
+        out = run_op("fake_quantize_abs_max", {"X": x}, {"bit_length": 8})
+        scale = float(np.asarray(out["OutScale"][0]).ravel()[0])
+        np.testing.assert_allclose(scale, 1.0, rtol=1e-6)
+        q = np.asarray(out["Out"][0])
+        np.testing.assert_allclose(q, np.round(x / 1.0 * 127), rtol=1e-5)
+
+    def test_fake_quantize_dequantize_round_trip_error(self):
+        x = np.linspace(-1, 1, 9, dtype=np.float32)[None]
+        out = run_op("fake_quantize_dequantize_abs_max", {"X": x},
+                     {"bit_length": 8})
+        got = np.asarray(out["Out"][0])
+        # dequantized value = round(x/scale*127)*scale/127
+        want = np.round(x * 127) / 127
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_channel_wise_scales(self):
+        x = np.stack([np.full((4,), 0.5, np.float32),
+                      np.full((4,), 2.0, np.float32)])
+        out = run_op("fake_channel_wise_quantize_abs_max", {"X": x},
+                     {"bit_length": 8, "quant_axis": 0})
+        scales = np.asarray(out["OutScale"][0]).ravel()
+        np.testing.assert_allclose(scales, [0.5, 2.0], rtol=1e-6)
+
+    def test_fake_dequantize_max_abs(self):
+        x = np.array([[127, -127, 64]], np.float32)
+        out = run_op("fake_dequantize_max_abs",
+                     {"X": x, "Scale": np.array([2.0], np.float32)},
+                     {"max_range": 127})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   x * 2.0 / 127, rtol=1e-5)
+
+    def test_moving_average_state_update(self):
+        # fake_quantize_moving_average_abs_max: state = rho*state +
+        # (1-rho)*max|x|, accum/state normalized scale
+        x = np.full((1, 4), 3.0, np.float32)
+        out = run_op("fake_quantize_moving_average_abs_max",
+                     {"X": x, "InScale": np.array([1.0], np.float32),
+                      "InState": np.array([1.0], np.float32),
+                      "InAccum": np.array([1.0], np.float32)},
+                     {"bit_length": 8, "moving_rate": 0.9,
+                      "is_test": False})
+        state = float(np.asarray(out["OutState"][0]).ravel()[0])
+        accum = float(np.asarray(out["OutAccum"][0]).ravel()[0])
+        scale = float(np.asarray(out["OutScale"][0]).ravel()[0])
+        # fake_quantize_op.cc:274-276: state = rate*state + 1,
+        # accum = rate*accum + max|x|, scale = accum/state
+        np.testing.assert_allclose(state, 0.9 * 1.0 + 1.0, rtol=1e-5)
+        np.testing.assert_allclose(accum, 0.9 * 1.0 + 3.0, rtol=1e-5)
+        np.testing.assert_allclose(scale, accum / state, rtol=1e-5)
+
+
+class TestGridNumeric:
+    def test_affine_grid_identity(self):
+        # affine_grid_op.cc: identity theta -> normalized coord grid
+        theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32)
+        out = run_op("affine_grid", {"Theta": theta},
+                     {"output_shape": [1, 1, 2, 2]})
+        grid = np.asarray(out["Output"][0])
+        assert grid.shape == (1, 2, 2, 2)
+        # corners at normalized (-1,-1) .. (1,1), x fastest
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid[0, 1, 1], [1, 1], atol=1e-6)
+
+    def test_grid_sampler_identity(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        theta = np.array([[[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]]], np.float32)
+        grid = np.asarray(run_op("affine_grid", {"Theta": theta},
+                                 {"output_shape": [1, 1, 4, 4]})
+                          ["Output"][0])
+        out = run_op("grid_sampler", {"X": x, "Grid": grid}, {})
+        np.testing.assert_allclose(np.asarray(out["Output"][0]), x,
+                                   atol=1e-5)
+
+    def test_roi_align_single_cell(self):
+        # one ROI covering one pixel: average pooling degenerates to it
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 1.0, 1.0, 2.0, 2.0]], np.float32)
+        out = run_op("roi_align",
+                     {"X": x, "ROIs": rois[:, 1:],
+                      "RoisNum": np.array([1], np.int32)},
+                     {"pooled_height": 1, "pooled_width": 1,
+                      "spatial_scale": 1.0, "sampling_ratio": 1})
+        val = float(np.asarray(out["Out"][0]).ravel()[0])
+        # bilinear samples inside [1,2]x[1,2] average around x[1..2,1..2]
+        assert 5.0 <= val <= 10.0
+
+    def test_prior_box_center_and_size(self):
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        feat = np.zeros((1, 8, 2, 2), np.float32)
+        out = run_op("prior_box", {"Input": feat, "Image": img},
+                     {"min_sizes": [4.0], "aspect_ratios": [1.0],
+                      "variances": [0.1, 0.1, 0.2, 0.2], "flip": False,
+                      "clip": False, "step_w": 16.0, "step_h": 16.0,
+                      "offset": 0.5})
+        boxes = np.asarray(out["Boxes"][0])
+        # first cell center (8, 8), min_size 4 -> normalized [6,6,10,10]/32
+        np.testing.assert_allclose(boxes[0, 0, 0],
+                                   [6 / 32, 6 / 32, 10 / 32, 10 / 32],
+                                   atol=1e-5)
+        var = np.asarray(out["Variances"][0])
+        np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
