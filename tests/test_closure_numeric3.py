@@ -1,0 +1,136 @@
+"""Third tranche of numeric contracts: the RNN cell family — gru_unit /
+lstm (peephole and plain) / gru step math pinned against step-by-step
+numpy recurrences (reference gru_unit_op.cc, lstm_op.cc formulas)."""
+import numpy as np
+
+import jax
+
+from paddle_tpu.ops.registry import LoweringContext, get_op
+
+
+def run_op(op_type, ins, attrs=None):
+    ctx = LoweringContext(base_key=jax.random.PRNGKey(0), mesh_axes={},
+                          is_test=False)
+    packed = {k: [jax.numpy.asarray(a) for a in
+                  (v if isinstance(v, list) else [v])]
+              for k, v in ins.items()}
+    return get_op(op_type).fn(packed, attrs or {}, ctx)
+
+
+def sigmoid(v):
+    return 1 / (1 + np.exp(-v))
+
+
+R = np.random.RandomState(42)
+H = 3
+
+
+class TestGruUnit:
+    def test_matches_numpy_step(self):
+        # gru_unit_op.cc: u,r from first 2H gate columns; candidate from
+        # last H with reset-gated hidden; default (non-origin) blend
+        x = R.randn(2, 3 * H).astype("float32")
+        hprev = R.randn(2, H).astype("float32")
+        w = R.randn(H, 3 * H).astype("float32") * 0.5
+        b = R.randn(1, 3 * H).astype("float32") * 0.1
+        out = run_op("gru_unit", {"Input": x, "HiddenPrev": hprev,
+                                  "Weight": w, "Bias": b}, {})
+        bb = b.reshape(-1)
+        ur = sigmoid(x[:, :2 * H] + bb[:2 * H] + hprev @ w[:, :2 * H])
+        u, r = ur[:, :H], ur[:, H:]
+        c = np.tanh(x[:, 2 * H:] + bb[2 * H:] + (r * hprev) @ w[:, 2 * H:])
+        want = (1 - u) * hprev + u * c
+        np.testing.assert_allclose(np.asarray(out["Hidden"][0]), want,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["ResetHiddenPrev"][0]),
+                                   r * hprev, rtol=1e-5)
+
+    def test_origin_mode_blend(self):
+        x = R.randn(1, 3 * H).astype("float32")
+        hprev = R.randn(1, H).astype("float32")
+        w = R.randn(H, 3 * H).astype("float32") * 0.5
+        out = run_op("gru_unit", {"Input": x, "HiddenPrev": hprev,
+                                  "Weight": w}, {"origin_mode": True})
+        ur = sigmoid(x[:, :2 * H] + hprev @ w[:, :2 * H])
+        u, r = ur[:, :H], ur[:, H:]
+        c = np.tanh(x[:, 2 * H:] + (r * hprev) @ w[:, 2 * H:])
+        want = u * hprev + (1 - u) * c
+        np.testing.assert_allclose(np.asarray(out["Hidden"][0]), want,
+                                   rtol=1e-5)
+
+
+def _lstm_numpy(x, w, b4, h0, c0, peep=None):
+    """Step-by-step plain/peephole LSTM (lstm_op.cc gate order i,f,c,o)."""
+    B, T, _ = x.shape
+    Hn = w.shape[0]
+    h, c = h0.copy(), c0.copy()
+    outs, cells = [], []
+    w_ic, w_if, w_oc = peep if peep else (0, 0, 0)
+    for t in range(T):
+        g = x[:, t] + h @ w + b4
+        i, f, cc, o = np.split(g, 4, axis=-1)
+        i = sigmoid(i + w_ic * c)
+        f = sigmoid(f + w_if * c)
+        c = f * c + i * np.tanh(cc)
+        o = sigmoid(o + w_oc * c)
+        h = o * np.tanh(c)
+        outs.append(h.copy())
+        cells.append(c.copy())
+    return np.stack(outs, 1), np.stack(cells, 1)
+
+
+class TestLstm:
+    def test_plain_matches_numpy(self):
+        B, T = 2, 4
+        x = R.randn(B, T, 4 * H).astype("float32")
+        w = (R.randn(H, 4 * H) * 0.4).astype("float32")
+        b = (R.randn(1, 4 * H) * 0.1).astype("float32")
+        out = run_op("lstm", {"Input": x, "Weight": w, "Bias": b},
+                     {"use_peepholes": False})
+        want_h, want_c = _lstm_numpy(x, w, b.reshape(-1),
+                                     np.zeros((B, H), "float32"),
+                                     np.zeros((B, H), "float32"))
+        np.testing.assert_allclose(np.asarray(out["Hidden"][0]), want_h,
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out["Cell"][0]), want_c,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_peephole_matches_numpy(self):
+        B, T = 1, 3
+        x = R.randn(B, T, 4 * H).astype("float32")
+        w = (R.randn(H, 4 * H) * 0.4).astype("float32")
+        b7 = (R.randn(1, 7 * H) * 0.1).astype("float32")
+        out = run_op("lstm", {"Input": x, "Weight": w, "Bias": b7},
+                     {"use_peepholes": True})
+        bb = b7.reshape(-1)
+        want_h, want_c = _lstm_numpy(
+            x, w, bb[:4 * H], np.zeros((B, H), "float32"),
+            np.zeros((B, H), "float32"),
+            peep=(bb[4 * H:5 * H], bb[5 * H:6 * H], bb[6 * H:7 * H]))
+        np.testing.assert_allclose(np.asarray(out["Hidden"][0]), want_h,
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_reverse_runs_backward(self):
+        B, T = 1, 3
+        x = R.randn(B, T, 4 * H).astype("float32")
+        w = (R.randn(H, 4 * H) * 0.4).astype("float32")
+        fwd = run_op("lstm", {"Input": x, "Weight": w},
+                     {"use_peepholes": False, "is_reverse": False})
+        rev = run_op("lstm", {"Input": x[:, ::-1], "Weight": w},
+                     {"use_peepholes": False, "is_reverse": True})
+        # reversing input + is_reverse = forward outputs reversed in time
+        np.testing.assert_allclose(
+            np.asarray(rev["Hidden"][0])[:, ::-1],
+            np.asarray(fwd["Hidden"][0]), rtol=1e-4, atol=1e-6)
+
+    def test_initial_state_honored(self):
+        B, T = 2, 2
+        x = R.randn(B, T, 4 * H).astype("float32")
+        w = (R.randn(H, 4 * H) * 0.4).astype("float32")
+        h0 = R.randn(B, H).astype("float32")
+        c0 = R.randn(B, H).astype("float32")
+        out = run_op("lstm", {"Input": x, "Weight": w, "H0": h0,
+                              "C0": c0}, {"use_peepholes": False})
+        want_h, _ = _lstm_numpy(x, w, 0.0, h0, c0)
+        np.testing.assert_allclose(np.asarray(out["Hidden"][0]), want_h,
+                                   rtol=1e-4, atol=1e-6)
