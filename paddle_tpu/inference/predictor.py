@@ -165,8 +165,11 @@ class AnalysisPredictor:
             import os as _os
             model_dir = _os.path.dirname(config.prog_file) or "."
             model_file = _os.path.basename(config.prog_file)
-            if config.params_file:
-                params_file = _os.path.basename(config.params_file)
+        if config.params_file:
+            # honored in BOTH forms: with model_dir set, an explicit
+            # params_file selects the combined (save_combine) file
+            import os as _os
+            params_file = _os.path.basename(config.params_file)
         if model_dir is None:
             raise ValueError("AnalysisConfig needs model_dir or prog_file")
         self._program, self._feed_names, self._fetch_vars = \

@@ -7,16 +7,12 @@ import pytest
 
 import jax
 
-from paddle_tpu.ops.registry import LoweringContext, get_op
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from op_test import run_op
 
 
-def run_op(op_type, ins, attrs=None):
-    ctx = LoweringContext(base_key=jax.random.PRNGKey(0), mesh_axes={},
-                          is_test=False)
-    packed = {k: [jax.numpy.asarray(a) for a in
-                  (v if isinstance(v, list) else [v])]
-              for k, v in ins.items()}
-    return get_op(op_type).fn(packed, attrs or {}, ctx)
+
 
 
 class TestMetricsNumeric:
@@ -105,8 +101,6 @@ class TestQuantNumeric:
                                    x * 2.0 / 127, rtol=1e-5)
 
     def test_moving_average_state_update(self):
-        # fake_quantize_moving_average_abs_max: state = rho*state +
-        # (1-rho)*max|x|, accum/state normalized scale
         x = np.full((1, 4), 3.0, np.float32)
         out = run_op("fake_quantize_moving_average_abs_max",
                      {"X": x, "InScale": np.array([1.0], np.float32),

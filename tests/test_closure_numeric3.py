@@ -5,16 +5,12 @@ import numpy as np
 
 import jax
 
-from paddle_tpu.ops.registry import LoweringContext, get_op
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from op_test import run_op
 
 
-def run_op(op_type, ins, attrs=None):
-    ctx = LoweringContext(base_key=jax.random.PRNGKey(0), mesh_axes={},
-                          is_test=False)
-    packed = {k: [jax.numpy.asarray(a) for a in
-                  (v if isinstance(v, list) else [v])]
-              for k, v in ins.items()}
-    return get_op(op_type).fn(packed, attrs or {}, ctx)
+
 
 
 def sigmoid(v):
