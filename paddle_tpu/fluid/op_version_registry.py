@@ -95,3 +95,41 @@ register_op_version("dropout", 1)
 @register_converter("dropout", from_version=0)
 def _dropout_v0_to_v1(attrs):
     attrs.setdefault("dropout_implementation", "downgrade_in_infer")
+
+
+# --- the reference's own REGISTER_OP_VERSION pins (all 26 sites under
+# operators/; each has one checkpoint = version 1).  Attr-adding
+# checkpoints get converters injecting the checkpoint's defaults so a v0
+# artifact means exactly what it meant; input/output additions and
+# behavior bugfixes need no attr conversion (missing inputs are optional
+# in the lowerings, and this framework implements the POST-fix behavior).
+
+def _defaults(op_type, **kv):
+    register_op_version(op_type, 1)
+
+    @register_converter(op_type, from_version=0)
+    def _conv(attrs, _kv=kv):
+        for k, v in _kv.items():
+            # copy list defaults: a shared mutable would alias across ops
+            attrs.setdefault(k, list(v) if isinstance(v, list) else v)
+
+
+_defaults("arg_max", flatten=False)                # arg_max_op.cc:35
+_defaults("arg_min", flatten=False)                # arg_min_op.cc
+_defaults("cumsum", flatten=False)                 # cumsum_op.cc
+_defaults("softplus", beta=1.0, threshold=20.0)    # activation_op.cc:1375
+_defaults("momentum", regularization_method="",    # momentum_op.cc
+          regularization_coeff=0.0)
+_defaults("conv2d", use_addto=False)               # conv_op.cc
+_defaults("conv3d", use_addto=False)
+_defaults("depthwise_conv2d", use_addto=False)
+_defaults("conv2d_transpose", output_padding=[])   # conv_transpose_op.cc
+_defaults("unique", return_index=False,            # unique_op.cc
+          return_inverse=False, return_counts=False)
+
+for _op in ("leaky_relu", "hard_shrink", "lookup_table_v2", "clip",
+            "gather", "roi_align", "roi_pool", "fill_constant",
+            "gaussian_random", "cudnn_lstm", "data_norm", "matrix_nms",
+            "generate_proposals", "collect_fpn_proposals",
+            "distribute_fpn_proposals", "quantize"):
+    register_op_version(_op, 1)

@@ -167,8 +167,17 @@ class AnalysisPredictor:
             model_file = _os.path.basename(config.prog_file)
         if config.params_file:
             # honored in BOTH forms: with model_dir set, an explicit
-            # params_file selects the combined (save_combine) file
+            # params_file selects the combined (save_combine) file —
+            # which must live in model_dir (the loader resolves names
+            # against it; an out-of-dir path would silently misresolve)
             import os as _os
+            pdir = _os.path.dirname(config.params_file)
+            if pdir and _os.path.abspath(pdir) != _os.path.abspath(
+                    model_dir):
+                raise ValueError(
+                    f"params_file {config.params_file!r} is outside "
+                    f"model_dir {model_dir!r}; the combined params file "
+                    f"must sit next to the model")
             params_file = _os.path.basename(config.params_file)
         if model_dir is None:
             raise ValueError("AnalysisConfig needs model_dir or prog_file")

@@ -5,14 +5,7 @@ references (reference test strategy, SURVEY §4)."""
 import numpy as np
 import pytest
 
-import jax
-
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from op_test import run_op
-
-
-
 
 
 class TestMetricsNumeric:

@@ -3,14 +3,7 @@ lstm (peephole and plain) / gru step math pinned against step-by-step
 numpy recurrences (reference gru_unit_op.cc, lstm_op.cc formulas)."""
 import numpy as np
 
-import jax
-
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from op_test import run_op
-
-
-
 
 
 def sigmoid(v):

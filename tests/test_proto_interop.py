@@ -321,6 +321,22 @@ class TestOpVersionMap:
         assert prog.global_block().ops[0].attrs[
             "dropout_implementation"] == "downgrade_in_infer"
 
+    def test_reference_version_pins_mirrored(self):
+        # the reference's REGISTER_OP_VERSION sites are tracked at v1 and
+        # v0 artifacts get the checkpoint defaults injected
+        assert opver.current_version("arg_max") == 1
+        assert opver.current_version("momentum") == 1
+        attrs = {}
+        opver.check_and_convert("arg_max", attrs, 0)
+        assert attrs == {"flatten": False}
+        attrs = {}
+        opver.check_and_convert("softplus", attrs, 0)
+        assert attrs == {"beta": 1.0, "threshold": 20.0}
+        # a v1 save of a tracked op converts nothing
+        attrs = {"flatten": True}
+        opver.check_and_convert("arg_max", attrs, 1)
+        assert attrs == {"flatten": True}
+
     def test_untracked_op_any_version_accepted(self):
         # real reference exports pin versions for many ops this registry
         # doesn't track — those must load, not raise
