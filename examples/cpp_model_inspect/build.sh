@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate the C++ proto classes and build the inspector.
+set -e
+cd "$(dirname "$0")"
+protoc --cpp_out=. -I ../../paddle_tpu/fluid/proto \
+    ../../paddle_tpu/fluid/proto/framework.proto
+g++ -std=c++17 -O2 main.cc framework.pb.cc -lprotobuf -o inspect_model
+echo "built: $(pwd)/inspect_model"

@@ -1,0 +1,124 @@
+// Native consumer of the model format: reads a `__model__` ProgramDesc
+// protobuf (the wire contract in paddle_tpu/fluid/proto/framework.proto,
+// the reference framework's own format) from pure C++ and prints the
+// program structure — blocks, vars with shapes/kinds, the op stream with
+// attrs, and the op version map.  The C++ analog of the reference's
+// fluid/train + capi consumers: proves the artifact is a language-neutral
+// contract, not a Python object.
+//
+// Build + run (see build.sh):
+//   g++ -std=c++17 main.cc framework.pb.cc -lprotobuf -o inspect_model
+//   ./inspect_model ../../tests/fixtures/ref_fc_model/__model__
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+#include "framework.pb.h"
+
+namespace fp = paddle::framework::proto;
+
+static const char* DtypeName(fp::VarType_Type t) {
+  switch (t) {
+    case fp::VarType_Type_FP32: return "float32";
+    case fp::VarType_Type_FP64: return "float64";
+    case fp::VarType_Type_FP16: return "float16";
+    case fp::VarType_Type_BF16: return "bfloat16";
+    case fp::VarType_Type_INT32: return "int32";
+    case fp::VarType_Type_INT64: return "int64";
+    case fp::VarType_Type_BOOL: return "bool";
+    case fp::VarType_Type_UINT8: return "uint8";
+    case fp::VarType_Type_INT8: return "int8";
+    default: return "?";
+  }
+}
+
+static std::string AttrRepr(const fp::OpDesc::Attr& a) {
+  std::ostringstream os;
+  switch (a.type()) {
+    case fp::INT: os << a.i(); break;
+    case fp::LONG: os << a.l(); break;
+    case fp::FLOAT: os << a.f(); break;
+    case fp::STRING: os << '"' << a.s() << '"'; break;
+    case fp::BOOLEAN: os << (a.b() ? "true" : "false"); break;
+    case fp::BLOCK: os << "block#" << a.block_idx(); break;
+    case fp::INTS: {
+      os << '[';
+      for (int i = 0; i < a.ints_size(); ++i)
+        os << (i ? "," : "") << a.ints(i);
+      os << ']';
+      break;
+    }
+    default: os << "<" << fp::AttrType_Name(a.type()) << ">";
+  }
+  return os.str();
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::cerr << "usage: inspect_model <__model__ file>\n";
+    return 2;
+  }
+  std::ifstream in(argv[1], std::ios::binary);
+  if (!in) {
+    std::cerr << "cannot open " << argv[1] << "\n";
+    return 2;
+  }
+  fp::ProgramDesc program;
+  if (!program.ParseFromIstream(&in)) {
+    std::cerr << "not a ProgramDesc protobuf\n";
+    return 1;
+  }
+
+  std::cout << "blocks: " << program.blocks_size() << "\n";
+  for (const auto& block : program.blocks()) {
+    std::cout << "block " << block.idx() << " (parent "
+              << block.parent_idx() << "): " << block.vars_size()
+              << " vars, " << block.ops_size() << " ops\n";
+    for (const auto& var : block.vars()) {
+      std::cout << "  var " << var.name();
+      if (var.type().type() == fp::VarType_Type_LOD_TENSOR &&
+          var.type().has_lod_tensor()) {
+        const auto& td = var.type().lod_tensor().tensor();
+        std::cout << " " << DtypeName(td.data_type()) << " [";
+        for (int i = 0; i < td.dims_size(); ++i)
+          std::cout << (i ? "," : "") << td.dims(i);
+        std::cout << "]";
+      } else {
+        std::cout << " <" << fp::VarType_Type_Name(var.type().type())
+                  << ">";
+      }
+      if (var.persistable()) std::cout << " persistable";
+      std::cout << "\n";
+    }
+    for (const auto& op : block.ops()) {
+      std::cout << "  op " << op.type() << "(";
+      for (int i = 0; i < op.inputs_size(); ++i) {
+        const auto& slot = op.inputs(i);
+        std::cout << (i ? ", " : "") << slot.parameter() << "=";
+        for (int j = 0; j < slot.arguments_size(); ++j)
+          std::cout << (j ? "|" : "") << slot.arguments(j);
+      }
+      std::cout << ") -> ";
+      bool first_out = true;
+      for (const auto& slot : op.outputs()) {
+        for (const auto& arg : slot.arguments()) {
+          std::cout << (first_out ? "" : ",") << arg;
+          first_out = false;
+        }
+      }
+      for (const auto& a : op.attrs())
+        std::cout << " " << a.name() << "=" << AttrRepr(a);
+      std::cout << "\n";
+    }
+  }
+  if (program.has_op_version_map()) {
+    std::cout << "op versions:";
+    for (const auto& pair : program.op_version_map().pair())
+      std::cout << " " << pair.op_name() << "="
+                << pair.op_version().version();
+    std::cout << "\n";
+  }
+  std::cout << "OK\n";
+  return 0;
+}
