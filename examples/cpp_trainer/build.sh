@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the C++ standalone trainer (embeds CPython; run from a checkout
+# so `import paddle_tpu` resolves, or set PYTHONPATH to the repo root).
+set -e
+cd "$(dirname "$0")"
+PYCFG="${PYTHON_CONFIG:-python3-config}"
+g++ -std=c++17 -O2 main.cc $($PYCFG --includes) \
+    $($PYCFG --embed --ldflags) -o cpp_trainer
+echo "built: $(pwd)/cpp_trainer"

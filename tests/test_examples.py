@@ -72,6 +72,35 @@ fluid.io.save_inference_model(r'{tmp_path}', ['x'], [out], exe,
     assert "op feed(" in r.stdout and "op versions:" in r.stdout
 
 
+def test_cpp_trainer(tmp_path):
+    """The C++ standalone trainer (reference fluid/train/demo analog):
+    a host binary embedding CPython trains through the fluid API, the
+    loss falls, and the exported __model__ parses."""
+    import shutil
+    if not shutil.which("g++") or not shutil.which("python3-config"):
+        pytest.skip("native toolchain unavailable")
+    probe = subprocess.run(["python3-config", "--embed", "--ldflags"],
+                           capture_output=True, text=True, timeout=60)
+    if probe.returncode != 0:
+        pytest.skip("libpython embed config unavailable")
+    build = os.path.join(ROOT, "examples", "cpp_trainer", "build.sh")
+    r = subprocess.run(["sh", build], capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    exe = os.path.join(ROOT, "examples", "cpp_trainer", "cpp_trainer")
+    out_dir = str(tmp_path / "m")
+    env = dict(os.environ, CPP_TRAINER_PLATFORM="cpu")
+    env.pop("XLA_FLAGS", None)          # the trainer owns device config
+    env.pop("EXAMPLES_ON_TPU", None)
+    env["PYTHONPATH"] = ROOT + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    r = subprocess.run([exe, out_dir], capture_output=True, text=True,
+                       timeout=400, env=env)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    assert "OK" in r.stdout
+    assert os.path.exists(os.path.join(out_dir, "__model__"))
+
+
 def test_serve_reference_model_example():
     """The migration example serves the reference-layout fixture."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
