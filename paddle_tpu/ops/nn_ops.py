@@ -502,25 +502,135 @@ def _interp(ins, attrs, ctx, method):
                   if isinstance(scale, (list, tuple)) else (scale, scale))
         oh, ow = int(h * sh), int(w * sw)
     xt = x if nhwc else jnp.transpose(x, (0, 2, 3, 1))
-    if attrs.get("align_corners", False) and method == "bilinear" \
-            and oh > 1 and ow > 1:
-        # jax.image.resize has half-pixel-centres semantics; align_corners
-        # maps output corners onto input corners — build the grid by hand
-        ys = jnp.linspace(0.0, h - 1.0, oh)
-        xs = jnp.linspace(0.0, w - 1.0, ow)
-        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 2)
-        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 2)
-        fy = (ys - y0)[None, :, None, None]
-        fx = (xs - x0)[None, None, :, None]
+    align_corners = attrs.get("align_corners", False)
+    align_mode = attrs.get("align_mode", 1)
+
+    def ratio(i, o):
+        # interpolate_op.h:895-904
+        if o <= 1:
+            return 0.0
+        return (i - 1) / (o - 1) if align_corners else i / o
+
+    rh, rw = ratio(h, oh), ratio(w, ow)
+    if method == "nearest":
+        # interpolate_op.h:96-101: trunc(ratio*k + 0.5) with corners,
+        # trunc(ratio*k) origin-aligned otherwise — NOT half-pixel
+        off = 0.5 if align_corners else 0.0
+        iy = jnp.clip((rh * jnp.arange(oh) + off).astype(jnp.int32),
+                      0, h - 1)
+        ix = jnp.clip((rw * jnp.arange(ow) + off).astype(jnp.int32),
+                      0, w - 1)
+        out = xt[:, iy][:, :, ix]
+    elif method == "bilinear":
+        # interpolate_op.h BilinearInterpolation: three alignment modes
+        align_flag = (align_mode == 0 and not align_corners)
+
+        def axis_idx(r, o, i):
+            k = jnp.arange(o, dtype=jnp.float32)
+            src = r * (k + 0.5) - 0.5 if align_flag else r * k
+            lo = jnp.maximum(jnp.floor(src).astype(jnp.int32), 0)
+            hi = jnp.minimum(lo + 1, i - 1)
+            frac = (jnp.maximum(src, 0.0) - lo) if align_flag \
+                else r * k - lo
+            return lo, hi, frac
+
+        y0, y1, fy = axis_idx(rh, oh, h)
+        x0, x1, fx = axis_idx(rw, ow, w)
+        fy = fy[None, :, None, None]
+        fx = fx[None, None, :, None]
         g = lambda yy, xx: xt[:, yy][:, :, xx]
         out = ((1 - fy) * (1 - fx) * g(y0, x0)
-               + (1 - fy) * fx * g(y0, x0 + 1)
-               + fy * (1 - fx) * g(y0 + 1, x0)
-               + fy * fx * g(y0 + 1, x0 + 1))
+               + (1 - fy) * fx * g(y0, x1)
+               + fy * (1 - fx) * g(y1, x0)
+               + fy * fx * g(y1, x1))
+    elif method == "bicubic":
+        # interpolate_op.h BicubicInterpolation: Keys kernel A=-0.75,
+        # src = ratio*k (corners) or ratio*(k+0.5)-0.5; 4 taps per axis
+        # clamped into range
+        def cubic_weights(r, o):
+            k = jnp.arange(o, dtype=jnp.float32)
+            src = r * k if align_corners else r * (k + 0.5) - 0.5
+            base = jnp.floor(src).astype(jnp.int32)
+            t = src - base
+            A = -0.75
+
+            def cc1(v):
+                return ((A + 2) * v - (A + 3)) * v * v + 1
+
+            def cc2(v):
+                return ((A * v - 5 * A) * v + 8 * A) * v - 4 * A
+            w4 = jnp.stack([cc2(t + 1.0), cc1(t), cc1(1.0 - t),
+                            cc2(2.0 - t)])            # [4, o]
+            return base, w4
+
+        by, wy = cubic_weights(rh, oh)
+        bx, wx = cubic_weights(rw, ow)
+        out = 0.0
+        for i in range(4):
+            yy = jnp.clip(by + (i - 1), 0, h - 1)
+            row = 0.0
+            for j in range(4):
+                xx = jnp.clip(bx + (j - 1), 0, w - 1)
+                row = row + wx[j][None, None, :, None] \
+                    * xt[:, yy][:, :, xx]
+            out = out + wy[i][None, :, None, None] * row
     else:
         out = jax.image.resize(xt, (n, oh, ow, c), method=method)
     out = out.astype(x.dtype)
     return {"Out": [out if nhwc else jnp.transpose(out, (0, 3, 1, 2))]}
+
+
+def _trilinear_interp(ins, attrs, ctx):
+    """interpolate_op.h TrilinearInterpolation: 5D NCDHW/NDHWC with the
+    same three alignment modes as bilinear, over d/h/w."""
+    x = _x(ins)
+    ndhwc = attrs.get("data_layout", "NCDHW") == "NDHWC"
+    if ndhwc:
+        n, d, h, w, c = x.shape
+    else:
+        n, c, d, h, w = x.shape
+    od = attrs.get("out_d", -1)
+    oh = attrs.get("out_h", -1)
+    ow = attrs.get("out_w", -1)
+    if ins.get("OutSize"):
+        sz = np.asarray(ins["OutSize"][0])
+        od, oh, ow = int(sz[0]), int(sz[1]), int(sz[2])
+    elif od <= 0:
+        scale = attrs.get("scale", 1.0)
+        od, oh, ow = int(d * scale), int(h * scale), int(w * scale)
+    align_corners = attrs.get("align_corners", False)
+    align_mode = attrs.get("align_mode", 1)
+    align_flag = (align_mode == 0 and not align_corners)
+
+    def ratio(i, o):
+        if o <= 1:
+            return 0.0
+        return (i - 1) / (o - 1) if align_corners else i / o
+
+    def axis_idx(r, o, i):
+        k = jnp.arange(o, dtype=jnp.float32)
+        src = r * (k + 0.5) - 0.5 if align_flag else r * k
+        lo = jnp.maximum(jnp.floor(src).astype(jnp.int32), 0)
+        hi = jnp.minimum(lo + 1, i - 1)
+        frac = (jnp.maximum(src, 0.0) - lo) if align_flag else r * k - lo
+        return lo, hi, frac
+
+    xt = x if ndhwc else jnp.transpose(x, (0, 2, 3, 4, 1))  # N D H W C
+    d0, d1, fd = axis_idx(ratio(d, od), od, d)
+    y0, y1, fy = axis_idx(ratio(h, oh), oh, h)
+    x0, x1, fx = axis_idx(ratio(w, ow), ow, w)
+    fd = fd[None, :, None, None, None]
+    fy = fy[None, None, :, None, None]
+    fx = fx[None, None, None, :, None]
+    g = lambda dd, yy, xx: xt[:, dd][:, :, yy][:, :, :, xx]
+    out = 0.0
+    for wd, dd in ((1 - fd, d0), (fd, d1)):
+        for wh, yy in ((1 - fy, y0), (fy, y1)):
+            for ww, xx in ((1 - fx, x0), (fx, x1)):
+                out = out + wd * wh * ww * g(dd, yy, xx)
+    out = out.astype(x.dtype)
+    return {"Out": [out if ndhwc else jnp.transpose(out,
+                                                    (0, 4, 1, 2, 3))]}
 
 
 register_op("nearest_interp", lambda ins, a, c: _interp(ins, a, c, "nearest"),
@@ -529,7 +639,7 @@ register_op("bilinear_interp", lambda ins, a, c: _interp(ins, a, c, "bilinear"),
             nondiff_inputs=("OutSize",))
 register_op("bicubic_interp", lambda ins, a, c: _interp(ins, a, c, "bicubic"),
             nondiff_inputs=("OutSize",))
-register_op("trilinear_interp", lambda ins, a, c: _interp(ins, a, c, "trilinear"),
+register_op("trilinear_interp", _trilinear_interp,
             nondiff_inputs=("OutSize",))
 
 

@@ -256,6 +256,12 @@ def build_specs():
                               "paddings": [0, 0, 0],
                               "dilations": [1, 1, 1], "groups": 1},
                        out_slot="Output"),
+        "trilinear_interp": dict(inputs={"X": _sym(1, 1, 2, 3, 3)},
+                                 grad_slots=["X"],
+                                 attrs={"out_d": 4, "out_h": 5,
+                                        "out_w": 5,
+                                        "align_corners": True},
+                                 out_slot="Out"),
         "conv_fusion": dict(inputs={"Input": _sym(1, 2, 4, 4),
                                     "Filter": _sym(3, 2, 2, 2),
                                     "Bias": _sym(3)},
