@@ -106,7 +106,11 @@ _unary("acos", lambda x, a: jnp.arccos(x))
 _unary("atan", lambda x, a: jnp.arctan(x))
 _unary("sinh", lambda x, a: jnp.sinh(x))
 _unary("cosh", lambda x, a: jnp.cosh(x))
-_unary("softplus", lambda x, a: jax.nn.softplus(x))
+# activation_op.h:1055-1068: log(1+exp(beta*x))/beta, linear past the
+# numerical-stability threshold (the softplus v1 checkpoint attrs)
+_unary("softplus", lambda x, a: jnp.where(
+    a.get("beta", 1.0) * x > a.get("threshold", 20.0), x,
+    jax.nn.softplus(a.get("beta", 1.0) * x) / a.get("beta", 1.0)))
 _unary("softsign", lambda x, a: jax.nn.soft_sign(x))
 _unary("softshrink", lambda x, a: jnp.where(
     jnp.abs(x) > a.get("lambda", 0.5),

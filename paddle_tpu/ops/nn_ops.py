@@ -484,6 +484,24 @@ def _maxout(ins, attrs, ctx):
     return {"Out": [x.reshape(n, c // g, g, h, w).max(axis=2)]}
 
 
+def _interp_ratio(i, o, align_corners):
+    # interpolate_op.h:895-904
+    if o <= 1:
+        return 0.0
+    return (i - 1) / (o - 1) if align_corners else i / o
+
+
+def _interp_axis_idx(r, o, i, align_flag):
+    """Per-axis (lo, hi, frac) source indices for linear interpolation —
+    the BilinearInterpolation/TrilinearInterpolation index math."""
+    k = jnp.arange(o, dtype=jnp.float32)
+    src = r * (k + 0.5) - 0.5 if align_flag else r * k
+    lo = jnp.maximum(jnp.floor(src).astype(jnp.int32), 0)
+    hi = jnp.minimum(lo + 1, i - 1)
+    frac = (jnp.maximum(src, 0.0) - lo) if align_flag else r * k - lo
+    return lo, hi, frac
+
+
 def _interp(ins, attrs, ctx, method):
     x = _x(ins)
     nhwc = attrs.get("data_layout", "NCHW") == "NHWC"
@@ -506,10 +524,7 @@ def _interp(ins, attrs, ctx, method):
     align_mode = attrs.get("align_mode", 1)
 
     def ratio(i, o):
-        # interpolate_op.h:895-904
-        if o <= 1:
-            return 0.0
-        return (i - 1) / (o - 1) if align_corners else i / o
+        return _interp_ratio(i, o, align_corners)
 
     rh, rw = ratio(h, oh), ratio(w, ow)
     if method == "nearest":
@@ -524,18 +539,8 @@ def _interp(ins, attrs, ctx, method):
     elif method == "bilinear":
         # interpolate_op.h BilinearInterpolation: three alignment modes
         align_flag = (align_mode == 0 and not align_corners)
-
-        def axis_idx(r, o, i):
-            k = jnp.arange(o, dtype=jnp.float32)
-            src = r * (k + 0.5) - 0.5 if align_flag else r * k
-            lo = jnp.maximum(jnp.floor(src).astype(jnp.int32), 0)
-            hi = jnp.minimum(lo + 1, i - 1)
-            frac = (jnp.maximum(src, 0.0) - lo) if align_flag \
-                else r * k - lo
-            return lo, hi, frac
-
-        y0, y1, fy = axis_idx(rh, oh, h)
-        x0, x1, fx = axis_idx(rw, ow, w)
+        y0, y1, fy = _interp_axis_idx(rh, oh, h, align_flag)
+        x0, x1, fx = _interp_axis_idx(rw, ow, w, align_flag)
         fy = fy[None, :, None, None]
         fx = fx[None, None, :, None]
         g = lambda yy, xx: xt[:, yy][:, :, xx]
@@ -575,7 +580,9 @@ def _interp(ins, attrs, ctx, method):
                     * xt[:, yy][:, :, xx]
             out = out + wy[i][None, :, None, None] * row
     else:
-        out = jax.image.resize(xt, (n, oh, ow, c), method=method)
+        # every registered 2D method has a reference-exact branch above;
+        # a half-pixel jax.image fallback here would silently diverge
+        raise ValueError(f"unsupported interpolation method {method!r}")
     out = out.astype(x.dtype)
     return {"Out": [out if nhwc else jnp.transpose(out, (0, 3, 1, 2))]}
 
@@ -597,28 +604,20 @@ def _trilinear_interp(ins, attrs, ctx):
         od, oh, ow = int(sz[0]), int(sz[1]), int(sz[2])
     elif od <= 0:
         scale = attrs.get("scale", 1.0)
-        od, oh, ow = int(d * scale), int(h * scale), int(w * scale)
+        sd, sh, sw = (tuple(scale[:3]) if isinstance(scale, (list, tuple))
+                      else (scale, scale, scale))
+        od, oh, ow = int(d * sd), int(h * sh), int(w * sw)
     align_corners = attrs.get("align_corners", False)
     align_mode = attrs.get("align_mode", 1)
     align_flag = (align_mode == 0 and not align_corners)
 
-    def ratio(i, o):
-        if o <= 1:
-            return 0.0
-        return (i - 1) / (o - 1) if align_corners else i / o
-
-    def axis_idx(r, o, i):
-        k = jnp.arange(o, dtype=jnp.float32)
-        src = r * (k + 0.5) - 0.5 if align_flag else r * k
-        lo = jnp.maximum(jnp.floor(src).astype(jnp.int32), 0)
-        hi = jnp.minimum(lo + 1, i - 1)
-        frac = (jnp.maximum(src, 0.0) - lo) if align_flag else r * k - lo
-        return lo, hi, frac
-
     xt = x if ndhwc else jnp.transpose(x, (0, 2, 3, 4, 1))  # N D H W C
-    d0, d1, fd = axis_idx(ratio(d, od), od, d)
-    y0, y1, fy = axis_idx(ratio(h, oh), oh, h)
-    x0, x1, fx = axis_idx(ratio(w, ow), ow, w)
+    d0, d1, fd = _interp_axis_idx(_interp_ratio(d, od, align_corners),
+                                  od, d, align_flag)
+    y0, y1, fy = _interp_axis_idx(_interp_ratio(h, oh, align_corners),
+                                  oh, h, align_flag)
+    x0, x1, fx = _interp_axis_idx(_interp_ratio(w, ow, align_corners),
+                                  ow, w, align_flag)
     fd = fd[None, :, None, None, None]
     fy = fy[None, None, :, None, None]
     fx = fx[None, None, None, :, None]
