@@ -1,0 +1,153 @@
+"""Fifth tranche of numeric contracts: activation constants (the
+slope/offset/scale/beta families where silent divergence is easiest),
+cumsum modes, norm-family statistics, and similarity/distance formulas
+(reference activation_op.h / cum_op.h / *_norm_op.cc)."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(17)
+
+
+def _one(op, x, attrs=None, slot="Out"):
+    return np.asarray(run_op(op, {"X": np.asarray(x, np.float32)},
+                             attrs or {})[slot][0])
+
+
+class TestActivationConstants:
+    X = np.array([-3.0, -0.4, 0.0, 0.4, 3.0], np.float32)
+
+    def test_hard_sigmoid(self):
+        # activation_op.h HardSigmoid: clip(slope*x + offset, 0, 1)
+        got = _one("hard_sigmoid", self.X, {"slope": 0.2, "offset": 0.5})
+        np.testing.assert_allclose(got, np.clip(0.2 * self.X + 0.5, 0, 1),
+                                   rtol=1e-6)
+
+    def test_hard_swish(self):
+        # x * clip(x + offset, 0, threshold) / scale, defaults 3/6/6
+        got = _one("hard_swish", self.X)
+        want = self.X * np.clip(self.X + 3.0, 0, 6.0) / 6.0
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_selu_constants(self):
+        got = _one("selu", self.X)
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        want = scale * np.where(self.X > 0, self.X,
+                                alpha * (np.exp(self.X) - 1))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_softplus_beta_threshold(self):
+        # softplus v1: log(1+exp(beta*x))/beta, linear past threshold
+        x = np.array([-1.0, 0.5, 15.0], np.float32)
+        got = _one("softplus", x, {"beta": 2.0, "threshold": 20.0})
+        want = np.where(2.0 * x > 20.0, x,
+                        np.log1p(np.exp(2.0 * x)) / 2.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # linear branch engages exactly past threshold/beta
+        big = np.array([30.0], np.float32)
+        np.testing.assert_allclose(_one("softplus", big, {"beta": 2.0}),
+                                   big, rtol=1e-6)
+
+    def test_swish_beta(self):
+        got = _one("swish", self.X, {"beta": 2.0})
+        want = self.X / (1 + np.exp(-2.0 * self.X))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_mish(self):
+        got = _one("mish", self.X)
+        want = self.X * np.tanh(np.log1p(np.exp(self.X)))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_softshrink_thresholded_relu_stanh(self):
+        got = _one("softshrink", self.X, {"lambda": 0.5})
+        want = np.where(np.abs(self.X) > 0.5,
+                        self.X - np.sign(self.X) * 0.5, 0.0)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        got = _one("thresholded_relu", self.X, {"threshold": 1.0})
+        np.testing.assert_allclose(got, np.where(self.X > 1.0, self.X, 0),
+                                   rtol=1e-6)
+        got = _one("stanh", self.X, {"scale_a": 0.67, "scale_b": 1.7159})
+        np.testing.assert_allclose(got, 1.7159 * np.tanh(0.67 * self.X),
+                                   rtol=1e-5)
+
+
+class TestCumsumModes:
+    def test_exclusive_reverse(self):
+        x = np.array([[1.0, 2.0, 3.0]], np.float32)
+        np.testing.assert_allclose(
+            _one("cumsum", x, {"axis": 1}), [[1, 3, 6]])
+        np.testing.assert_allclose(
+            _one("cumsum", x, {"axis": 1, "exclusive": True}),
+            [[0, 1, 3]])
+        np.testing.assert_allclose(
+            _one("cumsum", x, {"axis": 1, "reverse": True}),
+            [[6, 5, 3]])
+        np.testing.assert_allclose(
+            _one("cumsum", x, {"axis": 1, "exclusive": True,
+                               "reverse": True}),
+            [[5, 3, 0]])
+        np.testing.assert_allclose(
+            _one("cumsum", x, {"flatten": True}), [1, 3, 6])
+
+
+class TestNormFamily:
+    def test_instance_norm(self):
+        x = R.randn(2, 3, 4, 4).astype("float32")
+        out = run_op("instance_norm", {"X": x,
+                                       "Scale": np.ones(3, np.float32),
+                                       "Bias": np.zeros(3, np.float32)},
+                     {"epsilon": 1e-5})
+        got = np.asarray(out["Y"][0])
+        m = x.mean(axis=(2, 3), keepdims=True)
+        v = x.var(axis=(2, 3), keepdims=True)
+        np.testing.assert_allclose(got, (x - m) / np.sqrt(v + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_group_norm(self):
+        x = R.randn(2, 4, 3, 3).astype("float32")
+        out = run_op("group_norm", {"X": x,
+                                    "Scale": np.ones(4, np.float32),
+                                    "Bias": np.zeros(4, np.float32)},
+                     {"groups": 2, "epsilon": 1e-5})
+        got = np.asarray(out["Y"][0])
+        xr = x.reshape(2, 2, 2, 3, 3)
+        m = xr.mean(axis=(2, 3, 4), keepdims=True)
+        v = xr.var(axis=(2, 3, 4), keepdims=True)
+        want = ((xr - m) / np.sqrt(v + 1e-5)).reshape(x.shape)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_clip_by_norm(self):
+        x = np.array([[3.0, 4.0]], np.float32)      # norm 5
+        got = _one("clip_by_norm", x, {"max_norm": 1.0})
+        np.testing.assert_allclose(got, x / 5.0, rtol=1e-5)
+        small = np.array([[0.3, 0.4]], np.float32)  # norm 0.5 <= max
+        np.testing.assert_allclose(
+            _one("clip_by_norm", small, {"max_norm": 1.0}), small,
+            rtol=1e-6)
+
+
+class TestSimilarity:
+    def test_cos_sim(self):
+        x = R.randn(3, 5).astype("float32")
+        y = R.randn(3, 5).astype("float32")
+        out = run_op("cos_sim", {"X": x, "Y": y})
+        got = np.asarray(out["Out"][0]).ravel()
+        want = (x * y).sum(1) / (np.linalg.norm(x, axis=1)
+                                 * np.linalg.norm(y, axis=1))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_squared_l2_distance(self):
+        x = R.randn(3, 4).astype("float32")
+        y = R.randn(3, 4).astype("float32")
+        out = run_op("squared_l2_distance", {"X": x, "Y": y})
+        got = np.asarray(out["Out"][0]).ravel()
+        np.testing.assert_allclose(got, ((x - y) ** 2).sum(1), rtol=1e-4)
+
+    def test_squared_l2_norm(self):
+        x = R.randn(3, 4).astype("float32")
+        out = run_op("squared_l2_norm", {"X": x})
+        np.testing.assert_allclose(
+            float(np.asarray(out["Out"][0]).ravel()[0]),
+            (x ** 2).sum(), rtol=1e-4)
