@@ -1,0 +1,200 @@
+"""Fourth tranche of numeric contracts: the classic divergence traps —
+interpolate alignment modes, average-pool exclusivity, paddle's
+elementwise broadcast-axis semantics, LRN, and the scalar loss formulas
+(reference op files cited per test)."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(9)
+
+
+class TestInterpNumeric:
+    def test_bilinear_align_corners_exact(self):
+        # interpolate_op.h align_corners: src = dst*(H_in-1)/(H_out-1)
+        x = np.array([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32)
+        out = run_op("bilinear_interp", {"X": x},
+                     {"out_h": 3, "out_w": 3, "align_corners": True})
+        got = np.asarray(out["Out"][0])[0, 0]
+        want = np.array([[0, 0.5, 1], [1, 1.5, 2], [2, 2.5, 3]],
+                        np.float32)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_bilinear_half_pixel(self):
+        # align_corners=False, align_mode=0: src = (dst+0.5)*scale - 0.5
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+        out = run_op("bilinear_interp", {"X": x},
+                     {"out_h": 1, "out_w": 8, "align_corners": False,
+                      "align_mode": 0})
+        got = np.asarray(out["Out"][0]).ravel()
+        src = (np.arange(8) + 0.5) * 0.5 - 0.5
+        src = np.clip(src, 0, 3)
+        lo = np.floor(src).astype(int)
+        hi = np.minimum(lo + 1, 3)
+        f = src - lo
+        want = x.ravel()[lo] * (1 - f) + x.ravel()[hi] * f
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_bilinear_align_mode1_origin(self):
+        # align_corners=False + align_mode=1 (the fluid DEFAULT):
+        # src = dst * ratio, origin-aligned — not half-pixel
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+        out = run_op("bilinear_interp", {"X": x},
+                     {"out_h": 1, "out_w": 8, "align_corners": False,
+                      "align_mode": 1})
+        got = np.asarray(out["Out"][0]).ravel()
+        src = np.arange(8) * 0.5
+        lo = np.floor(src).astype(int)
+        hi = np.minimum(lo + 1, 3)
+        f = src - lo
+        want = x.ravel()[lo] * (1 - f) + x.ravel()[hi] * f
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_nearest_downscale_origin_aligned(self):
+        # nearest_interp_op.h align_corners=False: src = floor(dst*ratio)
+        # (origin-aligned, NOT half-pixel) — downscale 4->2 must pick
+        # rows/cols 0 and 2
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = run_op("nearest_interp", {"X": x},
+                     {"out_h": 2, "out_w": 2, "align_corners": False})
+        got = np.asarray(out["Out"][0])[0, 0]
+        want = np.array([[0, 2], [8, 10]], np.float32)
+        np.testing.assert_allclose(got, want)
+
+    def test_nearest_align_corners_downscale(self):
+        # align_corners=True nearest: src = round(dst*(H_in-1)/(H_out-1))
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = run_op("nearest_interp", {"X": x},
+                     {"out_h": 2, "out_w": 2, "align_corners": True})
+        got = np.asarray(out["Out"][0])[0, 0]
+        want = np.array([[0, 3], [12, 15]], np.float32)
+        np.testing.assert_allclose(got, want)
+
+
+    def test_bicubic_align_corners_exact_at_corners(self):
+        # bicubic with corner alignment must reproduce input corners
+        x = R.randn(1, 1, 4, 4).astype("float32")
+        out = run_op("bicubic_interp", {"X": x},
+                     {"out_h": 7, "out_w": 7, "align_corners": True})
+        got = np.asarray(out["Out"][0])[0, 0]
+        np.testing.assert_allclose(got[0, 0], x[0, 0, 0, 0], atol=1e-5)
+        np.testing.assert_allclose(got[-1, -1], x[0, 0, -1, -1],
+                                   atol=1e-5)
+        np.testing.assert_allclose(got[0, -1], x[0, 0, 0, -1], atol=1e-5)
+        # and at even grid points it passes through the input samples
+        np.testing.assert_allclose(got[::2, ::2], x[0, 0], atol=1e-5)
+
+    def test_trilinear_align_corners(self):
+        # 5D NCDHW, corner-aligned: doubles every axis exactly on corners
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+        out = run_op("trilinear_interp", {"X": x},
+                     {"out_d": 3, "out_h": 3, "out_w": 3,
+                      "align_corners": True})
+        got = np.asarray(out["Out"][0])[0, 0]
+        assert got.shape == (3, 3, 3)
+        np.testing.assert_allclose(got[0, 0, 0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(got[2, 2, 2], 7.0, atol=1e-6)
+        # centre of the cube is the mean of all 8 corners
+        np.testing.assert_allclose(got[1, 1, 1], x.mean(), atol=1e-6)
+
+
+class TestPoolNumeric:
+    def test_avg_pool_exclusive_vs_inclusive(self):
+        # pool_op.h exclusive: padded cells excluded from the divisor
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out_ex = run_op("pool2d", {"X": x},
+                        {"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [1, 1], "pooling_type": "avg",
+                         "exclusive": True})
+        out_in = run_op("pool2d", {"X": x},
+                        {"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [1, 1], "pooling_type": "avg",
+                         "exclusive": False})
+        # each 2x2 window at a corner covers exactly 1 real cell
+        np.testing.assert_allclose(np.asarray(out_ex["Out"][0]).ravel(),
+                                   [1, 1, 1, 1], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_in["Out"][0]).ravel(),
+                                   [0.25] * 4, atol=1e-6)
+
+    def test_lrn_formula(self):
+        # lrn_op.cc: mid = k + alpha * sum_{n-window} x^2; out = x/mid^beta
+        x = R.randn(1, 6, 2, 2).astype("float32")
+        out = run_op("lrn", {"X": x}, {"n": 5, "k": 2.0, "alpha": 1e-4,
+                                       "beta": 0.75})
+        got = np.asarray(out["Out"][0])
+        sq = np.square(x)
+        want = np.empty_like(x)
+        for c in range(6):
+            lo, hi = max(0, c - 2), min(6, c + 3)
+            mid = 2.0 + 1e-4 * sq[:, lo:hi].sum(axis=1)
+            want[:, c] = x[:, c] / mid ** 0.75
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestElementwiseAxis:
+    def test_broadcast_axis_semantics(self):
+        # elementwise_op.h: y's dims align to x starting at `axis`
+        x = R.randn(2, 3, 4).astype("float32")
+        y = R.randn(3).astype("float32")
+        out = run_op("elementwise_add", {"X": x, "Y": y}, {"axis": 1})
+        want = x + y[None, :, None]
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want,
+                                   rtol=1e-6)
+        out2 = run_op("elementwise_mul", {"X": x, "Y": y}, {"axis": 1})
+        np.testing.assert_allclose(np.asarray(out2["Out"][0]),
+                                   x * y[None, :, None], rtol=1e-6)
+
+    def test_axis_minus_one_trailing(self):
+        x = R.randn(2, 3, 4).astype("float32")
+        y = R.randn(4).astype("float32")
+        out = run_op("elementwise_sub", {"X": x, "Y": y}, {"axis": -1})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), x - y,
+                                   rtol=1e-6)
+
+
+class TestScalarLosses:
+    def test_log_loss(self):
+        p = np.array([[0.3], [0.9]], np.float32)
+        y = np.array([[1.0], [0.0]], np.float32)
+        eps = 1e-4
+        out = run_op("log_loss", {"Predicted": p, "Labels": y},
+                     {"epsilon": eps})
+        want = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+        np.testing.assert_allclose(np.asarray(out["Loss"][0]), want,
+                                   rtol=1e-5)
+
+    def test_huber_loss(self):
+        x = np.array([[0.0], [0.0]], np.float32)   # prediction
+        y = np.array([[0.5], [3.0]], np.float32)   # label
+        out = run_op("huber_loss", {"X": x, "Y": y}, {"delta": 1.0})
+        got = np.asarray(out["Out"][0]).ravel()
+        # |r|<=delta: 0.5 r^2; else delta(|r| - delta/2)
+        np.testing.assert_allclose(got, [0.125, 2.5], rtol=1e-6)
+
+    def test_smooth_l1(self):
+        # smooth_l1_loss_op.h: sigma2 scaling, per-ROW summed loss
+        x = np.array([[0.0, 0.0]], np.float32)
+        y = np.array([[0.3, 2.0]], np.float32)
+        out = run_op("smooth_l1_loss", {"X": x, "Y": y}, {"sigma": 1.0})
+        got = float(np.asarray(out["Out"][0]).ravel()[0])
+        want = 0.5 * 0.3 ** 2 + (2.0 - 0.5)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_kldiv_loss_batchmean(self):
+        # kldiv_loss_op.h: input is LOG-prob; batchmean divides by N
+        logp = np.log(np.array([[0.5, 0.5], [0.25, 0.75]], np.float32))
+        t = np.array([[0.4, 0.6], [0.5, 0.5]], np.float32)
+        out = run_op("kldiv_loss", {"X": logp, "Target": t},
+                     {"reduction": "batchmean"})
+        want = (t * (np.log(t) - logp)).sum() / 2
+        np.testing.assert_allclose(float(np.asarray(out["Loss"][0])),
+                                   want, rtol=1e-5)
+
+    def test_label_smooth(self):
+        x = np.array([[1.0, 0.0, 0.0]], np.float32)
+        out = run_op("label_smooth", {"X": x}, {"epsilon": 0.1})
+        want = (1 - 0.1) * x + 0.1 / 3
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want,
+                                   rtol=1e-5)
