@@ -1,0 +1,140 @@
+"""Sixth tranche of numeric contracts: padding modes, prelu modes, the
+unfold (im2col) patch layout, and the linalg tail (p_norm/dist/addmm/
+trace/cross/kron) against numpy references."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(23)
+
+
+class TestPaddingModes:
+    def test_pad2d_reflect_edge_constant(self):
+        x = np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3)
+        got = np.asarray(run_op("pad2d", {"X": x},
+                                {"paddings": [1, 1, 1, 1],
+                                 "mode": "constant", "pad_value": 7.0})
+                         ["Out"][0])
+        want = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)],
+                      constant_values=7.0)
+        np.testing.assert_allclose(got, want)
+        for mode in ("reflect", "edge"):
+            got = np.asarray(run_op("pad2d", {"X": x},
+                                    {"paddings": [1, 1, 1, 1],
+                                     "mode": mode})["Out"][0])
+            want = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)], mode=mode)
+            np.testing.assert_allclose(got, want, err_msg=mode)
+
+    def test_pad2d_nhwc(self):
+        x = R.randn(1, 3, 3, 2).astype("float32")
+        got = np.asarray(run_op("pad2d", {"X": x},
+                                {"paddings": [1, 0, 0, 1],
+                                 "mode": "constant",
+                                 "data_format": "NHWC"})["Out"][0])
+        want = np.pad(x, [(0, 0), (1, 0), (0, 1), (0, 0)])
+        np.testing.assert_allclose(got, want)
+
+
+class TestPrelu:
+    def test_modes(self):
+        x = R.randn(2, 3, 2, 2).astype("float32")
+        # all: one shared alpha
+        a = np.array([0.25], np.float32)
+        got = np.asarray(run_op("prelu", {"X": x, "Alpha": a},
+                                {"mode": "all"})["Out"][0])
+        np.testing.assert_allclose(got, np.where(x > 0, x, 0.25 * x),
+                                   rtol=1e-6)
+        # channel: per-channel alphas broadcast over HW
+        ac = np.array([0.1, 0.2, 0.3], np.float32)
+        got = np.asarray(run_op("prelu", {"X": x, "Alpha": ac},
+                                {"mode": "channel"})["Out"][0])
+        want = np.where(x > 0, x, ac[None, :, None, None] * x)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        # element: full-shape alpha
+        ae = np.abs(R.randn(1, 3, 2, 2)).astype("float32")
+        got = np.asarray(run_op("prelu", {"X": x, "Alpha": ae},
+                                {"mode": "element"})["Out"][0])
+        np.testing.assert_allclose(got, np.where(x > 0, x, ae * x),
+                                   rtol=1e-6)
+
+
+class TestUnfold:
+    def test_im2col_layout(self):
+        # unfold_op.h: output [N, C*kh*kw, L], patches column-major over
+        # output positions, channel-major over the C*kh*kw axis
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        got = np.asarray(run_op("unfold", {"X": x},
+                                {"kernel_sizes": [2, 2],
+                                 "strides": [2, 2], "paddings": [0, 0],
+                                 "dilations": [1, 1]})["Y"][0])
+        assert got.shape == (1, 4, 4)
+        # patch at (0,0): values 0,1,4,5 down the C*kh*kw axis
+        np.testing.assert_allclose(got[0, :, 0], [0, 1, 4, 5])
+        # patch order: (0,0),(0,2),(2,0),(2,2) row-major positions
+        np.testing.assert_allclose(got[0, :, 3], [10, 11, 14, 15])
+
+
+class TestLinalgTail:
+    def test_p_norm(self):
+        x = R.randn(3, 4).astype("float32")
+        for p in (1.0, 2.0, 3.0):
+            got = np.asarray(run_op("p_norm", {"X": x},
+                                    {"porder": p, "axis": 1})["Out"][0])
+            want = (np.abs(x) ** p).sum(1) ** (1 / p)
+            np.testing.assert_allclose(got, want, rtol=1e-4, err_msg=p)
+
+    def test_dist(self):
+        x = R.randn(3, 4).astype("float32")
+        y = R.randn(3, 4).astype("float32")
+        for p in (0.0, 1.0, 2.0, float("inf")):
+            got = float(np.asarray(run_op("dist", {"X": x, "Y": y},
+                                          {"p": p})["Out"][0])
+                        .ravel()[0])
+            d = (x - y).ravel()
+            if p == 0:
+                want = float((d != 0).sum())
+            elif p == float("inf"):
+                want = float(np.abs(d).max())
+            else:
+                want = float((np.abs(d) ** p).sum() ** (1 / p))
+            np.testing.assert_allclose(got, want, rtol=1e-4, err_msg=p)
+
+    def test_addmm_alpha_beta(self):
+        inp = R.randn(2, 3).astype("float32")
+        x = R.randn(2, 4).astype("float32")
+        y = R.randn(4, 3).astype("float32")
+        got = np.asarray(run_op("addmm",
+                                {"Input": inp, "X": x, "Y": y},
+                                {"Alpha": 2.0, "Beta": 0.5})["Out"][0])
+        np.testing.assert_allclose(got, 0.5 * inp + 2.0 * (x @ y),
+                                   rtol=1e-4)
+
+    def test_trace_offset_axes(self):
+        x = R.randn(2, 3, 3).astype("float32")
+        got = np.asarray(run_op("trace", {"Input": x},
+                                {"offset": 1, "axis1": 1, "axis2": 2})
+                         ["Out"][0])
+        np.testing.assert_allclose(
+            got, np.trace(x, offset=1, axis1=1, axis2=2), rtol=1e-5)
+
+    def test_cross_kron(self):
+        x = R.randn(2, 3).astype("float32")
+        y = R.randn(2, 3).astype("float32")
+        got = np.asarray(run_op("cross", {"X": x, "Y": y}, {"dim": -1})
+                         ["Out"][0])
+        np.testing.assert_allclose(got, np.cross(x, y), rtol=1e-5)
+        a = R.randn(2, 2).astype("float32")
+        b = R.randn(3, 2).astype("float32")
+        got = np.asarray(run_op("kron", {"X": a, "Y": b})["Out"][0])
+        np.testing.assert_allclose(got, np.kron(a, b), rtol=1e-5)
+
+    def test_one_hot_out_of_range(self):
+        ids = np.array([[1], [5]], np.int64)
+        out = run_op("one_hot", {"X": ids},
+                     {"depth": 3, "allow_out_of_range": True})
+        got = np.asarray(out["Out"][0])
+        # out-of-range rows are all-zero when allowed (one_hot_op.h)
+        np.testing.assert_allclose(got[0].ravel()[:3], [0, 1, 0])
+        np.testing.assert_allclose(got[1].ravel()[:3], [0, 0, 0])
