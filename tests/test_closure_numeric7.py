@@ -1,0 +1,183 @@
+"""Seventh tranche of numeric contracts: optimizer update rules pinned
+step-by-step against the reference kernel formulas (operators/optimizers/
+*_op.h).  Epsilon placement, bias-correction form, and nesterov blending
+are where implementations silently drift — each test recomputes one
+update in numpy and compares every output slot."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(31)
+LR = np.array([0.1], np.float32)
+
+
+def _arr(*s):
+    return R.randn(*s).astype("float32")
+
+
+class TestAdamFamily:
+    def test_adam_update(self):
+        p, g = _arr(4), _arr(4)
+        m, v = _arr(4) * 0.1, np.abs(_arr(4)) * 0.1
+        b1p = np.array([0.9 ** 3], np.float32)
+        b2p = np.array([0.999 ** 3], np.float32)
+        out = run_op("adam", {"Param": p, "Grad": g, "Moment1": m,
+                              "Moment2": v, "Beta1Pow": b1p,
+                              "Beta2Pow": b2p, "LearningRate": LR},
+                     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+        m2 = 0.9 * m + 0.1 * g
+        v2 = 0.999 * v + 0.001 * g * g
+        # adam_op.h: lr_t = lr*sqrt(1-b2^t)/(1-b1^t); eps OUTSIDE sqrt
+        lr_t = 0.1 * np.sqrt(1 - b2p[0]) / (1 - b1p[0])
+        want_p = p - lr_t * m2 / (np.sqrt(v2) + 1e-8)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want_p,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["Moment1Out"][0]), m2,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["Moment2Out"][0]), v2,
+                                   rtol=1e-5)
+        # Beta*Pow advance by one factor
+        np.testing.assert_allclose(
+            float(np.asarray(out["Beta1PowOut"][0]).ravel()[0]),
+            b1p[0] * 0.9, rtol=1e-6)
+
+    def test_lamb_trust_ratio(self):
+        p, g = _arr(6), _arr(6)
+        m = np.zeros(6, np.float32)
+        v = np.zeros(6, np.float32)
+        one = np.array([1.0], np.float32)
+        out = run_op("lamb", {"Param": p, "Grad": g, "Moment1": m,
+                              "Moment2": v, "Beta1Pow": one * 0.9,
+                              "Beta2Pow": one * 0.999,
+                              "LearningRate": LR},
+                     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
+                      "weight_decay": 0.01})
+        m2 = 0.1 * g
+        v2 = 0.001 * g * g
+        r = (m2 / (1 - 0.9)) / (np.sqrt(v2 / (1 - 0.999)) + 1e-6) \
+            + 0.01 * p
+        ratio = np.linalg.norm(p) / np.linalg.norm(r)
+        want = p - 0.1 * ratio * r
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want,
+                                   rtol=1e-4)
+
+
+class TestMomentumFamily:
+    def test_momentum_plain_and_nesterov(self):
+        p, g, v = _arr(4), _arr(4), _arr(4) * 0.1
+        out = run_op("momentum", {"Param": p, "Grad": g, "Velocity": v,
+                                  "LearningRate": LR}, {"mu": 0.9})
+        v2 = 0.9 * v + g
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                                   p - 0.1 * v2, rtol=1e-5)
+        out = run_op("momentum", {"Param": p, "Grad": g, "Velocity": v,
+                                  "LearningRate": LR},
+                     {"mu": 0.9, "use_nesterov": True})
+        # momentum_op.h nesterov: p -= lr * (g + mu * v_new)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                                   p - 0.1 * (g + 0.9 * v2), rtol=1e-5)
+
+    def test_momentum_v1_regularization(self):
+        # the momentum v1 checkpoint attrs: l2_decay folds into the grad
+        p, g, v = _arr(4), _arr(4), np.zeros(4, np.float32)
+        out = run_op("momentum", {"Param": p, "Grad": g, "Velocity": v,
+                                  "LearningRate": LR},
+                     {"mu": 0.9, "regularization_method": "l2_decay",
+                      "regularization_coeff": 0.5})
+        v2 = g + 0.5 * p
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                                   p - 0.1 * v2, rtol=1e-5)
+
+    def test_lars_local_lr(self):
+        p = np.full(4, 2.0, np.float32)
+        g = np.full(4, 1.0, np.float32)
+        v = np.zeros(4, np.float32)
+        out = run_op("lars_momentum",
+                     {"Param": p, "Grad": g, "Velocity": v,
+                      "LearningRate": LR},
+                     {"mu": 0.9, "lars_coeff": 0.001,
+                      "lars_weight_decay": 0.0005})
+        pn, gn = np.linalg.norm(p), np.linalg.norm(g)
+        local = 0.001 * pn / (gn + 0.0005 * pn)
+        v2 = 0.1 * local * (g + 0.0005 * p)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), p - v2,
+                                   rtol=1e-5)
+
+
+class TestAdaptiveFamily:
+    def test_adagrad_eps_outside_sqrt(self):
+        p, g = _arr(4), _arr(4)
+        mom = np.abs(_arr(4))
+        out = run_op("adagrad", {"Param": p, "Grad": g, "Moment": mom,
+                                 "LearningRate": LR}, {"epsilon": 1e-6})
+        m2 = mom + g * g
+        want = p - 0.1 * g / (np.sqrt(m2) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want,
+                                   rtol=1e-5)
+
+    def test_rmsprop_eps_inside_sqrt(self):
+        # rmsprop_op.h: denom = sqrt(ms_new + eps) — eps INSIDE
+        p, g = _arr(4), _arr(4)
+        ms, mom = np.abs(_arr(4)), _arr(4) * 0.1
+        out = run_op("rmsprop", {"Param": p, "Grad": g, "MeanSquare": ms,
+                                 "Moment": mom, "LearningRate": LR},
+                     {"decay": 0.95, "epsilon": 1e-6, "momentum": 0.8})
+        ms2 = 0.95 * ms + 0.05 * g * g
+        mom2 = 0.8 * mom + 0.1 * g / np.sqrt(ms2 + 1e-6)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                                   p - mom2, rtol=1e-5)
+
+    def test_rmsprop_centered(self):
+        p, g = _arr(4), _arr(4)
+        ms, mom, mg = np.abs(_arr(4)), _arr(4) * 0.1, _arr(4) * 0.1
+        out = run_op("rmsprop", {"Param": p, "Grad": g, "MeanSquare": ms,
+                                 "Moment": mom, "MeanGrad": mg,
+                                 "LearningRate": LR},
+                     {"decay": 0.95, "epsilon": 1e-6, "momentum": 0.8,
+                      "centered": True})
+        ms2 = 0.95 * ms + 0.05 * g * g
+        mg2 = 0.95 * mg + 0.05 * g
+        mom2 = 0.8 * mom + 0.1 * g / np.sqrt(ms2 - mg2 * mg2 + 1e-6)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                                   p - mom2, rtol=1e-4)
+
+    def test_ftrl(self):
+        # ftrl_op.h with lr_power=-0.5
+        p = _arr(4)
+        g = _arr(4)
+        sq = np.abs(_arr(4)) + 0.5
+        lin = _arr(4) * 0.1
+        l1, l2, lr = 0.1, 0.2, 0.1
+        out = run_op("ftrl", {"Param": p, "Grad": g,
+                              "SquaredAccumulator": sq,
+                              "LinearAccumulator": lin,
+                              "LearningRate": np.array([lr], np.float32)},
+                     {"l1": l1, "l2": l2, "lr_power": -0.5})
+        sq2 = sq + g * g
+        sigma = (np.sqrt(sq2) - np.sqrt(sq)) / lr
+        lin2 = lin + g - sigma * p
+        quad = np.sqrt(sq2) / lr + 2 * l2
+        want = np.where(np.abs(lin2) > l1,
+                        (np.clip(lin2, -l1, l1) - lin2) / quad, 0.0)
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(out["SquaredAccumOut"][0]), sq2, rtol=1e-5)
+
+    def test_adadelta(self):
+        p, g = _arr(4), _arr(4)
+        avg_sq = np.abs(_arr(4))
+        avg_upd = np.abs(_arr(4)) * 0.1
+        out = run_op("adadelta",
+                     {"Param": p, "Grad": g, "AvgSquaredGrad": avg_sq,
+                      "AvgSquaredUpdate": avg_upd},
+                     {"rho": 0.95, "epsilon": 1e-6})
+        sq2 = 0.95 * avg_sq + 0.05 * g * g
+        upd = -np.sqrt((avg_upd + 1e-6) / (sq2 + 1e-6)) * g
+        upd2 = 0.95 * avg_upd + 0.05 * upd * upd
+        np.testing.assert_allclose(np.asarray(out["ParamOut"][0]),
+                                   p + upd, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(out["AvgSquaredUpdateOut"][0]), upd2, rtol=1e-4)
