@@ -1,0 +1,120 @@
+"""Eighth tranche: CRF and CTC numerics against BRUTE-FORCE references —
+linear_chain_crf's log-partition by path enumeration, crf_decoding by
+exhaustive viterbi, warpctc by summing every collapsing alignment, and
+ctc_align greedy decode (reference linear_chain_crf_op.h,
+crf_decoding_op.h, warpctc_op.cc, ctc_align_op.cu)."""
+import itertools
+
+import numpy as np
+
+from op_test import run_op
+
+
+R = np.random.RandomState(41)
+
+
+def _crf_path_score(em, start, stop, trans, path):
+    s = start[path[0]] + em[0, path[0]]
+    for t in range(1, len(path)):
+        s += trans[path[t - 1], path[t]] + em[t, path[t]]
+    return s + stop[path[-1]]
+
+
+class TestCrf:
+    def setup_method(self, _):
+        self.T, self.D = 3, 2
+        self.em = R.randn(1, self.T, self.D).astype("float32")
+        tr = R.randn(2 + self.D, self.D).astype("float32")
+        self.trans = tr
+        self.start, self.stop, self.tmat = tr[0], tr[1], tr[2:]
+
+    def test_log_likelihood_matches_enumeration(self):
+        label = np.array([[1, 0, 1]], np.int64)
+        out = run_op("linear_chain_crf",
+                     {"Emission": self.em, "Transition": self.trans,
+                      "Label": label[..., None]}, {})
+        ll = float(np.asarray(out["LogLikelihood"][0]).ravel()[0])
+        scores = [_crf_path_score(self.em[0], self.start, self.stop,
+                                  self.tmat, p)
+                  for p in itertools.product(range(self.D),
+                                             repeat=self.T)]
+        log_z = np.logaddexp.reduce(scores)
+        want = log_z - _crf_path_score(self.em[0], self.start, self.stop,
+                                       self.tmat, label[0])
+        np.testing.assert_allclose(ll, want, rtol=1e-4)
+
+    def test_decoding_matches_exhaustive_viterbi(self):
+        out = run_op("crf_decoding",
+                     {"Emission": self.em, "Transition": self.trans}, {})
+        got = np.asarray(out["ViterbiPath"][0]).ravel()[:self.T]
+        best = max(itertools.product(range(self.D), repeat=self.T),
+                   key=lambda p: _crf_path_score(
+                       self.em[0], self.start, self.stop, self.tmat, p))
+        np.testing.assert_array_equal(got, best)
+
+
+def _ctc_brute(logits, label, blank=0):
+    """-log P(label) by enumerating every frame path that collapses to
+    the label (remove repeats, then blanks)."""
+    t, c = logits.shape
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t):
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(label):
+            pr = 1.0
+            for i, s in enumerate(path):
+                pr *= p[i, s]
+            total += pr
+    return -np.log(total)
+
+
+class TestCtc:
+    def test_warpctc_matches_brute_force(self):
+        T, C = 4, 3
+        logits = R.randn(1, T, C).astype("float32")
+        label = np.array([[1, 2]], np.int64)
+        out = run_op("warpctc", {"Logits": logits, "Label": label},
+                     {"blank": 0})
+        got = float(np.asarray(out["Loss"][0]).ravel()[0])
+        want = _ctc_brute(logits[0], [1, 2])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_warpctc_repeated_label(self):
+        # repeats force a blank between them — the skip_ok gate
+        T, C = 5, 3
+        logits = R.randn(1, T, C).astype("float32")
+        label = np.array([[1, 1]], np.int64)
+        out = run_op("warpctc", {"Logits": logits, "Label": label},
+                     {"blank": 0})
+        got = float(np.asarray(out["Loss"][0]).ravel()[0])
+        np.testing.assert_allclose(got, _ctc_brute(logits[0], [1, 1]),
+                                   rtol=1e-4)
+
+    def test_warpctc_empty_label(self):
+        T, C = 3, 2
+        logits = R.randn(1, T, C).astype("float32")
+        label = np.zeros((1, 1), np.int64)      # all-blank label
+        out = run_op("warpctc", {"Logits": logits, "Label": label,
+                                 "LabelLength": np.array([0], np.int64)},
+                     {"blank": 0})
+        got = float(np.asarray(out["Loss"][0]).ravel()[0])
+        # only the all-blank path survives
+        logp = logits[0] - np.log(np.exp(logits[0]).sum(-1,
+                                                        keepdims=True))
+        np.testing.assert_allclose(got, -logp[:, 0].sum(), rtol=1e-4)
+
+    def test_ctc_align_greedy(self):
+        # ctc_align: merge repeats then drop blanks, zero-pad
+        x = np.array([[0, 1, 1, 0, 2, 2, 0]], np.int64)
+        out = run_op("ctc_align", {"Input": x}, {"blank": 0})
+        got = np.asarray(out["Output"][0]).ravel()
+        np.testing.assert_array_equal(got[:2], [1, 2])
+        assert (got[2:] == 0).all()
