@@ -164,12 +164,13 @@ def _similarity_focus(ins, attrs, ctx):
 
 @register_op("lstm_unit")
 def _lstm_unit(ins, attrs, ctx):
-    """lstm_unit_op.cc: one cell step from pre-activations."""
+    """lstm_unit_op.h:62-73: one cell step from pre-activations; gate
+    layout along the 4H axis is i, f, o, g — candidate LAST."""
     x, c_prev = _p(ins, "X"), _p(ins, "C_prev")
     forget_bias = attrs.get("forget_bias", 0.0)
-    i, j, f, o = jnp.split(x, 4, axis=1)
+    i, f, o, g = jnp.split(x, 4, axis=1)
     new_c = (c_prev * jax.nn.sigmoid(f + forget_bias)
-             + jax.nn.sigmoid(i) * jnp.tanh(j))
+             + jax.nn.sigmoid(i) * jnp.tanh(g))
     new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
     return {"C": [new_c], "H": [new_h]}
 

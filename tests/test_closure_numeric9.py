@@ -1,0 +1,134 @@
+"""Ninth tranche: cross-entropy variants (soft labels, ignore_index),
+attention numerics vs a numpy transformer reference, and the remaining
+fused-op math (segment_pool, unpool, lstm_unit, frobenius_norm)."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(47)
+
+
+def softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis, keepdims=True))
+    return e / e.sum(axis, keepdims=True)
+
+
+class TestCrossEntropyVariants:
+    def test_hard_label_with_ignore_index(self):
+        logits = R.randn(4, 5).astype("float32")
+        label = np.array([[1], [3], [-100], [0]], np.int64)
+        out = run_op("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": label},
+                     {"ignore_index": -100})
+        got = np.asarray(out["Loss"][0]).ravel()
+        p = softmax(logits)
+        for i, l in enumerate([1, 3, None, 0]):
+            if l is None:
+                np.testing.assert_allclose(got[i], 0.0, atol=1e-6)
+            else:
+                np.testing.assert_allclose(got[i], -np.log(p[i, l]),
+                                           rtol=1e-4)
+
+    def test_soft_label(self):
+        logits = R.randn(3, 4).astype("float32")
+        soft = softmax(R.randn(3, 4).astype("float32"))
+        out = run_op("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": soft.astype("float32")},
+                     {"soft_label": True})
+        got = np.asarray(out["Loss"][0]).ravel()
+        want = -(soft * np.log(softmax(logits))).sum(-1)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_cross_entropy_prob_input(self):
+        # cross_entropy_op.h takes PROBABILITIES (not logits)
+        p = softmax(R.randn(3, 4).astype("float32"))
+        label = np.array([[0], [2], [1]], np.int64)
+        out = run_op("cross_entropy", {"X": p.astype("float32"),
+                                       "Label": label}, {})
+        got = np.asarray(out["Y"][0]).ravel()
+        want = -np.log(p[np.arange(3), label.ravel()])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_bce_and_sigmoid_ce(self):
+        x = np.clip(R.rand(3, 2).astype("float32"), 0.05, 0.95)
+        y = (R.rand(3, 2) > 0.5).astype("float32")
+        out = run_op("bce_loss", {"X": x, "Label": y}, {})
+        want = -(y * np.log(x) + (1 - y) * np.log(1 - x))
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want,
+                                   rtol=1e-4)
+        logits = R.randn(3, 2).astype("float32")
+        out = run_op("sigmoid_cross_entropy_with_logits",
+                     {"X": logits, "Label": y}, {})
+        want = np.maximum(logits, 0) - logits * y \
+            + np.log1p(np.exp(-np.abs(logits)))
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want,
+                                   rtol=1e-4)
+
+
+class TestAttentionNumeric:
+    def test_fused_multihead_matches_numpy(self):
+        B, T, H, D = 1, 4, 2, 6
+        q = R.randn(B, H, T, D // H).astype("float32")
+        k = R.randn(B, H, T, D // H).astype("float32")
+        v = R.randn(B, H, T, D // H).astype("float32")
+        out = run_op("fused_multihead_attention",
+                     {"Q": [q], "K": [k], "V": [v]}, {})
+        slot = [s for s in out if out[s]][0]
+        got = np.asarray(out[slot][0])
+        scale = (D // H) ** -0.5
+        att = softmax(np.einsum("bhtd,bhsd->bhts", q, k) * scale)
+        want = np.einsum("bhts,bhsd->bhtd", att, v)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedTail:
+    def test_segment_pool_sum_mean(self):
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        seg = np.array([0, 0, 1, 1], np.int64)
+        out = run_op("segment_pool", {"X": x, "SegmentIds": seg},
+                     {"pooltype": "SUM"})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   [[2, 4], [10, 12]])
+        out = run_op("segment_pool", {"X": x, "SegmentIds": seg},
+                     {"pooltype": "MEAN"})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   [[1, 2], [5, 6]])
+
+    def test_lstm_unit(self):
+        B, H = 2, 3
+        x = R.randn(B, 4 * H).astype("float32")
+        c = R.randn(B, H).astype("float32")
+        out = run_op("lstm_unit", {"X": x, "C_prev": c},
+                     {"forget_bias": 0.0})
+        i, f, o, j = (x[:, :H], x[:, H:2 * H], x[:, 2 * H:3 * H],
+                      x[:, 3 * H:])
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        # lstm_unit_op.h gate order i, f, o, j (candidate last)
+        c2 = sig(f) * c + sig(i) * np.tanh(j)
+        h2 = sig(o) * np.tanh(c2)
+        np.testing.assert_allclose(np.asarray(out["C"][0]), c2,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["H"][0]), h2,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_frobenius_norm(self):
+        x = R.randn(2, 3, 4).astype("float32")
+        out = run_op("frobenius_norm", {"X": x},
+                     {"dim": [1, 2], "keep_dim": False})
+        want = np.sqrt((x ** 2).sum(axis=(1, 2)))
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want,
+                                   rtol=1e-4)
+
+    def test_unpool(self):
+        # unpool_op.h: scatter pooled values back to argmax positions
+        x = np.array([[[[5.0]]]], np.float32)
+        idx = np.array([[[[3]]]], np.int64)   # flat position in 2x2
+        out = run_op("unpool", {"X": x, "Indices": idx},
+                     {"ksize": [2, 2], "strides": [2, 2],
+                      "unpooling_type": "max", "output_size": [2, 2]})
+        got = np.asarray(out["Out"][0]).reshape(2, 2)
+        np.testing.assert_allclose(got, [[0, 0], [0, 5.0]])
