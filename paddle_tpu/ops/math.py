@@ -54,7 +54,18 @@ _ew("elementwise_min", jnp.minimum)
 _ew("elementwise_max", jnp.maximum)
 _ew("elementwise_pow", jnp.power)
 _ew("elementwise_mod", jnp.mod)
-_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+def _trunc_div(a, b):
+    # elementwise_floordiv_op.h:38: trunc(a / b) — C-style division
+    # toward ZERO, not python floor (differs for negative operands)
+    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.integer) \
+            and jnp.issubdtype(jnp.asarray(b).dtype, jnp.integer):
+        return lax.div(a, b)
+    return jnp.trunc(a / b)
+
+
+_ew("elementwise_floordiv", _trunc_div)
 
 
 @register_op("sum")  # fluid sum op: variadic add (used for grad fan-in)

@@ -83,6 +83,29 @@ class TestAttentionNumeric:
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+class TestIntDivisionSemantics:
+    def test_floordiv_truncates_toward_zero(self):
+        # elementwise_floordiv_op.h:38: trunc(a/b), NOT python floor
+        a = np.array([-7, 7, -7, 7], np.int32)
+        b = np.array([2, 2, -2, -2], np.int32)
+        out = run_op("elementwise_floordiv", {"X": a, "Y": b}, {})
+        np.testing.assert_array_equal(np.asarray(out["Out"][0]),
+                                      [-3, 3, 3, -3])
+        af = a.astype(np.float32)
+        bf = b.astype(np.float32)
+        out = run_op("elementwise_floordiv", {"X": af, "Y": bf}, {})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   [-3, 3, 3, -3])
+
+    def test_mod_sign_of_divisor(self):
+        # elementwise_mod_op.h:27-30: result takes the DIVISOR's sign
+        a = np.array([-7, 7, -7, 7], np.int32)
+        b = np.array([3, 3, -3, -3], np.int32)
+        out = run_op("elementwise_mod", {"X": a, "Y": b}, {})
+        np.testing.assert_array_equal(np.asarray(out["Out"][0]),
+                                      [2, 1, -1, -2])
+
+
 class TestFusedTail:
     def test_segment_pool_sum_mean(self):
         x = np.arange(8, dtype=np.float32).reshape(4, 2)
@@ -124,11 +147,13 @@ class TestFusedTail:
                                    rtol=1e-4)
 
     def test_unpool(self):
-        # unpool_op.h: scatter pooled values back to argmax positions
+        # unpool_op.h: scatter pooled values back to argmax positions,
+        # target size from the unpooled_height/width attrs the op reads
         x = np.array([[[[5.0]]]], np.float32)
-        idx = np.array([[[[3]]]], np.int64)   # flat position in 2x2
+        idx = np.array([[[[5]]]], np.int64)   # flat position in 3x3
         out = run_op("unpool", {"X": x, "Indices": idx},
-                     {"ksize": [2, 2], "strides": [2, 2],
-                      "unpooling_type": "max", "output_size": [2, 2]})
-        got = np.asarray(out["Out"][0]).reshape(2, 2)
-        np.testing.assert_allclose(got, [[0, 0], [0, 5.0]])
+                     {"unpooled_height": 3, "unpooled_width": 3})
+        got = np.asarray(out["Out"][0]).reshape(3, 3)
+        want = np.zeros((3, 3), np.float32)
+        want[1, 2] = 5.0
+        np.testing.assert_allclose(got, want)
