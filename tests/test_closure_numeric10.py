@@ -1,0 +1,145 @@
+"""Tenth tranche: the convolution family against manual numpy loops
+(stride/padding/dilation/groups, transpose-conv, depthwise) and
+batch_norm's training-mode statistics contract (reference conv_op.h,
+conv_transpose_op.h, batch_norm_op.cc)."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(53)
+
+
+def conv2d_ref(x, w, stride, pad, dilation=1, groups=1):
+    """Direct NCHW cross-correlation."""
+    n, cin, h, ww = x.shape
+    cout, cin_g, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    eh = (kh - 1) * dilation + 1
+    ew = (kw - 1) * dilation + 1
+    oh = (h + 2 * pad - eh) // stride + 1
+    ow = (ww + 2 * pad - ew) // stride + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    cpg_out = cout // groups
+    for b in range(n):
+        for oc in range(cout):
+            gi = oc // cpg_out
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for ic in range(cin_g):
+                        for u in range(kh):
+                            for v in range(kw):
+                                acc += (xp[b, gi * cin_g + ic,
+                                           i * stride + u * dilation,
+                                           j * stride + v * dilation]
+                                        * w[oc, ic, u, v])
+                    out[b, oc, i, j] = acc
+    return out
+
+
+class TestConvFamily:
+    def test_conv2d_stride_pad(self):
+        x = R.randn(1, 2, 5, 5).astype("float32")
+        w = R.randn(3, 2, 3, 3).astype("float32")
+        out = run_op("conv2d", {"Input": x, "Filter": w},
+                     {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1})
+        np.testing.assert_allclose(np.asarray(out["Output"][0]),
+                                   conv2d_ref(x, w, 2, 1), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_conv2d_dilation(self):
+        x = R.randn(1, 1, 6, 6).astype("float32")
+        w = R.randn(2, 1, 3, 3).astype("float32")
+        out = run_op("conv2d", {"Input": x, "Filter": w},
+                     {"strides": [1, 1], "paddings": [0, 0],
+                      "dilations": [2, 2], "groups": 1})
+        np.testing.assert_allclose(
+            np.asarray(out["Output"][0]),
+            conv2d_ref(x, w, 1, 0, dilation=2), rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_groups(self):
+        x = R.randn(1, 4, 4, 4).astype("float32")
+        w = R.randn(4, 2, 3, 3).astype("float32")     # groups=2
+        out = run_op("conv2d", {"Input": x, "Filter": w},
+                     {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 2})
+        np.testing.assert_allclose(
+            np.asarray(out["Output"][0]),
+            conv2d_ref(x, w, 1, 1, groups=2), rtol=1e-3, atol=1e-4)
+
+    def test_depthwise(self):
+        x = R.randn(1, 3, 4, 4).astype("float32")
+        w = R.randn(3, 1, 3, 3).astype("float32")
+        out = run_op("depthwise_conv2d", {"Input": x, "Filter": w},
+                     {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 3})
+        np.testing.assert_allclose(
+            np.asarray(out["Output"][0]),
+            conv2d_ref(x, w, 1, 1, groups=3), rtol=1e-3, atol=1e-4)
+
+    def test_conv2d_transpose_values(self):
+        # conv_transpose_op.h: gradient-of-conv semantics; check by
+        # scatter-accumulate reference
+        x = R.randn(1, 2, 3, 3).astype("float32")
+        w = R.randn(2, 3, 3, 3).astype("float32")   # [Cin, Cout, kh, kw]
+        out = run_op("conv2d_transpose", {"Input": x, "Filter": w},
+                     {"strides": [2, 2], "paddings": [0, 0],
+                      "dilations": [1, 1], "groups": 1})
+        got = np.asarray(out["Output"][0])
+        oh = (3 - 1) * 2 + 3
+        want = np.zeros((1, 3, oh, oh), np.float32)
+        for i in range(3):
+            for j in range(3):
+                for ci in range(2):
+                    for co in range(3):
+                        want[0, co, i * 2:i * 2 + 3, j * 2:j * 2 + 3] \
+                            += x[0, ci, i, j] * w[ci, co]
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+class TestBatchNormStats:
+    def test_training_mode_stats_and_running_update(self):
+        # batch_norm_op.cc: normalize by BATCH stats; running stats
+        # updated as momentum*running + (1-momentum)*batch; SavedMean/
+        # SavedVariance expose the batch stats
+        x = R.randn(4, 3, 2, 2).astype("float32")
+        scale = np.array([1.0, 2.0, 0.5], np.float32)
+        bias = np.array([0.0, 1.0, -1.0], np.float32)
+        rm = np.array([0.1, 0.2, 0.3], np.float32)
+        rv = np.array([1.0, 1.0, 1.0], np.float32)
+        out = run_op("batch_norm",
+                     {"X": x, "Scale": scale, "Bias": bias,
+                      "Mean": rm, "Variance": rv},
+                     {"momentum": 0.9, "epsilon": 1e-5, "is_test": False})
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        want = (x - bm[None, :, None, None]) \
+            / np.sqrt(bv[None, :, None, None] + 1e-5)
+        want = want * scale[None, :, None, None] \
+            + bias[None, :, None, None]
+        np.testing.assert_allclose(np.asarray(out["Y"][0]), want,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["MeanOut"][0]),
+                                   0.9 * rm + 0.1 * bm, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(out["VarianceOut"][0]),
+                                   0.9 * rv + 0.1 * bv, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(out["SavedMean"][0]), bm,
+                                   rtol=1e-4)
+
+    def test_inference_mode_uses_running_stats(self):
+        x = R.randn(2, 3, 2, 2).astype("float32")
+        scale = np.ones(3, np.float32)
+        bias = np.zeros(3, np.float32)
+        rm = np.array([0.5, -0.5, 0.0], np.float32)
+        rv = np.array([2.0, 1.0, 4.0], np.float32)
+        out = run_op("batch_norm",
+                     {"X": x, "Scale": scale, "Bias": bias,
+                      "Mean": rm, "Variance": rv},
+                     {"epsilon": 1e-5, "is_test": True})
+        want = (x - rm[None, :, None, None]) \
+            / np.sqrt(rv[None, :, None, None] + 1e-5)
+        np.testing.assert_allclose(np.asarray(out["Y"][0]), want,
+                                   rtol=1e-4, atol=1e-5)
