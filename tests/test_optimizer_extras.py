@@ -101,6 +101,50 @@ class TestGradientMergeAdam:
         np.testing.assert_allclose(_w(), ref, rtol=1e-5, atol=1e-7)
 
 
+class TestRecomputeInvariance:
+    def test_recompute_matches_plain_trajectory(self, rng):
+        """RecomputeOptimizer trades FLOPs for memory (jax.checkpoint
+        segments in the executor); the training trajectory must be
+        IDENTICAL to the plain optimizer — reference optimizer.py:4491
+        semantics, recompute changes scheduling, never numerics."""
+        def run(recompute):
+            fluid.framework.reset_unique_name()
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [-1, 8])
+                y = fluid.data("y", [-1, 1])
+                h1 = fluid.layers.fc(x, 16, act="relu",
+                                     param_attr=fluid.ParamAttr(name="w1"))
+                h2 = fluid.layers.fc(h1, 16, act="relu",
+                                     param_attr=fluid.ParamAttr(name="w2"))
+                pred = fluid.layers.fc(h2, 1,
+                                       param_attr=fluid.ParamAttr(
+                                           name="w3"))
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                opt = fluid.optimizer.SGDOptimizer(0.05)
+                if recompute:
+                    opt = fluid.optimizer.RecomputeOptimizer(opt)
+                    opt._set_checkpoints([h1, h2])
+                opt.minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = np.random.RandomState(3)
+            losses = []
+            for _ in range(6):
+                xs = r.randn(8, 8).astype("float32")
+                (l,) = exe.run(main, feed={"x": xs, "y": xs[:, :1]},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+            w = np.asarray(fluid.global_scope().find_var("w1")).copy()
+            return losses, w
+
+        plain_losses, plain_w = run(False)
+        rc_losses, rc_w = run(True)
+        np.testing.assert_allclose(rc_losses, plain_losses, rtol=1e-5)
+        np.testing.assert_allclose(rc_w, plain_w, rtol=1e-5)
+
+
 class TestModelAverage:
     def test_apply_restores_and_averages(self, rng):
         loss = _simple_net()
