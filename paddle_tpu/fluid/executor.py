@@ -355,7 +355,37 @@ class Executor:
                        if op.type not in ("feed", "fetch")]
         else:
             run_ops = prune_ops(block, block.ops, targets=list(fetch_names),
-                                extra_state=scope_state)
+                                extra_state=scope_state,
+                                feeds=set(feed))
+            # a PARTIAL intermediate feed leaves a kept op needing a var
+            # whose producer only survives the no-feed prune — that would
+            # die deep in a lowering with an opaque IndexError (grad
+            # fan-in `sum` tolerating truly-pruned partials is fine);
+            # name the missing var up front instead
+            if feed and any(n not in (v.name for v in block.vars.values()
+                                      if v.is_data) for n in feed):
+                nofeed_out = {
+                    n for op in prune_ops(block, block.ops,
+                                          targets=list(fetch_names),
+                                          extra_state=scope_state)
+                    for n in op.output_arg_names}
+                kept_out = {n for op in run_ops
+                            for n in op.output_arg_names}
+                for op in run_ops:
+                    for n in op.input_arg_names:
+                        if n in feed or scope.find_var(n) is not None \
+                                or n in kept_out:
+                            continue
+                        v = block._find_var_recursive(n)
+                        if n in nofeed_out or (v is not None
+                                               and v.is_data):
+                            raise ValueError(
+                                f"op '{op.type}' needs var '{n}', which "
+                                f"the feed set {sorted(feed)} neither "
+                                f"supplies nor makes reachable — when "
+                                f"feeding an intermediate, all vars its "
+                                f"producer chain would have provided "
+                                f"must be fed together")
         written_names = sorted(
             {n for op in run_ops for n in op.output_arg_names
              if n in persist or n in scope_state})

@@ -528,7 +528,7 @@ def _op_reads(block, op, _seen=None):
 
 
 def prune_ops(block, ops, targets=None, keep_state_writes=True,
-              extra_state=()):
+              extra_state=(), feeds=()):
     """Backward-reachability prune (framework/prune.cc analog).
 
     Keeps an op iff it (a) produces a var in the needed set, seeded from
@@ -538,7 +538,13 @@ def prune_ops(block, ops, targets=None, keep_state_writes=True,
     or `extra_state` var while `keep_state_writes` (optimizer / BN-stats
     updates must survive a fetch-only prune), or (c) has side effects the
     dataflow can't see.  Kept ops contribute their reads — including
-    control-flow sub-block captures — to the needed set, one reverse pass."""
+    control-flow sub-block captures — to the needed set, one reverse pass.
+
+    `feeds` names vars the caller materialises directly: an op whose
+    needed outputs are ALL fed is dropped and its inputs are not
+    traversed — feeding an intermediate var runs the program FROM that
+    var, exactly the reference's prune-with-input semantics
+    (framework/prune.cc feed targets; executor.py feed of any var)."""
     def persistable(n):
         # resolve through parent blocks: sub-block ops write global-block
         # counters (GradientMerge-style state updated inside while bodies)
@@ -546,6 +552,7 @@ def prune_ops(block, ops, targets=None, keep_state_writes=True,
         return v is not None and v.persistable
 
     extra = set(extra_state)
+    fed = set(feeds)
     if targets is None:
         consumed = {n for op in ops for n in _op_reads(block, op)}
         needed = {n for op in ops for n in op.output_arg_names
@@ -554,11 +561,19 @@ def prune_ops(block, ops, targets=None, keep_state_writes=True,
         needed = set(targets)
     kept = []
     for op in reversed(ops):
-        keep = (op.type in _SIDE_EFFECT_OP_TYPES
-                or any(n in needed for n in op.output_arg_names)
-                or (keep_state_writes
-                    and any(persistable(n) or n in extra
-                            for n in op.output_arg_names)))
+        outs = op.output_arg_names
+        state_write = keep_state_writes and any(
+            persistable(n) or n in extra for n in outs)
+        needed_outs = [n for n in outs if n in needed]
+        if (fed and needed_outs and not state_write
+                and op.type not in _SIDE_EFFECT_OP_TYPES
+                and all(n in fed for n in needed_outs)
+                # in-place op on the fed var (reads the same name it
+                # writes): the op transforms the fed value — keep it
+                and not (set(needed_outs) & set(_op_reads(block, op)))):
+            continue          # feed satisfies everything this op is for
+        keep = (op.type in _SIDE_EFFECT_OP_TYPES or needed_outs
+                or state_write)
         if keep:
             kept.append(op)
             needed.update(_op_reads(block, op))

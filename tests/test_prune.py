@@ -140,3 +140,56 @@ class TestPruneDCE:
         xs = rng.randn(2, 4).astype("float32")
         got, = exe.run(feed={"x": xs}, fetch_list=[out])
         np.testing.assert_allclose(np.asarray(got), xs * 6.0, rtol=1e-6)
+
+    def test_feed_intermediate_var_skips_producers(self, rng):
+        """Feeding a mid-graph var runs the program FROM that var
+        (framework/prune.cc feed-target semantics): producers of the fed
+        var must be pruned, not executed against missing inputs, and
+        training-state writes upstream must still be reachable only when
+        actually needed."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            h = fluid.layers.fc(x, 3, act="relu",
+                                param_attr=fluid.ParamAttr(name="pw_a"))
+            out = fluid.layers.fc(h, 2,
+                                  param_attr=fluid.ParamAttr(name="pw_b"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = rng.randn(2, 4).astype("float32")
+        hv, ov = exe.run(main, feed={"x": xs}, fetch_list=[h, out])
+        # run from the intermediate: no "x" feed at all
+        ov2, = exe.run(main, feed={h.name: np.asarray(hv)},
+                       fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(ov2), np.asarray(ov),
+                                   rtol=1e-6)
+
+    def test_feed_inplace_op_still_transforms(self, rng):
+        """An op that reads AND writes the fed name (increment-style
+        in-place) transforms the fed value — it must run, not be treated
+        as a pruned producer."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [1])
+            y = fluid.layers.increment(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.array([5.0], "float32")},
+                       fetch_list=[y])
+        np.testing.assert_allclose(np.asarray(got).ravel(), [6.0])
+
+    def test_partial_feed_of_multi_output_producer_diagnosed(self, rng):
+        """Feeding only ONE output of a multi-output producer cannot run
+        the program (the producer is neither satisfiable nor prunable);
+        the executor must name the missing feed."""
+        import pytest
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            h, g = fluid.layers.split(x, 2, dim=1)
+            out = h + g
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        hv = rng.randn(2, 2).astype("float32")
+        with pytest.raises(ValueError, match="fed together"):
+            exe.run(main, feed={h.name: hv}, fetch_list=[out])
