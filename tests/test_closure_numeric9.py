@@ -157,3 +157,15 @@ class TestFusedTail:
         want = np.zeros((3, 3), np.float32)
         want[1, 2] = 5.0
         np.testing.assert_allclose(got, want)
+
+
+class TestRowConv:
+    def test_lookahead_formula(self):
+        # row_conv_op.cc: out[b,t,d] = sum_k x[b,t+k,d] * filt[k,d]
+        x = R.randn(1, 4, 2).astype("float32")
+        f = R.randn(3, 2).astype("float32")
+        out = run_op("row_conv", {"X": x, "Filter": f}, {})
+        got = np.asarray(out["Out"][0])
+        xp = np.pad(x, [(0, 0), (0, 2), (0, 0)])
+        want = sum(xp[:, k:k + 4] * f[k][None, None, :] for k in range(3))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
