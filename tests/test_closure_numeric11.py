@@ -1,0 +1,93 @@
+"""Eleventh tranche: pad3d layouts/modes, the expand/tile family's
+repeat semantics, index_select/index_sample gathers, and a beam_search
+step against a manual top-k reference."""
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+R = np.random.RandomState(59)
+
+
+class TestPad3d:
+    def test_ncdhw_paddings_order(self):
+        # pad3d paddings attr is [left, right, top, bottom, front, back]
+        x = R.randn(1, 1, 2, 2, 2).astype("float32")
+        out = run_op("pad3d", {"X": x},
+                     {"paddings": [1, 0, 0, 1, 1, 0],
+                      "mode": "constant", "value": 3.0})
+        got = np.asarray(out["Out"][0])
+        want = np.pad(x, [(0, 0), (0, 0), (1, 0), (0, 1), (1, 0)],
+                      constant_values=3.0)
+        np.testing.assert_allclose(got, want)
+
+    def test_reflect_mode(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 1, 2, 2, 2)
+        out = run_op("pad3d", {"X": x},
+                     {"paddings": [1, 1, 0, 0, 0, 0], "mode": "reflect"})
+        want = np.pad(x, [(0, 0), (0, 0), (0, 0), (0, 0), (1, 1)],
+                      mode="reflect")
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want)
+
+
+class TestExpandFamily:
+    def test_expand_times(self):
+        x = np.array([[1.0, 2.0]], np.float32)
+        out = run_op("expand", {"X": x}, {"expand_times": [2, 3]})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   np.tile(x, (2, 3)))
+
+    def test_expand_v2_broadcast_shape(self):
+        x = np.array([[1.0], [2.0]], np.float32)
+        out = run_op("expand_v2", {"X": x}, {"shape": [2, 4]})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   np.broadcast_to(x, (2, 4)))
+
+    def test_tile_repeat_times(self):
+        x = np.array([1.0, 2.0], np.float32)
+        out = run_op("tile", {"X": x}, {"repeat_times": [2, 2]})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   np.tile(x, (2, 2)))
+
+
+class TestIndexOps:
+    def test_index_select(self):
+        x = R.randn(4, 3).astype("float32")
+        idx = np.array([2, 0], np.int64)
+        out = run_op("index_select", {"X": x, "Index": idx}, {"dim": 0})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), x[[2, 0]])
+        out = run_op("index_select", {"X": x, "Index": idx}, {"dim": 1})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]),
+                                   x[:, [2, 0]])
+
+    def test_index_sample(self):
+        # index_sample_op.h: per-row gather
+        x = R.randn(3, 5).astype("float32")
+        idx = np.array([[0, 4], [1, 1], [3, 2]], np.int64)
+        out = run_op("index_sample", {"X": x, "Index": idx}, {})
+        want = np.take_along_axis(x, idx, axis=1)
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), want)
+
+
+class TestBeamSearchStep:
+    def test_topk_per_source(self):
+        # 1 source sentence, beam 2, vocab 4: accumulated scores pick the
+        # global top-2 (id, score) pairs across the beam
+        beam, v = 2, 4
+        scores = np.array([[0.1, 0.9, 0.2, 0.3],
+                           [0.8, 0.05, 0.6, 0.4]], np.float32)
+        pre_ids = np.array([[3], [2]], np.int64)     # no beam finished
+        pre_scores = np.zeros((beam, 1), np.float32)
+        ids = np.tile(np.arange(v)[None], (beam, 1)).astype(np.int64)
+        out = run_op("beam_search",
+                     {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                      "ids": ids, "scores": scores},
+                     {"beam_size": beam, "end_id": 1,
+                      "is_accumulated": True, "level": 0})
+        sel_scores = np.sort(
+            np.asarray(out["selected_scores"][0]).ravel())[::-1]
+        # global top-2 of all 8 candidates: 0.9 (beam0,id1), 0.8 (beam1,id0)
+        np.testing.assert_allclose(sel_scores, [0.9, 0.8], rtol=1e-6)
+        sel_ids = set(np.asarray(out["selected_ids"][0]).ravel().tolist())
+        assert sel_ids == {1, 0}
