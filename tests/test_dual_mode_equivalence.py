@@ -1,0 +1,116 @@
+"""Dual-mode equivalence at MODEL level — the reference's
+dygraph_to_static integration tier (SURVEY §4: full models compared
+dygraph vs static): the same LeNet-style CNN with identical weights and
+data must produce the same loss trajectory trained eagerly (tape +
+eager optimizer) and as a static Program (append_backward + Executor),
+because both modes share one op registry and one grad rule."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.dygraph import base as dybase
+from paddle_tpu.dygraph.base import to_variable
+
+STEPS = 5
+LR = 0.05
+
+
+def _data(step):
+    # one fixed batch for every step: the loss must then decrease, and
+    # the dual-mode comparison is unaffected
+    rng = np.random.RandomState(100)
+    xs = rng.randn(8, 1, 8, 8).astype("float32")
+    ys = rng.randint(0, 10, (8, 1)).astype("int64")
+    return xs, ys
+
+
+def _init_weights():
+    rng = np.random.RandomState(7)
+    return {
+        "conv_w": (rng.randn(4, 1, 3, 3) * 0.1).astype("float32"),
+        "fc1_w": (rng.randn(4 * 16, 32) * 0.1).astype("float32"),
+        "fc1_b": np.zeros(32, np.float32),
+        "fc2_w": (rng.randn(32, 10) * 0.1).astype("float32"),
+        "fc2_b": np.zeros(10, np.float32),
+    }
+
+
+def run_static(weights):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 1, 8, 8])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        conv = fluid.layers.conv2d(
+            x, 4, 3, padding=1, stride=2,
+            param_attr=fluid.ParamAttr(name="conv_w"), bias_attr=False)
+        h = fluid.layers.reshape(fluid.layers.relu(conv), [-1, 4 * 16])
+        h = fluid.layers.fc(h, 32, act="relu",
+                            param_attr=fluid.ParamAttr(name="fc1_w"),
+                            bias_attr=fluid.ParamAttr(name="fc1_b"))
+        logits = fluid.layers.fc(h, 10,
+                                 param_attr=fluid.ParamAttr(name="fc2_w"),
+                                 bias_attr=fluid.ParamAttr(name="fc2_b"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(LR).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    import jax.numpy as jnp
+    for name, val in weights.items():
+        scope.set_var(name, jnp.asarray(val))
+    losses = []
+    for step in range(STEPS):
+        xs, ys = _data(step)
+        (l,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(l)))
+    final_w = np.asarray(scope.find_var("conv_w")).copy()
+    return losses, final_w
+
+
+def run_dygraph(weights):
+    import jax.numpy as jnp
+    from paddle_tpu import nn
+    import paddle_tpu.fluid.layers as L
+
+    dybase.enable_dygraph()
+    try:
+        conv = nn.Conv2D(1, 4, 3, padding=1, stride=2, bias_attr=False)
+        fc1 = nn.Linear(4 * 16, 32)
+        fc2 = nn.Linear(32, 10)
+        conv.weight._value = jnp.asarray(weights["conv_w"])
+        fc1.weight._value = jnp.asarray(weights["fc1_w"])
+        fc1.bias._value = jnp.asarray(weights["fc1_b"])
+        fc2.weight._value = jnp.asarray(weights["fc2_w"])
+        fc2.bias._value = jnp.asarray(weights["fc2_b"])
+        params = (list(conv.parameters()) + list(fc1.parameters())
+                  + list(fc2.parameters()))
+        opt = fluid.optimizer.SGDOptimizer(LR, parameter_list=params)
+        losses = []
+        for step in range(STEPS):
+            xs, ys = _data(step)
+            h = L.relu(conv(to_variable(xs)))
+            h = L.relu(fc1(L.reshape(h, [-1, 4 * 16])))
+            logits = fc2(h)
+            loss = L.mean(L.softmax_with_cross_entropy(
+                logits, to_variable(ys)))
+            loss.backward()
+            opt.minimize(loss)
+            for p in params:
+                p.clear_gradient()
+            losses.append(float(np.asarray(loss._value)))
+        final_w = np.asarray(conv.weight._value).copy()
+        return losses, final_w
+    finally:
+        dybase.disable_dygraph()
+
+
+class TestDualModeEquivalence:
+    def test_same_trajectory(self):
+        w = _init_weights()
+        s_losses, s_w = run_static({k: v.copy() for k, v in w.items()})
+        d_losses, d_w = run_dygraph({k: v.copy() for k, v in w.items()})
+        np.testing.assert_allclose(d_losses, s_losses, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(d_w, s_w, rtol=1e-4, atol=1e-6)
+        assert s_losses[-1] < s_losses[0]
