@@ -91,3 +91,53 @@ class TestBeamSearchStep:
         np.testing.assert_allclose(sel_scores, [0.9, 0.8], rtol=1e-6)
         sel_ids = set(np.asarray(out["selected_ids"][0]).ravel().tolist())
         assert sel_ids == {1, 0}
+
+
+class TestAmpScalingOps:
+    def test_check_finite_and_unscale(self):
+        # amp/check_finite_and_unscale_op.cc: grads divided by scale,
+        # FoundInfinite set if ANY input has a nan/inf
+        g1 = np.array([2.0, 4.0], np.float32)
+        g2 = np.array([8.0], np.float32)
+        out = run_op("check_finite_and_unscale",
+                     {"X": [g1, g2], "Scale": np.array([2.0], np.float32)})
+        np.testing.assert_allclose(np.asarray(out["Out"][0]), [1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(out["Out"][1]), [4.0])
+        assert not bool(np.asarray(out["FoundInfinite"][0])[0])
+        bad = np.array([np.inf, 1.0], np.float32)
+        out = run_op("check_finite_and_unscale",
+                     {"X": [g1, bad],
+                      "Scale": np.array([2.0], np.float32)})
+        assert bool(np.asarray(out["FoundInfinite"][0])[0])
+
+    def test_update_loss_scaling_dynamics(self):
+        # amp/update_loss_scaling_op.h: grow after incr_every good steps,
+        # halve after decr_every bad steps, counters reset
+        x = [np.ones(2, np.float32)]
+
+        def step(found, scale, good, bad):
+            out = run_op("update_loss_scaling",
+                         {"X": x,
+                          "FoundInfinite": np.array([found]),
+                          "PrevLossScaling": np.array([scale], np.float32),
+                          "InGoodSteps": np.array([good], np.int32),
+                          "InBadSteps": np.array([bad], np.int32)},
+                         {"incr_every_n_steps": 2,
+                          "decr_every_n_nan_or_inf": 2,
+                          "incr_ratio": 2.0, "decr_ratio": 0.5})
+            return (float(np.asarray(out["LossScaling"][0])[0]),
+                    int(np.asarray(out["OutGoodSteps"][0])[0]),
+                    int(np.asarray(out["OutBadSteps"][0])[0]),
+                    np.asarray(out["Out"][0]))
+
+        # two good steps -> scale doubles, counter resets
+        s, g, b, _ = step(False, 1024.0, 0, 0)
+        assert (s, g, b) == (1024.0, 1, 0)
+        s, g, b, _ = step(False, s, g, b)
+        assert (s, g, b) == (2048.0, 0, 0)
+        # one bad step: counter only; second bad: halve + zeroed grads
+        s, g, b, _ = step(True, s, g, b)
+        assert (s, g, b) == (2048.0, 0, 1)
+        s, g, b, outg = step(True, s, g, b)
+        assert (s, g, b) == (1024.0, 0, 0)
+        np.testing.assert_allclose(outg, 0.0)
