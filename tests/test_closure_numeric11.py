@@ -141,3 +141,29 @@ class TestAmpScalingOps:
         s, g, b, outg = step(True, s, g, b)
         assert (s, g, b) == (1024.0, 0, 0)
         np.testing.assert_allclose(outg, 0.0)
+
+
+class TestHierarchicalSigmoid:
+    def test_simple_code_path_loss(self):
+        # matrix_bit_code.h SimpleCode: c = label + num_classes;
+        # index(j) = (c >> (j+1)) - 1, bit(j) = c & (1<<j),
+        # length = floor(log2(c)); loss = sum_j softplus(z_j) - bit_j z_j
+        num_classes, d, b = 6, 4, 3
+        x = R.randn(b, d).astype("float32")
+        w = R.randn(num_classes - 1, d).astype("float32") * 0.5
+        bias = R.randn(num_classes - 1).astype("float32") * 0.1
+        label = np.array([[0], [3], [5]], np.int64)
+        out = run_op("hierarchical_sigmoid",
+                     {"X": x, "W": w, "Label": label, "Bias": bias},
+                     {"num_classes": num_classes})
+        got = np.asarray(out["Out"][0]).ravel()
+        want = np.zeros(b)
+        for i in range(b):
+            c = int(label[i, 0]) + num_classes
+            length = int(np.floor(np.log2(c)))
+            for j in range(length):
+                idx = (c >> (j + 1)) - 1
+                bit = (c >> j) & 1
+                z = float(x[i] @ w[idx] + bias[idx])
+                want[i] += np.log1p(np.exp(z)) - bit * z
+        np.testing.assert_allclose(got, want, rtol=1e-4)
