@@ -542,4 +542,4 @@ class TestExtrasNumeric:
             input_length=ti(hyp_len), label_length=ti(ref_len))
         # levenshtein([1,2,3],[1,3,3,2]) = 2 (sub 2->3, insert 2)
         np.testing.assert_allclose(npv(dist).ravel()[0], 2.0)
-        assert int(npv(seq_num)) == 1
+        assert int(npv(seq_num).ravel()[0]) == 1
