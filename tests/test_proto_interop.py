@@ -367,3 +367,14 @@ class TestOpVersionMap:
         pair.op_version.version = 99
         with pytest.raises(opver.OpVersionError, match="version 99"):
             proto_serde.program_from_proto(pb)
+
+
+class TestOpVersionCheckerUtils:
+    def test_checker_reflects_registry(self):
+        from paddle_tpu.utils.op_version import OpLastCheckpointChecker
+        c = OpLastCheckpointChecker()
+        assert c.version("arg_max") == 1
+        assert c.check_add("arg_max") == ["flatten"]
+        assert c.check_add("softplus") == ["beta", "threshold"]
+        assert c.check_add("softplus", "beta") == ["beta"]
+        assert c.check_add("relu") == []        # no pins -> v0
