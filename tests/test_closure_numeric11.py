@@ -167,3 +167,40 @@ class TestHierarchicalSigmoid:
                 z = float(x[i] @ w[idx] + bias[idx])
                 want[i] += np.log1p(np.exp(z)) - bit * z
         np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+class TestCenterLossAndTdm:
+    def test_center_loss_and_ema_update(self):
+        # center_loss_op.h: loss_i = 0.5||x_i - c[l_i]||^2;
+        # c_out = c + alpha * sum_diff / (1 + count)
+        x = R.randn(3, 4).astype("float32")
+        centers = R.randn(5, 4).astype("float32")
+        label = np.array([[1], [1], [3]], np.int64)
+        alpha = np.array([0.5], np.float32)
+        out = run_op("center_loss",
+                     {"X": x, "Label": label, "Centers": centers,
+                      "CenterUpdateRate": alpha}, {"need_update": True})
+        diff = x - centers[label.ravel()]
+        np.testing.assert_allclose(
+            np.asarray(out["Loss"][0]).ravel(),
+            0.5 * (diff ** 2).sum(1), rtol=1e-4)
+        want_c = centers.copy()
+        want_c[1] += 0.5 * (diff[0] + diff[1]) / 3.0   # count 2 -> 1+2
+        want_c[3] += 0.5 * diff[2] / 2.0               # count 1 -> 1+1
+        np.testing.assert_allclose(np.asarray(out["CentersOut"][0]),
+                                   want_c, rtol=1e-4)
+
+    def test_tdm_child_lookup(self):
+        # tdm_child_op.cc: TreeInfo row = [item, layer, parent, children]
+        tree = np.array([[0, 0, 0, 0, 0],
+                         [10, 0, 0, 2, 3],     # node 1 -> children 2, 3
+                         [20, 1, 1, 0, 0],     # node 2: leaf
+                         [30, 1, 1, 4, 0]],    # node 3 -> child 4
+                        np.int64)
+        x = np.array([[1], [2]], np.int64)
+        out = run_op("tdm_child", {"X": x, "TreeInfo": tree},
+                     {"child_nums": 2})
+        child = np.asarray(out["Child"][0]).reshape(2, 2)
+        mask = np.asarray(out["LeafMask"][0]).reshape(2, 2)
+        np.testing.assert_array_equal(child, [[2, 3], [0, 0]])
+        np.testing.assert_array_equal(mask, [[1, 1], [0, 0]])
