@@ -72,6 +72,11 @@ from .fluid.core import TPUPlace as XPUPlace               # noqa: E402
 from .dygraph import DataParallel                          # noqa: E402
 from .dygraph.base import VarBase as Tensor                # noqa: E402
 from .hapi import callbacks                                # noqa: E402
+from . import observability                                # noqa: E402
+from . import observability as profiler                    # noqa: E402
+import sys as _sys                                         # noqa: E402
+# `import paddle_tpu.profiler` must resolve to the observability surface
+_sys.modules.setdefault(__name__ + ".profiler", observability)
 from . import onnx                                         # noqa: E402
 from .fluid.framework import (set_default_dtype,           # noqa: E402
                               get_default_dtype)

@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..fluid import trace as _trace
 from ..fluid.framework import (convert_dtype, unique_name, _set_dygraph_tracer,
                                _dygraph_tracer)
 from ..ops.registry import get_op, LoweringContext
@@ -227,7 +228,14 @@ class Tracer:
         if opdef.stateful_rng and "op_seed" not in attrs:
             attrs["op_seed"] = int(np.random.randint(0, 2**31 - 1))
         ctx = self._ctx()
-        outs_arr = opdef.fn(ins_arr, attrs, ctx)
+        # eager dispatch span: unlike static mode (trace-time only), this
+        # times every real execution.  One boolean when the plane is off.
+        if _trace.enabled():
+            _t0 = _trace.now()
+            outs_arr = opdef.fn(ins_arr, attrs, ctx)
+            _trace.complete(op_type, _t0, cat="dygraph_op")
+        else:
+            outs_arr = opdef.fn(ins_arr, attrs, ctx)
 
         outs_vb: Dict[str, List[VarBase]] = {}
         requires = (not self._no_grad and opdef.differentiable and any(

@@ -38,6 +38,7 @@ from .data_feeder import DataFeeder
 from . import metrics
 from . import dataset
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
+from . import trace
 from . import profiler
 from . import monitor
 from .reader import DataLoader

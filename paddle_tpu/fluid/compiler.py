@@ -14,6 +14,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+from . import trace
+
 
 class ReduceStrategy:
     AllReduce = 0
@@ -60,6 +62,7 @@ class CompiledProgram:
         self._is_data_parallel = False
         # forwarded so Executor.run can treat us like a Program
         self._hints = self._program._hints
+        trace.metrics().counter("compiler.compiled_programs").inc()
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -68,7 +71,12 @@ class CompiledProgram:
         if build_strategy is not None:
             self._build_strategy = build_strategy
         from ..parallel.mesh import build_data_parallel_mesh
+        _t0 = trace.now() if trace.enabled() else 0
         self._mesh = build_data_parallel_mesh(places)
+        if _t0:
+            trace.complete("compiler::with_data_parallel", _t0,
+                           cat="compile",
+                           args={"devices": int(self._mesh.size)})
         self._is_data_parallel = True
         if self._build_strategy.sync_batch_norm:
             self._program._hints["sync_batch_norm"] = True

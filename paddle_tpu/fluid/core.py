@@ -11,6 +11,11 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+# stdlib-only module; single source of truth for trace env parsing and the
+# default timeline path (import order with this package is cycle-safe:
+# trace only touches core lazily, inside functions)
+from . import trace as _trace
+
 
 class Place:
     device_kind = "cpu"
@@ -104,6 +109,12 @@ _FLAGS: Dict[str, object] = {
     # indices in numpy), so device programs rarely need 64-bit ints.  The
     # executor raises on silently-truncating feeds instead of corrupting.
     "enable_x64": False,
+    # observability plane (fluid/trace.py): host-side structured tracing.
+    # Env defaults let `FLAGS_enable_trace=1 python train.py` produce a
+    # chrome://tracing timeline at FLAGS_trace_path with no code changes;
+    # trace.enable()/disable()/set_path() keep these mirror values in sync.
+    "enable_trace": _trace.enabled(),
+    "trace_path": _trace.get_path(),
 }
 
 
@@ -140,6 +151,12 @@ def set_flags(flags: Dict[str, object]):
         elif k == "enable_x64":
             import jax
             jax.config.update("jax_enable_x64", bool(v))
+        elif k == "enable_trace":
+            from . import trace
+            (trace.enable if v else trace.disable)()
+        elif k == "trace_path":
+            from . import trace
+            trace.set_path(str(v))
 
 
 def get_flags(names):

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from . import core
+from . import trace
 from .core import Scope, global_scope
 from .framework import Program, Block, Variable, default_main_program
 from ..ops.registry import get_op, has_op, LoweringContext
@@ -115,6 +116,11 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
     from . import control_flow_impl
     op_list = block.ops if ops is None else ops
     debug_nan = getattr(ctx, "debug_nan", False)
+    # observability plane: ONE boolean read for the whole loop; when off the
+    # per-op cost is a single `if` (acceptance: no measurable overhead).
+    # Under jit these spans time host dispatch/lowering per op — the
+    # operator.cc RunImpl host-side cost (see trace.py module docstring).
+    tr_on = trace.enabled()
     # IR-level constant folding for tensor-array indices: under jit EVERY
     # value is staged abstract, but fill_constant/increment counter chains
     # are statically known from the op stream — fold them so
@@ -129,7 +135,10 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
                        "select_output"):
             for n in op.output_arg_names:    # runtime writes: un-fold
                 const_env.pop(n, None)
+            _t0 = trace.now() if tr_on else 0
             control_flow_impl.run_control_flow_op(op, block, env, ctx)
+            if tr_on:
+                trace.complete(op.type, _t0, cat="op")
             continue
         opdef = get_op(op.type)
         ins = {}
@@ -160,6 +169,7 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
                 const_env.pop(n, None)
         # named_scope: per-op spans in profiler traces / HLO metadata
         # (platform/profiler.h:127 RecordEvent placement, operator.cc:1077)
+        _t0 = trace.now() if tr_on else 0
         with jax.named_scope(op.type):
             if call_op is not None:
                 outs = call_op(opdef, ins, op_attrs, ctx)
@@ -172,6 +182,8 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
                         ins, opdef.fn(plain, op_attrs, ctx))
                 else:
                     outs = opdef.fn(ins, op_attrs, ctx)
+        if tr_on:
+            trace.complete(op.type, _t0, cat="op")
         for slot, names in op.outputs.items():
             produced = outs.get(slot, [])
             for name, val in zip(names, produced):
@@ -244,11 +256,33 @@ class Executor:
                bool(core.get_flag("check_nan_inf")),
                bool(program._hints.get("inference_no_prune")),
                bool(program._hints.get("donate_buffers")))
+        # compile-cache instrumentation (the _ExecutorCache hit-rate is THE
+        # first-order perf signal on this stack: a miss is a whole-block
+        # XLA recompile).  Counters are always on (one int bump per run);
+        # timeline events only when the plane is enabled.
+        tr_on = trace.enabled()
         compiled = self._cache.get(key)
         if compiled is None:
+            trace.metrics().counter("executor.compile_cache_miss").inc()
+            if tr_on:
+                trace.instant("compile_cache_miss", cat="compile",
+                              args={"fingerprint": key[0][:12],
+                                    "n_feeds": len(feed)})
+            _t0 = trace.now()
             compiled = self._prepare(program, feed, fetch_names, scope, mesh)
+            trace.metrics().histogram("executor.compile_seconds").observe(
+                (trace.now() - _t0) / 1e9)
+            if tr_on:
+                trace.complete("executor::compile", _t0, cat="compile",
+                               args={"fingerprint": key[0][:12],
+                                     "n_ops": compiled.n_ops})
             if use_program_cache:
                 self._cache[key] = compiled
+        else:
+            trace.metrics().counter("executor.compile_cache_hit").inc()
+            if tr_on:
+                trace.instant("compile_cache_hit", cat="compile",
+                              args={"fingerprint": key[0][:12]})
 
         mut = {n: scope.find_var(n) for n in compiled.param_names
                if n in compiled.written_names}
@@ -262,7 +296,14 @@ class Executor:
         step_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
 
+        _t0 = trace.now() if tr_on else 0
         fetches, new_vals = compiled.fn(mut, ro, feeds, step_key)
+        if tr_on:
+            # device-program launch span (per-step time; the per-op "op"
+            # spans above are per-compile host cost)
+            trace.complete("executor::step", _t0, cat="step",
+                           args={"step": self._step - 1,
+                                 "n_fetch": len(fetch_names)})
         for n, v in new_vals.items():
             scope.set_var(n, v)
 

@@ -3,49 +3,54 @@
 Reference: platform/monitor.h:31,43,129 — ``StatValue`` int counters in a
 process-wide ``StatRegistry``, bumped via ``STAT_ADD``/``STAT_SUB`` macros
 (BoxPS memory stats, dataset ingest counters).  TPU-native: the counters
-live host-side (device-side counts belong in the profiler); thread-safe so
-data-feed worker threads can bump them.
+live host-side and are BACKED by the unified observability plane's metrics
+registry (fluid/trace.py) — ``stat_add("psgpu/mem", n)`` and
+``trace.metrics().counter("psgpu/mem")`` are the same thread-safe cell, so
+monitor stats ride into exported Chrome timelines for free.  StatRegistry
+remains the reference-shaped facade (singleton + ``get``/``stats``) and
+tracks which names were created through it, so ``print_stats`` shows only
+monitor-plane counters, not every framework metric.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Tuple
+from typing import List, Tuple
+
+from . import trace
 
 
 class StatValue:
-    __slots__ = ("name", "_value", "_lock")
+    """Reference StatValue surface over a plane Counter (thread-safe)."""
+
+    __slots__ = ("name", "_counter")
 
     def __init__(self, name: str):
         self.name = name
-        self._value = 0
-        self._lock = threading.Lock()
+        self._counter = trace.metrics().counter(name)
 
     def increase(self, n: int = 1) -> int:
-        with self._lock:
-            self._value += n
-            return self._value
+        return self._counter.add(n)
 
     def decrease(self, n: int = 1) -> int:
-        return self.increase(-n)
+        return self._counter.add(-n)
 
     def reset(self) -> None:
-        with self._lock:
-            self._value = 0
+        self._counter.reset()
 
     def get(self) -> int:
-        with self._lock:
-            return self._value
+        return self._counter.value
 
 
 class StatRegistry:
     """Process-wide registry; ``StatRegistry.instance()`` mirrors the
-    reference singleton."""
+    reference singleton.  Map creation and lookups are lock-guarded so
+    data-feed worker threads can create/bump stats concurrently."""
 
     _instance = None
     _instance_lock = threading.Lock()
 
     def __init__(self):
-        self._stats: Dict[str, StatValue] = {}
+        self._stats = {}
         self._lock = threading.Lock()
 
     @classmethod
@@ -64,7 +69,16 @@ class StatRegistry:
 
     def stats(self) -> List[Tuple[str, int]]:
         with self._lock:
-            return sorted((n, s.get()) for n, s in self._stats.items())
+            items = list(self._stats.items())
+        return sorted((n, s.get()) for n, s in items)
+
+    def reset_all(self) -> None:
+        """Zero every registered stat — test isolation (reference has no
+        analog; the C++ registry lives for the process)."""
+        with self._lock:
+            items = list(self._stats.values())
+        for s in items:
+            s.reset()
 
 
 def stat_add(name: str, n: int = 1) -> int:
@@ -79,6 +93,10 @@ def stat_sub(name: str, n: int = 1) -> int:
 
 def stat_get(name: str) -> int:
     return StatRegistry.instance().get(name).get()
+
+
+def reset_all() -> None:
+    StatRegistry.instance().reset_all()
 
 
 def print_stats() -> str:

@@ -1,53 +1,131 @@
-"""Profiler facade over the JAX/XLA profiler.
+"""Profiler facade: host observability plane + optional JAX/XLA profiler.
 
 Reference: python/paddle/fluid/profiler.py context manager ->
 platform/profiler.cc RAII spans + CUPTI device tracer (SURVEY §5 tracing).
-TPU-native: jax.profiler emits XPlane traces viewable in TensorBoard /
-Perfetto — the chrome://tracing role of tools/timeline.py.  RecordEvent maps
-to jax.profiler.TraceAnnotation (host spans visible alongside device ops).
+TPU-native, two tiers:
+
+* the framework-native host plane (fluid/trace.py) — always available:
+  per-op dispatch spans, compile-cache events, step timing, the sorted
+  calls/total/min/max/ave summary, Chrome-trace export;
+* ``jax.profiler`` XPlane traces (TensorBoard / Perfetto) for device-side
+  op time — best effort: on backends/headless setups where
+  ``start_trace`` raises, the profiler DEGRADES to host-only tracing
+  instead of crashing the training run.
+
+``RecordEvent`` spans land in both tiers, so host annotations line up with
+device ops in either viewer.
 """
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import time
 
 import jax
 
+from . import trace
+
+_DEFAULT_PATH = "/tmp/paddle_tpu_profile"
+
+# whether a jax.profiler trace session is live (start/stop must pair)
+_jax_trace_active = False
+
+
+def _start_jax_trace(profile_path: str) -> bool:
+    """Best-effort device trace.  Headless/CPU-CI/odd backends can make
+    ``start_trace`` raise — degrade to the host plane, never propagate."""
+    global _jax_trace_active
+    if _jax_trace_active:
+        return True
+    try:
+        jax.profiler.start_trace(profile_path)
+        _jax_trace_active = True
+        return True
+    except Exception as e:          # noqa: BLE001 — degrade by contract
+        print(f"paddle_tpu.profiler: device trace unavailable "
+              f"({type(e).__name__}: {e}); continuing with host-only "
+              f"tracing", file=sys.stderr)
+        return False
+
+
+def _stop_jax_trace() -> None:
+    global _jax_trace_active
+    if not _jax_trace_active:
+        return
+    _jax_trace_active = False
+    try:
+        jax.profiler.stop_trace()
+    except Exception:               # noqa: BLE001 — stop must not raise
+        pass
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   profile_path=_DEFAULT_PATH):
+    """Begin profiling: host plane on, device trace if the backend
+    supports it (reference start_profiler semantics, no-crash)."""
+    trace.enable()
+    _start_jax_trace(profile_path)
+
+
+def stop_profiler(sorted_key=None, profile_path=_DEFAULT_PATH):
+    """Stop profiling; print the reference-style sorted op-time summary and
+    export the host timeline next to the device trace."""
+    _stop_jax_trace()
+    if trace.get_events():
+        out = os.path.join(profile_path, "paddle_tpu_timeline.json")
+        trace.export_chrome_trace(out)
+        print(trace.summary_table(sorted_key or "total"))
+        print(f"[profiler] host timeline: {out} "
+              f"(chrome://tracing / ui.perfetto.dev)")
+
 
 @contextlib.contextmanager
-def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
-    jax.profiler.start_trace(profile_path)
+def profiler(state="All", sorted_key=None, profile_path=_DEFAULT_PATH):
+    was_enabled = trace.enabled()
+    start_profiler(state, profile_path=profile_path)
     t0 = time.time()
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
-        print(f"[profiler] trace written to {profile_path} "
-              f"(wall {time.time() - t0:.3f}s); view with tensorboard "
+        stop_profiler(sorted_key, profile_path)
+        print(f"[profiler] trace under {profile_path} "
+              f"(wall {time.time() - t0:.3f}s); device view: tensorboard "
               f"--logdir {profile_path}")
-
-
-def start_profiler(state="All", tracer_option="Default",
-                   profile_path="/tmp/paddle_tpu_profile"):
-    jax.profiler.start_trace(profile_path)
-
-
-def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
-    jax.profiler.stop_trace()
+        if not was_enabled:
+            trace.disable()         # restore caller's gating
 
 
 class RecordEvent:
-    """platform/profiler.h:127 RecordEvent analog — host span annotation."""
+    """platform/profiler.h:127 RecordEvent analog — host span annotation.
+    Emits into the host plane always (when enabled) and into the device
+    trace when one is live; TraceAnnotation failures never propagate."""
 
     def __init__(self, name):
-        self._ann = jax.profiler.TraceAnnotation(name)
+        self.name = name
+        self._t0 = None
+        self._ann = None
 
     def __enter__(self):
-        self._ann.__enter__()
+        if trace.enabled():
+            self._t0 = trace.now()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:           # noqa: BLE001 — annotation best-effort
+            self._ann = None
         return self
 
     def __exit__(self, *exc):
-        return self._ann.__exit__(*exc)
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:       # noqa: BLE001
+                pass
+        if self._t0 is not None:
+            trace.complete(self.name, self._t0, cat="annotation")
+            self._t0 = None
+        return False
 
 
 record_event = RecordEvent
@@ -59,12 +137,10 @@ def cuda_profiler(*a, **k):  # API parity; no CUDA on TPU
 
 
 def reset_profiler():
-    """Clear accumulated profile events (profiler.py reset_profiler)."""
-    import jax
-    try:
-        jax.profiler.stop_trace()
-    except RuntimeError:
-        pass                          # no trace running
+    """Clear accumulated profile events (profiler.py reset_profiler):
+    stops any live device trace and empties the host event buffer."""
+    _stop_jax_trace()
+    trace.reset()
 
 
 def start_gperf_profiler():

@@ -58,6 +58,69 @@ class ProgBarLogger(Callback):
             print(f"epoch {epoch} done in {dt:.1f}s: {logs}")
 
 
+class ProfilerCallback(Callback):
+    """Observability-plane profiling during fit() (reference hapi has no
+    analog; reference users wrapped fit in fluid.profiler by hand).
+
+    Per-batch ``hapi::train_batch`` spans + a ``hapi.step_seconds`` timing
+    histogram land in the host plane (fluid/trace.py); an optional
+    ``batch_range=[lo, hi)`` window additionally runs the device profiler
+    (utils.profiler semantics, degrade-no-crash).  On train end the
+    timeline exports to ``timeline_path`` (default FLAGS_trace_path) and
+    the sorted op summary prints."""
+
+    def __init__(self, batch_range=None, timeline_path=None,
+                 sorted_key="total", verbose=1):
+        from ..utils.profiler import Profiler, ProfilerOptions
+        # option validation (batch_range shape/ordering, sorted_key) and
+        # the [lo, hi) start/stop state machine both live in
+        # utils.profiler — one implementation, reference semantics
+        opts = {"sorted_key": sorted_key}
+        if batch_range is not None:
+            opts["batch_range"] = list(batch_range)
+        popts = ProfilerOptions(opts)       # validates even without a window
+        self._dev = Profiler(popts) if batch_range is not None else None
+        self.timeline_path = timeline_path
+        self.sorted_key = sorted_key
+        self.verbose = verbose
+        self._t0 = None
+        self._was_enabled = False
+
+    def on_train_begin(self, logs=None):
+        from ..fluid import trace
+        self._was_enabled = trace.enabled()
+        trace.enable()
+
+    def on_train_batch_begin(self, step, logs=None):
+        from ..fluid import trace
+        if self._dev is not None:
+            self._dev.step()
+        self._t0 = trace.now()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..fluid import trace
+        if self._t0 is not None:
+            trace.complete("hapi::train_batch", self._t0, cat="step",
+                           args={"step": int(step)})
+            trace.metrics().histogram("hapi.step_seconds").observe(
+                (trace.now() - self._t0) / 1e9)
+            self._t0 = None
+
+    def on_train_end(self, logs=None):
+        from ..fluid import trace
+        if self._dev is not None:
+            self._dev.stop()        # no-op unless the window is open
+        path = trace.export_chrome_trace(self.timeline_path)
+        if self.verbose:
+            if self._dev is None:
+                # a batch_range window already printed the report via
+                # stop_profiler — don't repeat it at train end
+                print(trace.summary_table(self.sorted_key or "total"))
+            print(f"[ProfilerCallback] timeline: {path}")
+        if not self._was_enabled:
+            trace.disable()
+
+
 class ModelCheckpoint(Callback):
     def __init__(self, save_freq=1, save_dir=None):
         self.save_freq = save_freq

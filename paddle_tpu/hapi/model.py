@@ -305,6 +305,7 @@ class Model:
             cbs.on_epoch_begin(epoch)
             losses = []
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
                 ins, lbs = _split_batch(batch)
                 vals = self.train_batch(ins, lbs)
                 losses.append(vals[0])

@@ -1,0 +1,45 @@
+"""paddle_tpu.observability — the unified runtime observability surface.
+
+One import gives the whole plane (also aliased as ``paddle_tpu.profiler``):
+
+* event stream + gating: ``enable`` / ``disable`` / ``span`` / ``instant``
+  / ``export_chrome_trace`` / ``op_summary`` (fluid/trace.py);
+* profiler facade: ``profiler()`` / ``RecordEvent`` / ``reset_profiler``
+  (fluid/profiler.py — host plane + best-effort jax.profiler);
+* metrics: ``metrics()`` registry, monitor STAT_* macros
+  (fluid/monitor.py);
+* option-driven batch windows: ``Profiler`` / ``ProfilerOptions`` /
+  ``get_profiler`` (utils/profiler.py).
+
+See docs/observability.md for the event model and viewer workflow.
+"""
+from ..fluid.trace import (                                    # noqa: F401
+    enabled, enable, disable, reset, reset_all, now, complete, instant,
+    counter_event, add_event, span, get_events, set_path, get_path,
+    set_max_events, export_chrome_trace, op_summary, summary_table,
+    metrics, MetricsRegistry, Counter, Gauge, Histogram, SORTED_KEYS)
+from ..fluid.profiler import (                                 # noqa: F401
+    profiler, start_profiler, stop_profiler, reset_profiler, RecordEvent,
+    record_event, cuda_profiler)
+from ..fluid import monitor                                    # noqa: F401
+from ..fluid.monitor import (                                  # noqa: F401
+    StatRegistry, stat_add, stat_sub, stat_get, print_stats)
+from ..utils.profiler import (                                 # noqa: F401
+    Profiler, ProfilerOptions, get_profiler)
+
+__all__ = [
+    # event stream
+    "enabled", "enable", "disable", "reset", "reset_all", "now",
+    "complete", "instant", "counter_event", "add_event", "span",
+    "get_events", "set_path", "get_path", "set_max_events",
+    "export_chrome_trace",
+    "op_summary", "summary_table", "SORTED_KEYS",
+    # metrics
+    "metrics", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "StatRegistry", "stat_add", "stat_sub", "stat_get", "print_stats",
+    "monitor",
+    # profiler facade
+    "profiler", "start_profiler", "stop_profiler", "reset_profiler",
+    "RecordEvent", "record_event", "cuda_profiler",
+    "Profiler", "ProfilerOptions", "get_profiler",
+]

@@ -19,11 +19,43 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..fluid import trace
 from .registry import register_op
 
 
 def _axis(ctx, attrs):
     return ctx.axis_for_ring(attrs.get("ring_id", 0))
+
+
+def _annotate(op_type, fn):
+    """Observability-plane comm annotation: spans (cat="comm") carry the
+    ring -> mesh-axis resolution so a timeline shows WHICH collective on
+    WHICH axis, nested inside the generic per-op dispatch span.  At
+    trace/lowering time only (XLA owns the device schedule); one boolean
+    when the plane is off."""
+    def lower(ins, attrs, ctx):
+        if not trace.enabled():
+            return fn(ins, attrs, ctx)
+        t0 = trace.now()
+        out = fn(ins, attrs, ctx)
+        trace.complete(op_type, t0, cat="comm",
+                       args={"ring_id": int(attrs.get("ring_id", 0)),
+                             "axis": _axis(ctx, attrs)})
+        return out
+    lower.__name__ = f"comm_{op_type}"
+    return lower
+
+
+def register_comm_op(type, fn=None, **kwargs):
+    """register_op for data-moving collectives: same contract, comm-span
+    annotated (bootstrap no-ops stay unannotated)."""
+    if fn is not None:
+        return register_op(type, _annotate(type, fn), **kwargs)
+
+    def deco(f):
+        register_op(type, _annotate(type, f), **kwargs)
+        return f
+    return deco
 
 
 def _allreduce(reducer):
@@ -36,16 +68,16 @@ def _allreduce(reducer):
     return lower
 
 
-register_op("c_allreduce_sum", _allreduce(lax.psum))
-register_op("c_allreduce_max", _allreduce(lax.pmax))
-register_op("c_allreduce_min", _allreduce(lax.pmin))
-register_op("c_allreduce_prod", _allreduce(
+register_comm_op("c_allreduce_sum", _allreduce(lax.psum))
+register_comm_op("c_allreduce_max", _allreduce(lax.pmax))
+register_comm_op("c_allreduce_min", _allreduce(lax.pmin))
+register_comm_op("c_allreduce_prod", _allreduce(
     lambda x, axis_name: jnp.exp(lax.psum(jnp.log(x), axis_name=axis_name))))
-register_op("allreduce", _allreduce(lax.psum))  # legacy operators/nccl era
-register_op("c_allreduce_avg", _allreduce(lax.pmean))
+register_comm_op("allreduce", _allreduce(lax.psum))  # legacy operators/nccl era
+register_comm_op("c_allreduce_avg", _allreduce(lax.pmean))
 
 
-@register_op("c_allgather")
+@register_comm_op("c_allgather")
 def _c_allgather(ins, attrs, ctx):
     x = ins["X"][0]
     axis = _axis(ctx, attrs)
@@ -55,7 +87,7 @@ def _c_allgather(ins, attrs, ctx):
     return {"Out": [g.reshape((-1,) + x.shape[1:])]}
 
 
-@register_op("c_reducescatter")
+@register_comm_op("c_reducescatter")
 def _c_reducescatter(ins, attrs, ctx):
     x = ins["X"][0]
     axis = _axis(ctx, attrs)
@@ -64,7 +96,7 @@ def _c_reducescatter(ins, attrs, ctx):
     return {"Out": [lax.psum_scatter(x, axis_name=axis, tiled=True)]}
 
 
-@register_op("c_broadcast")
+@register_comm_op("c_broadcast")
 def _c_broadcast(ins, attrs, ctx):
     x = ins["X"][0]
     axis = _axis(ctx, attrs)
@@ -87,14 +119,14 @@ def _c_reduce(reducer):
     return lower
 
 
-register_op("c_reduce_sum", _c_reduce(lax.psum))
-register_op("c_reduce_max", _c_reduce(lax.pmax))
-register_op("c_reduce_min", _c_reduce(lax.pmin))
-register_op("c_reduce_prod", _c_reduce(
+register_comm_op("c_reduce_sum", _c_reduce(lax.psum))
+register_comm_op("c_reduce_max", _c_reduce(lax.pmax))
+register_comm_op("c_reduce_min", _c_reduce(lax.pmin))
+register_comm_op("c_reduce_prod", _c_reduce(
     lambda x, axis_name: jnp.exp(lax.psum(jnp.log(x), axis_name=axis_name))))
 
 
-@register_op("c_scatter")
+@register_comm_op("c_scatter")
 def _c_scatter(ins, attrs, ctx):
     x = ins["X"][0]
     axis = _axis(ctx, attrs)
@@ -106,7 +138,7 @@ def _c_scatter(ins, attrs, ctx):
     return {"Out": [lax.dynamic_index_in_dim(chunks, idx, keepdims=False)]}
 
 
-@register_op("c_concat")
+@register_comm_op("c_concat")
 def _c_concat(ins, attrs, ctx):
     # tensor-parallel all-gather along last dim (model-parallel fc output)
     x = ins["X"][0]
@@ -117,7 +149,7 @@ def _c_concat(ins, attrs, ctx):
                                    tiled=True)]}
 
 
-@register_op("c_split")
+@register_comm_op("c_split")
 def _c_split(ins, attrs, ctx):
     x = ins["X"][0]
     axis = _axis(ctx, attrs)
@@ -135,7 +167,7 @@ def _c_identity(ins, attrs, ctx):
     return {"Out": [ins["X"][0]]}
 
 
-@register_op("send_v2", differentiable=False)
+@register_comm_op("send_v2", differentiable=False)
 def _send_v2(ins, attrs, ctx):
     """p2p pipeline send (reference: operators/collective/send_v2_op.cc).
 
@@ -149,7 +181,7 @@ def _send_v2(ins, attrs, ctx):
     return {}
 
 
-@register_op("recv_v2", differentiable=False)
+@register_comm_op("recv_v2", differentiable=False)
 def _recv_v2(ins, attrs, ctx):
     ring = int(attrs.get("ring_id", 0))
     if ring not in ctx.p2p:
@@ -165,12 +197,12 @@ def _recv_v2(ins, attrs, ctx):
     return {"Out": [lax.ppermute(x, axis, perm)]}
 
 
-@register_op("partial_send", differentiable=False)
+@register_comm_op("partial_send", differentiable=False)
 def _partial_send(ins, attrs, ctx):
     return {}
 
 
-@register_op("c_ppermute")
+@register_comm_op("c_ppermute")
 def _c_ppermute(ins, attrs, ctx):
     """Native ring shift (no reference analog — exposed for ring attention
     and pipeline p2p).  attrs: shift (+1 = to next rank)."""
@@ -184,7 +216,7 @@ def _c_ppermute(ins, attrs, ctx):
     return {"Out": [lax.ppermute(x, axis, perm)]}
 
 
-@register_op("barrier", differentiable=False)
+@register_comm_op("barrier", differentiable=False)
 def _barrier(ins, attrs, ctx):
     x = ins["X"][0] if ins.get("X") else jnp.zeros((1,), jnp.float32)
     axis = _axis(ctx, attrs)

@@ -5,6 +5,7 @@ from __future__ import annotations
 import os
 
 from ..fluid import profiler as _prof
+from ..fluid import trace as _trace
 
 __all__ = ["ProfilerOptions", "Profiler", "get_profiler"]
 
@@ -25,6 +26,24 @@ class ProfilerOptions:
                     elif k == "timer_only":
                         v = v.strip().lower() in ("1", "true", "yes")
                 self._options[k] = v
+        self._validate()
+
+    def _validate(self):
+        br = self._options["batch_range"]
+        if (not isinstance(br, (list, tuple)) or len(br) != 2
+                or not all(isinstance(x, int) for x in br)):
+            raise ValueError(
+                f"batch_range must be two ints [start, end], got {br!r}")
+        lo, hi = br
+        if lo < 0 or hi < 0 or lo >= hi:
+            raise ValueError(
+                f"batch_range [start, end) needs 0 <= start < end, got "
+                f"[{lo}, {hi}] — the profiling window would never open")
+        sk = self._options["sorted_key"]
+        if sk is not None and sk not in _trace.SORTED_KEYS:
+            raise ValueError(
+                f"sorted_key must be one of {_trace.SORTED_KEYS}, "
+                f"got {sk!r}")
 
     def __getitem__(self, name):
         return self._options[name]
@@ -39,7 +58,8 @@ class Profiler:
     def start(self):
         if not self._options["timer_only"]:
             _prof.start_profiler(self._options["state"],
-                                 self._options["tracer_option"])
+                                 self._options["tracer_option"],
+                                 self._options["profile_path"])
             self._running = True
 
     def stop(self):
@@ -58,15 +78,23 @@ class Profiler:
 
 
 _profiler = None
+_profiler_env = None
 
 
 def get_profiler():
-    global _profiler
-    if _profiler is None:
+    """Build (or rebuild) the env-configured profiler.  The reference
+    cached the FIRST instance forever, silently ignoring later
+    FLAGS_profile_options changes; here a changed env string invalidates
+    the cache, so tests/batch scripts can re-point the window."""
+    global _profiler, _profiler_env
+    env = os.environ.get("FLAGS_profile_options")
+    if _profiler is None or env != _profiler_env:
+        if _profiler is not None:
+            _profiler.stop()         # close a live window before rebuild
         opts = None
-        env = os.environ.get("FLAGS_profile_options")
         if env:
             kv = dict(p.split("=", 1) for p in env.split(";") if "=" in p)
             opts = ProfilerOptions(kv)
         _profiler = Profiler(opts)
+        _profiler_env = env
     return _profiler

@@ -1,0 +1,498 @@
+"""Unified observability plane (fluid/trace.py + profiler/monitor/timeline
+integration): span nesting, metrics math, compile-cache instrumentation,
+Chrome-trace schema, summary sort keys, flag gating."""
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.fluid import trace
+
+
+@pytest.fixture(autouse=True)
+def clean_plane():
+    """Each test starts with a disabled plane, empty buffer, zero metrics."""
+    trace.disable()
+    trace.reset_all()
+    yield
+    trace.disable()
+    trace.reset_all()
+
+
+def _timeline_mod():
+    spec = importlib.util.spec_from_file_location(
+        "timeline", os.path.join(os.path.dirname(__file__), "..",
+                                 "tools", "timeline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _two_op_program():
+    import paddle_tpu.fluid as fluid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [4])
+        y = fluid.layers.scale(x, scale=2.0)
+        z = fluid.layers.mean(y)
+    return main, z
+
+
+class TestEventStream:
+    def test_span_nesting(self):
+        trace.enable()
+        with trace.span("outer", cat="annotation"):
+            time.sleep(0.002)
+            with trace.span("inner", cat="annotation"):
+                time.sleep(0.001)
+        evs = {e["name"]: e for e in trace.get_events()}
+        outer, inner = evs["outer"], evs["inner"]
+        # the child's window nests inside the parent's
+        assert inner["ts"] >= outer["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1
+        assert outer["dur"] >= inner["dur"]
+        for e in (outer, inner):
+            assert e["ph"] == "X" and "pid" in e and "tid" in e
+
+    def test_zero_events_when_disabled(self):
+        assert not trace.enabled()
+        with trace.span("nope"):
+            pass
+        trace.complete("also-nope", trace.now())  # hot path emits via guard
+        # span() emitted nothing; the raw complete() IS recorded (callers
+        # guard) — only the span/hot-path contract is gate-checked here
+        assert all(e["name"] != "nope" for e in trace.get_events())
+
+    def test_instant_and_counter_events(self):
+        trace.enable()
+        trace.instant("marker", cat="compile", args={"k": 1})
+        trace.counter_event("queue_depth", 7)
+        phs = {e["name"]: e["ph"] for e in trace.get_events()}
+        assert phs == {"marker": "i", "queue_depth": "C"}
+
+    def test_enable_syncs_core_flag(self):
+        from paddle_tpu.fluid import core
+        trace.enable()
+        assert core.get_flag("enable_trace") is True
+        trace.disable()
+        assert core.get_flag("enable_trace") is False
+
+    def test_set_path_syncs_core_flag(self):
+        from paddle_tpu.fluid import core
+        prev = trace.get_path()
+        try:
+            trace.set_path("/tmp/_sync_check.json")
+            assert core.get_flag("trace_path") == "/tmp/_sync_check.json"
+        finally:
+            trace.set_path(prev)
+
+    def test_event_buffer_bounded(self, tmp_path, capsys):
+        from paddle_tpu.fluid.trace import _state
+        prev = _state.max_events
+        trace.enable()
+        try:
+            trace.set_max_events(2)
+            for i in range(4):
+                trace.add_event(f"e{i}", float(i), 1.0)
+            assert len(trace.get_events()) == 2
+            assert "buffer full" in capsys.readouterr().err
+            doc = json.loads(open(trace.export_chrome_trace(
+                str(tmp_path / "capped.json"))).read())
+            assert doc["metadata"]["dropped_events"] == 2
+            trace.reset()            # reset clears the drop count too
+            assert _state.dropped == 0
+        finally:
+            trace.set_max_events(prev)
+
+    def test_export_survives_numpy_args(self, tmp_path):
+        trace.enable()
+        trace.instant("np", args={"n": np.int64(3),
+                                  "v": np.float32(1.5)})
+        path = trace.export_chrome_trace(str(tmp_path / "np.json"))
+        assert json.loads(open(path).read())["traceEvents"]
+
+    def test_set_flags_drives_plane(self):
+        from paddle_tpu.fluid import core
+        core.set_flags({"FLAGS_enable_trace": True})
+        try:
+            assert trace.enabled()
+            core.set_flags({"FLAGS_trace_path": "/tmp/_custom_tl.json"})
+            assert trace.get_path() == "/tmp/_custom_tl.json"
+        finally:
+            core.set_flags({"FLAGS_enable_trace": False})
+        assert not trace.enabled()
+
+
+class TestMetricsRegistry:
+    def test_counter_math(self):
+        c = trace.metrics().counter("t/c")
+        assert c.add(5) == 5
+        assert c.inc() == 6
+        assert c.dec(2) == 4
+        assert c.value == 4
+        c.reset()
+        assert c.value == 0
+
+    def test_gauge(self):
+        g = trace.metrics().gauge("t/g")
+        g.set(3.5)
+        assert g.value == 3.5
+
+    def test_histogram_math(self):
+        h = trace.metrics().histogram("t/h")
+        for v in (1.0, 2.0, 3.0):
+            h.observe(v)
+        s = h.stats()
+        assert s["count"] == 3 and s["total"] == 6.0
+        assert s["min"] == 1.0 and s["max"] == 3.0 and s["avg"] == 2.0
+        assert sum(n for _, n in h.buckets()) == 3
+
+    def test_type_collision_raises(self):
+        trace.metrics().counter("t/typed")
+        with pytest.raises(TypeError):
+            trace.metrics().gauge("t/typed")
+
+    def test_monitor_backed_by_plane(self):
+        """StatRegistry and the metrics registry share cells (tentpole:
+        trace.py subsumes and backs monitor.py)."""
+        from paddle_tpu.fluid import monitor
+        monitor.stat_add("t/shared", 3)
+        assert trace.metrics().counter("t/shared").value == 3
+        trace.metrics().counter("t/shared").inc(2)
+        assert monitor.stat_get("t/shared") == 5
+
+    def test_monitor_reset_all(self):
+        from paddle_tpu.fluid import monitor
+        monitor.stat_add("t/r1", 7)
+        monitor.stat_add("t/r2", 9)
+        monitor.StatRegistry.instance().reset_all()
+        assert monitor.stat_get("t/r1") == 0
+        assert monitor.stat_get("t/r2") == 0
+
+    def test_monitor_thread_safety(self):
+        from paddle_tpu.fluid import monitor
+        monitor.StatRegistry.instance().get("t/mt").reset()
+        ts = [threading.Thread(
+            target=lambda: [monitor.stat_add("t/mt") for _ in range(500)])
+            for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert monitor.stat_get("t/mt") == 2000
+
+
+class TestExecutorInstrumentation:
+    def test_compile_cache_hit_miss(self):
+        import paddle_tpu.fluid as fluid
+        main, z = _two_op_program()
+        exe = fluid.Executor()
+        trace.enable()
+        feed = {"x": np.ones(4, "float32")}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[z])
+        names = [e["name"] for e in trace.get_events()]
+        assert names.count("compile_cache_miss") == 1
+        assert names.count("compile_cache_hit") == 2
+        assert names.count("executor::compile") == 1
+        assert names.count("executor::step") == 3
+        m = trace.metrics()
+        assert m.counter("executor.compile_cache_miss").value == 1
+        assert m.counter("executor.compile_cache_hit").value == 2
+        assert m.histogram("executor.compile_seconds").count == 1
+
+    def test_per_op_spans(self):
+        import paddle_tpu.fluid as fluid
+        main, z = _two_op_program()
+        exe = fluid.Executor()
+        trace.enable()
+        exe.run(main, feed={"x": np.ones(4, "float32")}, fetch_list=[z])
+        ops = {e["name"] for e in trace.get_events() if e["cat"] == "op"}
+        assert {"scale", "mean"} <= ops
+
+    def test_disabled_run_emits_nothing(self):
+        import paddle_tpu.fluid as fluid
+        main, z = _two_op_program()
+        exe = fluid.Executor()
+        assert not trace.enabled()
+        exe.run(main, feed={"x": np.ones(4, "float32")}, fetch_list=[z])
+        assert trace.get_events() == []
+        # counters still tick (always-on stats, events gated)
+        assert trace.metrics().counter(
+            "executor.compile_cache_miss").value == 1
+
+    def test_dygraph_op_spans(self):
+        from paddle_tpu.dygraph import base as dybase
+        with dybase.guard():
+            trace.enable()
+            a = dybase.to_variable(np.ones((2, 2), "float32"))
+            _ = a + a
+        evs = [e for e in trace.get_events() if e["cat"] == "dygraph_op"]
+        assert any(e["name"] == "elementwise_add" for e in evs)
+
+    def test_comm_op_annotation(self):
+        from paddle_tpu.ops.registry import get_op, LoweringContext
+        import jax.numpy as jnp
+        trace.enable()
+        out = get_op("c_allreduce_sum").fn(
+            {"X": [jnp.ones((2,))]}, {"ring_id": 0}, LoweringContext())
+        assert out["Out"][0].shape == (2,)
+        comm = [e for e in trace.get_events() if e["cat"] == "comm"]
+        assert comm and comm[0]["name"] == "c_allreduce_sum"
+        assert comm[0]["args"]["ring_id"] == 0
+
+
+class TestChromeTraceExport:
+    def test_schema(self, tmp_path):
+        trace.enable()
+        with trace.span("a"):
+            pass
+        trace.instant("m")
+        trace.metrics().counter("t/exp").inc()
+        path = trace.export_chrome_trace(str(tmp_path / "t.json"))
+        doc = json.loads(open(path).read())
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in evs)
+        last = None
+        for e in evs:
+            if e["ph"] == "M":
+                continue
+            assert "pid" in e and "tid" in e and e["ts"] >= 0
+            if last is not None:
+                assert e["ts"] >= last       # monotonic
+            last = e["ts"]
+        # terminal metric sample rides along as a counter event
+        assert any(e["ph"] == "C" and e["name"] == "t/exp" for e in evs)
+
+    def test_timeline_tool_validate_and_merge(self, tmp_path):
+        trace.enable()
+        with trace.span("w"):
+            pass
+        p1 = trace.export_chrome_trace(str(tmp_path / "a.json"))
+        p2 = trace.export_chrome_trace(str(tmp_path / "b.json"))
+        tl = _timeline_mod()
+        assert tl.validate_timeline(p1)
+        out = str(tmp_path / "merged.json")
+        assert tl.convert([p1, p2], out) == 0
+        merged = tl.validate_timeline(out)
+        # same-pid inputs got re-keyed into distinct process rows
+        assert len({e["pid"] for e in merged}) >= 2
+
+    def test_validator_rejects_garbage(self, tmp_path):
+        tl = _timeline_mod()
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"traceEvents": [{"ph": "X"}]}))
+        with pytest.raises(ValueError):
+            tl.validate_timeline(str(bad))
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"traceEvents": []}))
+        with pytest.raises(ValueError):
+            tl.validate_timeline(str(empty))
+
+
+class TestSummaryTable:
+    def _seed_events(self):
+        trace.enable()
+        # deterministic windows via add_event (ts/dur in us)
+        trace.add_event("opA", 0.0, 10.0)     # calls 2, total 30, max 20
+        trace.add_event("opA", 20.0, 20.0)
+        trace.add_event("opB", 50.0, 25.0)    # calls 1, total 25, min 25
+
+    def test_sort_total_and_calls(self):
+        self._seed_events()
+        by_total = [r[0] for r in trace.op_summary("total")]
+        assert by_total == ["opA", "opB"]
+        by_calls = [r[0] for r in trace.op_summary("calls")]
+        assert by_calls == ["opA", "opB"]
+
+    def test_sort_min_max_ave(self):
+        self._seed_events()
+        assert [r[0] for r in trace.op_summary("max")] == ["opB", "opA"]
+        assert [r[0] for r in trace.op_summary("min")] == ["opB", "opA"]
+        assert [r[0] for r in trace.op_summary("ave")] == ["opB", "opA"]
+
+    def test_row_math(self):
+        self._seed_events()
+        row = {r[0]: r for r in trace.op_summary("total")}["opA"]
+        name, calls, total, lo, hi, ave = row
+        assert (calls, total, lo, hi, ave) == (2, 30.0, 10.0, 20.0, 15.0)
+
+    def test_invalid_key_raises(self):
+        with pytest.raises(ValueError):
+            trace.op_summary("bogus")
+
+    def test_table_renders(self):
+        self._seed_events()
+        txt = trace.summary_table("total")
+        assert "opA" in txt and "Calls" in txt
+
+
+class TestProfilerFacade:
+    def test_record_event_emits_plane_span(self):
+        from paddle_tpu.fluid.profiler import RecordEvent
+        trace.enable()
+        with RecordEvent("anno"):
+            pass
+        evs = [e for e in trace.get_events() if e["cat"] == "annotation"]
+        assert evs and evs[0]["name"] == "anno"
+
+    def test_profiler_degrades_when_jax_trace_raises(self, monkeypatch,
+                                                     tmp_path, capsys):
+        import jax
+        from paddle_tpu.fluid import profiler as fprof
+
+        def boom(*a, **k):
+            raise RuntimeError("no profiler backend")
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        with fprof.profiler(profile_path=str(tmp_path)):
+            with fprof.RecordEvent("inside"):
+                pass
+        # host plane captured the span despite the device tier failing
+        out = capsys.readouterr()
+        assert "host-only" in out.err
+        assert os.path.exists(str(tmp_path / "paddle_tpu_timeline.json"))
+
+    def test_reset_profiler_clears_events(self):
+        from paddle_tpu.fluid.profiler import reset_profiler
+        trace.enable()
+        with trace.span("x"):
+            pass
+        assert trace.get_events()
+        reset_profiler()            # fixed: no shadow import, no crash
+        assert trace.get_events() == []
+
+    def test_reset_inside_open_span_keeps_ts_nonnegative(self):
+        """reset() must not rebase the epoch: a span in flight across it
+        still exports a valid (non-negative, monotonic) ts."""
+        trace.enable()
+        with trace.span("straddler"):
+            trace.reset()
+        ev, = trace.get_events()
+        assert ev["name"] == "straddler" and ev["ts"] >= 0
+
+    def test_get_profiler_rereads_env(self, monkeypatch):
+        from paddle_tpu.utils import profiler as uprof
+        monkeypatch.setattr(uprof, "_profiler", None)
+        monkeypatch.setattr(uprof, "_profiler_env", None)
+        monkeypatch.delenv("FLAGS_profile_options", raising=False)
+        p1 = uprof.get_profiler()
+        assert uprof.get_profiler() is p1            # stable env -> cached
+        monkeypatch.setenv("FLAGS_profile_options",
+                           "batch_range=[2,5];sorted_key=calls")
+        p2 = uprof.get_profiler()
+        assert p2 is not p1                          # env change -> rebuilt
+        assert p2._options["batch_range"] == [2, 5]
+        assert p2._options["sorted_key"] == "calls"
+        assert uprof.get_profiler() is p2
+
+    def test_get_profiler_rebuild_stops_live_window(self, monkeypatch):
+        from paddle_tpu.utils import profiler as uprof
+        monkeypatch.setattr(uprof, "_profiler", None)
+        monkeypatch.setattr(uprof, "_profiler_env", None)
+        monkeypatch.setenv("FLAGS_profile_options", "batch_range=[0,9]")
+        p1 = uprof.get_profiler()
+        started = []
+        monkeypatch.setattr(p1, "start", lambda: (started.append(1),
+                            setattr(p1, "_running", True)))
+        stopped = []
+        monkeypatch.setattr(p1, "stop", lambda: (stopped.append(1),
+                            setattr(p1, "_running", False)))
+        p1.step()                    # batch 0 == lo -> window opens
+        assert started and p1._running
+        monkeypatch.setenv("FLAGS_profile_options", "batch_range=[1,9]")
+        p2 = uprof.get_profiler()    # env change -> rebuild
+        assert p2 is not p1
+        assert stopped and not p1._running   # old window was closed
+
+    def test_batch_range_validation(self):
+        from paddle_tpu.utils.profiler import ProfilerOptions
+        with pytest.raises(ValueError):
+            ProfilerOptions({"batch_range": "[5, 2]"})
+        with pytest.raises(ValueError):
+            ProfilerOptions({"batch_range": [-1, 3]})
+        with pytest.raises(ValueError):
+            ProfilerOptions({"sorted_key": "bogus"})
+        assert ProfilerOptions({"batch_range": "[1, 4]"})[
+            "batch_range"] == [1, 4]
+
+    def test_profiler_timer_only_step_window(self):
+        from paddle_tpu.utils.profiler import Profiler, ProfilerOptions
+        p = Profiler(ProfilerOptions({"batch_range": [1, 3],
+                                      "timer_only": True}))
+        for _ in range(5):
+            p.step()
+        assert p._batch == 5 and not p._running
+
+
+class TestProfilerCallback:
+    def test_batch_spans_and_export(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+        out = str(tmp_path / "fit_timeline.json")
+        cb = ProfilerCallback(timeline_path=out, verbose=0)
+        cb.on_train_begin()
+        for s in range(3):
+            cb.on_train_batch_begin(s)
+            cb.on_train_batch_end(s)
+        cb.on_train_end()
+        evs = _timeline_mod().validate_timeline(out)
+        steps = [e for e in evs if e.get("name") == "hapi::train_batch"]
+        assert len(steps) == 3
+        assert trace.metrics().histogram("hapi.step_seconds").count == 3
+        assert not trace.enabled()   # restored caller's gating
+
+    def test_validates_args(self):
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+        with pytest.raises(ValueError):
+            ProfilerCallback(batch_range=[5, 2])
+        with pytest.raises(ValueError):
+            ProfilerCallback(sorted_key="bogus")
+        with pytest.raises(ValueError):
+            ProfilerCallback(batch_range=[1.5, 3.0])   # ints required
+
+    def test_fit_dispatches_batch_begin(self, tmp_path):
+        """The real fit() loop must drive on_train_batch_begin — the spans
+        and step histogram are dead otherwise."""
+        import paddle_tpu as paddle
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.nn import Linear
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+        from paddle_tpu import optimizer as opt
+        dybase.enable_dygraph()
+        try:
+            net = Linear(4, 1)
+            model = paddle.Model(net)
+            model.prepare(
+                optimizer=opt.SGD(0.1, parameters=net.parameters()),
+                loss=lambda p, y: paddle.fluid.layers.reduce_mean(
+                    paddle.fluid.layers.square(p - y)))
+            xs = np.random.RandomState(0).randn(8, 4).astype("float32")
+            ys = np.zeros((8, 1), "float32")
+            out = str(tmp_path / "fit_tl.json")
+            model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=4,
+                      epochs=1, verbose=0,
+                      callbacks=[ProfilerCallback(timeline_path=out,
+                                                  verbose=0)])
+        finally:
+            dybase.disable_dygraph()
+        evs = _timeline_mod().validate_timeline(out)
+        steps = [e for e in evs if e.get("name") == "hapi::train_batch"]
+        assert len(steps) == 2      # 8 samples / batch 4
+        assert trace.metrics().histogram("hapi.step_seconds").count == 2
+
+
+class TestPackageSurface:
+    def test_profiler_alias(self):
+        import paddle_tpu
+        import paddle_tpu.profiler as prof
+        assert prof is paddle_tpu.observability
+        assert prof.enable is trace.enable
+        assert callable(prof.profiler) and callable(prof.stat_add)
+        assert prof.Profiler is not None
+
+    def test_fluid_trace_exported(self):
+        import paddle_tpu.fluid as fluid
+        assert fluid.trace is trace

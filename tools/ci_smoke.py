@@ -120,6 +120,41 @@ def main():
         assert r.returncode == 0, r.stderr
         assert "served without paddle_tpu" in r.stdout
 
+    step("observability: traced 2-op program -> schema-valid timeline "
+         "(1 compile miss, >=1 hit)")
+    import importlib.util
+    code = (
+        "import numpy as np\n"
+        "import paddle_tpu.fluid as fluid\n"
+        "main, startup = fluid.Program(), fluid.Program()\n"
+        "with fluid.program_guard(main, startup):\n"
+        "    x = fluid.data('x', [4])\n"
+        "    y = fluid.layers.scale(x, scale=2.0)\n"
+        "    z = fluid.layers.mean(y)\n"
+        "exe = fluid.Executor()\n"
+        "for _ in range(2):\n"
+        "    exe.run(main, feed={'x': np.ones(4, 'float32')},\n"
+        "            fetch_list=[z])\n")
+    with tempfile.TemporaryDirectory() as td:
+        tj = os.path.join(td, "timeline.json")
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                     FLAGS_enable_trace="1", FLAGS_trace_path=tj),
+            cwd=_ROOT, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        spec = importlib.util.spec_from_file_location(
+            "timeline", os.path.join(_ROOT, "tools", "timeline.py"))
+        tl = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tl)
+        evs = tl.validate_timeline(tj)
+        assert evs, "timeline is empty"
+        names = [e.get("name") for e in evs]
+        assert names.count("compile_cache_miss") == 1, names
+        assert names.count("compile_cache_hit") >= 1, names
+        assert any(e.get("cat") == "op" for e in evs), \
+            "no per-op spans in timeline"
+
     step("bench child emits one JSON line (cpu)")
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],

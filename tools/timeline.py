@@ -1,21 +1,115 @@
 #!/usr/bin/env python
-"""Timeline viewer prep (reference tools/timeline.py: profiler proto ->
+"""Timeline tool (reference tools/timeline.py: profiler proto ->
 chrome://tracing JSON).
 
-The JAX profiler (fluid.profiler) already writes a gzipped Chrome trace in
-<logdir>/plugins/profile/<run>/*.trace.json.gz; this tool finds the newest
-run and extracts it to a plain .json loadable in chrome://tracing or
-https://ui.perfetto.dev.
+Two producers feed it:
+
+* the framework-native observability plane (paddle_tpu/fluid/trace.py)
+  writes Chrome-trace JSON directly (FLAGS_enable_trace=1 +
+  FLAGS_trace_path, or trace.export_chrome_trace()).  This tool merges one
+  or more such files — e.g. per-process traces from a multi-host run —
+  re-keys pids so processes don't collide (the reference merged
+  multi-device profile protos the same way), sorts events, validates the
+  schema, and writes a single timeline loadable in chrome://tracing or
+  https://ui.perfetto.dev;
+* the JAX/XLA profiler (fluid.profiler device tier) writes a gzipped
+  Chrome trace under <logdir>/plugins/profile/<run>/ — ``extract`` finds
+  the newest run and inflates it (legacy path, kept).
+
+Usage:
+    python tools/timeline.py --trace_path a.json,b.json --timeline_path out.json
+    python tools/timeline.py --profile_path /tmp/paddle_tpu_profile
 """
 import argparse
 import glob
 import gzip
+import json
 import os
 import shutil
 import sys
 
 
+def load_trace_events(path):
+    """Read one trace file: either {"traceEvents": [...]} (the plane's
+    exporter, chrome's save format) or a bare JSON event list."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        evs = doc.get("traceEvents")
+        if not isinstance(evs, list):
+            raise ValueError(f"{path}: no traceEvents list")
+        return evs
+    if isinstance(doc, list):
+        return doc
+    raise ValueError(f"{path}: not a chrome trace (dict or list expected)")
+
+
+def merge_traces(paths):
+    """Merge event streams from several trace files.  Each file keeps its
+    own pid namespace: on collision with an earlier file the pid is offset,
+    so two single-process traces stay distinguishable rows in Perfetto."""
+    merged, used_pids = [], set()
+    for path in paths:
+        evs = load_trace_events(path)
+        pids = {e.get("pid", 0) for e in evs}
+        offset = 0
+        if pids & used_pids:
+            offset = max(used_pids | {0}) + 1 - min(pids | {0})
+        for e in evs:
+            e = dict(e)
+            e["pid"] = e.get("pid", 0) + offset
+            merged.append(e)
+        used_pids |= {p + offset for p in pids}
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return merged
+
+
+def validate_timeline(path_or_events):
+    """Schema check for a timeline: non-empty traceEvents; every
+    non-metadata event carries name/ph/pid/tid and a numeric ts; "X"
+    events have non-negative dur; ts is monotonic (the exporter sorts).
+    Returns the event list; raises ValueError with the first violation."""
+    if isinstance(path_or_events, (list, tuple)):
+        evs = list(path_or_events)
+    else:
+        evs = load_trace_events(path_or_events)
+    if not evs:
+        raise ValueError("timeline has no events")
+    last_ts = None
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict) or "ph" not in e or "name" not in e:
+            raise ValueError(f"event {i}: missing ph/name: {e!r}")
+        if e["ph"] == "M":
+            continue
+        for field in ("pid", "tid", "ts"):
+            if field not in e:
+                raise ValueError(f"event {i} ({e['name']}): missing "
+                                 f"'{field}'")
+        ts = e["ts"]
+        if not isinstance(ts, (int, float)) or ts < 0:
+            raise ValueError(f"event {i} ({e['name']}): bad ts {ts!r}")
+        if last_ts is not None and ts < last_ts:
+            raise ValueError(f"event {i} ({e['name']}): ts {ts} < previous "
+                             f"{last_ts} — events must be sorted")
+        last_ts = ts
+        if e["ph"] == "X" and float(e.get("dur", 0)) < 0:
+            raise ValueError(f"event {i} ({e['name']}): negative dur")
+    return evs
+
+
+def convert(trace_paths, out):
+    """Merge + validate + write the final chrome trace."""
+    events = merge_traces(trace_paths)
+    validate_timeline(events)
+    with open(out, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    print(f"{len(events)} events from {len(trace_paths)} trace(s) -> {out}; "
+          f"open in chrome://tracing or ui.perfetto.dev")
+    return 0
+
+
 def extract(logdir, out):
+    """Legacy path: inflate the newest jax.profiler run's .trace.json.gz."""
     pats = sorted(glob.glob(os.path.join(
         logdir, "plugins", "profile", "*", "*.trace.json.gz")))
     if not pats:
@@ -28,9 +122,28 @@ def extract(logdir, out):
     return 0
 
 
-if __name__ == "__main__":
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--profile_path", default="/tmp/paddle_tpu_profile")
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trace_path", default=None,
+                    help="comma-separated observability-plane trace JSONs "
+                         "(FLAGS_trace_path outputs) to merge")
+    ap.add_argument("--profile_path", default="/tmp/paddle_tpu_profile",
+                    help="jax.profiler logdir (fallback when no "
+                         "--trace_path)")
     ap.add_argument("--timeline_path", default="timeline.json")
-    a = ap.parse_args()
-    sys.exit(extract(a.profile_path, a.timeline_path))
+    ap.add_argument("--validate", action="store_true",
+                    help="only validate --trace_path files, write nothing")
+    a = ap.parse_args(argv)
+    if a.trace_path:
+        paths = [p for p in a.trace_path.split(",") if p]
+        if a.validate:
+            for p in paths:
+                n = len(validate_timeline(p))
+                print(f"{p}: OK ({n} events)")
+            return 0
+        return convert(paths, a.timeline_path)
+    return extract(a.profile_path, a.timeline_path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

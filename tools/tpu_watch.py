@@ -15,20 +15,53 @@ import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# observability plane: probe/bench wall-times become spans + a step-time
+# histogram, so a watch session leaves a timeline (FLAGS_enable_trace=1
+# auto-exports to FLAGS_trace_path at exit) and prints a step-timing
+# summary after a sweep.  Loaded by file path — trace.py is stdlib-only —
+# so the watcher process stays jax-free (the canary subprocess exists
+# precisely because backend init can wedge when the tunnel flaps).
+import importlib.util  # noqa: E402
+_spec = importlib.util.spec_from_file_location(
+    "paddle_tpu_trace",
+    os.path.join(_ROOT, "paddle_tpu", "fluid", "trace.py"))
+trace = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(trace)
+
 
 def canary(budget=75):
     code = ("import jax; ds = jax.devices(); "
             "print('CANARY_OK', len(ds), jax.default_backend())")
+    _t0 = trace.now() if trace.enabled() else 0
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=budget)
-        return "CANARY_OK" in (r.stdout or "") and \
+        up = "CANARY_OK" in (r.stdout or "") and \
             " cpu" not in (r.stdout or "")
     except subprocess.TimeoutExpired:
-        return False
+        up = False
+    if _t0:
+        trace.complete("watch::canary", _t0, cat="step", args={"up": up})
+    return up
 
 
 def run_child(args, budget, extra_env=None, _retried=False):
+    """Bench child + step-timing surface: every child's wall time lands in
+    the watch.child_seconds histogram (and as a bench:: span when the
+    plane is enabled) so a watch session reports step timing at the end."""
+    label = " ".join(args) or "bert"
+    _t0 = trace.now() if trace.enabled() else 0
+    t_wall = time.time()
+    ok = _run_child(args, budget, extra_env, _retried)
+    trace.metrics().histogram("watch.child_seconds").observe(
+        time.time() - t_wall)
+    if _t0:
+        trace.complete(f"bench::{label}", _t0, cat="step",
+                       args={"ok": bool(ok)})
+    return ok
+
+
+def _run_child(args, budget, extra_env=None, _retried=False):
     env = dict(os.environ, GRAFT_BENCH_CHILD="1", **(extra_env or {}))
     t0 = time.time()
     try:
@@ -48,9 +81,11 @@ def run_child(args, budget, extra_env=None, _retried=False):
             if not _retried and r.returncode != 0:
                 print("[watch] retrying with PADDLE_TPU_UNFUSED_EPILOGUE=1",
                       flush=True)
-                return run_child(args, budget,
-                                 {"PADDLE_TPU_UNFUSED_EPILOGUE": "1"},
-                                 _retried=True)
+                # stay below the instrumented wrapper: one logical child =
+                # one watch.child_seconds sample / one bench:: span
+                return _run_child(args, budget,
+                                  {"PADDLE_TPU_UNFUSED_EPILOGUE": "1"},
+                                  _retried=True)
             return False
         print(f"[watch] {' '.join(args) or 'bert'}: {out[-1]} "
               f"({time.time()-t0:.0f}s)", flush=True)
@@ -136,6 +171,7 @@ def main():
             if ok:
                 print("[watch] sweep complete — evidence recorded",
                       flush=True)
+                _report_step_timing()
                 return 0
         else:
             parity_done = False
@@ -143,7 +179,22 @@ def main():
                   f"({time.strftime('%H:%M:%S')})", flush=True)
         time.sleep(interval)
     print("[watch] window expired with no TPU", flush=True)
+    _report_step_timing()
     return 1
+
+
+def _report_step_timing():
+    """Surface per-child step timing collected by the plane; with
+    FLAGS_enable_trace=1 also write the timeline now (belt over the
+    atexit braces)."""
+    h = trace.metrics().histogram("watch.child_seconds").stats()
+    if h["count"]:
+        print(f"[watch] step timing: {int(h['count'])} bench children, "
+              f"avg {h['avg']:.1f}s min {h['min']:.1f}s max {h['max']:.1f}s",
+              flush=True)
+    if trace.enabled() and trace.get_events():
+        print(f"[watch] timeline -> {trace.export_chrome_trace()}",
+              flush=True)
 
 
 if __name__ == "__main__":
