@@ -190,6 +190,21 @@ def timed_run(step_fn, steps, warmup):
     return sum(_LAST_CHUNKS)
 
 
+def _compile_stats():
+    """Recompile cost alongside throughput: the bench trajectory must show
+    compile-cache regressions (a miss is a whole-block XLA recompile), not
+    just steady-state step rate (docs/performance.md)."""
+    try:
+        from paddle_tpu.fluid import trace as _tr
+        m = _tr.metrics()
+        return {"compile_misses":
+                m.counter("executor.compile_cache_miss").value,
+                "compile_seconds": round(m.histogram(
+                    "executor.compile_seconds").stats()["total"], 3)}
+    except Exception:           # noqa: BLE001 — bench must report anyway
+        return {}
+
+
 def report(metric, unit, rate, flops_rate, backend, config=None):
     """One JSON line; vs_baseline = MFU / 0.35 (BASELINE.md north star).
     bf16 peak: v5e 197 TF — MFU only meaningful on a known accelerator.
@@ -202,6 +217,7 @@ def report(metric, unit, rate, flops_rate, backend, config=None):
         "vs_baseline": round(mfu / 0.35, 4), "backend": backend,
         "mfu": round(mfu, 4),
     }
+    out.update(_compile_stats())
     if backend not in ("cpu", "error"):
         record_evidence(dict(out, chunk_secs=list(_LAST_CHUNKS),
                              config=config or {}))
@@ -386,6 +402,7 @@ def main_ctr():
         "metric": "wide_deep_ctr_train_throughput", "value": round(ex_s, 1),
         "unit": "examples/sec/chip", "vs_baseline": 0.0, "backend": backend,
     }
+    out.update(_compile_stats())
     if backend not in ("cpu", "error"):
         record_evidence(dict(out, chunk_secs=list(_LAST_CHUNKS),
                              config={"slots": slots, "dim": dim,

@@ -72,7 +72,9 @@ def insert_allreduce_ops(block, params_grads, ring_id=0, average=True):
             op_type, inputs={"X": [g]}, outputs={"Out": [g]},
             attrs={"ring_id": ring_id, "use_calc_stream": True,
                    OP_ROLE_KEY: OpRole.Backward})
-        block.ops.remove(op)
+        # _remove_op (not bare list surgery): the pop-and-reinsert keeps the
+        # op count stable, so the executor fingerprint needs the version bump
+        block._remove_op(block.ops.index(op))
         block.ops.insert(pos, op)
         pos += 1
         new_pg.append((p, g))

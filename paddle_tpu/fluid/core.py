@@ -9,6 +9,7 @@ executor.py maintains.
 """
 from __future__ import annotations
 
+import os as _os
 from typing import Dict, Optional
 
 # stdlib-only module; single source of truth for trace env parsing and the
@@ -115,6 +116,26 @@ _FLAGS: Dict[str, object] = {
     # trace.enable()/disable()/set_path() keep these mirror values in sync.
     "enable_trace": _trace.enabled(),
     "trace_path": _trace.get_path(),
+    # recompile hygiene (fluid/compile_cache.py).  shape_bucketing pads
+    # ragged leading batch dims up to a bucket edge so a tail batch reuses
+    # a cached executable; bucket_edges=None means powers of two.  The
+    # persistent cache dir survives process restarts (jax compilation
+    # cache + program-level index).  Env defaults let
+    # `FLAGS_shape_bucketing=1 python train.py` opt in with no code change.
+    "shape_bucketing": _os.environ.get(
+        "FLAGS_shape_bucketing", "").strip().lower() in _trace._TRUE_STRINGS,
+    "shape_bucket_edges": _os.environ.get("FLAGS_shape_bucket_edges") or None,
+    "persistent_cache_dir": _os.environ.get(
+        "FLAGS_persistent_cache_dir") or None,
+    # in-memory executable cache bound (executor LRU; 0 disables eviction)
+    "executor_cache_capacity": int(_os.environ.get(
+        "FLAGS_executor_cache_capacity", "128")),
+    # recompile-storm warning: N compile misses within the window (seconds)
+    # emit a trace event with shape/bucket attribution; 0 disables
+    "recompile_warn_threshold": int(_os.environ.get(
+        "FLAGS_recompile_warn_threshold", "8")),
+    "recompile_warn_window": float(_os.environ.get(
+        "FLAGS_recompile_warn_window", "60")),
 }
 
 
@@ -157,6 +178,14 @@ def set_flags(flags: Dict[str, object]):
         elif k == "trace_path":
             from . import trace
             trace.set_path(str(v))
+        elif k == "shape_bucket_edges":
+            from . import compile_cache
+            _FLAGS[k] = compile_cache.normalize_edges(v)
+        elif k == "persistent_cache_dir" and v:
+            # eagerly wire jax's compilation cache so compiles between this
+            # call and the first executor run also persist
+            from . import compile_cache
+            compile_cache.persistent_cache()
 
 
 def get_flags(names):

@@ -19,6 +19,7 @@ scheduling that FastThreadedSSAGraphExecutor did by hand.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -26,6 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import compile_cache
 from . import core
 from . import trace
 from .core import Scope, global_scope
@@ -103,6 +105,27 @@ class _CompiledBlock:
         self.n_ops = n_ops          # post-prune op count (introspection)
 
 
+def _batch_major_hint(block, op):
+    """IR-level gate for the shape-bucketing row mask, resolved from the
+    op's primary input var: False for persistable inputs and for vars
+    with a known STATIC leading dim (a parameter, or anything derived
+    only from parameters — their rows are never the batch, even when
+    dim 0 aliases the bucket size), True when the IR marks the var
+    batch-major (-1 leading dim, propagated by shape inference), None
+    when provenance is unknown (the dim0 heuristic decides)."""
+    names = op.inputs.get("X") or op.input_arg_names[:1]
+    if not names:
+        return None
+    v = block._find_var_recursive(names[0])
+    if v is None:
+        return None
+    if v.persistable:
+        return False
+    if v.shape is None:
+        return None
+    return len(v.shape) >= 1 and v.shape[0] == -1
+
+
 def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
                   stop_at: Optional[int] = None, ops=None,
                   call_op=None):
@@ -167,6 +190,12 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
         else:
             for n in op.output_arg_names:   # any other writer invalidates
                 const_env.pop(n, None)
+        if ctx.batch_valid is not None:
+            # trace-time only (cost is per compile, not per step): tell
+            # the masked reductions whether this op's input is really
+            # batch-major, so a parameter whose dim 0 aliases the bucket
+            # size is never masked
+            ctx.cur_op_batch_major = _batch_major_hint(block, op)
         # named_scope: per-op spans in profiler traces / HLO metadata
         # (platform/profiler.h:127 RecordEvent placement, operator.cc:1077)
         _t0 = trace.now() if tr_on else 0
@@ -208,7 +237,11 @@ class Executor:
     def __init__(self, place: Optional[core.Place] = None):
         self.place = place or (core.TPUPlace(0) if core.is_compiled_with_tpu()
                                else core.CPUPlace())
-        self._cache: Dict[tuple, _CompiledBlock] = {}
+        # LRU over compiled executables (FLAGS_executor_cache_capacity):
+        # unbounded growth on shape-churning workloads held every traced
+        # program + XLA executable alive for the process lifetime
+        self._cache: "OrderedDict[tuple, _CompiledBlock]" = OrderedDict()
+        self._storm = compile_cache.RecompileStormDetector()
         self._step = 0
 
     # -- public API ---------------------------------------------------------
@@ -242,11 +275,47 @@ class Executor:
         feed = feed or {}
         fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
 
+        # ONE host conversion per feed (was: np.asarray per list/tuple feed
+        # twice per step — once for the sig dtype, once in
+        # check_feed_width).  Device/numpy arrays pass through untouched:
+        # np.asarray on a device array forces a D2H sync, serialising the
+        # prefetch pipeline.
+        feed = {k: (v if hasattr(v, "dtype") else np.asarray(v))
+                for k, v in feed.items()}
+        for k, v in feed.items():
+            check_feed_width(k, v)
+
+        # shape bucketing (fluid/compile_cache.py): pad the leading batch
+        # dim up to a bucket edge BEFORE computing feed_sig, so a ragged
+        # epoch compiles <= len(edges) executables instead of one per
+        # distinct tail shape.  The true batch size rides into the
+        # compiled step as the traced __batch_valid__ scalar; mask-aware
+        # batch reductions keep numerics padding-invariant, and fetches
+        # are sliced back below.  Mesh / pipeline / recompute paths keep
+        # exact shapes (their step builders do per-axis surgery).
+        bucket = n_valid = None
+        if (core.get_flag("shape_bucketing") and feed and mesh is None
+                and not program._hints.get("pipeline_microbatches")
+                and not program._hints.get("recompute_checkpoints")):
+            dims = {np.shape(v)[0] for v in feed.values() if np.ndim(v) >= 1}
+            if len(dims) == 1:
+                n_valid = int(next(iter(dims)))
+                edges = compile_cache.normalize_edges(
+                    program._hints.get("bucket_edges")
+                    or core.get_flag("shape_bucket_edges"))
+                bucket = compile_cache.bucket_for(n_valid, edges)
+                if bucket != n_valid:
+                    feed = {k: compile_cache.pad_dim0(v, bucket)
+                            for k, v in feed.items()}
+            else:
+                # mixed leading dims: no common batch axis to pad.  Count
+                # it — the storm warning points here so an enabled-but-
+                # inert bucketing flag is discoverable, not silent
+                trace.metrics().counter(
+                    "executor.bucketing_skipped_mixed_feeds").inc()
+
         feed_sig = tuple(sorted(
-            (k, tuple(np.shape(v)),
-             # avoid np.asarray on device arrays: it forces a D2H sync,
-             # serialising the prefetch pipeline
-             str(getattr(v, "dtype", None) or np.asarray(v).dtype))
+            (k, tuple(np.shape(v)), str(v.dtype))
             for k, v in feed.items()))
         key = (_fingerprint(program), feed_sig, tuple(fetch_names),
                id(scope), bool(program._hints.get("is_test")),
@@ -255,30 +324,62 @@ class Executor:
                id(mesh) if mesh is not None else None,
                bool(core.get_flag("check_nan_inf")),
                bool(program._hints.get("inference_no_prune")),
-               bool(program._hints.get("donate_buffers")))
+               bool(program._hints.get("donate_buffers")),
+               bucket)
         # compile-cache instrumentation (the _ExecutorCache hit-rate is THE
         # first-order perf signal on this stack: a miss is a whole-block
         # XLA recompile).  Counters are always on (one int bump per run);
         # timeline events only when the plane is enabled.
         tr_on = trace.enabled()
+        pending_compile = None
         compiled = self._cache.get(key)
         if compiled is None:
             trace.metrics().counter("executor.compile_cache_miss").inc()
             if tr_on:
                 trace.instant("compile_cache_miss", cat="compile",
                               args={"fingerprint": key[0][:12],
-                                    "n_feeds": len(feed)})
+                                    "n_feeds": len(feed), "bucket": bucket,
+                                    "batch_valid": n_valid})
+            self._note_recompile(feed_sig, bucket, tr_on)
+            # persistent program-level cache: jax's on-disk compilation
+            # cache serves the XLA compile; the index tells a COLD miss
+            # (never compiled on this cache dir) from a persistent-warm
+            # re-trace after a process restart
+            pcache = compile_cache.persistent_cache()
+            pkey = pwarm = None
+            if pcache is not None:
+                # key minus the process-local ids (scope, mesh objects)
+                pkey = compile_cache.persistent_key(
+                    key[0], feed_sig, fetch_names,
+                    extras=key[4:7] + (mesh is not None,) + key[8:])
+                pwarm = pcache.has(pkey)
+            if pwarm:
+                trace.metrics().counter(
+                    "executor.compile_cache_persistent_hit").inc()
+                if tr_on:
+                    trace.instant("compile_cache_persistent_hit",
+                                  cat="compile",
+                                  args={"fingerprint": key[0][:12]})
+            else:
+                trace.metrics().counter(
+                    "executor.compile_cache_cold_miss").inc()
             _t0 = trace.now()
-            compiled = self._prepare(program, feed, fetch_names, scope, mesh)
-            trace.metrics().histogram("executor.compile_seconds").observe(
-                (trace.now() - _t0) / 1e9)
-            if tr_on:
-                trace.complete("executor::compile", _t0, cat="compile",
-                               args={"fingerprint": key[0][:12],
-                                     "n_ops": compiled.n_ops})
+            compiled = self._prepare(program, feed, fetch_names, scope, mesh,
+                                     bucket=bucket)
+            # the XLA compile itself happens lazily on the FIRST jitted
+            # call — the executor::compile span, the compile_seconds
+            # observation, and the persistent record all land after the
+            # step call below so they cover the real compile
+            pending_compile = (_t0, pcache, pkey, pwarm)
             if use_program_cache:
                 self._cache[key] = compiled
+                cap = int(core.get_flag("executor_cache_capacity", 128) or 0)
+                while cap > 0 and len(self._cache) > cap:
+                    self._cache.popitem(last=False)
+                    trace.metrics().counter(
+                        "executor.compile_cache_evict").inc()
         else:
+            self._cache.move_to_end(key)
             trace.metrics().counter("executor.compile_cache_hit").inc()
             if tr_on:
                 trace.instant("compile_cache_hit", cat="compile",
@@ -288,10 +389,9 @@ class Executor:
                if n in compiled.written_names}
         ro = {n: scope.find_var(n) for n in compiled.param_names
               if n not in compiled.written_names}
-        for k, v in feed.items():
-            check_feed_width(k, np.asarray(v)
-                             if isinstance(v, (list, tuple)) else v)
         feeds = {k: jnp.asarray(v) for k, v in feed.items()}
+        if bucket is not None:
+            feeds["__batch_valid__"] = jnp.asarray(n_valid, jnp.int32)
         seed = program.random_seed if program.random_seed is not None else 0
         step_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
@@ -304,6 +404,42 @@ class Executor:
             trace.complete("executor::step", _t0, cat="step",
                            args={"step": self._step - 1,
                                  "n_fetch": len(fetch_names)})
+        if pending_compile is not None:
+            # trace + XLA compile both happened inside this first call
+            _t0c, pcache, pkey, pwarm = pending_compile
+            compile_s = (trace.now() - _t0c) / 1e9
+            trace.metrics().histogram("executor.compile_seconds").observe(
+                compile_s)
+            if tr_on:
+                trace.complete("executor::compile", _t0c, cat="compile",
+                               args={"fingerprint": key[0][:12],
+                                     "n_ops": compiled.n_ops})
+            if pcache is not None and not pwarm:
+                pcache.record(pkey, {
+                    "fingerprint": key[0], "feed_sig": list(feed_sig),
+                    "fetch": list(fetch_names), "bucket": bucket,
+                    "compile_seconds": round(compile_s, 4),
+                    "n_ops": compiled.n_ops})
+        if bucket is not None and bucket != n_valid:
+            # fetches come back at the TRUE batch size (device-side slice,
+            # lazy — no extra sync).  The IR vetoes the dim0 heuristic:
+            # persistable vars (parameters/state) and vars with a known
+            # STATIC leading dim are never batch-major, even when dim 0
+            # aliases the bucket size.
+            blk = program.global_block()
+
+            def _not_batch(n):
+                v = blk._find_var_recursive(n)
+                return v is not None and (
+                    v.persistable or (v.shape is not None
+                                      and len(v.shape) >= 1
+                                      and v.shape[0] != -1))
+
+            fetches = [
+                f if (getattr(f, "ndim", 0) < 1 or f.shape[0] != bucket
+                      or _not_batch(n))
+                else f[:n_valid]
+                for n, f in zip(compiled.fetch_names, fetches)]
         for n, v in new_vals.items():
             scope.set_var(n, v)
 
@@ -316,9 +452,45 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def _note_recompile(self, feed_sig, bucket, tr_on):
+        """Recompile-storm detection: a burst of compile misses means
+        something upstream feeds unstable shapes (a drop_last=False loader
+        without bucketing, per-step attr churn).  One warning per storm,
+        with shape/bucket attribution so the timeline names the culprit."""
+        thr = int(core.get_flag("recompile_warn_threshold", 0) or 0)
+        if thr <= 0:
+            return
+        window = float(core.get_flag("recompile_warn_window", 60.0))
+        info = {"shapes": [f"{k}{list(s)}" for k, s, _ in feed_sig],
+                "bucket": bucket}
+        recent = self._storm.note_miss(info, thr, window)
+        if recent is None:
+            return
+        trace.metrics().counter("executor.recompile_storm").inc()
+        if tr_on:
+            trace.instant("recompile_storm", cat="compile",
+                          args={"misses": len(recent),
+                                "window_s": window,
+                                "recent": recent[-5:]})
+        import sys
+        skipped = trace.metrics().counter(
+            "executor.bucketing_skipped_mixed_feeds").value
+        why = (f"bucketing is ON but was skipped on {skipped} runs — "
+               f"feeds had no common leading dim; align the batch axis "
+               f"of every feed"
+               if core.get_flag("shape_bucketing") and skipped
+               else "enable FLAGS_shape_bucketing (and set "
+                    "FLAGS_shape_bucket_edges to your loader's sizes) or "
+                    "stabilise the feed shapes")
+        print(f"paddle_tpu: WARNING: recompile storm — {len(recent)} "
+              f"compile-cache misses within {window:.0f}s; recent feed "
+              f"shapes: {[i['shapes'] for i in recent[-3:]]}.  {why} — "
+              f"every miss is a whole-block XLA recompile "
+              f"(docs/performance.md)", file=sys.stderr)
+
     # -- compilation --------------------------------------------------------
     def _prepare(self, program: Program, feed, fetch_names, scope,
-                 mesh=None) -> _CompiledBlock:
+                 mesh=None, bucket=None) -> _CompiledBlock:
         block = program.global_block()
         is_test = bool(program._hints.get("is_test"))
         checkpoints = program._hints.get("recompute_checkpoints")
@@ -441,6 +613,11 @@ class Executor:
             ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
                                   is_test=is_test)
             ctx.debug_nan = debug_nan
+            if bucket is not None:
+                # true batch size rides in as a traced scalar: varying
+                # tails within one bucket share ONE executable
+                ctx.batch_valid = env.pop("__batch_valid__", None)
+                ctx.batch_padded = bucket
             run_block_ops(block, env, ctx, ops=run_ops)
             fetches = [env[n] for n in fetch_names]
             new_vals = {n: env[n] for n in written_names if n in env}

@@ -189,6 +189,18 @@ class Operator:
     def attr(self, name: str, default=None):
         return self.attrs.get(name, default)
 
+    def set_attr(self, name: str, val) -> None:
+        """Mutate an attr on an op already in the graph, bumping the
+        program's mutation version: an in-place rewrite keeps the op count
+        AND ``_version`` unchanged, so a bare ``op.attrs[k] = v`` would let
+        the executor's ``_fingerprint`` cache serve a stale digest (a
+        cached executable compiled for the OLD attr value)."""
+        self.attrs[name] = val
+        self.block.program._bump_version()
+
+    # reference OpDesc spelling (framework.py Operator._update_desc_attr)
+    _update_desc_attr = set_attr
+
     def __repr__(self):
         return f"Op({self.type}: {self.inputs} -> {self.outputs})"
 
@@ -276,6 +288,14 @@ class Block:
         op = self.append_op(type, inputs, outputs, attrs)
         self.ops.insert(0, self.ops.pop())
         return op
+
+    def _remove_op(self, index: int, end: Optional[int] = None):
+        """Remove ``ops[index:end]`` (reference Block._remove_op), bumping
+        the program mutation version.  Passes that pop-and-reinsert ops
+        keep the op count stable, so without the bump the executor's
+        ``_fingerprint`` count-based safety net cannot see the change."""
+        del self.ops[index:(index + 1) if end is None else end]
+        self.program._bump_version()
 
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.program.global_block().vars.values()

@@ -75,6 +75,23 @@ class DataLoader:
         end = n - n % bs if self.drop_last else n
         return [idx[i:i + bs] for i in range(0, end, bs)]
 
+    @property
+    def bucket_edges(self):
+        """The exact batch sizes this loader will emit — full batches of
+        ``batch_size`` plus (with ``drop_last=False``) the one deterministic
+        tail — advertised so the executor's shape-bucketing layer
+        (FLAGS_shape_bucketing, program hint ``bucket_edges``) compiles one
+        executable per size instead of discovering the tail the hard way.
+        None when a batch_sampler owns batching (sizes unknown here)."""
+        if self.batch_sampler is not None:
+            return None
+        sizes = {int(self.batch_size)}
+        if not self.drop_last:
+            tail = len(self.dataset) % self.batch_size
+            if tail:
+                sizes.add(int(tail))
+        return tuple(sorted(sizes))
+
     def __iter__(self):
         batches = self._index_batches()
         if self.num_workers > 0:
@@ -120,6 +137,10 @@ class GeneratorLoader:
         self._generator: Optional[Callable] = None
         self._places = None
         self._use_multiprocess = use_multiprocess
+        # advertised to the executor's shape-bucketing layer; generator
+        # length is unknown so the tail can be ANY size < batch_size —
+        # set_sample_generator advertises power-of-two edges
+        self.bucket_edges = None
 
     # -- wiring -------------------------------------------------------------
     def set_sample_generator(self, reader, batch_size, drop_last=True,
@@ -135,6 +156,11 @@ class GeneratorLoader:
                 yield rows
         self._generator = lambda: (_rows_to_feed(self._feed_names, rows)
                                    for rows in batcher())
+        if drop_last:
+            self.bucket_edges = (int(batch_size),)
+        else:
+            from . import compile_cache
+            self.bucket_edges = compile_cache.pow2_edges(batch_size)
         self._places = places
         return self
 

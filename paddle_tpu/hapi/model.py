@@ -42,6 +42,10 @@ class _StaticAdapter:
         self._startup_done = False
         self._startup_nprogs = -1
         self._startup_ran = set()
+        # bucket edges advertised by the fit() loader: stamped onto the
+        # mode programs so the executor's shape-bucketing layer pads the
+        # ragged tail batch to a known edge instead of recompiling
+        self._bucket_edges = None
 
     # -- plumbing -----------------------------------------------------------
     def _executor(self):
@@ -128,6 +132,10 @@ class _StaticAdapter:
 
     def _run(self, mode, inputs, labels):
         entry = self._build(mode)
+        if self._bucket_edges:
+            entry["prog"]._hints["bucket_edges"] = self._bucket_edges
+        else:
+            entry["prog"]._hints.pop("bucket_edges", None)
         self._ensure_startup()
         feed = {}
         for name, arr in zip(entry["ins"], _as_list(inputs)):
@@ -295,6 +303,14 @@ class Model:
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None):
         loader = _as_loader(train_data, batch_size, shuffle, drop_last)
+        if self._adapter is not None:
+            # loaders advertise their exact batch sizes (DataLoader
+            # .bucket_edges); with FLAGS_shape_bucketing on, the static
+            # programs bucket the ragged tail instead of recompiling.
+            # Always (re)assigned: edges from a previous fit's loader must
+            # not leak onto this one's programs.
+            edges = getattr(loader, "bucket_edges", None)
+            self._adapter._bucket_edges = tuple(edges) if edges else None
         cbs = cb_mod.CallbackList(callbacks or [cb_mod.ProgBarLogger(log_freq,
                                                                      verbose)])
         cbs.set_model(self)

@@ -124,12 +124,24 @@ register_op("softmax_with_cross_entropy", _softmax_xent_fwd,
             custom_grad=_softmax_xent_grad)
 
 
+def _and_batch_mask(mask, x, ctx):
+    """Fold the shape-bucketing row mask (padded tail rows, executor.py)
+    into an op's own validity mask, so weighted counts/denominators see
+    only the TRUE batch."""
+    bm = ctx.batch_mask(x.shape[0]) if x.ndim else None
+    if bm is None:
+        return mask
+    return mask * bm.reshape((x.shape[0],) + (1,) * (mask.ndim - 1)) \
+        .astype(mask.dtype)
+
+
 @register_op("sigmoid_cross_entropy_with_logits", nondiff_inputs=("Label",))
 def _sce(ins, attrs, ctx):
     x, label = _x(ins), _x(ins, "Label")
     ignore = attrs.get("ignore_index", -100)
     loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
     mask = (label != ignore).astype(x.dtype)
+    mask = _and_batch_mask(mask, x, ctx)
     loss = loss * mask
     if attrs.get("normalize", False):
         loss = loss / jnp.maximum(jnp.sum(mask), 1.0)
@@ -186,15 +198,25 @@ def _mse(ins, attrs, ctx):
 
 @register_op("kldiv_loss", nondiff_inputs=("Target",))
 def _kldiv(ins, attrs, ctx):
+    from .reduction import masked_batch_reduce
     x, t = _x(ins), _x(ins, "Target")
     loss = jnp.where(t > 0, t * (jnp.log(t) - x), 0.0)
     red = attrs.get("reduction", "mean")
+    # padding-aware reductions: under shape bucketing the padded rows must
+    # not enter the mean/sum, and batchmean divides by the TRUE batch size
     if red == "mean":
-        loss = jnp.mean(loss)
+        m = (masked_batch_reduce(loss, ctx, None, mean=True)
+             if loss.ndim else None)
+        loss = jnp.mean(loss) if m is None else m
     elif red == "sum":
-        loss = jnp.sum(loss)
+        m = masked_batch_reduce(loss, ctx, None) if loss.ndim else None
+        loss = jnp.sum(loss) if m is None else m
     elif red == "batchmean":
-        loss = jnp.sum(loss) / x.shape[0]
+        m = masked_batch_reduce(loss, ctx, None) if loss.ndim else None
+        if m is None:
+            loss = jnp.sum(loss) / x.shape[0]
+        else:
+            loss = m / ctx.batch_valid.astype(m.dtype)
     return {"Loss": [loss]}
 
 
@@ -206,6 +228,7 @@ def _nll(ins, attrs, ctx):
     picked = jnp.take_along_axis(x, label[:, None], axis=1).squeeze(1)
     wl = jnp.take(w, jnp.clip(label, 0), axis=0)
     mask = (label != ignore).astype(x.dtype)
+    mask = _and_batch_mask(mask, x, ctx)
     loss = -picked * wl * mask
     red = attrs.get("reduction", "mean")
     total_w = jnp.sum(wl * mask)
@@ -272,6 +295,19 @@ def _accuracy(ins, attrs, ctx):
     if label.ndim < pred_idx.ndim:
         label = label[..., None]
     correct = jnp.any(pred_idx == label, axis=-1)
+    bm = ctx.batch_mask(correct.shape[0]) if correct.ndim else None
+    if bm is not None:
+        # shape bucketing: padded rows are neither correct nor counted
+        row = bm.reshape((correct.shape[0],) + (1,) * (correct.ndim - 1))
+        num_correct = jnp.sum(jnp.where(row, correct, False)
+                              .astype(jnp.float32))
+        rest = 1
+        for d in correct.shape[1:]:
+            rest *= d
+        total = ctx.batch_valid * rest
+        return {"Accuracy": [num_correct / total.astype(jnp.float32)],
+                "Correct": [num_correct.astype(jnp.int32)],
+                "Total": [total.astype(jnp.int32)]}
     num_correct = jnp.sum(correct.astype(jnp.float32))
     total = correct.size
     return {"Accuracy": [num_correct / total],
@@ -289,8 +325,13 @@ def _auc(ins, attrs, ctx):
     p1 = preds[:, -1] if preds.ndim > 1 else preds
     idx = jnp.clip((p1 * num_thresholds).astype(jnp.int32), 0, num_thresholds)
     lbl = labels.reshape(-1).astype(jnp.float32)
-    pos_new = stat_pos.reshape(-1).at[idx].add(lbl)
-    neg_new = stat_neg.reshape(-1).at[idx].add(1.0 - lbl)
+    # shape bucketing: padded tail rows must not enter the PERSISTABLE
+    # histogram state — the corruption would outlive the padded step
+    bm = ctx.batch_mask(p1.shape[0])
+    row_w = bm.astype(jnp.float32) if bm is not None \
+        else jnp.ones_like(lbl)
+    pos_new = stat_pos.reshape(-1).at[idx].add(lbl * row_w)
+    neg_new = stat_neg.reshape(-1).at[idx].add((1.0 - lbl) * row_w)
     # trapezoid integration over thresholds (descending)
     pos_c = jnp.cumsum(pos_new[::-1])
     neg_c = jnp.cumsum(neg_new[::-1])
@@ -314,6 +355,9 @@ def _precision_recall(ins, attrs, ctx):
     n_cls = int(attrs["class_number"])
     w = (ins["Weights"][0].astype(jnp.float32).reshape(-1)
          if ins.get("Weights") else jnp.ones_like(idx, jnp.float32))
+    bm = ctx.batch_mask(idx.shape[0])
+    if bm is not None:      # shape bucketing: padded rows carry no weight
+        w = w * bm.astype(jnp.float32)
 
     pred_1h = jax.nn.one_hot(idx, n_cls, dtype=jnp.float32)
     true_1h = jax.nn.one_hot(label, n_cls, dtype=jnp.float32)

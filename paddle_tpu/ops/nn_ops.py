@@ -315,6 +315,19 @@ def _fused_act_dropout_op(ins, attrs, ctx):
     return {"Out": [jnp.where(keep, a * scale, 0.0).astype(x.dtype)]}
 
 
+def _masked_batch_stats(xf, ctx, red_axes):
+    """Batch-norm mean/variance over the VALID rows only (shape bucketing:
+    executor pads the leading batch dim — zero-padded rows must not drag
+    the statistics, or padded-step training diverges from the unpadded
+    run).  Returns (mean, var) or None when masking does not apply."""
+    from .reduction import masked_batch_reduce
+    m = masked_batch_reduce(xf, ctx, red_axes, mean=True)
+    if m is None:
+        return None
+    msq = masked_batch_reduce(jnp.square(xf), ctx, red_axes, mean=True)
+    return m, msq - jnp.square(m)
+
+
 @register_op("batch_norm",
              nondiff_inputs=("Mean", "Variance"),
              nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
@@ -338,8 +351,12 @@ def _batch_norm(ins, attrs, ctx):
         mean_out, var_out = mean, var
     else:
         xf = x.astype(jnp.float32)
-        m = jnp.mean(xf, axis=red_axes)
-        v = jnp.var(xf, axis=red_axes)
+        stats = _masked_batch_stats(xf, ctx, red_axes)
+        if stats is not None:
+            m, v = stats
+        else:
+            m = jnp.mean(xf, axis=red_axes)
+            v = jnp.var(xf, axis=red_axes)
         mean_out = momentum * mean + (1 - momentum) * m
         var_out = momentum * var + (1 - momentum) * v
     inv = lax.rsqrt(v.astype(jnp.float32) + eps)
@@ -373,12 +390,17 @@ def _sync_batch_norm(ins, attrs, ctx):
         mean_out, var_out = mean, var
     else:
         xf = x.astype(jnp.float32)
-        m = jnp.mean(xf, axis=red_axes)
-        msq = jnp.mean(jnp.square(xf), axis=red_axes)
-        if axis_name is not None:
-            m = lax.pmean(m, axis_name)
-            msq = lax.pmean(msq, axis_name)
-        v = msq - jnp.square(m)
+        stats = None if axis_name is not None else \
+            _masked_batch_stats(xf, ctx, red_axes)
+        if stats is not None:
+            m, v = stats
+        else:
+            m = jnp.mean(xf, axis=red_axes)
+            msq = jnp.mean(jnp.square(xf), axis=red_axes)
+            if axis_name is not None:
+                m = lax.pmean(m, axis_name)
+                msq = lax.pmean(msq, axis_name)
+            v = msq - jnp.square(m)
         mean_out = momentum * mean + (1 - momentum) * m
         var_out = momentum * var + (1 - momentum) * v
     inv = lax.rsqrt(v + eps)

@@ -23,19 +23,68 @@ def _axes(attrs, ndim):
     return tuple(d % ndim for d in dim)
 
 
-def _reduce(name, f):
+def masked_batch_reduce(x, ctx, axes, keepdims=False, mean=False):
+    """Sum/mean with padded batch rows excluded, or None when masking does
+    not apply (bucketing off, axis 0 not reduced, or x does not carry the
+    padded batch dim).  Under shape bucketing (executor.py) a reduction
+    that collapses axis 0 must ignore the zero-padded tail rows — their
+    values are whatever the network computed FROM zero inputs, not zero —
+    and a mean must divide by the true batch size, so the padded step
+    matches the unpadded step to fp tolerance."""
+    if x.ndim == 0:
+        return None
+    mask = ctx.batch_mask(x.shape[0])
+    if mask is None or (axes is not None and 0 not in axes):
+        return None
+    row = mask.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+    s = jnp.sum(jnp.where(row, x, jnp.zeros((), x.dtype)),
+                axis=axes, keepdims=keepdims)
+    if not mean:
+        return s
+    rest = 1
+    for d in (range(1, x.ndim) if axes is None else axes):
+        if d != 0:
+            rest *= x.shape[d]
+    count = (ctx.batch_valid * rest).astype(
+        s.dtype if jnp.issubdtype(s.dtype, jnp.floating) else jnp.float32)
+    return s / count
+
+
+def _reduce_identity(fill, dtype):
+    """The neutral fill for masking padded rows out of a max/min/prod."""
+    if fill == "one" or jnp.issubdtype(dtype, jnp.bool_):
+        return jnp.asarray(fill == "max", dtype) if fill != "one" \
+            else jnp.ones((), dtype)
+    info = (jnp.finfo(dtype) if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.iinfo(dtype))
+    return info.min if fill == "min" else info.max
+
+
+def _reduce(name, f, mean=None, fill=None):
     def lower(ins, attrs, ctx):
         x = _x(ins)
-        return {"Out": [f(x, axis=_axes(attrs, x.ndim),
-                          keepdims=attrs.get("keep_dim", False))]}
+        axes = _axes(attrs, x.ndim)
+        keep = attrs.get("keep_dim", False)
+        if mean is not None:
+            out = masked_batch_reduce(x, ctx, axes, keep, mean=mean)
+            if out is not None:
+                return {"Out": [out]}
+        elif fill is not None and x.ndim and \
+                (axes is None or 0 in axes):
+            mask = ctx.batch_mask(x.shape[0])
+            if mask is not None:
+                # padded rows become the reduction's identity element
+                row = mask.reshape((x.shape[0],) + (1,) * (x.ndim - 1))
+                x = jnp.where(row, x, _reduce_identity(fill, x.dtype))
+        return {"Out": [f(x, axis=axes, keepdims=keep)]}
     register_op(name, lower)
 
 
-_reduce("reduce_sum", jnp.sum)
-_reduce("reduce_mean", jnp.mean)
-_reduce("reduce_max", jnp.max)
-_reduce("reduce_min", jnp.min)
-_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_sum", jnp.sum, mean=False)
+_reduce("reduce_mean", jnp.mean, mean=True)
+_reduce("reduce_max", jnp.max, fill="min")
+_reduce("reduce_min", jnp.min, fill="max")
+_reduce("reduce_prod", jnp.prod, fill="one")
 register_op("reduce_all", lambda ins, a, c: {"Out": [
     jnp.all(_x(ins), axis=_axes(a, _x(ins).ndim),
             keepdims=a.get("keep_dim", False))]}, differentiable=False)
@@ -46,7 +95,11 @@ register_op("reduce_any", lambda ins, a, c: {"Out": [
 
 @register_op("mean")
 def _mean(ins, attrs, ctx):
-    return {"Out": [jnp.mean(_x(ins))]}
+    x = _x(ins)
+    out = masked_batch_reduce(x, ctx, None, mean=True)
+    if out is not None:
+        return {"Out": [out]}
+    return {"Out": [jnp.mean(x)]}
 
 
 @register_op("arg_max", differentiable=False)

@@ -102,6 +102,30 @@ class LoweringContext:
         self.mesh_axes = mesh_axes or {}   # ring_id -> mesh axis name(s)
         self.is_test = is_test
         self.p2p = {}                      # ring_id -> in-flight send_v2 value
+        # shape bucketing (fluid/compile_cache.py): when the executor pads
+        # feeds up to a bucket edge, batch_padded is the static padded
+        # leading dim and batch_valid the traced true batch size; batch
+        # reductions consult batch_mask() to stay padding-invariant
+        self.batch_valid = None
+        self.batch_padded = None
+        # per-op IR hint set by run_block_ops: False when the op's primary
+        # input is a persistable var (parameter/state — its rows are never
+        # the batch, even if dim 0 aliases the bucket size), True when the
+        # IR marks it batch-major (-1 leading dim), None when unknown
+        self.cur_op_batch_major = None
+
+    def batch_mask(self, dim0):
+        """Row-validity mask (bool[dim0]) when ``dim0`` is the bucketed
+        batch axis under shape bucketing, else None.  The IR hint
+        (cur_op_batch_major) vetoes masking for persistable inputs; for
+        unknown provenance the dim0-equality heuristic applies — pick
+        bucket edges disjoint from model dims if that ever aliases
+        (docs/performance.md)."""
+        if self.batch_valid is None or self.batch_padded != dim0 \
+                or self.cur_op_batch_major is False:
+            return None
+        import jax.numpy as jnp
+        return jnp.arange(int(dim0)) < self.batch_valid
 
     def key_for(self, op_seed: int):
         import jax

@@ -1,0 +1,73 @@
+"""Regression tests for _fingerprint's mutation-version safety net
+(ISSUE 2 satellite): a pass that rewrites an op in place — same op count,
+same ``_version`` — must not let the executor serve a stale digest."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.executor import _fingerprint
+from paddle_tpu.fluid.framework import Program
+
+
+def _two_scale_program():
+    p = Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=[4], dtype="float32")
+    b.append_op("scale", {"X": ["x"]}, {"Out": ["y"]}, {"scale": 2.0})
+    b.append_op("scale", {"X": ["y"]}, {"Out": ["z"]}, {"scale": 3.0})
+    return p, b
+
+
+def test_remove_then_append_same_count_changes_digest():
+    """remove + append keeps the op count, defeating the count-based
+    safety net — Block._remove_op's version bump must invalidate."""
+    p, b = _two_scale_program()
+    f0 = _fingerprint(p)
+    b._remove_op(1)
+    b.append_op("scale", {"X": ["y"]}, {"Out": ["z"]}, {"scale": 4.0})
+    assert _fingerprint(p) != f0
+
+
+def test_remove_op_range():
+    p, b = _two_scale_program()
+    f0 = _fingerprint(p)
+    b._remove_op(0, 2)
+    assert len(b.ops) == 0
+    assert _fingerprint(p) != f0
+
+
+def test_set_attr_on_existing_op_changes_digest():
+    """In-place attr rewrite: same count, and without set_attr the same
+    ``_version`` — the documented stale-digest hazard."""
+    p, b = _two_scale_program()
+    f0 = _fingerprint(p)
+    b.ops[1].set_attr("scale", 5.0)
+    f1 = _fingerprint(p)
+    assert f1 != f0
+    # idempotence: no further mutation -> digest is stable (cached)
+    assert _fingerprint(p) == f1
+
+
+def test_update_desc_attr_alias():
+    p, b = _two_scale_program()
+    f0 = _fingerprint(p)
+    b.ops[0]._update_desc_attr("scale", -1.0)
+    assert _fingerprint(p) != f0
+
+
+def test_executor_recompiles_after_set_attr():
+    """End to end: the cached executable must NOT be reused after an
+    in-place attr rewrite (the stale result would be numerically wrong)."""
+    from paddle_tpu.fluid import trace
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3])
+        y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor()
+    feed = {"x": np.ones(3, "float32")}
+    out1, = exe.run(main, feed=feed, fetch_list=[y])
+    scale_op = [op for op in main.global_block().ops
+                if op.type == "scale"][0]
+    scale_op.set_attr("scale", 10.0)
+    out2, = exe.run(main, feed=feed, fetch_list=[y])
+    assert np.allclose(out1, 2.0)
+    assert np.allclose(out2, 10.0), "stale executable served after set_attr"

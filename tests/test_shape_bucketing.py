@@ -1,0 +1,372 @@
+"""Shape-bucketed execution (ISSUE 2 tentpole): padded-vs-unpadded parity,
+ragged-epoch compile counts, LRU eviction, recompile-storm warning."""
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, trace
+from paddle_tpu.fluid import compile_cache as cc
+from paddle_tpu.fluid.framework import reset_unique_name
+
+
+@pytest.fixture
+def bucketing_flags():
+    """Enable bucketing for one test; always restore the defaults."""
+    saved = {k: core.get_flag(k) for k in
+             ("shape_bucketing", "shape_bucket_edges",
+              "executor_cache_capacity", "recompile_warn_threshold")}
+    core.set_flags({"FLAGS_shape_bucketing": True})
+    yield
+    core._FLAGS.update(saved)
+
+
+def _miss():
+    return trace.metrics().counter("executor.compile_cache_miss").value
+
+
+def _build_mnist():
+    reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 32])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        per_row = fluid.layers.softmax_with_cross_entropy(logits, y)
+        loss = fluid.layers.mean(per_row)
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main, startup, loss, per_row
+
+
+def _train(sizes, bucketing, build=_build_mnist, seed=0):
+    """N steps over a ragged feed stream; returns (losses, fetch row
+    counts, compile misses for the train loop, final params)."""
+    rng = np.random.RandomState(seed)
+    total = sum(sizes)
+    X = rng.randn(total, 32).astype("float32")
+    Y = rng.randint(0, 10, (total, 1)).astype("int64")
+    scope = core.Scope()
+    saved = core.get_flag("shape_bucketing")
+    with core.scope_guard(scope):
+        main, startup, loss, per_row = build()
+        core.set_flags({"FLAGS_shape_bucketing": bucketing})
+        try:
+            exe = fluid.Executor()
+            exe.run(startup)
+            m0 = _miss()
+            losses, rows, off = [], [], 0
+            for n in sizes:
+                lv, pr = exe.run(main,
+                                 feed={"x": X[off:off + n],
+                                       "y": Y[off:off + n]},
+                                 fetch_list=[loss, per_row])
+                losses.append(float(np.ravel(lv)[0]))
+                rows.append(np.asarray(pr).shape[0])
+                off += n
+            misses = _miss() - m0
+        finally:
+            core.set_flags({"FLAGS_shape_bucketing": saved})
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.all_parameters()}
+    return losses, rows, misses, params
+
+
+class TestBucketAlgebra:
+    def test_bucket_for_pow2_default(self):
+        assert cc.bucket_for(1) == 1
+        assert cc.bucket_for(7) == 8
+        assert cc.bucket_for(8) == 8
+        assert cc.bucket_for(33) == 64
+
+    def test_bucket_for_explicit_edges(self):
+        assert cc.bucket_for(20, (16, 32)) == 32
+        assert cc.bucket_for(16, (16, 32)) == 16
+        # above the largest edge: its own bucket, no padding
+        assert cc.bucket_for(40, (16, 32)) == 40
+
+    def test_normalize_edges(self):
+        assert cc.normalize_edges("32, 8,16") == (8, 16, 32)
+        assert cc.normalize_edges([16, 4]) == (4, 16)
+        assert cc.normalize_edges(None) is None
+        with pytest.raises(ValueError):
+            cc.normalize_edges([0, 8])
+
+    def test_pow2_edges(self):
+        assert cc.pow2_edges(32) == (1, 2, 4, 8, 16, 32)
+        assert cc.pow2_edges(24) == (1, 2, 4, 8, 16, 24)
+
+    def test_pad_dim0(self):
+        v = np.arange(6, dtype="float32").reshape(3, 2)
+        p = cc.pad_dim0(v, 5)
+        assert p.shape == (5, 2)
+        assert np.all(p[3:] == 0) and np.all(p[:3] == v)
+        assert cc.pad_dim0(v, 3) is v
+
+
+class TestPaddedParity:
+    def test_ragged_tail_matches_unbucketed(self):
+        """Acceptance: params after N steps + fetched losses match the
+        unbucketed run to fp tolerance; fetches at the TRUE batch size."""
+        sizes = [32, 32, 32, 7]
+        l0, r0, m0, p0 = _train(sizes, bucketing=False)
+        l1, r1, m1, p1 = _train(sizes, bucketing=True)
+        assert r0 == sizes and r1 == sizes
+        np.testing.assert_allclose(l0, l1, rtol=1e-5, atol=1e-6)
+        for k in p0:
+            np.testing.assert_allclose(p0[k], p1[k], rtol=1e-5, atol=1e-5,
+                                       err_msg=k)
+        # 2 shapes -> 2 compiles either way here; bucketing must not
+        # compile MORE than the distinct-shape count
+        assert m1 <= m0 == 2
+
+    def test_ragged_epoch_compiles_at_most_two(self):
+        """Acceptance: 10 batches of 32 + tail of 7 -> <= 2 executables,
+        verified by the executor.compile_cache_miss counter."""
+        _, rows, misses, _ = _train([32] * 10 + [7], bucketing=True)
+        assert misses <= 2, misses
+        assert rows[-1] == 7
+
+    def test_varying_tails_share_buckets(self):
+        """5 distinct tail shapes collapse into pow2 buckets {4, 8, 32}:
+        <= bucket count compiles, not one per shape."""
+        sizes = [32, 7, 5, 3, 6]
+        _, _, m_un, _ = _train(sizes, bucketing=False)
+        _, _, m_bk, _ = _train(sizes, bucketing=True)
+        assert m_un == 5
+        assert m_bk <= 3, m_bk
+
+    def test_explicit_edges_share_executable(self, bucketing_flags):
+        """With edges (16, 32), a 20-row batch pads to 32 and REUSES the
+        32-row executable — one compile for both shapes."""
+        core.set_flags({"FLAGS_shape_bucket_edges": "16,32"})
+        main, startup, loss, _ = _build_mnist()
+        rng = np.random.RandomState(3)
+        exe = fluid.Executor()
+        exe.run(startup)
+        m0 = _miss()
+        for n in (32, 20, 17):
+            exe.run(main, feed={"x": rng.randn(n, 32).astype("float32"),
+                                "y": rng.randint(0, 10, (n, 1))
+                                .astype("int64")},
+                    fetch_list=[loss])
+        assert _miss() - m0 == 1
+
+    def test_batch_norm_stats_parity(self):
+        """Masked BN statistics: moving mean/variance after ragged steps
+        match the unbucketed run (padded rows must not drag the stats)."""
+        def build():
+            reset_unique_name()
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [-1, 32])
+                h = fluid.layers.fc(x, 16)
+                hn = fluid.layers.batch_norm(h)
+                y = fluid.data("y", [-1, 1], dtype="int64")
+                logits = fluid.layers.fc(hn, 10)
+                per_row = fluid.layers.softmax_with_cross_entropy(logits, y)
+                loss = fluid.layers.mean(per_row)
+                fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+            return main, startup, loss, per_row
+
+        sizes = [32, 32, 5]
+        l0, _, _, p0 = _train(sizes, bucketing=False, build=build)
+        l1, _, _, p1 = _train(sizes, bucketing=True, build=build)
+        np.testing.assert_allclose(l0, l1, rtol=1e-4, atol=1e-5)
+        for k in p0:        # includes batch_norm moving mean/variance
+            np.testing.assert_allclose(p0[k], p1[k], rtol=1e-4, atol=1e-5,
+                                       err_msg=k)
+
+    def test_accuracy_and_weighted_losses_mask_padded_rows(
+            self, bucketing_flags):
+        """accuracy counts only true rows; sigmoid_cross_entropy's
+        normalize denominator and nll_loss's weighted mean exclude the
+        padded tail."""
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 8])
+            y = fluid.data("y", [-1, 1], dtype="int64")
+            logits = fluid.layers.fc(x, 4)
+            acc = fluid.layers.accuracy(fluid.layers.softmax(logits), y)
+            onehot = fluid.layers.cast(fluid.layers.one_hot(y, 4), "float32")
+            sce = fluid.layers.reduce_sum(
+                fluid.layers.sigmoid_cross_entropy_with_logits(
+                    logits, onehot, normalize=True))
+        exe = fluid.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(11)
+        xv = rng.randn(7, 8).astype("float32")
+        yv = rng.randint(0, 4, (7, 1)).astype("int64")
+        core.set_flags({"FLAGS_shape_bucketing": False})
+        a0, s0 = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[acc, sce])
+        core.set_flags({"FLAGS_shape_bucketing": True})
+        a1, s1 = exe.run(main, feed={"x": xv, "y": yv},
+                         fetch_list=[acc, sce])       # padded 7 -> 8
+        np.testing.assert_allclose(np.ravel(a0), np.ravel(a1), rtol=1e-6)
+        np.testing.assert_allclose(np.ravel(s0), np.ravel(s1), rtol=1e-5)
+
+    def test_param_dim0_aliasing_bucket_not_masked(self, bucketing_flags):
+        """A parameter whose dim 0 equals the bucket size (fc weight 8x8,
+        tail 7 padded to 8) must NOT be row-masked in reductions nor
+        sliced when fetched — the IR hint (persistable) vetoes the dim0
+        heuristic."""
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 8])
+            h = fluid.layers.fc(x, 8)          # weight: (8, 8)
+            w = [p for p in main.all_parameters()
+                 if tuple(p.shape) == (8, 8)][0]
+            reg = fluid.layers.reduce_mean(w * w)   # reduces axis 0 of W
+            loss = fluid.layers.mean(h) + reg
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = -np.abs(np.random.RandomState(13).randn(7, 8)) \
+            .astype("float32")
+        core.set_flags({"FLAGS_shape_bucketing": False})
+        l0, w0 = exe.run(main, feed={"x": xv}, fetch_list=[loss, w])
+        core.set_flags({"FLAGS_shape_bucketing": True})
+        l1, w1 = exe.run(main, feed={"x": xv}, fetch_list=[loss, w])
+        np.testing.assert_allclose(np.ravel(l0), np.ravel(l1), rtol=1e-6)
+        assert np.asarray(w1).shape == (8, 8), "persistable fetch sliced"
+        np.testing.assert_allclose(w0, w1)
+
+    def test_reduce_max_over_batch_masks_padded_rows(self, bucketing_flags):
+        """Padded zero rows must not win a reduce_max over all-negative
+        activations (identity-element fill, not zero)."""
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 8])
+            mx = fluid.layers.reduce_max(x, dim=[0])
+            mn = fluid.layers.reduce_min(x, dim=[0])
+        exe = fluid.Executor()
+        exe.run(startup)
+        xv = -1.0 - np.abs(np.random.RandomState(17).randn(7, 8)) \
+            .astype("float32")
+        core.set_flags({"FLAGS_shape_bucketing": False})
+        mx0, mn0 = exe.run(main, feed={"x": xv}, fetch_list=[mx, mn])
+        core.set_flags({"FLAGS_shape_bucketing": True})
+        mx1, mn1 = exe.run(main, feed={"x": xv}, fetch_list=[mx, mn])
+        np.testing.assert_allclose(mx0, mx1)    # all < 0: pad 0 would win
+        np.testing.assert_allclose(mn0, mn1)
+
+    def test_storm_detector_rearms_after_window_drains(self):
+        d = cc.RecompileStormDetector()
+        assert d.note_miss({}, threshold=1, window=10, now=0.0)
+        assert d.note_miss({}, threshold=1, window=10, now=1.0) is None
+        # window drained: the next burst must warn again
+        assert d.note_miss({}, threshold=1, window=10, now=100.0)
+
+    def test_mixed_leading_dims_skip_bucketing(self, bucketing_flags):
+        """Feeds with no common leading dim: bucketing steps aside (no
+        padding, exact-shape compile) instead of guessing a batch axis."""
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.data("a", [-1, 4])
+            b = fluid.data("b", [3])
+            out = fluid.layers.reduce_sum(a) + fluid.layers.reduce_sum(b)
+        exe = fluid.Executor()
+        exe.run(startup)
+        av = np.ones((7, 4), "float32")
+        bv = np.ones((3,), "float32")
+        ov, = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[out])
+        assert np.allclose(np.ravel(ov)[0], 31.0)
+
+
+class TestCacheHygiene:
+    def test_lru_eviction(self, bucketing_flags):
+        core.set_flags({"FLAGS_shape_bucketing": False,
+                        "FLAGS_executor_cache_capacity": 2})
+        main, startup, loss, _ = _build_mnist()
+        rng = np.random.RandomState(5)
+        exe = fluid.Executor()
+        exe.run(startup)
+
+        def run(n):
+            exe.run(main, feed={"x": rng.randn(n, 32).astype("float32"),
+                                "y": rng.randint(0, 10, (n, 1))
+                                .astype("int64")}, fetch_list=[loss])
+
+        ev0 = trace.metrics().counter("executor.compile_cache_evict").value
+        for n in (8, 16, 24):
+            run(n)
+        assert len(exe._cache) <= 2
+        assert trace.metrics().counter(
+            "executor.compile_cache_evict").value > ev0
+        m0 = _miss()
+        run(8)                  # evicted: recompiles
+        assert _miss() - m0 == 1
+
+    def test_recompile_storm_warning(self, bucketing_flags, capsys):
+        core.set_flags({"FLAGS_shape_bucketing": False,
+                        "FLAGS_recompile_warn_threshold": 3})
+        main, startup, loss, _ = _build_mnist()
+        rng = np.random.RandomState(6)
+        exe = fluid.Executor()
+        exe.run(startup)
+        trace.enable("/tmp/_storm_test.json")
+        try:
+            s0 = trace.metrics().counter("executor.recompile_storm").value
+            for n in (9, 10, 11, 12):
+                exe.run(main,
+                        feed={"x": rng.randn(n, 32).astype("float32"),
+                              "y": rng.randint(0, 10, (n, 1))
+                              .astype("int64")}, fetch_list=[loss])
+            assert trace.metrics().counter(
+                "executor.recompile_storm").value > s0
+            evs = [e for e in trace.get_events()
+                   if e.get("name") == "recompile_storm"]
+            assert evs and "recent" in evs[0]["args"]
+            # shape/bucket attribution rides in the event args
+            assert any("x[" in s for i in evs[0]["args"]["recent"]
+                       for s in i["shapes"])
+        finally:
+            trace.disable()
+            trace.reset()
+        assert "recompile storm" in capsys.readouterr().err
+
+
+class TestLoaderEdges:
+    def test_dataloader_advertises_exact_sizes(self):
+        from paddle_tpu.fluid.reader import DataLoader
+
+        class DS:
+            def __len__(self):
+                return 70
+
+            def __getitem__(self, i):
+                return np.zeros((4,), "float32")
+
+        assert DataLoader(DS(), batch_size=32).bucket_edges == (6, 32)
+        assert DataLoader(DS(), batch_size=32,
+                          drop_last=True).bucket_edges == (32,)
+
+    def test_generator_loader_advertises_pow2(self):
+        from paddle_tpu.fluid.reader import GeneratorLoader
+        gl = GeneratorLoader(["x"])
+        assert gl.bucket_edges is None
+        gl.set_sample_generator(lambda: iter(()), batch_size=32,
+                                drop_last=False)
+        assert gl.bucket_edges == (1, 2, 4, 8, 16, 32)
+        gl2 = GeneratorLoader(["x"])
+        gl2.set_sample_generator(lambda: iter(()), batch_size=32,
+                                 drop_last=True)
+        assert gl2.bucket_edges == (32,)
+
+    def test_program_hint_overrides_flag_edges(self, bucketing_flags):
+        """A loader-advertised hint (hapi fit wiring) wins over the
+        global flag edges."""
+        main, startup, loss, _ = _build_mnist()
+        main._hints["bucket_edges"] = (64,)
+        rng = np.random.RandomState(7)
+        exe = fluid.Executor()
+        exe.run(startup)
+        m0 = _miss()
+        for n in (40, 50, 64):     # all pad to the single 64 edge
+            exe.run(main, feed={"x": rng.randn(n, 32).astype("float32"),
+                                "y": rng.randint(0, 10, (n, 1))
+                                .astype("int64")}, fetch_list=[loss])
+        assert _miss() - m0 == 1

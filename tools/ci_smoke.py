@@ -155,6 +155,31 @@ def main():
         assert any(e.get("cat") == "op" for e in evs), \
             "no per-op spans in timeline"
 
+    step("shape bucketing: ragged epoch compiles <= bucket count")
+    from paddle_tpu.fluid import trace as tr
+    fluid.core.set_flags({"FLAGS_shape_bucketing": True})
+    try:
+        m2, s2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m2, s2):
+            xb = fluid.data("xb", [-1, 16])
+            hb = fluid.layers.fc(xb, 8, act="relu")
+            lb = fluid.layers.mean(hb)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(lb)
+        exe2 = fluid.Executor()
+        exe2.run(s2)
+        miss0 = tr.metrics().counter("executor.compile_cache_miss").value
+        rngb = np.random.RandomState(1)
+        for nrows in (32, 32, 7, 5, 3, 32, 6):
+            hv, = exe2.run(m2, feed={"xb": rngb.randn(nrows, 16)
+                                     .astype("float32")}, fetch_list=[hb])
+            assert np.asarray(hv).shape[0] == nrows  # true-batch fetches
+        misses = tr.metrics().counter(
+            "executor.compile_cache_miss").value - miss0
+        # 5 distinct tail shapes land in 3 pow2 buckets {4, 8, 32}
+        assert misses <= 3, f"ragged epoch recompiled {misses}x (want <=3)"
+    finally:
+        fluid.core.set_flags({"FLAGS_shape_bucketing": False})
+
     step("bench child emits one JSON line (cpu)")
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],

@@ -8,6 +8,7 @@ Run: python tools/tpu_watch.py [--interval 300] [--max-hours 10]
 Stops after one full successful sweep (or the time budget)."""
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -89,6 +90,19 @@ def _run_child(args, budget, extra_env=None, _retried=False):
             return False
         print(f"[watch] {' '.join(args) or 'bert'}: {out[-1]} "
               f"({time.time()-t0:.0f}s)", flush=True)
+        # recompile cost rides the bench trajectory: children report
+        # executor compile-miss counts + total compile seconds in their
+        # JSON line (bench.report) — aggregate them into the watch metrics
+        # so a sweep summary shows compile tax next to throughput
+        try:
+            info = json.loads(out[-1])
+            if "compile_seconds" in info:
+                trace.metrics().histogram("watch.compile_seconds").observe(
+                    float(info["compile_seconds"]))
+                trace.metrics().counter("watch.compile_misses").add(
+                    int(info.get("compile_misses", 0)))
+        except (ValueError, TypeError):
+            pass
         return True
     except subprocess.TimeoutExpired:
         print(f"[watch] {' '.join(args) or 'bert'}: timeout {budget}s",
@@ -192,6 +206,12 @@ def _report_step_timing():
         print(f"[watch] step timing: {int(h['count'])} bench children, "
               f"avg {h['avg']:.1f}s min {h['min']:.1f}s max {h['max']:.1f}s",
               flush=True)
+    c = trace.metrics().histogram("watch.compile_seconds").stats()
+    if c["count"]:
+        print(f"[watch] compile tax: "
+              f"{trace.metrics().counter('watch.compile_misses').value} "
+              f"misses, {c['total']:.1f}s total compile across "
+              f"{int(c['count'])} children", flush=True)
     if trace.enabled() and trace.get_events():
         print(f"[watch] timeline -> {trace.export_chrome_trace()}",
               flush=True)
