@@ -68,14 +68,15 @@ def insert_allreduce_ops(block, params_grads, ring_id=0, average=True):
             break
     new_pg = []
     for p, g in params_grads:
-        op = block.append_op(
-            op_type, inputs={"X": [g]}, outputs={"Out": [g]},
+        # Block._insert_op: build-and-place with the version bump the
+        # executor fingerprint requires (bare ops.insert is the documented
+        # stale-digest hazard).  The contiguous run this produces is
+        # exactly what the coalesce_allreduce pass buckets
+        # (BuildStrategy.fuse_all_reduce_ops, docs/passes.md).
+        block._insert_op(
+            pos, op_type, inputs={"X": [g]}, outputs={"Out": [g]},
             attrs={"ring_id": ring_id, "use_calc_stream": True,
                    OP_ROLE_KEY: OpRole.Backward})
-        # _remove_op (not bare list surgery): the pop-and-reinsert keeps the
-        # op count stable, so the executor fingerprint needs the version bump
-        block._remove_op(block.ops.index(op))
-        block.ops.insert(pos, op)
         pos += 1
         new_pg.append((p, g))
     return new_pg

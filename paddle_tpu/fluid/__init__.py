@@ -27,6 +27,7 @@ from . import io
 from .io import save_persistables, load_persistables, save_params, load_params
 from . import nets
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import passes
 from . import dygraph
 from ..contrib import memory_usage_calc as _muc  # noqa: F401 (cycle guard)
 from .. import contrib                            # fluid.contrib alias

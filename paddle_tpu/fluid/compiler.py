@@ -23,19 +23,31 @@ class ReduceStrategy:
 
 
 class BuildStrategy:
-    """Knob container (details/build_strategy.h).  Knobs that map to XLA
-    concepts are honored; the rest are inert but settable for API parity."""
+    """Knob container (details/build_strategy.h).  Since the pass
+    framework landed (fluid/passes/, docs/passes.md) the rewrite knobs
+    are REAL: each one selects a registered Program-IR pass that
+    CompiledProgram applies before the Executor caches the lowered
+    function (passes.passes_for_build_strategy is the
+    build_strategy.cc AppendPass analog).  Knobs that map to XLA concepts
+    (enable_inplace -> buffer donation, sync_batch_norm) keep their
+    executor-side meaning; the remainder stay settable for API parity."""
 
     def __init__(self):
         self.reduce_strategy = ReduceStrategy.AllReduce
         self.gradient_scale_strategy = 0
+        # directory: the pipeline dumps one Graphviz .dot per pass stage
         self.debug_graphviz_path = ""
         self.enable_inplace = True          # -> buffer donation (default on)
+        # True -> constant_fold + prune_identity + dce passes (the 1.x
+        # memory_optimize contract: shrink the live set / op stream)
         self.memory_optimize = None
         self.fuse_all_optimizer_ops = False  # XLA fuses regardless
-        self.fuse_all_reduce_ops = False
-        self.fuse_elewise_add_act_ops = False
-        self.fuse_bn_act_ops = False
+        self.fuse_all_reduce_ops = False     # -> coalesce_allreduce pass
+        self.fuse_grad_size_in_num = 32      # allreduce bucket size (ops)
+        self.fuse_elewise_add_act_ops = False  # -> fuse_elewise_add_act
+        self.fuse_bn_act_ops = False           # -> fuse_bn_act
+        self.enable_dce = False                # -> dce pass (fetch-seeded)
+        self.constant_folding = False          # -> constant_fold pass
         self.enable_sequential_execution = False
         self.remove_unnecessary_lock = True
         self.sync_batch_norm = False        # -> sync_batch_norm op psum
@@ -60,9 +72,42 @@ class CompiledProgram:
         self._build_strategy = build_strategy or BuildStrategy()
         self._mesh = None
         self._is_data_parallel = False
+        self._ir_passes_applied = False
         # forwarded so Executor.run can treat us like a Program
         self._hints = self._program._hints
         trace.metrics().counter("compiler.compiled_programs").inc()
+
+    def _apply_ir_passes(self, fetch_names=()):
+        """Run the BuildStrategy-selected pass pipeline over the program,
+        once, before the executor fingerprints it (the reference applies
+        build-strategy passes when ParallelExecutor materialises the
+        graph).  Called by Executor.run with the first run's fetch list —
+        the DCE seed and the rewrite protection set.  The rewrite is
+        in-place and version-bumped, so every executor cache keyed on the
+        old fingerprint is dead the moment a pass mutates."""
+        if self._ir_passes_applied:
+            return
+        self._ir_passes_applied = True
+        from . import passes
+        plist = passes.passes_for_build_strategy(self._build_strategy)
+        gv = self._build_strategy.debug_graphviz_path or None
+        if not plist and not gv:
+            return
+        pipe = passes.PassPipeline(plist, graphviz_path=gv)
+        if any(p.name == "dce" for p in plist):
+            # DCE permanently removes ops unreachable from THIS fetch set;
+            # the executor uses the recorded seed to turn a later fetch of
+            # a pruned var into an actionable error instead of a bare
+            # KeyError deep in the trace
+            self._program._hints["ir_pass_dce_targets"] = \
+                [str(n) for n in fetch_names]
+        _t0 = trace.now() if trace.enabled() else 0
+        stats = pipe.apply(self._program, targets=fetch_names,
+                           build_strategy=self._build_strategy)
+        if _t0:
+            trace.complete("compiler::apply_ir_passes", _t0, cat="compile",
+                           args={p: dict(s) for p, s in stats.items()})
+        return stats
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,

@@ -149,11 +149,13 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
     # are statically known from the op stream — fold them so
     # write/read_to_array resolve their slot at trace time
     const_env: Dict[str, float] = {}
+    n_dispatched = 0
     for i, op in enumerate(op_list):
         if stop_at is not None and i >= stop_at:
             break
         if op.type in ("feed", "fetch"):
             continue
+        n_dispatched += 1
         if op.type in ("while", "conditional_block", "select_input",
                        "select_output"):
             for n in op.output_arg_names:    # runtime writes: un-fold
@@ -228,6 +230,11 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
                             jnp.all(jnp.isfinite(val)),
                             f"NaN/Inf in output '{name}' of op "
                             f"'{op.type}'")
+    if n_dispatched:
+        # trace-time dispatch volume (always-on int bump per BLOCK, not
+        # per op): the executed-op counter the pass pipeline's end-to-end
+        # gate compares pipeline-on vs -off (docs/passes.md)
+        trace.metrics().counter("executor.ops_dispatched").inc(n_dispatched)
     return env
 
 
@@ -252,9 +259,15 @@ class Executor:
             return_numpy: bool = True,
             use_program_cache: bool = True):
         program = program or default_main_program()
+        fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
         # CompiledProgram facade (compiler.py) unwraps to its program + mesh
         mesh = getattr(program, "_mesh", None)
         if hasattr(program, "_program"):   # CompiledProgram
+            # BuildStrategy-selected IR passes run ONCE, seeded/protected
+            # by this first run's fetch set, before the program is
+            # fingerprinted — the pass framework contract (fluid/passes/)
+            if hasattr(program, "_apply_ir_passes"):
+                program._apply_ir_passes(fetch_names)
             mesh = getattr(program, "_mesh", None) or mesh
             program = program._program
         if program._hints.get("ps_server") is not None:
@@ -273,7 +286,6 @@ class Executor:
                                        use_program_cache)
         scope = scope or global_scope()
         feed = feed or {}
-        fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
 
         # ONE host conversion per feed (was: np.asarray per list/tuple feed
         # twice per step — once for the sig dtype, once in
@@ -602,6 +614,26 @@ class Executor:
         written_names = sorted(
             {n for op in run_ops for n in op.output_arg_names
              if n in persist or n in scope_state})
+        # post-prune op volume for this executable (bench.py reports it as
+        # ops_per_step beside throughput; the IR passes shrink it)
+        trace.metrics().gauge("executor.ops_per_step").set(len(run_ops))
+        dce_targets = program._hints.get("ir_pass_dce_targets")
+        if dce_targets is not None:
+            # the pass pipeline's DCE ran seeded by the first run's fetch
+            # set — a fetch of a var it pruned must fail with the cause,
+            # not a bare KeyError deep inside the jit trace
+            producible = set(feed) | set(param_names) | {
+                n for op in run_ops for n in op.output_arg_names}
+            for n in fetch_names:
+                if n not in producible:
+                    raise ValueError(
+                        f"fetch target '{n}' is no longer produced by "
+                        f"this program: the IR pass pipeline ran "
+                        f"dead-code elimination seeded by the FIRST "
+                        f"run's fetch set {sorted(dce_targets)}.  Fetch "
+                        f"every var you will ever need on the first run "
+                        f"of a CompiledProgram, or leave enable_dce / "
+                        f"memory_optimize off (docs/passes.md)")
         # per-op checkify checks can't be staged under wrap_with_mesh's
         # plain jit — mesh runs keep the post-hoc fetched-var scan instead
         debug_nan = bool(core.get_flag("check_nan_inf")) and mesh is None

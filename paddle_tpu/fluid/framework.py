@@ -297,6 +297,61 @@ class Block:
         del self.ops[index:(index + 1) if end is None else end]
         self.program._bump_version()
 
+    def _insert_op(self, index: int, type: str, inputs=None, outputs=None,
+                   attrs=None) -> Operator:
+        """Build an op (var creation + shape inference, exactly like
+        append_op) and place it at ``index`` (reference Block._insert_op).
+        The bump rides on append_op."""
+        op = self.append_op(type, inputs, outputs, attrs)
+        self.ops.insert(index, self.ops.pop())
+        return op
+
+    def _insert_op_obj(self, index: int, op: Operator) -> Operator:
+        """Insert an already-constructed Operator at ``index`` — the
+        pattern-rewriter path, where ops are assembled detached and spliced
+        in.  A bare ``ops.insert`` would keep ``_version`` stale exactly
+        like the documented ``_remove_op`` hazard."""
+        self.ops.insert(index, op)
+        for names in op.outputs.values():
+            for n in names:
+                if self._find_var_recursive(n) is None:
+                    self.create_var(name=n)
+        self.program._bump_version()
+        return op
+
+    def _remove_var(self, name: str) -> bool:
+        """Drop a var from this block (reference Block._remove_var),
+        bumping the version: serialized descs and pass-managed rewrites
+        key off it."""
+        existed = self.vars.pop(name, None) is not None
+        if existed:
+            self.program._bump_version()
+        return existed
+
+    def _rename_var(self, old: str, new: str) -> Optional[Variable]:
+        """Rename a var and every reference to it (reference
+        Block._rename_var): op input/output lists in ALL blocks (sub-block
+        ops capture outer vars by name), and the name-carrying control-flow
+        attrs (`true_outs`, read by the conditional_block pass-through
+        path).  Bumps the version: these name lists feed the executor
+        fingerprint."""
+        v = self.vars.pop(old, None)
+        if v is not None:
+            v.name = new
+            self.vars[new] = v
+        for b in self.program.blocks:
+            for op in b.ops:
+                for d in (op.inputs, op.outputs):
+                    for slot, names in d.items():
+                        d[slot] = [new if n == old else n for n in names]
+                for k, val in op.attrs.items():
+                    if k in ("true_outs", "false_outs") and isinstance(
+                            val, (list, tuple)):
+                        op.attrs[k] = type(val)(
+                            new if n == old else n for n in val)
+        self.program._bump_version()
+        return v
+
     def all_parameters(self) -> List[Parameter]:
         return [v for v in self.program.global_block().vars.values()
                 if isinstance(v, Parameter)]

@@ -1,0 +1,202 @@
+"""Declarative op-DAG pattern matcher with var capture.
+
+Reference: paddle/fluid/framework/ir/graph_pattern_detector.h — PDPattern
+nodes linked by var edges, GraphPatternDetector walking the graph and
+handing matched subgraphs to a rewrite callback.  Here a Pattern is an
+ordered list of op templates (op type or alternatives, plus constraints on
+named input/output slots and attrs); a slot constraint is a list of PVar
+captures and/or literal var names.  Matching walks ``block.ops`` in
+program order (fluid blocks are topologically ordered by construction), so
+pattern ops must be declared in the order they appear in the block —
+forward ops first, their grad ops after, exactly how append_backward lays
+them out.
+
+Only the slots named in the template are constrained; unlisted slots match
+anything (a generic_grad carries I_<slot> mirrors of every forward slot —
+a pattern usually pins just the one that identifies the edge).  Attr
+constraints are literal values or predicates.
+
+Rewrites go through the Block mutators (``_insert_op`` / ``_insert_op_obj``
+/ ``_remove_op``) so every rewrite bumps the program version and the
+executor recompiles (see executor._fingerprint).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .core import Pass, PassContext
+
+__all__ = ["PVar", "POp", "Pattern", "Match", "PatternRewritePass",
+           "writer_index"]
+
+
+class PVar:
+    """A capture slot: first binding fixes the var name, later uses must
+    agree (the DAG edge)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"?{self.name}"
+
+
+class POp:
+    """One op template in a Pattern."""
+
+    def __init__(self, types, ins=None, outs=None, attrs=None):
+        self.types = (types,) if isinstance(types, str) else tuple(types)
+        self.ins = dict(ins or {})
+        self.outs = dict(outs or {})
+        self.attrs = dict(attrs or {})
+
+    def _match_slots(self, spec: Dict[str, list], actual: Dict[str, list],
+                     binding: Dict[str, str]) -> Optional[Dict[str, str]]:
+        for slot, pats in spec.items():
+            names = actual.get(slot)
+            if names is None or len(names) != len(pats):
+                return None
+            for pat, name in zip(pats, names):
+                if isinstance(pat, PVar):
+                    bound = binding.get(pat.name)
+                    if bound is None:
+                        binding = dict(binding)
+                        binding[pat.name] = name
+                    elif bound != name:
+                        return None
+                elif pat != name:
+                    return None
+        return binding
+
+    def match(self, op, binding: Dict[str, str]) -> Optional[Dict[str, str]]:
+        if op.type not in self.types:
+            return None
+        for k, want in self.attrs.items():
+            have = op.attrs.get(k)
+            ok = want(have) if callable(want) else have == want
+            if not ok:
+                return None
+        binding = self._match_slots(self.ins, op.inputs, binding)
+        if binding is None:
+            return None
+        return self._match_slots(self.outs, op.outputs, binding)
+
+
+class Match:
+    """A matched subgraph: pattern-aligned ops + the var bindings."""
+
+    def __init__(self, block, ops, binding: Dict[str, str]):
+        self.block = block
+        self.ops = list(ops)
+        self.binding = dict(binding)
+
+    def var(self, name: str) -> str:
+        return self.binding[name]
+
+    def index(self, i: int) -> int:
+        """Current position of matched op i in the block (positions move
+        as rewrites splice ops)."""
+        return self.block.ops.index(self.ops[i])
+
+
+class Pattern:
+    """Build with ``var()`` + ``op()``; match with ``match_all(block)``."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.pops: List[POp] = []
+        self._vars: Dict[str, PVar] = {}
+
+    def var(self, name: str) -> PVar:
+        v = self._vars.get(name)
+        if v is None:
+            v = self._vars[name] = PVar(name)
+        return v
+
+    def vars(self, names: str) -> Tuple[PVar, ...]:
+        return tuple(self.var(n) for n in names.split())
+
+    def op(self, types, ins=None, outs=None, attrs=None) -> POp:
+        p = POp(types, ins, outs, attrs)
+        self.pops.append(p)
+        return p
+
+    # -- matching -----------------------------------------------------------
+    def _extend(self, ops, start: int, depth: int,
+                binding: Dict[str, str], picked: list):
+        if depth == len(self.pops):
+            yield picked, binding
+            return
+        pop = self.pops[depth]
+        for i in range(start, len(ops)):
+            b = pop.match(ops[i], binding)
+            if b is not None:
+                yield from self._extend(ops, i + 1, depth + 1, b,
+                                        picked + [ops[i]])
+
+    def first_match(self, block, start: int = 0) -> Optional[Match]:
+        for picked, binding in self._extend(block.ops, start, 0, {}, []):
+            return Match(block, picked, binding)
+        return None
+
+    def match_all(self, block) -> List[Match]:
+        """All non-overlapping matches, scanning in program order."""
+        out, used = [], set()
+        for picked, binding in self._extend(block.ops, 0, 0, {}, []):
+            if any(id(op) in used for op in picked):
+                continue
+            used.update(id(op) for op in picked)
+            out.append(Match(block, picked, binding))
+        return out
+
+
+def writer_index(block, name: str) -> List[int]:
+    """Indices of ops writing ``name`` — the single-writer precondition
+    every rewrite rule checks before re-aliasing an edge."""
+    return [i for i, op in enumerate(block.ops)
+            if name in op.output_arg_names]
+
+
+class PatternRewritePass(Pass):
+    """A Pass driven by (Pattern, rewrite) rules, tried in order.
+
+    ``rewrite(match, ctx) -> bool`` performs the in-place block rewrite
+    through the mutators and returns True on success; returning False
+    leaves the block untouched (a structural precondition failed — e.g.
+    the intermediate var has an extra consumer) and the scan moves on.
+    After every successful rewrite the scan restarts: positions and
+    consumer sets have changed.
+    """
+
+    #: list of (Pattern, rewrite_fn-name) pairs; subclasses populate in
+    #: __init__ via self.rules
+    max_rewrites = 10_000
+
+    def __init__(self, **options):
+        super().__init__(**options)
+        self.rules: List[Tuple[Pattern, Callable]] = []
+
+    def apply_block(self, block, ctx: PassContext) -> Dict[str, int]:
+        fused = 0
+        for pattern, rewrite in self.rules:
+            budget = self.max_rewrites
+            rejected = set()            # op-id tuples rewrite() declined
+            while budget > 0:
+                budget -= 1
+                done = False
+                for picked, binding in pattern._extend(
+                        block.ops, 0, 0, {}, []):
+                    key = tuple(id(op) for op in picked)
+                    if key in rejected:
+                        continue
+                    m = Match(block, picked, binding)
+                    if rewrite(m, ctx):
+                        fused += 1
+                        done = True
+                        break           # restart scan: block changed
+                    rejected.add(key)
+                if not done:
+                    break
+        return {"ops_fused": fused} if fused else {}

@@ -503,7 +503,8 @@ def export_chrome_trace(path: Optional[str] = None) -> str:
 # ProfilerOptions / platform/profiler.cc EventSortingKey)
 SORTED_KEYS = ("default", "calls", "total", "max", "min", "ave")
 
-_SUMMARY_CATS = ("op", "dygraph_op", "comm", "step", "compile", "annotation")
+_SUMMARY_CATS = ("op", "dygraph_op", "comm", "step", "compile", "pass",
+                 "annotation")
 
 
 def op_summary(sorted_key: str = "total", cats=_SUMMARY_CATS):

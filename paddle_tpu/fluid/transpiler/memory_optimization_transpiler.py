@@ -2,8 +2,16 @@
 
 The reference's var-reuse rewriting (memory_optimization_transpiler.py)
 was already deprecated in 1.8 in favor of build-strategy passes; on this
-stack XLA owns buffer liveness and reuse outright (SURVEY §2.2 TPU note),
-so both entry points are contract-keeping no-ops that warn once."""
+stack XLA owns buffer liveness and reuse outright (SURVEY §2.2 TPU note).
+Both entry points are deprecation shims that route through the IR pass
+manager (fluid/passes/): they apply the registered
+``memory_optimize_legacy`` no-op pass, so a legacy caller sees a
+``pass::memory_optimize_legacy`` span and counter in the observability
+plane instead of silently doing nothing.  Callers who want the op-stream
+actually shrunk should set ``BuildStrategy.memory_optimize = True`` on a
+CompiledProgram — that wires the real constant_fold / prune_identity /
+dce passes (docs/passes.md).
+"""
 from __future__ import annotations
 
 import warnings
@@ -11,17 +19,32 @@ import warnings
 __all__ = ["memory_optimize", "release_memory"]
 
 
+def _apply_legacy_noop(input_program):
+    from ..passes import PassPipeline, create_pass
+    if input_program is None or not hasattr(input_program, "blocks"):
+        return None
+    return PassPipeline([create_pass("memory_optimize_legacy")]).apply(
+        input_program)
+
+
 def memory_optimize(input_program, skip_opt_set=None, print_log=False,
                     level=0, skip_grads=True):
     warnings.warn(
-        "memory_optimize is a no-op on the TPU build: XLA performs buffer "
-        "sharing/reuse during compilation (the reference deprecated this "
-        "pass in 1.8 as well)", DeprecationWarning, stacklevel=2)
+        "memory_optimize is deprecated on the TPU build: XLA performs "
+        "buffer sharing/reuse during compilation (the reference "
+        "deprecated this pass in 1.8 as well).  The call now routes "
+        "through the IR pass manager as the no-op "
+        "'memory_optimize_legacy' pass; for real op-stream shrinking use "
+        "CompiledProgram with BuildStrategy.memory_optimize=True "
+        "(docs/passes.md)", DeprecationWarning, stacklevel=2)
+    _apply_legacy_noop(input_program)
     return None
 
 
 def release_memory(input_program, skip_opt_set=None):
     warnings.warn(
-        "release_memory is a no-op on the TPU build: XLA owns HBM "
-        "lifetime", DeprecationWarning, stacklevel=2)
+        "release_memory is deprecated on the TPU build: XLA owns HBM "
+        "lifetime; the call routes through the IR pass manager as a "
+        "traced no-op", DeprecationWarning, stacklevel=2)
+    _apply_legacy_noop(input_program)
     return None

@@ -68,6 +68,29 @@ def _allreduce(reducer):
     return lower
 
 
+@register_comm_op("c_allreduce_coalesced", differentiable=False)
+def _c_allreduce_coalesced(ins, attrs, ctx):
+    """Bucketed gradient all-reduce (fuse_all_reduce_op_pass +
+    coalesce_tensor analog), emitted by the coalesce_allreduce graph pass:
+    N small per-grad launches become ONE flattened psum/pmean over the
+    concatenated bucket, then the slices go back to their own shapes and
+    dtypes.  Mixed dtypes ride in the promoted dtype and are cast back —
+    same-or-better precision than per-tensor reduction."""
+    xs = list(ins["X"])
+    axis = _axis(ctx, attrs)
+    if axis is None:
+        return {"Out": xs}
+    reducer = lax.pmean if attrs.get("reduce", "sum") == "avg" else lax.psum
+    flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    red = reducer(flat, axis_name=axis)
+    outs, off = [], 0
+    for x in xs:
+        n = int(x.size)
+        outs.append(red[off:off + n].reshape(x.shape).astype(x.dtype))
+        off += n
+    return {"Out": outs}
+
+
 register_comm_op("c_allreduce_sum", _allreduce(lax.psum))
 register_comm_op("c_allreduce_max", _allreduce(lax.pmax))
 register_comm_op("c_allreduce_min", _allreduce(lax.pmin))

@@ -253,14 +253,20 @@ def _correlation(ins, attrs, ctx):
 # fused family — compositions
 # ---------------------------------------------------------------------------
 
-@register_op("fused_bn_activation")
+@register_op("fused_bn_activation",
+             nondiff_inputs=("Mean", "Variance"),
+             nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                              "SavedVariance"))
 def _fused_bn_activation(ins, attrs, ctx):
     outs = get_op("batch_norm").fn(ins, attrs, ctx)
     outs["Y"] = [_act(attrs.get("act_type", "relu"), outs["Y"][0])]
     return outs
 
 
-@register_op("fused_bn_add_activation")
+@register_op("fused_bn_add_activation",
+             nondiff_inputs=("Mean", "Variance"),
+             nondiff_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                              "SavedVariance"))
 def _fused_bn_add_activation(ins, attrs, ctx):
     z = _p(ins, "Z")
     outs = get_op("batch_norm").fn(

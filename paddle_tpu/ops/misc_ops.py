@@ -360,12 +360,16 @@ _UNARY = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
 @register_op("fused_elemwise_activation")
 def _fused_elemwise_activation(ins, attrs, ctx):
     """fused_elemwise_activation_op.cc: functor_list like
-    ['elementwise_add', 'relu'] applied as f2(f1(x, y))."""
-    x, y = _x(ins), _x(ins, "Y")
+    ['elementwise_add', 'relu'] applied as f2(f1(x, y)).  Honors the
+    elementwise `axis` attr with the same alignment as the standalone
+    elementwise ops (the fuse_elewise_add_act pass folds fc's axis=1 bias
+    add), and IntermediateOut is f1's result, not the final value."""
+    from .math import _bcast
+    x, y = _bcast(_x(ins), _x(ins, "Y"), attrs.get("axis", -1))
     functors = attrs.get("functor_list", ["elementwise_add", "relu"])
     binop = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply,
              "elementwise_sub": jnp.subtract}
-    cur = None
+    cur = inter = None
     for f in functors:
         if f in binop:
             cur = binop[f](x, y) if cur is None else binop[f](cur, y)
@@ -373,7 +377,8 @@ def _fused_elemwise_activation(ins, attrs, ctx):
             name = f.replace("scale", "identity")
             cur = _UNARY.get(name, _UNARY["identity"])(
                 cur if cur is not None else x)
-    return {"Out": [cur], "IntermediateOut": [cur]}
+        inter = cur if inter is None else inter
+    return {"Out": [cur], "IntermediateOut": [inter]}
 
 
 @register_op("fused_embedding_eltwise_layernorm",

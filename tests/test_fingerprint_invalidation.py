@@ -54,6 +54,81 @@ def test_update_desc_attr_alias():
     assert _fingerprint(p) != f0
 
 
+def test_insert_op_changes_digest():
+    """Block._insert_op (the pass-framework splice point) must bump."""
+    p, b = _two_scale_program()
+    f0 = _fingerprint(p)
+    b._insert_op(1, "scale", {"X": ["y"]}, {"Out": ["w"]}, {"scale": 9.0})
+    assert b.ops[1].type == "scale" and b.ops[1].attrs["scale"] == 9.0
+    assert _fingerprint(p) != f0
+
+
+def test_insert_op_obj_changes_digest():
+    """Inserting a detached Operator (pattern-rewriter path) must bump —
+    a bare ops.insert keeps count AND version when paired with a remove."""
+    from paddle_tpu.fluid.framework import Operator
+    p, b = _two_scale_program()
+    f0 = _fingerprint(p)
+    op = Operator(b, "scale", {"X": ["y"]}, {"Out": ["q"]}, {"scale": 7.0})
+    b._remove_op(1)
+    b._insert_op_obj(1, op)          # same op count as before
+    assert len(b.ops) == 2
+    assert _fingerprint(p) != f0
+
+
+def test_remove_var_and_rename_var_bump():
+    p, b = _two_scale_program()
+    v0 = p._version
+    assert b._remove_var("z")
+    assert p._version > v0
+    v1 = p._version
+    b.ops[1].attrs["true_outs"] = ["y"]     # name-carrying attr capture
+    b._rename_var("y", "y2")
+    assert p._version > v1
+    assert b.ops[0].outputs["Out"] == ["y2"]
+    assert b.ops[1].inputs["X"] == ["y2"]
+    assert b.ops[1].attrs["true_outs"] == ["y2"]
+
+
+def test_pass_application_invalidates_fingerprint():
+    """ISSUE 3 satellite: ANY mutating pass application must change the
+    executor's cached fingerprint — a pipeline that fused/removed ops but
+    left the digest intact would serve a stale executable."""
+    from paddle_tpu.fluid.passes import PassPipeline, create_pass
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4])
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.reduce_sum(h)
+    f0 = _fingerprint(main)
+    stats = PassPipeline([create_pass("fuse_elewise_add_act")]).apply(
+        main, targets=[out.name])
+    assert stats["fuse_elewise_add_act"]["ops_fused"] == 1
+    assert _fingerprint(main) != f0
+
+
+def test_executor_recompiles_after_pass_pipeline():
+    """End to end: results must reflect the rewritten program on a warm
+    executor cache (compile-cache key includes the bumped fingerprint)."""
+    from paddle_tpu.fluid.passes import PassPipeline, create_pass
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [3])
+        y = fluid.layers.scale(x, scale=2.0)
+        z = fluid.layers.scale(y, scale=3.0)
+    exe = fluid.Executor()
+    feed = {"x": np.ones(3, "float32")}
+    out1, = exe.run(main, feed=feed, fetch_list=[z])
+    assert np.allclose(out1, 6.0)
+    # constant-fold-style rewrite: compose the chain into one scale
+    PassPipeline([create_pass("constant_fold"),
+                  create_pass("dce")]).apply(main, targets=[z.name])
+    ops = [op for op in main.global_block().ops if op.type == "scale"]
+    assert len(ops) == 1 and ops[0].attrs["scale"] == 6.0
+    out2, = exe.run(main, feed=feed, fetch_list=[z])
+    assert np.allclose(out2, 6.0), "stale executable after pass rewrite"
+
+
 def test_executor_recompiles_after_set_attr():
     """End to end: the cached executable must NOT be reused after an
     in-place attr rewrite (the stale result would be numerically wrong)."""

@@ -180,6 +180,58 @@ def main():
     finally:
         fluid.core.set_flags({"FLAGS_shape_bucketing": False})
 
+    step("IR passes: DCE+fusion drops >=15% ops, loss unchanged")
+    from paddle_tpu.fluid import trace as tr2
+    from paddle_tpu.fluid.framework import reset_unique_name
+
+    def build_demo():
+        mp, sp = fluid.Program(), fluid.Program()
+        with fluid.program_guard(mp, sp):
+            xd = fluid.data("xd", [-1, 16])
+            yd = fluid.data("yd", [-1, 1], dtype="int64")
+            h = fluid.layers.fc(xd, 32, act="relu")
+            h = fluid.layers.fc(h, 32, act="relu")
+            h = fluid.layers.fc(h, 16, act="relu")
+            logits = fluid.layers.fc(h, 10)
+            lo = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, yd))
+            fluid.optimizer.SGDOptimizer(0.1).minimize(lo)
+        return mp, sp, lo
+
+    demo_feed = {"xd": rng.randn(16, 16).astype("float32"),
+                 "yd": rng.randint(0, 10, (16, 1)).astype("int64")}
+
+    def run_demo(with_passes):
+        reset_unique_name()
+        mp, sp, lo = build_demo()
+        ex = fluid.Executor()
+        from paddle_tpu.fluid.core import Scope, scope_guard
+        with scope_guard(Scope()):
+            ex.run(sp)
+            prog = mp
+            if with_passes:
+                bs = fluid.BuildStrategy()
+                bs.fuse_elewise_add_act_ops = True
+                bs.fuse_bn_act_ops = True
+                bs.enable_dce = True
+                bs.constant_folding = True
+                prog = fluid.CompiledProgram(mp, build_strategy=bs)
+            lvs = [float(np.asarray(ex.run(prog, feed=demo_feed,
+                                           fetch_list=[lo])[0]).ravel()[0])
+                   for _ in range(3)]
+            nops = tr2.metrics().gauge("executor.ops_per_step").value
+        return lvs, nops
+
+    loss_off, ops_off = run_demo(False)
+    loss_on, ops_on = run_demo(True)
+    assert np.allclose(loss_off, loss_on, rtol=1e-5, atol=1e-6), \
+        (loss_off, loss_on)
+    drop = (ops_off - ops_on) / max(ops_off, 1)
+    assert drop >= 0.15, \
+        f"pass pipeline dropped only {drop:.1%} ops ({ops_off}->{ops_on})"
+    print(f"[smoke]   ops/step {ops_off:.0f} -> {ops_on:.0f} "
+          f"(-{drop:.0%}), loss parity OK", flush=True)
+
     step("bench child emits one JSON line (cpu)")
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],
