@@ -204,6 +204,17 @@ def _compile_stats():
         ops = m.gauge("executor.ops_per_step").value
         if ops:                 # static-Executor benches only
             out["ops_per_step"] = int(ops)
+        # async pipeline depth + host-wait vs dispatch split
+        # (docs/performance.md "Async step pipeline"): how deep the
+        # in-flight window got and how much of the loop the host spent
+        # blocked on device results vs dispatching new work
+        hw = m.histogram("executor.host_wait_seconds").stats()["total"]
+        dp = m.histogram("executor.dispatch_seconds").stats()["total"]
+        peak = m.gauge("executor.inflight_peak").value
+        if peak:
+            out["inflight_depth"] = int(peak)
+            out["host_wait_seconds"] = round(hw, 3)
+            out["dispatch_seconds"] = round(dp, 3)
         return out
     except Exception:           # noqa: BLE001 — bench must report anyway
         return {}
@@ -406,13 +417,19 @@ def main_ctr():
 
     it = {"i": 0}
 
+    # async dispatch window (fluid/async_pipeline.py): submit returns a
+    # lazy loss; timed_run's float(loss) at the chunk boundary is the only
+    # sync, so feed staging and dispatch overlap device compute
+    from paddle_tpu.fluid.async_pipeline import AsyncStepRunner
+    runner = AsyncStepRunner(exe, train_prog, [loss])
+
     def one_step():
         f = feeds[it["i"] % n_batches]
         it["i"] += 1
-        lv, = exe.run(train_prog, feed=f, fetch_list=[loss])
-        return lv
+        return runner.submit(f).lazy(0)
 
     dt = timed_run(one_step, steps, warmup)
+    runner.drain()
     cache_rows = box.cache_rows
     box.end_pass(global_scope().find_var("bench_box@HBMCACHE"))
     ex_s = steps * batch / dt

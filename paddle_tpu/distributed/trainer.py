@@ -24,7 +24,8 @@ class TrainerStats:
     def __init__(self):
         self.steps = 0
         self.input_wait_s = 0.0     # consumer blocked on the feed queue
-        self.step_s = 0.0           # executor.run (dispatch + sync points)
+        self.step_s = 0.0           # dispatch time per step (async: submit)
+        self.host_wait_s = 0.0      # blocked on in-flight device steps
         self.produce_s = 0.0        # producer parse+stage time (overlapped)
         self.total_s = 0.0
         self.stage_fallbacks = 0    # batches that failed device staging
@@ -33,6 +34,7 @@ class TrainerStats:
         return {"steps": self.steps,
                 "input_wait_s": round(self.input_wait_s, 4),
                 "step_s": round(self.step_s, 4),
+                "host_wait_s": round(self.host_wait_s, 4),
                 "produce_s": round(self.produce_s, 4),
                 "total_s": round(self.total_s, 4),
                 "stage_fallbacks": self.stage_fallbacks}
@@ -175,6 +177,20 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
 
     pf = Prefetcher(dataset._iter_batches(), stage=stage,
                     capacity=max(1, prefetch), on_produce=on_produce)
+    # async dispatch window (fluid/async_pipeline.py): submit returns
+    # immediately and the runner bounds in-flight steps, so host feed
+    # prep / staging / dispatch all overlap device compute.  PS-served
+    # programs keep the blocking loop — their pull/push phases wrap each
+    # run() call and must see it complete.
+    prog_hints = getattr(program, "_hints", {}) or {}
+    runner = None
+    if prog_hints.get("ps_plan") is None \
+            and prog_hints.get("ps_server") is None:
+        from ..fluid.async_pipeline import AsyncStepRunner
+        runner = AsyncStepRunner(executor, program, fetch_names)
+    from ..fluid import trace as _trace
+    _hw = _trace.metrics().histogram("executor.host_wait_seconds")
+    hw0 = _hw.stats()["total"]
     t0 = time.perf_counter()
     results = []
     step = 0
@@ -186,20 +202,41 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
             if item is Prefetcher._STOP:
                 break
             t_step = time.perf_counter()
-            outs = executor.run(program, feed=item, fetch_list=fetch_names)
+            if runner is not None:
+                fut = runner.submit(item)
+                outs = None
+            else:
+                outs = executor.run(program, feed=item,
+                                    fetch_list=fetch_names)
             stats.step_s += time.perf_counter() - t_step
             if fetch_names and print_period and step % print_period == 0:
+                if outs is None:
+                    outs = fut.result()     # materialise only print steps
                 vals = {n: np.asarray(o).reshape(-1)[:4]
                         for n, o in zip(fetch_names, outs)}
                 print(f"[trainer] step {step}: {vals}")
                 results.append(outs)
             step += 1
+        if runner is not None:
+            # close the window before the box writeback reads trained
+            # rows; also surfaces any buffered dispatch error
+            runner.drain()
+            runner = None
     finally:
+        if runner is not None:
+            # error path: wait out in-flight device steps (the box
+            # writeback below reads the state they write) without letting
+            # a secondary dispatch error mask the primary exception
+            try:
+                runner.drain()
+            except Exception:       # noqa: BLE001 — primary error wins
+                pass
         # on error: cancel + drain so the producer thread and its staged
         # device buffers never leak, and stats still publish
         pf.close()
         box_finish()
         stats.steps = step
+        stats.host_wait_s = _hw.stats()["total"] - hw0
         stats.total_s = time.perf_counter() - t0
         executor._last_trainer_stats = stats
     return results

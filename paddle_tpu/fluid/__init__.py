@@ -14,6 +14,8 @@ from .framework import (Program, Variable, Parameter, program_guard,
                         in_dygraph_mode, convert_dtype,
                         cpu_places, device_guard)
 from .executor import Executor
+from . import async_pipeline
+from .async_pipeline import AsyncStepRunner, FetchHandle, StepFuture
 from .backward import append_backward, gradients
 from . import initializer
 from .initializer import Constant, Uniform, Normal, Xavier, MSRA

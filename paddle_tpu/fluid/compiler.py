@@ -62,12 +62,18 @@ class ExecutionStrategy:
     def __init__(self):
         self.num_threads = 0                # XLA schedules; inert
         self.num_iteration_per_drop_scope = 1
+        # num_iteration_per_run is REAL since the async pipeline landed
+        # (fluid/async_pipeline.py): K > 1 stamps the program's
+        # steps_per_dispatch hint, and the AsyncStepRunner drives K steps
+        # through one lax.scan executable per Python dispatch — the
+        # reference's "run K iterations per PE invocation" contract
         self.num_iteration_per_run = 1
         self.allow_op_delay = False
 
 
 class CompiledProgram:
-    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None,
+                 exec_strategy: Optional[ExecutionStrategy] = None):
         self._program = getattr(program_or_graph, "_program", program_or_graph)
         self._build_strategy = build_strategy or BuildStrategy()
         self._mesh = None
@@ -75,7 +81,18 @@ class CompiledProgram:
         self._ir_passes_applied = False
         # forwarded so Executor.run can treat us like a Program
         self._hints = self._program._hints
+        if exec_strategy is not None:
+            self._apply_exec_strategy(exec_strategy)
         trace.metrics().counter("compiler.compiled_programs").inc()
+
+    def _apply_exec_strategy(self, exec_strategy):
+        k = int(getattr(exec_strategy, "num_iteration_per_run", 1) or 1)
+        if k > 1:
+            self._program._hints["steps_per_dispatch"] = k
+        else:
+            # explicit k=1 must undo an earlier strategy's hint — the
+            # hints dict is shared with the underlying Program
+            self._program._hints.pop("steps_per_dispatch", None)
 
     def _apply_ir_passes(self, fetch_names=()):
         """Run the BuildStrategy-selected pass pipeline over the program,
@@ -115,6 +132,8 @@ class CompiledProgram:
         """Local multi-chip DP: build a 1-axis device mesh over the chips."""
         if build_strategy is not None:
             self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._apply_exec_strategy(exec_strategy)
         from ..parallel.mesh import build_data_parallel_mesh
         _t0 = trace.now() if trace.enabled() else 0
         self._mesh = build_data_parallel_mesh(places)

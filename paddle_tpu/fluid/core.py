@@ -136,6 +136,16 @@ _FLAGS: Dict[str, object] = {
         "FLAGS_recompile_warn_threshold", "8")),
     "recompile_warn_window": float(_os.environ.get(
         "FLAGS_recompile_warn_window", "60")),
+    # async step pipeline (fluid/async_pipeline.py, docs/performance.md).
+    # max_inflight_steps bounds how many dispatched steps may be
+    # outstanding before the runner blocks on the oldest one's fetches
+    # (also caps the Prefetcher's device-staged queue at inflight+1);
+    # steps_per_dispatch=K compiles a lax.scan over K stacked microbatches
+    # so one Python dispatch drives K device steps.
+    "max_inflight_steps": int(_os.environ.get(
+        "FLAGS_max_inflight_steps", "2")),
+    "steps_per_dispatch": int(_os.environ.get(
+        "FLAGS_steps_per_dispatch", "1")),
 }
 
 

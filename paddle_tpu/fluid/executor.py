@@ -97,12 +97,29 @@ class _CompiledBlock:
     (program, feed signature)."""
 
     def __init__(self, fn, param_names, written_names, fetch_names,
-                 n_ops=None):
+                 n_ops=None, raw_fn=None, donates=False, err_cell=None,
+                 alias_cell=None):
         self.fn = fn
         self.param_names = param_names
         self.written_names = written_names
         self.fetch_names = fetch_names
         self.n_ops = n_ops          # post-prune op count (introspection)
+        self.raw_fn = raw_fn        # un-jitted step (run_scan fuses over it)
+        self.donates = donates      # jit donates the mutable-state args
+        self.err_cell = err_cell    # deferred checkify error (lazy fetches)
+        # per-fetch does-it-alias-scope-state mask, recorded by TRACER
+        # identity at trace time (id() of the returned arrays is useless:
+        # XLA may back a fetch and a state output with ONE buffer).  None
+        # = unknown (non-plain step builders): treat every fetch as
+        # aliasing when the program donates — conservative, never unsafe.
+        self.alias_cell = alias_cell
+
+    def fetch_alias_mask(self, n_fetch):
+        if self.alias_cell is None:
+            return ((self.donates,) * n_fetch)
+        if self.alias_cell:
+            return self.alias_cell[0]
+        return (False,) * n_fetch
 
 
 def _batch_major_hint(block, op):
@@ -250,6 +267,18 @@ class Executor:
         self._cache: "OrderedDict[tuple, _CompiledBlock]" = OrderedDict()
         self._storm = compile_cache.RecompileStormDetector()
         self._step = 0
+        # run_async keeps one AsyncStepRunner per (program, fetches, scope),
+        # LRU-bounded like _cache (a runner pins its program, scope, and
+        # in-flight device buffers) — evicted runners are drained first
+        self._async_runners: "OrderedDict[tuple, Any]" = OrderedDict()
+        # weakrefs to every live state-aliasing FetchHandle issued by a
+        # lazy run on this executor: the next DONATING dispatch (from any
+        # runner, or a plain sync run) persists these before it
+        # invalidates the scope's state buffers.  Executor-level because
+        # scope state is shared across runners and programs — a read-only
+        # eval fetch of W must survive the train step donating W.
+        # Weakrefs so handles the caller dropped cost nothing.
+        self._alias_live: List[Any] = []
 
     # -- public API ---------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -285,17 +314,7 @@ class Executor:
                                        scope, return_numpy,
                                        use_program_cache)
         scope = scope or global_scope()
-        feed = feed or {}
-
-        # ONE host conversion per feed (was: np.asarray per list/tuple feed
-        # twice per step — once for the sig dtype, once in
-        # check_feed_width).  Device/numpy arrays pass through untouched:
-        # np.asarray on a device array forces a D2H sync, serialising the
-        # prefetch pipeline.
-        feed = {k: (v if hasattr(v, "dtype") else np.asarray(v))
-                for k, v in feed.items()}
-        for k, v in feed.items():
-            check_feed_width(k, v)
+        feed = self._normalize_feed(feed)
 
         # shape bucketing (fluid/compile_cache.py): pad the leading batch
         # dim up to a bucket edge BEFORE computing feed_sig, so a ragged
@@ -384,12 +403,7 @@ class Executor:
             # step call below so they cover the real compile
             pending_compile = (_t0, pcache, pkey, pwarm)
             if use_program_cache:
-                self._cache[key] = compiled
-                cap = int(core.get_flag("executor_cache_capacity", 128) or 0)
-                while cap > 0 and len(self._cache) > cap:
-                    self._cache.popitem(last=False)
-                    trace.metrics().counter(
-                        "executor.compile_cache_evict").inc()
+                self._cache_store(key, compiled)
         else:
             self._cache.move_to_end(key)
             trace.metrics().counter("executor.compile_cache_hit").inc()
@@ -408,6 +422,8 @@ class Executor:
         step_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
         self._step += 1
 
+        if compiled.donates:
+            self._persist_alias_live()
         _t0 = trace.now() if tr_on else 0
         fetches, new_vals = compiled.fn(mut, ro, feeds, step_key)
         if tr_on:
@@ -432,37 +448,349 @@ class Executor:
                     "fetch": list(fetch_names), "bucket": bucket,
                     "compile_seconds": round(compile_s, 4),
                     "n_ops": compiled.n_ops})
+        deferred_err = (compiled.err_cell.pop("err", None)
+                        if compiled.err_cell else None)
         if bucket is not None and bucket != n_valid:
-            # fetches come back at the TRUE batch size (device-side slice,
-            # lazy — no extra sync).  The IR vetoes the dim0 heuristic:
-            # persistable vars (parameters/state) and vars with a known
-            # STATIC leading dim are never batch-major, even when dim 0
-            # aliases the bucket size.
-            blk = program.global_block()
-
-            def _not_batch(n):
-                v = blk._find_var_recursive(n)
-                return v is not None and (
-                    v.persistable or (v.shape is not None
-                                      and len(v.shape) >= 1
-                                      and v.shape[0] != -1))
-
-            fetches = [
-                f if (getattr(f, "ndim", 0) < 1 or f.shape[0] != bucket
-                      or _not_batch(n))
-                else f[:n_valid]
-                for n, f in zip(compiled.fetch_names, fetches)]
+            fetches = self._slice_true_batch(program, compiled.fetch_names,
+                                             fetches, bucket, n_valid)
         for n, v in new_vals.items():
             scope.set_var(n, v)
 
-        if core.get_flag("check_nan_inf"):
-            for n, v in zip(compiled.fetch_names, fetches):
-                if jnp.issubdtype(v.dtype, jnp.floating) and not bool(
-                        jnp.all(jnp.isfinite(v))):
-                    raise FloatingPointError(f"NaN/Inf in fetched var '{n}'")
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            if deferred_err is not None:
+                deferred_err.throw()
+            # ONE D2H transfer for the whole fetch tree (was: np.asarray
+            # per fetch — N serial device syncs per step)
+            host = jax.device_get(list(fetches))
+            if core.get_flag("check_nan_inf"):
+                for n, v in zip(compiled.fetch_names, host):
+                    va = np.asarray(v)
+                    if np.issubdtype(va.dtype, np.floating) \
+                            and not np.all(np.isfinite(va)):
+                        raise FloatingPointError(
+                            f"NaN/Inf in fetched var '{n}'")
+            return [np.asarray(f) for f in host]
+        # lazy fetches: live device arrays behind FetchHandle — no sync at
+        # all until someone materialises.  NaN scans and deferred checkify
+        # errors fire at materialisation; aliases_state marks fetches that
+        # share a buffer with scope state (the donation-safety signal the
+        # async runner consumes before the next dispatch donates).
+        from .async_pipeline import FetchHandle, _once
+        check = bool(core.get_flag("check_nan_inf"))
+        mask = compiled.fetch_alias_mask(len(fetches))
+        pre = _once(deferred_err.throw) if deferred_err is not None else None
+        handles = [FetchHandle(f, name=n, aliases_state=alias,
+                               check_nan=check, pre_check=pre)
+                   for n, f, alias
+                   in zip(compiled.fetch_names, fetches, mask)]
+        import weakref
+        self._alias_live.extend(weakref.ref(h) for h in handles
+                                if h.aliases_state)
+        if len(self._alias_live) > 4096:
+            # never-donating processes (CPU) only ever append: compact to
+            # the handles still alive and unpersisted
+            self._alias_live = [r for r in self._alias_live
+                                if (h := r()) is not None
+                                and not h.is_materialized()]
+        return handles
+
+    def _persist_alias_live(self):
+        """Host-copy every outstanding state-aliasing lazy fetch before a
+        donating dispatch invalidates the scope's state buffers — shared
+        across runners, programs, and sync runs (the donation-safety
+        invariant)."""
+        for ref in self._alias_live:
+            h = ref()
+            if h is not None:
+                h.persist()
+        del self._alias_live[:]
+
+    def _slice_true_batch(self, program, fetch_names, fetches, bucket,
+                          n_valid):
+        """Slice padded fetches back to the TRUE batch size (device-side
+        lazy slice — no extra sync).  The IR vetoes the dim0 heuristic:
+        persistable vars (parameters/state) and vars with a known STATIC
+        leading dim are never batch-major, even when dim 0 aliases the
+        bucket size."""
+        blk = program.global_block()
+
+        def _not_batch(n):
+            v = blk._find_var_recursive(n)
+            return v is not None and (
+                v.persistable or (v.shape is not None
+                                  and len(v.shape) >= 1
+                                  and v.shape[0] != -1))
+
+        return [
+            f if (getattr(f, "ndim", 0) < 1 or f.shape[0] != bucket
+                  or _not_batch(n))
+            else f[:n_valid]
+            for n, f in zip(fetch_names, fetches)]
+
+    # -- async / multi-step dispatch ----------------------------------------
+    def run_async(self, program: Optional[Program] = None,
+                  feed: Optional[Dict[str, Any]] = None,
+                  fetch_list: Optional[Sequence] = None,
+                  scope: Optional[Scope] = None,
+                  max_inflight: Optional[int] = None,
+                  steps_per_dispatch: Optional[int] = None):
+        """Async analog of :meth:`run`: submit the step into a bounded
+        in-flight window (`FLAGS_max_inflight_steps`) and return a
+        StepFuture of FetchHandles immediately — the host keeps feeding
+        while the device computes (fluid/async_pipeline.py).  One runner
+        is kept per (program, fetch set, scope) on this Executor;
+        :meth:`drain_async` flushes and waits on all of them."""
+        from .async_pipeline import AsyncStepRunner
+        program = program or default_main_program()
+        fetch_names = tuple(_fetch_name(f) for f in _as_list(fetch_list))
+        # explicit window params are part of the key: a later call with a
+        # different max_inflight/K gets its own runner, never a silently
+        # reused one with the old bounds
+        key = (id(program), fetch_names, id(scope), max_inflight,
+               steps_per_dispatch)
+        runner = self._async_runners.get(key)
+        if runner is None:
+            runner = self._async_runners[key] = AsyncStepRunner(
+                self, program, _as_list(fetch_list), scope=scope,
+                max_inflight=max_inflight,
+                steps_per_dispatch=steps_per_dispatch)
+            cap = int(core.get_flag("executor_cache_capacity", 128) or 0)
+            while cap > 0 and len(self._async_runners) > cap:
+                _, old = self._async_runners.popitem(last=False)
+                old.drain()
+        else:
+            self._async_runners.move_to_end(key)
+        return runner.submit(feed or {})
+
+    def drain_async(self):
+        """Flush partial scan groups, wait on every in-flight step, and
+        re-raise any unconsumed dispatch error."""
+        for runner in list(self._async_runners.values()):
+            runner.drain()
+
+    def run_scan(self, program: Optional[Program] = None,
+                 feed_list: Optional[Sequence[Dict[str, Any]]] = None,
+                 fetch_list: Optional[Sequence] = None,
+                 scope: Optional[Scope] = None,
+                 return_numpy: bool = True,
+                 use_program_cache: bool = True,
+                 return_handles: bool = False):
+        """Multi-step fusion: run K feeds through ONE ``lax.scan``-wrapped
+        executable — one Python dispatch, K device steps, with the scope
+        state (params/opt state) carried device-side between iterations
+        (never through numpy).  Bit-equal to K sequential :meth:`run`
+        calls: same per-step PRNG fold_in, same op stream, and with shape
+        bucketing the per-step true batch size rides in as a stacked
+        ``__batch_valid__`` vector.  Raises :class:`ScanUnsupportedError`
+        for programs whose step builders do their own batch surgery
+        (mesh / pipeline / recompute / PS) or checkify debug mode — the
+        AsyncStepRunner degrades to sequential dispatches on that signal.
+        Compile accounting mirrors run() (hit/miss counters, compile
+        span); the persistent program index only records single-step
+        executables."""
+        from .async_pipeline import FetchHandle, ScanUnsupportedError
+        program = program or default_main_program()
+        feeds_in = list(feed_list or [])
+        if not feeds_in:
+            return []
+        fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
+        mesh = getattr(program, "_mesh", None)
+        if hasattr(program, "_program"):   # CompiledProgram
+            if hasattr(program, "_apply_ir_passes"):
+                program._apply_ir_passes(fetch_names)
+            mesh = getattr(program, "_mesh", None) or mesh
+            program = program._program
+        if (mesh is not None
+                or program._hints.get("pipeline_microbatches")
+                or program._hints.get("recompute_checkpoints")
+                or program._hints.get("ps_plan") is not None
+                or program._hints.get("ps_server") is not None):
+            raise ScanUnsupportedError(
+                "run_scan: mesh/pipeline/recompute/PS programs do their "
+                "own per-step surgery — dispatch them one step at a time")
+        if core.get_flag("check_nan_inf"):
+            raise ScanUnsupportedError(
+                "run_scan: FLAGS_check_nan_inf compiles per-op checkify "
+                "checks that cannot nest under lax.scan", permanent=False)
+        if len(feeds_in) == 1:
+            out = self.run(program, feed=feeds_in[0],
+                           fetch_list=fetch_list, scope=scope,
+                           return_numpy=return_numpy and not return_handles,
+                           use_program_cache=use_program_cache)
+            return [out]
+        scope = scope or global_scope()
+        k_steps = len(feeds_in)
+
+        feeds = [self._normalize_feed(f) for f in feeds_in]
+
+        # shape bucketing: every feed in the group pads to the GROUP's
+        # bucket (max of the per-step edges) so the stacked batch is
+        # rectangular; the per-step true size rides in __batch_valid__
+        bucket = None
+        n_valids = None
+        if core.get_flag("shape_bucketing") and feeds[0]:
+            per_feed = []
+            for f in feeds:
+                dims = {np.shape(v)[0] for v in f.values()
+                        if np.ndim(v) >= 1}
+                per_feed.append(int(next(iter(dims)))
+                                if len(dims) == 1 else None)
+            if all(n is not None for n in per_feed):
+                n_valids = per_feed
+                edges = compile_cache.normalize_edges(
+                    program._hints.get("bucket_edges")
+                    or core.get_flag("shape_bucket_edges"))
+                bucket = max(compile_cache.bucket_for(n, edges)
+                             for n in n_valids)
+                feeds = [{k: (compile_cache.pad_dim0(v, bucket)
+                              if np.ndim(v) >= 1
+                              and np.shape(v)[0] != bucket else v)
+                          for k, v in f.items()} for f in feeds]
+            else:
+                trace.metrics().counter(
+                    "executor.bucketing_skipped_mixed_feeds").inc()
+
+        sigs = {tuple(sorted((k, tuple(np.shape(v)), str(v.dtype))
+                             for k, v in f.items())) for f in feeds}
+        if len(sigs) != 1:
+            raise ScanUnsupportedError(
+                "run_scan: feed shapes differ across the group and no "
+                "common bucket edge covers them — enable "
+                "FLAGS_shape_bucketing or feed uniform shapes",
+                permanent=False)
+        feed_sig = next(iter(sigs))
+
+        # MIRRORS run()'s key tuple (positions 4-11) with the rejected
+        # paths pinned to their inert values and a ("scan", K) suffix —
+        # a new field added to run()'s key must be added here too, or the
+        # two paths cache under inconsistent keys
+        key = (_fingerprint(program), feed_sig, tuple(fetch_names),
+               id(scope), bool(program._hints.get("is_test")), (), None,
+               None, False,
+               bool(program._hints.get("inference_no_prune")),
+               bool(program._hints.get("donate_buffers")),
+               bucket, ("scan", k_steps))
+        tr_on = trace.enabled()
+        pending_compile = None
+        compiled = self._cache.get(key)
+        if compiled is None:
+            trace.metrics().counter("executor.compile_cache_miss").inc()
+            if tr_on:
+                trace.instant("compile_cache_miss", cat="compile",
+                              args={"fingerprint": key[0][:12],
+                                    "n_feeds": len(feeds[0]),
+                                    "bucket": bucket, "scan": k_steps})
+            self._note_recompile(feed_sig, bucket, tr_on)
+            _t0 = trace.now()
+            base = self._prepare(program, feeds[0], fetch_names, scope,
+                                 None, bucket=bucket)
+            if base.raw_fn is None:
+                raise ScanUnsupportedError(
+                    "run_scan: this program compiles through a step "
+                    "builder with no scannable raw step")
+            raw = base.raw_fn
+
+            def scan_fn(carry, ro, stacked, keys):
+                def body(c, xs):
+                    fd, kk = xs
+                    step_fetches, new_vals = raw(dict(c), ro, fd, kk)
+                    c2 = {n: new_vals.get(n, c[n]) for n in c}
+                    extras = {n: v for n, v in new_vals.items()
+                              if n not in c}
+                    return c2, (list(step_fetches), extras)
+                c_end, (ys, extras) = jax.lax.scan(body, carry,
+                                                   (stacked, keys))
+                return ys, c_end, extras
+
+            donate = base.donates
+            jfn = jax.jit(scan_fn, donate_argnums=(0,) if donate else ())
+            compiled = _CompiledBlock(jfn, base.param_names,
+                                      base.written_names, fetch_names,
+                                      n_ops=base.n_ops, donates=donate)
+            pending_compile = _t0
+            if use_program_cache:
+                self._cache_store(key, compiled)
+        else:
+            self._cache.move_to_end(key)
+            trace.metrics().counter("executor.compile_cache_hit").inc()
+            if tr_on:
+                trace.instant("compile_cache_hit", cat="compile",
+                              args={"fingerprint": key[0][:12],
+                                    "scan": k_steps})
+
+        mut = {n: scope.find_var(n) for n in compiled.param_names
+               if n in compiled.written_names}
+        ro = {n: scope.find_var(n) for n in compiled.param_names
+              if n not in compiled.written_names}
+        stacked = {k: jnp.stack([jnp.asarray(f[k]) for f in feeds])
+                   for k in feeds[0]}
+        if bucket is not None:
+            stacked["__batch_valid__"] = jnp.asarray(n_valids, jnp.int32)
+        seed = program.random_seed if program.random_seed is not None else 0
+        base_key = jax.random.PRNGKey(seed)
+        keys = jnp.stack([jax.random.fold_in(base_key, self._step + i)
+                          for i in range(k_steps)])
+        self._step += k_steps
+
+        if compiled.donates:
+            self._persist_alias_live()
+        _t0 = trace.now() if tr_on else 0
+        st_fetches, carry_end, st_extras = compiled.fn(mut, ro, stacked,
+                                                       keys)
+        if tr_on:
+            trace.complete("executor::step", _t0, cat="step",
+                           args={"step": self._step - k_steps,
+                                 "steps_fused": k_steps,
+                                 "n_fetch": len(fetch_names)})
+        if pending_compile is not None:
+            compile_s = (trace.now() - pending_compile) / 1e9
+            trace.metrics().histogram("executor.compile_seconds").observe(
+                compile_s)
+            if tr_on:
+                trace.complete("executor::compile", pending_compile,
+                               cat="compile",
+                               args={"fingerprint": key[0][:12],
+                                     "scan": k_steps,
+                                     "n_ops": compiled.n_ops})
+        for n, v in carry_end.items():
+            scope.set_var(n, v)
+        for n, v in st_extras.items():
+            scope.set_var(n, v[-1])
+
+        out = []
+        for i in range(k_steps):
+            row = [f[i] for f in st_fetches]
+            if bucket is not None and bucket != n_valids[i]:
+                row = self._slice_true_batch(program, fetch_names, row,
+                                             bucket, n_valids[i])
+            out.append(row)
+        if return_handles:
+            return [[FetchHandle(f, name=n)
+                     for n, f in zip(fetch_names, row)] for row in out]
+        if return_numpy:
+            host = jax.device_get(out)    # ONE transfer for all K steps
+            return [[np.asarray(f) for f in row] for row in host]
+        return out
+
+    @staticmethod
+    def _normalize_feed(feed):
+        """ONE host conversion per feed (np.asarray on a device array
+        forces a D2H sync, serialising the prefetch pipeline) + the
+        64-bit-width check.  Shared by run() and run_scan()."""
+        feed = {k: (v if hasattr(v, "dtype") else np.asarray(v))
+                for k, v in (feed or {}).items()}
+        for k, v in feed.items():
+            check_feed_width(k, v)
+        return feed
+
+    def _cache_store(self, key, compiled):
+        """Insert into the LRU-bounded executable cache
+        (FLAGS_executor_cache_capacity), counting evictions."""
+        self._cache[key] = compiled
+        cap = int(core.get_flag("executor_cache_capacity", 128) or 0)
+        while cap > 0 and len(self._cache) > cap:
+            self._cache.popitem(last=False)
+            trace.metrics().counter("executor.compile_cache_evict").inc()
 
     def _note_recompile(self, feed_sig, bucket, tr_on):
         """Recompile-storm detection: a burst of compile misses means
@@ -563,10 +891,14 @@ class Executor:
                 if mesh is not None:
                     from ..parallel.api import wrap_with_mesh
                     jfn = wrap_with_mesh(fn, mesh, program)
+                    donate = False
                 else:
                     jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
+                # no alias_cell: fetch_alias_mask degrades to all-True
+                # when donating — conservative, the guard persists every
+                # lazy fetch before the next donating dispatch
                 return _CompiledBlock(jfn, param_names, written_names,
-                                      fetch_names)
+                                      fetch_names, donates=donate)
 
         # prune to fetch-reachable ops (framework/prune.cc analog):
         # persistable/scope-state writes (optimizer, BN stats, user scope
@@ -638,6 +970,8 @@ class Executor:
         # plain jit — mesh runs keep the post-hoc fetched-var scan instead
         debug_nan = bool(core.get_flag("check_nan_inf")) and mesh is None
 
+        alias_cell: list = []
+
         def fn(mut_params, ro_params, feeds, step_key):
             env = dict(mut_params)
             env.update(ro_params)
@@ -653,30 +987,50 @@ class Executor:
             run_block_ops(block, env, ctx, ops=run_ops)
             fetches = [env[n] for n in fetch_names]
             new_vals = {n: env[n] for n in written_names if n in env}
+            if not alias_cell:
+                # trace-time: which fetches return the very value that is
+                # (or becomes) scope state?  Those share the state's XLA
+                # buffer, which a LATER donating dispatch may invalidate —
+                # the executor persists them first (_persist_alias_live).
+                # ro params count too: a read-only fetch of W from an eval
+                # program aliases the same scope buffer a train program
+                # donates.  Feeds are excluded — donation never touches
+                # the feed arguments.
+                state_vals = list(mut_params.values()) \
+                    + list(ro_params.values()) + list(new_vals.values())
+                alias_cell.append(tuple(
+                    any(f is v for v in state_vals) for f in fetches))
             return fetches, new_vals
 
         backend = self.place.jax_device().platform
         donate = ((core.get_flag("use_donated_buffers")
                    or program._hints.get("donate_buffers"))
                   and backend != "cpu")
+        err_cell = None
         if mesh is not None:
             from ..parallel.api import wrap_with_mesh
             jfn = wrap_with_mesh(fn, mesh, program)
+            donate = False
         elif debug_nan:
             # debug recompile: every op output carries a compiled-in
-            # finite-check; err.throw() names the first failing op
+            # finite-check.  The error is stashed, not thrown here: run()
+            # throws at dispatch for the sync path, and lazy fetches defer
+            # the throw to materialisation (no forced sync at dispatch).
             from jax.experimental import checkify
             checked = jax.jit(checkify.checkify(
                 fn, errors=checkify.user_checks))
+            err_cell = {}
 
             def jfn(mut, ro, feeds, key):
                 err, out = checked(mut, ro, feeds, key)
-                err.throw()
+                err_cell["err"] = err
                 return out
+            donate = False
         else:
             jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
         return _CompiledBlock(jfn, param_names, written_names, fetch_names,
-                              n_ops=len(run_ops))
+                              n_ops=len(run_ops), raw_fn=fn, donates=donate,
+                              err_cell=err_cell, alias_cell=alias_cell)
 
     # -- Trainer/dataset path (executor.cc:139-173 analog) ------------------
     def train_from_dataset(self, program, dataset, scope=None, thread=0,
@@ -703,4 +1057,10 @@ class Executor:
                             print_period, train=True)
 
     def close(self):
+        for runner in list(self._async_runners.values()):
+            try:
+                runner.drain()
+            except Exception:       # noqa: BLE001 — close() is cleanup;
+                pass                # unconsumed errors were best-effort
+        self._async_runners.clear()
         self._cache.clear()

@@ -111,6 +111,23 @@ class DataLoader:
         return n // self.batch_size if self.drop_last else \
             (n + self.batch_size - 1) // self.batch_size
 
+    def stacked(self, k, mesh=None, capacity=2):
+        """K-step staging hook for the async pipeline
+        (`FLAGS_steps_per_dispatch`): yield lists of ``k`` consecutive
+        batches, `jax.device_put` on the Prefetcher's producer thread —
+        sharded along the data-parallel mesh axis when `mesh` is given —
+        so H2D transfer of group t+1 overlaps the device steps of group
+        t.  Feed the groups to `Executor.run_scan` or submit each member
+        to an `AsyncStepRunner(steps_per_dispatch=k)`."""
+        return _stacked_prefetcher(self, k, mesh, capacity)
+
+
+def _stacked_prefetcher(loader, k, mesh, capacity):
+    from ..utils.prefetch import Prefetcher
+    from .async_pipeline import batch_stack, group_steps
+    return Prefetcher(group_steps(iter(loader), k),
+                      stage=batch_stack(k, mesh), capacity=capacity)
+
 
 def _default_collate(batch):
     first = batch[0]
@@ -201,6 +218,12 @@ class GeneratorLoader:
                 yield [item[n] for n in self._feed_names]
             else:
                 yield item
+
+    def stacked(self, k, mesh=None, capacity=2):
+        """K-step staging hook (see DataLoader.stacked): groups of ``k``
+        feed dicts device-staged on the producer thread for
+        `steps_per_dispatch=k` scan dispatch."""
+        return _stacked_prefetcher(self, k, mesh, capacity)
 
     # legacy non-iterable protocol
     def start(self):

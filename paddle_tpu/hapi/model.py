@@ -46,6 +46,9 @@ class _StaticAdapter:
         # mode programs so the executor's shape-bucketing layer pads the
         # ragged tail batch to a known edge instead of recompiling
         self._bucket_edges = None
+        # async train window (fluid/async_pipeline.py): fit() submits
+        # batches through this runner instead of blocking per step
+        self._train_runner = None
 
     # -- plumbing -----------------------------------------------------------
     def _executor(self):
@@ -130,7 +133,9 @@ class _StaticAdapter:
         self._startup_done = True
         self._startup_nprogs = len(self._progs)
 
-    def _run(self, mode, inputs, labels):
+    def _prep(self, mode, inputs, labels):
+        """Build-once plumbing shared by the sync and async paths: mode
+        program, startup, bucket-edge stamping, and the feed dict."""
         entry = self._build(mode)
         if self._bucket_edges:
             entry["prog"]._hints["bucket_edges"] = self._bucket_edges
@@ -142,8 +147,36 @@ class _StaticAdapter:
             feed[name] = np.asarray(arr)
         for name, arr in zip(entry["lbs"], _as_list(labels)):
             feed[name] = np.asarray(arr)
+        return entry, feed
+
+    def _run(self, mode, inputs, labels):
+        entry, feed = self._prep(mode, inputs, labels)
         return entry, self._executor().run(entry["prog"], feed=feed,
                                            fetch_list=entry["fetch"])
+
+    def train_batch_async(self, inputs, labels=None):
+        """Submit one train step into the async window and return its
+        StepFuture — the loss rides back as a lazy FetchHandle, so the
+        host keeps dispatching while the device computes.  fit() is the
+        caller; drain() closes the window at epoch end."""
+        entry, feed = self._prep("train", inputs, labels)
+        if self._train_runner is None:
+            from ..fluid.async_pipeline import AsyncStepRunner
+            self._train_runner = AsyncStepRunner(
+                self._executor(), entry["prog"], entry["fetch"])
+        return self._train_runner.submit(feed)
+
+    def drain(self):
+        """Wait out the async train window (epoch boundaries, before eval
+        /save) and surface any buffered dispatch error."""
+        if self._train_runner is not None:
+            self._train_runner.drain()
+
+    def abort(self):
+        """Error-path cleanup: drop buffered feeds from the aborted epoch
+        so a later fit() never trains on stale batches."""
+        if self._train_runner is not None:
+            self._train_runner.abort()
 
     # -- Model surface ------------------------------------------------------
     def _loss_and_metrics(self, mode, inputs, labels):
@@ -315,18 +348,58 @@ class Model:
                                                                      verbose)])
         cbs.set_model(self)
         cbs.on_train_begin()
-        history = []
         self.stop_training = False          # EarlyStopping contract
+        # async window only when no per-batch metrics are configured: the
+        # sync path reports [loss] + metrics to callbacks every batch, and
+        # metrics are computed host-side from the outputs — forcing them
+        # through the window would materialise every step anyway
+        use_async = self._adapter is not None and not self._metrics
+        try:
+            return self._fit_epochs(loader, eval_data, batch_size, epochs,
+                                    eval_freq, save_dir, save_freq, cbs,
+                                    use_async)
+        except BaseException:
+            if use_async:
+                # never leave the aborted epoch's buffered feeds pending —
+                # a later fit()/evaluate() must not dispatch stale batches
+                self._adapter.abort()
+            raise
+
+    def _fit_epochs(self, loader, eval_data, batch_size, epochs, eval_freq,
+                    save_dir, save_freq, cbs, use_async):
+        history = []
         for epoch in range(epochs):
             cbs.on_epoch_begin(epoch)
             losses = []
             for step, batch in enumerate(loader):
                 cbs.on_train_batch_begin(step)
                 ins, lbs = _split_batch(batch)
-                vals = self.train_batch(ins, lbs)
+                if use_async:
+                    # async window: submit returns immediately; the loss
+                    # is a lazy fetch that only materialises when a
+                    # callback (or the epoch-end mean) actually reads it,
+                    # so per-batch host sync is gone from the hot loop
+                    fut = self._adapter.train_batch_async(ins, lbs)
+                    vals = [fut.lazy(0)]
+                else:
+                    vals = self.train_batch(ins, lbs)
                 losses.append(vals[0])
                 cbs.on_train_batch_end(step, {"loss": vals})
-            logs = {"loss": float(np.mean(losses))}
+                if use_async:
+                    # bound retention: once a step is a full window
+                    # behind, its loss buffer is (or is about to be)
+                    # done — fold it to a float so a long epoch never
+                    # pins one device scalar per step
+                    r = self._adapter._train_runner
+                    lag = (r.max_inflight + 1) * r.steps_per_dispatch
+                    idx = len(losses) - 1 - lag
+                    if idx >= 0 and not isinstance(losses[idx], float):
+                        losses[idx] = float(losses[idx])
+            if use_async:
+                # close the window before epoch-end logs/eval/save read
+                # state; also surfaces any buffered dispatch error
+                self._adapter.drain()
+            logs = {"loss": float(np.mean([float(v) for v in losses]))}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 logs["eval_loss"] = self.evaluate(eval_data,
                                                   batch_size)["loss"]

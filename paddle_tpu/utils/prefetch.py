@@ -4,12 +4,28 @@ Reference: operators/reader/buffered_reader.cc (double buffer thread) and
 framework/channel.h — one producer thread fills a bounded queue, the
 consumer drains it; producer exceptions are FORWARDED to the consumer (not
 swallowed into a truncated epoch), and cancellation unblocks a producer
-stuck on a full queue so no thread/device-buffer leaks survive an error."""
+stuck on a full queue so no thread/device-buffer leaks survive an error.
+
+Observability (docs/observability.md): per-item produce time lands in the
+``loader.produce_seconds`` histogram and the live queue fill in the
+``loader.queue_depth`` gauge, so a starved consumer (queue pinned at 0) is
+distinguishable from a starved producer (queue pinned at capacity).
+
+Device staging: when ``stage`` is set the queued items hold LIVE device
+buffers, so the capacity is capped at ``FLAGS_max_inflight_steps + 1`` —
+the async dispatch window can never need more than one staged batch per
+in-flight step plus the one being consumed, and an unbounded staged queue
+would pin an epoch's worth of batches in device memory."""
 from __future__ import annotations
 
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
+
+
+def _trace_mod():
+    from ..fluid import trace
+    return trace
 
 
 class Prefetcher:
@@ -27,17 +43,36 @@ class Prefetcher:
                  on_produce: Optional[Callable[[float], None]] = None):
         self._source = source
         self._stage = stage
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, capacity))
+        capacity = max(1, capacity)
+        if stage is not None:
+            # staged items pin device buffers: bound them by the dispatch
+            # window, not by whatever capacity the caller guessed
+            from ..fluid import core
+            cap = int(core.get_flag("max_inflight_steps", 2) or 1) + 1
+            capacity = min(capacity, max(1, cap))
+        self._q: "queue.Queue" = queue.Queue(maxsize=capacity)
         self._cancel = threading.Event()
         self._on_produce = on_produce
+        self._trace = _trace_mod()
+        self._metrics = self._trace.metrics()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._started = False
+
+    def _note_depth(self):
+        """Queue fill to the gauge (last-writer-wins across loaders) and,
+        when the plane is on, a timeline counter sample — the per-write
+        series is what disambiguates concurrent loaders."""
+        depth = self._q.qsize()
+        self._metrics.gauge("loader.queue_depth").set(depth)
+        if self._trace.enabled():
+            self._trace.counter_event("loader.queue_depth", depth)
 
     # -- producer -----------------------------------------------------------
     def _put(self, item) -> bool:
         while not self._cancel.is_set():
             try:
                 self._q.put(item, timeout=0.1)
+                self._note_depth()
                 return True
             except queue.Full:
                 continue
@@ -50,8 +85,10 @@ class Prefetcher:
             for item in self._source:
                 if self._stage is not None:
                     item = self._stage(item)
+                dt = time.perf_counter() - t_last
+                self._metrics.histogram("loader.produce_seconds").observe(dt)
                 if self._on_produce is not None:
-                    self._on_produce(time.perf_counter() - t_last)
+                    self._on_produce(dt)
                 if not self._put(item):
                     return                   # cancelled
                 t_last = time.perf_counter()
@@ -67,6 +104,7 @@ class Prefetcher:
         try:
             while True:
                 item = self._q.get()
+                self._note_depth()
                 if item is self._STOP:
                     return
                 if isinstance(item, BaseException):
@@ -81,6 +119,7 @@ class Prefetcher:
             self._started = True
             self._thread.start()
         item = self._q.get()
+        self._note_depth()
         if isinstance(item, BaseException):
             self.close()
             raise item

@@ -1,0 +1,696 @@
+"""Async step pipeline (ISSUE 4 tentpole): lazy fetches, bounded
+in-flight window, donation alias guard, multi-step scan fusion, loader
+staging hooks, hapi fit integration."""
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, trace
+from paddle_tpu.fluid.async_pipeline import (AsyncStepRunner, FetchHandle,
+                                             ScanUnsupportedError,
+                                             StepFuture, batch_stack,
+                                             group_steps, _once)
+from paddle_tpu.fluid.framework import reset_unique_name
+
+
+def _build_mlp(lr=0.1):
+    reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 16])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(batch, 16).astype("float32"),
+             "y": rng.randint(0, 10, (batch, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _params(scope, program):
+    return {p.name: np.asarray(scope.find_var(p.name))
+            for p in program.all_parameters()}
+
+
+def _sync_run(feeds, lr=0.1):
+    main, startup, loss = _build_mlp(lr)
+    scope = core.Scope()
+    with core.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        losses = [float(np.ravel(exe.run(main, feed=f,
+                                         fetch_list=[loss])[0])[0])
+                  for f in feeds]
+        params = _params(scope, main)
+    return losses, params
+
+
+class TestFetchHandle:
+    def test_materialisation_protocols(self):
+        h = FetchHandle(np.arange(6, dtype="float32").reshape(2, 3),
+                        name="t")
+        assert h.shape == (2, 3) and h.dtype == np.float32 and h.ndim == 2
+        assert not h.is_materialized()
+        assert float(FetchHandle(np.float32(2.5))) == 2.5
+        assert int(FetchHandle(np.int64(7))) == 7
+        np.testing.assert_array_equal(np.asarray(h),
+                                      np.arange(6).reshape(2, 3))
+        assert h.is_materialized()
+        # persist() drops the device reference and caches the host copy
+        assert h.numpy() is h.persist()
+
+    def test_check_nan_fires_at_materialisation_not_construction(self):
+        h = FetchHandle(np.array([1.0, np.inf], "float32"), name="bad",
+                        check_nan=True)
+        with pytest.raises(FloatingPointError, match="bad"):
+            h.numpy()
+
+    def test_pre_check_runs_once_across_handles(self):
+        calls = []
+        pre = _once(lambda: calls.append(1))
+        a = FetchHandle(np.zeros(2), pre_check=pre)
+        b = FetchHandle(np.ones(2), pre_check=pre)
+        a.numpy()
+        b.block_until_ready()
+        assert calls == [1]
+
+
+class TestLazyFetchesFromRun:
+    def test_return_numpy_false_yields_handles(self):
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = _feeds(1)[0]
+            lazy = exe.run(main, feed=feed, fetch_list=[loss],
+                           return_numpy=False)
+            assert isinstance(lazy[0], FetchHandle)
+            assert lazy[0].name == loss.name
+
+    def test_return_numpy_true_single_device_get(self, monkeypatch):
+        """The sync fetch path does ONE jax.device_get over the whole
+        fetch list — not one np.asarray sync per fetch."""
+        import jax
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 16])
+            h = fluid.layers.fc(x, 8, act="relu")
+            g = fluid.layers.fc(h, 4)
+            loss = fluid.layers.mean(g)
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = {"x": np.ones((4, 16), "float32")}
+            calls = []
+            real = jax.device_get
+            monkeypatch.setattr(jax, "device_get",
+                                lambda tree: calls.append(1) or real(tree))
+            outs = exe.run(main, feed=feed, fetch_list=[loss, h, g])
+            assert len(calls) == 1
+            assert all(isinstance(o, np.ndarray) for o in outs)
+
+    def test_lazy_values_match_sync(self):
+        feeds = _feeds(4)
+        sync_losses, _ = _sync_run(feeds)
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            lazy_losses = [float(exe.run(main, feed=f, fetch_list=[loss],
+                                         return_numpy=False)[0])
+                           for f in feeds]
+        assert lazy_losses == sync_losses
+
+    def test_check_nan_inf_lazy_raises_at_materialisation(self):
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            out = fluid.layers.sqrt(x)      # sqrt(-1) -> NaN
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            core.set_flags({"FLAGS_check_nan_inf": True})
+            try:
+                # dispatch itself must NOT raise: the compiled-in checkify
+                # error is deferred to materialisation of the handle
+                h, = exe.run(main, feed={"x": -np.ones((2, 4), "float32")},
+                             fetch_list=[out], return_numpy=False)
+                with pytest.raises(Exception, match="NaN/Inf"):
+                    h.numpy()
+            finally:
+                core.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class _FakeDeviceRunner(AsyncStepRunner):
+    """Runner whose 'device' is a background thread completing one step
+    every `step_time` seconds — lets the backpressure contract be tested
+    without timing-dependent XLA behaviour."""
+
+    def __init__(self, max_inflight, step_time=0.02):
+        prog = types.SimpleNamespace(_hints={})
+        super().__init__(executor=None, program=prog, fetch_list=["v"],
+                         max_inflight=max_inflight, steps_per_dispatch=1,
+                         donate_guard=False)
+        self.step_time = step_time
+        self.outstanding = 0
+        self.peak = 0
+        self._lock = threading.Lock()
+
+    def _dispatch_feeds(self, feeds):
+        with self._lock:
+            self.outstanding += 1
+            self.peak = max(self.peak, self.outstanding)
+        done = threading.Event()
+
+        def complete():
+            time.sleep(self.step_time)
+            with self._lock:
+                self.outstanding -= 1
+            done.set()
+        threading.Thread(target=complete, daemon=True).start()
+        return [[FetchHandle(np.zeros(1), waiter=done.wait)]
+                for _ in feeds]
+
+
+class TestBackpressure:
+    def test_window_bounds_outstanding_steps(self):
+        r = _FakeDeviceRunner(max_inflight=2)
+        futs = [r.submit({"i": i}) for i in range(8)]
+        r.drain()
+        assert r.peak <= 2
+        assert all(f.dispatched for f in futs)
+
+    def test_window_of_one_serialises(self):
+        r = _FakeDeviceRunner(max_inflight=1)
+        for i in range(5):
+            r.submit({"i": i})
+        r.drain()
+        assert r.peak <= 1
+
+    def test_host_wait_and_dispatch_metrics_recorded(self):
+        m = trace.metrics()
+        hw0 = m.histogram("executor.host_wait_seconds").stats()["count"]
+        dp0 = m.histogram("executor.dispatch_seconds").stats()["count"]
+        r = _FakeDeviceRunner(max_inflight=2)
+        for i in range(6):
+            r.submit({"i": i})
+        r.drain()
+        assert m.histogram("executor.dispatch_seconds").stats()["count"] \
+            - dp0 == 6
+        assert m.histogram("executor.host_wait_seconds").stats()["count"] \
+            - hw0 == 6
+        assert m.gauge("executor.inflight_peak").value >= 2
+
+
+class TestDispatchErrors:
+    def test_error_surfaces_on_its_own_future(self):
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], max_inflight=2)
+            good = _feeds(3)
+            f0 = r.submit(good[0])
+            f_bad = r.submit({"nonsense": np.zeros((2, 2), "float32")})
+            f2 = r.submit(good[1])
+            assert np.isfinite(float(f0[0]))
+            with pytest.raises(ValueError):
+                f_bad.handles()
+            # the error was consumed where it belonged — later steps and
+            # drain() are unaffected
+            assert np.isfinite(float(f2[0]))
+            r.drain()
+
+    def test_unconsumed_error_raises_on_drain(self):
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], max_inflight=2)
+            r.submit({"nonsense": np.zeros((2, 2), "float32")})
+            with pytest.raises(ValueError):
+                r.drain()
+            r.drain()               # consumed: second drain is clean
+
+
+class TestDonationAliasGuard:
+    def _build_fetch_param(self):
+        """Train program that also FETCHES a persistable updated param —
+        the fetch aliases scope state, the donation hazard."""
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            h = fluid.layers.fc(x, 4)
+            loss = fluid.layers.mean(h)
+            fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+        main._hints["donate_buffers"] = True
+        w = main.all_parameters()[0].name
+        return main, startup, loss, w
+
+    def test_aliasing_fetch_is_flagged_and_persisted(self):
+        main, startup, loss, w = self._build_fetch_param()
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.randn(4, 4).astype("float32")}
+                 for _ in range(4)]
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss, w], max_inflight=2,
+                                donate_guard=True)
+            f0 = r.submit(feeds[0])
+            h_loss, h_w = f0.handles()
+            assert not h_loss.aliases_state
+            assert h_w.aliases_state
+            assert not h_w.is_materialized()
+            # the NEXT dispatch would donate the state buffer h_w reads:
+            # the guard must host-persist it first
+            r.submit(feeds[1])
+            assert h_w.is_materialized()
+            r.drain()
+
+    def test_guard_covers_handles_waited_out_of_the_window(self):
+        """max_inflight=1: step N-1 leaves _inflight via backpressure
+        BEFORE step N dispatches — its aliasing handles must still be
+        persisted before the dispatch donates their buffers."""
+        main, startup, loss, w = self._build_fetch_param()
+        rng = np.random.RandomState(2)
+        feeds = [{"x": rng.randn(4, 4).astype("float32")}
+                 for _ in range(3)]
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss, w], max_inflight=1,
+                                donate_guard=True)
+            f0 = r.submit(feeds[0])
+            h_w = f0.handles()[1]
+            r.submit(feeds[1])      # waits f0 out, THEN dispatches+donates
+            assert h_w.is_materialized()
+            r.submit(feeds[2])
+            r.drain()
+
+    def test_guarded_window_matches_sync_loop(self):
+        rng = np.random.RandomState(1)
+        feeds = [{"x": rng.randn(4, 4).astype("float32")}
+                 for _ in range(7)]
+        main, startup, loss, w = self._build_fetch_param()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            sync = [[np.asarray(v) for v in
+                     exe.run(main, feed=f, fetch_list=[loss, w])]
+                    for f in feeds]
+        main, startup, loss, w = self._build_fetch_param()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss, w], max_inflight=3,
+                                donate_guard=True)
+            futs = [r.submit(f) for f in feeds]
+            r.drain()
+            got = [f.result() for f in futs]
+        for (sl, sw), (gl, gw) in zip(sync, got):
+            np.testing.assert_array_equal(sl, gl)
+            np.testing.assert_array_equal(sw, gw)
+
+
+class TestAsyncParity:
+    def test_inflight_window_bit_identical_to_sync(self):
+        feeds = _feeds(10, seed=3)
+        sync_losses, sync_params = _sync_run(feeds)
+        main, startup, loss = _build_mlp()
+        scope = core.Scope()
+        with core.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], max_inflight=3)
+            futs = [r.submit(f) for f in feeds]
+            r.drain()
+            async_losses = [float(f[0]) for f in futs]
+            async_params = _params(scope, main)
+        assert async_losses == sync_losses
+        for k in sync_params:
+            np.testing.assert_array_equal(sync_params[k], async_params[k])
+
+    def test_run_async_api_and_drain(self):
+        feeds = _feeds(5, seed=4)
+        sync_losses, _ = _sync_run(feeds)
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            futs = [exe.run_async(main, feed=f, fetch_list=[loss])
+                    for f in feeds]
+            exe.drain_async()
+            assert [float(f[0]) for f in futs] == sync_losses
+            exe.close()             # drains again without error
+
+
+class TestScanFusion:
+    def test_scan_matches_sequential_bitwise(self):
+        feeds = _feeds(12, seed=5)
+        sync_losses, sync_params = _sync_run(feeds)
+        main, startup, loss = _build_mlp()
+        scope = core.Scope()
+        with core.scope_guard(scope):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], max_inflight=2,
+                                steps_per_dispatch=4)
+            futs = [r.submit(f) for f in feeds]
+            r.drain()
+            scan_losses = [float(f[0]) for f in futs]
+            scan_params = _params(scope, main)
+        assert scan_losses == sync_losses
+        for k in sync_params:
+            np.testing.assert_array_equal(sync_params[k], scan_params[k])
+
+    def test_partial_tail_group(self):
+        """11 steps at K=4 -> groups of 4,4,3; numerics unchanged."""
+        feeds = _feeds(11, seed=6)
+        sync_losses, _ = _sync_run(feeds)
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], steps_per_dispatch=4)
+            futs = [r.submit(f) for f in feeds]
+            r.drain()
+            assert [float(f[0]) for f in futs] == sync_losses
+
+    def test_scan_with_shape_bucketing_batch_valid(self):
+        """Ragged group pads to ONE bucket edge; per-step __batch_valid__
+        keeps the masked reductions exact vs the sequential loop."""
+        rng = np.random.RandomState(7)
+        sizes = [32, 32, 7, 5, 32, 3]
+        feeds = [{"x": rng.randn(n, 16).astype("float32"),
+                  "y": rng.randint(0, 10, (n, 1)).astype("int64")}
+                 for n in sizes]
+        seq_losses, seq_params = _sync_run(feeds)
+
+        saved = core.get_flag("shape_bucketing")
+        core.set_flags({"FLAGS_shape_bucketing": True})
+        try:
+            main, startup, loss = _build_mlp()
+            scope = core.Scope()
+            with core.scope_guard(scope):
+                exe = fluid.Executor()
+                exe.run(startup)
+                r = AsyncStepRunner(exe, main, [loss], max_inflight=2,
+                                    steps_per_dispatch=3)
+                futs = [r.submit(f) for f in feeds]
+                r.drain()
+                scan_losses = [float(f[0]) for f in futs]
+                scan_params = _params(scope, main)
+        finally:
+            core.set_flags({"FLAGS_shape_bucketing": saved})
+        np.testing.assert_allclose(scan_losses, seq_losses,
+                                   rtol=1e-5, atol=1e-6)
+        for k in seq_params:
+            np.testing.assert_allclose(seq_params[k], scan_params[k],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_scan_compile_cached_across_groups(self):
+        feeds = _feeds(16, seed=8)
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            m = trace.metrics().counter("executor.compile_cache_miss")
+            h = trace.metrics().counter("executor.compile_cache_hit")
+            m0, h0 = m.value, h.value
+            r = AsyncStepRunner(exe, main, [loss], steps_per_dispatch=4)
+            for f in feeds:
+                r.submit(f)
+            r.drain()
+            assert m.value - m0 == 1        # one scan executable
+            assert h.value - h0 == 3        # reused by the other 3 groups
+
+    def test_check_nan_inf_degrades_to_sequential(self):
+        feeds = _feeds(4, seed=9)
+        sync_losses, _ = _sync_run(feeds)
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            core.set_flags({"FLAGS_check_nan_inf": True})
+            try:
+                r = AsyncStepRunner(exe, main, [loss],
+                                    steps_per_dispatch=4)
+                futs = [r.submit(f) for f in feeds]
+                r.drain()
+                got = [float(f[0]) for f in futs]
+            finally:
+                core.set_flags({"FLAGS_check_nan_inf": False})
+        np.testing.assert_allclose(got, sync_losses, rtol=1e-6)
+
+    def test_ragged_group_falls_back_per_group_not_permanently(self):
+        """A single mixed-shape group (ragged tail, bucketing off) runs
+        sequentially but must NOT kill scan fusion for later uniform
+        groups — counted in executor.scan_fallback_groups."""
+        rng = np.random.RandomState(10)
+        sizes = [8, 8, 8, 8, 8, 8, 8, 5, 8, 8, 8, 8]   # group 2 is ragged
+        feeds = [{"x": rng.randn(n, 16).astype("float32"),
+                  "y": rng.randint(0, 10, (n, 1)).astype("int64")}
+                 for n in sizes]
+        seq_losses, _ = _sync_run(feeds)
+        fb = trace.metrics().counter("executor.scan_fallback_groups")
+        fb0 = fb.value
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], max_inflight=2,
+                                steps_per_dispatch=4)
+            futs = [r.submit(f) for f in feeds]
+            r.drain()
+            assert r._scan_ok          # fusion survives the ragged group
+            assert fb.value - fb0 == 1
+            assert [float(f[0]) for f in futs] == seq_losses
+
+    def test_run_scan_rejects_ragged_without_bucketing(self):
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            ragged = [{"x": rng.randn(n, 16).astype("float32"),
+                       "y": rng.randint(0, 10, (n, 1)).astype("int64")}
+                      for n in (8, 5)]
+            with pytest.raises(ScanUnsupportedError):
+                exe.run_scan(main, ragged, [loss])
+
+
+class TestErrorPathCleanup:
+    def test_abort_drops_pending_and_marks_futures(self):
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss], steps_per_dispatch=4)
+            f_buffered = r.submit(_feeds(1, seed=11)[0])
+            assert not f_buffered.dispatched
+            r.abort()
+            with pytest.raises(RuntimeError, match="aborted"):
+                f_buffered.handles()
+            assert r._pending == [] and r.inflight == 0
+            # the runner stays usable after an abort
+            f2 = r.submit(_feeds(1, seed=12)[0])
+            r.drain()
+            assert np.isfinite(float(f2[0]))
+
+    def test_executor_alias_registry_persists_before_donating_dispatch(self):
+        import weakref
+        exe = fluid.Executor()
+        h = FetchHandle(np.arange(3.0), name="w", aliases_state=True)
+        exe._alias_live.append(weakref.ref(h))
+        dead = FetchHandle(np.zeros(1), aliases_state=True)
+        exe._alias_live.append(weakref.ref(dead))
+        del dead                        # dropped handles cost nothing
+        exe._persist_alias_live()
+        assert h.is_materialized()
+        assert exe._alias_live == []
+
+    def test_run_registers_aliasing_lazy_fetches_on_executor(self):
+        """Every state-aliasing lazy fetch lands in the executor-level
+        registry — including READ-ONLY param fetches from a program that
+        never writes them (the cross-program donation hazard)."""
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            h = fluid.layers.fc(x, 4)           # reads fc.w_0, fc.b_0
+            loss = fluid.layers.mean(h)
+        w = main.all_parameters()[0].name
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                          fetch_list=[loss, w], return_numpy=False)
+            assert not out[0].aliases_state     # computed loss
+            assert out[1].aliases_state         # ro param fetch
+            live = [r() for r in exe._alias_live if r() is not None]
+            assert out[1] in live
+            exe._persist_alias_live()
+            assert out[1].is_materialized()
+
+    def test_run_async_honours_explicit_window_args(self):
+        main, startup, loss = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            feed = _feeds(1, seed=13)[0]
+            exe.run_async(main, feed=feed, fetch_list=[loss])
+            exe.run_async(main, feed=feed, fetch_list=[loss],
+                          max_inflight=1)
+            winds = sorted(r.max_inflight
+                           for r in exe._async_runners.values())
+            assert winds == [1, 2]
+            exe.drain_async()
+
+    def test_exec_strategy_reset_clears_hint(self):
+        main = fluid.Program()
+        es = fluid.ExecutionStrategy()
+        es.num_iteration_per_run = 4
+        fluid.CompiledProgram(main, exec_strategy=es)
+        es.num_iteration_per_run = 1
+        fluid.CompiledProgram(main, exec_strategy=es)
+        assert "steps_per_dispatch" not in main._hints
+
+
+class TestPrefetcherPlane:
+    def test_produce_timings_and_queue_depth(self):
+        from paddle_tpu.utils.prefetch import Prefetcher
+        m = trace.metrics()
+        c0 = m.histogram("loader.produce_seconds").stats()["count"]
+        items = list(Prefetcher(iter(range(6)), capacity=2))
+        assert items == list(range(6))
+        assert m.histogram("loader.produce_seconds").stats()["count"] \
+            - c0 == 6
+        assert m.gauge("loader.queue_depth").value >= 0
+
+    def test_staged_capacity_capped_by_inflight_window(self):
+        from paddle_tpu.utils.prefetch import Prefetcher
+        saved = core.get_flag("max_inflight_steps")
+        core.set_flags({"FLAGS_max_inflight_steps": 2})
+        try:
+            staged = Prefetcher(iter(range(4)), stage=lambda x: x,
+                                capacity=64)
+            assert staged._q.maxsize == 3       # inflight + 1
+            unstaged = Prefetcher(iter(range(4)), capacity=64)
+            assert unstaged._q.maxsize == 64    # host batches: uncapped
+            staged.close()
+            unstaged.close()
+        finally:
+            core.set_flags({"FLAGS_max_inflight_steps": saved})
+
+
+class TestLoaderStagingHooks:
+    def test_group_steps(self):
+        assert list(group_steps(iter(range(7)), 3)) == \
+            [[0, 1, 2], [3, 4, 5], [6]]
+
+    def test_batch_stack_stages_device_arrays(self):
+        import jax
+        stage = batch_stack(2)
+        group = [{"x": np.ones((2, 3), "float32")},
+                 {"x": np.zeros((2, 3), "float32")}]
+        out = stage(group)
+        assert len(out) == 2
+        assert isinstance(out[0]["x"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(out[1]["x"]),
+                                      np.zeros((2, 3)))
+
+    def test_dataloader_stacked_groups(self):
+        from paddle_tpu.fluid.reader import DataLoader
+
+        class DS:
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((4,), float(i), "float32")
+
+        groups = list(DataLoader(DS(), batch_size=2).stacked(3))
+        assert [len(g) for g in groups] == [3, 2]
+        np.testing.assert_array_equal(
+            np.asarray(groups[0][0]),
+            np.stack([np.full(4, 0.0), np.full(4, 1.0)]))
+
+
+class TestExecStrategyWiring:
+    def test_num_iteration_per_run_sets_steps_per_dispatch(self):
+        main = fluid.Program()
+        es = fluid.ExecutionStrategy()
+        es.num_iteration_per_run = 4
+        cp = fluid.CompiledProgram(main, exec_strategy=es)
+        assert main._hints["steps_per_dispatch"] == 4
+        r = AsyncStepRunner(fluid.Executor(), cp, [])
+        assert r.steps_per_dispatch == 4
+
+
+class TestHapiFitAsync:
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import hapi, nn
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+        model = hapi.Model(net,
+                           inputs=[hapi.Input([-1, 16], "float32", "x")],
+                           labels=[hapi.Input([-1, 1], "int64", "y")])
+        model.prepare(optimizer=fluid.optimizer.AdamOptimizer(1e-2),
+                      loss=paddle.nn.CrossEntropyLoss())
+        return model
+
+    class _DS:
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return rng.randn(16).astype("float32"), np.int64(i % 4)
+
+    def test_fit_trains_through_async_window(self):
+        hist = self._model().fit(self._DS(), batch_size=4, epochs=3,
+                                 verbose=0)
+        assert all(np.isfinite(h["loss"]) for h in hist)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_fit_with_metrics_keeps_per_batch_metric_logs(self):
+        """Per-batch metrics force the sync path: callbacks must keep
+        seeing [loss] + metrics, exactly as before the async window."""
+        from paddle_tpu.hapi.callbacks import Callback
+        from paddle_tpu.metric import Accuracy
+        seen = []
+
+        class Probe(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                seen.append(list((logs or {}).get("loss", [])))
+
+        model = self._model()
+        model._metrics = [Accuracy()]
+        model.fit(self._DS(), batch_size=4, epochs=1, verbose=0,
+                  callbacks=[Probe()])
+        assert len(seen) == 5
+        assert all(len(v) == 2 for v in seen)       # loss + accuracy
+        assert all(np.isfinite(float(v[0])) for v in seen)
+
+    def test_profiler_callback_sees_per_batch_timings(self):
+        from paddle_tpu.hapi.callbacks import ProfilerCallback
+        h = trace.metrics().histogram("hapi.step_seconds")
+        c0 = h.stats()["count"]
+        self._model().fit(self._DS(), batch_size=4, epochs=2, verbose=0,
+                          callbacks=[ProfilerCallback(verbose=0)])
+        assert h.stats()["count"] - c0 == 10    # 5 batches x 2 epochs

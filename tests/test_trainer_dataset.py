@@ -116,7 +116,8 @@ class TestPrefetchOverlap:
         class SleepExecutor:
             _last_trainer_stats = None
 
-            def run(self, program, feed=None, fetch_list=None):
+            def run(self, program, feed=None, fetch_list=None, scope=None,
+                    return_numpy=True):
                 time.sleep(0.015)
                 return [np.zeros(1)]
 

@@ -101,6 +101,18 @@ def _run_child(args, budget, extra_env=None, _retried=False):
                     float(info["compile_seconds"]))
                 trace.metrics().counter("watch.compile_misses").add(
                     int(info.get("compile_misses", 0)))
+            # async pipeline signals (bench reports them when an
+            # AsyncStepRunner drove the child): host-wait vs dispatch
+            # split + in-flight depth, summarised after the sweep
+            if "host_wait_seconds" in info:
+                trace.metrics().histogram("watch.host_wait_seconds") \
+                    .observe(float(info["host_wait_seconds"]))
+                trace.metrics().histogram("watch.dispatch_seconds") \
+                    .observe(float(info.get("dispatch_seconds", 0.0)))
+                depth = int(info.get("inflight_depth", 0))
+                g = trace.metrics().gauge("watch.inflight_depth")
+                if depth > g.value:
+                    g.set(depth)
         except (ValueError, TypeError):
             pass
         return True
@@ -212,6 +224,16 @@ def _report_step_timing():
               f"{trace.metrics().counter('watch.compile_misses').value} "
               f"misses, {c['total']:.1f}s total compile across "
               f"{int(c['count'])} children", flush=True)
+    w = trace.metrics().histogram("watch.host_wait_seconds").stats()
+    if w["count"]:
+        d = trace.metrics().histogram("watch.dispatch_seconds").stats()
+        busy = w["total"] + d["total"]
+        share = w["total"] / busy if busy else 0.0
+        print(f"[watch] async pipeline: inflight depth "
+              f"{int(trace.metrics().gauge('watch.inflight_depth').value)}, "
+              f"host-wait share {share:.0%} "
+              f"({w['total']:.1f}s waiting vs {d['total']:.1f}s "
+              f"dispatching)", flush=True)
     if trace.enabled() and trace.get_events():
         print(f"[watch] timeline -> {trace.export_chrome_trace()}",
               flush=True)
