@@ -5,5 +5,7 @@ defaults to a no-op identity scale but keeps the dynamic-scaling machinery
 for fp16 parity)."""
 from .auto_cast import auto_cast, amp_guard
 from .grad_scaler import GradScaler, AmpScaler
-from .lists import WHITE_OPS, BLACK_OPS
-from .static_amp import decorate
+from .lists import (WHITE_OPS, BLACK_OPS, FP32_FAMILY_OPS, classify,
+                    is_mxu_family, unclassified_family_ops)
+from .static_amp import (decorate, rewrite_program_bf16, CustomOpLists,
+                         AutoMixedPrecisionLists)

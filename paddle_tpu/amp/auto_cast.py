@@ -13,21 +13,25 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     tracer = _dygraph_tracer()
     if tracer is not None:
         prev = tracer._amp_enabled
+        prev_dt = getattr(tracer, "_amp_dtype", None)
         tracer._amp_enabled = enable
         tracer._amp_dtype = dtype
         try:
             yield
         finally:
             tracer._amp_enabled = prev
+            tracer._amp_dtype = prev_dt
     else:
         prog = default_main_program()
         prev = prog._amp_enabled
+        prev_dt = prog._amp_dtype
         prog._amp_enabled = enable
         prog._amp_dtype = dtype
         try:
             yield
         finally:
             prog._amp_enabled = prev
+            prog._amp_dtype = prev_dt
 
 
 amp_guard = auto_cast

@@ -1,6 +1,12 @@
 """GradScaler (reference fluid/dygraph/amp/loss_scaler.py AmpScaler:27).
-bf16 needs no loss scaling (same exponent range as fp32); the dynamic
-scaling state machine is kept for fp16-parity and API compatibility."""
+
+bf16 needs no loss scaling (same exponent range as fp32), so for
+bf16-only runs the scaler degrades to a true identity: ``scale()``
+returns the loss untouched, ``unscale_``/``step`` skip the per-param
+finite scan entirely (zero overhead — no ``jnp.isfinite`` launches), and
+``is_enable()`` reports False.  The dynamic-scaling state machine stays
+fully functional for the optional fp16 path (``auto_cast(dtype=
+"float16")`` or an explicit ``GradScaler(dtype="float16")``)."""
 from __future__ import annotations
 
 import numpy as np
@@ -10,7 +16,8 @@ import jax.numpy as jnp
 class GradScaler:
     def __init__(self, enable=True, init_loss_scaling=2.**15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
-                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True,
+                 dtype="auto"):
         self._enable = enable
         self._scale = float(init_loss_scaling) if enable else 1.0
         self._incr_ratio = incr_ratio
@@ -18,17 +25,51 @@ class GradScaler:
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
+        # "auto": follow the ambient autocast dtype per call — fp16 runs
+        # scale, bf16/fp32 runs don't.  Explicit "float16"/"bfloat16" pin
+        # the behaviour regardless of context.
+        self._dtype = dtype
         self._good = 0
         self._bad = 0
         self._found_inf = False
+        self._auto_fp16_seen = False
+
+    def _is_identity(self) -> bool:
+        """True when loss scaling buys nothing: disabled, or a
+        bf16/fp32-only run (bf16's exponent range == fp32's — overflow
+        that scaling would dodge cannot happen)."""
+        if not self._enable:
+            return True
+        if self._dtype == "float16":
+            return False
+        if self._dtype not in (None, "auto"):
+            return True             # pinned bf16 (or anything non-fp16)
+        if self._auto_fp16_seen:
+            return False
+        from ..fluid.framework import _dygraph_tracer
+        tracer = _dygraph_tracer()
+        amp_dt = getattr(tracer, "_amp_dtype", None) if tracer is not None \
+            else None
+        amp_on = bool(getattr(tracer, "_amp_enabled", False)) \
+            if tracer is not None else False
+        if amp_on and amp_dt == "float16":
+            # LATCH: the canonical pattern scales the loss INSIDE
+            # `with auto_cast(dtype="float16")` but calls step() outside
+            # it — once an fp16 context is observed, the unscale/finite
+            # machinery must keep running after the context exits, or the
+            # optimizer would step on 2^15-scaled gradients unchecked
+            self._auto_fp16_seen = True
+            return False
+        return True
 
     def scale(self, loss):
-        if not self._enable:
+        if self._is_identity():
             return loss
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if self._is_identity():
+            self._found_inf = False
             return
         inv = 1.0 / self._scale
         found = False
@@ -40,6 +81,9 @@ class GradScaler:
         self._found_inf = found
 
     def step(self, optimizer):
+        if self._is_identity():
+            optimizer.step()        # zero-overhead path: no finite scan
+            return
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
@@ -49,7 +93,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
-        if not (self._enable and self._dynamic):
+        if self._is_identity() or not self._dynamic:
             return
         if self._found_inf:
             self._bad += 1
@@ -65,10 +109,10 @@ class GradScaler:
                 self._good = 0
 
     def is_enable(self):
-        return self._enable
+        return self._enable and not self._is_identity()
 
     def get_scale(self):
-        return self._scale
+        return 1.0 if self._is_identity() else self._scale
 
     def state_dict(self):
         return {"scale": self._scale, "good": self._good, "bad": self._bad}

@@ -1,14 +1,43 @@
 """AMP op lists (reference python/paddle/fluid/contrib/mixed_precision/
-fp16_lists.py:28-39 black/white lists, adapted bf16-first for TPU MXU)."""
+fp16_lists.py:28-39 black/white lists, adapted bf16-first for TPU MXU).
+
+Audited against the op registry (ops/registry.py): every registered op in
+the matmul/conv family — the ops whose lowering is MXU-bound — must be
+classified white (bf16 compute), black (fp32 compute), or explicitly
+fp32-fallback.  `unclassified_family_ops()` names the stragglers; the
+amp_bf16 pass treats them as fp32 with a one-shot trace warning instead
+of a silent skip, and tests/test_amp_plane.py keeps the set empty.
+"""
+import re
+
+# white: consume bf16, MXU systolic-array path; fp32 accumulation rides
+# the lowerings' preferred_element_type (ops/math.py) / XLA's bf16-conv
+# f32 accumulator (ops/nn_ops.py).
 WHITE_OPS = {
-    "matmul", "matmul_v2", "mul", "bmm", "conv2d", "depthwise_conv2d",
-    "conv2d_transpose", "conv3d", "fc", "fused_multihead_attention",
+    "matmul", "matmul_v2", "mul", "bmm", "mv", "conv2d",
+    "depthwise_conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "conv_fusion", "fc", "batch_fc", "scaled_fc", "multihead_matmul",
+    "fused_multihead_attention", "var_conv_2d", "sequence_conv",
+    "row_conv",
 }
 BLACK_OPS = {
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
     "reduce_mean", "reduce_sum", "mean", "sum", "exp",
     "log", "rsqrt", "sqrt", "square", "sigmoid_cross_entropy_with_logits",
     "cumsum", "p_norm", "l2_normalize", "softplus",
+}
+# matmul/conv-family ops deliberately kept fp32: recurrent cells whose
+# hidden-state chains drift in bf16, int8-quantized kernels, gather-heavy
+# deformable/tree variants, and fusions that embed a norm (stats must be
+# f32) — classified so the registry audit can tell "decided fp32" from
+# "nobody looked".
+FP32_FAMILY_OPS = {
+    "attention_lstm", "fused_embedding_fc_lstm", "multi_gru",
+    "scaled_int8fc", "fused_fc_elementwise_layernorm", "deformable_conv",
+    "deformable_conv_v1", "conv_shift", "rank_attention",
+    "fusion_conv_inception", "fusion_repeated_fc_relu",
+    "fusion_seqconv_eltadd_relu", "fusion_seqexpand_concat_fc",
+    "tree_conv", "dot",
 }
 # NOTE: the norm family (batch/sync_batch/layer/instance/group_norm) is
 # deliberately GRAY, not black: their lowerings compute statistics in f32
@@ -18,3 +47,56 @@ BLACK_OPS = {
 # HBM-bound at ~800GB/s with 59GB/step of traffic largely from those
 # boundary converts.
 # everything else: gray — runs in whatever dtype arrives
+
+# names that MATCH the family regex but are not matmul/conv compute
+# (elementwise mul, NMS "multi", comm plumbing, accumulators)
+_FAMILY_FALSE_POSITIVES = {
+    "elementwise_mul", "multiclass_nms", "multiclass_nms2", "multinomial",
+    "multiplex", "multi_gru", "slice_multi_tensor", "average_accumulates",
+    "c_comm_init_multitrainer",
+}
+
+_FAMILY_RE = re.compile(r"matmul|conv|bmm|attention|fc|gemm|^mul$|^mv$"
+                        r"|^dot$|^multi")
+
+
+def is_mxu_family(op_type: str) -> bool:
+    """Does this op name claim matmul/conv-family compute?"""
+    return (bool(_FAMILY_RE.search(op_type))
+            and op_type not in _FAMILY_FALSE_POSITIVES)
+
+
+def classify(op_type: str, white=None, black=None) -> str:
+    """'white' | 'black' | 'fp32' | 'gray' under optional custom lists.
+    Custom lists EXTEND the defaults and WIN over them — a custom white
+    entry moves an op out of the default black list (reference
+    fp16_lists semantics: custom_white_list overrides), and custom black
+    wins custom-white overlaps.  This is the single source of truth for
+    the taxonomy: AmpBf16Pass delegates here, so
+    BuildStrategy.amp_custom_white_list/_black_list get exactly these
+    semantics."""
+    custom_black = set(black or ())
+    if op_type in custom_black:
+        return "black"
+    if op_type in set(white or ()) - custom_black:
+        return "white"
+    if op_type in BLACK_OPS:
+        return "black"
+    if op_type in WHITE_OPS:
+        return "white"
+    if op_type in FP32_FAMILY_OPS:
+        return "fp32"
+    if is_mxu_family(op_type):
+        return "unclassified"      # family op nobody classified — caller
+    return "gray"                  # warns once and runs it fp32
+
+
+def unclassified_family_ops():
+    """Registered matmul/conv-family ops missing from every list — the
+    registry-audit surface (kept empty by tests/test_amp_plane.py)."""
+    from ..ops.registry import all_ops
+    return sorted(op for op in all_ops()
+                  if is_mxu_family(op)
+                  and op not in WHITE_OPS
+                  and op not in BLACK_OPS
+                  and op not in FP32_FAMILY_OPS)

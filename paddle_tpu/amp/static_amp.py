@@ -19,54 +19,42 @@ from .lists import WHITE_OPS, BLACK_OPS
 
 class CustomOpLists:
     def __init__(self, custom_white_list=None, custom_black_list=None):
-        self.white_list = set(WHITE_OPS) | set(custom_white_list or ())
-        self.black_list = set(BLACK_OPS) | set(custom_black_list or ())
+        self.custom_white_list = set(custom_white_list or ())
+        self.custom_black_list = set(custom_black_list or ())
+        self.white_list = set(WHITE_OPS) | self.custom_white_list
+        self.black_list = set(BLACK_OPS) | self.custom_black_list
 
 
 AutoMixedPrecisionLists = CustomOpLists
 
 
 def rewrite_program_bf16(program: Program, amp_lists: CustomOpLists = None,
-                         dtype: str = "bfloat16"):
-    """Insert casts so white-list ops consume `dtype` inputs.  The param
-    master copies stay fp32; the cast pairs fold into XLA fusions."""
+                         dtype: str = "bfloat16", targets=(),
+                         prune_casts: bool = True):
+    """Rewrite ``program`` to `dtype` mixed precision THROUGH the
+    registered IR passes (fluid/passes/amp.py): amp_bf16 cast insertion
+    (grad halves included) plus the prune_redundant_casts cleanup.  Every
+    mutation rides the version-bumping Block mutators, so the executor's
+    fingerprint cache can never serve a pre-rewrite compiled step — the
+    hazard the old raw ``block.append_op + block.ops.pop()`` rewrite left
+    open.  Runs as pass::amp_bf16 / pass::prune_redundant_casts spans on
+    the trace plane like every other pipeline application."""
     amp_lists = amp_lists or CustomOpLists()
-    block = program.global_block()
-    new_ops = []
-    cast_cache = {}
-
-    def cast_in(name, to):
-        key = (name, to)
-        if key in cast_cache:
-            return cast_cache[key], None
-        out = f"{name}@CAST_{to}"
-        block.create_var(name=out, dtype=to, stop_gradient=True)
-        op = block.append_op("cast", inputs={"X": [name]},
-                             outputs={"Out": [out]},
-                             attrs={"out_dtype": to})
-        block.ops.pop()      # re-positioned into new_ops below
-        cast_cache[key] = out
-        return out, op
-
-    for op in list(block.ops):
-        if op.type in amp_lists.white_list:
-            for slot, names in op.inputs.items():
-                new_names = []
-                for n in names:
-                    v = block._find_var_recursive(n)
-                    if v is not None and v.dtype in ("float32", None):
-                        out, cop = cast_in(n, dtype)
-                        if cop is not None:
-                            new_ops.append(cop)
-                        new_names.append(out)
-                    else:
-                        new_names.append(n)
-                op.inputs[slot] = new_names
-        new_ops.append(op)
-    block.ops = new_ops
-    program._bump_version()
-    program._amp_enabled = True
-    program._amp_dtype = dtype
+    from ..fluid.passes import PassPipeline, create_pass
+    # hand the pass only the CUSTOM deltas (including any post-construction
+    # mutation of .white_list/.black_list): lists.classify lets a custom
+    # white entry pull an op out of the default black list, which feeding
+    # the full unioned black_list back as "custom" would defeat
+    white = (amp_lists.white_list - WHITE_OPS) \
+        | getattr(amp_lists, "custom_white_list", set())
+    black = (amp_lists.black_list - BLACK_OPS) \
+        | getattr(amp_lists, "custom_black_list", set())
+    plist = [create_pass("amp_bf16", dtype=dtype,
+                         custom_white_list=white,
+                         custom_black_list=black)]
+    if prune_casts:
+        plist.append(create_pass("prune_redundant_casts"))
+    PassPipeline(plist).apply(program, targets=targets)
     return program
 
 
@@ -89,7 +77,13 @@ class OptimizerWithMixedPrecision:
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
         program = loss.block.program
-        rewrite_program_bf16(program, self._amp_lists, self._dtype)
+        # keep the inserted casts as REAL ops here: backward hasn't run
+        # yet, and append_backward must differentiate THROUGH them (a
+        # folded-away cast is invisible to the grad builder, so the vjp
+        # would recompute an fp32 forward).  The cleanup pass runs below,
+        # after the grad + loss-scaling + update ops all exist.
+        rewrite_program_bf16(program, self._amp_lists, self._dtype,
+                             targets=[loss.name], prune_casts=False)
 
         scaled_loss = loss
         if self._init_scale != 1.0 or self._dynamic:
@@ -128,6 +122,11 @@ class OptimizerWithMixedPrecision:
                              "OutGoodSteps": [good], "OutBadSteps": [bad]},
                     attrs={})
         ops = self._optimizer.apply_gradients(params_grads)
+        # now that forward, grads, and updates all exist, clean up: the
+        # fold rewires forward ops AND their grad mirrors consistently
+        from ..fluid.passes import PassPipeline, create_pass
+        PassPipeline([create_pass("prune_redundant_casts")]).apply(
+            program, targets=[loss.name, scaled_loss.name])
         return ops, params_grads
 
     def backward(self, loss, **kw):

@@ -1,8 +1,10 @@
 """AMP meta-optimizer (reference: meta_optimizers/amp_optimizer.py).
 
-Delegates to the static AMP decorator (amp/static_amp.py), which rewrites
-the program to bf16 per black/white lists — the TPU-native counterpart of
-the reference's fp16 rewrite (contrib/mixed_precision/decorate:253).
+Delegates to the static AMP decorator (amp/static_amp.py), whose rewrite
+now runs THROUGH the registered IR passes (fluid/passes/amp.py amp_bf16 +
+prune_redundant_casts) — version-bumped mutations, pass::amp_bf16 trace
+spans, and the amp.ops_cast/amp.casts_pruned counters, exactly like a
+BuildStrategy-driven pipeline application.
 """
 from __future__ import annotations
 

@@ -48,6 +48,15 @@ class BuildStrategy:
         self.fuse_bn_act_ops = False           # -> fuse_bn_act
         self.enable_dce = False                # -> dce pass (fetch-seeded)
         self.constant_folding = False          # -> constant_fold pass
+        # bf16 mixed precision as a compiler plane (passes/amp.py):
+        # amp -> amp_bf16 pass (white/black-list cast insertion with the
+        # grad halves kept dtype-consistent), followed by the
+        # prune_redundant_casts cleanup unless disabled
+        self.amp = False
+        self.amp_dtype = "bfloat16"
+        self.amp_custom_white_list = None
+        self.amp_custom_black_list = None
+        self.prune_redundant_casts = True
         self.enable_sequential_execution = False
         self.remove_unnecessary_lock = True
         self.sync_batch_norm = False        # -> sync_batch_norm op psum

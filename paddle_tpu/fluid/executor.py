@@ -80,7 +80,15 @@ def _fingerprint(program: Program) -> str:
     if cached is not None and cached[0] == shape:
         return cached[1]
     h = hashlib.sha1()
+    # dtype-aware: the AMP plane rewrites VAR dtypes (a bf16 program and
+    # its fp32 twin can share an op stream modulo attrs), and the compiled
+    # executable is specialised on them — they must key the cache exactly
+    # like the op stream does
+    h.update(f"amp:{int(bool(getattr(program, '_amp_enabled', False)))}:"
+             f"{getattr(program, '_amp_dtype', '')}".encode())
     for b in program.blocks:
+        h.update(repr(sorted((n, v.dtype) for n, v in b.vars.items()))
+                 .encode())
         for op in b.ops:
             h.update(op.type.encode())
             h.update(repr(sorted(op.inputs.items())).encode())
@@ -184,8 +192,18 @@ def run_block_ops(block: Block, env: Dict[str, Any], ctx: LoweringContext,
             continue
         opdef = get_op(op.type)
         ins = {}
+        amp_cast = op.attrs.get("__amp_cast__")
         for slot, names in op.inputs.items():
-            vals = [env[n] for n in names if n in env]
+            if amp_cast and slot in amp_cast:
+                # folded AMP cast (passes/amp.py prune_redundant_casts):
+                # the astype happens here, inline, instead of as its own
+                # dispatched cast op — zero extra ops in the traced block
+                dts = amp_cast[slot]
+                vals = [env[n] if j >= len(dts) or dts[j] is None
+                        else env[n].astype(dts[j])
+                        for j, n in enumerate(names) if n in env]
+            else:
+                vals = [env[n] for n in names if n in env]
             if vals or names:
                 ins[slot] = vals
         op_attrs = op.attrs

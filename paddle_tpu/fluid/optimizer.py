@@ -47,9 +47,14 @@ class _EagerOptHelper:
 
 class Optimizer:
     _accumulator_defaults: Dict[str, float] = {}
+    # subclasses whose update op wires MasterParam/MasterParamOut
+    # (ops/optimizer_ops.py) flip this on; everyone else REJECTS
+    # multi_precision=True instead of silently ignoring it
+    _supports_multi_precision = False
 
     def __init__(self, learning_rate=0.001, parameter_list=None,
-                 regularization=None, grad_clip=None, name=None):
+                 regularization=None, grad_clip=None, name=None,
+                 multi_precision=False):
         self._learning_rate = learning_rate
         self._parameter_list = parameter_list
         self.regularization = regularization
@@ -57,6 +62,19 @@ class Optimizer:
         self._name = name or type(self).__name__
         self._accumulators: Dict[str, Dict[str, Variable]] = {}
         self._lr_var: Optional[Variable] = None
+        # fp32 master weights (reference optimizer.py multi_precision on
+        # SGD/Momentum/Adam/AdamW/Lamb): low-precision params keep an fp32
+        # master copy the update computes on; the param is a bf16 VIEW of
+        # the master.  Master + moments are ordinary persistable
+        # accumulators, so they ride the executor's written-names set and
+        # the PR-4 donation path like every other optimizer state —
+        # master copies never defeat buffer donation.
+        if multi_precision and not self._supports_multi_precision:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no fp32 master-weight path; "
+                f"multi_precision=True is only supported on "
+                f"SGD/Momentum/Adam/AdamW/Lamb")
+        self._multi_precision = bool(multi_precision)
         self.helper = LayerHelper(self._name)
 
     # -- learning rate ------------------------------------------------------
@@ -102,6 +120,55 @@ class Optimizer:
 
     def _get_accumulator(self, name, param):
         return self._accumulators[name][param.name]
+
+    # -- fp32 master weights ------------------------------------------------
+    def _mp_active(self, param) -> bool:
+        dtype = (str(param._value.dtype) if hasattr(param, "_value")
+                 else param.dtype)
+        return self._multi_precision and dtype in ("float16", "bfloat16")
+
+    def _master_weight(self, param):
+        """The fp32 master accumulator for a low-precision param,
+        initialised FROM the param's value (a startup-program cast in
+        static mode, an eager astype in dygraph) — not zero-filled like
+        ordinary accumulators."""
+        accs = self._accumulators.setdefault("master_weight", {})
+        if param.name in accs:
+            return accs[param.name]
+        if in_dygraph_mode():
+            from ..dygraph.base import VarBase
+            import jax.numpy as jnp
+            mv = VarBase(param._value.astype(jnp.float32),
+                         stop_gradient=True)
+            accs[param.name] = mv
+            return mv
+        key = f"{self._name}_master_weight_{param.name}"
+        block = default_main_program().global_block()
+        var = block.create_var(name=key, shape=list(param.shape or []),
+                               dtype="float32", persistable=True,
+                               stop_gradient=True)
+        sb = default_startup_program().global_block()
+        sb.create_var(name=key, shape=list(param.shape or []),
+                      dtype="float32", persistable=True)
+        sb.append_op("cast", inputs={"X": [param.name]},
+                     outputs={"Out": [key]},
+                     attrs={"out_dtype": "float32"})
+        accs[param.name] = var
+        return var
+
+    def _mp_io(self, param, inputs, outputs):
+        """Wire MasterParam/MasterParamOut into an update op's slots when
+        multi_precision applies to this param."""
+        if self._mp_active(param):
+            master = self._master_weight(param)
+            inputs["MasterParam"] = [master]
+            outputs["MasterParamOut"] = [master]
+        return inputs, outputs
+
+    def _acc_dtype(self, param):
+        """Moment accumulators follow the COMPUTE dtype: fp32 under
+        multi_precision, the param dtype otherwise."""
+        return "float32" if self._mp_active(param) else None
 
     # -- main entry points --------------------------------------------------
     def backward(self, loss, startup_program=None, parameter_list=None,
@@ -260,15 +327,22 @@ class Optimizer:
 
 
 class SGDOptimizer(Optimizer):
+    _supports_multi_precision = True
+
     def _append_optimize_op(self, param, grad):
-        return self.helper.append_op(
-            "sgd",
-            inputs={"Param": [param], "Grad": [grad],
-                    "LearningRate": [self._lr_var]},
-            outputs={"ParamOut": [param]})
+        ins, outs = self._mp_io(
+            param,
+            {"Param": [param], "Grad": [grad],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [param]})
+        return self.helper.append_op("sgd", inputs=ins, outputs=outs,
+                                     attrs={"multi_precision":
+                                            self._mp_active(param)})
 
 
 class MomentumOptimizer(Optimizer):
+    _supports_multi_precision = True
+
     def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
                  **kw):
         super().__init__(learning_rate, **kw)
@@ -277,16 +351,19 @@ class MomentumOptimizer(Optimizer):
 
     def _create_accumulators(self, params):
         for p in params:
-            self._add_accumulator("velocity", p)
+            self._add_accumulator("velocity", p, dtype=self._acc_dtype(p))
 
     def _append_optimize_op(self, param, grad):
         v = self._get_accumulator("velocity", param)
+        ins, outs = self._mp_io(
+            param,
+            {"Param": [param], "Grad": [grad], "Velocity": [v],
+             "LearningRate": [self._lr_var]},
+            {"ParamOut": [param], "VelocityOut": [v]})
         return self.helper.append_op(
-            "momentum",
-            inputs={"Param": [param], "Grad": [grad], "Velocity": [v],
-                    "LearningRate": [self._lr_var]},
-            outputs={"ParamOut": [param], "VelocityOut": [v]},
-            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+            "momentum", inputs=ins, outputs=outs,
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                   "multi_precision": self._mp_active(param)})
 
 
 class LarsMomentumOptimizer(Optimizer):
@@ -313,6 +390,8 @@ class LarsMomentumOptimizer(Optimizer):
 
 
 class AdamOptimizer(Optimizer):
+    _supports_multi_precision = True
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
@@ -320,25 +399,29 @@ class AdamOptimizer(Optimizer):
 
     def _create_accumulators(self, params):
         for p in params:
-            self._add_accumulator("moment1", p)
-            self._add_accumulator("moment2", p)
-            self._add_accumulator("beta1_pow", p, self._beta1, [1])
-            self._add_accumulator("beta2_pow", p, self._beta2, [1])
+            self._add_accumulator("moment1", p, dtype=self._acc_dtype(p))
+            self._add_accumulator("moment2", p, dtype=self._acc_dtype(p))
+            self._add_accumulator("beta1_pow", p, self._beta1, [1],
+                                  dtype="float32")
+            self._add_accumulator("beta2_pow", p, self._beta2, [1],
+                                  dtype="float32")
 
     def _append_optimize_op(self, param, grad):
         m1 = self._get_accumulator("moment1", param)
         m2 = self._get_accumulator("moment2", param)
         b1p = self._get_accumulator("beta1_pow", param)
         b2p = self._get_accumulator("beta2_pow", param)
-        return self.helper.append_op(
-            self._op_type(),
-            inputs={"Param": [param], "Grad": [grad], "Moment1": [m1],
-                    "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
-                    "LearningRate": [self._lr_var]},
-            outputs={"ParamOut": [param], "Moment1Out": [m1],
-                     "Moment2Out": [m2], "Beta1PowOut": [b1p],
-                     "Beta2PowOut": [b2p]},
-            attrs=self._op_attrs())
+        ins = {"Param": [param], "Grad": [grad], "Moment1": [m1],
+               "Moment2": [m2], "Beta1Pow": [b1p], "Beta2Pow": [b2p],
+               "LearningRate": [self._lr_var]}
+        outs = {"ParamOut": [param], "Moment1Out": [m1],
+                "Moment2Out": [m2], "Beta1PowOut": [b1p],
+                "Beta2PowOut": [b2p]}
+        attrs = dict(self._op_attrs())
+        ins, outs = self._mp_io(param, ins, outs)
+        attrs["multi_precision"] = self._mp_active(param)
+        return self.helper.append_op(self._op_type(), inputs=ins,
+                                     outputs=outs, attrs=attrs)
 
     def _op_type(self):
         return "adam"

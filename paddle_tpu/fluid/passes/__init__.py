@@ -15,11 +15,14 @@ from .core import (Pass, PassContext, PassRegistry, PassPipeline,
 from .pattern import (Pattern, PVar, POp, Match, PatternRewritePass)
 from .graphviz import program_to_dot, dump_program
 from . import builtin  # registers the built-in pass catalog
+from . import amp      # registers amp_bf16 + prune_redundant_casts
 from .builtin import passes_for_build_strategy
+from .amp import AmpBf16Pass, PruneRedundantCastsPass
 
 __all__ = [
     "Pass", "PassContext", "PassRegistry", "PassPipeline",
     "register_pass", "create_pass", "get_pass_names",
     "Pattern", "PVar", "POp", "Match", "PatternRewritePass",
     "program_to_dot", "dump_program", "passes_for_build_strategy",
+    "AmpBf16Pass", "PruneRedundantCastsPass",
 ]

@@ -580,7 +580,11 @@ class MemoryOptimizeLegacyPass(Pass):
 
 def passes_for_build_strategy(build_strategy) -> List[Pass]:
     """Instantiate the pass list a BuildStrategy's knobs select, in the
-    canonical order: fold -> fuse -> clean -> dce -> coalesce."""
+    canonical order: fold -> fuse -> clean -> amp -> dce -> coalesce.
+    AMP runs after the fusions (the fused ops are gray — they follow
+    their bf16 inputs) and before DCE (which sweeps the cast orphans the
+    redundancy pruner leaves)."""
+    from . import amp as _amp  # noqa: F401 — registers the AMP passes
     bs = build_strategy
     mem = bool(getattr(bs, "memory_optimize", None))
     specs = []
@@ -592,6 +596,15 @@ def passes_for_build_strategy(build_strategy) -> List[Pass]:
         specs.append(("fuse_bn_act", {}))
     if mem:
         specs.append(("prune_identity", {}))
+    if getattr(bs, "amp", False):
+        specs.append(("amp_bf16", {
+            "dtype": getattr(bs, "amp_dtype", "bfloat16") or "bfloat16",
+            "custom_white_list": getattr(bs, "amp_custom_white_list",
+                                         None),
+            "custom_black_list": getattr(bs, "amp_custom_black_list",
+                                         None)}))
+        if getattr(bs, "prune_redundant_casts", True):
+            specs.append(("prune_redundant_casts", {}))
     if getattr(bs, "enable_dce", False) or mem:
         specs.append(("dce", {}))
     if getattr(bs, "fuse_all_reduce_ops", False):

@@ -59,6 +59,9 @@ def _to_plain(v):
     return v
 
 
+_JSON_ATTR_TAG = "__pdtpu_json__:"
+
+
 def _set_attr(pb_attr, name: str, value, op_type: str) -> bool:
     """Fill one OpDesc.Attr; returns False when the value has no proto
     representation (caller decides whether that is fatal)."""
@@ -83,6 +86,16 @@ def _set_attr(pb_attr, name: str, value, op_type: str) -> bool:
     elif isinstance(value, str):
         pb_attr.type = fp.STRING
         pb_attr.s = value
+    elif isinstance(value, dict):
+        # dict attrs (the AMP plane's __amp_cast__ slot->dtypes map) ride
+        # as tagged-JSON STRINGs: the reference reader sees an opaque
+        # string attr it ignores; our reader round-trips the dict
+        import json
+        try:
+            pb_attr.type = fp.STRING
+            pb_attr.s = _JSON_ATTR_TAG + json.dumps(value, sort_keys=True)
+        except (TypeError, ValueError):
+            return False
     elif isinstance(value, list):
         items = [_to_plain(x) for x in value]
         if not items:
@@ -119,6 +132,9 @@ def _get_attr(pb_attr):
     if t == fp.FLOAT:
         return pb_attr.f
     if t == fp.STRING:
+        if pb_attr.s.startswith(_JSON_ATTR_TAG):
+            import json
+            return json.loads(pb_attr.s[len(_JSON_ATTR_TAG):])
         return pb_attr.s
     if t == fp.INTS:
         return list(pb_attr.ints)

@@ -93,8 +93,21 @@ class _StaticAdapter:
                 if mode == "train":
                     _static_optimizer(m._optimizer).minimize(loss)
                 fetch = [loss.name] + [o.name for o in outs]
-        entry = {"prog": prog, "ins": [v.name for v in ins],
+        entry = {"prog": prog, "run_prog": prog,
+                 "ins": [v.name for v in ins],
                  "lbs": [v.name for v in lbs], "fetch": fetch}
+        if mode == "train" and self.model._amp_level not in (None, "O0"):
+            # Model.prepare(amp_level="O1"/"O2"): route the train program
+            # through the AMP compiler plane (fluid/passes/amp.py) — the
+            # amp_bf16 + prune_redundant_casts passes run once at the
+            # first batch, fp32 master semantics come from the params
+            # staying fp32 in the scope while the forward consumes bf16
+            # views through the inserted casts
+            from ..fluid.compiler import BuildStrategy, CompiledProgram
+            bs = BuildStrategy()
+            bs.amp = True
+            bs.amp_dtype = self.model._amp_dtype
+            entry["run_prog"] = CompiledProgram(prog, build_strategy=bs)
         self._progs[mode] = entry
         return entry
 
@@ -151,7 +164,7 @@ class _StaticAdapter:
 
     def _run(self, mode, inputs, labels):
         entry, feed = self._prep(mode, inputs, labels)
-        return entry, self._executor().run(entry["prog"], feed=feed,
+        return entry, self._executor().run(entry["run_prog"], feed=feed,
                                            fetch_list=entry["fetch"])
 
     def train_batch_async(self, inputs, labels=None):
@@ -163,7 +176,7 @@ class _StaticAdapter:
         if self._train_runner is None:
             from ..fluid.async_pipeline import AsyncStepRunner
             self._train_runner = AsyncStepRunner(
-                self._executor(), entry["prog"], entry["fetch"])
+                self._executor(), entry["run_prog"], entry["fetch"])
         return self._train_runner.submit(feed)
 
     def drain(self):
@@ -270,15 +283,35 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._amp_level = None
+        self._amp_dtype = "bfloat16"
         # mode picked at construction, like the reference (model.py:1012
         # fluid.in_dygraph_mode() chooses the adapter)
         self._adapter = None if in_dygraph_mode() else _StaticAdapter(self)
 
-    def prepare(self, optimizer=None, loss=None, metrics=None):
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_level=None, amp_dtype="bfloat16"):
+        """``amp_level``: None/"O0" = fp32 (default); "O1"/"O2" = bf16
+        mixed precision.  Static mode routes the train program through
+        the amp_bf16 + prune_redundant_casts IR passes; dygraph mode
+        wraps each train/eval batch in ``amp.auto_cast``.  On this stack
+        O1 and O2 coincide: params stay fp32 in the scope (master
+        semantics) and the forward consumes bf16 views either way."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = (metrics if isinstance(metrics, (list, tuple))
                          else [metrics]) if metrics else []
+        lvl = amp_level
+        if isinstance(lvl, str):
+            lvl = lvl.upper()
+            if lvl not in ("O0", "O1", "O2"):
+                raise ValueError(
+                    f"amp_level must be one of None/'O0'/'O1'/'O2', "
+                    f"got {amp_level!r}")
+        elif lvl:
+            lvl = "O1"
+        self._amp_level = lvl or None
+        self._amp_dtype = amp_dtype
         return self
 
     # -- core steps ----------------------------------------------------------
@@ -288,7 +321,12 @@ class Model:
         self.network.train()
         ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
         lbs = [to_variable(np.asarray(x)) for x in _as_list(labels)]
-        outs = self.network(*ins)
+        if self._amp_level not in (None, "O0"):
+            from ..amp import auto_cast
+            with auto_cast(enable=True, dtype=self._amp_dtype):
+                outs = self.network(*ins)
+        else:
+            outs = self.network(*ins)
         outs_l = _as_list(outs)
         loss = self._loss(*outs_l, *lbs) if self._loss else outs_l[0]
         final = loss
@@ -307,7 +345,12 @@ class Model:
         self.network.eval()
         ins = [to_variable(np.asarray(x)) for x in _as_list(inputs)]
         lbs = [to_variable(np.asarray(x)) for x in _as_list(labels)]
-        outs = _as_list(self.network(*ins))
+        if self._amp_level not in (None, "O0"):
+            from ..amp import auto_cast
+            with auto_cast(enable=True, dtype=self._amp_dtype):
+                outs = _as_list(self.network(*ins))
+        else:
+            outs = _as_list(self.network(*ins))
         loss = self._loss(*outs, *lbs) if self._loss else outs[0]
         metrics = [self._eval_metric(m, outs, lbs) for m in self._metrics]
         lv = float(np.asarray(loss.numpy()).reshape(-1)[0]) \

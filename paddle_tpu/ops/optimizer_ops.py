@@ -21,15 +21,43 @@ def _p(ins, slot):
     return ins[slot][0]
 
 
+def _mp_param(ins):
+    """Multi-precision entry (reference sgd_op.h MultiPrecision path):
+    when a MasterParam rides in, the update computes on the fp32 master
+    and the low-precision param is just a VIEW of it — (compute_param,
+    fp32_grad, master?) with the grad widened so accumulation never
+    happens in bf16."""
+    master = ins.get("MasterParam", [None])[0]
+    p = master if master is not None else _p(ins, "Param")
+    g = _p(ins, "Grad")
+    if master is not None and g.dtype != master.dtype:
+        g = g.astype(master.dtype)
+    return p, g, master
+
+
+def _mp_outs(outs, ins, master_new):
+    """Split the updated master into (bf16 ParamOut view, fp32
+    MasterParamOut)."""
+    lo = _p(ins, "Param").dtype
+    outs["ParamOut"] = [master_new.astype(lo)]
+    outs["MasterParamOut"] = [master_new]
+    return outs
+
+
 @register_op("sgd", differentiable=False)
 def _sgd(ins, attrs, ctx):
-    p, g, lr = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "LearningRate")
-    return {"ParamOut": [p - lr.reshape(()) * g]}
+    p, g, master = _mp_param(ins)
+    lr = _p(ins, "LearningRate").reshape(())
+    p_new = p - lr * g
+    if master is not None:
+        return _mp_outs({}, ins, p_new)
+    return {"ParamOut": [p_new]}
 
 
 @register_op("momentum", differentiable=False)
 def _momentum(ins, attrs, ctx):
-    p, g, v = _p(ins, "Param"), _p(ins, "Grad"), _p(ins, "Velocity")
+    p, g, master = _mp_param(ins)
+    v = _p(ins, "Velocity")
     lr = _p(ins, "LearningRate").reshape(())
     mu = attrs.get("mu", 0.9)
     rd = attrs.get("regularization_coeff", 0.0)
@@ -40,7 +68,11 @@ def _momentum(ins, attrs, ctx):
         p_new = p - lr * (g + mu * v_new)
     else:
         p_new = p - lr * v_new
-    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+    outs = {"VelocityOut": [v_new]}
+    if master is not None:
+        return _mp_outs(outs, ins, p_new)
+    outs["ParamOut"] = [p_new]
+    return outs
 
 
 @register_op("lars_momentum", differentiable=False)
@@ -61,7 +93,7 @@ def _lars_momentum(ins, attrs, ctx):
 
 @register_op("adam", differentiable=False)
 def _adam(ins, attrs, ctx):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g, master = _mp_param(ins)
     m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
     b1p, b2p = _p(ins, "Beta1Pow").reshape(()), _p(ins, "Beta2Pow").reshape(())
     lr = _p(ins, "LearningRate").reshape(())
@@ -73,20 +105,26 @@ def _adam(ins, attrs, ctx):
     # reference adam_op.h: lr_t = lr * sqrt(1 - b2^t) / (1 - b1^t)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
-    return {"ParamOut": [p_new], "Moment1Out": [m_new], "Moment2Out": [v_new],
+    outs = {"Moment1Out": [m_new], "Moment2Out": [v_new],
             "Beta1PowOut": [(b1p * b1).reshape(1)],
             "Beta2PowOut": [(b2p * b2).reshape(1)]}
+    if master is not None:
+        return _mp_outs(outs, ins, p_new)
+    outs["ParamOut"] = [p_new]
+    return outs
 
 
 @register_op("adamw", differentiable=False)
 def _adamw(ins, attrs, ctx):
-    p = _p(ins, "Param")
+    p, _, master = _mp_param(ins)
     coeff = attrs.get("coeff", 0.01)
     lr = _p(ins, "LearningRate").reshape(())
     out = _adam(ins, attrs, ctx)
     if not attrs.get("with_decay", True):
         return out
-    # decoupled weight decay applied against the pre-update param
+    # decoupled weight decay applied against the pre-update (master) param
+    if master is not None:
+        return _mp_outs(out, ins, out["MasterParamOut"][0] - lr * coeff * p)
     out["ParamOut"] = [out["ParamOut"][0] - lr * coeff * p]
     return out
 
@@ -124,7 +162,7 @@ def _rmsprop(ins, attrs, ctx):
 
 @register_op("lamb", differentiable=False)
 def _lamb(ins, attrs, ctx):
-    p, g = _p(ins, "Param"), _p(ins, "Grad")
+    p, g, master = _mp_param(ins)
     m, v = _p(ins, "Moment1"), _p(ins, "Moment2")
     b1p, b2p = _p(ins, "Beta1Pow").reshape(()), _p(ins, "Beta2Pow").reshape(())
     lr = _p(ins, "LearningRate").reshape(())
@@ -139,10 +177,13 @@ def _lamb(ins, attrs, ctx):
     p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
     r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
     ratio = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
-    return {"ParamOut": [p - lr * ratio * r], "Moment1Out": [m_new],
-            "Moment2Out": [v_new],
+    outs = {"Moment1Out": [m_new], "Moment2Out": [v_new],
             "Beta1PowOut": [(b1p * b1).reshape(1)],
             "Beta2PowOut": [(b2p * b2).reshape(1)]}
+    if master is not None:
+        return _mp_outs(outs, ins, p - lr * ratio * r)
+    outs["ParamOut"] = [p - lr * ratio * r]
+    return outs
 
 
 @register_op("ftrl", differentiable=False)

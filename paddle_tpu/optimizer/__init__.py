@@ -18,14 +18,26 @@ from .lr import (LRScheduler, NoamDecay, ExponentialDecay,  # noqa: F401
 class _EagerOptimizer:
     """Applies ops/optimizer_ops.py lowerings directly to parameters."""
     op_type = "sgd"
+    # flipped on by subclasses whose _apply_one wires _mp_io/_mp_write;
+    # the rest REJECT multi_precision=True instead of silently ignoring it
+    _supports_master = False
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
-                 grad_clip=None, **kw):
+                 grad_clip=None, multi_precision=False, **kw):
         self._lr = learning_rate
         self._parameters = list(parameters or [])
         self._accum = {}
         self._grad_clip = grad_clip
         self._weight_decay = weight_decay
+        # fp32 master weights for bf16/fp16 params: the update computes on
+        # the master; the param becomes a low-precision view of it
+        if (multi_precision or kw.get("multi_precision")) \
+                and not self._supports_master:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no fp32 master-weight path; "
+                f"multi_precision=True is only supported on "
+                f"SGD/Momentum/Adam/AdamW/Lamb")
+        self._multi_precision = bool(multi_precision)
         self._kw = kw
         self._ctx = LoweringContext()
 
@@ -46,13 +58,33 @@ class _EagerOptimizer:
         self._lr = v
 
     def _accs(self, p, names_and_init):
-        key = id(p)
-        if key not in self._accum:
-            self._accum[key] = {n: (jnp.full(shape, iv, jnp.float32)
-                                    if shape else jnp.full((1,), iv,
-                                                           jnp.float32))
-                                for n, (shape, iv) in names_and_init.items()}
-        return self._accum[key]
+        d = self._accum.setdefault(id(p), {})
+        for n, (shape, iv) in names_and_init.items():
+            if n not in d:
+                d[n] = (jnp.full(shape, iv, jnp.float32) if shape
+                        else jnp.full((1,), iv, jnp.float32))
+        return d
+
+    def _master_of(self, p):
+        """fp32 master for a low-precision param (initialised FROM the
+        param, not zero-filled), or None when multi_precision is off or
+        the param is already fp32."""
+        if not self._multi_precision or p._value.dtype == jnp.float32:
+            return None
+        d = self._accum.setdefault(id(p), {})
+        if "master" not in d:
+            d["master"] = p._value.astype(jnp.float32)
+        return d["master"]
+
+    def _mp_io(self, p, ins):
+        master = self._master_of(p)
+        if master is not None:
+            ins["MasterParam"] = [master]
+        return master
+
+    def _mp_write(self, p, outs, master):
+        if master is not None and "MasterParamOut" in outs:
+            self._accum[id(p)]["master"] = outs["MasterParamOut"][0]
 
     def step(self):
         params_grads = [(p, p._grad) for p in self._parameters
@@ -110,53 +142,68 @@ class _EagerOptimizer:
 
 
 class SGD(_EagerOptimizer):
+    _supports_master = True
+
     def _apply_one(self, p, g, lr_arr):
-        out = get_op("sgd").fn(
-            {"Param": [p._value], "Grad": [g], "LearningRate": [lr_arr]},
-            {}, self._ctx)
+        ins = {"Param": [p._value], "Grad": [g], "LearningRate": [lr_arr]}
+        master = self._mp_io(p, ins)
+        out = get_op("sgd").fn(ins, {}, self._ctx)
         p._value = out["ParamOut"][0]
+        self._mp_write(p, out, master)
 
 
 class Momentum(_EagerOptimizer):
+    _supports_master = True
+
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
-                 use_nesterov=False, weight_decay=None, grad_clip=None, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._mu = momentum
         self._nesterov = use_nesterov
 
     def _apply_one(self, p, g, lr_arr):
         accs = self._accs(p, {"velocity": (p.shape, 0.0)})
+        ins = {"Param": [p._value], "Grad": [g],
+               "Velocity": [accs["velocity"]], "LearningRate": [lr_arr]}
+        master = self._mp_io(p, ins)
         out = get_op("momentum").fn(
-            {"Param": [p._value], "Grad": [g], "Velocity": [accs["velocity"]],
-             "LearningRate": [lr_arr]},
-            {"mu": self._mu, "use_nesterov": self._nesterov}, self._ctx)
+            ins, {"mu": self._mu, "use_nesterov": self._nesterov},
+            self._ctx)
         p._value = out["ParamOut"][0]
         accs["velocity"] = out["VelocityOut"][0]
+        self._mp_write(p, out, master)
 
 
 class Adam(_EagerOptimizer):
+    _supports_master = True
     op_type = "adam"
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
-                 grad_clip=None, lazy_mode=False, **kw):
-        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._b1, self._b2, self._eps = beta1, beta2, epsilon
 
     def _attrs(self):
         return {"beta1": self._b1, "beta2": self._b2, "epsilon": self._eps}
 
-    def _apply_one(self, p, g, lr_arr):
+    def _apply_one(self, p, g, lr_arr, attrs=None):
         accs = self._accs(p, {"m1": (p.shape, 0.0), "m2": (p.shape, 0.0),
                               "b1p": ((1,), self._b1), "b2p": ((1,), self._b2)})
-        out = get_op(self.op_type).fn(
-            {"Param": [p._value], "Grad": [g], "Moment1": [accs["m1"]],
-             "Moment2": [accs["m2"]], "Beta1Pow": [accs["b1p"]],
-             "Beta2Pow": [accs["b2p"]], "LearningRate": [lr_arr]},
-            self._attrs(), self._ctx)
+        ins = {"Param": [p._value], "Grad": [g], "Moment1": [accs["m1"]],
+               "Moment2": [accs["m2"]], "Beta1Pow": [accs["b1p"]],
+               "Beta2Pow": [accs["b2p"]], "LearningRate": [lr_arr]}
+        master = self._mp_io(p, ins)
+        out = get_op(self.op_type).fn(ins, attrs or self._attrs(),
+                                      self._ctx)
         p._value = out["ParamOut"][0]
         accs["m1"], accs["m2"] = out["Moment1Out"][0], out["Moment2Out"][0]
         accs["b1p"], accs["b2p"] = out["Beta1PowOut"][0], out["Beta2PowOut"][0]
+        self._mp_write(p, out, master)
 
 
 class AdamW(Adam):
@@ -164,40 +211,30 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
-                 grad_clip=None, apply_decay_param_fun=None, **kw):
+                 grad_clip=None, apply_decay_param_fun=None,
+                 multi_precision=False, **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip)
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._decay_fun = apply_decay_param_fun
 
     def _attrs(self):
         return {**super()._attrs(),
                 "coeff": float(self._weight_decay or 0.0)}
 
-    def _apply_one(self, p, g, lr_arr):
+    def _apply_one(self, p, g, lr_arr, attrs=None):
         if self._decay_fun is not None and not self._decay_fun(p.name):
-            saved = self._weight_decay
-            self._weight_decay = 0.0
-            coeff0 = {"beta1": self._b1, "beta2": self._b2,
-                      "epsilon": self._eps, "coeff": 0.0}
-            accs = self._accs(p, {"m1": (p.shape, 0.0), "m2": (p.shape, 0.0),
-                                  "b1p": ((1,), self._b1),
-                                  "b2p": ((1,), self._b2)})
-            out = get_op("adamw").fn(
-                {"Param": [p._value], "Grad": [g], "Moment1": [accs["m1"]],
-                 "Moment2": [accs["m2"]], "Beta1Pow": [accs["b1p"]],
-                 "Beta2Pow": [accs["b2p"]], "LearningRate": [lr_arr]},
-                coeff0, self._ctx)
-            p._value = out["ParamOut"][0]
-            accs["m1"], accs["m2"] = out["Moment1Out"][0], out["Moment2Out"][0]
-            accs["b1p"], accs["b2p"] = out["Beta1PowOut"][0], out["Beta2PowOut"][0]
-            self._weight_decay = saved
+            # this param opts out of decay: same adamw op, coeff 0
+            super()._apply_one(p, g, lr_arr,
+                               attrs={**super()._attrs(), "coeff": 0.0})
             return
-        super()._apply_one(p, g, lr_arr)
+        super()._apply_one(p, g, lr_arr, attrs=attrs)
 
 
 class Adagrad(_EagerOptimizer):
     def __init__(self, learning_rate, epsilon=1e-6, parameters=None, **kw):
-        super().__init__(learning_rate, parameters)
+        super().__init__(learning_rate, parameters,
+                         multi_precision=kw.get("multi_precision", False))
         self._eps = epsilon
 
     def _apply_one(self, p, g, lr_arr):
@@ -212,7 +249,8 @@ class Adagrad(_EagerOptimizer):
 class RMSProp(_EagerOptimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
                  centered=False, parameters=None, **kw):
-        super().__init__(learning_rate, parameters)
+        super().__init__(learning_rate, parameters,
+                         multi_precision=kw.get("multi_precision", False))
         self._rho, self._eps = rho, epsilon
         self._mu, self._centered = momentum, centered
 
@@ -238,7 +276,8 @@ class Lamb(Adam):
 
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
                  beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, **kw):
-        super().__init__(learning_rate, beta1, beta2, epsilon, parameters)
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         multi_precision=kw.get("multi_precision", False))
         self._wd = lamb_weight_decay
 
     def _attrs(self):
@@ -258,7 +297,8 @@ class Adadelta(_EagerOptimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay,
-                         grad_clip)
+                         grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._epsilon, self._rho = epsilon, rho
 
     def _apply_one(self, p, g, lr_arr=None):
@@ -283,7 +323,8 @@ class Adamax(_EagerOptimizer):
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, **kw):
         super().__init__(learning_rate, parameters, weight_decay,
-                         grad_clip)
+                         grad_clip,
+                         multi_precision=kw.get("multi_precision", False))
         self._b1, self._b2, self._eps = beta1, beta2, epsilon
 
     def _apply_one(self, p, g, lr_arr):

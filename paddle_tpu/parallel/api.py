@@ -53,8 +53,20 @@ def wrap_with_mesh(fn, mesh: Mesh, program, batch_axis: str = "dp",
     return wrapped
 
 
+def compat_shard_map(fn, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: new jax exports it at top level
+    with the `check_vma` switch; 0.4.x only has
+    jax.experimental.shard_map with the same switch named `check_rep`."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_old
+    return sm_old(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+
+
 def shard_map_step(fn, mesh: Mesh, in_specs, out_specs):
     """Explicit-mode: shard_map with collective ops live on their axes."""
-    from jax import shard_map
-    return jax.jit(shard_map(fn, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False))
+    return jax.jit(compat_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                    out_specs=out_specs, check_vma=False))

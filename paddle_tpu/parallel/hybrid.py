@@ -315,8 +315,8 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig):
     pspecs = {n: s[1] for n, s in schema.items()}
     data_spec = P("dp", "sp")
     opt_spec = (pspecs, pspecs, P())
-    from jax import shard_map
-    sharded = shard_map(
+    from .api import compat_shard_map
+    sharded = compat_shard_map(
         local_step, mesh=mesh,
         in_specs=(pspecs, opt_spec, data_spec, data_spec),
         out_specs=(pspecs, opt_spec, P()),

@@ -244,7 +244,7 @@ def build_pipeline_step(block, plan: BlockPlan, mesh, microbatches: int,
     """
     from ..fluid.executor import run_block_ops
     from ..ops.registry import LoweringContext
-    from jax import shard_map
+    from .api import compat_shard_map
 
     if "pp" not in mesh.axis_names:
         raise ValueError("pipeline mesh needs a 'pp' axis")
@@ -385,7 +385,7 @@ def build_pipeline_step(block, plan: BlockPlan, mesh, microbatches: int,
 
     from jax.sharding import PartitionSpec as P
     repl = P()
-    sharded = shard_map(device_fn, mesh=mesh,
-                        in_specs=(repl, repl, repl, repl),
-                        out_specs=(repl, repl), check_vma=False)
+    sharded = compat_shard_map(device_fn, mesh=mesh,
+                               in_specs=(repl, repl, repl, repl),
+                               out_specs=(repl, repl), check_vma=False)
     return jax.jit(sharded)

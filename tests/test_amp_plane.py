@@ -1,0 +1,641 @@
+"""bf16 mixed precision as a compiler plane (ISSUE 5): the amp_bf16 +
+prune_redundant_casts passes, fp32 master weights, GradScaler bf16
+degrade, the dygraph auto_cast contract, the registry audit, and the
+end-to-end parity harness (mlp + conv+bn + ctr-embedding, mirroring
+test_pass_pipeline_e2e.py) — bf16 loss tracks fp32 within tolerance over
+>= 10 steps, master-weight updates are bit-stable, and cast-pruning never
+changes fetches."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import trace
+from paddle_tpu.fluid.framework import reset_unique_name
+
+STEPS = 10
+
+
+# ---------------------------------------------------------------------------
+# demo programs (the test_pass_pipeline_e2e trio)
+# ---------------------------------------------------------------------------
+
+def _mlp(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 16])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        h = fluid.layers.fc(h, 32, act="relu")
+        h = fluid.layers.fc(h, 16, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    feeds = [{"x": rng.randn(8, 16).astype("float32"),
+              "y": rng.randint(0, 10, (8, 1)).astype("int64")}
+             for _ in range(STEPS)]
+    return main, startup, [loss.name], feeds
+
+
+def _conv_bn(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 3, 8, 8])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        c = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        c = fluid.layers.batch_norm(c, act="relu")
+        f = fluid.layers.reshape(c, [-1, 8 * 8 * 8])
+        h = fluid.layers.fc(f, 16, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    feeds = [{"x": rng.randn(4, 3, 8, 8).astype("float32"),
+              "y": rng.randint(0, 10, (4, 1)).astype("int64")}
+             for _ in range(STEPS)]
+    return main, startup, [loss.name], feeds
+
+
+def _ctr_embedding(rng):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [-1, 4], dtype="int64")
+        dense = fluid.data("dense", [-1, 8])
+        label = fluid.data("label", [-1, 1])
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        flat = fluid.layers.reshape(emb, [-1, 4 * 8])
+        feat = fluid.layers.concat([flat, dense], axis=1)
+        h = fluid.layers.fc(feat, 32, act="relu")
+        h = fluid.layers.fc(h, 16, act="relu")
+        logit = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    feeds = [{"ids": rng.randint(0, 50, (8, 4)).astype("int64"),
+              "dense": rng.randn(8, 8).astype("float32"),
+              "label": rng.randint(0, 2, (8, 1)).astype("float32")}
+             for _ in range(STEPS)]
+    return main, startup, [loss.name], feeds
+
+
+_run_memo = {}
+
+
+def _run(build, amp, prune_casts=True):
+    # deterministic (fixed seed feeds), so one (build, amp, prune) combo
+    # is computed once per session — the parity/pruning/counter tests
+    # share results instead of recompiling the same programs
+    key = (build.__name__, amp, prune_casts)
+    if key in _run_memo:
+        return _run_memo[key]
+    reset_unique_name()
+    rng = np.random.RandomState(7)
+    main, startup, fetch, feeds = build(rng)
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        prog = main
+        if amp:
+            bs = fluid.BuildStrategy()
+            bs.amp = True
+            bs.prune_redundant_casts = prune_casts
+            prog = fluid.CompiledProgram(main, build_strategy=bs)
+        outs = [np.asarray(exe.run(prog, feed=f, fetch_list=fetch)[0],
+                           np.float32)
+                for f in feeds]
+    _run_memo[key] = (outs, main)
+    return _run_memo[key]
+
+
+# ---------------------------------------------------------------------------
+# e2e parity harness
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("build", [_mlp, _conv_bn, _ctr_embedding],
+                         ids=["mlp", "conv_bn", "ctr_embedding"])
+def test_bf16_loss_tracks_fp32(build):
+    ref, _ = _run(build, amp=False)
+    got, prog = _run(build, amp=True)
+    # bf16 has ~3 significant decimal digits; over 10 SGD steps the demo
+    # losses must track the fp32 trajectory, not drift
+    for i, (a, b) in enumerate(zip(ref, got)):
+        assert np.allclose(a, b, rtol=0.05, atol=0.05), (i, a, b)
+    # the rewrite actually happened: program flagged + bf16 in the IR
+    assert prog._amp_enabled and prog._amp_dtype == "bfloat16"
+    dts = {v.dtype for v in prog.global_block().vars.values()}
+    assert "bfloat16" in dts
+
+
+@pytest.mark.parametrize("build", [_mlp, _conv_bn, _ctr_embedding],
+                         ids=["mlp", "conv_bn", "ctr_embedding"])
+def test_cast_pruning_never_changes_fetches(build):
+    """Every prune rule is value-exact, so pruned and unpruned bf16 runs
+    must be BIT-identical — not merely close."""
+    a, _ = _run(build, amp=True, prune_casts=True)
+    b, _ = _run(build, amp=True, prune_casts=False)
+    for i, (x, y) in enumerate(zip(a, b)):
+        assert np.array_equal(x, y), (i, x, y)
+
+
+def test_prune_folds_casts_out_of_the_op_stream():
+    _, prog = _run(_mlp, amp=True, prune_casts=True)
+    block = prog.global_block()
+    assert sum(1 for op in block.ops if op.type == "cast") == 0
+    assert any("__amp_cast__" in op.attrs for op in block.ops)
+    _, prog2 = _run(_mlp, amp=True, prune_casts=False)
+    assert sum(1 for op in prog2.global_block().ops
+               if op.type == "cast") > 0
+
+
+def test_amp_counters_and_dtype_histogram():
+    from paddle_tpu.fluid.passes import PassPipeline, create_pass
+    m = trace.metrics()
+    c0 = m.counter("amp.ops_cast").value
+    p0 = m.counter("amp.casts_pruned").value
+    reset_unique_name()
+    main, startup, fetch, _ = _mlp(np.random.RandomState(7))
+    PassPipeline([create_pass("amp_bf16"),
+                  create_pass("prune_redundant_casts")]).apply(
+        main, targets=fetch)
+    inserted = m.counter("amp.ops_cast").value - c0
+    pruned = m.counter("amp.casts_pruned").value - p0
+    assert inserted > 0
+    assert pruned >= 0.5 * inserted
+    hist = {n: m.gauge(n).value for n in m.names()
+            if n.startswith("amp.dtype_hist.")}
+    assert hist.get("amp.dtype_hist.bfloat16", 0) > 0
+    assert hist.get("amp.dtype_hist.float32", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# registry audit (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+class TestRegistryAudit:
+    def test_every_family_op_classified(self):
+        from paddle_tpu.amp.lists import unclassified_family_ops
+        assert unclassified_family_ops() == [], \
+            "matmul/conv-family ops missing from amp/lists.py"
+
+    def test_classify(self):
+        from paddle_tpu.amp import lists
+        assert lists.classify("matmul") == "white"
+        assert lists.classify("softmax") == "black"
+        assert lists.classify("attention_lstm") == "fp32"
+        assert lists.classify("relu") == "gray"
+        assert lists.classify("some_future_matmul_v9") == "unclassified"
+
+    def test_unclassified_family_op_runs_fp32_with_warning(self, capsys):
+        """A family op nobody classified: inputs stay fp32 (no bf16
+        downcast), one amp.unclassified_ops bump, one stderr warning."""
+        from paddle_tpu.fluid.passes import PassPipeline, create_pass
+        from paddle_tpu.ops.registry import register_op, _OP_REGISTRY
+        name = "test_only_matmul_variant"
+        register_op(name, lambda ins, attrs, ctx:
+                    {"Out": [ins["X"][0]]}, differentiable=False)
+        try:
+            p = fluid.Program()
+            b = p.global_block()
+            b.create_var(name="x", shape=[4, 4], dtype="float32")
+            b.append_op(name, {"X": ["x"]}, {"Out": ["y"]}, {})
+            m0 = trace.metrics().counter("amp.unclassified_ops").value
+            PassPipeline([create_pass("amp_bf16")]).apply(p)
+            assert trace.metrics().counter("amp.unclassified_ops").value \
+                == m0 + 1
+            ops = p.global_block().ops
+            assert [op.type for op in ops] == [name]    # no casts at all
+            assert "WARNING" in capsys.readouterr().err
+        finally:
+            # the registry is process-global: leaking the test op would
+            # fail test_op_grads_auto's full-registry accounting sweep
+            _OP_REGISTRY.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# GradScaler degrade + dygraph auto_cast (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+class TestGradScalerDegrade:
+    def test_bf16_identity(self):
+        from paddle_tpu.amp import GradScaler
+        s = GradScaler(enable=True, init_loss_scaling=2.**15,
+                       dtype="bfloat16")
+        assert not s.is_enable()
+        assert s.get_scale() == 1.0
+        loss = jnp.asarray(3.0)
+        assert float(s.scale(loss)) == 3.0              # identity scale
+
+    def test_fp16_machinery_active(self):
+        from paddle_tpu.amp import GradScaler
+        s = GradScaler(enable=True, init_loss_scaling=8.0,
+                       dtype="float16")
+        assert s.is_enable()
+        assert float(s.scale(jnp.asarray(2.0))) == 16.0
+
+    def test_auto_detect_follows_autocast_dtype(self):
+        from paddle_tpu.amp import GradScaler, auto_cast
+        from paddle_tpu.dygraph import base as dybase
+        dybase.enable_dygraph()
+        try:
+            s = GradScaler(enable=True)                 # dtype="auto"
+            assert not s.is_enable()                    # no fp16 ambient
+            with auto_cast(enable=True, dtype="float16"):
+                assert s.is_enable()
+            # LATCHED: once an fp16 context was seen, the machinery stays
+            # active outside it (scale inside / step outside is the
+            # canonical pattern)
+            assert s.is_enable()
+            # a scaler that only ever sees bf16 contexts stays identity
+            s2 = GradScaler(enable=True)
+            with auto_cast(enable=True, dtype="bfloat16"):
+                assert not s2.is_enable()
+            assert not s2.is_enable()
+        finally:
+            dybase.disable_dygraph()
+
+    def test_bf16_step_updates_without_finite_scan(self):
+        """Identity path: step() applies the optimizer directly — the
+        update lands even though unscale_ never ran."""
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.base import VarBase
+        dybase.enable_dygraph()
+        try:
+            p = VarBase(jnp.ones((4,), jnp.float32))
+            p.trainable = True
+            p._grad = jnp.ones((4,), jnp.float32)
+            o = opt.SGD(0.5, parameters=[p])
+            s = GradScaler(enable=True, dtype="bfloat16")
+            s.step(o)
+            np.testing.assert_allclose(np.asarray(p._value), 0.5)
+        finally:
+            dybase.disable_dygraph()
+
+
+def test_auto_cast_changes_matmul_compute_dtype():
+    """ISSUE 5 satellite: the dygraph auto_cast context must actually
+    flip matmul compute to bf16 — and back when it exits."""
+    from paddle_tpu.amp import auto_cast
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.base import to_variable
+    import paddle_tpu.fluid.layers as L
+    dybase.enable_dygraph()
+    try:
+        rng = np.random.RandomState(0)
+        x = to_variable(rng.randn(4, 8).astype("float32"))
+        w = to_variable(rng.randn(8, 8).astype("float32"))
+        with auto_cast(enable=True):
+            y16 = L.matmul(x, w)
+        y32 = L.matmul(x, w)
+        assert y16._value.dtype == jnp.bfloat16
+        assert y32._value.dtype == jnp.float32
+        np.testing.assert_allclose(
+            np.asarray(y16._value, np.float32), np.asarray(y32._value),
+            rtol=0.05, atol=0.05)
+    finally:
+        dybase.disable_dygraph()
+
+
+# ---------------------------------------------------------------------------
+# fp32 master weights (tentpole part 2)
+# ---------------------------------------------------------------------------
+
+class TestMasterWeights:
+    def _eager_run(self, n_steps=50, lr=1e-3, multi_precision=True):
+        from paddle_tpu import optimizer as opt
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.dygraph.base import VarBase
+        dybase.enable_dygraph()
+        try:
+            p = VarBase(jnp.ones((4,), jnp.bfloat16))
+            p.trainable = True
+            o = opt.SGD(lr, parameters=[p],
+                        multi_precision=multi_precision)
+            for _ in range(n_steps):
+                p._grad = jnp.full((4,), 1.0, jnp.bfloat16)
+                o.step()
+            master = o._accum.get(id(p), {}).get("master")
+            return np.asarray(p._value, np.float32), \
+                (np.asarray(master) if master is not None else None)
+        finally:
+            dybase.disable_dygraph()
+
+    def test_eager_master_tracks_fp32_reference(self):
+        """Updates smaller than a bf16 ulp: 50 steps of lr*g = 1e-3 from
+        p=1.0 must integrate to 0.95 on the fp32 master."""
+        view, master = self._eager_run()
+        assert master is not None and master.dtype == np.float32
+        np.testing.assert_allclose(master, 0.95, atol=1e-4)
+        # the bf16 view is the master rounded, not an independent value
+        np.testing.assert_allclose(view, master, atol=0.004)
+
+    def test_eager_master_updates_bit_stable(self):
+        _, m1 = self._eager_run()
+        _, m2 = self._eager_run()
+        assert np.array_equal(m1, m2)
+
+    def _static_run(self, opt_cls, **opt_kw):
+        reset_unique_name()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            gb = main.global_block()
+            gb.create_parameter("W_lo", [4, 4], dtype="bfloat16")
+            sb = startup.global_block()
+            sb.create_var(name="W_lo", shape=[4, 4], dtype="bfloat16",
+                          persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": ["W_lo"]},
+                         attrs={"shape": [4, 4], "dtype": "bfloat16",
+                                "value": 1.0})
+            h = fluid.layers.matmul(x, gb.vars["W_lo"])
+            loss = fluid.layers.mean(h)
+            opt_cls(1e-3, multi_precision=True, **opt_kw).minimize(loss)
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.core.Scope()):
+            exe.run(startup)
+            scope = fluid.global_scope()
+            masters = [n for n in main.global_block().vars
+                       if "master_weight" in n]
+            assert len(masters) == 1, masters
+            for _ in range(STEPS):
+                exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[loss])
+            m = np.asarray(scope.find_var(masters[0]))
+            w = np.asarray(scope.find_var("W_lo"), np.float32)
+        return m, w
+
+    @pytest.mark.parametrize("opt_name", ["sgd", "momentum", "adam",
+                                          "adamw", "lamb"])
+    def test_static_master_weight_updates(self, opt_name):
+        cls = {"sgd": fluid.optimizer.SGDOptimizer,
+               "momentum": fluid.optimizer.MomentumOptimizer,
+               "adam": fluid.optimizer.AdamOptimizer,
+               "adamw": fluid.optimizer.AdamWOptimizer,
+               "lamb": fluid.optimizer.LambOptimizer}[opt_name]
+        m, w = self._static_run(cls)
+        assert m.dtype == np.float32
+        assert np.all(m < 1.0)                 # the update landed
+        # the bf16 scope param is the master's rounded view
+        np.testing.assert_allclose(w, m, atol=0.004)
+
+    def test_static_master_bit_stable(self):
+        m1, _ = self._static_run(fluid.optimizer.MomentumOptimizer)
+        m2, _ = self._static_run(fluid.optimizer.MomentumOptimizer)
+        assert np.array_equal(m1, m2)
+
+    def test_master_survives_small_updates_plain_bf16_loses(self):
+        """The reason master weights exist: with the param AT 1.0 and
+        per-step deltas below the bf16 ulp, a pure-bf16 param cannot
+        move while the master integrates every step."""
+        view, master = self._eager_run(n_steps=3, lr=1e-4)
+        assert master is not None
+        # 3 * 1e-4 accumulated exactly in fp32...
+        np.testing.assert_allclose(master, 1.0 - 3e-4, atol=1e-6)
+        # ...while each delta alone is far below bf16 resolution at 1.0
+        assert np.all(view == np.float32(1.0))
+
+
+# ---------------------------------------------------------------------------
+# decorate() (contrib API) through the pass plane
+# ---------------------------------------------------------------------------
+
+def test_decorate_routes_through_passes():
+    from paddle_tpu.amp import decorate
+    m = trace.metrics()
+    c0 = m.counter("amp.ops_cast").value
+    reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8])
+        h = fluid.layers.fc(x, 8)
+        loss = fluid.layers.mean(h)
+        opt = decorate(fluid.optimizer.SGDOptimizer(0.1))
+        opt.minimize(loss)
+    assert m.counter("amp.ops_cast").value > c0
+    assert main._amp_enabled and main._hints.get("amp_dtype") == "bfloat16"
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        lv, = exe.run(main, feed={"x": np.ones((4, 8), "float32")},
+                      fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv, np.float32)).all()
+
+
+def test_hapi_prepare_amp_level_static():
+    """Model.prepare(amp_level="O1") routes the static train program
+    through the AMP plane and the fit loss still falls."""
+    import paddle_tpu as paddle
+    from paddle_tpu import hapi
+    reset_unique_name()
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    model = hapi.Model(net, inputs=[hapi.Input([-1, 8], name="x")],
+                       labels=[hapi.Input([-1, 4], name="y")])
+    model.prepare(optimizer=fluid.optimizer.SGDOptimizer(0.05),
+                  loss=paddle.nn.functional.mse_loss, amp_level="O1")
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype("float32")
+    ys = np.zeros((32, 4), "float32")
+    hist = model.fit([(x, y) for x, y in zip(xs, ys)], batch_size=8,
+                     epochs=2, verbose=0)
+    entry = model._adapter._progs["train"]
+    assert entry["prog"]._amp_enabled
+    assert any(v.dtype == "bfloat16"
+               for v in entry["prog"].global_block().vars.values())
+    assert hist[1]["loss"] < hist[0]["loss"]
+    with pytest.raises(ValueError):
+        model.prepare(optimizer=fluid.optimizer.SGDOptimizer(0.05),
+                      loss=paddle.nn.functional.mse_loss, amp_level="O7")
+
+
+# ---------------------------------------------------------------------------
+# review regressions
+# ---------------------------------------------------------------------------
+
+def test_grad_scaler_auto_latches_across_context_exit():
+    """The canonical fp16 pattern scales INSIDE auto_cast but steps
+    OUTSIDE it — the auto-detected scaler must stay active after the
+    context exits (or the optimizer steps on 2^15-scaled grads with no
+    finite check)."""
+    from paddle_tpu.amp import GradScaler, auto_cast
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.base import VarBase
+    dybase.enable_dygraph()
+    try:
+        s = GradScaler(enable=True, init_loss_scaling=8.0)
+        with auto_cast(enable=True, dtype="float16"):
+            scaled = s.scale(jnp.asarray(2.0))
+        assert float(scaled) == 16.0
+        assert s.is_enable()                    # latched past the exit
+        p = VarBase(jnp.ones((2,), jnp.float32))
+        p.trainable = True
+        p._grad = jnp.full((2,), 8.0, jnp.float32)   # pre-unscale grads
+        o = opt.SGD(0.5, parameters=[p])
+        s.step(o)                               # outside the context
+        # unscale_ ran: effective grad 1.0 -> p = 1 - 0.5
+        np.testing.assert_allclose(np.asarray(p._value), 0.5)
+    finally:
+        dybase.disable_dygraph()
+
+
+def test_decorate_grads_follow_bf16_forward():
+    """decorate() must leave the grad halves consistent with the bf16
+    forward: the generic_grad over a white op sees bf16 inputs (either a
+    live cast var or a folded __amp_cast__ on the grad op itself)."""
+    from paddle_tpu.amp import decorate
+    reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8])
+        h = fluid.layers.fc(x, 8)
+        loss = fluid.layers.mean(h)
+        decorate(fluid.optimizer.SGDOptimizer(0.1)).minimize(loss)
+    grads = [op for op in main.global_block().ops
+             if op.type == "generic_grad" and op.attrs["fwd_type"] == "mul"]
+    assert grads, "no grad over the white mul op"
+    g = grads[0]
+    amp = g.attrs.get("__amp_cast__", {})
+    blk = main.global_block()
+
+    def sees_bf16(slot, j):
+        if (amp.get(slot) or [None] * 9)[j] == "bfloat16":
+            return True
+        v = blk._find_var_recursive(g.inputs[slot][j])
+        return v is not None and v.dtype == "bfloat16"
+
+    assert sees_bf16("I_X", 0) and sees_bf16("I_Y", 0), \
+        (g.inputs, amp)
+    # and training still works end to end
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        feed = {"x": np.ones((4, 8), "float32")}
+        l0, = exe.run(main, feed=feed, fetch_list=[loss])
+        for _ in range(5):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv, np.float32)).all()
+
+
+def test_prune_respects_inplace_rewrites_of_cast_source():
+    """An identity cast whose SOURCE is overwritten in place between the
+    cast and a consumer must survive — rewiring the consumer to the
+    source would read the overwritten value (review finding repro:
+    fetch flipped 2.0 -> 5.0)."""
+    from paddle_tpu.fluid.passes import PassPipeline, create_pass
+    p = fluid.Program()
+    b = p.global_block()
+    b.create_var(name="x", shape=[3], dtype="float32")
+    b.append_op("scale", {"X": ["x"]}, {"Out": ["a"]}, {"scale": 2.0})
+    # identity cast of a (f32 -> f32), amp-marked so every rule sees it
+    b.append_op("cast", {"X": ["a"]}, {"Out": ["y"]},
+                {"out_dtype": "float32", "amp_inserted": True})
+    b.append_op("scale", {"X": ["x"]}, {"Out": ["a"]}, {"scale": 5.0})
+    b.append_op("scale", {"X": ["y"]}, {"Out": ["z"]}, {"scale": 1.0})
+    PassPipeline([create_pass("prune_redundant_casts")]).apply(
+        p, targets=["z"])
+    exe = fluid.Executor()
+    with fluid.scope_guard(fluid.core.Scope()):
+        out, = exe.run(p, feed={"x": np.ones(3, "float32")},
+                       fetch_list=["z"])
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_hapi_dygraph_eval_batch_under_amp():
+    """prepare(amp_level='O1') wraps dygraph EVAL batches in auto_cast
+    too — eval must not silently run different numerics than train."""
+    import paddle_tpu as paddle
+    from paddle_tpu import hapi
+    from paddle_tpu.dygraph import base as dybase
+    dybase.enable_dygraph()
+    try:
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+        m = hapi.Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+                      0.05, parameters=net.parameters()),
+                  loss=paddle.nn.functional.mse_loss, amp_level="O1")
+        seen = {}
+        orig_fwd = net.forward
+
+        def spy(*a, **kw):
+            out = orig_fwd(*a, **kw)
+            seen["dtype"] = out._value.dtype
+            return out
+
+        net.forward = spy
+        xs = np.ones((4, 8), "float32")
+        ys = np.zeros((4, 4), "float32")
+        m.eval_batch([xs], [ys])
+        assert seen["dtype"] == jnp.bfloat16, seen
+    finally:
+        dybase.disable_dygraph()
+
+
+def test_classify_custom_lists_extend_defaults():
+    from paddle_tpu.amp import lists
+    assert lists.classify("matmul", white={"my_op"}) == "white"
+    assert lists.classify("my_op", white={"my_op"}) == "white"
+    assert lists.classify("matmul", black={"matmul"}) == "black"
+
+
+def test_classify_custom_white_overrides_default_black():
+    """Reference fp16_lists semantics: custom lists WIN over the
+    defaults — custom_white_list moves an op out of the default black
+    list (a silent no-op otherwise), and custom black still wins
+    custom-white overlaps."""
+    from paddle_tpu.amp import lists
+    assert lists.classify("softmax", white={"softmax"}) == "white"
+    assert lists.classify("softmax", white={"softmax"},
+                          black={"softmax"}) == "black"
+    # same through the static_amp CustomOpLists path: the rewrite must
+    # hand the pass the custom DELTAS, not the unioned black list
+    from paddle_tpu.amp.static_amp import CustomOpLists, \
+        rewrite_program_bf16
+    reset_unique_name()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.data("x", [4, 8])
+        sm = fluid.layers.softmax(x)
+    rewrite_program_bf16(prog, CustomOpLists(custom_white_list=["softmax"]),
+                         targets=[sm.name], prune_casts=False)
+    ops = prog.global_block().ops
+    sm_op = next(op for op in ops if op.type == "softmax")
+    cast_outs = {op.outputs["Out"][0]: op.attrs.get("out_dtype")
+                 for op in ops if op.type == "cast"}
+    in_dts = [cast_outs.get(n) for n in sm_op.input_arg_names]
+    assert "bfloat16" in in_dts, \
+        "custom-whitelisted softmax did not get a bf16 input cast"
+
+
+def test_multi_precision_lamb_and_unsupported_raise():
+    """multi_precision on Lamb wires real master weights (it used to be
+    half-applied: fp32 moments, no master), and optimizers without a
+    master-weight path reject the flag instead of silently ignoring it."""
+    from paddle_tpu import optimizer as opt
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.dygraph.base import VarBase
+
+    with pytest.raises(NotImplementedError):
+        fluid.optimizer.AdagradOptimizer(1e-3, multi_precision=True)
+    with pytest.raises(NotImplementedError):
+        opt.RMSProp(1e-3, multi_precision=True)
+    with pytest.raises(NotImplementedError):
+        opt.Adamax(1e-3, multi_precision=True)
+
+    dybase.enable_dygraph()
+    try:
+        p = VarBase(jnp.ones((4,), jnp.bfloat16))
+        p.trainable = True
+        o = opt.Lamb(1e-3, parameters=[p], multi_precision=True)
+        for _ in range(3):
+            p._grad = jnp.full((4,), 1.0, jnp.bfloat16)
+            o.step()
+        master = o._accum.get(id(p), {}).get("master")
+        assert master is not None and master.dtype == jnp.float32
+        assert np.all(np.asarray(master) < 1.0)          # update landed
+        np.testing.assert_allclose(np.asarray(p._value, np.float32),
+                                   np.asarray(master), atol=0.004)
+    finally:
+        dybase.disable_dygraph()

@@ -20,7 +20,7 @@ def dp_mesh():
 
 class TestInTrace:
     def test_all_reduce_inside_shard_map(self, dp_mesh):
-        from jax import shard_map
+        from paddle_tpu.parallel.api import compat_shard_map as shard_map
 
         def body(x):
             return dist.all_reduce(x, op=dist.ReduceOp.SUM, group=0)
@@ -35,7 +35,7 @@ class TestInTrace:
         np.testing.assert_allclose(np.asarray(out), expect)
 
     def test_all_gather_and_broadcast(self, dp_mesh):
-        from jax import shard_map
+        from paddle_tpu.parallel.api import compat_shard_map as shard_map
 
         def body(x):
             lst = []
@@ -52,7 +52,7 @@ class TestInTrace:
                                    [2.0] * 4)   # src shard value everywhere
 
     def test_max_reduce(self, dp_mesh):
-        from jax import shard_map
+        from paddle_tpu.parallel.api import compat_shard_map as shard_map
 
         def body(x):
             return dist.all_reduce(x, op=dist.ReduceOp.MAX, group=0)
@@ -81,7 +81,7 @@ class TestEagerSingleProcess:
 
 class TestScatter:
     def test_scatter_in_trace_each_shard_gets_own_slice(self, dp_mesh):
-        from jax import shard_map
+        from paddle_tpu.parallel.api import compat_shard_map as shard_map
 
         parts = [jnp.full((2,), float(i)) for i in range(4)]
 

@@ -3,6 +3,9 @@
 processes, fleet.init runs jax.distributed.initialize (the gen_nccl_id
 rendezvous), dygraph DataParallel allreduces grads across processes, and
 the loss/params must match single-process full-batch training."""
+import pytest
+pytestmark = pytest.mark.slow
+
 import json
 import os
 import socket

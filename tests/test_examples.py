@@ -6,6 +6,8 @@ import subprocess
 import sys
 
 import pytest
+pytestmark = pytest.mark.slow
+
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = ["mnist_static.py", "bert_dygraph.py", "ctr_boxps.py",

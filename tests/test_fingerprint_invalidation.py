@@ -146,3 +146,56 @@ def test_executor_recompiles_after_set_attr():
     out2, = exe.run(main, feed=feed, fetch_list=[y])
     assert np.allclose(out1, 2.0)
     assert np.allclose(out2, 10.0), "stale executable served after set_attr"
+
+
+def test_amp_rewrite_invalidates_fingerprint():
+    """ISSUE 5 satellite: the AMP rewrite must ride the version-bumping
+    mutators — the old raw block.append_op + block.ops.pop() path kept
+    ``_version`` stale, letting the executor serve a PRE-rewrite compiled
+    step (fp32 numerics after the user asked for bf16)."""
+    from paddle_tpu.amp import rewrite_program_bf16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4])
+        h = fluid.layers.fc(x, 8)
+        out = fluid.layers.reduce_sum(h)
+    f0 = _fingerprint(main)
+    v0 = main._version
+    rewrite_program_bf16(main, targets=[out.name])
+    assert main._version > v0
+    assert _fingerprint(main) != f0
+
+
+def test_executor_recompiles_after_amp_rewrite():
+    """End to end: a warm executor cache must recompile after the AMP
+    passes run — the fetched value must come back bf16, not the stale
+    fp32 executable's output."""
+    from paddle_tpu.amp import rewrite_program_bf16
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 4])
+        h = fluid.layers.fc(x, 8)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 4), "float32")}
+    with fluid.scope_guard(fluid.core.Scope()):
+        exe.run(startup)
+        out1, = exe.run(main, feed=feed, fetch_list=[h])
+        assert np.asarray(out1).dtype == np.float32
+        rewrite_program_bf16(main, targets=[h.name])
+        out2, = exe.run(main, feed=feed, fetch_list=[h])
+        assert str(np.asarray(out2).dtype) == "bfloat16", \
+            "stale fp32 executable served after the AMP rewrite"
+        np.testing.assert_allclose(np.asarray(out2, np.float32),
+                                   np.asarray(out1), rtol=0.05, atol=0.05)
+
+
+def test_var_dtype_rides_the_fingerprint():
+    """Dtype-aware fingerprints (ISSUE 5): two programs with an identical
+    op stream but different var dtypes must not share a digest."""
+    def build(dtype):
+        p = Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[4], dtype=dtype)
+        b.append_op("scale", {"X": ["x"]}, {"Out": ["y"]}, {"scale": 2.0})
+        return p
+    assert _fingerprint(build("float32")) != _fingerprint(build("bfloat16"))

@@ -11,6 +11,8 @@ import functools
 
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow
+
 
 import jax
 import jax.numpy as jnp

@@ -9,9 +9,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 import pytest
+pytestmark = pytest.mark.slow
+
 
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from paddle_tpu.parallel.api import compat_shard_map as shard_map
 
 from paddle_tpu.parallel.hybrid import (TransformerConfig, build_hybrid_mesh,
                                         make_train_step, demo_batch,

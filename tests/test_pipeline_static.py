@@ -114,7 +114,7 @@ class TestStaticPipeline:
         """Explicit send_v2/recv_v2 pair shifts values around the pp ring."""
         from paddle_tpu.parallel.mesh import build_mesh, RING_PP
         from paddle_tpu.ops.registry import get_op, LoweringContext
-        from jax import shard_map
+        from paddle_tpu.parallel.api import compat_shard_map as shard_map
         from jax.sharding import PartitionSpec as P
 
         mesh = build_mesh({"pp": 2}, devices=jax.devices()[:2])

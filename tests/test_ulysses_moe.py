@@ -5,10 +5,12 @@ import math
 
 import numpy as np
 import pytest
+pytestmark = pytest.mark.slow
+
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.parallel.api import compat_shard_map as shard_map
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.parallel import mesh as pmesh

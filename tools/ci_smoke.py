@@ -295,6 +295,47 @@ def main():
           f"{host_wait/max(async_wall, 1e-9):.0%}, bit-identical OK",
           flush=True)
 
+    step("AMP plane: bf16 compiles once, loss parity, >=50% casts pruned")
+    from paddle_tpu.fluid import trace as tr5
+
+    def run_amp_demo(amp_on, n_steps=5):
+        reset_unique_name()
+        mp, sp, lo = build_demo()
+        ex5 = fluid.Executor()
+        with scope_guard(Scope()):
+            ex5.run(sp)
+            prog = mp
+            if amp_on:
+                bs5 = fluid.BuildStrategy()
+                bs5.amp = True
+                prog = fluid.CompiledProgram(mp, build_strategy=bs5)
+            miss0 = tr5.metrics().counter(
+                "executor.compile_cache_miss").value
+            lvs = [float(np.asarray(ex5.run(prog, feed=demo_feed,
+                                            fetch_list=[lo])[0]).ravel()[0])
+                   for _ in range(n_steps)]
+            misses = tr5.metrics().counter(
+                "executor.compile_cache_miss").value - miss0
+        return lvs, misses
+
+    cast0 = tr5.metrics().counter("amp.ops_cast").value
+    pruned0 = tr5.metrics().counter("amp.casts_pruned").value
+    loss_fp32, _ = run_amp_demo(False)
+    loss_bf16, misses_bf16 = run_amp_demo(True)
+    # one executable for the whole bf16 epoch: the AMP rewrite runs once,
+    # before fingerprinting — per-step recompiles would mean the pass
+    # left the program version churning
+    assert misses_bf16 == 1, f"bf16 demo compiled {misses_bf16}x (want 1)"
+    assert np.allclose(loss_bf16, loss_fp32, rtol=0.05, atol=0.05), \
+        (loss_bf16, loss_fp32)
+    inserted = tr5.metrics().counter("amp.ops_cast").value - cast0
+    pruned = tr5.metrics().counter("amp.casts_pruned").value - pruned0
+    assert inserted > 0, "amp_bf16 inserted no casts on the mlp demo"
+    assert pruned >= 0.5 * inserted, \
+        f"prune_redundant_casts removed {pruned}/{inserted} casts (<50%)"
+    print(f"[smoke]   amp: {inserted} casts inserted, {pruned} pruned "
+          f"({pruned/inserted:.0%}), 1 compile, loss parity OK", flush=True)
+
     step("bench child emits one JSON line (cpu)")
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],

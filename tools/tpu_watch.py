@@ -113,6 +113,19 @@ def _run_child(args, budget, extra_env=None, _retried=False):
                 g = trace.metrics().gauge("watch.inflight_depth")
                 if depth > g.value:
                     g.set(depth)
+            # AMP plane signals (bench reports them since the bf16 plane
+            # landed): best analytic MFU + bf16-vs-fp32 speedup across
+            # the sweep, dtype mix as a sweep-summary line
+            mfu = float(info.get("mfu", 0.0) or 0.0)
+            gm = trace.metrics().gauge("watch.mfu")
+            if mfu > gm.value:
+                gm.set(mfu)
+            spd = float(info.get("amp_speedup", 0.0) or 0.0)
+            gs = trace.metrics().gauge("watch.amp_speedup")
+            if spd > gs.value:
+                gs.set(spd)
+            for dt, n in (info.get("dtype_mix") or {}).items():
+                trace.metrics().gauge(f"watch.dtype_mix.{dt}").set(int(n))
         except (ValueError, TypeError):
             pass
         return True
@@ -224,6 +237,15 @@ def _report_step_timing():
               f"{trace.metrics().counter('watch.compile_misses').value} "
               f"misses, {c['total']:.1f}s total compile across "
               f"{int(c['count'])} children", flush=True)
+    mfu = trace.metrics().gauge("watch.mfu").value
+    if mfu:
+        mix = {n.split("watch.dtype_mix.", 1)[1]:
+               int(trace.metrics().gauge(n).value)
+               for n in trace.metrics().names()
+               if n.startswith("watch.dtype_mix.")}
+        spd = trace.metrics().gauge("watch.amp_speedup").value
+        print(f"[watch] amp plane: best MFU {mfu:.1%}, bf16-vs-fp32 "
+              f"speedup {spd:.2f}x, dtype mix {mix or 'n/a'}", flush=True)
     w = trace.metrics().histogram("watch.host_wait_seconds").stats()
     if w["count"]:
         d = trace.metrics().histogram("watch.dispatch_seconds").stats()
