@@ -7,10 +7,13 @@ from .collective import (ReduceOp, all_gather, all_reduce, barrier,
                          broadcast, get_rank, get_world_size,
                          init_parallel_env, reduce, scatter)
 from . import launch
+from . import elastic
+from .elastic import ElasticContext
 from ..dygraph.parallel import ParallelEnv   # DEFINE_ALIAS
                                              # (reference distributed/__init__.py:23)
 
 __all__ = ["fleet", "DistributedStrategy", "spawn", "collective",
            "ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce",
            "scatter", "barrier", "get_rank", "get_world_size",
-           "init_parallel_env", "launch", "ParallelEnv"]
+           "init_parallel_env", "launch", "ParallelEnv", "elastic",
+           "ElasticContext"]

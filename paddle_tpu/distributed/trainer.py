@@ -29,6 +29,7 @@ class TrainerStats:
         self.produce_s = 0.0        # producer parse+stage time (overlapped)
         self.total_s = 0.0
         self.stage_fallbacks = 0    # batches that failed device staging
+        self.preempted = False      # loop exited via the elastic drain
 
     def as_dict(self):
         return {"steps": self.steps,
@@ -37,7 +38,8 @@ class TrainerStats:
                 "host_wait_s": round(self.host_wait_s, 4),
                 "produce_s": round(self.produce_s, 4),
                 "total_s": round(self.total_s, 4),
-                "stage_fallbacks": self.stage_fallbacks}
+                "stage_fallbacks": self.stage_fallbacks,
+                "preempted": self.preempted}
 
 
 def _enumerate_pass_ids(plan, dataset):
@@ -143,8 +145,22 @@ def train_passes(executor, program, datasets, fetch_list=None,
 
 
 def run_from_dataset(executor, program, dataset, fetch_list=None,
-                     print_period=100, train=True, prefetch=2, _box=None):
+                     print_period=100, train=True, prefetch=2, _box=None,
+                     checkpoint_manager=None, checkpoint_every=0,
+                     start_step=0):
+    """``checkpoint_manager`` + ``checkpoint_every=N``: async snapshot
+    every N steps (off the step window).  The loop also polls the ambient
+    :mod:`paddle_tpu.distributed.elastic` context each step: on
+    preemption it stops consuming, drains the in-flight window so every
+    submitted step completes, takes a final synchronous snapshot with
+    the exact dataset cursor, and returns with ``stats.preempted`` set.
+    ``start_step`` skips batches already trained before a resume (the
+    cursor a restored checkpoint reports) without paying their device
+    staging."""
+    import itertools
+
     from ..utils.prefetch import Prefetcher
+    from . import elastic as _elastic
 
     fetch_list = fetch_list or []
     fetch_names = [f if isinstance(f, str) else f.name for f in fetch_list]
@@ -175,7 +191,13 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
     def on_produce(dt):
         stats.produce_s += dt
 
-    pf = Prefetcher(dataset._iter_batches(), stage=stage,
+    source = dataset._iter_batches()
+    if start_step > 0:
+        # resume fast-forward happens HERE, before the stage callback, so
+        # already-trained batches are parsed-and-dropped on the producer
+        # thread without paying box translation or a device_put each
+        source = itertools.islice(source, int(start_step), None)
+    pf = Prefetcher(source, stage=stage,
                     capacity=max(1, prefetch), on_produce=on_produce)
     # async dispatch window (fluid/async_pipeline.py): submit returns
     # immediately and the runner bounds in-flight steps, so host feed
@@ -193,9 +215,31 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
     hw0 = _hw.stats()["total"]
     t0 = time.perf_counter()
     results = []
-    step = 0
+    step = int(start_step)
+
+    last_snap = [-1]
+
+    def _snapshot(sync, reason):
+        # a scan group buffered in the runner (steps_per_dispatch > 1)
+        # has NOT touched the scope yet — the cursor must count only
+        # dispatched steps, or resume would skip never-trained batches.
+        # Consecutive periodic polls can land on the same dispatched
+        # count; re-saving an identical step is wasted IO, skip it
+        done = step - (runner.pending if runner is not None else 0)
+        if done == last_snap[0]:
+            return
+        last_snap[0] = done
+        checkpoint_manager.save(
+            program=program, executor=executor, step=done,
+            cursor={"dataset_step": done}, sync=sync, reason=reason)
+
     try:
         while True:
+            if _elastic.preemption_requested():
+                # stop consuming; the drain below completes every
+                # submitted step, so `step` is an exact resume cursor
+                stats.preempted = True
+                break
             t_wait = time.perf_counter()
             item = pf.get()
             stats.input_wait_s += time.perf_counter() - t_wait
@@ -217,7 +261,26 @@ def run_from_dataset(executor, program, dataset, fetch_list=None,
                 print(f"[trainer] step {step}: {vals}")
                 results.append(outs)
             step += 1
-        if runner is not None:
+            if checkpoint_manager is not None and checkpoint_every \
+                    and step % int(checkpoint_every) == 0:
+                # async: the snapshot handles ride the alias guard, the
+                # write happens on the manager's background thread
+                _snapshot(sync=False, reason="periodic")
+        if stats.preempted and checkpoint_manager is not None:
+            # the elastic drain plane: close the in-flight window (timed
+            # as elastic::drain / elastic.drain_seconds), flush queued
+            # async saves, final sync snapshot, RESUMABLE marker.  After
+            # the drain every submitted step completed, so `step` is the
+            # exact resume cursor
+            ctx = _elastic.current_context() or _elastic.ElasticContext(
+                checkpoint_manager, install_signal_handlers=False)
+            ctx.drain_and_save(
+                executor=executor,
+                runners=[runner] if runner is not None else [],
+                manager=checkpoint_manager, program=program, step=step,
+                cursor={"dataset_step": step})
+            runner = None
+        elif runner is not None:
             # close the window before the box writeback reads trained
             # rows; also surfaces any buffered dispatch error
             runner.drain()

@@ -27,6 +27,8 @@ from . import clip
 from .layers.tensor import data
 from . import io
 from .io import save_persistables, load_persistables, save_params, load_params
+from . import checkpoint
+from .checkpoint import CheckpointManager
 from . import nets
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import passes

@@ -315,10 +315,15 @@ class AsyncStepRunner:
         # still sitting in _inflight
         self._alias_handles: List[FetchHandle] = []
         self._scan_ok = self.steps_per_dispatch > 1
+        # elastic-runtime accounting (distributed/elastic.py): after a
+        # drain() every submitted step has completed, so `submitted` IS
+        # the exact resume cursor a preemption checkpoint records
+        self.submitted = 0
 
     # -- public -------------------------------------------------------------
     def submit(self, feed: Dict[str, Any]) -> StepFuture:
         fut = StepFuture(self)
+        self.submitted += 1
         self._pending.append((dict(feed or {}), fut))
         if len(self._pending) >= self.steps_per_dispatch:
             self._dispatch_group()
@@ -353,6 +358,7 @@ class AsyncStepRunner:
             "loop aborted — it was never dispatched")
         for _, fut in self._pending:
             fut._set_error(aborted)
+        self.submitted -= len(self._pending)    # never ran: not resumable
         self._pending = []
         while self._inflight:
             try:
@@ -372,6 +378,14 @@ class AsyncStepRunner:
     @property
     def inflight(self) -> int:
         return len(self._inflight)
+
+    @property
+    def pending(self) -> int:
+        """Buffered submits not yet dispatched (a partial scan group).
+        Their updates are NOT in the scope yet, so a point-in-time
+        checkpoint cursor is ``submitted - pending`` until a
+        flush()/drain() empties the buffer."""
+        return len(self._pending)
 
     # -- internals ----------------------------------------------------------
     def _dispatch_feeds(self, feeds: List[Dict[str, Any]]

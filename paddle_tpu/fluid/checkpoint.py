@@ -1,0 +1,771 @@
+"""Fault-tolerant training checkpoints: async snapshots, atomic commit,
+deterministic resume.
+
+Reference: python/paddle/fluid/io.py save_persistables/load_persistables is
+the reference's checkpoint path — a blocking, whole-state save the PS/BoxPS
+trainers call between passes.  On preemptible TPUs that contract is not
+enough: the save must come OFF the step window (an async snapshot while
+the device keeps training), the on-disk artifact must survive a crash at
+ANY byte (atomic commit + checksums), and a restart must resume
+bit-deterministically (params, optimizer accumulators including fp32
+masters, program ``random_seed``, the executor's per-step PRNG counter,
+host RNG streams, and the data-loader cursor).  This module owns all of
+that; `paddle_tpu/distributed/elastic.py` layers the preemption plane
+(SIGTERM drain, resumable marker) on top.
+
+Checkpoint layout (``docs/checkpointing.md`` has the full schema)::
+
+    <root>/
+      ckpt-00000042/
+        manifest.json        # written LAST inside the tmp dir; commit is
+                             # one atomic directory rename
+        shard-00000.npz      # vars grouped up to FLAGS_checkpoint_shard_bytes
+        shard-00001.npz
+      ckpt-00000040/ ...
+      RESUMABLE              # preemption marker (distributed/elastic.py)
+
+Durability protocol: every shard is staged into ``.tmp-ckpt-*`` with
+``write → flush → fsync``; the manifest (carrying a sha256 per shard) is
+written last; the tmp directory is fsynced and committed with one
+``os.rename`` onto the final name, then the parent directory is fsynced.
+A crash before the rename leaves only a tmp dir (ignored + garbage
+collected); a crash after it leaves a fully valid checkpoint.  ``restore``
+re-verifies every checksum and silently falls back to the newest INTACT
+checkpoint when the newest one is torn (counted in
+``ckpt.restore_fallbacks``).
+
+Donation safety (the PR-4 alias-guard path): an async snapshot must not
+host-copy on the training thread, but with ``donate_buffers`` the next
+dispatch donates the very scope buffers the snapshot references.  The
+snapshot therefore wraps each state array in a ``FetchHandle`` with
+``aliases_state=True`` registered on the executor's ``_alias_live`` list —
+any donating dispatch persists them (host copy) first, and the background
+writer's ``device_get`` happens off-thread either way, so the step window
+never blocks on checkpoint IO.
+
+Observability: ``ckpt.saves`` / ``ckpt.restores`` / ``ckpt.bytes`` /
+``ckpt.save_errors`` / ``ckpt.save_retries`` / ``ckpt.restore_fallbacks``
+counters, ``ckpt.save_seconds`` / ``ckpt.restore_seconds`` histograms and
+``checkpoint::save`` / ``checkpoint::restore`` spans on the trace plane.
+"""
+from __future__ import annotations
+
+import hashlib
+import io as _io
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import core
+from . import trace
+
+__all__ = [
+    "CheckpointManager", "CheckpointState", "CheckpointError",
+    "CorruptCheckpointError", "InjectedCrash", "faults",
+    "atomic_write_bytes", "list_checkpoint_steps", "latest_checkpoint_step",
+]
+
+FORMAT_VERSION = 1
+MANIFEST = "manifest.json"
+CKPT_PREFIX = "ckpt-"
+TMP_PREFIX = ".tmp-ckpt-"
+
+
+class CheckpointError(RuntimeError):
+    """Base for checkpoint failures (missing state, exhausted retries)."""
+
+
+class CorruptCheckpointError(CheckpointError):
+    """Every on-disk checkpoint failed validation — nothing to resume."""
+
+
+class InjectedCrash(RuntimeError):
+    """Raised by the fault harness to simulate a process death mid-save.
+    Deliberately NOT an OSError: the retry loop must not absorb it."""
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness (used by tests/ and tools/ci_smoke.py)
+# ---------------------------------------------------------------------------
+
+class FaultInjector:
+    """Process-global switchboard for simulated storage failures.  Kinds:
+
+    - ``io_error``        — ``atomic_write_bytes`` raises a *transient*
+      OSError (consumed per armed count; the save retry loop absorbs it)
+    - ``crash_after_tmp_write`` — raise :class:`InjectedCrash` after the
+      shards are staged but BEFORE the manifest/commit (a death mid-save:
+      no new checkpoint may appear)
+    - ``torn_manifest``   — after commit, truncate the manifest mid-byte
+      (a torn write from a non-atomic writer / bad disk)
+    - ``partial_shard``   — after commit, truncate the first shard
+      (silent data loss the checksums must catch)
+    - ``slow_disk``       — sleep ``delay`` seconds inside every write
+
+    Arm with ``faults.arm(kind, times=1, delay=...)``; each firing
+    consumes one count.  ``faults.clear()`` between tests.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, Dict[str, Any]] = {}
+
+    def arm(self, kind: str, times: int = 1, **kw) -> None:
+        with self._lock:
+            self._armed[kind] = dict(kw, times=int(times))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._armed.clear()
+
+    def fire(self, kind: str) -> Optional[Dict[str, Any]]:
+        """Consume one armed count of ``kind``; None when not armed."""
+        with self._lock:
+            ent = self._armed.get(kind)
+            if not ent or ent["times"] <= 0:
+                return None
+            ent["times"] -= 1
+            if ent["times"] <= 0:
+                self._armed.pop(kind, None)
+            return ent
+
+
+faults = FaultInjector()
+
+
+# ---------------------------------------------------------------------------
+# durable-write primitives
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives power loss.
+    Best-effort on filesystems that refuse O_RDONLY dir fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(path: str, data: bytes, do_fsync: bool = True) -> None:
+    """The commit idiom shared with PR-2's PersistentCache: write to a
+    same-directory tmp file, flush+fsync, then one atomic ``os.replace``.
+    A reader never observes a half-written file; a crash leaves the old
+    content (or nothing) — never a torn new one."""
+    slow = faults.fire("slow_disk")
+    if slow:
+        time.sleep(float(slow.get("delay", 0.05)))
+    if faults.fire("io_error"):
+        raise OSError(f"injected transient IO error writing {path}")
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f".tmp-{os.path.basename(path)}.{os.getpid()}"
+                          f".{threading.get_ident()}")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            if do_fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if do_fsync:
+        _fsync_dir(d)
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# bf16 (and any other dtype numpy's npz format can't round-trip natively)
+# is stored as its same-width unsigned view; the manifest records the true
+# dtype so restore views it back bit-exactly
+_DTYPE_ENCODE = {"bfloat16": "uint16", "float8_e4m3fn": "uint8",
+                 "float8_e5m2": "uint8"}
+
+
+def _encode_array(arr: np.ndarray):
+    dt = str(arr.dtype)
+    enc = _DTYPE_ENCODE.get(dt)
+    if enc is not None:
+        return arr.view(np.dtype(enc)), dt
+    return arr, dt
+
+
+def _decode_array(arr: np.ndarray, true_dtype: str) -> np.ndarray:
+    if str(arr.dtype) != true_dtype and true_dtype in _DTYPE_ENCODE:
+        import ml_dtypes
+        return arr.view(np.dtype(getattr(ml_dtypes, true_dtype)))
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# directory scan helpers
+# ---------------------------------------------------------------------------
+
+def _step_dirname(step: int) -> str:
+    return f"{CKPT_PREFIX}{int(step):08d}"
+
+
+def list_checkpoint_steps(root: str) -> List[int]:
+    """Committed checkpoint steps under ``root`` (unvalidated), ascending."""
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return []
+    out = []
+    for e in entries:
+        if e.startswith(CKPT_PREFIX):
+            try:
+                out.append(int(e[len(CKPT_PREFIX):]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def latest_checkpoint_step(root: str) -> Optional[int]:
+    steps = list_checkpoint_steps(root)
+    return steps[-1] if steps else None
+
+
+# ---------------------------------------------------------------------------
+# snapshot source
+# ---------------------------------------------------------------------------
+
+def _collect_state_names(program, scope) -> List[str]:
+    """Vars a checkpoint covers: the program's persistables that have a
+    value in the scope (params, optimizer accumulators incl. fp32
+    masters, learning-rate var, BN stats), or — with no program — every
+    array-valued var in the scope."""
+    if program is not None:
+        prog = getattr(program, "_program", program)
+        return sorted(
+            v.name for v in prog.global_block().vars.values()
+            if v.persistable and scope.find_var(v.name) is not None)
+    out = []
+    for n in scope.local_var_names():
+        v = scope.find_var(n)
+        if v is not None and hasattr(v, "dtype") and hasattr(v, "shape"):
+            out.append(n)
+    return sorted(out)
+
+
+def _snapshot_handles(names: Sequence[str], scope, executor=None):
+    """Point-in-time references to the scope's device arrays, wrapped as
+    state-aliasing FetchHandles.  With an executor, each handle rides the
+    PR-4 donation alias guard (``Executor._alias_live``): a later dispatch
+    that donates the scope's buffers host-persists these first, so the
+    background writer always reads valid data — and the training thread
+    itself never pays a device_get."""
+    if executor is not None and hasattr(executor, "snapshot_vars"):
+        return executor.snapshot_vars(names, scope=scope)
+    from .async_pipeline import FetchHandle
+    return {n: FetchHandle(scope.find_var(n), name=n, aliases_state=True)
+            for n in names if scope.find_var(n) is not None}
+
+
+class CheckpointState:
+    """What ``restore`` hands back: resume-relevant metadata."""
+
+    def __init__(self, step: int, path: str, manifest: Dict[str, Any]):
+        self.step = int(step)
+        self.path = path
+        self.manifest = manifest
+        self.cursor: Dict[str, Any] = manifest.get("cursor") or {}
+        self.extra: Dict[str, Any] = manifest.get("extra") or {}
+        self.reason: str = manifest.get("reason", "periodic")
+        self.var_names: List[str] = sorted(
+            n for s in manifest.get("shards", []) for n in s.get("vars", {}))
+
+    def __repr__(self):
+        return (f"CheckpointState(step={self.step}, reason={self.reason!r}, "
+                f"vars={len(self.var_names)}, cursor={self.cursor})")
+
+
+class _SaveJob:
+    __slots__ = ("step", "handles", "meta", "done", "error", "sync")
+
+    def __init__(self, step, handles, meta, sync=False):
+        self.step = step
+        self.handles = handles
+        self.meta = meta
+        self.sync = bool(sync)
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
+class CheckpointManager:
+    """Asynchronous, fault-tolerant checkpoint save/restore for one
+    training job.
+
+    ``save()`` snapshots full training state — program persistables
+    (params, optimizer accumulators including fp32 masters), the
+    program's ``random_seed``, the executor's per-step PRNG counter, the
+    host numpy RNG stream, and a caller-supplied loader cursor — and, by
+    default, hands the write to a background thread (one in-flight save;
+    a second ``save`` while one is writing waits for it, bounding
+    memory).  ``sync=True`` (the preemption path) writes inline.
+
+    ``restore()`` validates manifest + per-shard sha256 checksums, falls
+    back to the newest intact checkpoint on corruption, loads every var
+    back into the scope (strict by default: a persistable the program
+    declares but the checkpoint lacks, or a shape/dtype mismatch, raises
+    naming the offenders), and restores the RNG/seed/step-counter plane
+    so the continuation is bit-identical to an uninterrupted run.
+    """
+
+    def __init__(self, root: str, keep_last: Optional[int] = None,
+                 keep_every: Optional[int] = None,
+                 async_save: Optional[bool] = None,
+                 max_retries: int = 3, retry_backoff: float = 0.05,
+                 shard_bytes: Optional[int] = None):
+        self.root = os.path.abspath(str(root))
+        os.makedirs(self.root, exist_ok=True)
+        self.keep_last = int(core.get_flag("checkpoint_keep_last", 3)
+                             if keep_last is None else keep_last)
+        self.keep_every = int(core.get_flag("checkpoint_keep_every", 0)
+                              if keep_every is None else (keep_every or 0))
+        self.async_save = bool(core.get_flag("checkpoint_async", True)
+                               if async_save is None else async_save)
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff = float(retry_backoff)
+        self.shard_bytes = int(core.get_flag("checkpoint_shard_bytes",
+                                             64 << 20)
+                               if shard_bytes is None else shard_bytes)
+        self._gc_stale_tmp()
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[Optional[_SaveJob]]" = queue.Queue(
+            maxsize=1)
+        self._worker: Optional[threading.Thread] = None
+        self._pending: List[_SaveJob] = []
+        self._error: Optional[BaseException] = None
+
+    # -- save ---------------------------------------------------------------
+    def save(self, program=None, scope=None, executor=None, optimizer=None,
+             step: Optional[int] = None, cursor: Optional[Dict] = None,
+             extra: Optional[Dict] = None, rng_state=None,
+             sync: bool = False, reason: str = "periodic") -> int:
+        """Snapshot and (a)synchronously commit one checkpoint; returns
+        the checkpoint's step id.  The snapshot itself is cheap (no host
+        copy on this thread); a previous async save that FAILED surfaces
+        here, so durability errors are never silently dropped."""
+        self._raise_pending_error()
+        from .core import global_scope
+        scope = scope or global_scope()
+        prog = getattr(program, "_program", program) if program is not None \
+            else None
+        if step is None:
+            step = int(getattr(executor, "_step", 0) or 0)
+        names = _collect_state_names(prog, scope)
+        if not names:
+            raise CheckpointError(
+                "checkpoint.save: nothing to save — no persistable var has "
+                "a value in the scope (run the startup program first)")
+        handles = _snapshot_handles(names, scope, executor)
+        from .generator import rng_state_to_jsonable
+        if rng_state is None:
+            rng_state = np.random.get_state()
+        meta = {
+            "format_version": FORMAT_VERSION,
+            "step": int(step),
+            "wall_time": time.time(),
+            "reason": reason,
+            "random_seed": (prog.random_seed if prog is not None else None),
+            "executor_step": (int(getattr(executor, "_step", 0))
+                              if executor is not None else None),
+            "numpy_rng": rng_state_to_jsonable(rng_state),
+            "cursor": dict(cursor or {}),
+            "extra": dict(extra or {}),
+            "optimizer_state": (sorted(optimizer.state_var_names())
+                                if optimizer is not None
+                                and hasattr(optimizer, "state_var_names")
+                                else None),
+        }
+        job = _SaveJob(int(step), handles, meta,
+                       sync=sync or not self.async_save)
+        if job.sync:
+            self._run_job(job)
+            if job.error is not None:
+                raise job.error
+            return job.step
+        self._ensure_worker()
+        with self._lock:
+            self._pending.append(job)
+        self._queue.put(job)        # maxsize=1: bounds snapshot retention
+        return job.step
+
+    def wait(self) -> None:
+        """Block until every queued async save committed; re-raise the
+        first failure.  Call before relying on durability (preemption
+        final save, end of training)."""
+        with self._lock:
+            pending = list(self._pending)
+        for job in pending:
+            job.done.wait()
+        self._raise_pending_error()
+
+    def close(self) -> None:
+        """Flush + stop the background writer (idempotent)."""
+        try:
+            self.wait()
+        finally:
+            w = self._worker
+            if w is not None and w.is_alive():
+                self._queue.put(None)
+                w.join(timeout=30)
+            self._worker = None
+
+    def _raise_pending_error(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def _ensure_worker(self):
+        w = self._worker
+        if w is None or not w.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="ckpt-writer", daemon=True)
+            self._worker.start()
+
+    def _worker_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            self._run_job(job)
+
+    def _run_job(self, job: _SaveJob):
+        m = trace.metrics()
+        t0 = trace.now()
+        try:
+            with trace.span("checkpoint::save", cat="step",
+                            args={"step": job.step, "reason":
+                                  job.meta.get("reason")}):
+                nbytes = self._write_checkpoint(job)
+            m.counter("ckpt.saves").inc()
+            m.counter("ckpt.bytes").inc(nbytes)
+            m.histogram("ckpt.save_seconds").observe(
+                (trace.now() - t0) / 1e9)
+        except BaseException as exc:    # noqa: BLE001 — stored, surfaced
+            m.counter("ckpt.save_errors").inc()
+            job.error = exc
+            if not job.sync:
+                # async failure: park it for the next save()/wait() to
+                # raise.  Sync jobs raise at the call site — parking too
+                # would double-raise on the NEXT save.
+                with self._lock:
+                    self._error = exc
+        finally:
+            job.done.set()
+            with self._lock:
+                if job in self._pending:
+                    self._pending.remove(job)
+
+    # -- the durable write --------------------------------------------------
+    def _write_checkpoint(self, job: _SaveJob) -> int:
+        """Materialise shards and commit atomically, retrying TRANSIENT
+        IO errors with backoff (a flaky NFS mount mid-save must not kill
+        the trainer); InjectedCrash and non-IO errors propagate."""
+        arrays = {}
+        for n, h in job.handles.items():
+            arrays[n] = h.persist() if hasattr(h, "persist") \
+                else np.asarray(h)
+        attempt = 0
+        while True:
+            try:
+                return self._commit_once(job, arrays)
+            except OSError:
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                trace.metrics().counter("ckpt.save_retries").inc()
+                time.sleep(self.retry_backoff * (2 ** (attempt - 1)))
+
+    def _commit_once(self, job: _SaveJob, arrays: Dict[str, np.ndarray]
+                     ) -> int:
+        final = os.path.join(self.root, _step_dirname(job.step))
+        tmp = os.path.join(self.root, f"{TMP_PREFIX}{job.step}-{os.getpid()}"
+                                      f"-{threading.get_ident()}")
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        total = 0
+        try:
+            shards = []
+            for si, group in enumerate(self._shard_groups(arrays)):
+                fname = f"shard-{si:05d}.npz"
+                buf = _io.BytesIO()
+                var_meta = {}
+                enc = {}
+                for n in group:
+                    a, true_dt = _encode_array(np.asarray(arrays[n]))
+                    enc[n] = a
+                    var_meta[n] = {"shape": list(np.shape(arrays[n])),
+                                   "dtype": true_dt}
+                np.savez(buf, **enc)
+                data = buf.getvalue()
+                atomic_write_bytes(os.path.join(tmp, fname), data)
+                total += len(data)
+                shards.append({"file": fname, "bytes": len(data),
+                               "sha256": _sha256(data), "vars": var_meta})
+            if faults.fire("crash_after_tmp_write"):
+                raise InjectedCrash(
+                    f"injected crash after tmp write of step {job.step}")
+            manifest = dict(job.meta, shards=shards, complete=True)
+            atomic_write_bytes(os.path.join(tmp, MANIFEST),
+                               json.dumps(manifest, indent=1).encode())
+            _fsync_dir(tmp)
+            if os.path.exists(final):
+                # re-save of the same step (rare; e.g. periodic + preempt
+                # racing on one step id): replace wholesale.  The retired
+                # dir gets a TMP_PREFIX name so a crash between the two
+                # renames is recoverable — _gc_stale_tmp ADOPTS a tmp dir
+                # whose manifest validates when the final name is free,
+                # so the previously durable checkpoint is never lost
+                old = os.path.join(
+                    self.root, f"{TMP_PREFIX}old-{job.step}-{os.getpid()}"
+                               f"-{threading.get_ident()}")
+                os.rename(final, old)
+                os.rename(tmp, final)
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                os.rename(tmp, final)
+            _fsync_dir(self.root)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        # post-commit fault hooks: simulate torn/partial artifacts that
+        # restore() must detect and skip
+        if faults.fire("torn_manifest"):
+            p = os.path.join(final, MANIFEST)
+            with open(p, "r+b") as f:
+                f.truncate(max(os.path.getsize(p) // 2, 1))
+        if faults.fire("partial_shard"):
+            p = os.path.join(final, "shard-00000.npz")
+            with open(p, "r+b") as f:
+                f.truncate(max(os.path.getsize(p) // 2, 1))
+        self._apply_retention()
+        return total
+
+    def _shard_groups(self, arrays: Dict[str, np.ndarray]):
+        """Deterministic name-ordered grouping, cut at shard_bytes."""
+        group, size = [], 0
+        for n in sorted(arrays):
+            nb = int(np.asarray(arrays[n]).nbytes)
+            if group and size + nb > self.shard_bytes:
+                yield group
+                group, size = [], 0
+            group.append(n)
+            size += nb
+        if group:
+            yield group
+
+    def _apply_retention(self):
+        """keep-last-K ∪ keep-every-N; everything else is deleted.  Runs
+        after every successful commit, best-effort."""
+        steps = list_checkpoint_steps(self.root)
+        if not steps:
+            return
+        keep = set(steps[-max(1, self.keep_last):])
+        if self.keep_every > 0:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        for s in steps:
+            if s not in keep:
+                shutil.rmtree(os.path.join(self.root, _step_dirname(s)),
+                              ignore_errors=True)
+
+    def _gc_stale_tmp(self):
+        """Tmp staging dirs left by a crashed writer: ADOPT one that is
+        fully intact (complete manifest, every checksum valid) when its
+        final name is free — that is the crash window between the two
+        renames of a same-step re-save, where the retired-but-valid old
+        checkpoint must not be lost — and delete the rest (a mid-write
+        stage was never committed, so deleting it is always safe)."""
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return
+        for e in entries:
+            if not e.startswith(TMP_PREFIX):
+                continue
+            p = os.path.join(self.root, e)
+            manifest = self._validate_dir(p)
+            if manifest is not None and manifest.get("step") is not None:
+                final = os.path.join(self.root,
+                                     _step_dirname(manifest["step"]))
+                if not os.path.exists(final):
+                    try:
+                        os.rename(p, final)
+                        _fsync_dir(self.root)
+                        continue
+                    except OSError:
+                        pass
+            shutil.rmtree(p, ignore_errors=True)
+
+    # -- restore ------------------------------------------------------------
+    def validate(self, step: int) -> Optional[Dict[str, Any]]:
+        """Manifest of checkpoint ``step`` iff it is fully intact
+        (manifest parses, complete flag set, every shard present with a
+        matching sha256); None otherwise."""
+        return self._validate_dir(os.path.join(self.root,
+                                               _step_dirname(step)))
+
+    @staticmethod
+    def _validate_dir(d: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(d, MANIFEST), "rb") as f:
+                manifest = json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return None
+        if not manifest.get("complete") \
+                or manifest.get("format_version") != FORMAT_VERSION:
+            return None
+        for sh in manifest.get("shards", []):
+            p = os.path.join(d, sh.get("file", ""))
+            try:
+                with open(p, "rb") as f:
+                    data = f.read()
+            except OSError:
+                return None
+            if len(data) != sh.get("bytes") \
+                    or _sha256(data) != sh.get("sha256"):
+                return None
+        return manifest
+
+    def restore(self, program=None, scope=None, executor=None,
+                strict: bool = True, step: Optional[int] = None
+                ) -> Optional[CheckpointState]:
+        """Load the newest intact checkpoint (or ``step``) into the scope
+        and restore the determinism plane.  Returns None when the root
+        holds no checkpoints at all (cold start); raises
+        :class:`CorruptCheckpointError` when checkpoints exist but none
+        validates."""
+        m = trace.metrics()
+        steps = list_checkpoint_steps(self.root)
+        if step is not None:
+            steps = [s for s in steps if s == int(step)]
+        if not steps:
+            return None
+        t0 = trace.now()
+        chosen = manifest = None
+        for s in reversed(steps):
+            manifest = self.validate(s)
+            if manifest is not None:
+                chosen = s
+                break
+            m.counter("ckpt.restore_fallbacks").inc()
+        if chosen is None:
+            raise CorruptCheckpointError(
+                f"no intact checkpoint under {self.root}: all of "
+                f"{steps} failed manifest/checksum validation")
+        d = os.path.join(self.root, _step_dirname(chosen))
+        with trace.span("checkpoint::restore", cat="step",
+                        args={"step": chosen}):
+            self._load_into_scope(d, manifest, program, scope,
+                                  strict=strict)
+            self._restore_determinism(manifest, program, executor)
+        m.counter("ckpt.restores").inc()
+        m.histogram("ckpt.restore_seconds").observe((trace.now() - t0) / 1e9)
+        return CheckpointState(chosen, d, manifest)
+
+    def _load_into_scope(self, d, manifest, program, scope, strict):
+        import jax.numpy as jnp
+        from .core import global_scope
+        scope = scope or global_scope()
+        prog = getattr(program, "_program", program) if program is not None \
+            else None
+        loaded: Dict[str, Dict[str, Any]] = {}
+        for sh in manifest.get("shards", []):
+            with np.load(os.path.join(d, sh["file"]),
+                         allow_pickle=False) as data:
+                for n in data.files:
+                    vm = sh["vars"].get(n, {})
+                    arr = _decode_array(data[n],
+                                        vm.get("dtype", str(data[n].dtype)))
+                    loaded[n] = vm
+                    scope.set_var(n, jnp.asarray(arr))
+        if strict and prog is not None:
+            wanted = {v.name: v for v in prog.global_block().vars.values()
+                      if v.persistable}
+            missing = sorted(set(wanted) - set(loaded))
+            mismatched = []
+            for n, v in wanted.items():
+                vm = loaded.get(n)
+                if vm is None:
+                    continue
+                shp = list(v.shape or [])
+                if shp and all(int(x) >= 0 for x in shp) \
+                        and vm.get("shape") is not None \
+                        and list(vm["shape"]) != shp:
+                    mismatched.append(
+                        f"{n}: checkpoint shape {vm['shape']} != program "
+                        f"shape {shp}")
+                try:
+                    if v.dtype is not None and vm.get("dtype") and \
+                            np.dtype(_DTYPE_ENCODE.get(vm["dtype"])
+                                     or vm["dtype"]).name \
+                            != _np_dtype_name(v.dtype):
+                        mismatched.append(
+                            f"{n}: checkpoint dtype {vm['dtype']} != "
+                            f"program dtype {v.dtype}")
+                except TypeError:
+                    pass
+            opt_names = manifest.get("optimizer_state")
+            if opt_names:
+                missing += sorted(n for n in opt_names
+                                  if n not in loaded and n not in missing
+                                  and n in wanted)
+            if missing or mismatched:
+                raise CheckpointError(
+                    "checkpoint restore (strict): state does not cover the "
+                    "program.  Missing vars: "
+                    + (", ".join(missing) or "none")
+                    + ".  Mismatches: " + ("; ".join(mismatched) or "none")
+                    + ".  Pass strict=False to load best-effort")
+
+    @staticmethod
+    def _restore_determinism(manifest, program, executor):
+        """RNG + counters: program.random_seed, the executor step counter
+        the per-step PRNG fold_in consumes, and the host numpy stream
+        (loader shuffles, dygraph seeds)."""
+        from .generator import rng_state_from_jsonable
+        prog = getattr(program, "_program", program) if program is not None \
+            else None
+        if prog is not None and manifest.get("random_seed") is not None:
+            prog.random_seed = manifest["random_seed"]
+        if executor is not None and manifest.get("executor_step") is not None:
+            executor._step = int(manifest["executor_step"])
+        st = manifest.get("numpy_rng")
+        if st is not None:
+            try:
+                np.random.set_state(rng_state_from_jsonable(st))
+            except (ValueError, KeyError, TypeError):
+                pass            # foreign bit-generator: leave stream as-is
+
+
+def _np_dtype_name(dt) -> str:
+    """Program var dtype (string or np dtype) -> canonical numpy name;
+    bf16 stays 'bfloat16' (not an np builtin)."""
+    s = str(dt)
+    if s in _DTYPE_ENCODE:
+        return np.dtype(_DTYPE_ENCODE[s]).name
+    try:
+        return np.dtype(s).name
+    except TypeError:
+        return s

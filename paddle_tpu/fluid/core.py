@@ -146,6 +146,20 @@ _FLAGS: Dict[str, object] = {
         "FLAGS_max_inflight_steps", "2")),
     "steps_per_dispatch": int(_os.environ.get(
         "FLAGS_steps_per_dispatch", "1")),
+    # elastic checkpoint plane (fluid/checkpoint.py, docs/checkpointing.md).
+    # keep_last bounds retention (newest K checkpoints); keep_every
+    # additionally pins every Nth step (0 = off); async routes snapshot
+    # writes to a background thread so the step window never blocks;
+    # shard_bytes caps per-shard file size.
+    "checkpoint_keep_last": int(_os.environ.get(
+        "FLAGS_checkpoint_keep_last", "3")),
+    "checkpoint_keep_every": int(_os.environ.get(
+        "FLAGS_checkpoint_keep_every", "0")),
+    "checkpoint_async": _os.environ.get(
+        "FLAGS_checkpoint_async", "1").strip().lower()
+        in _trace._TRUE_STRINGS,
+    "checkpoint_shard_bytes": int(_os.environ.get(
+        "FLAGS_checkpoint_shard_bytes", str(64 << 20))),
 }
 
 

@@ -512,6 +512,37 @@ class Executor:
                                 and not h.is_materialized()]
         return handles
 
+    # -- checkpoint plane ---------------------------------------------------
+    @property
+    def step_counter(self) -> int:
+        """The per-step PRNG counter (`fold_in(PRNGKey(seed), step)`).
+        CheckpointManager saves/restores it so RNG-bearing programs
+        (dropout, *_random ops) resume bit-deterministically."""
+        return self._step
+
+    @step_counter.setter
+    def step_counter(self, value: int) -> None:
+        self._step = int(value)
+
+    def snapshot_vars(self, names, scope: Optional[Scope] = None):
+        """Donation-safe point-in-time snapshot of scope vars: each array
+        is wrapped in a state-aliasing FetchHandle registered on
+        ``_alias_live``, so a later dispatch that donates the scope's
+        buffers host-persists these first (the PR-4 alias-guard
+        invariant).  The caller (fluid/checkpoint.py's background writer)
+        materialises them OFF the training thread — an async checkpoint
+        never stalls the step window."""
+        from .async_pipeline import FetchHandle
+        import weakref
+        scope = scope or global_scope()
+        out = {}
+        for n in names:
+            v = scope.find_var(n)
+            if v is not None:
+                out[n] = FetchHandle(v, name=n, aliases_state=True)
+        self._alias_live.extend(weakref.ref(h) for h in out.values())
+        return out
+
     def _persist_alias_live(self):
         """Host-copy every outstanding state-aliasing lazy fetch before a
         donating dispatch invalidates the scope's state buffers — shared

@@ -1,10 +1,40 @@
 """fluid.generator analog (reference generator.py / framework
-generator.cc): per-device RNG state handle."""
+generator.cc): per-device RNG state handle + serializable state for the
+checkpoint plane (fluid/checkpoint.py saves the numpy global stream and
+any Generator the caller owns so shuffles/dygraph seeds resume
+deterministically)."""
 from __future__ import annotations
+
+from typing import Any, Dict, Union
 
 import numpy as np
 
-__all__ = ["Generator"]
+__all__ = ["Generator", "rng_state_to_jsonable", "rng_state_from_jsonable"]
+
+
+def rng_state_to_jsonable(state) -> Dict[str, Any]:
+    """Serialize a numpy legacy RNG state tuple (``np.random.get_state()``
+    / ``RandomState.get_state()``) into a JSON-safe dict — what checkpoint
+    manifests store.  Dicts pass through (already serialized)."""
+    if isinstance(state, dict):
+        return state
+    alg, keys, pos, has_gauss, cached = state
+    return {"alg": str(alg),
+            "keys": [int(k) for k in np.asarray(keys).ravel()],
+            "pos": int(pos),
+            "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached)}
+
+
+def rng_state_from_jsonable(obj: Union[Dict[str, Any], tuple]) -> tuple:
+    """Inverse of :func:`rng_state_to_jsonable`; tuples pass through."""
+    if not isinstance(obj, dict):
+        return tuple(obj)
+    return (obj["alg"],
+            np.asarray(obj["keys"], dtype=np.uint32),
+            int(obj["pos"]),
+            int(obj.get("has_gauss", 0)),
+            float(obj.get("cached_gaussian", 0.0)))
 
 
 class Generator:
@@ -31,8 +61,17 @@ class Generator:
     def random(self, shape=(1,)):
         return self._rng.random_sample(shape)
 
-    def get_state(self):
-        return self._rng.get_state()
+    def get_state(self) -> Dict[str, Any]:
+        """JSON-serializable snapshot of the stream (checkpoint-safe);
+        feed it back to :meth:`set_state` — in this process or a
+        restarted one — to resume the exact sequence."""
+        out = rng_state_to_jsonable(self._rng.get_state())
+        out["seed"] = self._seed
+        return out
 
-    def set_state(self, state):
-        self._rng.set_state(state)
+    def set_state(self, state) -> None:
+        """Accepts both the serialized dict from :meth:`get_state` and a
+        raw numpy state tuple (legacy callers)."""
+        if isinstance(state, dict):
+            self._seed = state.get("seed", self._seed)
+        self._rng.set_state(rng_state_from_jsonable(state))

@@ -38,6 +38,12 @@ def _vars_to_save(program: Program, predicate=None):
 
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
+    """Atomic archive write: the npz is serialized to memory and committed
+    via tmp+fsync+``os.replace`` (checkpoint.atomic_write_bytes — the
+    PR-2 PersistentCache idiom), so a crash mid-save leaves the PREVIOUS
+    archive intact instead of a torn .npz that refuses to load."""
+    import io as _io
+    from .checkpoint import atomic_write_bytes
     main_program = main_program or default_main_program()
     scope = global_scope()
     if vars is None:
@@ -50,20 +56,57 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         if val is not None:
             arrays[name] = np.asarray(val)
     path = os.path.join(dirname, filename or "params.npz")
-    np.savez(path, **arrays)
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    atomic_write_bytes(path, buf.getvalue())
     return path
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, strict=False):
+    """With ``strict=True`` (the checkpoint-restore contract) a requested
+    var missing from the archive, or present with a different
+    shape/dtype than the program declares, raises naming every offender —
+    the legacy default silently skips, which turns a truncated save into
+    randomly re-initialised weights."""
     import jax.numpy as jnp
     scope = global_scope()
     path = os.path.join(dirname, filename or "params.npz")
     data = np.load(path, allow_pickle=False)
     main_program = main_program or default_main_program()
+    if vars is None and predicate is not None:
+        vars = _vars_to_save(main_program, predicate)
     wanted = None
     if vars is not None:
         wanted = {v.name if not isinstance(v, str) else v for v in vars}
+    if strict:
+        requested = wanted if wanted is not None else {
+            v.name for v in _vars_to_save(main_program)}
+        missing = sorted(requested - set(data.files))
+        mismatched = []
+        block = main_program.global_block()
+        for name in sorted(requested & set(data.files)):
+            v = block.vars.get(name)
+            if v is None:
+                continue
+            arr = data[name]
+            shp = list(v.shape or [])
+            if shp and all(int(x) >= 0 for x in shp) \
+                    and list(arr.shape) != shp:
+                mismatched.append(f"{name}: archive shape "
+                                  f"{list(arr.shape)} != var shape {shp}")
+            try:
+                if v.dtype is not None \
+                        and np.dtype(str(v.dtype)) != arr.dtype:
+                    mismatched.append(f"{name}: archive dtype {arr.dtype} "
+                                      f"!= var dtype {v.dtype}")
+            except TypeError:
+                pass        # non-numpy dtype (bf16 etc): archive wins
+        if missing or mismatched:
+            raise ValueError(
+                f"load_vars(strict): archive {path} does not satisfy the "
+                f"request.  Missing vars: {', '.join(missing) or 'none'}.  "
+                f"Mismatches: {'; '.join(mismatched) or 'none'}")
     for name in data.files:
         if wanted is not None and name not in wanted:
             continue

@@ -121,6 +121,25 @@ class Optimizer:
     def _get_accumulator(self, name, param):
         return self._accumulators[name][param.name]
 
+    def state_var_names(self):
+        """Every persistable var this optimizer owns in static mode —
+        moment/velocity accumulators, beta-power counters, the fp32
+        ``master_weight`` copies, and the learning-rate var.  The
+        checkpoint plane (fluid/checkpoint.py) records these in the
+        manifest so a strict restore can prove the optimizer state is
+        fully covered, not just the params."""
+        names = set()
+        for accs in self._accumulators.values():
+            for v in accs.values():
+                n = getattr(v, "name", None)
+                if isinstance(n, str):
+                    names.add(n)
+        if self._lr_var is not None:
+            n = getattr(self._lr_var, "name", None)
+            if isinstance(n, str):
+                names.add(n)
+        return sorted(names)
+
     # -- fp32 master weights ------------------------------------------------
     def _mp_active(self, param) -> bool:
         dtype = (str(param._value.dtype) if hasattr(param, "_value")

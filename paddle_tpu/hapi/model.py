@@ -90,12 +90,16 @@ class _StaticAdapter:
                 loss = m._loss(*outs, *lbs) if m._loss else outs[0]
                 if loss.shape not in ((), (1,), None):
                     loss = FL.mean(loss)
+                opt = None
                 if mode == "train":
-                    _static_optimizer(m._optimizer).minimize(loss)
+                    opt = _static_optimizer(m._optimizer)
+                    opt.minimize(loss)
                 fetch = [loss.name] + [o.name for o in outs]
         entry = {"prog": prog, "run_prog": prog,
                  "ins": [v.name for v in ins],
                  "lbs": [v.name for v in lbs], "fetch": fetch}
+        if mode == "train":
+            entry["optimizer"] = opt    # checkpoint coverage (state vars)
         if mode == "train" and self.model._amp_level not in (None, "O0"):
             # Model.prepare(amp_level="O1"/"O2"): route the train program
             # through the AMP compiler plane (fluid/passes/amp.py) — the
@@ -377,7 +381,16 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
+            callbacks=None, checkpoint_dir=None, checkpoint_freq=1):
+        """``checkpoint_dir``: elastic auto-resume (static mode).  fit()
+        restores the newest intact checkpoint from the directory (params,
+        optimizer state incl. fp32 masters, RNG streams, executor step
+        counter, epoch/batch cursor) and continues training exactly where
+        it stopped — bit-identical to an uninterrupted run.  Every
+        ``checkpoint_freq`` epochs an ASYNC snapshot commits off the step
+        window; a SIGTERM/SIGINT mid-epoch drains the in-flight window,
+        takes a final synchronous snapshot with a mid-epoch cursor, and
+        returns with ``self.preempted`` set (docs/checkpointing.md)."""
         loader = _as_loader(train_data, batch_size, shuffle, drop_last)
         if self._adapter is not None:
             # loaders advertise their exact batch sizes (DataLoader
@@ -392,29 +405,92 @@ class Model:
         cbs.set_model(self)
         cbs.on_train_begin()
         self.stop_training = False          # EarlyStopping contract
+        self.preempted = False              # elastic-drain indicator
+        # elastic auto-resume plane (fluid/checkpoint.py + elastic.py)
+        ckpt = ectx = None
+        start_epoch = skip_batches = 0
+        if checkpoint_dir is not None:
+            if self._adapter is None:
+                raise ValueError(
+                    "fit(checkpoint_dir=...) needs static-graph mode — the "
+                    "elastic checkpoint plane snapshots program "
+                    "persistables (call fit outside dygraph guard)")
+            from ..fluid.checkpoint import CheckpointManager
+            from ..distributed.elastic import ElasticContext
+            ckpt = CheckpointManager(checkpoint_dir)
+            state = ckpt.restore(executor=self._adapter._executor())
+            if state is not None:
+                start_epoch = int(state.cursor.get("epoch", 0))
+                skip_batches = int(state.cursor.get("batch", 0))
+            ectx = ElasticContext(ckpt)
         # async window only when no per-batch metrics are configured: the
         # sync path reports [loss] + metrics to callbacks every batch, and
         # metrics are computed host-side from the outputs — forcing them
         # through the window would materialise every step anyway
         use_async = self._adapter is not None and not self._metrics
+        import contextlib
         try:
-            return self._fit_epochs(loader, eval_data, batch_size, epochs,
-                                    eval_freq, save_dir, save_freq, cbs,
-                                    use_async)
+            with (ectx if ectx is not None else contextlib.nullcontext()):
+                return self._fit_epochs(loader, eval_data, batch_size,
+                                        epochs, eval_freq, save_dir,
+                                        save_freq, cbs, use_async,
+                                        ckpt=ckpt, ectx=ectx,
+                                        start_epoch=start_epoch,
+                                        skip_batches=skip_batches,
+                                        checkpoint_freq=checkpoint_freq)
         except BaseException:
             if use_async:
                 # never leave the aborted epoch's buffered feeds pending —
                 # a later fit()/evaluate() must not dispatch stale batches
                 self._adapter.abort()
             raise
+        finally:
+            if ckpt is not None:
+                ckpt.close()
+
+    def _ckpt_save(self, ckpt, ectx, epoch, batch, rng_state, preempt):
+        """One checkpoint: the train program's persistables + optimizer
+        state, cursor = (epoch, batch), RNG captured at epoch start for
+        mid-epoch cursors (so the resumed process re-shuffles the SAME
+        epoch permutation) or current for epoch boundaries."""
+        entry = self._adapter._progs.get("train")
+        if entry is None:
+            return
+        exe = self._adapter._executor()
+        kw = dict(program=entry["prog"], executor=exe,
+                  optimizer=entry.get("optimizer"),
+                  step=exe.step_counter,
+                  cursor={"epoch": int(epoch), "batch": int(batch)},
+                  rng_state=rng_state)
+        if preempt:
+            r = self._adapter._train_runner
+            ectx.drain_and_save(runners=[r] if r is not None else [], **kw)
+        else:
+            ckpt.save(sync=False, **kw)
 
     def _fit_epochs(self, loader, eval_data, batch_size, epochs, eval_freq,
-                    save_dir, save_freq, cbs, use_async):
+                    save_dir, save_freq, cbs, use_async, ckpt=None,
+                    ectx=None, start_epoch=0, skip_batches=0,
+                    checkpoint_freq=1):
         history = []
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbs.on_epoch_begin(epoch)
+            # epoch-start RNG: a mid-epoch resume restores THIS state so
+            # the shuffled batch order of the interrupted epoch replays
+            epoch_rng = np.random.get_state() if ckpt is not None else None
+            skip = skip_batches if epoch == start_epoch else 0
             losses = []
             for step, batch in enumerate(loader):
+                if step < skip:
+                    continue        # resume fast-forward (already trained)
+                if ectx is not None and ectx.preemption_requested():
+                    # drain the in-flight window, final sync snapshot
+                    # with an exact mid-epoch cursor, exit resumable
+                    self._ckpt_save(ckpt, ectx, epoch, step, epoch_rng,
+                                    preempt=True)
+                    self.preempted = True
+                    self.stop_training = True
+                    break
                 cbs.on_train_batch_begin(step)
                 ins, lbs = _split_batch(batch)
                 if use_async:
@@ -438,21 +514,32 @@ class Model:
                     idx = len(losses) - 1 - lag
                     if idx >= 0 and not isinstance(losses[idx], float):
                         losses[idx] = float(losses[idx])
+            if self.preempted:
+                break               # window already drained + snapshotted
             if use_async:
                 # close the window before epoch-end logs/eval/save read
                 # state; also surfaces any buffered dispatch error
                 self._adapter.drain()
-            logs = {"loss": float(np.mean([float(v) for v in losses]))}
+            logs = {"loss": float(np.mean([float(v) for v in losses]))
+                    if losses else float("nan")}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 logs["eval_loss"] = self.evaluate(eval_data,
                                                   batch_size)["loss"]
             history.append(logs)
             cbs.on_epoch_end(epoch, logs)
+            if ckpt is not None and (epoch + 1) % max(1, checkpoint_freq) \
+                    == 0:
+                # epoch-boundary snapshot rides the background writer —
+                # the next epoch's dispatches overlap the checkpoint IO
+                self._ckpt_save(ckpt, ectx, epoch + 1, 0,
+                                np.random.get_state(), preempt=False)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
             if self.stop_training:
                 break
         cbs.on_train_end()
+        if ckpt is not None:
+            ckpt.wait()             # durability before fit() returns
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

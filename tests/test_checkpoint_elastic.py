@@ -1,0 +1,1057 @@
+"""Elastic training runtime (ISSUE 6): async fault-tolerant
+checkpointing, preemption drain, deterministic resume.
+
+Covers fluid/checkpoint.py (atomic commit, checksums, retention, retry,
+fault-injection harness), distributed/elastic.py (SIGTERM drain,
+resumable marker), the io.py satellites (atomic save_vars, strict
+load_vars), serializable Generator state, and the kill-and-resume parity
+acceptance: interrupted training resumes to bit-identical per-step
+losses vs. an uninterrupted run — sync, async (inflight=2), and
+bf16+master-weights configurations, on mlp and ctr programs."""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import core, trace
+from paddle_tpu.fluid.async_pipeline import AsyncStepRunner
+from paddle_tpu.fluid.checkpoint import (CheckpointManager, CheckpointError,
+                                         CorruptCheckpointError,
+                                         InjectedCrash, atomic_write_bytes,
+                                         faults, latest_checkpoint_step,
+                                         list_checkpoint_steps)
+from paddle_tpu.fluid.framework import reset_unique_name
+from paddle_tpu.distributed import elastic
+from paddle_tpu.distributed.elastic import (ElasticContext, FileProbe,
+                                            clear_resume_marker,
+                                            read_resume_marker,
+                                            write_resume_marker)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# program builders (bit-determinism demands identical var names per build:
+# every builder resets the unique-name counter, simulating a fresh process)
+# ---------------------------------------------------------------------------
+
+def _build_mlp():
+    reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 11
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 16])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)   # per-step PRNG
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        opt = fluid.optimizer.AdamOptimizer(1e-2)
+        opt.minimize(loss)
+    return main, startup, loss, opt
+
+
+def _mlp_feeds(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(8, 16).astype("float32"),
+             "y": rng.randint(0, 10, (8, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _build_ctr():
+    reset_unique_name()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 5
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        ids = fluid.data("ids", [-1, 4], dtype="int64")
+        dense = fluid.data("dense", [-1, 8])
+        label = fluid.data("label", [-1, 1])
+        emb = fluid.layers.embedding(ids, size=[50, 8])
+        flat = fluid.layers.reshape(emb, [-1, 4 * 8])
+        feat = fluid.layers.concat([flat, dense], axis=1)
+        h = fluid.layers.fc(feat, 32, act="relu")
+        logit = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(logit, label))
+        opt = fluid.optimizer.SGDOptimizer(0.05)
+        opt.minimize(loss)
+    return main, startup, loss, opt
+
+
+def _ctr_feeds(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [{"ids": rng.randint(0, 50, (8, 4)).astype("int64"),
+             "dense": rng.randn(8, 8).astype("float32"),
+             "label": rng.randint(0, 2, (8, 1)).astype("float32")}
+            for _ in range(n)]
+
+
+BUILDERS = {"mlp": (_build_mlp, _mlp_feeds),
+            "ctr": (_build_ctr, _ctr_feeds)}
+
+
+def _params(scope, program):
+    prog = getattr(program, "_program", program)
+    return {v.name: np.asarray(scope.find_var(v.name))
+            for v in prog.global_block().vars.values()
+            if v.persistable and scope.find_var(v.name) is not None}
+
+
+# ---------------------------------------------------------------------------
+# durable-write primitives
+# ---------------------------------------------------------------------------
+
+class TestAtomicWrite:
+    def test_roundtrip_and_replace(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        atomic_write_bytes(p, b"one")
+        atomic_write_bytes(p, b"two")
+        with open(p, "rb") as f:
+            assert f.read() == b"two"
+        assert [e for e in os.listdir(tmp_path)
+                if e.startswith(".tmp-")] == []
+
+    def test_injected_error_leaves_old_content(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        atomic_write_bytes(p, b"old")
+        faults.arm("io_error")
+        with pytest.raises(OSError, match="injected"):
+            atomic_write_bytes(p, b"new")
+        with open(p, "rb") as f:
+            assert f.read() == b"old"           # never torn, never lost
+
+    def test_no_tmp_litter_after_error(self, tmp_path):
+        faults.arm("io_error")
+        with pytest.raises(OSError):
+            atomic_write_bytes(str(tmp_path / "g.bin"), b"x")
+        assert [e for e in os.listdir(tmp_path)
+                if e.startswith(".tmp-")] == []
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: save/restore mechanics
+# ---------------------------------------------------------------------------
+
+class TestSaveRestore:
+    def _trained(self, n_steps=3):
+        main, startup, loss, opt = _build_mlp()
+        exe = fluid.Executor()
+        exe.run(startup)
+        for f in _mlp_feeds(n_steps):
+            exe.run(main, feed=f, fetch_list=[loss.name])
+        return main, startup, loss, opt, exe
+
+    def test_empty_root_restores_none(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt, exe = self._trained()
+            cm = CheckpointManager(str(tmp_path))
+            assert cm.restore(program=main, executor=exe) is None
+
+    def test_manifest_records_determinism_plane(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt, exe = self._trained()
+            cm = CheckpointManager(str(tmp_path))
+            step = cm.save(program=main, executor=exe, optimizer=opt,
+                           cursor={"batch": 3}, extra={"note": "t"},
+                           sync=True)
+            d = os.path.join(str(tmp_path), f"ckpt-{step:08d}")
+            with open(os.path.join(d, "manifest.json")) as f:
+                man = json.load(f)
+            assert man["complete"] and man["format_version"] == 1
+            assert man["random_seed"] == 11
+            assert man["executor_step"] == exe.step_counter
+            assert man["cursor"] == {"batch": 3}
+            assert man["extra"] == {"note": "t"}
+            assert man["numpy_rng"]["pos"] is not None
+            # optimizer coverage listed for strict-restore proof
+            assert set(man["optimizer_state"]) == set(opt.state_var_names())
+            # every persistable accounted for in some shard, checksummed
+            saved = {n for sh in man["shards"] for n in sh["vars"]}
+            assert set(opt.state_var_names()) <= saved
+            for sh in man["shards"]:
+                assert sh["sha256"] and sh["bytes"] > 0
+
+    def test_roundtrip_bit_identical_fresh_scope(self, tmp_path):
+        feeds = _mlp_feeds(10)
+        # uninterrupted
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            base = [float(np.ravel(exe.run(main, feed=f,
+                                           fetch_list=[loss.name])[0])[0])
+                    for f in feeds]
+        # interrupted at 5 + checkpoint
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            part = [float(np.ravel(exe.run(main, feed=f,
+                                           fetch_list=[loss.name])[0])[0])
+                    for f in feeds[:5]]
+            cm = CheckpointManager(str(tmp_path))
+            cm.save(program=main, executor=exe, optimizer=opt, step=5,
+                    cursor={"batch": 5}, sync=True)
+            cm.close()
+        # fresh "process"
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm = CheckpointManager(str(tmp_path))
+            st = cm.restore(program=main, executor=exe)
+            assert st.step == 5 and st.cursor == {"batch": 5}
+            assert exe.step_counter == st.manifest["executor_step"]
+            rest = [float(np.ravel(exe.run(main, feed=f,
+                                           fetch_list=[loss.name])[0])[0])
+                    for f in feeds[5:]]
+        assert part + rest == base
+
+    def test_async_save_commits_and_waits(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt, exe = self._trained()
+            cm = CheckpointManager(str(tmp_path), async_save=True)
+            s0 = trace.metrics().counter("ckpt.saves").value
+            cm.save(program=main, executor=exe, step=1)
+            cm.save(program=main, executor=exe, step=2)
+            cm.wait()
+            assert trace.metrics().counter("ckpt.saves").value - s0 == 2
+            assert list_checkpoint_steps(str(tmp_path)) == [1, 2]
+            cm.close()
+
+    def test_async_save_overlaps_slow_disk(self, tmp_path):
+        """The step-window contract: save() hands the IO to the writer
+        thread — the caller is not blocked for the (slow) write."""
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt, exe = self._trained()
+            cm = CheckpointManager(str(tmp_path), async_save=True)
+            faults.arm("slow_disk", times=1, delay=0.5)
+            t0 = time.perf_counter()
+            cm.save(program=main, executor=exe, step=1)
+            submit_s = time.perf_counter() - t0
+            # training can proceed while the writer sleeps in the write
+            exe.run(main, feed=_mlp_feeds(1)[0], fetch_list=[loss.name])
+            cm.wait()
+            assert submit_s < 0.25, submit_s
+            assert cm.validate(1) is not None
+            cm.close()
+
+    def test_sharding_splits_and_restores(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt, exe = self._trained()
+            before = _params(core.global_scope(), main)
+            cm = CheckpointManager(str(tmp_path), shard_bytes=1024)
+            step = cm.save(program=main, executor=exe, sync=True)
+            d = os.path.join(str(tmp_path), f"ckpt-{step:08d}")
+            shards = [e for e in os.listdir(d) if e.startswith("shard-")]
+            assert len(shards) > 1      # mlp state >> 1KiB per shard
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm = CheckpointManager(str(tmp_path))
+            cm.restore(program=main, executor=exe)
+            after = _params(core.global_scope(), main)
+        assert set(before) == set(after)
+        for n in before:
+            assert np.array_equal(before[n], after[n]), n
+
+    def test_retention_keep_last_and_keep_every(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt, exe = self._trained()
+            cm = CheckpointManager(str(tmp_path), keep_last=2, keep_every=4,
+                                   async_save=False)
+            for s in range(1, 11):
+                cm.save(program=main, executor=exe, step=s, sync=True)
+            # newest 2 (9, 10) plus every 4th (4, 8)
+            assert list_checkpoint_steps(str(tmp_path)) == [4, 8, 9, 10]
+
+    def test_bf16_state_roundtrips_bit_exact(self, tmp_path):
+        import ml_dtypes
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        vals = rng.randn(4, 4).astype(ml_dtypes.bfloat16)
+        with core.scope_guard(core.Scope()):
+            scope = core.global_scope()
+            scope.set_var("W_bf16", jnp.asarray(vals))
+            cm = CheckpointManager(str(tmp_path))
+            cm.save(scope=scope, step=1, sync=True)
+        with core.scope_guard(core.Scope()):
+            scope = core.global_scope()
+            cm = CheckpointManager(str(tmp_path))
+            st = cm.restore(scope=scope)
+            got = np.asarray(scope.find_var("W_bf16"))
+        assert str(got.dtype) == "bfloat16"
+        assert np.array_equal(got.view(np.uint16), vals.view(np.uint16))
+        assert "W_bf16" in st.var_names
+
+    def test_nothing_to_save_raises(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            exe = fluid.Executor()          # startup NOT run: empty scope
+            cm = CheckpointManager(str(tmp_path))
+            with pytest.raises(CheckpointError, match="nothing to save"):
+                cm.save(program=main, executor=exe, step=1, sync=True)
+
+
+# ---------------------------------------------------------------------------
+# fault injection: crash-after-tmp-write, torn manifest, partial shard,
+# transient/persistent IO errors
+# ---------------------------------------------------------------------------
+
+class TestFaultInjection:
+    def _ready(self, tmp_path):
+        main, startup, loss, opt = _build_mlp()
+        exe = fluid.Executor()
+        exe.run(startup)
+        exe.run(main, feed=_mlp_feeds(1)[0], fetch_list=[loss.name])
+        cm = CheckpointManager(str(tmp_path), async_save=False)
+        return main, exe, cm
+
+    def test_crash_after_tmp_write_commits_nothing(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            cm.save(program=main, executor=exe, step=1, sync=True)
+            faults.arm("crash_after_tmp_write")
+            with pytest.raises(InjectedCrash):
+                cm.save(program=main, executor=exe, step=2, sync=True)
+            # the half-written step 2 never appeared; step 1 untouched
+            assert list_checkpoint_steps(str(tmp_path)) == [1]
+            assert cm.validate(1) is not None
+            # and the crash did not poison later saves (sync error path)
+            cm.save(program=main, executor=exe, step=3, sync=True)
+            assert latest_checkpoint_step(str(tmp_path)) == 3
+
+    def test_stale_tmp_dirs_garbage_collected(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            faults.arm("crash_after_tmp_write")
+            with pytest.raises(InjectedCrash):
+                cm.save(program=main, executor=exe, step=1, sync=True)
+            # simulate a writer that died before its cleanup ran
+            os.makedirs(str(tmp_path / ".tmp-ckpt-9-dead-1"), exist_ok=True)
+            CheckpointManager(str(tmp_path))        # init GCs stale tmp
+            assert [e for e in os.listdir(tmp_path)
+                    if e.startswith(".tmp-ckpt-")] == []
+
+    def test_intact_tmp_dir_adopted_not_deleted(self, tmp_path):
+        # the one non-atomic window: a same-step re-save retires the old
+        # checkpoint to a .tmp-ckpt-old-* name before renaming the new
+        # one in.  A crash between the two renames leaves only that tmp
+        # dir — init must ADOPT it (it validates fully), not delete the
+        # job's only durable state
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            cm.save(program=main, executor=exe, step=1, sync=True)
+            os.rename(str(tmp_path / "ckpt-00000001"),
+                      str(tmp_path / ".tmp-ckpt-old-1-999-1"))
+            assert list_checkpoint_steps(str(tmp_path)) == []
+            cm2 = CheckpointManager(str(tmp_path))
+            assert list_checkpoint_steps(str(tmp_path)) == [1]
+            assert cm2.validate(1) is not None
+            st = cm2.restore(program=main, executor=exe)
+            assert st.step == 1
+
+    @pytest.mark.parametrize("kind", ["torn_manifest", "partial_shard"])
+    def test_corruption_falls_back_to_newest_intact(self, tmp_path, kind):
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            cm.save(program=main, executor=exe, step=1, sync=True)
+            fb0 = trace.metrics().counter("ckpt.restore_fallbacks").value
+            faults.arm(kind)
+            cm.save(program=main, executor=exe, step=2, sync=True)
+            assert cm.validate(2) is None           # detectably corrupt
+            st = cm.restore(program=main, executor=exe)
+            assert st.step == 1
+            assert trace.metrics().counter(
+                "ckpt.restore_fallbacks").value == fb0 + 1
+
+    def test_all_corrupt_raises(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            faults.arm("torn_manifest")
+            cm.save(program=main, executor=exe, step=1, sync=True)
+            with pytest.raises(CorruptCheckpointError):
+                cm.restore(program=main, executor=exe)
+
+    def test_transient_io_error_retried_with_backoff(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            r0 = trace.metrics().counter("ckpt.save_retries").value
+            faults.arm("io_error", times=2)
+            cm.save(program=main, executor=exe, step=1, sync=True)
+            assert cm.validate(1) is not None
+            assert trace.metrics().counter(
+                "ckpt.save_retries").value >= r0 + 1
+
+    def test_exhausted_retries_raise(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe, cm = self._ready(tmp_path)
+            cm.max_retries = 1
+            cm.retry_backoff = 0.01
+            faults.arm("io_error", times=99)
+            with pytest.raises(OSError):
+                cm.save(program=main, executor=exe, step=1, sync=True)
+            faults.clear()
+
+    def test_async_failure_surfaces_on_next_save(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe, _ = self._ready(tmp_path)
+            cm = CheckpointManager(str(tmp_path), async_save=True,
+                                   max_retries=0)
+            e0 = trace.metrics().counter("ckpt.save_errors").value
+            faults.arm("io_error", times=99)
+            cm.save(program=main, executor=exe, step=1)
+            with pytest.raises(OSError):
+                cm.wait()
+            faults.clear()
+            assert trace.metrics().counter(
+                "ckpt.save_errors").value >= e0 + 1
+            # the plane recovers: later saves succeed
+            cm.save(program=main, executor=exe, step=2)
+            cm.wait()
+            assert cm.validate(2) is not None
+            cm.close()
+
+
+# ---------------------------------------------------------------------------
+# strict restore coverage
+# ---------------------------------------------------------------------------
+
+class TestStrictRestore:
+    def test_missing_program_var_raises_with_names(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm = CheckpointManager(str(tmp_path))
+            cm.save(program=main, executor=exe, step=1, sync=True)
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = _build_mlp()
+            # a persistable the checkpoint has never seen
+            main.global_block().create_parameter("late_extra_w", [4, 4])
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm = CheckpointManager(str(tmp_path))
+            with pytest.raises(CheckpointError, match="late_extra_w"):
+                cm.restore(program=main, executor=exe)
+            # best-effort escape hatch still loads what exists
+            st = cm.restore(program=main, executor=exe, strict=False)
+            assert st.step == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: io.py atomic save + strict load, Generator state
+# ---------------------------------------------------------------------------
+
+class TestIoSatellites:
+    def _setup(self, tmp_path):
+        main, startup, loss, opt = _build_mlp()
+        exe = fluid.Executor()
+        exe.run(startup)
+        return main, exe
+
+    def test_save_vars_is_atomic(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe = self._setup(tmp_path)
+            p = fluid.io.save_persistables(exe, str(tmp_path),
+                                           main_program=main)
+            with open(p, "rb") as f:
+                good = f.read()
+            # a crashing re-save must leave the previous archive intact
+            faults.arm("io_error")
+            with pytest.raises(OSError):
+                fluid.io.save_persistables(exe, str(tmp_path),
+                                           main_program=main)
+            with open(p, "rb") as f:
+                assert f.read() == good
+
+    def test_load_vars_strict_names_missing(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe = self._setup(tmp_path)
+            fluid.io.save_persistables(exe, str(tmp_path),
+                                       main_program=main)
+            main.global_block().create_parameter("phantom_w", [2, 2])
+            with pytest.raises(ValueError, match="phantom_w"):
+                fluid.io.load_vars(exe, str(tmp_path), main_program=main,
+                                   strict=True)
+            # legacy default: silently skips (backwards compatible)
+            fluid.io.load_vars(exe, str(tmp_path), main_program=main)
+
+    def test_load_vars_strict_shape_mismatch(self, tmp_path):
+        with core.scope_guard(core.Scope()):
+            main, exe = self._setup(tmp_path)
+            fluid.io.save_persistables(exe, str(tmp_path),
+                                       main_program=main)
+        with core.scope_guard(core.Scope()):
+            reset_unique_name()
+            main2, startup2 = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main2, startup2):
+                x = fluid.data("x", [-1, 16])
+                h = fluid.layers.fc(x, 24, act="relu")  # 32 -> 24
+                h = fluid.layers.dropout(h, dropout_prob=0.3)
+                logits = fluid.layers.fc(h, 10)
+            exe2 = fluid.Executor()
+            exe2.run(startup2)
+            with pytest.raises(ValueError, match="shape"):
+                fluid.io.load_vars(exe2, str(tmp_path),
+                                   main_program=main2, strict=True)
+
+
+class TestGeneratorState:
+    def test_get_set_state_resumes_stream(self):
+        from paddle_tpu.fluid.generator import Generator
+        g = Generator()
+        g.manual_seed(7)
+        g.random((3,))
+        st = g.get_state()
+        a = g.random((5,))
+        g.set_state(st)
+        b = g.random((5,))
+        assert np.array_equal(a, b)
+
+    def test_state_is_json_serializable(self):
+        from paddle_tpu.fluid.generator import Generator
+        g = Generator()
+        g.manual_seed(3)
+        g.random((2,))
+        st = json.loads(json.dumps(g.get_state()))   # wire roundtrip
+        a = g.random((4,))
+        g2 = Generator()
+        g2.set_state(st)
+        assert g2.initial_seed() == 3
+        assert np.array_equal(g2.random((4,)), a)
+
+    def test_numpy_global_stream_roundtrips_via_manifest(self, tmp_path):
+        from paddle_tpu.fluid.generator import (rng_state_from_jsonable,
+                                                rng_state_to_jsonable)
+        np.random.seed(99)
+        np.random.rand(10)
+        st = json.loads(json.dumps(
+            rng_state_to_jsonable(np.random.get_state())))
+        a = np.random.rand(6)
+        np.random.set_state(rng_state_from_jsonable(st))
+        assert np.array_equal(np.random.rand(6), a)
+
+
+# ---------------------------------------------------------------------------
+# elastic plane: probes, signals, markers, drain
+# ---------------------------------------------------------------------------
+
+class TestElasticContext:
+    def test_file_probe_triggers(self, tmp_path):
+        probe = FileProbe(str(tmp_path / "maintenance-event"))
+        with ElasticContext(probe=probe,
+                            install_signal_handlers=False) as ctx:
+            assert not ctx.preemption_requested()
+            assert not elastic.preemption_requested()
+            (tmp_path / "maintenance-event").write_text("now")
+            assert elastic.preemption_requested()
+            assert ctx.reason == "probe"
+
+    def test_sigterm_sets_flag_and_restores_handler(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        with ElasticContext() as ctx:
+            assert signal.getsignal(signal.SIGTERM) != prev
+            os.kill(os.getpid(), signal.SIGTERM)
+            # handler runs in the main thread between bytecodes
+            for _ in range(100):
+                if ctx.preemption_requested():
+                    break
+                time.sleep(0.01)
+            assert ctx.preemption_requested()
+            assert ctx.reason == f"signal:{int(signal.SIGTERM)}"
+        assert signal.getsignal(signal.SIGTERM) == prev
+        assert elastic.current_context() is None
+
+    def test_ambient_context_nests(self):
+        with ElasticContext(install_signal_handlers=False) as outer:
+            with ElasticContext(install_signal_handlers=False) as inner:
+                assert elastic.current_context() is inner
+            assert elastic.current_context() is outer
+
+    def test_resume_marker_roundtrip(self, tmp_path):
+        root = str(tmp_path)
+        assert read_resume_marker(root) is None
+        write_resume_marker(root, 17, reason="signal:15")
+        mk = read_resume_marker(root)
+        assert mk["step"] == 17 and mk["reason"] == "signal:15"
+        assert mk["pid"] == os.getpid()
+        clear_resume_marker(root)
+        assert read_resume_marker(root) is None
+
+    def test_drain_and_save_requires_manager(self):
+        with ElasticContext(install_signal_handlers=False) as ctx:
+            with pytest.raises(RuntimeError, match="CheckpointManager"):
+                ctx.drain_and_save()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: kill-and-resume parity (SIGTERM mid-run, inflight=2),
+# bit-identical per-step losses vs. uninterrupted training
+# ---------------------------------------------------------------------------
+
+class TestPreemptionDrainParity:
+    def _async_uninterrupted(self, build, feeds):
+        main, startup, loss, opt = build()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [loss.name])
+            assert r.max_inflight == 2          # FLAGS default
+            futs = [r.submit(f) for f in feeds]
+            r.drain()
+            losses = [float(np.ravel(f.result()[0])[0]) for f in futs]
+            params = _params(core.global_scope(), main)
+        return losses, params
+
+    @pytest.mark.parametrize("kind", ["mlp", "ctr"])
+    def test_sigterm_drain_resumes_bit_identical(self, tmp_path, kind):
+        """SIGTERM mid-epoch with the async window at inflight=2: the
+        drain completes every submitted step, the final sync snapshot's
+        cursor is exact, and a fresh process resumes to bit-identical
+        losses and final params vs. the uninterrupted run."""
+        build, make_feeds = BUILDERS[kind]
+        feeds = make_feeds(12)
+        base_losses, base_params = self._async_uninterrupted(build, feeds)
+
+        root = str(tmp_path)
+        # -- interrupted run: SIGTERM lands after the 6th submit --------
+        main, startup, loss, opt = build()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm = CheckpointManager(root)
+            with ElasticContext(cm) as ctx:
+                r = AsyncStepRunner(exe, main, [loss.name])
+                futs, consumed = [], 0
+                for f in feeds:
+                    if ctx.preemption_requested():
+                        break
+                    futs.append(r.submit(f))
+                    consumed += 1
+                    if consumed == 6:
+                        os.kill(os.getpid(), signal.SIGTERM)
+                assert consumed < len(feeds)    # it really was cut short
+                ctx.drain_and_save(executor=exe, runners=[r],
+                                   program=main, optimizer=opt,
+                                   cursor={"batch": consumed})
+                # the drain completed every submitted step
+                part = [float(np.ravel(f.result()[0])[0]) for f in futs]
+        mk = read_resume_marker(root)
+        assert mk is not None and mk["reason"].startswith("signal:")
+
+        # -- fresh process: restore + finish the epoch ------------------
+        main, startup, loss, opt = build()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm2 = CheckpointManager(root)
+            st = cm2.restore(program=main, executor=exe)
+            start = st.cursor["batch"]
+            assert start == consumed
+            r2 = AsyncStepRunner(exe, main, [loss.name])
+            futs2 = [r2.submit(f) for f in feeds[start:]]
+            r2.drain()
+            rest = [float(np.ravel(f.result()[0])[0]) for f in futs2]
+            end_params = _params(core.global_scope(), main)
+
+        assert part + rest == base_losses
+        assert set(end_params) == set(base_params)
+        for n in base_params:
+            assert np.array_equal(base_params[n], end_params[n]), n
+
+    def test_crash_during_save_resumes_from_previous(self, tmp_path):
+        """Injected crash mid-save (after tmp write): the torn attempt
+        never becomes a checkpoint, and a restart resumes from the
+        previous intact one to bit-identical losses."""
+        feeds = _mlp_feeds(12)
+        base_losses, _ = self._async_uninterrupted(_build_mlp, feeds)
+
+        root = str(tmp_path)
+        main, startup, loss, opt = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm = CheckpointManager(root, async_save=False)
+            r = AsyncStepRunner(exe, main, [loss.name])
+            futs = []
+            for i, f in enumerate(feeds[:8]):
+                futs.append(r.submit(f))
+                if i == 3:                      # checkpoint after step 4
+                    r.drain()
+                    cm.save(program=main, executor=exe, optimizer=opt,
+                            cursor={"batch": 4}, sync=True)
+            r.drain()
+            [f.result() for f in futs]
+            # the step-8 save dies mid-write (process crash simulation)
+            faults.arm("crash_after_tmp_write")
+            with pytest.raises(InjectedCrash):
+                cm.save(program=main, executor=exe, optimizer=opt,
+                        cursor={"batch": 8}, sync=True)
+
+        main, startup, loss, opt = _build_mlp()
+        with core.scope_guard(core.Scope()):
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm2 = CheckpointManager(root)
+            st = cm2.restore(program=main, executor=exe)
+            start = st.cursor["batch"]
+            assert start == 4                   # the intact checkpoint
+            r2 = AsyncStepRunner(exe, main, [loss.name])
+            futs2 = [r2.submit(f) for f in feeds[start:]]
+            r2.drain()
+            rest = [float(np.ravel(f.result()[0])[0]) for f in futs2]
+        assert rest == base_losses[start:]
+
+    def test_bf16_master_weights_resume_bit_identical(self, tmp_path):
+        """The PR-5 interaction: fp32 master accumulators (the sub-ulp
+        integration state) survive the checkpoint, so a resumed bf16
+        multi_precision run is bit-identical — plain-bf16 restores would
+        lose the master's low bits."""
+        def build_bf16():
+            reset_unique_name()
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [-1, 4])
+                gb = main.global_block()
+                gb.create_parameter("W_lo", [4, 4], dtype="bfloat16")
+                sb = startup.global_block()
+                sb.create_var(name="W_lo", shape=[4, 4],
+                              dtype="bfloat16", persistable=True)
+                sb.append_op("fill_constant", outputs={"Out": ["W_lo"]},
+                             attrs={"shape": [4, 4], "dtype": "bfloat16",
+                                    "value": 1.0})
+                h = fluid.layers.matmul(x, gb.vars["W_lo"])
+                loss = fluid.layers.mean(h)
+                opt = fluid.optimizer.MomentumOptimizer(
+                    1e-4, 0.9, multi_precision=True)
+                opt.minimize(loss)
+            return main, startup, loss, opt
+
+        feed = {"x": np.ones((2, 4), "float32")}
+
+        def run(exe, main, loss, n):
+            for _ in range(n):
+                exe.run(main, feed=feed, fetch_list=[loss.name])
+
+        def masters(main):
+            return [n for n in main.global_block().vars
+                    if "master_weight" in n]
+
+        # uninterrupted: 8 sub-ulp steps integrate on the master
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = build_bf16()
+            exe = fluid.Executor()
+            exe.run(startup)
+            run(exe, main, loss, 8)
+            mname, = masters(main)
+            base_m = np.asarray(core.global_scope().find_var(mname))
+
+        root = str(tmp_path)
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = build_bf16()
+            exe = fluid.Executor()
+            exe.run(startup)
+            run(exe, main, loss, 4)
+            cm = CheckpointManager(root)
+            cm.save(program=main, executor=exe, optimizer=opt, step=4,
+                    sync=True)
+            mname, = masters(main)
+            assert mname in set(opt.state_var_names())
+        with core.scope_guard(core.Scope()):
+            main, startup, loss, opt = build_bf16()
+            exe = fluid.Executor()
+            exe.run(startup)
+            cm2 = CheckpointManager(root)
+            cm2.restore(program=main, executor=exe)
+            run(exe, main, loss, 4)
+            got_m = np.asarray(core.global_scope().find_var(mname))
+        assert got_m.dtype == np.float32
+        assert np.array_equal(base_m, got_m)
+
+
+# ---------------------------------------------------------------------------
+# hapi Model.fit auto-resume
+# ---------------------------------------------------------------------------
+
+def _fresh_hapi_model():
+    import paddle_tpu.hapi as hapi
+    import paddle_tpu.nn as nn
+    from paddle_tpu.dygraph import base as dybase
+    from paddle_tpu.fluid import framework
+    from paddle_tpu.hapi.model import Model
+    dybase.disable_dygraph()
+    framework._main_program = fluid.Program()
+    framework._startup_program = fluid.Program()
+    reset_unique_name()
+    np.random.seed(123)                 # shuffle stream, like a restart
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    m = Model(net, inputs=[hapi.Input([-1, 4], "float32", name="x")],
+              labels=[hapi.Input([-1, 1], "float32", name="y")])
+    m.prepare(optimizer=fluid.optimizer.Adam(learning_rate=0.01),
+              loss=lambda p, y: ((p - y) ** 2))
+    return m
+
+
+def _hapi_data(n=32):
+    rng = np.random.RandomState(0)
+    return [(rng.rand(4).astype(np.float32),
+             rng.rand(1).astype(np.float32)) for _ in range(n)]
+
+
+from paddle_tpu.hapi.callbacks import Callback as _HapiCallback
+
+
+class _BatchLossRecorder(_HapiCallback):
+    """Callback that materialises every per-step loss (the parity unit
+    the acceptance criterion names)."""
+
+    def __init__(self):
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(float(logs["loss"][0]))
+
+
+class _PreemptAfter(_HapiCallback):
+    """Callback that raises the preemption flag after N batches — the
+    in-process stand-in for the platform's SIGTERM."""
+
+    def __init__(self, n):
+        self.n = n
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.seen += 1
+        if self.seen == self.n:
+            elastic.current_context().request_preemption("test")
+
+
+class TestHapiAutoResume:
+    def test_epoch_boundary_resume_bit_identical(self, tmp_path):
+        data = _hapi_data()
+        with core.scope_guard(core.Scope()):
+            rec = _BatchLossRecorder()
+            m1 = _fresh_hapi_model()
+            m1.fit(data, batch_size=8, epochs=4, shuffle=True, verbose=0,
+                   callbacks=[rec])
+            base = rec.losses
+        with core.scope_guard(core.Scope()):
+            rec_a = _BatchLossRecorder()
+            m2 = _fresh_hapi_model()
+            m2.fit(data, batch_size=8, epochs=2, shuffle=True, verbose=0,
+                   checkpoint_dir=str(tmp_path), callbacks=[rec_a])
+        assert latest_checkpoint_step(str(tmp_path)) is not None
+        with core.scope_guard(core.Scope()):
+            rec_b = _BatchLossRecorder()
+            m3 = _fresh_hapi_model()
+            m3.fit(data, batch_size=8, epochs=4, shuffle=True, verbose=0,
+                   checkpoint_dir=str(tmp_path), callbacks=[rec_b])
+        assert rec_a.losses + rec_b.losses == base
+
+    def test_mid_epoch_preemption_resume_bit_identical(self, tmp_path):
+        """Preemption strikes mid-epoch (batch 6 of a 4-batch/epoch run,
+        i.e. inside epoch 1): fit drains, snapshots with an exact
+        (epoch, batch) cursor + the epoch-start RNG, sets .preempted,
+        and the restarted fit replays the same shuffle and continues to
+        bit-identical per-step losses."""
+        data = _hapi_data()
+        with core.scope_guard(core.Scope()):
+            rec = _BatchLossRecorder()
+            m1 = _fresh_hapi_model()
+            m1.fit(data, batch_size=8, epochs=3, shuffle=True, verbose=0,
+                   callbacks=[rec])
+            base = rec.losses               # 12 per-step losses
+        with core.scope_guard(core.Scope()):
+            rec_a = _BatchLossRecorder()
+            m2 = _fresh_hapi_model()
+            m2.fit(data, batch_size=8, epochs=3, shuffle=True, verbose=0,
+                   checkpoint_dir=str(tmp_path),
+                   callbacks=[rec_a, _PreemptAfter(6)])
+            assert m2.preempted
+        mk = read_resume_marker(str(tmp_path))
+        assert mk is not None
+        with core.scope_guard(core.Scope()):
+            rec_b = _BatchLossRecorder()
+            m3 = _fresh_hapi_model()
+            m3.fit(data, batch_size=8, epochs=3, shuffle=True, verbose=0,
+                   checkpoint_dir=str(tmp_path), callbacks=[rec_b])
+            assert not m3.preempted
+        assert len(rec_a.losses) == 6
+        assert rec_a.losses + rec_b.losses == base
+
+    def test_checkpoint_dir_requires_static_mode(self, tmp_path):
+        from paddle_tpu.dygraph import base as dybase
+        from paddle_tpu.hapi.model import Model
+        import paddle_tpu.nn as nn
+        dybase.enable_dygraph()
+        try:
+            m = Model(nn.Linear(2, 2))
+            m.prepare(loss=lambda p: p)
+            with pytest.raises(ValueError, match="static"):
+                m.fit(_hapi_data(4), batch_size=2, epochs=1,
+                      checkpoint_dir=str(tmp_path))
+        finally:
+            dybase.disable_dygraph()
+
+
+# ---------------------------------------------------------------------------
+# distributed trainer loop: periodic snapshots + preemption drain
+# ---------------------------------------------------------------------------
+
+class TestTrainerPreemption:
+    def _dataset(self, tmp_path, lines=64):
+        rng = np.random.RandomState(0)
+        p = tmp_path / "part-0.txt"
+        rows = []
+        for _ in range(lines):
+            sid = rng.randint(0, 50)
+            feat = rng.randn(4)
+            label = float(feat.sum() > 0)
+            rows.append("1 %d 4 %f %f %f %f 1 %f"
+                        % (sid, *feat.tolist(), label))
+        p.write_text("\n".join(rows) + "\n")
+        ids = fluid.data("ids", [-1, 1], dtype="int64")
+        feat = fluid.data("feat", [-1, 4])
+        label = fluid.data("label", [-1, 1])
+        emb = fluid.layers.embedding(ids, size=[50, 4])
+        emb = fluid.layers.reshape(emb, [-1, 4])
+        h = fluid.layers.concat([emb, feat], axis=1)
+        pred = fluid.layers.fc(h, 1, act="sigmoid")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, label))
+        fluid.optimizer.SGDOptimizer(0.5).minimize(loss)
+        ds = fluid.DatasetFactory().create_dataset("QueueDataset")
+        ds.set_batch_size(8)
+        ds.set_use_var([ids, feat, label])
+        ds.set_filelist([str(p)])
+        return ds, loss
+
+    def test_periodic_and_preempt_snapshots(self, tmp_path):
+        from paddle_tpu.distributed.trainer import run_from_dataset
+        ds, loss = self._dataset(tmp_path)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        root = str(tmp_path / "ckpt")
+        cm = CheckpointManager(root)
+
+        class _AfterSteps(elastic.PreemptionProbe):
+            def __init__(self):
+                self.count = 0
+
+            def should_preempt(self):
+                # polled once per step by the loop: preempt after 4
+                self.count += 1
+                return self.count > 4
+
+        with ElasticContext(cm, probe=_AfterSteps(),
+                            install_signal_handlers=False):
+            run_from_dataset(
+                exe, fluid.default_main_program(), ds,
+                fetch_list=[loss], print_period=1000,
+                checkpoint_manager=cm, checkpoint_every=2)
+        stats = exe._last_trainer_stats
+        assert stats.preempted
+        assert stats.steps == 4                 # 4 trained, then drained
+        cm.wait()
+        mk = read_resume_marker(root)
+        assert mk is not None and mk["step"] == 4
+        st = CheckpointManager(root).restore(
+            program=fluid.default_main_program(), executor=exe)
+        assert st.cursor == {"dataset_step": 4}
+        assert st.reason == "preempt"
+
+        # restart: start_step fast-forwards past trained batches
+        clear_resume_marker(root)
+        run_from_dataset(
+            exe, fluid.default_main_program(), ds,
+            fetch_list=[loss], print_period=1000,
+            start_step=st.cursor["dataset_step"])
+        stats2 = exe._last_trainer_stats
+        assert not stats2.preempted
+        assert stats2.steps == 8                # cursor 8 = 4 skipped + 4 run
+
+    def test_periodic_cursor_excludes_buffered_scan_group(self, tmp_path):
+        # steps_per_dispatch=4: submits 1-3 sit buffered in the runner
+        # (not yet in the scope), so the periodic snapshot at loop step 2
+        # must record cursor 0, not 2 — a resume from it must not skip
+        # batches whose updates the checkpoint never saw
+        from paddle_tpu.distributed.trainer import run_from_dataset
+        ds, loss = self._dataset(tmp_path)
+        fluid.default_main_program()._hints["steps_per_dispatch"] = 4
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        root = str(tmp_path / "ckpt")
+        cm = CheckpointManager(root, async_save=False)
+
+        class _AfterSteps(elastic.PreemptionProbe):
+            def __init__(self):
+                self.count = 0
+
+            def should_preempt(self):
+                self.count += 1
+                return self.count > 4
+
+        with ElasticContext(cm, probe=_AfterSteps(),
+                            install_signal_handlers=False):
+            run_from_dataset(
+                exe, fluid.default_main_program(), ds,
+                fetch_list=[loss], print_period=0,
+                checkpoint_manager=cm, checkpoint_every=2)
+        cm.wait()
+        # step-2 periodic snapshot had 2 buffered submits -> cursor 0;
+        # step-4 snapshot followed a full group dispatch -> cursor 4; the
+        # preempt re-save of step 4 keeps cursor 4 (drain completed all)
+        assert list_checkpoint_steps(root) == [0, 4]
+        st0 = CheckpointManager(root).restore(
+            program=fluid.default_main_program(), executor=exe, step=0)
+        assert st0.cursor == {"dataset_step": 0}
+        st = CheckpointManager(root).restore(
+            program=fluid.default_main_program(), executor=exe)
+        assert st.step == 4 and st.cursor == {"dataset_step": 4}
+        assert st.reason == "preempt"
+
+
+# ---------------------------------------------------------------------------
+# observability: the new instruments exist and move
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_ckpt_counters_and_spans(self, tmp_path):
+        trace.enable()
+        try:
+            with core.scope_guard(core.Scope()):
+                main, startup, loss, opt = _build_mlp()
+                exe = fluid.Executor()
+                exe.run(startup)
+                m = trace.metrics()
+                s0 = m.counter("ckpt.saves").value
+                b0 = m.counter("ckpt.bytes").value
+                r0 = m.counter("ckpt.restores").value
+                cm = CheckpointManager(str(tmp_path))
+                cm.save(program=main, executor=exe, step=1, sync=True)
+                cm.restore(program=main, executor=exe)
+                assert m.counter("ckpt.saves").value == s0 + 1
+                assert m.counter("ckpt.bytes").value > b0
+                assert m.counter("ckpt.restores").value == r0 + 1
+                assert m.histogram("ckpt.save_seconds").count >= 1
+                assert m.histogram("ckpt.restore_seconds").count >= 1
+            names = {e.get("name") for e in trace.get_events()}
+            assert "checkpoint::save" in names
+            assert "checkpoint::restore" in names
+        finally:
+            trace.disable()

@@ -336,6 +336,161 @@ def main():
     print(f"[smoke]   amp: {inserted} casts inserted, {pruned} pruned "
           f"({pruned/inserted:.0%}), 1 compile, loss parity OK", flush=True)
 
+    step("elastic: crash-safe save, warm-restart SLO, no step-window stall")
+    import json
+    import shutil
+    import tempfile
+
+    from paddle_tpu.fluid.checkpoint import (CheckpointManager,
+                                             InjectedCrash, faults,
+                                             list_checkpoint_steps)
+
+    elastic_dir = tempfile.mkdtemp(prefix="smoke-elastic-")
+    try:
+        # -- gate 1: a crash-injected save leaves a loadable newest-intact
+        # checkpoint (the mid-save process death never corrupts state)
+        ck_root = os.path.join(elastic_dir, "ckpt")
+        reset_unique_name()
+        mp6, sp6, lo6 = build_demo()
+        ex6 = fluid.Executor()
+        with scope_guard(Scope()):
+            ex6.run(sp6)
+            losses6 = [float(np.asarray(
+                ex6.run(mp6, feed=demo_feed, fetch_list=[lo6])[0]).ravel()[0])
+                for _ in range(4)]
+            cm6 = CheckpointManager(ck_root)
+            cm6.save(program=mp6, executor=ex6, step=2, sync=True)
+            faults.arm("crash_after_tmp_write")
+            try:
+                cm6.save(program=mp6, executor=ex6, step=4, sync=True)
+                raise AssertionError("injected crash did not fire")
+            except InjectedCrash:
+                pass
+            assert list_checkpoint_steps(ck_root) == [2], \
+                "crashed save must commit nothing"
+        reset_unique_name()
+        mp6b, sp6b, lo6b = build_demo()
+        ex6b = fluid.Executor()
+        with scope_guard(Scope()):
+            ex6b.run(sp6b)
+            st6 = CheckpointManager(ck_root).restore(program=mp6b,
+                                                     executor=ex6b)
+            assert st6 is not None and st6.step == 2
+            ex6b.run(mp6b, feed=demo_feed, fetch_list=[lo6b])
+        print("[smoke]   crash-injected save: newest-intact checkpoint "
+              "loadable OK", flush=True)
+
+        # -- gate 2: async snapshots add no step-window stall — armed
+        # slow-disk IO (1s total) rides the writer thread, not the loop
+        def step_loop(ckpt_root=None):
+            reset_unique_name()
+            mpA, spA, loA = build_demo()
+            exA = fluid.Executor()
+            with scope_guard(Scope()):
+                exA.run(spA)
+                cmA = CheckpointManager(ckpt_root) if ckpt_root else None
+                runner = AsyncStepRunner(exA, mpA, [loA], max_inflight=2)
+                runner.submit(dict(demo_feed)).result()  # warm compile
+                t0 = time.perf_counter()
+                for i in range(8):
+                    runner.submit(dict(demo_feed))
+                    if cmA is not None and (i + 1) % 4 == 0:
+                        cmA.save(program=mpA, executor=exA, step=i + 1)
+                runner.drain()
+                wall = time.perf_counter() - t0
+                if cmA is not None:
+                    cmA.wait()
+                    assert list_checkpoint_steps(ckpt_root) == [4, 8]
+                    cmA.close()
+            return wall
+
+        wall_base = step_loop()
+        injected_s = 1.0
+        faults.arm("slow_disk", times=4, delay=injected_s / 4)
+        wall_ckpt = step_loop(os.path.join(elastic_dir, "ckpt-async"))
+        faults.clear()
+        stall = wall_ckpt - wall_base
+        assert stall < injected_s / 2, \
+            (f"async checkpoint stalled the step window {stall:.2f}s "
+             f"against {injected_s:.1f}s of injected IO")
+        print(f"[smoke]   async snapshot stall {max(stall, 0)*1e3:.0f}ms "
+              f"over {injected_s:.1f}s slow-disk IO (loop {wall_base*1e3:.0f}"
+              f"ms -> {wall_ckpt*1e3:.0f}ms) OK", flush=True)
+
+        # -- gate 3: restart-to-first-step SLO on a warm persistent
+        # compile cache (PR-2): the restarted process restores the newest
+        # checkpoint and reaches its first post-resume step with ZERO cold
+        # compiles, inside the budget
+        slo_s = float(os.environ.get("GRAFT_ELASTIC_SLO_S", "60"))
+        child_code = (
+            "import json, time\n"
+            "t_start = time.perf_counter()\n"
+            "import numpy as np\n"
+            "import paddle_tpu.fluid as fluid\n"
+            "from paddle_tpu.fluid import trace\n"
+            "main, startup = fluid.Program(), fluid.Program()\n"
+            "with fluid.program_guard(main, startup):\n"
+            "    x = fluid.data('x', [-1, 16])\n"
+            "    y = fluid.data('y', [-1, 1], dtype='int64')\n"
+            "    h = fluid.layers.fc(x, 32, act='relu')\n"
+            "    logits = fluid.layers.fc(h, 10)\n"
+            "    loss = fluid.layers.mean(\n"
+            "        fluid.layers.softmax_with_cross_entropy(logits, y))\n"
+            "    fluid.optimizer.AdamOptimizer(1e-2).minimize(loss)\n"
+            "exe = fluid.Executor()\n"
+            "rng = np.random.RandomState(0)\n"
+            "feed = {'x': rng.randn(8, 16).astype('float32'),\n"
+            "        'y': rng.randint(0, 10, (8, 1)).astype('int64')}\n"
+            "cm = fluid.CheckpointManager({ROOT})\n"
+            "st = cm.restore(program=main, executor=exe)\n"
+            "if st is None:\n"
+            "    exe.run(startup)\n"
+            "    for _ in range(3):\n"
+            "        exe.run(main, feed=feed, fetch_list=[loss])\n"
+            "    cm.save(program=main, executor=exe, sync=True)\n"
+            "    print(json.dumps({'phase': 'cold'}))\n"
+            "else:\n"
+            "    t_restored = time.perf_counter()\n"
+            "    exe.run(main, feed=feed, fetch_list=[loss])\n"
+            "    t_first = time.perf_counter()\n"
+            "    m = trace.metrics()\n"
+            "    print(json.dumps({'phase': 'resume',\n"
+            "        'total_s': t_first - t_start,\n"
+            "        'restore_to_step_s': t_first - t_restored,\n"
+            "        'cold': m.counter("
+            "'executor.compile_cache_cold_miss').value,\n"
+            "        'phit': m.counter("
+            "'executor.compile_cache_persistent_hit').value}))\n"
+        ).replace("{ROOT}", repr(os.path.join(elastic_dir, "ckpt-slo")))
+        env6 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    FLAGS_persistent_cache_dir=os.path.join(elastic_dir,
+                                                            "xla-cache"))
+
+        def run_child():
+            r6 = subprocess.run([sys.executable, "-c", child_code],
+                                env=env6, cwd=_ROOT, capture_output=True,
+                                text=True, timeout=300)
+            assert r6.returncode == 0, r6.stderr
+            line = [ln for ln in r6.stdout.splitlines()
+                    if ln.startswith("{")][-1]
+            return json.loads(line)
+
+        first = run_child()
+        assert first["phase"] == "cold", first
+        resume = run_child()
+        assert resume["phase"] == "resume", resume
+        assert resume["cold"] == 0, \
+            f"restart cold-compiled {resume['cold']}x (want 0: warm cache)"
+        assert resume["total_s"] < slo_s, \
+            (f"restart-to-first-step {resume['total_s']:.1f}s exceeds the "
+             f"{slo_s:.0f}s SLO")
+        print(f"[smoke]   restart-to-first-step {resume['total_s']:.1f}s "
+              f"(restore+step {resume['restore_to_step_s']*1e3:.0f}ms, "
+              f"0 cold compiles, {resume['phit']} persistent hits) "
+              f"within {slo_s:.0f}s SLO OK", flush=True)
+    finally:
+        shutil.rmtree(elastic_dir, ignore_errors=True)
+
     step("bench child emits one JSON line (cpu)")
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],
