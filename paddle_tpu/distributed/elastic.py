@@ -222,4 +222,8 @@ class ElasticContext:
             rng_state=rng_state, sync=True, reason="preempt")
         write_resume_marker(manager.root, committed,
                             reason=self._reason or "preempt")
+        # goodput/SLO surface: the committed resume cursor as a gauge, so
+        # a metrics scrape (or JSONL snapshot) taken between the drain
+        # and process exit records how far this incarnation got
+        trace.metrics().gauge("elastic.last_drain_step").set(committed)
         return committed

@@ -44,11 +44,25 @@ from . import metrics
 from . import dataset
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from . import trace
+from . import goodput
 from . import profiler
 from . import monitor
 from .reader import DataLoader
 
 core.init_signal_handlers()
+
+# live metrics export (fluid/metrics_export.py): env-gated like the trace
+# plane — `FLAGS_metrics_port=9090 python train.py` serves /metrics with
+# no code changes, and a snapshot path starts the JSONL writer.  Lazy:
+# the module is only imported when a flag asks for it.
+if core.get_flag("metrics_port") or core.get_flag("metrics_snapshot_path"):
+    try:
+        from . import metrics_export as _metrics_export
+        _metrics_export.apply_flags()
+    except Exception as _e:             # noqa: BLE001 — export is advisory
+        import sys as _sys
+        print(f"paddle_tpu: WARNING: metrics export failed to start: "
+              f"{type(_e).__name__}: {_e}", file=_sys.stderr)
 
 
 def name_scope(prefix=None):

@@ -472,6 +472,7 @@ class AsyncStepRunner:
         if not self._inflight:
             return
         handles = self._inflight.popleft()
+        _sp = trace.now() if trace.enabled() else 0
         t0 = time.perf_counter()
         for h in handles:
             if h._check_nan:
@@ -481,6 +482,12 @@ class AsyncStepRunner:
                 h.persist()
             else:
                 h.block_until_ready()
+        if _sp:
+            # goodput plane: host blocked on device results = the device
+            # was the bottleneck doing productive work — this span is
+            # what charges backpressure to the device_compute bucket
+            trace.complete("executor::host_wait", _sp, cat="step",
+                           args={"n_handles": len(handles)})
         m = trace.metrics()
         m.histogram("executor.host_wait_seconds").observe(
             time.perf_counter() - t0)

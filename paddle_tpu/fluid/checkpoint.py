@@ -398,15 +398,31 @@ class CheckpointManager:
         }
         job = _SaveJob(int(step), handles, meta,
                        sync=sync or not self.async_save)
+        _t0 = trace.now()
         if job.sync:
             self._run_job(job)
+            # step-window stall truth for the goodput plane: a sync save
+            # blocks the caller for its whole duration...
+            trace.metrics().histogram("ckpt.stall_seconds").observe(
+                (trace.now() - _t0) / 1e9)
             if job.error is not None:
                 raise job.error
             return job.step
         self._ensure_worker()
         with self._lock:
             self._pending.append(job)
+        _sp = trace.now() if trace.enabled() else 0
         self._queue.put(job)        # maxsize=1: bounds snapshot retention
+        if _sp:
+            # ...while an async save only stalls for the enqueue (which
+            # blocks when a previous save is still writing) — this span
+            # is the slice goodput charges to checkpoint_stall, and its
+            # near-zero duration is the async-checkpointing win made
+            # visible
+            trace.complete("checkpoint::submit", _sp, cat="step",
+                           args={"step": job.step})
+        trace.metrics().histogram("ckpt.stall_seconds").observe(
+            (trace.now() - _t0) / 1e9)
         return job.step
 
     def wait(self) -> None:
@@ -455,8 +471,8 @@ class CheckpointManager:
         t0 = trace.now()
         try:
             with trace.span("checkpoint::save", cat="step",
-                            args={"step": job.step, "reason":
-                                  job.meta.get("reason")}):
+                            args={"step": job.step, "sync": job.sync,
+                                  "reason": job.meta.get("reason")}):
                 nbytes = self._write_checkpoint(job)
             m.counter("ckpt.saves").inc()
             m.counter("ckpt.bytes").inc(nbytes)

@@ -202,6 +202,39 @@ class PersistentCache:
         except OSError:
             return []
 
+    def entries(self) -> List[Dict[str, Any]]:
+        """Every readable index record (unreadable/corrupt ones are
+        skipped — the index degrades, it never throws at inspectors)."""
+        out = []
+        for k in self.keys():
+            meta = self.get(k)
+            if meta is not None:
+                out.append(dict(meta, key=k))
+        return out
+
+    def device_footprints(self) -> List[Dict[str, Any]]:
+        """Executables in the index that carry device-truth meta
+        (``device.flops`` / ``device.peak_bytes``, recorded by the
+        executor when FLAGS_device_cost_analysis captured them), sorted
+        by peak HBM bytes descending — what "which executable is
+        biggest?" tooling reads after the fact, without a live
+        process."""
+        rows = []
+        for meta in self.entries():
+            dev = meta.get("device") or {}
+            if dev.get("peak_bytes") or dev.get("flops"):
+                rows.append({"key": meta.get("key"),
+                             "fingerprint": str(
+                                 meta.get("fingerprint", ""))[:12],
+                             "bucket": meta.get("bucket"),
+                             "n_ops": meta.get("n_ops"),
+                             "flops": dev.get("flops"),
+                             "peak_bytes": dev.get("peak_bytes"),
+                             "argument_bytes": dev.get("argument_bytes")})
+        rows.sort(key=lambda r: float(r.get("peak_bytes") or 0),
+                  reverse=True)
+        return rows
+
 
 _instance: Optional[PersistentCache] = None
 

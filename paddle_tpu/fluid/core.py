@@ -160,6 +160,37 @@ _FLAGS: Dict[str, object] = {
         in _trace._TRUE_STRINGS,
     "checkpoint_shard_bytes": int(_os.environ.get(
         "FLAGS_checkpoint_shard_bytes", str(64 << 20))),
+    # live metrics export plane (fluid/metrics_export.py,
+    # docs/observability.md "Goodput & device memory").  metrics_port
+    # serves /metrics (Prometheus text) + /goodput (JSON) on a daemon
+    # thread (0 = off); the snapshot path/interval append periodic JSONL
+    # metrics rows for headless runs.  Both are exact no-ops when unset.
+    "metrics_port": int(_os.environ.get("FLAGS_metrics_port", "0") or 0),
+    # bind address for the export server.  Localhost by default: the
+    # registry names executables/checkpoints — serving beyond the host
+    # is an explicit opt-in (FLAGS_metrics_host=0.0.0.0 for fleet
+    # scrapers).
+    "metrics_host": _os.environ.get("FLAGS_metrics_host", "127.0.0.1"),
+    "metrics_snapshot_path": _os.environ.get(
+        "FLAGS_metrics_snapshot_path") or None,
+    "metrics_snapshot_interval_s": float(_os.environ.get(
+        "FLAGS_metrics_snapshot_interval_s", "60") or 60),
+    # device truth (fluid/device_stats.py): AOT cost/memory analysis of
+    # every freshly compiled executable.  "auto" = follows tracing;
+    # True/False force it.  The capture pays a second (only partially
+    # cached) XLA compile per compile MISS and nothing per step — which
+    # is why serving /metrics alone does NOT opt a run in.
+    "device_cost_analysis": _os.environ.get(
+        "FLAGS_device_cost_analysis", "auto"),
+    # rolling window for the goodput.ratio gauge and /goodput (seconds;
+    # 0 = whole run).  A bounded default keeps scrape cost O(window) on
+    # long traced runs: the live accumulator prunes intervals that can
+    # no longer enter a window, so attribution never re-sweeps hours of
+    # history per scrape.  Whole-run attribution stays available
+    # explicitly (goodput.snapshot(window_s=0) / attribute_events on an
+    # exported timeline).
+    "goodput_window_s": float(_os.environ.get(
+        "FLAGS_goodput_window_s", "600") or 600),
 }
 
 
@@ -210,6 +241,12 @@ def set_flags(flags: Dict[str, object]):
             # call and the first executor run also persist
             from . import compile_cache
             compile_cache.persistent_cache()
+        elif k in ("metrics_port", "metrics_host", "metrics_snapshot_path",
+                   "metrics_snapshot_interval_s"):
+            # reconcile the export surfaces with the new flag values
+            # (start, restart on a changed port/path, or stop on unset)
+            from . import metrics_export
+            metrics_export.apply_flags()
 
 
 def get_flags(names):

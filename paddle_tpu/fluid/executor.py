@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from . import compile_cache
 from . import core
+from . import device_stats
 from . import trace
 from .core import Scope, global_scope
 from .framework import Program, Block, Variable, default_main_program
@@ -106,7 +107,7 @@ class _CompiledBlock:
 
     def __init__(self, fn, param_names, written_names, fetch_names,
                  n_ops=None, raw_fn=None, donates=False, err_cell=None,
-                 alias_cell=None):
+                 alias_cell=None, jitted=None):
         self.fn = fn
         self.param_names = param_names
         self.written_names = written_names
@@ -115,6 +116,10 @@ class _CompiledBlock:
         self.raw_fn = raw_fn        # un-jitted step (run_scan fuses over it)
         self.donates = donates      # jit donates the mutable-state args
         self.err_cell = err_cell    # deferred checkify error (lazy fetches)
+        # the lowerable jit wrapper (device_stats.capture AOT-analyses it
+        # for measured FLOPs / HBM footprint); None for step builders
+        # with no .lower (checkify wrapper, pipeline/PS custom loops)
+        self.jitted = jitted if hasattr(jitted, "lower") else None
         # per-fetch does-it-alias-scope-state mask, recorded by TRACER
         # identity at trace time (id() of the returned arrays is useless:
         # XLA may back a fetch and a state output with ONE buffer).  None
@@ -128,6 +133,16 @@ class _CompiledBlock:
         if self.alias_cell:
             return self.alias_cell[0]
         return (False,) * n_fetch
+
+
+def _unpublish_footprints(footprints):
+    """Retire every footprint in the dict from the gauges and the
+    process-wide aggregates — shared by Executor.close() and the
+    GC-time weakref finalizer (which holds this dict, not the
+    executor)."""
+    for fp in footprints.values():
+        device_stats.unpublish(fp.get("label", ""))
+    footprints.clear()
 
 
 def _batch_major_hint(block, op):
@@ -297,6 +312,13 @@ class Executor:
         # eval fetch of W must survive the train step donating W.
         # Weakrefs so handles the caller dropped cost nothing.
         self._alias_live: List[Any] = []
+        # device truth (fluid/device_stats.py): per-live-executable
+        # footprint records keyed like _cache, populated on compile when
+        # FLAGS_device_cost_analysis allows — eviction drops the record
+        # and its gauges, OOM errors get the top footprints attached
+        self._footprints: "OrderedDict[tuple, Dict[str, Any]]" = \
+            OrderedDict()
+        self._fp_finalizer = None   # GC-time unpublish (set on capture)
 
     # -- public API ---------------------------------------------------------
     def run(self, program: Optional[Program] = None,
@@ -443,7 +465,12 @@ class Executor:
         if compiled.donates:
             self._persist_alias_live()
         _t0 = trace.now() if tr_on else 0
-        fetches, new_vals = compiled.fn(mut, ro, feeds, step_key)
+        try:
+            fetches, new_vals = compiled.fn(mut, ro, feeds, step_key)
+        except Exception as e:          # noqa: BLE001 — OOM forensics only
+            if device_stats.is_oom(e):
+                device_stats.attach_oom_report(e, self.top_footprints())
+            raise
         if tr_on:
             # device-program launch span (per-step time; the per-op "op"
             # spans above are per-compile host cost)
@@ -460,12 +487,28 @@ class Executor:
                 trace.complete("executor::compile", _t0c, cat="compile",
                                args={"fingerprint": key[0][:12],
                                      "n_ops": compiled.n_ops})
+            # device truth AFTER the compile span closes: the AOT
+            # analysis pays a second (only partially cached) compile,
+            # which must not pollute executor.compile_seconds (it lands
+            # in xla.analysis_seconds instead).  Uncached runs
+            # (use_program_cache=False) miss on EVERY call — capturing
+            # there would put the analysis on the step path and grow
+            # _footprints without an eviction to retire it.
+            dinfo = self._capture_device_stats(
+                key, compiled, (mut, ro, feeds, step_key),
+                bucket=bucket) if use_program_cache else None
             if pcache is not None and not pwarm:
-                pcache.record(pkey, {
+                meta = {
                     "fingerprint": key[0], "feed_sig": list(feed_sig),
                     "fetch": list(fetch_names), "bucket": bucket,
                     "compile_seconds": round(compile_s, 4),
-                    "n_ops": compiled.n_ops})
+                    "n_ops": compiled.n_ops}
+                if dinfo is not None:
+                    meta["device"] = {
+                        "flops": dinfo.get("flops"),
+                        "peak_bytes": dinfo.get("peak_bytes"),
+                        "argument_bytes": dinfo.get("argument_bytes")}
+                pcache.record(pkey, meta)
         deferred_err = (compiled.err_cell.pop("err", None)
                         if compiled.err_cell else None)
         if bucket is not None and bucket != n_valid:
@@ -755,7 +798,8 @@ class Executor:
             jfn = jax.jit(scan_fn, donate_argnums=(0,) if donate else ())
             compiled = _CompiledBlock(jfn, base.param_names,
                                       base.written_names, fetch_names,
-                                      n_ops=base.n_ops, donates=donate)
+                                      n_ops=base.n_ops, donates=donate,
+                                      jitted=jfn)
             pending_compile = _t0
             if use_program_cache:
                 self._cache_store(key, compiled)
@@ -784,8 +828,13 @@ class Executor:
         if compiled.donates:
             self._persist_alias_live()
         _t0 = trace.now() if tr_on else 0
-        st_fetches, carry_end, st_extras = compiled.fn(mut, ro, stacked,
-                                                       keys)
+        try:
+            st_fetches, carry_end, st_extras = compiled.fn(mut, ro, stacked,
+                                                           keys)
+        except Exception as e:          # noqa: BLE001 — OOM forensics only
+            if device_stats.is_oom(e):
+                device_stats.attach_oom_report(e, self.top_footprints())
+            raise
         if tr_on:
             trace.complete("executor::step", _t0, cat="step",
                            args={"step": self._step - k_steps,
@@ -801,6 +850,10 @@ class Executor:
                                args={"fingerprint": key[0][:12],
                                      "scan": k_steps,
                                      "n_ops": compiled.n_ops})
+            if use_program_cache:   # uncached scans miss every call
+                self._capture_device_stats(key, compiled,
+                                           (mut, ro, stacked, keys),
+                                           bucket=bucket, scan=k_steps)
         for n, v in carry_end.items():
             scope.set_var(n, v)
         for n, v in st_extras.items():
@@ -834,12 +887,70 @@ class Executor:
 
     def _cache_store(self, key, compiled):
         """Insert into the LRU-bounded executable cache
-        (FLAGS_executor_cache_capacity), counting evictions."""
+        (FLAGS_executor_cache_capacity), counting evictions.  Evicting
+        an executable also retires its device-footprint record and
+        gauges — and, when tracing, names the evictee and its HBM
+        footprint so eviction decisions are auditable."""
         self._cache[key] = compiled
         cap = int(core.get_flag("executor_cache_capacity", 128) or 0)
         while cap > 0 and len(self._cache) > cap:
-            self._cache.popitem(last=False)
+            old_key, _ = self._cache.popitem(last=False)
             trace.metrics().counter("executor.compile_cache_evict").inc()
+            fp = self._footprints.pop(old_key, None)
+            if fp is not None:
+                device_stats.unpublish(fp.get("label", ""))
+                if trace.enabled():
+                    trace.instant(
+                        "compile_cache_evict", cat="compile",
+                        args={"label": fp.get("label"),
+                              "peak_bytes": fp.get("peak_bytes")})
+
+    # -- device truth (fluid/device_stats.py) --------------------------------
+    def _capture_device_stats(self, key, compiled, example_args,
+                              bucket=None, scan=None):
+        """AOT cost/memory analysis of a freshly compiled executable,
+        published as per-executable gauges and kept beside the LRU for
+        OOM forensics.  Runs only on a compile miss and only when
+        FLAGS_device_cost_analysis allows — never on the step path."""
+        if compiled.jitted is None or not device_stats.capture_enabled():
+            return None
+        # label salt includes THIS executor: two Executors compiling the
+        # same (program, scope) produce identical cache keys, and a
+        # shared label would let one executor's close()/eviction retire
+        # the other's still-resident footprint from the process-wide
+        # aggregates
+        label = (key[0][:8] + "-"
+                 + hashlib.sha1(repr((id(self), key)).encode())
+                 .hexdigest()[:6])
+        info = device_stats.capture(compiled.jitted, example_args,
+                                    label=label)
+        if info is None:
+            return None
+        info["bucket"] = bucket
+        info["n_ops"] = compiled.n_ops
+        if scan:
+            info["scan"] = scan
+        self._footprints[key] = info
+        # publish maintains the per-executable gauges AND the
+        # process-wide xla.mem.lru_* aggregates (device_stats._agg —
+        # shared across every Executor in the process)
+        device_stats.publish(label, info)
+        if self._fp_finalizer is None:
+            # an Executor dropped WITHOUT close() must still retire its
+            # footprints, or the process-wide aggregates over-report
+            # dead executables forever.  The finalizer holds only the
+            # footprint dict (never self — that would defeat GC).
+            import weakref
+            self._fp_finalizer = weakref.finalize(
+                self, _unpublish_footprints, self._footprints)
+        return info
+
+    def top_footprints(self, n: int = 5):
+        """The n biggest live executables by XLA-reported peak bytes —
+        what a RESOURCE_EXHAUSTED error gets attached (OOM forensics
+        names executables, not guesses)."""
+        return sorted(self._footprints.values(),
+                      key=device_stats.peak_bytes_of, reverse=True)[:n]
 
     def _note_recompile(self, feed_sig, bucket, tr_on):
         """Recompile-storm detection: a burst of compile misses means
@@ -921,7 +1032,7 @@ class Executor:
                 block, plan, mesh, microbatches, fetch_names, mesh_axes,
                 is_test, written_names, example_env, list(feed))
             return _CompiledBlock(jfn, param_names, written_names,
-                                  fetch_names)
+                                  fetch_names, jitted=jfn)
 
         # --- recompute path (RecomputeOptimizer checkpoints) ---------------
         if checkpoints:
@@ -947,7 +1058,8 @@ class Executor:
                 # when donating — conservative, the guard persists every
                 # lazy fetch before the next donating dispatch
                 return _CompiledBlock(jfn, param_names, written_names,
-                                      fetch_names, donates=donate)
+                                      fetch_names, donates=donate,
+                                      jitted=jfn)
 
         # prune to fetch-reachable ops (framework/prune.cc analog):
         # persistable/scope-state writes (optimizer, BN stats, user scope
@@ -1079,7 +1191,8 @@ class Executor:
             jfn = jax.jit(fn, donate_argnums=(0,) if donate else ())
         return _CompiledBlock(jfn, param_names, written_names, fetch_names,
                               n_ops=len(run_ops), raw_fn=fn, donates=donate,
-                              err_cell=err_cell, alias_cell=alias_cell)
+                              err_cell=err_cell, alias_cell=alias_cell,
+                              jitted=jfn)
 
     # -- Trainer/dataset path (executor.cc:139-173 analog) ------------------
     def train_from_dataset(self, program, dataset, scope=None, thread=0,
@@ -1113,3 +1226,4 @@ class Executor:
                 pass                # unconsumed errors were best-effort
         self._async_runners.clear()
         self._cache.clear()
+        _unpublish_footprints(self._footprints)

@@ -1,0 +1,369 @@
+"""Live metrics export plane: Prometheus endpoint + JSONL snapshots.
+
+Reference: the reference fleet scrapes metrics off running trainers
+(monitor.h counters exposed to the production monitoring plane).
+TPU-native, the analog is two stdlib-only surfaces over the unified
+metrics registry (fluid/trace.py):
+
+* **HTTP endpoint** (``FLAGS_metrics_port``): a daemon-thread
+  ``http.server`` serving
+
+  - ``/metrics`` — the full registry in Prometheus text exposition
+    format (counters/gauges as-is, histograms as summaries with
+    p50/p95/p99 quantile lines from the bucket estimates);
+  - ``/goodput`` — the goodput attribution report as JSON (exact
+    span-based when tracing is on, the metrics-totals estimate
+    otherwise);
+  - ``/healthz`` — liveness.
+
+  Every scrape renders from a point-in-time ``registry.items()`` list
+  with each instrument read under its own lock, so concurrent training
+  threads never produce torn lines.  ``port=0`` binds an ephemeral port
+  (tests); the bound port is on ``MetricsServer.port``.
+
+* **JSONL snapshot writer** (``FLAGS_metrics_snapshot_path`` /
+  ``FLAGS_metrics_snapshot_interval_s``): for headless runs with no
+  scraper, a background thread appends one JSON line per interval —
+  ``{"ts", "uptime_s", "metrics": {...}, "goodput": {...}}`` — and a
+  final line at shutdown.  Lines are self-contained (json.loads
+  round-trips each).
+
+Both degrade to exact no-ops when their flags are unset: nothing is
+imported on the training path, no thread starts, and the hot path keeps
+its single-boolean-off contract.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from . import goodput
+from . import trace
+
+__all__ = [
+    "prometheus_text", "sanitize_metric_name", "goodput_payload",
+    "MetricsServer", "SnapshotWriter", "write_snapshot",
+    "start_http", "stop_http", "start_snapshots", "stop_snapshots",
+    "apply_flags", "shutdown",
+]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _uptime_s() -> float:
+    """Process wall so far, measured against the TRACE epoch (trace.py
+    is imported with fluid, at process start) — not this module's import
+    time, which can be hours later when the export plane is enabled
+    mid-run via set_flags."""
+    return trace.elapsed_us() / 1e6
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Registry names use dots/slashes; Prometheus wants
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    out = _NAME_RE.sub("_", str(name))
+    if not out or not (out[0].isalpha() or out[0] in "_:"):
+        out = "_" + out
+    return out
+
+
+def prometheus_text(registry: Optional[trace.MetricsRegistry] = None) -> str:
+    """The whole registry in Prometheus text exposition format (0.0.4).
+
+    Counters/gauges are single samples; histograms render as summaries
+    (quantile lines from the bucket-estimated p50/p95/p99 plus
+    ``_sum``/``_count``).  The instrument list is snapshotted first and
+    each read is lock-guarded by the instrument itself, so a scrape
+    racing a training loop sees consistent individual values and never a
+    torn line."""
+    reg = registry or trace.metrics()
+    lines = []
+    for name, inst in reg.items():
+        pname = sanitize_metric_name(name)
+        if isinstance(inst, trace.Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {inst.value}")
+        elif isinstance(inst, trace.Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_fmt(inst.value)}")
+        elif isinstance(inst, trace.Histogram):
+            s = inst.stats()
+            lines.append(f"# TYPE {pname} summary")
+            for q, key in (("0.5", "p50"), ("0.95", "p95"),
+                           ("0.99", "p99")):
+                lines.append(
+                    f'{pname}{{quantile="{q}"}} {_fmt(s[key])}')
+            lines.append(f"{pname}_sum {_fmt(s['total'])}")
+            lines.append(f"{pname}_count {int(s['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f != f:                          # NaN — Prometheus spells both
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def goodput_payload() -> Dict[str, Any]:
+    """The /goodput JSON body: exact span attribution when the trace
+    plane is on, the metrics-totals estimate otherwise (both refresh
+    the ``goodput.*`` gauges so the Prometheus view agrees)."""
+    try:
+        if trace.enabled():
+            rep = goodput.update_gauges()
+        else:
+            rep = goodput.publish_gauges(
+                goodput.from_metrics(_uptime_s()))
+    except Exception as e:              # noqa: BLE001 — a scrape must
+        return {"error": f"{type(e).__name__}: {e}"}       # never crash
+    return rep
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-metrics/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):                   # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path in ("/", "/metrics"):
+            # refresh the goodput gauges so a plain Prometheus scrape
+            # carries goodput_ratio without a second endpoint
+            goodput_payload()
+            body = prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/goodput":
+            body = json.dumps(goodput_payload(), default=str).encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body = b"ok\n"
+            ctype = "text/plain"
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):       # scrapes are not stderr news
+        pass
+
+
+class MetricsServer:
+    """The /metrics HTTP surface on a daemon thread.  ``port=0`` binds
+    ephemeral; read the real one from ``.port``."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="metrics-http",
+            daemon=True)
+        self._thread.start()
+        trace.metrics().gauge("metrics.export_port").set(self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)
+
+
+def write_snapshot(path: str) -> Dict[str, Any]:
+    """Append one self-contained JSONL metrics snapshot (histograms as
+    their full stats dicts incl. p50/p95/p99) and return the row."""
+    row = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "uptime_s": round(_uptime_s(), 3),
+        "metrics": trace.metrics().snapshot(),
+        "goodput": goodput_payload(),
+    }
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(row, default=str) + "\n")
+    return row
+
+
+class SnapshotWriter:
+    """Background JSONL snapshot loop for headless runs: one line every
+    ``interval_s``, plus a final line at ``stop()`` so short runs always
+    leave at least one record."""
+
+    def __init__(self, path: str, interval_s: float = 60.0):
+        self.path = str(path)
+        self.interval_s = max(0.01, float(interval_s))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="metrics-snapshot", daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self._write()
+
+    def _write(self):
+        try:
+            write_snapshot(self.path)
+        except Exception:               # noqa: BLE001 — a full disk must
+            trace.metrics().counter(    # not kill training
+                "metrics.snapshot_errors").inc()
+
+    def stop(self) -> None:
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._write()               # terminal snapshot
+
+
+# ---------------------------------------------------------------------------
+# module-level lifecycle (flag-driven)
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_server: Optional[MetricsServer] = None
+_server_flagged = False             # started by apply_flags (vs direct)
+_writer: Optional[SnapshotWriter] = None
+_writer_flagged = False
+_atexit_registered = False
+
+
+def _register_atexit():
+    global _atexit_registered
+    if not _atexit_registered:
+        _atexit_registered = True
+        import atexit
+        atexit.register(shutdown)
+
+
+def start_http(port: Optional[int] = None,
+               host: Optional[str] = None) -> MetricsServer:
+    """Start (or return) the process metrics server.  ``port=None``
+    reads FLAGS_metrics_port (and marks the server flag-managed, so
+    later ``apply_flags`` reconciliation may stop/restart it — a server
+    started with an explicit port is left alone).  ``host`` defaults to
+    FLAGS_metrics_host (127.0.0.1: the registry names executables and
+    checkpoints — exposing it beyond the host is an explicit opt-in via
+    FLAGS_metrics_host=0.0.0.0)."""
+    global _server, _server_flagged
+    from . import core
+    with _lock:
+        if _server is not None:
+            return _server
+        flagged = port is None
+        if port is None:
+            port = int(core.get_flag("metrics_port", 0) or 0)
+        if host is None:
+            host = str(core.get_flag("metrics_host", "127.0.0.1")
+                       or "127.0.0.1")
+        _server = MetricsServer(int(port), host=host)
+        _server_flagged = flagged
+        _register_atexit()
+        return _server
+
+
+def stop_http() -> None:
+    global _server, _server_flagged
+    with _lock:
+        srv, _server = _server, None
+        _server_flagged = False
+    if srv is not None:
+        srv.stop()
+        trace.metrics().gauge("metrics.export_port").set(0)
+
+
+def start_snapshots(path: Optional[str] = None,
+                    interval_s: Optional[float] = None) -> SnapshotWriter:
+    """Start (or return) the process snapshot writer.  ``path=None``
+    reads the flags and marks the writer flag-managed (like
+    :func:`start_http`: only flag-started surfaces are reconciled by
+    ``apply_flags``; a writer started with an explicit path belongs to
+    its caller)."""
+    global _writer, _writer_flagged
+    with _lock:
+        if _writer is not None:
+            return _writer
+        flagged = path is None
+        if path is None or interval_s is None:
+            from . import core
+            path = path or core.get_flag("metrics_snapshot_path")
+            if interval_s is None:
+                interval_s = float(
+                    core.get_flag("metrics_snapshot_interval_s", 60.0)
+                    or 60.0)
+        if not path:
+            raise ValueError("start_snapshots needs a path "
+                             "(FLAGS_metrics_snapshot_path)")
+        _writer = SnapshotWriter(str(path), interval_s)
+        _writer_flagged = flagged
+        _register_atexit()
+        return _writer
+
+
+def stop_snapshots() -> None:
+    global _writer, _writer_flagged
+    with _lock:
+        w, _writer = _writer, None
+        _writer_flagged = False
+    if w is not None:
+        w.stop()
+
+
+def apply_flags() -> None:
+    """Reconcile the running surfaces with the current flags — called
+    from ``fluid.core.set_flags`` and at import when the FLAGS_metrics_*
+    env vars are set.  Unset flags stop the corresponding surface, so
+    ``set_flags({"FLAGS_metrics_port": 0})`` is the off switch.  Only
+    flag-started servers are reconciled: one started programmatically
+    (``start_http(port=...)``, e.g. on an ephemeral port in tests)
+    belongs to its caller and is never stopped from here."""
+    from . import core
+    port = int(core.get_flag("metrics_port", 0) or 0)
+    host = str(core.get_flag("metrics_host", "127.0.0.1") or "127.0.0.1")
+    path = core.get_flag("metrics_snapshot_path")
+    interval = float(core.get_flag("metrics_snapshot_interval_s", 60.0)
+                     or 60.0)
+    with _lock:
+        server, flagged = _server, _server_flagged
+        writer, w_flagged = _writer, _writer_flagged
+    if server is None:
+        if port:
+            start_http()            # port=None: reads flags, stays
+    elif flagged:                   # flag-managed for later reconciles
+        if not port or server.port != port or server.host != host:
+            stop_http()
+            if port:
+                start_http()
+    if writer is None:
+        if path:
+            start_snapshots()       # path=None: reads flags, stays
+    elif w_flagged:
+        if not path or writer.path != str(path) \
+                or writer.interval_s != interval:
+            stop_snapshots()
+            if path:
+                start_snapshots()
+
+
+def shutdown() -> None:
+    """Stop both surfaces (atexit hook; the writer flushes a final
+    snapshot)."""
+    try:
+        stop_snapshots()
+    finally:
+        stop_http()

@@ -20,25 +20,67 @@ from . import trace
 
 
 class StatValue:
-    """Reference StatValue surface over a plane Counter (thread-safe)."""
+    """Reference StatValue surface over a plane instrument (thread-safe).
 
-    __slots__ = ("name", "_counter")
+    Binds to whatever already lives under ``name`` in the metrics
+    registry — Counter, Gauge (``goodput.ratio``, ``xla.mem.*``), or
+    Histogram.  Binding is lazy and READS never create: a
+    ``stat_get("xla.mem.lru_total_peak_bytes")`` issued before the
+    first compile returns 0 without registering a Counter under a name
+    the executor will later need as a Gauge (that poisoning would make
+    the plane's ``gauge()`` call raise TypeError mid-training).  Only a
+    WRITE (``increase``/``decrease``) on a still-unknown name creates
+    the legacy Counter.  ``get()`` on a gauge returns its float; on a
+    histogram, its observation count."""
+
+    __slots__ = ("name", "_m")
 
     def __init__(self, name: str):
         self.name = name
-        self._counter = trace.metrics().counter(name)
+        self._m = trace.metrics().get(name)     # bind if present only
 
-    def increase(self, n: int = 1) -> int:
-        return self._counter.add(n)
+    def _bound(self, create: bool = False):
+        if self._m is not None \
+                and trace.metrics().get(self.name) is not self._m:
+            # the registry retired this instrument (evicted-executable
+            # gauge): drop the pinned binding instead of serving its
+            # frozen value forever
+            self._m = None
+        if self._m is None:
+            if create:
+                # instrument(): bind-any-type-or-create under ONE lock
+                # acquisition, so a gauge created concurrently between a
+                # lookup and a counter() call can never raise
+                self._m = trace.metrics().instrument(
+                    self.name, default=trace.Counter)
+            else:
+                self._m = trace.metrics().get(self.name)
+        return self._m
 
-    def decrease(self, n: int = 1) -> int:
-        return self._counter.add(-n)
+    def increase(self, n: int = 1):
+        m = self._bound(create=True)
+        if isinstance(m, trace.Histogram):
+            raise TypeError(
+                f"stat '{self.name}' is a histogram — read-only through "
+                f"the monitor facade (observe via "
+                f"trace.metrics().histogram)")
+        return m.add(n)             # Counter.add / Gauge.add: both atomic
+
+    def decrease(self, n: int = 1):
+        return self.increase(-n)
 
     def reset(self) -> None:
-        self._counter.reset()
+        m = self._bound()
+        if m is not None:
+            m.reset()
 
-    def get(self) -> int:
-        return self._counter.value
+    def get(self):
+        m = self._bound()
+        if m is None:
+            return 0
+        if isinstance(m, trace.Histogram):
+            return m.stats()["count"]
+        return m.value
 
 
 class StatRegistry:
@@ -67,7 +109,23 @@ class StatRegistry:
                 stat = self._stats[name] = StatValue(name)
             return stat
 
-    def stats(self) -> List[Tuple[str, int]]:
+    def stats(self, prefix: str = None) -> List[Tuple[str, int]]:
+        """Registered stats as ``(name, value)``.  With ``prefix``, query
+        the PLANE registry instead: every instrument whose name starts
+        with it — e.g. ``stats(prefix="goodput.")`` or
+        ``stats(prefix="xla.mem.")`` surfaces the new gauges through the
+        legacy API.  Prefix queries read without registering StatValues,
+        so instruments a later eviction removes (per-executable
+        footprint gauges) don't linger here as stale copies."""
+        if prefix is not None:
+            out = []
+            for n, inst in trace.metrics().items():   # one lock pass
+                if not n.startswith(prefix):
+                    continue
+                v = inst.stats()["count"] \
+                    if isinstance(inst, trace.Histogram) else inst.value
+                out.append((n, v))
+            return out                  # items() is already name-sorted
         with self._lock:
             items = list(self._stats.items())
         return sorted((n, s.get()) for n, s in items)

@@ -48,8 +48,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "enabled", "enable", "disable", "now", "complete", "instant",
-    "counter_event", "add_event", "span", "get_events", "reset",
-    "reset_all", "set_path", "get_path", "set_max_events",
+    "counter_event", "add_event", "span", "get_events", "event_count",
+    "reset",
+    "reset_all", "set_path", "get_path", "set_max_events", "elapsed_us",
     "export_chrome_trace",
     "op_summary", "summary_table", "metrics", "MetricsRegistry",
     "Counter", "Gauge", "Histogram", "SORTED_KEYS",
@@ -80,6 +81,9 @@ class _State:
         self.max_events = int(os.environ.get("FLAGS_trace_max_events",
                                              "1000000"))
         self.dropped = 0
+        # bumped by reset(): incremental consumers (goodput) invalidate
+        # their cursor when the generation moves
+        self.generation = 0
 
 
 _state = _State()
@@ -158,6 +162,13 @@ def now() -> int:
 
 def _ts_us(t_ns: int) -> float:
     return (t_ns - _state.epoch_ns) / 1e3
+
+
+def elapsed_us() -> float:
+    """Now in the exported timeline's coordinate system (microseconds
+    since the trace epoch ≈ process start) — what goodput attribution
+    uses as its default window end."""
+    return _ts_us(now())
 
 
 def _append(ev: Dict[str, Any]) -> None:
@@ -262,9 +273,36 @@ def span(name: str, cat: str = "span",
     return _Span(name, cat, args)
 
 
-def get_events() -> List[Dict[str, Any]]:
+def get_events(start: int = 0) -> List[Dict[str, Any]]:
+    """Copy of the event buffer from index ``start`` (default: all).
+    Incremental consumers (goodput's live accumulator) pass their cursor
+    so a scrape copies only the new tail instead of holding the lock
+    across a full-buffer copy."""
     with _state.lock:
-        return list(_state.events)
+        return _state.events[start:] if start else list(_state.events)
+
+
+def event_count() -> int:
+    """Current buffer length."""
+    with _state.lock:
+        return len(_state.events)
+
+
+def buffer_generation() -> int:
+    """Monotonic reset() counter — incremental consumers drop their
+    cursor when this moves (length alone can't tell a reset that
+    restored the same count)."""
+    with _state.lock:
+        return _state.generation
+
+
+def dropped_count() -> int:
+    """Events dropped since the buffer filled (FLAGS_trace_max_events).
+    Nonzero means span-derived views (goodput attribution) are BLIND to
+    recent activity — consumers surface this as a degraded flag instead
+    of quietly reporting idle."""
+    with _state.lock:
+        return _state.dropped
 
 
 def reset() -> None:
@@ -275,6 +313,7 @@ def reset() -> None:
     with _state.lock:
         _state.events.clear()
         _state.dropped = 0
+        _state.generation += 1
 
 
 def reset_all() -> None:
@@ -336,6 +375,14 @@ class Gauge:
         with self._lock:
             self._value = float(v)
 
+    def add(self, n: float = 1.0) -> float:
+        """Atomic increment (the monitor facade bumps gauges through
+        this — a read-modify-write outside the lock would lose
+        concurrent updates)."""
+        with self._lock:
+            self._value += float(n)
+            return self._value
+
     @property
     def value(self) -> float:
         with self._lock:
@@ -385,11 +432,48 @@ class Histogram:
         with self._lock:
             return self.total / self.count if self.count else 0.0
 
+    def _percentile_locked(self, q: float) -> float:
+        """Estimate the q-quantile from the exponential buckets (caller
+        holds the lock).  Linear interpolation inside the bucket bounds —
+        accurate to a factor-of-4 bucket at worst, which is enough to
+        tell a 100us tail from a 10ms one without retaining samples."""
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        for i, n in enumerate(self._buckets):
+            if not n:
+                continue
+            if cum + n >= rank:
+                lo = 0.0 if i == 0 else self.BOUNDS[i - 1]
+                hi = self.BOUNDS[i]
+                if hi == float("inf"):      # open top bucket: best bound
+                    hi = self.max if self.max is not None else lo
+                    lo = min(lo, hi)
+                frac = (rank - cum) / n
+                v = lo + (hi - lo) * frac
+                # the true observed extremes are tighter than the bucket
+                if self.min is not None:
+                    v = max(v, self.min)
+                if self.max is not None:
+                    v = min(v, self.max)
+                return v
+            cum += n
+        return self.max if self.max is not None else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Bucket-estimated quantile, q in [0, 1]."""
+        with self._lock:
+            return self._percentile_locked(float(q))
+
     def stats(self) -> Dict[str, float]:
         with self._lock:
             return {"count": self.count, "total": self.total,
                     "min": self.min or 0.0, "max": self.max or 0.0,
-                    "avg": self.total / self.count if self.count else 0.0}
+                    "avg": self.total / self.count if self.count else 0.0,
+                    "p50": self._percentile_locked(0.50),
+                    "p95": self._percentile_locked(0.95),
+                    "p99": self._percentile_locked(0.99)}
 
     def buckets(self) -> List[Tuple[float, int]]:
         with self._lock:
@@ -434,6 +518,37 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
+
+    def instrument(self, name: str, default=Counter):
+        """The instrument registered under ``name`` whatever its type,
+        creating a ``default`` when absent — bind-or-create under one
+        lock acquisition, so the monitor facade's legacy STAT_ADD write
+        path can bind a concurrently-created gauge without a type
+        race."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = default(name)
+            return m
+
+    def get(self, name: str):
+        """The instrument under ``name``, or None (read-only lookup)."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def items(self) -> List[Tuple[str, Any]]:
+        """Point-in-time (name, instrument) list, sorted by name — the
+        Prometheus renderer iterates this; each instrument read is then
+        individually lock-guarded, so a concurrent scrape never sees a
+        torn value."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
+    def remove(self, name: str) -> None:
+        """Drop an instrument (per-executable gauges of an evicted
+        executable; no-op when absent)."""
+        with self._lock:
+            self._metrics.pop(name, None)
 
     def names(self) -> List[str]:
         with self._lock:

@@ -26,6 +26,9 @@ from ..fluid.monitor import (                                  # noqa: F401
     StatRegistry, stat_add, stat_sub, stat_get, print_stats)
 from ..utils.profiler import (                                 # noqa: F401
     Profiler, ProfilerOptions, get_profiler)
+from ..fluid import goodput                                    # noqa: F401
+from ..fluid import metrics_export                             # noqa: F401
+from ..fluid.goodput import attribute_events                   # noqa: F401
 
 __all__ = [
     # event stream
@@ -42,4 +45,6 @@ __all__ = [
     "profiler", "start_profiler", "stop_profiler", "reset_profiler",
     "RecordEvent", "record_event", "cuda_profiler",
     "Profiler", "ProfilerOptions", "get_profiler",
+    # goodput + live export plane
+    "goodput", "metrics_export", "attribute_events",
 ]

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 
@@ -97,14 +98,28 @@ class Prefetcher:
             self._put(e)
 
     # -- consumer -----------------------------------------------------------
+    def _get_blocking(self):
+        """One consumer dequeue, with the blocked time observed into the
+        ``loader.consume_wait_seconds`` histogram and (plane on) a
+        ``loader::wait`` span — the host_input_wait goodput bucket.  A
+        non-empty queue costs one perf_counter pair."""
+        _sp = self._trace.now() if self._trace.enabled() else 0
+        t0 = time.perf_counter()
+        item = self._q.get()
+        self._metrics.histogram("loader.consume_wait_seconds").observe(
+            time.perf_counter() - t0)
+        if _sp:
+            self._trace.complete("loader::wait", _sp, cat="step")
+        self._note_depth()
+        return item
+
     def __iter__(self) -> Iterator[Any]:
         if not self._started:
             self._started = True
             self._thread.start()
         try:
             while True:
-                item = self._q.get()
-                self._note_depth()
+                item = self._get_blocking()
                 if item is self._STOP:
                     return
                 if isinstance(item, BaseException):
@@ -118,8 +133,7 @@ class Prefetcher:
         if not self._started:
             self._started = True
             self._thread.start()
-        item = self._q.get()
-        self._note_depth()
+        item = self._get_blocking()
         if isinstance(item, BaseException):
             self.close()
             raise item

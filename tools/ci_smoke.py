@@ -491,7 +491,106 @@ def main():
     finally:
         shutil.rmtree(elastic_dir, ignore_errors=True)
 
-    step("bench child emits one JSON line (cpu)")
+    step("observability: goodput attribution, device footprints, "
+         "live metrics export")
+    import threading
+    import urllib.request
+    from paddle_tpu.fluid import trace as tr8, goodput, metrics_export
+
+    obs_dir = tempfile.mkdtemp(prefix="smoke-obs-")
+    fluid.core.set_flags({"FLAGS_enable_trace": True,
+                          "FLAGS_device_cost_analysis": True})
+    try:
+        t_gate_us = tr8.elapsed_us()
+        reset_unique_name()
+        mp8, sp8, lo8 = build_demo()
+        ex8 = fluid.Executor()
+        srv = metrics_export.start_http(port=0)
+        scrapes, scrape_err = [], []
+
+        def scrape_loop():
+            try:
+                for _ in range(4):
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/metrics",
+                        timeout=10).read().decode()
+                    scrapes.append(body)
+                    time.sleep(0.02)
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                scrape_err.append(e)
+
+        with scope_guard(Scope()):
+            ex8.run(sp8)
+            cm8 = CheckpointManager(os.path.join(obs_dir, "ckpt"))
+            # scrape concurrently with the training loop: the live
+            # endpoint must serve the registry WHILE counters mutate
+            scraper = threading.Thread(target=scrape_loop)
+            scraper.start()
+            for i in range(8):
+                ex8.run(mp8, feed=demo_feed, fetch_list=[lo8])
+                if i == 3:
+                    cm8.save(program=mp8, executor=ex8, step=i + 1,
+                             sync=True)
+            scraper.join(timeout=60)
+            cm8.close()
+        assert not scrape_err, scrape_err
+        assert not scraper.is_alive(), "metrics scrape deadlocked"
+
+        # gate 1: attribution is exhaustive and exclusive — the buckets
+        # sum to wall-clock (5% slack for float accumulation only) and
+        # the demo populated the compute/compile/checkpoint buckets
+        rep = goodput.snapshot(t0_us=t_gate_us)
+        total = sum(rep["buckets"].values())
+        assert abs(total - rep["wall_seconds"]) \
+            <= 0.05 * max(rep["wall_seconds"], 1e-9), (total, rep)
+        for b in ("device_compute", "compile", "checkpoint_stall"):
+            assert rep["buckets"][b] > 0, (b, rep)
+
+        # gate 2: device truth — per-executable HBM footprint gauges
+        names8 = tr8.metrics().names()
+        mem8 = [n for n in names8 if n.startswith("xla.mem.exe.")
+                and n.endswith(".peak_bytes")]
+        assert mem8 and any(tr8.metrics().gauge(n).value > 0
+                            for n in mem8), names8
+        assert tr8.metrics().gauge("xla.mem.lru_total_peak_bytes").value \
+            > 0
+
+        # gate 3: the concurrent scrapes served >=1 sample from each of
+        # the executor./ckpt./goodput. families, with no torn lines
+        assert len(scrapes) == 4, len(scrapes)
+        last = scrapes[-1]
+        for family in ("executor_", "ckpt_", "goodput_"):
+            assert any(ln.startswith(family) for ln in last.splitlines()
+                       if not ln.startswith("#")), (family, last[:2000])
+        gp8 = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/goodput", timeout=10)
+            .read().decode())
+        assert 0.0 <= gp8["ratio"] <= 1.0 and "buckets" in gp8, gp8
+
+        # gate 4: JSONL metrics snapshot round-trips
+        snap8 = os.path.join(obs_dir, "metrics.jsonl")
+        metrics_export.write_snapshot(snap8)
+        with open(snap8) as f:
+            row8 = json.loads(f.read().splitlines()[-1])
+        assert row8["metrics"]["executor.compile_cache_miss"] == \
+            tr8.metrics().counter("executor.compile_cache_miss").value
+        assert "goodput" in row8 and "p95" in \
+            row8["metrics"]["executor.compile_seconds"]
+        print(f"[smoke]   goodput {rep['ratio']:.0%} over "
+              f"{rep['wall_seconds']:.1f}s "
+              f"(compile {rep['buckets']['compile']*1e3:.0f}ms, ckpt "
+              f"{rep['buckets']['checkpoint_stall']*1e3:.0f}ms), "
+              f"{len(mem8)} executable footprints, 4 live scrapes OK",
+              flush=True)
+    finally:
+        metrics_export.stop_http()
+        fluid.core.set_flags({"FLAGS_enable_trace": False,
+                              "FLAGS_device_cost_analysis": "auto"})
+        tr8.reset()
+        shutil.rmtree(obs_dir, ignore_errors=True)
+
+    step("bench child emits one JSON line (cpu) with measured MFU + "
+         "goodput")
     r = subprocess.run(
         [sys.executable, "bench.py", "--quick"],
         env=dict(os.environ, GRAFT_BENCH_CHILD="1", JAX_PLATFORMS="cpu"),
@@ -499,6 +598,10 @@ def main():
         timeout=600)
     lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
     assert len(lines) == 1, r.stdout
+    info = json.loads(lines[0])
+    # mfu_measured (XLA cost_analysis) beside the analytic mfu
+    assert float(info.get("mfu_measured", 0.0)) > 0, info
+    assert "mfu" in info and "goodput" in info, info
 
     print(f"[smoke] OK in {time.time() - t0:.0f}s")
 

@@ -97,14 +97,80 @@ def validate_timeline(path_or_events):
     return evs
 
 
-def convert(trace_paths, out):
-    """Merge + validate + write the final chrome trace."""
+_GOODPUT_CNAMES = {
+    # chrome://tracing reserved color names, one per bucket so the track
+    # reads at a glance: green = productive, warm = badput, grey = init
+    "device_compute": "good",
+    "host_input_wait": "yellow",
+    "compile": "olive",
+    "checkpoint_stall": "bad",
+    "preemption_drain": "terrible",
+    "restart_init": "grey",
+    "idle": "white",
+}
+
+
+def _load_goodput():
+    """fluid/goodput.py by file path (it is stdlib-pure at import, like
+    trace.py), so the converter works outside an installed package."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "paddle_tpu", "fluid", "goodput.py")
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "paddle_tpu_goodput", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except (OSError, ImportError):
+        return None
+
+
+def goodput_track(events):
+    """Synthetic events for a dedicated per-process goodput track: the
+    wall-clock attribution rendered as one colored slice per bucket
+    segment, on a pid of its own above the real rows.  Processes with no
+    goodput-classified spans get no track."""
+    gp = _load_goodput()
+    if gp is None:
+        return []
+    pids = sorted({e.get("pid", 0) for e in events if e.get("ph") == "X"})
+    base_pid = max(pids, default=0) + 1
+    out = []
+    for i, pid in enumerate(pids):
+        evs = [e for e in events if e.get("pid") == pid]
+        rep = gp.attribute_events(evs, include_segments=True)
+        if not rep["classified_spans"]:
+            continue
+        tpid = base_pid + i
+        out.append({"name": "process_name", "ph": "M", "pid": tpid,
+                    "tid": 0, "args": {"name": f"goodput (pid {pid}, "
+                                               f"{rep['ratio']:.0%})"}})
+        for s, e, bucket in rep["segments"]:
+            out.append({"name": bucket, "cat": "goodput", "ph": "X",
+                        "ts": s, "dur": e - s, "pid": tpid, "tid": 0,
+                        "cname": _GOODPUT_CNAMES.get(bucket),
+                        "args": {"bucket": bucket}})
+    return out
+
+
+def convert(trace_paths, out, goodput=True):
+    """Merge + validate + write the final chrome trace, with the goodput
+    attribution rendered as a dedicated track when the inputs carry
+    goodput-classified spans (--no-goodput skips it)."""
     events = merge_traces(trace_paths)
+    n_goodput = 0
+    if goodput:
+        extra = goodput_track(events)
+        n_goodput = sum(1 for e in extra if e.get("ph") == "X")
+        events = events + extra
+        events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
     validate_timeline(events)
     with open(out, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
-    print(f"{len(events)} events from {len(trace_paths)} trace(s) -> {out}; "
-          f"open in chrome://tracing or ui.perfetto.dev")
+    note = f" (+{n_goodput} goodput slices)" if n_goodput else ""
+    print(f"{len(events)} events from {len(trace_paths)} trace(s){note} -> "
+          f"{out}; open in chrome://tracing or ui.perfetto.dev")
     return 0
 
 
@@ -133,6 +199,8 @@ def main(argv=None):
     ap.add_argument("--timeline_path", default="timeline.json")
     ap.add_argument("--validate", action="store_true",
                     help="only validate --trace_path files, write nothing")
+    ap.add_argument("--no-goodput", action="store_true",
+                    help="skip the synthetic goodput-attribution track")
     a = ap.parse_args(argv)
     if a.trace_path:
         paths = [p for p in a.trace_path.split(",") if p]
@@ -141,7 +209,7 @@ def main(argv=None):
                 n = len(validate_timeline(p))
                 print(f"{p}: OK ({n} events)")
             return 0
-        return convert(paths, a.timeline_path)
+        return convert(paths, a.timeline_path, goodput=not a.no_goodput)
     return extract(a.profile_path, a.timeline_path)
 
 

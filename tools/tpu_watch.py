@@ -120,6 +120,15 @@ def _run_child(args, budget, extra_env=None, _retried=False):
             gm = trace.metrics().gauge("watch.mfu")
             if mfu > gm.value:
                 gm.set(mfu)
+            # device-truth MFU (XLA cost_analysis numerator) + the
+            # goodput estimate: aggregated per sweep like mfu
+            mfu_m = float(info.get("mfu_measured", 0.0) or 0.0)
+            gmm = trace.metrics().gauge("watch.mfu_measured")
+            if mfu_m > gmm.value:
+                gmm.set(mfu_m)
+            gp = float(info.get("goodput", 0.0) or 0.0)
+            if gp:
+                trace.metrics().histogram("watch.goodput").observe(gp)
             spd = float(info.get("amp_speedup", 0.0) or 0.0)
             gs = trace.metrics().gauge("watch.amp_speedup")
             if spd > gs.value:
@@ -244,8 +253,16 @@ def _report_step_timing():
                for n in trace.metrics().names()
                if n.startswith("watch.dtype_mix.")}
         spd = trace.metrics().gauge("watch.amp_speedup").value
-        print(f"[watch] amp plane: best MFU {mfu:.1%}, bf16-vs-fp32 "
-              f"speedup {spd:.2f}x, dtype mix {mix or 'n/a'}", flush=True)
+        mfu_m = trace.metrics().gauge("watch.mfu_measured").value
+        measured = f" (measured {mfu_m:.1%})" if mfu_m else ""
+        print(f"[watch] amp plane: best MFU {mfu:.1%}{measured}, "
+              f"bf16-vs-fp32 speedup {spd:.2f}x, dtype mix {mix or 'n/a'}",
+              flush=True)
+    g = trace.metrics().histogram("watch.goodput").stats()
+    if g["count"]:
+        print(f"[watch] goodput: avg {g['avg']:.0%} min {g['min']:.0%} "
+              f"across {int(g['count'])} bench children "
+              f"(metrics-estimate; see docs/observability.md)", flush=True)
     w = trace.metrics().histogram("watch.host_wait_seconds").stats()
     if w["count"]:
         d = trace.metrics().histogram("watch.dispatch_seconds").stats()
