@@ -776,6 +776,29 @@ def supervise():
          else error_record())
 
 
+def main_serve():
+    """Serving-plane row: open-loop QPS + latency percentiles through
+    tools/serve_bench (the ROADMAP item-2 'millions of users' number —
+    request-level, not steps/sec)."""
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import serve_bench
+
+    quick = "--quick" in sys.argv or backend_name() == "cpu"
+    qps = 200.0 if quick else 2000.0
+    n = 300 if quick else 4000
+    report = serve_bench.serve_bench(qps=qps, n_requests=n,
+                                     sizes=(1, 2, 4, 8),
+                                     max_batch=32, hidden=64)
+    backend = backend_name()
+    out = dict(report, backend=backend, mfu=0.0, vs_baseline=0.0)
+    out.update(_compile_stats())
+    if backend not in ("cpu", "error"):
+        record_evidence(dict(out))
+    print(json.dumps(out))
+
+
 def main():
     import os
     import jax
@@ -864,6 +887,8 @@ if __name__ == "__main__":
             main_nmt()
         elif "--model" in sys.argv and "wide_deep" in sys.argv:
             main_ctr()
+        elif "--model" in sys.argv and "serve" in sys.argv:
+            main_serve()
         else:
             main()
     else:

@@ -37,6 +37,7 @@ from . import amp
 from . import distributed
 from . import static
 from . import inference
+from . import serving
 from .hapi import Model
 from .hapi.flops import flops
 from . import jit

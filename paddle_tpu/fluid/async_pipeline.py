@@ -141,18 +141,25 @@ class FetchHandle:
 
     def persist(self) -> np.ndarray:
         """Materialise to host and cache — after this the handle survives
-        donation of the underlying device buffer."""
+        donation of the underlying device buffer.  Safe under concurrent
+        callers (the serving plane persists from its collector thread
+        while the runner's backpressure path may persist the same
+        handle): the loser of the race re-reads the winner's cached
+        value instead of converting an already-dropped reference."""
         if self._np is None:
             self._run_pre_check()
             if self._waiter is not None:
                 self._waiter()
-            v = np.asarray(self._raw)
+            raw = self._raw            # local ref: survives a concurrent
+            if raw is None:            # winner clearing the attribute
+                return self._np
+            v = np.asarray(raw)
             if self._check_nan and np.issubdtype(v.dtype, np.floating) \
                     and not np.all(np.isfinite(v)):
                 raise FloatingPointError(
                     f"NaN/Inf in fetched var '{self.name}'")
-            self._np = v
-            self._raw = None           # drop the device reference
+            self._np = v               # publish BEFORE dropping the ref
+            self._raw = None
         return self._np
 
     def _run_pre_check(self):

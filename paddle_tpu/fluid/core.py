@@ -182,6 +182,21 @@ _FLAGS: Dict[str, object] = {
     # is why serving /metrics alone does NOT opt a run in.
     "device_cost_analysis": _os.environ.get(
         "FLAGS_device_cost_analysis", "auto"),
+    # serving plane (paddle_tpu/serving/, docs/serving.md).  max_batch
+    # caps the rows per coalesced device batch; max_wait_us is the
+    # batch-formation deadline (dispatch a partial batch rather than
+    # hold a request longer); queue_depth bounds the admission queue
+    # (a full queue REJECTS at submit — backpressure, not OOM);
+    # default_deadline_ms rejects requests that queue longer than their
+    # deadline (0 = no deadline unless the request carries one).
+    "serving_max_batch": int(_os.environ.get(
+        "FLAGS_serving_max_batch", "32")),
+    "serving_max_wait_us": int(_os.environ.get(
+        "FLAGS_serving_max_wait_us", "2000")),
+    "serving_queue_depth": int(_os.environ.get(
+        "FLAGS_serving_queue_depth", "256")),
+    "serving_default_deadline_ms": float(_os.environ.get(
+        "FLAGS_serving_default_deadline_ms", "0") or 0),
     # rolling window for the goodput.ratio gauge and /goodput (seconds;
     # 0 = whole run).  A bounded default keeps scrape cost O(window) on
     # long traced runs: the live accumulator prunes intervals that can

@@ -365,7 +365,9 @@ class Executor:
         # are sliced back below.  Mesh / pipeline / recompute paths keep
         # exact shapes (their step builders do per-axis surgery).
         bucket = n_valid = None
-        if (core.get_flag("shape_bucketing") and feed and mesh is None
+        if ((core.get_flag("shape_bucketing")
+             or program._hints.get("shape_bucketing"))
+                and feed and mesh is None
                 and not program._hints.get("pipeline_microbatches")
                 and not program._hints.get("recompute_checkpoints")):
             dims = {np.shape(v)[0] for v in feed.values() if np.ndim(v) >= 1}
@@ -720,7 +722,8 @@ class Executor:
         # rectangular; the per-step true size rides in __batch_valid__
         bucket = None
         n_valids = None
-        if core.get_flag("shape_bucketing") and feeds[0]:
+        if (core.get_flag("shape_bucketing")
+                or program._hints.get("shape_bucketing")) and feeds[0]:
             per_feed = []
             for f in feeds:
                 dims = {np.shape(v)[0] for v in f.values()

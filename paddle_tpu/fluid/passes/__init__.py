@@ -16,8 +16,11 @@ from .pattern import (Pattern, PVar, POp, Match, PatternRewritePass)
 from .graphviz import program_to_dot, dump_program
 from . import builtin  # registers the built-in pass catalog
 from . import amp      # registers amp_bf16 + prune_redundant_casts
+from . import inference as inference_preset  # registers fold_batch_norm
 from .builtin import passes_for_build_strategy
 from .amp import AmpBf16Pass, PruneRedundantCastsPass
+from .inference import (FoldBatchNormPass, inference_passes,
+                        INFERENCE_PASS_NAMES)
 
 __all__ = [
     "Pass", "PassContext", "PassRegistry", "PassPipeline",
@@ -25,4 +28,5 @@ __all__ = [
     "Pattern", "PVar", "POp", "Match", "PatternRewritePass",
     "program_to_dot", "dump_program", "passes_for_build_strategy",
     "AmpBf16Pass", "PruneRedundantCastsPass",
+    "FoldBatchNormPass", "inference_passes", "INFERENCE_PASS_NAMES",
 ]

@@ -1,0 +1,245 @@
+"""Inference-preset passes: batch-norm folding into the preceding
+conv/fc weights.
+
+Reference: the OptimizeInferenceProgram pass list that AnalysisPredictor
+runs over a loaded model (paddle/fluid/inference/analysis/, notably
+conv_bn_fuse_pass.cc / fc_fuse_pass.cc).  TPU-native twist: folding BN
+into the producer's weights is a *value* rewrite, not just an IR
+rewrite — the folded weights are computed host-side from the scope's
+parameter values and stored under fresh names, so the training scope's
+originals are never touched and a freeze can share a live training
+scope safely.
+
+The `inference_passes()` preset is the freeze pipeline
+(serving/freeze.py, docs/serving.md):
+
+    constant_fold -> fold_batch_norm -> fuse_elewise_add_act
+    -> fuse_bn_act -> prune_identity -> dce (fetch-seeded)
+
+BN folding runs before the fusions so a foldable BN disappears into the
+conv/fc entirely (zero extra ops at serving time); an *unfoldable* BN
+(training-mode stats, multi-consumer edge, missing scope values) is left
+for fuse_bn_act to at least pair with its activation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework import _op_reads
+from .core import Pass, PassContext, register_pass, create_pass
+from .pattern import writer_index as _writer_idxs
+
+__all__ = ["FoldBatchNormPass", "inference_passes",
+           "INFERENCE_PASS_NAMES"]
+
+# the freeze preset, in order (docs/passes.md catalog)
+INFERENCE_PASS_NAMES = ("constant_fold", "fold_batch_norm",
+                        "fuse_elewise_add_act", "fuse_bn_act",
+                        "prune_identity", "dce")
+
+
+def inference_passes(scope=None) -> List[Pass]:
+    """Instantiate the inference/freeze pass preset.  ``scope`` holds the
+    parameter values fold_batch_norm reads (defaults to the ambient
+    global scope at apply time)."""
+    out = []
+    for name in INFERENCE_PASS_NAMES:
+        kw = {"scope": scope} if name == "fold_batch_norm" else {}
+        out.append(create_pass(name, **kw))
+    return out
+
+
+def _consumers(block, name):
+    return [op for op in block.ops if name in _op_reads(block, op)]
+
+
+@register_pass
+class FoldBatchNormPass(Pass):
+    """Fold an inference-mode ``batch_norm`` into the preceding
+    conv2d/mul (fc) weights (conv_bn_fuse_pass.cc analog).
+
+    ``y = (z - mean) * rsqrt(var + eps) * gamma + beta`` with
+    ``z = W·x (+ b0)`` becomes ``W' = W * k`` (per out-channel
+    ``k = gamma * rsqrt(var + eps)``) and ``b' = (b0 - mean) * k + beta``
+    — the BN op vanishes and the bias add absorbs it.  Folded weight
+    values are computed in float64 and stored in the scope under fresh
+    ``@bn_fold`` names; the original params stay untouched (they may be
+    live training state in a shared scope).
+
+    Folds only when: the BN runs in inference mode (op-level ``is_test``
+    / ``use_global_stats`` or the program's ``is_test`` hint), the
+    conv/mul -> (bias add ->) bn chain is single-writer/single-consumer,
+    none of the intermediate edges are protected (fetch targets,
+    persistables, feeds), and every needed param value is in the scope.
+    Anything else is skipped, never broken.
+    """
+
+    name = "fold_batch_norm"
+    writes = frozenset({"ops", "vars"})
+
+    # producer op -> (weight slot, out slot, weight out-channel axis fn)
+    _PRODUCERS = {
+        "conv2d": ("Filter", "Output", lambda w: 0),
+        "mul": ("Y", "Out", lambda w: w.ndim - 1),
+    }
+
+    def __init__(self, scope=None, **options):
+        super().__init__(**options)
+        self.scope = scope
+
+    def _scope(self):
+        if self.scope is not None:
+            return self.scope
+        from ..core import global_scope
+        return global_scope()
+
+    def apply_block(self, block, ctx: PassContext) -> Dict[str, int]:
+        folded = 0
+        for _ in range(len(block.ops) + 16):
+            if not self._fold_one(block, ctx):
+                break
+            folded += 1
+        return {"bn_folded": folded}
+
+    # -- helpers -------------------------------------------------------------
+    def _value(self, scope, name) -> Optional[np.ndarray]:
+        v = scope.find_var(name)
+        return None if v is None else np.asarray(v)
+
+    def _is_inference_bn(self, block, op) -> bool:
+        if op.type != "batch_norm":
+            return False
+        if op.attrs.get("is_test") or op.attrs.get("use_global_stats"):
+            return True
+        return bool(block.program._hints.get("is_test"))
+
+    def _single_internal_edge(self, block, ctx, name, consumer) -> bool:
+        """``name`` is written once, read only by ``consumer``, and not
+        protected (fetch target / persistable / feed)."""
+        if ctx.is_protected(block, name):
+            return False
+        if len(_writer_idxs(block, name)) != 1:
+            return False
+        return all(c is consumer for c in _consumers(block, name))
+
+    def _writer(self, block, name):
+        idx = _writer_idxs(block, name)
+        return block.ops[idx[0]] if len(idx) == 1 else None
+
+    def _fresh_param(self, block, scope, base, value):
+        """Store ``value`` under a fresh persistable var; the original
+        param keeps its value (shared-scope safety)."""
+        from ..framework import unique_name
+        name = unique_name(base + "@bn_fold")
+        dtype = value.dtype.name
+        block.create_var(name=name, shape=list(value.shape), dtype=dtype,
+                         persistable=True)
+        scope.set_var(name, value)
+        return name
+
+    # -- the fold ------------------------------------------------------------
+    def _fold_one(self, block, ctx: PassContext) -> bool:
+        scope = self._scope()
+        for bn in list(block.ops):
+            if not self._is_inference_bn(block, bn):
+                continue
+            if self._try_fold(block, ctx, scope, bn):
+                return True
+        return False
+
+    def _try_fold(self, block, ctx, scope, bn) -> bool:
+        x_name = (bn.inputs.get("X") or [None])[0]
+        y_name = (bn.outputs.get("Y") or [None])[0]
+        if x_name is None or y_name is None:
+            return False
+        if not self._single_internal_edge(block, ctx, x_name, bn):
+            return False
+        if len(_writer_idxs(block, y_name)) != 1:
+            return False
+
+        # resolve the producer chain: conv/mul [-> elementwise_add(bias)]
+        writer = self._writer(block, x_name)
+        if writer is None:
+            return False
+        add_op = None
+        if writer.type == "elementwise_add":
+            b_name = (writer.inputs.get("Y") or [None])[0]
+            z_name = (writer.inputs.get("X") or [None])[0]
+            bv = block._find_var_recursive(b_name) if b_name else None
+            if bv is None or not bv.persistable or z_name is None:
+                return False
+            add_op = writer
+            if not self._single_internal_edge(block, ctx, z_name, add_op):
+                return False
+            writer = self._writer(block, z_name)
+            if writer is None:
+                return False
+        if writer.type not in self._PRODUCERS:
+            return False
+        w_slot, out_slot, ch_axis_of = self._PRODUCERS[writer.type]
+        w_name = (writer.inputs.get(w_slot) or [None])[0]
+        if w_name is None:
+            return False
+        wv = block._find_var_recursive(w_name)
+        if wv is None or not wv.persistable:
+            return False
+        if any(w_name in op.output_arg_names for op in block.ops):
+            return False             # weight rewritten at runtime: unsafe
+
+        # param values (all must be resident in the scope)
+        names = {k: (bn.inputs.get(k) or [None])[0]
+                 for k in ("Scale", "Bias", "Mean", "Variance")}
+        if any(n is None for n in names.values()):
+            return False
+        vals = {k: self._value(scope, n) for k, n in names.items()}
+        w = self._value(scope, w_name)
+        if w is None or any(v is None for v in vals.values()):
+            return False
+        b0_name = (add_op.inputs.get("Y") or [None])[0] if add_op else None
+        b0 = self._value(scope, b0_name) if b0_name else None
+        if add_op is not None and b0 is None:
+            return False
+
+        eps = float(bn.attrs.get("epsilon", 1e-5))
+        k = (vals["Scale"].astype(np.float64)
+             / np.sqrt(vals["Variance"].astype(np.float64) + eps))
+        if k.ndim != 1:
+            return False
+        ch_axis = ch_axis_of(w)
+        if w.shape[ch_axis] != k.shape[0]:
+            return False
+        shape = [1] * w.ndim
+        shape[ch_axis] = k.shape[0]
+        w_new = (w.astype(np.float64) * k.reshape(shape)).astype(w.dtype)
+        b_prev = (b0.astype(np.float64) if b0 is not None
+                  else np.zeros(k.shape[0]))
+        b_new = ((b_prev - vals["Mean"].astype(np.float64)) * k
+                 + vals["Bias"].astype(np.float64)).astype(
+                     vals["Bias"].dtype)
+
+        # splice: producer reads the folded weight; the bias add absorbs
+        # the BN and writes the BN's output name; the BN op vanishes
+        w_folded = self._fresh_param(block, scope, w_name, w_new)
+        b_folded = self._fresh_param(block, scope,
+                                     b0_name or (w_name + "_b"), b_new)
+        writer.inputs[w_slot] = [w_folded]
+        if add_op is not None:
+            add_op.inputs["Y"] = [b_folded]
+            add_op.outputs["Out"] = [y_name]
+        else:
+            fmt = bn.attrs.get("data_layout", "NCHW")
+            x_var = block._find_var_recursive(x_name)
+            ndim = len(x_var.shape) if (x_var is not None
+                                        and x_var.shape) else 2
+            axis = (1 if (writer.type == "conv2d" and fmt == "NCHW")
+                    else ndim - 1)
+            block._insert_op(
+                block.ops.index(bn), "elementwise_add",
+                inputs={"X": [x_name], "Y": [b_folded]},
+                outputs={"Out": [y_name]},
+                attrs={"axis": axis,
+                       "op_role": bn.attrs.get("op_role", 0)})
+        block._remove_op(block.ops.index(bn))
+        return True

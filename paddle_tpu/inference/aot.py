@@ -9,70 +9,94 @@ weights closed over (baked into the module as constants — one
 self-contained file) and shipped with a JSON sidecar naming feeds/fetches.
 A consumer needs jax (any language binding over PJRT), NOT this framework
 or the model's Python code — the capi/go-client story, solved the XLA way.
+
+Multi-shape artifacts: shapes are baked statically into StableHLO, so a
+single module serves exactly one batch size.  ``save_aot_model`` with
+``bucket_edges`` therefore exports ONE module per bucket edge
+(``model.b{edge}.stablehlo``) beside the baked example-shape module, all
+sharing one sidecar.  Each bucketed module takes an extra trailing
+``batch_valid`` scalar (the PR-2 masking contract, so batch reductions
+stay exact under padding); :class:`AotPredictor` picks the smallest
+bucket >= the request rows, zero-pads the batch feeds, threads the true
+row count, and slices the outputs back — exactly the executor's
+shape-bucketing dance, replayed framework-free at serving time.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 __all__ = ["save_aot_model", "load_aot_model", "AotPredictor"]
 
 _ARTIFACT = "model.stablehlo"
+_BUCKET_ARTIFACT = "model.b{edge}.stablehlo"
 _META = "aot_meta.json"
 
 
-def save_aot_model(dirname: str, predictor, example_feed: Dict[str, np.ndarray]):
-    """Export `predictor`'s loaded model as a serialized StableHLO artifact.
-
-    example_feed supplies shapes/dtypes for tracing (values unused).  Shapes
-    are baked statically — export one artifact per served batch shape, the
-    same contract as AnalysisPredictor's shape-keyed compile cache.
-    """
-    import jax
-    from jax import export as jexport
-
+def _export_fn(predictor, feed_names, fetch_names):
+    """The traced serving function: weights closed over as constants,
+    optional trailing batch_valid scalar for masked batch reductions."""
     from ..fluid.core import global_scope
     from ..fluid.executor import run_block_ops
     from ..ops.registry import LoweringContext
     from ..fluid.framework import prune_ops
 
     program = predictor._program
+    block = program.global_block()
+    scope = global_scope()
+    params = {}
+    for name in block.vars:
+        v = scope.find_var(name)
+        if v is not None and name not in feed_names:
+            params[name] = np.asarray(v)
+    run_ops = prune_ops(block, block.ops, targets=fetch_names,
+                        extra_state=set())
+
+    def fn(feeds, batch_valid=None, batch_padded=None):
+        env = dict(params)                 # weights baked in as constants
+        env.update(zip(feed_names, feeds))
+        ctx = LoweringContext(base_key=None, mesh_axes={}, is_test=True)
+        if batch_valid is not None:
+            ctx.batch_valid = batch_valid
+            ctx.batch_padded = batch_padded
+        run_block_ops(block, env, ctx, ops=run_ops)
+        return [env[n] for n in fetch_names]
+    return fn
+
+
+def save_aot_model(dirname: str, predictor,
+                   example_feed: Dict[str, np.ndarray],
+                   bucket_edges: Optional[Sequence[int]] = None):
+    """Export ``predictor``'s loaded model as serialized StableHLO.
+
+    ``example_feed`` supplies shapes/dtypes for tracing (values unused).
+    The example shape is always baked into ``model.stablehlo`` (the
+    legacy single-shape artifact).  With ``bucket_edges`` (explicit, or
+    inherited from the predictor program's ``bucket_edges`` hint) one
+    additional module per edge is exported so :class:`AotPredictor`
+    serves ANY batch size up to the largest edge by pad-and-slice.
+    """
+    import jax
+    from jax import export as jexport
+
     missing = [n for n in predictor._feed_names if n not in example_feed]
     if missing:
         raise ValueError(f"example_feed missing inputs: {missing}")
     feed_names = list(predictor._feed_names)   # artifact bakes the full list
     fetch_names = list(predictor._fetch_names)
-    block = program.global_block()
-    scope = global_scope()
-
-    params = {}
-    for name, var in block.vars.items():
-        v = scope.find_var(name)
-        if v is not None and name not in example_feed:
-            params[name] = np.asarray(v)
-
-    run_ops = prune_ops(block, block.ops, targets=fetch_names,
-                        extra_state=set())
-
-    def fn(*feeds):
-        env = dict(params)                 # weights baked in as constants
-        env.update(zip(feed_names, feeds))
-        ctx = LoweringContext(base_key=None, mesh_axes={}, is_test=True)
-        run_block_ops(block, env, ctx, ops=run_ops)
-        return [env[n] for n in fetch_names]
+    fn = _export_fn(predictor, feed_names, fetch_names)
 
     specs = [jax.ShapeDtypeStruct(np.shape(example_feed[n]),
                                   np.asarray(example_feed[n]).dtype)
              for n in feed_names]
-    exported = jexport.export(jax.jit(fn))(*specs)
-    blob = exported.serialize()
+    exported = jexport.export(jax.jit(lambda *f: fn(list(f))))(*specs)
 
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, _ARTIFACT), "wb") as f:
-        f.write(blob)
+        f.write(exported.serialize())
     meta = {
         "feed_names": feed_names,
         "fetch_names": fetch_names,
@@ -82,6 +106,46 @@ def save_aot_model(dirname: str, predictor, example_feed: Dict[str, np.ndarray])
                          for n in feed_names},
         "platforms": list(exported.platforms),
     }
+
+    # -- multi-shape tier ---------------------------------------------------
+    if bucket_edges is None:
+        bucket_edges = getattr(predictor, "_program", None) and \
+            predictor._program._hints.get("bucket_edges")
+    if bucket_edges:
+        from ..fluid import compile_cache
+        edges = compile_cache.normalize_edges(bucket_edges)
+        # batch-major feeds: the ones sharing the example's leading dim
+        dims = {int(np.shape(example_feed[n])[0]) for n in feed_names
+                if np.ndim(example_feed[n]) >= 1}
+        n0 = next(iter(dims)) if len(dims) == 1 else None
+        if n0 is None:
+            raise ValueError(
+                "bucketed export needs every feed to share one leading "
+                f"batch dim; example_feed has {dims}")
+        files = {}
+        for edge in edges:
+            especs = []
+            for n in feed_names:
+                shape = list(np.shape(example_feed[n]))
+                if shape:
+                    shape[0] = int(edge)
+                especs.append(jax.ShapeDtypeStruct(
+                    tuple(shape), np.asarray(example_feed[n]).dtype))
+            especs.append(jax.ShapeDtypeStruct((), np.int32))
+
+            def bucket_fn(*args, _edge=int(edge)):
+                return fn(list(args[:-1]), batch_valid=args[-1],
+                          batch_padded=_edge)
+
+            ex_b = jexport.export(jax.jit(bucket_fn))(*especs)
+            fname = _BUCKET_ARTIFACT.format(edge=int(edge))
+            with open(os.path.join(dirname, fname), "wb") as f:
+                f.write(ex_b.serialize())
+            files[str(int(edge))] = fname
+        meta["buckets"] = [int(e) for e in edges]
+        meta["bucket_files"] = files
+        meta["batch_valid_arg"] = True
+
     with open(os.path.join(dirname, _META), "w") as f:
         json.dump(meta, f, indent=1)
     return meta
@@ -89,14 +153,27 @@ def save_aot_model(dirname: str, predictor, example_feed: Dict[str, np.ndarray])
 
 class AotPredictor:
     """Serve a saved StableHLO artifact: __call__(feed dict) -> fetch dict.
-    No Program, no op registry — just the deserialized executable."""
+    No Program, no op registry — just the deserialized executable(s).
+    Multi-shape artifacts pick the smallest bucket >= the request rows,
+    pad, thread the true row count, and slice the outputs back."""
 
     def __init__(self, dirname: str):
-        from jax import export as jexport
-        with open(os.path.join(dirname, _ARTIFACT), "rb") as f:
-            self._exported = jexport.deserialize(f.read())
+        self._dir = dirname
         with open(os.path.join(dirname, _META)) as f:
             self._meta = json.load(f)
+        self._modules: Dict[Optional[int], object] = {}
+
+    def _module(self, edge: Optional[int]):
+        """Deserialize lazily, once per bucket (None = the baked
+        example-shape module)."""
+        mod = self._modules.get(edge)
+        if mod is None:
+            from jax import export as jexport
+            fname = (_ARTIFACT if edge is None
+                     else self._meta["bucket_files"][str(edge)])
+            with open(os.path.join(self._dir, fname), "rb") as f:
+                mod = self._modules[edge] = jexport.deserialize(f.read())
+        return mod
 
     def get_input_names(self) -> Sequence[str]:
         return list(self._meta["feed_names"])
@@ -104,9 +181,64 @@ class AotPredictor:
     def get_output_names(self) -> Sequence[str]:
         return list(self._meta["fetch_names"])
 
+    @property
+    def buckets(self):
+        return list(self._meta.get("buckets") or [])
+
+    def _rows(self, feed) -> Optional[int]:
+        dims = {int(np.shape(feed[n])[0])
+                for n in self._meta["feed_names"]
+                if np.ndim(feed.get(n)) >= 1}
+        return next(iter(dims)) if len(dims) == 1 else None
+
+    def call_lazy(self, feed: Dict[str, np.ndarray]):
+        """Dispatch and return the raw (device-resident, true-rows-
+        sliced) outputs without forcing a host copy — what
+        ServingEngine's AOT backend overlaps against batch formation."""
+        names = self._meta["feed_names"]
+        buckets = self._meta.get("buckets")
+        n = self._rows(feed)
+        baked = None
+        shapes = self._meta.get("input_shapes") or {}
+        if shapes and names:
+            s0 = shapes.get(names[0]) or []
+            baked = int(s0[0]) if s0 else None
+        # bucketed artifacts ALWAYS serve coverable sizes through the
+        # bucket modules (even rows == the baked example shape), so
+        # warmup() warms exactly the modules steady-state serving hits
+        if not buckets or n is None:
+            if not buckets and n is not None and baked is not None \
+                    and n != baked:
+                raise ValueError(
+                    f"this artifact bakes batch size {baked} only (no "
+                    f"bucketed modules); request has {n} rows — "
+                    f"re-export with save_aot_model(..., "
+                    f"bucket_edges=[...]) to serve other sizes")
+            outs = self._module(None).call(*[feed[n_] for n_ in names])
+            return list(outs)
+        cands = [e for e in buckets if e >= n]
+        if not cands:
+            if n == baked:
+                outs = self._module(None).call(*[feed[n_] for n_ in names])
+                return list(outs)
+            raise ValueError(
+                f"request rows {n} exceed the largest exported bucket "
+                f"{max(buckets)} (and the baked shape {baked}); "
+                f"re-export with larger bucket_edges")
+        edge = min(cands)
+        from ..fluid import compile_cache
+        args = []
+        for name in names:
+            v = np.asarray(feed[name])
+            args.append(compile_cache.pad_dim0(v, edge)
+                        if v.ndim >= 1 and v.shape[0] == n else v)
+        args.append(np.int32(n))
+        outs = list(self._module(edge).call(*args))
+        return [o[:n] if getattr(o, "ndim", 0) >= 1
+                and o.shape[0] == edge else o for o in outs]
+
     def __call__(self, feed: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-        args = [feed[n] for n in self._meta["feed_names"]]
-        outs = self._exported.call(*args)
+        outs = self.call_lazy(feed)
         return dict(zip(self._meta["fetch_names"],
                         [np.asarray(o) for o in outs]))
 

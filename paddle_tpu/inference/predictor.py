@@ -49,6 +49,11 @@ class AnalysisConfig:
         self._precision = PrecisionType.Float32
         self._profile = False
         self._cpu_math_threads = 1
+        # shape bucketing (fluid/compile_cache.py): on by default so a
+        # new request batch size pads to a bucket edge and reuses a
+        # cached executable instead of paying a fresh cold compile
+        self._shape_bucketing = True
+        self._bucket_edges = None
 
     # -- device ------------------------------------------------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -73,6 +78,20 @@ class AnalysisConfig:
 
     def enable_memory_optim(self):
         self._memory_optim = True
+
+    def switch_shape_bucketing(self, flag=True, edges=None):
+        """Pad request batches up to a bucket edge so a never-seen batch
+        size reuses a cached executable (PR-2 plane; default ON).
+        ``edges`` pins explicit bucket sizes (default powers of two)."""
+        self._shape_bucketing = bool(flag)
+        self._bucket_edges = edges
+
+    def set_optim_cache_dir(self, opt_cache_dir):
+        """Reference AnalysisConfig::SetOptimCacheDir — here it points
+        the PR-2 persistent compile cache at ``opt_cache_dir`` so a
+        restarted predictor process takes zero cold compiles."""
+        from ..fluid import core as _core
+        _core.set_flags({"FLAGS_persistent_cache_dir": str(opt_cache_dir)})
 
     def enable_profile(self):
         self._profile = True
@@ -186,9 +205,26 @@ class AnalysisPredictor:
                                  model_filename=model_file,
                                  params_filename=params_file)
         self._fetch_names = [v.name for v in self._fetch_vars]
-        if not config._ir_optim:
+        if config._ir_optim:
+            # OptimizeInferenceProgram: the freeze/inference pass preset
+            # (serving/freeze.py) — constant_fold, BN folded into the
+            # preceding conv/fc, fusion, identity pruning, fetch-seeded
+            # DCE — instead of the bare executor-side prune_ops
+            from ..serving.freeze import freeze_program
+            self._program = freeze_program(
+                self._program, self._feed_names, self._fetch_names)
+        else:
             # pass pipeline off == no fetch-reachability pruning
             self._program._hints["inference_no_prune"] = True
+        if config._shape_bucketing:
+            # PR-2 plane, per-program: a new batch size pads to a bucket
+            # edge and reuses a cached executable (plus the persistent
+            # cache across restarts) instead of a fresh cold compile
+            self._program._hints["shape_bucketing"] = True
+            if config._bucket_edges is not None:
+                from ..fluid import compile_cache
+                self._program._hints["bucket_edges"] = \
+                    compile_cache.normalize_edges(config._bucket_edges)
         if config._memory_optim:
             self._program._hints["donate_buffers"] = True
         if config._precision in (PrecisionType.Half,

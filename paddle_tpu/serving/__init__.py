@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — the inference serving plane.
+
+Reference: paddle/fluid/inference/ (AnalysisPredictor +
+OptimizeInferenceProgram + the deployment APIs, PAPER.md layer 8),
+rebuilt TPU-native around three pieces:
+
+* :func:`freeze_program` (freeze.py) — trained Program -> read-only
+  inference Program via the registered inference pass preset
+  (constant_fold -> fold_batch_norm -> fuse -> prune_identity -> dce).
+* :class:`ServingEngine` (engine.py) — bounded admission queue,
+  shape-bucketed continuous batching of heterogeneous requests,
+  async-windowed dispatch, per-request demux, ``warmup()``
+  bucket precompilation.
+* The SLO surface — ``serving.*`` counters/histograms on the PR-1/PR-7
+  metrics plane (p50/p95/p99, live /metrics endpoint), ``serving::batch``
+  trace spans, and ``tools/serve_bench.py`` for open-loop load.
+
+See docs/serving.md.
+"""
+from .freeze import freeze_program, strip_distribution_ops
+from .engine import (ServingEngine, ServingFuture, ServingError,
+                     QueueFullError, DeadlineExceededError,
+                     EngineClosedError)
+
+__all__ = [
+    "freeze_program", "strip_distribution_ops",
+    "ServingEngine", "ServingFuture", "ServingError",
+    "QueueFullError", "DeadlineExceededError", "EngineClosedError",
+]

@@ -1,0 +1,145 @@
+"""Program freeze: turn a trained Program into a serving Program.
+
+Reference: AnalysisPredictor's OptimizeInferenceProgram
+(paddle/fluid/inference/api/analysis_predictor.cc) — clone the trained
+program for inference, strip everything training-only, and run the
+inference pass list over what remains.  Here:
+
+1. ``clone(for_test=True)`` drops the backward/optimizer tail and marks
+   inference mode (dropout off, BN uses moving stats).
+2. Distribution ops are stripped: single-replica serving has no ring —
+   ``c_allreduce_*``/``c_broadcast``-style collectives are rewired to
+   identity (consumers read the collective's input), send/recv/barrier
+   plumbing is dropped outright.
+3. The registered **inference pass preset** (fluid/passes/inference.py)
+   runs through the PR-3 pipeline, seeded and protected by the fetch
+   set: constant_fold -> fold_batch_norm (BN folded into the preceding
+   conv/fc weights, values read from the scope) -> fuse -> prune_identity
+   -> fetch-seeded dce.
+4. The result is stamped read-only (``frozen`` hint, no state writes
+   survive the clone) with its feed/fetch contract and optional bucket
+   edges in ``_hints`` — the single artifact ``ServingEngine``,
+   ``AnalysisPredictor`` and the AOT exporter all consume.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..fluid import trace
+from ..fluid.core import global_scope
+from ..fluid.framework import Program, Variable
+from ..fluid.passes import PassPipeline, inference_passes
+
+__all__ = ["freeze_program", "strip_distribution_ops"]
+
+
+# collectives with identity single-replica semantics (one X -> one Out):
+# consumers are rewired to the input.  avg divides by world size — on a
+# single replica that is also identity.
+_IDENTITY_COLLECTIVES = frozenset({
+    "c_allreduce_sum", "c_allreduce_avg", "c_allreduce_max",
+    "c_allreduce_min", "c_allreduce_prod", "c_broadcast", "c_identity",
+})
+
+# pure plumbing with no dataflow value at serving time
+_DROP_OPS = frozenset({
+    "send_v2", "recv_v2", "partial_send", "partial_recv", "barrier",
+    "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_compute",
+    "c_wait_comm", "c_gen_nccl_id", "gen_nccl_id", "c_comm_init",
+    "c_comm_init_all",
+})
+
+
+def strip_distribution_ops(program: Program) -> int:
+    """Remove distributed-training plumbing from every block; identity
+    collectives rewire their consumers to the pre-collective value.
+    Returns the number of ops removed (mutates in place, version-bumped
+    through the Block mutators)."""
+    removed = 0
+    for block in program.blocks:
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in _IDENTITY_COLLECTIVES \
+                    and len(op.inputs.get("X", ())) == 1 \
+                    and len(op.outputs.get("Out", ())) == 1:
+                src = op.inputs["X"][0]
+                out = op.outputs["Out"][0]
+                if src != out:
+                    for o in block.ops:
+                        if o is op:
+                            continue
+                        for slot, names in o.inputs.items():
+                            if out in names:
+                                o.inputs[slot] = [src if n == out else n
+                                                  for n in names]
+                block._remove_op(i)
+                removed += 1
+            elif op.type in _DROP_OPS:
+                block._remove_op(i)
+                removed += 1
+            else:
+                i += 1
+    return removed
+
+
+def freeze_program(program: Program,
+                   feeds: Sequence,
+                   fetches: Sequence,
+                   scope=None,
+                   bucket_edges=None) -> Program:
+    """Freeze ``program`` for serving: inference clone, distribution
+    strip, inference pass preset, read-only stamp.
+
+    ``feeds``/``fetches`` are var names or Variables — the serving
+    contract, recorded in the frozen program's hints.  ``scope`` supplies
+    the parameter values BN folding reads (default: the ambient global
+    scope; the originals are never mutated).  ``bucket_edges`` optionally
+    pins the shape-bucket edges every consumer (engine, predictor, AOT
+    export) compiles against.
+    """
+    def _name(v):
+        return v.name if isinstance(v, Variable) else str(v)
+
+    feed_names = [_name(f) for f in (feeds or [])]
+    fetch_names = [_name(f) for f in (fetches or [])]
+    if not fetch_names:
+        raise ValueError("freeze_program needs at least one fetch — the "
+                         "fetch set seeds DCE and protects the rewrite")
+    scope = scope or global_scope()
+
+    _t0 = trace.now() if trace.enabled() else 0
+    frozen = program.clone(for_test=True)
+    stripped = strip_distribution_ops(frozen)
+
+    block = frozen.global_block()
+    missing = [n for n in fetch_names if not block.has_var(n)]
+    if missing:
+        raise ValueError(f"fetch vars {missing} do not exist in the "
+                         f"program being frozen")
+
+    pipe = PassPipeline(inference_passes(scope))
+    stats = pipe.apply(frozen, targets=fetch_names)
+
+    # read-only serving stamp: the for_test clone already dropped every
+    # state write, so the executor binds all params as read-only args;
+    # the hints make the contract (and the bucket plan) portable
+    frozen._hints["is_test"] = True
+    frozen._hints["frozen"] = True
+    frozen._hints["feed_names"] = list(feed_names)
+    frozen._hints["fetch_names"] = list(fetch_names)
+    if bucket_edges is not None:
+        from ..fluid import compile_cache
+        frozen._hints["bucket_edges"] = \
+            compile_cache.normalize_edges(bucket_edges)
+
+    m = trace.metrics()
+    m.counter("serving.programs_frozen").inc()
+    if _t0:
+        trace.complete(
+            "serving::freeze", _t0, cat="serving",
+            args={"ops": sum(len(b.ops) for b in frozen.blocks),
+                  "distribution_ops_stripped": stripped,
+                  "bn_folded": stats.get("fold_batch_norm", {})
+                  .get("bn_folded", 0)})
+    return frozen

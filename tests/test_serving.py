@@ -1,0 +1,586 @@
+"""Serving plane tests: program freeze (inference pass preset incl. BN
+folding), ServingEngine continuous batching (parity, overload rejection,
+deadline timeouts, concurrent clients), and the multi-shape AOT tier.
+
+Reference: paddle/fluid/inference/ (AnalysisPredictor /
+OptimizeInferenceProgram) + Orca-style continuous batching — see
+docs/serving.md.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu import serving
+from paddle_tpu.fluid import trace
+from paddle_tpu.fluid.core import Scope, scope_guard
+
+
+def _build_mlp(features=16, classes=10):
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.data("x", [-1, features])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        h = fluid.layers.fc(h, 32, act="relu")
+        logits = fluid.layers.fc(h, classes)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main_p, startup, logits, loss
+
+
+def _build_conv_bn(classes=10):
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.data("x", [-1, 3, 8, 8])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = fluid.layers.conv2d(x, 4, 3, padding=1)
+        h = fluid.layers.batch_norm(h, act="relu")
+        h = fluid.layers.fc(h, 16, act="relu")
+        logits = fluid.layers.fc(h, classes)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    return main_p, startup, logits, loss
+
+
+def _train(exe, main_p, feed, loss, steps=3):
+    for _ in range(steps):
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+
+
+class TestFreeze:
+    def test_mlp_freeze_parity_and_shrink(self, rng):
+        main_p, startup, logits, loss = _build_mlp()
+        exe = fluid.Executor()
+        exe.run(startup)
+        xs = rng.randn(16, 16).astype("float32")
+        ys = rng.randint(0, 10, (16, 1)).astype("int64")
+        _train(exe, main_p, {"x": xs, "y": ys}, loss)
+        ref, = exe.run(main_p.clone(for_test=True), feed={"x": xs},
+                       fetch_list=[logits])
+
+        frozen = serving.freeze_program(main_p, ["x"], [logits])
+        out, = exe.run(frozen, feed={"x": xs}, fetch_list=[logits])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+        # freeze strips training: fewer ops than the raw program, no
+        # grad/optimizer ops, read-only stamp + contract hints present
+        types = [op.type for op in frozen.global_block().ops]
+        assert not any(t in ("sgd", "generic_grad") for t in types), types
+        assert len(types) < len(main_p.global_block().ops)
+        assert frozen._hints["frozen"] and frozen._hints["is_test"]
+        assert frozen._hints["feed_names"] == ["x"]
+        assert frozen._hints["fetch_names"] == [logits.name]
+
+    def test_conv_bn_fold(self, rng):
+        """BN folds into the conv weights: the frozen program has NO
+        batch_norm op, and outputs match the unfused inference clone."""
+        main_p, startup, logits, loss = _build_conv_bn()
+        exe = fluid.Executor()
+        exe.run(startup)
+        xs = rng.randn(8, 3, 8, 8).astype("float32")
+        ys = rng.randint(0, 10, (8, 1)).astype("int64")
+        _train(exe, main_p, {"x": xs, "y": ys}, loss)
+        ref, = exe.run(main_p.clone(for_test=True), feed={"x": xs},
+                       fetch_list=[logits])
+
+        folded0 = trace.metrics().counter(
+            "pass.fold_batch_norm.bn_folded").value
+        frozen = serving.freeze_program(main_p, ["x"], [logits])
+        types = [op.type for op in frozen.global_block().ops]
+        assert "batch_norm" not in types, types
+        assert trace.metrics().counter(
+            "pass.fold_batch_norm.bn_folded").value == folded0 + 1
+        out, = exe.run(frozen, feed={"x": xs}, fetch_list=[logits])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fold_preserves_training_scope(self, rng):
+        """Folding writes fresh @bn_fold params — the ORIGINAL weights in
+        the shared scope are untouched, so training can continue."""
+        main_p, startup, logits, loss = _build_conv_bn()
+        exe = fluid.Executor()
+        exe.run(startup)
+        xs = rng.randn(8, 3, 8, 8).astype("float32")
+        ys = rng.randint(0, 10, (8, 1)).astype("int64")
+        _train(exe, main_p, {"x": xs, "y": ys}, loss)
+        scope = fluid.global_scope()
+        params_before = {
+            p.name: np.asarray(scope.find_var(p.name)).copy()
+            for p in main_p.all_parameters()}
+        serving.freeze_program(main_p, ["x"], [logits])
+        for name, before in params_before.items():
+            assert np.array_equal(
+                before, np.asarray(scope.find_var(name))), name
+
+    def test_fold_skipped_for_training_bn(self, rng):
+        """A training-mode batch_norm (no is_test anywhere) must NOT
+        fold — the inference preset run on a training program leaves the
+        BN alone."""
+        from paddle_tpu.fluid.passes import PassPipeline, create_pass
+        main_p, startup, logits, loss = _build_conv_bn()
+        exe = fluid.Executor()
+        exe.run(startup)
+        clone = main_p.clone(for_test=False)
+        n_bn0 = sum(1 for op in clone.global_block().ops
+                    if op.type == "batch_norm")
+        pipe = PassPipeline([create_pass("fold_batch_norm")])
+        pipe.apply(clone, targets=[logits.name])
+        n_bn = sum(1 for op in clone.global_block().ops
+                   if op.type == "batch_norm")
+        assert n_bn == n_bn0 > 0
+
+    def test_strip_distribution_ops(self):
+        main_p = fluid.Program()
+        block = main_p.global_block()
+        block.create_var(name="g", shape=[4], dtype="float32")
+        block.append_op("c_allreduce_sum", inputs={"X": ["g"]},
+                        outputs={"Out": ["g_red"]}, attrs={"ring_id": 0})
+        block.append_op("scale", inputs={"X": ["g_red"]},
+                        outputs={"Out": ["out"]}, attrs={"scale": 2.0})
+        block.append_op("barrier", inputs={}, outputs={}, attrs={})
+        removed = serving.strip_distribution_ops(main_p)
+        assert removed == 2
+        types = [op.type for op in block.ops]
+        assert types == ["scale"]
+        # the consumer was rewired to the pre-collective value
+        assert block.ops[0].inputs["X"] == ["g"]
+
+    def test_freeze_requires_fetches(self):
+        main_p, _, logits, _ = _build_mlp()
+        with pytest.raises(ValueError, match="fetch"):
+            serving.freeze_program(main_p, ["x"], [])
+        with pytest.raises(ValueError, match="do not exist"):
+            serving.freeze_program(main_p, ["x"], ["nope"])
+
+
+def _engine_fixture(rng, **kw):
+    main_p, startup, logits, loss = _build_mlp()
+    exe = fluid.Executor()
+    exe.run(startup)
+    xs = rng.randn(32, 16).astype("float32")
+    ys = rng.randint(0, 10, (32, 1)).astype("int64")
+    _train(exe, main_p, {"x": xs, "y": ys}, loss)
+    frozen = serving.freeze_program(main_p, ["x"], [logits])
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("max_wait_us", 2000)
+    eng = serving.ServingEngine(frozen, executor=exe, **kw)
+    return eng, frozen, exe, logits, xs
+
+
+class TestServingEngine:
+    def test_batched_bit_identical_to_sequential(self, rng):
+        """Mixed request sizes (incl. a partial final batch) coalesce,
+        and every per-request slice is BIT-identical to a sequential
+        per-request run of the same frozen program.  The bucket is
+        pinned to one edge so batched and sequential runs share ONE
+        executable — position-in-batch must not change a row's value.
+        (Cross-bucket exactness is backend-dependent: XLA picks
+        different gemm paths for [1,k] vs [16,k]; the ci_smoke gate
+        covers the single-device case, test_mixed_bucket_parity the
+        tolerance-bounded general one.)"""
+        eng, frozen, exe, logits, xs = _engine_fixture(
+            rng, bucket_edges=[16])
+        sizes = [1, 3, 5, 2, 8, 4, 7, 6, 1, 2, 3]   # last batch partial
+        with eng:
+            eng.warmup()
+            futs = [(i, s, eng.submit({"x": xs[:s] + 0.01 * i}))
+                    for i, s in enumerate(sizes)]
+            outs = [(i, s, f.result(timeout=60)) for i, s, f in futs]
+        for i, s, out in outs:
+            assert out[logits.name].shape[0] == s
+            seq, = exe.run(frozen, feed={"x": xs[:s] + 0.01 * i},
+                           fetch_list=[logits])
+            assert np.array_equal(np.asarray(seq), out[logits.name]), \
+                (i, s)
+        st = eng.stats()
+        assert st["batches"] < len(sizes)   # coalescing happened
+
+    def test_mixed_bucket_parity(self, rng):
+        """Default pow2 buckets: batched results match sequential
+        per-request runs to fp tolerance across bucket boundaries."""
+        eng, frozen, exe, logits, xs = _engine_fixture(rng)
+        sizes = [1, 3, 5, 2, 8, 4, 7, 6, 1, 2, 3]
+        with eng:
+            eng.warmup()
+            futs = [(i, s, eng.submit({"x": xs[:s] + 0.01 * i}))
+                    for i, s in enumerate(sizes)]
+            outs = [(i, s, f.result(timeout=60)) for i, s, f in futs]
+        for i, s, out in outs:
+            seq, = exe.run(frozen, feed={"x": xs[:s] + 0.01 * i},
+                           fetch_list=[logits])
+            np.testing.assert_allclose(out[logits.name],
+                                       np.asarray(seq),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_warmup_kills_cold_compiles(self, rng):
+        eng, frozen, exe, logits, xs = _engine_fixture(rng)
+        m = trace.metrics()
+        with eng:
+            rep = eng.warmup()
+            assert rep["buckets"] == list(eng.bucket_edges)
+            assert rep["compiles"] >= 1
+            miss0 = m.counter("executor.compile_cache_miss").value
+            futs = [eng.submit({"x": xs[:s]}) for s in (1, 5, 9, 16, 3)]
+            for f in futs:
+                f.result(timeout=60)
+            assert m.counter("executor.compile_cache_miss").value \
+                == miss0, "serving load compiled after warmup"
+
+    def test_queue_full_rejects(self, rng):
+        eng, frozen, exe, logits, xs = _engine_fixture(
+            rng, queue_depth=2, auto_start=False)
+        m = trace.metrics()
+        rej0 = m.counter("serving.rejected").value
+        accepted = []
+        with pytest.raises(serving.QueueFullError):
+            for _ in range(5):
+                accepted.append(eng.submit({"x": xs[:2]}))
+        assert len(accepted) == 2
+        assert m.counter("serving.rejected").value == rej0 + 1
+        eng.start()
+        for f in accepted:
+            assert f.result(timeout=60)[logits.name].shape[0] == 2
+        eng.close()
+        # a rejected submit's future is resolved with the error too
+        with pytest.raises(serving.EngineClosedError):
+            eng.submit({"x": xs[:2]})
+
+    def test_deadline_timeout_under_overload(self, rng):
+        """A request whose deadline elapses while queued is rejected
+        with DeadlineExceededError and counted in serving.timeouts."""
+        eng, frozen, exe, logits, xs = _engine_fixture(
+            rng, auto_start=False, default_deadline_ms=5)
+        m = trace.metrics()
+        t0 = m.counter("serving.timeouts").value
+        futs = [eng.submit({"x": xs[:2]}) for _ in range(4)]
+        time.sleep(0.05)                 # deadlines elapse while queued
+        eng.start()
+        errs = [f.exception(timeout=60) for f in futs]
+        eng.close()
+        assert all(isinstance(e, serving.DeadlineExceededError)
+                   for e in errs), errs
+        assert m.counter("serving.timeouts").value == t0 + 4
+
+    def test_concurrent_clients_no_torn_responses(self, rng):
+        """8 client threads × 16 requests each, every request tagged by
+        a unique constant row value — each response must contain exactly
+        its own rows' function value (no cross-request tearing)."""
+        # row-tagged program: fetch depends row-wise on the input
+        mp, sp = fluid.Program(), fluid.Program()
+        with fluid.program_guard(mp, sp):
+            x = fluid.data("x", [-1, 4])
+            out = fluid.layers.scale(x, scale=3.0)
+        exe = fluid.Executor()
+        exe.run(sp)
+        frozen = serving.freeze_program(mp, ["x"], [out])
+        eng = serving.ServingEngine(frozen, executor=exe, max_batch=32,
+                                    max_wait_us=1000)
+        results, errors = {}, []
+
+        def client(cid):
+            try:
+                rng_c = np.random.RandomState(cid)
+                for j in range(16):
+                    rows = int(rng_c.randint(1, 6))
+                    tag = cid * 1000 + j
+                    feed = np.full((rows, 4), float(tag), "float32")
+                    got = eng.submit({"x": feed}).result(timeout=60)
+                    results[(cid, j)] = (tag, rows, got[out.name])
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        with eng:
+            eng.warmup()
+            threads = [threading.Thread(target=client, args=(c,))
+                       for c in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        assert not errors, errors
+        assert len(results) == 8 * 16
+        for (cid, j), (tag, rows, arr) in results.items():
+            assert arr.shape == (rows, 4)
+            assert np.all(arr == 3.0 * tag), (cid, j, arr)
+
+    def test_scalar_feed_value_splits_batches(self, rng):
+        """A 0-d knob feed is part of the coalescing signature BY VALUE:
+        requests with different knob values never share a batch, and
+        each gets its own knob's result."""
+        mp, sp = fluid.Program(), fluid.Program()
+        with fluid.program_guard(mp, sp):
+            x = fluid.data("x", [-1, 4])
+            k = fluid.data("k", [])
+            out = fluid.layers.elementwise_mul(x, k)
+        exe = fluid.Executor()
+        exe.run(sp)
+        frozen = serving.freeze_program(mp, ["x", "k"], [out])
+        eng = serving.ServingEngine(frozen, executor=exe, max_batch=16,
+                                    max_wait_us=50000, auto_start=False)
+        xs = np.ones((2, 4), "float32")
+        f1 = eng.submit({"x": xs, "k": np.float32(2.0)})
+        f2 = eng.submit({"x": xs, "k": np.float32(3.0)})
+        f3 = eng.submit({"x": xs, "k": np.float32(2.0)})   # coalesces w/ f1
+        eng.start()
+        r1, r2, r3 = (f.result(timeout=60)[out.name] for f in (f1, f2, f3))
+        eng.close()
+        assert np.all(r1 == 2.0) and np.all(r3 == 2.0), (r1, r3)
+        assert np.all(r2 == 3.0), r2
+        assert trace.metrics().counter("serving.batches").value >= 2
+
+    def test_oversize_request_served_alone(self, rng):
+        """A request bigger than max_batch still completes (its own
+        batch/bucket)."""
+        eng, frozen, exe, logits, xs = _engine_fixture(rng, max_batch=8)
+        with eng:
+            got = eng.infer({"x": xs[:24]}, timeout=60)
+        assert got[logits.name].shape[0] == 24
+
+    def test_feed_validation(self, rng):
+        eng, frozen, exe, logits, xs = _engine_fixture(rng)
+        with eng:
+            with pytest.raises(ValueError, match="missing feeds"):
+                eng.submit({})
+            with pytest.raises(ValueError, match="leading batch"):
+                eng.submit({"x": np.float32(3.0)})
+
+    def test_slo_instruments_populated(self, rng):
+        eng, frozen, exe, logits, xs = _engine_fixture(rng)
+        with eng:
+            eng.warmup()
+            for s in (1, 2, 3, 4):
+                eng.infer({"x": xs[:s]}, timeout=60)
+        st = eng.stats()
+        assert st["requests"] >= 4 and st["batches"] >= 1
+        for h in ("latency_seconds", "queue_seconds", "device_seconds",
+                  "batch_size"):
+            assert st[h]["count"] >= 1, (h, st)
+            assert np.isfinite(st[h]["p99"]), (h, st)
+        # queue + device make up the latency (within histogram slack)
+        assert st["latency_seconds"]["avg"] >= \
+            st["device_seconds"]["avg"] - 1e-6
+
+    def test_serving_batch_trace_span(self, rng):
+        eng, frozen, exe, logits, xs = _engine_fixture(rng)
+        trace.reset()
+        fluid.core.set_flags({"FLAGS_enable_trace": True})
+        try:
+            with eng:
+                eng.infer({"x": xs[:3]}, timeout=60)
+            evs = trace.get_events()
+            names = [e.get("name") for e in evs]
+            assert "serving::batch" in names, names
+            batch_ev = [e for e in evs
+                        if e.get("name") == "serving::batch"][0]
+            assert batch_ev["args"]["rows"] == 3
+        finally:
+            fluid.core.set_flags({"FLAGS_enable_trace": False})
+            trace.reset()
+
+
+class TestAnalysisPredictorPlanes:
+    def _export(self, tmp_path, rng):
+        x = fluid.data("x", [-1, 8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(32, 8).astype("float32")
+        model_dir = str(tmp_path / "model")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        test_p = fluid.default_main_program().clone(for_test=True)
+        refs = {n: np.asarray(exe.run(test_p, feed={"x": xs[:n]},
+                                      fetch_list=[pred])[0])
+                for n in (4, 7, 3)}
+        return model_dir, xs, refs
+
+    def test_new_batch_size_reuses_bucket(self, tmp_path, rng):
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+        model_dir, xs, refs = self._export(tmp_path, rng)
+        p = create_predictor(AnalysisConfig(model_dir))
+        assert p._program._hints.get("frozen")          # freeze preset ran
+        assert p._program._hints.get("shape_bucketing")  # PR-2 plane on
+        m = trace.metrics()
+        name = p.get_input_names()[0]
+        out_name = p.get_output_names()[0]
+        p.get_input_handle(name).copy_from_cpu(xs[:8])
+        p.run()                                         # bucket 8 compiled
+        miss0 = m.counter("executor.compile_cache_miss").value
+        for n in (7, 5, 6, 8):                          # all inside bucket 8
+            p.get_input_handle(name).copy_from_cpu(xs[:n])
+            p.run()
+            got = p.get_output_handle(out_name).copy_to_cpu()
+            assert np.asarray(got).shape[0] == n
+        assert m.counter("executor.compile_cache_miss").value == miss0, \
+            "new batch sizes inside the bucket recompiled"
+        # numbers still match the training-program forward
+        for n, ref in refs.items():
+            p.get_input_handle(name).copy_from_cpu(xs[:n])
+            p.run()
+            got = np.asarray(p.get_output_handle(out_name).copy_to_cpu())
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_bucketing_opt_out(self, tmp_path, rng):
+        from paddle_tpu.inference import AnalysisConfig, create_predictor
+        model_dir, xs, refs = self._export(tmp_path, rng)
+        cfg = AnalysisConfig(model_dir)
+        cfg.switch_shape_bucketing(False)
+        p = create_predictor(cfg)
+        assert not p._program._hints.get("shape_bucketing")
+
+
+class TestMultiShapeAot:
+    def test_bucketed_export_serves_any_size(self, tmp_path, rng):
+        import os
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        x = fluid.data("x", [-1, 8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(16, 8).astype("float32")
+        model_dir = str(tmp_path / "m")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        test_p = fluid.default_main_program().clone(for_test=True)
+
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot")
+        meta = save_aot_model(aot_dir, p, {"x": xs[:4]},
+                              bucket_edges=[2, 4, 8, 16])
+        assert meta["buckets"] == [2, 4, 8, 16]
+        for edge, fname in meta["bucket_files"].items():
+            assert os.path.exists(os.path.join(aot_dir, fname)), edge
+        assert os.path.exists(os.path.join(aot_dir, "model.stablehlo"))
+
+        served = load_aot_model(aot_dir)
+        assert served.buckets == [2, 4, 8, 16]
+        for n in (1, 2, 3, 5, 7, 8, 11, 16):
+            got = served({"x": xs[:n]})[served.get_output_names()[0]]
+            want, = exe.run(test_p, feed={"x": xs[:n]}, fetch_list=[pred])
+            assert got.shape[0] == n
+            np.testing.assert_allclose(got, np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_oversize_rejected_with_guidance(self, tmp_path, rng):
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        x = fluid.data("x", [-1, 8])
+        pred = fluid.layers.fc(x, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(16, 8).astype("float32")
+        model_dir = str(tmp_path / "m")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot")
+        save_aot_model(aot_dir, p, {"x": xs[:4]}, bucket_edges=[2, 4])
+        served = load_aot_model(aot_dir)
+        with pytest.raises(ValueError, match="largest exported bucket"):
+            served({"x": xs[:9]})
+
+    def test_unbucketed_artifact_unchanged(self, tmp_path, rng):
+        """No bucket_edges -> the legacy single-shape artifact, same
+        files, same behaviour."""
+        import os
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        x = fluid.data("x", [-1, 8])
+        pred = fluid.layers.fc(x, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(4, 8).astype("float32")
+        model_dir = str(tmp_path / "m")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        cfg = AnalysisConfig(model_dir)
+        cfg.switch_shape_bucketing(False)
+        p = create_predictor(cfg)
+        aot_dir = str(tmp_path / "aot")
+        meta = save_aot_model(aot_dir, p, {"x": xs})
+        assert "buckets" not in meta
+        assert sorted(os.listdir(aot_dir)) == ["aot_meta.json",
+                                               "model.stablehlo"]
+        served = load_aot_model(aot_dir)
+        out = served({"x": xs})
+        assert out[served.get_output_names()[0]].shape[0] == 4
+
+
+    def test_legacy_artifact_clear_error_on_other_size(self, tmp_path,
+                                                       rng):
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        x = fluid.data("x", [-1, 8])
+        pred = fluid.layers.fc(x, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(8, 8).astype("float32")
+        model_dir = str(tmp_path / "m")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot")
+        save_aot_model(aot_dir, p, {"x": xs[:4]})     # legacy, baked 4
+        served = load_aot_model(aot_dir)
+        with pytest.raises(ValueError, match="bakes batch size 4"):
+            served({"x": xs[:3]})
+
+
+class TestAotEngine:
+    def test_engine_over_legacy_artifact(self, tmp_path, rng):
+        """A legacy single-shape artifact still serves through the
+        engine: the baked batch size becomes the only bucket, warmup
+        targets it, and exact-size requests complete."""
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        x = fluid.data("x", [-1, 8])
+        pred = fluid.layers.fc(x, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(8, 8).astype("float32")
+        model_dir = str(tmp_path / "m")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot")
+        save_aot_model(aot_dir, p, {"x": xs[:4]})     # no bucket_edges
+        served = load_aot_model(aot_dir)
+        with serving.ServingEngine(served, max_wait_us=1000) as eng:
+            assert list(eng.bucket_edges) == [4]      # baked size only
+            eng.warmup()                              # must not crash
+            got = eng.infer({"x": xs[:4]}, timeout=60)
+        assert got[served.get_output_names()[0]].shape[0] == 4
+
+    def test_engine_over_aot_artifact(self, tmp_path, rng):
+        """ServingEngine driven by the multi-bucket AOT artifact (the
+        examples/aot_serve.py --engine path)."""
+        from paddle_tpu.inference import (AnalysisConfig, create_predictor,
+                                          save_aot_model, load_aot_model)
+        x = fluid.data("x", [-1, 8])
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        exe = fluid.Executor()
+        exe.run(fluid.default_startup_program())
+        xs = rng.randn(16, 8).astype("float32")
+        model_dir = str(tmp_path / "m")
+        fluid.io.save_inference_model(model_dir, ["x"], [pred], exe)
+        p = create_predictor(AnalysisConfig(model_dir))
+        aot_dir = str(tmp_path / "aot")
+        save_aot_model(aot_dir, p, {"x": xs[:4]}, bucket_edges=[2, 4, 8])
+        served = load_aot_model(aot_dir)
+
+        with serving.ServingEngine(served, max_batch=8,
+                                   max_wait_us=1000) as eng:
+            eng.warmup()
+            sizes = [1, 2, 3, 1, 2, 3]
+            futs = [eng.submit({"x": xs[:s] + 0.1 * i})
+                    for i, s in enumerate(sizes)]
+            for i, (s, f) in enumerate(zip(sizes, futs)):
+                got = f.result(timeout=60)
+                direct = served({"x": xs[:s] + 0.1 * i})
+                np.testing.assert_allclose(
+                    got[served.get_output_names()[0]],
+                    direct[served.get_output_names()[0]],
+                    rtol=1e-6, atol=1e-7)

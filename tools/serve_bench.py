@@ -1,0 +1,219 @@
+"""Open-loop serving benchmark: sustained QPS + latency percentiles.
+
+The "millions of users" measurement (ROADMAP item 2): drive a
+ServingEngine with **open-loop** synthetic load — Poisson arrivals at a
+target rate with mixed request sizes, submitted on schedule whether or
+not earlier requests finished — and report what the engine actually
+sustained: completed QPS, p50/p95/p99 latency split into queue vs
+device time, rejection/timeout counts, and the batch-size distribution
+the continuous batcher achieved.  Open loop is the honest protocol: a
+closed loop would slow the clients down with the server and hide the
+knee.
+
+Run:
+    python tools/serve_bench.py                       # demo mlp, 200 qps
+    python tools/serve_bench.py --qps 500 --seconds 5 --sizes 1,2,4,8
+    python tools/serve_bench.py --metrics-port 9100   # live /metrics
+
+Emits one JSON line (machine-readable, bench.py-style) and appends it
+to BENCH_evidence.json via bench.record_evidence on real accelerators.
+``bench.py --model serve`` (child mode) rides this module for the
+driver-window serving row.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def build_demo_engine(hidden=64, features=16, classes=10, max_batch=32,
+                      max_wait_us=2000, queue_depth=256):
+    """A small frozen mlp + ServingEngine — the ci_smoke serving demo."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import serving
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.data("x", [-1, features])
+        h = fluid.layers.fc(x, hidden, act="relu")
+        h = fluid.layers.fc(h, hidden, act="relu")
+        logits = fluid.layers.fc(h, classes)
+    exe = fluid.Executor()
+    exe.run(startup)
+    frozen = serving.freeze_program(main_p, ["x"], [logits])
+    eng = serving.ServingEngine(frozen, executor=exe, max_batch=max_batch,
+                                max_wait_us=max_wait_us,
+                                queue_depth=queue_depth)
+    return eng, frozen, exe, logits.name, features
+
+
+def run_open_loop(engine, feed_of_rows, qps: float, n_requests: int,
+                  sizes, seed=0, deadline_ms=None):
+    """Submit ``n_requests`` on a Poisson schedule at ``qps`` offered
+    load; returns (futures, wall_seconds, offered_seconds, rejected).
+    Submission never waits for results — open loop."""
+    rng = np.random.RandomState(seed)
+    inter = rng.exponential(1.0 / max(qps, 1e-9), size=n_requests)
+    sched = np.cumsum(inter)
+    sizes = list(sizes)
+    req_rows = [int(sizes[i % len(sizes)]) for i in rng.permutation(
+        n_requests)]
+    futures, rejected = [], 0
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        lag = sched[i] - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        try:
+            futures.append(engine.submit(feed_of_rows(req_rows[i]),
+                                         deadline_ms=deadline_ms))
+        except Exception:           # noqa: BLE001 — QueueFull counts
+            rejected += 1
+    wall_submit = time.perf_counter() - t0
+    return futures, wall_submit, float(sched[-1]), rejected
+
+
+def collect(futures, timeout=120.0):
+    """Wait every future out; returns (completed, failed)."""
+    done = failed = 0
+    deadline = time.monotonic() + timeout
+    for f in futures:
+        try:
+            f.result(timeout=max(deadline - time.monotonic(), 0.01))
+            done += 1
+        except Exception:           # noqa: BLE001 — timeouts/rejections
+            failed += 1
+    return done, failed
+
+
+def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
+                max_batch=32, max_wait_us=2000, queue_depth=256,
+                hidden=64, deadline_ms=None, metrics_port=None,
+                warmup=True):
+    """Build the demo engine, warm it, run the open-loop load, and
+    return the report dict."""
+    from paddle_tpu.fluid import trace, metrics_export
+
+    srv = None
+    if metrics_port is not None:
+        srv = metrics_export.start_http(port=int(metrics_port))
+        print(f"# /metrics live on port {srv.port}", file=sys.stderr)
+
+    try:
+        eng, frozen, exe, fetch_name, features = build_demo_engine(
+            hidden=hidden, max_batch=max_batch, max_wait_us=max_wait_us,
+            queue_depth=queue_depth)
+        rng = np.random.RandomState(1)
+        pool = rng.randn(max(sizes) * 4, features).astype("float32")
+
+        def feed_of_rows(n):
+            off = rng.randint(0, len(pool) - n + 1)
+            return {"x": pool[off:off + n]}
+
+        m = trace.metrics()
+        with eng:
+            wreport = eng.warmup() if warmup else None
+            cold0 = m.counter("executor.compile_cache_cold_miss").value
+            miss0 = m.counter("executor.compile_cache_miss").value
+            t0 = time.perf_counter()
+            futures, wall_submit, offered_s, rejected = run_open_loop(
+                eng, feed_of_rows, qps, n_requests, sizes,
+                deadline_ms=deadline_ms)
+            done, failed = collect(futures)
+            wall = time.perf_counter() - t0
+            compiles_under_load = \
+                m.counter("executor.compile_cache_miss").value - miss0
+            cold_under_load = \
+                m.counter("executor.compile_cache_cold_miss").value - cold0
+        stats = eng.stats()
+    finally:
+        if srv is not None:
+            metrics_export.stop_http()
+
+    lat = stats["latency_seconds"]
+    q = stats["queue_seconds"]
+    d = stats["device_seconds"]
+    report = {
+        "metric": "serving_sustained_qps",
+        "value": round(done / wall, 1) if wall > 0 else 0.0,
+        "unit": "req/s",
+        "offered_qps": round(qps, 1),
+        "requests": n_requests,
+        "completed": done,
+        "failed": failed,
+        "rejected_at_submit": rejected,
+        "timeouts": stats["timeouts"],
+        "latency_ms": {
+            "p50": round(lat.get("p50", 0) * 1e3, 3),
+            "p95": round(lat.get("p95", 0) * 1e3, 3),
+            "p99": round(lat.get("p99", 0) * 1e3, 3),
+            "queue_p50": round(q.get("p50", 0) * 1e3, 3),
+            "queue_p99": round(q.get("p99", 0) * 1e3, 3),
+            "device_p50": round(d.get("p50", 0) * 1e3, 3),
+            "device_p99": round(d.get("p99", 0) * 1e3, 3),
+        },
+        "batch_size_avg": round(stats["batch_size"].get("avg", 0), 2),
+        "batches": stats["batches"],
+        "buckets": stats["buckets"],
+        "warmup": wreport,
+        "compiles_under_load": compiles_under_load,
+        "cold_compiles_under_load": cold_under_load,
+        "config": {"max_batch": max_batch, "max_wait_us": max_wait_us,
+                   "queue_depth": queue_depth, "sizes": list(sizes),
+                   "hidden": hidden, "deadline_ms": deadline_ms},
+    }
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--qps", type=float, default=200.0,
+                    help="offered (open-loop) arrival rate")
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="derive --requests as qps * seconds")
+    ap.add_argument("--sizes", default="1,2,4,8",
+                    help="comma list of request row counts to mix")
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-us", type=int, default=2000)
+    ap.add_argument("--queue-depth", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve live /metrics during the run (0=ephemeral)")
+    args = ap.parse_args(argv)
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    n = args.requests
+    if args.seconds:
+        n = max(1, int(args.qps * args.seconds))
+    sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+    report = serve_bench(
+        qps=args.qps, n_requests=n, sizes=sizes,
+        max_batch=args.max_batch, max_wait_us=args.max_wait_us,
+        queue_depth=args.queue_depth, hidden=args.hidden,
+        deadline_ms=args.deadline_ms, metrics_port=args.metrics_port)
+
+    import bench
+    report["backend"] = bench.backend_name()
+    if report["backend"] not in ("cpu", "error"):
+        bench.record_evidence(dict(report))
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
