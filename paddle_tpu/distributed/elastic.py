@@ -200,14 +200,24 @@ class ElasticContext:
         marker.  Returns the committed checkpoint step.  ``manager``
         overrides the context's own (a loop that owns its
         CheckpointManager but runs under an ambient context)."""
-        from ..fluid import trace
+        from ..fluid import flight_recorder, trace
         t0 = trace.now()
-        with trace.span("elastic::drain", cat="step",
-                        args={"reason": self._reason}):
-            for r in runners:
-                r.drain()
-            if executor is not None and hasattr(executor, "drain_async"):
-                executor.drain_async()
+        flight_recorder.record("preempt", reason=self._reason or "preempt",
+                               step=step)
+        # SLO-watchdog liveness: a drain legitimately pauses completions
+        # while the window closes — never a stall (fluid/watchdog.py)
+        drain_g = trace.metrics().gauge("elastic.drain_in_progress")
+        drain_g.add(1)
+        try:
+            with trace.span("elastic::drain", cat="step",
+                            args={"reason": self._reason}):
+                for r in runners:
+                    r.drain()
+                if executor is not None and hasattr(executor,
+                                                    "drain_async"):
+                    executor.drain_async()
+        finally:
+            drain_g.add(-1)
         trace.metrics().histogram("elastic.drain_seconds").observe(
             (trace.now() - t0) / 1e9)
         manager = manager or self.manager

@@ -45,11 +45,24 @@ from . import dataset
 from .dataset import DatasetFactory, InMemoryDataset, QueueDataset
 from . import trace
 from . import goodput
+from . import flight_recorder
 from . import profiler
 from . import monitor
 from .reader import DataLoader
 
 core.init_signal_handlers()
+
+# SLO watchdog (fluid/watchdog.py): env-gated like the export plane —
+# `FLAGS_watchdog=1 python serve.py` arms stall/breach/crash/OOM
+# detection with post-mortem diagnostic bundles, no code changes.
+if core.get_flag("watchdog"):
+    try:
+        from . import watchdog as _watchdog
+        _watchdog.apply_flags()
+    except Exception as _e:             # noqa: BLE001 — forensics are
+        import sys as _sys              # advisory, never block import
+        print(f"paddle_tpu: WARNING: watchdog failed to start: "
+              f"{type(_e).__name__}: {_e}", file=_sys.stderr)
 
 # live metrics export (fluid/metrics_export.py): env-gated like the trace
 # plane — `FLAGS_metrics_port=9090 python train.py` serves /metrics with

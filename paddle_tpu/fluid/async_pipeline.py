@@ -312,7 +312,7 @@ class AsyncStepRunner:
             # no scan fusion, plain async window only
             self.steps_per_dispatch = 1
         self._donate_guard = donate_guard
-        self._pending: List[tuple] = []          # (feed, future) pre-group
+        self._pending: List[tuple] = []    # (feed, future, trace ctx)
         self._inflight: "deque[List[FetchHandle]]" = deque()
         self._error_futures: List[StepFuture] = []
         # every not-yet-persisted state-aliasing handle issued while
@@ -331,7 +331,12 @@ class AsyncStepRunner:
     def submit(self, feed: Dict[str, Any]) -> StepFuture:
         fut = StepFuture(self)
         self.submitted += 1
-        self._pending.append((dict(feed or {}), fut))
+        # the submitter's ambient trace context (a serving batch id)
+        # rides with the feed: a buffered scan group dispatches LATER,
+        # possibly under a different request's context — the step must
+        # still attribute to the one that submitted it
+        self._pending.append((dict(feed or {}), fut,
+                              trace.current_trace_id()))
         if len(self._pending) >= self.steps_per_dispatch:
             self._dispatch_group()
         return fut
@@ -363,7 +368,7 @@ class AsyncStepRunner:
         aborted = RuntimeError(
             "AsyncStepRunner.abort(): step was buffered when the driving "
             "loop aborted — it was never dispatched")
-        for _, fut in self._pending:
+        for _, fut, _ctx in self._pending:
             fut._set_error(aborted)
         self.submitted -= len(self._pending)    # never ran: not resumable
         self._pending = []
@@ -447,14 +452,22 @@ class AsyncStepRunner:
             raise
         m = trace.metrics()
         t0 = time.perf_counter()
+        # restore the SUBMITTER's trace context around the real dispatch:
+        # a buffered group dispatches later (flush/next submit), possibly
+        # under another request's ambient context — the executor::step
+        # span and step wide event must attribute to the context that
+        # submitted the group (its head; a scan group shares one span)
+        token = trace.set_context(group[0][2])
         try:
-            per_step = self._dispatch_feeds([f for f, _ in group])
+            per_step = self._dispatch_feeds([f for f, _, _ in group])
         except BaseException as exc:    # noqa: BLE001 — stored, not lost
-            for _, fut in group:
+            for _, fut, _ctx in group:
                 fut._set_error(exc)
                 self._error_futures.append(fut)
             m.counter("executor.async_dispatch_errors").inc()
             return
+        finally:
+            trace.restore_context(token)
         m.histogram("executor.dispatch_seconds").observe(
             time.perf_counter() - t0)
         m.counter("executor.async_steps").inc(len(group))
@@ -463,7 +476,7 @@ class AsyncStepRunner:
         per_step = [[h if isinstance(h, FetchHandle) else FetchHandle(h)
                      for h in hs] for hs in per_step]
         flat: List[FetchHandle] = []
-        for (_, fut), handles in zip(group, per_step):
+        for (_, fut, _ctx), handles in zip(group, per_step):
             fut._set_handles(handles)
             flat.extend(handles)
         if donate:

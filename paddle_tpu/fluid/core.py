@@ -206,6 +206,38 @@ _FLAGS: Dict[str, object] = {
     # exported timeline).
     "goodput_window_s": float(_os.environ.get(
         "FLAGS_goodput_window_s", "600") or 600),
+    # forensic plane (fluid/flight_recorder.py + fluid/watchdog.py,
+    # docs/observability.md "Flight recorder & post-mortems").  The
+    # flight recorder is a bounded ring of wide events (one per step /
+    # served request) that runs even with tracing OFF; the watchdog is
+    # a daemon that detects stalled progress / sustained p99 breach /
+    # crash+OOM and dumps one atomic diagnostic bundle per incident
+    # into diagnostic_dir (tools/diagnose.py renders them).
+    "flight_recorder": _os.environ.get(
+        "FLAGS_flight_recorder", "1").strip().lower()
+        in _trace._TRUE_STRINGS,
+    "flight_recorder_events": int(_os.environ.get(
+        "FLAGS_flight_recorder_events", "4096") or 4096),
+    "watchdog": _os.environ.get(
+        "FLAGS_watchdog", "").strip().lower() in _trace._TRUE_STRINGS,
+    "watchdog_interval_s": float(_os.environ.get(
+        "FLAGS_watchdog_interval_s", "1.0") or 1.0),
+    # stalled = work outstanding (inflight / step-in-progress / serving
+    # queue) with zero completions for this long; live compiles and
+    # elastic drains count as liveness so a long legit XLA compile
+    # never false-positives
+    "watchdog_stall_s": float(_os.environ.get(
+        "FLAGS_watchdog_stall_s", "30") or 30),
+    # sustained-p99 breach: threshold in ms (0 = off) held for N
+    # consecutive watchdog windows
+    "watchdog_p99_ms": float(_os.environ.get(
+        "FLAGS_watchdog_p99_ms", "0") or 0),
+    "watchdog_breach_windows": int(_os.environ.get(
+        "FLAGS_watchdog_breach_windows", "3") or 3),
+    "diagnostic_dir": _os.environ.get("FLAGS_diagnostic_dir") or None,
+    # how many trailing trace events a bundle embeds
+    "diagnostic_trace_tail": int(_os.environ.get(
+        "FLAGS_diagnostic_trace_tail", "5000") or 5000),
 }
 
 
@@ -262,6 +294,15 @@ def set_flags(flags: Dict[str, object]):
             # (start, restart on a changed port/path, or stop on unset)
             from . import metrics_export
             metrics_export.apply_flags()
+        elif k in ("flight_recorder", "flight_recorder_events"):
+            from . import flight_recorder
+            flight_recorder.configure(
+                capacity=int(_FLAGS.get("flight_recorder_events", 4096)
+                             or 4096),
+                enabled=bool(_FLAGS.get("flight_recorder", True)))
+        elif k == "watchdog":
+            from . import watchdog
+            watchdog.apply_flags()
 
 
 def get_flags(names):

@@ -47,7 +47,7 @@ from . import trace
 __all__ = [
     "capture_enabled", "capture", "sds_tree", "publish", "unpublish",
     "peak_bytes_of", "flops_of", "is_oom", "attach_oom_report",
-    "format_footprints",
+    "format_footprints", "live_footprints",
 ]
 
 _TRUE = ("1", "true", "yes", "on")
@@ -227,6 +227,15 @@ def unpublish(label: str) -> None:
     _refresh_aggregates()
 
 
+def live_footprints() -> List[Dict[str, Any]]:
+    """Every published (still-resident) executable as
+    ``{"label", "peak_bytes"}`` rows, biggest first — what a diagnostic
+    bundle embeds as the device-memory picture at incident time."""
+    with _agg_lock:
+        items = sorted(_agg.items(), key=lambda kv: kv[1], reverse=True)
+    return [{"label": k, "peak_bytes": int(v)} for k, v in items]
+
+
 def _refresh_aggregates() -> None:
     """Aggregate footprint across every live executable in the process:
     how much HBM the resident executables claim in total and at worst —
@@ -305,4 +314,12 @@ def attach_oom_report(exc: BaseException,
                           {"label": r.get("label"),
                            "peak_bytes": r.get("peak_bytes")}
                           for r in rows]})
+    try:
+        # RESOURCE_EXHAUSTED hook for the SLO watchdog: a running
+        # watchdog freezes the evidence (footprints now ride on exc)
+        # into an `oom` diagnostic bundle — rate-limited there
+        from . import watchdog
+        watchdog.notify_oom(exc)
+    except Exception:                   # noqa: BLE001 — forensics never
+        pass                            # worsen the primary error
     return exc

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from . import compile_cache
 from . import core
 from . import device_stats
+from . import flight_recorder as _flight
 from . import trace
 from .core import Scope, global_scope
 from .framework import Program, Block, Variable, default_main_program
@@ -47,6 +48,16 @@ def _fetch_name(f):
 
 
 _I32_MAX, _I32_MIN = 2 ** 31 - 1, -(2 ** 31)
+
+# cached instrument refs for the per-step path (a registry dict lookup
+# per step would be measurable on the flight recorder's 5% gate).  The
+# SLO watchdog reads these as its liveness/progress signals:
+# steps_in_progress > 0 means a (possibly wedged) device call is live,
+# compiles_in_progress > 0 marks a legitimately long first-call XLA
+# compile (never a stall), steps_completed is monotonic progress.
+_g_step_live = trace.metrics().gauge("executor.steps_in_progress")
+_g_compiling = trace.metrics().gauge("executor.compiles_in_progress")
+_c_steps_done = trace.metrics().counter("executor.steps_completed")
 
 
 def check_feed_width(name, v):
@@ -466,19 +477,35 @@ class Executor:
 
         if compiled.donates:
             self._persist_alias_live()
-        _t0 = trace.now() if tr_on else 0
+        _t0 = trace.now()               # always: the flight recorder and
+        _g_step_live.add(1)             # the watchdog time every step
+        if pending_compile is not None:
+            _g_compiling.add(1)
         try:
             fetches, new_vals = compiled.fn(mut, ro, feeds, step_key)
         except Exception as e:          # noqa: BLE001 — OOM forensics only
             if device_stats.is_oom(e):
                 device_stats.attach_oom_report(e, self.top_footprints())
             raise
+        finally:
+            _g_step_live.add(-1)
+            if pending_compile is not None:
+                _g_compiling.add(-1)
         if tr_on:
             # device-program launch span (per-step time; the per-op "op"
             # spans above are per-compile host cost)
             trace.complete("executor::step", _t0, cat="step",
                            args={"step": self._step - 1,
                                  "n_fetch": len(fetch_names)})
+        _c_steps_done.inc()
+        if _flight.enabled():
+            # one wide event per step, tracing on or off (the flight
+            # recorder is the always-on forensic ring)
+            _flight.record_step(
+                step=self._step - 1, dur_us=(trace.now() - _t0) / 1e3,
+                bucket=bucket, batch_valid=n_valid,
+                compile_miss=pending_compile is not None,
+                fp=key[0][:12], n_fetch=len(fetch_names))
         if pending_compile is not None:
             # trace + XLA compile both happened inside this first call
             _t0c, pcache, pkey, pwarm = pending_compile
@@ -830,7 +857,10 @@ class Executor:
 
         if compiled.donates:
             self._persist_alias_live()
-        _t0 = trace.now() if tr_on else 0
+        _t0 = trace.now()
+        _g_step_live.add(1)
+        if pending_compile is not None:
+            _g_compiling.add(1)
         try:
             st_fetches, carry_end, st_extras = compiled.fn(mut, ro, stacked,
                                                            keys)
@@ -838,11 +868,22 @@ class Executor:
             if device_stats.is_oom(e):
                 device_stats.attach_oom_report(e, self.top_footprints())
             raise
+        finally:
+            _g_step_live.add(-1)
+            if pending_compile is not None:
+                _g_compiling.add(-1)
         if tr_on:
             trace.complete("executor::step", _t0, cat="step",
                            args={"step": self._step - k_steps,
                                  "steps_fused": k_steps,
                                  "n_fetch": len(fetch_names)})
+        _c_steps_done.inc(k_steps)
+        if _flight.enabled():
+            _flight.record_step(
+                step=self._step - k_steps,
+                dur_us=(trace.now() - _t0) / 1e3, bucket=bucket,
+                compile_miss=pending_compile is not None,
+                fp=key[0][:12], n_fetch=len(fetch_names), scan=k_steps)
         if pending_compile is not None:
             compile_s = (trace.now() - pending_compile) / 1e9
             trace.metrics().histogram("executor.compile_seconds").observe(

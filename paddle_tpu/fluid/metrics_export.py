@@ -127,6 +127,16 @@ def goodput_payload() -> Dict[str, Any]:
     return rep
 
 
+def _watchdog_health() -> Dict[str, Any]:
+    """The SLO watchdog's state (``{"status": "ok"}`` when none runs) —
+    a scrape must never crash on a half-imported forensic plane."""
+    try:
+        from . import watchdog
+        return watchdog.health()
+    except Exception as e:              # noqa: BLE001
+        return {"status": "ok", "error": f"{type(e).__name__}: {e}"}
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle-tpu-metrics/1.0"
     protocol_version = "HTTP/1.1"
@@ -135,16 +145,26 @@ class _Handler(BaseHTTPRequestHandler):
         path = self.path.split("?", 1)[0]
         if path in ("/", "/metrics"):
             # refresh the goodput gauges so a plain Prometheus scrape
-            # carries goodput_ratio without a second endpoint
+            # carries goodput_ratio without a second endpoint, and the
+            # trace-drop gauge so attribution blindness is scrapeable
+            # live (not just in export metadata at run end)
             goodput_payload()
+            trace.metrics().gauge("trace.dropped_events").set(
+                trace.dropped_count())
             body = prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/goodput":
             body = json.dumps(goodput_payload(), default=str).encode()
             ctype = "application/json"
         elif path == "/healthz":
-            body = b"ok\n"
+            # liveness + the SLO watchdog's verdict: a fleet router
+            # reads the status word (ok / stalled / breached) as its
+            # ejection signal; /watchdog has the full state
+            body = (_watchdog_health().get("status", "ok") + "\n").encode()
             ctype = "text/plain"
+        elif path == "/watchdog":
+            body = json.dumps(_watchdog_health(), default=str).encode()
+            ctype = "application/json"
         else:
             body = b"not found\n"
             self.send_response(404)
@@ -187,11 +207,14 @@ class MetricsServer:
 def write_snapshot(path: str) -> Dict[str, Any]:
     """Append one self-contained JSONL metrics snapshot (histograms as
     their full stats dicts incl. p50/p95/p99) and return the row."""
+    trace.metrics().gauge("trace.dropped_events").set(
+        trace.dropped_count())
     row = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "uptime_s": round(_uptime_s(), 3),
         "metrics": trace.metrics().snapshot(),
         "goodput": goodput_payload(),
+        "watchdog": _watchdog_health(),
     }
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)

@@ -40,6 +40,7 @@ mode (``cat="dygraph_op"``) times real eager execution per call.
 from __future__ import annotations
 
 import atexit
+import itertools
 import json
 import os
 import threading
@@ -49,8 +50,10 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "enabled", "enable", "disable", "now", "complete", "instant",
     "counter_event", "add_event", "span", "get_events", "event_count",
-    "reset",
+    "tail_events", "reset",
     "reset_all", "set_path", "get_path", "set_max_events", "elapsed_us",
+    "new_id", "new_trace_id", "trace_context", "current_trace_id",
+    "current_span_id", "set_context", "restore_context",
     "export_chrome_trace",
     "op_summary", "summary_table", "metrics", "MetricsRegistry",
     "Counter", "Gauge", "Histogram", "SORTED_KEYS",
@@ -151,6 +154,107 @@ def _export_at_exit() -> None:
 
 
 # ---------------------------------------------------------------------------
+# trace identity: request/batch ids + the propagated context token
+# ---------------------------------------------------------------------------
+
+# process-wide id allocator (itertools.count.__next__ is atomic under the
+# GIL — the cheapest thread-safe counter there is)
+_ids = itertools.count(1)
+
+
+def new_id() -> int:
+    """A process-unique monotonically increasing integer id."""
+    return next(_ids)
+
+
+def new_trace_id(prefix: str = "req") -> str:
+    """A short process-salted trace id, e.g. ``req-3f2a-1c`` — unique
+    within the process and distinguishable across merged multi-process
+    timelines (the pid rides in the middle).  Allocation is a counter
+    bump + a format: cheap enough to run per request with tracing OFF
+    (the flight recorder keys wide events on these even then)."""
+    return f"{prefix}-{os.getpid() & 0xffff:x}-{next(_ids):x}"
+
+
+class _CtxLocal(threading.local):
+    ctx: Optional[Tuple[Optional[str], Optional[int]]] = None
+
+
+_tls = _CtxLocal()
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient request/batch trace id on this thread, or None."""
+    ctx = _tls.ctx
+    return ctx[0] if ctx is not None else None
+
+
+def current_span_id() -> Optional[int]:
+    ctx = _tls.ctx
+    return ctx[1] if ctx is not None else None
+
+
+def set_context(trace_id: Optional[str],
+                span_id: Optional[int] = None):
+    """Install (trace_id, span_id) as this thread's ambient trace
+    context and return the previous token for :func:`restore_context` —
+    the non-contextmanager spelling for cross-callback handoff."""
+    prev = _tls.ctx
+    _tls.ctx = (trace_id, span_id)
+    return prev
+
+
+def restore_context(token) -> None:
+    _tls.ctx = token
+
+
+class _TraceCtx:
+    """``with trace.trace_context(tid): ...`` — every event emitted on
+    this thread inside the block carries ``trace_id`` in its args, so a
+    dispatch made on behalf of request/batch X stamps X onto the
+    executor spans it triggers (the causal link the serving plane
+    threads from submit through the batcher into the device step)."""
+
+    __slots__ = ("trace_id", "span_id", "_token")
+
+    def __init__(self, trace_id, span_id=None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self._token = None
+
+    def __enter__(self):
+        self._token = set_context(self.trace_id, self.span_id)
+        return self
+
+    def __exit__(self, *exc):
+        restore_context(self._token)
+        return False
+
+
+def trace_context(trace_id: Optional[str],
+                  span_id: Optional[int] = None) -> _TraceCtx:
+    return _TraceCtx(trace_id, span_id)
+
+
+def _with_ctx(ev: Dict[str, Any],
+              args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Attach caller args plus the ambient trace context to an event.
+    The caller's dict is never mutated (a context merge copies)."""
+    ctx = _tls.ctx
+    if ctx is None or (ctx[0] is None and ctx[1] is None):
+        if args:
+            ev["args"] = args
+        return ev
+    merged = dict(args) if args else {}
+    if ctx[0] is not None:
+        merged.setdefault("trace_id", ctx[0])
+    if ctx[1] is not None:
+        merged.setdefault("parent_span", ctx[1])
+    ev["args"] = merged
+    return ev
+
+
+# ---------------------------------------------------------------------------
 # event emission
 # ---------------------------------------------------------------------------
 
@@ -182,11 +286,17 @@ def _append(ev: Dict[str, Any]) -> None:
         else:
             _state.events.append(ev)
     if warn:
+        # live drop visibility: flip the gauge on the FIRST drop; the
+        # export plane refreshes the exact count from dropped_count()
+        # on every /metrics scrape and JSONL snapshot, so a saturated
+        # hot loop never pays a second lock per dropped event here
+        _registry.gauge("trace.dropped_events").set(1)
         import sys
         print(f"paddle_tpu.trace: event buffer full "
               f"({_state.max_events} events) — dropping further events "
               f"(raise FLAGS_trace_max_events or export/reset "
-              f"periodically); drop count lands in the export metadata",
+              f"periodically); drop count lands in the export metadata "
+              f"and the trace.dropped_events gauge",
               file=sys.stderr)
 
 
@@ -203,9 +313,7 @@ def complete(name: str, t0_ns: int, cat: str = "op",
     ev = {"name": name, "cat": cat, "ph": "X",
           "ts": _ts_us(t0_ns), "dur": max((t1 - t0_ns) / 1e3, 0.0),
           "pid": os.getpid(), "tid": threading.get_ident()}
-    if args:
-        ev["args"] = args
-    _append(ev)
+    _append(_with_ctx(ev, args))
 
 
 def instant(name: str, cat: str = "instant",
@@ -214,9 +322,7 @@ def instant(name: str, cat: str = "instant",
     ev = {"name": name, "cat": cat, "ph": "i", "s": "p",
           "ts": _ts_us(now()), "pid": os.getpid(),
           "tid": threading.get_ident()}
-    if args:
-        ev["args"] = args
-    _append(ev)
+    _append(_with_ctx(ev, args))
 
 
 def counter_event(name: str, value, cat: str = "metric") -> None:
@@ -244,24 +350,39 @@ def add_event(name: str, ts_us: float, dur_us: float, cat: str = "op",
 class _Span:
     """RAII span (platform/profiler.h RecordEvent shape).  Enabled-ness is
     sampled at __enter__, so a span opened while tracing is on closes
-    correctly even if tracing flips mid-flight."""
+    correctly even if tracing flips mid-flight.
 
-    __slots__ = ("name", "cat", "args", "_t0")
+    While tracing, each span allocates a ``span_id``, records its parent
+    from the ambient context, and installs itself as the context for the
+    duration — nested spans export a reconstructible parent chain
+    (``args.span_id`` / ``args.parent_span``) alongside whatever
+    ``trace_id`` the enclosing request/batch context carries."""
+
+    __slots__ = ("name", "cat", "args", "_t0", "span_id", "_token")
 
     def __init__(self, name, cat, args):
         self.name = name
         self.cat = cat
         self.args = args
         self._t0 = None
+        self.span_id = None
+        self._token = None
 
     def __enter__(self):
         if _state.enabled:
             self._t0 = now()
+            self.span_id = new_id()
+            ctx = _tls.ctx
+            trace_id = ctx[0] if ctx is not None else None
+            self._token = set_context(trace_id, self.span_id)
         return self
 
     def __exit__(self, *exc):
         if self._t0 is not None:
-            complete(self.name, self._t0, cat=self.cat, args=self.args)
+            restore_context(self._token)
+            args = dict(self.args) if self.args else {}
+            args["span_id"] = self.span_id
+            complete(self.name, self._t0, cat=self.cat, args=args)
             self._t0 = None
         return False
 
@@ -286,6 +407,16 @@ def event_count() -> int:
     """Current buffer length."""
     with _state.lock:
         return len(_state.events)
+
+
+def tail_events(n: int) -> List[Dict[str, Any]]:
+    """Copy of the LAST ``n`` events — what a diagnostic bundle embeds
+    (the trace tail around an incident, not the whole buffer)."""
+    n = int(n)
+    if n <= 0:
+        return []
+    with _state.lock:
+        return _state.events[-n:]
 
 
 def buffer_generation() -> int:
@@ -314,6 +445,7 @@ def reset() -> None:
         _state.events.clear()
         _state.dropped = 0
         _state.generation += 1
+    _registry.gauge("trace.dropped_events").set(0)
 
 
 def reset_all() -> None:

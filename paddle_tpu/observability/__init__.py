@@ -17,7 +17,8 @@ from ..fluid.trace import (                                    # noqa: F401
     enabled, enable, disable, reset, reset_all, now, complete, instant,
     counter_event, add_event, span, get_events, set_path, get_path,
     set_max_events, export_chrome_trace, op_summary, summary_table,
-    metrics, MetricsRegistry, Counter, Gauge, Histogram, SORTED_KEYS)
+    metrics, MetricsRegistry, Counter, Gauge, Histogram, SORTED_KEYS,
+    new_trace_id, trace_context, current_trace_id)
 from ..fluid.profiler import (                                 # noqa: F401
     profiler, start_profiler, stop_profiler, reset_profiler, RecordEvent,
     record_event, cuda_profiler)
@@ -29,6 +30,9 @@ from ..utils.profiler import (                                 # noqa: F401
 from ..fluid import goodput                                    # noqa: F401
 from ..fluid import metrics_export                             # noqa: F401
 from ..fluid.goodput import attribute_events                   # noqa: F401
+from ..fluid import flight_recorder                            # noqa: F401
+from ..fluid import watchdog                                   # noqa: F401
+from ..fluid.watchdog import dump_bundle, load_bundle          # noqa: F401
 
 __all__ = [
     # event stream
@@ -47,4 +51,7 @@ __all__ = [
     "Profiler", "ProfilerOptions", "get_profiler",
     # goodput + live export plane
     "goodput", "metrics_export", "attribute_events",
+    # request tracing + forensic plane
+    "new_trace_id", "trace_context", "current_trace_id",
+    "flight_recorder", "watchdog", "dump_bundle", "load_bundle",
 ]

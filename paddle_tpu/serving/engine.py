@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..fluid import compile_cache, core, trace
+from ..fluid import flight_recorder as _flight
 from ..fluid.async_pipeline import AsyncStepRunner
 from ..fluid.core import global_scope
 from ..fluid.executor import Executor
@@ -72,15 +73,23 @@ class ServingFuture:
     """One request's pending result: ``result(timeout)`` blocks until the
     batch containing this request completes, then returns
     ``{fetch_name: rows-sliced ndarray}``.  A rejection/timeout resolves
-    the future with the corresponding :class:`ServingError`."""
+    the future with the corresponding :class:`ServingError`.
 
-    __slots__ = ("_event", "_result", "_exc", "rows")
+    ``trace_id`` is the request's causal identity: every span/wide event
+    the request produces on its way through admit → queue → batch →
+    device → demux carries it, so a client can hand the id to
+    ``tools/diagnose.py`` (or grep the exported timeline) and get the
+    request's full trajectory — allocated whether or not tracing is on
+    (the flight recorder keys on it even then)."""
 
-    def __init__(self, rows: int):
+    __slots__ = ("_event", "_result", "_exc", "rows", "trace_id")
+
+    def __init__(self, rows: int, trace_id: Optional[str] = None):
         self._event = threading.Event()
         self._result: Optional[Dict[str, np.ndarray]] = None
         self._exc: Optional[BaseException] = None
         self.rows = rows
+        self.trace_id = trace_id
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -108,15 +117,19 @@ class ServingFuture:
 
 
 class _Request:
-    __slots__ = ("feed", "rows", "sig", "t_enqueue", "deadline", "future")
+    __slots__ = ("feed", "rows", "sig", "t_enqueue", "t_ns", "deadline",
+                 "future", "trace_id")
 
-    def __init__(self, feed, rows, sig, t_enqueue, deadline, future):
+    def __init__(self, feed, rows, sig, t_enqueue, deadline, future,
+                 trace_id):
         self.feed = feed
         self.rows = rows
         self.sig = sig
-        self.t_enqueue = t_enqueue
+        self.t_enqueue = t_enqueue      # monotonic: deadline math
+        self.t_ns = trace.now()         # trace clock: span windows
         self.deadline = deadline
         self.future = future
+        self.trace_id = trace_id
 
 
 _STOP = object()
@@ -435,8 +448,11 @@ class ServingEngine:
         dl_ms = (deadline_ms if deadline_ms is not None
                  else self.default_deadline_ms)
         deadline = now + dl_ms / 1e3 if dl_ms and dl_ms > 0 else None
-        fut = ServingFuture(n_rows)
-        req = _Request(arrs, n_rows, sig, now, deadline, fut)
+        # the request's causal identity — allocated with tracing ON or
+        # OFF (the flight recorder's wide events key on it either way)
+        trace_id = trace.new_trace_id("req")
+        fut = ServingFuture(n_rows, trace_id=trace_id)
+        req = _Request(arrs, n_rows, sig, now, deadline, fut, trace_id)
         # closed-check + enqueue under the lock: close() takes the same
         # lock to flip _closed BEFORE it enqueues _STOP, so a request can
         # never land behind the departing batcher and strand its future
@@ -447,6 +463,9 @@ class ServingEngine:
                 self._q.put_nowait(req)
             except queue.Full:
                 m.counter("serving.rejected").inc()
+                if _flight.enabled():
+                    _flight.record_request(trace_id, n_rows,
+                                           outcome="rejected")
                 exc = QueueFullError(
                     f"admission queue full ({self.queue_depth} requests)"
                     f" — the device is saturated; shed load or raise "
@@ -456,6 +475,10 @@ class ServingEngine:
         # admitted only (docs/observability.md): rejections don't count
         m.counter("serving.requests").inc()
         m.gauge("serving.queue_depth").set(self._q.qsize())
+        if trace.enabled():
+            trace.instant("serving::admit", cat="serving",
+                          args={"trace_id": trace_id, "rows": n_rows,
+                                "deadline_ms": dl_ms or 0})
         return fut
 
     def infer(self, feed: Dict[str, Any],
@@ -467,9 +490,18 @@ class ServingEngine:
     # -- batcher thread ------------------------------------------------------
     def _timeout_request(self, req: _Request) -> None:
         trace.metrics().counter("serving.timeouts").inc()
+        waited_ms = (time.monotonic() - req.t_enqueue) * 1e3
+        if trace.enabled():
+            trace.complete("serving::queue", req.t_ns, cat="serving",
+                           args={"trace_id": req.trace_id,
+                                 "outcome": "timeout"})
+        if _flight.enabled():
+            _flight.record_request(req.trace_id, req.rows,
+                                   outcome="timeout",
+                                   queue_us=waited_ms * 1e3,
+                                   latency_us=waited_ms * 1e3)
         req.future._reject(DeadlineExceededError(
-            f"deadline elapsed after "
-            f"{(time.monotonic() - req.t_enqueue) * 1e3:.1f}ms in queue"))
+            f"deadline elapsed after {waited_ms:.1f}ms in queue"))
 
     def _batcher(self) -> None:
         max_wait_s = self.max_wait_us / 1e6
@@ -557,27 +589,48 @@ class ServingEngine:
                 for n in self.feed_names}
         m = trace.metrics()
         tr_on = trace.enabled()
+        # the batch's causal identity: member request spans name it, the
+        # executor::step span dispatched below inherits it through the
+        # ambient trace context, and tools/timeline.py draws flow arrows
+        # from each request lane into the batch span
+        batch_id = trace.new_trace_id("batch")
+        bucket = compile_cache.bucket_for(rows, self.bucket_edges)
         _t0 = trace.now() if tr_on else 0
         try:
             # may block on the async window (backpressure) — that wait is
             # exactly the device saturating, and it throttles formation
-            fut = self._backend.dispatch(feed)
+            with trace.trace_context(batch_id):
+                fut = self._backend.dispatch(feed)
         except BaseException as exc:   # noqa: BLE001 — resolved, not lost
             for r in live:
                 r.future._reject(exc)
+                if _flight.enabled():
+                    _flight.record_request(r.trace_id, r.rows,
+                                           outcome="error",
+                                           batch_id=batch_id)
             m.counter("serving.dispatch_errors").inc()
             return
         t_dispatch = time.monotonic()
+        t_dispatch_ns = trace.now()
         if tr_on:
+            # per-request queue span: admit -> this dispatch (the queue
+            # half of the latency split, anchored on the trace clock)
+            for r in live:
+                trace.complete("serving::queue", r.t_ns, cat="serving",
+                               args={"trace_id": r.trace_id,
+                                     "batch_id": batch_id},
+                               end_ns=_t0)
             trace.complete(
                 "serving::batch", _t0, cat="serving",
                 args={"rows": rows, "n_requests": len(live),
-                      "bucket": compile_cache.bucket_for(
-                          rows, self.bucket_edges)})
+                      "batch_id": batch_id, "bucket": bucket,
+                      "request_ids": [r.trace_id for r in live]})
         m.counter("serving.batches").inc()
         m.histogram("serving.batch_size").observe(float(rows))
         with self._cv:
-            self._completions.append((fut, live, rows, t_dispatch))
+            self._completions.append(
+                (fut, live, rows, t_dispatch, batch_id, t_dispatch_ns,
+                 bucket))
             self._cv.notify()
 
     # -- collector thread ----------------------------------------------------
@@ -590,17 +643,29 @@ class ServingEngine:
                 item = self._completions.popleft()
             if item is _STOP:
                 return
-            fut, reqs, rows, t_dispatch = item
+            fut, reqs, rows, t_dispatch, batch_id, t_dispatch_ns, \
+                bucket = item
             try:
                 arrays = self._backend.wait(fut)
             except BaseException as exc:  # noqa: BLE001 — per-request
                 for r in reqs:
                     r.future._reject(exc)
+                    if _flight.enabled():
+                        _flight.record_request(r.trace_id, r.rows,
+                                               outcome="error",
+                                               batch_id=batch_id)
                 m.counter("serving.dispatch_errors").inc()
                 continue
             t_done = time.monotonic()
-            m.histogram("serving.device_seconds").observe(
-                max(t_done - t_dispatch, 0.0))
+            t_done_ns = trace.now()
+            tr_on = trace.enabled()
+            device_s = max(t_done - t_dispatch, 0.0)
+            m.histogram("serving.device_seconds").observe(device_s)
+            if tr_on:
+                trace.complete("serving::device", t_dispatch_ns,
+                               cat="serving",
+                               args={"batch_id": batch_id, "rows": rows},
+                               end_ns=t_done_ns)
             off = 0
             for r in reqs:
                 res = {}
@@ -611,10 +676,30 @@ class ServingEngine:
                     else:
                         res[name] = arr
                 off += r.rows
-                m.histogram("serving.queue_seconds").observe(
-                    max(t_dispatch - r.t_enqueue, 0.0))
-                m.histogram("serving.latency_seconds").observe(
-                    max(t_done - r.t_enqueue, 0.0))
+                queue_s = max(t_dispatch - r.t_enqueue, 0.0)
+                latency_s = max(t_done - r.t_enqueue, 0.0)
+                m.histogram("serving.queue_seconds").observe(queue_s)
+                m.histogram("serving.latency_seconds").observe(latency_s)
+                if tr_on:
+                    # the request's full span, closed at demux: the
+                    # causal chain a trace_id reconstructs is
+                    # admit(i) -> serving::queue -> serving::batch
+                    # -> serving::device -> serving::request (this)
+                    trace.complete(
+                        "serving::request", r.t_ns, cat="serving",
+                        args={"trace_id": r.trace_id,
+                              "batch_id": batch_id, "rows": r.rows,
+                              "bucket": bucket,
+                              "queue_us": round(queue_s * 1e6, 1),
+                              "device_us": round(device_s * 1e6, 1)},
+                        end_ns=t_done_ns)
+                if _flight.enabled():
+                    _flight.record_request(
+                        r.trace_id, r.rows, outcome="ok",
+                        batch_id=batch_id, batch_rows=rows,
+                        bucket=bucket, queue_us=queue_s * 1e6,
+                        device_us=device_s * 1e6,
+                        latency_us=latency_s * 1e6)
                 r.future._resolve(res)
 
     # -- introspection -------------------------------------------------------

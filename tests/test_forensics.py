@@ -1,0 +1,732 @@
+"""Forensic plane: request-scoped tracing, flight recorder, SLO
+watchdog, and post-mortem diagnostic bundles (fluid/flight_recorder.py,
+fluid/watchdog.py, tools/diagnose.py, the serving trace-id thread).
+
+Satellite contract (ISSUE 11): stall detection fires exactly once per
+incident, a live compile suppresses it, the p99 breach needs M
+consecutive windows, and a bundle written mid-crash is loadable
+(atomic tmp+rename like checkpoints).
+"""
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import flight_recorder, trace, watchdog
+from paddle_tpu.fluid.core import Scope, scope_guard
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_plane():
+    trace.reset_all()
+    flight_recorder.reset()
+    yield
+    watchdog.stop()
+    trace.disable()
+    trace.reset_all()
+    flight_recorder.reset()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# trace identity
+# ---------------------------------------------------------------------------
+
+class TestTraceIdentity:
+    def test_new_trace_id_unique_and_prefixed(self):
+        ids = {trace.new_trace_id("req") for _ in range(1000)}
+        assert len(ids) == 1000
+        assert all(i.startswith("req-") for i in ids)
+
+    def test_context_attaches_trace_id_to_events(self):
+        trace.enable()
+        with trace.trace_context("batch-xyz"):
+            t0 = trace.now()
+            trace.complete("inner", t0, cat="step", args={"k": 1})
+            trace.instant("mark", cat="step")
+        t0 = trace.now()
+        trace.complete("outside", t0, cat="step")
+        evs = {e["name"]: e for e in trace.get_events()}
+        assert evs["inner"]["args"]["trace_id"] == "batch-xyz"
+        assert evs["inner"]["args"]["k"] == 1
+        assert evs["mark"]["args"]["trace_id"] == "batch-xyz"
+        assert "trace_id" not in (evs["outside"].get("args") or {})
+
+    def test_context_is_thread_local(self):
+        trace.enable()
+        seen = []
+
+        def other():
+            seen.append(trace.current_trace_id())
+
+        with trace.trace_context("mine"):
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+            assert trace.current_trace_id() == "mine"
+        assert seen == [None]
+        assert trace.current_trace_id() is None
+
+    def test_span_ids_nest_with_parent_chain(self):
+        trace.enable()
+        with trace.span("outer", cat="step"):
+            with trace.span("inner", cat="step"):
+                pass
+        evs = {e["name"]: e for e in trace.get_events()}
+        outer, inner = evs["outer"]["args"], evs["inner"]["args"]
+        assert inner["parent_span"] == outer["span_id"]
+        assert inner["span_id"] != outer["span_id"]
+
+    def test_caller_args_dict_never_mutated(self):
+        trace.enable()
+        args = {"a": 1}
+        with trace.trace_context("t1"):
+            trace.complete("x", trace.now(), args=args)
+        assert args == {"a": 1}
+
+    def test_tail_events(self):
+        trace.enable()
+        for i in range(10):
+            trace.instant(f"e{i}")
+        tail = trace.tail_events(3)
+        assert [e["name"] for e in tail] == ["e7", "e8", "e9"]
+        assert trace.tail_events(0) == []
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_order(self):
+        r = flight_recorder.FlightRecorder(capacity=16)
+        for i in range(40):
+            r.record({"kind": "step", "i": i})
+        snap = r.snapshot()
+        assert len(snap) == 16
+        assert [s["i"] for s in snap] == list(range(24, 40))
+        assert r.total == 40
+        assert [s["seq"] for s in snap] == list(range(24, 40))
+
+    def test_disabled_recorder_records_nothing(self):
+        r = flight_recorder.FlightRecorder(capacity=16, enabled=False)
+        r.record({"kind": "step"})
+        assert r.total == 0 and r.snapshot() == []
+
+    def test_snapshot_last_and_copies(self):
+        r = flight_recorder.FlightRecorder(capacity=16)
+        for i in range(5):
+            r.record({"kind": "step", "i": i})
+        last2 = r.snapshot(last=2)
+        assert [s["i"] for s in last2] == [3, 4]
+        last2[0]["i"] = 999                     # copies: ring unchanged
+        assert r.snapshot(last=2)[0]["i"] == 3
+
+    def test_configure_flags_roundtrip(self):
+        saved_en = flight_recorder.enabled()
+        saved_cap = flight_recorder.recorder().capacity
+        try:
+            fluid.core.set_flags({"FLAGS_flight_recorder": False})
+            assert not flight_recorder.enabled()
+            flight_recorder.record("step", i=1)
+            assert flight_recorder.recorder().total == 0
+            fluid.core.set_flags({"FLAGS_flight_recorder": True,
+                                  "FLAGS_flight_recorder_events": 64})
+            assert flight_recorder.enabled()
+            assert flight_recorder.recorder().capacity == 64
+        finally:
+            fluid.core.set_flags({
+                "FLAGS_flight_recorder": saved_en,
+                "FLAGS_flight_recorder_events": saved_cap})
+
+    def test_executor_steps_recorded_with_tracing_off(self):
+        assert not trace.enabled()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [4])
+            y = fluid.layers.scale(x, scale=2.0)
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            for _ in range(3):
+                exe.run(main, feed={"x": np.ones(4, "float32")},
+                        fetch_list=[y])
+        steps = [r for r in flight_recorder.recorder().snapshot()
+                 if r["kind"] == "step"]
+        assert len(steps) == 3
+        assert steps[0]["compile_miss"] and not steps[1]["compile_miss"]
+        assert steps[0]["fp"] and steps[0]["dur_us"] > 0
+        assert "goodput_ratio" in steps[0] and "rss_bytes" in steps[0]
+        # steps_completed is the watchdog's progress counter
+        assert trace.metrics().counter(
+            "executor.steps_completed").value >= 3
+
+
+# ---------------------------------------------------------------------------
+# serving: causal request traces + request wide events
+# ---------------------------------------------------------------------------
+
+def _build_engine(exe, max_batch=8, max_wait_us=1000, **kw):
+    from paddle_tpu import serving
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8])
+        logits = fluid.layers.fc(x, 4)
+    exe.run(startup)
+    frozen = serving.freeze_program(main, ["x"], [logits])
+    return serving.ServingEngine(frozen, executor=exe,
+                                 max_batch=max_batch,
+                                 max_wait_us=max_wait_us, **kw), logits
+
+
+class TestRequestTracing:
+    def test_future_exposes_trace_id_even_untraced(self):
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            eng, logits = _build_engine(exe)
+            with eng:
+                fut = eng.submit(
+                    {"x": np.ones((2, 8), "float32")})
+                fut.result(timeout=30)
+            assert fut.trace_id and fut.trace_id.startswith("req-")
+            recs = [r for r in flight_recorder.recorder().snapshot()
+                    if r.get("trace_id") == fut.trace_id]
+            assert recs and recs[0]["outcome"] == "ok"
+            assert recs[0]["latency_us"] > 0
+
+    def test_causal_chain_reconstructible_by_trace_id(self):
+        trace.enable()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            eng, logits = _build_engine(exe)
+            with eng:
+                futs = [eng.submit({"x": np.ones((2, 8), "float32")})
+                        for _ in range(4)]
+                [f.result(timeout=30) for f in futs]
+        evs = trace.get_events()
+        for fut in futs:
+            tid = fut.trace_id
+            mine = [e for e in evs
+                    if (e.get("args") or {}).get("trace_id") == tid]
+            names = {e["name"] for e in mine}
+            # admit -> queue -> request(full span, closed at demux)
+            assert {"serving::admit", "serving::queue",
+                    "serving::request"} <= names, (tid, names)
+            req = [e for e in mine if e["name"] == "serving::request"][0]
+            batch_id = req["args"]["batch_id"]
+            assert req["args"]["queue_us"] >= 0
+            assert req["args"]["device_us"] >= 0
+            # the batch span lists this request as a member...
+            batch = [e for e in evs if e["name"] == "serving::batch"
+                     and (e.get("args") or {}).get("batch_id")
+                     == batch_id]
+            assert batch and tid in batch[0]["args"]["request_ids"]
+            # ...the device span exists for the batch...
+            assert any(e["name"] == "serving::device"
+                       and e["args"]["batch_id"] == batch_id
+                       for e in evs)
+            # ...and the executor step dispatched under the batch's
+            # context carries the batch id (request -> batch -> step)
+            assert any(e["name"] == "executor::step"
+                       and (e.get("args") or {}).get("trace_id")
+                       == batch_id for e in evs)
+
+    def test_runner_restores_submitter_context_on_deferred_dispatch(self):
+        """A scan group buffered at submit time dispatches LATER (at
+        flush), possibly outside the submitter's trace context — the
+        executor::step span must still carry the submitter's id."""
+        from paddle_tpu.fluid.async_pipeline import AsyncStepRunner
+        trace.enable()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [4])
+            y = fluid.layers.scale(x, scale=2.0)
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            r = AsyncStepRunner(exe, main, [y], max_inflight=2,
+                                steps_per_dispatch=4)
+            with trace.trace_context("batch-deferred"):
+                r.submit({"x": np.ones(4, "float32")})
+                r.submit({"x": np.ones(4, "float32")})
+            assert trace.current_trace_id() is None
+            r.flush()                   # dispatched OUTSIDE the context
+            r.drain()
+        steps = [e for e in trace.get_events()
+                 if e["name"] == "executor::step"]
+        assert steps and steps[-1]["args"]["trace_id"] == "batch-deferred"
+
+    def test_timeout_and_rejection_wide_events(self):
+        from paddle_tpu import serving
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            eng, _ = _build_engine(exe, max_wait_us=200000, queue_depth=2,
+                                   auto_start=False)
+            ok = [eng.submit({"x": np.ones((1, 8), "float32")})
+                  for _ in range(2)]
+            with pytest.raises(serving.QueueFullError):
+                eng.submit({"x": np.ones((1, 8), "float32")})
+            recs = flight_recorder.recorder().snapshot()
+            rej = [r for r in recs if r.get("outcome") == "rejected"]
+            assert len(rej) == 1 and rej[0]["trace_id"].startswith("req-")
+            eng.start()
+            [f.result(timeout=30) for f in ok]
+            eng.close()
+
+    def test_timeline_flows_and_lanes(self, tmp_path):
+        trace.enable()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            eng, _ = _build_engine(exe)
+            with eng:
+                futs = [eng.submit({"x": np.ones((2, 8), "float32")})
+                        for _ in range(3)]
+                [f.result(timeout=30) for f in futs]
+        src = tmp_path / "t.json"
+        out = tmp_path / "out.json"
+        trace.export_chrome_trace(str(src))
+        tl = _load_tool("timeline")
+        assert tl.convert([str(src)], str(out)) == 0
+        evs = json.loads(out.read_text())["traceEvents"]
+        starts = [e for e in evs if e.get("ph") == "s"]
+        ends = [e for e in evs if e.get("ph") == "f"]
+        assert len(starts) == 3 and len(ends) == 3
+        assert {e["id"] for e in starts} == {e["id"] for e in ends}
+        lanes = [e for e in evs if e.get("ph") == "M"
+                 and e.get("name") == "thread_name"
+                 and str((e.get("args") or {}).get("name", ""))
+                 .startswith("req-")]
+        assert len(lanes) == 3
+        # --no-flows opt-out
+        out2 = tmp_path / "out2.json"
+        assert tl.convert([str(src)], str(out2), flows=False) == 0
+        evs2 = json.loads(out2.read_text())["traceEvents"]
+        assert not any(e.get("ph") in ("s", "f") for e in evs2)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _wd(tmp_path, clock, **kw):
+    kw.setdefault("stall_s", 5.0)
+    kw.setdefault("p99_ms", 0.0)
+    return watchdog.SloWatchdog(diagnostic_dir=str(tmp_path),
+                                now_fn=clock, **kw)
+
+
+class TestWatchdogStall:
+    def test_stall_fires_exactly_once_per_incident(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        g.set(1)                        # work outstanding, never completes
+        try:
+            assert wd.tick() == "ok"
+            clock.t += 6.0
+            assert wd.tick() == "stalled"
+            bundles = watchdog.list_bundles(str(tmp_path))
+            assert len(bundles) == 1
+            # stays stalled, but no second bundle while latched
+            clock.t += 20.0
+            assert wd.tick() == "stalled"
+            assert len(watchdog.list_bundles(str(tmp_path))) == 1
+            # progress resumes -> ok, latch cleared
+            flight_recorder.record("step", i=1)
+            assert wd.tick() == "ok"
+            # a NEW incident fires again
+            clock.t += 6.0
+            assert wd.tick() == "stalled"
+            assert len(watchdog.list_bundles(str(tmp_path))) == 2
+        finally:
+            g.set(0)
+
+    def test_rejection_storm_is_not_liveness(self, tmp_path):
+        """A wedged device under open-loop load keeps producing
+        rejected/timeout wide events — those are NOT completions and
+        must not keep resetting the stall clock."""
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        g.set(1)
+        try:
+            for _ in range(3):          # clients keep hammering submit()
+                clock.t += 2.0
+                flight_recorder.record_request(
+                    trace.new_trace_id("req"), rows=1, outcome="rejected")
+                flight_recorder.record_request(
+                    trace.new_trace_id("req"), rows=1, outcome="timeout",
+                    latency_us=1e6)
+                wd.tick()
+            assert wd.state == "stalled"
+            assert len(watchdog.list_bundles(str(tmp_path))) == 1
+        finally:
+            g.set(0)
+
+    def test_latch_clears_when_outstanding_work_disappears(self,
+                                                           tmp_path):
+        """An aborted/closed engine takes its queue down WITHOUT any
+        completion — a healthy idle process must not report `stalled`
+        forever."""
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        g.set(1)
+        clock.t += 6.0
+        assert wd.tick() == "stalled"
+        g.set(0)                        # the wedged work was torn down
+        assert wd.tick() == "ok"
+        assert trace.metrics().counter(
+            "watchdog.stall_recoveries").value == 1
+
+    def test_no_stall_without_outstanding_work(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        clock.t += 100.0
+        assert wd.tick() == "ok"
+        assert watchdog.list_bundles(str(tmp_path)) == []
+
+    def test_live_compile_suppresses_stall(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        c = trace.metrics().gauge("executor.compiles_in_progress")
+        g.set(1)
+        c.set(1)                        # a long legit XLA compile
+        try:
+            clock.t += 50.0
+            assert wd.tick() == "ok"
+            assert watchdog.list_bundles(str(tmp_path)) == []
+            # compile ends and nothing completes -> NOW it may stall,
+            # counting from the compile's end (liveness reset the clock)
+            c.set(0)
+            clock.t += 4.0
+            assert wd.tick() == "ok"
+            clock.t += 2.0
+            assert wd.tick() == "stalled"
+        finally:
+            g.set(0)
+            c.set(0)
+
+    def test_elastic_drain_suppresses_stall(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        d = trace.metrics().gauge("elastic.drain_in_progress")
+        g.set(1)
+        d.set(1)
+        try:
+            clock.t += 50.0
+            assert wd.tick() == "ok"
+        finally:
+            g.set(0)
+            d.set(0)
+
+    def test_bundle_goodput_and_wide_events_cover_stall(self, tmp_path):
+        # a little real work first, so the bundle has evidence
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [4])
+            y = fluid.layers.scale(x, scale=2.0)
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            for _ in range(3):
+                exe.run(main, feed={"x": np.ones(4, "float32")},
+                        fetch_list=[y])
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        g.set(1)
+        try:
+            clock.t += 6.0
+            assert wd.tick() == "stalled"
+        finally:
+            g.set(0)
+        doc = watchdog.load_bundle(watchdog.list_bundles(str(tmp_path))[0])
+        assert doc["reason"] == "stall"
+        assert doc["watchdog"]["status"] == "stalled"
+        assert doc["extra"]["no_progress_s"] >= 5.0
+        steps = [r for r in doc["wide_events"] if r["kind"] == "step"]
+        assert len(steps) == 3          # the pre-stall work is retained
+        assert doc["goodput"]["wall_seconds"] > 0
+        assert doc["program_fingerprints"]
+
+
+class TestWatchdogBreach:
+    def _req(self, latency_ms):
+        flight_recorder.record_request(
+            trace.new_trace_id("req"), rows=1, outcome="ok",
+            latency_us=latency_ms * 1e3)
+
+    def test_breach_needs_m_consecutive_windows(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock, p99_ms=50.0, breach_windows=3)
+        for i in range(2):              # two hot windows: not yet
+            self._req(200.0)
+            assert wd.tick() == "ok", i
+        self._req(10.0)                 # a cool window resets the streak
+        assert wd.tick() == "ok"
+        for i in range(2):
+            self._req(200.0)
+            assert wd.tick() == "ok", i
+        self._req(200.0)                # third consecutive -> breach
+        assert wd.tick() == "breached"
+        bundles = watchdog.list_bundles(str(tmp_path))
+        assert len(bundles) == 1
+        doc = watchdog.load_bundle(bundles[0])
+        assert doc["reason"] == "breach"
+        assert doc["extra"]["threshold_ms"] == 50.0
+        # latched: staying hot adds no second bundle
+        self._req(200.0)
+        assert wd.tick() == "breached"
+        assert len(watchdog.list_bundles(str(tmp_path))) == 1
+        # recovery clears it
+        self._req(10.0)
+        assert wd.tick() == "ok"
+
+    def test_empty_window_clears_breach(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock, p99_ms=50.0, breach_windows=1)
+        self._req(200.0)
+        assert wd.tick() == "breached"
+        assert wd.tick() == "ok"        # traffic stopped: not sustained
+
+    def test_breach_off_when_threshold_zero(self, tmp_path):
+        clock = _Clock()
+        wd = _wd(tmp_path, clock, p99_ms=0.0)
+        self._req(10000.0)
+        assert wd.tick() == "ok"
+
+
+class TestBundles:
+    def test_bundle_atomic_under_injected_io_error(self, tmp_path):
+        from paddle_tpu.fluid.checkpoint import faults
+        faults.arm("io_error")
+        try:
+            path = watchdog.dump_bundle("stall",
+                                        diagnostic_dir=str(tmp_path))
+        finally:
+            faults.clear()
+        assert path == ""               # failed dump reports, not raises
+        # nothing half-written: no bundle, no tmp litter
+        assert watchdog.list_bundles(str(tmp_path)) == []
+        assert [f for f in os.listdir(tmp_path)
+                if f.startswith(".tmp")] == []
+        # and a clean dump right after loads
+        path = watchdog.dump_bundle("stall", diagnostic_dir=str(tmp_path))
+        doc = watchdog.load_bundle(path)
+        assert doc["schema"] == watchdog.BUNDLE_SCHEMA
+
+    def test_crash_hook_dumps_bundle_with_traceback(self, tmp_path):
+        wd = watchdog.SloWatchdog(diagnostic_dir=str(tmp_path))
+        watchdog._watchdog = wd
+        try:
+            watchdog.install_crash_hook()
+            assert sys.excepthook is watchdog._crash_hook
+            seen = []
+            prev, watchdog._prev_excepthook = \
+                watchdog._prev_excepthook, lambda *a: seen.append(a)
+            try:
+                raise ValueError("boom at step 12")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+            watchdog._prev_excepthook = prev
+            assert seen                 # the previous hook still ran
+            bundles = watchdog.list_bundles(str(tmp_path))
+            assert len(bundles) == 1
+            doc = watchdog.load_bundle(bundles[0])
+            assert doc["reason"] == "crash"
+            assert doc["exception"]["type"] == "ValueError"
+            assert "boom at step 12" in doc["exception"]["traceback"]
+        finally:
+            watchdog._watchdog = None
+            watchdog.uninstall_crash_hook()
+
+    def test_oom_notify_rate_limited(self, tmp_path):
+        from paddle_tpu.fluid import device_stats
+        wd = watchdog.SloWatchdog(diagnostic_dir=str(tmp_path))
+        watchdog._watchdog = wd
+        watchdog._last_oom_bundle_t[0] = 0.0
+        try:
+            exc = RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                               "allocating 1.5G")
+            assert device_stats.is_oom(exc)
+            device_stats.attach_oom_report(exc, [
+                {"label": "big-exe", "peak_bytes": 1 << 30}])
+            bundles = watchdog.list_bundles(str(tmp_path))
+            assert len(bundles) == 1
+            doc = watchdog.load_bundle(bundles[0])
+            assert doc["reason"] == "oom"
+            assert doc["exception"]["device_footprints"][0]["label"] \
+                == "big-exe"
+            # a second OOM inside the rate window adds no bundle
+            device_stats.attach_oom_report(exc, [])
+            assert len(watchdog.list_bundles(str(tmp_path))) == 1
+        finally:
+            watchdog._watchdog = None
+            watchdog._last_oom_bundle_t[0] = 0.0
+
+    def test_unarmed_oom_dumps_nothing(self, tmp_path):
+        assert watchdog.get() is None
+        assert watchdog.notify_oom(RuntimeError("RESOURCE_EXHAUSTED")) \
+            == ""
+
+
+class TestHealthEndpoint:
+    def test_healthz_flips_stalled_and_back(self, tmp_path):
+        import urllib.request
+        from paddle_tpu.fluid import metrics_export
+        clock = _Clock()
+        wd = _wd(tmp_path, clock)
+        watchdog._watchdog = wd         # tick()ed manually, no thread
+        srv = metrics_export.start_http(port=0)
+        g = trace.metrics().gauge("executor.inflight_steps")
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+
+            def healthz():
+                return urllib.request.urlopen(
+                    base + "/healthz", timeout=10).read().decode().strip()
+
+            assert healthz() == "ok"
+            g.set(1)
+            clock.t += 6.0
+            wd.tick()
+            assert healthz() == "stalled"
+            doc = json.loads(urllib.request.urlopen(
+                base + "/watchdog", timeout=10).read().decode())
+            assert doc["status"] == "stalled" and doc["stall_latched"]
+            g.set(0)
+            flight_recorder.record("step")
+            wd.tick()
+            assert healthz() == "ok"
+        finally:
+            g.set(0)
+            metrics_export.stop_http()
+            watchdog._watchdog = None
+
+    def test_dropped_events_gauge_live_on_scrape(self):
+        import urllib.request
+        from paddle_tpu.fluid import metrics_export
+        saved = trace._state.max_events
+        trace.enable()
+        try:
+            trace.set_max_events(4)
+            for i in range(8):
+                trace.instant(f"e{i}")
+            assert trace.dropped_count() == 4
+            srv = metrics_export.start_http(port=0)
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics",
+                    timeout=10).read().decode()
+            finally:
+                metrics_export.stop_http()
+            line = [ln for ln in body.splitlines()
+                    if ln.startswith("trace_dropped_events ")]
+            assert line and float(line[0].split()[1]) == 4
+        finally:
+            trace.set_max_events(saved)
+
+    def test_flag_lifecycle(self, tmp_path):
+        saved = fluid.core.get_flag("watchdog")
+        try:
+            fluid.core.set_flags({"FLAGS_watchdog": True})
+            assert watchdog.get() is not None
+            assert watchdog.health()["running"]
+            fluid.core.set_flags({"FLAGS_watchdog": False})
+            assert watchdog.get() is None
+            assert watchdog.health() == {"status": "ok",
+                                         "running": False}
+        finally:
+            fluid.core.set_flags({"FLAGS_watchdog": bool(saved)})
+
+
+# ---------------------------------------------------------------------------
+# diagnose.py renders a bundle without the producing process
+# ---------------------------------------------------------------------------
+
+class TestDiagnose:
+    def _make_bundle(self, tmp_path):
+        trace.enable()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            eng, _ = _build_engine(exe)
+            with eng:
+                futs = [eng.submit({"x": np.ones((2, 8), "float32")})
+                        for _ in range(3)]
+                [f.result(timeout=30) for f in futs]
+        path = watchdog.dump_bundle("stall",
+                                    diagnostic_dir=str(tmp_path),
+                                    extra={"no_progress_s": 9.9})
+        trace.disable()
+        return path, futs
+
+    def test_report_and_trace_render(self, tmp_path, capsys):
+        path, futs = self._make_bundle(tmp_path)
+        diag = _load_tool("diagnose")
+        out_trace = str(tmp_path / "trace.json")
+        assert diag.main([path, "--trace", out_trace,
+                          "--request", futs[0].trace_id]) == 0
+        text = capsys.readouterr().out
+        assert "STALL" in text
+        assert futs[0].trace_id in text
+        assert "goodput" in text
+        evs = json.loads(open(out_trace).read())["traceEvents"]
+        assert any(e.get("ph") == "s" for e in evs)       # flow arrows
+        assert any(e.get("cat") == "wide" for e in evs)   # recorder row
+        tl = _load_tool("timeline")
+        tl.validate_timeline(sorted(
+            [e for e in evs], key=lambda e: (e.get("ph") != "M",
+                                             e.get("ts", 0.0))))
+
+    def test_rejects_non_bundle(self, tmp_path):
+        p = tmp_path / "x.json"
+        p.write_text("{}")
+        diag = _load_tool("diagnose")
+        with pytest.raises(ValueError):
+            diag.load_bundle(str(p))
+
+    def test_list_mode(self, tmp_path, capsys):
+        watchdog.dump_bundle("stall", diagnostic_dir=str(tmp_path))
+        diag = _load_tool("diagnose")
+        assert diag.main(["--list", str(tmp_path)]) == 0
+        assert "bundle-" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# serve_bench satellite: slowest requests link to traces
+# ---------------------------------------------------------------------------
+
+class TestServeBenchTraceIds:
+    def test_slowest_requests_in_report(self):
+        sb = _load_tool("serve_bench")
+        report = sb.serve_bench(qps=300.0, n_requests=30, sizes=(1, 2),
+                                warmup=False)
+        slow = report["slowest_requests"]
+        assert slow and all(r["trace_id"].startswith("req-")
+                            for r in slow)
+        assert slow == sorted(slow, key=lambda r: -r["latency_ms"])
+        assert all("batch_id" in r and "queue_ms" in r for r in slow)

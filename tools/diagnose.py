@@ -1,0 +1,341 @@
+#!/usr/bin/env python
+"""Render a paddle_tpu diagnostic bundle into a human post-mortem.
+
+A bundle is the single atomic JSON file the SLO watchdog
+(paddle_tpu/fluid/watchdog.py) dumps on a stall / p99 breach / crash /
+OOM: trace tail, flight-recorder wide events, goodput report, device
+footprints, metrics snapshot, flags, program fingerprints.  This tool
+needs NOTHING from the process that produced it — stdlib only, plus
+fluid/goodput.py and tools/timeline.py loaded by file path — so a
+responder can run it anywhere the bundle landed.
+
+Usage:
+    python tools/diagnose.py bundle.json                # report to stdout
+    python tools/diagnose.py bundle.json --trace out.json   # + chrome trace
+    python tools/diagnose.py bundle.json --request req-1a2b-3c  # one request
+    python tools/diagnose.py --list [/diag/dir]         # newest bundles
+
+The Chrome trace carries the bundle's trace tail, a per-request lane +
+request↔batch flow arrows (timeline.request_flows; --no-flows skips),
+the goodput attribution track, and the wide events rendered as their
+own "flight recorder" row — open in chrome://tracing or ui.perfetto.dev.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_by_path(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _timeline():
+    return _load_by_path("paddle_tpu_timeline",
+                         os.path.join(_HERE, "timeline.py"))
+
+
+def load_bundle(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != "paddle_tpu.diagnostic_bundle.v1":
+        raise ValueError(f"{path}: not a paddle_tpu diagnostic bundle "
+                         f"(schema={doc.get('schema')!r})")
+    return doc
+
+
+def _fmt_bytes(n):
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{int(n)}B" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _percentile(values, q):
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    return vs[min(len(vs) - 1, int(q * len(vs)))]
+
+
+# ---------------------------------------------------------------------------
+# report sections
+# ---------------------------------------------------------------------------
+
+def _header(doc):
+    lines = [
+        "=" * 72,
+        f"paddle_tpu post-mortem — {doc['reason'].upper()}",
+        "=" * 72,
+        f"time      : {doc.get('time')}  (pid {doc.get('pid')}, "
+        f"uptime {doc.get('uptime_s', 0):.1f}s)",
+        f"watchdog  : {json.dumps(doc.get('watchdog', {}), default=str)}",
+        f"tracing   : {'on' if doc.get('trace_enabled') else 'off'}"
+        f" ({len(doc.get('trace_tail') or [])} tail events,"
+        f" {doc.get('trace_dropped_events', 0)} dropped)",
+    ]
+    exc = doc.get("exception")
+    if exc:
+        lines += ["", f"exception : {exc.get('type')}: "
+                      f"{exc.get('message')}"]
+        tb = (exc.get("traceback") or "").strip().splitlines()
+        lines += ["  " + ln for ln in tb[-12:]]
+    if doc.get("extra"):
+        lines.append(f"detail    : {json.dumps(doc['extra'], default=str)}")
+    return lines
+
+
+def _goodput_section(doc):
+    gp = doc.get("goodput") or {}
+    if "buckets" not in gp:
+        return [f"goodput   : unavailable ({gp.get('error', 'no data')})"]
+    lines = [f"goodput   : ratio {gp.get('ratio', 0):.1%} over "
+             f"{gp.get('wall_seconds', 0):.1f}s "
+             f"(source={gp.get('source')}"
+             + (", DEGRADED — trace buffer dropped events"
+                if gp.get("degraded") else "") + ")"]
+    for b, v in sorted((gp.get("buckets") or {}).items(),
+                       key=lambda kv: -kv[1]):
+        if v > 0:
+            lines.append(f"    {b:<18s} {v:10.3f}s")
+    return lines
+
+
+def _wide_event_section(doc, last=8):
+    wide = doc.get("wide_events") or []
+    steps = [r for r in wide if r.get("kind") == "step"]
+    reqs = [r for r in wide if r.get("kind") == "request"]
+    lines = [f"recorder  : {len(wide)} wide events retained "
+             f"({len(steps)} steps, {len(reqs)} requests)"]
+    if steps:
+        misses = sum(1 for r in steps if r.get("compile_miss"))
+        last_step = steps[-1]
+        lines.append(
+            f"    last step: #{last_step.get('step')} at "
+            f"{last_step.get('ts_us', 0) / 1e6:.2f}s, "
+            f"{last_step.get('dur_us', 0) / 1e3:.1f}ms, "
+            f"goodput {last_step.get('goodput_ratio', 0):.0%}, "
+            f"rss {_fmt_bytes(last_step.get('rss_bytes'))}, "
+            f"{misses} compile misses across the ring")
+    bad = [r for r in reqs if r.get("outcome") not in (None, "ok")]
+    if bad:
+        by = {}
+        for r in bad:
+            by[r["outcome"]] = by.get(r["outcome"], 0) + 1
+        lines.append(f"    non-ok requests: {by}")
+    for r in wide[-last:]:
+        lines.append("    " + json.dumps(r, default=str)[:160])
+    return lines
+
+
+def _slow_request_section(doc, top=5):
+    reqs = [r for r in (doc.get("wide_events") or [])
+            if r.get("kind") == "request"
+            and r.get("latency_us") is not None]
+    if not reqs:
+        return []
+    lats = [r["latency_us"] for r in reqs]
+    p99 = _percentile(lats, 0.99)
+    slow = sorted(reqs, key=lambda r: -r["latency_us"])[:top]
+    lines = [f"requests  : {len(reqs)} completed in ring, p50 "
+             f"{_percentile(lats, 0.5) / 1e3:.1f}ms / p99 "
+             f"{p99 / 1e3:.1f}ms; slowest:"]
+    for r in slow:
+        lines.append(
+            f"    {r.get('trace_id'):<20s} {r['latency_us'] / 1e3:8.1f}ms "
+            f"(queue {r.get('queue_us', 0) / 1e3:.1f}ms / device "
+            f"{r.get('device_us', 0) / 1e3:.1f}ms, rows "
+            f"{r.get('rows')}, batch {r.get('batch_id')})")
+    return lines
+
+
+def _device_section(doc, top=5):
+    fps = doc.get("device_footprints") or []
+    if not fps:
+        return []
+    lines = [f"device    : {len(fps)} resident executables by XLA peak:"]
+    for r in fps[:top]:
+        lines.append(f"    {str(r.get('label', '?')):<24s} "
+                     f"{_fmt_bytes(r.get('peak_bytes'))}")
+    return lines
+
+
+def _metrics_section(doc):
+    m = doc.get("metrics") or {}
+
+    def _v(name):
+        v = m.get(name)
+        return v.get("count") if isinstance(v, dict) else v
+
+    interesting = [
+        ("executor.steps_completed", "steps completed"),
+        ("executor.compile_cache_miss", "compile misses"),
+        ("executor.compile_cache_hit", "compile hits"),
+        ("serving.requests", "requests admitted"),
+        ("serving.rejected", "requests rejected"),
+        ("serving.timeouts", "request timeouts"),
+        ("serving.dispatch_errors", "dispatch errors"),
+        ("xla.oom_errors", "device OOMs"),
+        ("ckpt.saves", "checkpoints saved"),
+        ("elastic.preemptions", "preemptions"),
+        ("watchdog.stalls", "stalls detected"),
+        ("watchdog.breaches", "p99 breaches"),
+    ]
+    rows = [(label, _v(name)) for name, label in interesting
+            if _v(name)]
+    if not rows:
+        return []
+    return ["metrics   : " + ", ".join(f"{label} {v}"
+                                       for label, v in rows)]
+
+
+def _request_story(doc, trace_id):
+    """Everything the bundle knows about one trace id — the per-request
+    forensic view."""
+    lines = [f"request {trace_id}:"]
+    for r in (doc.get("wide_events") or []):
+        if r.get("trace_id") == trace_id \
+                or r.get("batch_id") == trace_id:
+            lines.append("  wide  " + json.dumps(r, default=str))
+    for e in (doc.get("trace_tail") or []):
+        args = e.get("args") or {}
+        if args.get("trace_id") == trace_id \
+                or args.get("batch_id") == trace_id \
+                or trace_id in (args.get("request_ids") or []):
+            lines.append(
+                f"  span  {e.get('name'):<20s} ts={e.get('ts', 0):.1f}us "
+                f"dur={e.get('dur', 0):.1f}us args="
+                + json.dumps(args, default=str)[:120])
+    if len(lines) == 1:
+        lines.append("  (nothing retained for this id — it may have "
+                     "aged out of the ring / trace tail)")
+    return lines
+
+
+def report(doc, request=None):
+    lines = _header(doc)
+    lines.append("")
+    lines += _goodput_section(doc)
+    lines.append("")
+    lines += _wide_event_section(doc)
+    sec = _slow_request_section(doc)
+    if sec:
+        lines.append("")
+        lines += sec
+    sec = _device_section(doc)
+    if sec:
+        lines.append("")
+        lines += sec
+    sec = _metrics_section(doc)
+    if sec:
+        lines.append("")
+        lines += sec
+    fps = doc.get("program_fingerprints") or []
+    if fps:
+        lines.append(f"programs  : {', '.join(fps)}")
+    if request:
+        lines.append("")
+        lines += _request_story(doc, request)
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace rendering
+# ---------------------------------------------------------------------------
+
+def _recorder_track(doc, base_pid):
+    """The flight recorder's wide events as their own timeline row:
+    steps as slices (ts - dur .. ts), requests/markers as instants."""
+    out = [{"name": "process_name", "ph": "M", "pid": base_pid, "tid": 0,
+            "args": {"name": "flight recorder (wide events)"}}]
+    for r in doc.get("wide_events") or []:
+        kind = r.get("kind", "event")
+        ts = float(r.get("ts_us", 0.0))
+        if kind == "step" and r.get("dur_us"):
+            dur = float(r["dur_us"])
+            out.append({"name": f"step#{r.get('step')}", "cat": "wide",
+                        "ph": "X", "ts": max(ts - dur, 0.0), "dur": dur,
+                        "pid": base_pid, "tid": 1, "args": r})
+        else:
+            out.append({"name": f"{kind}:{r.get('trace_id', r.get('seq'))}",
+                        "cat": "wide", "ph": "i", "s": "p", "ts": ts,
+                        "pid": base_pid, "tid": 2, "args": r})
+    return out
+
+
+def write_trace(doc, out_path, flows=True):
+    tl = _timeline()
+    events = list(doc.get("trace_tail") or [])
+    extra = []
+    if flows:
+        extra += tl.request_flows(events)
+    extra += tl.goodput_track(events)
+    base_pid = max((e.get("pid", 0) for e in events + extra
+                    if isinstance(e.get("pid"), (int, float))),
+                   default=0) + 2
+    extra += _recorder_track(doc, base_pid)
+    events = events + extra
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    if events:
+        tl.validate_timeline(events)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                   "metadata": {"producer": "tools/diagnose.py",
+                                "bundle_reason": doc.get("reason")}}, f)
+    return len(events)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", nargs="?",
+                    help="path to a bundle-*.json diagnostic bundle")
+    ap.add_argument("--list", nargs="?", const="", default=None,
+                    metavar="DIR",
+                    help="list bundles in DIR (default: the standard "
+                         "diagnostic dir) and exit")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="additionally render the bundle's trace tail + "
+                         "wide events as a chrome trace")
+    ap.add_argument("--no-flows", action="store_true",
+                    help="skip request↔batch flow arrows in --trace")
+    ap.add_argument("--request", default=None, metavar="TRACE_ID",
+                    help="append everything known about one request id")
+    a = ap.parse_args(argv)
+
+    if a.list is not None:
+        root = a.list or "/tmp/paddle_tpu_diagnostics"
+        found = sorted(
+            os.path.join(root, f) for f in
+            (os.listdir(root) if os.path.isdir(root) else [])
+            if f.startswith("bundle-") and f.endswith(".json"))
+        for p in found:
+            print(p)
+        if not found:
+            print(f"no bundles under {root}", file=sys.stderr)
+            return 1
+        return 0
+
+    if not a.bundle:
+        print("diagnose.py: a bundle path (or --list) is required",
+              file=sys.stderr)
+        return 2
+    doc = load_bundle(a.bundle)
+    print(report(doc, request=a.request))
+    if a.trace:
+        n = write_trace(doc, a.trace, flows=not a.no_flows)
+        print(f"\n{n} events -> {a.trace}; open in chrome://tracing or "
+              f"ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

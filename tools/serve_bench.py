@@ -96,6 +96,28 @@ def collect(futures, timeout=120.0):
     return done, failed
 
 
+def slowest_requests(futures, top=5):
+    """The slowest completed requests of this round, by the engine's own
+    per-request latency (the flight recorder's wide events, keyed by
+    each future's ``trace_id``) — a bad bench round links straight to
+    the offending request traces (`tools/diagnose.py --request <id>` or
+    grep the exported timeline)."""
+    from paddle_tpu.fluid import flight_recorder
+
+    ids = {f.trace_id for f in futures if getattr(f, "trace_id", None)}
+    recs = [r for r in flight_recorder.recorder().snapshot()
+            if r.get("kind") == "request" and r.get("trace_id") in ids
+            and r.get("outcome") == "ok"
+            and r.get("latency_us") is not None]
+    recs.sort(key=lambda r: -r["latency_us"])
+    return [{"trace_id": r["trace_id"],
+             "latency_ms": round(r["latency_us"] / 1e3, 3),
+             "queue_ms": round(r.get("queue_us", 0) / 1e3, 3),
+             "device_ms": round(r.get("device_us", 0) / 1e3, 3),
+             "rows": r.get("rows"), "batch_id": r.get("batch_id")}
+            for r in recs[:top]]
+
+
 def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                 max_batch=32, max_wait_us=2000, queue_depth=256,
                 hidden=64, deadline_ms=None, metrics_port=None,
@@ -131,6 +153,7 @@ def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                 deadline_ms=deadline_ms)
             done, failed = collect(futures)
             wall = time.perf_counter() - t0
+            slowest = slowest_requests(futures)
             compiles_under_load = \
                 m.counter("executor.compile_cache_miss").value - miss0
             cold_under_load = \
@@ -165,6 +188,8 @@ def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
         "batch_size_avg": round(stats["batch_size"].get("avg", 0), 2),
         "batches": stats["batches"],
         "buckets": stats["buckets"],
+        # the p99 offenders of THIS round, linkable to their traces
+        "slowest_requests": slowest,
         "warmup": wreport,
         "compiles_under_load": compiles_under_load,
         "cold_compiles_under_load": cold_under_load,

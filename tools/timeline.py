@@ -154,21 +154,82 @@ def goodput_track(events):
     return out
 
 
-def convert(trace_paths, out, goodput=True):
+def request_flows(events):
+    """Synthetic events for the serving plane's causal view: every
+    ``serving::request`` span gets its OWN lane (a per-request tid on a
+    dedicated "serving requests" process row — requests read as parallel
+    lifelines instead of interleaved slices on the collector thread),
+    and Chrome flow events (``ph`` "s"/"f") draw an arrow from each
+    request lane into the ``serving::batch`` span that served it (keyed
+    by the ``batch_id`` the engine stamps into both args).  Inputs with
+    no serving spans produce nothing."""
+    reqs = [e for e in events
+            if e.get("ph") == "X" and e.get("name") == "serving::request"
+            and (e.get("args") or {}).get("trace_id")]
+    if not reqs:
+        return []
+    batches = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "serving::batch":
+            bid = (e.get("args") or {}).get("batch_id")
+            if bid:
+                batches[bid] = e
+    base_pid = max((e.get("pid", 0) for e in events
+                    if isinstance(e.get("pid"), (int, float))),
+                   default=0) + 2
+    out = [{"name": "process_name", "ph": "M", "pid": base_pid, "tid": 0,
+            "args": {"name": "serving requests (one lane per request)"}}]
+    lanes = {}
+    for e in sorted(reqs, key=lambda e: e.get("ts", 0.0)):
+        trace_id = e["args"]["trace_id"]
+        tid = lanes.get(trace_id)
+        if tid is None:
+            tid = lanes[trace_id] = len(lanes) + 1
+            out.append({"name": "thread_name", "ph": "M", "pid": base_pid,
+                        "tid": tid, "args": {"name": trace_id}})
+        lane_ev = dict(e)
+        lane_ev["pid"] = base_pid
+        lane_ev["tid"] = tid
+        out.append(lane_ev)
+        b = batches.get(e["args"].get("batch_id"))
+        if b is not None:
+            # flow start anchored on the request's lane slice, finish
+            # bound ("bp":"e") inside the batch span — chrome/perfetto
+            # render the arrow request -> batch
+            fid = f"flow-{trace_id}"
+            out.append({"name": "req->batch", "cat": "flow", "ph": "s",
+                        "id": fid, "ts": lane_ev["ts"],
+                        "pid": base_pid, "tid": tid})
+            out.append({"name": "req->batch", "cat": "flow", "ph": "f",
+                        "bp": "e", "id": fid,
+                        "ts": b["ts"] + float(b.get("dur", 0.0)) / 2,
+                        "pid": b["pid"], "tid": b["tid"]})
+    return out
+
+
+def convert(trace_paths, out, goodput=True, flows=True):
     """Merge + validate + write the final chrome trace, with the goodput
     attribution rendered as a dedicated track when the inputs carry
-    goodput-classified spans (--no-goodput skips it)."""
+    goodput-classified spans (--no-goodput skips it) and the serving
+    request↔batch causality as per-request lanes + flow arrows when
+    they carry serving spans (--no-flows skips it)."""
     events = merge_traces(trace_paths)
-    n_goodput = 0
+    n_goodput = n_flows = 0
+    if flows:
+        extra = request_flows(events)
+        n_flows = sum(1 for e in extra if e.get("ph") == "s")
+        events = events + extra
     if goodput:
         extra = goodput_track(events)
         n_goodput = sum(1 for e in extra if e.get("ph") == "X")
         events = events + extra
-        events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
     validate_timeline(events)
     with open(out, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     note = f" (+{n_goodput} goodput slices)" if n_goodput else ""
+    if n_flows:
+        note += f" (+{n_flows} request flows)"
     print(f"{len(events)} events from {len(trace_paths)} trace(s){note} -> "
           f"{out}; open in chrome://tracing or ui.perfetto.dev")
     return 0
@@ -201,6 +262,9 @@ def main(argv=None):
                     help="only validate --trace_path files, write nothing")
     ap.add_argument("--no-goodput", action="store_true",
                     help="skip the synthetic goodput-attribution track")
+    ap.add_argument("--no-flows", action="store_true",
+                    help="skip per-request lanes + request↔batch flow "
+                         "arrows for serving traces")
     a = ap.parse_args(argv)
     if a.trace_path:
         paths = [p for p in a.trace_path.split(",") if p]
@@ -209,7 +273,8 @@ def main(argv=None):
                 n = len(validate_timeline(p))
                 print(f"{p}: OK ({n} events)")
             return 0
-        return convert(paths, a.timeline_path, goodput=not a.no_goodput)
+        return convert(paths, a.timeline_path, goodput=not a.no_goodput,
+                       flows=not a.no_flows)
     return extract(a.profile_path, a.timeline_path)
 
 
