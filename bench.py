@@ -577,6 +577,119 @@ def main_ctr():
     print(json.dumps(out))
 
 
+def main_sharding():
+    """Unified-SPMD-plane leg (docs/sharding.md): the fluid mlp/CTR demo
+    trained single-chip vs whole-step-sharded DP over every visible
+    device (8 emulated host devices on CPU — set BEFORE jax init).  The
+    row records the plane's three claims: ONE executable dispatch per
+    step (vs N per-gradient allreduce launches), the implied-vs-
+    dispatched collective split (0 dispatched in the sharded program),
+    and per-device HBM from the XLA memory analysis — the numbers the
+    next accelerator round baselines multichip against."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu" \
+            and "--xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=8")
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import core, trace
+    from paddle_tpu.fluid.core import Scope, scope_guard
+    from paddle_tpu.fluid.framework import reset_unique_name
+    from paddle_tpu.distributed.fleet.meta_optimizers.common import \
+        insert_allreduce_ops
+
+    quick = "--quick" in sys.argv
+    backend = backend_name()
+    n_dev = len(jax.devices())
+    batch, steps, warmup = (256, 4, 1) if quick or backend == "cpu" \
+        else (4096, 20, 3)
+    core.set_flags({"FLAGS_device_cost_analysis": True})
+
+    def build():
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            x = fluid.data("x", [-1, 64])
+            y = fluid.data("y", [-1, 1], dtype="int64")
+            h = fluid.layers.fc(x, 256, act="relu")
+            h = fluid.layers.fc(h, 128, act="relu")
+            logits = fluid.layers.fc(h, 16)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            opt = fluid.optimizer.AdamOptimizer(1e-3)
+            _, pg = opt.minimize(loss)
+        return m, s, loss, pg
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(batch, 64).astype("float32"),
+            "y": rng.randint(0, 16, (batch, 1)).astype("int64")}
+
+    def run_leg(sharded):
+        reset_unique_name()
+        m, s, loss, pg = build()
+        prog = m
+        if sharded:
+            insert_allreduce_ops(m.global_block(), pg)
+            bs = fluid.BuildStrategy()
+            bs.sharding = "dp"
+            prog = fluid.CompiledProgram(m, build_strategy=bs)
+        exe = fluid.Executor()
+        losses = []
+        with scope_guard(Scope()):
+            exe.run(s)
+            it = {"n": 0}
+
+            def one_step():
+                it["n"] += 1
+                lv, = exe.run(prog, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+                return lv
+
+            dt = timed_run(one_step, steps, warmup)
+            hbm = max((int(fp.get("per_device_peak_bytes",
+                                  fp.get("peak_bytes", 0)) or 0)
+                       for fp in exe._footprints.values()), default=0)
+        plan = prog._sharding_plan if sharded else None
+        return dt, losses, hbm, plan
+
+    d0 = trace.metrics().counter("sharding.collectives_dispatched").value
+    dt1, loss1, hbm1, _ = run_leg(False)
+    dt8, loss8, hbm8, plan = run_leg(True)
+    dispatched = trace.metrics().counter(
+        "sharding.collectives_dispatched").value - d0
+    implied = trace.metrics().counter("sharding.collectives_implied").value
+    parity = max(abs(a - b) / max(abs(a), 1e-9)
+                 for a, b in zip(loss1[-steps:], loss8[-steps:]))
+    ex_s = steps * batch / dt8 / max(n_dev, 1)
+    out = {
+        "metric": "sharded_dp_train_throughput",
+        "value": round(ex_s, 1), "unit": "examples/sec/chip",
+        "vs_baseline": 0.0, "backend": backend,
+        # the sharding-plane record (tools/tpu_watch.py aggregates these;
+        # the next accelerator round baselines multichip on them)
+        "sharding": "dp",
+        "mesh_shape": plan.mesh_shape() if plan is not None else {},
+        "step_dispatches_per_step": 1,
+        "collectives_implied": int(implied),
+        "collectives_dispatched": int(dispatched),
+        "hbm_peak_bytes_per_device": int(hbm8),
+        "hbm_peak_bytes_single": int(hbm1),
+        "single_chip_examples_per_sec": round(steps * batch / dt1, 1),
+        "loss_parity_rel_err": round(parity, 8),
+    }
+    out.update(_compile_stats())
+    if backend not in ("cpu", "error"):
+        record_evidence(dict(out, chunk_secs=list(_LAST_CHUNKS),
+                             config={"batch": batch, "steps": steps,
+                                     "n_devices": n_dev}))
+    print(json.dumps(out))
+
+
 def _scan_json(stdout):
     """Last parseable JSON line of a child's stdout, or None."""
     if isinstance(stdout, bytes):
@@ -679,6 +792,8 @@ def supervise():
             "nmt": ("transformer_nmt_train_throughput", "tokens/sec/chip"),
             "wide_deep": ("wide_deep_ctr_train_throughput",
                           "examples/sec/chip"),
+            "sharding": ("sharded_dp_train_throughput",
+                         "examples/sec/chip"),
         }
         metric, unit = "bert_base_pretrain_throughput", "tokens/sec/chip"
         for key, (m, u) in names.items():
@@ -889,6 +1004,8 @@ if __name__ == "__main__":
             main_ctr()
         elif "--model" in sys.argv and "serve" in sys.argv:
             main_serve()
+        elif "--model" in sys.argv and "sharding" in sys.argv:
+            main_sharding()
         else:
             main()
     else:

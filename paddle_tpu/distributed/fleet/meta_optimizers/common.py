@@ -66,6 +66,11 @@ def insert_allreduce_ops(block, params_grads, ring_id=0, average=True):
         if op.type in grad_consumers:
             pos = i
             break
+    # the mesh-axis stamp: collective ops carry the axis NAME beside the
+    # ring id, so the shard_collectives pass (and any trace consumer)
+    # maps ring -> axis from the op itself instead of relying on the
+    # process-global ring registry still holding the build-time binding
+    mesh_axis = mesh_registry.axis_for_ring(ring_id) or ""
     new_pg = []
     for p, g in params_grads:
         # Block._insert_op: build-and-place with the version bump the
@@ -76,6 +81,7 @@ def insert_allreduce_ops(block, params_grads, ring_id=0, average=True):
         block._insert_op(
             pos, op_type, inputs={"X": [g]}, outputs={"Out": [g]},
             attrs={"ring_id": ring_id, "use_calc_stream": True,
+                   "mesh_axis": mesh_axis,
                    OP_ROLE_KEY: OpRole.Backward})
         pos += 1
         new_pg.append((p, g))

@@ -215,6 +215,158 @@ def _decode_array(arr: np.ndarray, true_dtype: str) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# per-shard (addressable-shard) IO — the sharding plane's checkpoint
+# customer (parallel/sharding.py, docs/sharding.md).  A param sharded over
+# the mesh is saved as its UNIQUE device shards (each an ordinary
+# device_get of one device's slice) with the global index recorded per
+# piece; the full array is never gathered to host.  Restore reassembles
+# any requested slice from the pieces, so a checkpoint written on one mesh
+# restores onto a different one (DP-8 save -> DP-4 restore) or onto a
+# meshless single-chip scope, bit-exactly either way.
+# ---------------------------------------------------------------------------
+
+def _to_host(h) -> np.ndarray:
+    """THE single full-array host-materialisation point of the save path
+    (the tests' gather-spy seam): unsharded state and already-persisted
+    handles come through here; multi-device-sharded state must not."""
+    return h.persist() if hasattr(h, "persist") else np.asarray(h)
+
+
+def _is_sharded_array(raw) -> bool:
+    """True for a live multi-device jax.Array (the per-shard IO case)."""
+    sharding = getattr(raw, "sharding", None)
+    if sharding is None or not hasattr(raw, "addressable_shards"):
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except Exception:               # noqa: BLE001 — exotic sharding objs
+        return False
+
+
+def _sharded_value(h):
+    """The live multi-device jax.Array behind a snapshot handle, or None
+    when the value is host/single-device (or was already host-persisted
+    by the donation alias guard — a gather that already happened)."""
+    if hasattr(h, "is_materialized") and h.is_materialized():
+        return None
+    raw = getattr(h, "raw", h)
+    return raw if _is_sharded_array(raw) else None
+
+
+def _norm_index(index, shape):
+    """Shard index (tuple of slices) -> hashable ((start, stop), ...)."""
+    out = []
+    for i, d in enumerate(tuple(int(x) for x in shape)):
+        sl = index[i] if i < len(index) else slice(None)
+        out.append((int(sl.start or 0),
+                    d if sl.stop is None else int(sl.stop)))
+    return tuple(out)
+
+
+def _shard_pieces(arr):
+    """Unique addressable shards of a sharded array, as
+    ``[(index, np_piece), ...]`` sorted by index.  Replicated axes
+    produce duplicate indices — saved once.  Each ``np.asarray`` is a
+    device_get of ONE device's slice, never a cross-device gather."""
+    shape = np.shape(arr)
+    seen = {}
+    for s in arr.addressable_shards:
+        idx = _norm_index(s.index, shape)
+        if idx not in seen:
+            seen[idx] = np.asarray(s.data)
+    return [(idx, seen[idx]) for idx in sorted(seen)]
+
+
+class _ShardedState:
+    """One sharded var staged for writing: global shape/dtype + pieces."""
+
+    __slots__ = ("shape", "dtype", "pieces")
+
+    def __init__(self, arr):
+        self.shape = tuple(int(d) for d in np.shape(arr))
+        self.pieces = _shard_pieces(arr)
+        self.dtype = str(self.pieces[0][1].dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(p.nbytes) for _, p in self.pieces)
+
+
+_shard_handle_cls = None
+
+
+def _snapshot_handle_cls():
+    """Donation-safe snapshot handle for mesh-sharded state: when the
+    executor's pre-donation alias guard calls ``persist()``, the handle
+    materialises its UNIQUE addressable shards (one device_get per local
+    shard) instead of gathering the full array to host — so the
+    per-shard no-gather guarantee holds even on donating (TPU) runs
+    where a dispatch overtakes the background writer.  Defined lazily:
+    checkpoint stays importable without the async plane."""
+    global _shard_handle_cls
+    if _shard_handle_cls is None:
+        from .async_pipeline import FetchHandle
+
+        class _ShardSnapshotHandle(FetchHandle):
+            __slots__ = ("sharded_pieces",)
+
+            def __init__(self, value, name=None):
+                super().__init__(value, name=name, aliases_state=True)
+                self.sharded_pieces = None
+
+            def persist(self):
+                raw = self._raw     # local ref: FetchHandle's race idiom
+                if self.sharded_pieces is None and self._np is None \
+                        and raw is not None and _is_sharded_array(raw):
+                    pieces = _ShardedState(raw)
+                    self.sharded_pieces = pieces   # publish BEFORE the
+                    self._raw = None               # buffer ref drops
+                    return None
+                return super().persist()
+
+        _shard_handle_cls = _ShardSnapshotHandle
+    return _shard_handle_cls
+
+
+def _snapshot_handle(value, name):
+    """Factory for one snapshot handle: sharded values get the
+    per-shard-persisting handle, everything else a plain state-aliasing
+    FetchHandle."""
+    if _is_sharded_array(value):
+        return _snapshot_handle_cls()(value, name)
+    from .async_pipeline import FetchHandle
+    return FetchHandle(value, name=name, aliases_state=True)
+
+
+def _assemble_slice(target, shape, dtype, pieces):
+    """Reassemble the ``target`` index (tuple of slices) of a var from
+    its saved pieces — reads only the overlapping pieces.  ``pieces`` is
+    ``[(index, load_fn), ...]`` with lazy per-piece loaders."""
+    tgt = _norm_index(target, shape)
+    out_shape = tuple(e - s for s, e in tgt)
+    out = np.empty(out_shape, dtype=np.dtype(dtype))
+    filled = 0
+    for idx, load in pieces:
+        inter = tuple((max(s0, s1), min(e0, e1))
+                      for (s0, e0), (s1, e1) in zip(idx, tgt))
+        if any(s >= e for s, e in inter):
+            continue
+        src = load()
+        src_sel = tuple(slice(s - ps, e - ps)
+                        for (s, e), (ps, _) in zip(inter, idx))
+        dst_sel = tuple(slice(s - ts, e - ts)
+                        for (s, e), (ts, _) in zip(inter, tgt))
+        out[dst_sel] = src[src_sel]
+        filled += int(np.prod([e - s for s, e in inter]) or 1)
+    if filled != int(np.prod(out_shape) or 1):
+        raise CorruptCheckpointError(
+            f"sharded var pieces do not cover the requested slice "
+            f"{tgt} of shape {shape} ({filled} of "
+            f"{int(np.prod(out_shape) or 1)} elements)")
+    return out
+
+
+# ---------------------------------------------------------------------------
 # directory scan helpers
 # ---------------------------------------------------------------------------
 
@@ -269,13 +421,14 @@ def _snapshot_handles(names: Sequence[str], scope, executor=None):
     """Point-in-time references to the scope's device arrays, wrapped as
     state-aliasing FetchHandles.  With an executor, each handle rides the
     PR-4 donation alias guard (``Executor._alias_live``): a later dispatch
-    that donates the scope's buffers host-persists these first, so the
-    background writer always reads valid data — and the training thread
-    itself never pays a device_get."""
+    that donates the scope's buffers host-persists these first — for
+    mesh-sharded state that persist is PER SHARD (``_snapshot_handle``),
+    never a full gather — so the background writer always reads valid
+    data and the training thread itself never pays a device_get."""
     if executor is not None and hasattr(executor, "snapshot_vars"):
-        return executor.snapshot_vars(names, scope=scope)
-    from .async_pipeline import FetchHandle
-    return {n: FetchHandle(scope.find_var(n), name=n, aliases_state=True)
+        return executor.snapshot_vars(names, scope=scope,
+                                      handle_factory=_snapshot_handle)
+    return {n: _snapshot_handle(scope.find_var(n), n)
             for n in names if scope.find_var(n) is not None}
 
 
@@ -500,8 +653,19 @@ class CheckpointManager:
         the trainer); InjectedCrash and non-IO errors propagate."""
         arrays = {}
         for n, h in job.handles.items():
-            arrays[n] = h.persist() if hasattr(h, "persist") \
-                else np.asarray(h)
+            pieces = getattr(h, "sharded_pieces", None)
+            if pieces is None:
+                sharded = _sharded_value(h)
+                if sharded is not None:
+                    pieces = _ShardedState(sharded)
+            if pieces is not None:
+                # addressable-shard IO: per-device slices, no host
+                # gather — either extracted here or already persisted
+                # per-shard by the donation alias guard
+                arrays[n] = pieces
+                trace.metrics().counter("ckpt.sharded_vars").inc()
+            else:
+                arrays[n] = _to_host(h)
         attempt = 0
         while True:
             try:
@@ -530,9 +694,26 @@ class CheckpointManager:
                 var_meta = {}
                 enc = {}
                 for n in group:
-                    a, true_dt = _encode_array(np.asarray(arrays[n]))
+                    v = arrays[n]
+                    if isinstance(v, _ShardedState):
+                        # one npz entry per device shard; the manifest
+                        # records each piece's global index so restore
+                        # reassembles any slice on any mesh
+                        pieces_meta = []
+                        for k, (idx, piece) in enumerate(v.pieces):
+                            a, true_dt = _encode_array(piece)
+                            key = f"{n}@@p{k}"
+                            enc[key] = a
+                            pieces_meta.append(
+                                {"key": key,
+                                 "index": [[s, e] for s, e in idx]})
+                        var_meta[n] = {"shape": list(v.shape),
+                                       "dtype": true_dt,
+                                       "pieces": pieces_meta}
+                        continue
+                    a, true_dt = _encode_array(np.asarray(v))
                     enc[n] = a
-                    var_meta[n] = {"shape": list(np.shape(arrays[n])),
+                    var_meta[n] = {"shape": list(np.shape(v)),
                                    "dtype": true_dt}
                 np.savez(buf, **enc)
                 data = buf.getvalue()
@@ -579,11 +760,14 @@ class CheckpointManager:
         self._apply_retention()
         return total
 
-    def _shard_groups(self, arrays: Dict[str, np.ndarray]):
-        """Deterministic name-ordered grouping, cut at shard_bytes."""
+    def _shard_groups(self, arrays: Dict[str, Any]):
+        """Deterministic name-ordered grouping, cut at shard_bytes.  A
+        sharded var's pieces stay in one file (its total size counts)."""
         group, size = [], 0
         for n in sorted(arrays):
-            nb = int(np.asarray(arrays[n]).nbytes)
+            v = arrays[n]
+            nb = int(v.nbytes if isinstance(v, _ShardedState)
+                     else np.asarray(v).nbytes)
             if group and size + nb > self.shard_bytes:
                 yield group
                 group, size = [], 0
@@ -665,13 +849,20 @@ class CheckpointManager:
         return manifest
 
     def restore(self, program=None, scope=None, executor=None,
-                strict: bool = True, step: Optional[int] = None
-                ) -> Optional[CheckpointState]:
+                strict: bool = True, step: Optional[int] = None,
+                plan=None) -> Optional[CheckpointState]:
         """Load the newest intact checkpoint (or ``step``) into the scope
         and restore the determinism plane.  Returns None when the root
         holds no checkpoints at all (cold start); raises
         :class:`CorruptCheckpointError` when checkpoints exist but none
-        validates."""
+        validates.
+
+        ``plan`` (a ``parallel.sharding.ShardingPlan``, defaulting to the
+        program's own) reshards per-shard-saved vars straight onto the
+        target mesh: each device materialises only its slice of the saved
+        pieces (``jax.make_array_from_callback``), so a checkpoint
+        written under one mesh restores under another — or, with no
+        plan, reassembles to ordinary single-device arrays."""
         m = trace.metrics()
         steps = list_checkpoint_steps(self.root)
         if step is not None:
@@ -691,16 +882,19 @@ class CheckpointManager:
                 f"no intact checkpoint under {self.root}: all of "
                 f"{steps} failed manifest/checksum validation")
         d = os.path.join(self.root, _step_dirname(chosen))
+        if plan is None and program is not None:
+            plan = getattr(program, "_sharding_plan", None)
         with trace.span("checkpoint::restore", cat="step",
                         args={"step": chosen}):
             self._load_into_scope(d, manifest, program, scope,
-                                  strict=strict)
+                                  strict=strict, plan=plan)
             self._restore_determinism(manifest, program, executor)
         m.counter("ckpt.restores").inc()
         m.histogram("ckpt.restore_seconds").observe((trace.now() - t0) / 1e9)
         return CheckpointState(chosen, d, manifest)
 
-    def _load_into_scope(self, d, manifest, program, scope, strict):
+    def _load_into_scope(self, d, manifest, program, scope, strict,
+                         plan=None):
         import jax.numpy as jnp
         from .core import global_scope
         scope = scope or global_scope()
@@ -710,12 +904,15 @@ class CheckpointManager:
         for sh in manifest.get("shards", []):
             with np.load(os.path.join(d, sh["file"]),
                          allow_pickle=False) as data:
-                for n in data.files:
-                    vm = sh["vars"].get(n, {})
-                    arr = _decode_array(data[n],
-                                        vm.get("dtype", str(data[n].dtype)))
+                for n, vm in sh.get("vars", {}).items():
+                    if vm.get("pieces"):
+                        scope.set_var(
+                            n, self._load_sharded(n, vm, data, plan))
+                    else:
+                        arr = _decode_array(
+                            data[n], vm.get("dtype", str(data[n].dtype)))
+                        scope.set_var(n, jnp.asarray(arr))
                     loaded[n] = vm
-                    scope.set_var(n, jnp.asarray(arr))
         if strict and prog is not None:
             wanted = {v.name: v for v in prog.global_block().vars.values()
                       if v.persistable}
@@ -754,6 +951,34 @@ class CheckpointManager:
                     + (", ".join(missing) or "none")
                     + ".  Mismatches: " + ("; ".join(mismatched) or "none")
                     + ".  Pass strict=False to load best-effort")
+
+    @staticmethod
+    def _load_sharded(n, vm, data, plan):
+        """One per-shard-saved var -> a scope value.  With a plan, each
+        target-mesh device pulls exactly its slice out of the saved
+        pieces (resharded restore: the piece layout and the target
+        sharding need not match); without one, the pieces reassemble to
+        a plain array."""
+        import jax
+        import jax.numpy as jnp
+        shape = tuple(int(x) for x in vm["shape"])
+        true_dt = vm.get("dtype", "float32")
+        # assemble in the ENCODED dtype (bf16 rides as its uint16 view,
+        # manifest-recorded) and view back after — bit-exact
+        pieces = [
+            (tuple((int(s), int(e)) for s, e in p["index"]),
+             (lambda key=p["key"]: data[key]))
+            for p in vm["pieces"]]
+        np_dt = np.dtype(_DTYPE_ENCODE.get(true_dt) or true_dt)
+
+        def _block(index):
+            return _decode_array(
+                _assemble_slice(index, shape, np_dt, pieces), true_dt)
+
+        if plan is not None:
+            sharding = plan.sharding_for(n, shape)
+            return jax.make_array_from_callback(shape, sharding, _block)
+        return jnp.asarray(_block(tuple(slice(0, d) for d in shape)))
 
     @staticmethod
     def _restore_determinism(manifest, program, executor):

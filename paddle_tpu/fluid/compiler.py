@@ -57,6 +57,18 @@ class BuildStrategy:
         self.amp_custom_white_list = None
         self.amp_custom_black_list = None
         self.prune_redundant_casts = True
+        # the unified SPMD sharding plane (parallel/sharding.py,
+        # docs/sharding.md): "dp" | "tp" | "fsdp" lower a regex
+        # PartitionSpec rule set over every param/grad/optimizer
+        # accumulator, the executor compiles the WHOLE step as one
+        # sharded (pjit) executable with buffer donation, and the
+        # shard_collectives pass rewrites Fleet's ring-id allreduce ops
+        # into sharding constraints (0 dispatched collectives).  A custom
+        # [(regex, PartitionSpec), ...] list is accepted too.
+        self.sharding = None
+        # optional {"axis": size, ...} mesh override; default is a
+        # 1-axis mesh over all local devices (dp/fsdp -> "dp", tp -> "tp")
+        self.sharding_mesh = None
         self.enable_sequential_execution = False
         self.remove_unnecessary_lock = True
         self.sync_batch_norm = False        # -> sync_batch_norm op psum
@@ -86,6 +98,7 @@ class CompiledProgram:
         self._program = getattr(program_or_graph, "_program", program_or_graph)
         self._build_strategy = build_strategy or BuildStrategy()
         self._mesh = None
+        self._sharding_plan = None
         self._is_data_parallel = False
         self._ir_passes_applied = False
         # forwarded so Executor.run can treat us like a Program
@@ -102,6 +115,29 @@ class CompiledProgram:
             # explicit k=1 must undo an earlier strategy's hint — the
             # hints dict is shared with the underlying Program
             self._program._hints.pop("steps_per_dispatch", None)
+
+    def _ensure_sharding_plan(self):
+        """Lower ``BuildStrategy.sharding`` into a ShardingPlan once, at
+        first run (the program's params and shapes exist by then).  The
+        mesh defaults to the shared process mesh or a fresh 1-axis mesh
+        over all local devices (``sharding_mesh`` overrides); the plan is
+        what the executor's sharded-compile path consumes."""
+        mode = getattr(self._build_strategy, "sharding", None)
+        if not mode or self._sharding_plan is not None:
+            return self._sharding_plan
+        from ..parallel import sharding as shard_plane
+        from ..parallel import mesh as mesh_registry
+        mesh = self._mesh
+        axes = getattr(self._build_strategy, "sharding_mesh", None)
+        if mesh is None and axes:
+            mesh = mesh_registry.build_mesh(dict(axes))
+        self._sharding_plan = shard_plane.build_plan(
+            program=self._program, mode=mode, mesh=mesh)
+        self._program._hints["sharding"] = self._sharding_plan.describe()
+        if trace.enabled():
+            trace.instant("sharding_plan", cat="compile",
+                          args=self._sharding_plan.describe())
+        return self._sharding_plan
 
     def _apply_ir_passes(self, fetch_names=()):
         """Run the BuildStrategy-selected pass pipeline over the program,

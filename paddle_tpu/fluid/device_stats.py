@@ -112,13 +112,21 @@ def _tree_bytes(tree) -> int:
 
 
 def capture(jitted, example_args: Sequence,
-            label: Optional[str] = None) -> Optional[Dict[str, Any]]:
+            label: Optional[str] = None,
+            n_devices: int = 1) -> Optional[Dict[str, Any]]:
     """Lower + compile ``jitted`` at ``example_args`` (arrays or
     ShapeDtypeStruct trees) and return the merged device-truth record::
 
         {"flops", "bytes_accessed",
          "argument_bytes", "output_bytes", "temp_bytes", "alias_bytes",
-         "generated_code_bytes", "peak_bytes", "analysis_seconds"}
+         "generated_code_bytes", "peak_bytes", "per_device_peak_bytes",
+         "mesh_devices", "analysis_seconds"}
+
+    Under a sharded (SPMD) compile, XLA's analyses describe the
+    PER-DEVICE program — pass ``n_devices`` (the plan's mesh size) so the
+    record says both what one device holds (``per_device_peak_bytes``,
+    the HBM-fit question) and how wide the executable runs
+    (``mesh_devices``).
 
     Returns None when the callable has no ``lower`` (checkify wrappers,
     custom step builders) or the backend refuses the analysis — capture
@@ -167,6 +175,12 @@ def capture(jitted, example_args: Sequence,
         0,
         info["argument_bytes"] + info["output_bytes"] + info["temp_bytes"]
         + info["generated_code_bytes"] - info["alias_bytes"])
+    # per-shard HBM truth: the analysis above is already per-device (one
+    # SPMD program per chip); record it under the explicit name the
+    # sharding plane's consumers (bench --sharding, tpu_watch, OOM
+    # forensics) read, beside the mesh width
+    info["mesh_devices"] = max(1, int(n_devices or 1))
+    info["per_device_peak_bytes"] = info["peak_bytes"]
     dt = time.perf_counter() - t0
     info["analysis_seconds"] = round(dt, 4)
     m.histogram("xla.analysis_seconds").observe(dt)
@@ -190,7 +204,8 @@ def peak_bytes_of(info: Dict[str, Any]) -> int:
 # gauge surface
 # ---------------------------------------------------------------------------
 
-_MEM_FIELDS = ("peak_bytes", "argument_bytes", "output_bytes", "temp_bytes")
+_MEM_FIELDS = ("peak_bytes", "argument_bytes", "output_bytes", "temp_bytes",
+               "per_device_peak_bytes")
 _COST_FIELDS = ("flops", "bytes_accessed")
 
 # process-wide label -> peak bytes of every published executable.  The

@@ -340,9 +340,16 @@ class Executor:
             use_program_cache: bool = True):
         program = program or default_main_program()
         fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
-        # CompiledProgram facade (compiler.py) unwraps to its program + mesh
+        # CompiledProgram facade (compiler.py) unwraps to its program +
+        # mesh + sharding plan (parallel/sharding.py — the whole-step
+        # pjit path; a plain frozen Program may carry a plan too)
         mesh = getattr(program, "_mesh", None)
+        plan = getattr(program, "_sharding_plan", None)
         if hasattr(program, "_program"):   # CompiledProgram
+            # BuildStrategy.sharding lowers to its plan + the
+            # shard_collectives rewrite before fingerprinting
+            if hasattr(program, "_ensure_sharding_plan"):
+                plan = program._ensure_sharding_plan() or plan
             # BuildStrategy-selected IR passes run ONCE, seeded/protected
             # by this first run's fetch set, before the program is
             # fingerprinted — the pass framework contract (fluid/passes/)
@@ -350,6 +357,9 @@ class Executor:
                 program._apply_ir_passes(fetch_names)
             mesh = getattr(program, "_mesh", None) or mesh
             program = program._program
+            plan = getattr(program, "_sharding_plan", None) or plan
+        if plan is not None:
+            mesh = None     # the plan path subsumes the legacy auto mode
         if program._hints.get("ps_server") is not None:
             # pserver program from DistributeTranspiler.get_pserver_program:
             # running it IS the server loop (listen_and_serv_op role) —
@@ -379,6 +389,7 @@ class Executor:
         if ((core.get_flag("shape_bucketing")
              or program._hints.get("shape_bucketing"))
                 and feed and mesh is None
+                and (plan is None or plan.data_axis is None)
                 and not program._hints.get("pipeline_microbatches")
                 and not program._hints.get("recompute_checkpoints")):
             dims = {np.shape(v)[0] for v in feed.values() if np.ndim(v) >= 1}
@@ -409,7 +420,8 @@ class Executor:
                bool(core.get_flag("check_nan_inf")),
                bool(program._hints.get("inference_no_prune")),
                bool(program._hints.get("donate_buffers")),
-               bucket)
+               bucket,
+               id(plan) if plan is not None else None)
         # compile-cache instrumentation (the _ExecutorCache hit-rate is THE
         # first-order perf signal on this stack: a miss is a whole-block
         # XLA recompile).  Counters are always on (one int bump per run);
@@ -432,10 +444,14 @@ class Executor:
             pcache = compile_cache.persistent_cache()
             pkey = pwarm = None
             if pcache is not None:
-                # key minus the process-local ids (scope, mesh objects)
+                # key minus the process-local ids (scope, mesh, plan
+                # objects); the plan contributes its stable description,
+                # never its id (an id would defeat warm starts)
                 pkey = compile_cache.persistent_key(
                     key[0], feed_sig, fetch_names,
-                    extras=key[4:7] + (mesh is not None,) + key[8:])
+                    extras=key[4:7] + (mesh is not None,) + key[8:12]
+                    + (repr(sorted(plan.describe().items()))
+                       if plan is not None else None,))
                 pwarm = pcache.has(pkey)
             if pwarm:
                 trace.metrics().counter(
@@ -449,7 +465,7 @@ class Executor:
                     "executor.compile_cache_cold_miss").inc()
             _t0 = trace.now()
             compiled = self._prepare(program, feed, fetch_names, scope, mesh,
-                                     bucket=bucket)
+                                     bucket=bucket, plan=plan)
             # the XLA compile itself happens lazily on the FIRST jitted
             # call — the executor::compile span, the compile_seconds
             # observation, and the persistent record all land after the
@@ -525,7 +541,9 @@ class Executor:
             # _footprints without an eviction to retire it.
             dinfo = self._capture_device_stats(
                 key, compiled, (mut, ro, feeds, step_key),
-                bucket=bucket) if use_program_cache else None
+                bucket=bucket,
+                n_devices=plan.n_devices if plan is not None else 1) \
+                if use_program_cache else None
             if pcache is not None and not pwarm:
                 meta = {
                     "fingerprint": key[0], "feed_sig": list(feed_sig),
@@ -596,22 +614,27 @@ class Executor:
     def step_counter(self, value: int) -> None:
         self._step = int(value)
 
-    def snapshot_vars(self, names, scope: Optional[Scope] = None):
+    def snapshot_vars(self, names, scope: Optional[Scope] = None,
+                      handle_factory=None):
         """Donation-safe point-in-time snapshot of scope vars: each array
         is wrapped in a state-aliasing FetchHandle registered on
         ``_alias_live``, so a later dispatch that donates the scope's
         buffers host-persists these first (the PR-4 alias-guard
         invariant).  The caller (fluid/checkpoint.py's background writer)
         materialises them OFF the training thread — an async checkpoint
-        never stalls the step window."""
+        never stalls the step window.  ``handle_factory(value, name)``
+        overrides handle construction (checkpoint's per-shard-persisting
+        handle for mesh-sharded state)."""
         from .async_pipeline import FetchHandle
         import weakref
         scope = scope or global_scope()
+        make = handle_factory or (
+            lambda v, n: FetchHandle(v, name=n, aliases_state=True))
         out = {}
         for n in names:
             v = scope.find_var(n)
             if v is not None:
-                out[n] = FetchHandle(v, name=n, aliases_state=True)
+                out[n] = make(v, n)
         self._alias_live.extend(weakref.ref(h) for h in out.values())
         return out
 
@@ -716,19 +739,24 @@ class Executor:
             return []
         fetch_names = [_fetch_name(f) for f in _as_list(fetch_list)]
         mesh = getattr(program, "_mesh", None)
+        plan = getattr(program, "_sharding_plan", None)
         if hasattr(program, "_program"):   # CompiledProgram
+            if hasattr(program, "_ensure_sharding_plan"):
+                plan = program._ensure_sharding_plan() or plan
             if hasattr(program, "_apply_ir_passes"):
                 program._apply_ir_passes(fetch_names)
             mesh = getattr(program, "_mesh", None) or mesh
             program = program._program
-        if (mesh is not None
+            plan = getattr(program, "_sharding_plan", None) or plan
+        if (mesh is not None or plan is not None
                 or program._hints.get("pipeline_microbatches")
                 or program._hints.get("recompute_checkpoints")
                 or program._hints.get("ps_plan") is not None
                 or program._hints.get("ps_server") is not None):
             raise ScanUnsupportedError(
-                "run_scan: mesh/pipeline/recompute/PS programs do their "
-                "own per-step surgery — dispatch them one step at a time")
+                "run_scan: mesh/sharded/pipeline/recompute/PS programs do "
+                "their own per-step surgery — dispatch them one step at a "
+                "time")
         if core.get_flag("check_nan_inf"):
             raise ScanUnsupportedError(
                 "run_scan: FLAGS_check_nan_inf compiles per-op checkify "
@@ -782,7 +810,7 @@ class Executor:
                 permanent=False)
         feed_sig = next(iter(sigs))
 
-        # MIRRORS run()'s key tuple (positions 4-11) with the rejected
+        # MIRRORS run()'s key tuple (positions 4-12) with the rejected
         # paths pinned to their inert values and a ("scan", K) suffix —
         # a new field added to run()'s key must be added here too, or the
         # two paths cache under inconsistent keys
@@ -791,7 +819,7 @@ class Executor:
                None, False,
                bool(program._hints.get("inference_no_prune")),
                bool(program._hints.get("donate_buffers")),
-               bucket, ("scan", k_steps))
+               bucket, None, ("scan", k_steps))
         tr_on = trace.enabled()
         pending_compile = None
         compiled = self._cache.get(key)
@@ -951,7 +979,7 @@ class Executor:
 
     # -- device truth (fluid/device_stats.py) --------------------------------
     def _capture_device_stats(self, key, compiled, example_args,
-                              bucket=None, scan=None):
+                              bucket=None, scan=None, n_devices=1):
         """AOT cost/memory analysis of a freshly compiled executable,
         published as per-executable gauges and kept beside the LRU for
         OOM forensics.  Runs only on a compile miss and only when
@@ -967,7 +995,7 @@ class Executor:
                  + hashlib.sha1(repr((id(self), key)).encode())
                  .hexdigest()[:6])
         info = device_stats.capture(compiled.jitted, example_args,
-                                    label=label)
+                                    label=label, n_devices=n_devices)
         if info is None:
             return None
         info["bucket"] = bucket
@@ -1034,7 +1062,7 @@ class Executor:
 
     # -- compilation --------------------------------------------------------
     def _prepare(self, program: Program, feed, fetch_names, scope,
-                 mesh=None, bucket=None) -> _CompiledBlock:
+                 mesh=None, bucket=None, plan=None) -> _CompiledBlock:
         block = program.global_block()
         is_test = bool(program._hints.get("is_test"))
         checkpoints = program._hints.get("recompute_checkpoints")
@@ -1060,7 +1088,7 @@ class Executor:
                 and "pp" in getattr(mesh, "axis_names", ())
                 and mesh.shape["pp"] > 1):
             from ..parallel.pipeline import classify_block, build_pipeline_step
-            plan = classify_block(block)
+            stage_plan = classify_block(block)
             example_env = {}
             for n in param_names:
                 v = scope.find_var(n)   # shape/dtype only — no host copy
@@ -1073,8 +1101,8 @@ class Executor:
                 example_env[k] = jax.ShapeDtypeStruct(
                     tuple(shape), np.asarray(v).dtype)
             jfn = build_pipeline_step(
-                block, plan, mesh, microbatches, fetch_names, mesh_axes,
-                is_test, written_names, example_env, list(feed))
+                block, stage_plan, mesh, microbatches, fetch_names,
+                mesh_axes, is_test, written_names, example_env, list(feed))
             return _CompiledBlock(jfn, param_names, written_names,
                                   fetch_names, jitted=jfn)
 
@@ -1082,11 +1110,11 @@ class Executor:
         if checkpoints:
             from ..parallel.pipeline import (classify_block,
                                              build_functional_step)
-            plan = classify_block(block)
+            stage_plan = classify_block(block)
             # inference clones keep the hint but have no backward to
             # rematerialise — fall through to the plain path
-            if plan.loss_name is not None:
-                fn = build_functional_step(block, plan, fetch_names,
+            if stage_plan.loss_name is not None:
+                fn = build_functional_step(block, stage_plan, fetch_names,
                                            mesh_axes, is_test, checkpoints,
                                            written_names)
                 backend = self.place.jax_device().platform
@@ -1172,8 +1200,11 @@ class Executor:
                         f"of a CompiledProgram, or leave enable_dce / "
                         f"memory_optimize off (docs/passes.md)")
         # per-op checkify checks can't be staged under wrap_with_mesh's
-        # plain jit — mesh runs keep the post-hoc fetched-var scan instead
-        debug_nan = bool(core.get_flag("check_nan_inf")) and mesh is None
+        # plain jit — mesh/sharded runs keep the post-hoc fetched-var
+        # scan instead
+        debug_nan = bool(core.get_flag("check_nan_inf")) \
+            and mesh is None and plan is None
+        plan_mesh = plan.mesh if plan is not None else None
 
         alias_cell: list = []
 
@@ -1184,6 +1215,10 @@ class Executor:
             ctx = LoweringContext(base_key=step_key, mesh_axes=mesh_axes,
                                   is_test=is_test)
             ctx.debug_nan = debug_nan
+            # sharded compile: shard_constraint ops (the rewritten
+            # collectives) pin values through this mesh; everything else
+            # is GSPMD's problem, not per-op dispatch
+            ctx.mesh = plan_mesh
             if bucket is not None:
                 # true batch size rides in as a traced scalar: varying
                 # tails within one bucket share ONE executable
@@ -1212,6 +1247,25 @@ class Executor:
                    or program._hints.get("donate_buffers"))
                   and backend != "cpu")
         err_cell = None
+        if plan is not None:
+            # the whole-step sharded compile (parallel/sharding.py):
+            # in_shardings from the plan's rules, state donation for the
+            # in-place optimizer update, collectives implied by
+            # constraints instead of dispatched — ONE executable per step
+            from ..parallel.sharding import wrap_with_plan
+            shapes = {n: scope.find_var(n) for n in param_names}
+            plan_feed = dict(feed)
+            if bucket is not None:
+                plan_feed["__batch_valid__"] = np.int32(0)
+            mut_names = [n for n in param_names if n in written_names]
+            ro_names = [n for n in param_names if n not in written_names]
+            jfn, jitted = wrap_with_plan(
+                fn, plan, shapes, mut_names, ro_names, plan_feed,
+                block=block, donate=donate)
+            return _CompiledBlock(jfn, param_names, written_names,
+                                  fetch_names, n_ops=len(run_ops),
+                                  raw_fn=fn, donates=donate,
+                                  alias_cell=alias_cell, jitted=jitted)
         if mesh is not None:
             from ..parallel.api import wrap_with_mesh
             jfn = wrap_with_mesh(fn, mesh, program)

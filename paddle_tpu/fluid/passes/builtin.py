@@ -438,14 +438,20 @@ class CoalesceAllReducePass(Pass):
             if len(chunk) < 2:
                 out_ops.extend(chunk)
                 continue
+            attrs = {"ring_id": ring,
+                     "reduce": self.COALESCABLE[op_type],
+                     "use_calc_stream": True,
+                     "op_role": chunk[0].attrs.get("op_role", 1)}
+            # the mesh-axis stamp (insert_allreduce_ops) survives
+            # coalescing so shard_collectives maps ring -> axis
+            # deterministically from the op itself
+            if chunk[0].attrs.get("mesh_axis"):
+                attrs["mesh_axis"] = chunk[0].attrs["mesh_axis"]
             out_ops.append(Operator(
                 block, "c_allreduce_coalesced",
                 {"X": [o.inputs["X"][0] for o in chunk]},
                 {"Out": [o.outputs["Out"][0] for o in chunk]},
-                {"ring_id": ring,
-                 "reduce": self.COALESCABLE[op_type],
-                 "use_calc_stream": True,
-                 "op_role": chunk[0].attrs.get("op_role", 1)}))
+                attrs))
             removed += len(chunk) - 1
             fused += len(chunk)
         return removed, fused
@@ -578,6 +584,73 @@ class MemoryOptimizeLegacyPass(Pass):
 # BuildStrategy -> pipeline wiring (build_strategy.cc AppendPass analog)
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# dispatched collectives -> sharding constraints (the SPMD sharding plane)
+# ---------------------------------------------------------------------------
+
+@register_pass
+class ShardCollectivesPass(Pass):
+    """Rewrite Fleet's ring-id collectives into ``shard_constraint`` ops —
+    the pjit-first half of the sharding plane (parallel/sharding.py,
+    docs/sharding.md).  A dispatched ``c_allreduce_*`` is an opaque
+    launch XLA cannot fuse or overlap; under a whole-step sharded compile
+    the same synchronisation is a *replicated sharding constraint* on the
+    gradient: GSPMD inserts (and schedules, and fuses) the reduce the
+    constraint implies.  The op keeps its dataflow position, records its
+    origin + mesh axis (``mesh_axis`` attr stamped by
+    ``insert_allreduce_ops``, else the ring registry's mapping), and
+    lowers to ``lax.with_sharding_constraint`` when a plan's mesh is live
+    — identity otherwise, so the rewritten program still runs unsharded.
+
+    The per-op dispatch path is untouched for programs that never opt in
+    (``BuildStrategy.sharding`` unset): those keep lowering collectives
+    through ``LoweringContext.mesh_axes`` as before.
+    """
+
+    name = "shard_collectives"
+    REWRITABLE = frozenset({
+        "c_allreduce_sum", "c_allreduce_avg", "c_allreduce_coalesced",
+        "c_broadcast",
+    })
+
+    def _axis_of(self, op) -> Optional[str]:
+        ax = op.attrs.get("mesh_axis")
+        if ax:
+            return str(ax)
+        from ...parallel import mesh as mesh_registry
+        return mesh_registry.axis_for_ring(
+            int(op.attrs.get("ring_id", 0)))
+
+    def apply_block(self, block, ctx: PassContext) -> Dict[str, int]:
+        from .. import trace
+        implied = 0
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            xs = list(op.inputs.get("X", ()))
+            outs = list(op.outputs.get("Out", ()))
+            if op.type not in self.REWRITABLE or not xs \
+                    or len(xs) != len(outs):
+                i += 1
+                continue
+            new = Operator(
+                block, "shard_constraint",
+                {"X": xs}, {"Out": outs},
+                {"spec": [],                        # replicated = synced
+                 "origin": op.type,
+                 "ring_id": int(op.attrs.get("ring_id", 0)),
+                 "mesh_axis": self._axis_of(op) or "",
+                 "op_role": op.attrs.get("op_role", 1)})
+            block._remove_op(i)
+            block._insert_op_obj(i, new)
+            implied += len(xs)
+            i += 1
+        if implied:
+            trace.metrics().counter("sharding.collectives_implied").inc(
+                implied)
+        return {"collectives_implied": implied}
+
+
 def passes_for_build_strategy(build_strategy) -> List[Pass]:
     """Instantiate the pass list a BuildStrategy's knobs select, in the
     canonical order: fold -> fuse -> clean -> amp -> dce -> coalesce.
@@ -611,4 +684,8 @@ def passes_for_build_strategy(build_strategy) -> List[Pass]:
         specs.append(("coalesce_allreduce", {
             "bucket_size": int(
                 getattr(bs, "fuse_grad_size_in_num", 32) or 32)}))
+    if getattr(bs, "sharding", None):
+        # last: whatever allreduce shape survives (coalesced or per-grad)
+        # is rewritten into sharding constraints for the pjit step
+        specs.append(("shard_collectives", {}))
     return [create_pass(name, **kw) for name, kw in specs]

@@ -68,12 +68,23 @@ def register_comm_op(type, fn=None, **kwargs):
     return deco
 
 
+def _note_dispatched(n: int = 1):
+    """The other half of the implied-vs-dispatched split
+    (parallel/sharding.py): a collective that lowers to a REAL psum/
+    pmean launch counts here, once per compile (trace time).  The
+    sharding plane's ``shard_collectives`` rewrite counts into
+    ``sharding.collectives_implied`` instead — a sharded executable
+    gates on this counter staying at zero."""
+    trace.metrics().counter("sharding.collectives_dispatched").inc(n)
+
+
 def _allreduce(reducer):
     def lower(ins, attrs, ctx):
         x = ins["X"][0]
         axis = _axis(ctx, attrs)
         if axis is None:
             return {"Out": [x]}
+        _note_dispatched()
         return {"Out": [reducer(x, axis_name=axis)]}
     return lower
 
@@ -90,6 +101,7 @@ def _c_allreduce_coalesced(ins, attrs, ctx):
     axis = _axis(ctx, attrs)
     if axis is None:
         return {"Out": xs}
+    _note_dispatched(len(xs))
     reducer = lax.pmean if attrs.get("reduce", "sum") == "avg" else lax.psum
     flat = jnp.concatenate([x.reshape(-1) for x in xs])
     red = reducer(flat, axis_name=axis)
@@ -198,6 +210,25 @@ def _c_split(ins, attrs, ctx):
 def _c_identity(ins, attrs, ctx):
     # TP forward-identity/backward-allreduce boundary op
     return {"Out": [ins["X"][0]]}
+
+
+@register_op("shard_constraint", differentiable=False)
+def _shard_constraint(ins, attrs, ctx):
+    """PartitionSpec-implied communication (parallel/sharding.py): the
+    ``shard_collectives`` pass rewrites ring-id allreduce ops into this
+    marker.  Under a sharded compile (``ctx.mesh`` set by the executor's
+    plan path) each value is pinned to the attr's spec — replicated ``[]``
+    for a rewritten gradient allreduce — and GSPMD inserts the reduce the
+    constraint implies; with no live mesh it is identity, so the
+    rewritten program still runs unsharded (the per-op fallback)."""
+    xs = list(ins["X"])
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is None:
+        return {"Out": xs}
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = PartitionSpec(*(attrs.get("spec") or ()))
+    sh = NamedSharding(mesh, spec)
+    return {"Out": [lax.with_sharding_constraint(x, sh) for x in xs]}
 
 
 @register_comm_op("send_v2", differentiable=False)

@@ -82,7 +82,7 @@ REASONS = {
     # -- communication / process plumbing ------------------------------------
     **{op: "comm" for op in (
         "barrier", "c_allreduce_coalesced", "c_comm_init",
-        "c_comm_init_all",
+        "c_comm_init_all", "shard_constraint",
         "c_comm_init_multitrainer", "c_gen_nccl_id", "gen_nccl_id",
         "c_sync_calc_stream", "c_sync_comm_stream", "send_v2", "recv_v2",
         "partial_send", "enqueue", "dequeue", "queue_generator")},

@@ -8,11 +8,19 @@ Two modes, mirroring the two ways the reference parallelises (SURVEY §2.9):
   gradient all-reduce that AllReduceOpHandle issued by hand.  Explicit
   c_allreduce ops in the program lower to identity here (their ring has no
   bound axis), so fleet-style programs stay correct without double-reducing.
+  The rule-driven generalisation of this mode is parallel/sharding.py
+  (``BuildStrategy.sharding`` — whole-step pjit from regex PartitionSpec
+  rules); wrap_with_mesh remains the legacy per-Parameter-annotation path.
 
 * explicit (shard_map) — the collective-op path.  ring_id -> axis bindings
   are live, c_* ops lower to lax.psum/all_gather/ppermute on ICI.  Used for
   tensor/sequence parallel layers and ring attention where communication
   placement is the point.
+
+Both planes share ONE process mesh: every wrapper funnels its mesh through
+:func:`resolved_mesh`, which registers it in parallel/mesh.py — so a plan
+built by sharding.py and a shard_map step built here resolve the same
+``jax.sharding.Mesh`` object, never two twins over the same devices.
 """
 from __future__ import annotations
 
@@ -20,6 +28,43 @@ from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_registry
+
+# ---------------------------------------------------------------------------
+# jax-version compat, resolved ONCE at import (not per call): new
+# (use_mesh-era) jax exports shard_map at top level with the `check_vma`
+# switch; 0.4.x only has jax.experimental.shard_map with the same switch
+# named `check_rep`.  A per-call getattr probed this on EVERY wrapped-step
+# build; the resolution is a property of the installed jax, not the call.
+# ---------------------------------------------------------------------------
+_SHARD_MAP_FN = getattr(jax, "shard_map", None)
+if _SHARD_MAP_FN is not None:
+    _SHARD_MAP_CHECK_KW = "check_vma"
+else:                                   # 0.4.x fallback, import-time only
+    from jax.experimental.shard_map import shard_map as _SHARD_MAP_FN
+    _SHARD_MAP_CHECK_KW = "check_rep"
+# use_mesh-era marker (jax >= 0.6 context-manager mesh API): informational
+# for callers that want to gate on the new ambient-mesh style
+USE_MESH_API = hasattr(jax.sharding, "use_mesh") \
+    or hasattr(jax, "set_mesh")
+
+
+def resolved_mesh(mesh: Optional[Mesh] = None) -> Optional[Mesh]:
+    """THE mesh both planes share.  With an explicit mesh, install it as
+    the process mesh (parallel/mesh.py) and return it; otherwise return
+    the current process mesh (None when nothing built one yet).
+    sharding.py's plan builder and the executor's auto-mode wrapper
+    resolve through here, so the sharding plane and the mesh registry can
+    never hold two different Mesh objects over the same devices.
+    One-off explicit wrappers (``compat_shard_map`` over an ad-hoc mesh)
+    deliberately do NOT install — a temporary two-device shard_map must
+    not hijack the process default every later plan adopts."""
+    if mesh is not None:
+        if mesh_registry.current_mesh() is not mesh:
+            mesh_registry.set_current_mesh(mesh)
+        return mesh
+    return mesh_registry.current_mesh()
 
 
 def param_sharding(mesh: Mesh, program) -> Dict[str, NamedSharding]:
@@ -36,6 +81,7 @@ def wrap_with_mesh(fn, mesh: Mesh, program, batch_axis: str = "dp",
                    donate: bool = True):
     """Auto-mode wrapper for Executor step functions:
     fn(mut_params, ro_params, feeds, key) -> (fetches, new_vals)."""
+    mesh = resolved_mesh(mesh)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P(batch_axis))
     psh = param_sharding(mesh, program)
@@ -54,16 +100,13 @@ def wrap_with_mesh(fn, mesh: Mesh, program, batch_axis: str = "dp",
 
 
 def compat_shard_map(fn, mesh, in_specs, out_specs, check_vma=False):
-    """jax.shard_map across jax versions: new jax exports it at top level
-    with the `check_vma` switch; 0.4.x only has
-    jax.experimental.shard_map with the same switch named `check_rep`."""
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=check_vma)
-    from jax.experimental.shard_map import shard_map as sm_old
-    return sm_old(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=check_vma)
+    """jax.shard_map across jax versions, resolved at module import (the
+    top-level export + `check_vma` on use_mesh-era jax, the experimental
+    one + `check_rep` on 0.4.x).  The mesh is used as passed — an ad-hoc
+    shard_map never mutates the shared process mesh (resolved_mesh)."""
+    return _SHARD_MAP_FN(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs,
+                         **{_SHARD_MAP_CHECK_KW: check_vma})
 
 
 def shard_map_step(fn, mesh: Mesh, in_specs, out_specs):

@@ -100,26 +100,35 @@ def param_schema(cfg: TransformerConfig) -> Dict[str, Tuple[tuple, P, tuple]]:
     V, D, H, F, L, T = (cfg.vocab, cfg.d_model, cfg.n_heads, cfg.d_ff,
                         cfg.n_layers, cfg.seq_len)
     Dh = D // H
-    return {
-        # name: (global_shape, partition_spec, grad_psum_axes)
-        "embed": ((V, D), P("tp", None), ("dp", "pp", "sp")),
-        "pos":   ((T, D), P("sp", None), ("dp", "pp", "tp")),
-        "wq":    ((L, D, H, Dh), P("pp", None, "tp", None), ("dp", "sp")),
-        "wk":    ((L, D, H, Dh), P("pp", None, "tp", None), ("dp", "sp")),
-        "wv":    ((L, D, H, Dh), P("pp", None, "tp", None), ("dp", "sp")),
-        "wo":    ((L, H, Dh, D), P("pp", "tp", None, None), ("dp", "sp")),
-        "w1":    ((L, D, F), P("pp", None, "tp"), ("dp", "sp")),
-        "b1":    ((L, F), P("pp", "tp"), ("dp", "sp")),
-        "w2":    ((L, F, D), P("pp", "tp", None), ("dp", "sp")),
-        "b2":    ((L, D), P("pp", None), ("dp", "sp", "tp")),
-        "ln1_g": ((L, D), P("pp", None), ("dp", "sp", "tp")),
-        "ln1_b": ((L, D), P("pp", None), ("dp", "sp", "tp")),
-        "ln2_g": ((L, D), P("pp", None), ("dp", "sp", "tp")),
-        "ln2_b": ((L, D), P("pp", None), ("dp", "sp", "tp")),
-        "lnf_g": ((D,), P(None), ("dp", "pp", "sp", "tp")),
-        "lnf_b": ((D,), P(None), ("dp", "pp", "sp", "tp")),
-        "head":  ((D, V), P(None, "tp"), ("dp", "pp", "sp")),
+    shapes = {
+        "embed": (V, D), "pos": (T, D),
+        "wq": (L, D, H, Dh), "wk": (L, D, H, Dh), "wv": (L, D, H, Dh),
+        "wo": (L, H, Dh, D),
+        "w1": (L, D, F), "b1": (L, F), "w2": (L, F, D), "b2": (L, D),
+        "ln1_g": (L, D), "ln1_b": (L, D), "ln2_g": (L, D), "ln2_b": (L, D),
+        "lnf_g": (D,), "lnf_b": (D,),
+        "head": (D, V),
     }
+    # gradients must be psum'ed over exactly the axes holding replicas
+    rep = {
+        "embed": ("dp", "pp", "sp"), "pos": ("dp", "pp", "tp"),
+        "wq": ("dp", "sp"), "wk": ("dp", "sp"), "wv": ("dp", "sp"),
+        "wo": ("dp", "sp"),
+        "w1": ("dp", "sp"), "b1": ("dp", "sp"), "w2": ("dp", "sp"),
+        "b2": ("dp", "sp", "tp"),
+        "ln1_g": ("dp", "sp", "tp"), "ln1_b": ("dp", "sp", "tp"),
+        "ln2_g": ("dp", "sp", "tp"), "ln2_b": ("dp", "sp", "tp"),
+        "lnf_g": ("dp", "pp", "sp", "tp"),
+        "lnf_b": ("dp", "pp", "sp", "tp"),
+        "head": ("dp", "pp", "sp"),
+    }
+    # partition specs come from the SAME rule engine every other plane
+    # uses (parallel/sharding.py HYBRID_RULES) — the per-module table and
+    # BuildStrategy.sharding are one mechanism, not two
+    from .sharding import HYBRID_RULES, match_partition_rules
+    specs = match_partition_rules(HYBRID_RULES, shapes,
+                                  on_unmatched="raise")
+    return {n: (shapes[n], specs[n], rep[n]) for n in shapes}
 
 
 def init_params(cfg: TransformerConfig, key=None) -> Dict[str, jax.Array]:

@@ -73,6 +73,14 @@ def register_ring(ring_id: int, axis_name: str):
     _ring_axes[int(ring_id)] = axis_name
 
 
+def axis_for_ring(ring_id: int) -> Optional[str]:
+    """The axis name a ring id is bound to, regardless of whether a mesh
+    is live — what ``insert_allreduce_ops`` stamps onto emitted
+    collective ops (``mesh_axis`` attr) and the ``shard_collectives``
+    pass falls back to, so ring -> axis is deterministic at IR time."""
+    return _ring_axes.get(int(ring_id))
+
+
 def ring_axes() -> Dict[int, str]:
     """Mapping consumed by LoweringContext.mesh_axes, filtered to axes that
     actually exist on the current mesh."""

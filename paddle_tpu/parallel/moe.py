@@ -90,6 +90,20 @@ def moe_ffn(x, gate_w, w_in, w_out, axis_name: Optional[str] = None,
     return out.astype(x.dtype), aux.astype(jnp.float32)
 
 
+def moe_partition_rules(axis: str = "ep"):
+    """MoE placement through the shared rule engine
+    (parallel/sharding.py): the gate replicates (every device routes),
+    expert weights shard their expert dim over ``ep`` — feed these to
+    ``match_partition_rules``/``ShardingPlan`` instead of hand-placing
+    each array."""
+    from jax.sharding import PartitionSpec as P
+    return [
+        (r"(^|[_/.])gate(_w)?$", P()),
+        (r"(^|[_/.])w_in$", P(axis, None, None)),
+        (r"(^|[_/.])w_out$", P(axis, None, None)),
+    ]
+
+
 def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
                     e_local: Optional[int] = None):
     """Initializer helper: returns (gate_w [D, E], w_in [E_l, D, F],

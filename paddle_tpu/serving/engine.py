@@ -252,7 +252,9 @@ class ServingEngine:
                  default_deadline_ms: Optional[float] = None,
                  bucket_edges=None,
                  max_inflight: Optional[int] = None,
-                 auto_start: bool = True):
+                 auto_start: bool = True,
+                 mesh=None,
+                 sharding=None):
         self.max_batch = int(max_batch
                              or core.get_flag("serving_max_batch", 32))
         self.max_wait_us = int(max_wait_us if max_wait_us is not None
@@ -265,6 +267,13 @@ class ServingEngine:
         self.default_deadline_ms = float(dl or 0)
 
         if hasattr(program, "call_lazy"):       # AotPredictor
+            if mesh is not None or sharding is not None:
+                raise ValueError(
+                    "ServingEngine(mesh=/sharding=) needs a frozen "
+                    "Program — an AOT artifact's modules were exported "
+                    "with their sharding baked in and cannot be "
+                    "re-sharded; freeze with serving.freeze_program("
+                    "..., mesh=) instead")
             self._backend = _AotBackend(program)
             self.feed_names = list(feed_names
                                    or program.get_input_names())
@@ -286,6 +295,20 @@ class ServingEngine:
                 self.max_batch = min(self.max_batch, edges[0])
             self.bucket_edges = compile_cache.normalize_edges(edges)
         else:
+            if mesh is not None or sharding is not None:
+                # serving over the SPMD plane (parallel/sharding.py): the
+                # executor runs the frozen program as one sharded (pjit)
+                # executable over the mesh — TP rules by default, so the
+                # batch replicates and shape bucketing keeps its partial-
+                # batch exactness (docs/sharding.md)
+                if getattr(program, "_sharding_plan", None) is None:
+                    from ..parallel import sharding as shard_plane
+                    plan = shard_plane.build_plan(
+                        program=program,
+                        mode=sharding if sharding is not None else "tp",
+                        mesh=mesh)
+                    program._sharding_plan = plan
+                    program._hints["sharding"] = plan.describe()
             hints = program._hints
             self.feed_names = list(feed_names or hints.get("feed_names")
                                    or [])

@@ -87,7 +87,9 @@ def freeze_program(program: Program,
                    feeds: Sequence,
                    fetches: Sequence,
                    scope=None,
-                   bucket_edges=None) -> Program:
+                   bucket_edges=None,
+                   mesh=None,
+                   sharding=None) -> Program:
     """Freeze ``program`` for serving: inference clone, distribution
     strip, inference pass preset, read-only stamp.
 
@@ -97,6 +99,14 @@ def freeze_program(program: Program,
     scope; the originals are never mutated).  ``bucket_edges`` optionally
     pins the shape-bucket edges every consumer (engine, predictor, AOT
     export) compiles against.
+
+    ``mesh`` / ``sharding`` opt the frozen program into the SPMD sharding
+    plane (parallel/sharding.py): the executor serves it as ONE sharded
+    (pjit) executable over the mesh — a TP-sharded frozen program serves
+    models bigger than one chip.  ``sharding`` is ``"tp"`` (default when
+    only a mesh is given) | ``"dp"`` | ``"fsdp"`` | custom
+    ``[(regex, PartitionSpec), ...]`` rules; the mesh defaults to the
+    shared process mesh (docs/sharding.md, serving-with-mesh lifecycle).
     """
     def _name(v):
         return v.name if isinstance(v, Variable) else str(v)
@@ -132,6 +142,13 @@ def freeze_program(program: Program,
         from ..fluid import compile_cache
         frozen._hints["bucket_edges"] = \
             compile_cache.normalize_edges(bucket_edges)
+    if mesh is not None or sharding is not None:
+        from ..parallel import sharding as shard_plane
+        plan = shard_plane.build_plan(
+            program=frozen, mode=sharding if sharding is not None
+            else "tp", mesh=mesh)
+        frozen._sharding_plan = plan
+        frozen._hints["sharding"] = plan.describe()
 
     m = trace.metrics()
     m.counter("serving.programs_frozen").inc()

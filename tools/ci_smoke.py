@@ -786,6 +786,44 @@ def main():
           f"stall -> 1 bundle ({len(stepsF)} wide events), healthz "
           f"ok->stalled->ok OK", flush=True)
 
+    step("sharding plane: 8-device whole-step DP parity + per-shard "
+         "reshard + 0 dispatched collectives")
+    # both gates run in children: the emulated 8-device mesh must be
+    # fixed BEFORE jax initialises (tests/sharding_worker.py)
+    import json as _sjson
+    env8 = dict(os.environ, JAX_PLATFORMS="cpu",
+                XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"))
+
+    def _sharding_child(mode):
+        r = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "tests",
+                                          "sharding_worker.py"), mode],
+            env=env8, capture_output=True, text=True, timeout=600,
+            cwd=_ROOT)
+        assert r.returncode == 0, f"{mode}: {r.stdout}\n{r.stderr}"
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        return _sjson.loads(line)
+
+    # gate 1: whole-step sharded DP — loss parity with the single-chip
+    # baseline, every fleet allreduce implied (0 dispatched per-op
+    # collectives in the compiled step), one executable per step
+    infoS = _sharding_child("dp_parity")
+    assert infoS["devices"] == 8 and infoS["collectives_dispatched"] == 0
+    assert infoS["collectives_implied"] > 0
+    rel = max(abs(a - b) / max(abs(a), 1e-9)
+              for a, b in zip(infoS["loss_base"], infoS["loss_sharded"]))
+    assert rel < 1e-3, (rel, infoS)
+    # gate 2: per-shard checkpoint IO — fsdp-8 save (gather-spy armed)
+    # round-trips bit-exactly into an fsdp-4 restore AND a meshless one
+    infoR = _sharding_child("reshard")
+    assert infoR["saved_devices"] == 8 and infoR["restored_devices"] == 4
+    print(f"[smoke]   sharding: DP-8 parity rel_err {rel:.2e}, "
+          f"{infoS['collectives_implied']} implied / 0 dispatched "
+          f"collectives, reshard 8->4 bit-exact "
+          f"({infoR['vars_checked']} vars)", flush=True)
+
     step("bench child emits one JSON line (cpu) with measured MFU + "
          "goodput")
     r = subprocess.run(

@@ -135,6 +135,28 @@ def _run_child(args, budget, extra_env=None, _retried=False):
                 gs.set(spd)
             for dt, n in (info.get("dtype_mix") or {}).items():
                 trace.metrics().gauge(f"watch.dtype_mix.{dt}").set(int(n))
+            # sharding-plane signals (bench --sharding leg): the mesh
+            # shape + per-device HBM row the next accelerator round
+            # baselines multichip against
+            if info.get("sharding"):
+                mesh = info.get("mesh_shape") or {}
+                ndev = 1
+                for v in mesh.values():
+                    ndev *= int(v)
+                trace.metrics().gauge("watch.sharding_devices").set(ndev)
+                trace.metrics().gauge(
+                    "watch.hbm_peak_bytes_per_device").set(
+                    int(info.get("hbm_peak_bytes_per_device", 0) or 0))
+                trace.metrics().gauge(
+                    "watch.collectives_dispatched").set(
+                    int(info.get("collectives_dispatched", 0) or 0))
+                print(f"[watch] sharding leg: {info['sharding']} over "
+                      f"{mesh}, {info.get('collectives_dispatched', 0)} "
+                      f"dispatched / "
+                      f"{info.get('collectives_implied', 0)} implied "
+                      f"collectives, per-device HBM "
+                      f"{int(info.get('hbm_peak_bytes_per_device', 0) or 0) / 1e6:.1f}MB",
+                      flush=True)
         except (ValueError, TypeError):
             pass
         return True
@@ -211,6 +233,9 @@ def main():
             run_child(["--model", "resnet50", "--layout=nchw"], 900)
             run_child(["--model", "nmt"], 900)
             run_child(["--model", "wide_deep"], 600)
+            # multichip baseline row: sharded-DP throughput + per-device
+            # HBM + the implied-vs-dispatched collective split
+            run_child(["--model", "sharding"], 600)
             if ok:
                 # operating-point ablation while the window lasts: does a
                 # bigger batch / longer seq beat the headline config?
@@ -258,6 +283,13 @@ def _report_step_timing():
         print(f"[watch] amp plane: best MFU {mfu:.1%}{measured}, "
               f"bf16-vs-fp32 speedup {spd:.2f}x, dtype mix {mix or 'n/a'}",
               flush=True)
+    sd = trace.metrics().gauge("watch.sharding_devices").value
+    if sd:
+        print(f"[watch] sharding plane: DP over {int(sd)} devices, "
+              f"per-device HBM "
+              f"{trace.metrics().gauge('watch.hbm_peak_bytes_per_device').value / 1e6:.1f}MB, "
+              f"{int(trace.metrics().gauge('watch.collectives_dispatched').value)} "
+              f"dispatched collectives", flush=True)
     g = trace.metrics().histogram("watch.goodput").stats()
     if g["count"]:
         print(f"[watch] goodput: avg {g['avg']:.0%} min {g['min']:.0%} "
