@@ -41,11 +41,24 @@ class BuildStrategy:
         # True -> constant_fold + prune_identity + dce passes (the 1.x
         # memory_optimize contract: shrink the live set / op stream)
         self.memory_optimize = None
-        self.fuse_all_optimizer_ops = False  # XLA fuses regardless
+        # REAL since the kernel tier landed: legacy alias for
+        # fuse_optimizer (framework/ir/fuse_optimizer_ops_pass analog)
+        self.fuse_all_optimizer_ops = False
         self.fuse_all_reduce_ops = False     # -> coalesce_allreduce pass
         self.fuse_grad_size_in_num = 32      # allreduce bucket size (ops)
         self.fuse_elewise_add_act_ops = False  # -> fuse_elewise_add_act
         self.fuse_bn_act_ops = False           # -> fuse_bn_act
+        # Pallas kernel tier (fluid/passes/kernel_tier.py,
+        # docs/performance.md "Custom kernel tier"): pattern-rewrite the
+        # naive attention chain onto fused_multihead_attention (flash
+        # kernel on TPU), lookup_table+pool chains onto
+        # fused_embedding_pool (fused gather/scatter-add), and runs of
+        # per-param adam/lamb/momentum updates onto one fused bucket
+        # update.  kernel_tier=True is the umbrella for all three.
+        self.kernel_tier = False
+        self.fuse_attention = False            # -> fuse_attention
+        self.fuse_sparse_embedding = False     # -> fuse_sparse_embedding
+        self.fuse_optimizer = False            # -> fuse_optimizer
         self.enable_dce = False                # -> dce pass (fetch-seeded)
         self.constant_folding = False          # -> constant_fold pass
         # bf16 mixed precision as a compiler plane (passes/amp.py):
@@ -165,7 +178,8 @@ class CompiledProgram:
                 [str(n) for n in fetch_names]
         _t0 = trace.now() if trace.enabled() else 0
         stats = pipe.apply(self._program, targets=fetch_names,
-                           build_strategy=self._build_strategy)
+                           build_strategy=self._build_strategy,
+                           sharding_plan=self._sharding_plan)
         if _t0:
             trace.complete("compiler::apply_ir_passes", _t0, cat="compile",
                            args={p: dict(s) for p, s in stats.items()})

@@ -238,6 +238,15 @@ _FLAGS: Dict[str, object] = {
     # how many trailing trace events a bundle embeds
     "diagnostic_trace_tail": int(_os.environ.get(
         "FLAGS_diagnostic_trace_tail", "5000") or 5000),
+    # kernel tier (fluid/passes/kernel_tier.py, ops/attention.py): minimum
+    # sequence length before attention dispatches to the Pallas flash
+    # kernel.  Default 1024 — measured on the round-3 BERT sweep: at seq
+    # 512 the flash kernel loses end-to-end (23.4% vs 34.8% MFU) because
+    # XLA's softmax(QK^T)V fusion is still near-roofline there; the knob
+    # lets bench.py/tpu_watch sweep the real crossover per chip and the
+    # future auto-tuner (ROADMAP item 5) own the value.
+    "pallas_min_seq": int(_os.environ.get(
+        "FLAGS_pallas_min_seq", "1024") or 1024),
 }
 
 

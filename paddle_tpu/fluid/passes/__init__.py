@@ -17,10 +17,13 @@ from .graphviz import program_to_dot, dump_program
 from . import builtin  # registers the built-in pass catalog
 from . import amp      # registers amp_bf16 + prune_redundant_casts
 from . import inference as inference_preset  # registers fold_batch_norm
+from . import kernel_tier  # registers the Pallas kernel-tier passes
 from .builtin import passes_for_build_strategy
 from .amp import AmpBf16Pass, PruneRedundantCastsPass
 from .inference import (FoldBatchNormPass, inference_passes,
                         INFERENCE_PASS_NAMES)
+from .kernel_tier import (FuseAttentionPass, FuseSparseEmbeddingPass,
+                          FuseOptimizerPass)
 
 __all__ = [
     "Pass", "PassContext", "PassRegistry", "PassPipeline",
@@ -29,4 +32,5 @@ __all__ = [
     "program_to_dot", "dump_program", "passes_for_build_strategy",
     "AmpBf16Pass", "PruneRedundantCastsPass",
     "FoldBatchNormPass", "inference_passes", "INFERENCE_PASS_NAMES",
+    "FuseAttentionPass", "FuseSparseEmbeddingPass", "FuseOptimizerPass",
 ]

@@ -653,13 +653,17 @@ class ShardCollectivesPass(Pass):
 
 def passes_for_build_strategy(build_strategy) -> List[Pass]:
     """Instantiate the pass list a BuildStrategy's knobs select, in the
-    canonical order: fold -> fuse -> clean -> amp -> dce -> coalesce.
-    AMP runs after the fusions (the fused ops are gray — they follow
-    their bf16 inputs) and before DCE (which sweeps the cast orphans the
-    redundancy pruner leaves)."""
+    canonical order: fold -> fuse -> kernel_tier -> clean -> amp -> dce
+    -> coalesce.  The kernel tier runs after the pairwise fusions (they
+    never overlap its chains) and before AMP (the fused attention op is
+    white-listed MXU compute, so the bf16 rewrite sees ONE op instead of
+    the six-op chain); AMP runs before DCE (which sweeps the cast
+    orphans the redundancy pruner leaves)."""
     from . import amp as _amp  # noqa: F401 — registers the AMP passes
+    from . import kernel_tier as _kt  # noqa: F401 — registers the tier
     bs = build_strategy
     mem = bool(getattr(bs, "memory_optimize", None))
+    tier = bool(getattr(bs, "kernel_tier", False))
     specs = []
     if getattr(bs, "constant_folding", False) or mem:
         specs.append(("constant_fold", {}))
@@ -667,6 +671,13 @@ def passes_for_build_strategy(build_strategy) -> List[Pass]:
         specs.append(("fuse_elewise_add_act", {}))
     if getattr(bs, "fuse_bn_act_ops", False):
         specs.append(("fuse_bn_act", {}))
+    if tier or getattr(bs, "fuse_attention", False):
+        specs.append(("fuse_attention", {}))
+    if tier or getattr(bs, "fuse_sparse_embedding", False):
+        specs.append(("fuse_sparse_embedding", {}))
+    if tier or getattr(bs, "fuse_optimizer", False) \
+            or getattr(bs, "fuse_all_optimizer_ops", False):
+        specs.append(("fuse_optimizer", {}))
     if mem:
         specs.append(("prune_identity", {}))
     if getattr(bs, "amp", False):

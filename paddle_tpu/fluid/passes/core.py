@@ -46,10 +46,14 @@ class PassContext:
     """
 
     def __init__(self, program, targets: Sequence[str] = (),
-                 build_strategy=None):
+                 build_strategy=None, sharding_plan=None):
         self.program = program
         self.targets = [str(t) for t in (targets or ())]
         self.build_strategy = build_strategy
+        # the resolved PR-10 ShardingPlan when the pipeline runs under a
+        # sharded CompiledProgram (run() ensures the plan BEFORE the
+        # passes) — spec-aware passes (fuse_optimizer) group by it
+        self.sharding_plan = sharding_plan
         self.stats: Dict[str, Dict[str, int]] = {}
 
     def is_protected(self, block, name: str) -> bool:
@@ -169,10 +173,12 @@ class PassPipeline:
             self.graphviz_path, f"{stage:02d}_{label}.dot"))
 
     def apply(self, program, targets: Sequence[str] = (),
-              build_strategy=None) -> Dict[str, Dict[str, int]]:
+              build_strategy=None,
+              sharding_plan=None) -> Dict[str, Dict[str, int]]:
         """Run every pass over ``program``; returns {pass: stats}."""
         ctx = PassContext(program, targets=targets,
-                          build_strategy=build_strategy)
+                          build_strategy=build_strategy,
+                          sharding_plan=sharding_plan)
         self._dump(program, 0, "input")
         tr_on = trace.enabled()
         for i, p in enumerate(self.passes):

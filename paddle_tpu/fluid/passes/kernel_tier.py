@@ -1,0 +1,516 @@
+"""The Pallas kernel tier as compiler passes.
+
+Reference: the qingshui/PaddleBox fork's identity is its fused ads/CTR
+operators (PAPER.md: ``operators/fused/``, ``multihead_matmul_op.cu``,
+``bert_encoder_functor.cu``, ``fused_embedding_seq_pool_op.cc``,
+``framework/ir/fuse_optimizer_ops_pass/``).  The seed shipped the KERNELS
+half of that story — ``ops/pallas_kernels.py`` behind the
+``fused_multihead_attention`` / ``fused_embedding_pool`` / ``fused_*``
+op boundaries — but nothing in the compiler ever *produced* those ops: a
+BERT program built from plain matmul/softmax layers lowered op-by-op.
+These three pattern-rewrite passes close the gap the same way PR 3/PR 5
+did for fusion and AMP: any existing program gets the kernels without
+touching model code.
+
+* ``fuse_attention`` — the naive attention chain matmul(Q,Kᵀ) → scale →
+  (+mask) → softmax → (dropout) → matmul(·,V), including the paired
+  ``generic_grad`` ops of training programs, rewrites to ONE
+  ``fused_multihead_attention`` op (+ one fused generic_grad).  The
+  lowering dispatches to the Pallas flash kernel on TPU
+  (``FLAGS_pallas_min_seq`` crossover, additive-bias masks ride the
+  kernel's ``ab`` argument) and the XLA-fused reference elsewhere; an
+  absorbed dropout op's seed is stamped into the fused op so the XLA
+  path regenerates the identical mask.
+* ``fuse_sparse_embedding`` — the CTR hot path
+  ``lookup_table[_v2]`` (+ ``sequence_pool``/``reduce_sum(dim=1)``)
+  rewrites to ``fused_embedding_pool``: Pallas fused gather+pool forward
+  with a fused scatter-add (segment-sum) backward, XLA take/masked-sum
+  fallback mirroring the unfused chain.
+* ``fuse_optimizer`` — consecutive same-(family, dtype, attrs, lr,
+  PartitionSpec-group) ``adam``/``lamb``/``momentum`` update ops bucket
+  into one ``fused_adam``/``fused_lamb``/``fused_momentum`` op: one
+  launch per bucket over a flattened param buffer, element-for-element
+  the same arithmetic (bit-compares against per-param updates), PR-5
+  MasterParam slots carried through, and — under a PR-10 sharding plan —
+  bucketing only within identical-spec groups so the whole-step pjit
+  path never pays a reshard.
+
+Every pass counts ``kernel_tier.<pass>.rewrites``; wiring is the
+``BuildStrategy.fuse_attention`` / ``fuse_sparse_embedding`` /
+``fuse_optimizer`` knobs plus the ``kernel_tier`` umbrella, appended by
+``passes_for_build_strategy`` after the pairwise fusions and before AMP
+(docs/passes.md).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import trace
+from ..framework import Operator, _op_reads
+from .core import Pass, PassContext, register_pass
+from .pattern import Pattern, PatternRewritePass, writer_index as _widx
+
+__all__ = ["FuseAttentionPass", "FuseSparseEmbeddingPass",
+           "FuseOptimizerPass"]
+
+
+def _consumers(block, name: str) -> List[Operator]:
+    return [op for op in block.ops if name in _op_reads(block, op)]
+
+
+def _internal_edge(block, ctx: PassContext, name: str, allowed_ops) -> bool:
+    """A var the rewrite deletes must be a purely internal edge: written
+    once, not protected, consumed only by the ops being fused."""
+    if ctx.is_protected(block, name):
+        return False
+    if len(_widx(block, name)) != 1:
+        return False
+    allowed = {id(o) for o in allowed_ops}
+    return all(id(c) in allowed for c in _consumers(block, name))
+
+
+def _ndim(block, name: str) -> Optional[int]:
+    v = block._find_var_recursive(name)
+    if v is None or v.shape is None:
+        return None
+    return len(v.shape)
+
+
+def _splice(block, new_op: Operator, anchor: Operator, dead) -> None:
+    """Insert ``new_op`` right after ``anchor``, remove the ``dead`` ops —
+    all through the version-bumping mutators."""
+    block._insert_op_obj(block.ops.index(anchor) + 1, new_op)
+    for op in dead:
+        block._remove_op(block.ops.index(op))
+
+
+def _count_rewrite(pass_name: str) -> None:
+    trace.metrics().counter(f"kernel_tier.{pass_name}.rewrites").inc()
+
+
+# ---------------------------------------------------------------------------
+# fuse_attention
+# ---------------------------------------------------------------------------
+
+def _falsy(v) -> bool:
+    return not v
+
+
+def _truthy(v) -> bool:
+    return bool(v)
+
+
+@register_pass
+class FuseAttentionPass(PatternRewritePass):
+    """matmul(Q,Kᵀ) → [scale] → [+mask] → softmax → [dropout] → matmul(·,V)
+    ⇒ ``fused_multihead_attention`` (forward AND the paired generic_grad
+    chain in training programs).  Patterns are generated for every
+    optional-op combination, training variants first and longer chains
+    before their own sub-chains, so a complete chain always wins."""
+
+    name = "fuse_attention"
+
+    def __init__(self, **options):
+        super().__init__(**options)
+        for train in (True, False):
+            for with_drop in (True, False):
+                for with_mask in (True, False):
+                    for with_scale in (True, False):
+                        self.rules.append(self._rule(
+                            train, with_scale, with_mask, with_drop))
+
+    # -- pattern construction ----------------------------------------------
+    def _rule(self, train, with_scale, with_mask, with_drop):
+        p = Pattern(f"attention_{'train' if train else 'fwd'}"
+                    f"_s{int(with_scale)}m{int(with_mask)}d{int(with_drop)}")
+        q, k, v, out = p.vars("q k v out")
+        scores = [p.var("s0")]            # score-var chain, program order
+        p.op("matmul", ins={"X": [q], "Y": [k]}, outs={"Out": [scores[-1]]},
+             attrs={"transpose_X": _falsy, "transpose_Y": _truthy})
+        if with_scale:
+            scores.append(p.var("s1"))
+            p.op("scale", ins={"X": [scores[-2]]},
+                 outs={"Out": [scores[-1]]},
+                 attrs={"bias": _falsy})
+        if with_mask:
+            scores.append(p.var("s2"))
+            # only the trailing-broadcast, unscaled spelling: a Paddle
+            # leading-dim axis or a post-add scale multiplier is not what
+            # the fused lowering's `s + mask` computes
+            p.op("elementwise_add",
+                 ins={"X": [scores[-2]], "Y": [p.var("mask")]},
+                 outs={"Out": [scores[-1]]},
+                 attrs={"axis": lambda a: a in (None, -1),
+                        "scale": lambda sc: sc is None
+                        or float(sc) == 1.0})
+        probs = [p.var("p0")]
+        p.op("softmax", ins={"X": [scores[-1]]}, outs={"Out": [probs[-1]]},
+             attrs={"axis": lambda a: a in (None, -1, 3)})
+        if with_drop:
+            probs.append(p.var("p1"))
+            p.op("dropout", ins={"X": [probs[-2]]},
+                 outs={"Out": [probs[-1]]})
+        p.op("matmul", ins={"X": [probs[-1]], "Y": [v]},
+             outs={"Out": [out]},
+             attrs={"transpose_X": _falsy, "transpose_Y": _falsy,
+                    "alpha": lambda a: a is None or float(a) == 1.0})
+        if train:
+            # grads in reverse forward order (append_backward layout)
+            p.op("generic_grad",
+                 ins={"I_X": [probs[-1]], "I_Y": [v], "G_Out": [p.var("go")]},
+                 outs={"GI_X": [p.var("gp")], "GI_Y": [p.var("gv")]},
+                 attrs={"fwd_type": "matmul"})
+            g_cur = p.var("gp")
+            if with_drop:
+                p.op("generic_grad",
+                     ins={"I_X": [probs[-2]], "G_Out": [g_cur]},
+                     outs={"GI_X": [p.var("gp0")]},
+                     attrs={"fwd_type": "dropout"})
+                g_cur = p.var("gp0")
+            p.op("generic_grad", ins={"I_X": [scores[-1]], "G_Out": [g_cur]},
+                 outs={"GI_X": [p.var("gsm")]},
+                 attrs={"fwd_type": "softmax"})
+            g_cur = p.var("gsm")
+            if with_mask:
+                p.op("generic_grad",
+                     ins={"I_X": [scores[-2]], "G_Out": [g_cur]},
+                     outs={"GI_X": [p.var("gadd")]},
+                     attrs={"fwd_type": "elementwise_add"})
+                g_cur = p.var("gadd")
+            if with_scale:
+                p.op("generic_grad",
+                     ins={"I_X": [scores[0]], "G_Out": [g_cur]},
+                     outs={"GI_X": [p.var("gsc")]},
+                     attrs={"fwd_type": "scale"})
+                g_cur = p.var("gsc")
+            p.op("generic_grad",
+                 ins={"I_X": [q], "I_Y": [k], "G_Out": [g_cur]},
+                 outs={"GI_X": [p.var("gq")], "GI_Y": [p.var("gk")]},
+                 attrs={"fwd_type": "matmul"})
+
+        def rewrite(m, ctx, _flags=(train, with_scale, with_mask,
+                                    with_drop)):
+            return self._rewrite(m, ctx, *_flags)
+
+        return (p, rewrite)
+
+    # -- rewrite ------------------------------------------------------------
+    def _rewrite(self, m, ctx, train, with_scale, with_mask,
+                 with_drop) -> bool:
+        block = m.block
+        n_fwd = 3 + int(with_scale) + int(with_mask) + int(with_drop)
+        fwd_ops, grad_ops = m.ops[:n_fwd], m.ops[n_fwd:]
+        mm2 = fwd_ops[-1]
+        drop_op = fwd_ops[-2] if with_drop else None
+        # the naive chain operates on [B, H, T, T] scores — require the
+        # 4-d shape the fused op's lowering assumes.  Unknown shapes stay
+        # on the op-by-op path (conservative: never mis-fuse an mlp's
+        # matmul→softmax→matmul into an attention kernel).
+        for name in (m.var("q"), m.var("k"), m.var("v"), m.var("out")):
+            if _ndim(block, name) != 4:
+                return False
+        # internal edges: every intermediate score/prob var dies with the
+        # rewrite, so it must have no consumer outside the matched ops
+        inter = [m.binding[n] for n in
+                 ("s0", "s1", "s2", "p0", "p1") if n in m.binding]
+        allowed = fwd_ops + grad_ops
+        for t in inter:
+            if not _internal_edge(block, ctx, t, allowed):
+                return False
+        if len(_widx(block, m.var("out"))) != 1:
+            return False
+        for name in (m.var("q"), m.var("k"), m.var("v")):
+            if len(_widx(block, name)) > 1:
+                return False
+        if drop_op is not None:
+            mask_out = (drop_op.outputs.get("Mask") or [None])[0]
+            if mask_out and _consumers(block, mask_out):
+                return False
+        if train:
+            # grad chain intermediates are internal too, and the mask must
+            # not itself require a gradient (the fused op cannot emit one)
+            ginter = [m.binding[n] for n in
+                      ("gp", "gp0", "gsm", "gadd", "gsc") if n in m.binding]
+            for t in ginter:
+                if not _internal_edge(block, ctx, t, allowed):
+                    return False
+            for n in ("gq", "gk", "gv"):
+                if len(_widx(block, m.var(n))) != 1:
+                    return False
+            if with_mask:
+                add_g = next(o for o in grad_ops
+                             if o.attrs.get("fwd_type") == "elementwise_add")
+                if add_g.outputs.get("GI_Y"):
+                    return False
+
+        scale = float(fwd_ops[0].attrs.get("alpha", 1.0) or 1.0)
+        if with_scale:
+            scale *= float(fwd_ops[1].attrs.get("scale", 1.0))
+        attrs = {"scale": scale, "causal": False,
+                 "op_role": fwd_ops[0].attrs.get("op_role", 0)}
+        if drop_op is not None:
+            attrs.update(
+                dropout_rate=float(drop_op.attrs.get("dropout_prob", 0.5)),
+                dropout_seed=int(drop_op.attrs.get(
+                    "op_seed", drop_op.attrs.get("seed", 0) or 0)),
+                dropout_implementation=drop_op.attrs.get(
+                    "dropout_implementation", "downgrade_in_infer"),
+                dropout_is_test=bool(drop_op.attrs.get("is_test", False)))
+        ins = {"Q": [m.var("q")], "K": [m.var("k")], "V": [m.var("v")]}
+        in_slots = ["Q", "K", "V"]
+        if with_mask:
+            ins["Mask"] = [m.var("mask")]
+            in_slots.append("Mask")
+        fused = Operator(block, "fused_multihead_attention", ins,
+                         {"Out": [m.var("out")]}, attrs)
+        if train:
+            g_ins = {"I_" + s: list(ins[s]) for s in in_slots}
+            g_ins["G_Out"] = [m.var("go")]
+            fused_g = Operator(
+                block, "generic_grad", g_ins,
+                {"GI_Q": [m.var("gq")], "GI_K": [m.var("gk")],
+                 "GI_V": [m.var("gv")]},
+                {"fwd_type": "fused_multihead_attention",
+                 "fwd_attrs": dict(attrs), "in_slots": list(in_slots),
+                 "grad_slots": ["Q", "K", "V"], "op_role": 1})
+            _splice(block, fused_g, grad_ops[0], grad_ops)
+        _splice(block, fused, mm2, fwd_ops)
+        _count_rewrite(self.name)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# fuse_sparse_embedding
+# ---------------------------------------------------------------------------
+
+_LOOKUPS = ("lookup_table_v2", "lookup_table")
+
+
+@register_pass
+class FuseSparseEmbeddingPass(PatternRewritePass):
+    """``lookup_table[_v2]`` + (``sequence_pool``(SUM/AVERAGE) |
+    ``reduce_sum(dim=[1])``) ⇒ ``fused_embedding_pool`` — the PaddleBox
+    fused_embedding_seq_pool path.  Training programs collapse the two
+    generic_grad ops into one whose backward is the fused scatter-add."""
+
+    name = "fuse_sparse_embedding"
+
+    def __init__(self, **options):
+        super().__init__(**options)
+        for train in (True, False):
+            for pool_kind in ("sequence_pool", "reduce_sum"):
+                self.rules.append(self._rule(train, pool_kind))
+
+    def _rule(self, train, pool_kind):
+        p = Pattern(f"emb_pool_{pool_kind}_{'train' if train else 'fwd'}")
+        w, ids, e, out = p.vars("w ids e out")
+        p.op(_LOOKUPS, ins={"W": [w], "Ids": [ids]}, outs={"Out": [e]})
+        if pool_kind == "sequence_pool":
+            p.op("sequence_pool", ins={"X": [e]}, outs={"Out": [out]},
+                 attrs={"pooltype": lambda t: str(t).upper()
+                        in ("SUM", "AVERAGE")})
+        else:
+            p.op("reduce_sum", ins={"X": [e]}, outs={"Out": [out]},
+                 attrs={"dim": lambda d: list(d or ()) == [1],
+                        "keep_dim": _falsy, "reduce_all": _falsy})
+        if train:
+            p.op("generic_grad", ins={"I_X": [e], "G_Out": [p.var("g")]},
+                 outs={"GI_X": [p.var("ge")]},
+                 attrs={"fwd_type": pool_kind})
+            p.op("generic_grad", ins={"I_W": [w], "G_Out": [p.var("ge")]},
+                 outs={"GI_W": [p.var("gw")]},
+                 attrs={"fwd_type": lambda t: t in _LOOKUPS})
+
+        def rewrite(m, ctx, _flags=(train, pool_kind)):
+            return self._rewrite(m, ctx, *_flags)
+
+        return (p, rewrite)
+
+    def _rewrite(self, m, ctx, train, pool_kind) -> bool:
+        block = m.block
+        lookup, pool = m.ops[0], m.ops[1]
+        grad_ops = m.ops[2:]
+        # the gathered [B, S, D] intermediate dies with the rewrite
+        if not _internal_edge(block, ctx, m.var("e"), m.ops):
+            return False
+        nd = _ndim(block, m.var("e"))
+        if nd is not None and nd != 3:
+            return False
+        if nd is None and pool_kind == "reduce_sum":
+            return False          # reduce_sum(dim=1) is only a pool on 3-d
+        if len(_widx(block, m.var("out"))) != 1:
+            return False
+        # side outputs of the pooled op (MaxIndex) must be unconsumed
+        for slot, names in pool.outputs.items():
+            if slot == "Out":
+                continue
+            for n in names:
+                if _consumers(block, n):
+                    return False
+        if train:
+            if not _internal_edge(block, ctx, m.var("ge"), m.ops):
+                return False
+            if len(_widx(block, m.var("gw"))) != 1:
+                return False
+
+        attrs = {"pooltype": str(pool.attrs.get("pooltype", "SUM")).upper()
+                 if pool_kind == "sequence_pool" else "SUM",
+                 "padding_idx": lookup.attrs.get("padding_idx", -1),
+                 "squeeze_ids": lookup.type == "lookup_table",
+                 "op_role": lookup.attrs.get("op_role", 0)}
+        ins = {"W": [m.var("w")], "Ids": [m.var("ids")]}
+        in_slots = ["W", "Ids"]
+        length = (pool.inputs.get("Length") or [None])[0] \
+            if pool_kind == "sequence_pool" else None
+        if length is not None:
+            ins["Length"] = [length]
+            in_slots.append("Length")
+        fused = Operator(block, "fused_embedding_pool", ins,
+                         {"Out": [m.var("out")]}, attrs)
+        if train:
+            g_ins = {"I_" + s: list(ins[s]) for s in in_slots}
+            g_ins["G_Out"] = [m.var("g")]
+            fused_g = Operator(
+                block, "generic_grad", g_ins, {"GI_W": [m.var("gw")]},
+                {"fwd_type": "fused_embedding_pool",
+                 "fwd_attrs": dict(attrs), "in_slots": list(in_slots),
+                 "grad_slots": ["W"], "op_role": 1})
+            _splice(block, fused_g, grad_ops[0], grad_ops)
+        _splice(block, fused, pool, [lookup, pool])
+        _count_rewrite(self.name)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# fuse_optimizer
+# ---------------------------------------------------------------------------
+
+_FUSABLE_UPDATES: Dict[str, Dict] = {
+    "adam": {"fused": "fused_adam",
+             "ins": frozenset({"Param", "Grad", "Moment1", "Moment2",
+                               "Beta1Pow", "Beta2Pow", "LearningRate"}),
+             "outs": ("ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut")},
+    "lamb": {"fused": "fused_lamb",
+             "ins": frozenset({"Param", "Grad", "Moment1", "Moment2",
+                               "Beta1Pow", "Beta2Pow", "LearningRate"}),
+             "outs": ("ParamOut", "Moment1Out", "Moment2Out",
+                      "Beta1PowOut", "Beta2PowOut")},
+    "momentum": {"fused": "fused_momentum",
+                 "ins": frozenset({"Param", "Grad", "Velocity",
+                                   "LearningRate"}),
+                 "outs": ("ParamOut", "VelocityOut")},
+}
+
+_SHARED_SLOTS = ("LearningRate",)
+
+
+@register_pass
+class FuseOptimizerPass(Pass):
+    """Bucket consecutive same-family per-param update ops into one fused
+    update op (fuse_adam_op_pass / fuse_momentum_op_pass semantics).  The
+    bucket key is (op type, param dtype, multi-precision, the lr var, the
+    full attr set, and — when a PR-10 sharding plan is live — the param's
+    resolved PartitionSpec), so a bucket is always homogeneous: one
+    flattened buffer, one launch, zero implied reshards under pjit."""
+
+    name = "fuse_optimizer"
+
+    def __init__(self, bucket_size: int = 1024, **options):
+        super().__init__(**options)
+        self.bucket_size = max(int(bucket_size), 2)
+
+    # -- bucket keying ------------------------------------------------------
+    def _spec_group(self, block, ctx: PassContext, param: str) -> str:
+        plan = getattr(ctx, "sharding_plan", None)
+        if plan is None:
+            return ""
+        v = block._find_var_recursive(param)
+        if v is None or v.shape is None:
+            return f"?{param}"     # unknown shape: never buckets
+        try:
+            return repr(plan.spec_for(param, tuple(v.shape)))
+        except Exception:          # noqa: BLE001 — never block the rewrite
+            return f"?{param}"
+
+    def _key(self, block, ctx: PassContext, op) -> Optional[tuple]:
+        spec = _FUSABLE_UPDATES.get(op.type)
+        if spec is None:
+            return None
+        slots = set(op.inputs)
+        has_master = "MasterParam" in slots
+        want = spec["ins"] | ({"MasterParam"} if has_master else set())
+        if slots != want:
+            return None            # SkipUpdate or exotic wiring: leave it
+        if any(len(names) != 1 for names in op.inputs.values()):
+            return None
+        param = op.inputs["Param"][0]
+        v = block._find_var_recursive(param)
+        dtype = v.dtype if v is not None else None
+        attr_sig = tuple(sorted((k, repr(val)) for k, val in op.attrs.items()
+                                if k not in ("op_role", "op_seed")))
+        return (op.type, str(dtype), has_master,
+                op.inputs["LearningRate"][0], attr_sig,
+                self._spec_group(block, ctx, param))
+
+    # -- rewriting ----------------------------------------------------------
+    def _fuse_run(self, block, seg, out_ops) -> int:
+        """Fuse one same-key run; returns the number of ops removed."""
+        if len(seg) < 2:
+            out_ops.extend(seg)
+            return 0
+        spec = _FUSABLE_UPDATES[seg[0].type]
+        # per-param vars must be pairwise disjoint (params shared between
+        # two update ops would race inside one fused op)
+        per_param = [n for op in seg for slot, names in op.inputs.items()
+                     if slot not in _SHARED_SLOTS for n in names]
+        if len(set(per_param)) != len(per_param):
+            out_ops.extend(seg)
+            return 0
+        removed = 0
+        for lo in range(0, len(seg), self.bucket_size):
+            chunk = seg[lo:lo + self.bucket_size]
+            if len(chunk) < 2:
+                out_ops.extend(chunk)
+                continue
+            ins = {slot: [op.inputs[slot][0] for op in chunk]
+                   for slot in chunk[0].inputs if slot not in _SHARED_SLOTS}
+            ins["LearningRate"] = list(chunk[0].inputs["LearningRate"])
+            out_slots = list(spec["outs"])
+            if "MasterParam" in chunk[0].inputs:
+                out_slots.append("MasterParamOut")
+            outs = {slot: [op.outputs[slot][0] for op in chunk]
+                    for slot in out_slots}
+            out_ops.append(Operator(
+                block, spec["fused"], ins, outs, dict(chunk[0].attrs)))
+            removed += len(chunk) - 1
+            _count_rewrite(self.name)
+        return removed
+
+    def apply_block(self, block, ctx: PassContext) -> Dict[str, int]:
+        out_ops: list = []
+        seg: list = []
+        seg_key = None
+        removed = 0
+
+        def flush():
+            nonlocal removed
+            if seg:
+                removed += self._fuse_run(block, seg, out_ops)
+                seg.clear()
+
+        for op in block.ops:
+            key = self._key(block, ctx, op)
+            if key is not None:
+                if seg and key != seg_key:
+                    flush()
+                seg_key = key
+                seg.append(op)
+            else:
+                flush()
+                out_ops.append(op)
+        flush()
+        if removed:
+            block.ops = out_ops
+            block.program._bump_version()
+        return {"ops_removed": removed}

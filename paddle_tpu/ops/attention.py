@@ -5,27 +5,45 @@ transformer attention) and math/bert_encoder_functor.cu (SURVEY §2.5 fused/).
 TPU-native: one `fused_multihead_attention` op whose lowering is (a) a Pallas
 flash-attention kernel on TPU for long sequences (pallas_kernels.py), or
 (b) an XLA-fused softmax(QK^T)V otherwise.  The op boundary is what enables
-kernel substitution without touching model code.
+kernel substitution without touching model code — and since the kernel tier
+landed (fluid/passes/kernel_tier.py), the `fuse_attention` pass PRODUCES
+this op from the naive matmul→softmax→matmul chain, so plain static
+programs get the kernel too.
 """
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 
 from .registry import register_op
 
-_PALLAS_MIN_SEQ = 1024     # below this XLA fusion is already near-roofline
-                           # (measured: at seq512 the flash kernel LOSES
-                           # end-to-end — 23.4% vs 34.8% MFU on the BERT
-                           # sweep — despite winning a fwd+bwd microbench;
-                           # only enable where the [B,H,T,T] score tensor
-                           # actually blows past fusion scale)
+_PALLAS_MIN_SEQ_DEFAULT = 1024
+# Crossover rationale (measured, BERT sweep round 3): below ~1024 the XLA
+# softmax(QK^T)V fusion is already near-roofline — at seq 512 the flash
+# kernel LOSES end-to-end (23.4% vs 34.8% MFU) despite winning a fwd+bwd
+# microbench, because the [B,H,T,T] score tensor still fits fusion scale
+# and the kernel's block bookkeeping is pure overhead.  Only above the
+# crossover does streaming K/V blocks through VMEM pay.  The knob
+# (FLAGS_pallas_min_seq) exists so bench.py/tpu_watch can sweep the real
+# crossover per chip generation and the future auto-tuner (ROADMAP item 5)
+# can own the value instead of this constant.
 
 
-def _reference_attention(q, k, v, mask, scale, causal):
+def _pallas_min_seq() -> int:
+    """Runtime crossover knob: FLAGS_pallas_min_seq (default 1024)."""
+    try:
+        from ..fluid import core
+        v = core.get_flag("pallas_min_seq", _PALLAS_MIN_SEQ_DEFAULT)
+        return int(v) if v is not None else _PALLAS_MIN_SEQ_DEFAULT
+    except Exception:               # noqa: BLE001 — dispatch must not die
+        return _PALLAS_MIN_SEQ_DEFAULT
+
+
+def _reference_attention(q, k, v, mask, scale, causal,
+                         dropout_rate=0.0, dropout_key=None,
+                         dropout_upscale=True, prob_scale=None):
     # q,k,v: [B, H, T, D]
     acc = jnp.float32
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
@@ -38,31 +56,92 @@ def _reference_attention(q, k, v, mask, scale, causal):
     if mask is not None:
         s = s + mask.astype(acc)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    # attention dropout ON THE PROBABILITIES, spelled exactly like the
+    # standalone dropout lowering (ops/nn_ops.py) so a kernel-tier rewrite
+    # that absorbed a dropout op reproduces the identical mask from the
+    # identical key — CPU-fallback parity is bit-level, not just allclose
+    if dropout_rate and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_rate, p.shape)
+        if dropout_upscale:
+            p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0).astype(p.dtype)
+        else:
+            p = jnp.where(keep, p, 0.0).astype(p.dtype)
+    elif prob_scale is not None:
+        # downgrade_in_infer at test time: probs scaled by (1 - rate)
+        p = (p * p.dtype.type(prob_scale)).astype(p.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
-def flash_attention(q, k, v, mask=None, scale=None, causal=False):
-    """Dispatch to the Pallas TPU kernel when profitable, else XLA."""
+def _bias_broadcastable(mask, q, k) -> bool:
+    """Can ``mask`` serve as the Pallas kernel's additive-bias ``ab``
+    argument — i.e. broadcast to [B, H, Tq, Tk]?"""
+    if mask is None or mask.ndim != 4:
+        return False
+    target = (q.shape[0], q.shape[1], q.shape[2], k.shape[2])
+    return all(m == 1 or m == t for m, t in zip(mask.shape, target))
+
+
+def flash_attention(q, k, v, mask=None, scale=None, causal=False,
+                    dropout_rate=0.0, dropout_key=None,
+                    dropout_upscale=True, prob_scale=None):
+    """Dispatch to the Pallas TPU kernel when profitable, else XLA.
+
+    The Pallas path handles additive-bias masks via the kernel's ``ab``
+    argument (anything broadcastable to [B, H, Tq, Tk]); genuinely
+    unsupported mask shapes and active attention dropout fall back to the
+    XLA reference (the jax flash kernel has no in-kernel prob dropout).
+    """
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     seq = q.shape[-2]
     on_tpu = jax.default_backend() not in ("cpu",)
-    if on_tpu and seq >= _PALLAS_MIN_SEQ and mask is None:
+    drop_active = bool(dropout_rate) and dropout_key is not None
+    if on_tpu and seq >= _pallas_min_seq() and not drop_active \
+            and prob_scale is None and scale != 0.0 \
+            and (mask is None or _bias_broadcastable(mask, q, k)):
         try:
             from .pallas_kernels import flash_attention_tpu
         except ImportError:
             flash_attention_tpu = None
         if flash_attention_tpu is not None:
-            return flash_attention_tpu(q, k, v, scale=scale, causal=causal)
-    return _reference_attention(q, k, v, mask, scale, causal)
+            ab = None
+            if mask is not None:
+                # the Pallas kernel computes softmax((QKᵀ + ab)·scale);
+                # our contract is softmax(QKᵀ·scale + mask), so the bias
+                # rides in pre-divided by the scale
+                ab = (jnp.broadcast_to(
+                    mask, (q.shape[0], q.shape[1], q.shape[2], k.shape[2])
+                ).astype(jnp.float32) / scale).astype(q.dtype)
+            return flash_attention_tpu(q, k, v, scale=scale, causal=causal,
+                                       ab=ab)
+    return _reference_attention(q, k, v, mask, scale, causal,
+                                dropout_rate if drop_active else 0.0,
+                                dropout_key, dropout_upscale, prob_scale)
 
 
 @register_op("fused_multihead_attention", nondiff_inputs=("Mask",))
 def _fused_mha(ins, attrs, ctx):
     q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
     mask = ins["Mask"][0] if ins.get("Mask") else None
+    # attention-dropout attrs stamped by the fuse_attention pass when it
+    # absorbs a dropout op: same op_seed -> same ctx key -> same mask as
+    # the unrewritten program on the XLA path
+    rate = float(attrs.get("dropout_rate", 0.0) or 0.0)
+    dropout_key = None
+    prob_scale = None
+    upscale = attrs.get("dropout_implementation",
+                        "downgrade_in_infer") == "upscale_in_train"
+    if rate:
+        is_test = attrs.get("dropout_is_test", False) or ctx.is_test
+        if is_test:
+            if not upscale:
+                prob_scale = 1.0 - rate
+        else:
+            dropout_key = ctx.key_for(attrs.get("dropout_seed", 0))
     out = flash_attention(q, k, v, mask,
                           scale=attrs.get("scale", None),
-                          causal=attrs.get("causal", False))
+                          causal=attrs.get("causal", False),
+                          dropout_rate=rate, dropout_key=dropout_key,
+                          dropout_upscale=upscale, prob_scale=prob_scale)
     return {"Out": [out]}
 
 

@@ -36,6 +36,81 @@ def _cvm_fwd(x, use_cvm):
     return x[:, 2:]
 
 
+# --- fused sparse embedding: gather + pool in one op ------------------------
+# Reference: operators/fused/fused_embedding_seq_pool_op.cc (the PaddleBox
+# CTR hot path).  Produced by the kernel-tier fuse_sparse_embedding pass
+# (fluid/passes/kernel_tier.py) from lookup_table(+sequence_pool/reduce_sum)
+# chains; on TPU the lowering is the Pallas fused gather+pool kernel with a
+# fused scatter-add (segment-sum) gradient (ops/pallas_kernels.py), on CPU
+# an XLA take + masked sum that mirrors the unfused chain bit-for-bit.
+
+def _emb_pool_prep(ins, attrs):
+    """(w, ids, wgt, denom-applied weights): the per-(row, position)
+    contribution weight folds padding_idx zeroing, the Length mask, and
+    mean-pool division into one [B, S] tensor."""
+    w, ids = _x(ins, "W"), _x(ins, "Ids").astype(jnp.int32)
+    if attrs.get("squeeze_ids") and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])     # lookup_table [.., 1] squeeze
+    b, s = ids.shape
+    pool = str(attrs.get("pooltype", "SUM")).upper()
+    padding_idx = attrs.get("padding_idx", -1)
+    length = ins["Length"][0] if ins.get("Length") else None
+    if length is not None:
+        wgt = (jnp.arange(s)[None, :]
+               < length.reshape(-1, 1)).astype(w.dtype)
+        denom = jnp.maximum(length.reshape(-1, 1).astype(w.dtype), 1)
+    else:
+        wgt = jnp.ones((b, s), w.dtype)
+        denom = jnp.full((b, 1), float(s), w.dtype)
+    if padding_idx is not None and padding_idx >= 0:
+        wgt = wgt * (ids != padding_idx).astype(w.dtype)
+    if pool == "AVERAGE":
+        wgt = wgt / denom
+    return w, ids, wgt
+
+
+def _fused_embedding_pool_grad(ins, outs, out_grads, attrs, ctx):
+    """Fused gradient: dW via one weighted scatter-add — the SelectedRows
+    sparse grad of the reference's fused_embedding_seq_pool, as a dense
+    segment-sum.  Never materialises the [B, S, D] per-position cotangent."""
+    w, ids, wgt = _emb_pool_prep(ins, attrs)
+    g = out_grads.get("Out")
+    if g is None:
+        return {"W": [jnp.zeros_like(w)]}
+    g = g.astype(w.dtype)
+    vocab = w.shape[0]
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import (embedding_pool_grad_tpu,
+                                     fused_embedding_pool_supported)
+        if fused_embedding_pool_supported(w, ids):
+            return {"W": [embedding_pool_grad_tpu(g, ids, wgt, vocab)]}
+    rows = g[:, None, :] * wgt[:, :, None]          # [B, S, D]
+    dw = jax.ops.segment_sum(rows.reshape(-1, g.shape[-1]),
+                             ids.reshape(-1), num_segments=vocab)
+    return {"W": [dw.astype(w.dtype)]}
+
+
+@register_op("fused_embedding_pool", nondiff_inputs=("Ids", "Length"),
+             custom_grad=_fused_embedding_pool_grad)
+def _fused_embedding_pool(ins, attrs, ctx):
+    w, ids, wgt = _emb_pool_prep(ins, attrs)
+    if jax.default_backend() == "tpu":
+        from .pallas_kernels import (fused_embedding_pool_supported,
+                                     fused_embedding_pool_tpu)
+        if fused_embedding_pool_supported(w, ids):
+            return {"Out": [fused_embedding_pool_tpu(w, ids, wgt)]}
+    # XLA fallback mirrors the unfused lookup_table + sequence_pool chain
+    # (take -> zero padding rows -> masked sum); for sum pooling the
+    # elementwise structure is identical, so a kernel-tier rewrite matches
+    # the unrewritten program bit-for-bit on CPU (mean folds the divide
+    # into the weights — allclose, one rounding step apart)
+    gathered = jnp.take(w, ids, axis=0)
+    padding_idx = attrs.get("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        gathered = jnp.where((ids == padding_idx)[..., None], 0.0, gathered)
+    return {"Out": [jnp.sum(gathered * wgt[..., None], axis=1)]}
+
+
 @register_op("cvm", nondiff_inputs=("CVM",))
 def _cvm(ins, attrs, ctx):
     return {"Y": [_cvm_fwd(_x(ins), attrs.get("use_cvm", True))]}

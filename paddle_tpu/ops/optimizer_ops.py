@@ -219,6 +219,154 @@ def _dpsgd(ins, attrs, ctx):
 
 
 # ---------------------------------------------------------------------------
+# bucketed (fused) optimizer updates — the kernel-tier ops the
+# fuse_optimizer pass (fluid/passes/kernel_tier.py) produces from runs of
+# same-(family, dtype, attrs, PartitionSpec) per-param update ops.
+# Reference: framework/ir/fuse_optimizer_ops_pass/ (fuse_adam_op_pass,
+# fuse_momentum_op_pass) + coalesce_tensor semantics.  One op dispatch per
+# BUCKET instead of one per param; the elementwise core runs over a single
+# flattened buffer (a Pallas kernel on TPU, ops/pallas_kernels.py), and is
+# element-for-element the SAME arithmetic as the per-param ops —
+# concatenation changes layout, never values — so the rewrite bit-compares
+# against the unfused program.  Per-param bias-correction scalars (each
+# param owns its beta-pow accumulators) broadcast over their segment.
+# ---------------------------------------------------------------------------
+
+def _flat(xs, dtype):
+    return jnp.concatenate([x.reshape(-1).astype(dtype) for x in xs])
+
+
+def _unflat(buf, templates, sizes):
+    out, off = [], 0
+    for t, s in zip(templates, sizes):
+        out.append(buf[off:off + s].reshape(t.shape))
+        off += s
+    return out
+
+
+def _flat_pallas_ok(p_f):
+    return (jax.default_backend() == "tpu" and p_f.dtype == jnp.float32
+            and p_f.size >= 1024)
+
+
+def _pad_rows(x, lane=1024):
+    pad = (-x.size) % lane
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return x.reshape(-1, lane)
+
+
+def _bucket_params(ins):
+    """(compute params, widened grads, low-precision params or None): the
+    _mp_param() contract over the whole bucket."""
+    masters = ins.get("MasterParam")
+    lo = ins["Param"]
+    ps = masters if masters else lo
+    gs = [g.astype(p.dtype) if g.dtype != p.dtype else g
+          for g, p in zip(ins["Grad"], ps)]
+    return ps, gs, (lo if masters else None)
+
+
+def _bucket_param_outs(outs, lo, new_ps):
+    if lo is not None:
+        outs["ParamOut"] = [p.astype(l.dtype) for p, l in zip(new_ps, lo)]
+        outs["MasterParamOut"] = list(new_ps)
+    else:
+        outs["ParamOut"] = list(new_ps)
+    return outs
+
+
+@register_op("fused_adam", differentiable=False)
+def _fused_adam(ins, attrs, ctx):
+    ps, gs, lo = _bucket_params(ins)
+    ms, vs = ins["Moment1"], ins["Moment2"]
+    b1ps, b2ps = ins["Beta1Pow"], ins["Beta2Pow"]
+    lr = _p(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    sizes = [int(p.size) for p in ps]
+    cdt = ps[0].dtype
+    # per-param bias-corrected lr, broadcast over each segment
+    lrts = [(lr * jnp.sqrt(1 - p2.reshape(())) / (1 - p1.reshape(())))
+            .astype(cdt)
+            for p1, p2 in zip(b1ps, b2ps)]
+    p_f, g_f = _flat(ps, cdt), _flat(gs, cdt)
+    m_f, v_f = _flat(ms, cdt), _flat(vs, cdt)
+    lrt_f = jnp.concatenate([jnp.broadcast_to(t, (s,))
+                             for t, s in zip(lrts, sizes)])
+    if _flat_pallas_ok(p_f):
+        from .pallas_kernels import fused_adam_tpu
+        args = [_pad_rows(x) for x in (p_f, g_f, m_f, v_f, lrt_f)]
+        p2, m2, v2 = fused_adam_tpu(*args, b1, b2, eps)
+        n = p_f.size
+        p_new, m_new, v_new = (x.reshape(-1)[:n] for x in (p2, m2, v2))
+    else:
+        m_new = b1 * m_f + (1 - b1) * g_f
+        v_new = b2 * v_f + (1 - b2) * jnp.square(g_f)
+        p_new = p_f - lrt_f * m_new / (jnp.sqrt(v_new) + eps)
+    outs = {"Moment1Out": _unflat(m_new, ms, sizes),
+            "Moment2Out": _unflat(v_new, vs, sizes),
+            "Beta1PowOut": [(p1.reshape(()) * b1).reshape(1)
+                            for p1 in b1ps],
+            "Beta2PowOut": [(p2.reshape(()) * b2).reshape(1)
+                            for p2 in b2ps]}
+    return _bucket_param_outs(outs, lo, _unflat(p_new, ps, sizes))
+
+
+@register_op("fused_momentum", differentiable=False)
+def _fused_momentum(ins, attrs, ctx):
+    ps, gs, lo = _bucket_params(ins)
+    vs = ins["Velocity"]
+    lr = _p(ins, "LearningRate").reshape(())
+    mu = attrs.get("mu", 0.9)
+    rd = attrs.get("regularization_coeff", 0.0)
+    l2 = rd if attrs.get("regularization_method", "") == "l2_decay" else 0.0
+    nesterov = attrs.get("use_nesterov", False)
+    sizes = [int(p.size) for p in ps]
+    cdt = ps[0].dtype
+    p_f, g_f, v_f = _flat(ps, cdt), _flat(gs, cdt), _flat(vs, cdt)
+    if _flat_pallas_ok(p_f):
+        from .pallas_kernels import fused_momentum_tpu
+        args = [_pad_rows(x) for x in (p_f, g_f, v_f)]
+        p2, v2 = fused_momentum_tpu(*args, lr, mu, nesterov, l2)
+        n = p_f.size
+        p_new, v_new = (x.reshape(-1)[:n] for x in (p2, v2))
+    else:
+        if l2:
+            g_f = g_f + l2 * p_f
+        v_new = mu * v_f + g_f
+        if nesterov:
+            p_new = p_f - lr * (g_f + mu * v_new)
+        else:
+            p_new = p_f - lr * v_new
+    outs = {"VelocityOut": _unflat(v_new, vs, sizes)}
+    return _bucket_param_outs(outs, lo, _unflat(p_new, ps, sizes))
+
+
+@register_op("fused_lamb", differentiable=False)
+def _fused_lamb(ins, attrs, ctx):
+    """Bucketed LAMB: one op dispatch over the bucket.  The trust-ratio
+    norms are PER-PARAM reductions by definition, so the lowering keeps
+    per-param arrays (bit-identical to N separate lamb ops; XLA fuses the
+    elementwise stages across params within the single computation)."""
+    n = len(ins["Param"])
+    has_master = bool(ins.get("MasterParam"))
+    slots_in = ["Param", "Grad", "Moment1", "Moment2", "Beta1Pow",
+                "Beta2Pow"] + (["MasterParam"] if has_master else [])
+    outs = {k: [] for k in
+            ["ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+             "Beta2PowOut"] + (["MasterParamOut"] if has_master else [])}
+    for i in range(n):
+        sub = {s: [ins[s][i]] for s in slots_in}
+        sub["LearningRate"] = ins["LearningRate"]
+        o = _lamb(sub, attrs, ctx)
+        for k in outs:
+            outs[k].append(o[k][0])
+    return outs
+
+
+# ---------------------------------------------------------------------------
 # AMP dynamic loss scaling (operators/amp/*)
 # ---------------------------------------------------------------------------
 @register_op("check_finite_and_unscale", differentiable=False)

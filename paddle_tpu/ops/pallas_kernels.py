@@ -25,21 +25,27 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["flash_attention_tpu", "fused_dropout_tpu",
-           "fused_dropout_add_tpu", "fused_act_dropout_tpu"]
+           "fused_dropout_add_tpu", "fused_act_dropout_tpu",
+           "fused_embedding_pool_tpu", "embedding_pool_grad_tpu",
+           "fused_embedding_pool_supported",
+           "fused_adam_tpu", "fused_momentum_tpu"]
 
 
 # ---------------------------------------------------------------------------
 # flash attention: thin wrapper over jax's production pallas kernel
 # ---------------------------------------------------------------------------
 
-def flash_attention_tpu(q, k, v, scale=None, causal=False):
-    """q/k/v: [B, H, T, D].  Falls back by raising ImportError-like None
-    handling in the caller if shapes are unsupported."""
+def flash_attention_tpu(q, k, v, scale=None, causal=False, ab=None):
+    """q/k/v: [B, H, T, D]; ``ab`` an optional additive bias already
+    broadcast to [B, H, Tq, Tk] (the kernel's attention-bias argument —
+    how a BERT padding mask rides the Pallas path).  Falls back by
+    raising ImportError-like None handling in the caller if shapes are
+    unsupported."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         flash_attention as _fa)
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _fa(q, k, v, causal=causal, sm_scale=float(scale))
+    return _fa(q, k, v, ab=ab, causal=causal, sm_scale=float(scale))
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +304,170 @@ def fused_act_dropout_tpu(x, key, rate, upscale_in_train, act):
     out = _fused_act_dropout(x.reshape(-1, n), seed, float(rate),
                              bool(upscale_in_train), act)
     return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# fused CTR embedding: gather + pool forward, weighted scatter-add backward.
+#
+# The kernel-tier pass (fluid/passes/kernel_tier.py fuse_sparse_embedding)
+# rewrites lookup_table(+sequence_pool) chains onto the fused_embedding_pool
+# op; on TPU its lowering lands here.  The naive chain materialises the
+# [B, S, D] gathered tensor in HBM just to collapse it one op later — here
+# each batch row streams its S table rows through VMEM and accumulates the
+# pooled [1, D] result in registers, so the intermediate never exists.  The
+# backward is the PaddleBox fused gradient: a weighted scatter-add
+# (segment-sum) straight into the dW buffer, one pass, no [B, S, D]
+# cotangent.  TPU grid steps run sequentially, so the read-modify-write
+# scatter is race-free by construction.
+# ---------------------------------------------------------------------------
+
+_EMB_VMEM_BYTES = 4 << 20     # the table block must fit VMEM; bigger tables
+                              # take the XLA take/segment_sum fallback
+
+
+def fused_embedding_pool_supported(w, ids) -> bool:
+    """Static gate for the pallas path: lane-aligned row dim, 2-d ids, and
+    a table small enough to hold as one VMEM block (the streaming-DMA
+    variant for HBM-resident tables is future work — ROADMAP item 4)."""
+    if w.ndim != 2 or ids.ndim != 2 or ids.shape[1] == 0:
+        return False
+    v, d = w.shape
+    return d % 128 == 0 and v * d * w.dtype.itemsize <= _EMB_VMEM_BYTES
+
+
+def _gather_pool_kernel(ids_ref, wgt_ref, w_ref, o_ref, *, n_ids):
+    d = o_ref.shape[-1]
+
+    def body(j, acc):
+        idx = ids_ref[0, j]
+        row = pl.load(w_ref, (pl.dslice(idx, 1), pl.dslice(0, d)))
+        return acc + row * wgt_ref[0, j]
+
+    o_ref[:] = jax.lax.fori_loop(
+        0, n_ids, body, jnp.zeros((1, d), w_ref.dtype))
+
+
+def fused_embedding_pool_tpu(w, ids, wgt):
+    """out[i] = sum_j w[ids[i, j]] * wgt[i, j] — gather and pool in one
+    kernel.  ``wgt`` carries the pooling semantics (0 for padding_idx /
+    beyond-length positions, 1/len for mean pooling)."""
+    b, s = ids.shape
+    v, d = w.shape
+    return pl.pallas_call(
+        functools.partial(_gather_pool_kernel, n_ids=s),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, s), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, s), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((v, d), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, d), w.dtype),
+    )(ids.astype(jnp.int32), wgt.astype(w.dtype), w)
+
+
+def _scatter_grad_kernel(ids_ref, wgt_ref, g_ref, o_ref, *, n_ids):
+    d = o_ref.shape[-1]
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    def body(j, _):
+        idx = ids_ref[0, j]
+        cur = pl.load(o_ref, (pl.dslice(idx, 1), pl.dslice(0, d)))
+        pl.store(o_ref, (pl.dslice(idx, 1), pl.dslice(0, d)),
+                 cur + g_ref[:] * wgt_ref[0, j])
+        return 0
+
+    jax.lax.fori_loop(0, n_ids, body, 0)
+
+
+def embedding_pool_grad_tpu(g, ids, wgt, vocab):
+    """dW[ids[i, j]] += g[i] * wgt[i, j]: the fused gradient scatter-add.
+    The whole dW buffer is the (sequentially-gridded) output block, so the
+    accumulation never materialises per-position cotangent rows."""
+    b, s = ids.shape
+    d = g.shape[-1]
+    return pl.pallas_call(
+        functools.partial(_scatter_grad_kernel, n_ids=s),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, s), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, s), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((vocab, d), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((vocab, d), g.dtype),
+    )(ids.astype(jnp.int32), wgt.astype(g.dtype), g)
+
+
+# ---------------------------------------------------------------------------
+# bucketed optimizer updates: one elementwise kernel over a flattened
+# same-(dtype, family, PartitionSpec) parameter bucket (fuse_optimizer pass).
+# The math is element-for-element identical to the per-param update ops —
+# concatenation changes layout, never values — so the rewrite bit-compares
+# against N separate launches.  lr_t rides in as a per-element tensor
+# because Adam's bias correction is a per-PARAM scalar (each param owns its
+# beta-pow accumulators); broadcasting it outside the kernel keeps the
+# kernel a pure 5-in/3-out elementwise map.
+# ---------------------------------------------------------------------------
+
+def _fused_adam_kernel(p_ref, g_ref, m_ref, v_ref, lrt_ref,
+                       po_ref, mo_ref, vo_ref, *, beta1, beta2, eps):
+    g = g_ref[:]
+    m_new = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v_new = beta2 * v_ref[:] + (1.0 - beta2) * jnp.square(g)
+    po_ref[:] = p_ref[:] - lrt_ref[:] * m_new / (jnp.sqrt(v_new) + eps)
+    mo_ref[:] = m_new
+    vo_ref[:] = v_new
+
+
+def fused_adam_tpu(p2d, g2d, m2d, v2d, lrt2d, beta1, beta2, eps):
+    """(p, m, v) updated over a padded [rows, lanes] bucket in one launch."""
+    m, n = p2d.shape
+    bm = _pick_block_rows(m, n)
+    spec = pl.BlockSpec((bm, n), lambda i: (i, 0))
+    outs = pl.pallas_call(
+        functools.partial(_fused_adam_kernel, beta1=float(beta1),
+                          beta2=float(beta2), eps=float(eps)),
+        grid=(m // bm,),
+        in_specs=[spec] * 5,
+        out_specs=[spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((m, n), p2d.dtype)] * 3,
+    )(p2d, g2d, m2d, v2d, lrt2d)
+    return outs
+
+
+def _fused_momentum_kernel(lr_ref, p_ref, g_ref, v_ref, po_ref, vo_ref, *,
+                           mu, use_nesterov, l2_decay):
+    g = g_ref[:]
+    p = p_ref[:]
+    if l2_decay:
+        g = g + p.dtype.type(l2_decay) * p
+    v_new = p.dtype.type(mu) * v_ref[:] + g
+    lr = lr_ref[0]
+    if use_nesterov:
+        po_ref[:] = p - lr * (g + p.dtype.type(mu) * v_new)
+    else:
+        po_ref[:] = p - lr * v_new
+    vo_ref[:] = v_new
+
+
+def fused_momentum_tpu(p2d, g2d, v2d, lr, mu, use_nesterov, l2_decay):
+    m, n = p2d.shape
+    bm = _pick_block_rows(m, n)
+    spec = pl.BlockSpec((bm, n), lambda i: (i, 0))
+    return pl.pallas_call(
+        functools.partial(_fused_momentum_kernel, mu=float(mu),
+                          use_nesterov=bool(use_nesterov),
+                          l2_decay=float(l2_decay)),
+        grid=(m // bm,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [spec] * 3,
+        out_specs=[spec] * 2,
+        out_shape=[jax.ShapeDtypeStruct((m, n), p2d.dtype)] * 2,
+    )(lr.reshape(1).astype(p2d.dtype), p2d, g2d, v2d)
 
 
 def fused_dropout_tpu(x, key, rate, upscale_in_train):

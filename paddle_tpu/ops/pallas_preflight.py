@@ -34,15 +34,18 @@ def mosaic_tc_primitives() -> frozenset:
     from jax._src.pallas.mosaic import lowering as _ml
     rules = _ml.lowering_rules
     # keyed by KernelType since jax 0.8; TC (TensorCore) is what
-    # pl.pallas_call targets on TPU
+    # pl.pallas_call targets on TPU.  On 0.4.x the registry is flat —
+    # primitive -> rule directly — so the keys ARE the TC set.
     tc_key = next((k for k in rules if getattr(k, "name", "") == "TC"
                    or str(k).endswith("TC")), None)
-    if tc_key is None:
-        raise MosaicLoweringError(
-            f"could not locate the TensorCore rule set in jax's Mosaic "
-            f"lowering registry (keys: {list(rules)}) — jax internals "
-            f"moved; update mosaic_tc_primitives()")
-    return frozenset(p.name for p in rules[tc_key])
+    if tc_key is not None:
+        return frozenset(p.name for p in rules[tc_key])
+    if rules and all(hasattr(k, "name") for k in rules):
+        return frozenset(p.name for p in rules)
+    raise MosaicLoweringError(
+        f"could not locate the TensorCore rule set in jax's Mosaic "
+        f"lowering registry (keys: {list(rules)}) — jax internals "
+        f"moved; update mosaic_tc_primitives()")
 
 
 def _sub_jaxprs(eqn):

@@ -1,0 +1,548 @@
+"""Pallas kernel tier as compiler passes (fluid/passes/kernel_tier.py):
+fuse_attention / fuse_sparse_embedding / fuse_optimizer pattern-rewrites,
+their negative cases (patterns must NOT fire), fused-optimizer numerics
+bit-compared against per-param updates (incl. bf16 multi_precision
+masters and sharded bucket grouping), and the kernel-tier satellites
+(FLAGS_pallas_min_seq knob, additive-bias mask dispatch, interpret-mode
+kernel numerics)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import layers as L
+from paddle_tpu.fluid import trace
+from paddle_tpu.fluid.core import Scope, scope_guard
+from paddle_tpu.fluid.framework import reset_unique_name
+from paddle_tpu.fluid.passes import (PassPipeline, create_pass)
+from paddle_tpu.models.static_graphs import (
+    build_bert_train_program, build_ctr_train_program, bert_demo_feed,
+    ctr_demo_feed)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    reset_unique_name()
+    yield
+
+
+def _counter(name):
+    return trace.metrics().counter(name).value
+
+
+def _train(main, startup, loss, feed, n=10, build=None):
+    ex = fluid.Executor()
+    with scope_guard(Scope()):
+        ex.run(startup)
+        prog = main
+        if build is not None:
+            prog = fluid.CompiledProgram(main, build_strategy=build)
+        losses = [float(np.asarray(
+            ex.run(prog, feed=feed, fetch_list=[loss])[0]).ravel()[0])
+            for _ in range(n)]
+        scope = fluid.global_scope()
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.all_parameters()}
+    return losses, params
+
+
+def _tier_bs(**kw):
+    bs = fluid.BuildStrategy()
+    for k, v in kw.items():
+        setattr(bs, k, v)
+    return bs
+
+
+def _op_types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+# ---------------------------------------------------------------------------
+# fuse_attention — positive
+# ---------------------------------------------------------------------------
+
+class TestFuseAttention:
+    @pytest.mark.parametrize("dropout,with_mask", [
+        (0.0, True), (0.1, True), (0.0, False), (0.1, False)])
+    def test_train_rewrite_bit_parity(self, dropout, with_mask):
+        """Every attention block (forward + grad) rewrites, the training
+        trajectory is bit-identical on the CPU fallback — the absorbed
+        dropout regenerates the same mask from the same op seed."""
+        rng = np.random.RandomState(0)
+        feed = bert_demo_feed(rng, with_mask=with_mask)
+        kw = dict(layers=2, dropout=dropout, with_mask=with_mask)
+        l_off, p_off = _train(*build_bert_train_program(**kw), feed)
+        reset_unique_name()
+        r0 = _counter("kernel_tier.fuse_attention.rewrites")
+        m, s, loss = build_bert_train_program(**kw)
+        l_on, p_on = _train(m, s, loss, feed,
+                            build=_tier_bs(fuse_attention=True))
+        assert _counter("kernel_tier.fuse_attention.rewrites") - r0 == 2
+        types = _op_types(m)
+        assert types.count("fused_multihead_attention") == 2
+        assert "softmax" not in types
+        assert l_on == l_off
+        for name in p_off:
+            assert np.array_equal(p_off[name], p_on[name]), name
+
+    def test_fwd_only_rewrite(self):
+        """Inference-shaped programs (no grads) fuse through the
+        fwd-only rules."""
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            ids = fluid.data("ids", [-1, 8], dtype="int64")
+            h = L.embedding(ids, size=[32, 16])
+            from paddle_tpu.models.static_graphs import _naive_attention
+            h = _naive_attention(h, 16, 2)
+            out = L.reduce_mean(h, dim=1)
+        rng = np.random.RandomState(1)
+        feed = {"ids": rng.randint(0, 32, (4, 8)).astype("int64")}
+        ex = fluid.Executor()
+        with scope_guard(Scope()):
+            ex.run(s)
+            want, = ex.run(m, feed=feed, fetch_list=[out])
+            pipe = PassPipeline([create_pass("fuse_attention")])
+            stats = pipe.apply(m, targets=[out.name])
+            assert stats["fuse_attention"]["ops_fused"] == 1
+            got, = ex.run(m, feed=feed, fetch_list=[out])
+        assert np.array_equal(np.asarray(want), np.asarray(got))
+
+    def test_rewrite_is_idempotent(self):
+        m, s, loss = build_bert_train_program(layers=1)
+        pipe = PassPipeline([create_pass("fuse_attention")])
+        stats1 = pipe.apply(m, targets=[loss.name])
+        assert stats1["fuse_attention"]["ops_fused"] == 1
+        v = m._version
+        stats2 = PassPipeline([create_pass("fuse_attention")]).apply(
+            m, targets=[loss.name])
+        assert stats2["fuse_attention"].get("ops_fused", 0) == 0
+        assert m._version == v
+
+    def test_fused_op_carries_scale_and_dropout_attrs(self):
+        m, s, loss = build_bert_train_program(layers=1, dropout=0.25,
+                                              hidden=32, heads=4)
+        PassPipeline([create_pass("fuse_attention")]).apply(
+            m, targets=[loss.name])
+        op = next(o for o in m.global_block().ops
+                  if o.type == "fused_multihead_attention")
+        assert op.attrs["scale"] == pytest.approx((32 // 4) ** -0.5)
+        assert op.attrs["dropout_rate"] == pytest.approx(0.25)
+        assert op.attrs["dropout_seed"] > 0
+        assert "Mask" in op.inputs
+
+
+# ---------------------------------------------------------------------------
+# fuse_attention — the patterns must NOT fire
+# ---------------------------------------------------------------------------
+
+def _qkv_data(seq=8, heads=2, dh=8):
+    q = fluid.data("q", [-1, heads, seq, dh])
+    k = fluid.data("k", [-1, heads, seq, dh])
+    v = fluid.data("v", [-1, heads, seq, dh])
+    return q, k, v
+
+
+class TestFuseAttentionNegative:
+    def test_multi_consumer_score_tensor(self):
+        """The score tensor feeds a second consumer -> fusing it away
+        would break that consumer; the rewrite must decline."""
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            q, k, v = _qkv_data()
+            sc = L.matmul(q, k, transpose_y=True)
+            p = L.softmax(sc)
+            out = L.matmul(p, v)
+            leak = L.reduce_mean(sc)        # second consumer of the score
+        stats = PassPipeline([create_pass("fuse_attention")]).apply(
+            m, targets=[out.name, leak.name])
+        assert stats["fuse_attention"].get("ops_fused", 0) == 0
+        assert "fused_multihead_attention" not in _op_types(m)
+
+    def test_non_attention_matmul_softmax_chain(self):
+        """A 2-d matmul->softmax->matmul (an mlp with a softmax gate) is
+        not attention — the 4-d gate must keep it on the op-by-op path."""
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            x = fluid.data("x", [-1, 16])
+            a = fluid.data("a", [-1, 16])
+            b = fluid.data("b", [-1, 16])
+            sc = L.matmul(x, a, transpose_y=True)
+            p = L.softmax(sc)
+            out = L.matmul(p, b)
+        stats = PassPipeline([create_pass("fuse_attention")]).apply(
+            m, targets=[out.name])
+        assert stats["fuse_attention"].get("ops_fused", 0) == 0
+
+    def test_fetched_probability_tensor_declines(self):
+        """Fetching the softmax output keeps it protected: no rewrite."""
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            q, k, v = _qkv_data()
+            p = L.softmax(L.matmul(q, k, transpose_y=True))
+            out = L.matmul(p, v)
+        stats = PassPipeline([create_pass("fuse_attention")]).apply(
+            m, targets=[out.name, p.name])
+        assert stats["fuse_attention"].get("ops_fused", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# fuse_sparse_embedding
+# ---------------------------------------------------------------------------
+
+class TestFuseSparseEmbedding:
+    def test_ctr_train_rewrite_bit_parity(self):
+        rng = np.random.RandomState(0)
+        feed = ctr_demo_feed(rng)
+        l_off, p_off = _train(*build_ctr_train_program(), feed)
+        reset_unique_name()
+        r0 = _counter("kernel_tier.fuse_sparse_embedding.rewrites")
+        m, s, loss = build_ctr_train_program()
+        l_on, p_on = _train(m, s, loss, feed,
+                            build=_tier_bs(fuse_sparse_embedding=True))
+        assert _counter(
+            "kernel_tier.fuse_sparse_embedding.rewrites") - r0 == 4
+        types = _op_types(m)
+        assert types.count("fused_embedding_pool") == 4
+        assert "lookup_table_v2" not in types
+        assert l_on == l_off
+        for name in p_off:
+            assert np.array_equal(p_off[name], p_on[name]), name
+
+    @pytest.mark.parametrize("pool", ["sum", "average"])
+    def test_length_masked_pool_parity(self, pool):
+        def build():
+            m, s = fluid.Program(), fluid.Program()
+            with fluid.program_guard(m, s):
+                ids = fluid.data("ids", [-1, 6], dtype="int64")
+                ln = fluid.data("ln", [-1], dtype="int64")
+                emb = L.embedding(ids, size=[64, 8])
+                p = L.sequence_pool(emb, pool, length=ln)
+                loss = L.mean(L.fc(p, 4))
+                fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+            return m, s, loss
+
+        rng = np.random.RandomState(2)
+        feed = {"ids": rng.randint(0, 64, (5, 6)).astype("int64"),
+                "ln": np.array([6, 3, 1, 5, 2], "int64")}
+        l_off, p_off = _train(*build(), feed, n=6)
+        reset_unique_name()
+        m, s, loss = build()
+        l_on, p_on = _train(m, s, loss, feed, n=6,
+                            build=_tier_bs(fuse_sparse_embedding=True))
+        op = next(o for o in m.global_block().ops
+                  if o.type == "fused_embedding_pool")
+        assert "Length" in op.inputs
+        np.testing.assert_allclose(l_on, l_off, rtol=1e-6, atol=1e-7)
+        for name in p_off:
+            np.testing.assert_allclose(p_on[name], p_off[name],
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_reduce_sum_spelling_fuses(self):
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            ids = fluid.data("ids", [-1, 4], dtype="int64")
+            emb = L.embedding(ids, size=[32, 8])
+            out = L.reduce_sum(emb, dim=1)
+        stats = PassPipeline([create_pass("fuse_sparse_embedding")]).apply(
+            m, targets=[out.name])
+        assert stats["fuse_sparse_embedding"]["ops_fused"] == 1
+        assert "fused_embedding_pool" in _op_types(m)
+
+    def test_multi_consumer_embedding_declines(self):
+        """The gathered [B,S,D] tensor feeds a second consumer — the
+        whole point of the fusion is to never materialise it, so the
+        rewrite must leave the chain alone."""
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            ids = fluid.data("ids", [-1, 4], dtype="int64")
+            emb = L.embedding(ids, size=[32, 8])
+            pooled = L.sequence_pool(emb, "sum")
+            flat = L.reshape(emb, [-1, 32])      # second consumer
+        stats = PassPipeline([create_pass("fuse_sparse_embedding")]).apply(
+            m, targets=[pooled.name, flat.name])
+        assert stats["fuse_sparse_embedding"].get("ops_fused", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# fuse_optimizer — numerics bit-compared against per-param updates
+# ---------------------------------------------------------------------------
+
+def _mlp(optimizer):
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        x = fluid.data("x", [-1, 16])
+        y = fluid.data("y", [-1, 1], dtype="int64")
+        h = L.fc(x, 32, act="relu")
+        h = L.fc(h, 16, act="relu")
+        logits = L.fc(h, 10)
+        loss = L.mean(L.softmax_with_cross_entropy(logits, y))
+        optimizer().minimize(loss)
+    return m, s, loss
+
+
+_OPTS = {
+    "adam": lambda: fluid.optimizer.AdamOptimizer(1e-2),
+    "momentum": lambda: fluid.optimizer.MomentumOptimizer(0.05, 0.9),
+    "nesterov": lambda: fluid.optimizer.MomentumOptimizer(
+        0.05, 0.9, use_nesterov=True),
+    "lamb": lambda: fluid.optimizer.LambOptimizer(1e-2),
+}
+
+
+class TestFuseOptimizer:
+    @pytest.mark.parametrize("opt", sorted(_OPTS))
+    def test_bucketed_update_bit_identical(self, opt):
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(8, 16).astype("float32"),
+                "y": rng.randint(0, 10, (8, 1)).astype("int64")}
+        l_off, p_off = _train(*_mlp(_OPTS[opt]), feed, n=8)
+        reset_unique_name()
+        m, s, loss = _mlp(_OPTS[opt])
+        l_on, p_on = _train(m, s, loss, feed, n=8,
+                            build=_tier_bs(fuse_optimizer=True))
+        types = _op_types(m)
+        fused_type = {"adam": "fused_adam", "momentum": "fused_momentum",
+                      "nesterov": "fused_momentum",
+                      "lamb": "fused_lamb"}[opt]
+        assert types.count(fused_type) == 1
+        assert not any(t in types for t in ("adam", "momentum", "lamb"))
+        assert l_on == l_off
+        for name in p_off:
+            assert np.array_equal(p_off[name], p_on[name]), name
+
+    def test_bf16_multi_precision_masters_bit_identical(self):
+        """A bucket of bf16 params with fp32 masters: the fused update
+        computes on the masters and writes back bit-identical masters +
+        bf16 views."""
+        def build():
+            m, s = fluid.Program(), fluid.Program()
+            with fluid.program_guard(m, s):
+                x = fluid.data("x", [-1, 4])
+                gb = m.global_block()
+                for nm in ("Wa_lo", "Wb_lo"):
+                    gb.create_parameter(nm, [4, 4], dtype="bfloat16")
+                    sb = s.global_block()
+                    sb.create_var(name=nm, shape=[4, 4], dtype="bfloat16",
+                                  persistable=True)
+                    sb.append_op("fill_constant", outputs={"Out": [nm]},
+                                 attrs={"shape": [4, 4],
+                                        "dtype": "bfloat16", "value": 1.0})
+                h = L.matmul(x, gb.vars["Wa_lo"])
+                h = L.matmul(h, gb.vars["Wb_lo"])
+                loss = L.mean(h)
+                fluid.optimizer.AdamOptimizer(
+                    1e-3, multi_precision=True,
+                    parameter_list=[gb.vars["Wa_lo"],
+                                    gb.vars["Wb_lo"]]).minimize(loss)
+            return m, s, loss
+
+        feed = {"x": np.ones((2, 4), "float32")}
+
+        def run(fuse):
+            reset_unique_name()
+            m, s, loss = build()
+            ex = fluid.Executor()
+            with scope_guard(Scope()):
+                ex.run(s)
+                prog = m
+                if fuse:
+                    prog = fluid.CompiledProgram(
+                        m, build_strategy=_tier_bs(fuse_optimizer=True))
+                for _ in range(20):
+                    ex.run(prog, feed=feed, fetch_list=[loss])
+                scope = fluid.global_scope()
+                state = {n: np.asarray(scope.find_var(n)).view(np.uint16)
+                         if "lo" in n else np.asarray(scope.find_var(n))
+                         for n in m.global_block().vars
+                         if "master_weight" in n or n.endswith("_lo")}
+            return m, state
+
+        m_off, st_off = run(False)
+        m_on, st_on = run(True)
+        op = next(o for o in m_on.global_block().ops
+                  if o.type == "fused_adam")
+        assert len(op.inputs["MasterParam"]) == 2
+        assert st_off and sorted(st_off) == sorted(st_on)
+        for name in st_off:
+            assert np.array_equal(st_off[name], st_on[name]), name
+
+    def test_sharded_bucket_grouping_by_partition_spec(self):
+        """Under a PR-10 plan, params with different PartitionSpecs must
+        never share a bucket — the whole-step pjit path would otherwise
+        pay a reshard inside the fused op."""
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.parallel import mesh as mesh_registry
+        from paddle_tpu.parallel.sharding import ShardingPlan
+        m, s, loss = _mlp(_OPTS["adam"])
+        mesh = mesh_registry.build_mesh({"dp": 1},
+                                        devices=jax.devices()[:1])
+        # adam op order is b_0, b_1, b_2, w_0, w_1, w_2; w_0 gets its own
+        # spec, so the weights' run splits [w_0] | [w_1, w_2]
+        plan = ShardingPlan(
+            mesh, [(r"w_0$", P("dp")), (r".*", P())],
+            param_names=[p.name for p in m.all_parameters()])
+        assert _op_types(m).count("adam") == 6
+        pipe = PassPipeline([create_pass("fuse_optimizer")])
+        pipe.apply(m, targets=[loss.name], sharding_plan=plan)
+        types = _op_types(m)
+        # bias bucket + [w_1, w_2] bucket; w_0 stays per-param (a bucket
+        # of one is no bucket)
+        assert types.count("fused_adam") == 2
+        assert types.count("adam") == 1
+        bare = next(o for o in m.global_block().ops if o.type == "adam")
+        assert bare.inputs["Param"] == ["fc.w_0"]
+        fused = [o for o in m.global_block().ops
+                 if o.type == "fused_adam"]
+        groups = [sorted(o.inputs["Param"]) for o in fused]
+        assert ["fc.b_0", "fc.b_1", "fc.b_2"] in groups
+        assert ["fc.w_1", "fc.w_2"] in groups
+
+    def test_mixed_family_runs_split(self):
+        """Adjacent adam ops with different attrs (two optimizers) never
+        share a bucket."""
+        m, s = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m, s):
+            x = fluid.data("x", [-1, 8])
+            h = L.fc(x, 8)
+            logits = L.fc(h, 4)
+            loss = L.mean(logits)
+            pg = fluid.backward.append_backward(loss)
+            opt1 = fluid.optimizer.AdamOptimizer(1e-2)
+            opt2 = fluid.optimizer.AdamOptimizer(5e-3, beta1=0.8)
+            half = len(pg) // 2
+            opt1.apply_gradients(pg[:half])
+            opt2.apply_gradients(pg[half:])
+        pipe = PassPipeline([create_pass("fuse_optimizer")])
+        pipe.apply(m, targets=[loss.name])
+        types = _op_types(m)
+        # each optimizer's run buckets separately (2 params each)
+        assert types.count("fused_adam") == 2
+
+
+# ---------------------------------------------------------------------------
+# kernel-tier umbrella + satellites
+# ---------------------------------------------------------------------------
+
+class TestKernelTierUmbrella:
+    def test_umbrella_knob_enables_all_three(self):
+        bs = _tier_bs(kernel_tier=True)
+        from paddle_tpu.fluid.passes import passes_for_build_strategy
+        names = [p.name for p in passes_for_build_strategy(bs)]
+        assert names == ["fuse_attention", "fuse_sparse_embedding",
+                         "fuse_optimizer"]
+
+    def test_canonical_order_with_amp(self):
+        bs = _tier_bs(kernel_tier=True, amp=True, enable_dce=True,
+                      fuse_elewise_add_act_ops=True)
+        from paddle_tpu.fluid.passes import passes_for_build_strategy
+        names = [p.name for p in passes_for_build_strategy(bs)]
+        assert names.index("fuse_elewise_add_act") \
+            < names.index("fuse_attention") < names.index("amp_bf16") \
+            < names.index("dce")
+
+    def test_legacy_fuse_all_optimizer_ops_alias(self):
+        bs = _tier_bs(fuse_all_optimizer_ops=True)
+        from paddle_tpu.fluid.passes import passes_for_build_strategy
+        assert [p.name for p in passes_for_build_strategy(bs)] \
+            == ["fuse_optimizer"]
+
+    def test_ops_per_step_drops_under_tier(self):
+        rng = np.random.RandomState(0)
+        feed = bert_demo_feed(rng)
+        _, _ = _train(*build_bert_train_program(), feed, n=1)
+        off = trace.metrics().gauge("executor.ops_per_step").value
+        reset_unique_name()
+        m, s, loss = build_bert_train_program()
+        _train(m, s, loss, feed, n=1, build=_tier_bs(kernel_tier=True))
+        on = trace.metrics().gauge("executor.ops_per_step").value
+        assert on < off
+
+
+class TestSatellites:
+    def test_pallas_min_seq_flag(self):
+        from paddle_tpu.ops.attention import _pallas_min_seq
+        assert _pallas_min_seq() == 1024          # documented default
+        fluid.core.set_flags({"FLAGS_pallas_min_seq": 256})
+        try:
+            assert _pallas_min_seq() == 256
+        finally:
+            fluid.core.set_flags({"FLAGS_pallas_min_seq": 1024})
+
+    def test_bias_broadcastable_gate(self):
+        from paddle_tpu.ops.attention import _bias_broadcastable
+        q = jnp.zeros((2, 4, 16, 8))
+        k = jnp.zeros((2, 4, 16, 8))
+        assert _bias_broadcastable(jnp.zeros((2, 1, 1, 16)), q, k)
+        assert _bias_broadcastable(jnp.zeros((1, 4, 16, 16)), q, k)
+        assert not _bias_broadcastable(jnp.zeros((2, 16)), q, k)
+        assert not _bias_broadcastable(jnp.zeros((2, 3, 1, 16)), q, k)
+        assert not _bias_broadcastable(None, q, k)
+
+    def test_embedding_kernels_interpret_numerics(self):
+        """The Pallas gather+pool / scatter-add kernels in interpret mode
+        against the XLA reference (no TPU required)."""
+        import functools
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from paddle_tpu.ops import pallas_kernels as pk
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(64, 128).astype("float32"))
+        ids = jnp.asarray(rng.randint(0, 64, (4, 5)).astype("int32"))
+        wgt = jnp.asarray(rng.rand(4, 5).astype("float32"))
+        g = jnp.asarray(rng.randn(4, 128).astype("float32"))
+
+        fwd = pl.pallas_call(
+            functools.partial(pk._gather_pool_kernel, n_ids=5),
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 5), lambda i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, 5), lambda i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((64, 128), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((4, 128), jnp.float32),
+            interpret=True)(ids, wgt, w)
+        want = jnp.einsum("bsd,bs->bd", jnp.take(w, ids, axis=0), wgt)
+        np.testing.assert_allclose(np.asarray(fwd), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+        bwd = pl.pallas_call(
+            functools.partial(pk._scatter_grad_kernel, n_ids=5),
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 5), lambda i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, 5), lambda i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((64, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+            interpret=True)(ids, wgt, g)
+        rows = g[:, None, :] * wgt[:, :, None]
+        want_b = jax.ops.segment_sum(rows.reshape(-1, 128),
+                                     ids.reshape(-1), num_segments=64)
+        np.testing.assert_allclose(np.asarray(bwd), np.asarray(want_b),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_new_kernels_pass_mosaic_preflight(self):
+        """Every pallas_call in the fused embedding/optimizer kernels
+        passes the Mosaic lowering pre-flight offline."""
+        import functools
+        from paddle_tpu.ops import pallas_kernels as pk
+        from paddle_tpu.ops.pallas_preflight import assert_mosaic_lowerable
+        w = jnp.zeros((64, 128), jnp.float32)
+        ids = jnp.zeros((2, 4), jnp.int32)
+        wgt = jnp.ones((2, 4), jnp.float32)
+        g = jnp.zeros((2, 128), jnp.float32)
+        p = jnp.zeros((8, 1024), jnp.float32)
+        assert_mosaic_lowerable(pk.fused_embedding_pool_tpu, w, ids, wgt)
+        assert_mosaic_lowerable(
+            lambda g_, i_, w_: pk.embedding_pool_grad_tpu(g_, i_, w_, 64),
+            g, ids, wgt)
+        assert_mosaic_lowerable(
+            functools.partial(pk.fused_adam_tpu, beta1=0.9, beta2=0.999,
+                              eps=1e-8), p, p, p, p, p)
+        assert_mosaic_lowerable(
+            functools.partial(pk.fused_momentum_tpu, mu=0.9,
+                              use_nesterov=True, l2_decay=1e-4),
+            p, p, p, jnp.asarray(0.1))

@@ -364,6 +364,10 @@ def build_specs():
                                             [2, 1, 2, 2, 3]], np.int64),
                     "RankParam": _sym(4, 4 * 3)},
             grad_slots=["X", "RankParam"], attrs={"MaxRank": 2}),
+        "fused_embedding_pool": dict(
+            inputs={"W": _sym(6, 4), "Ids": _ints(6, 2, 3)},
+            grad_slots=["W"],
+            attrs={"pooltype": "SUM", "padding_idx": -1}),
         "fused_embedding_eltwise_layernorm": dict(
             inputs={"Embs": [_sym(6, D), _sym(6, D)],
                     "Ids": [_ints(6, 2, 3), _ints(6, 2, 3)],

@@ -135,6 +135,23 @@ def _run_child(args, budget, extra_env=None, _retried=False):
                 gs.set(spd)
             for dt, n in (info.get("dtype_mix") or {}).items():
                 trace.metrics().gauge(f"watch.dtype_mix.{dt}").set(int(n))
+            # kernel-tier signals (bench kernel_tier legs): total pattern
+            # rewrites across the sweep + the best tier-variant measured
+            # MFU, so a sweep summary shows whether the Pallas tier is
+            # firing and what it buys
+            kt = info.get("kernel_tier") or {}
+            if kt.get("rewrites_total"):
+                trace.metrics().counter("watch.kernel_rewrites").add(
+                    int(kt["rewrites_total"]))
+                mfu_kt = float((kt.get("kernel_tier") or {})
+                               .get("mfu_measured", 0.0) or 0.0)
+                gk = trace.metrics().gauge("watch.mfu_kernel_tier")
+                if mfu_kt > gk.value:
+                    gk.set(mfu_kt)
+                spd_kt = float(kt.get("speedup", 0.0) or 0.0)
+                gks = trace.metrics().gauge("watch.kernel_tier_speedup")
+                if spd_kt > gks.value:
+                    gks.set(spd_kt)
             # sharding-plane signals (bench --sharding leg): the mesh
             # shape + per-device HBM row the next accelerator round
             # baselines multichip against
@@ -282,6 +299,14 @@ def _report_step_timing():
         measured = f" (measured {mfu_m:.1%})" if mfu_m else ""
         print(f"[watch] amp plane: best MFU {mfu:.1%}{measured}, "
               f"bf16-vs-fp32 speedup {spd:.2f}x, dtype mix {mix or 'n/a'}",
+              flush=True)
+    kr = trace.metrics().counter("watch.kernel_rewrites").value
+    if kr:
+        mfu_kt = trace.metrics().gauge("watch.mfu_kernel_tier").value
+        spd_kt = trace.metrics().gauge("watch.kernel_tier_speedup").value
+        best = f", best tier MFU {mfu_kt:.1%}" if mfu_kt else ""
+        print(f"[watch] kernel tier: {int(kr)} pattern rewrites across "
+              f"the sweep{best}, best tier speedup {spd_kt:.2f}x",
               flush=True)
     sd = trace.metrics().gauge("watch.sharding_devices").value
     if sd:
