@@ -42,6 +42,7 @@ host threads.
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
@@ -314,6 +315,10 @@ class AsyncStepRunner:
         self._donate_guard = donate_guard
         self._pending: List[tuple] = []    # (feed, future, trace ctx)
         self._inflight: "deque[List[FetchHandle]]" = deque()
+        # serialises the window's FRONT pops: _wait_oldest (batcher /
+        # drain thread) vs reap() (serving collector) — never held
+        # across a device wait
+        self._pop_lock = threading.Lock()
         self._error_futures: List[StepFuture] = []
         # every not-yet-persisted state-aliasing handle issued while
         # donation is active — the guard persists THESE before a dispatch
@@ -390,6 +395,32 @@ class AsyncStepRunner:
     @property
     def inflight(self) -> int:
         return len(self._inflight)
+
+    def reap(self) -> None:
+        """Pop fully-materialised entries off the front of the window —
+        for consumers (the serving collector) that wait results OUT of
+        band instead of through drain()/backpressure.  Without this, the
+        last dispatched batch sits in the window forever once traffic
+        stops, and ``executor.inflight_steps`` reads >0 on an idle
+        engine — which the SLO watchdog must interpret as outstanding
+        work (a false ``stalled`` verdict that would get a healthy idle
+        replica ejected from a fleet).  The front-pop is serialised with
+        ``_wait_oldest`` through ``_pop_lock`` (check-then-pop on the
+        bare deque would race the batcher's backpressure pop); the lock
+        never spans a device wait, so contention is a few instructions."""
+        with self._pop_lock:
+            popped = False
+            while self._inflight and all(h.is_materialized()
+                                         for h in self._inflight[0]):
+                self._inflight.popleft()
+                popped = True
+            if popped:
+                # gauge set INSIDE the lock: outside it, a stale 0 from
+                # this thread could overwrite the count of a batch the
+                # batcher dispatched in between — and the watchdog would
+                # miss that batch wedging
+                trace.metrics().gauge("executor.inflight_steps").set(
+                    len(self._inflight))
 
     @property
     def pending(self) -> int:
@@ -489,9 +520,10 @@ class AsyncStepRunner:
             peak.set(depth)
 
     def _wait_oldest(self):
-        if not self._inflight:
-            return
-        handles = self._inflight.popleft()
+        with self._pop_lock:
+            if not self._inflight:
+                return
+            handles = self._inflight.popleft()
         _sp = trace.now() if trace.enabled() else 0
         t0 = time.perf_counter()
         for h in handles:

@@ -197,6 +197,15 @@ _FLAGS: Dict[str, object] = {
         "FLAGS_serving_queue_depth", "256")),
     "serving_default_deadline_ms": float(_os.environ.get(
         "FLAGS_serving_default_deadline_ms", "0") or 0),
+    # serving fleet (paddle_tpu/serving/fleet.py, docs/serving.md
+    # "Serving fleet"): the router polls each replica's compact /stats
+    # every scrape_interval_s; missed_scrapes consecutive failed polls
+    # eject an unreachable replica (a stalled/breached /healthz verdict
+    # ejects on the FIRST scrape that carries it)
+    "fleet_scrape_interval_s": float(_os.environ.get(
+        "FLAGS_fleet_scrape_interval_s", "1.0") or 1.0),
+    "fleet_missed_scrapes": int(_os.environ.get(
+        "FLAGS_fleet_missed_scrapes", "3") or 3),
     # rolling window for the goodput.ratio gauge and /goodput (seconds;
     # 0 = whole run).  A bounded default keeps scrape cost O(window) on
     # long traced runs: the live accumulator prunes intervals that can

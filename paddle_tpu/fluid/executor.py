@@ -172,6 +172,11 @@ def _batch_major_hint(block, op):
         return None
     if v.persistable:
         return False
+    if names[0] in (block.program._hints.get("carry_vars") or ()):
+        # declared carried state (decode KV caches): its leading dim is
+        # the state's slot capacity, never the step's batch — exempt
+        # from the padded-row mask like a parameter
+        return False
     if v.shape is None:
         return None
     return len(v.shape) >= 1 and v.shape[0] == -1
@@ -386,9 +391,10 @@ class Executor:
         # are sliced back below.  Mesh / pipeline / recompute paths keep
         # exact shapes (their step builders do per-axis surgery).
         bucket = n_valid = None
-        if ((core.get_flag("shape_bucketing")
-             or program._hints.get("shape_bucketing"))
-                and feed and mesh is None
+        want_bucketing = program._hints.get("shape_bucketing")
+        if want_bucketing is None:
+            want_bucketing = core.get_flag("shape_bucketing")
+        if (want_bucketing and feed and mesh is None
                 and (plan is None or plan.data_axis is None)
                 and not program._hints.get("pipeline_microbatches")
                 and not program._hints.get("recompute_checkpoints")):
@@ -436,7 +442,10 @@ class Executor:
                               args={"fingerprint": key[0][:12],
                                     "n_feeds": len(feed), "bucket": bucket,
                                     "batch_valid": n_valid})
-            self._note_recompile(feed_sig, bucket, tr_on)
+            if not program._hints.get("expected_shape_churn"):
+                # iteration engines (serving/decode.py) compile one
+                # executable per DECLARED bucket — expected, not a storm
+                self._note_recompile(feed_sig, bucket, tr_on)
             # persistent program-level cache: jax's on-disk compilation
             # cache serves the XLA compile; the index tells a COLD miss
             # (never compiled on this cache dir) from a persistent-warm
@@ -657,8 +666,11 @@ class Executor:
         leading dim are never batch-major, even when dim 0 aliases the
         bucket size."""
         blk = program.global_block()
+        carry = set(program._hints.get("carry_vars") or ())
 
         def _not_batch(n):
+            if n in carry:      # carried state: dim 0 is slot capacity
+                return True
             v = blk._find_var_recursive(n)
             return v is not None and (
                 v.persistable or (v.shape is not None
@@ -777,8 +789,10 @@ class Executor:
         # rectangular; the per-step true size rides in __batch_valid__
         bucket = None
         n_valids = None
-        if (core.get_flag("shape_bucketing")
-                or program._hints.get("shape_bucketing")) and feeds[0]:
+        want_bucketing = program._hints.get("shape_bucketing")
+        if want_bucketing is None:
+            want_bucketing = core.get_flag("shape_bucketing")
+        if want_bucketing and feeds[0]:
             per_feed = []
             for f in feeds:
                 dims = {np.shape(v)[0] for v in f.values()
@@ -1078,6 +1092,16 @@ class Executor:
         # state too: their updates must survive pruning + be written back
         scope_state = {n for op in block.ops for n in op.output_arg_names
                        if n not in persist and scope.find_var(n) is not None}
+        # DECLARED carried state (program._hints["carry_vars"], the decode
+        # plane's KV caches — docs/serving.md "Autoregressive decode"):
+        # written back like scope-seeded state whether or not the scope
+        # held a value at compile time, so a carry write can never be
+        # silently pruned by a fetch-seeded compile that happened before
+        # the state was seeded
+        carry = set(program._hints.get("carry_vars") or ())
+        if carry:
+            scope_state |= {n for op in block.ops
+                            for n in op.output_arg_names if n in carry}
         written_names = sorted(
             {n for op in block.ops for n in op.output_arg_names
              if n in persist or n in scope_state})

@@ -47,6 +47,7 @@ from . import trace
 
 __all__ = [
     "prometheus_text", "sanitize_metric_name", "goodput_payload",
+    "stats_payload",
     "MetricsServer", "SnapshotWriter", "write_snapshot",
     "start_http", "stop_http", "start_snapshots", "stop_snapshots",
     "apply_flags", "shutdown",
@@ -137,6 +138,63 @@ def _watchdog_health() -> Dict[str, Any]:
         return {"status": "ok", "error": f"{type(e).__name__}: {e}"}
 
 
+def stats_payload() -> Dict[str, Any]:
+    """The compact ``/stats`` body a fleet router polls every interval:
+    the watchdog verdict, serving queue depth, window p99, and the core
+    serving counters in ONE small JSON payload — one cheap request per
+    scrape instead of parsing the full Prometheus text.  Named engines
+    (``serving.<name>.*``) appear under ``engines``; a process running
+    the decode plane reports a ``decode`` block too.  Deliberately does
+    NOT refresh goodput (a control-plane poll at router frequency must
+    stay O(registry lookup))."""
+    m = trace.metrics()
+    wd = _watchdog_health()
+    _gauge = trace.gauge_value          # shared defensive reads — the
+    _counter = trace.counter_value      # watchdog uses the same pair
+
+    def _p99_ms(hist_name):
+        inst = m.get(hist_name)
+        if isinstance(inst, trace.Histogram):
+            return round(inst.percentile(0.99) * 1e3, 3)
+        return 0.0
+
+    out: Dict[str, Any] = {
+        "status": wd.get("status", "ok"),
+        "uptime_s": round(_uptime_s(), 3),
+        "queue_depth": _gauge("serving.queue_depth"),
+        "p99_ms": _p99_ms("serving.latency_seconds"),
+        "window_p99_ms": round(_gauge("watchdog.window_p99_ms"), 3),
+        "requests": _counter("serving.requests"),
+        "batches": _counter("serving.batches"),
+        "rejected": _counter("serving.rejected"),
+        "timeouts": _counter("serving.timeouts"),
+    }
+    # named engines: serving.<name>.queue_depth marks a namespace
+    engines: Dict[str, Any] = {}
+    for name, _ in m.items():
+        parts = name.split(".")
+        if len(parts) == 3 and parts[0] == "serving" \
+                and parts[2] == "queue_depth":
+            eng = parts[1]
+            engines[eng] = {
+                "queue_depth": _gauge(name),
+                "p99_ms": _p99_ms(f"serving.{eng}.latency_seconds"),
+                "requests": _counter(f"serving.{eng}.requests"),
+                "batches": _counter(f"serving.{eng}.batches"),
+            }
+    if engines:
+        out["engines"] = engines
+    if m.get("decode.requests") is not None:
+        out["decode"] = {
+            "requests": _counter("decode.requests"),
+            "tokens": _counter("decode.tokens"),
+            "steps": _counter("decode.steps"),
+            "active_slots": _gauge("decode.active_slots"),
+            "queue_depth": _gauge("decode.queue_depth"),
+        }
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle-tpu-metrics/1.0"
     protocol_version = "HTTP/1.1"
@@ -164,6 +222,12 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "text/plain"
         elif path == "/watchdog":
             body = json.dumps(_watchdog_health(), default=str).encode()
+            ctype = "application/json"
+        elif path == "/stats":
+            # the fleet router's control-plane poll: verdict + queue
+            # depth + window p99 in one compact payload (docs/serving.md
+            # "Serving fleet")
+            body = json.dumps(stats_payload(), default=str).encode()
             ctype = "application/json"
         else:
             body = b"not found\n"

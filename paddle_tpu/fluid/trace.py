@@ -56,6 +56,7 @@ __all__ = [
     "current_span_id", "set_context", "restore_context",
     "export_chrome_trace",
     "op_summary", "summary_table", "metrics", "MetricsRegistry",
+    "gauge_value", "counter_value",
     "Counter", "Gauge", "Histogram", "SORTED_KEYS",
 ]
 
@@ -703,6 +704,28 @@ _registry = MetricsRegistry()
 
 def metrics() -> MetricsRegistry:
     return _registry
+
+
+def gauge_value(name: str, default: float = 0.0) -> float:
+    """Read a gauge/counter-like instrument WITHOUT creating it —
+    the defensive read every control-plane consumer (SLO watchdog,
+    /stats payload) shares: a missing instrument or a type surprise
+    reads as ``default``, never a crash and never a phantom
+    registration."""
+    inst = _registry.get(name)
+    try:
+        return float(inst.value) if inst is not None else default
+    except (TypeError, AttributeError):
+        return default
+
+
+def counter_value(name: str, default: int = 0) -> int:
+    """Integer twin of :func:`gauge_value`."""
+    inst = _registry.get(name)
+    try:
+        return int(inst.value) if inst is not None else default
+    except (TypeError, AttributeError):
+        return default
 
 
 # ---------------------------------------------------------------------------

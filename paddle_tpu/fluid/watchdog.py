@@ -264,36 +264,44 @@ class SloWatchdog:
         self.bundles: List[str] = []
 
     # -- signals ------------------------------------------------------------
-    @staticmethod
-    def _gauge(name) -> float:
-        inst = trace.metrics().get(name)
-        try:
-            return float(inst.value) if inst is not None else 0.0
-        except (TypeError, AttributeError):
-            return 0.0
-
-    @staticmethod
-    def _counter(name) -> int:
-        inst = trace.metrics().get(name)
-        try:
-            return int(inst.value) if inst is not None else 0
-        except (TypeError, AttributeError):
-            return 0
+    _gauge = staticmethod(trace.gauge_value)
+    _counter = staticmethod(trace.counter_value)
 
     def _progress(self) -> tuple:
         """Anything that moves when the process COMPLETES work.  Only
         completion signals count — recorder ``completions`` (steps + ok
         requests), never ``total``: a wedged device under open-loop
         load keeps writing rejected/timeout wide events, and those must
-        not read as liveness."""
+        not read as liveness.  ``serving.batches`` aggregates every
+        engine (named engines dual-write the plain family); the decode
+        plane's step counter rides alongside."""
         return (flight_recorder.recorder().completions,
                 self._counter("executor.steps_completed"),
-                self._counter("serving.batches"))
+                self._counter("serving.batches"),
+                self._counter("decode.steps"))
 
     def _outstanding(self) -> bool:
-        return (self._gauge("executor.inflight_steps") > 0
+        if (self._gauge("executor.inflight_steps") > 0
                 or self._gauge("executor.steps_in_progress") > 0
-                or self._gauge("serving.queue_depth") > 0)
+                or self._gauge("serving.queue_depth") > 0
+                or self._gauge("decode.queue_depth") > 0
+                or self._gauge("decode.active_slots") > 0):
+            return True
+        # NAMED serving engines (serving.<name>.queue_depth): the plain
+        # aggregate gauge is last-writer-wins across engines, so a named
+        # engine's backlog can hide behind another's zero — scan the
+        # namespaced gauges too (fleet replicas run one unnamed engine
+        # per process; this covers the in-process multi-engine shape)
+        for name, inst in trace.metrics().items():
+            parts = name.split(".")
+            if len(parts) == 3 and parts[0] in ("serving", "decode") \
+                    and parts[2] in ("queue_depth", "active_slots"):
+                try:
+                    if float(inst.value) > 0:
+                        return True
+                except (TypeError, AttributeError):
+                    pass
+        return False
 
     def _alive_anyway(self) -> bool:
         """Live compiles and elastic drains are legitimate long pauses."""

@@ -1,8 +1,9 @@
 """paddle_tpu.serving — the inference serving plane.
 
 Reference: paddle/fluid/inference/ (AnalysisPredictor +
-OptimizeInferenceProgram + the deployment APIs, PAPER.md layer 8),
-rebuilt TPU-native around three pieces:
+OptimizeInferenceProgram + the deployment APIs, PAPER.md layer 8) and
+the reference fleet's multi-worker serving tier (layer 6), rebuilt
+TPU-native around five pieces:
 
 * :func:`freeze_program` (freeze.py) — trained Program -> read-only
   inference Program via the registered inference pass preset
@@ -10,10 +11,20 @@ rebuilt TPU-native around three pieces:
 * :class:`ServingEngine` (engine.py) — bounded admission queue,
   shape-bucketed continuous batching of heterogeneous requests,
   async-windowed dispatch, per-request demux, ``warmup()``
-  bucket precompilation.
-* The SLO surface — ``serving.*`` counters/histograms on the PR-1/PR-7
-  metrics plane (p50/p95/p99, live /metrics endpoint), ``serving::batch``
-  trace spans, and ``tools/serve_bench.py`` for open-loop load.
+  bucket precompilation; per-engine ``serving.<name>.*`` instruments.
+* :class:`ServingFleet` / :class:`Router` (fleet.py) — N engine
+  replicas behind least-queue-depth/session-affinity dispatch, with
+  /healthz-verdict-driven ejection, readmission, and warm replacement
+  spin-up through the persistent cache + AOT artifacts.
+* :class:`DecodeEngine` (decode.py) — iterative autoregressive decode:
+  KV caches as carried device state, prefill/decode shape buckets,
+  requests joining and leaving the running batch mid-flight with
+  masked bit-exactness.
+* The SLO surface — ``serving.*`` / ``fleet.*`` / ``decode.*``
+  counters/histograms on the PR-1/PR-7 metrics plane (p50/p95/p99,
+  live /metrics + compact /stats endpoints), ``serving::batch`` trace
+  spans, and ``tools/serve_bench.py`` for open-loop (and fleet
+  kill-drill) load.
 
 See docs/serving.md.
 """
@@ -21,9 +32,20 @@ from .freeze import freeze_program, strip_distribution_ops
 from .engine import (ServingEngine, ServingFuture, ServingError,
                      QueueFullError, DeadlineExceededError,
                      EngineClosedError)
+from .fleet import (ServingFleet, Router, ReplicaHandle, FleetFuture,
+                    ReplicaServer, serve_replica, build_engine_from_spec,
+                    demo_mlp_spec, NoReplicaError, ReplicaTransportError)
+from .decode import (DecodeModel, DecodeEngine, DecodeFuture,
+                     DecodeRejectedError, build_demo_decode_model,
+                     decode_sequential)
 
 __all__ = [
     "freeze_program", "strip_distribution_ops",
     "ServingEngine", "ServingFuture", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineClosedError",
+    "ServingFleet", "Router", "ReplicaHandle", "FleetFuture",
+    "ReplicaServer", "serve_replica", "build_engine_from_spec",
+    "demo_mlp_spec", "NoReplicaError", "ReplicaTransportError",
+    "DecodeModel", "DecodeEngine", "DecodeFuture", "DecodeRejectedError",
+    "build_demo_decode_model", "decode_sequential",
 ]

@@ -1,0 +1,785 @@
+"""Autoregressive decode serving: KV-cached continuous batching with
+mid-flight join/leave.
+
+The continuous batcher (serving/engine.py) serves ONE-SHOT requests:
+each request is a single device batch row, in and out.  Iterative
+autoregressive decode is the workload class it cannot express — a
+request is a *sequence* of device steps with per-request state (the KV
+cache) that must stay device-resident between steps, and the economics
+only work when many requests share each step's batch even though they
+start and finish at different times (Orca-style iteration-level
+scheduling, PAPERS.md).
+
+This module opens that workload on the planes the stack already has:
+
+* **Carried device state** — the decode step's K/V caches are scope
+  vars declared in ``program._hints["carry_vars"]``: the executor keeps
+  them device-side between steps exactly like ``run_scan`` carries the
+  optimizer state (fluid/executor.py), writes them back without a host
+  round-trip, never batch-slices them, and never lets a fetch-seeded
+  compile prune their writes.
+* **Prefill vs decode shape buckets** — a joining request's prompt is
+  padded to a *prefill bucket* (one executable per prompt-length
+  bucket x batch bucket), while the running batch steps through a
+  *decode bucket* executable sized by ``bucket_for(live_slots)``.
+* **Join/leave with masked exactness** — requests join the running
+  batch at step boundaries (prefill writes their KV rows into free
+  slots) and leave on EOS/length; per-position validity masks
+  (``__batch_valid__``-style: ``arange < cur_len`` folded into the
+  attention scores, padded-position probabilities underflow to exactly
+  0.0) make every live row's logits BIT-identical to decoding that
+  request alone — the ci_smoke decode gate asserts it across
+  prefill/decode bucket boundaries.
+
+The numerics contract the demo model honours (and custom models must):
+per-row computation only, in batch-size-stable spellings.  On CPU XLA
+the batched 3-D ``matmul`` produces different last-ulp row values at
+different batch sizes; the elementwise-mul + ``reduce_sum`` attention
+spelling is row-stable, which is what makes join/leave bit-exact.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..fluid import compile_cache, trace
+from ..fluid import flight_recorder as _flight
+from ..fluid.core import Scope
+from ..fluid.executor import Executor
+from .engine import (BaseFuture, EngineClosedError, FamilyInstruments,
+                     QueueFullError, ServingError)
+
+__all__ = [
+    "DecodeModel", "DecodeEngine", "DecodeFuture", "DecodeRejectedError",
+    "build_demo_decode_model", "decode_sequential",
+]
+
+_STOP = object()
+_NEG_BIG = 1e30          # masked-score magnitude: exp(-1e30 - max) == 0.0
+
+
+class DecodeRejectedError(ServingError):
+    """The request cannot be decoded (prompt/budget outside the model's
+    ``max_len`` window, or the admission queue is full)."""
+
+
+class DecodeFuture(BaseFuture):
+    """One decode request's pending result.  ``result(timeout)`` returns
+    ``{"tokens", "prompt_len", "finish_reason", "logits"?}`` — tokens is
+    the generated id sequence (EOS included when hit)."""
+
+    __slots__ = ("trace_id",)
+
+    _pending_msg = "decode request still pending"
+
+    def __init__(self, trace_id: Optional[str] = None):
+        super().__init__()
+        self.trace_id = trace_id
+
+
+# ---------------------------------------------------------------------------
+# the model contract
+# ---------------------------------------------------------------------------
+
+class DecodeModel:
+    """The two-program contract a DecodeEngine drives.
+
+    * ``decode_program`` — ONE step for the whole running batch.  Feeds
+      ``tok [B,1] int64`` (previous token per slot), ``posi [B,1] int64``
+      / ``pos [B,1] float32`` (the position this step writes = current
+      length), ``arange [1, max_len] float32``.  Carries (hints
+      ``carry_vars``) the KV caches ``k_cache``/``v_cache``
+      ``[B, max_len, d]`` as scope vars.  Fetches next-token logits
+      ``[B, vocab]``.
+    * ``prefill_program(s_p)`` — consume a prompt padded to the
+      prompt-length bucket ``s_p``: feeds ``prompt [B, s_p] int64``,
+      ``lastpos [B,1] int64``, ``plen [B,1] float32``,
+      ``arange_p [1, s_p] float32``; fetches first-token logits and the
+      initial KV rows ``[B, max_len, d]`` (positions >= plen hold
+      deterministic don't-care values the decode mask excludes until
+      they are overwritten in order).
+
+    Both programs share their weights through one scope; the engine
+    runs them in a CHILD scope so several engines (batched + the
+    sequential reference) share parameters without sharing KV state.
+    Custom models plug in by constructing this class directly with the
+    same feed/fetch names — keep every op per-row and batch-size-stable
+    (module docstring) or join/leave exactness is forfeit.
+    """
+
+    def __init__(self, executor: Executor, scope, decode_program,
+                 logits_name: str, vocab: int, d_model: int, max_len: int,
+                 prefill_builder: Callable[[int], tuple],
+                 k_name: str = "k_cache", v_name: str = "v_cache"):
+        self.executor = executor
+        self.scope = scope
+        self.decode_program = decode_program
+        self.logits_name = logits_name
+        self.vocab = int(vocab)
+        self.d_model = int(d_model)
+        self.max_len = int(max_len)
+        self.k_name = k_name
+        self.v_name = v_name
+        self._prefill_builder = prefill_builder
+        self._prefill: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+
+    def prefill_program(self, s_p: int):
+        """(program, logits_name, k_init_name, v_init_name) for prompt
+        bucket ``s_p`` — built lazily, one program per bucket."""
+        s_p = int(s_p)
+        with self._lock:
+            entry = self._prefill.get(s_p)
+            if entry is None:
+                entry = self._prefill[s_p] = self._prefill_builder(s_p)
+            return entry
+
+
+def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
+                            max_len: int = 24, seed: int = 0,
+                            executor: Optional[Executor] = None,
+                            scope=None) -> DecodeModel:
+    """A single-layer attention LM over the static IR — the decode
+    demo/ci model.  One embedding + shared Q/K/V projections + an output
+    head; the attention uses the batch-size-stable mul+reduce_sum
+    spelling so batched join/leave decode is bit-identical to
+    sequential decode (module docstring)."""
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid import layers as L
+    from paddle_tpu.fluid.param_attr import ParamAttr
+
+    executor = executor or Executor()
+    scope = scope if scope is not None else Scope()
+    scale = float(d_model) ** -0.5
+
+    def proj(x, which, flatten=1):
+        return L.fc(x, d_model, num_flatten_dims=flatten,
+                    param_attr=ParamAttr(name=f"dec_w{which}"),
+                    bias_attr=ParamAttr(name=f"dec_b{which}"))
+
+    def head(h):
+        return L.fc(h, vocab, param_attr=ParamAttr(name="dec_wo"),
+                    bias_attr=ParamAttr(name="dec_bo"))
+
+    def attend(q, k, v, valid):
+        # mul+reduce_sum spelling: per-row accumulation order is
+        # independent of the batch size (a batched 3-D matmul is NOT)
+        s = L.reduce_sum(k * L.unsqueeze(q, [1]), dim=[2])      # [B, S]
+        s = L.scale(s, scale=scale)
+        s = s * valid + L.scale(valid, scale=_NEG_BIG, bias=-_NEG_BIG)
+        p = L.softmax(s)        # masked positions underflow to exact 0.0
+        return L.reduce_sum(v * L.unsqueeze(p, [2]), dim=[1])   # [B, d]
+
+    # -- the decode-step program (all params live here; its startup is
+    # the one that runs) ----------------------------------------------------
+    dec, dec_startup = fluid.Program(), fluid.Program()
+    dec.random_seed = seed
+    dec_startup.random_seed = seed
+    with fluid.program_guard(dec, dec_startup):
+        tok = fluid.data("tok", [-1, 1], dtype="int64")
+        posi = fluid.data("posi", [-1, 1], dtype="int64")
+        pos = fluid.data("pos", [-1, 1], dtype="float32")
+        ar = fluid.data("arange", [1, max_len], dtype="float32")
+        k_cache = fluid.data("k_cache", [-1, max_len, d_model])
+        v_cache = fluid.data("v_cache", [-1, max_len, d_model])
+        x = L.squeeze(L.embedding(tok, size=[vocab, d_model],
+                                  param_attr=ParamAttr(name="dec_emb")),
+                      [1])                                       # [B, d]
+        q, k_new, v_new = proj(x, "q"), proj(x, "k"), proj(x, "v")
+        oh3 = L.unsqueeze(L.one_hot(posi, max_len), [2])         # [B,S,1]
+        keep = L.scale(oh3, scale=-1.0, bias=1.0)
+        k_upd = k_cache * keep + L.unsqueeze(k_new, [1]) * oh3
+        v_upd = v_cache * keep + L.unsqueeze(v_new, [1]) * oh3
+        # in-place carry writes: the executor hands the updated caches
+        # back to the scope device-side (carry_vars below)
+        L.assign(k_upd, output=k_cache)
+        L.assign(v_upd, output=v_cache)
+        valid = L.cast(L.less_than(ar, L.scale(pos, bias=1.0)), "float32")
+        logits = head(attend(q, k_upd, v_upd, valid) + x)        # [B, V]
+    dec._hints["is_test"] = True
+    dec._hints["shape_bucketing"] = False    # the engine pads slots itself
+    dec._hints["expected_shape_churn"] = True  # one compile per bucket
+    dec._hints["carry_vars"] = ("k_cache", "v_cache")
+    dec._hints["feed_names"] = ["tok", "posi", "pos", "arange"]
+    dec._hints["fetch_names"] = [logits.name]
+    executor.run(dec_startup, scope=scope)
+
+    # -- prefill programs, one per prompt-length bucket ----------------------
+    def build_prefill(s_p: int):
+        if not 0 < s_p < max_len:
+            raise ValueError(f"prefill bucket {s_p} must sit inside "
+                             f"max_len={max_len} (decode needs headroom)")
+        pf, pf_startup = fluid.Program(), fluid.Program()
+        pf.random_seed = seed
+        with fluid.program_guard(pf, pf_startup):
+            prompt = fluid.data("prompt", [-1, s_p], dtype="int64")
+            lastpos = fluid.data("lastpos", [-1, 1], dtype="int64")
+            plen = fluid.data("plen", [-1, 1], dtype="float32")
+            arp = fluid.data("arange_p", [1, s_p], dtype="float32")
+            x = L.embedding(prompt, size=[vocab, d_model],
+                            param_attr=ParamAttr(name="dec_emb"))
+            k = proj(x, "k", flatten=2)                    # [B, s_p, d]
+            v = proj(x, "v", flatten=2)
+            oh = L.unsqueeze(L.one_hot(lastpos, s_p), [2])  # [B, s_p, 1]
+            x_last = L.reduce_sum(x * oh, dim=[1])          # [B, d]
+            q = proj(x_last, "q")
+            valid = L.cast(L.less_than(arp, plen), "float32")
+            logits = head(attend(q, k, v, valid) + x_last)
+            zpad = L.fill_constant_batch_size_like(
+                k, [-1, max_len - s_p, d_model], "float32", 0.0)
+            k_init = L.concat([k, zpad], axis=1)            # [B, S, d]
+            v_init = L.concat([v, zpad], axis=1)
+        pf._hints["is_test"] = True
+        pf._hints["shape_bucketing"] = False
+        pf._hints["expected_shape_churn"] = True
+        pf._hints["feed_names"] = ["prompt", "lastpos", "plen", "arange_p"]
+        pf._hints["fetch_names"] = [logits.name, k_init.name, v_init.name]
+        return pf, logits.name, k_init.name, v_init.name
+
+    return DecodeModel(executor, scope, dec, logits.name, vocab, d_model,
+                       max_len, build_prefill)
+
+
+# ---------------------------------------------------------------------------
+# per-engine decode.* instruments (the shared serving-family bundle)
+# ---------------------------------------------------------------------------
+
+class _DecodeInstruments(FamilyInstruments):
+    COUNTERS = ("requests", "rejected", "joins", "leaves", "tokens",
+                "steps", "prefills")
+    HISTOGRAMS = ("ttft_seconds", "step_seconds", "request_seconds",
+                  "batch_occupancy")
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__("decode", self.COUNTERS, self.HISTOGRAMS,
+                         ("active_slots", "queue_depth"), name)
+
+    def set_active(self, v):
+        self.set_gauge("active_slots", v)
+
+    def set_queue_depth(self, v):
+        self.set_gauge("queue_depth", v)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class _Slot:
+    __slots__ = ("req", "pos", "last_token", "k_row", "v_row", "tokens",
+                 "logits", "t_submit", "t_first")
+
+    def __init__(self, req):
+        self.req = req
+        self.pos = 0            # current length = position the next step writes
+        self.last_token = 0
+        self.k_row = None       # [max_len, d] device rows, valid at sync points
+        self.v_row = None
+        self.tokens: List[int] = []
+        self.logits: List[np.ndarray] = []
+        self.t_submit = req.t_submit
+        self.t_first = None
+
+
+class _DecodeRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "future", "trace_id",
+                 "t_submit")
+
+    def __init__(self, prompt, max_new, eos_id, future, trace_id):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.future = future
+        self.trace_id = trace_id
+        self.t_submit = time.monotonic()
+
+
+class DecodeEngine:
+    """Iteration-level scheduler over a :class:`DecodeModel`.
+
+    ::
+
+        model = decode.build_demo_decode_model(vocab=64, max_len=32)
+        with decode.DecodeEngine(model, max_batch=8) as eng:
+            fut = eng.submit([3, 7, 1], max_new_tokens=8, eos_id=0)
+            out = fut.result(timeout=30)   # {"tokens": [...], ...}
+
+    One loop thread owns the running batch: it admits queued requests
+    into free slots at step boundaries (prefill per prompt bucket),
+    runs one decode step for every live slot, emits a token per live
+    request, and retires finished requests.  The KV buffers live in a
+    CHILD scope of the model scope as carried device state
+    (``carry_vars``) sized to ``bucket_for(live, batch_edges)``;
+    membership changes re-pack the live rows device-side.
+
+    ``close()`` is a planned drain: queued + live requests finish, then
+    the loop exits — no accepted request is lost.
+    """
+
+    def __init__(self, model: DecodeModel, max_batch: int = 8,
+                 batch_edges=None, prefill_edges=None,
+                 queue_depth: int = 64, collect_logits: bool = False,
+                 name: Optional[str] = None, auto_start: bool = True):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.batch_edges = compile_cache.normalize_edges(
+            batch_edges or compile_cache.pow2_edges(self.max_batch))
+        default_pf = [e for e in compile_cache.pow2_edges(model.max_len)
+                      if e < model.max_len] or [model.max_len - 1]
+        self.prefill_edges = compile_cache.normalize_edges(
+            prefill_edges or default_pf)
+        bad = [e for e in self.prefill_edges if e >= model.max_len]
+        if bad:
+            raise ValueError(f"prefill edges {bad} leave no decode "
+                             f"headroom inside max_len={model.max_len}")
+        self.queue_depth = int(queue_depth)
+        self.collect_logits = bool(collect_logits)
+        self.name = name
+        self._ins = _DecodeInstruments(name)
+        # KV state lives in a child scope: parameters resolve through
+        # the parent (shared with every engine over this model), carry
+        # vars stay private per engine
+        self._scope = Scope(parent=model.scope)
+        self._arange = np.arange(model.max_len, dtype=np.float32)[None, :]
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
+        self._slots: List[_Slot] = []
+        self._cap = 0
+        self._dirty = False
+        self._closed = False
+        self._started = False
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._auto_start = bool(auto_start)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "DecodeEngine":
+        with self._lock:
+            if self._started or self._closed:
+                return self
+            self._started = True
+            self._thread = threading.Thread(target=self._loop,
+                                            name="decode-loop", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Planned drain: finish everything queued + live, then stop."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._started
+        if started:
+            self._q.put(_STOP)
+            self._thread.join()
+        else:
+            while True:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if req is not _STOP:
+                    req.future._reject(EngineClosedError(
+                        "decode engine closed before its loop started"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
+               eos_id: Optional[int] = None) -> DecodeFuture:
+        if self._closed:
+            raise EngineClosedError("DecodeEngine is closed")
+        if not self._started and self._auto_start:
+            self.start()
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        max_new = int(max_new_tokens)
+        if prompt.size < 1 or max_new < 1:
+            raise DecodeRejectedError(
+                "decode needs a non-empty prompt and max_new_tokens >= 1")
+        if prompt.size > max(self.prefill_edges):
+            raise DecodeRejectedError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"prefill bucket {max(self.prefill_edges)}")
+        if prompt.size + max_new > self.model.max_len:
+            raise DecodeRejectedError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
+                f"exceeds the model's KV window max_len="
+                f"{self.model.max_len}")
+        trace_id = trace.new_trace_id("dec")
+        fut = DecodeFuture(trace_id=trace_id)
+        req = _DecodeRequest(prompt, max_new, eos_id, fut, trace_id)
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError("DecodeEngine is closed")
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self._ins.count("rejected")
+                exc = QueueFullError(
+                    f"decode admission queue full ({self.queue_depth})")
+                fut._reject(exc)
+                raise exc
+        self._ins.count("requests")
+        self._ins.set_queue_depth(self._q.qsize())
+        if trace.enabled():
+            trace.instant("decode::admit", cat="serving",
+                          args={"trace_id": trace_id,
+                                "prompt_len": int(prompt.size),
+                                "max_new": max_new})
+        return fut
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, max_new_tokens, eos_id).result(timeout)
+
+    # -- the loop ------------------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as exc:    # noqa: BLE001 — resolved, never
+            self._abort(exc)            # a stranded client
+
+    def _abort(self, exc: BaseException) -> None:
+        """A loop-level failure (compile error, device fault) must reach
+        every waiting client instead of stranding their futures behind a
+        dead thread — reject live slots + the whole queue, mark the
+        engine closed so later submits fail fast, and let close() join a
+        finished thread."""
+        with self._lock:
+            self._closed = True
+        for s in self._slots:
+            s.req.future._reject(exc)
+        self._slots = []
+        self._ins.set_active(0)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                item.future._reject(exc)
+
+    def _loop_inner(self) -> None:
+        stop_seen = False
+        while True:
+            joins = self._gather_joins()
+            if joins and joins[-1] is _STOP:
+                stop_seen = True
+                joins = joins[:-1]
+            if joins:
+                self._admit(joins)
+            if not self._slots:
+                # _STOP is enqueued AFTER _closed flips, so once seen no
+                # further request can be behind it — drain done
+                if stop_seen:
+                    return
+                if not joins:
+                    # idle: block for work
+                    try:
+                        item = self._q.get(timeout=0.05)
+                    except queue.Empty:
+                        continue
+                    if item is _STOP:
+                        stop_seen = True
+                        continue
+                    self._admit([item])
+                if not self._slots:
+                    continue
+            self._decode_step()
+
+    def _gather_joins(self):
+        """Drain queued requests up to the free slot budget; _STOP rides
+        through as a trailing marker."""
+        out: List[Any] = []
+        free = self.max_batch - len(self._slots)
+        while free > 0:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is _STOP:
+                out.append(_STOP)
+                break
+            out.append(item)
+            free -= 1
+        self._ins.set_queue_depth(self._q.qsize())
+        return out
+
+    # -- join (prefill) ------------------------------------------------------
+    def _admit(self, reqs: List[_DecodeRequest]) -> None:
+        groups: Dict[int, List[_DecodeRequest]] = {}
+        for r in reqs:
+            s_p = compile_cache.bucket_for(int(r.prompt.size),
+                                           self.prefill_edges)
+            groups.setdefault(s_p, []).append(r)
+        for s_p in sorted(groups):
+            self._prefill(s_p, groups[s_p])
+
+    def _prefill(self, s_p: int, reqs: List[_DecodeRequest]) -> None:
+        model = self.model
+        prog, logits_n, k_n, v_n = model.prefill_program(s_p)
+        batch = compile_cache.bucket_for(len(reqs), self.batch_edges)
+        prompt = np.zeros((batch, s_p), dtype=np.int64)
+        plen = np.ones((batch, 1), dtype=np.float32)
+        lastpos = np.zeros((batch, 1), dtype=np.int64)
+        for i, r in enumerate(reqs):
+            n = int(r.prompt.size)
+            prompt[i, :n] = r.prompt
+            plen[i, 0] = float(n)
+            lastpos[i, 0] = n - 1
+        feed = {"prompt": prompt, "lastpos": lastpos, "plen": plen,
+                "arange_p": np.arange(s_p, dtype=np.float32)[None, :]}
+        _t0 = trace.now() if trace.enabled() else 0
+        t0 = time.perf_counter()
+        handles = model.executor.run(prog, feed=feed,
+                                     fetch_list=[logits_n, k_n, v_n],
+                                     scope=self._scope, return_numpy=False)
+        logits = np.asarray(handles[0].persist())          # [batch, V]
+        k_init, v_init = handles[1].raw, handles[2].raw    # device [B,S,d]
+        self._ins.count("prefills")
+        self._ins.observe("step_seconds", time.perf_counter() - t0)
+        if _t0:
+            trace.complete("decode::prefill", _t0, cat="serving",
+                           args={"bucket": s_p, "batch": batch,
+                                 "n_requests": len(reqs)})
+        # sync survivors' rows before the membership mutation, then seat
+        # the joiners
+        self._sync_rows()
+        for i, r in enumerate(reqs):
+            slot = _Slot(r)
+            slot.pos = int(r.prompt.size)
+            slot.k_row = k_init[i]
+            slot.v_row = v_init[i]
+            slot.t_first = time.monotonic()
+            self._ins.observe("ttft_seconds", slot.t_first - r.t_submit)
+            self._ins.count("joins")
+            if self._emit(slot, logits[i]):
+                # finished at its very first token: never occupies a slot
+                self._retire(slot, synced=True)
+            else:
+                self._slots.append(slot)
+                self._dirty = True
+        self._ins.set_active(len(self._slots))
+
+    # -- token emission / retirement ----------------------------------------
+    def _emit(self, slot: _Slot, logits_row: np.ndarray) -> bool:
+        """Record the next token for ``slot``; True when it finishes."""
+        tok = int(np.argmax(logits_row))
+        slot.tokens.append(tok)
+        slot.last_token = tok
+        if self.collect_logits:
+            slot.logits.append(np.asarray(logits_row, dtype=np.float32))
+        self._ins.count("tokens")
+        r = slot.req
+        return (r.eos_id is not None and tok == r.eos_id) \
+            or len(slot.tokens) >= r.max_new
+
+    def _retire(self, slot: _Slot, synced: bool = False) -> None:
+        if not synced:
+            self._sync_rows()
+        if slot in self._slots:
+            self._slots.remove(slot)
+            self._dirty = True
+        r = slot.req
+        reason = ("eos" if r.eos_id is not None and slot.tokens
+                  and slot.tokens[-1] == r.eos_id else "length")
+        out = {"tokens": np.asarray(slot.tokens, dtype=np.int64),
+               "prompt_len": int(r.prompt.size),
+               "finish_reason": reason}
+        if self.collect_logits:
+            out["logits"] = np.stack(slot.logits)
+        dur = time.monotonic() - slot.t_submit
+        self._ins.count("leaves")
+        self._ins.observe("request_seconds", dur)
+        self._ins.set_active(len(self._slots))
+        if _flight.enabled():
+            _flight.record_request(r.trace_id, rows=1, outcome="ok",
+                                   latency_us=dur * 1e6)
+        if trace.enabled():
+            trace.instant("decode::finish", cat="serving",
+                          args={"trace_id": r.trace_id,
+                                "n_tokens": len(slot.tokens),
+                                "reason": reason})
+        r.future._resolve(out)
+
+    # -- KV buffer management ------------------------------------------------
+    def _sync_rows(self) -> None:
+        """Pull each live slot's KV rows out of the current device
+        buffers (device-side slices, no host copy) — called before any
+        membership mutation so a re-pack starts from current state.
+        While ``_dirty`` the buffer has NOT absorbed the latest
+        membership (slot indices don't match buffer rows); the per-slot
+        ``k_row``/``v_row`` refs are already authoritative then."""
+        if self._dirty or not self._slots or self._cap == 0:
+            return
+        kb = self._scope.find_var(self.model.k_name)
+        vb = self._scope.find_var(self.model.v_name)
+        for i, s in enumerate(self._slots):
+            s.k_row = kb[i]
+            s.v_row = vb[i]
+
+    def _rebuild_buffers(self) -> None:
+        """Re-pack live rows into buffers sized to the decode bucket."""
+        import jax.numpy as jnp
+        model = self.model
+        n = len(self._slots)
+        cap = compile_cache.bucket_for(max(n, 1), self.batch_edges)
+        zero = jnp.zeros((model.max_len, model.d_model), jnp.float32)
+        rows_k = [s.k_row for s in self._slots] + [zero] * (cap - n)
+        rows_v = [s.v_row for s in self._slots] + [zero] * (cap - n)
+        self._scope.set_var(model.k_name, jnp.stack(rows_k))
+        self._scope.set_var(model.v_name, jnp.stack(rows_v))
+        self._cap = cap
+        self._dirty = False
+
+    # -- one decode step -----------------------------------------------------
+    def _decode_step(self) -> None:
+        if self._dirty:
+            self._rebuild_buffers()
+        model = self.model
+        cap = self._cap
+        tok = np.zeros((cap, 1), dtype=np.int64)
+        posi = np.zeros((cap, 1), dtype=np.int64)
+        pos = np.zeros((cap, 1), dtype=np.float32)
+        for i, s in enumerate(self._slots):
+            tok[i, 0] = s.last_token
+            posi[i, 0] = s.pos
+            pos[i, 0] = float(s.pos)
+        feed = {"tok": tok, "posi": posi, "pos": pos,
+                "arange": self._arange}
+        _t0 = trace.now() if trace.enabled() else 0
+        t0 = time.perf_counter()
+        logits, = model.executor.run(model.decode_program, feed=feed,
+                                     fetch_list=[model.logits_name],
+                                     scope=self._scope, return_numpy=True)
+        dur = time.perf_counter() - t0
+        self._ins.count("steps")
+        self._ins.observe("step_seconds", dur)
+        self._ins.observe("batch_occupancy", float(len(self._slots)) / cap)
+        if _t0:
+            trace.complete("decode::step", _t0, cat="serving",
+                           args={"cap": cap, "live": len(self._slots)})
+        finished = []
+        for i, s in enumerate(self._slots):
+            s.pos += 1
+            if self._emit(s, logits[i]):
+                finished.append(s)
+        if finished:
+            # sync ONCE while slot order still matches the buffer, then
+            # retire — retiring mutates the slot list, after which
+            # buffer indices no longer line up
+            self._sync_rows()
+            for s in finished:
+                self._retire(s, synced=True)
+
+    # -- warmup / introspection ---------------------------------------------
+    def warmup(self, full: bool = False) -> Dict[str, Any]:
+        """Precompile the decode-step executable per batch bucket and
+        the prefill executables (per prompt bucket; ``full=True`` also
+        crosses every prefill bucket with every batch bucket).  Run it
+        before serving: under ``FLAGS_persistent_cache_dir`` a restarted
+        decode replica reaches serving with zero cold compiles."""
+        if self._started:
+            raise RuntimeError("warmup() must run before the loop starts")
+        m = trace.metrics()
+        miss0 = m.counter("executor.compile_cache_miss").value
+        cold0 = m.counter("executor.compile_cache_cold_miss").value
+        t0 = time.perf_counter()
+        model = self.model
+        saved = (self._scope.find_var(model.k_name),
+                 self._scope.find_var(model.v_name))
+        import jax.numpy as jnp
+        for cap in self.batch_edges:
+            self._scope.set_var(model.k_name, jnp.zeros(
+                (cap, model.max_len, model.d_model), jnp.float32))
+            self._scope.set_var(model.v_name, jnp.zeros(
+                (cap, model.max_len, model.d_model), jnp.float32))
+            feed = {"tok": np.zeros((cap, 1), np.int64),
+                    "posi": np.zeros((cap, 1), np.int64),
+                    "pos": np.ones((cap, 1), np.float32),
+                    "arange": self._arange}
+            model.executor.run(model.decode_program, feed=feed,
+                               fetch_list=[model.logits_name],
+                               scope=self._scope, return_numpy=True)
+        batch_list = list(self.batch_edges) if full else \
+            [self.batch_edges[0]]
+        for s_p in self.prefill_edges:
+            prog, logits_n, k_n, v_n = model.prefill_program(s_p)
+            for b in batch_list:
+                feed = {"prompt": np.zeros((b, s_p), np.int64),
+                        "lastpos": np.zeros((b, 1), np.int64),
+                        "plen": np.ones((b, 1), np.float32),
+                        "arange_p": np.arange(s_p, dtype=np.float32)[None]}
+                model.executor.run(prog, feed=feed,
+                                   fetch_list=[logits_n, k_n, v_n],
+                                   scope=self._scope, return_numpy=False)
+        if saved[0] is not None:
+            self._scope.set_var(model.k_name, saved[0])
+            self._scope.set_var(model.v_name, saved[1])
+        report = {
+            "decode_buckets": list(self.batch_edges),
+            "prefill_buckets": list(self.prefill_edges),
+            "compiles": m.counter("executor.compile_cache_miss").value
+            - miss0,
+            "cold_misses": m.counter(
+                "executor.compile_cache_cold_miss").value - cold0,
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+        return report
+
+    def stats(self) -> Dict[str, Any]:
+        out = {
+            "name": self.name,
+            "requests": self._ins.counter_value("requests"),
+            "rejected": self._ins.counter_value("rejected"),
+            "tokens": self._ins.counter_value("tokens"),
+            "steps": self._ins.counter_value("steps"),
+            "prefills": self._ins.counter_value("prefills"),
+            "joins": self._ins.counter_value("joins"),
+            "leaves": self._ins.counter_value("leaves"),
+            "active_slots": len(self._slots),
+            "queue_depth": self._q.qsize(),
+            "decode_buckets": list(self.batch_edges),
+            "prefill_buckets": list(self.prefill_edges),
+        }
+        for h in ("ttft_seconds", "step_seconds", "request_seconds",
+                  "batch_occupancy"):
+            st = self._ins.hist_stats(h)
+            out[h] = {k: st[k] for k in
+                      ("count", "avg", "p50", "p95", "p99") if k in st}
+        return out
+
+
+def decode_sequential(model: DecodeModel, prompts, max_new_tokens=16,
+                      eos_id: Optional[int] = None,
+                      collect_logits: bool = True,
+                      timeout: float = 300.0,
+                      **engine_kwargs) -> List[Dict[str, Any]]:
+    """The reference path the join/leave gate compares against: decode
+    each request ALONE (one at a time through one engine, so every step
+    batch holds a single live row).  ``max_new_tokens`` may be a list
+    (one budget per prompt)."""
+    budgets = (list(max_new_tokens)
+               if isinstance(max_new_tokens, (list, tuple))
+               else [max_new_tokens] * len(prompts))
+    out = []
+    eng = DecodeEngine(model, collect_logits=collect_logits,
+                       **engine_kwargs)
+    try:
+        for p, budget in zip(prompts, budgets):
+            out.append(eng.submit(p, max_new_tokens=budget,
+                                  eos_id=eos_id).result(timeout=timeout))
+    finally:
+        eng.close()
+    return out

@@ -48,7 +48,8 @@ from ..fluid.async_pipeline import AsyncStepRunner
 from ..fluid.core import global_scope
 from ..fluid.executor import Executor
 
-__all__ = ["ServingEngine", "ServingFuture", "ServingError",
+__all__ = ["ServingEngine", "ServingFuture", "BaseFuture",
+           "FamilyInstruments", "ServingError",
            "QueueFullError", "DeadlineExceededError", "EngineClosedError"]
 
 
@@ -69,7 +70,45 @@ class EngineClosedError(ServingError):
     """submit() after close()."""
 
 
-class ServingFuture:
+class BaseFuture:
+    """The shared pending-result machinery every serving-plane future
+    rides (ServingFuture here, fleet.FleetFuture, decode.DecodeFuture):
+    one event, one result-or-exception cell, timeout-raising reads."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    _pending_msg = "request still pending"
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(self._pending_msg)
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(self._pending_msg)
+        return self._exc
+
+    def _resolve(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def _reject(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+
+class ServingFuture(BaseFuture):
     """One request's pending result: ``result(timeout)`` blocks until the
     batch containing this request completes, then returns
     ``{fetch_name: rows-sliced ndarray}``.  A rejection/timeout resolves
@@ -82,38 +121,14 @@ class ServingFuture:
     request's full trajectory — allocated whether or not tracing is on
     (the flight recorder keys on it even then)."""
 
-    __slots__ = ("_event", "_result", "_exc", "rows", "trace_id")
+    __slots__ = ("rows", "trace_id")
+
+    _pending_msg = "serving request still pending"
 
     def __init__(self, rows: int, trace_id: Optional[str] = None):
-        self._event = threading.Event()
-        self._result: Optional[Dict[str, np.ndarray]] = None
-        self._exc: Optional[BaseException] = None
+        super().__init__()
         self.rows = rows
         self.trace_id = trace_id
-
-    def done(self) -> bool:
-        return self._event.is_set()
-
-    def result(self, timeout: Optional[float] = None
-               ) -> Dict[str, np.ndarray]:
-        if not self._event.wait(timeout):
-            raise TimeoutError("serving request still pending")
-        if self._exc is not None:
-            raise self._exc
-        return self._result
-
-    def exception(self, timeout: Optional[float] = None):
-        if not self._event.wait(timeout):
-            raise TimeoutError("serving request still pending")
-        return self._exc
-
-    def _resolve(self, result: Dict[str, np.ndarray]) -> None:
-        self._result = result
-        self._event.set()
-
-    def _reject(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._event.set()
 
 
 class _Request:
@@ -133,6 +148,76 @@ class _Request:
 
 
 _STOP = object()
+
+
+class FamilyInstruments:
+    """Per-engine instrument bundle over one metric family.
+
+    PR 8 documented the process-global limitation: every engine in one
+    process accumulated into one ``serving.*`` family.  A NAMED engine
+    (``ServingEngine(..., name="r0")``, ``DecodeEngine(..., name=...)``)
+    now writes its own ``<family>.<name>.*`` sub-family — per-replica
+    attribution inside one test process — and ALSO bumps the plain
+    ``<family>.*`` aggregate so fleet dashboards keep a single roll-up
+    (the default-engine alias: an unnamed engine writes the plain
+    family only, exactly the PR-8 behaviour).  Counters/histograms
+    aggregate additively; plain gauges stay last-writer-wins across
+    engines (read the namespaced gauge for a specific engine — the SLO
+    watchdog scans both)."""
+
+    def __init__(self, family: str, counters, histograms, gauges,
+                 name: Optional[str] = None):
+        m = trace.metrics()
+        self.name = name or None
+        self.prefix = f"{family}.{name}." if name else f"{family}."
+        self._c = {}
+        self._h = {}
+        self._g = {}
+        for b in counters:
+            insts = [m.counter(f"{family}.{name}.{b}")] if name else []
+            insts.append(m.counter(f"{family}.{b}"))
+            self._c[b] = insts
+        for b in histograms:
+            insts = [m.histogram(f"{family}.{name}.{b}")] if name else []
+            insts.append(m.histogram(f"{family}.{b}"))
+            self._h[b] = insts
+        for b in gauges:
+            insts = [m.gauge(f"{family}.{name}.{b}")] if name else []
+            insts.append(m.gauge(f"{family}.{b}"))
+            self._g[b] = insts
+
+    def count(self, base: str, n: int = 1) -> None:
+        for inst in self._c[base]:
+            inst.inc(n)
+
+    def observe(self, base: str, v: float) -> None:
+        for inst in self._h[base]:
+            inst.observe(v)
+
+    def set_gauge(self, base: str, v: float) -> None:
+        for g in self._g[base]:
+            g.set(v)
+
+    # reads come from the engine's OWN family (namespaced when named)
+    def counter_value(self, base: str) -> int:
+        return self._c[base][0].value
+
+    def hist_stats(self, base: str):
+        return self._h[base][0].stats()
+
+
+class _EngineInstruments(FamilyInstruments):
+    COUNTERS = ("requests", "rejected", "timeouts", "batches",
+                "dispatch_errors", "warmup_compiles")
+    HISTOGRAMS = ("batch_size", "queue_seconds", "device_seconds",
+                  "latency_seconds")
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__("serving", self.COUNTERS, self.HISTOGRAMS,
+                         ("queue_depth",), name)
+
+    def set_queue_depth(self, v: float) -> None:
+        self.set_gauge("queue_depth", v)
 
 
 # ---------------------------------------------------------------------------
@@ -160,7 +245,13 @@ class _ExecutorBackend:
         return self.runner.submit(feed)
 
     def wait(self, fut) -> List[np.ndarray]:
-        return [h.persist() for h in fut.handles()]
+        out = [h.persist() for h in fut.handles()]
+        # retire materialised entries from the async window: an idle
+        # engine must read executor.inflight_steps == 0, or the SLO
+        # watchdog sees phantom outstanding work and flips a healthy
+        # replica to `stalled` (the fleet would eject it)
+        self.runner.reap()
+        return out
 
     def warmup_run(self, feed) -> None:
         self.executor.run(self.program, feed=feed,
@@ -254,7 +345,12 @@ class ServingEngine:
                  max_inflight: Optional[int] = None,
                  auto_start: bool = True,
                  mesh=None,
-                 sharding=None):
+                 sharding=None,
+                 name: Optional[str] = None):
+        # per-engine instrument namespace (serving.<name>.* beside the
+        # process aggregate; None = the plain serving.* family)
+        self.name = name
+        self._ins = _EngineInstruments(name)
         self.max_batch = int(max_batch
                              or core.get_flag("serving_max_batch", 32))
         self.max_wait_us = int(max_wait_us if max_wait_us is not None
@@ -339,6 +435,12 @@ class ServingEngine:
         self._cv = threading.Condition()
         self._closed = False
         self._started = False
+        # pause()/resume() chaos+maintenance hook: cleared = the batcher
+        # holds every dispatch (admission keeps filling the queue, so a
+        # paused engine looks exactly like a wedged device to the SLO
+        # watchdog — the fleet drill's honest stall injection)
+        self._resume = threading.Event()
+        self._resume.set()
         self._lock = threading.Lock()
         self._batcher_t: Optional[threading.Thread] = None
         self._collector_t: Optional[threading.Thread] = None
@@ -359,8 +461,27 @@ class ServingEngine:
             self._collector_t.start()
         return self
 
+    def pause(self) -> None:
+        """Hold every dispatch (maintenance / chaos drills): admission
+        stays open, the queue fills, nothing reaches the device.  A
+        paused engine under load trips the SLO watchdog's stall verdict
+        — which is exactly what the fleet's ejection drill injects.
+        No-op after close(): a late pause must not re-wedge the batcher
+        close() is draining (nobody would be left to resume it)."""
+        if not self._closed:
+            self._resume.clear()
+
+    def resume(self) -> None:
+        self._resume.set()
+
+    def paused(self) -> bool:
+        return not self._resume.is_set()
+
     def close(self) -> None:
-        """Stop admitting, drain everything in flight, join threads."""
+        """Stop admitting, drain everything in flight, join threads.
+        Implies :meth:`resume` — a close must drain, never deadlock on a
+        paused batcher."""
+        self._resume.set()
         with self._lock:
             if self._closed:
                 return
@@ -434,7 +555,7 @@ class ServingEngine:
                 "executor.compile_cache_cold_miss").value - cold0,
             "seconds": round(time.perf_counter() - t0, 4),
         }
-        m.counter("serving.warmup_compiles").inc(report["compiles"])
+        self._ins.count("warmup_compiles", report["compiles"])
         self.warmup_report = report
         return report
 
@@ -445,7 +566,6 @@ class ServingEngine:
         leading (row) dim; raises :class:`QueueFullError` when the
         admission queue is at capacity and :class:`EngineClosedError`
         after close()."""
-        m = trace.metrics()
         if self._closed:
             raise EngineClosedError("ServingEngine is closed")
         if not self._started and self._auto_start:
@@ -485,7 +605,7 @@ class ServingEngine:
             try:
                 self._q.put_nowait(req)
             except queue.Full:
-                m.counter("serving.rejected").inc()
+                self._ins.count("rejected")
                 if _flight.enabled():
                     _flight.record_request(trace_id, n_rows,
                                            outcome="rejected")
@@ -496,8 +616,8 @@ class ServingEngine:
                 fut._reject(exc)
                 raise exc
         # admitted only (docs/observability.md): rejections don't count
-        m.counter("serving.requests").inc()
-        m.gauge("serving.queue_depth").set(self._q.qsize())
+        self._ins.count("requests")
+        self._ins.set_queue_depth(self._q.qsize())
         if trace.enabled():
             trace.instant("serving::admit", cat="serving",
                           args={"trace_id": trace_id, "rows": n_rows,
@@ -512,7 +632,7 @@ class ServingEngine:
 
     # -- batcher thread ------------------------------------------------------
     def _timeout_request(self, req: _Request) -> None:
-        trace.metrics().counter("serving.timeouts").inc()
+        self._ins.count("timeouts")
         waited_ms = (time.monotonic() - req.t_enqueue) * 1e3
         if trace.enabled():
             trace.complete("serving::queue", req.t_ns, cat="serving",
@@ -558,8 +678,7 @@ class ServingEngine:
                             drained += it.rows
                 except queue.Empty:
                     pass
-                trace.metrics().gauge("serving.queue_depth").set(
-                    self._q.qsize())
+                self._ins.set_queue_depth(self._q.qsize())
             now = time.monotonic()
             for item in items:
                 if item is _STOP:
@@ -610,8 +729,15 @@ class ServingEngine:
         feed = {n: (np.concatenate([r.feed[n] for r in live])
                     if np.ndim(live[0].feed[n]) >= 1 else live[0].feed[n])
                 for n in self.feed_names}
-        m = trace.metrics()
         tr_on = trace.enabled()
+        # paused (maintenance / chaos drill): hold the dispatch until
+        # resume() — close() resumes first, and the timed re-check makes
+        # a pause that races past close()'s resume unable to wedge the
+        # drain forever
+        while not self._resume.wait(0.1):
+            if self._closed:
+                self._resume.set()
+                break
         # the batch's causal identity: member request spans name it, the
         # executor::step span dispatched below inherits it through the
         # ambient trace context, and tools/timeline.py draws flow arrows
@@ -631,7 +757,7 @@ class ServingEngine:
                     _flight.record_request(r.trace_id, r.rows,
                                            outcome="error",
                                            batch_id=batch_id)
-            m.counter("serving.dispatch_errors").inc()
+            self._ins.count("dispatch_errors")
             return
         t_dispatch = time.monotonic()
         t_dispatch_ns = trace.now()
@@ -648,8 +774,8 @@ class ServingEngine:
                 args={"rows": rows, "n_requests": len(live),
                       "batch_id": batch_id, "bucket": bucket,
                       "request_ids": [r.trace_id for r in live]})
-        m.counter("serving.batches").inc()
-        m.histogram("serving.batch_size").observe(float(rows))
+        self._ins.count("batches")
+        self._ins.observe("batch_size", float(rows))
         with self._cv:
             self._completions.append(
                 (fut, live, rows, t_dispatch, batch_id, t_dispatch_ns,
@@ -658,7 +784,6 @@ class ServingEngine:
 
     # -- collector thread ----------------------------------------------------
     def _collector(self) -> None:
-        m = trace.metrics()
         while True:
             with self._cv:
                 while not self._completions:
@@ -677,13 +802,13 @@ class ServingEngine:
                         _flight.record_request(r.trace_id, r.rows,
                                                outcome="error",
                                                batch_id=batch_id)
-                m.counter("serving.dispatch_errors").inc()
+                self._ins.count("dispatch_errors")
                 continue
             t_done = time.monotonic()
             t_done_ns = trace.now()
             tr_on = trace.enabled()
             device_s = max(t_done - t_dispatch, 0.0)
-            m.histogram("serving.device_seconds").observe(device_s)
+            self._ins.observe("device_seconds", device_s)
             if tr_on:
                 trace.complete("serving::device", t_dispatch_ns,
                                cat="serving",
@@ -701,8 +826,8 @@ class ServingEngine:
                 off += r.rows
                 queue_s = max(t_dispatch - r.t_enqueue, 0.0)
                 latency_s = max(t_done - r.t_enqueue, 0.0)
-                m.histogram("serving.queue_seconds").observe(queue_s)
-                m.histogram("serving.latency_seconds").observe(latency_s)
+                self._ins.observe("queue_seconds", queue_s)
+                self._ins.observe("latency_seconds", latency_s)
                 if tr_on:
                     # the request's full span, closed at demux: the
                     # causal chain a trace_id reconstructs is
@@ -729,25 +854,26 @@ class ServingEngine:
     def stats(self) -> Dict[str, Any]:
         """Point-in-time SLO snapshot (counters + latency percentiles).
 
-        The ``serving.*`` instruments live on the PROCESS-global metrics
-        plane (the PR-1 registry every other subsystem shares, and what
-        /metrics scrapes): two engines in one process accumulate into
-        the same family, so per-engine attribution needs one engine per
-        process — the serving deployment shape — or a registry reset
-        between engines (tests)."""
-        m = trace.metrics()
+        Reads the engine's OWN instrument family: a named engine
+        (``name="r0"``) reads ``serving.r0.*`` — per-engine attribution
+        with several engines in one process (the fleet's in-process
+        replica shape) — while the unnamed default engine reads the
+        process-wide ``serving.*`` family (several UNNAMED engines in
+        one process still share it, the documented PR-8 limitation)."""
         out = {
-            "requests": m.counter("serving.requests").value,
-            "rejected": m.counter("serving.rejected").value,
-            "timeouts": m.counter("serving.timeouts").value,
-            "batches": m.counter("serving.batches").value,
-            "dispatch_errors": m.counter("serving.dispatch_errors").value,
+            "name": self.name,
+            "requests": self._ins.counter_value("requests"),
+            "rejected": self._ins.counter_value("rejected"),
+            "timeouts": self._ins.counter_value("timeouts"),
+            "batches": self._ins.counter_value("batches"),
+            "dispatch_errors": self._ins.counter_value("dispatch_errors"),
             "queue_depth": self._q.qsize(),
+            "paused": self.paused(),
             "buckets": list(self.bucket_edges),
         }
         for h in ("batch_size", "queue_seconds", "device_seconds",
                   "latency_seconds"):
-            st = m.histogram(f"serving.{h}").stats()
+            st = self._ins.hist_stats(h)
             out[h] = {k: st[k] for k in
                       ("count", "avg", "p50", "p95", "p99") if k in st}
         return out

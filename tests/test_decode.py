@@ -1,0 +1,262 @@
+"""Autoregressive decode plane: KV-cached join/leave batching
+bit-identical to sequential decode, carried-state executor support,
+prefill/decode buckets, lifecycle + instruments.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.fluid as fluid                          # noqa: E402
+from paddle_tpu.fluid import trace                        # noqa: E402
+from paddle_tpu.fluid.core import Scope, scope_guard      # noqa: E402
+from paddle_tpu.serving import decode                     # noqa: E402
+from paddle_tpu.serving.engine import QueueFullError      # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def model():
+    return decode.build_demo_decode_model(vocab=19, d_model=8,
+                                          max_len=16, seed=5)
+
+
+PROMPTS = [[3, 1, 4], [2, 7], [5, 9, 2, 6, 5], [1], [8, 8, 3, 1],
+           [4, 4]]
+BUDGETS = [5, 7, 4, 6, 3, 5]
+
+
+class TestJoinLeaveExactness:
+    def test_batched_bit_identical_to_sequential(self, model):
+        """THE decode acceptance property: continuous-batched decode
+        with requests joining/leaving mid-flight is bit-identical (CPU
+        path) to decoding each request alone — tokens AND every step's
+        logits — across prefill buckets (prompt lens 1..5 span buckets
+        1/2/8) and decode buckets (live count crosses 1/2/4)."""
+        seq = decode.decode_sequential(model, PROMPTS,
+                                       max_new_tokens=BUDGETS,
+                                       collect_logits=True, max_batch=4)
+        eng = decode.DecodeEngine(model, max_batch=4, collect_logits=True)
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=b)
+                    for p, b in zip(PROMPTS[:3], BUDGETS[:3])]
+            time.sleep(0.25)     # staggered joins: membership churns
+            futs += [eng.submit(p, max_new_tokens=b)
+                     for p, b in zip(PROMPTS[3:], BUDGETS[3:])]
+            batched = [f.result(timeout=180) for f in futs]
+        for i, (a, b) in enumerate(zip(seq, batched)):
+            assert np.array_equal(a["tokens"], b["tokens"]), \
+                (i, a["tokens"], b["tokens"])
+            assert np.array_equal(a["logits"], b["logits"]), \
+                (i, np.abs(a["logits"] - b["logits"]).max())
+        # the run genuinely crossed prefill buckets
+        prompts_buckets = {decode.compile_cache.bucket_for(
+            len(p), eng.prefill_edges) for p in PROMPTS}
+        assert len(prompts_buckets) >= 2
+
+    def test_eos_termination(self, model):
+        # find which token request [1] emits first, use it as EOS for a
+        # longer budget: generation must stop AT the eos token
+        probe = decode.decode_sequential(model, [[1]], max_new_tokens=6)
+        first = int(probe[0]["tokens"][0])
+        with decode.DecodeEngine(model, max_batch=2) as eng:
+            out = eng.generate([1], max_new_tokens=6, eos_id=first,
+                               timeout=60)
+        assert out["finish_reason"] == "eos"
+        assert out["tokens"].tolist() == [first]
+
+    def test_immediate_finish_never_occupies_slot(self, model):
+        with decode.DecodeEngine(model, max_batch=2) as eng:
+            out = eng.generate([3, 1], max_new_tokens=1, timeout=60)
+            assert len(out["tokens"]) == 1
+            assert out["finish_reason"] == "length"
+            assert eng.stats()["active_slots"] == 0
+
+
+class TestCarriedState:
+    def test_kv_stays_device_side(self, model):
+        eng = decode.DecodeEngine(model, max_batch=2)
+        with eng:
+            eng.generate([2, 7, 1], max_new_tokens=3, timeout=60)
+            kb = eng._scope.find_var(model.k_name)
+            # carried state is a live device array, never a numpy host
+            # round-trip between steps
+            assert not isinstance(kb, np.ndarray)
+            assert hasattr(kb, "devices") or hasattr(kb, "device")
+
+    def test_carry_vars_survive_prune_without_seeding(self):
+        """A non-persistable carry var whose write would otherwise be
+        pruned by the fetch-seeded compile IS written back — even when
+        the scope held no value at compile time."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            state = fluid.data("carry_st", [-1, 4])
+            y = fluid.layers.scale(x, scale=2.0)
+            fluid.layers.assign(y, output=state)
+            out = fluid.layers.reduce_sum(y, dim=[1])
+        main._hints["carry_vars"] = ("carry_st",)
+        exe = fluid.Executor()
+        with scope_guard(Scope()) as sc:
+            sc = fluid.global_scope()
+            exe.run(startup)
+            feed = {"x": np.ones((3, 4), "float32")}
+            exe.run(main, feed=feed, fetch_list=[out])
+            got = sc.find_var("carry_st")
+            assert got is not None
+            assert np.array_equal(np.asarray(got),
+                                  np.full((3, 4), 2.0, "float32"))
+
+    def test_carry_write_pruned_without_hint(self):
+        """Control: the SAME program without the hint prunes the unread
+        assign (nothing fetched depends on it, nothing seeded)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            state = fluid.data("carry_st2", [-1, 4])
+            y = fluid.layers.scale(x, scale=2.0)
+            fluid.layers.assign(y, output=state)
+            out = fluid.layers.reduce_sum(y, dim=[1])
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            sc = fluid.global_scope()
+            exe.run(startup)
+            exe.run(main, feed={"x": np.ones((3, 4), "float32")},
+                    fetch_list=[out])
+            assert sc.find_var("carry_st2") is None
+
+    def test_carry_var_exempt_from_batch_slicing(self):
+        """Under shape bucketing a fetched carry var keeps its full
+        capacity dim (it is state, not a batch row view)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.data("x", [-1, 4])
+            state = fluid.data("carry_st3", [-1, 4])
+            y = fluid.layers.scale(x, scale=3.0)
+            fluid.layers.assign(y, output=state)
+        main._hints["carry_vars"] = ("carry_st3",)
+        main._hints["shape_bucketing"] = True
+        main._hints["bucket_edges"] = [8]
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            ys, st = exe.run(main, feed={"x": np.ones((3, 4), "float32")},
+                             fetch_list=["carry_st3", "carry_st3"])
+            # both fetches of the carry var keep the BUCKET capacity
+            assert np.asarray(ys).shape[0] == 8
+            assert np.asarray(st).shape[0] == 8
+
+    def test_shape_bucketing_hint_veto(self):
+        """hints['shape_bucketing'] = False vetoes the global flag (the
+        decode engine pads its own slots)."""
+        fluid.core.set_flags({"FLAGS_shape_bucketing": True})
+        try:
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.data("x", [-1, 4])
+                y = fluid.layers.scale(x, scale=2.0)
+            main._hints["shape_bucketing"] = False
+            exe = fluid.Executor()
+            with scope_guard(Scope()):
+                exe.run(startup)
+                yv, = exe.run(main,
+                              feed={"x": np.ones((3, 4), "float32")},
+                              fetch_list=[y])
+                # no bucket padding happened anywhere: the fetch keeps
+                # the exact feed rows even mid-pipeline
+                assert np.asarray(yv).shape[0] == 3
+        finally:
+            fluid.core.set_flags({"FLAGS_shape_bucketing": False})
+
+
+class TestLifecycle:
+    def test_rejections(self, model):
+        eng = decode.DecodeEngine(model, max_batch=2, auto_start=False)
+        with pytest.raises(decode.DecodeRejectedError):
+            eng.submit([], max_new_tokens=2)
+        with pytest.raises(decode.DecodeRejectedError):
+            eng.submit([1] * 100, max_new_tokens=2)     # prompt too long
+        with pytest.raises(decode.DecodeRejectedError):
+            eng.submit([1, 2], max_new_tokens=100)      # budget too big
+        eng.close()
+
+    def test_queue_full_rejects_at_submit(self, model):
+        eng = decode.DecodeEngine(model, max_batch=1, queue_depth=2,
+                                  auto_start=False)
+        eng.submit([1], max_new_tokens=2)
+        eng.submit([2], max_new_tokens=2)
+        with pytest.raises(QueueFullError):
+            eng.submit([3], max_new_tokens=2)
+        assert trace.metrics().counter("decode.rejected").value >= 1
+        eng.close()     # never started: queued futures reject
+
+    def test_close_drains_queued_work(self, model):
+        eng = decode.DecodeEngine(model, max_batch=2)
+        eng.start()
+        futs = [eng.submit(p, max_new_tokens=3) for p in PROMPTS[:4]]
+        eng.close()     # planned drain: everything completes
+        for f in futs:
+            out = f.result(timeout=1)
+            assert len(out["tokens"]) == 3
+
+    def test_submit_after_close_raises(self, model):
+        eng = decode.DecodeEngine(model, max_batch=2)
+        eng.close()
+        from paddle_tpu.serving.engine import EngineClosedError
+        with pytest.raises(EngineClosedError):
+            eng.submit([1], max_new_tokens=2)
+
+    def test_warmup_then_zero_compiles_during_decode(self, model):
+        m = trace.metrics()
+        eng = decode.DecodeEngine(model, max_batch=2,
+                                  prefill_edges=[2, 4])
+        rep = eng.warmup(full=True)
+        assert rep["decode_buckets"] == [1, 2]
+        assert rep["prefill_buckets"] == [2, 4]
+        miss0 = m.counter("executor.compile_cache_miss").value
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=3)
+                    for p in ([1, 2], [3, 4, 5, 1])]
+            [f.result(timeout=120) for f in futs]
+        assert m.counter("executor.compile_cache_miss").value == miss0, \
+            "warmup(full=True) must precompile every bucket combination"
+
+    def test_stats_and_instruments(self, model):
+        m = trace.metrics()
+        eng = decode.DecodeEngine(model, max_batch=2, name="dx")
+        with eng:
+            eng.generate([3, 1], max_new_tokens=3, timeout=60)
+        st = eng.stats()
+        assert st["name"] == "dx"
+        assert st["requests"] >= 1 and st["tokens"] >= 3
+        assert st["leaves"] == st["joins"]
+        # named family + plain aggregate both moved
+        assert m.counter("decode.dx.requests").value >= 1
+        assert m.counter("decode.requests").value >= \
+            m.counter("decode.dx.requests").value
+        # /stats payload exposes the decode block
+        from paddle_tpu.fluid import metrics_export as mx
+        payload = mx.stats_payload()
+        assert "decode" in payload and payload["decode"]["tokens"] >= 3
+
+    def test_decode_counts_as_watchdog_progress(self, model):
+        """A decode process under load must read as live work to the
+        SLO watchdog: queued work -> outstanding, steps -> progress."""
+        from paddle_tpu.fluid import watchdog as wdog
+        wd = wdog.SloWatchdog(stall_s=3600.0, interval_s=3600.0)
+        trace.metrics().gauge("decode.queue_depth").set(2)
+        try:
+            assert wd._outstanding()
+        finally:
+            trace.metrics().gauge("decode.queue_depth").set(0)
+        p0 = wd._progress()
+        trace.metrics().counter("decode.steps").inc()
+        assert wd._progress() != p0

@@ -11,7 +11,7 @@ pytestmark = pytest.mark.slow
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLES = ["mnist_static.py", "bert_dygraph.py", "ctr_boxps.py",
-            "multi_chip.py"]
+            "multi_chip.py", "fleet_decode.py"]
 
 
 @pytest.mark.parametrize("script", EXAMPLES)

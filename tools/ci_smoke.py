@@ -784,6 +784,129 @@ def main():
           f"{p99*1e3:.1f}ms, 0 cold compiles under load, "
           f"{rejected} overload rejections OK", flush=True)
 
+    step("serving fleet: /healthz-verdict ejection + readmission, "
+         "kill mid-burst -> 0 lost + warm replacement")
+    import urllib.request as _urlG
+    from paddle_tpu.serving import fleet as FL
+    from paddle_tpu.fluid import trace as trG
+
+    fleet_dir = tempfile.mkdtemp(prefix="smoke-fleet-")
+    mG = trG.metrics()
+    flG = FL.ServingFleet(
+        spec=FL.demo_mlp_spec(watchdog_stall_s=0.5, queue_depth=64),
+        n_replicas=2, scrape_interval_s=0.15, missed_scrape_limit=2,
+        auto_replace=True,
+        persistent_cache_dir=os.path.join(fleet_dir, "cache"),
+        rpc_timeout_s=3.0, quiet_children=True)
+    try:
+        rngG = np.random.RandomState(3)
+        poolG = rngG.randn(16, 16).astype("float32")
+        fail0 = mG.counter("fleet.failures").value
+
+        def _wait(cond, timeout, what):
+            deadline = time.time() + timeout
+            while not cond():
+                assert time.time() < deadline, f"timed out: {what}"
+                time.sleep(0.05)
+
+        # mixed burst lands on BOTH replicas
+        futsG = [flG.submit({"x": poolG[: 1 + i % 8]}) for i in range(40)]
+        [f.result(timeout=60) for f in futsG]
+        assert {f.replica for f in futsG} == {"r0", "r1"}, \
+            {f.replica for f in futsG}
+
+        # gate A: VERDICT-driven ejection — wedge r0 (its batcher holds
+        # every dispatch), its own SLO watchdog flips /healthz to
+        # `stalled`, and the router ejects on that verdict while the
+        # process is alive and scrapes keep succeeding (NOT a
+        # router-local timeout)
+        r0 = flG._resolve("r0")
+        r0.pause()
+        futsA = [flG.submit({"x": poolG[: 1 + i % 8]}) for i in range(20)]
+        _wait(lambda: r0.state == "ejected", 30, "verdict ejection")
+        assert r0.ejected_reason == "stalled", r0.ejected_reason
+        assert r0.alive(), "verdict ejection needs a LIVE wedged replica"
+        hz = _urlG.urlopen(
+            f"http://127.0.0.1:{r0.metrics_port}/healthz",
+            timeout=5).read().decode().strip()
+        assert hz == "stalled", hz
+        outsA = [f.result(timeout=90) for f in futsA]
+        assert len(outsA) == 20     # redispatch preserved every request
+        r0.resume()
+        _wait(lambda: r0.state == "up", 30, "readmission after recovery")
+
+        # gate B: kill mid-burst — SIGKILL one replica while requests
+        # stream; zero accepted requests lost, replacement reaches
+        # serving with 0 cold compiles off the shared persistent cache
+        futsB = [flG.submit({"x": poolG[: 1 + i % 8]}) for i in range(10)]
+        victim = flG.kill_replica("r1")
+        futsB += [flG.submit({"x": poolG[: 1 + i % 8]})
+                  for i in range(30)]
+        outsB = [f.result(timeout=90) for f in futsB]
+        assert len(outsB) == 40
+        assert mG.counter("fleet.failures").value == fail0, \
+            "an accepted request was lost in the kill drill"
+        _wait(lambda: flG.events_of("replace"), 90, "warm replacement")
+        rep = flG.events_of("replace")[0]
+        assert (rep.get("warmup") or {}).get("cold_misses") == 0, rep
+        kills = flG.events_of("kill")
+        ejects = [e for e in flG.events_of("eject")
+                  if e["replica"] == victim.name]
+        eject_s = ejects[0]["t_mono"] - kills[0]["t_mono"]
+        # the replacement serves real traffic
+        _wait(lambda: len(flG.router.admitted()) >= 2, 30,
+              "replacement admitted")
+        futsC = [flG.submit({"x": poolG[:4]}) for _ in range(8)]
+        [f.result(timeout=60) for f in futsC]
+        redisp = mG.counter("fleet.redispatches").value
+    finally:
+        flG.close()
+        shutil.rmtree(fleet_dir, ignore_errors=True)
+    print(f"[smoke]   fleet: verdict eject+readmit (live /healthz -> "
+          f"'stalled'), kill drill 0/40 lost ({redisp} redispatches), "
+          f"eject {eject_s:.2f}s after SIGKILL, replacement warm "
+          f"(0 cold compiles) OK", flush=True)
+
+    step("decode: batched join/leave bit-identical to sequential "
+         "across prefill/decode buckets")
+    from paddle_tpu.serving import decode as DC
+
+    dmodel = DC.build_demo_decode_model(vocab=23, d_model=8, max_len=16,
+                                        seed=9)
+    dprompts = [[3, 1, 4], [2, 7], [5, 9, 2, 6, 5], [1], [8, 8, 3, 1],
+                [4, 4]]
+    dbudgets = [5, 7, 4, 6, 3, 5]
+    dseq = DC.decode_sequential(dmodel, dprompts,
+                                max_new_tokens=dbudgets,
+                                collect_logits=True, max_batch=4)
+    dengine = DC.DecodeEngine(dmodel, max_batch=4, collect_logits=True)
+    with dengine:
+        dfuts = [dengine.submit(p, max_new_tokens=b)
+                 for p, b in zip(dprompts[:3], dbudgets[:3])]
+        time.sleep(0.25)        # stagger: joins land mid-flight
+        dfuts += [dengine.submit(p, max_new_tokens=b)
+                  for p, b in zip(dprompts[3:], dbudgets[3:])]
+        dbatched = [f.result(timeout=180) for f in dfuts]
+    for i, (a, b) in enumerate(zip(dseq, dbatched)):
+        assert np.array_equal(a["tokens"], b["tokens"]), \
+            (i, a["tokens"], b["tokens"])
+        assert np.array_equal(a["logits"], b["logits"]), \
+            (i, float(np.abs(a["logits"] - b["logits"]).max()))
+    dstats = dengine.stats()
+    # the run crossed prefill buckets (prompt lens 1..5) and ran real
+    # join/leave churn (more prefills+steps than a single static batch)
+    from paddle_tpu.fluid import compile_cache as _cc
+    dbuckets = {_cc.bucket_for(len(p), dengine.prefill_edges)
+                for p in dprompts}
+    assert len(dbuckets) >= 2, dbuckets
+    assert dstats["joins"] >= len(dprompts) \
+        and dstats["leaves"] >= len(dprompts)
+    print(f"[smoke]   decode: {len(dprompts)} reqs "
+          f"({sum(dbudgets)} tokens) joining/leaving mid-flight "
+          f"bit-identical to sequential across {sorted(dbuckets)} "
+          f"prefill buckets, {dstats['steps']} batched steps OK",
+          flush=True)
+
     step("forensics: recorder overhead <=5%, induced stall -> one "
          "bundle, /healthz flips stalled and back")
     import urllib.request as _urlF
@@ -793,36 +916,46 @@ def main():
     from paddle_tpu.fluid import watchdog as wdog
 
     # gate 1: the always-on flight recorder must be provably cheap —
-    # a recorder-on demo loop within 5% of recorder-off (best-of-N
-    # epochs so a CI scheduler hiccup can't flip the gate)
-    def forensic_loop(rec_on, epochs=4, steps=30):
+    # a recorder-on demo loop within 5% of recorder-off.  Measurement
+    # discipline for busy CI boxes: PAIRED off/on epochs interleave over
+    # one warmed program (each pair shares one load window, so machine
+    # drift hits both variants), and the BEST pair's on/off ratio is
+    # the verdict — min-of-each-variant across separate blocks was
+    # biased whenever load ramped during the gate and flipped it flaky.
+    def forensic_overhead(pairs=6, steps=60):
         reset_unique_name()
         mpF, spF, loF = build_demo()
         exF = fluid.Executor()
-        walls = []
-        flrec.configure(enabled=rec_on)
+        ratios, walls = [], []
         try:
             with scope_guard(Scope()):
                 exF.run(spF)
                 exF.run(mpF, feed=demo_feed, fetch_list=[loF])  # warm
-                for _ in range(epochs):
-                    t0 = time.perf_counter()
-                    for _ in range(steps):
-                        exF.run(mpF, feed=demo_feed, fetch_list=[loF])
-                    walls.append(time.perf_counter() - t0)
+                for _ in range(pairs):
+                    pair = []
+                    for rec_on in (False, True):
+                        flrec.configure(enabled=rec_on)
+                        t0 = time.perf_counter()
+                        for _ in range(steps):
+                            exF.run(mpF, feed=demo_feed,
+                                    fetch_list=[loF])
+                        pair.append(time.perf_counter() - t0)
+                    ratios.append(pair[1] / pair[0])
+                    walls.append(pair)
         finally:
             flrec.configure(enabled=True)
-        return min(walls)
+        best = min(range(len(ratios)), key=lambda i: ratios[i])
+        return ratios[best], walls[best], pairs * steps
 
-    wall_off = forensic_loop(False)
-    wall_on = forensic_loop(True)
-    overhead = wall_on / wall_off - 1.0
-    assert wall_on <= wall_off * 1.05, \
-        (f"flight recorder added {overhead:.1%} to the demo loop "
-         f"({wall_off*1e3:.0f}ms -> {wall_on*1e3:.0f}ms; want <=5%)")
+    ratio_on, (wall_off, wall_on), n_on_steps = forensic_overhead()
+    overhead = ratio_on - 1.0
+    assert ratio_on <= 1.05, \
+        (f"flight recorder added {overhead:.1%} to the demo loop in "
+         f"EVERY off/on pair (best pair {wall_off*1e3:.0f}ms -> "
+         f"{wall_on*1e3:.0f}ms; want <=5%)")
     n_steps_rec = sum(1 for r in flrec.recorder().snapshot()
                       if r.get("kind") == "step")
-    assert n_steps_rec >= 30, n_steps_rec
+    assert n_steps_rec >= min(n_on_steps, 60), n_steps_rec
 
     # gate 2: an induced stall (a wedged dispatch: inflight > 0,
     # nothing completing) produces EXACTLY one valid bundle, and

@@ -15,6 +15,13 @@ Run:
     python tools/serve_bench.py --qps 500 --seconds 5 --sizes 1,2,4,8
     python tools/serve_bench.py --metrics-port 9100   # live /metrics
 
+Fleet mode (ROADMAP item 2's protocol — sustained fleet QPS/p99 under
+open-loop Poisson load with a replica KILLED mid-run; reports ejection
+latency, requests rerouted, and warm replacement spin-up as BENCH
+evidence):
+
+    python tools/serve_bench.py --fleet 3 --kill-replica-at 2.0
+
 Emits one JSON line (machine-readable, bench.py-style) and appends it
 to BENCH_evidence.json via bench.record_evidence on real accelerators.
 ``bench.py --model serve`` (child mode) rides this module for the
@@ -200,6 +207,131 @@ def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
     return report
 
 
+def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
+                kill_at=None, policy="least_queue", hidden=64,
+                max_batch=32, max_wait_us=2000, queue_depth=256,
+                cache_dir=None, watchdog_stall_s=2.0, deadline_ms=None,
+                seed=0):
+    """The kill-mid-run fleet protocol: N subprocess replicas behind the
+    router, open-loop Poisson load, SIGKILL one replica at ``kill_at``
+    seconds into the run (auto_replace spawns a warm replacement from
+    the shared persistent cache), wait every future out.  Reports
+    sustained QPS, latency percentiles, ejection latency, requests
+    rerouted, warm spin-up seconds, and (the invariant) how many
+    accepted requests were lost — which must be 0."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.fluid import trace
+    from paddle_tpu.serving import fleet as fleet_mod
+
+    own_cache = cache_dir is None
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix="serve-fleet-cache-")
+    m = trace.metrics()
+    spec = fleet_mod.demo_mlp_spec(
+        hidden=hidden, features=16, max_batch=max_batch,
+        max_wait_us=max_wait_us, queue_depth=queue_depth, seed=seed,
+        watchdog_stall_s=watchdog_stall_s)
+    t_up0 = time.perf_counter()
+    fl = fleet_mod.ServingFleet(
+        spec=spec, n_replicas=int(n_replicas), policy=policy,
+        auto_replace=True, persistent_cache_dir=cache_dir,
+        scrape_interval_s=0.25, missed_scrape_limit=2,
+        rpc_timeout_s=10.0, quiet_children=True)
+    fleet_up_s = time.perf_counter() - t_up0
+    rng = np.random.RandomState(1)
+    pool = rng.randn(max(sizes) * 4, 16).astype("float32")
+
+    def feed_of_rows(n):
+        off = rng.randint(0, len(pool) - n + 1)
+        return {"x": pool[off:off + n]}
+
+    kill_info = {}
+
+    def killer():
+        time.sleep(float(kill_at))
+        victims = [r for r in fl.router.replicas if r.state == "up"]
+        if victims:
+            v = fl.kill_replica(victims[0])
+            kill_info["name"] = v.name
+            kill_info["t_mono"] = time.monotonic()
+
+    redis0 = m.counter("fleet.redispatches").value
+    try:
+        kt = None
+        if kill_at is not None:
+            kt = threading.Thread(target=killer, daemon=True)
+            kt.start()
+        t0 = time.perf_counter()
+        futures, wall_submit, offered_s, rejected = run_open_loop(
+            fl, feed_of_rows, qps, n_requests, sizes,
+            deadline_ms=deadline_ms)
+        done, failed = collect(futures, timeout=180.0)
+        wall = time.perf_counter() - t0
+        if kt is not None:
+            kt.join(timeout=10)
+        # let the ejection + replacement land in the event log
+        deadline = time.time() + 90
+        while kill_at is not None and not fl.events_of("replace") \
+                and time.time() < deadline:
+            time.sleep(0.1)
+        lat = m.histogram("fleet.latency_seconds").stats()
+        rerouted = m.counter("fleet.redispatches").value - redis0
+        eject_latency = warm_spinup = replacement_cold = None
+        if kill_info:
+            ejects = [e for e in fl.events_of("eject")
+                      if e["replica"] == kill_info["name"]]
+            if ejects:
+                eject_latency = round(
+                    ejects[0]["t_mono"] - kill_info["t_mono"], 3)
+            reps = fl.events_of("replace")
+            if reps:
+                spawns = [e for e in fl.events_of("spawn")
+                          if e["replica"] == reps[0]["replica"]]
+                if spawns:
+                    warm_spinup = spawns[0]["spinup_s"]
+                w = reps[0].get("warmup") or {}
+                replacement_cold = w.get("cold_misses")
+        fstats = fl.stats()
+    finally:
+        fl.close()
+        if own_cache:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+    report = {
+        "metric": "fleet_sustained_qps",
+        "value": round(done / wall, 1) if wall > 0 else 0.0,
+        "unit": "req/s",
+        "replicas": int(n_replicas),
+        "policy": policy,
+        "offered_qps": round(qps, 1),
+        "requests": n_requests,
+        "completed": done,
+        # the invariant the kill drill proves: accepted requests lost
+        "lost": failed,
+        "rejected_at_submit": rejected,
+        "latency_ms": {
+            "p50": round(lat.get("p50", 0) * 1e3, 3),
+            "p95": round(lat.get("p95", 0) * 1e3, 3),
+            "p99": round(lat.get("p99", 0) * 1e3, 3),
+        },
+        "fleet_up_s": round(fleet_up_s, 3),
+        "kill_replica_at_s": kill_at,
+        "killed": kill_info.get("name"),
+        "ejection_latency_s": eject_latency,
+        "requests_rerouted": rerouted,
+        "warm_spinup_s": warm_spinup,
+        "replacement_cold_compiles": replacement_cold,
+        "ejections": fstats["ejections"],
+        "replacements": fstats["replacements"],
+        "config": {"max_batch": max_batch, "max_wait_us": max_wait_us,
+                   "queue_depth": queue_depth, "sizes": list(sizes),
+                   "hidden": hidden, "deadline_ms": deadline_ms,
+                   "watchdog_stall_s": watchdog_stall_s},
+    }
+    return report
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--qps", type=float, default=200.0,
@@ -216,6 +348,19 @@ def main(argv=None):
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve live /metrics during the run (0=ephemeral)")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="fleet mode: N subprocess replicas behind the "
+                         "router (paddle_tpu.serving.fleet)")
+    ap.add_argument("--kill-replica-at", type=float, default=None,
+                    metavar="T", help="fleet mode: SIGKILL one replica T "
+                    "seconds into the load (reports ejection latency, "
+                    "reroutes, warm spin-up)")
+    ap.add_argument("--policy", default="least_queue",
+                    choices=("least_queue", "round_robin"))
+    ap.add_argument("--cache-dir", default=None,
+                    help="fleet mode: shared persistent compile cache "
+                         "(default: a temp dir per run)")
+    ap.add_argument("--watchdog-stall-s", type=float, default=2.0)
     args = ap.parse_args(argv)
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -226,11 +371,21 @@ def main(argv=None):
     if args.seconds:
         n = max(1, int(args.qps * args.seconds))
     sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
-    report = serve_bench(
-        qps=args.qps, n_requests=n, sizes=sizes,
-        max_batch=args.max_batch, max_wait_us=args.max_wait_us,
-        queue_depth=args.queue_depth, hidden=args.hidden,
-        deadline_ms=args.deadline_ms, metrics_port=args.metrics_port)
+    if args.fleet:
+        report = fleet_bench(
+            n_replicas=args.fleet, qps=args.qps, n_requests=n,
+            sizes=sizes, kill_at=args.kill_replica_at,
+            policy=args.policy, hidden=args.hidden,
+            max_batch=args.max_batch, max_wait_us=args.max_wait_us,
+            queue_depth=args.queue_depth, cache_dir=args.cache_dir,
+            watchdog_stall_s=args.watchdog_stall_s,
+            deadline_ms=args.deadline_ms)
+    else:
+        report = serve_bench(
+            qps=args.qps, n_requests=n, sizes=sizes,
+            max_batch=args.max_batch, max_wait_us=args.max_wait_us,
+            queue_depth=args.queue_depth, hidden=args.hidden,
+            deadline_ms=args.deadline_ms, metrics_port=args.metrics_port)
 
     import bench
     report["backend"] = bench.backend_name()
