@@ -1,0 +1,375 @@
+"""Seeded, deterministic socket-level fault injection for the RPC plane.
+
+Reference: the reference stack's brpc dataplane survives real networks
+because real networks were part of its test loop.  Our TPU-native
+transport (``distributed/ps/rpc.py`` and the serving fleet riding its
+framing) runs on one host in CI, so the network half of the failure
+model — latency spikes, drops, partitions, corrupt frames, slow peers —
+has to be *injected*.  This module is that injection plane:
+
+* **Composable fault rules**, each scoped by endpoint pattern and time
+  window: ``latency`` (added delay), ``drop`` (frame blackhole),
+  ``reset`` (connection reset mid-send), ``partition`` (deny traffic to
+  matching endpoints for the window), ``corrupt`` (single-bit flip in
+  the frame payload), ``trickle`` (slow-peer byte dribble).
+* **Seeded determinism**: every rule owns its own ``random.Random``
+  seeded from ``(schedule seed, rule index)`` and draws one decision
+  per matching frame — the n-th decision of rule *k* is a pure function
+  of the seed, so the same seed against the same traffic injects the
+  same fault sequence (the chaos-drill replay contract).
+* **Observability**: every injection bumps ``fault.injected`` +
+  ``fault.<kind>`` counters; terminal faults (drop/reset/partition/
+  corrupt) also leave a flight-recorder ``fault`` marker and (when
+  tracing) a ``fault::inject`` instant, so a post-mortem bundle shows
+  what chaos was active when an incident fired.
+
+Install paths (all equivalent):
+
+* ``faultline.install(spec)`` in-process;
+* ``FLAGS_faultline`` env var (JSON spec, or ``@/path/to/spec.json``) —
+  picked up at import, which is how fleet replica *subprocesses*
+  inherit the schedule from their parent;
+* ``fluid.set_flags({"FLAGS_faultline": spec_json})`` at runtime.
+
+The hot path when no schedule is installed is one module-global read
+(``get() is None``) — the fault plane fully off is an exact no-op.
+
+Spec format (JSON-able)::
+
+    {"seed": 42, "faults": [
+        {"kind": "latency", "prob": 0.3, "ms": 10, "jitter_ms": 5},
+        {"kind": "drop", "prob": 0.02, "max_injections": 4},
+        {"kind": "corrupt", "prob": 1.0, "start_s": 1.0, "end_s": 1.5},
+        {"kind": "reset", "endpoint": "*:9000",
+         "start_s": 2.0, "end_s": 4.0},
+        {"kind": "partition", "endpoint": "local:*:9001"},
+        {"kind": "trickle", "prob": 0.05, "bytes_per_s": 65536}]}
+
+``endpoint`` is an fnmatch pattern against the REMOTE ``host:port``
+(default ``*``); a ``local:`` prefix matches the socket's local address
+instead (how a server-side rule targets replies without knowing client
+ephemeral ports).  ``start_s``/``end_s`` are seconds relative to
+install time.  See docs/robustness.md.
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..fluid import flight_recorder, trace
+
+__all__ = [
+    "FaultRule", "Faultline", "install", "uninstall", "get",
+    "apply_flags", "parse_spec", "KINDS",
+]
+
+KINDS = ("latency", "drop", "reset", "partition", "corrupt", "trickle")
+
+_m = trace.metrics()
+_c_total = _m.counter("fault.injected")
+_c_kind = {k: _m.counter(f"fault.{k}") for k in KINDS}
+
+# kinds worth an incident marker (latency/trickle flood the ring under
+# a hot schedule; their counters are the record)
+_MARKER_KINDS = frozenset(("drop", "reset", "partition", "corrupt"))
+
+
+class FaultRule:
+    """One fault kind + scope + seeded decision stream."""
+
+    def __init__(self, spec: Dict[str, Any], seed: int, idx: int):
+        self.kind = str(spec["kind"])
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {KINDS})")
+        self.prob = float(spec.get("prob", 1.0))
+        self.endpoint = str(spec.get("endpoint", "*"))
+        self.start_s = float(spec.get("start_s", 0.0))
+        self.end_s = float(spec.get("end_s", float("inf")))
+        self.max_injections = spec.get("max_injections")
+        self.ms = float(spec.get("ms", 0.0))
+        self.jitter_ms = float(spec.get("jitter_ms", 0.0))
+        self.bytes_per_s = float(spec.get("bytes_per_s", 65536.0))
+        self.chunk = int(spec.get("chunk", 512))
+        # per-rule rng: the n-th draw is a pure function of (seed, idx)
+        self._rng = random.Random((int(seed) * 1000003) ^ (idx * 7919))
+        self._lock = threading.Lock()
+        self.decisions = 0
+        self.injected = 0
+
+    # -- scope ---------------------------------------------------------------
+    def matches(self, peer: str, local: str, t_s: float) -> bool:
+        if not (self.start_s <= t_s < self.end_s):
+            return False
+        if self.endpoint.startswith("local:"):
+            return fnmatch.fnmatch(local, self.endpoint[len("local:"):])
+        return fnmatch.fnmatch(peer, self.endpoint)
+
+    # -- seeded decisions ----------------------------------------------------
+    def decide(self) -> bool:
+        """One decision draw.  The stream of outcomes depends only on
+        (seed, rule index, call count) — the determinism contract."""
+        with self._lock:
+            self.decisions += 1
+            if self.max_injections is not None \
+                    and self.injected >= int(self.max_injections):
+                return False
+            hit = self._rng.random() < self.prob
+            if hit:
+                self.injected += 1
+            return hit
+
+    def draw_latency_s(self) -> float:
+        with self._lock:
+            j = self._rng.uniform(0.0, self.jitter_ms) if self.jitter_ms \
+                else 0.0
+        return (self.ms + j) / 1e3
+
+    def draw_position(self, n: int) -> int:
+        with self._lock:
+            return self._rng.randrange(n)
+
+    def describe(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "prob": self.prob,
+                "endpoint": self.endpoint,
+                "window_s": [self.start_s,
+                             None if self.end_s == float("inf")
+                             else self.end_s],
+                "decisions": self.decisions, "injected": self.injected}
+
+
+class Faultline:
+    """An installed fault schedule: rules + the schedule clock.
+
+    ``send(sock, payload)`` replaces ``sock.sendall(payload)`` on the
+    framed transport; ``connect_check(endpoint)`` runs before a client
+    ``connect``.  Both are only reached when a schedule is installed —
+    the framing layer guards with ``faultline.get() is None``."""
+
+    def __init__(self, spec: Dict[str, Any], now_fn=time.monotonic):
+        spec = parse_spec(spec)
+        self.seed = int(spec.get("seed", 0))
+        self.rules: List[FaultRule] = [
+            FaultRule(r, self.seed, i)
+            for i, r in enumerate(spec.get("faults", []))]
+        self._now = now_fn
+        self.t0 = now_fn()
+
+    # -- bookkeeping ---------------------------------------------------------
+    def age_s(self) -> float:
+        return self._now() - self.t0
+
+    @property
+    def injected(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.rules:
+            out[r.kind] = out.get(r.kind, 0) + r.injected
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "age_s": round(self.age_s(), 3),
+                "injected": self.injected,
+                "rules": [r.describe() for r in self.rules]}
+
+    def decision_fingerprint(self, n: int = 100) -> tuple:
+        """The first ``n`` decision outcomes of every rule, drawn from
+        FRESH rngs (the live streams are untouched) — two schedules
+        with the same seed produce the same fingerprint.  What the
+        ci_smoke chaos gate asserts for same-seed replayability."""
+        out = []
+        for i, r in enumerate(self.rules):
+            rng = random.Random((self.seed * 1000003) ^ (i * 7919))
+            out.append(tuple(rng.random() < r.prob for _ in range(n)))
+        return tuple(out)
+
+    def _record(self, rule: FaultRule, endpoint: str) -> None:
+        _c_total.inc()
+        _c_kind[rule.kind].inc()
+        if rule.kind in _MARKER_KINDS:
+            flight_recorder.record("fault", fault=rule.kind,
+                                   endpoint=endpoint,
+                                   t_s=round(self.age_s(), 3))
+            if trace.enabled():
+                trace.instant("fault::inject", cat="comm",
+                              args={"kind": rule.kind,
+                                    "endpoint": endpoint})
+
+    # -- hooks ---------------------------------------------------------------
+    @staticmethod
+    def _addrs(sock) -> tuple:
+        try:
+            p = sock.getpeername()
+            peer = f"{p[0]}:{p[1]}"
+        except OSError:
+            peer = "?:?"
+        try:
+            l = sock.getsockname()
+            local = f"{l[0]}:{l[1]}"
+        except OSError:
+            local = "?:?"
+        return peer, local
+
+    def connect_check(self, endpoint: str) -> None:
+        """Pre-connect hook: latency delays the connect; a matching
+        drop/reset/partition refuses it (fast-fail stand-in for the
+        SYN blackhole — keeps drills inside their wall budget)."""
+        t = self.age_s()
+        for r in self.rules:
+            if not r.matches(endpoint, "?:?", t):
+                continue
+            if r.kind == "latency":
+                if r.decide():
+                    self._record(r, endpoint)
+                    time.sleep(r.draw_latency_s())
+            elif r.kind in ("drop", "reset", "partition"):
+                if r.decide():
+                    self._record(r, endpoint)
+                    raise ConnectionRefusedError(
+                        f"faultline: {r.kind} on connect to {endpoint}")
+
+    def send(self, sock, payload: bytes) -> None:
+        """Framed-transport send with the schedule applied.  Exactly
+        one frame per call: drop/partition discard it whole (the peer
+        sees silence, the caller's deadline machinery sees a timeout),
+        reset kills the connection, corrupt flips one bit past the
+        length prefix (so checksums, not framing luck, must catch it),
+        trickle dribbles it."""
+        peer, local = self._addrs(sock)
+        t = self.age_s()
+        active = [r for r in self.rules if r.matches(peer, local, t)]
+        lat = 0.0
+        terminal: Optional[FaultRule] = None
+        for r in active:
+            if r.kind == "latency":
+                if r.decide():
+                    lat += r.draw_latency_s()
+                    self._record(r, peer)
+            elif r.kind in ("drop", "partition", "reset"):
+                if terminal is None and r.decide():
+                    terminal = r
+                    self._record(r, peer)
+        if lat > 0:
+            time.sleep(lat)
+        if terminal is not None:
+            if terminal.kind in ("drop", "partition"):
+                return                  # blackhole: bytes never leave
+            try:                        # reset: abortive close
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(
+                f"faultline: reset on send to {peer}")
+        # only a frame that WILL be delivered may corrupt/trickle —
+        # injected-corrupt counts must equal receiver-side checksum
+        # detections (the chaos-gate accounting contract), so a frame a
+        # drop rule already blackholed never draws a corrupt decision
+        corrupt = [r for r in active if r.kind == "corrupt"
+                   and r.decide()]
+        for r in corrupt:
+            self._record(r, peer)
+        trickle: Optional[FaultRule] = None
+        for r in active:
+            if r.kind == "trickle" and r.decide():
+                trickle = r
+                self._record(r, peer)
+                break
+        if corrupt:
+            buf = bytearray(payload)
+            for r in corrupt:
+                if len(buf) > 8:
+                    # skip the 8-byte length/crc prefix: a flipped
+                    # LENGTH desyncs framing into a hang the checksum
+                    # can't attribute; a flipped PAYLOAD must be caught
+                    # by CRC — that is the property under test
+                    pos = 8 + r.draw_position(len(buf) - 8)
+                    bit = r.draw_position(8)
+                    buf[pos] ^= 1 << bit
+            payload = bytes(buf)
+        if trickle is not None:
+            rate = max(trickle.bytes_per_s, 1.0)
+            chunk = max(trickle.chunk, 1)
+            for off in range(0, len(payload), chunk):
+                sock.sendall(payload[off:off + chunk])
+                time.sleep(min(chunk / rate, 0.25))
+            return
+        sock.sendall(payload)
+
+
+# ---------------------------------------------------------------------------
+# module lifecycle
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_active: Optional[Faultline] = None
+
+
+def parse_spec(v) -> Dict[str, Any]:
+    """Accept a dict, a JSON string, or ``@/path`` / existing-path to a
+    JSON file (the env-var forms)."""
+    if isinstance(v, dict):
+        return v
+    s = str(v).strip()
+    if s.startswith("@"):
+        s = open(s[1:]).read()
+    elif os.path.exists(s):
+        s = open(s).read()
+    return json.loads(s)
+
+
+def install(spec, now_fn=time.monotonic) -> Faultline:
+    """Install (replacing any previous) fault schedule; returns it."""
+    global _active
+    fl = Faultline(spec, now_fn=now_fn)
+    with _lock:
+        _active = fl
+    flight_recorder.record("faultline", action="install", seed=fl.seed,
+                           rules=len(fl.rules))
+    return fl
+
+
+def uninstall() -> None:
+    global _active
+    with _lock:
+        was, _active = _active, None
+    if was is not None:
+        flight_recorder.record("faultline", action="uninstall",
+                               seed=was.seed,
+                               injected=sum(was.injected.values()))
+
+
+def get() -> Optional[Faultline]:
+    """The installed schedule, or None (the single-read hot-path
+    guard)."""
+    return _active
+
+
+def apply_flags() -> None:
+    """Reconcile with FLAGS_faultline (called from core.set_flags).
+    Unset/empty uninstalls."""
+    try:
+        from ..fluid import core
+        v = core.get_flag("faultline", None)
+    except Exception:               # noqa: BLE001 — flags are advisory
+        v = None
+    if v:
+        install(v)
+    else:
+        uninstall()
+
+
+# env auto-install: replica subprocesses inherit the parent's schedule
+# through their environment, so a chaos drill covers both directions
+if os.environ.get("FLAGS_faultline"):
+    try:
+        install(os.environ["FLAGS_faultline"])
+    except Exception as _e:         # noqa: BLE001 — a malformed spec
+        # must never crash every importing process (the whole fleet
+        # inherits this env var); warn and run without chaos
+        import sys as _sys
+        print(f"paddle_tpu.faultline: ignoring FLAGS_faultline "
+              f"({type(_e).__name__}: {_e})", file=_sys.stderr)

@@ -11,8 +11,27 @@ channel analog), and id-sharding across servers by `id % n_servers`
 (RoundRobin dispatcher semantics).
 
 Frame format (both directions):
-    u32 header_len | header json utf-8 | raw array bytes...
-header = {"op": str, ...meta, "arrays": [{"dtype": str, "shape": [...]}]}
+    u32 header_len | u32 header_crc32 | header json utf-8 | raw array bytes
+header = {"op": str, ...meta,
+          "arrays": [{"dtype": str, "shape": [...], "crc": u32}]}
+
+Robustness contract (docs/robustness.md):
+
+* every frame is CRC32-checksummed (header and each array separately) —
+  a flipped bit anywhere surfaces as a typed :class:`CorruptFrameError`,
+  never a torn ndarray;
+* declared sizes are bounded (``FLAGS_rpc_max_frame_bytes``) — a
+  garbage or hostile length prefix raises :class:`FrameTooLargeError`
+  instead of driving a multi-GB allocation;
+* clients carry a per-call deadline threaded into socket timeouts AND
+  propagated in the header (``deadline_ts``, same-host wall clock /
+  NTP-synced fleet) so servers shed already-expired work;
+* clients reconnect on reset and retry with exponential backoff +
+  jitter: idempotent ops freely, non-idempotent ops under a request-id
+  (``req_id``) the server dedups in a bounded window, so a retried
+  ``push_sparse``/``push_dense`` after an ack loss applies exactly once;
+* fault injection (``distributed/faultline.py``) hooks the send path of
+  this framing — the chaos drills exercise every clause above.
 """
 from __future__ import annotations
 
@@ -21,14 +40,61 @@ import socket
 import socketserver
 import struct
 import threading
+import time
+import uuid
+import zlib
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...fluid import flight_recorder, trace
+from .. import faultline
 from .table import (BarrierTable, CommonDenseTable, CommonSparseTable,
                     Initializer)
 
-_U32 = struct.Struct("!I")
+_FRAME_HDR = struct.Struct("!II")          # header_len, header_crc32
+_MAX_HEADER_BYTES = 1 << 20                # headers are small json
+
+
+class CorruptFrameError(ConnectionError):
+    """A frame failed its CRC32 (or was undecodable): the stream is
+    desynchronized and the connection must be dropped.  Subclasses
+    ConnectionError so existing transport-error handling (close +
+    reconnect + retry) covers it."""
+
+
+class FrameTooLargeError(CorruptFrameError):
+    """A declared header/array size exceeds the configured bound —
+    a garbage length prefix is treated like corruption, rejected
+    before any allocation."""
+
+
+class RpcDeadlineError(TimeoutError):
+    """The per-call deadline elapsed (client side) or the server shed
+    the already-expired request."""
+
+
+def _flag(name: str, default):
+    try:
+        from ...fluid import core
+        v = core.get_flag(name, default)
+        return default if v is None else v
+    except Exception:               # noqa: BLE001 — flags are advisory
+        return default
+
+
+def _max_frame_bytes() -> int:
+    return int(_flag("rpc_max_frame_bytes", 1 << 30))
+
+
+_m = trace.metrics()
+_c_corrupt = _m.counter("rpc.corrupt_frames")
+_c_oversize = _m.counter("rpc.oversized_frames")
+_c_retries = _m.counter("rpc.retries")
+_c_reconnects = _m.counter("rpc.reconnects")
+_c_shed = _m.counter("rpc.deadline_shed")
+_c_dedup = _m.counter("rpc.dedup_hits")
 
 
 # ---------------------------------------------------------------------------
@@ -50,28 +116,115 @@ def _recv_exact(sock, n: int) -> bytes:
     return bytes(buf)
 
 
+def connect_endpoint(host: str, port: int,
+                     timeout: Optional[float] = None) -> socket.socket:
+    """``socket.create_connection`` with the faultline connect hook —
+    every framed-transport client connects through here so partition/
+    reset windows cover connection establishment too."""
+    fl = faultline.get()
+    if fl is not None:
+        fl.connect_check(f"{host}:{int(port)}")
+    s = socket.create_connection((host, int(port)), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
 def send_msg(sock, header: dict, arrays: Sequence[np.ndarray] = ()):
     arrays = [np.ascontiguousarray(a) for a in arrays]
     header = dict(header)
-    header["arrays"] = [{"dtype": a.dtype.str, "shape": list(a.shape)}
-                        for a in arrays]
+    specs, views, total = [], [], 0
+    for a in arrays:
+        # zero-size arrays can't cast a strided memoryview — they carry
+        # no bytes anyway
+        v = memoryview(a).cast("B") if a.nbytes else memoryview(b"")
+        specs.append({"dtype": a.dtype.str, "shape": list(a.shape),
+                      "crc": zlib.crc32(v)})
+        views.append(v)
+        total += a.nbytes
+    header["arrays"] = specs
     hb = json.dumps(header).encode()
-    parts = [_U32.pack(len(hb)), hb]
-    parts += [memoryview(a).cast("B") for a in arrays]
-    sock.sendall(b"".join(parts))
+    if len(hb) > _MAX_HEADER_BYTES:
+        raise ValueError(f"rpc header of {len(hb)} bytes exceeds "
+                         f"{_MAX_HEADER_BYTES}")
+    if total > _max_frame_bytes():
+        raise ValueError(
+            f"rpc frame of {total} array bytes exceeds "
+            f"FLAGS_rpc_max_frame_bytes={_max_frame_bytes()}")
+    payload = b"".join([_FRAME_HDR.pack(len(hb), zlib.crc32(hb)), hb,
+                        *views])
+    fl = faultline.get()
+    if fl is not None:
+        fl.send(sock, payload)
+    else:
+        sock.sendall(payload)
 
 
-def recv_msg(sock):
-    (hlen,) = _U32.unpack(_recv_exact(sock, 4))
-    header = json.loads(_recv_exact(sock, hlen))
-    arrays = []
-    for spec in header.pop("arrays", []):
+def recv_msg(sock, max_frame_bytes: Optional[int] = None):
+    limit = int(max_frame_bytes if max_frame_bytes is not None
+                else _max_frame_bytes())
+    hlen, hcrc = _FRAME_HDR.unpack(_recv_exact(sock, 8))
+    if hlen > min(_MAX_HEADER_BYTES, limit):
+        _c_corrupt.inc()
+        _c_oversize.inc()
+        raise FrameTooLargeError(
+            f"declared header of {hlen} bytes exceeds bound "
+            f"{min(_MAX_HEADER_BYTES, limit)}")
+    hb = _recv_exact(sock, hlen)
+    if zlib.crc32(hb) != hcrc:
+        _c_corrupt.inc()
+        raise CorruptFrameError("header checksum mismatch")
+    try:
+        header = json.loads(hb)
+    except ValueError as e:         # crc passed but json broken: treat
+        _c_corrupt.inc()            # as corruption, not a caller bug
+        raise CorruptFrameError(f"undecodable header: {e}") from e
+    arrays, total = [], 0
+    for i, spec in enumerate(header.pop("arrays", [])):
+        try:
+            shape = tuple(int(d) for d in spec["shape"])
+            dt = np.dtype(spec["dtype"])
+        except (KeyError, TypeError, ValueError) as e:
+            _c_corrupt.inc()
+            raise CorruptFrameError(f"bad array spec {i}: {e}") from e
+        if any(d < 0 for d in shape):
+            _c_corrupt.inc()
+            raise CorruptFrameError(f"negative dim in array {i}")
+        nbytes = dt.itemsize
+        for d in shape:
+            nbytes *= d
+        total += nbytes
+        if total > limit:
+            # bound BEFORE the allocation: a hostile/garbage size never
+            # drives a multi-GB bytearray
+            _c_corrupt.inc()
+            _c_oversize.inc()
+            raise FrameTooLargeError(
+                f"declared frame of {total} bytes exceeds "
+                f"FLAGS_rpc_max_frame_bytes={limit}")
         # recv straight into the destination buffer: one traversal, owned
         # and writable (the design's one-memcpy-per-array contract)
-        a = np.empty(tuple(spec["shape"]), np.dtype(spec["dtype"]))
-        _recv_into(sock, memoryview(a).cast("B"))
+        a = np.empty(shape, dt)
+        if nbytes:
+            view = memoryview(a).cast("B")
+            _recv_into(sock, view)
+            crc = spec.get("crc")
+            if crc is not None and zlib.crc32(view) != int(crc):
+                _c_corrupt.inc()
+                raise CorruptFrameError(f"array {i} checksum mismatch")
         arrays.append(a)
     return header, arrays
+
+
+# ops safe to blind-retry (re-execution is a no-op or pure read) vs ops
+# that need the server-side req_id dedup window to retry safely
+_IDEMPOTENT_OPS = frozenset((
+    "ping", "pull_sparse", "pull_dense", "create_sparse", "create_dense",
+    "set_dense", "save", "size", "heartbeat", "stop", "shrink",
+))
+_DEDUP_OPS = frozenset((
+    "push_sparse", "push_dense", "push_sparse_delta", "push_dense_delta",
+    "put_blob", "take_blobs", "end_day",
+))
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +259,16 @@ class PsServer:
         self._hb_stop = threading.Event()
         self.dead_ranks: set = set()
         self._stop = threading.Event()
+        # event log (the fleet.events shape): worker_dead/worker_recovered/
+        # all_workers_dead transitions with timestamps
+        self.events: List[Dict] = []
+        self._ev_lock = threading.Lock()
+        # req_id -> (reply, arrays) dedup window: a retried non-idempotent
+        # op whose ack was lost returns the cached reply instead of
+        # double-applying (exactly-once for push_sparse/push_dense)
+        self._dedup: "OrderedDict[str, tuple]" = OrderedDict()
+        self._dedup_lock = threading.Lock()
+        self._dedup_cap = int(_flag("rpc_dedup_window", 1024))
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -114,16 +277,75 @@ class PsServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 try:
                     while True:
-                        header, arrays = recv_msg(sock)
                         try:
-                            reply, out = outer._dispatch(header, arrays)
-                        except Exception as e:   # noqa: BLE001 — report,
-                            # don't kill the connection on a bad request
-                            reply, out = {"ok": False,
-                                          "error": f"{type(e).__name__}: "
-                                                   f"{e}"}, []
+                            header, arrays = recv_msg(sock)
+                        except CorruptFrameError:
+                            # counted in recv_msg; the stream is
+                            # desynchronized — drop the connection, the
+                            # client reconnects and retries
+                            return
+                        op = header.get("op")
+                        rid = header.get("req_id")
+                        reply = out = None
+                        owner = False
+                        if rid is not None:
+                            entry = outer._dedup_claim(rid)
+                            if entry[0] == "wait":
+                                # the original attempt is still
+                                # executing: wait it out, then replay
+                                # its ack
+                                entry[1].wait(timeout=60.0)
+                                entry = outer._dedup_claim(rid)
+                            if entry[0] == "done":
+                                _c_dedup.inc()
+                                reply, out = entry[1], entry[2]
+                            elif entry[0] == "wait":
+                                # original wedged past the wait bound:
+                                # NEVER execute concurrently with it —
+                                # exactly-once beats availability here
+                                reply, out = {
+                                    "ok": False, "retryable": True,
+                                    "error": "RetryPendingError",
+                                    "message": f"{op} req {rid} still "
+                                               f"executing"}, []
+                            else:
+                                owner = True
+                        if reply is None:
+                            dl = header.get("deadline_ts")
+                            if dl is not None and op != "stop" \
+                                    and time.time() > float(dl):
+                                # already expired in transit/queue: shed
+                                # instead of doing dead work
+                                _c_shed.inc()
+                                reply, out = {
+                                    "ok": False, "shed": True,
+                                    "error": "DeadlineExceededError",
+                                    "message": f"deadline expired before "
+                                               f"{op} dispatch"}, []
+                                if owner:
+                                    outer._dedup_abort(rid)
+                            else:
+                                try:
+                                    reply, out = outer._dispatch(header,
+                                                                 arrays)
+                                except Exception as e:  # noqa: BLE001 —
+                                    # report, don't kill the connection
+                                    # on a bad request
+                                    reply, out = {
+                                        "ok": False,
+                                        "error": f"{type(e).__name__}: "
+                                                 f"{e}"}, []
+                                    if owner:
+                                        outer._dedup_abort(rid)
+                                else:
+                                    if owner:
+                                        if reply.get("ok"):
+                                            outer._dedup_done(rid, reply,
+                                                              out)
+                                        else:
+                                            outer._dedup_abort(rid)
                         send_msg(sock, reply, out)
-                        if header.get("op") == "stop":
+                        if op == "stop":
                             break
                 except (ConnectionError, OSError):
                     pass
@@ -244,11 +466,64 @@ class PsServer:
             return {"ok": True}, []
         return {"ok": False, "error": f"unknown op {op}"}, []
 
+    # -- dedup window --------------------------------------------------------
+    # entries: rid -> ("pending", Event) while the first attempt is
+    # still executing, then ("done", reply, out).  A duplicate that
+    # lands while the original is IN FLIGHT (attempt-timeout retry under
+    # a latency/trickle fault) must wait for the original, not apply a
+    # second time — exactly-once covers in-flight races, not just lost
+    # acks.
+    def _dedup_claim(self, rid: str) -> tuple:
+        """("owner",) — caller executes and must settle with
+        _dedup_done/_dedup_abort; ("done", reply, out) — replay the
+        cached ack; ("wait", event) — the original is executing."""
+        with self._dedup_lock:
+            e = self._dedup.get(rid)
+            if e is None:
+                self._dedup[rid] = ("pending", threading.Event())
+                return ("owner",)
+            if e[0] == "done":
+                return e
+            return ("wait", e[1])
+
+    def _dedup_done(self, rid: str, reply, out):
+        with self._dedup_lock:
+            prev = self._dedup.pop(rid, None)
+            self._dedup[rid] = ("done", reply, out)
+            if len(self._dedup) > self._dedup_cap:
+                # evict oldest DONE entries only — a pending entry is a
+                # live execution some waiter may be parked on
+                for k in list(self._dedup):
+                    if len(self._dedup) <= self._dedup_cap:
+                        break
+                    if self._dedup[k][0] == "done" and k != rid:
+                        del self._dedup[k]
+        if prev is not None and prev[0] == "pending":
+            prev[1].set()
+
+    def _dedup_abort(self, rid: str):
+        """The owning attempt failed or was shed: clear the entry so a
+        retry with fresh budget can still apply."""
+        with self._dedup_lock:
+            prev = self._dedup.pop(rid, None)
+        if prev is not None and prev[0] == "pending":
+            prev[1].set()
+
+    # -- events --------------------------------------------------------------
+    def _event(self, kind: str, **fields):
+        ev = {"t_mono": time.monotonic(), "ts": time.time(),
+              "kind": kind, "shard": self.shard_idx, **fields}
+        with self._ev_lock:
+            self.events.append(ev)
+
+    def events_of(self, kind: str) -> List[Dict]:
+        with self._ev_lock:
+            return [e for e in self.events if e["kind"] == kind]
+
     # -- worker liveness ----------------------------------------------------
     def dead_workers(self, timeout: float) -> List[int]:
         """Ranks that heartbeated at least once and then went silent for
         longer than `timeout` seconds."""
-        import time
         now = time.monotonic()
         with self._hb_lock:
             return sorted(r for r, t in self._heartbeats.items()
@@ -258,10 +533,14 @@ class PsServer:
                                 interval: float = 2.0):
         """heart_beat_monitor.cc analog: watch trainer liveness; when every
         known trainer has gone silent, stop serving so the pod tears down
-        instead of hanging on a dead job.  Individual deaths are recorded
-        in `dead_ranks` and logged."""
+        instead of hanging on a dead job.  Individual deaths land in
+        `dead_ranks`, the `ps.dead_workers` gauge (live on /metrics),
+        `PsServer.events`, and flight-recorder markers — silent worker
+        loss is visible to scrapers, not just via this callback."""
         import sys
-        import time
+
+        g_dead = _m.gauge("ps.dead_workers")
+        c_deaths = _m.counter("ps.worker_deaths")
 
         def watch():
             while not self._hb_stop.wait(interval):
@@ -272,7 +551,16 @@ class PsServer:
                     print(f"ps shard {self.shard_idx}: trainer {r} missed "
                           f"heartbeats for >{timeout}s — marking dead",
                           file=sys.stderr)
+                    c_deaths.inc()
+                    self._event("worker_dead", rank=r)
+                    flight_recorder.record("worker_dead", rank=r,
+                                           shard=self.shard_idx)
+                for r in sorted(self.dead_ranks - dead):
+                    self._event("worker_recovered", rank=r)
+                    flight_recorder.record("worker_recovered", rank=r,
+                                           shard=self.shard_idx)
                 self.dead_ranks = dead
+                g_dead.set(len(dead))
                 # "all dead" needs the full expected pod to have checked in
                 # once — a late-starting trainer that never beat must not
                 # count as dead, or a healthy job gets torn down
@@ -280,6 +568,10 @@ class PsServer:
                         and len(known) >= self.n_trainers):
                     print(f"ps shard {self.shard_idx}: ALL trainers dead — "
                           f"shutting down", file=sys.stderr)
+                    self._event("all_workers_dead", ranks=sorted(known))
+                    flight_recorder.record("incident",
+                                           reason="all_workers_dead",
+                                           shard=self.shard_idx)
                     self._stop.set()
                     return
 
@@ -298,11 +590,16 @@ class PsServer:
         """Block until a client sends `stop` (run_server serving loop)."""
         self._stop.wait()
         self._server.shutdown()
+        self._server.server_close()
 
     def stop(self):
         self._hb_stop.set()
         self._stop.set()
         self._server.shutdown()
+        # release the listening socket too — a restarted server must be
+        # able to rebind the port immediately (the server-restart
+        # reconnect drill)
+        self._server.server_close()
 
 
 # ---------------------------------------------------------------------------
@@ -311,24 +608,50 @@ class PsServer:
 
 class PsClient:
     """Partitions ids over server shards and moves rows/grads on raw
-    sockets (brpc_ps_client.cc analog)."""
+    sockets (brpc_ps_client.cc analog).
 
-    def __init__(self, endpoints: Sequence[str], timeout=60.0):
+    Resilience (docs/robustness.md): every call carries a deadline
+    (socket timeout + ``deadline_ts`` header for server-side shedding);
+    transport failures close the poisoned socket, reconnect, and retry
+    with exponential backoff + jitter — blind retries for idempotent
+    ops, ``req_id``-deduped retries for pushes (exactly-once), and a
+    single send-phase retry for everything else (a connection that died
+    idle — server restart, kept-alive reset — never surfaces a raw
+    ConnectionError to the caller)."""
+
+    def __init__(self, endpoints: Sequence[str], timeout=60.0,
+                 retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None):
         self.endpoints = list(endpoints)
         self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
         self._locks = [threading.Lock() for _ in endpoints]
         self.timeout = timeout
+        self.retries = int(retries if retries is not None
+                           else _flag("rpc_retries", 3))
+        self.backoff_ms = float(backoff_ms if backoff_ms is not None
+                                else _flag("rpc_backoff_ms", 25.0))
         self._sparse_dims: Dict[str, int] = {}
+        # req_id namespace: unique per client instance across processes
+        self._client_id = uuid.uuid4().hex[:12]
+        self._req_n = 0
+        self._req_lock = threading.Lock()
+        import random as _random
+        self._jitter = _random.Random()
 
-    def _sock(self, i):
+    def _next_req_id(self) -> str:
+        with self._req_lock:
+            self._req_n += 1
+            return f"{self._client_id}-{self._req_n}"
+
+    def _sock(self, i, budget_s: Optional[float] = None):
         if self._socks[i] is None:
-            import time
             host, port = self.endpoints[i].rsplit(":", 1)
-            deadline = time.monotonic() + self.timeout
+            deadline = time.monotonic() + min(self.timeout,
+                                              budget_s or self.timeout)
             while True:
                 try:
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=self.timeout)
+                    s = connect_endpoint(host, int(port),
+                                         timeout=self.timeout)
                     break
                 except OSError:
                     # server process may still be starting (brpc clients
@@ -336,29 +659,82 @@ class PsClient:
                     if time.monotonic() >= deadline:
                         raise
                     time.sleep(0.3)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]
 
-    def _call(self, i, header, arrays=()):
-        with self._locks[i]:
+    def _drop_sock(self, i):
+        if self._socks[i] is not None:
             try:
-                sock = self._sock(i)
-                send_msg(sock, header, arrays)
-                reply, out = recv_msg(sock)
-            except (OSError, ConnectionError):
-                # drop the poisoned socket so the next call reconnects
-                if self._socks[i] is not None:
+                self._socks[i].close()
+            except OSError:
+                pass
+            self._socks[i] = None
+            _c_reconnects.inc()
+
+    def _call(self, i, header, arrays=(), deadline_s=None):
+        op = header["op"]
+        deadline = time.monotonic() + (deadline_s if deadline_s is not None
+                                       else self.timeout)
+        retryable = op in _IDEMPOTENT_OPS or op in _DEDUP_OPS
+        hdr = dict(header)
+        if op in _DEDUP_OPS and "req_id" not in hdr:
+            # one id per LOGICAL call, stable across retries — the
+            # server's dedup window makes the retry exactly-once
+            hdr["req_id"] = self._next_req_id()
+        max_attempts = 1 + self.retries if retryable else 1
+        attempt = 0
+        while True:
+            attempt += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RpcDeadlineError(
+                    f"ps rpc {op} to {self.endpoints[i]}: deadline "
+                    f"elapsed after {attempt - 1} attempts")
+            # split the remaining budget across the attempts still
+            # allowed, so one blackholed reply can't eat the whole
+            # deadline (non-retryable ops keep the full window; the
+            # send-phase free retry can push attempt past max_attempts)
+            att_timeout = max(
+                remaining / max(max_attempts - attempt + 1, 1), 0.05)
+            send_done = False
+            try:
+                with self._locks[i]:
                     try:
-                        self._socks[i].close()
-                    except OSError:
-                        pass
-                    self._socks[i] = None
-                raise
-        if not reply.get("ok", False):
-            raise RuntimeError(f"ps rpc {header['op']} failed on "
-                               f"{self.endpoints[i]}: {reply}")
-        return reply, out
+                        sock = self._sock(i, budget_s=remaining)
+                        sock.settimeout(min(att_timeout, self.timeout))
+                        hdr["deadline_ts"] = time.time() + remaining
+                        send_msg(sock, hdr, arrays)
+                        send_done = True
+                        reply, out = recv_msg(sock)
+                    except (OSError, ConnectionError):
+                        # drop the poisoned socket UNDER the shard lock:
+                        # released first, a concurrent caller could
+                        # check out the desynchronized stream and read
+                        # this call's late reply as its own
+                        self._drop_sock(i)
+                        raise
+            except (OSError, ConnectionError):
+                # a send-phase failure means the server never saw the
+                # request (connection died idle / reset on write): one
+                # free retry even for non-retryable ops
+                can_retry = (retryable and attempt < max_attempts) \
+                    or (not send_done and attempt == 1)
+                if not can_retry:
+                    raise
+                _c_retries.inc()
+                backoff = (self.backoff_ms / 1e3) * (2 ** (attempt - 1))
+                backoff *= 0.5 + 0.5 * self._jitter.random()
+                time.sleep(min(backoff,
+                               max(deadline - time.monotonic(), 0.0)))
+                continue
+            if not reply.get("ok", False):
+                if reply.get("error") == "DeadlineExceededError":
+                    raise RpcDeadlineError(
+                        f"ps rpc {op} on {self.endpoints[i]}: "
+                        f"{reply.get('message', 'deadline exceeded')}")
+                raise RuntimeError(f"ps rpc {op} failed on "
+                                   f"{self.endpoints[i]}: {reply}")
+            return reply, out
 
     def _fanout(self, op_name, shard_fn, shards=None):
         """Run shard_fn(i) on each shard index in parallel; raise if any

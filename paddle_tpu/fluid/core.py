@@ -247,6 +247,29 @@ _FLAGS: Dict[str, object] = {
     # how many trailing trace events a bundle embeds
     "diagnostic_trace_tail": int(_os.environ.get(
         "FLAGS_diagnostic_trace_tail", "5000") or 5000),
+    # chaos/robustness plane (distributed/faultline.py + ps/rpc.py +
+    # serving/fleet.py, docs/robustness.md).  faultline installs a
+    # seeded socket-level fault-injection schedule (JSON spec or @path;
+    # replica subprocesses inherit it via the env var).  The rpc_* knobs
+    # bound the hardened framing: max_frame_bytes rejects garbage/
+    # hostile length prefixes before allocation, retries/backoff_ms
+    # shape the client retry policy (exponential + jitter), and
+    # dedup_window sizes the server's req_id window that makes retried
+    # non-idempotent pushes exactly-once.  fleet_breaker_* shape the
+    # per-replica circuit breaker (consecutive transport failures to
+    # open; cooldown before the half-open probe; 0 failures disables).
+    "faultline": _os.environ.get("FLAGS_faultline") or None,
+    "rpc_max_frame_bytes": int(_os.environ.get(
+        "FLAGS_rpc_max_frame_bytes", str(1 << 30))),
+    "rpc_retries": int(_os.environ.get("FLAGS_rpc_retries", "3")),
+    "rpc_backoff_ms": float(_os.environ.get(
+        "FLAGS_rpc_backoff_ms", "25")),
+    "rpc_dedup_window": int(_os.environ.get(
+        "FLAGS_rpc_dedup_window", "1024")),
+    "fleet_breaker_failures": int(_os.environ.get(
+        "FLAGS_fleet_breaker_failures", "5") or 5),
+    "fleet_breaker_cooldown_s": float(_os.environ.get(
+        "FLAGS_fleet_breaker_cooldown_s", "3.0") or 3.0),
     # kernel tier (fluid/passes/kernel_tier.py, ops/attention.py): minimum
     # sequence length before attention dispatches to the Pallas flash
     # kernel.  Default 1024 — measured on the round-3 BERT sweep: at seq
@@ -321,6 +344,10 @@ def set_flags(flags: Dict[str, object]):
         elif k == "watchdog":
             from . import watchdog
             watchdog.apply_flags()
+        elif k == "faultline":
+            # install/replace/uninstall the fault-injection schedule
+            from ..distributed import faultline
+            faultline.apply_flags()
 
 
 def get_flags(names):

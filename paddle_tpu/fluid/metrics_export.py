@@ -192,6 +192,23 @@ def stats_payload() -> Dict[str, Any]:
             "active_slots": _gauge("decode.active_slots"),
             "queue_depth": _gauge("decode.queue_depth"),
         }
+    # transport-robustness truth (docs/robustness.md): checksum-caught
+    # corruptions, retries, deadline sheds, and injected faults — how a
+    # chaos drill audits "every corruption detected" across the fleet
+    # without reaching into replica processes
+    rpc = {k: _counter(f"rpc.{k}")
+           for k in ("corrupt_frames", "oversized_frames", "retries",
+                     "reconnects", "deadline_shed", "dedup_hits")}
+    if any(rpc.values()):
+        out["rpc"] = rpc
+    injected = _counter("fault.injected")
+    if injected:
+        out["faults"] = {"injected": injected}
+        for k in ("latency", "drop", "reset", "partition", "corrupt",
+                  "trickle"):
+            n = _counter(f"fault.{k}")
+            if n:
+                out["faults"][k] = n
     return out
 
 

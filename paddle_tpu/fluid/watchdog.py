@@ -118,6 +118,22 @@ def _device_footprints() -> List[Dict[str, Any]]:
         return []
 
 
+def _faultline_state() -> Optional[Dict[str, Any]]:
+    """The active fault-injection schedule, if any — an incident bundle
+    must say what chaos was deliberately being injected when it fired,
+    or a responder debugs the fault plane as a production failure.
+    Only reads an ALREADY-imported faultline module: a bundle dump
+    never pulls in the distributed package."""
+    mod = sys.modules.get("paddle_tpu.distributed.faultline")
+    if mod is None:
+        return None
+    try:
+        fl = mod.get()
+        return fl.describe() if fl is not None else None
+    except Exception:               # noqa: BLE001 — forensics degrade
+        return None
+
+
 def _program_fingerprints(wide_events) -> List[str]:
     return sorted({r["fp"] for r in wide_events
                    if r.get("kind") == "step" and r.get("fp")})
@@ -173,6 +189,7 @@ def _dump_bundle(reason, diagnostic_dir, exc, extra, trace_tail,
         "metrics": _json_safe(trace.metrics().snapshot()),
         "device_footprints": _device_footprints(),
         "program_fingerprints": _program_fingerprints(wide),
+        "faultline": _faultline_state(),
     }
     if exc is not None:
         doc["exception"] = {

@@ -54,6 +54,7 @@ __all__ = [
     "ServingFleet", "Router", "ReplicaHandle", "FleetFuture",
     "ReplicaServer", "serve_replica", "build_engine_from_spec",
     "demo_mlp_spec", "NoReplicaError", "ReplicaTransportError",
+    "CircuitBreaker",
 ]
 
 
@@ -141,7 +142,8 @@ class ReplicaServer:
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, info: Optional[Dict[str, Any]] = None):
-        from ..distributed.ps.rpc import recv_msg, send_msg
+        from ..distributed.ps.rpc import (CorruptFrameError, recv_msg,
+                                          send_msg)
         self.engine = engine
         self.info = dict(info or {})
         self._stop = threading.Event()
@@ -153,7 +155,14 @@ class ReplicaServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 try:
                     while True:
-                        header, arrays = recv_msg(sock)
+                        try:
+                            header, arrays = recv_msg(sock)
+                        except CorruptFrameError:
+                            # checksum caught a torn/flipped frame (the
+                            # rpc.corrupt_frames counter has it); the
+                            # stream is desynchronized — drop the
+                            # connection, the router redispatches
+                            return
                         try:
                             reply, out = outer._dispatch(header, arrays)
                         except Exception as e:  # noqa: BLE001 — report
@@ -190,8 +199,25 @@ class ReplicaServer:
             names = header["feeds"]
             feed = dict(zip(names, arrays))
             dl = header.get("deadline_ms") or None
+            dl_ts = header.get("deadline_ts")
+            if dl_ts is not None:
+                # the router's absolute deadline (same-host wall clock):
+                # shed already-expired work before it costs a batch slot,
+                # and hand the engine's admission queue only the budget
+                # that actually remains
+                rem_ms = (float(dl_ts) - time.time()) * 1e3
+                if rem_ms <= 0:
+                    trace.metrics().counter("rpc.deadline_shed").inc()
+                    return {"ok": False, "shed": True,
+                            "error": "DeadlineExceededError",
+                            "message": "deadline expired before "
+                                       "admission"}, []
+                dl = min(dl, rem_ms) if dl else rem_ms
             fut = self.engine.submit(feed, deadline_ms=dl)
-            res = fut.result(timeout=header.get("timeout_s", 60.0))
+            timeout_s = float(header.get("timeout_s", 60.0))
+            if dl:
+                timeout_s = min(timeout_s, dl / 1e3 + 5.0)
+            res = fut.result(timeout=timeout_s)
             fetch_names = list(res)
             return ({"ok": True, "fetches": fetch_names,
                      "trace_id": fut.trace_id},
@@ -266,8 +292,137 @@ def serve_replica(spec: Dict[str, Any], ready_stream=None) -> None:
 
 
 # ---------------------------------------------------------------------------
-# parent side: replica handles
+# parent side: circuit breaker + replica handles
 # ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-replica transport circuit breaker (docs/robustness.md).
+
+    ``closed`` → (``failures`` CONSECUTIVE transport failures) →
+    ``open`` → (after ``cooldown_s``) one ``half_open`` probe →
+    success closes, failure reopens and restarts the cooldown.
+
+    Transport failures only (connection refused/reset/timeout/corrupt
+    frame): QueueFull is a healthy replica saying no, and application
+    errors are the request's problem — neither trips the breaker.
+    ``failures <= 0`` disables the breaker entirely.
+
+    ``on_open``/``on_close`` callbacks (invoked OUTSIDE the breaker
+    lock) feed the fleet's ejection/readmission lifecycle."""
+
+    def __init__(self, failures: Optional[int] = None,
+                 cooldown_s: Optional[float] = None, name: str = "",
+                 now_fn=time.monotonic,
+                 on_open: Optional[Callable] = None,
+                 on_close: Optional[Callable] = None):
+        from ..fluid import core
+        self.threshold = int(
+            failures if failures is not None
+            else core.get_flag("fleet_breaker_failures", 5))
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else core.get_flag("fleet_breaker_cooldown_s", 3.0))
+        self.name = name
+        self._now = now_fn
+        self.state = "closed"
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self._probing = False
+        self.on_open = on_open
+        self.on_close = on_close
+        self.opens = 0
+        self.closes = 0
+        self._lock = threading.Lock()
+        m = trace.metrics()
+        self._c_opens = m.counter("fleet.breaker_opens")
+        self._c_closes = m.counter("fleet.breaker_closes")
+        self._c_probes = m.counter("fleet.breaker_probes")
+
+    def probe_ready(self) -> bool:
+        """An open breaker past its cooldown with no probe in flight."""
+        with self._lock:
+            return (self.state == "open" and not self._probing
+                    and self._now() - self.opened_at >= self.cooldown_s)
+
+    def available(self) -> bool:
+        """May a request be dispatched through this breaker right now?
+        Closed: yes.  Open past cooldown with no probe in flight: yes —
+        that request IS the half-open probe (callers follow up with
+        :meth:`begin_probe`)."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            return (self.state == "open" and not self._probing
+                    and self._now() - self.opened_at >= self.cooldown_s)
+
+    def begin_probe(self) -> None:
+        with self._lock:
+            if self.state in ("open", "half_open"):
+                self.state = "half_open"
+                self._probing = True
+                self._c_probes.inc()
+
+    def try_acquire_probe(self) -> bool:
+        """Atomic check-and-begin: True for a closed breaker (no token
+        needed) or for exactly ONE caller of an open-past-cooldown
+        breaker — two racing dispatchers can't both become the
+        half-open probe."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if (self.state == "open" and not self._probing
+                    and self._now() - self.opened_at >= self.cooldown_s):
+                self.state = "half_open"
+                self._probing = True
+                self._c_probes.inc()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        cb = None
+        with self._lock:
+            if self.state == "half_open":
+                # the probe's own outcome: recovery confirmed
+                self.state = "closed"
+                self.closes += 1
+                self._c_closes.inc()
+                self.consecutive_failures = 0
+                self._probing = False
+                self.opened_at = None
+                cb = self.on_close
+            elif self.state == "closed":
+                self.consecutive_failures = 0
+            # state "open": a straggler dispatched BEFORE the open
+            # completed late — ignored; only the half-open probe may
+            # close the circuit (no zero-cooldown readmission storms)
+        if cb is not None:
+            cb()
+
+    def record_failure(self) -> None:
+        cb = None
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == "half_open":
+                # failed probe: reopen, restart the cooldown
+                self.state = "open"
+                self.opened_at = self._now()
+                self._probing = False
+            elif (self.state == "closed" and self.threshold > 0
+                    and self.consecutive_failures >= self.threshold):
+                self.state = "open"
+                self.opened_at = self._now()
+                self.opens += 1
+                self._c_opens.inc()
+                cb = self.on_open
+        if cb is not None:
+            cb()
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"state": self.state,
+                    "consecutive_failures": self.consecutive_failures,
+                    "opens": self.opens, "closes": self.closes}
+
 
 class _SockPool:
     """Per-replica blocking-socket pool: checkout/checkin gives the
@@ -285,10 +440,9 @@ class _SockPool:
         with self._lock:
             if self._idle:
                 return self._idle.pop()
-        s = socket.create_connection((self.host, self.port),
-                                     timeout=self.timeout_s)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return s
+        from ..distributed.ps.rpc import connect_endpoint
+        return connect_endpoint(self.host, self.port,
+                                timeout=self.timeout_s)
 
     def checkin(self, s: socket.socket) -> None:
         with self._lock:
@@ -324,8 +478,10 @@ class ReplicaHandle:
                  engine: Optional[ServingEngine] = None,
                  infer_fn: Optional[Callable] = None,
                  health_fn: Optional[Callable] = None,
+                 probe_fn: Optional[Callable] = None,
                  rpc_timeout_s: float = 15.0,
-                 warmup_report: Optional[Dict[str, Any]] = None):
+                 warmup_report: Optional[Dict[str, Any]] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.name = name
         self.proc = proc
         self.rpc_port = rpc_port
@@ -333,8 +489,19 @@ class ReplicaHandle:
         self.engine = engine
         self._infer_fn = infer_fn
         self._health_fn = health_fn
+        self._probe_fn = probe_fn
+        self._infer_takes_deadline = False
+        if infer_fn is not None:
+            try:
+                import inspect
+                self._infer_takes_deadline = "deadline_ms" in \
+                    inspect.signature(infer_fn).parameters
+            except (TypeError, ValueError):
+                pass
         self.rpc_timeout_s = float(rpc_timeout_s)
         self.warmup_report = warmup_report
+        self.breaker = breaker if breaker is not None \
+            else CircuitBreaker(name=name)
         self.state = "up"
         self.ejected_reason: Optional[str] = None
         self.missed_scrapes = 0
@@ -371,9 +538,11 @@ class ReplicaHandle:
         return self.state not in ("dead", "stopped")
 
     # -- RPC -----------------------------------------------------------------
-    def call(self, header: Dict[str, Any], arrays: Sequence = ()):
+    def call(self, header: Dict[str, Any], arrays: Sequence = (),
+             timeout_s: Optional[float] = None):
         """One framed RPC round-trip; raises ReplicaTransportError on any
-        socket-level failure (retryable elsewhere)."""
+        socket-level failure — including a checksum-caught corrupt frame
+        (retryable elsewhere; a torn reply never reaches the caller)."""
         if self.in_process:
             raise ReplicaTransportError(
                 f"replica {self.name} is in-process: no RPC endpoint")
@@ -384,6 +553,11 @@ class ReplicaHandle:
             raise ReplicaTransportError(
                 f"connect to {self.name}: {e}") from e
         try:
+            # per-call socket deadline, with headroom over the replica's
+            # own wait so its typed TimeoutError reply (retryable) wins
+            # the race against a raw socket timeout
+            s.settimeout((timeout_s + 2.0) if timeout_s
+                         else self.rpc_timeout_s)
             send_msg(s, header, arrays)
             reply, out = recv_msg(s)
         except (OSError, ConnectionError) as e:
@@ -405,14 +579,21 @@ class ReplicaHandle:
         elsewhere), or the replica's terminal error."""
         if self.in_process:
             if self._infer_fn is not None:
+                if self._infer_takes_deadline:
+                    return self._infer_fn(feed, deadline_ms=deadline_ms)
                 return self._infer_fn(feed)
             fut = self.engine.submit(feed, deadline_ms=deadline_ms)
             return fut.result(timeout=timeout_s or self.rpc_timeout_s)
         names = sorted(feed)
+        hdr = {"op": "infer", "feeds": names, "deadline_ms": deadline_ms,
+               "timeout_s": timeout_s or self.rpc_timeout_s}
+        if deadline_ms and deadline_ms > 0:
+            # absolute deadline for server-side shedding (same host /
+            # NTP-synced clocks — docs/robustness.md)
+            hdr["deadline_ts"] = time.time() + deadline_ms / 1e3
         reply, arrays = self.call(
-            {"op": "infer", "feeds": names, "deadline_ms": deadline_ms,
-             "timeout_s": timeout_s or self.rpc_timeout_s},
-            [np.asarray(feed[n]) for n in names])
+            hdr, [np.asarray(feed[n]) for n in names],
+            timeout_s=timeout_s or self.rpc_timeout_s)
         if not reply.get("ok"):
             err = reply.get("error", "ServingError")
             msg = f"{self.name}: {reply.get('message', err)}"
@@ -446,6 +627,17 @@ class ReplicaHandle:
             f"http://127.0.0.1:{self.metrics_port}/stats",
             timeout=timeout_s).read()
         return json.loads(body)
+
+    def probe(self) -> bool:
+        """Half-open breaker probe: one cheap transport round-trip (the
+        monitor drives this for breaker-ejected replicas, so a closed
+        breaker — not live traffic — is what readmits them)."""
+        if self.in_process:
+            if self._probe_fn is not None:
+                return bool(self._probe_fn())
+            return self.state != "dead"
+        reply, _ = self.call({"op": "hello"})
+        return bool(reply.get("ok"))
 
     # -- control -------------------------------------------------------------
     def pause(self) -> None:
@@ -570,25 +762,40 @@ class Router:
     # -- pick ----------------------------------------------------------------
     def _pick(self, session: Optional[str],
               exclude: set) -> Optional[ReplicaHandle]:
+        # an open breaker gates dispatch even while the replica is still
+        # formally admitted (transport failure is faster news than the
+        # next health scrape); a cooled-down breaker admits exactly one
+        # request as its half-open probe
         candidates = [r for r in self.admitted()
-                      if r.name not in exclude]
+                      if r.name not in exclude
+                      and r.breaker.available()]
         if not candidates:
             return None
+        chosen = None
         if session is not None:
             with self._lock:
                 pinned = self._affinity.get(session)
             if pinned is not None:
                 for r in candidates:
                     if r.name == pinned:
-                        return r
-                # sticky replica gone/ejected: re-pin below
-                self._c_affinity.inc()
-        if self.policy == "round_robin":
-            with self._lock:
-                self._rr += 1
-                chosen = candidates[self._rr % len(candidates)]
-        else:
-            chosen = min(candidates, key=lambda r: r.load_score())
+                        chosen = r
+                        break
+                if chosen is None:
+                    # sticky replica gone/ejected: re-pin below
+                    self._c_affinity.inc()
+        if chosen is None:
+            if self.policy == "round_robin":
+                with self._lock:
+                    self._rr += 1
+                    chosen = candidates[self._rr % len(candidates)]
+            else:
+                chosen = min(candidates, key=lambda r: r.load_score())
+        if chosen.breaker.state != "closed" \
+                and not chosen.breaker.try_acquire_probe():
+            # lost the probe race to a concurrent dispatcher: exactly
+            # one request may be the half-open probe — sit this round
+            # out (the caller's loop re-picks)
+            return None
         if session is not None:
             with self._lock:
                 self._affinity[session] = chosen.name
@@ -621,7 +828,14 @@ class Router:
              t0: float) -> None:
         exclude: set = set()
         last_exc: Optional[BaseException] = None
+        # the request's own deadline caps the retry budget: redispatching
+        # expired work would burn replica batch slots on a result nobody
+        # can use
+        abs_dl = (t0 + deadline_ms / 1e3
+                  if deadline_ms and deadline_ms > 0 else None)
         deadline = t0 + self.request_timeout_s
+        if abs_dl is not None:
+            deadline = min(deadline, abs_dl)
         while fut.attempts < self.max_attempts \
                 and time.monotonic() < deadline:
             if self._closed:
@@ -631,6 +845,15 @@ class Router:
                 fut._reject(EngineClosedError(
                     "router closed while the request was pending"))
                 return
+            rem_ms = None
+            att_timeout = self.attempt_timeout_s
+            if abs_dl is not None:
+                # decrement the budget per attempt: the replica's
+                # admission queue sees only what remains
+                rem_ms = (abs_dl - time.monotonic()) * 1e3
+                if rem_ms <= 0:
+                    break
+                att_timeout = min(att_timeout, rem_ms / 1e3)
             r = self._pick(session, exclude)
             if r is None:
                 if exclude:
@@ -646,12 +869,24 @@ class Router:
                 self._c_redispatch.inc()
             r._inc()
             try:
-                res = r.infer(feed, deadline_ms=deadline_ms,
-                              timeout_s=self.attempt_timeout_s)
-            except (ReplicaTransportError, QueueFullError,
-                    EngineClosedError, TimeoutError) as e:
+                res = r.infer(feed, deadline_ms=rem_ms,
+                              timeout_s=att_timeout)
+            except (ReplicaTransportError, TimeoutError) as e:
+                # transport-class failure: trips the replica's breaker
+                r.breaker.record_failure()
                 last_exc = e
                 exclude.add(r.name)
+                # fast-failing transports (reset storms, corrupt-frame
+                # windows) must not burn the whole attempt budget in
+                # milliseconds — tiny growing backoff between attempts
+                time.sleep(min(0.02 * fut.attempts, 0.2))
+                continue
+            except (QueueFullError, EngineClosedError) as e:
+                # a healthy replica saying no — retryable elsewhere,
+                # never a breaker signal
+                last_exc = e
+                exclude.add(r.name)
+                time.sleep(min(0.02 * fut.attempts, 0.2))
                 continue
             except BaseException as e:      # noqa: BLE001 — terminal
                 self._c_failures.inc()
@@ -659,10 +894,16 @@ class Router:
                 return
             finally:
                 r._dec()
+            r.breaker.record_success()
             self._h_latency.observe(time.monotonic() - t0)
             fut._resolve(res, r.name)
             return
         self._c_failures.inc()
+        if abs_dl is not None and time.monotonic() >= abs_dl:
+            fut._reject(DeadlineExceededError(
+                f"deadline elapsed after {fut.attempts} attempts "
+                f"(last: {last_exc})"))
+            return
         fut._reject(NoReplicaError(
             f"no replica served the request after {fut.attempts} "
             f"attempts (last: {last_exc})"))
@@ -716,6 +957,7 @@ class ServingFleet:
                  rpc_timeout_s: float = 15.0,
                  spawn_timeout_s: float = 180.0,
                  max_workers: int = 32,
+                 max_attempts: int = 6,
                  request_timeout_s: float = 120.0,
                  env: Optional[Dict[str, str]] = None,
                  quiet_children: bool = False):
@@ -764,8 +1006,11 @@ class ServingFleet:
                 raise
         self.router = Router(handles, policy=policy,
                              max_workers=max_workers,
+                             max_attempts=max_attempts,
                              attempt_timeout_s=rpc_timeout_s,
                              request_timeout_s=request_timeout_s)
+        for h in handles:
+            self._wire_breaker(h)
         self._g_up.set(len(self.router.admitted()))
         self._stop = threading.Event()
         self._monitor_t = threading.Thread(target=self._monitor,
@@ -829,6 +1074,24 @@ class ServingFleet:
                     warmup=info.get("warmup"), pid=info.get("pid"))
         return handle
 
+    # -- breaker lifecycle ---------------------------------------------------
+    def _wire_breaker(self, h: ReplicaHandle) -> None:
+        """Breaker transitions feed the ejection/readmission lifecycle:
+        open ejects (reason ``breaker_open``), a half-open probe that
+        closes the breaker readmits."""
+        h.breaker.on_open = lambda h=h: self._on_breaker_open(h)
+        h.breaker.on_close = lambda h=h: self._on_breaker_close(h)
+
+    def _on_breaker_open(self, r: ReplicaHandle) -> None:
+        self._event("breaker_open", r.name,
+                    failures=r.breaker.consecutive_failures)
+        self.eject(r, "breaker_open")
+
+    def _on_breaker_close(self, r: ReplicaHandle) -> None:
+        self._event("breaker_close", r.name)
+        if r.state == "ejected" and r.ejected_reason == "breaker_open":
+            self.readmit(r)
+
     # -- monitor -------------------------------------------------------------
     def _monitor(self) -> None:
         while not self._stop.wait(self.scrape_interval_s):
@@ -838,6 +1101,20 @@ class ServingFleet:
                 if not r.alive():
                     self._mark_dead(r, "died")
                     continue
+                # breaker-ejected replicas get no traffic, so the
+                # monitor drives the half-open probe: a transport
+                # round-trip that closes the breaker readmits
+                if r.state == "ejected" \
+                        and r.ejected_reason == "breaker_open" \
+                        and r.breaker.probe_ready():
+                    r.breaker.begin_probe()
+                    try:
+                        ok = r.probe()
+                    except Exception:   # noqa: BLE001 — a failed probe
+                        ok = False      # reopens, never kills the loop
+                    self._event("breaker_probe", r.name, ok=ok)
+                    (r.breaker.record_success if ok
+                     else r.breaker.record_failure)()
                 try:
                     st = r.scrape(timeout_s=max(
                         1.0, self.scrape_interval_s * 2))
@@ -853,7 +1130,12 @@ class ServingFleet:
                 verdict = str(st.get("status", "ok"))
                 if r.state == "up" and verdict in ("stalled", "breached"):
                     self.eject(r, verdict)
-                elif r.state == "ejected" and verdict == "ok":
+                elif r.state == "ejected" and verdict == "ok" \
+                        and r.ejected_reason != "breaker_open":
+                    # breaker ejections readmit through the probe path
+                    # only — a healthy /healthz can't outrun an open
+                    # breaker (the RPC plane may be partitioned while
+                    # the HTTP plane still answers)
                     self.readmit(r)
             self._g_up.set(len(self.router.admitted()))
 
@@ -871,6 +1153,7 @@ class ServingFleet:
     def _replace(self, dead: ReplicaHandle) -> None:
         try:
             handle = self.spawn_replica()
+            self._wire_breaker(handle)
             self.router.add_replica(handle)
             self._c_replace.inc()
             self._event("replace", handle.name, replaced=dead.name,
@@ -960,6 +1243,7 @@ class ServingFleet:
                 "outstanding": r.outstanding,
                 "queue_depth": r.last_stats.get("queue_depth"),
                 "status": r.last_stats.get("status"),
+                "breaker": r.breaker.describe(),
             } for r in self.router.replicas],
             "admitted": len(self.router.admitted()),
             "dispatches": m.counter("fleet.dispatches").value,
@@ -967,6 +1251,8 @@ class ServingFleet:
             "ejections": self._c_eject.value,
             "readmissions": self._c_readmit.value,
             "replacements": self._c_replace.value,
+            "breaker_opens": m.counter("fleet.breaker_opens").value,
+            "breaker_closes": m.counter("fleet.breaker_closes").value,
             "failures": m.counter("fleet.failures").value,
             "latency": {k: lat[k] for k in
                         ("count", "avg", "p50", "p95", "p99")},

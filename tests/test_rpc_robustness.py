@@ -1,0 +1,589 @@
+"""Chaos-hardened transport: framing integrity, fault injection,
+retry/dedup semantics, deadlines (docs/robustness.md).
+
+Framing tests run against in-memory fake sockets (every single-bit
+corruption position, EOF mid-frame, partial reads, bounds) — no network
+timing.  Client/server tests run real PsServer/PsClient pairs under
+installed faultline schedules: reply-ack loss (dedup exactly-once),
+resets (reconnect+retry), corruption (checksum-caught, retried), and
+deadline shedding.
+"""
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.distributed import faultline                  # noqa: E402
+from paddle_tpu.distributed.ps import rpc as R                # noqa: E402
+from paddle_tpu.distributed.ps.rpc import (                   # noqa: E402
+    CorruptFrameError, FrameTooLargeError, PsClient, PsServer,
+    RpcDeadlineError, recv_msg, send_msg)
+from paddle_tpu.fluid import trace                            # noqa: E402
+
+m = trace.metrics()
+
+
+@pytest.fixture(autouse=True)
+def _no_faultline():
+    """Faultline state is process-global: never leak a schedule."""
+    yield
+    faultline.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# in-memory socket stand-ins
+# ---------------------------------------------------------------------------
+
+class CaptureSock:
+    """Collects sendall bytes (builds frames without a network)."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def sendall(self, b):
+        self.buf += b
+
+
+class ChunkSock:
+    """Serves recv_into from a byte buffer, at most ``chunk`` bytes per
+    call (exercises partial-read reassembly); returns 0 at EOF."""
+
+    def __init__(self, data, chunk=1 << 16):
+        self.data = bytes(data)
+        self.off = 0
+        self.chunk = chunk
+
+    def recv_into(self, view, n):
+        n = min(n, self.chunk, len(self.data) - self.off)
+        if n <= 0:
+            return 0
+        view[:n] = self.data[self.off:self.off + n]
+        self.off += n
+        return n
+
+
+def build_frame(header, arrays=()):
+    cap = CaptureSock()
+    send_msg(cap, header, arrays)
+    return bytes(cap.buf)
+
+
+class DummySock:
+    """Endpoint-addressable sendall recorder for faultline unit tests."""
+
+    def __init__(self, peer=("127.0.0.1", 9000), local=("127.0.0.1", 1234)):
+        self.peer, self.local = peer, local
+        self.sent = bytearray()
+        self.closed = False
+
+    def getpeername(self):
+        return self.peer
+
+    def getsockname(self):
+        return self.local
+
+    def sendall(self, b):
+        self.sent += b
+
+    def close(self):
+        self.closed = True
+
+
+# ---------------------------------------------------------------------------
+# framing integrity
+# ---------------------------------------------------------------------------
+
+class TestFraming:
+    def test_roundtrip_zero_arrays(self):
+        frame = build_frame({"op": "ping", "k": 7})
+        h, arrs = recv_msg(ChunkSock(frame))
+        assert h == {"op": "ping", "k": 7} and arrs == []
+
+    def test_roundtrip_multi_array_dtypes(self):
+        arrays = [np.arange(6, dtype=np.float32).reshape(2, 3),
+                  np.array([1, 2, 3], np.int64),
+                  np.zeros((0, 4), np.uint8)]
+        frame = build_frame({"op": "x"}, arrays)
+        h, arrs = recv_msg(ChunkSock(frame))
+        assert h == {"op": "x"}
+        for a, b in zip(arrays, arrs):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+
+    def test_partial_recv_into_reassembles(self):
+        a = np.arange(37, dtype=np.float32)
+        frame = build_frame({"op": "x"}, [a])
+        h, arrs = recv_msg(ChunkSock(frame, chunk=1))  # 1 byte at a time
+        np.testing.assert_array_equal(arrs[0], a)
+
+    def test_eof_mid_header(self):
+        frame = build_frame({"op": "x", "long_field": "y" * 64})
+        with pytest.raises((ConnectionError, OSError)):
+            recv_msg(ChunkSock(frame[:12]))            # cut inside header
+
+    def test_eof_mid_array(self):
+        frame = build_frame({"op": "x"}, [np.arange(64, dtype=np.float32)])
+        with pytest.raises((ConnectionError, OSError)):
+            recv_msg(ChunkSock(frame[:-17]))           # cut inside array
+
+    def test_every_single_bit_corruption_detected(self):
+        """The satellite gate: flip EVERY bit position of a small frame,
+        one at a time — recv must raise a typed error for every one and
+        never return torn data.  (Payload flips are CRC-caught; length-
+        prefix flips surface as bounds/checksum/EOF errors.)"""
+        frame = build_frame({"op": "k"}, [np.arange(3, dtype=np.float32)])
+        survived = []
+        for pos in range(len(frame) * 8):
+            bad = bytearray(frame)
+            bad[pos // 8] ^= 1 << (pos % 8)
+            try:
+                recv_msg(ChunkSock(bytes(bad)))
+                survived.append(pos)
+            except (CorruptFrameError, ConnectionError, OSError):
+                pass
+        assert survived == [], f"torn frames accepted at bits {survived}"
+
+    def test_corruption_bumps_counter(self):
+        frame = bytearray(build_frame({"op": "k"},
+                                      [np.ones(4, np.float32)]))
+        frame[-3] ^= 0x10                              # flip an array bit
+        c0 = m.counter("rpc.corrupt_frames").value
+        with pytest.raises(CorruptFrameError):
+            recv_msg(ChunkSock(bytes(frame)))
+        assert m.counter("rpc.corrupt_frames").value == c0 + 1
+
+    def test_oversized_declared_array_rejected_before_alloc(self):
+        """A garbage/hostile size never drives the allocation: the
+        declared 4 TB array is rejected from its header spec alone."""
+        import json
+        import zlib
+        hb = json.dumps({"op": "x", "arrays": [
+            {"dtype": "<f4", "shape": [1 << 40], "crc": 0}]}).encode()
+        frame = struct.pack("!II", len(hb), zlib.crc32(hb)) + hb
+        t0 = time.monotonic()
+        with pytest.raises(FrameTooLargeError):
+            recv_msg(ChunkSock(frame))
+        assert time.monotonic() - t0 < 1.0             # no 4TB bytearray
+
+    def test_garbage_length_prefix_rejected(self):
+        with pytest.raises(FrameTooLargeError):
+            recv_msg(ChunkSock(struct.pack("!II", 0xFFFFFFFF, 0)))
+
+    def test_send_side_bound(self):
+        import paddle_tpu.fluid as fluid
+        fluid.core.set_flags({"FLAGS_rpc_max_frame_bytes": 256})
+        try:
+            with pytest.raises(ValueError):
+                send_msg(CaptureSock(), {"op": "x"},
+                         [np.zeros(1024, np.float32)])
+        finally:
+            fluid.core.set_flags({"FLAGS_rpc_max_frame_bytes": 1 << 30})
+
+
+# ---------------------------------------------------------------------------
+# faultline unit semantics
+# ---------------------------------------------------------------------------
+
+class TestFaultline:
+    def test_same_seed_same_decision_stream(self):
+        spec = {"seed": 11, "faults": [{"kind": "drop", "prob": 0.4},
+                                       {"kind": "corrupt", "prob": 0.2}]}
+        assert (faultline.Faultline(spec).decision_fingerprint(200)
+                == faultline.Faultline(spec).decision_fingerprint(200))
+        other = faultline.Faultline({**spec, "seed": 12})
+        assert (other.decision_fingerprint(200)
+                != faultline.Faultline(spec).decision_fingerprint(200))
+
+    def test_window_scoping(self):
+        clock = [0.0]
+        fl = faultline.Faultline(
+            {"seed": 1, "faults": [{"kind": "drop", "prob": 1.0,
+                                    "start_s": 10, "end_s": 20}]},
+            now_fn=lambda: clock[0])
+        s = DummySock()
+        fl.send(s, b"\0" * 32)
+        assert len(s.sent) == 32                       # before the window
+        clock[0] = 15.0
+        s2 = DummySock()
+        fl.send(s2, b"\0" * 32)
+        assert len(s2.sent) == 0                       # inside: blackholed
+        clock[0] = 25.0
+        s3 = DummySock()
+        fl.send(s3, b"\0" * 32)
+        assert len(s3.sent) == 32                      # after
+
+    def test_endpoint_scoping_peer_and_local(self):
+        fl = faultline.Faultline({"seed": 1, "faults": [
+            {"kind": "drop", "prob": 1.0, "endpoint": "*:9000"},
+            {"kind": "drop", "prob": 1.0, "endpoint": "local:*:4321"}]})
+        hit = DummySock(peer=("127.0.0.1", 9000))
+        fl.send(hit, b"\0" * 8)
+        assert len(hit.sent) == 0
+        miss = DummySock(peer=("127.0.0.1", 9001))
+        fl.send(miss, b"\0" * 8)
+        assert len(miss.sent) == 8
+        local_hit = DummySock(peer=("127.0.0.1", 9001),
+                              local=("127.0.0.1", 4321))
+        fl.send(local_hit, b"\0" * 8)
+        assert len(local_hit.sent) == 0
+
+    def test_latency_injection_delays(self):
+        fl = faultline.Faultline({"seed": 1, "faults": [
+            {"kind": "latency", "prob": 1.0, "ms": 40}]})
+        s = DummySock()
+        t0 = time.monotonic()
+        fl.send(s, b"\0" * 8)
+        assert time.monotonic() - t0 >= 0.03
+        assert len(s.sent) == 8
+
+    def test_reset_closes_and_raises(self):
+        fl = faultline.Faultline({"seed": 1, "faults": [
+            {"kind": "reset", "prob": 1.0}]})
+        s = DummySock()
+        with pytest.raises(ConnectionResetError):
+            fl.send(s, b"\0" * 8)
+        assert s.closed and len(s.sent) == 0
+
+    def test_corrupt_flips_one_bit_past_prefix(self):
+        fl = faultline.Faultline({"seed": 4, "faults": [
+            {"kind": "corrupt", "prob": 1.0}]})
+        payload = bytes(range(64))
+        s = DummySock()
+        fl.send(s, payload)
+        assert len(s.sent) == 64
+        diff = [i for i in range(64) if s.sent[i] != payload[i]]
+        assert len(diff) == 1 and diff[0] >= 8
+        assert bin(s.sent[diff[0]] ^ payload[diff[0]]).count("1") == 1
+
+    def test_max_injections_caps(self):
+        fl = faultline.Faultline({"seed": 1, "faults": [
+            {"kind": "drop", "prob": 1.0, "max_injections": 2}]})
+        sent = []
+        for _ in range(4):
+            s = DummySock()
+            fl.send(s, b"\0" * 8)
+            sent.append(len(s.sent))
+        assert sent == [0, 0, 8, 8]
+        assert fl.injected == {"drop": 2}
+
+    def test_trickle_sends_everything(self):
+        fl = faultline.Faultline({"seed": 1, "faults": [
+            {"kind": "trickle", "prob": 1.0, "bytes_per_s": 1 << 20,
+             "chunk": 16}]})
+        s = DummySock()
+        payload = bytes(range(100))
+        fl.send(s, payload)
+        assert bytes(s.sent) == payload
+
+    def test_connect_check_partition_refuses(self):
+        fl = faultline.Faultline({"seed": 1, "faults": [
+            {"kind": "partition", "prob": 1.0, "endpoint": "*:7777"}]})
+        with pytest.raises(ConnectionRefusedError):
+            fl.connect_check("127.0.0.1:7777")
+        fl.connect_check("127.0.0.1:7778")             # unmatched: fine
+
+    def test_install_via_flags_and_describe(self):
+        import paddle_tpu.fluid as fluid
+        fluid.core.set_flags({"FLAGS_faultline":
+                              '{"seed": 9, "faults": '
+                              '[{"kind": "latency", "ms": 1}]}'})
+        try:
+            fl = faultline.get()
+            assert fl is not None and fl.seed == 9
+            d = fl.describe()
+            assert d["rules"][0]["kind"] == "latency"
+        finally:
+            fluid.core.set_flags({"FLAGS_faultline": None})
+        assert faultline.get() is None
+
+    def test_off_is_noop(self):
+        assert faultline.get() is None                 # nothing installed
+        a, b = socket.socketpair()
+        try:
+            send_msg(a, {"op": "x"}, [np.ones(3, np.float32)])
+            h, arrs = recv_msg(b)
+            assert h["op"] == "x"
+        finally:
+            a.close()
+            b.close()
+
+    def test_stats_payload_surfaces_rpc_and_faults(self):
+        from paddle_tpu.fluid import metrics_export as mx
+        m.counter("rpc.corrupt_frames").inc()
+        m.counter("fault.injected").inc()
+        m.counter("fault.drop").inc()
+        payload = mx.stats_payload()
+        assert payload["rpc"]["corrupt_frames"] >= 1
+        assert payload["faults"]["injected"] >= 1
+        assert payload["faults"]["drop"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# client/server resilience
+# ---------------------------------------------------------------------------
+
+def push_steps(client, ids, steps=3):
+    for step in range(steps):
+        client.push_sparse("e", ids,
+                           np.full((len(ids), 4), 1.0 + step, np.float32))
+    return client.pull_sparse("e", ids)
+
+
+def reference_state(ids, steps=3):
+    srv = PsServer(port=0)
+    srv.start()
+    c = PsClient([srv.endpoint], timeout=10)
+    c.create_sparse_table("e", 4, lr=0.5, init_kind="zeros")
+    ref = push_steps(c, ids, steps)
+    srv.stop()
+    c.close()
+    return ref
+
+
+class TestClientResilience:
+    def test_push_dedup_exactly_once_under_ack_loss(self):
+        """The acceptance gate: drop push ACKs so the client retries;
+        the server's req_id window must apply each push exactly once —
+        final table state bit-for-bit equal to a fault-free run."""
+        ids = np.arange(8, dtype=np.int64)
+        ref = reference_state(ids)
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=6, backoff_ms=5)
+        c.create_sparse_table("e", 4, lr=0.5, init_kind="zeros")
+        dedup0 = m.counter("rpc.dedup_hits").value
+        faultline.install({"seed": 3, "faults": [
+            {"kind": "drop", "prob": 1.0, "max_injections": 2,
+             "endpoint": f"local:*:{srv.port}"}]})     # server replies
+        try:
+            got = push_steps(c, ids)
+        finally:
+            faultline.uninstall()
+        np.testing.assert_array_equal(got, ref)        # bit-for-bit
+        assert m.counter("rpc.dedup_hits").value - dedup0 >= 1
+        srv.stop()
+        c.close()
+
+    def test_idempotent_retry_on_reset(self):
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=8, backoff_ms=5)
+        c.create_dense_table("w", [2, 2])
+        r0 = m.counter("rpc.retries").value
+        faultline.install({"seed": 6, "faults": [
+            {"kind": "reset", "prob": 1.0, "max_injections": 2,
+             "endpoint": f"*:{srv.port}"}]})
+        try:
+            c.set_dense("w", np.full((2, 2), 3.0, np.float32))
+        finally:
+            faultline.uninstall()
+        np.testing.assert_allclose(c.pull_dense("w"), 3.0)
+        assert m.counter("rpc.retries").value > r0
+        srv.stop()
+        c.close()
+
+    def test_corruption_detected_and_retried(self):
+        srv = PsServer(port=0).start()
+        det0 = m.counter("rpc.corrupt_frames").value
+        c = PsClient([srv.endpoint], timeout=6, backoff_ms=5)
+        c.create_sparse_table("e2", 2, lr=1.0, init_kind="zeros")
+        ids = np.arange(8, dtype=np.int64)
+        faultline.install({"seed": 5, "faults": [
+            {"kind": "corrupt", "prob": 1.0, "max_injections": 1,
+             "endpoint": f"*:{srv.port}"}]})
+        try:
+            c.push_sparse("e2", ids, np.ones((8, 2), np.float32))
+            v = c.pull_sparse("e2", ids)
+        finally:
+            fl = faultline.get()
+            faultline.uninstall()
+        assert fl.injected.get("corrupt") == 1
+        assert m.counter("rpc.corrupt_frames").value - det0 >= 1
+        np.testing.assert_allclose(v, -1.0)            # applied once
+        srv.stop()
+        c.close()
+
+    def test_inflight_duplicate_waits_not_reapplies(self):
+        """A duplicate req_id that lands while the ORIGINAL attempt is
+        still executing (attempt-timeout retry under latency) must wait
+        for it and replay its ack — never apply a second time."""
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=10)
+        c.create_dense_table("w", [2])
+        c.set_dense("w", np.zeros(2, np.float32))
+        orig_dispatch = srv._dispatch
+
+        def slow_dispatch(header, arrays):
+            if header.get("op") == "push_dense":
+                time.sleep(0.4)
+            return orig_dispatch(header, arrays)
+
+        srv._dispatch = slow_dispatch
+        dedup0 = m.counter("rpc.dedup_hits").value
+        hdr = {"op": "push_dense", "table": "w", "req_id": "dup-1",
+               "deadline_ts": time.time() + 30.0}
+        grad = np.ones(2, np.float32)
+        replies = []
+
+        def call_once():
+            s = socket.create_connection(("127.0.0.1", srv.port))
+            try:
+                send_msg(s, hdr, [grad])
+                replies.append(recv_msg(s)[0])
+            finally:
+                s.close()
+
+        t1 = threading.Thread(target=call_once)
+        t1.start()
+        time.sleep(0.1)                # original mid-execution
+        t2 = threading.Thread(target=call_once)
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        assert len(replies) == 2 and all(r["ok"] for r in replies)
+        assert m.counter("rpc.dedup_hits").value == dedup0 + 1
+        # applied exactly once: one sgd step, not two
+        v = c.pull_dense("w")
+        np.testing.assert_allclose(v, -0.01 * np.ones(2), rtol=1e-5)
+        srv.stop()
+        c.close()
+
+    def test_send_phase_retry_for_non_retryable_op(self):
+        """barrier is never blind-retried, but a SEND-phase failure on
+        a connection that died idle earns one reconnect (the server
+        never saw the request) — and must not crash on the retry."""
+        srv = PsServer(port=0, n_trainers=1).start()
+        c = PsClient([srv.endpoint], timeout=6)
+        assert c.ping() == [0]          # establishes the connection
+        port = srv.port
+        srv.stop()
+        time.sleep(0.2)
+        srv2 = PsServer(port=port, n_trainers=1).start()
+        c.barrier(timeout=5.0)          # dead idle socket -> free retry
+        srv2.stop()
+        c.close()
+
+    def test_reconnect_after_server_restart_same_port(self):
+        """Satellite: a connection that died idle (server restart)
+        reconnects and retries instead of surfacing ConnectionError."""
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=6)
+        assert c.ping() == [0]
+        port = srv.port
+        srv.stop()
+        time.sleep(0.2)
+        srv2 = PsServer(port=port).start()
+        assert c.ping() == [0]                         # transparent
+        srv2.stop()
+        c.close()
+
+    def test_deadline_shed_on_server(self):
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=6)
+        c.create_dense_table("w", [2])
+        shed0 = m.counter("rpc.deadline_shed").value
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            send_msg(s, {"op": "pull_dense", "table": "w",
+                         "deadline_ts": time.time() - 1.0})
+            reply, _ = recv_msg(s)
+        finally:
+            s.close()
+        assert reply["ok"] is False and reply.get("shed")
+        assert reply["error"] == "DeadlineExceededError"
+        assert m.counter("rpc.deadline_shed").value == shed0 + 1
+        srv.stop()
+        c.close()
+
+    def test_client_deadline_error_when_partitioned(self):
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=1.5, retries=2, backoff_ms=5)
+        faultline.install({"seed": 2, "faults": [
+            {"kind": "partition", "prob": 1.0,
+             "endpoint": f"*:{srv.port}"}]})
+        try:
+            # the typed error at the call layer...
+            with pytest.raises((RpcDeadlineError, OSError)):
+                c._call(0, {"op": "ping"})
+            # ...and the fanout surface still fails loudly
+            with pytest.raises(RuntimeError):
+                c.ping()
+        finally:
+            faultline.uninstall()
+        srv.stop()
+        c.close()
+
+    def test_shed_retry_uses_fresh_budget(self):
+        """A shed reply is NOT cached in the dedup window: the op can
+        be re-issued with fresh budget and then applies."""
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=6)
+        c.create_dense_table("w", [2])
+        c.set_dense("w", np.zeros(2, np.float32))
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        try:
+            hdr = {"op": "push_dense", "table": "w", "req_id": "rx-1",
+                   "deadline_ts": time.time() - 1.0}
+            send_msg(s, hdr, [np.ones(2, np.float32)])
+            reply, _ = recv_msg(s)
+            assert reply.get("shed")
+            hdr["deadline_ts"] = time.time() + 30.0
+            send_msg(s, hdr, [np.ones(2, np.float32)])
+            reply2, _ = recv_msg(s)
+            assert reply2["ok"]
+        finally:
+            s.close()
+        assert c.pull_dense("w")[0] != 0.0             # applied once, late
+        srv.stop()
+        c.close()
+
+
+class TestHeartbeatVisibility:
+    def test_dead_worker_gauge_and_events(self):
+        """Satellite: silent worker loss is visible on the metrics
+        plane (ps.dead_workers gauge + PsServer.events + recorder
+        markers), not just via the dead_workers() callback."""
+        srv = PsServer(port=0, n_trainers=2).start()
+        c = PsClient([srv.endpoint], timeout=5)
+        stop_beat = threading.Event()
+
+        def beat_rank1():
+            while not stop_beat.wait(0.05):
+                try:
+                    c.heartbeat(1)
+                except Exception:      # noqa: BLE001 — teardown race
+                    return
+
+        t = threading.Thread(target=beat_rank1, daemon=True)
+        t.start()
+        try:
+            c.heartbeat(0)
+            srv.start_heartbeat_monitor(timeout=0.3, interval=0.05)
+            deadline = time.time() + 10
+            while not srv.events_of("worker_dead") \
+                    and time.time() < deadline:
+                time.sleep(0.05)
+            dead_ev = srv.events_of("worker_dead")
+            assert any(e["rank"] == 0 for e in dead_ev), dead_ev
+            assert m.gauge("ps.dead_workers").value >= 1
+            assert m.counter("ps.worker_deaths").value >= 1
+            assert not srv._stop.is_set()              # rank 1 still beats
+            # recovery: rank 0 beats again
+            c.heartbeat(0)
+            deadline = time.time() + 10
+            while not srv.events_of("worker_recovered") \
+                    and time.time() < deadline:
+                c.heartbeat(0)
+                time.sleep(0.05)
+            assert any(e["rank"] == 0
+                       for e in srv.events_of("worker_recovered"))
+        finally:
+            stop_beat.set()
+            srv.stop()
+            c.close()

@@ -352,6 +352,194 @@ class TestReplicaServer:
             handle.infer({"x": np.ones((1, 4), "float32")})
 
 
+class TestCircuitBreaker:
+    def test_open_halfopen_close_lifecycle(self):
+        clock = [0.0]
+        events = []
+        b = F.CircuitBreaker(failures=3, cooldown_s=1.0,
+                             now_fn=lambda: clock[0],
+                             on_open=lambda: events.append("open"),
+                             on_close=lambda: events.append("close"))
+        assert b.available()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed" and b.available()
+        b.record_failure()                 # 3rd consecutive: open
+        assert b.state == "open" and events == ["open"]
+        assert not b.available()           # cooling down
+        clock[0] = 1.5
+        assert b.probe_ready() and b.available()
+        b.begin_probe()
+        assert b.state == "half_open"
+        assert not b.available()           # one probe at a time
+        b.record_failure()                 # probe failed: reopen
+        assert b.state == "open" and not b.probe_ready()
+        clock[0] = 3.0
+        assert b.probe_ready()
+        b.begin_probe()
+        b.record_success()                 # probe ok: close
+        assert b.state == "closed" and events == ["open", "close"]
+        assert b.available()
+
+    def test_success_resets_consecutive_count(self):
+        b = F.CircuitBreaker(failures=3, cooldown_s=1.0)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"         # never 3 CONSECUTIVE
+
+    def test_threshold_zero_disables(self):
+        b = F.CircuitBreaker(failures=0, cooldown_s=0.1)
+        for _ in range(50):
+            b.record_failure()
+        assert b.state == "closed"
+
+
+class TestBreakerFleet:
+    def _flaky(self, name, state):
+        def infer(feed):
+            if not state["healthy"]:
+                raise F.ReplicaTransportError(f"{name} transport down")
+            return {"y": np.asarray(feed["x"]) * 2.0}
+
+        return F.ReplicaHandle(
+            name, infer_fn=infer,
+            health_fn=lambda: {"status": "ok", "queue_depth": 0},
+            probe_fn=lambda: state["healthy"],
+            breaker=F.CircuitBreaker(failures=2, cooldown_s=0.05,
+                                     name=name))
+
+    def test_breaker_opens_ejects_probes_readmits(self):
+        state = {"healthy": False}
+        bad = self._flaky("bad", state)
+        good = make_stub("good", depth=5)
+        fl = make_fleet([bad, good])
+        try:
+            # requests flow despite the dead-transport replica: the
+            # router redispatches, the breaker opens after 2 consecutive
+            # transport failures and EJECTS via the fleet lifecycle
+            # (sequential submits so each pick sees settled load scores)
+            outs = [fl.submit({"x": np.ones(1, "float32")}).result(15)
+                    for _ in range(6)]
+            assert len(outs) == 6          # zero lost
+            wait_for(lambda: bad.state == "ejected"
+                     and bad.ejected_reason == "breaker_open",
+                     msg="breaker ejection")
+            assert fl.events_of("breaker_open")
+            # an ok VERDICT must not readmit a breaker-ejected replica
+            # while its transport stays dead (probes keep failing)
+            time.sleep(0.3)
+            assert bad.state == "ejected"
+            assert bad.breaker.state == "open"
+            # heal the transport: the monitor's half-open probe closes
+            # the breaker, which readmits
+            state["healthy"] = True
+            wait_for(lambda: bad.state == "up", msg="breaker readmission")
+            assert bad.breaker.state == "closed"
+            assert fl.events_of("breaker_close")
+            assert fl.events_of("breaker_probe")
+            # and it serves again
+            record = []
+            bad._infer_fn_orig = None
+            futs = [fl.submit({"x": np.ones(1, "float32")})
+                    for _ in range(8)]
+            served = {f.result(10) and f.replica for f in futs}
+            assert "bad" in served or "good" in served
+            # breaker state is surfaced in fleet stats
+            st = fl.stats()
+            names = {r["name"]: r["breaker"]["state"]
+                     for r in st["replicas"]}
+            assert names["bad"] == "closed"
+            assert st["breaker_opens"] >= 1
+        finally:
+            fl.close()
+
+    def test_open_breaker_gates_dispatch_before_ejection(self):
+        """Router-level: an open breaker excludes the replica from
+        _pick even while still formally admitted."""
+        state = {"healthy": False}
+        bad = self._flaky("bad", state)
+        good = make_stub("good", depth=5)
+        router = F.Router([bad, good], max_attempts=8)
+        try:
+            for _ in range(4):
+                router.submit({"x": np.ones(1, "float32")}).result(10)
+            assert bad.breaker.state in ("open", "half_open")
+            assert bad.state == "up"       # no fleet monitor: not ejected
+            # while open (cooldown running), only good is pickable
+            picked = router._pick(None, set())
+            assert picked is None or picked.name == "good" \
+                or bad.breaker.state == "half_open"
+        finally:
+            router.close()
+
+
+class TestDeadlinePropagation:
+    def _capture_handle(self, seen, delay=0.0):
+        def infer(feed, deadline_ms=None):
+            seen.append(deadline_ms)
+            if delay:
+                time.sleep(delay)
+            return {"y": np.asarray(feed["x"])}
+
+        return F.ReplicaHandle(
+            "d", infer_fn=infer,
+            health_fn=lambda: {"status": "ok", "queue_depth": 0})
+
+    def test_deadline_decrements_through_router(self):
+        seen = []
+        fl = make_fleet([self._capture_handle(seen)])
+        try:
+            fl.submit({"x": np.ones(1, "float32")},
+                      deadline_ms=5000).result(5)
+            assert seen[-1] is not None and 0 < seen[-1] <= 5000
+            fl.submit({"x": np.ones(1, "float32")}).result(5)
+            assert seen[-1] is None        # no deadline -> none invented
+        finally:
+            fl.close()
+
+    def test_expired_deadline_rejects_typed(self):
+        def infer(feed, deadline_ms=None):
+            time.sleep(0.08)
+            raise F.ReplicaTransportError("flaky")
+
+        h = F.ReplicaHandle(
+            "d", infer_fn=infer,
+            health_fn=lambda: {"status": "ok", "queue_depth": 0})
+        fl = make_fleet([h])
+        try:
+            fut = fl.submit({"x": np.ones(1, "float32")}, deadline_ms=120)
+            from paddle_tpu.serving.engine import DeadlineExceededError
+            with pytest.raises(DeadlineExceededError):
+                fut.result(15)
+        finally:
+            fl.close()
+
+    def test_replica_server_sheds_expired_infer(self):
+        """An already-expired request is shed at the replica's door —
+        it never reaches the engine's admission queue."""
+        from paddle_tpu.distributed.ps.rpc import recv_msg, send_msg
+        import socket as sk
+        srv = F.ReplicaServer(engine=None, info={})    # engine untouched
+        srv.start()
+        shed0 = trace.metrics().counter("rpc.deadline_shed").value
+        s = sk.create_connection(("127.0.0.1", srv.port))
+        try:
+            send_msg(s, {"op": "infer", "feeds": ["x"],
+                         "deadline_ts": time.time() - 1.0},
+                     [np.ones((1, 2), "float32")])
+            reply, _ = recv_msg(s)
+        finally:
+            s.close()
+            srv.stop()
+        assert reply["ok"] is False and reply.get("shed")
+        assert reply["error"] == "DeadlineExceededError"
+        assert trace.metrics().counter(
+            "rpc.deadline_shed").value == shed0 + 1
+
+
 class TestSubprocessReplica:
     def test_spawn_serve_remove(self, tmp_path):
         """The real child path: spawn one demo replica, serve over RPC,

@@ -22,6 +22,14 @@ evidence):
 
     python tools/serve_bench.py --fleet 3 --kill-replica-at 2.0
 
+Chaos mode (docs/robustness.md — the network half of the failure
+model): a seeded schedule mixing latency, drops, resets, frame
+corruption, and trickle against the fleet's RPC plane; reports lost
+requests (must be 0), checksum-detected corruptions, and circuit
+breaker transitions.  Same seed ⇒ same injected-fault sequence:
+
+    python tools/serve_bench.py --chaos 42 --fleet 2 --qps 60 --seconds 6
+
 Emits one JSON line (machine-readable, bench.py-style) and appends it
 to BENCH_evidence.json via bench.record_evidence on real accelerators.
 ``bench.py --model serve`` (child mode) rides this module for the
@@ -207,11 +215,42 @@ def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
     return report
 
 
+def chaos_schedule(seed: int, duration_s: float):
+    """Derive the --chaos fault schedule from one seed: a randomized
+    mix of every fault kind, placed deterministically (same seed ⇒ same
+    windows, same per-rule decision streams — the replay contract).
+    Returns (parent_spec, child_spec): the parent injects on the
+    router→replica request path (with a reset window aimed at one
+    replica's RPC port, patched in once ports are known), the child
+    spec rides FLAGS_faultline into every replica subprocess and
+    injects on the reply path."""
+    import random
+    rng = random.Random(int(seed))
+    corrupt_at = rng.uniform(0.4, max(0.8, duration_s * 0.25))
+    reset_at = rng.uniform(duration_s * 0.35, duration_s * 0.55)
+    parent = {"seed": int(seed), "faults": [
+        {"kind": "latency", "prob": 0.3, "ms": round(rng.uniform(2, 10), 2),
+         "jitter_ms": round(rng.uniform(0, 6), 2)},
+        {"kind": "drop", "prob": 0.02, "max_injections": 4},
+        {"kind": "trickle", "prob": 0.04, "bytes_per_s": 262144},
+        {"kind": "corrupt", "prob": 1.0, "start_s": round(corrupt_at, 2),
+         "end_s": round(corrupt_at + 0.3, 2)},
+        {"kind": "reset", "prob": 1.0, "start_s": round(reset_at, 2),
+         "end_s": round(reset_at + rng.uniform(1.2, 2.0), 2),
+         "endpoint": "VICTIM"},
+    ]}
+    child = {"seed": int(seed) + 1, "faults": [
+        {"kind": "latency", "prob": 0.2, "ms": 3, "jitter_ms": 4},
+        {"kind": "corrupt", "prob": 0.01, "max_injections": 3},
+    ]}
+    return parent, child
+
+
 def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                 kill_at=None, policy="least_queue", hidden=64,
                 max_batch=32, max_wait_us=2000, queue_depth=256,
                 cache_dir=None, watchdog_stall_s=2.0, deadline_ms=None,
-                seed=0):
+                seed=0, chaos_seed=None):
     """The kill-mid-run fleet protocol: N subprocess replicas behind the
     router, open-loop Poisson load, SIGKILL one replica at ``kill_at``
     seconds into the run (auto_replace spawns a warm replacement from
@@ -222,6 +261,7 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
     import shutil
     import tempfile
 
+    from paddle_tpu.distributed import faultline
     from paddle_tpu.fluid import trace
     from paddle_tpu.serving import fleet as fleet_mod
 
@@ -232,13 +272,32 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
         hidden=hidden, features=16, max_batch=max_batch,
         max_wait_us=max_wait_us, queue_depth=queue_depth, seed=seed,
         watchdog_stall_s=watchdog_stall_s)
+    duration_s = n_requests / max(qps, 1e-9)
+    chaos_parent = chaos_child = None
+    env = None
+    if chaos_seed is not None:
+        chaos_parent, chaos_child = chaos_schedule(chaos_seed, duration_s)
+        env = {"FLAGS_faultline": json.dumps(chaos_child)}
     t_up0 = time.perf_counter()
     fl = fleet_mod.ServingFleet(
         spec=spec, n_replicas=int(n_replicas), policy=policy,
         auto_replace=True, persistent_cache_dir=cache_dir,
         scrape_interval_s=0.25, missed_scrape_limit=2,
-        rpc_timeout_s=10.0, quiet_children=True)
+        max_attempts=30 if chaos_seed is not None else 6,
+        rpc_timeout_s=10.0, quiet_children=True, env=env)
     fleet_up_s = time.perf_counter() - t_up0
+    fl_inject = None
+    corrupt0 = m.counter("rpc.corrupt_frames").value
+    bopen0 = m.counter("fleet.breaker_opens").value
+    bclose0 = m.counter("fleet.breaker_closes").value
+    if chaos_parent is not None:
+        # aim the reset window at a live replica's RPC port, then start
+        # the schedule clock — the load loop below runs inside it
+        victim = fl.router.replicas[-1]
+        for rule in chaos_parent["faults"]:
+            if rule.get("endpoint") == "VICTIM":
+                rule["endpoint"] = f"*:{victim.rpc_port}"
+        fl_inject = faultline.install(chaos_parent)
     rng = np.random.RandomState(1)
     pool = rng.randn(max(sizes) * 4, 16).astype("float32")
 
@@ -292,8 +351,40 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                     warm_spinup = spawns[0]["spinup_s"]
                 w = reps[0].get("warmup") or {}
                 replacement_cold = w.get("cold_misses")
+        chaos = None
+        if chaos_parent is not None:
+            # replica-side truth: scraped /stats carries each child's
+            # checksum-caught corruptions and its own injections
+            child_detected = child_injected = 0
+            for r in fl.router.replicas:
+                if r.in_process or not r.alive():
+                    continue
+                try:
+                    st = r.scrape(timeout_s=3.0)
+                except Exception:   # noqa: BLE001 — best effort
+                    continue
+                child_detected += (st.get("rpc") or {}).get(
+                    "corrupt_frames", 0)
+                child_injected += (st.get("faults") or {}).get(
+                    "injected", 0)
+            chaos = {
+                "seed": int(chaos_seed),
+                "injected": fl_inject.injected,
+                "child_injected": child_injected,
+                "corruptions_detected_by_replicas": child_detected,
+                "corruptions_detected_by_router":
+                    m.counter("rpc.corrupt_frames").value - corrupt0,
+                "breaker_opens":
+                    m.counter("fleet.breaker_opens").value - bopen0,
+                "breaker_closes":
+                    m.counter("fleet.breaker_closes").value - bclose0,
+                "breaker_events": len(fl.events_of("breaker_open"))
+                    + len(fl.events_of("breaker_close")),
+            }
         fstats = fl.stats()
     finally:
+        if fl_inject is not None:
+            faultline.uninstall()
         fl.close()
         if own_cache:
             shutil.rmtree(cache_dir, ignore_errors=True)
@@ -329,6 +420,9 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                    "hidden": hidden, "deadline_ms": deadline_ms,
                    "watchdog_stall_s": watchdog_stall_s},
     }
+    if chaos is not None:
+        report["metric"] = "fleet_chaos_qps"
+        report["chaos"] = chaos
     return report
 
 
@@ -355,6 +449,12 @@ def main(argv=None):
                     metavar="T", help="fleet mode: SIGKILL one replica T "
                     "seconds into the load (reports ejection latency, "
                     "reroutes, warm spin-up)")
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="fleet mode: run under a seeded fault schedule "
+                         "mixing latency/drop/reset/corrupt/trickle on "
+                         "the RPC plane (same seed = same schedule); "
+                         "reports loss, detected corruptions, and "
+                         "breaker transitions")
     ap.add_argument("--policy", default="least_queue",
                     choices=("least_queue", "round_robin"))
     ap.add_argument("--cache-dir", default=None,
@@ -371,6 +471,8 @@ def main(argv=None):
     if args.seconds:
         n = max(1, int(args.qps * args.seconds))
     sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+    if args.chaos is not None and not args.fleet:
+        args.fleet = 2                  # chaos is a fleet drill
     if args.fleet:
         report = fleet_bench(
             n_replicas=args.fleet, qps=args.qps, n_requests=n,
@@ -379,7 +481,7 @@ def main(argv=None):
             max_batch=args.max_batch, max_wait_us=args.max_wait_us,
             queue_depth=args.queue_depth, cache_dir=args.cache_dir,
             watchdog_stall_s=args.watchdog_stall_s,
-            deadline_ms=args.deadline_ms)
+            deadline_ms=args.deadline_ms, chaos_seed=args.chaos)
     else:
         report = serve_bench(
             qps=args.qps, n_requests=n, sizes=sizes,
