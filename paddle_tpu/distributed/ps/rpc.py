@@ -215,6 +215,49 @@ def recv_msg(sock, max_frame_bytes: Optional[int] = None):
     return header, arrays
 
 
+# ---------------------------------------------------------------------------
+# cross-process trace propagation (server side)
+# ---------------------------------------------------------------------------
+
+def begin_server_trace(header):
+    """Open a server-side trace scope for one dispatched request.  When
+    the header carries a propagated ``trace_id`` (only ever stamped by a
+    tracing-on client), install it as the ambient trace context so every
+    span and flight-recorder record the dispatch emits inherits the
+    CALLER's id, and note the wall-clock receive instant for the
+    clock-offset pair.  Returns None (nothing to do, nothing added to
+    the reply — the tracing-off wire stays byte-identical) or an opaque
+    scope for :func:`end_server_trace`."""
+    tid = header.get("trace_id")
+    if tid is None:
+        return None
+    return {"trace_id": tid, "op": header.get("op"),
+            "recv_ts": time.time(),
+            "t0_ns": trace.now() if trace.enabled() else None,
+            "token": trace.set_context(tid, header.get("parent_span"))}
+
+
+def end_server_trace(scope, reply):
+    """Close a :func:`begin_server_trace` scope: restore the previous
+    ambient context, stamp the server's wall-clock recv/send pair into
+    the reply (the other half of the NTP-style offset estimate the
+    timeline stitcher uses), and emit the server-side ``rpc::server``
+    span when this process is tracing."""
+    if scope is None:
+        return
+    trace.restore_context(scope["token"])
+    send_ts = time.time()
+    if isinstance(reply, dict):
+        reply["srv_recv_ts"] = scope["recv_ts"]
+        reply["srv_send_ts"] = send_ts
+    if scope["t0_ns"] is not None:
+        trace.complete("rpc::server", scope["t0_ns"], cat="rpc",
+                       args={"op": scope["op"],
+                             "trace_id": scope["trace_id"],
+                             "recv_ts": scope["recv_ts"],
+                             "send_ts": send_ts})
+
+
 # ops safe to blind-retry (re-execution is a no-op or pure read) vs ops
 # that need the server-side req_id dedup window to retry safely
 _IDEMPOTENT_OPS = frozenset((
@@ -325,6 +368,7 @@ class PsServer:
                                 if owner:
                                     outer._dedup_abort(rid)
                             else:
+                                scope = begin_server_trace(header)
                                 try:
                                     reply, out = outer._dispatch(header,
                                                                  arrays)
@@ -344,6 +388,8 @@ class PsServer:
                                                               out)
                                         else:
                                             outer._dedup_abort(rid)
+                                finally:
+                                    end_server_trace(scope, reply)
                         send_msg(sock, reply, out)
                         if op == "stop":
                             break
@@ -681,6 +727,12 @@ class PsClient:
             # one id per LOGICAL call, stable across retries — the
             # server's dedup window makes the retry exactly-once
             hdr["req_id"] = self._next_req_id()
+        # trace propagation rides the same contract as req_id: stamped
+        # ONCE per logical call so every retry carries the SAME trace id
+        # (the dedup window never sees two ids for one call), and only
+        # when tracing is on — a tracing-off client's frames are
+        # byte-identical to a build without propagation
+        hdr.update(trace.propagation_fields("ps"))
         max_attempts = 1 + self.retries if retryable else 1
         attempt = 0
         while True:
@@ -697,12 +749,19 @@ class PsClient:
             att_timeout = max(
                 remaining / max(max_attempts - attempt + 1, 1), 0.05)
             send_done = False
+            t0_ns = None
             try:
                 with self._locks[i]:
                     try:
                         sock = self._sock(i, budget_s=remaining)
                         sock.settimeout(min(att_timeout, self.timeout))
                         hdr["deadline_ts"] = time.time() + remaining
+                        if "trace_id" in hdr:
+                            # wall-clock send stamp: the client half of
+                            # the clock-offset pair; refreshed per
+                            # attempt (only present when tracing is on)
+                            hdr["send_ts"] = time.time()
+                            t0_ns = trace.now()
                         send_msg(sock, hdr, arrays)
                         send_done = True
                         reply, out = recv_msg(sock)
@@ -727,6 +786,15 @@ class PsClient:
                 time.sleep(min(backoff,
                                max(deadline - time.monotonic(), 0.0)))
                 continue
+            if t0_ns is not None and trace.enabled():
+                trace.complete(
+                    "rpc::client", t0_ns, cat="rpc",
+                    args={"op": op, "endpoint": self.endpoints[i],
+                          "trace_id": hdr["trace_id"], "attempt": attempt,
+                          "send_ts": hdr["send_ts"],
+                          "recv_ts": time.time(),
+                          "srv_recv_ts": reply.get("srv_recv_ts"),
+                          "srv_send_ts": reply.get("srv_send_ts")})
             if not reply.get("ok", False):
                 if reply.get("error") == "DeadlineExceededError":
                     raise RpcDeadlineError(

@@ -232,12 +232,17 @@ def record_request(trace_id: str, rows: int, outcome: str = "ok",
                    batch_rows: Optional[int] = None,
                    bucket=None, queue_us: Optional[float] = None,
                    device_us: Optional[float] = None,
-                   latency_us: Optional[float] = None) -> None:
-    """One wide event per served (or rejected/timed-out) request."""
+                   latency_us: Optional[float] = None,
+                   replica: Optional[str] = None) -> None:
+    """One wide event per served (or rejected/timed-out) request.
+    ``replica`` attributes a fleet-routed request to the replica that
+    served it (the router records these parent-side)."""
     rec: Dict[str, Any] = {
         "kind": "request", "trace_id": trace_id, "rows": int(rows),
         "outcome": outcome,
     }
+    if replica is not None:
+        rec["replica"] = replica
     if batch_id is not None:
         rec["batch_id"] = batch_id
     if batch_rows is not None:

@@ -40,14 +40,15 @@ import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from . import goodput
 from . import trace
 
 __all__ = [
     "prometheus_text", "sanitize_metric_name", "goodput_payload",
-    "stats_payload",
+    "stats_payload", "parse_prometheus_text",
+    "register_fleet_provider", "unregister_fleet_provider",
     "MetricsServer", "SnapshotWriter", "write_snapshot",
     "start_http", "stop_http", "start_snapshots", "stop_snapshots",
     "apply_flags", "shutdown",
@@ -209,7 +210,79 @@ def stats_payload() -> Dict[str, Any]:
             n = _counter(f"fault.{k}")
             if n:
                 out["faults"][k] = n
+    # PS-tier health (start_heartbeat_monitor's gauges): surfaced in
+    # the compact payload so the fleet aggregator and chaos drills see
+    # dead workers without a full /metrics scrape
+    ps = {"dead_workers": int(_gauge("ps.dead_workers")),
+          "worker_deaths": _counter("ps.worker_deaths")}
+    if any(ps.values()):
+        out["ps"] = ps
     return out
+
+
+def parse_prometheus_text(text: str) -> List[Dict[str, Any]]:
+    """Parse the exposition format :func:`prometheus_text` renders back
+    into families: ``[{"name", "type", "samples": [(sample_name,
+    labels_dict, value), ...]}, ...]``.  Summary families carry their
+    quantile lines plus ``_sum``/``_count`` samples.  The fleet
+    aggregator uses this to re-label and roll up replica scrapes;
+    unknown/malformed lines are skipped, never fatal."""
+    fams: List[Dict[str, Any]] = []
+    by_name: Dict[str, Dict[str, Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                fam = {"name": parts[2], "type": parts[3], "samples": []}
+                fams.append(fam)
+                by_name[parts[2]] = fam
+            continue
+        try:
+            sample, value_s = line.rsplit(None, 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        sname = sample
+        if sample.endswith("}") and "{" in sample:
+            sname, _, lab = sample.partition("{")
+            for item in lab[:-1].split(","):
+                if "=" in item:
+                    k, _, v = item.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+        fam = by_name.get(sname)
+        if fam is None and (sname.endswith("_sum")
+                            or sname.endswith("_count")):
+            fam = by_name.get(sname.rsplit("_", 1)[0])
+        if fam is None:
+            fam = {"name": sname, "type": "untyped", "samples": []}
+            fams.append(fam)
+            by_name[sname] = fam
+        fam["samples"].append((sname, labels, value))
+    return fams
+
+
+# -- fleet provider ----------------------------------------------------------
+# A ServingFleet registers its FleetMetricsAggregator here; the handler
+# then serves the aggregated views on /fleet/metrics + /fleet/stats.
+# One provider at a time (latest registration wins).
+_fleet_provider = None
+
+
+def register_fleet_provider(provider) -> None:
+    """``provider`` must expose ``fleet_metrics_text() -> str`` and
+    ``fleet_stats() -> dict``."""
+    global _fleet_provider
+    _fleet_provider = provider
+
+
+def unregister_fleet_provider(provider) -> None:
+    global _fleet_provider
+    if _fleet_provider is provider:
+        _fleet_provider = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -246,6 +319,44 @@ class _Handler(BaseHTTPRequestHandler):
             # "Serving fleet")
             body = json.dumps(stats_payload(), default=str).encode()
             ctype = "application/json"
+        elif path == "/bundle":
+            # the process's diagnostic-bundle document, built on demand
+            # — what a fleet parent embeds in its incident bundle (HTTP
+            # rather than RPC: no frame-size cap, and a wedged engine's
+            # RPC plane may be the very thing being diagnosed)
+            reason = "fetch"
+            if "reason=" in self.path:
+                reason = self.path.split("reason=", 1)[1].split("&")[0] \
+                    or "fetch"
+            try:
+                from . import watchdog
+                doc = watchdog.build_bundle_doc(reason)
+            except Exception as e:      # noqa: BLE001 — a diagnostic
+                doc = {"error": f"{type(e).__name__}: {e}"}  # never 500s
+            body = json.dumps(doc, default=str).encode()
+            ctype = "application/json"
+        elif path in ("/fleet/metrics", "/fleet/stats"):
+            p = _fleet_provider
+            if p is None:
+                body = b"no fleet registered\n"
+                self.send_response(404)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            try:
+                if path == "/fleet/metrics":
+                    body = p.fleet_metrics_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    body = json.dumps(p.fleet_stats(),
+                                      default=str).encode()
+                    ctype = "application/json"
+            except Exception as e:      # noqa: BLE001 — a scrape must
+                body = json.dumps(                         # never crash
+                    {"error": f"{type(e).__name__}: {e}"}).encode()
+                ctype = "application/json"
         else:
             body = b"not found\n"
             self.send_response(404)

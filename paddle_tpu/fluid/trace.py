@@ -54,6 +54,7 @@ __all__ = [
     "reset_all", "set_path", "get_path", "set_max_events", "elapsed_us",
     "new_id", "new_trace_id", "trace_context", "current_trace_id",
     "current_span_id", "set_context", "restore_context",
+    "propagation_fields",
     "export_chrome_trace",
     "op_summary", "summary_table", "metrics", "MetricsRegistry",
     "gauge_value", "counter_value",
@@ -235,6 +236,26 @@ class _TraceCtx:
 def trace_context(trace_id: Optional[str],
                   span_id: Optional[int] = None) -> _TraceCtx:
     return _TraceCtx(trace_id, span_id)
+
+
+def propagation_fields(prefix: str = "rpc") -> Dict[str, Any]:
+    """Trace-context fields an RPC client should stamp into an outgoing
+    header: ``{"trace_id": ..., "parent_span": ...}`` (parent_span only
+    when a span is open).  Returns ``{}`` when tracing is disabled so a
+    tracing-off process puts ZERO extra bytes on the wire — frames stay
+    byte-identical to a build without propagation.  When tracing is on
+    but no ambient context is installed, a fresh id is allocated so the
+    callee's spans still join up under one id; callers doing retries
+    must call this ONCE per logical call (like the dedup ``req_id``) so
+    every attempt carries the same id."""
+    if not _state.enabled:
+        return {}
+    fields: Dict[str, Any] = {
+        "trace_id": current_trace_id() or new_trace_id(prefix)}
+    span_id = current_span_id()
+    if span_id is not None:
+        fields["parent_span"] = span_id
+    return fields
 
 
 def _with_ctx(ev: Dict[str, Any],
@@ -758,6 +779,12 @@ def export_chrome_trace(path: Optional[str] = None) -> str:
     doc = {"traceEvents": meta + events + tail,
            "displayTimeUnit": "ms",
            "metadata": {"producer": "paddle_tpu.fluid.trace",
+                        "pid": os.getpid(),
+                        # wall-clock instant of timeline ts=0: lets a
+                        # stitcher place several per-process traces
+                        # (each in its own perf_counter coordinate
+                        # system) on one common axis
+                        "epoch_unix_ts": time.time() - elapsed_us() / 1e6,
                         "dropped_events": _state.dropped,
                         "metrics": _registry.snapshot()}}
     d = os.path.dirname(os.path.abspath(path))

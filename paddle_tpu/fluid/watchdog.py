@@ -65,12 +65,14 @@ from . import trace
 __all__ = [
     "SloWatchdog", "start", "stop", "get", "health", "apply_flags",
     "dump_bundle", "list_bundles", "load_bundle", "notify_oom",
+    "build_bundle_doc", "dump_fleet_bundle", "list_fleet_bundles",
     "install_crash_hook", "uninstall_crash_hook",
-    "DEFAULT_DIAGNOSTIC_DIR", "BUNDLE_SCHEMA",
+    "DEFAULT_DIAGNOSTIC_DIR", "BUNDLE_SCHEMA", "FLEET_BUNDLE_SCHEMA",
 ]
 
 DEFAULT_DIAGNOSTIC_DIR = "/tmp/paddle_tpu_diagnostics"
 BUNDLE_SCHEMA = "paddle_tpu.diagnostic_bundle.v1"
+FLEET_BUNDLE_SCHEMA = "paddle_tpu.fleet_bundle.v1"
 
 
 def _flag(name, default):
@@ -157,12 +159,14 @@ def dump_bundle(reason: str, diagnostic_dir: Optional[str] = None,
         return ""
 
 
-def _dump_bundle(reason, diagnostic_dir, exc, extra, trace_tail,
-                 watchdog_state=None) -> str:
-    root = os.path.abspath(diagnostic_dir
-                           or _flag("diagnostic_dir", None)
-                           or DEFAULT_DIAGNOSTIC_DIR)
-    os.makedirs(root, exist_ok=True)
+def build_bundle_doc(reason: str, exc: Optional[BaseException] = None,
+                     extra: Optional[Dict[str, Any]] = None,
+                     trace_tail: Optional[int] = None,
+                     watchdog_state: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """The diagnostic-bundle document WITHOUT writing it anywhere —
+    what a fleet parent fetches over the replica's /bundle endpoint to
+    embed in a fleet incident bundle."""
     tail_n = int(trace_tail if trace_tail is not None
                  else _flag("diagnostic_trace_tail", 5000))
     wide = flight_recorder.recorder().snapshot()
@@ -202,6 +206,18 @@ def _dump_bundle(reason, diagnostic_dir, exc, extra, trace_tail,
         }
     if extra:
         doc["extra"] = _json_safe(extra)
+    return doc
+
+
+def _dump_bundle(reason, diagnostic_dir, exc, extra, trace_tail,
+                 watchdog_state=None) -> str:
+    root = os.path.abspath(diagnostic_dir
+                           or _flag("diagnostic_dir", None)
+                           or DEFAULT_DIAGNOSTIC_DIR)
+    os.makedirs(root, exist_ok=True)
+    doc = build_bundle_doc(reason, exc=exc, extra=extra,
+                           trace_tail=trace_tail,
+                           watchdog_state=watchdog_state)
     stamp = time.strftime("%Y%m%d-%H%M%S")
     path = os.path.join(
         root, f"bundle-{stamp}-{reason}-{os.getpid()}-{trace.new_id()}"
@@ -233,6 +249,63 @@ def list_bundles(diagnostic_dir: Optional[str] = None) -> List[str]:
 def load_bundle(path: str) -> Dict[str, Any]:
     with open(path) as f:
         return json.load(f)
+
+
+def dump_fleet_bundle(reason: str, replica: str,
+                      router_view: Dict[str, Any],
+                      replica_bundles: Dict[str, Any],
+                      diagnostic_dir: Optional[str] = None) -> str:
+    """Freeze one FLEET incident bundle: the router's own view of the
+    incident window (``router_view``: events, breaker states, scrape
+    history, in-flight count) plus each involved replica's embedded
+    diagnostic-bundle document (``replica_bundles``, name → doc or
+    ``{"error": ...}`` when the replica couldn't answer).  Same
+    never-raises contract as :func:`dump_bundle`."""
+    try:
+        root = os.path.abspath(diagnostic_dir
+                               or _flag("diagnostic_dir", None)
+                               or DEFAULT_DIAGNOSTIC_DIR)
+        os.makedirs(root, exist_ok=True)
+        doc = {
+            "schema": FLEET_BUNDLE_SCHEMA,
+            "reason": reason,
+            "replica": replica,
+            "ts": time.time(),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "pid": os.getpid(),
+            "router": _json_safe(router_view),
+            "replicas": replica_bundles,
+        }
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(
+            root, f"fleet-bundle-{stamp}-{reason}-{os.getpid()}-"
+                  f"{trace.new_id()}.json")
+        from .checkpoint import atomic_write_bytes
+        atomic_write_bytes(path, json.dumps(doc, default=str).encode())
+        trace.metrics().counter("watchdog.fleet_bundles").inc()
+        flight_recorder.record("fleet_incident", reason=reason,
+                               replica=replica, bundle=path)
+        print(f"paddle_tpu.watchdog: fleet {reason} ({replica}) — "
+              f"incident bundle written to {path} (render with: python "
+              f"tools/diagnose.py --fleet {path})", file=sys.stderr)
+        return path
+    except Exception as e:          # noqa: BLE001 — diagnostics never
+        print(f"paddle_tpu.watchdog: fleet bundle dump failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        trace.metrics().counter("watchdog.bundle_errors").inc()
+        return ""
+
+
+def list_fleet_bundles(diagnostic_dir: Optional[str] = None) -> List[str]:
+    root = os.path.abspath(diagnostic_dir
+                           or _flag("diagnostic_dir", None)
+                           or DEFAULT_DIAGNOSTIC_DIR)
+    try:
+        return sorted(os.path.join(root, f) for f in os.listdir(root)
+                      if f.startswith("fleet-bundle-")
+                      and f.endswith(".json"))
+    except OSError:
+        return []
 
 
 # ---------------------------------------------------------------------------

@@ -395,7 +395,8 @@ class DecodeEngine:
 
     # -- admission -----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 16,
-               eos_id: Optional[int] = None) -> DecodeFuture:
+               eos_id: Optional[int] = None,
+               trace_id: Optional[str] = None) -> DecodeFuture:
         if self._closed:
             raise EngineClosedError("DecodeEngine is closed")
         if not self._started and self._auto_start:
@@ -414,7 +415,10 @@ class DecodeEngine:
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
                 f"exceeds the model's KV window max_len="
                 f"{self.model.max_len}")
-        trace_id = trace.new_trace_id("dec")
+        # explicit/ambient id wins (cross-process propagation keeps the
+        # caller's causal identity); fresh "dec-" id otherwise
+        trace_id = (trace_id or trace.current_trace_id()
+                    or trace.new_trace_id("dec"))
         fut = DecodeFuture(trace_id=trace_id)
         req = _DecodeRequest(prompt, max_new, eos_id, fut, trace_id)
         with self._lock:

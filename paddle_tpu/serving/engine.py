@@ -121,7 +121,7 @@ class ServingFuture(BaseFuture):
     request's full trajectory — allocated whether or not tracing is on
     (the flight recorder keys on it even then)."""
 
-    __slots__ = ("rows", "trace_id")
+    __slots__ = ("rows", "trace_id", "timing")
 
     _pending_msg = "serving request still pending"
 
@@ -129,6 +129,10 @@ class ServingFuture(BaseFuture):
         super().__init__()
         self.rows = rows
         self.trace_id = trace_id
+        # set by the collector at demux: {"queue_us", "device_us",
+        # "latency_us"} — lets a replica server report the split back to
+        # the fleet router without scanning the flight recorder
+        self.timing: Optional[Dict[str, float]] = None
 
 
 class _Request:
@@ -561,11 +565,18 @@ class ServingEngine:
 
     # -- request admission ---------------------------------------------------
     def submit(self, feed: Dict[str, Any],
-               deadline_ms: Optional[float] = None) -> ServingFuture:
+               deadline_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> ServingFuture:
         """Admit one request.  Every feed array must share the same
         leading (row) dim; raises :class:`QueueFullError` when the
         admission queue is at capacity and :class:`EngineClosedError`
-        after close()."""
+        after close().
+
+        ``trace_id`` (or, failing that, the ambient
+        ``trace.current_trace_id()`` a fleet replica server installs
+        around dispatch) overrides the freshly allocated id, so a
+        request propagated across a process boundary keeps its CALLER's
+        causal identity end to end."""
         if self._closed:
             raise EngineClosedError("ServingEngine is closed")
         if not self._started and self._auto_start:
@@ -592,8 +603,10 @@ class ServingEngine:
                  else self.default_deadline_ms)
         deadline = now + dl_ms / 1e3 if dl_ms and dl_ms > 0 else None
         # the request's causal identity — allocated with tracing ON or
-        # OFF (the flight recorder's wide events key on it either way)
-        trace_id = trace.new_trace_id("req")
+        # OFF (the flight recorder's wide events key on it either way);
+        # a propagated/ambient id wins so cross-process stories join
+        trace_id = (trace_id or trace.current_trace_id()
+                    or trace.new_trace_id("req"))
         fut = ServingFuture(n_rows, trace_id=trace_id)
         req = _Request(arrs, n_rows, sig, now, deadline, fut, trace_id)
         # closed-check + enqueue under the lock: close() takes the same
@@ -848,6 +861,9 @@ class ServingEngine:
                         bucket=bucket, queue_us=queue_s * 1e6,
                         device_us=device_s * 1e6,
                         latency_us=latency_s * 1e6)
+                r.future.timing = {"queue_us": queue_s * 1e6,
+                                   "device_us": device_s * 1e6,
+                                   "latency_us": latency_s * 1e6}
                 r.future._resolve(res)
 
     # -- introspection -------------------------------------------------------

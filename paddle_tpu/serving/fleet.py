@@ -42,16 +42,19 @@ import sys
 import threading
 import time
 import urllib.request
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..fluid import flight_recorder as _flight
 from ..fluid import trace
 from .engine import (BaseFuture, DeadlineExceededError, EngineClosedError,
                      QueueFullError, ServingEngine, ServingError)
 
 __all__ = [
     "ServingFleet", "Router", "ReplicaHandle", "FleetFuture",
+    "FleetMetricsAggregator",
     "ReplicaServer", "serve_replica", "build_engine_from_spec",
     "demo_mlp_spec", "NoReplicaError", "ReplicaTransportError",
     "CircuitBreaker",
@@ -142,7 +145,9 @@ class ReplicaServer:
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, info: Optional[Dict[str, Any]] = None):
-        from ..distributed.ps.rpc import (CorruptFrameError, recv_msg,
+        from ..distributed.ps.rpc import (CorruptFrameError,
+                                          begin_server_trace,
+                                          end_server_trace, recv_msg,
                                           send_msg)
         self.engine = engine
         self.info = dict(info or {})
@@ -163,6 +168,11 @@ class ReplicaServer:
                             # stream is desynchronized — drop the
                             # connection, the router redispatches
                             return
+                        # propagated trace context (if any) wraps the
+                        # dispatch so engine spans + flight records
+                        # inherit the ROUTER's trace id
+                        reply = out = None
+                        scope = begin_server_trace(header)
                         try:
                             reply, out = outer._dispatch(header, arrays)
                         except Exception as e:  # noqa: BLE001 — report
@@ -179,6 +189,8 @@ class ReplicaServer:
                                         EngineClosedError,
                                         TimeoutError)),
                             }, []
+                        finally:
+                            end_server_trace(scope, reply)
                         send_msg(sock, reply, out)
                         if header.get("op") == "stop":
                             break
@@ -219,9 +231,14 @@ class ReplicaServer:
                 timeout_s = min(timeout_s, dl / 1e3 + 5.0)
             res = fut.result(timeout=timeout_s)
             fetch_names = list(res)
-            return ({"ok": True, "fetches": fetch_names,
-                     "trace_id": fut.trace_id},
-                    [np.asarray(res[n]) for n in fetch_names])
+            reply = {"ok": True, "fetches": fetch_names,
+                     "trace_id": fut.trace_id}
+            if "trace_id" in header and fut.timing:
+                # queue/device split for the router's attribution —
+                # only on traced requests, so the tracing-off wire
+                # stays byte-identical
+                reply.update(fut.timing)
+            return (reply, [np.asarray(res[n]) for n in fetch_names])
         if op == "hello":
             return {"ok": True, "pid": os.getpid(), **self.info}, []
         if op == "stats":
@@ -288,6 +305,15 @@ def serve_replica(spec: Dict[str, Any], ready_stream=None) -> None:
     ready_stream.flush()
     rpc.wait()
     engine.close()
+    if trace.enabled():
+        # per-process trace file (FLAGS_trace_path, templated per
+        # replica by the fleet) — written deterministically at graceful
+        # stop so `tools/timeline.py stitch` can merge it; the atexit
+        # hook still covers other exits
+        try:
+            trace.export_chrome_trace()
+        except OSError:
+            pass
     metrics_export.stop_http()
 
 
@@ -552,12 +578,19 @@ class ReplicaHandle:
         except OSError as e:
             raise ReplicaTransportError(
                 f"connect to {self.name}: {e}") from e
+        t0_ns = None
         try:
             # per-call socket deadline, with headroom over the replica's
             # own wait so its typed TimeoutError reply (retryable) wins
             # the race against a raw socket timeout
             s.settimeout((timeout_s + 2.0) if timeout_s
                          else self.rpc_timeout_s)
+            if "trace_id" in header and trace.enabled():
+                # wall-clock send stamp: the client half of the
+                # clock-offset pair the timeline stitcher estimates
+                # from (only present on traced requests)
+                header["send_ts"] = time.time()
+                t0_ns = trace.now()
             send_msg(s, header, arrays)
             reply, out = recv_msg(s)
         except (OSError, ConnectionError) as e:
@@ -569,21 +602,46 @@ class ReplicaHandle:
                 f"rpc {header.get('op')} to {self.name}: "
                 f"{type(e).__name__}: {e}") from e
         self._pool.checkin(s)
+        if t0_ns is not None:
+            trace.complete(
+                "rpc::client", t0_ns, cat="rpc",
+                args={"op": header.get("op"), "replica": self.name,
+                      "trace_id": header["trace_id"],
+                      "send_ts": header["send_ts"],
+                      "recv_ts": time.time(),
+                      "srv_recv_ts": reply.get("srv_recv_ts"),
+                      "srv_send_ts": reply.get("srv_send_ts")})
         return reply, out
 
     def infer(self, feed: Dict[str, np.ndarray],
               deadline_ms: Optional[float] = None,
-              timeout_s: Optional[float] = None) -> Dict[str, np.ndarray]:
+              timeout_s: Optional[float] = None,
+              info: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, np.ndarray]:
         """Serve one request on THIS replica.  Raises
         ReplicaTransportError (retryable), QueueFullError (retryable
-        elsewhere), or the replica's terminal error."""
+        elsewhere), or the replica's terminal error.
+
+        When tracing is on, the outgoing header carries the ambient
+        ``trace_id``/``parent_span`` (the router installs its request id
+        around this call) so the replica's spans inherit the caller's
+        causal identity; with tracing off the header is byte-identical
+        to a build without propagation.  ``info``, if given a dict, is
+        filled with reply metadata: the served ``trace_id`` and — on
+        traced requests — the replica's ``queue_us``/``device_us``
+        split."""
         if self.in_process:
             if self._infer_fn is not None:
                 if self._infer_takes_deadline:
                     return self._infer_fn(feed, deadline_ms=deadline_ms)
                 return self._infer_fn(feed)
             fut = self.engine.submit(feed, deadline_ms=deadline_ms)
-            return fut.result(timeout=timeout_s or self.rpc_timeout_s)
+            res = fut.result(timeout=timeout_s or self.rpc_timeout_s)
+            if info is not None:
+                info["trace_id"] = fut.trace_id
+                if fut.timing:
+                    info.update(fut.timing)
+            return res
         names = sorted(feed)
         hdr = {"op": "infer", "feeds": names, "deadline_ms": deadline_ms,
                "timeout_s": timeout_s or self.rpc_timeout_s}
@@ -591,6 +649,8 @@ class ReplicaHandle:
             # absolute deadline for server-side shedding (same host /
             # NTP-synced clocks — docs/robustness.md)
             hdr["deadline_ts"] = time.time() + deadline_ms / 1e3
+        # empty with tracing off: zero extra bytes on the wire
+        hdr.update(trace.propagation_fields("req"))
         reply, arrays = self.call(
             hdr, [np.asarray(feed[n]) for n in names],
             timeout_s=timeout_s or self.rpc_timeout_s)
@@ -604,6 +664,10 @@ class ReplicaHandle:
             if reply.get("retryable") or err == "TimeoutError":
                 raise ReplicaTransportError(msg)
             raise ServingError(msg)
+        if info is not None:
+            for k in ("trace_id", "queue_us", "device_us", "latency_us"):
+                if k in reply:
+                    info[k] = reply[k]
         return dict(zip(reply["fetches"], arrays))
 
     # -- health --------------------------------------------------------------
@@ -626,6 +690,21 @@ class ReplicaHandle:
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{self.metrics_port}/stats",
             timeout=timeout_s).read()
+        return json.loads(body)
+
+    def fetch_bundle(self, timeout_s: float = 5.0,
+                     reason: str = "fleet") -> Dict[str, Any]:
+        """The replica's own diagnostic-bundle document (watchdog
+        schema), fetched over its HTTP export plane — the fleet monitor
+        pulls this at ejection time, BEFORE any teardown, to embed in
+        the fleet incident bundle.  A wedged replica still answers (the
+        HTTP plane lives on its own threads); a dead one raises."""
+        if self.in_process:
+            from ..fluid import watchdog
+            return watchdog.build_bundle_doc(reason)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{self.metrics_port}/bundle?reason="
+            f"{reason}", timeout=timeout_s).read()
         return json.loads(body)
 
     def probe(self) -> bool:
@@ -688,9 +767,16 @@ class ReplicaHandle:
 
 class FleetFuture(BaseFuture):
     """One routed request's pending result (same result/exception shape
-    as ServingFuture); ``replica`` names who finally served it."""
+    as ServingFuture); ``replica`` names who finally served it.
 
-    __slots__ = ("replica", "attempts")
+    ``trace_id`` is the fleet-wide causal identity, allocated by the
+    router at submit and STABLE across redispatch attempts — every
+    replica that touches the request (including a second one after a
+    corrupt-frame redispatch) emits its spans under this one id.
+    ``server_timing`` carries the serving replica's queue/device split
+    on traced requests."""
+
+    __slots__ = ("replica", "attempts", "trace_id", "server_timing")
 
     _pending_msg = "fleet request still pending"
 
@@ -698,6 +784,8 @@ class FleetFuture(BaseFuture):
         super().__init__()
         self.replica: Optional[str] = None
         self.attempts = 0
+        self.trace_id: Optional[str] = None
+        self.server_timing: Optional[Dict[str, float]] = None
 
     def _resolve(self, result, replica: str) -> None:  # noqa: D401
         self.replica = replica
@@ -808,6 +896,10 @@ class Router:
         if self._closed:
             raise EngineClosedError("router is closed")
         fut = FleetFuture()
+        # one fleet-wide causal id per LOGICAL request, allocated here
+        # (the pool worker's thread-locals don't inherit the caller's)
+        # and propagated on every dispatch attempt
+        fut.trace_id = trace.new_trace_id("req")
         feed = {k: np.asarray(v) for k, v in feed.items()}
         t0 = time.monotonic()
         try:
@@ -828,6 +920,10 @@ class Router:
              t0: float) -> None:
         exclude: set = set()
         last_exc: Optional[BaseException] = None
+        info: Dict[str, Any] = {}
+        rows = max((int(a.shape[0]) for a in feed.values()
+                    if getattr(a, "ndim", 0) >= 1), default=1)
+        t0_ns = trace.now() if trace.enabled() else None
         # the request's own deadline caps the retry budget: redispatching
         # expired work would burn replica batch slots on a result nobody
         # can use
@@ -868,9 +964,15 @@ class Router:
             if fut.attempts > 1:
                 self._c_redispatch.inc()
             r._inc()
+            info.clear()
             try:
-                res = r.infer(feed, deadline_ms=rem_ms,
-                              timeout_s=att_timeout)
+                # the fleet id rides as ambient context: with tracing
+                # on, ReplicaHandle.infer stamps it into the RPC header
+                # so the replica's spans join under the router's id —
+                # the SAME id on every redispatch attempt
+                with trace.trace_context(fut.trace_id):
+                    res = r.infer(feed, deadline_ms=rem_ms,
+                                  timeout_s=att_timeout, info=info)
             except (ReplicaTransportError, TimeoutError) as e:
                 # transport-class failure: trips the replica's breaker
                 r.breaker.record_failure()
@@ -895,7 +997,26 @@ class Router:
             finally:
                 r._dec()
             r.breaker.record_success()
-            self._h_latency.observe(time.monotonic() - t0)
+            latency_s = time.monotonic() - t0
+            self._h_latency.observe(latency_s)
+            timing = {k: info[k] for k in ("queue_us", "device_us")
+                      if info.get(k) is not None}
+            fut.server_timing = timing or None
+            if _flight.enabled():
+                # parent-side wide event: fleet latency attributed to
+                # the replica that served (plus its queue/device split
+                # on traced requests) — what serve_bench's
+                # slowest_requests joins on
+                _flight.record_request(
+                    fut.trace_id, rows, outcome="ok", replica=r.name,
+                    queue_us=timing.get("queue_us"),
+                    device_us=timing.get("device_us"),
+                    latency_us=latency_s * 1e6)
+            if t0_ns is not None and trace.enabled():
+                trace.complete(
+                    "fleet::request", t0_ns, cat="serving",
+                    args={"trace_id": fut.trace_id, "replica": r.name,
+                          "attempts": fut.attempts, "rows": rows})
             fut._resolve(res, r.name)
             return
         self._c_failures.inc()
@@ -914,6 +1035,151 @@ class Router:
     def close(self) -> None:
         self._closed = True
         self._pool.shutdown(wait=True)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide metrics aggregation
+# ---------------------------------------------------------------------------
+
+class FleetMetricsAggregator:
+    """Merges every replica's ``/stats`` + ``/metrics`` into one
+    parent-side surface (docs/observability.md "Fleet observability").
+
+    The fleet monitor feeds :meth:`record_scrape` on every health poll,
+    building a bounded per-replica scrape history (also the incident
+    bundle's router-side evidence window).  ``metrics_export`` serves
+    the two views on the PARENT's endpoint once the fleet registers the
+    aggregator as its fleet provider:
+
+    * ``/fleet/stats`` — JSON: router stats + each replica's last
+      compact payload + fleet rollups (summed counters, max p99);
+    * ``/fleet/metrics`` — Prometheus text: every subprocess replica's
+      samples re-labeled with ``replica="rN"`` plus ``fleet:``-prefixed
+      rollups (counters summed, gauges as ``agg="min"``/``agg="max"``,
+      summary quantiles as the max over replicas — a p99 upper bound —
+      with ``_sum``/``_count`` summed exactly)."""
+
+    def __init__(self, fleet: "ServingFleet", history: int = 240):
+        self.fleet = fleet
+        self._hist: Dict[str, deque] = {}
+        self._hist_cap = int(history)
+        self._lock = threading.Lock()
+
+    # -- scrape history ------------------------------------------------------
+    def record_scrape(self, name: str, stats: Dict[str, Any]) -> None:
+        with self._lock:
+            dq = self._hist.get(name)
+            if dq is None:
+                dq = self._hist[name] = deque(maxlen=self._hist_cap)
+            dq.append({"ts": time.time(), "stats": stats})
+
+    def scrape_history(self, name: Optional[str] = None,
+                       since_ts: Optional[float] = None
+                       ) -> Dict[str, List[Dict[str, Any]]]:
+        with self._lock:
+            if name is None:
+                items = {n: list(dq) for n, dq in self._hist.items()}
+            else:
+                items = {name: list(self._hist.get(name, ()))}
+        if since_ts is not None:
+            items = {n: [s for s in v if s["ts"] >= since_ts]
+                     for n, v in items.items()}
+        return items
+
+    # -- /fleet/stats --------------------------------------------------------
+    def fleet_stats(self) -> Dict[str, Any]:
+        replicas: Dict[str, Any] = {}
+        rollup = {"requests": 0, "batches": 0, "rejected": 0,
+                  "timeouts": 0}
+        p99s: List[float] = []
+        for r in list(self.fleet.router.replicas):
+            st = dict(r.last_stats or {})
+            st["state"] = r.state
+            replicas[r.name] = st
+            for k in rollup:
+                try:
+                    rollup[k] += int(st.get(k) or 0)
+                except (TypeError, ValueError):
+                    pass
+            if st.get("p99_ms") is not None:
+                p99s.append(float(st["p99_ms"]))
+        rollup["p99_ms_max"] = max(p99s) if p99s else None
+        return {"fleet": self.fleet.stats(), "replicas": replicas,
+                "rollup": rollup}
+
+    # -- /fleet/metrics ------------------------------------------------------
+    def fleet_metrics_text(self) -> str:
+        from ..fluid import metrics_export as mx
+        # family name -> {"type": str, "samples": [(sample_name,
+        # labels, value, replica)]}
+        fams: Dict[str, Dict[str, Any]] = {}
+        notes: List[str] = []
+        n_scraped = 0
+        for r in list(self.fleet.router.replicas):
+            if r.in_process or not r.metrics_port:
+                # in-process replicas share the parent registry (the
+                # plain /metrics endpoint already has them)
+                notes.append(f"# replica {r.name}: in-process — see "
+                             f"/metrics")
+                continue
+            try:
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{r.metrics_port}/metrics",
+                    timeout=2.0).read().decode("utf-8", "replace")
+            except Exception as e:  # noqa: BLE001 — a dead replica is a
+                # fact to report, not a scrape failure
+                notes.append(f"# replica {r.name}: scrape failed: "
+                             f"{type(e).__name__}")
+                continue
+            n_scraped += 1
+            for fam in mx.parse_prometheus_text(text):
+                slot = fams.setdefault(
+                    fam["name"], {"type": fam["type"], "samples": []})
+                for sname, labels, value in fam["samples"]:
+                    slot["samples"].append((sname, labels, value,
+                                            r.name))
+        out = [f"# fleet metrics: {n_scraped} replica(s) aggregated by "
+               f"paddle_tpu ServingFleet"]
+        out += notes
+        for name in sorted(fams):
+            fam = fams[name]
+            ftype = fam["type"]
+            out.append(f"# TYPE {name} {ftype}")
+            for sname, labels, value, rep in fam["samples"]:
+                lab = dict(labels)
+                lab["replica"] = rep
+                body = ",".join(f'{k}="{v}"' for k, v in lab.items())
+                out.append(f"{sname}{{{body}}} {value:g}")
+            out.extend(self._rollup_lines(name, ftype, fam["samples"]))
+        return "\n".join(out) + "\n"
+
+    @staticmethod
+    def _rollup_lines(name: str, ftype: str, samples) -> List[str]:
+        lines = [f"# TYPE fleet:{name} {ftype}"]
+        if ftype == "counter":
+            total = sum(v for sn, _l, v, _r in samples if sn == name)
+            lines.append(f"fleet:{name} {total:g}")
+        elif ftype == "gauge":
+            vals = [v for sn, _l, v, _r in samples if sn == name]
+            if vals:
+                lines.append(f'fleet:{name}{{agg="min"}} {min(vals):g}')
+                lines.append(f'fleet:{name}{{agg="max"}} {max(vals):g}')
+        elif ftype == "summary":
+            by_q: Dict[str, List[float]] = {}
+            sums = {f"{name}_sum": 0.0, f"{name}_count": 0.0}
+            for sname, labels, value, _r in samples:
+                if sname in sums:
+                    sums[sname] += value
+                elif "quantile" in labels:
+                    by_q.setdefault(labels["quantile"], []).append(value)
+            for q in sorted(by_q):
+                # max over replicas: a conservative fleet quantile
+                # (exact merge needs the raw buckets)
+                lines.append(f'fleet:{name}{{quantile="{q}"}} '
+                             f'{max(by_q[q]):g}')
+            for sname, v in sums.items():
+                lines.append(f"fleet:{sname} {v:g}")
+        return lines
 
 
 # ---------------------------------------------------------------------------
@@ -960,9 +1226,24 @@ class ServingFleet:
                  max_attempts: int = 6,
                  request_timeout_s: float = 120.0,
                  env: Optional[Dict[str, str]] = None,
-                 quiet_children: bool = False):
+                 quiet_children: bool = False,
+                 trace_dir: Optional[str] = None,
+                 incident_bundles: Optional[bool] = None,
+                 diagnostic_dir: Optional[str] = None):
         from ..fluid import core
         self.spec = spec
+        # observability knobs: trace_dir turns tracing on in every
+        # replica subprocess, one trace file per replica
+        # (<trace_dir>/trace-<name>.json) for tools/timeline.py stitch;
+        # incident_bundles (default FLAGS_fleet_incident_bundles=True)
+        # freezes one fleet bundle per ejection into diagnostic_dir
+        self.trace_dir = trace_dir
+        self.incident_bundles = bool(
+            core.get_flag("fleet_incident_bundles", True)
+            if incident_bundles is None else incident_bundles)
+        self.diagnostic_dir = diagnostic_dir
+        self.bundles: List[str] = []
+        self.aggregator = FleetMetricsAggregator(self)
         self.scrape_interval_s = float(
             scrape_interval_s if scrape_interval_s is not None
             else core.get_flag("fleet_scrape_interval_s", 1.0))
@@ -1017,6 +1298,11 @@ class ServingFleet:
                                            name="fleet-monitor",
                                            daemon=True)
         self._monitor_t.start()
+        # publish the fleet views on the parent's export endpoint
+        # (/fleet/metrics + /fleet/stats); latest fleet wins if several
+        # coexist in one process
+        from ..fluid import metrics_export
+        metrics_export.register_fleet_provider(self.aggregator)
 
     # -- events --------------------------------------------------------------
     def _event(self, kind: str, replica: str, **fields) -> None:
@@ -1040,6 +1326,16 @@ class ServingFleet:
         if self.persistent_cache_dir:
             env["FLAGS_persistent_cache_dir"] = str(
                 self.persistent_cache_dir)
+        if self.trace_dir:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            env["FLAGS_enable_trace"] = "1"
+            env["FLAGS_trace_path"] = os.path.join(
+                self.trace_dir, f"trace-{name}.json")
+        elif "{replica}" in env.get("FLAGS_trace_path", ""):
+            # caller-supplied template (env={"FLAGS_trace_path":
+            # "/tmp/t-{replica}.json"}) — substitute the replica name
+            env["FLAGS_trace_path"] = \
+                env["FLAGS_trace_path"].format(replica=name)
         t_spawn = time.monotonic()
         proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.serving.fleet",
@@ -1127,6 +1423,7 @@ class ServingFleet:
                     continue
                 r.missed_scrapes = 0
                 r.last_stats = st
+                self.aggregator.record_scrape(r.name, st)
                 verdict = str(st.get("status", "ok"))
                 if r.state == "up" and verdict in ("stalled", "breached"):
                     self.eject(r, verdict)
@@ -1176,6 +1473,65 @@ class ServingFleet:
         self._c_eject.inc()
         self._event("eject", r.name, reason=reason)
         self._g_up.set(len(self.router.admitted()))
+        if self.incident_bundles:
+            # ONE fleet bundle per incident, frozen off the hot path:
+            # eject() is the single funnel every ejection cause
+            # (verdict, breaker, death) passes through, and a replica
+            # re-ejected later is a NEW incident.  The freeze thread
+            # must not block the monitor/breaker callback — the
+            # replica-side fetch rides an HTTP timeout.
+            threading.Thread(target=self._freeze_fleet_bundle,
+                             args=(r, reason), name="fleet-bundle",
+                             daemon=True).start()
+
+    def _freeze_fleet_bundle(self, r: ReplicaHandle, reason: str) -> None:
+        """Coordinated incident bundle: the router-side view of the
+        ejection window (routing decisions, breaker states, scrape
+        history) plus the ejected replica's OWN watchdog bundle fetched
+        before any teardown — one JSON document `diagnose.py --fleet`
+        renders as the cross-process story."""
+        from ..fluid import watchdog as wdog
+        try:
+            now = time.time()
+            window_s = 120.0
+            with self._ev_lock:
+                events = [e for e in self.events
+                          if now - e["ts"] <= window_s]
+            router_view = {
+                "stats": self.stats(),
+                "events": events,
+                "breakers": {h.name: h.breaker.describe()
+                             for h in list(self.router.replicas)},
+                "in_flight": self.router.outstanding(),
+                # routing decisions: the parent-side flight records the
+                # router writes per dispatched request (replica
+                # attribution + queue/device split when traced)
+                "requests": [rec for rec in
+                             _flight.recorder().snapshot(last=500)
+                             if rec.get("kind") == "request"],
+                "scrape_history": self.aggregator.scrape_history(
+                    since_ts=now - window_s),
+                "window_s": window_s,
+            }
+            bundles: Dict[str, Any] = {}
+            try:
+                bundles[r.name] = r.fetch_bundle(
+                    timeout_s=max(2.0, self.rpc_timeout_s / 3),
+                    reason=f"fleet_{reason}")
+            except Exception as e:      # noqa: BLE001 — a dead/
+                # partitioned replica can't answer; the router-side
+                # view still ships
+                bundles[r.name] = {"error": f"{type(e).__name__}: {e}"}
+            path = wdog.dump_fleet_bundle(
+                reason, r.name, router_view, bundles,
+                diagnostic_dir=self.diagnostic_dir)
+            if path:
+                self.bundles.append(path)
+                self._event("fleet_bundle", r.name, reason=reason,
+                            path=path)
+        except Exception:               # noqa: BLE001 — diagnostics
+            # must never take the control plane down with them
+            trace.metrics().counter("fleet.bundle_errors").inc()
 
     def readmit(self, replica) -> None:
         r = self._resolve(replica)
@@ -1260,6 +1616,8 @@ class ServingFleet:
         }
 
     def close(self, timeout_s: float = 30.0) -> None:
+        from ..fluid import metrics_export
+        metrics_export.unregister_fleet_provider(self.aggregator)
         self._stop.set()
         self._monitor_t.join(timeout=10)
         self.router.close()

@@ -1,0 +1,604 @@
+"""Fleet-wide observability: cross-process trace propagation, metrics
+aggregation, stitched timelines, and coordinated incident bundles.
+
+Satellite contract (ISSUE 16): with tracing OFF the RPC wire carries
+zero propagation bytes (header/reply key sets unchanged); a retried
+RPC reuses ONE trace id (the dedup window never sees two ids for one
+logical call); a transport-failed dispatch redispatches and the SECOND
+replica's spans join the router's trace id; plus unit coverage for the
+stitch clock math, the fleet metrics rollups, the /stats ps block, and
+the fleet incident bundle end to end through diagnose.py --fleet.
+"""
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu.fluid as fluid                          # noqa: E402
+from paddle_tpu.distributed import faultline              # noqa: E402
+from paddle_tpu.distributed.ps.rpc import (               # noqa: E402
+    PsClient, PsServer)
+from paddle_tpu.fluid import flight_recorder, metrics_export, trace, \
+    watchdog                                              # noqa: E402
+from paddle_tpu.fluid.core import Scope, scope_guard      # noqa: E402
+from paddle_tpu import serving                            # noqa: E402
+from paddle_tpu.serving import fleet as F                 # noqa: E402
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+m = trace.metrics()
+
+
+@pytest.fixture(autouse=True)
+def clean_plane():
+    trace.reset_all()
+    flight_recorder.reset()
+    yield
+    faultline.uninstall()
+    trace.disable()
+    trace.reset_all()
+    flight_recorder.reset()
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _recording_server():
+    """A PsServer whose dispatch records every request header."""
+    srv = PsServer(port=0).start()
+    headers = []
+    orig = srv._dispatch
+
+    def recorder(header, arrays):
+        headers.append(dict(header))
+        return orig(header, arrays)
+
+    srv._dispatch = recorder
+    return srv, headers
+
+
+_TRACE_HDR_KEYS = {"trace_id", "parent_span", "send_ts"}
+
+
+# ---------------------------------------------------------------------------
+# propagation: the wire contract
+# ---------------------------------------------------------------------------
+
+class TestWireContract:
+    def test_tracing_off_adds_zero_header_keys(self):
+        """With tracing off the propagation layer must be a no-op on
+        the wire: no trace keys in any request header."""
+        assert not trace.enabled()
+        assert trace.propagation_fields() == {}
+        srv, headers = _recording_server()
+        c = PsClient([srv.endpoint], timeout=10)
+        try:
+            c.create_dense_table("w", [2, 2])
+            c.set_dense("w", np.ones((2, 2), np.float32))
+            c.pull_dense("w")
+        finally:
+            c.close()
+            srv.stop()
+        assert headers
+        for h in headers:
+            assert not (_TRACE_HDR_KEYS & set(h)), h
+
+    def test_tracing_off_reply_has_no_server_stamps(self):
+        """The reply side of the same contract: no srv_recv_ts /
+        srv_send_ts unless the request carried a trace id."""
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            main_p, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_p, startup):
+                x = fluid.data("x", [-1, 4])
+                logits = fluid.layers.fc(x, 3)
+            exe.run(startup)
+            frozen = serving.freeze_program(main_p, ["x"], [logits])
+            eng = serving.ServingEngine(frozen, executor=exe,
+                                        max_batch=8, max_wait_us=500)
+            srv = F.ReplicaServer(eng, info={}).start()
+            handle = F.ReplicaHandle("r", rpc_port=srv.port,
+                                     rpc_timeout_s=10.0)
+            try:
+                reply, _ = handle.call({"op": "hello"})
+                assert "srv_recv_ts" not in reply
+                assert "srv_send_ts" not in reply
+                info = {}
+                handle.infer({"x": np.ones((1, 4), "float32")},
+                             info=info)
+                # untraced request: no replica timing leaks back (the
+                # trace_id key predates propagation — the replica's own
+                # fresh id — and stays for wire compatibility)
+                assert "queue_us" not in info
+                assert "device_us" not in info
+
+                trace.enable()
+                with trace.trace_context("req-wire-1"):
+                    handle.infer({"x": np.ones((1, 4), "float32")},
+                                 info=info)
+                assert info["trace_id"] == "req-wire-1"
+                assert info["queue_us"] >= 0
+                assert info["device_us"] >= 0
+            finally:
+                srv.stop()
+                eng.close()
+
+    def test_replica_spans_inherit_router_trace_id(self):
+        """Cross-process propagation (here over a real RPC socket into
+        the same-process ReplicaServer): the serving spans and flight
+        records on the serving side carry the CALLER's trace id."""
+        trace.enable()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            main_p, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_p, startup):
+                x = fluid.data("x", [-1, 4])
+                logits = fluid.layers.fc(x, 3)
+            exe.run(startup)
+            frozen = serving.freeze_program(main_p, ["x"], [logits])
+            eng = serving.ServingEngine(frozen, executor=exe,
+                                        max_batch=8, max_wait_us=500)
+            srv = F.ReplicaServer(eng, info={}).start()
+            handle = F.ReplicaHandle("r", rpc_port=srv.port,
+                                     rpc_timeout_s=10.0)
+            try:
+                with trace.trace_context("req-prop-7"):
+                    handle.infer({"x": np.ones((2, 4), "float32")})
+            finally:
+                srv.stop()
+                eng.close()
+        evs = trace.get_events()
+        served = [e for e in evs if e.get("name") == "serving::request"
+                  and (e.get("args") or {}).get("trace_id")
+                  == "req-prop-7"]
+        assert served, [e.get("name") for e in evs]
+        rpc_srv = [e for e in evs if e.get("name") == "rpc::server"
+                   and (e.get("args") or {}).get("trace_id")
+                   == "req-prop-7"]
+        assert rpc_srv
+        rpc_cli = [e for e in evs if e.get("name") == "rpc::client"
+                   and (e.get("args") or {}).get("trace_id")
+                   == "req-prop-7"]
+        assert rpc_cli
+        a = rpc_cli[0]["args"]
+        # the NTP quad for the stitcher
+        assert a["send_ts"] <= a["recv_ts"]
+        assert a["srv_recv_ts"] <= a["srv_send_ts"]
+        recs = [r for r in flight_recorder.recorder().snapshot()
+                if r.get("kind") == "request"
+                and r.get("trace_id") == "req-prop-7"]
+        assert recs
+
+
+class TestRetryStability:
+    def test_retried_rpc_reuses_one_trace_id(self, monkeypatch):
+        """A dropped reply forces a client retry; every attempt on the
+        wire must carry the SAME (req_id, trace_id) pair — propagation
+        fields are stamped once per logical call, not per attempt, so
+        the dedup window never sees two ids for one call."""
+        trace.enable()
+        from paddle_tpu.distributed.ps import rpc as R
+        sent = []
+        orig = R.send_msg
+
+        def recording_send(sock, header, arrays=()):
+            # requests only (the in-process server's replies also pass
+            # through send_msg)
+            if header.get("op") == "push_sparse":
+                sent.append(dict(header))
+            return orig(sock, header, arrays)
+
+        srv = PsServer(port=0).start()
+        c = PsClient([srv.endpoint], timeout=6, backoff_ms=5)
+        c.create_sparse_table("e", 4, lr=0.5, init_kind="zeros")
+        ids = np.arange(4, dtype=np.int64)
+        dedup0 = m.counter("rpc.dedup_hits").value
+        monkeypatch.setattr(R, "send_msg", recording_send)
+        faultline.install({"seed": 3, "faults": [
+            {"kind": "drop", "prob": 1.0, "max_injections": 1,
+             "endpoint": f"local:*:{srv.port}"}]})      # server replies
+        try:
+            c.push_sparse("e", ids, np.ones((4, 4), np.float32))
+        finally:
+            faultline.uninstall()
+            monkeypatch.setattr(R, "send_msg", orig)
+            c.close()
+            srv.stop()
+        assert len(sent) >= 2, "reply drop should force a retry"
+        req_ids = {h["req_id"] for h in sent}
+        trace_ids = {h.get("trace_id") for h in sent}
+        assert len(req_ids) == 1
+        assert len(trace_ids) == 1 and None not in trace_ids
+        # the duplicate landed in the dedup window (one logical call)
+        assert m.counter("rpc.dedup_hits").value > dedup0
+
+    def test_redispatch_joins_second_replicas_spans(self):
+        """The corrupt-frame/transport-failure path: the first replica
+        fails the dispatch, the router redispatches under the SAME
+        fleet trace id, and the replica that actually serves emits its
+        serving spans under that id — the stitched timeline joins to
+        the SECOND replica."""
+        trace.enable()
+
+        def broken(feed):
+            raise F.ReplicaTransportError("r0 frame corrupt")
+
+        r0 = F.ReplicaHandle("r0", infer_fn=broken,
+                             health_fn=lambda: {"status": "ok",
+                                                "queue_depth": 0})
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            main_p, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main_p, startup):
+                x = fluid.data("x", [-1, 4])
+                logits = fluid.layers.fc(x, 3)
+            exe.run(startup)
+            frozen = serving.freeze_program(main_p, ["x"], [logits])
+            eng = serving.ServingEngine(frozen, executor=exe,
+                                        max_batch=8, max_wait_us=500)
+            r1 = F.ReplicaHandle("r1", engine=eng)
+            fl = F.ServingFleet(replicas=[r0, r1], policy="round_robin",
+                                scrape_interval_s=0.05,
+                                missed_scrape_limit=100,
+                                incident_bundles=False)
+            try:
+                futs = [fl.submit({"x": np.ones((1, 4), "float32")})
+                        for _ in range(4)]
+                for f in futs:
+                    f.result(30)
+            finally:
+                fl.close()
+                eng.close()
+        redispatched = [f for f in futs if f.attempts > 1]
+        assert redispatched, "round_robin must have hit broken r0"
+        for f in futs:
+            assert f.replica == "r1"
+            assert f.trace_id and f.trace_id.startswith("req-")
+        evs = trace.get_events()
+        for f in redispatched:
+            served = [e for e in evs
+                      if e.get("name") == "serving::request"
+                      and (e.get("args") or {}).get("trace_id")
+                      == f.trace_id]
+            assert served, f.trace_id
+            fleet_spans = [e for e in evs
+                           if e.get("name") == "fleet::request"
+                           and (e.get("args") or {}).get("trace_id")
+                           == f.trace_id]
+            assert fleet_spans
+            assert fleet_spans[0]["args"]["replica"] == "r1"
+            assert fleet_spans[0]["args"]["attempts"] == f.attempts
+
+
+# ---------------------------------------------------------------------------
+# stitched timelines: the clock math
+# ---------------------------------------------------------------------------
+
+def _write_trace(tmp_path, name, events, epoch=None):
+    doc = {"traceEvents": events}
+    if epoch is not None:
+        doc["metadata"] = {"epoch_unix_ts": epoch, "pid": 1}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+class TestStitch:
+    def test_rpc_pair_cancels_clock_skew(self, tmp_path):
+        """A replica whose clock runs 5s AHEAD must land at the right
+        spot on the router's axis: the NTP pair estimate absorbs the
+        skew the epoch anchors alone would get wrong by 5s."""
+        tl = _load_tool("timeline")
+        theta = 5.0                       # replica wall = router wall + 5
+        router = _write_trace(tmp_path, "router.json", [
+            {"name": "rpc::client", "ph": "X", "ts": 1000.0,
+             "dur": 4000.0, "pid": 1, "tid": 2,
+             "args": {"op": "infer", "trace_id": "t1", "attempt": 1,
+                      "send_ts": 100.0, "recv_ts": 100.004,
+                      "srv_recv_ts": 100.001 + theta,
+                      "srv_send_ts": 100.003 + theta}},
+        ], epoch=99.999)
+        replica = _write_trace(tmp_path, "trace-r0.json", [
+            {"name": "rpc::server", "ph": "X", "ts": 2000.0,
+             "dur": 1800.0, "pid": 1, "tid": 3,
+             "args": {"op": "infer", "trace_id": "t1"}},
+            {"name": "serving::request", "ph": "X", "ts": 2100.0,
+             "dur": 1500.0, "pid": 1, "tid": 4,
+             "args": {"trace_id": "t1", "rows": 2, "batch_id": "b1"}},
+        ], epoch=104.999 + theta)
+        out = str(tmp_path / "fleet.json")
+        assert tl.stitch([router, replica], out) == 0
+        doc = json.loads(open(out).read())
+        rep = doc["metadata"]["stitch"][replica]
+        assert rep["method"] == "rpc" and rep["samples"] == 1
+        # server recv is 1ms after client send (one-way delay), so the
+        # server span must start at 1000us + 1000us on the router axis
+        assert abs(rep["shift_us"] - (-1000.0 + 1000.0)) < 1.0
+        srv = [e for e in doc["traceEvents"]
+               if e.get("name") == "rpc::server" and e.get("ph") == "X"]
+        assert abs(srv[0]["ts"] - 2000.0) < 1.0
+        # cross-process flow arrow joins client -> serving::request
+        flows = [e for e in doc["traceEvents"]
+                 if e.get("name") == "router->replica"]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        # each file got its own named lane
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"
+                 and e.get("name") == "process_name"}
+        assert {"router", "trace-r0"} <= lanes
+
+    def test_epoch_fallback_and_negative_clamp(self, tmp_path):
+        """Without rpc pairs the stitcher falls back to the exporters'
+        wall anchors; a file that started EARLIER than the reference
+        shifts negative and the whole timeline is rebased to ts>=0."""
+        tl = _load_tool("timeline")
+        a = _write_trace(tmp_path, "a.json", [
+            {"name": "x", "ph": "X", "ts": 10.0, "dur": 5.0,
+             "pid": 1, "tid": 1},
+        ], epoch=50.0)
+        b = _write_trace(tmp_path, "b.json", [
+            {"name": "y", "ph": "X", "ts": 10.0, "dur": 5.0,
+             "pid": 1, "tid": 1},
+        ], epoch=48.0)                    # b's ts=0 is 2s before a's
+        out = str(tmp_path / "out.json")
+        assert tl.stitch([a, b], out, flows=False) == 0
+        doc = json.loads(open(out).read())
+        rep = doc["metadata"]["stitch"]
+        assert rep[b]["method"] == "epoch"
+        assert abs(rep[b]["shift_us"] + 2e6) < 1.0
+        evs = {e["name"]: e for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+        # y at 10us on b's axis = -2s+10us on a's axis; after the >=0
+        # rebase y sits at 0-ish and x exactly 2s later
+        assert evs["y"]["ts"] >= 0.0
+        assert abs((evs["x"]["ts"] - evs["y"]["ts"]) - 2e6) < 1.0
+
+    def test_retry_attempts_excluded_from_offset_samples(self, tmp_path):
+        """Dedup-replayed replies (attempt > 1) carry the ORIGINAL
+        attempt's server stamps — they must not poison the estimate."""
+        tl = _load_tool("timeline")
+        good = {"op": "p", "trace_id": "t-good", "attempt": 1,
+                "send_ts": 10.0, "recv_ts": 10.002,
+                "srv_recv_ts": 10.001, "srv_send_ts": 10.001}
+        stale = {"op": "p", "trace_id": "t-stale", "attempt": 2,
+                 "send_ts": 10.0, "recv_ts": 10.002,
+                 "srv_recv_ts": 900.0, "srv_send_ts": 900.0}
+        router = _write_trace(tmp_path, "router.json", [
+            {"name": "rpc::client", "ph": "X", "ts": 100.0, "dur": 10.0,
+             "pid": 1, "tid": 1, "args": good},
+            {"name": "rpc::client", "ph": "X", "ts": 100.0, "dur": 10.0,
+             "pid": 1, "tid": 1, "args": stale},
+        ])
+        replica = _write_trace(tmp_path, "r0.json", [
+            {"name": "rpc::server", "ph": "X", "ts": 1100.0, "dur": 5.0,
+             "pid": 1, "tid": 1, "args": {"trace_id": "t-good"}},
+            {"name": "rpc::server", "ph": "X", "ts": 1100.0, "dur": 5.0,
+             "pid": 1, "tid": 1, "args": {"trace_id": "t-stale"}},
+        ])
+        docs = [{"path": p, "events": tl.load_trace_doc(p)[0],
+                 "meta": tl.load_trace_doc(p)[1]}
+                for p in (router, replica)]
+        shifts, report = tl.estimate_shifts(docs)
+        assert report[replica]["samples"] == 1
+        # from the good pair alone: delay = 1ms/2 ... exactly:
+        # ((10.001-10.0)-(10.001-10.002))/2 = 1ms -> 100+1000-1100 = 0
+        assert abs(shifts[replica]) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics aggregation
+# ---------------------------------------------------------------------------
+
+class TestAggregation:
+    def test_parse_prometheus_text_roundtrip(self):
+        text = (
+            "# TYPE serving_requests counter\n"
+            'serving_requests 41\n'
+            "# TYPE serving_queue_depth gauge\n"
+            "serving_queue_depth 3\n"
+            "# TYPE serving_latency_seconds summary\n"
+            'serving_latency_seconds{quantile="0.99"} 0.02\n'
+            "serving_latency_seconds_sum 1.5\n"
+            "serving_latency_seconds_count 41\n")
+        fams = {f["name"]: f
+                for f in metrics_export.parse_prometheus_text(text)}
+        assert fams["serving_requests"]["type"] == "counter"
+        assert fams["serving_requests"]["samples"] == [
+            ("serving_requests", {}, 41.0)]
+        summ = fams["serving_latency_seconds"]
+        assert ("serving_latency_seconds", {"quantile": "0.99"}, 0.02) \
+            in summ["samples"]
+        assert ("serving_latency_seconds_sum", {}, 1.5) \
+            in summ["samples"]
+
+    def test_rollup_lines_sum_min_max_and_quantiles(self):
+        roll = F.FleetMetricsAggregator._rollup_lines
+        lines = roll("serving_requests", "counter", [
+            ("serving_requests", {}, 40.0, "r0"),
+            ("serving_requests", {}, 2.0, "r1")])
+        assert "fleet:serving_requests 42" in lines
+        lines = roll("queue_depth", "gauge", [
+            ("queue_depth", {}, 1.0, "r0"),
+            ("queue_depth", {}, 7.0, "r1")])
+        assert 'fleet:queue_depth{agg="min"} 1' in lines
+        assert 'fleet:queue_depth{agg="max"} 7' in lines
+        lines = roll("lat", "summary", [
+            ("lat", {"quantile": "0.99"}, 0.010, "r0"),
+            ("lat", {"quantile": "0.99"}, 0.030, "r1"),
+            ("lat_sum", {}, 1.0, "r0"), ("lat_sum", {}, 2.0, "r1"),
+            ("lat_count", {}, 10.0, "r0"),
+            ("lat_count", {}, 20.0, "r1")])
+        assert 'fleet:lat{quantile="0.99"} 0.03' in lines
+        assert "fleet:lat_sum 3" in lines
+        assert "fleet:lat_count 30" in lines
+
+    def test_fleet_stats_rollup_and_http_endpoint(self):
+        a = F.ReplicaHandle(
+            "a", infer_fn=lambda feed: feed,
+            health_fn=lambda: {"status": "ok", "queue_depth": 1,
+                               "requests": 10, "batches": 4,
+                               "rejected": 1, "timeouts": 0,
+                               "p99_ms": 5.0})
+        b = F.ReplicaHandle(
+            "b", infer_fn=lambda feed: feed,
+            health_fn=lambda: {"status": "ok", "queue_depth": 2,
+                               "requests": 30, "batches": 6,
+                               "rejected": 0, "timeouts": 2,
+                               "p99_ms": 9.0})
+        fl = F.ServingFleet(replicas=[a, b], scrape_interval_s=0.03,
+                            missed_scrape_limit=100,
+                            incident_bundles=False)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                    not a.last_stats or not b.last_stats):
+                time.sleep(0.02)
+            fs = fl.aggregator.fleet_stats()
+            assert fs["rollup"]["requests"] == 40
+            assert fs["rollup"]["batches"] == 10
+            assert fs["rollup"]["timeouts"] == 2
+            assert fs["rollup"]["p99_ms_max"] == 9.0
+            assert fs["replicas"]["a"]["state"] == "up"
+            # scrape history accumulates per poll
+            hist = fl.aggregator.scrape_history("a")["a"]
+            assert hist and hist[-1]["stats"]["requests"] == 10
+            # the parent's export endpoint serves the fleet views
+            srv = metrics_export.start_http(port=0)
+            try:
+                doc = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/fleet/stats",
+                    timeout=10).read())
+                assert doc["rollup"]["requests"] == 40
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/fleet/metrics",
+                    timeout=10).read().decode()
+                # in-process replicas are noted, not double-scraped
+                assert "replica a: in-process" in text
+            finally:
+                metrics_export.stop_http()
+        finally:
+            fl.close()
+        # after close the provider is unregistered: 404, not stale data
+        srv = metrics_export.start_http(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/fleet/stats",
+                    timeout=10)
+        finally:
+            metrics_export.stop_http()
+
+    def test_stats_payload_carries_ps_block(self):
+        """Satellite bugfix: ps.dead_workers / ps.worker_deaths were
+        invisible in the compact /stats payload."""
+        m.gauge("ps.dead_workers").set(2)
+        m.counter("ps.worker_deaths").inc(3)
+        payload = metrics_export.stats_payload()
+        assert payload["ps"]["dead_workers"] == 2
+        assert payload["ps"]["worker_deaths"] >= 3
+        m.gauge("ps.dead_workers").set(0)
+
+
+# ---------------------------------------------------------------------------
+# coordinated incident bundles
+# ---------------------------------------------------------------------------
+
+class TestFleetBundles:
+    def test_eject_freezes_one_bundle_diagnose_renders(self, tmp_path):
+        """An ejection freezes exactly ONE fleet bundle — router view +
+        the replica's own doc — and diagnose.py --fleet renders the
+        cross-process story."""
+        def flaky(feed):
+            raise F.ReplicaTransportError("wedged")
+
+        r0 = F.ReplicaHandle("r0", infer_fn=flaky,
+                             health_fn=lambda: {"status": "stalled",
+                                                "queue_depth": 9})
+        r1 = F.ReplicaHandle("r1", infer_fn=lambda feed: feed,
+                             health_fn=lambda: {"status": "ok",
+                                                "queue_depth": 0})
+        fl = F.ServingFleet(replicas=[r0, r1], scrape_interval_s=0.03,
+                            missed_scrape_limit=2,
+                            incident_bundles=True,
+                            diagnostic_dir=str(tmp_path))
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and not fl.bundles:
+                time.sleep(0.05)
+            assert r0.state != "up"
+            assert len(fl.bundles) == 1, fl.bundles
+            # give the freeze thread no chance to double-fire
+            time.sleep(0.3)
+            assert len(fl.bundles) == 1
+        finally:
+            fl.close()
+        found = watchdog.list_fleet_bundles(str(tmp_path))
+        assert len(found) == 1
+        doc = json.loads(open(found[0]).read())
+        assert doc["schema"] == watchdog.FLEET_BUNDLE_SCHEMA
+        assert doc["replica"] == "r0"
+        assert doc["router"]["breakers"]["r0"]["state"] in (
+            "closed", "open", "half_open")
+        assert any(e["kind"] == "eject"
+                   for e in doc["router"]["events"])
+        # r0 is in-process: its own doc is a full diagnostic bundle
+        sub = doc["replicas"]["r0"]
+        assert sub.get("schema") == "paddle_tpu.diagnostic_bundle.v1"
+
+        dg = _load_tool("diagnose")
+        loaded = dg.load_bundle(found[0])
+        assert dg.is_fleet_bundle(loaded)
+        text = dg.fleet_report(loaded)
+        assert "FLEET post-mortem" in text
+        assert "replica r0" in text
+        assert "breaker=" in text
+        # the single-bundle CLI path keeps working and --fleet guards
+        assert dg.main([found[0]]) == 0
+        assert dg.main(["--fleet", found[0]]) == 0
+        assert dg.main(["--list", str(tmp_path)]) == 0
+
+    def test_fleet_bundle_never_raises_into_eject(self, tmp_path,
+                                                  monkeypatch):
+        """A broken bundle fetch must not break ejection itself."""
+        r0 = F.ReplicaHandle("r0", infer_fn=lambda feed: feed,
+                             health_fn=lambda: {"status": "ok",
+                                                "queue_depth": 0})
+        # slow monitor: the healthy replica must not be readmitted
+        # between the manual eject and the assertions
+        fl = F.ServingFleet(replicas=[r0], scrape_interval_s=30.0,
+                            missed_scrape_limit=100,
+                            incident_bundles=True,
+                            diagnostic_dir=str(tmp_path))
+        try:
+            monkeypatch.setattr(
+                F.ReplicaHandle, "fetch_bundle",
+                lambda self, **kw: (_ for _ in ()).throw(
+                    OSError("unreachable")))
+            fl.eject(r0, "test_reason")
+            deadline = time.time() + 10
+            while time.time() < deadline and not fl.bundles:
+                time.sleep(0.05)
+            assert r0.state == "ejected"
+            assert len(fl.bundles) == 1
+        finally:
+            fl.close()
+        doc = json.loads(open(fl.bundles[0]).read())
+        assert "error" in doc["replicas"]["r0"]

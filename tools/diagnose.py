@@ -14,6 +14,14 @@ Usage:
     python tools/diagnose.py bundle.json --trace out.json   # + chrome trace
     python tools/diagnose.py bundle.json --request req-1a2b-3c  # one request
     python tools/diagnose.py --list [/diag/dir]         # newest bundles
+    python tools/diagnose.py --fleet fleet-bundle.json  # cross-process story
+
+A FLEET bundle (fleet-bundle-*.json, frozen by ServingFleet on
+ejection) embeds the router's view of the incident window — routing
+decisions with replica attribution, breaker states, scrape history —
+plus the ejected replica's own watchdog bundle; ``--fleet`` (or schema
+auto-detection) renders which requests were in flight, where each
+one's time went, and on which replica.
 
 The Chrome trace carries the bundle's trace tail, a per-request lane +
 request↔batch flow arrows (timeline.request_flows; --no-flows skips),
@@ -41,13 +49,21 @@ def _timeline():
                          os.path.join(_HERE, "timeline.py"))
 
 
+BUNDLE_SCHEMA = "paddle_tpu.diagnostic_bundle.v1"
+FLEET_SCHEMA = "paddle_tpu.fleet_bundle.v1"
+
+
 def load_bundle(path):
     with open(path) as f:
         doc = json.load(f)
-    if doc.get("schema") != "paddle_tpu.diagnostic_bundle.v1":
+    if doc.get("schema") not in (BUNDLE_SCHEMA, FLEET_SCHEMA):
         raise ValueError(f"{path}: not a paddle_tpu diagnostic bundle "
                          f"(schema={doc.get('schema')!r})")
     return doc
+
+
+def is_fleet_bundle(doc):
+    return doc.get("schema") == FLEET_SCHEMA
 
 
 def _fmt_bytes(n):
@@ -249,6 +265,130 @@ def report(doc, request=None):
 
 
 # ---------------------------------------------------------------------------
+# fleet bundles — the cross-process story
+# ---------------------------------------------------------------------------
+
+def _fleet_header(doc):
+    return [
+        "=" * 72,
+        f"paddle_tpu FLEET post-mortem — {doc['reason'].upper()} "
+        f"(replica {doc.get('replica')})",
+        "=" * 72,
+        f"time      : {doc.get('time')}  (router pid {doc.get('pid')})",
+    ]
+
+
+def _fleet_router_section(doc, last_events=8):
+    rv = doc.get("router") or {}
+    st = rv.get("stats") or {}
+    lat = st.get("latency") or {}
+    lines = [
+        f"router    : {st.get('dispatches', 0)} dispatches "
+        f"({st.get('redispatches', 0)} redispatched, "
+        f"{st.get('failures', 0)} failures), "
+        f"{rv.get('in_flight', 0)} in flight at freeze, "
+        f"p99 {(lat.get('p99') or 0) * 1e3:.1f}ms; "
+        f"{st.get('ejections', 0)} ejections / "
+        f"{st.get('readmissions', 0)} readmissions / "
+        f"{st.get('replacements', 0)} replacements"
+    ]
+    for r in st.get("replicas") or []:
+        br = r.get("breaker") or {}
+        lines.append(
+            f"    {str(r.get('name')):<6s} {str(r.get('state')):<9s} "
+            f"breaker={br.get('state')} "
+            f"(fails {br.get('consecutive_failures', 0)}, "
+            f"opens {br.get('opens', 0)}) "
+            f"outstanding={r.get('outstanding')} "
+            f"queue={r.get('queue_depth')}"
+            + (f" reason={r['reason']}" if r.get("reason") else ""))
+    evs = rv.get("events") or []
+    if evs:
+        lines.append(f"    last {min(len(evs), last_events)} of "
+                     f"{len(evs)} fleet events in the "
+                     f"{rv.get('window_s', 0):.0f}s window:")
+        for e in evs[-last_events:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("t_mono", "ts", "kind", "replica")}
+            lines.append(
+                f"      {str(e.get('kind')):<16s} "
+                f"{str(e.get('replica')):<6s} "
+                + (json.dumps(extra, default=str)[:90] if extra else ""))
+    return lines
+
+
+def _fleet_requests_section(doc, top=5):
+    """Which requests were in flight and where each one's time went, on
+    which replica — from the router's parent-side flight records."""
+    reqs = [r for r in (doc.get("router") or {}).get("requests") or []
+            if r.get("kind") == "request"]
+    if not reqs:
+        return []
+    by_replica = {}
+    for r in reqs:
+        key = (r.get("replica") or "?", r.get("outcome") or "?")
+        by_replica[key] = by_replica.get(key, 0) + 1
+    lines = [f"requests  : {len(reqs)} routed requests in the router's "
+             "ring: "
+             + ", ".join(f"{rep}:{out}={n}" for (rep, out), n in
+                         sorted(by_replica.items()))]
+    timed = [r for r in reqs if r.get("latency_us") is not None]
+    for r in sorted(timed, key=lambda r: -r["latency_us"])[:top]:
+        q, d = r.get("queue_us"), r.get("device_us")
+        split = (f"queue {q / 1e3:.1f}ms / device {d / 1e3:.1f}ms"
+                 if q is not None and d is not None
+                 else "no replica split (untraced)")
+        lines.append(
+            f"    {str(r.get('trace_id')):<20s} "
+            f"{r['latency_us'] / 1e3:8.1f}ms on "
+            f"{str(r.get('replica')):<5s} ({split}, "
+            f"rows {r.get('rows')}, {r.get('outcome')})")
+    return lines
+
+
+def _fleet_scrape_section(doc):
+    hist = (doc.get("router") or {}).get("scrape_history") or {}
+    lines = []
+    for name, entries in sorted(hist.items()):
+        if not entries:
+            continue
+        last = entries[-1].get("stats") or {}
+        lines.append(f"    {str(name):<6s} {len(entries)} scrapes in "
+                     "window; last: "
+                     + json.dumps(last, default=str)[:140])
+    return ["scrapes   :"] + lines if lines else []
+
+
+def fleet_report(doc, request=None):
+    """The cross-process incident story: the router's view of the
+    ejection window, then each embedded replica bundle rendered with
+    the single-process report."""
+    lines = _fleet_header(doc)
+    lines.append("")
+    lines += _fleet_router_section(doc)
+    sec = _fleet_requests_section(doc)
+    if sec:
+        lines.append("")
+        lines += sec
+    sec = _fleet_scrape_section(doc)
+    if sec:
+        lines.append("")
+        lines += sec
+    for name, sub in sorted((doc.get("replicas") or {}).items()):
+        lines.append("")
+        if isinstance(sub, dict) and sub.get("schema") == BUNDLE_SCHEMA:
+            lines.append(f"replica {name} — its own watchdog bundle, "
+                         "frozen at ejection:")
+            lines += ["  " + ln for ln in
+                      report(sub, request=request).splitlines()]
+        else:
+            err = (sub or {}).get("error") if isinstance(sub, dict) else sub
+            lines.append(f"replica {name}: bundle unavailable ({err})")
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # chrome-trace rendering
 # ---------------------------------------------------------------------------
 
@@ -309,6 +449,11 @@ def main(argv=None):
                     help="skip request↔batch flow arrows in --trace")
     ap.add_argument("--request", default=None, metavar="TRACE_ID",
                     help="append everything known about one request id")
+    ap.add_argument("--fleet", action="store_true",
+                    help="expect a fleet incident bundle "
+                         "(fleet-bundle-*.json) and render the "
+                         "cross-process story; fleet bundles are also "
+                         "auto-detected by schema")
     a = ap.parse_args(argv)
 
     if a.list is not None:
@@ -316,7 +461,8 @@ def main(argv=None):
         found = sorted(
             os.path.join(root, f) for f in
             (os.listdir(root) if os.path.isdir(root) else [])
-            if f.startswith("bundle-") and f.endswith(".json"))
+            if (f.startswith("bundle-") or f.startswith("fleet-bundle-"))
+            and f.endswith(".json"))
         for p in found:
             print(p)
         if not found:
@@ -329,6 +475,28 @@ def main(argv=None):
               file=sys.stderr)
         return 2
     doc = load_bundle(a.bundle)
+    if a.fleet and not is_fleet_bundle(doc):
+        print(f"diagnose.py: {a.bundle} is a single-process bundle "
+              f"(schema={doc.get('schema')!r}), not a fleet bundle",
+              file=sys.stderr)
+        return 2
+    if is_fleet_bundle(doc):
+        print(fleet_report(doc, request=a.request))
+        if a.trace:
+            # render the ejected replica's embedded trace tail — its
+            # device-side story around the incident
+            sub = (doc.get("replicas") or {}).get(doc.get("replica"))
+            if isinstance(sub, dict) and sub.get("schema") == \
+                    BUNDLE_SCHEMA:
+                n = write_trace(sub, a.trace, flows=not a.no_flows)
+                print(f"\n{n} events (replica {doc.get('replica')}) -> "
+                      f"{a.trace}; open in chrome://tracing or "
+                      f"ui.perfetto.dev")
+            else:
+                print(f"\nno embedded replica bundle to render as a "
+                      f"trace (replica {doc.get('replica')} "
+                      f"unreachable at freeze)", file=sys.stderr)
+        return 0
     print(report(doc, request=a.request))
     if a.trace:
         n = write_trace(doc, a.trace, flows=not a.no_flows)

@@ -116,7 +116,12 @@ def slowest_requests(futures, top=5):
     per-request latency (the flight recorder's wide events, keyed by
     each future's ``trace_id``) — a bad bench round links straight to
     the offending request traces (`tools/diagnose.py --request <id>` or
-    grep the exported timeline)."""
+    grep the exported timeline).  Fleet rounds record these parent-side
+    with the serving replica attached, so each offender also carries
+    ``replica`` and — when the run was traced end-to-end, giving the
+    router the replica's queue/device split over the propagated trace
+    id — ``router_ms``, the router-side share (routing + RPC) of the
+    end-to-end latency."""
     from paddle_tpu.fluid import flight_recorder
 
     ids = {f.trace_id for f in futures if getattr(f, "trace_id", None)}
@@ -125,12 +130,22 @@ def slowest_requests(futures, top=5):
             and r.get("outcome") == "ok"
             and r.get("latency_us") is not None]
     recs.sort(key=lambda r: -r["latency_us"])
-    return [{"trace_id": r["trace_id"],
-             "latency_ms": round(r["latency_us"] / 1e3, 3),
-             "queue_ms": round(r.get("queue_us", 0) / 1e3, 3),
-             "device_ms": round(r.get("device_us", 0) / 1e3, 3),
-             "rows": r.get("rows"), "batch_id": r.get("batch_id")}
-            for r in recs[:top]]
+    out = []
+    for r in recs[:top]:
+        row = {"trace_id": r["trace_id"],
+               "latency_ms": round(r["latency_us"] / 1e3, 3),
+               "queue_ms": round(r.get("queue_us", 0) / 1e3, 3),
+               "device_ms": round(r.get("device_us", 0) / 1e3, 3),
+               "rows": r.get("rows"), "batch_id": r.get("batch_id")}
+        if r.get("replica") is not None:
+            row["replica"] = r["replica"]
+            if r.get("queue_us") is not None \
+                    and r.get("device_us") is not None:
+                row["router_ms"] = round(max(
+                    r["latency_us"] - r["queue_us"] - r["device_us"],
+                    0.0) / 1e3, 3)
+        out.append(row)
+    return out
 
 
 def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
@@ -327,6 +342,7 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
             deadline_ms=deadline_ms)
         done, failed = collect(futures, timeout=180.0)
         wall = time.perf_counter() - t0
+        slowest = slowest_requests(futures)
         if kt is not None:
             kt.join(timeout=10)
         # let the ejection + replacement land in the event log
@@ -413,6 +429,8 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
         "requests_rerouted": rerouted,
         "warm_spinup_s": warm_spinup,
         "replacement_cold_compiles": replacement_cold,
+        # p99 offenders with replica attribution (parent-side records)
+        "slowest_requests": slowest,
         "ejections": fstats["ejections"],
         "replacements": fstats["replacements"],
         "config": {"max_batch": max_batch, "max_wait_us": max_wait_us,

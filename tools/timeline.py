@@ -18,6 +18,8 @@ Two producers feed it:
 
 Usage:
     python tools/timeline.py --trace_path a.json,b.json --timeline_path out.json
+    python tools/timeline.py stitch --trace_path router.json,r0.json,r1.json \
+        --timeline_path fleet.json     # fleet: one clock, flow arrows
     python tools/timeline.py --profile_path /tmp/paddle_tpu_profile
 """
 import argparse
@@ -32,15 +34,23 @@ import sys
 def load_trace_events(path):
     """Read one trace file: either {"traceEvents": [...]} (the plane's
     exporter, chrome's save format) or a bare JSON event list."""
+    return load_trace_doc(path)[0]
+
+
+def load_trace_doc(path):
+    """Read one trace file as ``(events, metadata)`` — metadata is the
+    exporter's sidecar (epoch_unix_ts wall anchor, pid, dropped count),
+    ``{}`` for bare event lists."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict):
         evs = doc.get("traceEvents")
         if not isinstance(evs, list):
             raise ValueError(f"{path}: no traceEvents list")
-        return evs
+        meta = doc.get("metadata")
+        return evs, (meta if isinstance(meta, dict) else {})
     if isinstance(doc, list):
-        return doc
+        return doc, {}
     raise ValueError(f"{path}: not a chrome trace (dict or list expected)")
 
 
@@ -207,6 +217,185 @@ def request_flows(events):
     return out
 
 
+def _rpc_client_spans(events):
+    """First-attempt ``rpc::client`` spans carrying the full NTP
+    timestamp quad (send/recv client-side, srv_recv/srv_send
+    server-side), keyed by propagated trace id.  Replies replayed from
+    the dedup window (attempt > 1) carry the ORIGINAL attempt's server
+    stamps against the retry's client stamps — useless as clock
+    samples, so they are skipped."""
+    out = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") != "rpc::client":
+            continue
+        a = e.get("args") or {}
+        if int(a.get("attempt", 1) or 1) > 1:
+            continue
+        if not a.get("trace_id"):
+            continue
+        if any(a.get(k) is None for k in
+               ("send_ts", "recv_ts", "srv_recv_ts", "srv_send_ts")):
+            continue
+        out.setdefault(a["trace_id"], e)
+    return out
+
+
+def estimate_shifts(docs):
+    """Per-file shift (µs) mapping each file's timeline onto the FIRST
+    file's clock.  Preference order per file:
+
+    1. RPC pairs — every ``rpc::server`` span in this file whose trace
+       id matches an ``rpc::client`` span in the reference file yields
+       one NTP-style sample: the server span starts at the instant the
+       request arrived, which on the caller's clock is
+       ``send + one_way_delay`` where ``one_way_delay =
+       ((srv_recv - send) - (srv_send - recv)) / 2`` (the classic
+       offset θ cancels out of this form).  Shift = mean over samples.
+    2. Epoch anchor — both files' exporters recorded ``epoch_unix_ts``
+       (the wall-clock instant of their ts=0); shift = anchor delta.
+       Accurate to cross-process wall-clock skew only.
+    3. None — file stays in its own coordinates (pre-stitch behavior).
+
+    Returns ``(shifts, report)``: ``{path: shift_us}`` and
+    ``{path: {"shift_us", "method", "samples"}}``."""
+    ref = docs[0]
+    ref_clients = _rpc_client_spans(ref["events"])
+    ref_epoch = ref["meta"].get("epoch_unix_ts")
+    shifts, report = {ref["path"]: 0.0}, {
+        ref["path"]: {"shift_us": 0.0, "method": "reference", "samples": 0}}
+    for d in docs[1:]:
+        samples = []
+        for e in d["events"]:
+            if e.get("ph") != "X" or e.get("name") != "rpc::server":
+                continue
+            c = ref_clients.get((e.get("args") or {}).get("trace_id"))
+            if c is None:
+                continue
+            ca = c["args"]
+            send, recv = float(ca["send_ts"]), float(ca["recv_ts"])
+            srv_recv, srv_send = (float(ca["srv_recv_ts"]),
+                                  float(ca["srv_send_ts"]))
+            delay_s = ((srv_recv - send) - (srv_send - recv)) / 2.0
+            samples.append(float(c["ts"]) + delay_s * 1e6 - float(e["ts"]))
+        epoch = d["meta"].get("epoch_unix_ts")
+        if samples:
+            shift, method = sum(samples) / len(samples), "rpc"
+        elif epoch is not None and ref_epoch is not None:
+            shift, method = (float(epoch) - float(ref_epoch)) * 1e6, "epoch"
+        else:
+            shift, method = 0.0, "none"
+        shifts[d["path"]] = shift
+        report[d["path"]] = {"shift_us": round(shift, 1), "method": method,
+                             "samples": len(samples)}
+    return shifts, report
+
+
+def cross_process_flows(events):
+    """Flow arrows router → replica: for each propagated trace id, an
+    arrow from the router-side span that dispatched it
+    (``fleet::request``, else ``rpc::client``) into every
+    ``serving::request`` span carrying the same trace id on ANOTHER
+    pid.  After stitching, this is the cross-process causal join the
+    propagation header paid for."""
+    sources, targets = {}, []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id")
+        if not tid:
+            continue
+        if e.get("name") == "fleet::request":
+            sources[tid] = e
+        elif e.get("name") == "rpc::client":
+            sources.setdefault(tid, e)
+        elif e.get("name") == "serving::request":
+            targets.append((tid, e))
+    out = []
+    for tid, t in targets:
+        s = sources.get(tid)
+        if s is None or s.get("pid") == t.get("pid"):
+            continue
+        fid = f"xflow-{tid}-{t.get('pid')}"
+        out.append({"name": "router->replica", "cat": "flow", "ph": "s",
+                    "id": fid, "ts": s["ts"],
+                    "pid": s["pid"], "tid": s["tid"]})
+        out.append({"name": "router->replica", "cat": "flow", "ph": "f",
+                    "bp": "e", "id": fid,
+                    "ts": t["ts"] + float(t.get("dur", 0.0)) / 2,
+                    "pid": t["pid"], "tid": t["tid"]})
+    return out
+
+
+def stitch(trace_paths, out, flows=True, goodput=False):
+    """Merge per-process trace files (router + replicas) into ONE
+    timeline on a common clock: each file's events are shifted onto the
+    first file's time axis (see :func:`estimate_shifts` — RPC
+    timestamp pairs when the run was traced end-to-end, exporter wall
+    anchors otherwise), pids are offset on collision, every process
+    gets a lane named after its file, and router→replica flow arrows
+    join cross-process spans by propagated trace id."""
+    docs = []
+    for path in trace_paths:
+        evs, meta = load_trace_doc(path)
+        docs.append({"path": path, "events": evs, "meta": meta})
+    shifts, report = estimate_shifts(docs)
+    merged, used_pids = [], set()
+    for d in docs:
+        shift = shifts[d["path"]]
+        pids = {e.get("pid", 0) for e in d["events"]}
+        offset = 0
+        if pids & used_pids:
+            offset = max(used_pids | {0}) + 1 - min(pids | {0})
+        label = os.path.splitext(os.path.basename(d["path"]))[0]
+        for pid in pids:
+            merged.append({"name": "process_name", "ph": "M",
+                           "pid": pid + offset, "tid": 0,
+                           "args": {"name": label}})
+        for e in d["events"]:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                continue                  # replaced by the file label
+            e = dict(e)
+            e["pid"] = e.get("pid", 0) + offset
+            if e.get("ph") != "M" and isinstance(e.get("ts"), (int, float)):
+                e["ts"] = e["ts"] + shift
+            merged.append(e)
+        used_pids |= {p + offset for p in pids}
+    # a negative shift can pull early events below zero; rebase the whole
+    # stitched timeline so validate_timeline's ts >= 0 invariant holds
+    floor = min((e["ts"] for e in merged if e.get("ph") != "M"
+                 and isinstance(e.get("ts"), (int, float))), default=0.0)
+    if floor < 0:
+        for e in merged:
+            if e.get("ph") != "M" and isinstance(e.get("ts"), (int, float)):
+                e["ts"] -= floor
+    n_x = n_flows = 0
+    if flows:
+        extra = cross_process_flows(merged)
+        n_x = sum(1 for e in extra if e.get("ph") == "s")
+        merged = merged + extra
+        extra = request_flows(merged)
+        n_flows = sum(1 for e in extra if e.get("ph") == "s")
+        merged = merged + extra
+    if goodput:
+        merged = merged + goodput_track(merged)
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    validate_timeline(merged)
+    with open(out, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms",
+                   "metadata": {"stitch": report}}, f)
+    for path in trace_paths:
+        r = report[path]
+        print(f"  {path}: shift {r['shift_us']:+.1f}us "
+              f"({r['method']}, {r['samples']} rpc pair(s))")
+    note = f" (+{n_x} cross-process flows)" if n_x else ""
+    if n_flows:
+        note += f" (+{n_flows} request flows)"
+    print(f"stitched {len(merged)} events from {len(trace_paths)} "
+          f"process(es){note} -> {out}; open in chrome://tracing or "
+          f"ui.perfetto.dev")
+    return 0
+
+
 def convert(trace_paths, out, goodput=True, flows=True):
     """Merge + validate + write the final chrome trace, with the goodput
     attribution rendered as a dedicated track when the inputs carry
@@ -251,6 +440,10 @@ def extract(logdir, out):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("command", nargs="?", choices=["stitch"],
+                    help="'stitch': merge per-process traces (router + "
+                         "replicas) onto one clock with cross-process "
+                         "flow arrows, instead of the plain merge")
     ap.add_argument("--trace_path", default=None,
                     help="comma-separated observability-plane trace JSONs "
                          "(FLAGS_trace_path outputs) to merge")
@@ -266,6 +459,13 @@ def main(argv=None):
                     help="skip per-request lanes + request↔batch flow "
                          "arrows for serving traces")
     a = ap.parse_args(argv)
+    if a.command == "stitch":
+        if not a.trace_path:
+            ap.error("stitch requires --trace_path "
+                     "router.json,replica0.json,...")
+        paths = [p for p in a.trace_path.split(",") if p]
+        return stitch(paths, a.timeline_path, flows=not a.no_flows,
+                      goodput=not a.no_goodput)
     if a.trace_path:
         paths = [p for p in a.trace_path.split(",") if p]
         if a.validate:
