@@ -33,6 +33,10 @@ BLACK_OPS = {
 # "nobody looked".
 FP32_FAMILY_OPS = {
     "attention_lstm", "fused_embedding_fc_lstm", "multi_gru",
+    # paged decode attention: the op's contract is bit-identity with the
+    # unfused gather+softmax chain (serving exactness gate) — bf16 would
+    # break it, and decode is latency/HBM-bound, not MXU-bound
+    "paged_attention",
     "scaled_int8fc", "fused_fc_elementwise_layernorm", "deformable_conv",
     "deformable_conv_v1", "conv_shift", "rank_attention",
     "fusion_conv_inception", "fusion_repeated_fc_relu",

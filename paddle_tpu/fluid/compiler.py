@@ -57,6 +57,7 @@ class BuildStrategy:
         # update.  kernel_tier=True is the umbrella for all three.
         self.kernel_tier = False
         self.fuse_attention = False            # -> fuse_attention
+        self.fuse_paged_attention = False      # -> fuse_paged_attention
         self.fuse_sparse_embedding = False     # -> fuse_sparse_embedding
         self.fuse_optimizer = False            # -> fuse_optimizer
         self.enable_dce = False                # -> dce pass (fetch-seeded)

@@ -1102,6 +1102,28 @@ class Executor:
         if carry:
             scope_state |= {n for op in block.ops
                             for n in op.output_arg_names if n in carry}
+            # a carried data var that is READ before any op writes it,
+            # yet neither fed nor seeded, would surface later as a
+            # baffling missing-input lowering error; fail at the boundary
+            # with the actual fix instead.  Write-only carries (assign
+            # into fresh state) need no seed — the write defines them.
+            def _read_before_write(n):
+                for op in block.ops:
+                    if n in op.input_arg_names:
+                        return True
+                    if n in op.output_arg_names:
+                        return False
+                return False
+            missing = [n for n in sorted(carry)
+                       if n in block.vars and block.vars[n].is_data
+                       and n not in feed and scope.find_var(n) is None
+                       and _read_before_write(n)]
+            if missing:
+                raise ValueError(
+                    f"carry_vars {missing} are declared data vars but "
+                    f"neither fed nor seeded in the scope — seed the "
+                    f"initial carried state with scope.set_var(name, "
+                    f"value) before the first run (docs/serving.md)")
         written_names = sorted(
             {n for op in block.ops for n in op.output_arg_names
              if n in persist or n in scope_state})

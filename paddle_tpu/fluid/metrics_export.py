@@ -192,6 +192,14 @@ def stats_payload() -> Dict[str, Any]:
             "steps": _counter("decode.steps"),
             "active_slots": _gauge("decode.active_slots"),
             "queue_depth": _gauge("decode.queue_depth"),
+            # paged-KV / prefix-cache / speculative instruments (0 when
+            # the engine runs the dense path — cheap, stable schema)
+            "kv_pages_in_use": _gauge("decode.kv_pages_in_use"),
+            "kv_page_pool_free": _gauge("decode.kv_page_pool_free"),
+            "prefix_hits": _counter("decode.prefix_hits"),
+            "prefix_evictions": _counter("decode.prefix_evictions"),
+            "spec_proposed": _counter("decode.spec_proposed"),
+            "spec_accepted": _counter("decode.spec_accepted"),
         }
     # transport-robustness truth (docs/robustness.md): checksum-caught
     # corruptions, retries, deadline sheds, and injected faults — how a

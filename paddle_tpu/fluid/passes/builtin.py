@@ -673,6 +673,8 @@ def passes_for_build_strategy(build_strategy) -> List[Pass]:
         specs.append(("fuse_bn_act", {}))
     if tier or getattr(bs, "fuse_attention", False):
         specs.append(("fuse_attention", {}))
+    if tier or getattr(bs, "fuse_paged_attention", False):
+        specs.append(("fuse_paged_attention", {}))
     if tier or getattr(bs, "fuse_sparse_embedding", False):
         specs.append(("fuse_sparse_embedding", {}))
     if tier or getattr(bs, "fuse_optimizer", False) \

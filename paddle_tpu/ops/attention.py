@@ -145,6 +145,63 @@ def _fused_mha(ins, attrs, ctx):
     return {"Out": [out]}
 
 
+def _paged_reference(q, kp, vp, idx, valid, scale, neg):
+    """The XLA fallback: bit-for-bit the op-by-op lowering of the paged
+    decode attend chain (serving/decode.py demo paged program) —
+    gather → reshape → mul+reduce_sum scores → scale → masked add →
+    softmax → mul+reduce_sum context.  The fuse_paged_attention pass
+    (fluid/passes/kernel_tier.py) swaps the chain for this op, so every
+    spelling here must reproduce the individual op lowerings exactly
+    (jnp.take for gather, the same reduce axes, ``x * scale + bias`` for
+    scale) or the rewrite would not be bit-transparent on CPU."""
+    b = q.shape[0]
+    s_len = valid.shape[1]
+    d = kp.shape[-1]
+    ii = idx.astype(jnp.int32)
+    kg = jnp.take(kp, ii, axis=0).reshape(b, s_len, d)
+    vg = jnp.take(vp, ii, axis=0).reshape(b, s_len, d)
+    s = jnp.sum(jnp.multiply(kg, q.reshape(b, 1, d)), axis=(2,))
+    s = s * scale + 0.0
+    s = jnp.add(jnp.multiply(s, valid), valid * neg + (-neg))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.sum(jnp.multiply(vg, p.reshape(b, s_len, 1)), axis=(1,))
+
+
+@register_op("paged_attention", nondiff_inputs=("Index", "Valid"))
+def _paged_attention(ins, attrs, ctx):
+    """Decode-step attention over a block-paged KV pool.
+
+    Q [B, d]; KPool/VPool [R, d] flat page pools; Index [B*S] (or [B, S])
+    int32 pool-row per logical position; Valid [B, S] float 0/1 mask.
+    On TPU with lane-aligned shapes the lowering is the Pallas paged
+    flash kernel (pallas_kernels.paged_flash_attention_tpu); elsewhere
+    the XLA gather fallback mirrors the unfused chain bit-for-bit."""
+    q = ins["Q"][0]
+    kp, vp = ins["KPool"][0], ins["VPool"][0]
+    idx, valid = ins["Index"][0], ins["Valid"][0]
+    scale = float(attrs.get("scale", 1.0))
+    neg = float(attrs.get("neg", 1e30))
+    b, s_len = valid.shape
+    idx2 = idx.reshape(b, s_len)
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if on_tpu:
+        try:
+            from .pallas_kernels import (paged_attention_supported,
+                                         paged_flash_attention_tpu)
+        except ImportError:
+            paged_attention_supported = None
+        if paged_attention_supported is not None \
+                and paged_attention_supported(q, kp, idx2):
+            ps = int(attrs.get("page_size", 1) or 1)
+            if s_len % ps != 0:
+                ps = 1
+            lengths = jnp.sum(valid, axis=1, keepdims=True).astype(jnp.int32)
+            return {"Out": [paged_flash_attention_tpu(
+                q, kp, vp, idx2, lengths, scale, page_size=ps)]}
+    return {"Out": [_paged_reference(q, kp, vp, idx.reshape(-1), valid,
+                                     scale, neg)]}
+
+
 @register_op("multihead_matmul", nondiff_inputs=("BiasQK",))
 def _multihead_matmul(ins, attrs, ctx):
     """Reference multihead_matmul_op.cu API: packed QKV input."""

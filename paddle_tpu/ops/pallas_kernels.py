@@ -28,7 +28,8 @@ __all__ = ["flash_attention_tpu", "fused_dropout_tpu",
            "fused_dropout_add_tpu", "fused_act_dropout_tpu",
            "fused_embedding_pool_tpu", "embedding_pool_grad_tpu",
            "fused_embedding_pool_supported",
-           "fused_adam_tpu", "fused_momentum_tpu"]
+           "fused_adam_tpu", "fused_momentum_tpu",
+           "paged_flash_attention_tpu", "paged_attention_supported"]
 
 
 # ---------------------------------------------------------------------------
@@ -46,6 +47,97 @@ def flash_attention_tpu(q, k, v, scale=None, causal=False, ab=None):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     return _fa(q, k, v, ab=ab, causal=causal, sm_scale=float(scale))
+
+
+# ---------------------------------------------------------------------------
+# paged flash attention: decode-step attention over a block-paged KV pool.
+#
+# The decode plane (serving/decode.py) keeps K/V in fixed-size pages of a
+# device-resident pool; a slot's logical KV window is the pool rows named by
+# its page table.  The dense decode kernel would need the [B, max_len, d]
+# caches materialised per slot — here each grid step walks ITS page-table row
+# (SMEM), streams one page of pool rows at a time through VMEM, and folds
+# them into an online-softmax accumulator, so the gathered [B, max_len, d]
+# tensor never exists.  Positions >= the slot's length mask to -1e30 before
+# the running max, matching the XLA fallback's masked-softmax exactly-0.0
+# contract (ops/attention.py paged_attention).
+# ---------------------------------------------------------------------------
+
+_PAGED_VMEM_BYTES = 8 << 20   # both pools ride as whole VMEM blocks; bigger
+                              # pools take the XLA take/reshape fallback
+
+
+def paged_attention_supported(q, k_pool, idx) -> bool:
+    """Static gate for the Pallas paged path: lane-aligned head dim, flat
+    2-d pools small enough to hold as one VMEM block each, and a
+    per-position index row per batch entry."""
+    if q.ndim != 2 or k_pool.ndim != 2 or idx.ndim != 2:
+        return False
+    d = q.shape[-1]
+    if d != k_pool.shape[-1] or d % 128 != 0 or idx.shape[1] == 0:
+        return False
+    return 2 * k_pool.size * k_pool.dtype.itemsize <= _PAGED_VMEM_BYTES
+
+
+def _paged_attn_kernel(idx_ref, len_ref, q_ref, kp_ref, vp_ref, o_ref, *,
+                       n_blocks, page_size, scale):
+    d = o_ref.shape[-1]
+    q = q_ref[:]                                    # [1, d]
+    length = len_ref[0, 0]
+
+    def body(j, carry):
+        m, l, acc = carry
+        base = idx_ref[0, j * page_size]            # page rows contiguous
+        k = pl.load(kp_ref, (pl.dslice(base, page_size), pl.dslice(0, d)))
+        v = pl.load(vp_ref, (pl.dslice(base, page_size), pl.dslice(0, d)))
+        s = jax.lax.dot_general(                    # [1, page_size]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        s = jnp.where(pos < length, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                      # masked -> exactly 0.0
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((1, 1), jnp.float32)
+    acc0 = jnp.zeros((1, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+
+
+def paged_flash_attention_tpu(q, k_pool, v_pool, idx, lengths, scale,
+                              page_size=1):
+    """q: [B, d] one query row per decode slot; k_pool/v_pool: [R, d] flat
+    page pools (R = n_pages * page_size); idx: [B, S] int32 pool-row index
+    per logical position (page-contiguous in runs of ``page_size``);
+    lengths: [B, 1] int32 valid-position counts.  Returns [B, d]."""
+    b, s = idx.shape
+    r, d = k_pool.shape
+    if s % page_size != 0:
+        raise ValueError(f"seq window {s} not a multiple of page_size "
+                         f"{page_size}")
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel, n_blocks=s // page_size,
+                          page_size=page_size, scale=float(scale)),
+        grid=(b,),
+        in_specs=[pl.BlockSpec((1, s), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, 1), lambda i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, d), lambda i: (i, 0)),
+                  pl.BlockSpec((r, d), lambda i: (0, 0)),
+                  pl.BlockSpec((r, d), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, d), q.dtype),
+    )(idx.astype(jnp.int32), lengths.astype(jnp.int32).reshape(b, 1),
+      q, k_pool, v_pool)
 
 
 # ---------------------------------------------------------------------------

@@ -1,5 +1,6 @@
 """Autoregressive decode serving: KV-cached continuous batching with
-mid-flight join/leave.
+mid-flight join/leave, block-paged KV pools, prefix reuse, and
+speculative decoding.
 
 The continuous batcher (serving/engine.py) serves ONE-SHOT requests:
 each request is a single device batch row, in and out.  Iterative
@@ -31,17 +32,48 @@ This module opens that workload on the planes the stack already has:
   request alone — the ci_smoke decode gate asserts it across
   prefill/decode bucket boundaries.
 
+On top of the dense engine ride three composable serving tiers
+(``DecodeEngine(paged=True, prefix_cache=..., draft_model=...)``):
+
+* **Block-paged KV** — instead of a dense ``[B, max_len, d]`` cache
+  per slot, K/V rows live in a flat device pool ``[R, d]`` carved into
+  fixed-size pages; a slot owns ``ceil((prompt+new-1)/page_size)``
+  pages and a carried slot→page table (``pt``) tells the paged decode
+  program where each logical position lives.  Occupancy — not
+  ``max_len`` — bounds concurrency, retirement returns pages in O(1),
+  and overload is a typed :class:`PagePoolExhaustedError` at
+  admission, never a device OOM.  Page 0 is a scratch page that
+  absorbs padding-row writes.
+* **Prefix caching** — prompt prefixes are hashed at page granularity
+  (exact token tuples, chained per page); a new request whose prefix
+  matches seeds those pages from a refcounted warm pool and *replays*
+  only the uncovered prompt tail through decode steps instead of a
+  full prefill.  Eviction is LRU over cache entries and never frees a
+  page with live readers.
+* **Speculative decoding** — a cheap draft :class:`DecodeModel`
+  proposes up to ``spec_k - 1`` tokens per round and ONE batched
+  target launch (the verify program: ``spec_k`` chained paged steps)
+  scores them all; accepted tokens advance together.  Greedy
+  speculative output is token-identical to plain decode because every
+  verify block is bit-identical to the plain paged step at the same
+  position, and a proposal is only consumed after it matched the
+  target argmax.
+
 The numerics contract the demo model honours (and custom models must):
 per-row computation only, in batch-size-stable spellings.  On CPU XLA
 the batched 3-D ``matmul`` produces different last-ulp row values at
 different batch sizes; the elementwise-mul + ``reduce_sum`` attention
-spelling is row-stable, which is what makes join/leave bit-exact.
+spelling is row-stable, which is what makes join/leave bit-exact.  The
+paged data path preserves it: page writes are one-hot matmul scatters
+(the written row is exactly ``k_new``), page reads are ``gather`` (an
+exact copy), and masked positions still contribute exact 0.0.
 """
 from __future__ import annotations
 
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -51,10 +83,11 @@ from ..fluid import flight_recorder as _flight
 from ..fluid.core import Scope
 from ..fluid.executor import Executor
 from .engine import (BaseFuture, EngineClosedError, FamilyInstruments,
-                     QueueFullError, ServingError)
+                     PagePoolExhaustedError, QueueFullError, ServingError)
 
 __all__ = [
     "DecodeModel", "DecodeEngine", "DecodeFuture", "DecodeRejectedError",
+    "KVPagePool", "PrefixCache", "PagePoolExhaustedError",
     "build_demo_decode_model", "decode_sequential",
 ]
 
@@ -82,28 +115,189 @@ class DecodeFuture(BaseFuture):
 
 
 # ---------------------------------------------------------------------------
+# the page pool + prefix cache (host-side bookkeeping over device pages)
+# ---------------------------------------------------------------------------
+
+class KVPagePool:
+    """Refcounted allocator over the device KV page pool.
+
+    The device arrays (``k_pool``/``v_pool``, flat ``[n_pages *
+    page_size, d]``) never move; this object only tracks which pages
+    are free and how many readers hold each one.  Page 0 is reserved
+    as the scratch page: padding batch rows write there, and page-table
+    entries beyond a slot's allocation point there (always masked).
+
+    Refcounts are what let the prefix cache share pages: a live slot
+    holds one reference to each of its pages, the cache holds one more
+    for every registered prefix page, and a page returns to the free
+    list only when the LAST holder releases it — so prefix-shared
+    pages survive their donor's retirement.
+    """
+
+    def __init__(self, n_pages: int, page_size: int):
+        n_pages = int(n_pages)
+        if n_pages < 2:
+            raise ValueError("KVPagePool needs >= 2 pages "
+                             "(page 0 is the reserved scratch page)")
+        self.n_pages = n_pages
+        self.page_size = int(page_size)
+        self._free: List[int] = list(range(1, n_pages))   # LIFO reuse
+        self._ref: List[int] = [0] * n_pages
+
+    @property
+    def usable_pages(self) -> int:
+        return self.n_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.usable_pages - len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` pages (refcount 1 each); typed rejection when the
+        pool cannot satisfy the request — the paged answer to overload
+        is admission backpressure, never a device OOM."""
+        n = int(n)
+        if n > len(self._free):
+            raise PagePoolExhaustedError(
+                f"need {n} KV pages, only {len(self._free)} free "
+                f"of {self.usable_pages}")
+        out = [self._free.pop() for _ in range(n)]
+        for pid in out:
+            self._ref[pid] = 1
+        return out
+
+    def incref(self, pid: int) -> None:
+        if self._ref[pid] <= 0:
+            raise ValueError(f"page {pid} is free; cannot share it")
+        self._ref[pid] += 1
+
+    def release(self, pid: int) -> None:
+        r = self._ref[pid]
+        if r <= 0:
+            raise ValueError(f"double free of page {pid}")
+        self._ref[pid] = r - 1
+        if r == 1:
+            self._free.append(pid)
+
+    def refcount(self, pid: int) -> int:
+        return self._ref[pid]
+
+
+class PrefixCache:
+    """Page-granular prompt-prefix cache (CtrAccessor-style LRU).
+
+    Keys are EXACT token tuples ``tuple(prompt[:(j+1)*page_size])`` —
+    one chained entry per fully-covered prompt page, each mapping to
+    the pool page that holds those positions' K/V rows.  ``lookup``
+    walks the chain from page 0 and stops at the first miss, touching
+    every hit (LRU order = entry recency).  ``evict`` scans
+    oldest-first and only frees pages whose sole remaining reference
+    is the cache itself — a page with live readers is never freed.
+
+    Evicting a middle link breaks the chain for future lookups; the
+    now-unreachable longer entries simply age out through the same LRU
+    scan.  Only prefill-seeded pages are registered (a prefix-hit
+    joiner's replayed tail pages are not), which keeps registration a
+    admission-time-only affair.
+    """
+
+    def __init__(self, pool: KVPagePool):
+        self.pool = pool
+        self._entries: "OrderedDict[tuple, int]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, prompt) -> List[int]:
+        ps = self.pool.page_size
+        toks = [int(t) for t in prompt]
+        out: List[int] = []
+        j = 0
+        while (j + 1) * ps <= len(toks):
+            key = tuple(toks[:(j + 1) * ps])
+            pid = self._entries.get(key)
+            if pid is None:
+                break
+            self._entries.move_to_end(key)
+            out.append(pid)
+            j += 1
+        return out
+
+    def register(self, prompt, pages: Sequence[int]) -> int:
+        """Adopt the fully-prompt-covered prefix pages of a freshly
+        prefilled slot (one extra refcount per adopted page)."""
+        ps = self.pool.page_size
+        toks = [int(t) for t in prompt]
+        j, added = 0, 0
+        while (j + 1) * ps <= len(toks) and j < len(pages):
+            key = tuple(toks[:(j + 1) * ps])
+            if key not in self._entries:
+                self.pool.incref(pages[j])
+                self._entries[key] = pages[j]
+                added += 1
+            else:
+                self._entries.move_to_end(key)
+            j += 1
+        return added
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` cache-only pages, oldest entry first.
+        Returns how many pages actually went back to the pool."""
+        freed = 0
+        for key in list(self._entries.keys()):
+            if freed >= n_pages:
+                break
+            pid = self._entries[key]
+            if self.pool.refcount(pid) == 1:
+                del self._entries[key]
+                self.pool.release(pid)
+                freed += 1
+        return freed
+
+
+# ---------------------------------------------------------------------------
 # the model contract
 # ---------------------------------------------------------------------------
 
 class DecodeModel:
-    """The two-program contract a DecodeEngine drives.
+    """The program family a DecodeEngine drives.
 
-    * ``decode_program`` — ONE step for the whole running batch.  Feeds
-      ``tok [B,1] int64`` (previous token per slot), ``posi [B,1] int64``
-      / ``pos [B,1] float32`` (the position this step writes = current
-      length), ``arange [1, max_len] float32``.  Carries (hints
-      ``carry_vars``) the KV caches ``k_cache``/``v_cache``
-      ``[B, max_len, d]`` as scope vars.  Fetches next-token logits
-      ``[B, vocab]``.
+    * ``decode_program`` — ONE step for the whole running batch (dense
+      KV).  Feeds ``tok [B,1] int64`` (previous token per slot),
+      ``posi [B,1] int64`` / ``pos [B,1] float32`` (the position this
+      step writes = current length), ``arange [1, max_len] float32``.
+      Carries (hints ``carry_vars``) the KV caches
+      ``k_cache``/``v_cache`` ``[B, max_len, d]`` as scope vars.
+      Fetches next-token logits ``[B, vocab]``.
     * ``prefill_program(s_p)`` — consume a prompt padded to the
       prompt-length bucket ``s_p``: feeds ``prompt [B, s_p] int64``,
       ``lastpos [B,1] int64``, ``plen [B,1] float32``,
-      ``arange_p [1, s_p] float32``; fetches first-token logits and the
-      initial KV rows ``[B, max_len, d]`` (positions >= plen hold
+      ``arange_p [1, max_len] float32``; fetches first-token logits and
+      the initial KV rows ``[B, max_len, d]`` (positions >= plen hold
       deterministic don't-care values the decode mask excludes until
-      they are overwritten in order).
+      they are overwritten in order).  The prefill attends over the
+      full padded ``max_len`` window so its logits are spelled exactly
+      like a decode step's — that interchangeability is what makes a
+      prefix-cache hit's first emission (from a decode step) bit-match
+      a miss's (from prefill).
+    * ``paged_program(pool_rows)`` — ONE step over the flat paged
+      pools (optional; built by ``paged_builder``): feeds ``tok``,
+      ``widx [B,1] int64`` (flat pool row this step writes), ``pos``,
+      ``arange``; reads the carried ``k_pool``/``v_pool``
+      ``[pool_rows, d]`` and the seeded page table ``pt
+      [B, max_len] int32``.  Returns ``(program, logits_name)``.
+    * ``verify_program(pool_rows, k)`` — ``k`` chained paged steps in
+      one launch for speculative verification (optional; built by
+      ``verify_builder``): feeds ``toks [B,k]`` / ``widx [B,k]``
+      int64, ``pos [B,1] float32`` (base position), ``arange``;
+      returns ``(program, [k logits names])`` where block ``j`` is
+      bit-identical to a plain paged step at position ``pos + j``.
 
-    Both programs share their weights through one scope; the engine
+    All programs share their weights through one scope; the engine
     runs them in a CHILD scope so several engines (batched + the
     sequential reference) share parameters without sharing KV state.
     Custom models plug in by constructing this class directly with the
@@ -114,7 +308,12 @@ class DecodeModel:
     def __init__(self, executor: Executor, scope, decode_program,
                  logits_name: str, vocab: int, d_model: int, max_len: int,
                  prefill_builder: Callable[[int], tuple],
-                 k_name: str = "k_cache", v_name: str = "v_cache"):
+                 k_name: str = "k_cache", v_name: str = "v_cache",
+                 paged_builder: Optional[Callable[[int], tuple]] = None,
+                 verify_builder: Optional[Callable[[int, int], tuple]] = None,
+                 page_size: Optional[int] = None,
+                 k_pool_name: str = "k_pool", v_pool_name: str = "v_pool",
+                 pt_name: str = "pt"):
         self.executor = executor
         self.scope = scope
         self.decode_program = decode_program
@@ -124,8 +323,16 @@ class DecodeModel:
         self.max_len = int(max_len)
         self.k_name = k_name
         self.v_name = v_name
+        self.page_size = int(page_size) if page_size else None
+        self.k_pool_name = k_pool_name
+        self.v_pool_name = v_pool_name
+        self.pt_name = pt_name
         self._prefill_builder = prefill_builder
+        self._paged_builder = paged_builder
+        self._verify_builder = verify_builder
         self._prefill: Dict[int, tuple] = {}
+        self._paged: Dict[int, tuple] = {}
+        self._verify: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
 
     def prefill_program(self, s_p: int):
@@ -138,16 +345,45 @@ class DecodeModel:
                 entry = self._prefill[s_p] = self._prefill_builder(s_p)
             return entry
 
+    def paged_program(self, pool_rows: int):
+        """(program, logits_name) for the one-step paged decode over a
+        ``[pool_rows, d]`` pool — lazy, one program per pool size (the
+        one-hot write depth bakes ``pool_rows`` in)."""
+        if self._paged_builder is None:
+            raise ValueError("this DecodeModel has no paged_builder; "
+                             "paged decode is unavailable")
+        key = int(pool_rows)
+        with self._lock:
+            entry = self._paged.get(key)
+            if entry is None:
+                entry = self._paged[key] = self._paged_builder(key)
+            return entry
+
+    def verify_program(self, pool_rows: int, k: int):
+        """(program, [logits names]) for ``k`` chained paged steps —
+        the speculative-verification launch."""
+        if self._verify_builder is None:
+            raise ValueError("this DecodeModel has no verify_builder; "
+                             "speculative decode is unavailable")
+        key = (int(pool_rows), int(k))
+        with self._lock:
+            entry = self._verify.get(key)
+            if entry is None:
+                entry = self._verify[key] = self._verify_builder(*key)
+            return entry
+
 
 def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
                             max_len: int = 24, seed: int = 0,
                             executor: Optional[Executor] = None,
-                            scope=None) -> DecodeModel:
+                            scope=None, page_size: int = 4) -> DecodeModel:
     """A single-layer attention LM over the static IR — the decode
     demo/ci model.  One embedding + shared Q/K/V projections + an output
     head; the attention uses the batch-size-stable mul+reduce_sum
     spelling so batched join/leave decode is bit-identical to
-    sequential decode (module docstring)."""
+    sequential decode (module docstring).  Besides the dense
+    decode/prefill pair it supplies the paged one-step and speculative
+    verify builders over the same weights."""
     import paddle_tpu.fluid as fluid
     from paddle_tpu.fluid import layers as L
     from paddle_tpu.fluid.param_attr import ParamAttr
@@ -155,6 +391,10 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
     executor = executor or Executor()
     scope = scope if scope is not None else Scope()
     scale = float(d_model) ** -0.5
+    page_size = int(page_size)
+    if page_size < 1 or max_len % page_size:
+        raise ValueError(f"page_size {page_size} must divide "
+                         f"max_len={max_len}")
 
     def proj(x, which, flatten=1):
         return L.fc(x, d_model, num_flatten_dims=flatten,
@@ -174,6 +414,26 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
         p = L.softmax(s)        # masked positions underflow to exact 0.0
         return L.reduce_sum(v * L.unsqueeze(p, [2]), dim=[1])   # [B, d]
 
+    def embed_tok(tok):
+        return L.squeeze(L.embedding(tok, size=[vocab, d_model],
+                                     param_attr=ParamAttr(name="dec_emb")),
+                         [1])                                    # [B, d]
+
+    def pool_write(kp, vp, widx, k_new, v_new, pool_rows):
+        # one-hot matmul scatter into the flat pool: written rows get
+        # exactly k_new (keep==0 there), untouched rows are exact
+        # (keep==1, scatter adds +-0.0).  relu clamps keep at 0 when
+        # several padding rows pile onto the scratch page — without it
+        # keep = 1 - n_writers < -1 would grow the scratch row
+        # geometrically until it overflowed and NaN-poisoned the
+        # masked softmax.
+        ohw = L.one_hot(widx, pool_rows)                        # [B, R]
+        wsum = L.unsqueeze(L.reduce_sum(ohw, dim=[0]), [1])     # [R, 1]
+        keep = L.relu(L.scale(wsum, scale=-1.0, bias=1.0))
+        k_upd = kp * keep + L.matmul(ohw, k_new, transpose_x=True)
+        v_upd = vp * keep + L.matmul(ohw, v_new, transpose_x=True)
+        return k_upd, v_upd
+
     # -- the decode-step program (all params live here; its startup is
     # the one that runs) ----------------------------------------------------
     dec, dec_startup = fluid.Program(), fluid.Program()
@@ -186,9 +446,7 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
         ar = fluid.data("arange", [1, max_len], dtype="float32")
         k_cache = fluid.data("k_cache", [-1, max_len, d_model])
         v_cache = fluid.data("v_cache", [-1, max_len, d_model])
-        x = L.squeeze(L.embedding(tok, size=[vocab, d_model],
-                                  param_attr=ParamAttr(name="dec_emb")),
-                      [1])                                       # [B, d]
+        x = embed_tok(tok)
         q, k_new, v_new = proj(x, "q"), proj(x, "k"), proj(x, "v")
         oh3 = L.unsqueeze(L.one_hot(posi, max_len), [2])         # [B,S,1]
         keep = L.scale(oh3, scale=-1.0, bias=1.0)
@@ -219,7 +477,7 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
             prompt = fluid.data("prompt", [-1, s_p], dtype="int64")
             lastpos = fluid.data("lastpos", [-1, 1], dtype="int64")
             plen = fluid.data("plen", [-1, 1], dtype="float32")
-            arp = fluid.data("arange_p", [1, s_p], dtype="float32")
+            arp = fluid.data("arange_p", [1, max_len], dtype="float32")
             x = L.embedding(prompt, size=[vocab, d_model],
                             param_attr=ParamAttr(name="dec_emb"))
             k = proj(x, "k", flatten=2)                    # [B, s_p, d]
@@ -227,12 +485,15 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
             oh = L.unsqueeze(L.one_hot(lastpos, s_p), [2])  # [B, s_p, 1]
             x_last = L.reduce_sum(x * oh, dim=[1])          # [B, d]
             q = proj(x_last, "q")
-            valid = L.cast(L.less_than(arp, plen), "float32")
-            logits = head(attend(q, k, v, valid) + x_last)
             zpad = L.fill_constant_batch_size_like(
                 k, [-1, max_len - s_p, d_model], "float32", 0.0)
             k_init = L.concat([k, zpad], axis=1)            # [B, S, d]
             v_init = L.concat([v, zpad], axis=1)
+            # attend over the FULL max_len window (padding masked to
+            # exact 0.0) so the prefill logits stay bit-interchangeable
+            # with a decode step's at the same position
+            valid = L.cast(L.less_than(arp, plen), "float32")
+            logits = head(attend(q, k_init, v_init, valid) + x_last)
         pf._hints["is_test"] = True
         pf._hints["shape_bucketing"] = False
         pf._hints["expected_shape_churn"] = True
@@ -240,8 +501,93 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
         pf._hints["fetch_names"] = [logits.name, k_init.name, v_init.name]
         return pf, logits.name, k_init.name, v_init.name
 
+    # -- the paged one-step program, one per pool size -----------------------
+    def build_paged(pool_rows: int):
+        pg, pg_startup = fluid.Program(), fluid.Program()
+        pg.random_seed = seed
+        with fluid.program_guard(pg, pg_startup):
+            tok = fluid.data("tok", [-1, 1], dtype="int64")
+            widx = fluid.data("widx", [-1, 1], dtype="int64")
+            pos = fluid.data("pos", [-1, 1], dtype="float32")
+            ar = fluid.data("arange", [1, max_len], dtype="float32")
+            pt = fluid.data("pt", [-1, max_len], dtype="int32")
+            # concrete pool extent: the pool is never batch-sliced and
+            # one program exists per pool size anyway — and the static
+            # shape is what lets infer-shape see the write broadcast
+            k_pool = fluid.data("k_pool", [pool_rows, d_model])
+            v_pool = fluid.data("v_pool", [pool_rows, d_model])
+            x = embed_tok(tok)
+            q, k_new, v_new = proj(x, "q"), proj(x, "k"), proj(x, "v")
+            k_upd, v_upd = pool_write(k_pool, v_pool, widx,
+                                      k_new, v_new, pool_rows)
+            L.assign(k_upd, output=k_pool)
+            L.assign(v_upd, output=v_pool)
+            # page-table gather: exact row copies out of the pool, so
+            # the attend sees the same values a dense cache would hold
+            pti = L.reshape(pt, [-1])
+            kg = L.reshape(L.gather(k_upd, pti), [-1, max_len, d_model])
+            vg = L.reshape(L.gather(v_upd, pti), [-1, max_len, d_model])
+            valid = L.cast(L.less_than(ar, L.scale(pos, bias=1.0)),
+                           "float32")
+            logits = head(attend(q, kg, vg, valid) + x)
+        pg._hints["is_test"] = True
+        pg._hints["shape_bucketing"] = False
+        pg._hints["expected_shape_churn"] = True
+        pg._hints["carry_vars"] = ("k_pool", "v_pool")
+        pg._hints["feed_names"] = ["tok", "widx", "pos", "arange"]
+        pg._hints["fetch_names"] = [logits.name]
+        # lets the fuse_paged_attention pass stamp the real page size on
+        # the fused op (the Pallas kernel gathers page-at-a-time)
+        pg._hints["kv_page_size"] = page_size
+        return pg, logits.name
+
+    # -- the speculative verify program: k chained paged steps ---------------
+    def build_verify(pool_rows: int, k_steps: int):
+        vp_, vp_startup = fluid.Program(), fluid.Program()
+        vp_.random_seed = seed
+        with fluid.program_guard(vp_, vp_startup):
+            toks = fluid.data("toks", [-1, k_steps], dtype="int64")
+            widx = fluid.data("widx", [-1, k_steps], dtype="int64")
+            pos = fluid.data("pos", [-1, 1], dtype="float32")
+            ar = fluid.data("arange", [1, max_len], dtype="float32")
+            pt = fluid.data("pt", [-1, max_len], dtype="int32")
+            k_pool = fluid.data("k_pool", [pool_rows, d_model])
+            v_pool = fluid.data("v_pool", [pool_rows, d_model])
+            pti = L.reshape(pt, [-1])
+            kcur, vcur = k_pool, v_pool
+            names = []
+            for j in range(k_steps):
+                tj = L.slice(toks, axes=[1], starts=[j], ends=[j + 1])
+                wj = L.slice(widx, axes=[1], starts=[j], ends=[j + 1])
+                x = embed_tok(tj)
+                q, kn, vn = proj(x, "q"), proj(x, "k"), proj(x, "v")
+                kcur, vcur = pool_write(kcur, vcur, wj, kn, vn, pool_rows)
+                kg = L.reshape(L.gather(kcur, pti), [-1, max_len, d_model])
+                vg = L.reshape(L.gather(vcur, pti), [-1, max_len, d_model])
+                # block j's window is positions <= pos + j: the float
+                # adds are exact small integers, so this is bitwise the
+                # single-step valid at position pos + j
+                valid = L.cast(
+                    L.less_than(ar, L.scale(pos, bias=float(j + 1))),
+                    "float32")
+                lg = head(attend(q, kg, vg, valid) + x)
+                names.append(lg.name)
+            L.assign(kcur, output=k_pool)
+            L.assign(vcur, output=v_pool)
+        vp_._hints["is_test"] = True
+        vp_._hints["shape_bucketing"] = False
+        vp_._hints["expected_shape_churn"] = True
+        vp_._hints["carry_vars"] = ("k_pool", "v_pool")
+        vp_._hints["feed_names"] = ["toks", "widx", "pos", "arange"]
+        vp_._hints["fetch_names"] = list(names)
+        vp_._hints["kv_page_size"] = page_size
+        return vp_, names
+
     return DecodeModel(executor, scope, dec, logits.name, vocab, d_model,
-                       max_len, build_prefill)
+                       max_len, build_prefill,
+                       paged_builder=build_paged,
+                       verify_builder=build_verify,
+                       page_size=page_size)
 
 
 # ---------------------------------------------------------------------------
@@ -250,13 +596,15 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
 
 class _DecodeInstruments(FamilyInstruments):
     COUNTERS = ("requests", "rejected", "joins", "leaves", "tokens",
-                "steps", "prefills")
+                "steps", "prefills", "prefix_hits", "prefix_evictions",
+                "spec_proposed", "spec_accepted")
     HISTOGRAMS = ("ttft_seconds", "step_seconds", "request_seconds",
                   "batch_occupancy")
 
     def __init__(self, name: Optional[str] = None):
         super().__init__("decode", self.COUNTERS, self.HISTOGRAMS,
-                         ("active_slots", "queue_depth"), name)
+                         ("active_slots", "queue_depth", "kv_pages_in_use",
+                          "kv_page_pool_free"), name)
 
     def set_active(self, v):
         self.set_gauge("active_slots", v)
@@ -271,7 +619,8 @@ class _DecodeInstruments(FamilyInstruments):
 
 class _Slot:
     __slots__ = ("req", "pos", "last_token", "k_row", "v_row", "tokens",
-                 "logits", "t_submit", "t_first")
+                 "logits", "t_submit", "t_first", "plen", "pages",
+                 "d_k_row", "d_v_row")
 
     def __init__(self, req):
         self.req = req
@@ -279,6 +628,10 @@ class _Slot:
         self.last_token = 0
         self.k_row = None       # [max_len, d] device rows, valid at sync points
         self.v_row = None
+        self.plen = int(req.prompt.size)
+        self.pages: List[int] = []   # owned pool pages (paged mode)
+        self.d_k_row = None     # draft-model dense KV rows (speculative)
+        self.d_v_row = None
         self.tokens: List[int] = []
         self.logits: List[np.ndarray] = []
         self.t_submit = req.t_submit
@@ -316,6 +669,18 @@ class DecodeEngine:
     (``carry_vars``) sized to ``bucket_for(live, batch_edges)``;
     membership changes re-pack the live rows device-side.
 
+    ``paged=True`` swaps the dense per-slot caches for the block-paged
+    pool: admission reserves ``ceil((prompt + max_new - 1)/page_size)``
+    pages (transient shortage parks the request in a pending FIFO
+    retried every iteration; a request that can NEVER fit raises
+    :class:`PagePoolExhaustedError` at submit), membership changes
+    re-seed only the int32 page table, and retirement returns pages in
+    O(1).  ``prefix_cache=True`` adds the page-granular prompt prefix
+    cache; ``draft_model=`` adds speculative decoding with ``spec_k``
+    positions verified per target launch.  All three keep the bitwise
+    exactness contract vs :func:`decode_sequential` (greedy
+    speculative output is token-identical).
+
     ``close()`` is a planned drain: queued + live requests finish, then
     the loop exits — no accepted request is lost.
     """
@@ -323,7 +688,12 @@ class DecodeEngine:
     def __init__(self, model: DecodeModel, max_batch: int = 8,
                  batch_edges=None, prefill_edges=None,
                  queue_depth: int = 64, collect_logits: bool = False,
-                 name: Optional[str] = None, auto_start: bool = True):
+                 name: Optional[str] = None, auto_start: bool = True,
+                 paged: bool = False, page_size: Optional[int] = None,
+                 pool_pages: Optional[int] = None,
+                 prefix_cache: bool = False,
+                 draft_model: Optional[DecodeModel] = None,
+                 spec_k: int = 4):
         self.model = model
         self.max_batch = int(max_batch)
         self.batch_edges = compile_cache.normalize_edges(
@@ -347,13 +717,54 @@ class DecodeEngine:
         self._arange = np.arange(model.max_len, dtype=np.float32)[None, :]
         self._q: "queue.Queue" = queue.Queue(maxsize=self.queue_depth)
         self._slots: List[_Slot] = []
+        self._pending: "deque[_DecodeRequest]" = deque()
         self._cap = 0
         self._dirty = False
         self._closed = False
         self._started = False
+        self._peak_active = 0
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._auto_start = bool(auto_start)
+
+        # -- paged / prefix / speculative tiers ------------------------------
+        self.paged = bool(paged)
+        if (prefix_cache or draft_model is not None) and not self.paged:
+            raise ValueError("prefix_cache / draft_model require paged=True")
+        self._pool: Optional[KVPagePool] = None
+        self._prefix: Optional[PrefixCache] = None
+        self._draft = draft_model
+        self.page_size = 0
+        self.spec_k = 0
+        if self.paged:
+            ps = int(page_size or model.page_size or 4)
+            if ps < 1 or model.max_len % ps:
+                raise ValueError(f"page_size {ps} must divide "
+                                 f"max_len={model.max_len}")
+            self.page_size = ps
+            per_seq = model.max_len // ps
+            self.pool_pages = int(pool_pages
+                                  or self.max_batch * per_seq + 1)
+            if self.pool_pages < 2:
+                raise ValueError("pool_pages must be >= 2 "
+                                 "(page 0 is scratch)")
+            self._pool = KVPagePool(self.pool_pages, ps)
+            self._pool_rows = self.pool_pages * ps
+            if prefix_cache:
+                self._prefix = PrefixCache(self._pool)
+            import jax.numpy as jnp
+            zero = jnp.zeros((self._pool_rows, model.d_model), jnp.float32)
+            self._scope.set_var(model.k_pool_name, zero)
+            self._scope.set_var(model.v_pool_name, zero)
+        if draft_model is not None:
+            if (draft_model.max_len != model.max_len
+                    or draft_model.vocab != model.vocab):
+                raise ValueError(
+                    "draft model must share max_len and vocab with the "
+                    f"target (draft {draft_model.max_len}/"
+                    f"{draft_model.vocab} vs {model.max_len}/{model.vocab})")
+            self.spec_k = max(2, int(spec_k))
+            self._draft_scope = Scope(parent=draft_model.scope)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "DecodeEngine":
@@ -415,6 +826,17 @@ class DecodeEngine:
                 f"prompt ({prompt.size}) + max_new_tokens ({max_new}) "
                 f"exceeds the model's KV window max_len="
                 f"{self.model.max_len}")
+        if self.paged:
+            # static impossibility is a typed rejection NOW; transient
+            # shortage is not — those requests park in the pending FIFO
+            # until retirements/evictions free pages (never a device OOM)
+            need = -(-(int(prompt.size) + max_new - 1) // self.page_size)
+            if need > self._pool.usable_pages:
+                self._ins.count("rejected")
+                raise PagePoolExhaustedError(
+                    f"request needs {need} KV pages but the pool only "
+                    f"has {self._pool.usable_pages} "
+                    f"(page_size={self.page_size})")
         # explicit/ambient id wins (cross-process propagation keeps the
         # caller's causal identity); fresh "dec-" id otherwise
         trace_id = (trace_id or trace.current_trace_id()
@@ -433,7 +855,7 @@ class DecodeEngine:
                 fut._reject(exc)
                 raise exc
         self._ins.count("requests")
-        self._ins.set_queue_depth(self._q.qsize())
+        self._ins.set_queue_depth(self._q.qsize() + len(self._pending))
         if trace.enabled():
             trace.instant("decode::admit", cat="serving",
                           args={"trace_id": trace_id,
@@ -457,14 +879,17 @@ class DecodeEngine:
     def _abort(self, exc: BaseException) -> None:
         """A loop-level failure (compile error, device fault) must reach
         every waiting client instead of stranding their futures behind a
-        dead thread — reject live slots + the whole queue, mark the
-        engine closed so later submits fail fast, and let close() join a
-        finished thread."""
+        dead thread — reject live slots + pending + the whole queue,
+        mark the engine closed so later submits fail fast, and let
+        close() join a finished thread."""
         with self._lock:
             self._closed = True
         for s in self._slots:
             s.req.future._reject(exc)
         self._slots = []
+        for r in list(self._pending):
+            r.future._reject(exc)
+        self._pending.clear()
         self._ins.set_active(0)
         while True:
             try:
@@ -481,14 +906,16 @@ class DecodeEngine:
             if joins and joins[-1] is _STOP:
                 stop_seen = True
                 joins = joins[:-1]
-            if joins:
-                self._admit(joins)
+            ready = self._take_admittable(joins)
+            if ready:
+                self._admit_ready(ready)
             if not self._slots:
                 # _STOP is enqueued AFTER _closed flips, so once seen no
-                # further request can be behind it — drain done
-                if stop_seen:
+                # further request can be behind it — drain done once the
+                # pending FIFO is empty too
+                if stop_seen and not self._pending:
                     return
-                if not joins:
+                if not joins and not self._pending:
                     # idle: block for work
                     try:
                         item = self._q.get(timeout=0.05)
@@ -497,16 +924,22 @@ class DecodeEngine:
                     if item is _STOP:
                         stop_seen = True
                         continue
-                    self._admit([item])
+                    ready = self._take_admittable([item])
+                    if ready:
+                        self._admit_ready(ready)
                 if not self._slots:
+                    if self._pending and not ready:
+                        # defensive: pending head could not reserve even
+                        # with zero live slots — yield rather than spin
+                        time.sleep(0.005)
                     continue
-            self._decode_step()
+            self._step()
 
     def _gather_joins(self):
         """Drain queued requests up to the free slot budget; _STOP rides
         through as a trailing marker."""
         out: List[Any] = []
-        free = self.max_batch - len(self._slots)
+        free = self.max_batch - len(self._slots) - len(self._pending)
         while free > 0:
             try:
                 item = self._q.get_nowait()
@@ -517,8 +950,64 @@ class DecodeEngine:
                 break
             out.append(item)
             free -= 1
-        self._ins.set_queue_depth(self._q.qsize())
+        self._ins.set_queue_depth(self._q.qsize() + len(self._pending))
         return out
+
+    def _take_admittable(self, reqs):
+        """Dense mode: pass-through.  Paged mode: append to the pending
+        FIFO, then pop head-of-line requests whose page reservation
+        succeeds (strict FIFO — a stuck head blocks later requests so
+        admission can never starve it)."""
+        if not self.paged:
+            return list(reqs)
+        self._pending.extend(reqs)
+        ready = []
+        while self._pending \
+                and len(self._slots) + len(ready) < self.max_batch:
+            plan = self._reserve(self._pending[0])
+            if plan is None:
+                break
+            ready.append((self._pending.popleft(), plan))
+        self._ins.set_queue_depth(self._q.qsize() + len(self._pending))
+        return ready
+
+    def _reserve(self, req) -> Optional[Dict[str, List[int]]]:
+        """Try to reserve the request's pages: shared prefix pages are
+        increffed FIRST (so eviction can never free them out from under
+        us), then the fresh remainder is allocated, evicting cache-only
+        pages if the free list is short.  Returns None (and unwinds the
+        increfs) when the pool genuinely cannot cover it yet."""
+        ps = self.page_size
+        n = int(req.prompt.size)
+        total = -(-(n + req.max_new - 1) // ps)
+        shared: List[int] = []
+        if self._prefix is not None:
+            # keep at least the last prompt token out of the shared
+            # region: the joiner must replay >= 1 tail token through a
+            # decode step to produce its first logits
+            hits = self._prefix.lookup(req.prompt)[:(n - 1) // ps]
+            for pid in hits:
+                self._pool.incref(pid)
+            shared = hits
+        need = total - len(shared)
+        if need > self._pool.free_pages and self._prefix is not None:
+            freed = self._prefix.evict(need - self._pool.free_pages)
+            if freed:
+                self._ins.count("prefix_evictions", freed)
+        if need > self._pool.free_pages:
+            for pid in shared:
+                self._pool.release(pid)
+            return None
+        fresh = self._pool.alloc(need)
+        if shared:
+            self._ins.count("prefix_hits", len(shared))
+        return {"shared": shared, "fresh": fresh}
+
+    def _admit_ready(self, ready) -> None:
+        if self.paged:
+            self._admit_paged(ready)
+        else:
+            self._admit(ready)
 
     # -- join (prefill) ------------------------------------------------------
     def _admit(self, reqs: List[_DecodeRequest]) -> None:
@@ -530,9 +1019,7 @@ class DecodeEngine:
         for s_p in sorted(groups):
             self._prefill(s_p, groups[s_p])
 
-    def _prefill(self, s_p: int, reqs: List[_DecodeRequest]) -> None:
-        model = self.model
-        prog, logits_n, k_n, v_n = model.prefill_program(s_p)
+    def _prefill_feed(self, s_p: int, reqs) -> Dict[str, np.ndarray]:
         batch = compile_cache.bucket_for(len(reqs), self.batch_edges)
         prompt = np.zeros((batch, s_p), dtype=np.int64)
         plen = np.ones((batch, 1), dtype=np.float32)
@@ -542,8 +1029,13 @@ class DecodeEngine:
             prompt[i, :n] = r.prompt
             plen[i, 0] = float(n)
             lastpos[i, 0] = n - 1
-        feed = {"prompt": prompt, "lastpos": lastpos, "plen": plen,
-                "arange_p": np.arange(s_p, dtype=np.float32)[None, :]}
+        return {"prompt": prompt, "lastpos": lastpos, "plen": plen,
+                "arange_p": self._arange}
+
+    def _prefill(self, s_p: int, reqs: List[_DecodeRequest]) -> None:
+        model = self.model
+        prog, logits_n, k_n, v_n = model.prefill_program(s_p)
+        feed = self._prefill_feed(s_p, reqs)
         _t0 = trace.now() if trace.enabled() else 0
         t0 = time.perf_counter()
         handles = model.executor.run(prog, feed=feed,
@@ -555,7 +1047,8 @@ class DecodeEngine:
         self._ins.observe("step_seconds", time.perf_counter() - t0)
         if _t0:
             trace.complete("decode::prefill", _t0, cat="serving",
-                           args={"bucket": s_p, "batch": batch,
+                           args={"bucket": s_p,
+                                 "batch": feed["prompt"].shape[0],
                                  "n_requests": len(reqs)})
         # sync survivors' rows before the membership mutation, then seat
         # the joiners
@@ -565,8 +1058,6 @@ class DecodeEngine:
             slot.pos = int(r.prompt.size)
             slot.k_row = k_init[i]
             slot.v_row = v_init[i]
-            slot.t_first = time.monotonic()
-            self._ins.observe("ttft_seconds", slot.t_first - r.t_submit)
             self._ins.count("joins")
             if self._emit(slot, logits[i]):
                 # finished at its very first token: never occupies a slot
@@ -574,11 +1065,120 @@ class DecodeEngine:
             else:
                 self._slots.append(slot)
                 self._dirty = True
+        self._peak_active = max(self._peak_active, len(self._slots))
         self._ins.set_active(len(self._slots))
+
+    # -- join, paged: seed pages (prefill for misses, warm pages for hits) ---
+    def _admit_paged(self, ready) -> None:
+        # draft rows (speculative) must be synced before membership
+        # mutates; pool state itself is membership-independent
+        self._sync_rows()
+        misses = [p for p in ready if not p[1]["shared"]]
+        hits = [p for p in ready if p[1]["shared"]]
+        seated = []        # (slot, first_logits_or_None)
+        groups: Dict[int, list] = {}
+        for r, plan in misses:
+            s_p = compile_cache.bucket_for(int(r.prompt.size),
+                                           self.prefill_edges)
+            groups.setdefault(s_p, []).append((r, plan))
+        for s_p in sorted(groups):
+            seated.extend(self._paged_prefill(s_p, groups[s_p]))
+        for r, plan in hits:
+            # tail-join: the shared pages already hold the prefix K/V;
+            # the slot replays the uncovered prompt tail through decode
+            # steps and emits its first token once the replay crosses
+            # plen - 1 — no prefill launch at all
+            slot = _Slot(r)
+            slot.pages = plan["shared"] + plan["fresh"]
+            slot.pos = len(plan["shared"]) * self.page_size
+            seated.append((slot, None))
+        if self._draft is not None and seated:
+            self._draft_prefill([s for s, _ in seated])
+        for slot, first_logits in seated:
+            self._ins.count("joins")
+            if first_logits is not None and self._emit(slot, first_logits):
+                self._retire(slot, synced=True)
+            else:
+                self._slots.append(slot)
+                self._dirty = True
+        self._peak_active = max(self._peak_active, len(self._slots))
+        self._ins.set_active(len(self._slots))
+        self._update_page_gauges()
+
+    def _paged_prefill(self, s_p: int, pairs) -> list:
+        """Prefill the miss group, scatter the K/V rows into each
+        slot's fresh pages, and register fully-covered prompt pages
+        with the prefix cache."""
+        import jax.numpy as jnp
+        model = self.model
+        ps = self.page_size
+        reqs = [r for r, _ in pairs]
+        prog, logits_n, k_n, v_n = model.prefill_program(s_p)
+        feed = self._prefill_feed(s_p, reqs)
+        _t0 = trace.now() if trace.enabled() else 0
+        t0 = time.perf_counter()
+        handles = model.executor.run(prog, feed=feed,
+                                     fetch_list=[logits_n, k_n, v_n],
+                                     scope=self._scope, return_numpy=False)
+        logits = np.asarray(handles[0].persist())
+        k_init, v_init = handles[1].raw, handles[2].raw
+        self._ins.count("prefills")
+        self._ins.observe("step_seconds", time.perf_counter() - t0)
+        if _t0:
+            trace.complete("decode::prefill", _t0, cat="serving",
+                           args={"bucket": s_p, "paged": True,
+                                 "n_requests": len(reqs)})
+        out = []
+        rows_list, k_vals, v_vals = [], [], []
+        for i, (r, plan) in enumerate(pairs):
+            slot = _Slot(r)
+            slot.pages = list(plan["fresh"])
+            slot.pos = slot.plen
+            n_seed = (slot.plen - 1) // ps + 1
+            rows = (np.asarray(slot.pages[:n_seed], np.int64)[:, None] * ps
+                    + np.arange(ps, dtype=np.int64)[None, :]).reshape(-1)
+            rows_list.append(rows)
+            k_vals.append(k_init[i, :n_seed * ps])
+            v_vals.append(v_init[i, :n_seed * ps])
+            if self._prefix is not None:
+                self._prefix.register(r.prompt, slot.pages)
+            out.append((slot, logits[i]))
+        rows = np.concatenate(rows_list)
+        kp = self._scope.find_var(model.k_pool_name)
+        vp = self._scope.find_var(model.v_pool_name)
+        self._scope.set_var(model.k_pool_name,
+                            kp.at[rows].set(jnp.concatenate(k_vals)))
+        self._scope.set_var(model.v_pool_name,
+                            vp.at[rows].set(jnp.concatenate(v_vals)))
+        return out
+
+    def _draft_prefill(self, slots: List[_Slot]) -> None:
+        """Seed the draft model's dense KV rows for every new slot (its
+        numerics only steer proposal quality — verification alone
+        decides the output, so the draft needs no exactness care)."""
+        draft = self._draft
+        groups: Dict[int, List[_Slot]] = {}
+        for s in slots:
+            s_p = compile_cache.bucket_for(s.plen, self.prefill_edges)
+            groups.setdefault(s_p, []).append(s)
+        for s_p, group in sorted(groups.items()):
+            prog, logits_n, k_n, v_n = draft.prefill_program(s_p)
+            feed = self._prefill_feed(s_p, [s.req for s in group])
+            handles = draft.executor.run(prog, feed=feed,
+                                         fetch_list=[logits_n, k_n, v_n],
+                                         scope=self._draft_scope,
+                                         return_numpy=False)
+            k_init, v_init = handles[1].raw, handles[2].raw
+            for i, s in enumerate(group):
+                s.d_k_row = k_init[i]
+                s.d_v_row = v_init[i]
 
     # -- token emission / retirement ----------------------------------------
     def _emit(self, slot: _Slot, logits_row: np.ndarray) -> bool:
         """Record the next token for ``slot``; True when it finishes."""
+        if slot.t_first is None:
+            slot.t_first = time.monotonic()
+            self._ins.observe("ttft_seconds", slot.t_first - slot.t_submit)
         tok = int(np.argmax(logits_row))
         slot.tokens.append(tok)
         slot.last_token = tok
@@ -595,6 +1195,13 @@ class DecodeEngine:
         if slot in self._slots:
             self._slots.remove(slot)
             self._dirty = True
+        if self.paged and slot.pages:
+            # O(1) page return; prefix-shared pages survive through the
+            # cache's own refcount
+            for pid in slot.pages:
+                self._pool.release(pid)
+            slot.pages = []
+            self._update_page_gauges()
         r = slot.req
         reason = ("eos" if r.eos_id is not None and slot.tokens
                   and slot.tokens[-1] == r.eos_id else "length")
@@ -617,6 +1224,11 @@ class DecodeEngine:
                                 "reason": reason})
         r.future._resolve(out)
 
+    def _update_page_gauges(self) -> None:
+        if self._pool is not None:
+            self._ins.set_gauge("kv_pages_in_use", self._pool.pages_in_use)
+            self._ins.set_gauge("kv_page_pool_free", self._pool.free_pages)
+
     # -- KV buffer management ------------------------------------------------
     def _sync_rows(self) -> None:
         """Pull each live slot's KV rows out of the current device
@@ -624,8 +1236,19 @@ class DecodeEngine:
         membership mutation so a re-pack starts from current state.
         While ``_dirty`` the buffer has NOT absorbed the latest
         membership (slot indices don't match buffer rows); the per-slot
-        ``k_row``/``v_row`` refs are already authoritative then."""
+        row refs are already authoritative then.  In paged mode the
+        target state lives in the membership-independent pools, so only
+        the draft model's dense rows (speculative) need syncing."""
         if self._dirty or not self._slots or self._cap == 0:
+            return
+        if self.paged:
+            if self._draft is None:
+                return
+            kb = self._draft_scope.find_var(self._draft.k_name)
+            vb = self._draft_scope.find_var(self._draft.v_name)
+            for i, s in enumerate(self._slots):
+                s.d_k_row = kb[i]
+                s.d_v_row = vb[i]
             return
         kb = self._scope.find_var(self.model.k_name)
         vb = self._scope.find_var(self.model.v_name)
@@ -634,11 +1257,36 @@ class DecodeEngine:
             s.v_row = vb[i]
 
     def _rebuild_buffers(self) -> None:
-        """Re-pack live rows into buffers sized to the decode bucket."""
+        """Re-pack per-slot state into buffers sized to the decode
+        bucket.  Dense: stack the live KV rows.  Paged: re-seed only
+        the int32 page table (the pools never move); speculative adds
+        the draft model's dense row stack."""
         import jax.numpy as jnp
         model = self.model
         n = len(self._slots)
         cap = compile_cache.bucket_for(max(n, 1), self.batch_edges)
+        if self.paged:
+            ps = self.page_size
+            pt = np.zeros((cap, model.max_len), np.int32)
+            lane = np.arange(ps, dtype=np.int32)
+            for i, s in enumerate(self._slots):
+                for pi, pg in enumerate(s.pages):
+                    pt[i, pi * ps:(pi + 1) * ps] = pg * ps + lane
+            self._scope.set_var(model.pt_name, jnp.asarray(pt))
+            if self._draft is not None:
+                zero = jnp.zeros((model.max_len, self._draft.d_model),
+                                 jnp.float32)
+                rows_k = [s.d_k_row if s.d_k_row is not None else zero
+                          for s in self._slots] + [zero] * (cap - n)
+                rows_v = [s.d_v_row if s.d_v_row is not None else zero
+                          for s in self._slots] + [zero] * (cap - n)
+                self._draft_scope.set_var(self._draft.k_name,
+                                          jnp.stack(rows_k))
+                self._draft_scope.set_var(self._draft.v_name,
+                                          jnp.stack(rows_v))
+            self._cap = cap
+            self._dirty = False
+            return
         zero = jnp.zeros((model.max_len, model.d_model), jnp.float32)
         rows_k = [s.k_row for s in self._slots] + [zero] * (cap - n)
         rows_v = [s.v_row for s in self._slots] + [zero] * (cap - n)
@@ -648,6 +1296,14 @@ class DecodeEngine:
         self._dirty = False
 
     # -- one decode step -----------------------------------------------------
+    def _step(self) -> None:
+        if self._draft is not None:
+            self._spec_round()
+        elif self.paged:
+            self._paged_step()
+        else:
+            self._decode_step()
+
     def _decode_step(self) -> None:
         if self._dirty:
             self._rebuild_buffers()
@@ -687,35 +1343,243 @@ class DecodeEngine:
             for s in finished:
                 self._retire(s, synced=True)
 
+    def _observe_paged_step(self, dur: float) -> None:
+        self._ins.count("steps")
+        self._ins.observe("step_seconds", dur)
+        # THE occupancy signal under paging is page-pool fullness, not
+        # slots/cap: the fleet router's least-queue choice must see a
+        # replica whose pool is exhausted as full even when its slot
+        # count looks low (ISSUE 17 bugfix)
+        self._ins.observe(
+            "batch_occupancy",
+            self._pool.pages_in_use / max(1, self._pool.usable_pages))
+        self._update_page_gauges()
+
+    def _write_row(self, s: _Slot, p: int) -> int:
+        """Flat pool row logical position ``p`` of ``s`` lives in."""
+        ps = self.page_size
+        return s.pages[p // ps] * ps + p % ps
+
+    @staticmethod
+    def _token_at(s: _Slot, p: int):
+        """The token CONSUMED at position ``p`` (prompt, then generated
+        tokens); None when it has not been generated yet."""
+        if p < s.plen:
+            return int(s.req.prompt[p])
+        gi = p - s.plen
+        return int(s.tokens[gi]) if gi < len(s.tokens) else None
+
+    def _paged_step(self) -> None:
+        if self._dirty:
+            self._rebuild_buffers()
+        model = self.model
+        cap = self._cap
+        prog, logits_n = model.paged_program(self._pool_rows)
+        tok = np.zeros((cap, 1), dtype=np.int64)
+        widx = np.zeros((cap, 1), dtype=np.int64)   # padding -> scratch
+        pos = np.zeros((cap, 1), dtype=np.float32)
+        for i, s in enumerate(self._slots):
+            # replaying a prefix-hit's prompt tail feeds prompt tokens;
+            # past the prompt it is ordinary autoregressive decode
+            t = self._token_at(s, s.pos)
+            tok[i, 0] = s.last_token if t is None else t
+            widx[i, 0] = self._write_row(s, s.pos)
+            pos[i, 0] = float(s.pos)
+        feed = {"tok": tok, "widx": widx, "pos": pos,
+                "arange": self._arange}
+        _t0 = trace.now() if trace.enabled() else 0
+        t0 = time.perf_counter()
+        logits, = model.executor.run(prog, feed=feed,
+                                     fetch_list=[logits_n],
+                                     scope=self._scope, return_numpy=True)
+        self._observe_paged_step(time.perf_counter() - t0)
+        if _t0:
+            trace.complete("decode::step", _t0, cat="serving",
+                           args={"cap": cap, "live": len(self._slots),
+                                 "paged": True})
+        finished = []
+        for i, s in enumerate(self._slots):
+            p = s.pos
+            s.pos += 1
+            # steps below plen - 1 are prompt replay: no emission yet
+            if p >= s.plen - 1 and self._emit(s, logits[i]):
+                finished.append(s)
+        if finished:
+            self._sync_rows()
+            for s in finished:
+                self._retire(s, synced=True)
+
+    # -- one speculative round: draft proposes, one verify launch scores ----
+    def _spec_round(self) -> None:
+        """Draft ``spec_k - 1`` proposals with the cheap model, then run
+        ONE target verify launch (``spec_k`` chained paged steps) and
+        accept the longest prefix of proposals that match the target
+        argmax.  Exactness: every verify block is bit-identical to the
+        plain paged step at its position, a proposal is consumed only
+        AFTER matching, and acceptance is capped at ``spec_k - 1`` so
+        the draft's own KV below the advanced position always holds
+        true tokens.  Rejected verify writes land above the new
+        position and are masked until the next round overwrites them.
+        """
+        if self._dirty:
+            self._rebuild_buffers()
+        model, draft = self.model, self._draft
+        cap, ps, K = self._cap, self.page_size, self.spec_k
+        live = list(self._slots)
+        last_pos = [s.plen + s.req.max_new - 2 for s in live]
+        k_eff = [max(1, min(K, lp - s.pos + 1))
+                 for s, lp in zip(live, last_pos)]
+        kcaps = [min(ke, K - 1) for ke in k_eff]
+        u = np.zeros((cap, K), dtype=np.int64)
+        proposal = [[False] * K for _ in range(cap)]
+        for i, s in enumerate(live):
+            t = self._token_at(s, s.pos)
+            u[i, 0] = s.last_token if t is None else t
+        _t0 = trace.now() if trace.enabled() else 0
+        t0 = time.perf_counter()
+        # draft: K-1 cheap dense steps propose the unknown positions
+        for j in range(1, K):
+            tok = np.zeros((cap, 1), dtype=np.int64)
+            posi = np.zeros((cap, 1), dtype=np.int64)
+            posf = np.zeros((cap, 1), dtype=np.float32)
+            for i, s in enumerate(live):
+                # positions past the budget clamp onto max_len - 1, a
+                # row the mask can never reach (plen + max_new <=
+                # max_len) — a safe garbage dump for the draft
+                p = min(s.pos + j - 1, model.max_len - 1)
+                tok[i, 0] = u[i, j - 1]
+                posi[i, 0] = p
+                posf[i, 0] = float(p)
+            dlogits, = draft.executor.run(
+                draft.decode_program,
+                feed={"tok": tok, "posi": posi, "pos": posf,
+                      "arange": self._arange},
+                fetch_list=[draft.logits_name],
+                scope=self._draft_scope, return_numpy=True)
+            for i, s in enumerate(live):
+                if j >= k_eff[i]:
+                    continue
+                t = self._token_at(s, s.pos + j)
+                if t is None:
+                    u[i, j] = int(np.argmax(dlogits[i]))
+                    if j < kcaps[i]:
+                        proposal[i][j] = True
+                        self._ins.count("spec_proposed")
+                else:
+                    u[i, j] = t     # prompt replay: the token is forced
+        # verify: ONE target launch covering all K positions
+        vprog, logit_names = model.verify_program(self._pool_rows, K)
+        widx = np.zeros((cap, K), dtype=np.int64)
+        pos = np.zeros((cap, 1), dtype=np.float32)
+        for i, s in enumerate(live):
+            pos[i, 0] = float(s.pos)
+            for j in range(k_eff[i]):
+                widx[i, j] = self._write_row(s, s.pos + j)
+        louts = model.executor.run(
+            vprog, feed={"toks": u, "widx": widx, "pos": pos,
+                         "arange": self._arange},
+            fetch_list=logit_names, scope=self._scope, return_numpy=True)
+        self._observe_paged_step(time.perf_counter() - t0)
+        if _t0:
+            trace.complete("decode::spec_round", _t0, cat="serving",
+                           args={"cap": cap, "live": len(live), "k": K})
+        finished = []
+        for i, s in enumerate(live):
+            a = 0
+            fin = False
+            for j in range(kcaps[i]):
+                if proposal[i][j]:
+                    # l_{j-1} is the target's next-token distribution
+                    # after consuming u[j-1]; the proposal survives only
+                    # if it IS the greedy target token
+                    if int(u[i, j]) != int(np.argmax(louts[j - 1][i])):
+                        break
+                    self._ins.count("spec_accepted")
+                a += 1
+                if s.pos + j >= s.plen - 1:
+                    if self._emit(s, louts[j][i]):
+                        fin = True
+                        break
+            s.pos += a
+            if fin:
+                finished.append(s)
+        if finished:
+            self._sync_rows()
+            for s in finished:
+                self._retire(s, synced=True)
+
     # -- warmup / introspection ---------------------------------------------
     def warmup(self, full: bool = False) -> Dict[str, Any]:
         """Precompile the decode-step executable per batch bucket and
         the prefill executables (per prompt bucket; ``full=True`` also
         crosses every prefill bucket with every batch bucket).  Run it
         before serving: under ``FLAGS_persistent_cache_dir`` a restarted
-        decode replica reaches serving with zero cold compiles."""
+        decode replica reaches serving with zero cold compiles.  Paged
+        engines warm the paged/verify programs instead of the dense
+        step (warmup writes land on the scratch page only)."""
         if self._started:
             raise RuntimeError("warmup() must run before the loop starts")
+        import jax.numpy as jnp
         m = trace.metrics()
         miss0 = m.counter("executor.compile_cache_miss").value
         cold0 = m.counter("executor.compile_cache_cold_miss").value
         t0 = time.perf_counter()
         model = self.model
-        saved = (self._scope.find_var(model.k_name),
-                 self._scope.find_var(model.v_name))
-        import jax.numpy as jnp
-        for cap in self.batch_edges:
-            self._scope.set_var(model.k_name, jnp.zeros(
-                (cap, model.max_len, model.d_model), jnp.float32))
-            self._scope.set_var(model.v_name, jnp.zeros(
-                (cap, model.max_len, model.d_model), jnp.float32))
-            feed = {"tok": np.zeros((cap, 1), np.int64),
-                    "posi": np.zeros((cap, 1), np.int64),
-                    "pos": np.ones((cap, 1), np.float32),
-                    "arange": self._arange}
-            model.executor.run(model.decode_program, feed=feed,
-                               fetch_list=[model.logits_name],
-                               scope=self._scope, return_numpy=True)
+        if self.paged:
+            saved = (self._scope.find_var(model.k_pool_name),
+                     self._scope.find_var(model.v_pool_name),
+                     self._scope.find_var(model.pt_name))
+            prog, logits_n = model.paged_program(self._pool_rows)
+            for cap in self.batch_edges:
+                self._scope.set_var(
+                    model.pt_name,
+                    jnp.zeros((cap, model.max_len), jnp.int32))
+                feed = {"tok": np.zeros((cap, 1), np.int64),
+                        "widx": np.zeros((cap, 1), np.int64),
+                        "pos": np.ones((cap, 1), np.float32),
+                        "arange": self._arange}
+                model.executor.run(prog, feed=feed, fetch_list=[logits_n],
+                                   scope=self._scope, return_numpy=True)
+                if self._draft is not None:
+                    vprog, lnames = model.verify_program(self._pool_rows,
+                                                         self.spec_k)
+                    feed = {"toks": np.zeros((cap, self.spec_k), np.int64),
+                            "widx": np.zeros((cap, self.spec_k), np.int64),
+                            "pos": np.ones((cap, 1), np.float32),
+                            "arange": self._arange}
+                    model.executor.run(vprog, feed=feed, fetch_list=lnames,
+                                       scope=self._scope, return_numpy=True)
+                    self._draft_scope.set_var(
+                        self._draft.k_name,
+                        jnp.zeros((cap, model.max_len,
+                                   self._draft.d_model), jnp.float32))
+                    self._draft_scope.set_var(
+                        self._draft.v_name,
+                        jnp.zeros((cap, model.max_len,
+                                   self._draft.d_model), jnp.float32))
+                    dfeed = {"tok": np.zeros((cap, 1), np.int64),
+                             "posi": np.zeros((cap, 1), np.int64),
+                             "pos": np.ones((cap, 1), np.float32),
+                             "arange": self._arange}
+                    self._draft.executor.run(
+                        self._draft.decode_program, feed=dfeed,
+                        fetch_list=[self._draft.logits_name],
+                        scope=self._draft_scope, return_numpy=True)
+        else:
+            saved = (self._scope.find_var(model.k_name),
+                     self._scope.find_var(model.v_name), None)
+            for cap in self.batch_edges:
+                self._scope.set_var(model.k_name, jnp.zeros(
+                    (cap, model.max_len, model.d_model), jnp.float32))
+                self._scope.set_var(model.v_name, jnp.zeros(
+                    (cap, model.max_len, model.d_model), jnp.float32))
+                feed = {"tok": np.zeros((cap, 1), np.int64),
+                        "posi": np.zeros((cap, 1), np.int64),
+                        "pos": np.ones((cap, 1), np.float32),
+                        "arange": self._arange}
+                model.executor.run(model.decode_program, feed=feed,
+                                   fetch_list=[model.logits_name],
+                                   scope=self._scope, return_numpy=True)
         batch_list = list(self.batch_edges) if full else \
             [self.batch_edges[0]]
         for s_p in self.prefill_edges:
@@ -724,13 +1588,15 @@ class DecodeEngine:
                 feed = {"prompt": np.zeros((b, s_p), np.int64),
                         "lastpos": np.zeros((b, 1), np.int64),
                         "plen": np.ones((b, 1), np.float32),
-                        "arange_p": np.arange(s_p, dtype=np.float32)[None]}
+                        "arange_p": self._arange}
                 model.executor.run(prog, feed=feed,
                                    fetch_list=[logits_n, k_n, v_n],
                                    scope=self._scope, return_numpy=False)
-        if saved[0] is not None:
-            self._scope.set_var(model.k_name, saved[0])
-            self._scope.set_var(model.v_name, saved[1])
+        names = ((model.k_pool_name, model.v_pool_name, model.pt_name)
+                 if self.paged else (model.k_name, model.v_name, None))
+        for nm, val in zip(names, saved):
+            if nm is not None and val is not None:
+                self._scope.set_var(nm, val)
         report = {
             "decode_buckets": list(self.batch_edges),
             "prefill_buckets": list(self.prefill_edges),
@@ -753,7 +1619,8 @@ class DecodeEngine:
             "joins": self._ins.counter_value("joins"),
             "leaves": self._ins.counter_value("leaves"),
             "active_slots": len(self._slots),
-            "queue_depth": self._q.qsize(),
+            "peak_active": self._peak_active,
+            "queue_depth": self._q.qsize() + len(self._pending),
             "decode_buckets": list(self.batch_edges),
             "prefill_buckets": list(self.prefill_edges),
         }
@@ -762,6 +1629,26 @@ class DecodeEngine:
             st = self._ins.hist_stats(h)
             out[h] = {k: st[k] for k in
                       ("count", "avg", "p50", "p95", "p99") if k in st}
+        if self.paged:
+            paged = {
+                "page_size": self.page_size,
+                "pool_pages": self._pool.usable_pages,
+                "kv_pages_in_use": self._pool.pages_in_use,
+                "kv_page_pool_free": self._pool.free_pages,
+                "prefix_cache": self._prefix is not None,
+                "prefix_hits": self._ins.counter_value("prefix_hits"),
+                "prefix_evictions":
+                    self._ins.counter_value("prefix_evictions"),
+            }
+            if self._draft is not None:
+                prop = self._ins.counter_value("spec_proposed")
+                acc = self._ins.counter_value("spec_accepted")
+                paged["spec_k"] = self.spec_k
+                paged["spec_proposed"] = prop
+                paged["spec_accepted"] = acc
+                paged["spec_accept_rate"] = (round(acc / prop, 4)
+                                             if prop else None)
+            out["paged"] = paged
         return out
 
 

@@ -50,7 +50,8 @@ from ..fluid.executor import Executor
 
 __all__ = ["ServingEngine", "ServingFuture", "BaseFuture",
            "FamilyInstruments", "ServingError",
-           "QueueFullError", "DeadlineExceededError", "EngineClosedError"]
+           "QueueFullError", "PagePoolExhaustedError",
+           "DeadlineExceededError", "EngineClosedError"]
 
 
 class ServingError(RuntimeError):
@@ -60,6 +61,12 @@ class ServingError(RuntimeError):
 class QueueFullError(ServingError):
     """Admission queue at capacity: the request was rejected at submit —
     backpressure, the open-loop overload answer that is not an OOM."""
+
+
+class PagePoolExhaustedError(QueueFullError):
+    """The decode KV page pool cannot hold the request (serving/decode.py
+    block-paged mode): a typed queue-full rejection at admission — the
+    paged answer to overload is backpressure, never a device OOM."""
 
 
 class DeadlineExceededError(ServingError):

@@ -1091,6 +1091,16 @@ class FleetMetricsAggregator:
         replicas: Dict[str, Any] = {}
         rollup = {"requests": 0, "batches": 0, "rejected": 0,
                   "timeouts": 0}
+        # decode-plane rollup over per-replica stats_payload "decode"
+        # blocks: counters/gauges sum across the fleet, the acceptance
+        # rate recomputes from the summed raw counters (a mean of
+        # per-replica rates would weight an idle replica equally)
+        decode_keys = ("requests", "tokens", "steps", "kv_pages_in_use",
+                       "kv_page_pool_free", "prefix_hits",
+                       "prefix_evictions", "spec_proposed",
+                       "spec_accepted")
+        decode = {k: 0 for k in decode_keys}
+        decode_seen = False
         p99s: List[float] = []
         for r in list(self.fleet.router.replicas):
             st = dict(r.last_stats or {})
@@ -1101,9 +1111,22 @@ class FleetMetricsAggregator:
                     rollup[k] += int(st.get(k) or 0)
                 except (TypeError, ValueError):
                     pass
+            dec = st.get("decode")
+            if isinstance(dec, dict):
+                decode_seen = True
+                for k in decode_keys:
+                    try:
+                        decode[k] += int(dec.get(k) or 0)
+                    except (TypeError, ValueError):
+                        pass
             if st.get("p99_ms") is not None:
                 p99s.append(float(st["p99_ms"]))
         rollup["p99_ms_max"] = max(p99s) if p99s else None
+        if decode_seen:
+            decode["spec_accept_rate"] = (
+                round(decode["spec_accepted"] / decode["spec_proposed"], 4)
+                if decode["spec_proposed"] else None)
+            rollup["decode"] = decode
         return {"fleet": self.fleet.stats(), "replicas": replicas,
                 "rollup": rollup}
 

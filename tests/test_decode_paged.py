@@ -1,0 +1,368 @@
+"""Block-paged KV decode plane (PR 17): page-pool allocator invariants
+(typed exhaustion, refcounted release, prefix-shared survival, eviction
+safety, fragmentation reuse), paged-engine bit-exactness vs sequential
+decode, the batch_occupancy page-occupancy regression, prefix-cache
+hits, speculative decoding token-identity, and the /stats + fleet
+rollup schema for the new decode instruments.
+"""
+import os
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                   # noqa: E402
+import paddle_tpu.fluid as fluid                          # noqa: E402
+from paddle_tpu.serving import decode                     # noqa: E402
+from paddle_tpu.serving.decode import (                   # noqa: E402
+    KVPagePool, PagePoolExhaustedError, PrefixCache)
+from paddle_tpu.serving.engine import QueueFullError      # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def model():
+    return decode.build_demo_decode_model(vocab=19, d_model=8,
+                                          max_len=16, seed=5,
+                                          page_size=4)
+
+
+PROMPTS = [[3, 1, 4], [2, 7], [5, 9, 2, 6, 5], [1], [8, 8, 3, 1],
+           [4, 4]]
+BUDGETS = [5, 7, 4, 6, 3, 5]
+
+
+# ---------------------------------------------------------------------------
+# page allocator
+# ---------------------------------------------------------------------------
+
+class TestKVPagePool:
+    def test_exhaustion_is_typed_not_oom(self):
+        pool = KVPagePool(4, 4)          # page 0 is scratch: 3 usable
+        assert pool.usable_pages == 3
+        got = pool.alloc(3)
+        assert len(got) == 3 and pool.free_pages == 0
+        with pytest.raises(PagePoolExhaustedError):
+            pool.alloc(1)
+        # the typed error is a QueueFullError: serving clients that
+        # already handle backpressure handle pool exhaustion for free
+        assert issubclass(PagePoolExhaustedError, QueueFullError)
+
+    def test_release_returns_pages_and_guards_double_free(self):
+        pool = KVPagePool(4, 4)
+        a, b = pool.alloc(2)
+        pool.release(a)
+        assert pool.free_pages == 2 and pool.pages_in_use == 1
+        with pytest.raises(ValueError):
+            pool.release(a)              # double free is a bug, not a no-op
+        pool.release(b)
+        assert pool.free_pages == pool.usable_pages == 3
+
+    def test_refcount_shared_page_survives_first_release(self):
+        pool = KVPagePool(4, 4)
+        (pg,) = pool.alloc(1)
+        pool.incref(pg)                  # second reader
+        pool.release(pg)                 # first reader retires
+        assert pool.pages_in_use == 1    # still held
+        pool.release(pg)
+        assert pool.pages_in_use == 0
+
+    def test_fragmentation_reuse_after_churn(self):
+        pool = KVPagePool(9, 4)
+        held = pool.alloc(8)
+        # free a non-contiguous subset, then re-alloc: the freed pages
+        # (and only they) come back — no leak, no phantom pages
+        for pg in held[::2]:
+            pool.release(pg)
+        again = pool.alloc(4)
+        assert sorted(again) == sorted(held[::2])
+        with pytest.raises(PagePoolExhaustedError):
+            pool.alloc(1)
+
+
+class TestPrefixCacheEviction:
+    def test_eviction_never_frees_live_reader_pages(self):
+        pool = KVPagePool(6, 4)
+        cache = PrefixCache(pool)
+        pages = pool.alloc(2)
+        prompt = np.asarray([3, 1, 4, 1, 5, 9, 2, 6])     # two full pages
+        cache.register(prompt, pages)    # cache increfs both
+        for pg in pages:
+            pool.release(pg)             # donor retires
+        pool.incref(pages[0])            # a live reader still on page 0
+        freed = cache.evict(10)
+        assert freed == 1                # only the reader-free page went
+        assert pool.refcount(pages[0]) == 2   # cache ref + live reader
+        pool.release(pages[0])           # reader retires: cache ref only
+        assert pool.pages_in_use == 1
+        assert cache.evict(10) == 1      # now evictable
+        assert pool.pages_in_use == 0
+
+    def test_lru_order_and_lookup_touch(self):
+        pool = KVPagePool(8, 2)
+        cache = PrefixCache(pool)
+        a = np.asarray([1, 2, 7])
+        b = np.asarray([5, 6, 7])
+        pa, pb = pool.alloc(1), pool.alloc(1)
+        cache.register(a, pa)
+        cache.register(b, pb)
+        pool.release(pa[0])              # donors retire: cache refs only
+        pool.release(pb[0])
+        cache.lookup(a)                  # touches a: b is now oldest
+        assert cache.evict(1) == 1
+        assert cache.lookup(a) and not cache.lookup(b)
+
+
+# ---------------------------------------------------------------------------
+# paged engine
+# ---------------------------------------------------------------------------
+
+class TestPagedExactness:
+    @pytest.mark.parametrize("cache", [False, True])
+    def test_paged_bit_identical_to_sequential(self, model, cache):
+        """THE paged acceptance property: block-paged decode — prefix
+        cache on or off, joins landing mid-flight — is bit-identical to
+        sequential decode, tokens AND logits."""
+        seq = decode.decode_sequential(model, PROMPTS,
+                                       max_new_tokens=BUDGETS,
+                                       collect_logits=True, max_batch=4)
+        eng = decode.DecodeEngine(model, max_batch=4, collect_logits=True,
+                                  paged=True, prefix_cache=cache)
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=b)
+                    for p, b in zip(PROMPTS[:3], BUDGETS[:3])]
+            time.sleep(0.25)
+            futs += [eng.submit(p, max_new_tokens=b)
+                     for p, b in zip(PROMPTS[3:], BUDGETS[3:])]
+            out = [f.result(timeout=180) for f in futs]
+            st = eng.stats()
+        for i, (a, b) in enumerate(zip(seq, out)):
+            assert np.array_equal(a["tokens"], b["tokens"]), \
+                (i, a["tokens"], b["tokens"])
+            assert np.array_equal(a["logits"], b["logits"]), i
+        if not cache:
+            # O(1) page return on retirement drained the pool; with the
+            # prefix cache on, registered pages intentionally stay warm
+            assert st["paged"]["kv_pages_in_use"] == 0
+
+    def test_submit_too_long_rejected_typed(self, model):
+        # a request that could NEVER fit the pool is rejected at submit
+        # with the typed error — it must not wedge the queue
+        eng = decode.DecodeEngine(model, max_batch=2, paged=True,
+                                  pool_pages=3, name="too_long")
+        with eng:
+            with pytest.raises(PagePoolExhaustedError):
+                eng.submit([5, 9, 2, 6, 5], max_new_tokens=8)
+            assert eng.stats()["rejected"] == 1
+
+    def test_pool_pressure_queues_then_completes(self, model):
+        """More live requests than the pool can seat: the overflow
+        WAITS (occupancy-bounded admission) and completes when pages
+        free — never a device OOM, never a lost request."""
+        seq = decode.decode_sequential(model, PROMPTS,
+                                       max_new_tokens=BUDGETS,
+                                       max_batch=4)
+        eng = decode.DecodeEngine(model, max_batch=4, paged=True,
+                                  pool_pages=7)    # 6 usable: ~2 at a time
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=b)
+                    for p, b in zip(PROMPTS, BUDGETS)]
+            out = [f.result(timeout=180) for f in futs]
+            st = eng.stats()
+        for a, b in zip(seq, out):
+            assert np.array_equal(a["tokens"], b["tokens"])
+        assert st["paged"]["kv_pages_in_use"] == 0
+        assert st["peak_active"] <= 3    # the pool, not max_batch, bound
+
+    def test_batch_occupancy_reports_page_occupancy(self, model):
+        """Regression: under paging ``decode.batch_occupancy`` samples
+        page-pool occupancy, NOT live-slots/max_batch.  One request
+        holding 3 of 5 usable pages must sample 0.6 — the slot formula
+        would claim 0.25 and hide pool pressure entirely."""
+        eng = decode.DecodeEngine(model, name="occ_regress", max_batch=4,
+                                  paged=True, pool_pages=6)
+        with eng:
+            eng.generate([3, 1, 4, 1, 5], max_new_tokens=8, timeout=120)
+            st = eng.stats()
+        occ = st["batch_occupancy"]
+        assert occ["count"] > 0
+        assert occ["avg"] == pytest.approx(3 / 5, abs=1e-9)
+
+    def test_carry_var_must_be_seeded(self, model):
+        """Executor boundary validation (satellite): running a program
+        whose carry_vars are declared-but-never-seeded data vars fails
+        with the actionable error, not a missing-input crash later."""
+        prog, lname = model.paged_program(40)
+        ex = fluid.Executor()
+        feed = {"tok": np.zeros((1, 1), np.int64),
+                "widx": np.zeros((1, 1), np.int64),
+                "pos": np.zeros((1, 1), np.float32),
+                "arange": np.arange(16, dtype=np.float32)[None, :]}
+        with pytest.raises(ValueError, match="carry_vars.*seed"):
+            ex.run(prog, feed=feed, fetch_list=[lname],
+                   scope=fluid.core.Scope())
+
+
+class TestPrefixCacheEngine:
+    def test_shared_prefix_hits_and_stays_exact(self, model):
+        shared = [7, 7, 2, 9]            # one full page
+        prompts = [shared + [3], shared + [5, 1], shared + [3],
+                   shared + [8, 8, 1], shared + [3, 1, 4]]
+        seq = decode.decode_sequential(model, prompts, max_new_tokens=5,
+                                       collect_logits=True, max_batch=4)
+        eng = decode.DecodeEngine(model, name="prefix_hits", max_batch=4,
+                                  collect_logits=True, paged=True,
+                                  prefix_cache=True)
+        with eng:
+            out = [f.result(timeout=180) for f in
+                   [eng.submit(p, max_new_tokens=5) for p in prompts]]
+            st = eng.stats()
+        for a, b in zip(seq, out):
+            assert np.array_equal(a["tokens"], b["tokens"])
+            assert np.array_equal(a["logits"], b["logits"])
+        assert st["paged"]["prefix_hits"] > 0
+        assert st["paged"]["prefix_cache"] is True
+
+    def test_cached_pages_survive_donor_then_serve_hit(self, model):
+        shared = [6, 2, 8, 4]
+        eng = decode.DecodeEngine(model, name="prefix_donor", max_batch=2,
+                                  paged=True, prefix_cache=True)
+        with eng:
+            eng.generate(shared + [1], max_new_tokens=3, timeout=120)
+            st1 = eng.stats()
+            # donor retired, but its prefix pages stay warm in the pool
+            assert st1["paged"]["kv_pages_in_use"] > 0
+            ref = decode.decode_sequential(model, [shared + [2]],
+                                           max_new_tokens=4)[0]
+            out = eng.generate(shared + [2], max_new_tokens=4,
+                               timeout=120)
+            st2 = eng.stats()
+        assert np.array_equal(ref["tokens"], out["tokens"])
+        assert st2["paged"]["prefix_hits"] >= 1
+
+    def test_eviction_under_pool_pressure(self, model):
+        """Warm pages are sacrificed (LRU) when a new request needs the
+        pool — counted, and the engine stays exact.  Prefixes are all
+        DISTINCT so warm pages pile up without being re-shared and the
+        pool must evict to seat late arrivals."""
+        prompts = [[i, i + 1, i + 2, i + 3, 1] for i in range(1, 7)]
+        seq = decode.decode_sequential(model, prompts, max_new_tokens=4,
+                                       max_batch=2)
+        eng = decode.DecodeEngine(model, name="prefix_evict", max_batch=2,
+                                  paged=True, prefix_cache=True,
+                                  pool_pages=6)
+        with eng:
+            out = [f.result(timeout=180) for f in
+                   [eng.submit(p, max_new_tokens=4) for p in prompts]]
+            st = eng.stats()
+        for a, b in zip(seq, out):
+            assert np.array_equal(a["tokens"], b["tokens"])
+        assert st["paged"]["prefix_evictions"] > 0
+
+
+class TestSpeculative:
+    def test_greedy_spec_token_identical(self, model):
+        """THE speculative gate: greedy speculative decode emits the
+        token-identical stream to plain decode — join/leave churn and
+        all — because verify logits are bitwise the plain step's."""
+        draft = decode.build_demo_decode_model(vocab=19, d_model=4,
+                                               max_len=16, seed=11,
+                                               page_size=4)
+        seq = decode.decode_sequential(model, PROMPTS,
+                                       max_new_tokens=BUDGETS,
+                                       max_batch=4)
+        eng = decode.DecodeEngine(model, name="spec_gate", max_batch=4,
+                                  paged=True, draft_model=draft,
+                                  spec_k=4)
+        with eng:
+            futs = [eng.submit(p, max_new_tokens=b)
+                    for p, b in zip(PROMPTS[:3], BUDGETS[:3])]
+            time.sleep(0.25)
+            futs += [eng.submit(p, max_new_tokens=b)
+                     for p, b in zip(PROMPTS[3:], BUDGETS[3:])]
+            out = [f.result(timeout=180) for f in futs]
+            st = eng.stats()
+        for i, (a, b) in enumerate(zip(seq, out)):
+            assert np.array_equal(a["tokens"], b["tokens"]), \
+                (i, a["tokens"], b["tokens"])
+        sp = st["paged"]
+        assert sp["spec_proposed"] > 0
+        assert 0 <= sp["spec_accepted"] <= sp["spec_proposed"]
+        assert sp["spec_accept_rate"] == pytest.approx(
+            sp["spec_accepted"] / sp["spec_proposed"], abs=1e-4)
+
+    def test_self_draft_accepts_everything(self, model):
+        """Drafting with the target itself proposes the target's own
+        argmax — every proposal must be accepted (the acceptance rule
+        is exact comparison, so this is a sharp self-consistency
+        check), and output stays identical."""
+        seq = decode.decode_sequential(model, PROMPTS[:3],
+                                       max_new_tokens=6, max_batch=4)
+        eng = decode.DecodeEngine(model, name="spec_self", max_batch=4,
+                                  paged=True, draft_model=model,
+                                  spec_k=3)
+        with eng:
+            out = [f.result(timeout=180) for f in
+                   [eng.submit(p, max_new_tokens=6) for p in PROMPTS[:3]]]
+            st = eng.stats()
+        for a, b in zip(seq, out):
+            assert np.array_equal(a["tokens"], b["tokens"])
+        sp = st["paged"]
+        assert sp["spec_proposed"] > 0
+        assert sp["spec_accepted"] == sp["spec_proposed"]
+
+
+# ---------------------------------------------------------------------------
+# observability schema
+# ---------------------------------------------------------------------------
+
+class TestDecodeObservability:
+    def test_stats_payload_decode_block(self, model):
+        from paddle_tpu.fluid import metrics_export
+        eng = decode.DecodeEngine(model, max_batch=2, paged=True,
+                                  prefix_cache=True)
+        with eng:
+            eng.generate([2, 7], max_new_tokens=3, timeout=120)
+        payload = metrics_export.stats_payload()
+        dec = payload["decode"]
+        for k in ("kv_pages_in_use", "kv_page_pool_free", "prefix_hits",
+                  "prefix_evictions", "spec_proposed", "spec_accepted"):
+            assert k in dec, k
+
+    def test_fleet_rollup_sums_decode_blocks(self):
+        from paddle_tpu.serving.fleet import FleetMetricsAggregator
+
+        def replica(name, dec):
+            return SimpleNamespace(name=name, state="up",
+                                   last_stats={"requests": 1,
+                                               "decode": dec})
+
+        fleet = SimpleNamespace(
+            router=SimpleNamespace(replicas=[
+                replica("r0", {"requests": 2, "tokens": 10, "steps": 5,
+                               "kv_pages_in_use": 3,
+                               "kv_page_pool_free": 5, "prefix_hits": 4,
+                               "prefix_evictions": 1,
+                               "spec_proposed": 8, "spec_accepted": 6}),
+                replica("r1", {"requests": 1, "tokens": 5, "steps": 3,
+                               "kv_pages_in_use": 1,
+                               "kv_page_pool_free": 7, "prefix_hits": 0,
+                               "prefix_evictions": 0,
+                               "spec_proposed": 2, "spec_accepted": 1}),
+            ]),
+            stats=lambda: {})
+        agg = FleetMetricsAggregator.__new__(FleetMetricsAggregator)
+        agg.fleet = fleet
+        roll = agg.fleet_stats()["rollup"]["decode"]
+        assert roll["tokens"] == 15 and roll["prefix_hits"] == 4
+        assert roll["kv_pages_in_use"] == 4
+        assert roll["spec_proposed"] == 10 and roll["spec_accepted"] == 7
+        assert roll["spec_accept_rate"] == pytest.approx(0.7)

@@ -429,8 +429,8 @@ class TestKernelTierUmbrella:
         bs = _tier_bs(kernel_tier=True)
         from paddle_tpu.fluid.passes import passes_for_build_strategy
         names = [p.name for p in passes_for_build_strategy(bs)]
-        assert names == ["fuse_attention", "fuse_sparse_embedding",
-                         "fuse_optimizer"]
+        assert names == ["fuse_attention", "fuse_paged_attention",
+                         "fuse_sparse_embedding", "fuse_optimizer"]
 
     def test_canonical_order_with_amp(self):
         bs = _tier_bs(kernel_tier=True, amp=True, enable_dce=True,
@@ -546,3 +546,142 @@ class TestSatellites:
             functools.partial(pk.fused_momentum_tpu, mu=0.9,
                               use_nesterov=True, l2_decay=1e-4),
             p, p, p, jnp.asarray(0.1))
+
+
+# ---------------------------------------------------------------------------
+# fuse_paged_attention
+# ---------------------------------------------------------------------------
+
+def _paged_chain_program(mask_bias_ok=True):
+    """Hand-built copy of the paged decode attend chain
+    (serving/decode.py build_paged): gather×2 → reshape×2 →
+    mul+reduce_sum → scale → exact-zero mask → softmax →
+    mul+reduce_sum."""
+    m, s = fluid.Program(), fluid.Program()
+    with fluid.program_guard(m, s):
+        q = fluid.data("q", [-1, 8])
+        kp = fluid.data("kp", [40, 8])
+        vp = fluid.data("vp", [40, 8])
+        pt = fluid.data("pt", [-1, 16], dtype="int32")
+        valid = fluid.data("valid", [-1, 16])
+        pti = L.reshape(pt, [-1])
+        kg = L.reshape(L.gather(kp, pti), [-1, 16, 8])
+        vg = L.reshape(L.gather(vp, pti), [-1, 16, 8])
+        sc = L.reduce_sum(kg * L.unsqueeze(q, [1]), dim=[2])
+        sc = L.scale(sc, scale=0.25)
+        bias = -1e30 if mask_bias_ok else 0.0
+        sc = sc * valid + L.scale(valid, scale=1e30, bias=bias)
+        p = L.softmax(sc)
+        out = L.reduce_sum(vg * L.unsqueeze(p, [2]), dim=[1])
+    return m, out
+
+
+class TestFusePagedAttention:
+    def _run(self, prog, out_name, feed):
+        ex = fluid.Executor()
+        with scope_guard(Scope()):
+            return np.asarray(
+                ex.run(prog, feed=feed, fetch_list=[out_name])[0])
+
+    def _feed(self, rng, b=3):
+        pt = np.zeros((b, 16), np.int32)
+        for i in range(b):
+            pt[i] = np.arange(16) % 40
+        valid = np.zeros((b, 16), np.float32)
+        valid[:, :5] = 1.0
+        return {"q": rng.randn(b, 8).astype("float32"),
+                "kp": rng.randn(40, 8).astype("float32"),
+                "vp": rng.randn(40, 8).astype("float32"),
+                "pt": pt, "valid": valid}
+
+    def test_rewrite_counts_and_bit_parity(self):
+        """The chain rewrites to ONE paged_attention op and the fused
+        CPU fallback is bit-identical to the unfused chain — the
+        rewrite must be invisible to the decode exactness gate."""
+        rng = np.random.RandomState(3)
+        feed = self._feed(rng)
+        prog, out = _paged_chain_program()
+        ref = self._run(prog, out.name, feed)
+        r0 = _counter("kernel_tier.fuse_paged_attention.rewrites")
+        from paddle_tpu.fluid.passes import PassPipeline, create_pass
+        stats = PassPipeline([create_pass("fuse_paged_attention")]).apply(
+            prog, targets=[out.name])
+        assert _counter(
+            "kernel_tier.fuse_paged_attention.rewrites") - r0 == 1
+        types = _op_types(prog)
+        assert types.count("paged_attention") == 1
+        assert "softmax" not in types and "gather" not in types
+        fused = self._run(prog, out.name, feed)
+        assert np.array_equal(ref, fused)
+
+    def test_build_strategy_knob(self):
+        """fuse_paged_attention=False leaves the chain alone; the knob
+        (and the kernel_tier umbrella) selects the pass."""
+        from paddle_tpu.fluid.passes.builtin import \
+            passes_for_build_strategy
+        names = [p.name for p in passes_for_build_strategy(
+            _tier_bs(fuse_paged_attention=True))]
+        assert "fuse_paged_attention" in names
+        names_tier = [p.name for p in passes_for_build_strategy(
+            _tier_bs(kernel_tier=True))]
+        assert "fuse_paged_attention" in names_tier
+        names_off = [p.name for p in passes_for_build_strategy(
+            _tier_bs())]
+        assert "fuse_paged_attention" not in names_off
+
+    def test_negative_wrong_mask_bias(self):
+        """A mask add whose bias is NOT -scale is not the exact-zero
+        decode spelling — the pattern must not fire."""
+        prog, out = _paged_chain_program(mask_bias_ok=False)
+        from paddle_tpu.fluid.passes import PassPipeline, create_pass
+        PassPipeline([create_pass("fuse_paged_attention")]).apply(
+            prog, targets=[out.name])
+        assert "paged_attention" not in _op_types(prog)
+        assert "softmax" in _op_types(prog)
+
+    def test_negative_protected_intermediate(self):
+        """A fetched (protected) probability tensor pins the chain: the
+        rewrite would delete the fetch target, so it must decline."""
+        prog, out = _paged_chain_program()
+        sm_out = next(op.outputs["Out"][0]
+                      for op in prog.global_block().ops
+                      if op.type == "softmax")
+        from paddle_tpu.fluid.passes import PassPipeline, create_pass
+        PassPipeline([create_pass("fuse_paged_attention")]).apply(
+            prog, targets=[out.name, sm_out])
+        assert "paged_attention" not in _op_types(prog)
+
+    def test_demo_decode_programs_fuse(self):
+        """The real serving/decode.py paged + verify programs rewrite
+        (one fused op per unrolled step) and carry the page size from
+        the program hint."""
+        from paddle_tpu.fluid.passes import PassPipeline, create_pass
+        from paddle_tpu.serving import decode as dec
+        model = dec.build_demo_decode_model(vocab=13, d_model=8,
+                                            max_len=16, seed=2,
+                                            page_size=4)
+        prog, _ = model.paged_program(40)
+        vprog, _ = model.verify_program(40, 3)
+        pipe = PassPipeline([create_pass("fuse_paged_attention")])
+        pipe.apply(prog, targets=list(prog._hints["fetch_names"]))
+        pipe.apply(vprog, targets=list(vprog._hints["fetch_names"]))
+        assert _op_types(prog).count("paged_attention") == 1
+        assert _op_types(vprog).count("paged_attention") == 3
+        pa = next(op for op in prog.global_block().ops
+                  if op.type == "paged_attention")
+        assert pa.attrs["page_size"] == 4
+        assert pa.attrs["neg"] == pytest.approx(1e30)
+
+    def test_paged_kernel_mosaic_preflight(self):
+        """The paged flash kernel passes the Mosaic lowering pre-flight
+        offline (lane-aligned head dim, SMEM page table)."""
+        import functools
+        from paddle_tpu.ops import pallas_kernels as pk
+        from paddle_tpu.ops.pallas_preflight import assert_mosaic_lowerable
+        q = jnp.zeros((4, 128), jnp.float32)
+        pool = jnp.zeros((64, 128), jnp.float32)
+        idx = jnp.zeros((4, 16), jnp.int32)
+        lengths = jnp.ones((4, 1), jnp.int32)
+        assert_mosaic_lowerable(
+            functools.partial(pk.paged_flash_attention_tpu, scale=0.25,
+                              page_size=4), q, pool, pool, idx, lengths)

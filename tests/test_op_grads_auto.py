@@ -353,6 +353,14 @@ def build_specs():
             inputs={"Q": _sym(2, 2, 4, 3), "K": _sym(2, 2, 4, 3),
                     "V": _sym(2, 2, 4, 3)},
             grad_slots=["Q", "K", "V"], attrs={"scale": 0.5}),
+        "paged_attention": dict(
+            inputs={"Q": _sym(2, 3), "KPool": _sym(9, 3),
+                    "VPool": _sym(9, 3),
+                    "Index": np.array([[1, 2, 3, 4], [5, 6, 7, 8]],
+                                      np.int32),
+                    "Valid": np.ones((2, 4), np.float32)},
+            grad_slots=["Q", "KPool", "VPool"],
+            attrs={"scale": 0.5, "page_size": 4}),
         "multihead_matmul": dict(
             inputs={"Input": _sym(2, 4, 3 * 3 * 8),
                     "BiasQK": _sym(2, 3, 4, 4)},

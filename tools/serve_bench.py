@@ -230,6 +230,141 @@ def serve_bench(qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
     return report
 
 
+def decode_workload(n_requests, shared_prefix_ratio, vocab, page_size,
+                    seed=0):
+    """Prompt mix for the decode leg: a ``shared_prefix_ratio`` fraction
+    of requests shares one page-aligned warm prefix (two full pages plus
+    a unique tail token — a full prefix-cache hit), the rest are unique
+    prompts of mixed length."""
+    rng = np.random.RandomState(seed)
+    shared = [int(t) for t in rng.randint(1, vocab, size=2 * page_size)]
+    prompts = []
+    for _ in range(n_requests):
+        if rng.rand() < shared_prefix_ratio:
+            prompts.append(shared + [int(rng.randint(1, vocab))])
+        else:
+            n = int(rng.randint(2, 2 * page_size + 2))
+            prompts.append([int(t) for t in rng.randint(1, vocab, size=n)])
+    return prompts
+
+
+def _decode_leg(model, prompts, max_new, qps, name, draft=None, **eng_kw):
+    """Run one engine configuration over the open-loop decode workload;
+    returns the per-leg report row."""
+    import jax
+
+    from paddle_tpu.serving import decode as dec
+
+    eng = dec.DecodeEngine(model, name=name, draft_model=draft, **eng_kw)
+    rng = np.random.RandomState(7)
+    sched = np.cumsum(rng.exponential(1.0 / max(qps, 1e-9),
+                                      size=len(prompts)))
+    futs, rejected, tokens, failed = [], 0, 0, 0
+    try:
+        eng.warmup()
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            lag = sched[i] - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                futs.append(eng.submit(p, max_new_tokens=max_new))
+            except Exception:       # noqa: BLE001 — pool/queue rejections
+                rejected += 1
+        for f in futs:
+            try:
+                tokens += len(f.result(timeout=180)["tokens"])
+            except Exception:       # noqa: BLE001 — timeouts count
+                failed += 1
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+    finally:
+        eng.close()
+    ttft = st.get("ttft_seconds", {})
+    row = {
+        "requests": len(prompts),
+        "completed": len(futs) - failed,
+        "rejected_at_submit": rejected,
+        "tokens": tokens,
+        "tokens_per_sec_per_chip": round(
+            tokens / wall / max(jax.device_count(), 1), 1)
+            if wall > 0 else 0.0,
+        "ttft_ms": {"p50": round(ttft.get("p50", 0) * 1e3, 3),
+                    "p99": round(ttft.get("p99", 0) * 1e3, 3)},
+        "peak_concurrent_sessions": st.get("peak_active", 0),
+    }
+    paged = st.get("paged")
+    if paged:
+        row["kv"] = {k: paged.get(k) for k in
+                     ("page_size", "pool_pages", "prefix_hits",
+                      "prefix_evictions")}
+        if "spec_accept_rate" in paged:
+            row["spec_proposed"] = paged["spec_proposed"]
+            row["spec_accepted"] = paged["spec_accepted"]
+            row["spec_accept_rate"] = paged["spec_accept_rate"]
+    return row
+
+
+def decode_bench(shared_prefix_ratio=0.6, n_requests=32, qps=100.0,
+                 max_new=6, page_size=4, max_len=32, d_model=16,
+                 vocab=29, dense_batch=3, spec=False, seed=0):
+    """The --decode leg: the same open-loop workload against (a) the
+    dense per-slot KV engine, (b) the block-paged engine with the SAME
+    device KV-row budget (dense_batch·max_len rows), (c) paged + prefix
+    cache, and optionally (d) paged + prefix + speculative.  The two
+    acceptance wins ride the report: the paged pool sustains more
+    concurrent sessions than dense at equal memory (occupancy-bounded
+    vs max_len-bounded), and the warm prefix cache cuts TTFT p50 on a
+    shared-prefix workload."""
+    from paddle_tpu.serving import decode as dec
+
+    m = dec.build_demo_decode_model(vocab=vocab, d_model=d_model,
+                                    max_len=max_len, seed=seed,
+                                    page_size=page_size)
+    prompts = decode_workload(n_requests, shared_prefix_ratio, vocab,
+                              page_size, seed=seed)
+    # equal device memory: dense carries dense_batch*max_len KV rows;
+    # the paged pool gets exactly the same row budget (scratch included)
+    pool_pages = dense_batch * max_len // page_size
+    paged_kw = dict(paged=True, page_size=page_size,
+                    pool_pages=pool_pages,
+                    max_batch=min(16, pool_pages), queue_depth=256)
+    legs = {
+        "dense": _decode_leg(m, prompts, max_new, qps, "bench_dense",
+                             max_batch=dense_batch, queue_depth=256),
+        "paged_nocache": _decode_leg(m, prompts, max_new, qps,
+                                     "bench_paged", **paged_kw),
+        "paged_cache": _decode_leg(m, prompts, max_new, qps,
+                                   "bench_cache", prefix_cache=True,
+                                   **paged_kw),
+    }
+    if spec:
+        draft = dec.build_demo_decode_model(
+            vocab=vocab, d_model=max(4, d_model // 2), max_len=max_len,
+            seed=seed + 1, page_size=page_size)
+        legs["paged_spec"] = _decode_leg(
+            m, prompts, max_new, qps, "bench_spec", draft=draft,
+            prefix_cache=True, **paged_kw)
+    return {
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": legs["paged_cache"]["tokens_per_sec_per_chip"],
+        "unit": "tok/s/chip",
+        "legs": legs,
+        "prefix_ttft_win": legs["paged_cache"]["ttft_ms"]["p50"]
+            < legs["paged_nocache"]["ttft_ms"]["p50"],
+        "paged_concurrency_win":
+            legs["paged_nocache"]["peak_concurrent_sessions"]
+            > legs["dense"]["peak_concurrent_sessions"],
+        "config": {"shared_prefix_ratio": shared_prefix_ratio,
+                   "requests": n_requests, "qps": qps,
+                   "max_new": max_new, "page_size": page_size,
+                   "max_len": max_len, "d_model": d_model,
+                   "vocab": vocab, "dense_batch": dense_batch,
+                   "kv_rows_budget": dense_batch * max_len,
+                   "speculative": bool(spec)},
+    }
+
+
 def chaos_schedule(seed: int, duration_s: float):
     """Derive the --chaos fault schedule from one seed: a randomized
     mix of every fault kind, placed deterministically (same seed ⇒ same
@@ -473,6 +608,23 @@ def main(argv=None):
                          "the RPC plane (same seed = same schedule); "
                          "reports loss, detected corruptions, and "
                          "breaker transitions")
+    ap.add_argument("--decode", action="store_true",
+                    help="decode mode: open-loop autoregressive decode "
+                         "traffic against dense vs block-paged KV vs "
+                         "paged+prefix-cache engines at equal device "
+                         "memory; reports TTFT p50/p99, tokens/sec/chip "
+                         "and the concurrency/TTFT win booleans")
+    ap.add_argument("--shared-prefix-ratio", type=float, default=0.6,
+                    metavar="R", help="decode mode: fraction of requests "
+                    "sharing one page-aligned warm prompt prefix")
+    ap.add_argument("--spec", action="store_true",
+                    help="decode mode: add a speculative-decoding leg "
+                         "(half-width draft model) and report "
+                         "spec_accept_rate")
+    ap.add_argument("--page-size", type=int, default=4,
+                    help="decode mode: KV page size in tokens")
+    ap.add_argument("--max-new", type=int, default=6,
+                    help="decode mode: tokens to generate per request")
     ap.add_argument("--policy", default="least_queue",
                     choices=("least_queue", "round_robin"))
     ap.add_argument("--cache-dir", default=None,
@@ -491,7 +643,15 @@ def main(argv=None):
     sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
     if args.chaos is not None and not args.fleet:
         args.fleet = 2                  # chaos is a fleet drill
-    if args.fleet:
+    if args.decode:
+        # decode rounds are token-budgeted, not request-budgeted: the
+        # open-loop default of 400 requests would run for minutes on CPU
+        n_dec = n if (args.seconds or args.requests != 400) else 32
+        report = decode_bench(
+            shared_prefix_ratio=args.shared_prefix_ratio,
+            n_requests=n_dec, qps=args.qps, max_new=args.max_new,
+            page_size=args.page_size, spec=args.spec)
+    elif args.fleet:
         report = fleet_bench(
             n_replicas=args.fleet, qps=args.qps, n_requests=n,
             sizes=sizes, kill_at=args.kill_replica_at,
