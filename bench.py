@@ -910,6 +910,7 @@ def supervise():
                           "examples/sec/chip"),
             "sharding": ("sharded_dp_train_throughput",
                          "examples/sec/chip"),
+            "ps": ("ps_sharded_train_throughput", "steps/sec"),
         }
         metric, unit = "bert_base_pretrain_throughput", "tokens/sec/chip"
         for key, (m, u) in names.items():
@@ -1030,6 +1031,104 @@ def main_serve():
     print(json.dumps(out))
 
 
+def main_ps():
+    """Parameter-server row: sharded-embedding pull/push latency plus
+    trainer steps/s with the async working-set prefetcher on vs off (the
+    PR-18 scale tier).  Host-side only — the shard servers are real
+    subprocesses with WAL + snapshot persistence, so the numbers include
+    the RPC/dedup/durability tax a trainer actually pays.  The headline
+    value is prefetch-on steps/s; the extras carry the off leg and the
+    ``ps.pull_wait_seconds`` totals that show the prefetcher hiding the
+    multi-shard pull behind (simulated) device compute."""
+    import shutil
+    import tempfile
+    from paddle_tpu.distributed.ps.sharded import ShardedSparseTable
+    from paddle_tpu.fluid import trace as _tr
+
+    quick = "--quick" in sys.argv or backend_name() == "cpu"
+    n_shards = 4
+    dim = 16
+    vocab = 200_000 if quick else 2_000_000
+    batch = 256 if quick else 2048
+    lat_ops = 30 if quick else 150
+    steps = 20 if quick else 80
+    compute_s = 0.01            # simulated device step the prefetch hides
+    rng = np.random.default_rng(0)
+    m = _tr.metrics()
+
+    def batch_ids():
+        # zipfish working set: 80% of ids from a hot 1/16 slice
+        hot = rng.integers(0, vocab // 16, size=batch)
+        cold = rng.integers(0, vocab, size=batch)
+        return np.unique(np.where(rng.random(batch) < 0.8,
+                                  hot, cold)).astype(np.int64)
+
+    state = tempfile.mkdtemp(prefix="ps-bench-")
+    tbl = ShardedSparseTable("bench_emb", dim=dim, n_shards=n_shards,
+                             optimizer="sgd", lr=0.05, state_dir=state,
+                             staleness=0, supervise=False)
+    try:
+        # -- per-op latency: synchronous pull / push+flush ---------------
+        pull_ts, push_ts = [], []
+        for _ in range(lat_ops):
+            ids = batch_ids()
+            t0 = time.perf_counter()
+            tbl.pull(ids)
+            pull_ts.append(time.perf_counter() - t0)
+            g = np.full((len(ids), dim), 1e-3, np.float32)
+            t0 = time.perf_counter()
+            tbl.push(ids, g)
+            tbl.flush()
+            push_ts.append(time.perf_counter() - t0)
+
+        def pct(ts, q):
+            return round(float(np.percentile(np.asarray(ts) * 1e3, q)), 3)
+
+        def train_leg(prefetch):
+            # uniform feed: consecutive batches rarely share ids, so the
+            # bit-parity patch path (re-pull of ids pushed after the
+            # prefetch was issued) stays the exception, as it is at real
+            # terabyte-table vocab sizes
+            feed = [np.unique(rng.integers(0, vocab, size=batch))
+                    .astype(np.int64) for _ in range(steps)]
+            wait0 = m.histogram("ps.pull_wait_seconds").total
+            it = tbl.prefetching(iter(feed), extract=lambda b: b) \
+                if prefetch else iter(feed)
+            t0 = time.perf_counter()
+            for ids in it:
+                rows = tbl.pull(ids)
+                time.sleep(compute_s)               # "device" step
+                tbl.push(ids, rows * 1e-4)
+            tbl.flush()
+            dt = time.perf_counter() - t0
+            wait = m.histogram("ps.pull_wait_seconds").total - wait0
+            return steps / dt, wait
+
+        off_sps, off_wait = train_leg(prefetch=False)
+        on_sps, on_wait = train_leg(prefetch=True)
+        hits = m.counter("ps.prefetch_hits").value
+        misses = m.counter("ps.prefetch_misses").value
+        hit_rate = hits / (hits + misses) if hits + misses else 0.0
+        out = {
+            "metric": "ps_sharded_train_throughput",
+            "value": round(on_sps, 1), "unit": "steps/sec",
+            "vs_baseline": 0.0, "backend": backend_name(), "mfu": 0.0,
+            "n_shards": n_shards, "batch_ids": batch, "dim": dim,
+            "pull_p50_ms": pct(pull_ts, 50), "pull_p99_ms": pct(pull_ts, 99),
+            "push_p50_ms": pct(push_ts, 50), "push_p99_ms": pct(push_ts, 99),
+            "steps_per_sec_prefetch_on": round(on_sps, 1),
+            "steps_per_sec_prefetch_off": round(off_sps, 1),
+            "pull_wait_s_prefetch_on": round(on_wait, 4),
+            "pull_wait_s_prefetch_off": round(off_wait, 4),
+            "prefetch_hit_rate": round(hit_rate, 3),
+            "prefetch_patched": m.counter("ps.prefetch_patched").value,
+        }
+        print(json.dumps(out))
+    finally:
+        tbl.close()
+        shutil.rmtree(state, ignore_errors=True)
+
+
 def main():
     import os
     import jax
@@ -1143,6 +1242,8 @@ if __name__ == "__main__":
             main_serve()
         elif "--model" in sys.argv and "sharding" in sys.argv:
             main_sharding()
+        elif "--model" in sys.argv and "ps" in sys.argv:
+            main_ps()
         else:
             main()
     else:

@@ -9,4 +9,12 @@ boundary (SURVEY §7 step 8).
 from .the_one_ps import TheOnePSRuntime
 from . import table
 
-__all__ = ["TheOnePSRuntime", "table"]
+__all__ = ["TheOnePSRuntime", "table", "sharded"]
+
+
+def __getattr__(name):
+    # lazy: sharded pulls in rpc/serving machinery most callers never use
+    if name == "sharded":
+        from . import sharded
+        return sharded
+    raise AttributeError(name)

@@ -62,6 +62,23 @@ class BoxPSWrapper:
         self._wb_future: Optional[Future] = None      # in-flight writeback
         self._last_trained = None                     # (ids, vals) of it
 
+    @classmethod
+    def sharded(cls, dim: int, n_shards: int = 4, name: str = "box_host",
+                **kw) -> "BoxPSWrapper":
+        """Host store backed by the sharded PS tier instead of one
+        in-process table: the pass working set pulls fan out over the
+        shard processes (tiered RAM/disk per shard, WAL + snapshots),
+        so the total table size is bounded by the fleet's disks, not
+        this process's RAM.  `**kw` passes through to
+        :class:`~.sharded.ShardedSparseTable` (state_dir, hot_rows,
+        endpoints for attach mode, ...)."""
+        from .sharded import ShardedSparseTable
+        # training happens on-device in the cache; the store only holds
+        # values (same contract as the in-process table: sgd, lr 0)
+        table = ShardedSparseTable(name, dim=dim, n_shards=n_shards,
+                                   optimizer="sgd", lr=0.0, **kw)
+        return cls(dim, table=table)
+
     # -- pass lifecycle -----------------------------------------------------
     def begin_pass(self, ids) -> np.ndarray:
         """Stage the pass working set; returns the [C, dim] cache value

@@ -263,6 +263,7 @@ def end_server_trace(scope, reply):
 _IDEMPOTENT_OPS = frozenset((
     "ping", "pull_sparse", "pull_dense", "create_sparse", "create_dense",
     "set_dense", "save", "size", "heartbeat", "stop", "shrink",
+    "snapshot", "restore", "ps_stats", "set_rows",
 ))
 _DEDUP_OPS = frozenset((
     "push_sparse", "push_dense", "push_sparse_delta", "push_dense_delta",
@@ -411,24 +412,56 @@ class PsServer:
         if op == "create_sparse":
             name = header["table"]
             if name not in self.sparse:
-                # seed initializer per (table, shard) so shards don't
-                # duplicate row values but runs stay reproducible
-                init = Initializer(header.get("init_kind", "uniform"),
-                                   header.get("init_scale", 0.07),
-                                   seed=header.get("seed", 0) * 131
-                                   + self.shard_idx)
+                kind = header.get("init_kind", "uniform")
+                if kind == "id_hash":
+                    # id-deterministic rows: the SAME seed on every shard
+                    # — row(id) must not depend on which shard owns it,
+                    # or re-sharding/layout changes alter the model
+                    from .table import IdHashInitializer
+                    init = IdHashInitializer(
+                        scale=header.get("init_scale", 0.07),
+                        seed=header.get("seed", 0))
+                else:
+                    # seed initializer per (table, shard) so shards don't
+                    # duplicate row values but runs stay reproducible
+                    init = Initializer(kind,
+                                       header.get("init_scale", 0.07),
+                                       seed=header.get("seed", 0) * 131
+                                       + self.shard_idx)
                 acc = header.get("accessor")
                 if acc is not None:        # CTR accessor table (ps.proto)
                     from .table import CtrAccessorConfig, CtrSparseTable
-                    self.sparse[name] = CtrSparseTable(
+                    table = CtrSparseTable(
                         CtrAccessorConfig.from_dict(acc),
                         header.get("optimizer", "sgd"),
                         header.get("lr", 0.01), initializer=init)
                 else:
-                    self.sparse[name] = CommonSparseTable(
+                    table = CommonSparseTable(
                         header["dim"], header.get("optimizer", "sgd"),
                         header.get("lr", 0.01), initializer=init)
+                hot_rows = int(header.get("hot_rows") or 0)
+                if hot_rows > 0:
+                    # bounded hot tier fronting an mmap'd cold tier
+                    import os as _os
+                    import tempfile as _tf
+                    from .table import TieredSparseTable
+                    cold = (header.get("cold_dir")
+                            or _tf.mkdtemp(prefix=f"ps-cold-{name}-"))
+                    table = TieredSparseTable(
+                        table, hot_rows=hot_rows,
+                        cold_dir=_os.path.join(
+                            str(cold), f"shard{self.shard_idx}"))
+                self.sparse[name] = table
             return {"ok": True}, []
+        if op == "ps_stats":
+            tables = {}
+            for name, t in self.sparse.items():
+                info = {"size": int(t.size())}
+                if hasattr(t, "tier_stats"):
+                    info.update(t.tier_stats())
+                tables[name] = info
+            return {"ok": True, "shard": self.shard_idx,
+                    "tables": tables}, []
         if op == "create_dense":
             name = header["table"]
             if name not in self.dense:
@@ -460,6 +493,11 @@ class PsServer:
             return {"ok": True}, []
         if op == "push_sparse_delta":
             self.sparse[header["table"]].push_delta(arrays[0], arrays[1])
+            return {"ok": True}, []
+        if op == "set_rows":
+            # BoxPS EndPass writeback: install exact row values (bit-exact,
+            # unlike emulating with push_delta whose old+(new-old) rounds)
+            self.sparse[header["table"]].set_rows(arrays[0], arrays[1])
             return {"ok": True}, []
         if op == "pull_dense":
             return {"ok": True}, [self.dense[header["table"]].pull()]
@@ -667,7 +705,12 @@ class PsClient:
 
     def __init__(self, endpoints: Sequence[str], timeout=60.0,
                  retries: Optional[int] = None,
-                 backoff_ms: Optional[float] = None):
+                 backoff_ms: Optional[float] = None,
+                 partitioner=None):
+        # partitioner: optional callable(ids int64 array) -> shard index
+        # array; None keeps the classic `id % n_servers` layout.  The
+        # consistent-hash ring (sharded.HashRing.owners) plugs in here.
+        self.partitioner = partitioner
         self.endpoints = list(endpoints)
         self._socks: List[Optional[socket.socket]] = [None] * len(endpoints)
         self._locks = [threading.Lock() for _ in endpoints]
@@ -843,12 +886,13 @@ class PsClient:
     # -- table management ---------------------------------------------------
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
                             seed=0, init_kind="uniform", init_scale=0.07,
-                            accessor=None):
+                            accessor=None, hot_rows=0, cold_dir=None):
         self._sparse_dims[name] = dim
         self._call_all({"op": "create_sparse", "table": name, "dim": dim,
                         "optimizer": optimizer, "lr": lr, "seed": seed,
                         "init_kind": init_kind, "init_scale": init_scale,
-                        "accessor": accessor})
+                        "accessor": accessor, "hot_rows": int(hot_rows),
+                        "cold_dir": cold_dir})
 
     def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01):
         self._call_all({"op": "create_dense", "table": name,
@@ -863,7 +907,10 @@ class PsClient:
     # -- sparse -------------------------------------------------------------
     def _partition(self, ids):
         ids = np.asarray(ids, np.int64).reshape(-1)
-        owner = ids % len(self.endpoints)
+        if self.partitioner is not None:
+            owner = np.asarray(self.partitioner(ids), np.int64)
+        else:
+            owner = ids % len(self.endpoints)
         return ids, owner
 
     def pull_sparse(self, name, ids) -> np.ndarray:
@@ -926,6 +973,16 @@ class PsClient:
     def end_day(self, name):
         """Decay show/click stats + age unseen counters on every shard."""
         self._call_all({"op": "end_day", "table": name})
+
+    def snapshot(self, name) -> List[int]:
+        """Incremental snapshot of `name` on every shard (ShardServer op);
+        returns the per-shard snapshot sequence numbers."""
+        return [int(r[0].get("seq", 0))
+                for r in self._call_all({"op": "snapshot", "table": name})]
+
+    def ps_stats(self) -> List[Dict]:
+        """Per-shard table/tier occupancy + counters (ps_stats op)."""
+        return [r[0] for r in self._call_all({"op": "ps_stats"})]
 
     # -- dense --------------------------------------------------------------
     def pull_dense(self, name) -> np.ndarray:

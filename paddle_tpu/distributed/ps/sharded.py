@@ -1,0 +1,1102 @@
+"""Sharded terabyte-embedding parameter server.
+
+The scale story for the BoxPS/CTR path (PAPER.md layer 6, ROADMAP item
+1): feature ids consistent-hash over N :class:`~.rpc.PsServer` shard
+processes, each shard holding a tiered store (bounded hot RAM tier
+fronting an mmap'd cold disk tier — ``table.TieredSparseTable``), with
+the whole PR-13 robustness plane engaged per shard: heartbeat
+supervision, per-shard circuit breakers (serving/fleet.py), and
+exactly-once pushes riding the RPC ``req_id`` dedup window.
+
+Pieces (client side):
+
+* :class:`HashRing` — consistent-hash partitioner with virtual nodes;
+  plugs into ``PsClient(partitioner=...)``.  Re-sharding moves ~1/N of
+  the keyspace instead of re-dealing every id like ``id % n`` does.
+* :class:`ShardedSparseTable` — the trainer-facing table: spawns and
+  supervises shard processes like fleet replicas (ready-line protocol,
+  auto-restart + restore), async pushes with bounded staleness
+  (``FLAGS_ps_staleness`` outstanding before a pull fences), and an
+  async working-set prefetcher riding the PR-4 ``Prefetcher`` hook so
+  multi-shard pulls overlap the device step.  The residual wait is
+  traced as ``ps::pull_wait`` (its own goodput bucket).
+
+Pieces (server side):
+
+* :class:`WriteAheadLog` — CRC-framed redo log of mutating table RPCs,
+  flushed before apply/ack, so a SIGKILL'd shard replays every
+  acknowledged push on restart.
+* :class:`TableSnapshotter` — incremental snapshots in the PR-6
+  checkpoint idiom: full base + changed-rows deltas, each file
+  checksummed, manifest rewritten atomically last; restore = base +
+  deltas + WAL tail, bit-exact.
+* :class:`ShardServer` — a PsServer that journals mutations, snapshots
+  on demand (or every ``FLAGS_ps_snapshot_every`` mutations), and
+  restores its tables + dedup window at boot.
+
+Bit-parity contract: with ``init_kind="id_hash"`` (row values a pure
+function of (id, seed) — table.IdHashInitializer) and ``staleness=0``,
+an N-shard table is bit-identical to a single in-process table on any
+pull/push/end_day/shrink stream, for ANY hot-tier capacity, prefetch on
+or off.  One carve-out: a pull creates missing rows, so a prefetch
+issued BEFORE a shrink stages the future batch's rows early and changes
+what the shrink sees — issue prefetches after a step's maintenance ops
+(end_day/shrink sit at epoch boundaries, where the prefetcher is idle).
+The tests and the ci_smoke PS gate hold this line.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...fluid import trace
+from .rpc import PsClient, PsServer, RpcDeadlineError
+from .table import TieredSparseTable, _splitmix64
+
+_m = trace.metrics()
+
+
+def _flag(name, default):
+    from ...fluid import core
+    return core.get_flag(name, default)
+
+
+class ShardUnavailableError(ConnectionError):
+    """A shard's circuit breaker stayed open past the caller's wait
+    budget."""
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes (SplitMix64 points).
+
+    ``owners(ids)`` is fully vectorized: ring points are a sorted uint64
+    array; each id hashes to a point and is owned by the first ring
+    point clockwise (``searchsorted``, wrapping past the top).  Adding or
+    removing a shard remaps only the arcs adjacent to its vnodes —
+    ~1/N of the keyspace — where ``id % n`` would re-deal almost every
+    id (and with it every row's home shard)."""
+
+    def __init__(self, n_shards: int, vnodes: Optional[int] = None,
+                 seed: int = 0):
+        self.n_shards = int(n_shards)
+        self.vnodes = int(vnodes if vnodes is not None
+                          else _flag("ps_shard_vnodes", 64))
+        self.seed = np.uint64(int(seed) & 0xFFFFFFFFFFFFFFFF)
+        shard = np.repeat(np.arange(self.n_shards, dtype=np.uint64),
+                          self.vnodes)
+        vnode = np.tile(np.arange(self.vnodes, dtype=np.uint64),
+                        self.n_shards)
+        pts = _splitmix64(_splitmix64(shard * np.uint64(0x9E3779B97F4A7C15)
+                                      + vnode) + self.seed)
+        order = np.argsort(pts, kind="stable")
+        self._points = pts[order]
+        self._owner = shard[order].astype(np.int64)
+
+    def owners(self, ids) -> np.ndarray:
+        """Vectorized id -> shard index."""
+        ids = np.asarray(ids, np.int64).reshape(-1).astype(np.uint64)
+        h = _splitmix64(ids * np.uint64(0xBF58476D1CE4E5B9) + self.seed)
+        idx = np.searchsorted(self._points, h, side="right")
+        return self._owner[idx % len(self._points)]
+
+
+# ---------------------------------------------------------------------------
+# write-ahead log
+# ---------------------------------------------------------------------------
+
+_WAL_HDR = struct.Struct("!II")      # payload_len, payload_crc32
+
+
+class WriteAheadLog:
+    """Length-prefixed, CRC-framed redo log of mutating table RPCs.
+
+    ``append`` serializes (header json, arrays) into one npz payload and
+    flushes it to the OS *before* the op is applied or acked — an OS
+    that outlives the process (the SIGKILL drill) retains every
+    acknowledged mutation even with ``FLAGS_ps_wal_fsync=0``; turn fsync
+    on to also survive machine loss.  Files rotate at each snapshot:
+    records land in ``wal-<n>.log`` where ``n`` is the snapshot seq they
+    follow, so restore replays exactly the files with index >= the
+    manifest seq.  A torn final record (crash mid-append) is detected by
+    the CRC and dropped — by construction it was never acked."""
+
+    def __init__(self, dir_: str, index: int = 0, fsync: Optional[bool] = None):
+        self.dir = str(dir_)
+        os.makedirs(self.dir, exist_ok=True)
+        self.fsync = bool(_flag("ps_wal_fsync", False)
+                          if fsync is None else fsync)
+        self.records = 0
+        self._f = None
+        self.index = None
+        self._open(index)
+
+    def _path(self, index: int) -> str:
+        return os.path.join(self.dir, f"wal-{index:06d}.log")
+
+    def _open(self, index: int):
+        if self._f is not None:
+            self._f.close()
+        self.index = int(index)
+        self._f = open(self._path(self.index), "ab")
+
+    def append(self, header: Dict, arrays: Sequence[np.ndarray]):
+        payload = {"h": np.frombuffer(
+            json.dumps(header).encode(), np.uint8)}
+        for k, a in enumerate(arrays):
+            payload[f"a{k}"] = np.ascontiguousarray(a)
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        data = buf.getvalue()
+        self._f.write(_WAL_HDR.pack(len(data), zlib.crc32(data)))
+        self._f.write(data)
+        self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self.records += 1
+        _m.counter("ps.wal_records").inc()
+
+    def rotate(self, new_index: int):
+        """Start a fresh file; records already snapshotted (index <
+        new_index) are deleted AFTER the caller committed its manifest."""
+        self._open(new_index)
+        for fn in sorted(os.listdir(self.dir)):
+            if fn.startswith("wal-") and fn.endswith(".log"):
+                idx = int(fn[4:-4])
+                if idx < new_index:
+                    try:
+                        os.remove(os.path.join(self.dir, fn))
+                    except OSError:
+                        pass
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    @staticmethod
+    def replay(dir_: str, min_index: int = 0):
+        """Yield (header, arrays) for every intact record in files with
+        index >= min_index, in file-then-offset order.  Stops at the
+        first torn/corrupt record of a file (crash mid-append)."""
+        if not os.path.isdir(dir_):
+            return
+        files = sorted(fn for fn in os.listdir(dir_)
+                       if fn.startswith("wal-") and fn.endswith(".log")
+                       and int(fn[4:-4]) >= min_index)
+        for fn in files:
+            with open(os.path.join(dir_, fn), "rb") as f:
+                while True:
+                    hdr = f.read(_WAL_HDR.size)
+                    if len(hdr) < _WAL_HDR.size:
+                        break
+                    n, crc = _WAL_HDR.unpack(hdr)
+                    data = f.read(n)
+                    if len(data) < n or zlib.crc32(data) != crc:
+                        break                      # torn tail: never acked
+                    with np.load(io.BytesIO(data)) as z:
+                        header = json.loads(z["h"].tobytes().decode())
+                        arrays = [z[f"a{k}"]
+                                  for k in range(len(z.files) - 1)]
+                    yield header, arrays
+
+
+# ---------------------------------------------------------------------------
+# incremental snapshots (PR-6 checkpoint manifest idiom)
+# ---------------------------------------------------------------------------
+
+class TableSnapshotter:
+    """Incremental table snapshots: ``snap-000001.npz`` is the full base,
+    later files are changed-rows deltas (full row state of the ids the
+    table dirtied since the previous snapshot, plus the ids it deleted).
+    Every file is sha256'd into ``manifest.json``, which is rewritten
+    atomically LAST (the checkpoint plane's commit ordering) — a crash
+    mid-snapshot leaves the previous manifest + a WAL that still covers
+    the gap.  ``restore`` = base + deltas in order, bit-exact."""
+
+    FORMAT = "paddle_tpu.ps_snapshot.v1"
+
+    def __init__(self, dir_: str):
+        self.dir = str(dir_)
+        os.makedirs(self.dir, exist_ok=True)
+        self.seq = 0
+        self.files: List[Dict] = []
+        man = self._read_manifest(self.dir)
+        if man is not None:
+            self.seq = int(man["seq"])
+            self.files = list(man["files"])
+
+    @staticmethod
+    def _read_manifest(dir_) -> Optional[Dict]:
+        path = os.path.join(str(dir_), "manifest.json")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (man.get("format") != TableSnapshotter.FORMAT
+                or not man.get("complete")):
+            return None
+        return man
+
+    def snapshot(self, table) -> int:
+        """Write the next snapshot (base if first) from the table's dirty
+        set.  Caller is responsible for quiescing writers (the shard
+        server holds its mutation lock)."""
+        import hashlib
+
+        from ...fluid.checkpoint import atomic_write_bytes
+        self.seq += 1
+        if self.seq == 1:
+            table.drain_dirty()                  # base captures everything
+            ids = table.all_ids()
+            state = table.row_state(ids)
+            deleted = np.zeros(0, np.int64)
+            kind = "base"
+        else:
+            dirty, deleted = table.drain_dirty()
+            state = table.row_state(dirty)
+            kind = "delta"
+        buf = io.BytesIO()
+        np.savez(buf, deleted=deleted, **state)
+        data = buf.getvalue()
+        fname = f"snap-{self.seq:06d}.npz"
+        atomic_write_bytes(os.path.join(self.dir, fname), data)
+        self.files.append({
+            "file": fname, "kind": kind, "rows": int(len(state["ids"])),
+            "deleted": int(len(deleted)), "bytes": len(data),
+            "sha256": hashlib.sha256(data).hexdigest()})
+        manifest = {"format": self.FORMAT, "seq": self.seq,
+                    "files": self.files, "complete": True}
+        atomic_write_bytes(os.path.join(self.dir, "manifest.json"),
+                           json.dumps(manifest, indent=1).encode())
+        _m.counter("ps.snapshots").inc()
+        return self.seq
+
+    @staticmethod
+    def restore(table, dir_) -> Optional[Dict]:
+        """Load base + deltas into ``table``; returns the manifest (None
+        when no complete snapshot exists).  Raises ValueError on a
+        checksum mismatch — a torn file must never restore silently."""
+        import hashlib
+        man = TableSnapshotter._read_manifest(dir_)
+        if man is None:
+            return None
+        for ent in man["files"]:
+            path = os.path.join(str(dir_), ent["file"])
+            with open(path, "rb") as f:
+                data = f.read()
+            if hashlib.sha256(data).hexdigest() != ent["sha256"]:
+                raise ValueError(
+                    f"ps snapshot {ent['file']}: sha256 mismatch")
+            with np.load(io.BytesIO(data)) as z:
+                state = {k: z[k] for k in z.files if k != "deleted"}
+                deleted = z["deleted"]
+            if len(state["ids"]):
+                table.set_row_state(state)
+            if len(deleted):
+                table.evict_rows(deleted)
+        table.drain_dirty()        # restored state is snapshot-consistent
+        _m.counter("ps.restores").inc()
+        return man
+
+
+# ---------------------------------------------------------------------------
+# shard server
+# ---------------------------------------------------------------------------
+
+#: sparse-table mutations journaled to the WAL (dense tables stay on the
+#: classic save/load path — the sharded tier is a sparse-embedding plane)
+_WAL_OPS = frozenset(("push_sparse", "push_sparse_delta", "end_day",
+                      "shrink", "set_rows"))
+
+_META_KEYS = ("op", "table", "dim", "optimizer", "lr", "seed", "init_kind",
+              "init_scale", "accessor", "hot_rows")
+
+
+class ShardServer(PsServer):
+    """A PsServer shard with durability: journals mutating sparse ops to
+    a per-table WAL before applying them, snapshots incrementally, and
+    at boot rebuilds each table from (base + deltas + WAL tail), re-seeding
+    the req_id dedup window from the replayed records so a client retry
+    of an applied-but-unacked push replays the ack instead of
+    double-applying."""
+
+    def __init__(self, *args, state_dir: Optional[str] = None,
+                 snapshot_every: Optional[int] = None, **kw):
+        super().__init__(*args, **kw)
+        self.state_dir = str(state_dir) if state_dir else None
+        self.snapshot_every = int(_flag("ps_snapshot_every", 0)
+                                  if snapshot_every is None
+                                  else snapshot_every)
+        self._mut_lock = threading.Lock()
+        self._wals: Dict[str, WriteAheadLog] = {}
+        self._snaps: Dict[str, TableSnapshotter] = {}
+        self._since_snap: Dict[str, int] = {}
+        self.restored_tables: List[str] = []
+        if self.state_dir:
+            os.makedirs(self.state_dir, exist_ok=True)
+            self._boot_restore()
+
+    # -- persistence wiring -------------------------------------------------
+    def _table_dir(self, name: str) -> str:
+        return os.path.join(self.state_dir, name)
+
+    def _setup_persistence(self, name: str, meta: Dict,
+                           wal_index: Optional[int] = None):
+        d = self._table_dir(name)
+        os.makedirs(d, exist_ok=True)
+        meta_path = os.path.join(d, "meta.json")
+        if not os.path.exists(meta_path):
+            from ...fluid.checkpoint import atomic_write_bytes
+            keep = {k: meta[k] for k in _META_KEYS if k in meta}
+            atomic_write_bytes(meta_path, json.dumps(keep).encode())
+        snap = TableSnapshotter(os.path.join(d, "snaps"))
+        self._snaps[name] = snap
+        if wal_index is None:
+            wal_index = snap.seq
+        self._wals[name] = WriteAheadLog(os.path.join(d, "wal"),
+                                         index=wal_index)
+        self._since_snap.setdefault(name, 0)
+
+    def _boot_restore(self):
+        for name in sorted(os.listdir(self.state_dir)):
+            meta_path = os.path.join(self._table_dir(name), "meta.json")
+            if not os.path.isfile(meta_path):
+                continue
+            with open(meta_path, "r", encoding="utf-8") as f:
+                meta = json.load(f)
+            meta["table"] = name
+            meta["op"] = "create_sparse"
+            meta.setdefault("cold_dir",
+                            os.path.join(self._table_dir(name), "cold"))
+            PsServer._dispatch(self, meta, [])
+            table = self.sparse[name]
+            d = self._table_dir(name)
+            man = TableSnapshotter.restore(table, os.path.join(d, "snaps"))
+            start = int(man["seq"]) if man else 0
+            # WAL tail replay with req_id dedup: duplicate records (a
+            # retried push whose first attempt errored mid-apply) apply
+            # once; every replayed req_id seeds the dedup window so an
+            # in-flight client retry replays the ack
+            seen: set = set()
+            replayed = 0
+            for header, arrays in WriteAheadLog.replay(
+                    os.path.join(d, "wal"), start):
+                rid = header.get("req_id")
+                if rid is not None:
+                    if rid in seen:
+                        continue
+                    seen.add(rid)
+                try:
+                    PsServer._dispatch(self, header, arrays)
+                except Exception:       # noqa: BLE001 — a poisoned record
+                    # must not take down every healthy row on the shard
+                    continue
+                replayed += 1
+                if rid is not None:
+                    self._dedup_done(rid, {"ok": True, "replayed": True},
+                                     [])
+            # continue appending to the highest existing WAL file
+            wal_dir = os.path.join(d, "wal")
+            idxs = [int(fn[4:-4]) for fn in os.listdir(wal_dir)
+                    if fn.startswith("wal-")] if os.path.isdir(wal_dir) \
+                else []
+            self._setup_persistence(name, meta,
+                                    wal_index=max(idxs) if idxs else start)
+            self.restored_tables.append(name)
+            self._event("table_restored", table=name,
+                        rows=int(table.size()), wal_replayed=replayed,
+                        snapshot_seq=start)
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch(self, header, arrays):
+        op = header["op"]
+        name = header.get("table")
+        if op == "create_sparse":
+            if name in self.sparse:
+                # restored at boot (or a client retry): keep the restored
+                # rows — recreating would silently discard them
+                return {"ok": True, "existing": True}, []
+            if self.state_dir:
+                header = dict(header)
+                header.setdefault(
+                    "cold_dir", os.path.join(self._table_dir(name), "cold"))
+            reply, out = super()._dispatch(header, arrays)
+            if self.state_dir and reply.get("ok"):
+                self._setup_persistence(name, header)
+            return reply, out
+        if op == "snapshot":
+            return self._do_snapshot(name)
+        if op in _WAL_OPS and name in self._wals:
+            with self._mut_lock:
+                self._wals[name].append(dict(header), arrays)
+                reply, out = super()._dispatch(header, arrays)
+                self._since_snap[name] = self._since_snap.get(name, 0) + 1
+            if (self.snapshot_every > 0
+                    and self._since_snap[name] >= self.snapshot_every):
+                self._do_snapshot(name)
+            return reply, out
+        return super()._dispatch(header, arrays)
+
+    def _do_snapshot(self, name):
+        if name not in self._snaps:
+            return {"ok": False,
+                    "error": f"no snapshot dir for table {name}"}, []
+        t = self.sparse[name]
+        with self._mut_lock:
+            snap = self._snaps[name]
+            seq = snap.snapshot(t)
+            # records before this snapshot are now redundant: rotate so
+            # restore replays only what the snapshot chain doesn't cover
+            self._wals[name].rotate(seq)
+            self._since_snap[name] = 0
+        self._event("snapshot", table=name, seq=seq)
+        return {"ok": True, "seq": seq, "rows": int(t.size())}, []
+
+
+def serve_shard(spec: Dict, ready_stream=None):
+    """Child-process entry (`python -m paddle_tpu.distributed.ps.sharded
+    --serve-shard --spec ...`): bring up one ShardServer (restoring any
+    persisted tables), print ONE ready line with the bound port, serve
+    until ``stop``."""
+    ready_stream = ready_stream or sys.stdout
+    srv = ShardServer(
+        host=spec.get("host", "127.0.0.1"), port=int(spec.get("port", 0)),
+        shard_idx=int(spec.get("shard_idx", 0)),
+        n_servers=int(spec.get("n_servers", 1)),
+        n_trainers=int(spec.get("n_trainers", 1)),
+        state_dir=spec.get("state_dir"),
+        snapshot_every=spec.get("snapshot_every"))
+    srv.start()
+    ready_stream.write(json.dumps({
+        "ready": True, "pid": os.getpid(), "port": srv.port,
+        "endpoint": srv.endpoint,
+        "restored": srv.restored_tables}) + "\n")
+    ready_stream.flush()
+    srv.wait()
+
+
+# ---------------------------------------------------------------------------
+# sharded client
+# ---------------------------------------------------------------------------
+
+class _ShardProc:
+    """One supervised shard subprocess (fleet ReplicaHandle idiom)."""
+
+    def __init__(self, idx: int, spec: Dict, quiet: bool = True,
+                 spawn_timeout_s: float = 60.0):
+        self.idx = idx
+        self.spec = dict(spec)
+        self.quiet = quiet
+        self.spawn_timeout_s = spawn_timeout_s
+        self.proc: Optional[subprocess.Popen] = None
+        self.endpoint: Optional[str] = None
+        self.spawns = 0
+
+    def spawn(self) -> str:
+        self.spawns += 1
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.ps.sharded",
+             "--serve-shard", "--spec", json.dumps(self.spec)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL if self.quiet else None,
+            env=dict(os.environ), text=True)
+        line_box: List[str] = []
+        done = threading.Event()
+
+        def read_ready():
+            line_box.append(proc.stdout.readline())
+            done.set()
+
+        threading.Thread(target=read_ready, daemon=True).start()
+        if not done.wait(self.spawn_timeout_s) or not line_box[0]:
+            proc.kill()
+            raise RuntimeError(
+                f"ps shard {self.idx} produced no ready line within "
+                f"{self.spawn_timeout_s:.0f}s")
+        info = json.loads(line_box[0])
+        self.proc = proc
+        self.endpoint = info["endpoint"]
+        return self.endpoint
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self):
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class ShardedSparseTable:
+    """Trainer-facing sharded sparse table (the BoxPS scale tier).
+
+    Feature ids consistent-hash over N shard servers; each shard op is
+    gated by that shard's :class:`~paddle_tpu.serving.fleet
+    .CircuitBreaker` (an open breaker makes callers WAIT — with a
+    deadline — rather than fail, so a restarting shard absorbs the
+    backlog instead of losing it).  Pushes are asynchronous with bounded
+    staleness: at most ``staleness`` pushes may be outstanding before a
+    pull fences (0 = fully synchronous ordering = bit-parity with a
+    single table).  ``prefetching`` wraps a feed iterator with the PR-4
+    Prefetcher so the next batch's working set is pulled while the
+    device trains; bit-exactness is preserved by re-pulling only the ids
+    that were pushed after the prefetch was issued (patched hits).
+
+    Spawn mode (default) starts one subprocess per shard with a
+    persistent ``state_dir`` (WAL + incremental snapshots) and
+    supervises them: heartbeat pings, breaker bookkeeping, auto-restart
+    + restore of dead shards.  Attach mode (``endpoints=...``) rides
+    externally managed servers — in-process PsServers in tests."""
+
+    def __init__(self, name: str, dim: Optional[int] = None,
+                 accessor: Optional[Dict] = None, optimizer: str = "sgd",
+                 lr: float = 0.01, n_shards: int = 4,
+                 endpoints: Optional[Sequence[str]] = None,
+                 state_dir: Optional[str] = None,
+                 hot_rows: Optional[int] = None, seed: int = 0,
+                 init_kind: str = "id_hash", init_scale: float = 0.07,
+                 staleness: Optional[int] = None,
+                 vnodes: Optional[int] = None, timeout: float = 60.0,
+                 snapshot_every: Optional[int] = None,
+                 heartbeat_s: float = 0.5,
+                 breaker_failures: Optional[int] = None,
+                 breaker_cooldown_s: Optional[float] = None,
+                 restart_dead: bool = True, supervise: Optional[bool] = None,
+                 quiet_children: bool = True):
+        from ...serving.fleet import CircuitBreaker
+        self.name = name
+        self.dim = dim if dim is not None else (
+            1 + int((accessor or {}).get("embedx_dim", 8)))
+        self.accessor = accessor
+        self.timeout = float(timeout)
+        self.staleness = int(_flag("ps_staleness", 0)
+                             if staleness is None else staleness)
+        hot_rows = int(_flag("ps_hot_rows", 0)
+                       if hot_rows is None else hot_rows)
+        self.hot_rows = hot_rows
+        self.restart_dead = bool(restart_dead)
+        self.heartbeat_s = float(heartbeat_s)
+        self.events: List[Dict] = []
+        self._ev_lock = threading.Lock()
+        self._spawned = endpoints is None
+        self._procs: List[_ShardProc] = []
+        if endpoints is None:
+            if state_dir is None:
+                import tempfile
+                state_dir = tempfile.mkdtemp(prefix=f"ps-{name}-")
+            self.state_dir = str(state_dir)
+            endpoints = []
+            for s in range(n_shards):
+                spec = {"shard_idx": s, "n_servers": n_shards,
+                        "state_dir": os.path.join(self.state_dir,
+                                                  f"shard{s}"),
+                        "snapshot_every": snapshot_every}
+                p = _ShardProc(s, spec, quiet=quiet_children)
+                endpoints.append(p.spawn())
+                self._procs.append(p)
+        else:
+            self.state_dir = state_dir
+            endpoints = list(endpoints)
+        self.n_shards = len(endpoints)
+        self.ring = HashRing(self.n_shards, vnodes=vnodes, seed=seed)
+        self.client = PsClient(endpoints, timeout=self.timeout,
+                               partitioner=self.ring.owners)
+        self.breakers = [
+            CircuitBreaker(failures=breaker_failures,
+                           cooldown_s=breaker_cooldown_s,
+                           name=f"ps:{name}:shard{s}",
+                           on_open=(lambda s=s: self._event(
+                               "breaker_open", shard=s)),
+                           on_close=(lambda s=s: self._event(
+                               "breaker_close", shard=s)))
+            for s in range(self.n_shards)]
+        self.client.create_sparse_table(
+            name, self.dim, optimizer=optimizer, lr=lr, seed=seed,
+            init_kind=init_kind, init_scale=init_scale, accessor=accessor,
+            hot_rows=hot_rows)
+        # -- async push pipeline (bounded staleness) ------------------------
+        self._stop = threading.Event()
+        self._push_epoch = 0          # pushes accepted from the trainer
+        self._applied_epoch = 0       # pushes fully applied on the shards
+        self._push_cv = threading.Condition()
+        self._push_err: Optional[BaseException] = None
+        self._push_queue: deque = deque()
+        self._push_log: deque = deque(maxlen=256)   # (epoch, uniq ids)
+        self._push_worker = threading.Thread(target=self._drain_pushes,
+                                             daemon=True)
+        self._push_worker.start()
+        # -- prefetch state -------------------------------------------------
+        self._prefetched: Dict = {}
+        self._prefetch_lock = threading.Lock()
+        self._prefetch_pool: List[threading.Thread] = []
+        # -- supervision ----------------------------------------------------
+        self._monitor: Optional[threading.Thread] = None
+        if supervise if supervise is not None else self._spawned:
+            self._monitor = threading.Thread(target=self._supervise,
+                                             daemon=True)
+            self._monitor.start()
+        self._h_pull_wait = _m.histogram("ps.pull_wait_seconds")
+        self._h_pull = _m.histogram("ps.pull_seconds")
+        self._h_push = _m.histogram("ps.push_seconds")
+
+    # -- events / stats ------------------------------------------------------
+    def _event(self, kind: str, **fields):
+        ev = {"t_mono": time.monotonic(), "ts": time.time(), "kind": kind,
+              **fields}
+        with self._ev_lock:
+            self.events.append(ev)
+
+    def events_of(self, kind: str) -> List[Dict]:
+        with self._ev_lock:
+            return [e for e in self.events if e["kind"] == kind]
+
+    def breaker_states(self) -> List[str]:
+        return [b.state for b in self.breakers]
+
+    def ps_stats(self) -> List[Dict]:
+        return self.client.ps_stats()
+
+    # -- breaker-gated shard RPC --------------------------------------------
+    def _shard_call(self, s: int, header: Dict, arrays=(),
+                    wait_s: Optional[float] = None, attempt_s: float = 5.0):
+        """One logical RPC through shard ``s``'s breaker: short attempts,
+        retried until the wait budget runs out, waiting out an open
+        breaker between them — a shard mid-restart absorbs the call when
+        it comes back instead of failing it.  Callers stamp ``req_id``
+        on non-idempotent headers ONCE, so every retry here is the same
+        logical op to the server's dedup window (exactly-once)."""
+        br = self.breakers[s]
+        deadline = time.monotonic() + (self.timeout if wait_s is None
+                                       else wait_s)
+        last: Optional[BaseException] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ShardUnavailableError(
+                    f"ps shard {s} ({self.client.endpoints[s]}) "
+                    f"unavailable past wait budget"
+                    + (f": {type(last).__name__}: {last}" if last else ""))
+            if not br.try_acquire_probe():
+                _m.counter("ps.breaker_waits").inc()
+                time.sleep(0.02)
+                continue
+            try:
+                reply, out = self.client._call(
+                    s, header, arrays,
+                    deadline_s=min(attempt_s, remaining))
+            except (OSError, ConnectionError, RpcDeadlineError) as e:
+                br.record_failure()
+                last = e
+                time.sleep(0.05)
+                continue
+            br.record_success()
+            return reply, out
+
+    def _partition(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        return ids, self.ring.owners(ids)
+
+    # -- pushes: async with bounded staleness --------------------------------
+    def push(self, ids, grads, shows=None, clicks=None):
+        """Enqueue one push; applies asynchronously (FIFO).  At most
+        ``staleness`` pushes ride unapplied before a pull fences."""
+        self._raise_push_err()
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if not len(ids):
+            return
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        shows = (None if shows is None
+                 else np.asarray(shows, np.float32).reshape(-1).copy())
+        clicks = (None if clicks is None
+                  else np.asarray(clicks, np.float32).reshape(-1).copy())
+        with self._push_cv:
+            self._push_epoch += 1
+            self._push_log.append((self._push_epoch, np.unique(ids)))
+            self._push_queue.append(
+                (self._push_epoch, ids.copy(), grads.copy(), shows, clicks))
+            self._push_cv.notify_all()
+            _m.gauge("ps.outstanding_pushes").set(
+                self._push_epoch - self._applied_epoch)
+
+    def _drain_pushes(self):
+        while True:
+            with self._push_cv:
+                while not self._push_queue and not self._stop.is_set():
+                    self._push_cv.wait(0.2)
+                if self._stop.is_set() and not self._push_queue:
+                    return
+                if not self._push_queue:
+                    continue
+                epoch, ids, grads, shows, clicks = self._push_queue.popleft()
+            t0 = time.monotonic()
+            try:
+                self._push_sync(ids, grads, shows, clicks)
+            except BaseException as e:       # noqa: BLE001 — surfaced on
+                # the trainer thread at the next push/pull/flush
+                with self._push_cv:
+                    self._push_err = e
+                    self._applied_epoch = epoch
+                    self._push_cv.notify_all()
+                continue
+            self._h_push.observe(time.monotonic() - t0)
+            with self._push_cv:
+                self._applied_epoch = epoch
+                self._push_cv.notify_all()
+                _m.gauge("ps.outstanding_pushes").set(
+                    self._push_epoch - self._applied_epoch)
+
+    def _push_sync(self, ids, grads, shows, clicks):
+        ids, owner = self._partition(ids)
+        stats = shows is not None or clicks is not None
+        if stats:
+            if shows is None:
+                shows = np.ones(len(ids), np.float32)
+            if clicks is None:
+                clicks = np.zeros(len(ids), np.float32)
+        errs: List = []
+
+        def one(s):
+            sel = np.nonzero(owner == s)[0]
+            if not len(sel):
+                return
+            arrays = [ids[sel], grads[sel]]
+            if stats:
+                arrays += [shows[sel], clicks[sel]]
+            # req_id stamped HERE, once per logical push per shard: the
+            # _shard_call retry loop reuses it across a shard restart,
+            # so the rebuilt dedup window makes every retry exactly-once
+            self._shard_call(
+                s, {"op": "push_sparse", "table": self.name,
+                    "req_id": self.client._next_req_id()}, arrays)
+
+        def run(s):
+            try:
+                one(s)
+            except BaseException as e:      # noqa: BLE001 — re-raised below
+                errs.append((s, e))
+
+        ts = [threading.Thread(target=run, args=(s,))
+              for s in sorted(set(owner.tolist()))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0][1]
+
+    def flush(self):
+        """Block until every enqueued push has applied; re-raise any
+        asynchronous push failure."""
+        with self._push_cv:
+            target = self._push_epoch
+            while self._applied_epoch < target and self._push_err is None:
+                self._push_cv.wait(0.1)
+        self._raise_push_err()
+
+    def _raise_push_err(self):
+        with self._push_cv:
+            err, self._push_err = self._push_err, None
+        if err is not None:
+            raise err
+
+    def _fence(self, upto: Optional[int] = None):
+        """Wait until at most ``staleness`` pushes are outstanding (or
+        until push ``upto`` has applied)."""
+        with self._push_cv:
+            target = (self._push_epoch - self.staleness if upto is None
+                      else upto)
+            if self._applied_epoch < target:
+                _m.counter("ps.fence_stalls").inc()
+            while self._applied_epoch < target and self._push_err is None:
+                self._push_cv.wait(0.1)
+        self._raise_push_err()
+
+    # -- pulls ---------------------------------------------------------------
+    def _fetch(self, ids) -> np.ndarray:
+        """Multi-shard gather (no fence — callers order it)."""
+        ids, owner = self._partition(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        errs: List = []
+
+        def one(s):
+            try:
+                sel = np.nonzero(owner == s)[0]
+                if not len(sel):
+                    return
+                _, arrs = self._shard_call(
+                    s, {"op": "pull_sparse", "table": self.name},
+                    [ids[sel]])
+                out[sel] = arrs[0]
+            except BaseException as e:      # noqa: BLE001 — re-raised below
+                errs.append((s, e))
+
+        ts = [threading.Thread(target=one, args=(s,))
+              for s in sorted(set(owner.tolist()))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if errs:
+            raise errs[0][1]
+        return out
+
+    def pull(self, ids) -> np.ndarray:
+        """Gather rows for ``ids`` — from the prefetched working set when
+        the async prefetcher staged them (ids pushed after the prefetch
+        was issued are re-pulled and patched, preserving bit-parity),
+        otherwise synchronously.  The full wait is traced as
+        ``ps::pull_wait`` and lands in its own goodput bucket."""
+        t0 = time.monotonic()
+        t0_ns = trace.now() if trace.enabled() else None
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        entry = self._take_prefetched(ids)
+        if entry is not None:
+            entry["thread"].join(self.timeout)
+            if entry.get("err") is not None:
+                raise entry["err"]
+            rows = entry["rows"]
+            stale = self._pushed_since(entry["epoch"], ids)
+            if stale is not None and stale.any():
+                self._fence()
+                rows = rows.copy()
+                rows[stale] = self._fetch(ids[stale])
+                _m.counter("ps.prefetch_patched").inc()
+            _m.counter("ps.prefetch_hits").inc()
+        else:
+            self._fence()
+            rows = self._fetch(ids)
+        wait = time.monotonic() - t0
+        self._h_pull_wait.observe(wait)
+        self._h_pull.observe(wait)
+        if t0_ns is not None:
+            trace.complete("ps::pull_wait", t0_ns, cat="ps",
+                           args={"n_ids": int(len(ids)),
+                                 "prefetched": entry is not None})
+        return rows
+
+    # -- prefetch ------------------------------------------------------------
+    @staticmethod
+    def _ids_key(ids: np.ndarray):
+        b = np.ascontiguousarray(ids).tobytes()
+        return (len(ids), zlib.crc32(b))
+
+    def begin_prefetch(self, ids):
+        """Issue an async pull for a FUTURE batch's ids.  Fences to the
+        pushes enqueued so far (minus the staleness allowance) on the
+        background thread, so the staged rows reflect every push the
+        trainer had issued when this was called."""
+        ids = np.asarray(ids, np.int64).reshape(-1).copy()
+        with self._push_cv:
+            epoch = self._push_epoch
+        entry = {"ids": ids, "epoch": epoch, "rows": None, "err": None}
+
+        def work():
+            try:
+                self._fence(upto=epoch - self.staleness)
+                entry["rows"] = self._fetch(ids)
+            except BaseException as e:      # noqa: BLE001 — re-raised at use
+                entry["err"] = e
+
+        th = threading.Thread(target=work, daemon=True)
+        entry["thread"] = th
+        th.start()
+        with self._prefetch_lock:
+            self._prefetched[self._ids_key(ids)] = entry
+        return entry
+
+    def _take_prefetched(self, ids):
+        key = self._ids_key(ids)
+        with self._prefetch_lock:
+            entry = self._prefetched.pop(key, None)
+        if entry is None:
+            if self._prefetched or self._prefetch_pool:
+                _m.counter("ps.prefetch_misses").inc()
+            return None
+        if not np.array_equal(entry["ids"], ids):     # crc collision
+            _m.counter("ps.prefetch_misses").inc()
+            return None
+        return entry
+
+    def _pushed_since(self, epoch: int, ids: np.ndarray):
+        """Bool mask of ``ids`` pushed after ``epoch`` (None = none)."""
+        with self._push_cv:
+            pushed = [u for (e, u) in self._push_log if e > epoch]
+        if not pushed:
+            return None
+        touched = np.unique(np.concatenate(pushed))
+        return np.isin(ids, touched)
+
+    def prefetching(self, source, extract: Callable, capacity: int = 2):
+        """Wrap a feed-batch iterable with the PR-4 Prefetcher hook: the
+        producer stage extracts each batch's ids (``extract(item)``) and
+        issues :meth:`begin_prefetch` before the trainer reaches the
+        batch, so the multi-shard pull overlaps the device step."""
+        from ...utils.prefetch import Prefetcher
+        self._prefetch_pool.append(True)   # marks prefetch active
+
+        def stage(item):
+            ids = extract(item)
+            if ids is not None and len(np.asarray(ids).reshape(-1)):
+                self.begin_prefetch(ids)
+            return item
+
+        return Prefetcher(source, stage=stage, capacity=capacity)
+
+    # -- other table ops -----------------------------------------------------
+    def shrink(self) -> int:
+        self.flush()
+        total = 0
+        for s in range(self.n_shards):
+            reply, _ = self._shard_call(
+                s, {"op": "shrink", "table": self.name,
+                    "req_id": self.client._next_req_id()})
+            total += int(reply.get("evicted", 0))
+        return total
+
+    def end_day(self):
+        self.flush()
+        for s in range(self.n_shards):
+            self._shard_call(s, {"op": "end_day", "table": self.name,
+                                 "req_id": self.client._next_req_id()})
+
+    def set_rows(self, ids, values):
+        """BoxPS EndPass writeback (duck-types the host-table API)."""
+        self.flush()
+        ids, owner = self._partition(ids)
+        values = np.asarray(values, np.float32).reshape(len(ids), -1)
+        for s in sorted(set(owner.tolist())):
+            sel = np.nonzero(owner == s)[0]
+            self._shard_call(
+                s, {"op": "set_rows", "table": self.name},
+                [ids[sel], np.ascontiguousarray(values[sel])])
+
+    def size(self) -> int:
+        total = 0
+        for s in range(self.n_shards):
+            reply, _ = self._shard_call(s, {"op": "size",
+                                            "table": self.name})
+            total += int(reply.get("size", 0))
+        return total
+
+    def snapshot(self) -> List[int]:
+        """Incremental snapshot on every shard; returns per-shard seqs."""
+        self.flush()
+        seqs = []
+        for s in range(self.n_shards):
+            reply, _ = self._shard_call(s, {"op": "snapshot",
+                                            "table": self.name})
+            seqs.append(int(reply.get("seq", 0)))
+        return seqs
+
+    # -- supervision ---------------------------------------------------------
+    def _supervise(self):
+        g_up = _m.gauge("ps.shards_up")
+        g_open = _m.gauge("ps.breaker_open")
+        while not self._stop.wait(self.heartbeat_s):
+            up = 0
+            for s in range(self.n_shards):
+                br = self.breakers[s]
+                proc = self._procs[s] if s < len(self._procs) else None
+                if proc is not None and proc.proc is not None \
+                        and not proc.alive():
+                    # process death is as many failures as it takes: the
+                    # breaker opens NOW, not after N failed pings
+                    while br.state == "closed":
+                        br.record_failure()
+                    if self.restart_dead:
+                        self._restart_shard(s)
+                    continue
+                if br.state == "closed":
+                    up += 1
+                    continue
+                # open/half-open: probe when the cooldown allows
+                if br.try_acquire_probe():
+                    try:
+                        self.client._call(s, {"op": "ping"},
+                                          deadline_s=2.0)
+                    except Exception:    # noqa: BLE001 — probe failure
+                        br.record_failure()
+                    else:
+                        br.record_success()
+                        up += 1
+            g_up.set(up)
+            g_open.set(sum(1 for b in self.breakers
+                           if b.state != "closed"))
+
+    def _restart_shard(self, s: int):
+        proc = self._procs[s]
+        self._event("shard_dead", shard=s, pid=(proc.proc.pid
+                                                if proc.proc else None))
+        _m.counter("ps.shard_restarts").inc()
+        try:
+            ep = proc.spawn()
+        except RuntimeError as e:
+            self._event("shard_restart_failed", shard=s, error=str(e))
+            return
+        # swap the endpoint in place; the poisoned socket drops on the
+        # next checkout
+        self.client.endpoints[s] = ep
+        self.client._drop_sock(s)
+        self._event("shard_restarted", shard=s, endpoint=ep,
+                    pid=proc.proc.pid)
+
+    def kill_shard(self, s: int):
+        """SIGKILL shard ``s`` (the restart drill's fault injector)."""
+        if s < len(self._procs):
+            self._procs[s].kill()
+
+    def close(self, stop_servers: bool = True):
+        self._stop.set()
+        with self._push_cv:
+            self._push_cv.notify_all()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        try:
+            self.flush()
+        except Exception:            # noqa: BLE001 — teardown best-effort
+            pass
+        if stop_servers:
+            try:
+                self.client.stop_server()
+            except Exception:        # noqa: BLE001 — teardown race
+                pass
+        else:
+            self.client.close()
+        for p in self._procs:
+            if p.proc is not None:
+                try:
+                    p.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(prog="paddle_tpu.distributed.ps.sharded")
+    ap.add_argument("--serve-shard", action="store_true")
+    ap.add_argument("--spec", default="{}")
+    args = ap.parse_args(argv)
+    if args.serve_shard:
+        serve_shard(json.loads(args.spec))
+    else:
+        ap.error("nothing to do (expected --serve-shard)")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,13 +28,53 @@ class Initializer:
         self.scale = scale
         self.rng = np.random.RandomState(seed)
 
-    def __call__(self, n, dim):
+    def __call__(self, n, dim, ids=None, col0=0):
+        # ids/col0 are the id-deterministic hooks (IdHashInitializer);
+        # the sequential RNG kinds ignore them
         if self.kind == "zeros":
             return np.zeros((n, dim), np.float32)
         if self.kind == "gaussian":
             return (self.rng.randn(n, dim) * self.scale).astype(np.float32)
         return self.rng.uniform(-self.scale, self.scale,
                                 (n, dim)).astype(np.float32)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """SplitMix64 finalizer, vectorized over a uint64 array."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+class IdHashInitializer(Initializer):
+    """Deterministic per-id rows: row(id) is a pure function of
+    (id, column, seed), independent of arrival order, shard layout, or
+    how many rows were created before it.  This is what makes a 4-shard
+    `ShardedSparseTable` bit-identical to a single-table baseline — the
+    sequential-RNG kinds above seed rows in creation order, which differs
+    per layout.  Values are uniform in [-scale, scale) derived from a
+    counter-based SplitMix64 hash (the stateless analog of Philox)."""
+
+    def __init__(self, kind="uniform", scale=0.07, seed=0):
+        super().__init__(kind, scale, seed)
+        self._seed = np.uint64(int(seed) & 0xFFFFFFFFFFFFFFFF)
+
+    def __call__(self, n, dim, ids=None, col0=0):
+        if self.kind == "zeros" or ids is None:
+            # no ids -> nothing deterministic to key on; zeros keeps the
+            # no-ids fallback itself order-independent
+            return np.zeros((n, dim), np.float32)
+        ids = np.asarray(ids, np.int64).reshape(-1).astype(np.uint64)
+        assert len(ids) == n
+        cols = (np.uint64(col0)
+                + np.arange(dim, dtype=np.uint64))[None, :]
+        key = _splitmix64(ids * np.uint64(0x9E3779B97F4A7C15)
+                          + self._seed)[:, None]
+        h = _splitmix64(key + _splitmix64(cols))
+        # top 53 bits -> float64 uniform in [0, 1), then scale
+        u = (h >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+        return ((2.0 * u - 1.0) * self.scale).astype(np.float32)
 
 
 class CommonSparseTable:
@@ -55,6 +95,10 @@ class CommonSparseTable:
         self._v: Optional[np.ndarray] = None     # adam moment2 / adagrad acc
         self._t: Optional[np.ndarray] = None     # adam per-row step
         self._lock = threading.Lock()
+        # ids mutated / evicted since the last drain_dirty() — the
+        # changed-rows delta source for incremental snapshots
+        self._dirty: set = set()
+        self._deleted: set = set()
 
     # -- storage ------------------------------------------------------------
     def _grow(self, need):
@@ -77,6 +121,15 @@ class CommonSparseTable:
             t[: self._n] = self._t[: self._n]
             self._t = t
 
+    def _init_rows(self, n, dim, ids=None, col0=0):
+        """Invoke the initializer, threading ids through for the
+        id-deterministic kinds; plain callables that only take (n, dim)
+        keep working."""
+        try:
+            return self.init(n, dim, ids=ids, col0=col0)
+        except TypeError:
+            return self.init(n, dim)
+
     def _slots(self, uniq_ids) -> np.ndarray:
         """Map ids -> row slots, batch-creating missing rows."""
         slots = np.empty(len(uniq_ids), np.int64)
@@ -88,13 +141,16 @@ class CommonSparseTable:
             slots[k] = s
         if missing:
             self._grow(self._n + len(missing))
-            fresh = self.init(len(missing), self.dim)
+            fresh = self._init_rows(
+                len(missing), self.dim,
+                ids=np.array([uniq_ids[k] for k in missing], np.int64))
             for j, k in enumerate(missing):
                 s = self._n
                 self._n += 1
                 self._slot_of[uniq_ids[k]] = s
                 slots[k] = s
                 self._vals[s] = fresh[j]
+                self._dirty.add(uniq_ids[k])
         return slots
 
     def _ensure_state(self, want_t=False):
@@ -123,6 +179,7 @@ class CommonSparseTable:
         uniq, inv = np.unique(ids, return_inverse=True)
         with self._lock:
             slots = self._slots(uniq.tolist())
+            self._dirty.update(uniq.tolist())
             self._apply_grads_locked(slots, inv, grads)
 
     def _apply_grads_locked(self, slots, inv, grads):
@@ -160,6 +217,7 @@ class CommonSparseTable:
         values = np.asarray(values, np.float32).reshape(len(ids), self.dim)
         with self._lock:
             slots = self._slots(ids.tolist())
+            self._dirty.update(ids.tolist())
             self._vals[slots] = values
 
     def push_delta(self, ids: np.ndarray, deltas: np.ndarray):
@@ -171,33 +229,180 @@ class CommonSparseTable:
         np.add.at(merged, inv, deltas)
         with self._lock:
             slots = self._slots(uniq.tolist())
+            self._dirty.update(uniq.tolist())
             self._vals[slots] += merged
 
     def size(self):
         return self._n
 
+    # -- row-state plane ----------------------------------------------------
+    # Full per-row state as a dict of aligned arrays: the single payload
+    # format shared by tier demotion/promotion (TieredSparseTable),
+    # incremental snapshots (distributed/ps/sharded.py) and save/load.
+    # Copying state through this plane is bit-exact by construction.
+
+    def _row_state_locked(self, ids: np.ndarray) -> Dict[str, np.ndarray]:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        slots = np.array([self._slot_of[int(i)] for i in ids.tolist()],
+                         np.int64)
+        st = {"ids": ids.copy(),
+              "vals": (self._vals[slots].copy() if len(ids)
+                       else np.zeros((0, self.dim), np.float32))}
+        for key, attr in (("m", "_m"), ("v", "_v")):
+            arr = getattr(self, attr)
+            st[key] = (arr[slots].copy() if arr is not None
+                       else np.zeros((len(ids), self.dim), np.float32))
+        st["t"] = (self._t[slots].copy() if self._t is not None
+                   else np.zeros(len(ids), np.int64))
+        return st
+
+    def row_state(self, ids) -> Dict[str, np.ndarray]:
+        """Full state for existing `ids` (KeyError on unknown ids)."""
+        with self._lock:
+            return self._row_state_locked(ids)
+
+    def _install_slots_locked(self, ids: np.ndarray) -> np.ndarray:
+        """Slots for `ids`, creating rows WITHOUT initializer seeding —
+        callers overwrite the full row state (promotion / restore)."""
+        slots = np.empty(len(ids), np.int64)
+        fresh = []
+        for k, i in enumerate(map(int, ids)):
+            s = self._slot_of.get(i, -1)
+            if s < 0:
+                fresh.append((k, i))
+            slots[k] = s
+        if fresh:
+            self._grow(self._n + len(fresh))
+            for k, i in fresh:
+                s = self._n
+                self._n += 1
+                self._slot_of[i] = s
+                slots[k] = s
+                self._vals[s] = 0.0
+        return slots
+
+    def _set_stats_locked(self, slots: np.ndarray, state: Dict):
+        """Accessor-stat hook (show/click/... in CtrSparseTable)."""
+
+    def set_row_state(self, state: Dict[str, np.ndarray]):
+        """Install rows with their full state (inverse of row_state)."""
+        ids = np.asarray(state["ids"], np.int64).reshape(-1)
+        with self._lock:
+            self._set_row_state_locked(ids, state)
+
+    def _set_row_state_locked(self, ids, state):
+        slots = self._install_slots_locked(ids)
+        self._vals[slots] = np.asarray(state["vals"], np.float32)
+        for key, attr in (("m", "_m"), ("v", "_v")):
+            arr = state.get(key)
+            if arr is None:
+                continue
+            arr = np.asarray(arr, np.float32)
+            # a lazily-absent moment matrix equals all-zeros; only
+            # materialize storage when the incoming state is nonzero
+            if getattr(self, attr) is None and not arr.any():
+                continue
+            if getattr(self, attr) is None:
+                setattr(self, attr,
+                        np.zeros((len(self._vals), self.dim), np.float32))
+            getattr(self, attr)[slots] = arr
+        t = state.get("t")
+        if t is not None:
+            t = np.asarray(t, np.int64)
+            if self._t is None and t.any():
+                self._t = np.zeros(len(self._vals), np.int64)
+            if self._t is not None:
+                self._t[slots] = t
+        self._set_stats_locked(slots, state)
+        self._dirty.update(ids.tolist())
+        self._deleted.difference_update(ids.tolist())
+
+    def drain_dirty(self):
+        """Atomically take (changed_ids, deleted_ids) accumulated since
+        the last drain — the incremental-snapshot delta source."""
+        with self._lock:
+            dirty = np.array(sorted(self._dirty), np.int64)
+            deleted = np.array(sorted(self._deleted), np.int64)
+            self._dirty.clear()
+            self._deleted.clear()
+            return dirty, deleted
+
+    def all_ids(self) -> np.ndarray:
+        with self._lock:
+            return np.array(sorted(self._slot_of), np.int64)
+
+    def _compact_locked(self, keep: np.ndarray) -> int:
+        """Drop rows whose slot mask is False and compact storage; returns
+        the number dropped.  Caller holds the lock."""
+        n = self._n
+        if keep.all():
+            return 0
+        kept_slots = np.nonzero(keep)[0]
+        remap = {int(s): k for k, s in enumerate(kept_slots)}
+        self._slot_of = {i: remap[s] for i, s in self._slot_of.items()
+                         if s in remap}
+        m = len(kept_slots)
+        self._vals[:m] = self._vals[kept_slots]
+        self._vals[m:n] = 0.0     # freed tail: no stale state may leak
+        for attr in ("_m", "_v"):
+            arr = getattr(self, attr)
+            if arr is not None:
+                arr[:m] = arr[kept_slots]
+                arr[m:n] = 0.0
+        if self._t is not None:
+            self._t[:m] = self._t[kept_slots]
+            self._t[m:n] = 0
+        self._compact_stats_locked(kept_slots, m, n)
+        self._n = m
+        return n - m
+
+    def _compact_stats_locked(self, kept_slots, m, n):
+        """Accessor-stat compaction hook (CtrSparseTable)."""
+
+    def evict_rows(self, ids) -> int:
+        """Drop rows by id (tier demotion — the row stays alive in the
+        cold tier, so this does NOT record into the deleted set; lifecycle
+        eviction goes through shrink())."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            drop = {self._slot_of[int(i)] for i in ids.tolist()
+                    if int(i) in self._slot_of}
+            if not drop:
+                return 0
+            keep = np.ones(self._n, bool)
+            keep[list(drop)] = False
+            return self._compact_locked(keep)
+
     def save(self, path):
+        """Atomic full dump (tmp+fsync+rename through the checkpoint
+        plane — a crash mid-save can never leave a torn file the next
+        load() trusts) including optimizer state, round-tripped
+        bit-exactly."""
         with self._lock:
             ids = np.array(sorted(self._slot_of), np.int64)
-            slots = np.array([self._slot_of[i] for i in ids.tolist()],
-                             np.int64)
-            vals = (self._vals[slots] if len(ids)
-                    else np.zeros((0, self.dim), np.float32))
-        np.savez(path, ids=ids, vals=vals, dim=self.dim)
+            state = self._row_state_locked(ids)
+        _dump_state_npz(path, self.dim, state)
 
     def load(self, path):
-        data = np.load(path if str(path).endswith(".npz") else path + ".npz")
-        ids, vals = data["ids"], data["vals"]
+        p = str(path)
+        data = np.load(p if p.endswith(".npz") else p + ".npz")
+        ids = np.asarray(data["ids"], np.int64)
+        state = {k: data[k] for k in data.files if k != "dim"}
         with self._lock:
+            cap = max(1024, len(ids))
             self._slot_of = {}
             self._n = 0
-            self._vals = np.zeros((max(1024, len(ids)), self.dim),
-                                  np.float32)
+            self._vals = np.zeros((cap, self.dim), np.float32)
             self._m = self._v = self._t = None
-            for k, i in enumerate(ids.tolist()):
-                self._slot_of[int(i)] = k
-            self._n = len(ids)
-            self._vals[: len(ids)] = vals
+            self._reset_stats_locked(cap)
+            self._set_row_state_locked(ids, state)
+            # a freshly-loaded table is wholly dirty: the next incremental
+            # snapshot must capture everything it now holds
+            self._dirty = set(self._slot_of)
+            self._deleted = set()
+
+    def _reset_stats_locked(self, cap):
+        """Accessor-stat reset hook for load() (CtrSparseTable)."""
 
 
 class CtrAccessorConfig:
@@ -294,13 +499,18 @@ class CtrSparseTable(CommonSparseTable):
         uniq, inv = np.unique(ids, return_inverse=True)
         with self._lock:        # one slot resolve, stats+admission+train
             slots = self._slots(uniq.tolist())
+            self._dirty.update(uniq.tolist())
             np.add.at(self._show, slots[inv], shows)
             np.add.at(self._click, slots[inv], clicks)
             self._unseen[slots] = 0
             newly = (~self._admitted[slots]
                      & (self._score(slots) >= self.cfg.embedx_threshold))
             if newly.any():
-                init = self.init(int(newly.sum()), self.dim - 1)
+                # embedx columns sit at offset 1 in the row — col0 keeps
+                # the id-deterministic init distinct from the w column
+                init = self._init_rows(int(newly.sum()), self.dim - 1,
+                                       ids=uniq[newly].astype(np.int64),
+                                       col0=1)
                 self._vals[slots[newly], 1:] = init
                 self._admitted[slots[newly]] = True
             grads = grads.copy()
@@ -315,6 +525,7 @@ class CtrSparseTable(CommonSparseTable):
             self._show[:n] *= self.cfg.show_click_decay_rate
             self._click[:n] *= self.cfg.show_click_decay_rate
             self._unseen[:n] += 1
+            self._dirty.update(self._slot_of)
 
     def shrink(self):
         """Evict cold features (Table::Shrink): score below the delete
@@ -328,28 +539,412 @@ class CtrSparseTable(CommonSparseTable):
                        <= self.cfg.delete_after_unseen_days))
             if keep.all():
                 return 0
-            kept_slots = slots[keep]
-            remap = {int(s): k for k, s in enumerate(kept_slots)}
-            self._slot_of = {i: remap[s] for i, s in self._slot_of.items()
-                             if s in remap}
-            m = len(kept_slots)
-            self._vals[:m] = self._vals[kept_slots]
-            self._vals[m:n] = 0.0     # freed tail: no stale state may leak
-            for attr in ("_show", "_click", "_unseen", "_admitted"):
-                arr = getattr(self, attr)
-                arr[:m] = arr[kept_slots]
-                arr[m:n] = 0
-            for attr in ("_m", "_v"):
-                arr = getattr(self, attr)
-                if arr is not None:
-                    arr[:m] = arr[kept_slots]
-                    arr[m:n] = 0.0
-            if self._t is not None:
-                self._t[:m] = self._t[kept_slots]
-                self._t[m:n] = 0
-            evicted = n - m
-            self._n = m
+            dropped = {int(s) for s in slots[~keep]}
+            gone = [i for i, s in self._slot_of.items() if s in dropped]
+            evicted = self._compact_locked(keep)
+            self._deleted.update(gone)
+            self._dirty.difference_update(gone)
             return evicted
+
+    # -- row-state hooks ----------------------------------------------------
+    def _row_state_locked(self, ids):
+        st = super()._row_state_locked(ids)
+        slots = np.array([self._slot_of[int(i)] for i in
+                          np.asarray(ids, np.int64).reshape(-1).tolist()],
+                         np.int64)
+        st["show"] = self._show[slots].copy()
+        st["click"] = self._click[slots].copy()
+        st["unseen"] = self._unseen[slots].copy()
+        st["admitted"] = self._admitted[slots].copy()
+        return st
+
+    def _set_stats_locked(self, slots, state):
+        for key, attr, dt in (("show", "_show", np.float32),
+                              ("click", "_click", np.float32),
+                              ("unseen", "_unseen", np.int32),
+                              ("admitted", "_admitted", bool)):
+            arr = state.get(key)
+            if arr is not None:
+                getattr(self, attr)[slots] = np.asarray(arr, dt)
+
+    def _compact_stats_locked(self, kept_slots, m, n):
+        for attr in ("_show", "_click", "_unseen", "_admitted"):
+            arr = getattr(self, attr)
+            arr[:m] = arr[kept_slots]
+            arr[m:n] = 0
+
+    def _reset_stats_locked(self, cap):
+        self._show = np.zeros(cap, np.float32)
+        self._click = np.zeros(cap, np.float32)
+        self._unseen = np.zeros(cap, np.int32)
+        self._admitted = np.zeros(cap, bool)
+
+
+def _dump_state_npz(path, dim, state):
+    """Serialize a row-state dict to `.npz` via the checkpoint plane's
+    atomic tmp+fsync+rename write."""
+    import io
+
+    from ...fluid.checkpoint import atomic_write_bytes
+    buf = io.BytesIO()
+    np.savez(buf, dim=np.int64(dim), **state)
+    p = str(path)
+    if not p.endswith(".npz"):
+        p += ".npz"
+    atomic_write_bytes(p, buf.getvalue())
+
+
+class ColdRowStore:
+    """mmap'd cold tier: per-field row-state storage on disk keyed by a
+    free-slot allocator.  The big matrix fields (vals / adam m / adam v)
+    live in ``np.memmap`` files so a terabyte-class tier costs page
+    cache, not RAM; the small per-row stat columns (t, show, click,
+    unseen, admitted) stay in RAM arrays so eviction scans and daily
+    decay never fault cold pages in."""
+
+    _MAT_FIELDS = ("vals", "m", "v")
+
+    def __init__(self, dir_, dim, ctr=True, capacity=1024):
+        import os
+        self.dir = str(dir_)
+        os.makedirs(self.dir, exist_ok=True)
+        self.dim = int(dim)
+        self.ctr = bool(ctr)
+        self._slot_of: Dict[int, int] = {}
+        self._free: list = []
+        self._next = 0
+        self._cap = 0
+        self._maps: Dict[str, np.memmap] = {}
+        self._cols: Dict[str, np.ndarray] = {"t": np.zeros(0, np.int64)}
+        if ctr:
+            self._cols.update(
+                show=np.zeros(0, np.float32),
+                click=np.zeros(0, np.float32),
+                unseen=np.zeros(0, np.int32),
+                admitted=np.zeros(0, bool))
+        self._ensure_cap(capacity)
+
+    def _ensure_cap(self, need):
+        import os
+        if need <= self._cap and self._maps:
+            return
+        cap = max(1024, self._cap)
+        while cap < need:
+            cap *= 2
+        for name in self._MAT_FIELDS:
+            path = os.path.join(self.dir, f"cold-{name}.f32")
+            # truncate-extend preserves existing bytes and zero-fills the
+            # tail, so growing never copies row data through RAM
+            mode = "r+b" if os.path.exists(path) else "w+b"
+            with open(path, mode) as f:
+                f.truncate(cap * self.dim * 4)
+            self._maps[name] = np.memmap(path, np.float32, mode="r+",
+                                         shape=(cap, self.dim))
+        for name, arr in self._cols.items():
+            g = np.zeros(cap, arr.dtype)
+            g[: len(arr)] = arr
+            self._cols[name] = g
+        self._cap = cap
+
+    def __contains__(self, fid) -> bool:
+        return int(fid) in self._slot_of
+
+    def size(self) -> int:
+        return len(self._slot_of)
+
+    def ids(self) -> np.ndarray:
+        return np.array(sorted(self._slot_of), np.int64)
+
+    def _slots_for(self, ids, create):
+        slots = np.empty(len(ids), np.int64)
+        for k, i in enumerate(map(int, ids)):
+            s = self._slot_of.get(i, -1)
+            if s < 0:
+                if not create:
+                    raise KeyError(i)
+                if self._free:
+                    s = self._free.pop()
+                else:
+                    s = self._next
+                    self._next += 1
+                    self._ensure_cap(self._next)
+                self._slot_of[i] = s
+            slots[k] = s
+        return slots
+
+    def put(self, state: Dict[str, np.ndarray]):
+        """Install/overwrite rows with full state (tier demotion)."""
+        ids = np.asarray(state["ids"], np.int64).reshape(-1)
+        if not len(ids):
+            return
+        slots = self._slots_for(ids, create=True)
+        for name in self._MAT_FIELDS:
+            arr = state.get(name)
+            self._maps[name][slots] = (
+                0.0 if arr is None else np.asarray(arr, np.float32))
+        for name, col in self._cols.items():
+            arr = state.get(name)
+            col[slots] = (0 if arr is None
+                          else np.asarray(arr, col.dtype))
+
+    def get(self, ids) -> Dict[str, np.ndarray]:
+        """Full row state for existing ids (KeyError on unknown)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        slots = self._slots_for(ids, create=False)
+        st = {"ids": ids.copy()}
+        for name in self._MAT_FIELDS:
+            st[name] = np.array(self._maps[name][slots])  # copy off mmap
+        for name, col in self._cols.items():
+            st[name] = col[slots].copy()
+        return st
+
+    def delete(self, ids):
+        for i in map(int, np.asarray(ids, np.int64).reshape(-1)):
+            s = self._slot_of.pop(i, None)
+            if s is not None:
+                self._free.append(s)
+
+    def clear(self):
+        self._slot_of.clear()
+        self._free = []
+        self._next = 0
+
+    def decay(self, rate, age=1):
+        """Daily stat decay applied in place — the SAME elementwise
+        float32 multiply the hot tier runs, so a row's stats are
+        bit-identical whichever tier it sat in when the day ended."""
+        if not self.ctr or not self._slot_of:
+            return
+        used = np.fromiter(self._slot_of.values(), np.int64,
+                           len(self._slot_of))
+        self._cols["show"][used] *= rate
+        self._cols["click"][used] *= rate
+        self._cols["unseen"][used] += age
+
+    def flush(self):
+        for m in self._maps.values():
+            m.flush()
+
+
+class TieredSparseTable:
+    """Bounded hot tier (a plain in-RAM table) fronting an mmap'd cold
+    tier on disk.  Promotion on pull/push and demotion on overflow copy
+    full row state verbatim through the row-state plane, so a tiered
+    table is bit-identical to its plain table on any op stream,
+    regardless of hot capacity.  Eviction picks the lowest CtrAccessor
+    show/click score (ties: longest-unseen, then smallest id — fully
+    deterministic)."""
+
+    def __init__(self, table, hot_rows, cold_dir):
+        from ...fluid import trace as _trace
+        self.hot = table
+        self.hot_rows = int(hot_rows)
+        self._ctr = isinstance(table, CtrSparseTable)
+        self.cold = ColdRowStore(cold_dir, table.dim, ctr=self._ctr)
+        self.dim = table.dim
+        self.cfg = getattr(table, "cfg", None)
+        self._lock = threading.RLock()
+        self._cold_dirty: set = set()
+        self._cold_deleted: set = set()
+        m = _trace.metrics()
+        self._c_evict = m.counter("ps.evictions")
+        self._c_promote = m.counter("ps.promotions")
+        self._g_hot = m.gauge("ps.hot_rows")
+        self._g_cold = m.gauge("ps.cold_rows")
+        self.evictions = 0
+        self.promotions = 0
+
+    # -- tier movement ------------------------------------------------------
+    def _promote_locked(self, ids):
+        if not len(ids):
+            return
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        st = self.cold.get(ids)
+        self.cold.delete(ids)
+        self.hot.set_row_state(st)
+        self.promotions += len(ids)
+        self._c_promote.inc(len(ids))
+
+    def _promote_needed_locked(self, ids):
+        need = [int(i) for i in np.unique(np.asarray(ids).reshape(-1))
+                if int(i) not in self.hot._slot_of and int(i) in self.cold]
+        if need:
+            self._promote_locked(np.array(need, np.int64))
+
+    def _evict_over_capacity_locked(self):
+        h = self.hot
+        over = h.size() - self.hot_rows
+        if self.hot_rows <= 0 or over <= 0:
+            return
+        n = h._n
+        slots = np.arange(n)
+        if self._ctr:
+            score = h._score(slots)
+            unseen = h._unseen[:n]
+        else:
+            score = np.zeros(n, np.float32)
+            unseen = np.zeros(n, np.int32)
+        id_by_slot = np.empty(n, np.int64)
+        for i, s in h._slot_of.items():
+            id_by_slot[s] = i
+        # primary: score ascending; then longest-unseen; then id
+        order = np.lexsort((id_by_slot, -unseen.astype(np.int64), score))
+        victims = id_by_slot[order[:over]]
+        self.cold.put(h.row_state(victims))
+        h.evict_rows(victims)
+        self.evictions += len(victims)
+        self._c_evict.inc(len(victims))
+        self._g_hot.set(h.size())
+        self._g_cold.set(self.cold.size())
+
+    # -- accessor API -------------------------------------------------------
+    def pull(self, ids):
+        with self._lock:
+            self._promote_needed_locked(ids)
+            out = self.hot.pull(ids)
+            self._evict_over_capacity_locked()
+            return out
+
+    def push(self, ids, grads, shows=None, clicks=None):
+        with self._lock:
+            self._promote_needed_locked(ids)
+            if self._ctr:
+                self.hot.push(ids, grads, shows=shows, clicks=clicks)
+            else:
+                self.hot.push(ids, grads)
+            self._evict_over_capacity_locked()
+
+    def push_delta(self, ids, deltas):
+        with self._lock:
+            self._promote_needed_locked(ids)
+            self.hot.push_delta(ids, deltas)
+            self._evict_over_capacity_locked()
+
+    def set_rows(self, ids, values):
+        with self._lock:
+            self._promote_needed_locked(ids)
+            self.hot.set_rows(ids, values)
+            self._evict_over_capacity_locked()
+
+    def end_day(self):
+        with self._lock:
+            if hasattr(self.hot, "end_day"):
+                self.hot.end_day()
+            if self._ctr:
+                self.cold.decay(self.cfg.show_click_decay_rate)
+                self._cold_dirty.update(self.cold._slot_of)
+
+    def shrink(self) -> int:
+        with self._lock:
+            ev = self.hot.shrink() if hasattr(self.hot, "shrink") else 0
+            if self._ctr and self.cold.size():
+                used_ids = self.cold.ids()
+                slots = self.cold._slots_for(used_ids, create=False)
+                show = self.cold._cols["show"][slots]
+                click = self.cold._cols["click"][slots]
+                unseen = self.cold._cols["unseen"][slots]
+                cfg = self.cfg
+                score = (cfg.nonclk_coeff * (show - click)
+                         + cfg.click_coeff * click)
+                keep = ((score >= cfg.delete_threshold)
+                        & (unseen <= cfg.delete_after_unseen_days))
+                gone = used_ids[~keep]
+                if len(gone):
+                    self.cold.delete(gone)
+                    self._cold_deleted.update(gone.tolist())
+                    self._cold_dirty.difference_update(gone.tolist())
+                    ev += len(gone)
+            self._g_hot.set(self.hot.size())
+            self._g_cold.set(self.cold.size())
+            return ev
+
+    def size(self) -> int:
+        return self.hot.size() + self.cold.size()
+
+    # -- row-state plane ----------------------------------------------------
+    def _contains(self, fid) -> bool:
+        return int(fid) in self.hot._slot_of or int(fid) in self.cold
+
+    def _empty_state(self, n):
+        st = {"ids": np.zeros(n, np.int64),
+              "vals": np.zeros((n, self.dim), np.float32),
+              "m": np.zeros((n, self.dim), np.float32),
+              "v": np.zeros((n, self.dim), np.float32),
+              "t": np.zeros(n, np.int64)}
+        if self._ctr:
+            st.update(show=np.zeros(n, np.float32),
+                      click=np.zeros(n, np.float32),
+                      unseen=np.zeros(n, np.int32),
+                      admitted=np.zeros(n, bool))
+        return st
+
+    def row_state(self, ids) -> Dict[str, np.ndarray]:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            out = self._empty_state(len(ids))
+            out["ids"] = ids.copy()
+            hot_sel = np.array([int(i) in self.hot._slot_of
+                                for i in ids.tolist()], bool)
+            if hot_sel.any():
+                st = self.hot.row_state(ids[hot_sel])
+                for k, arr in st.items():
+                    if k != "ids":
+                        out[k][hot_sel] = arr
+            if (~hot_sel).any():
+                st = self.cold.get(ids[~hot_sel])
+                for k, arr in st.items():
+                    if k != "ids":
+                        out[k][~hot_sel] = arr
+            return out
+
+    def set_row_state(self, state):
+        with self._lock:
+            ids = np.asarray(state["ids"], np.int64).reshape(-1)
+            stale = [int(i) for i in ids.tolist() if int(i) in self.cold]
+            if stale:       # never leave a second copy in the cold tier
+                self.cold.delete(stale)
+            self.hot.set_row_state(state)
+            self._evict_over_capacity_locked()
+
+    def drain_dirty(self):
+        with self._lock:
+            d_h, x_h = self.hot.drain_dirty()
+            dirty = set(d_h.tolist()) | self._cold_dirty
+            deleted = set(x_h.tolist()) | self._cold_deleted
+            self._cold_dirty.clear()
+            self._cold_deleted.clear()
+            # existence wins: an id deleted then re-created is dirty, an
+            # id dirtied then deleted is deleted
+            dirty = {i for i in dirty if self._contains(i)}
+            deleted = {i for i in deleted if not self._contains(i)}
+            return (np.array(sorted(dirty), np.int64),
+                    np.array(sorted(deleted), np.int64))
+
+    def all_ids(self) -> np.ndarray:
+        with self._lock:
+            return np.array(sorted(set(self.hot._slot_of)
+                                   | set(self.cold._slot_of)), np.int64)
+
+    def save(self, path):
+        with self._lock:
+            state = self.row_state(self.all_ids())
+        _dump_state_npz(path, self.dim, state)
+
+    def load(self, path):
+        with self._lock:
+            self.hot.load(path)
+            self.cold.clear()
+            self._cold_dirty = set()
+            self._cold_deleted = set()
+            self._evict_over_capacity_locked()
+
+    def tier_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hot_rows": self.hot.size(),
+                    "cold_rows": self.cold.size(),
+                    "hot_capacity": self.hot_rows,
+                    "evictions": self.evictions,
+                    "promotions": self.promotions}
 
 
 class CommonDenseTable:

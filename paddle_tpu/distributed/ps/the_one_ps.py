@@ -40,11 +40,16 @@ class TheOnePSRuntime:
 
     # -- table registry (in-process mode) -----------------------------------
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
-                            init_kind="uniform", init_scale=0.07):
+                            init_kind="uniform", init_scale=0.07,
+                            hot_rows=None):
         if self._client is not None:
+            if hot_rows is None:
+                from ...fluid import core
+                hot_rows = core.get_flag("ps_hot_rows", 0)
             self._client.create_sparse_table(name, dim, optimizer, lr,
                                              init_kind=init_kind,
-                                             init_scale=init_scale)
+                                             init_scale=init_scale,
+                                             hot_rows=int(hot_rows))
             return None
         if name not in self._tables:
             from .table import Initializer
@@ -118,7 +123,8 @@ class TheOnePSRuntime:
             return                      # in-process mode
         from .rpc import PsClient
         from .communicator import HeartBeater, make_communicator
-        self._client = PsClient(eps)
+        self._client = PsClient(eps,
+                                partitioner=self._make_partitioner(eps))
         hb_interval = float(os.environ.get("PADDLE_PS_HEARTBEAT_INTERVAL",
                                            "2.0"))
         if hb_interval > 0:                 # <=0 disables, like the
@@ -136,6 +142,19 @@ class TheOnePSRuntime:
         elif strat is not None:
             mode = "sync"
         self._communicator = make_communicator(mode, self._client, **cfg)
+
+    @staticmethod
+    def _make_partitioner(eps):
+        """PADDLE_PS_CONSISTENT_HASH=1 replaces the classic `id % n`
+        layout with the sharded ring — every worker AND every durable
+        server restore must agree on it (same seed everywhere, from
+        PADDLE_PS_HASH_SEED), or rows change owners mid-job."""
+        if os.environ.get("PADDLE_PS_CONSISTENT_HASH",
+                          "0") in ("0", "", "false", "False"):
+            return None
+        from .sharded import HashRing
+        seed = int(os.environ.get("PADDLE_PS_HASH_SEED", "0"))
+        return HashRing(len(eps), seed=seed).owners
 
     @property
     def client(self):
@@ -159,10 +178,20 @@ class TheOnePSRuntime:
                 f"PADDLE_PSERVERS_IP_PORT_LIST {eps} — a silent shard_idx "
                 f"fallback would duplicate shard identities")
         shard_idx = eps.index(my_ep)
-        self._server = PsServer(
-            host="0.0.0.0" if os.environ.get("POD_IP") else "127.0.0.1",
-            port=port, shard_idx=shard_idx, n_servers=len(eps),
-            n_trainers=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        host = "0.0.0.0" if os.environ.get("POD_IP") else "127.0.0.1"
+        n_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        state_dir = os.environ.get("PADDLE_PS_STATE_DIR", "")
+        if state_dir:
+            # durable shard: WAL + incremental snapshots + boot restore
+            from .sharded import ShardServer
+            self._server = ShardServer(
+                host=host, port=port, shard_idx=shard_idx,
+                n_servers=len(eps), n_trainers=n_trainers,
+                state_dir=os.path.join(state_dir, f"shard{shard_idx}"))
+        else:
+            self._server = PsServer(
+                host=host, port=port, shard_idx=shard_idx,
+                n_servers=len(eps), n_trainers=n_trainers)
         self._server.start()
         hb_timeout = float(os.environ.get("PADDLE_PS_HEARTBEAT_TIMEOUT",
                                           "120"))

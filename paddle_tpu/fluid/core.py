@@ -270,6 +270,24 @@ _FLAGS: Dict[str, object] = {
         "FLAGS_fleet_breaker_failures", "5") or 5),
     "fleet_breaker_cooldown_s": float(_os.environ.get(
         "FLAGS_fleet_breaker_cooldown_s", "3.0") or 3.0),
+    # sharded parameter server (distributed/ps/sharded.py,
+    # docs/parameter_server.md).  ps_staleness bounds how many async
+    # pushes may be outstanding before a pull fences (0 = fully
+    # synchronous = bit-parity with the single-table baseline);
+    # ps_hot_rows caps each shard's hot RAM tier (0 = untired);
+    # ps_snapshot_every takes an incremental snapshot after every N
+    # logged mutations (0 = manual snapshots only); ps_wal_fsync forces
+    # fsync per WAL record (off: flush to the OS, which survives process
+    # SIGKILL — the restart drill — but not machine loss);
+    # ps_shard_vnodes sets virtual nodes per shard on the hash ring.
+    "ps_staleness": int(_os.environ.get("FLAGS_ps_staleness", "0") or 0),
+    "ps_hot_rows": int(_os.environ.get("FLAGS_ps_hot_rows", "0") or 0),
+    "ps_snapshot_every": int(_os.environ.get(
+        "FLAGS_ps_snapshot_every", "0") or 0),
+    "ps_wal_fsync": _os.environ.get(
+        "FLAGS_ps_wal_fsync", "0") not in ("0", "", "false", "False"),
+    "ps_shard_vnodes": int(_os.environ.get(
+        "FLAGS_ps_shard_vnodes", "64") or 64),
     # kernel tier (fluid/passes/kernel_tier.py, ops/attention.py): minimum
     # sequence length before attention dispatches to the Pallas flash
     # kernel.  Default 1024 — measured on the round-3 BERT sweep: at seq

@@ -8,11 +8,11 @@ pipeline, or replaying restarts looks healthy on a steps/sec counter —
 the badput only shows up when every wall-clock second is charged to
 exactly one bucket.
 
-This module classifies a run's wall-clock into seven exhaustive,
+This module classifies a run's wall-clock into eight exhaustive,
 mutually-exclusive buckets by consuming the spans the earlier PRs already
 emit (``executor::compile``, ``executor::step``, ``executor::host_wait``,
 ``loader::wait``, ``checkpoint::save``/``::submit``/``::restore``,
-``elastic::drain``):
+``elastic::drain``, ``ps::pull_wait``):
 
 =================  =========================================================
 bucket             meaning
@@ -34,6 +34,9 @@ preemption_drain   closing the in-flight window on preemption
                    (``elastic::drain``)
 restart_init       process start -> first instrumented activity, plus
                    ``checkpoint::restore``
+ps_pull_wait       step blocked on sharded parameter-server pulls
+                   (``ps::pull_wait`` — what the PS prefetcher failed to
+                   hide)
 idle               everything else (host-side gaps the plane cannot name)
 =================  =========================================================
 
@@ -81,7 +84,8 @@ __all__ = [
 
 #: every wall-clock second lands in exactly one of these
 BUCKETS = ("device_compute", "host_input_wait", "compile",
-           "checkpoint_stall", "preemption_drain", "restart_init", "idle")
+           "checkpoint_stall", "preemption_drain", "restart_init",
+           "ps_pull_wait", "idle")
 
 PRODUCTIVE_BUCKET = "device_compute"
 
@@ -89,9 +93,12 @@ PRODUCTIVE_BUCKET = "device_compute"
 # CONTAINS the host_wait spans of the window it closes, a sync
 # checkpoint::save inside drain_and_save, the first executor::step
 # overlaps its own executor::compile — the strongest bucket owns the
-# overlap and nothing double-counts.
+# overlap and nothing double-counts.  ps_pull_wait sits between the input
+# wait and device compute: a PS pull stalled inside a loader wait is the
+# loader's problem, but a pull stalling the step body is its own bucket.
 _PRIORITY = ("preemption_drain", "checkpoint_stall", "restart_init",
-             "compile", "host_input_wait", "device_compute")
+             "compile", "host_input_wait", "ps_pull_wait",
+             "device_compute")
 _PRIO_INDEX = {b: i for i, b in enumerate(_PRIORITY)}
 
 
@@ -109,6 +116,9 @@ def classify_event(ev: Dict[str, Any]) -> Optional[str]:
         return "device_compute"
     if name == "loader::wait":
         return "host_input_wait"
+    if name == "ps::pull_wait":
+        # sharded-PS pull latency the prefetcher failed to hide
+        return "ps_pull_wait"
     if name == "checkpoint::submit":
         return "checkpoint_stall"
     if name == "checkpoint::save":
@@ -409,6 +419,7 @@ def from_metrics(wall_s: float) -> Dict[str, Any]:
     buckets["checkpoint_stall"] = _total("ckpt.stall_seconds")
     buckets["preemption_drain"] = _total("elastic.drain_seconds")
     buckets["restart_init"] = _total("ckpt.restore_seconds")
+    buckets["ps_pull_wait"] = _total("ps.pull_wait_seconds")
     badput = sum(buckets.values())
     if badput > wall_s > 0.0:           # totals can exceed a sub-run wall
         scale = wall_s / badput

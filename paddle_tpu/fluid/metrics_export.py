@@ -218,11 +218,29 @@ def stats_payload() -> Dict[str, Any]:
             n = _counter(f"fault.{k}")
             if n:
                 out["faults"][k] = n
-    # PS-tier health (start_heartbeat_monitor's gauges): surfaced in
-    # the compact payload so the fleet aggregator and chaos drills see
-    # dead workers without a full /metrics scrape
+    # PS-tier health: worker liveness (start_heartbeat_monitor), the
+    # sharded tier's storage/latency-hiding instruments, and per-shard
+    # breaker state — surfaced in the compact payload so the fleet
+    # aggregator and chaos drills see the PS plane without a full
+    # /metrics scrape
     ps = {"dead_workers": int(_gauge("ps.dead_workers")),
-          "worker_deaths": _counter("ps.worker_deaths")}
+          "worker_deaths": _counter("ps.worker_deaths"),
+          "shards_up": int(_gauge("ps.shards_up")),
+          "breaker_open": int(_gauge("ps.breaker_open")),
+          "shard_restarts": _counter("ps.shard_restarts"),
+          "hot_rows": int(_gauge("ps.hot_rows")),
+          "cold_rows": int(_gauge("ps.cold_rows")),
+          "evictions": _counter("ps.evictions"),
+          "promotions": _counter("ps.promotions"),
+          "prefetch_hits": _counter("ps.prefetch_hits"),
+          "prefetch_misses": _counter("ps.prefetch_misses"),
+          "prefetch_patched": _counter("ps.prefetch_patched"),
+          "fence_stalls": _counter("ps.fence_stalls"),
+          "outstanding_pushes": int(_gauge("ps.outstanding_pushes")),
+          "snapshots": _counter("ps.snapshots"),
+          "restores": _counter("ps.restores"),
+          "wal_records": _counter("ps.wal_records"),
+          "pull_wait_p99_ms": _p99_ms("ps.pull_wait_seconds")}
     if any(ps.values()):
         out["ps"] = ps
     return out

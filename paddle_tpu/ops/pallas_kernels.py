@@ -27,6 +27,8 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention_tpu", "fused_dropout_tpu",
            "fused_dropout_add_tpu", "fused_act_dropout_tpu",
            "fused_embedding_pool_tpu", "embedding_pool_grad_tpu",
+           "fused_embedding_pool_stream_tpu",
+           "embedding_pool_grad_stream_tpu",
            "fused_embedding_pool_supported",
            "fused_adam_tpu", "fused_momentum_tpu",
            "paged_flash_attention_tpu", "paged_attention_supported"]
@@ -418,13 +420,24 @@ _EMB_VMEM_BYTES = 4 << 20     # the table block must fit VMEM; bigger tables
 
 
 def fused_embedding_pool_supported(w, ids) -> bool:
-    """Static gate for the pallas path: lane-aligned row dim, 2-d ids, and
-    a table small enough to hold as one VMEM block (the streaming-DMA
-    variant for HBM-resident tables is future work — ROADMAP item 4)."""
+    """Static gate for the pallas path: lane-aligned row dim and 2-d ids.
+    Tables that fit one VMEM block take the whole-table kernels below;
+    bigger tables take the streaming variants (grid over row blocks) —
+    the old ≤4MB whole-table ceiling is no longer a gate."""
     if w.ndim != 2 or ids.ndim != 2 or ids.shape[1] == 0:
         return False
+    return w.shape[1] % 128 == 0
+
+
+def _emb_whole_table_ok(w) -> bool:
     v, d = w.shape
-    return d % 128 == 0 and v * d * w.dtype.itemsize <= _EMB_VMEM_BYTES
+    return v * d * w.dtype.itemsize <= _EMB_VMEM_BYTES
+
+
+def _emb_stream_block_rows(d, itemsize) -> int:
+    """Largest fp32-sublane-aligned row count whose [block_rows, d] block
+    fits the VMEM budget."""
+    return max(8, (_EMB_VMEM_BYTES // (d * itemsize)) // 8 * 8)
 
 
 def _gather_pool_kernel(ids_ref, wgt_ref, w_ref, o_ref, *, n_ids):
@@ -442,7 +455,10 @@ def _gather_pool_kernel(ids_ref, wgt_ref, w_ref, o_ref, *, n_ids):
 def fused_embedding_pool_tpu(w, ids, wgt):
     """out[i] = sum_j w[ids[i, j]] * wgt[i, j] — gather and pool in one
     kernel.  ``wgt`` carries the pooling semantics (0 for padding_idx /
-    beyond-length positions, 1/len for mean pooling)."""
+    beyond-length positions, 1/len for mean pooling).  Tables beyond the
+    VMEM block budget take the streaming variant."""
+    if not _emb_whole_table_ok(w):
+        return fused_embedding_pool_stream_tpu(w, ids, wgt)
     b, s = ids.shape
     v, d = w.shape
     return pl.pallas_call(
@@ -454,6 +470,61 @@ def fused_embedding_pool_tpu(w, ids, wgt):
                                memory_space=pltpu.SMEM),
                   pl.BlockSpec((v, d), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((1, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, d), w.dtype),
+    )(ids.astype(jnp.int32), wgt.astype(w.dtype), w)
+
+
+def _gather_pool_stream_kernel(ids_ref, wgt_ref, w_ref, o_ref, *, n_ids,
+                               block_rows):
+    """Streaming forward: grid (batch, row_blocks), one [block_rows, d]
+    table slab resident per step.  Each step folds the ids that land in
+    its slab into the pooled row; out-of-slab positions contribute an
+    exact 0 (weight masked), so out[i] = sum over slabs of partials —
+    the pooled sum regrouped by slab (sum pooling reassociated; each
+    term is still w[id] * wgt computed once)."""
+    k = pl.program_id(1)
+    d = o_ref.shape[-1]
+    base = k * block_rows
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    def body(j, acc):
+        local = ids_ref[0, j] - base
+        in_blk = jnp.logical_and(local >= 0, local < block_rows)
+        row = pl.load(w_ref, (pl.dslice(jnp.where(in_blk, local, 0), 1),
+                              pl.dslice(0, d)))
+        wj = jnp.where(in_blk, wgt_ref[0, j],
+                       jnp.zeros((), w_ref.dtype))
+        return acc + row * wj
+
+    o_ref[:] += jax.lax.fori_loop(
+        0, n_ids, body, jnp.zeros((1, d), w_ref.dtype))
+
+
+def fused_embedding_pool_stream_tpu(w, ids, wgt, block_rows=None):
+    """Streaming gather+pool for tables bigger than one VMEM block: the
+    table streams through VMEM as [block_rows, d] slabs (row-block grid
+    axis, innermost so each output row accumulates over consecutive
+    steps), ids/weights ride in SMEM.  HBM-size tables never hit the old
+    ≤4MB whole-table ceiling."""
+    b, s = ids.shape
+    v, d = w.shape
+    br = int(block_rows or _emb_stream_block_rows(d, w.dtype.itemsize))
+    vp = -(-v // br) * br
+    if vp != v:                  # pad to a whole number of slabs; padding
+        w = jnp.pad(w, ((0, vp - v), (0, 0)))      # rows are never indexed
+    return pl.pallas_call(
+        functools.partial(_gather_pool_stream_kernel, n_ids=s,
+                          block_rows=br),
+        grid=(b, vp // br),
+        in_specs=[pl.BlockSpec((1, s), lambda i, k: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, s), lambda i, k: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((br, d), lambda i, k: (k, 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i, k: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((b, d), w.dtype),
     )(ids.astype(jnp.int32), wgt.astype(w.dtype), w)
 
@@ -479,9 +550,12 @@ def _scatter_grad_kernel(ids_ref, wgt_ref, g_ref, o_ref, *, n_ids):
 def embedding_pool_grad_tpu(g, ids, wgt, vocab):
     """dW[ids[i, j]] += g[i] * wgt[i, j]: the fused gradient scatter-add.
     The whole dW buffer is the (sequentially-gridded) output block, so the
-    accumulation never materialises per-position cotangent rows."""
+    accumulation never materialises per-position cotangent rows.  dW
+    buffers beyond the VMEM block budget take the streaming variant."""
     b, s = ids.shape
     d = g.shape[-1]
+    if vocab * d * g.dtype.itemsize > _EMB_VMEM_BYTES:
+        return embedding_pool_grad_stream_tpu(g, ids, wgt, vocab)
     return pl.pallas_call(
         functools.partial(_scatter_grad_kernel, n_ids=s),
         grid=(b,),
@@ -493,6 +567,60 @@ def embedding_pool_grad_tpu(g, ids, wgt, vocab):
         out_specs=pl.BlockSpec((vocab, d), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((vocab, d), g.dtype),
     )(ids.astype(jnp.int32), wgt.astype(g.dtype), g)
+
+
+def _scatter_grad_stream_kernel(ids_ref, wgt_ref, g_ref, o_ref, *, n_ids,
+                                block_rows):
+    """Streaming backward: grid (row_blocks, batch) — row-block axis
+    OUTERMOST so each [block_rows, d] dW slab stays resident while every
+    batch row scatters into it (consecutive revisits, the canonical
+    accumulation shape).  For any given table row the contributions
+    land in the same (i, j) order as the whole-table kernel, so the two
+    paths are bit-identical, not just close."""
+    k = pl.program_id(0)
+    i = pl.program_id(1)
+    d = o_ref.shape[-1]
+    base = k * block_rows
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    def body(j, _):
+        local = ids_ref[0, j] - base
+        in_blk = jnp.logical_and(local >= 0, local < block_rows)
+        safe = jnp.where(in_blk, local, 0)
+        cur = pl.load(o_ref, (pl.dslice(safe, 1), pl.dslice(0, d)))
+        wj = jnp.where(in_blk, wgt_ref[0, j], jnp.zeros((), g_ref.dtype))
+        # out-of-slab ids write row 0 back unchanged (wj == 0)
+        pl.store(o_ref, (pl.dslice(safe, 1), pl.dslice(0, d)),
+                 cur + g_ref[:] * wj)
+        return 0
+
+    jax.lax.fori_loop(0, n_ids, body, 0)
+
+
+def embedding_pool_grad_stream_tpu(g, ids, wgt, vocab, block_rows=None):
+    """Streaming scatter-add gradient for vocabularies whose dW exceeds
+    one VMEM block: dW is built slab by slab ([block_rows, d] output
+    grid axis), each slab swept once over the batch."""
+    b, s = ids.shape
+    d = g.shape[-1]
+    br = int(block_rows or _emb_stream_block_rows(d, g.dtype.itemsize))
+    vp = -(-vocab // br) * br
+    dw = pl.pallas_call(
+        functools.partial(_scatter_grad_stream_kernel, n_ids=s,
+                          block_rows=br),
+        grid=(vp // br, b),
+        in_specs=[pl.BlockSpec((1, s), lambda k, i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, s), lambda k, i: (i, 0),
+                               memory_space=pltpu.SMEM),
+                  pl.BlockSpec((1, d), lambda k, i: (i, 0))],
+        out_specs=pl.BlockSpec((br, d), lambda k, i: (k, 0)),
+        out_shape=jax.ShapeDtypeStruct((vp, d), g.dtype),
+    )(ids.astype(jnp.int32), wgt.astype(g.dtype), g)
+    return dw[:vocab] if vp != vocab else dw
 
 
 # ---------------------------------------------------------------------------

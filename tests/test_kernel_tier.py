@@ -547,6 +547,139 @@ class TestSatellites:
                               use_nesterov=True, l2_decay=1e-4),
             p, p, p, jnp.asarray(0.1))
 
+    # -- PR-18: streaming (row-block) embedding kernels ---------------------
+
+    @staticmethod
+    def _stream_fwd(w, ids, wgt, br, interpret=True):
+        """fused_embedding_pool_stream_tpu's exact pallas_call, interpret
+        mode (the wrapper itself has no interpret knob — CPU CI runs the
+        same grid/specs this way)."""
+        import functools
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from paddle_tpu.ops import pallas_kernels as pk
+        b, s = ids.shape
+        v, d = w.shape
+        vp = -(-v // br) * br
+        if vp != v:
+            w = jnp.pad(w, ((0, vp - v), (0, 0)))
+        return pl.pallas_call(
+            functools.partial(pk._gather_pool_stream_kernel, n_ids=s,
+                              block_rows=br),
+            grid=(b, vp // br),
+            in_specs=[pl.BlockSpec((1, s), lambda i, k: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, s), lambda i, k: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((br, d), lambda i, k: (k, 0))],
+            out_specs=pl.BlockSpec((1, d), lambda i, k: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, d), w.dtype),
+            interpret=interpret)(ids.astype(jnp.int32),
+                                 wgt.astype(w.dtype), w)
+
+    @staticmethod
+    def _stream_bwd(g, ids, wgt, vocab, br, interpret=True):
+        import functools
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from paddle_tpu.ops import pallas_kernels as pk
+        b, s = ids.shape
+        d = g.shape[-1]
+        vp = -(-vocab // br) * br
+        dw = pl.pallas_call(
+            functools.partial(pk._scatter_grad_stream_kernel, n_ids=s,
+                              block_rows=br),
+            grid=(vp // br, b),
+            in_specs=[pl.BlockSpec((1, s), lambda k, i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, s), lambda k, i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, d), lambda k, i: (i, 0))],
+            out_specs=pl.BlockSpec((br, d), lambda k, i: (k, 0)),
+            out_shape=jax.ShapeDtypeStruct((vp, d), g.dtype),
+            interpret=interpret)(ids.astype(jnp.int32),
+                                 wgt.astype(g.dtype), g)
+        return dw[:vocab] if vp != vocab else dw
+
+    def test_streaming_fwd_interpret_numerics(self):
+        """Streaming gather+pool == XLA reference; vocab 100 is NOT a
+        slab multiple, so the padded-tail path is exercised too."""
+        rng = np.random.RandomState(3)
+        w = jnp.asarray(rng.randn(100, 128).astype("float32"))
+        ids = jnp.asarray(rng.randint(0, 100, (4, 5)).astype("int32"))
+        wgt = jnp.asarray(rng.rand(4, 5).astype("float32"))
+        got = self._stream_fwd(w, ids, wgt, br=16)
+        want = jnp.einsum("bsd,bs->bd", jnp.take(w, ids, axis=0), wgt)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_streaming_fwd_bit_exact_on_dyadic(self):
+        """On dyadic values the slab reassociation is exact — the
+        streaming sum is the whole-table sum regrouped, each term
+        computed once."""
+        rng = np.random.RandomState(4)
+        w = jnp.asarray((rng.randint(-8, 8, (96, 128)) * 0.25)
+                        .astype("float32"))
+        ids = jnp.asarray(rng.randint(0, 96, (3, 7)).astype("int32"))
+        wgt = jnp.asarray((rng.randint(0, 4, (3, 7)) * 0.5)
+                          .astype("float32"))
+        got = self._stream_fwd(w, ids, wgt, br=32)
+        want = jnp.einsum("bsd,bs->bd", jnp.take(w, ids, axis=0), wgt)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_streaming_bwd_bit_identical_to_whole_table(self):
+        """The k-outermost grid keeps per-row contributions in the same
+        (i, j) order as the whole-table scatter kernel — bit-identical,
+        not allclose (duplicate ids included)."""
+        import functools
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from paddle_tpu.ops import pallas_kernels as pk
+        rng = np.random.RandomState(5)
+        vocab = 80                       # not a multiple of br=32
+        ids_np = rng.randint(0, vocab, (4, 6)).astype("int32")
+        ids_np[0, :3] = 7                # duplicate ids in one batch row
+        ids = jnp.asarray(ids_np)
+        wgt = jnp.asarray(rng.rand(4, 6).astype("float32"))
+        g = jnp.asarray(rng.randn(4, 128).astype("float32"))
+        whole = pl.pallas_call(
+            functools.partial(pk._scatter_grad_kernel, n_ids=6),
+            grid=(4,),
+            in_specs=[pl.BlockSpec((1, 6), lambda i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, 6), lambda i: (i, 0),
+                                   memory_space=pltpu.SMEM),
+                      pl.BlockSpec((1, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((vocab, 128), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((vocab, 128), jnp.float32),
+            interpret=True)(ids, wgt, g)
+        stream = self._stream_bwd(g, ids, wgt, vocab, br=32)
+        assert np.array_equal(np.asarray(stream), np.asarray(whole))
+
+    def test_streaming_kernels_pass_mosaic_preflight(self):
+        """An 8MB table (past the 4MB whole-table VMEM gate) lowers
+        through Mosaic via the public dispatchers — big vocabs no longer
+        fall back to XLA."""
+        from paddle_tpu.ops import pallas_kernels as pk
+        from paddle_tpu.ops.pallas_preflight import assert_mosaic_lowerable
+        w = jnp.zeros((16384, 128), jnp.float32)       # 8MB
+        ids = jnp.zeros((2, 4), jnp.int32)
+        wgt = jnp.ones((2, 4), jnp.float32)
+        g = jnp.zeros((2, 128), jnp.float32)
+        assert not pk._emb_whole_table_ok(w)
+        assert pk.fused_embedding_pool_supported(w, ids)
+        assert_mosaic_lowerable(pk.fused_embedding_pool_tpu, w, ids, wgt)
+        assert_mosaic_lowerable(
+            lambda g_, i_, w_: pk.embedding_pool_grad_tpu(g_, i_, w_,
+                                                          16384),
+            g, ids, wgt)
+
+    def test_stream_block_rows_sizing(self):
+        from paddle_tpu.ops import pallas_kernels as pk
+        br = pk._emb_stream_block_rows(128, 4)
+        assert br % 8 == 0 and br >= 8
+        assert br * 128 * 4 <= pk._EMB_VMEM_BYTES
+
 
 # ---------------------------------------------------------------------------
 # fuse_paged_attention

@@ -129,9 +129,9 @@ class TestHTTPEndpoint:
                 f"http://127.0.0.1:{srv.port}/goodput",
                 timeout=10).read().decode())
             assert set(doc["buckets"]) == set(
-                ("device_compute", "host_input_wait", "compile",
-                 "checkpoint_stall", "preemption_drain", "restart_init",
-                 "idle"))
+                ("device_compute", "host_input_wait", "ps_pull_wait",
+                 "compile", "checkpoint_stall", "preemption_drain",
+                 "restart_init", "idle"))
             assert 0.0 <= doc["ratio"] <= 1.0
             # tracing off in this test -> the metrics-totals estimate
             assert doc["source"] == "metrics"

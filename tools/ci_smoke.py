@@ -376,6 +376,15 @@ def main():
     assert_mosaic_lowerable(
         functools.partial(pk.paged_flash_attention_tpu, scale=0.25,
                           page_size=4), _pq, _pool, _pool, _pidx, _plen)
+    # the streaming embedding variants (PR 18): a table past the 4MB
+    # whole-table VMEM gate streams through as row-block slabs — the
+    # big-vocab dispatch in fused_embedding_pool_tpu takes this path
+    _wbig = jnp.zeros((16384, 128), jnp.float32)      # 8MB > VMEM gate
+    assert_mosaic_lowerable(pk.fused_embedding_pool_stream_tpu,
+                            _wbig, _ids, _wgt)
+    assert_mosaic_lowerable(
+        lambda g_, i_, w_: pk.embedding_pool_grad_stream_tpu(
+            g_, i_, w_, 16384), _g, _ids, _wgt)
 
     # gate 2: the rewrite passes fire on each demo (>=1 rewrite counted),
     # drop ops_per_step strictly, and keep fp32 loss parity over >=10
@@ -443,7 +452,7 @@ def main():
         ctr_demo_feed(_kt_rng))
     assert rw_ctr["fuse_sparse_embedding"] >= 1, rw_ctr
     assert rw_ctr["fuse_optimizer"] >= 1, rw_ctr
-    print(f"[smoke]   kernel tier: 4 kernels preflight clean; rewrites "
+    print(f"[smoke]   kernel tier: 7 kernels preflight clean; rewrites "
           f"mlp={rw_mlp['fuse_optimizer']} "
           f"bert={rw_bert['fuse_attention']}+{rw_bert['fuse_optimizer']} "
           f"ctr={rw_ctr['fuse_sparse_embedding']}+"
@@ -1332,6 +1341,66 @@ def main():
           f"{infoS['collectives_implied']} implied / 0 dispatched "
           f"collectives, reshard 8->4 bit-exact "
           f"({infoR['vars_checked']} vars)", flush=True)
+
+    step("parameter server: 4-shard spawn bit-parity vs single table, "
+         "SIGKILL mid-train -> restore, no accepted push lost")
+    import shutil as _psh
+    import tempfile as _pst
+    from paddle_tpu.distributed.ps.sharded import ShardedSparseTable
+    from paddle_tpu.distributed.ps.table import (CtrAccessorConfig,
+                                                 CtrSparseTable,
+                                                 IdHashInitializer)
+
+    _ps_t0 = time.time()
+    _ps_acc = {"embedx_dim": 8, "embedx_threshold": 2}
+    # the oracle: ONE local table with the identical id-deterministic
+    # initializer — 4 consistent-hash shards must be bit-indistinguishable
+    refP = CtrSparseTable(CtrAccessorConfig.from_dict(_ps_acc), "sgd", 0.05,
+                          initializer=IdHashInitializer(scale=0.07, seed=0))
+    _ps_dir = _pst.mkdtemp(prefix="smoke-ps-")
+    tblP = ShardedSparseTable("smoke_emb", accessor=_ps_acc,
+                              optimizer="sgd", lr=0.05, n_shards=4,
+                              state_dir=_ps_dir, staleness=0,
+                              snapshot_every=40, heartbeat_s=0.25)
+    _ps_rng = np.random.RandomState(11)
+    try:
+        dimP = tblP.dim
+        for sP in range(30):
+            idsP = np.unique(_ps_rng.randint(0, 5000,
+                                             size=96)).astype(np.int64)
+            gP = ((idsP[:, None] % 97 + sP) * 1e-3
+                  * np.ones((1, dimP))).astype(np.float32)
+            shP = np.ones(len(idsP), np.float32)
+            ckP = (idsP % 3 == 0).astype(np.float32)
+            tblP.push(idsP, gP, shows=shP, clicks=ckP)
+            refP.push(idsP, gP, shows=shP, clicks=ckP)
+            if sP == 9:
+                tblP.end_day()
+                refP.end_day()
+            if sP == 14:
+                tblP.kill_shard(2)      # SIGKILL mid-train; pushes to
+                # shard 2 park on its breaker until the supervisor
+                # restores it from snapshot+WAL, then apply exactly once
+            if sP == 21:
+                assert tblP.shrink() == refP.shrink()
+        tblP.flush()
+        probeP = np.arange(0, 5000, 13, dtype=np.int64)
+        rowsP, rowsR = tblP.pull(probeP), refP.pull(probeP)
+        assert np.array_equal(rowsP, rowsR), \
+            float(np.abs(rowsP - rowsR).max())
+        assert tblP.size() == refP.size(), (tblP.size(), refP.size())
+        deadP = tblP.events_of("shard_dead")
+        restP = tblP.events_of("shard_restarted")
+        assert deadP and restP, tblP.events
+    finally:
+        tblP.close()
+        _psh.rmtree(_ps_dir, ignore_errors=True)
+    _ps_dt = time.time() - _ps_t0
+    assert _ps_dt < 90.0, _ps_dt
+    print(f"[smoke]   ps: 4-shard parity bit-exact over 30 steps "
+          f"(end_day+shrink in-loop), shard2 SIGKILL -> "
+          f"{len(restP)} restart, {refP.size()} rows, {_ps_dt:.1f}s",
+          flush=True)
 
     step("bench child emits one JSON line (cpu) with measured MFU + "
          "goodput")

@@ -213,6 +213,52 @@ def _metrics_section(doc):
                                        for label, v in rows)]
 
 
+def _ps_section(doc):
+    """Sharded parameter-server tier: tier occupancy, prefetch
+    effectiveness, staleness fences, and per-shard availability — the
+    ps.* instruments the sharded table and ShardServer publish."""
+    m = doc.get("metrics") or {}
+
+    def _v(name):
+        v = m.get(name)
+        return v.get("count") if isinstance(v, dict) else v
+
+    if not any(_v(f"ps.{k}") for k in (
+            "shards_up", "hot_rows", "cold_rows", "prefetch_hits",
+            "wal_records", "shard_restarts", "dead_workers")):
+        return []
+    lines = ["ps tier   :"]
+    hot, cold = _v("ps.hot_rows") or 0, _v("ps.cold_rows") or 0
+    if hot or cold:
+        lines.append(f"    tiers      hot {hot} rows / cold {cold} rows; "
+                     f"evictions {_v('ps.evictions') or 0}, "
+                     f"promotions {_v('ps.promotions') or 0}")
+    pf_h = _v("ps.prefetch_hits") or 0
+    pf_m = _v("ps.prefetch_misses") or 0
+    if pf_h or pf_m:
+        rate = pf_h / max(1, pf_h + pf_m)
+        lines.append(f"    prefetch   {pf_h} hits / {pf_m} misses "
+                     f"({rate:.0%} hit rate), "
+                     f"{_v('ps.prefetch_patched') or 0} patched stale")
+    stalls = _v("ps.fence_stalls") or 0
+    outst = _v("ps.outstanding_pushes") or 0
+    if stalls or outst:
+        lines.append(f"    staleness  {stalls} fence stalls, "
+                     f"{outst} pushes outstanding")
+    up = _v("ps.shards_up")
+    if up is not None and (up or _v("ps.breaker_open")
+                           or _v("ps.shard_restarts")):
+        lines.append(f"    shards     {up} up, "
+                     f"{_v('ps.breaker_open') or 0} breakers open, "
+                     f"{_v('ps.shard_restarts') or 0} restarts")
+    wal = _v("ps.wal_records") or 0
+    if wal or _v("ps.snapshots"):
+        lines.append(f"    durability {wal} WAL records, "
+                     f"{_v('ps.snapshots') or 0} snapshots, "
+                     f"{_v('ps.restores') or 0} restores")
+    return lines
+
+
 def _request_story(doc, trace_id):
     """Everything the bundle knows about one trace id — the per-request
     forensic view."""
@@ -251,6 +297,10 @@ def report(doc, request=None):
         lines.append("")
         lines += sec
     sec = _metrics_section(doc)
+    if sec:
+        lines.append("")
+        lines += sec
+    sec = _ps_section(doc)
     if sec:
         lines.append("")
         lines += sec
