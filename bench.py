@@ -260,6 +260,17 @@ def _compile_stats():
         return {}
 
 
+def _autotune_block():
+    """The `autotune` block every leg carries (docs/performance.md
+    "Auto-tuning"): chosen config, probe cost, tuned-vs-untuned delta —
+    {"enabled": False, ...} when the tuner never ran in this child."""
+    try:
+        from paddle_tpu.fluid import autotune as _at
+        return _at.bench_block()
+    except Exception:           # noqa: BLE001
+        return {"enabled": False}
+
+
 def peak_flops(backend, dtype="bfloat16"):
     """Analytic peak for the MFU denominator, dtype-aware: the v5e MXU
     runs 197 TF in bf16 and ~half that when fp32 operands force the
@@ -322,6 +333,7 @@ def report(metric, unit, rate, flops_rate, backend, config=None,
                   f"disagree on this program — trust the measured number",
                   file=sys.stderr)
     out.update(extras or {})
+    out["autotune"] = _autotune_block()
     mix = dtype_mix()
     if mix:
         out["dtype_mix"] = mix
@@ -592,6 +604,10 @@ def main_ctr():
     bs = fluid.BuildStrategy()
     bs.fuse_elewise_add_act_ops = True
     bs.constant_folding = True
+    # FLAGS_auto_tune=1 closes the loop here: the first tuned step sweeps
+    # dispatch knobs in probe windows and commits the winner (persisted —
+    # the next bench round starts tuned at zero probe cost)
+    bs.auto_tune = bool(fluid.core.get_flag("auto_tune"))
     train_prog = fluid.CompiledProgram(main, build_strategy=bs)
 
     rng = np.random.RandomState(0)
@@ -682,6 +698,7 @@ def main_ctr():
         "amp_dtype": "float32",
         "kernel_tier": tier,
     }
+    out["autotune"] = _autotune_block()
     mix = dtype_mix()
     if mix:
         out["dtype_mix"] = mix
@@ -798,6 +815,7 @@ def main_sharding():
         "single_chip_examples_per_sec": round(steps * batch / dt1, 1),
         "loss_parity_rel_err": round(parity, 8),
     }
+    out["autotune"] = _autotune_block()
     out.update(_compile_stats())
     if backend not in ("cpu", "error"):
         record_evidence(dict(out, chunk_secs=list(_LAST_CHUNKS),
@@ -1020,11 +1038,15 @@ def main_serve():
     quick = "--quick" in sys.argv or backend_name() == "cpu"
     qps = 200.0 if quick else 2000.0
     n = 300 if quick else 4000
+    from paddle_tpu.fluid import core as _core
     report = serve_bench.serve_bench(qps=qps, n_requests=n,
                                      sizes=(1, 2, 4, 8),
-                                     max_batch=32, hidden=64)
+                                     max_batch=32, hidden=64,
+                                     auto_tune=bool(
+                                         _core.get_flag("auto_tune")))
     backend = backend_name()
     out = dict(report, backend=backend, mfu=0.0, vs_baseline=0.0)
+    out["autotune"] = _autotune_block()
     out.update(_compile_stats())
     if backend not in ("cpu", "error"):
         record_evidence(dict(out))
@@ -1123,6 +1145,7 @@ def main_ps():
             "prefetch_hit_rate": round(hit_rate, 3),
             "prefetch_patched": m.counter("ps.prefetch_patched").value,
         }
+        out["autotune"] = _autotune_block()
         print(json.dumps(out))
     finally:
         tbl.close()

@@ -164,11 +164,15 @@ class PersistentCache:
     sharing the directory (canary restarts, multi-host launches on a
     shared filesystem).  Existence of the file IS the hit predicate."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, configure_jax: bool = True):
         self.root = os.path.abspath(root)
         self.index_dir = os.path.join(self.root, "index")
         os.makedirs(self.index_dir, exist_ok=True)
-        _configure_jax_cache(self.root)
+        if configure_jax:
+            # a secondary index (the autotune config store under
+            # FLAGS_auto_tune_dir) must NOT re-root jax's compilation
+            # cache away from the primary persistent dir
+            _configure_jax_cache(self.root)
 
     def path_for(self, key: str) -> str:
         return os.path.join(self.index_dir, key + ".json")
@@ -252,6 +256,28 @@ def persistent_cache() -> Optional[PersistentCache]:
     if _instance is None or _instance.root != root:
         _instance = PersistentCache(root)
     return _instance
+
+
+_config_instance: Optional[PersistentCache] = None
+
+
+def config_store() -> Optional[PersistentCache]:
+    """The tuned-config store (fluid/autotune.py): the same atomic
+    JSON-per-key index, rooted at ``FLAGS_auto_tune_dir`` when set, else
+    riding the shared ``FLAGS_persistent_cache_dir`` cache — winning
+    configs live beside the executables they were measured for.  None
+    when neither flag is set (tuning still works, it just re-probes
+    after a restart).  Re-reads the flags each call so ``set_flags``
+    can repoint it mid-run."""
+    global _config_instance
+    from . import core
+    root = core.get_flag("auto_tune_dir")
+    if not root:
+        return persistent_cache()
+    root = os.path.abspath(str(root))
+    if _config_instance is None or _config_instance.root != root:
+        _config_instance = PersistentCache(root, configure_jax=False)
+    return _config_instance
 
 
 # ---------------------------------------------------------------------------

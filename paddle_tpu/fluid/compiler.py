@@ -83,6 +83,13 @@ class BuildStrategy:
         # optional {"axis": size, ...} mesh override; default is a
         # 1-axis mesh over all local devices (dp/fsdp -> "dp", tp -> "tp")
         self.sharding_mesh = None
+        # profile-guided self-tuning (fluid/autotune.py,
+        # docs/performance.md "Auto-tuning"): True opts this program
+        # into the executor-side search — bucket edges, dispatch
+        # fusion/inflight depth, and the kernel-tier crossover tune once
+        # per fingerprint on the first run, and persisted winners apply
+        # with zero probe cost on restart
+        self.auto_tune = False
         self.enable_sequential_execution = False
         self.remove_unnecessary_lock = True
         self.sync_batch_norm = False        # -> sync_batch_norm op psum
@@ -117,6 +124,10 @@ class CompiledProgram:
         self._ir_passes_applied = False
         # forwarded so Executor.run can treat us like a Program
         self._hints = self._program._hints
+        if getattr(self._build_strategy, "auto_tune", False):
+            # the hint rides the Program (shared dict) so the executor
+            # sees it after the CompiledProgram facade unwraps
+            self._program._hints["auto_tune"] = True
         if exec_strategy is not None:
             self._apply_exec_strategy(exec_strategy)
         trace.metrics().counter("compiler.compiled_programs").inc()

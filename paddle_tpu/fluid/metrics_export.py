@@ -185,6 +185,18 @@ def stats_payload() -> Dict[str, Any]:
             }
     if engines:
         out["engines"] = engines
+    # self-tuning decisions (fluid/autotune.py): the fleet monitor and
+    # diagnose tooling see what the tuner did from the same cheap poll.
+    # Only present once the tuner has actually acted — the payload stays
+    # small for untuned processes.
+    try:
+        from . import autotune
+        at = autotune.state()
+        if (at.get("enabled") or at.get("accepts") or at.get("rejects")
+                or at.get("reverts") or at.get("warm_starts")):
+            out["autotune"] = at
+    except Exception:                   # noqa: BLE001 — a scrape never
+        pass                            # crashes on a half-imported tuner
     if m.get("decode.requests") is not None:
         out["decode"] = {
             "requests": _counter("decode.requests"),

@@ -357,7 +357,9 @@ class ServingEngine:
                  auto_start: bool = True,
                  mesh=None,
                  sharding=None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 auto_tune: bool = False,
+                 slo_ms: Optional[float] = None):
         # per-engine instrument namespace (serving.<name>.* beside the
         # process aggregate; None = the plain serving.* family)
         self.name = name
@@ -456,6 +458,15 @@ class ServingEngine:
         self._batcher_t: Optional[threading.Thread] = None
         self._collector_t: Optional[threading.Thread] = None
         self.warmup_report: Optional[Dict[str, Any]] = None
+        # online self-tuning (fluid/autotune.py): auto_tune=True attaches
+        # a programmatic tuner (never stopped by flag flips); otherwise
+        # FLAGS_auto_tune attaches a flag-started one that
+        # autotune.apply_flags() reconciles.  A persisted winner for this
+        # program applies max_batch/max_wait_us here, before the first
+        # batch forms — the zero-probe warm start.
+        from ..fluid import autotune as _autotune
+        self._autotuner = _autotune.attach_engine(
+            self, programmatic=bool(auto_tune), slo_ms=slo_ms)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServingEngine":
@@ -470,6 +481,8 @@ class ServingEngine:
                 daemon=True)
             self._batcher_t.start()
             self._collector_t.start()
+        if self._autotuner is not None:
+            self._autotuner.start()
         return self
 
     def pause(self) -> None:
@@ -493,6 +506,8 @@ class ServingEngine:
         Implies :meth:`resume` — a close must drain, never deadlock on a
         paused batcher."""
         self._resume.set()
+        if self._autotuner is not None:
+            self._autotuner.stop()
         with self._lock:
             if self._closed:
                 return
@@ -667,10 +682,13 @@ class ServingEngine:
             f"deadline elapsed after {waited_ms:.1f}ms in queue"))
 
     def _batcher(self) -> None:
-        max_wait_s = self.max_wait_us / 1e6
         pending: Dict[tuple, List[_Request]] = {}
         stopping = False
         while True:
+            # read the formation deadline EVERY round, not once at thread
+            # start: the autotuner retunes max_wait_us on a live engine
+            # and a stale local would make the knob silently inert
+            max_wait_s = self.max_wait_us / 1e6
             timeout = 0.05
             if pending:
                 now = time.monotonic()
@@ -899,4 +917,8 @@ class ServingEngine:
             st = self._ins.hist_stats(h)
             out[h] = {k: st[k] for k in
                       ("count", "avg", "p50", "p95", "p99") if k in st}
+        if self._autotuner is not None:
+            out["autotune"] = dict(self._autotuner.state(),
+                                   max_batch=self.max_batch,
+                                   max_wait_us=self.max_wait_us)
         return out

@@ -78,15 +78,20 @@ class ReplicaTransportError(ServingError):
 def demo_mlp_spec(hidden: int = 32, features: int = 16, classes: int = 10,
                   max_batch: int = 16, max_wait_us: int = 2000,
                   queue_depth: int = 256, seed: int = 0,
-                  warmup: bool = True, watchdog_stall_s: float = 0.0
-                  ) -> Dict[str, Any]:
+                  warmup: bool = True, watchdog_stall_s: float = 0.0,
+                  auto_tune: bool = False) -> Dict[str, Any]:
     """The built-in demo replica spec (a small frozen mlp) — what
-    serve_bench --fleet and the ci_smoke fleet gate serve."""
+    serve_bench --fleet and the ci_smoke fleet gate serve.
+    ``auto_tune=True`` arms the per-replica online tuner
+    (fluid/autotune.py): each replica hill-climbs max_batch/max_wait
+    against its own window p99, and the decisions surface in the
+    replica's /stats payload the fleet monitor scrapes."""
     return {"kind": "demo_mlp", "hidden": hidden, "features": features,
             "classes": classes, "max_batch": max_batch,
             "max_wait_us": max_wait_us, "queue_depth": queue_depth,
             "seed": seed, "warmup": warmup,
-            "watchdog_stall_s": watchdog_stall_s}
+            "watchdog_stall_s": watchdog_stall_s,
+            "auto_tune": bool(auto_tune)}
 
 
 def build_engine_from_spec(spec: Dict[str, Any]) -> ServingEngine:
@@ -97,8 +102,13 @@ def build_engine_from_spec(spec: Dict[str, Any]) -> ServingEngine:
     multi-bucket StableHLO artifact — the PR-8 warm-start path)."""
     kind = spec.get("kind", "demo_mlp")
     kwargs = {k: spec[k] for k in ("max_batch", "max_wait_us",
-                                   "queue_depth", "default_deadline_ms")
+                                   "queue_depth", "default_deadline_ms",
+                                   "auto_tune")
               if spec.get(k) is not None}
+    if kwargs.get("auto_tune") and spec.get("watchdog_p99_ms"):
+        # the tuner's revert guard judges against the same p99 the
+        # replica's SLO watchdog enforces
+        kwargs["slo_ms"] = float(spec["watchdog_p99_ms"])
     if kind == "demo_mlp":
         import paddle_tpu.fluid as fluid
         from .freeze import freeze_program
@@ -1121,6 +1131,18 @@ class FleetMetricsAggregator:
                         pass
             if st.get("p99_ms") is not None:
                 p99s.append(float(st["p99_ms"]))
+            at = st.get("autotune")
+            if isinstance(at, dict):
+                # tuner-decision rollup: how many commits/reverts the
+                # fleet's replicas made, without reaching into them
+                ar = rollup.setdefault(
+                    "autotune", {"accepts": 0, "rejects": 0,
+                                 "reverts": 0})
+                for k in ("accepts", "rejects", "reverts"):
+                    try:
+                        ar[k] += int(at.get(k) or 0)
+                    except (TypeError, ValueError):
+                        pass
         rollup["p99_ms_max"] = max(p99s) if p99s else None
         if decode_seen:
             decode["spec_accept_rate"] = (

@@ -1,0 +1,313 @@
+"""Profile-guided self-tuning runtime (ISSUE 19): persisted-config store
+round-trips, keying, corrupt/stale fallback, warm restarts with zero
+probes, deterministic candidate proposal, AOT OOM rejection, and the
+serving tuner's SLO-breach revert guard."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid import autotune, core, trace
+from paddle_tpu.fluid import compile_cache as cc
+from paddle_tpu.fluid import executor as executor_mod
+
+
+@pytest.fixture
+def tune_env(tmp_path):
+    """Isolated config store + fast probes; autotune off unless the test
+    turns it on.  Restores every touched flag afterwards."""
+    saved = {k: core.get_flag(k) for k in
+             ("auto_tune", "auto_tune_dir", "auto_tune_probe_steps",
+              "auto_tune_hbm_budget_mb", "persistent_cache_dir")}
+    core._FLAGS.update({"auto_tune": False,
+                        "auto_tune_dir": str(tmp_path),
+                        "auto_tune_probe_steps": 2,
+                        "auto_tune_hbm_budget_mb": 0})
+    autotune.reset_for_tests()
+    yield str(tmp_path)
+    core._FLAGS.update(saved)
+    autotune.reset_for_tests()
+
+
+def _counters():
+    return {k: trace.counter_value(f"autotune.{k}")
+            for k in ("probes", "accepts", "rejects", "reverts",
+                      "warm_starts", "stale_configs", "errors")}
+
+
+def _build(hidden=4):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [-1, 8])
+        h = fluid.layers.fc(x, hidden, act="relu")
+        loss = fluid.layers.mean(h)
+    return main, startup, loss
+
+
+def _run_tuned(main, startup, loss, feed=None):
+    main._hints["auto_tune"] = True
+    exe = fluid.Executor()
+    exe.run(startup)
+    feed = feed or {"x": np.ones((16, 8), "float32")}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    return exe
+
+
+class TestConfigStore:
+    def test_round_trip(self, tune_env):
+        key = autotune.save_config("fp-abc", {"steps_per_dispatch": 2},
+                                   "train", extra={"speedup": 1.5})
+        assert key and key.startswith("at-")
+        meta = autotune.load_config("fp-abc", "train")
+        assert meta["config"] == {"steps_per_dispatch": 2}
+        assert meta["speedup"] == 1.5
+        assert meta["schema"] == autotune.SCHEMA
+
+    def test_key_covers_fingerprint_and_surface(self, tune_env):
+        import jax
+        k1 = autotune.config_key("fp-a", "train")
+        assert k1 != autotune.config_key("fp-b", "train")
+        assert k1 != autotune.config_key("fp-a", "serving")
+        # backend + device count are in the raw key material: a config
+        # measured on another topology can never collide
+        raw = "|".join(["autotune", str(autotune.SCHEMA), "fp-a",
+                        jax.__version__, jax.default_backend(),
+                        str(jax.device_count()), "train"])
+        import hashlib
+        assert k1 == "at-" + hashlib.sha256(raw.encode()).hexdigest()
+
+    def test_mismatch_is_stale_not_crash(self, tune_env):
+        autotune.save_config("fp-x", {"max_inflight_steps": 2}, "train")
+        store = cc.config_store()
+        key = autotune.config_key("fp-x", "train")
+        meta = store.get(key)
+        meta["n_devices"] = 999          # measured on another topology
+        store.record(key, meta)
+        c0 = _counters()
+        assert autotune.load_config("fp-x", "train") is None
+        assert _counters()["stale_configs"] - c0["stale_configs"] == 1
+
+    def test_corrupt_entry_degrades(self, tune_env):
+        autotune.save_config("fp-y", {"steps_per_dispatch": 4}, "train")
+        store = cc.config_store()
+        with open(store.path_for(autotune.config_key("fp-y", "train")),
+                  "w") as f:
+            f.write("{not json")
+        assert autotune.load_config("fp-y", "train") is None
+
+    def test_corrupt_store_never_crashes_run(self, tune_env):
+        """A tuned run whose persisted entry is garbage falls back to a
+        live search — no exception, no autotune.errors."""
+        with fluid.unique_name.guard():
+            main, startup, loss = _build()
+        fp = executor_mod._fingerprint(main)
+        autotune.save_config(fp, {"steps_per_dispatch": 2}, "train")
+        store = cc.config_store()
+        with open(store.path_for(autotune.config_key(fp, "train")),
+                  "w") as f:
+            f.write("\x00garbage\x00")
+        c0 = _counters()
+        _run_tuned(main, startup, loss)
+        c1 = _counters()
+        assert c1["errors"] - c0["errors"] == 0
+        assert c1["warm_starts"] - c0["warm_starts"] == 0
+        assert c1["probes"] - c0["probes"] > 0     # re-searched live
+
+
+class TestTrainingTuner:
+    def test_tune_commits_and_persists(self, tune_env):
+        with fluid.unique_name.guard():
+            main, startup, loss = _build()
+        c0 = _counters()
+        _run_tuned(main, startup, loss)
+        c1 = _counters()
+        assert c1["probes"] - c0["probes"] > 0
+        assert c1["accepts"] - c0["accepts"] == 1
+        fp = executor_mod._fingerprint(main)
+        meta = autotune.load_config(fp, "train")
+        assert meta is not None and isinstance(meta["config"], dict)
+        last = [d for d in autotune.decisions()
+                if d.get("action") == "accept"][-1]
+        assert last["surface"] == "train"
+        assert last["fingerprint"] == fp[:12]
+
+    def test_warm_restart_zero_probes(self, tune_env):
+        with fluid.unique_name.guard():
+            main, startup, loss = _build()
+        _run_tuned(main, startup, loss)
+        # "restart": fresh program objects with regenerated (identical)
+        # names — exactly what a real process restart produces — plus a
+        # cleared in-process memo
+        autotune.reset_for_tests()
+        with fluid.unique_name.guard():
+            main2, startup2, loss2 = _build()
+        assert (executor_mod._fingerprint(main2)
+                == executor_mod._fingerprint(main))
+        c0 = _counters()
+        _run_tuned(main2, startup2, loss2)
+        c1 = _counters()
+        assert c1["probes"] - c0["probes"] == 0
+        assert c1["warm_starts"] - c0["warm_starts"] == 1
+        last = autotune.decisions()[-1]
+        assert last["source"] == "persisted"
+        assert last["probe_steps"] == 0
+
+    def test_oom_candidates_rejected_without_execution(self, tune_env):
+        """A budget below the program's own baseline peak predicts OOM
+        for every candidate: all are rejected from memory_analysis alone,
+        zero probe steps execute."""
+        core._FLAGS["auto_tune_hbm_budget_mb"] = 1e-6   # ~1 byte
+        with fluid.unique_name.guard():
+            main, startup, loss = _build(hidden=6)
+        c0 = _counters()
+        _run_tuned(main, startup, loss)
+        c1 = _counters()
+        assert c1["probes"] - c0["probes"] == 0
+        assert c1["rejects"] - c0["rejects"] > 0
+        rejected = [d for d in autotune.decisions()
+                    if d.get("reason") == "oom_predicted"]
+        assert rejected and all(not d["executed"] for d in rejected)
+
+    def test_candidate_order_is_seeded(self, tune_env):
+        with fluid.unique_name.guard():
+            main, _, _ = _build()
+        feed = {"x": np.ones((16, 8), "float32")}
+        a = autotune.training_space(main, feed).candidates(seed=3)
+        b = autotune.training_space(main, feed).candidates(seed=3)
+        assert a == b
+        assert a[0] == autotune.training_space(main, feed).baseline()
+
+    def test_build_strategy_surface(self, tune_env):
+        strategy = fluid.BuildStrategy()
+        assert strategy.auto_tune is False
+        strategy.auto_tune = True
+        with fluid.unique_name.guard():
+            main, _, _ = _build()
+        compiled = fluid.CompiledProgram(main, build_strategy=strategy)
+        assert compiled._program._hints.get("auto_tune") is True
+
+
+class TestAnalyze:
+    def test_analyze_prices_without_execution(self, tune_env):
+        main, startup, loss = _build()
+        exe = fluid.Executor()
+        exe.run(startup)
+        n_cached = len(exe._cache)
+        info = exe.analyze(main, feed={"x": np.ones((16, 8), "float32")},
+                           fetch_list=[loss])
+        assert info is not None
+        assert info["flops"] > 0
+        assert info["per_device_peak_bytes"] > 0
+        # pricing must not publish a runnable entry into the step cache
+        assert len(exe._cache) == n_cached
+
+
+class TestServingTuner:
+    def _engine(self, **kw):
+        from paddle_tpu import serving
+        spec = serving.demo_mlp_spec(max_batch=8, max_wait_us=1000,
+                                     auto_tune=True, **kw)
+        return serving.build_engine_from_spec(spec)
+
+    def _load(self, eng, n):
+        futs = [eng.submit({"x": np.random.rand(2, 16).astype("float32")})
+                for _ in range(n)]
+        for f in futs:
+            f.result(timeout=30)
+
+    def test_breach_reverts_and_never_commits(self, tune_env):
+        with fluid.unique_name.guard():
+            eng = self._engine()
+        try:
+            eng.start()
+            tuner = eng._autotuner
+            assert tuner is not None and not tuner.flag_started
+            tuner._slo_ms = 1e-3         # unmeetable: every window breaches
+            committed0 = dict(tuner.committed)
+            self._load(eng, 12)
+            assert tuner.tick() is None  # propose
+            self._load(eng, 12)
+            d = tuner.tick()             # judge
+            assert d["action"] == "revert" and d["reason"] == "slo_breach"
+            assert tuner.committed == committed0
+            assert eng.max_batch == committed0["max_batch"]
+            assert eng.max_wait_us == committed0["max_wait_us"]
+            # the guard is absolute: no accept decision ever breached
+            for dec in autotune.decisions():
+                if dec.get("surface") == "serving" \
+                        and dec.get("action") == "accept" \
+                        and dec.get("window"):
+                    assert not (dec.get("slo_ms")
+                                and dec["window"]["p99_ms"]
+                                > dec["slo_ms"])
+        finally:
+            eng.close()
+
+    def test_commit_persists_and_warm_starts(self, tune_env):
+        from paddle_tpu import serving
+        with fluid.unique_name.guard():
+            eng = self._engine()
+        try:
+            eng.start()
+            tuner = eng._autotuner
+            tuner._slo_ms = 60_000.0     # generous: judge on throughput
+            tuner._window()              # drain older tests' records
+            self._load(eng, 6)
+            tuner.tick()                 # propose (baseline window = 6)
+            self._load(eng, 24)
+            d = tuner.tick()             # judge: 24 >= 6 * 1.02 -> commit
+            assert d["action"] == "accept"
+            assert d["config"] == tuner.committed
+            assert "autotune" in eng.stats()
+        finally:
+            eng.close()
+        with fluid.unique_name.guard():
+            eng2 = self._engine()
+        try:
+            t2 = eng2._autotuner
+            assert t2.warm_started
+            assert t2.committed == d["config"]
+            assert eng2.max_batch == d["config"]["max_batch"]
+        finally:
+            eng2.close()
+
+    def test_flag_reconciliation(self, tune_env):
+        """FLAGS_auto_tune start/stops flag-started tuners only — the
+        metrics-export reconciliation contract."""
+        from paddle_tpu import serving
+        spec = serving.demo_mlp_spec(max_batch=4, max_wait_us=500)
+        with fluid.unique_name.guard():
+            eng = serving.build_engine_from_spec(spec)
+        try:
+            assert eng._autotuner is None          # flag off, programmatic off
+            core.set_flags({"FLAGS_auto_tune": True})
+            tuner = eng._autotuner
+            assert tuner is not None and tuner.flag_started
+            core.set_flags({"FLAGS_auto_tune": False})
+            assert not tuner.running()
+        finally:
+            core._FLAGS["auto_tune"] = False
+            eng.close()
+
+
+class TestObservability:
+    def test_state_and_bench_block_shapes(self, tune_env):
+        st = autotune.state()
+        for k in ("enabled", "probes", "accepts", "rejects", "reverts",
+                  "warm_starts", "speedup"):
+            assert k in st
+        blk = autotune.bench_block()
+        assert "enabled" in blk and "decisions" in blk
+
+    def test_decisions_in_bundle(self, tune_env, tmp_path):
+        from paddle_tpu.fluid import watchdog
+        with fluid.unique_name.guard():
+            main, startup, loss = _build()
+        _run_tuned(main, startup, loss)
+        doc = watchdog.build_bundle_doc(reason="test")
+        assert doc["autotune"]["accepts"] >= 1
+        assert any(d.get("surface") == "train"
+                   for d in doc["autotune"]["decisions"])

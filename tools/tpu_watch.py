@@ -62,6 +62,11 @@ def run_child(args, budget, extra_env=None, _retried=False):
     return ok
 
 
+# one entry per child that committed a tuned config this sweep — the
+# per-sweep tuner-decision summary line renders from here
+_AUTOTUNE_DECISIONS = []
+
+
 def _run_child(args, budget, extra_env=None, _retried=False):
     env = dict(os.environ, GRAFT_BENCH_CHILD="1", **(extra_env or {}))
     t0 = time.time()
@@ -174,6 +179,23 @@ def _run_child(args, budget, extra_env=None, _retried=False):
                       f"collectives, per-device HBM "
                       f"{int(info.get('hbm_peak_bytes_per_device', 0) or 0) / 1e6:.1f}MB",
                       flush=True)
+            # self-tuning signals (bench autotune blocks): committed
+            # configs + the best tuned-vs-untuned delta across the
+            # sweep, summarised as a tuner-decision line per sweep
+            at = info.get("autotune") or {}
+            if at.get("enabled") and at.get("chosen") is not None:
+                trace.metrics().counter("watch.autotune_accepts").inc()
+                spd_at = float(at.get("speedup", 0.0) or 0.0)
+                ga = trace.metrics().gauge("watch.autotune_speedup")
+                if spd_at > ga.value:
+                    ga.set(spd_at)
+                _AUTOTUNE_DECISIONS.append(
+                    {"leg": " ".join(args) or "bert",
+                     "surface": at.get("surface"),
+                     "chosen": at.get("chosen"),
+                     "source": at.get("source"),
+                     "probe_cost_steps": at.get("probe_cost_steps", 0),
+                     "speedup": spd_at})
         except (ValueError, TypeError):
             pass
         return True
@@ -315,6 +337,22 @@ def _report_step_timing():
               f"{trace.metrics().gauge('watch.hbm_peak_bytes_per_device').value / 1e6:.1f}MB, "
               f"{int(trace.metrics().gauge('watch.collectives_dispatched').value)} "
               f"dispatched collectives", flush=True)
+    ata = trace.metrics().counter("watch.autotune_accepts").value
+    if ata:
+        spd_at = trace.metrics().gauge("watch.autotune_speedup").value
+        warm = sum(1 for d in _AUTOTUNE_DECISIONS
+                   if d.get("source") == "persisted")
+        probes = sum(int(d.get("probe_cost_steps") or 0)
+                     for d in _AUTOTUNE_DECISIONS)
+        print(f"[watch] autotune: {int(ata)} committed configs "
+              f"({warm} warm-started), best tuned-vs-untuned "
+              f"{spd_at:.2f}x, {probes} probe steps spent", flush=True)
+        for d in _AUTOTUNE_DECISIONS[-4:]:
+            print(f"[watch]   tuner: {d['leg']} [{d['surface']}] -> "
+                  f"{d['chosen']} ({d['source']}, "
+                  f"{d['probe_cost_steps']} probe steps, "
+                  f"{d['speedup']:.2f}x)", flush=True)
+        del _AUTOTUNE_DECISIONS[:]
     g = trace.metrics().histogram("watch.goodput").stats()
     if g["count"]:
         print(f"[watch] goodput: avg {g['avg']:.0%} min {g['min']:.0%} "
