@@ -6,15 +6,27 @@ TPU-native: one process per *host* (a TPU host already owns all its local
 chips through one PJRT client — per-chip processes would fight over the
 runtime), with `PADDLE_TPU_COORDINATOR` carrying the jax.distributed
 rendezvous address the way gen_nccl_id carried the NCCL unique id.
+
+`--host-agent` mode is the serving fleet's placement plane
+(docs/serving.md "Fleet topology"): one agent per host, spawning and
+supervising replica processes on behalf of a remote
+``ServingFleet(hosts=[...])`` over the chaos-hardened framed RPC —
+spawn/ping/stop/kill/shutdown, with the fleet monitor's heartbeat
+driving host-level ejection when a whole box partitions.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
+import socket
+import socketserver
 import subprocess
 import sys
+import threading
 import time
+from typing import Any, Dict, Optional
 
 
 def _parse_args(argv=None):
@@ -133,7 +145,244 @@ def _monitor(procs):
         return 1
 
 
+# ---------------------------------------------------------------------------
+# host agent: the serving fleet's per-host placement plane
+# ---------------------------------------------------------------------------
+
+class HostAgent:
+    """One host's replica supervisor, serving the framed-RPC ops a
+    remote ``ServingFleet(hosts=[...])`` drives:
+
+    * ``spawn`` — fork ``python -m paddle_tpu.serving.fleet
+      --serve-replica`` with the caller's spec + env, wait for its
+      ready line, return the ports/warmup report;
+    * ``ping`` — liveness heartbeat (pid + per-replica alive map); the
+      fleet monitor's consecutive-miss counter over THIS op is what
+      detects a host partition;
+    * ``stop``/``kill`` — reap or SIGKILL one replica;
+    * ``list`` — the supervised replica table;
+    * ``shutdown`` — kill every replica, then stop serving.
+
+    The transport is ``distributed/ps/rpc.py`` framing, so every
+    faultline kind covers the agent the way it covers replicas — a
+    partitioned host's heartbeat genuinely blackholes."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from .ps.rpc import (CorruptFrameError, begin_server_trace,
+                             end_server_trace, recv_msg, send_msg)
+        self.host = host
+        self._procs: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        try:
+                            header, arrays = recv_msg(sock)
+                        except CorruptFrameError:
+                            return
+                        scope = begin_server_trace(header)
+                        try:
+                            reply = outer._dispatch(header)
+                        except Exception as e:  # noqa: BLE001 — report
+                            reply = {"ok": False,
+                                     "error": type(e).__name__,
+                                     "message": str(e)}
+                        finally:
+                            end_server_trace(scope, reply)
+                        send_msg(sock, reply, [])
+                        if header.get("op") == "shutdown":
+                            break
+                except (ConnectionError, OSError):
+                    pass
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Srv((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- ops -----------------------------------------------------------------
+    def _dispatch(self, header: Dict[str, Any]) -> Dict[str, Any]:
+        op = header.get("op")
+        if op == "ping":
+            with self._lock:
+                reps = {n: (p["proc"].poll() is None)
+                        for n, p in self._procs.items()}
+            return {"ok": True, "pid": os.getpid(), "host": self.host,
+                    "replicas": reps}
+        if op == "spawn":
+            return self._spawn(header)
+        if op == "stop":
+            return self._stop_one(header.get("name"),
+                                  float(header.get("timeout_s", 30.0)))
+        if op == "kill":
+            with self._lock:
+                ent = self._procs.get(header.get("name"))
+            if ent is None:
+                return {"ok": False, "error": "KeyError",
+                        "message": f"no replica {header.get('name')!r}"}
+            ent["proc"].kill()
+            return {"ok": True}
+        if op == "list":
+            with self._lock:
+                return {"ok": True, "replicas": {
+                    n: dict(p["info"], alive=(p["proc"].poll() is None))
+                    for n, p in self._procs.items()}}
+        if op == "shutdown":
+            self.shutdown_replicas()
+            self._stop.set()
+            return {"ok": True}
+        return {"ok": False, "error": "ValueError",
+                "message": f"unknown op {op!r}"}
+
+    def _spawn(self, header: Dict[str, Any]) -> Dict[str, Any]:
+        name = str(header.get("name") or f"r{len(self._procs)}")
+        spec = header.get("spec") or {}
+        env = dict(os.environ)
+        env.update({str(k): str(v)
+                    for k, v in (header.get("env") or {}).items()})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.fleet",
+             "--serve-replica", "--spec", json.dumps(spec)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True)
+        line_box: list = []
+        done = threading.Event()
+
+        def read_ready():
+            line_box.append(proc.stdout.readline())
+            done.set()
+
+        threading.Thread(target=read_ready, daemon=True).start()
+        timeout_s = float(header.get("timeout_s", 180.0))
+        if not done.wait(timeout_s) or not line_box[0]:
+            proc.kill()
+            return {"ok": False, "error": "RuntimeError",
+                    "message": f"replica {name} produced no ready line "
+                               f"within {timeout_s:.0f}s"}
+        info = json.loads(line_box[0])
+        with self._lock:
+            self._procs[name] = {"proc": proc, "info": info}
+        return {"ok": True, "host": self.host, **info}
+
+    def _stop_one(self, name, timeout_s: float) -> Dict[str, Any]:
+        with self._lock:
+            ent = self._procs.get(name)
+        if ent is None:
+            return {"ok": False, "error": "KeyError",
+                    "message": f"no replica {name!r}"}
+        proc = ent["proc"]
+        try:
+            proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return {"ok": True, "returncode": proc.poll()}
+
+    def shutdown_replicas(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+        for ent in procs:
+            if ent["proc"].poll() is None:
+                ent["proc"].kill()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "HostAgent":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def wait(self) -> None:
+        self._stop.wait()
+        self._server.shutdown()
+        self.shutdown_replicas()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self.shutdown_replicas()
+
+
+class HostAgentClient:
+    """The fleet-side stub for one :class:`HostAgent`: every verb is a
+    single ``call_once`` round-trip over the framed transport, so the
+    faultline covers placement and heartbeat exactly as it covers
+    request traffic."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.host, self.port = host, int(port)
+        self.timeout_s = float(timeout_s)
+
+    def _call(self, header: Dict[str, Any],
+              timeout: Optional[float] = None) -> Dict[str, Any]:
+        from .ps.rpc import call_once
+        reply, _ = call_once(self.host, self.port, header,
+                             timeout=timeout or self.timeout_s)
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"host agent {self.host}:{self.port} "
+                f"{header.get('op')}: {reply.get('error')}: "
+                f"{reply.get('message')}")
+        return reply
+
+    def ping(self) -> Dict[str, Any]:
+        return self._call({"op": "ping"}, timeout=min(self.timeout_s, 3.0))
+
+    def spawn(self, name: str, spec: Dict[str, Any],
+              env: Optional[Dict[str, str]] = None,
+              timeout_s: float = 180.0) -> Dict[str, Any]:
+        return self._call({"op": "spawn", "name": name, "spec": spec,
+                           "env": dict(env or {}),
+                           "timeout_s": timeout_s},
+                          timeout=timeout_s + 10.0)
+
+    def stop(self, name: str, timeout_s: float = 30.0) -> Dict[str, Any]:
+        return self._call({"op": "stop", "name": name,
+                           "timeout_s": timeout_s},
+                          timeout=timeout_s + 10.0)
+
+    def kill(self, name: str) -> Dict[str, Any]:
+        return self._call({"op": "kill", "name": name})
+
+    def list(self) -> Dict[str, Any]:
+        return self._call({"op": "list"})
+
+    def shutdown(self) -> Dict[str, Any]:
+        return self._call({"op": "shutdown"})
+
+
+def _host_agent_main(argv) -> int:
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch "
+                                "--host-agent")
+    p.add_argument("--host-agent", action="store_true")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    agent = HostAgent(host=args.host, port=args.port).start()
+    sys.stdout.write(json.dumps({"ready": True, "host_agent": True,
+                                 "pid": os.getpid(), "host": args.host,
+                                 "port": agent.port}) + "\n")
+    sys.stdout.flush()
+    try:
+        agent.wait()
+    except KeyboardInterrupt:
+        agent.stop()
+    return 0
+
+
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--host-agent" in argv:
+        # separate parser: agent mode has no training script
+        return _host_agent_main(argv)
     args = _parse_args(argv)
     if args.server_num > 0:
         return launch_ps(args)

@@ -215,6 +215,28 @@ def recv_msg(sock, max_frame_bytes: Optional[int] = None):
     return header, arrays
 
 
+def call_once(host: str, port: int, header: dict,
+              arrays: Sequence[np.ndarray] = (),
+              timeout: Optional[float] = None):
+    """One connect → send → recv → close round-trip over the framed
+    transport — the control-plane verb host agents and heartbeats use.
+    Rides :func:`connect_endpoint`/:func:`send_msg`, so every faultline
+    kind (partition, reset, corruption) covers it: a partitioned host's
+    heartbeat genuinely fails here.  Raises OSError/ConnectionError on
+    transport failure; the caller maps that to its own policy."""
+    s = connect_endpoint(host, port, timeout=timeout)
+    try:
+        if timeout is not None:
+            s.settimeout(timeout)
+        send_msg(s, header, arrays)
+        return recv_msg(s)
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+
+
 # ---------------------------------------------------------------------------
 # cross-process trace propagation (server side)
 # ---------------------------------------------------------------------------

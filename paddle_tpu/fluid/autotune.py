@@ -262,6 +262,17 @@ def training_space(program=None, feed=None) -> KnobSpace:
         knobs.append(Knob("pallas_min_seq",
                           [cur_seq] + [s for s in (512, 1024, 2048)
                                        if s != cur_seq]))
+    if program is not None and (
+            getattr(program, "_sharding_plan", None) is not None
+            or hints.get("sharding")):
+        # gradient-coalescing bucket width only matters once a sharding
+        # plan makes the all-reduce ring real — without one the knob is
+        # dead weight in the cartesian product
+        cur_fg = int(hints.get("fuse_grad_size_in_num") or 32)
+        knobs.append(Knob("fuse_grad_size_in_num",
+                          [cur_fg] + [v for v in (8, 32, 128)
+                                      if v != cur_fg],
+                          kind="hint"))
     return KnobSpace(knobs)
 
 
@@ -396,7 +407,7 @@ def state() -> Dict[str, Any]:
         out["last_decisions"] = [
             {k: d.get(k) for k in ("surface", "action", "reason",
                                    "config", "speedup", "source",
-                                   "probe_steps")}
+                                   "probe_steps", "mesh")}
             for d in last]
     return out
 
@@ -813,7 +824,8 @@ class ServingAutoTuner:
                 "action": "revert" if breached else "reject",
                 "reason": "slo_breach" if breached else "no_gain",
                 "config": pend["config"], "window": win,
-                "baseline_window": base, "slo_ms": slo})
+                "baseline_window": base, "slo_ms": slo,
+                "mesh": _engine_mesh(eng)})
         self.committed = dict(pend["config"])
         speedup = (win["completed"] / base["completed"]
                    if base.get("completed") else 1.0)
@@ -824,7 +836,8 @@ class ServingAutoTuner:
             "action": "accept", "source": "probe",
             "config": dict(self.committed), "window": win,
             "baseline_window": base, "slo_ms": slo,
-            "speedup": round(speedup, 4)})
+            "speedup": round(speedup, 4),
+            "mesh": _engine_mesh(eng)})
         if self.persist and self._fp:
             save_config(self._fp, self.committed, "serving",
                         extra={"speedup": d["speedup"]})
@@ -850,7 +863,8 @@ class ServingAutoTuner:
         _record_decision({"surface": "serving", "engine": self.engine.name,
                           "action": "accept", "source": "persisted",
                           "config": dict(cfg), "probe_steps": 0,
-                          "speedup": meta.get("speedup")})
+                          "speedup": meta.get("speedup"),
+                          "mesh": _engine_mesh(self.engine)})
 
     def state(self) -> Dict[str, Any]:
         return {"running": self.running(),
@@ -860,6 +874,24 @@ class ServingAutoTuner:
                 if self._pending else None,
                 "warm_started": self.warm_started,
                 "slo_ms": self.slo_ms()}
+
+
+def _engine_mesh(engine) -> Optional[str]:
+    """The replica's mesh shape (``"tp:4"``-style) when its frozen
+    program carries a sharding plan — lets fleet rollups attribute
+    tuner decisions per topology instead of flattening 1-chip and
+    8-chip replicas into one bucket."""
+    try:
+        plan = getattr(getattr(getattr(engine, "_backend", None),
+                               "program", None), "_sharding_plan", None)
+        if plan is None:
+            return None
+        shape = plan.describe().get("mesh_shape")
+        if isinstance(shape, dict):
+            return ",".join(f"{k}:{v}" for k, v in sorted(shape.items()))
+        return str(shape) if shape else None
+    except Exception:                   # noqa: BLE001
+        return None
 
 
 def _engine_fingerprint(engine) -> Optional[str]:

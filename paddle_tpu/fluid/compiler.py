@@ -176,6 +176,11 @@ class CompiledProgram:
             return
         self._ir_passes_applied = True
         from . import passes
+        hint_fg = self._program._hints.get("fuse_grad_size_in_num")
+        if hint_fg is not None:
+            # auto-tuner override: the hint travels with the program so a
+            # persisted winning config re-applies without a BuildStrategy
+            self._build_strategy.fuse_grad_size_in_num = int(hint_fg)
         plist = passes.passes_for_build_strategy(self._build_strategy)
         gv = self._build_strategy.debug_graphviz_path or None
         if not plist and not gv:

@@ -205,7 +205,7 @@ def peak_bytes_of(info: Dict[str, Any]) -> int:
 # ---------------------------------------------------------------------------
 
 _MEM_FIELDS = ("peak_bytes", "argument_bytes", "output_bytes", "temp_bytes",
-               "per_device_peak_bytes")
+               "per_device_peak_bytes", "mesh_devices")
 _COST_FIELDS = ("flops", "bytes_accessed")
 
 # process-wide label -> peak bytes of every published executable.  The

@@ -162,7 +162,11 @@ def stats_payload() -> Dict[str, Any]:
     out: Dict[str, Any] = {
         "status": wd.get("status", "ok"),
         "uptime_s": round(_uptime_s(), 3),
-        "queue_depth": _gauge("serving.queue_depth"),
+        # a decode replica's backlog lives on decode.queue_depth — fold
+        # it in so least_queue routing sees one comparable number no
+        # matter which engine kind the replica hosts
+        "queue_depth": (_gauge("serving.queue_depth")
+                        + _gauge("decode.queue_depth")),
         "p99_ms": _p99_ms("serving.latency_seconds"),
         "window_p99_ms": round(_gauge("watchdog.window_p99_ms"), 3),
         "requests": _counter("serving.requests"),
@@ -210,9 +214,29 @@ def stats_payload() -> Dict[str, Any]:
             "kv_page_pool_free": _gauge("decode.kv_page_pool_free"),
             "prefix_hits": _counter("decode.prefix_hits"),
             "prefix_evictions": _counter("decode.prefix_evictions"),
+            "prefix_drops": _counter("decode.prefix_drops"),
             "spec_proposed": _counter("decode.spec_proposed"),
             "spec_accepted": _counter("decode.spec_accepted"),
         }
+    # per-device HBM truth (fluid/device_stats.py): the worst resident
+    # executable's per-shard peak + the widest mesh it compiled for —
+    # how the router and autotuner see that a sharded replica fits a
+    # batch one chip could not hold (FLAGS_device_cost_analysis)
+    _suffix = ".per_device_peak_bytes"
+    peak = 0.0
+    mesh_devices = 1
+    for name, _inst in m.items():
+        if name.startswith("xla.mem.exe.") and name.endswith(_suffix):
+            v = _gauge(name)
+            label = name[len("xla.mem.exe."):-len(_suffix)]
+            md = _gauge(f"xla.mem.exe.{label}.mesh_devices")
+            if v > peak:
+                peak = v
+            if md > mesh_devices:
+                mesh_devices = int(md)
+    if peak > 0:
+        out["hbm"] = {"per_device_peak_bytes": int(peak),
+                      "mesh_devices": mesh_devices}
     # transport-robustness truth (docs/robustness.md): checksum-caught
     # corruptions, retries, deadline sheds, and injected faults — how a
     # chaos drill audits "every corruption detected" across the fleet

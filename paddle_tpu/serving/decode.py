@@ -258,10 +258,38 @@ class PrefixCache:
                 freed += 1
         return freed
 
+    def drop(self, prompt) -> int:
+        """Release every chain entry covering a prefix of ``prompt`` —
+        the session-migration path: when a decode session re-pins to
+        another replica, its history's warm pages on THIS replica have
+        no future reader, so the router drops them instead of waiting
+        for LRU pressure.  Pages still referenced by a live slot lose
+        only the cache's reference (the slot's retirement returns
+        them); returns how many pages went back to the pool NOW."""
+        ps = self.pool.page_size
+        toks = [int(t) for t in prompt]
+        freed = 0
+        j = 0
+        while (j + 1) * ps <= len(toks):
+            key = tuple(toks[:(j + 1) * ps])
+            pid = self._entries.pop(key, None)
+            if pid is None:
+                break
+            if self.pool.refcount(pid) == 1:
+                freed += 1
+            self.pool.release(pid)
+            j += 1
+        return freed
+
 
 # ---------------------------------------------------------------------------
 # the model contract
 # ---------------------------------------------------------------------------
+
+# one lock for ALL lazy program builds: program construction runs under
+# fluid.program_guard, whose default-program switch is a module global
+_BUILD_LOCK = threading.Lock()
+
 
 class DecodeModel:
     """The program family a DecodeEngine drives.
@@ -333,7 +361,12 @@ class DecodeModel:
         self._prefill: Dict[int, tuple] = {}
         self._paged: Dict[int, tuple] = {}
         self._verify: Dict[tuple, tuple] = {}
-        self._lock = threading.Lock()
+        # PROCESS-wide, not per-model: the lazy builders run under
+        # fluid.program_guard, which swaps the module-global default
+        # program — two engines' loop threads building concurrently
+        # (routed decode puts one engine per replica in one process)
+        # would append ops into each other's programs
+        self._lock = _BUILD_LOCK
 
     def prefill_program(self, s_p: int):
         """(program, logits_name, k_init_name, v_init_name) for prompt
@@ -597,7 +630,7 @@ def build_demo_decode_model(vocab: int = 32, d_model: int = 16,
 class _DecodeInstruments(FamilyInstruments):
     COUNTERS = ("requests", "rejected", "joins", "leaves", "tokens",
                 "steps", "prefills", "prefix_hits", "prefix_evictions",
-                "spec_proposed", "spec_accepted")
+                "prefix_drops", "spec_proposed", "spec_accepted")
     HISTOGRAMS = ("ttft_seconds", "step_seconds", "request_seconds",
                   "batch_occupancy")
 
@@ -726,6 +759,9 @@ class DecodeEngine:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._auto_start = bool(auto_start)
+        # migration-drop requests from other threads, applied by the
+        # decode loop (the pool's single mutator) between steps
+        self._drops: "deque" = deque()
 
         # -- paged / prefix / speculative tiers ------------------------------
         self.paged = bool(paged)
@@ -869,6 +905,43 @@ class DecodeEngine:
         """Blocking convenience: submit + result."""
         return self.submit(prompt, max_new_tokens, eos_id).result(timeout)
 
+    def release_prefix(self, prompt, timeout: float = 5.0) -> int:
+        """Drop the prefix-cache pages warm-seeded by ``prompt`` — the
+        session-migration hook: when the router re-pins a decode session
+        to another replica, its history's pages here have no future
+        reader, so the old replica frees them eagerly instead of waiting
+        for LRU pressure.  The drop is applied by the decode loop (the
+        pool's only mutator) between steps; returns the number of pages
+        returned to the pool, 0 when no prefix cache is configured."""
+        if self._prefix is None:
+            return 0
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        done = threading.Event()
+        box = {"freed": 0}
+        with self._lock:
+            if self._closed:
+                return 0
+            started = self._started
+            self._drops.append((prompt, box, done))
+        if not started:
+            # no loop thread yet: this thread is the only pool mutator
+            self._process_drops()
+            return box["freed"]
+        done.wait(timeout)
+        return box["freed"]
+
+    def _process_drops(self) -> None:
+        while True:
+            with self._lock:
+                if not self._drops:
+                    return
+                prompt, box, done = self._drops.popleft()
+            freed = self._prefix.drop(prompt) if self._prefix else 0
+            if freed:
+                self._ins.count("prefix_drops", freed)
+            box["freed"] = freed
+            done.set()
+
     # -- the loop ------------------------------------------------------------
     def _loop(self) -> None:
         try:
@@ -902,6 +975,8 @@ class DecodeEngine:
     def _loop_inner(self) -> None:
         stop_seen = False
         while True:
+            if self._drops:
+                self._process_drops()
             joins = self._gather_joins()
             if joins and joins[-1] is _STOP:
                 stop_seen = True
@@ -1639,6 +1714,7 @@ class DecodeEngine:
                 "prefix_hits": self._ins.counter_value("prefix_hits"),
                 "prefix_evictions":
                     self._ins.counter_value("prefix_evictions"),
+                "prefix_drops": self._ins.counter_value("prefix_drops"),
             }
             if self._draft is not None:
                 prop = self._ins.counter_value("spec_proposed")

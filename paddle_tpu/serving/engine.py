@@ -921,4 +921,8 @@ class ServingEngine:
             out["autotune"] = dict(self._autotuner.state(),
                                    max_batch=self.max_batch,
                                    max_wait_us=self.max_wait_us)
+        plan = getattr(getattr(self._backend, "program", None),
+                       "_sharding_plan", None)
+        if plan is not None:
+            out["sharding"] = plan.describe()
         return out

@@ -54,10 +54,10 @@ from .engine import (BaseFuture, DeadlineExceededError, EngineClosedError,
 
 __all__ = [
     "ServingFleet", "Router", "ReplicaHandle", "FleetFuture",
-    "FleetMetricsAggregator",
+    "FleetMetricsAggregator", "DecodeSession",
     "ReplicaServer", "serve_replica", "build_engine_from_spec",
-    "demo_mlp_spec", "NoReplicaError", "ReplicaTransportError",
-    "CircuitBreaker",
+    "demo_mlp_spec", "demo_decode_spec", "NoReplicaError",
+    "ReplicaTransportError", "CircuitBreaker",
 ]
 
 
@@ -79,27 +79,69 @@ def demo_mlp_spec(hidden: int = 32, features: int = 16, classes: int = 10,
                   max_batch: int = 16, max_wait_us: int = 2000,
                   queue_depth: int = 256, seed: int = 0,
                   warmup: bool = True, watchdog_stall_s: float = 0.0,
-                  auto_tune: bool = False) -> Dict[str, Any]:
+                  auto_tune: bool = False,
+                  mesh: Optional[Dict[str, int]] = None,
+                  sharding: Optional[str] = None,
+                  emulate_devices: Optional[int] = None) -> Dict[str, Any]:
     """The built-in demo replica spec (a small frozen mlp) — what
     serve_bench --fleet and the ci_smoke fleet gate serve.
     ``auto_tune=True`` arms the per-replica online tuner
     (fluid/autotune.py): each replica hill-climbs max_batch/max_wait
     against its own window p99, and the decisions surface in the
-    replica's /stats payload the fleet monitor scrapes."""
-    return {"kind": "demo_mlp", "hidden": hidden, "features": features,
+    replica's /stats payload the fleet monitor scrapes.
+
+    ``mesh`` (axis→size, e.g. ``{"tp": 8}``) makes the replica itself a
+    pjit mesh: its subprocess builds the engine over a TP-sharded
+    ``freeze_program`` (``sharding`` picks the plan mode, default
+    ``"tp"``) and reports per-device HBM peak in /stats.
+    ``emulate_devices`` asks the parent to set
+    ``--xla_force_host_platform_device_count`` in the child's env — the
+    CPU-emulated multi-chip host every sharding test uses."""
+    spec = {"kind": "demo_mlp", "hidden": hidden, "features": features,
             "classes": classes, "max_batch": max_batch,
             "max_wait_us": max_wait_us, "queue_depth": queue_depth,
             "seed": seed, "warmup": warmup,
             "watchdog_stall_s": watchdog_stall_s,
             "auto_tune": bool(auto_tune)}
+    if mesh:
+        spec["mesh"] = {str(k): int(v) for k, v in dict(mesh).items()}
+        spec["sharding"] = sharding or "tp"
+    if emulate_devices:
+        spec["emulate_devices"] = int(emulate_devices)
+    return spec
+
+
+def demo_decode_spec(vocab: int = 32, d_model: int = 16, max_len: int = 24,
+                     seed: int = 0, page_size: int = 4,
+                     pool_pages: Optional[int] = None, max_batch: int = 8,
+                     queue_depth: int = 64, prefix_cache: bool = True,
+                     warmup: bool = True,
+                     watchdog_stall_s: float = 0.0) -> Dict[str, Any]:
+    """A replica spec that hosts the DECODE plane: the replica
+    subprocess builds the PR-12 demo decode transformer and serves it
+    through a paged :class:`~paddle_tpu.serving.decode.DecodeEngine`
+    behind the same ReplicaServer RPC surface (ops ``decode`` /
+    ``decode_drop``).  Same-``seed`` replicas share bit-identical
+    weights — what makes router-level session migration exact: the new
+    replica re-prefills the session's history and continues the
+    identical greedy stream."""
+    return {"kind": "demo_decode", "vocab": int(vocab),
+            "d_model": int(d_model), "max_len": int(max_len),
+            "seed": int(seed), "page_size": int(page_size),
+            "pool_pages": pool_pages, "max_batch": int(max_batch),
+            "queue_depth": int(queue_depth),
+            "prefix_cache": bool(prefix_cache), "warmup": warmup,
+            "watchdog_stall_s": watchdog_stall_s}
 
 
 def build_engine_from_spec(spec: Dict[str, Any]) -> ServingEngine:
     """Materialise a ServingEngine from a JSON-able replica spec.
 
-    Kinds: ``demo_mlp`` (built-in demo net), ``inference_model`` (a
-    ``save_inference_model`` directory), ``aot`` (a ``save_aot_model``
-    multi-bucket StableHLO artifact — the PR-8 warm-start path)."""
+    Kinds: ``demo_mlp`` (built-in demo net, optionally sharded over a
+    ``mesh`` spec), ``demo_decode`` (the paged decode plane),
+    ``inference_model`` (a ``save_inference_model`` directory), ``aot``
+    (a ``save_aot_model`` multi-bucket StableHLO artifact — the PR-8
+    warm-start path)."""
     kind = spec.get("kind", "demo_mlp")
     kwargs = {k: spec[k] for k in ("max_batch", "max_wait_us",
                                    "queue_depth", "default_deadline_ms",
@@ -109,6 +151,15 @@ def build_engine_from_spec(spec: Dict[str, Any]) -> ServingEngine:
         # the tuner's revert guard judges against the same p99 the
         # replica's SLO watchdog enforces
         kwargs["slo_ms"] = float(spec["watchdog_p99_ms"])
+    shard_kw: Dict[str, Any] = {}
+    if spec.get("mesh"):
+        # the replica IS a pjit mesh: build it here (inside the child,
+        # over however many devices its env exposes) and let the engine
+        # run the frozen program as one sharded executable
+        from ..parallel.mesh import build_mesh
+        shard_kw["mesh"] = build_mesh(
+            {str(k): int(v) for k, v in spec["mesh"].items()})
+        shard_kw["sharding"] = spec.get("sharding") or "tp"
     if kind == "demo_mlp":
         import paddle_tpu.fluid as fluid
         from .freeze import freeze_program
@@ -122,7 +173,22 @@ def build_engine_from_spec(spec: Dict[str, Any]) -> ServingEngine:
         exe = fluid.Executor()
         exe.run(startup)
         frozen = freeze_program(main_p, ["x"], [logits])
-        return ServingEngine(frozen, executor=exe, **kwargs)
+        return ServingEngine(frozen, executor=exe, **shard_kw, **kwargs)
+    if kind == "demo_decode":
+        from .decode import DecodeEngine, build_demo_decode_model
+        model = build_demo_decode_model(
+            vocab=int(spec.get("vocab", 32)),
+            d_model=int(spec.get("d_model", 16)),
+            max_len=int(spec.get("max_len", 24)),
+            seed=int(spec.get("seed", 0)),
+            page_size=int(spec.get("page_size", 4)))
+        return DecodeEngine(
+            model, max_batch=int(spec.get("max_batch", 8)),
+            queue_depth=int(spec.get("queue_depth", 64)),
+            paged=True, page_size=int(spec.get("page_size", 4)),
+            pool_pages=spec.get("pool_pages"),
+            prefix_cache=bool(spec.get("prefix_cache", True)),
+            auto_start=False)
     if kind == "inference_model":
         import paddle_tpu.fluid as fluid
         from ..fluid import io as fio
@@ -130,7 +196,7 @@ def build_engine_from_spec(spec: Dict[str, Any]) -> ServingEngine:
         exe = fluid.Executor()
         prog, feeds, fetches = fio.load_inference_model(spec["dir"], exe)
         frozen = freeze_program(prog, feeds, fetches)
-        return ServingEngine(frozen, executor=exe, **kwargs)
+        return ServingEngine(frozen, executor=exe, **shard_kw, **kwargs)
     if kind == "aot":
         from ..inference.aot import load_aot_model
         return ServingEngine(load_aot_model(spec["dir"]), **kwargs)
@@ -147,7 +213,10 @@ class ReplicaServer:
 
     Ops: ``hello`` (warmup report + ports), ``infer`` (feed arrays in,
     fetch arrays out, served through the engine's continuous batcher —
-    concurrent handler threads coalesce into device batches), ``stats``,
+    concurrent handler threads coalesce into device batches),
+    ``decode``/``decode_drop`` (a replica hosting the decode plane:
+    prompt tokens in, generated tokens out, plus the session-migration
+    hook that drops a departed session's warm prefix pages), ``stats``,
     ``pause``/``resume`` (chaos/maintenance: a paused replica genuinely
     stalls — its watchdog flips ``/healthz`` to ``stalled``, which is
     the fleet's verdict-driven ejection drill), ``drain`` (finish
@@ -160,6 +229,9 @@ class ReplicaServer:
                                           end_server_trace, recv_msg,
                                           send_msg)
         self.engine = engine
+        # engine-kind discriminator: the decode plane's engine carries
+        # prefill buckets, the batch plane's carries bucket_edges
+        self.is_decode = hasattr(engine, "prefill_edges")
         self.info = dict(info or {})
         self._stop = threading.Event()
         outer = self
@@ -218,6 +290,10 @@ class ReplicaServer:
     def _dispatch(self, header, arrays):
         op = header["op"]
         if op == "infer":
+            if self.is_decode:
+                return {"ok": False, "error": "ServingError",
+                        "message": "this replica hosts the decode "
+                                   "plane; use op=decode"}, []
             names = header["feeds"]
             feed = dict(zip(names, arrays))
             dl = header.get("deadline_ms") or None
@@ -249,6 +325,27 @@ class ReplicaServer:
                 # stays byte-identical
                 reply.update(fut.timing)
             return (reply, [np.asarray(res[n]) for n in fetch_names])
+        if op == "decode":
+            if not self.is_decode:
+                return {"ok": False, "error": "ServingError",
+                        "message": "this replica hosts the batch plane;"
+                                   " use op=infer"}, []
+            prompt = np.asarray(arrays[0], dtype=np.int64).reshape(-1)
+            fut = self.engine.submit(
+                prompt, max_new_tokens=int(header.get("max_new", 16)),
+                eos_id=header.get("eos_id"))
+            res = fut.result(timeout=float(header.get("timeout_s", 60.0)))
+            reply = {"ok": True, "prompt_len": int(res["prompt_len"]),
+                     "finish_reason": res["finish_reason"],
+                     "trace_id": fut.trace_id}
+            return reply, [np.asarray(res["tokens"], dtype=np.int64)]
+        if op == "decode_drop":
+            # session-migration hook: the router tells the OLD replica a
+            # migrated session's history pages have no future reader
+            fn = getattr(self.engine, "release_prefix", None)
+            tokens = np.asarray(arrays[0], dtype=np.int64).reshape(-1)
+            freed = int(fn(tokens)) if fn is not None else 0
+            return {"ok": True, "pages_freed": freed}, []
         if op == "hello":
             return {"ok": True, "pid": os.getpid(), **self.info}, []
         if op == "stats":
@@ -517,9 +614,16 @@ class ReplicaHandle:
                  probe_fn: Optional[Callable] = None,
                  rpc_timeout_s: float = 15.0,
                  warmup_report: Optional[Dict[str, Any]] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 host: str = "127.0.0.1",
+                 agent: Optional[Any] = None):
         self.name = name
         self.proc = proc
+        self.host = host or "127.0.0.1"
+        # host-agent placement (distributed/launch.py): the replica
+        # process lives on a (possibly remote) agent — teardown goes
+        # through it, liveness comes from its heartbeat
+        self.agent = agent
         self.rpc_port = rpc_port
         self.metrics_port = metrics_port
         self.engine = engine
@@ -546,7 +650,7 @@ class ReplicaHandle:
         self._out_lock = threading.Lock()
         self.spawned_at = time.monotonic()
         self.ready_at: Optional[float] = None
-        self._pool = (_SockPool("127.0.0.1", rpc_port, rpc_timeout_s)
+        self._pool = (_SockPool(self.host, rpc_port, rpc_timeout_s)
                       if rpc_port else None)
 
     # -- bookkeeping ---------------------------------------------------------
@@ -680,6 +784,53 @@ class ReplicaHandle:
                     info[k] = reply[k]
         return dict(zip(reply["fetches"], arrays))
 
+    def decode(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               timeout_s: Optional[float] = None
+               ) -> Dict[str, Any]:
+        """Serve one decode request on THIS replica — the decode-plane
+        sibling of :meth:`infer`, with the same error mapping (transport
+        failures retryable elsewhere, typed engine rejections
+        terminal).  Returns ``{"tokens", "prompt_len",
+        "finish_reason"}`` (generated tokens only, as plain ints)."""
+        if self.in_process:
+            fut = self.engine.submit(prompt,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_id=eos_id)
+            res = fut.result(timeout=timeout_s or self.rpc_timeout_s)
+            return {"tokens": [int(t) for t in res["tokens"]],
+                    "prompt_len": int(res["prompt_len"]),
+                    "finish_reason": res["finish_reason"]}
+        hdr = {"op": "decode", "max_new": int(max_new_tokens),
+               "eos_id": (None if eos_id is None else int(eos_id)),
+               "timeout_s": timeout_s or self.rpc_timeout_s}
+        hdr.update(trace.propagation_fields("dec"))
+        reply, arrays = self.call(
+            hdr, [np.asarray(prompt, dtype=np.int64)],
+            timeout_s=timeout_s or self.rpc_timeout_s)
+        if not reply.get("ok"):
+            err = reply.get("error", "ServingError")
+            msg = f"{self.name}: {reply.get('message', err)}"
+            if err == "QueueFullError":
+                raise QueueFullError(msg)
+            if reply.get("retryable") or err == "TimeoutError":
+                raise ReplicaTransportError(msg)
+            raise ServingError(msg)
+        return {"tokens": [int(t) for t in arrays[0]],
+                "prompt_len": int(reply["prompt_len"]),
+                "finish_reason": reply["finish_reason"]}
+
+    def release_prefix(self, tokens) -> int:
+        """Tell the replica a migrated session's history has no future
+        reader here (drops its warm prefix-cache pages); returns pages
+        freed.  Best-effort: 0 on any shape of refusal."""
+        if self.in_process:
+            fn = getattr(self.engine, "release_prefix", None)
+            return int(fn(tokens)) if fn is not None else 0
+        reply, _ = self.call({"op": "decode_drop"},
+                             [np.asarray(tokens, dtype=np.int64)])
+        return int(reply.get("pages_freed", 0)) if reply.get("ok") else 0
+
     # -- health --------------------------------------------------------------
     def scrape(self, timeout_s: float = 2.0) -> Dict[str, Any]:
         """The replica's compact /stats payload (verdict + queue depth
@@ -698,7 +849,7 @@ class ReplicaHandle:
                 st["status"] = "ok"
             return st
         body = urllib.request.urlopen(
-            f"http://127.0.0.1:{self.metrics_port}/stats",
+            f"http://{self.host}:{self.metrics_port}/stats",
             timeout=timeout_s).read()
         return json.loads(body)
 
@@ -713,7 +864,7 @@ class ReplicaHandle:
             from ..fluid import watchdog
             return watchdog.build_bundle_doc(reason)
         body = urllib.request.urlopen(
-            f"http://127.0.0.1:{self.metrics_port}/bundle?reason="
+            f"http://{self.host}:{self.metrics_port}/bundle?reason="
             f"{reason}", timeout=timeout_s).read()
         return json.loads(body)
 
@@ -763,12 +914,24 @@ class ReplicaHandle:
                 self.proc.wait(timeout=timeout_s)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+        elif self.agent is not None:
+            # agent-placed replica: the process is the AGENT's child —
+            # it reaps (and if needed kills) on our behalf
+            try:
+                self.agent.stop(self.name, timeout_s=timeout_s)
+            except Exception:           # noqa: BLE001 — a partitioned
+                pass                    # agent can't help teardown
         self._pool.close_all()
 
     def kill(self) -> None:
         """SIGKILL the replica process (chaos drills / bench)."""
         if self.proc is not None:
             self.proc.kill()
+        elif self.agent is not None:
+            try:
+                self.agent.kill(self.name)
+            except Exception:           # noqa: BLE001
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -840,6 +1003,12 @@ class Router:
         self._c_failures = m.counter("fleet.failures")
         self._c_affinity = m.counter("fleet.affinity_rebinds")
         self._h_latency = m.histogram("fleet.latency_seconds")
+        # decode-through-the-router state: which replica last served
+        # each decode session (the KV-locality pin) + the migration
+        # count when an ejection forces a re-pin
+        self._decode_pin: Dict[str, str] = {}
+        self._c_migrations = m.counter("decode.migrations")
+        self.on_decode_migration: Optional[Callable] = None
 
     # -- membership ----------------------------------------------------------
     def admitted(self) -> List[ReplicaHandle]:
@@ -1039,12 +1208,170 @@ class Router:
             f"no replica served the request after {fut.attempts} "
             f"attempts (last: {last_exc})"))
 
+    # -- decode dispatch -----------------------------------------------------
+    def submit_decode(self, prompt, max_new_tokens: int = 16,
+                      eos_id: Optional[int] = None,
+                      session: Optional[str] = None) -> FleetFuture:
+        """Route one decode request.  ``session`` pins to the replica
+        holding the session's warm KV pages (plain affinity); when the
+        pinned replica is ejected mid-session the request redispatches
+        and the NEW replica re-prefills the full prompt — prompt replay
+        through the paged prefill is bit-interchangeable with decode, so
+        the migrated stream stays token-identical (``decode.migrations``
+        counts every forced re-pin).  The router owns the prompt until a
+        replica answers: transport errors redispatch, and because the
+        prompt is the session's complete history, a redispatched request
+        regenerates the exact same greedy stream elsewhere."""
+        if self._closed:
+            raise EngineClosedError("router is closed")
+        fut = FleetFuture()
+        fut.trace_id = trace.new_trace_id("dec")
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        t0 = time.monotonic()
+        try:
+            self._pool.submit(self._run_decode, fut, prompt,
+                              int(max_new_tokens), eos_id, session, t0)
+        except RuntimeError as e:
+            raise EngineClosedError(f"router is closed: {e}") from e
+        return fut
+
+    def decode(self, prompt, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None,
+               session: Optional[str] = None,
+               timeout: Optional[float] = None) -> Dict[str, Any]:
+        return self.submit_decode(prompt, max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id,
+                                  session=session).result(timeout)
+
+    def _run_decode(self, fut: FleetFuture, prompt, max_new, eos_id,
+                    session, t0: float) -> None:
+        exclude: set = set()
+        last_exc: Optional[BaseException] = None
+        deadline = t0 + self.request_timeout_s
+        while fut.attempts < self.max_attempts \
+                and time.monotonic() < deadline:
+            if self._closed:
+                self._c_failures.inc()
+                fut._reject(EngineClosedError(
+                    "router closed while the request was pending"))
+                return
+            r = self._pick(session, exclude)
+            if r is None:
+                if exclude:
+                    exclude = set()
+                time.sleep(0.05)
+                continue
+            fut.attempts += 1
+            self._c_dispatch.inc()
+            if fut.attempts > 1:
+                self._c_redispatch.inc()
+            r._inc()
+            try:
+                with trace.trace_context(fut.trace_id):
+                    res = r.decode(prompt, max_new_tokens=max_new,
+                                   eos_id=eos_id,
+                                   timeout_s=self.attempt_timeout_s)
+            except (ReplicaTransportError, TimeoutError) as e:
+                r.breaker.record_failure()
+                last_exc = e
+                exclude.add(r.name)
+                time.sleep(min(0.02 * fut.attempts, 0.2))
+                continue
+            except (QueueFullError, EngineClosedError) as e:
+                last_exc = e
+                exclude.add(r.name)
+                time.sleep(min(0.02 * fut.attempts, 0.2))
+                continue
+            except BaseException as e:      # noqa: BLE001 — terminal
+                self._c_failures.inc()
+                fut._reject(e)
+                return
+            finally:
+                r._dec()
+            r.breaker.record_success()
+            self._h_latency.observe(time.monotonic() - t0)
+            if session is not None:
+                self._note_decode_pin(session, r, prompt)
+            fut._resolve(res, r.name)
+            return
+        self._c_failures.inc()
+        fut._reject(NoReplicaError(
+            f"no replica decoded the request after {fut.attempts} "
+            f"attempts (last: {last_exc})"))
+
+    def _note_decode_pin(self, session: str, r: ReplicaHandle,
+                         prompt) -> None:
+        """Record which replica now holds the session's KV pages; a
+        changed pin is a MIGRATION — count it, notify the fleet, and
+        tell the old replica (best-effort) to drop the session's warm
+        pages so they are never leaked in its pool gauges."""
+        with self._lock:
+            prev = self._decode_pin.get(session)
+            self._decode_pin[session] = r.name
+        if prev is None or prev == r.name:
+            return
+        self._c_migrations.inc()
+        cb = self.on_decode_migration
+        if cb is not None:
+            try:
+                cb(session, prev, r.name)
+            except Exception:           # noqa: BLE001 — observer only
+                pass
+        old = next((h for h in self.replicas if h.name == prev), None)
+        if old is not None and old.alive():
+            try:
+                old.release_prefix(prompt)
+            except Exception:           # noqa: BLE001 — the old replica
+                pass                    # may be partitioned or dead
+
     def outstanding(self) -> int:
         return sum(r.outstanding for r in self.replicas)
 
     def close(self) -> None:
         self._closed = True
         self._pool.shutdown(wait=True)
+
+
+class DecodeSession:
+    """One multi-turn decode conversation routed through the fleet.
+
+    The session object holds the AUTHORITATIVE token history (prompt +
+    every generated token) parent-side, so the fleet can serve each turn
+    anywhere: the pinned replica answers from its warm prefix pages,
+    and a migrated turn re-prefills the identical history on the new
+    replica — the emitted stream is bit-identical either way (the
+    migration gate tests/test_fleet_topology.py enforces)."""
+
+    _n = 0
+    _n_lock = threading.Lock()
+
+    def __init__(self, fleet, session: Optional[str] = None):
+        self.router: Router = getattr(fleet, "router", fleet)
+        if session is None:
+            with DecodeSession._n_lock:
+                DecodeSession._n += 1
+                session = f"dsess-{DecodeSession._n}"
+        self.session = session
+        self.history: List[int] = []
+        self.replica: Optional[str] = None
+
+    def generate(self, tokens, max_new_tokens: int = 16,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Append ``tokens`` to the history, decode ``max_new_tokens``
+        through the router, fold the generated tokens back into the
+        history.  Returns the replica reply plus ``replica``."""
+        prompt = self.history + [int(t) for t in np.asarray(tokens,
+                                                            dtype=np.int64)
+                                 .reshape(-1)]
+        fut = self.router.submit_decode(prompt,
+                                        max_new_tokens=max_new_tokens,
+                                        eos_id=eos_id,
+                                        session=self.session)
+        res = fut.result(timeout)
+        self.history = prompt + [int(t) for t in res["tokens"]]
+        self.replica = fut.replica
+        return dict(res, replica=fut.replica, attempts=fut.attempts)
 
 
 # ---------------------------------------------------------------------------
@@ -1143,6 +1470,19 @@ class FleetMetricsAggregator:
                         ar[k] += int(at.get(k) or 0)
                     except (TypeError, ValueError):
                         pass
+                # per-topology attribution: decisions carry the
+                # replica's mesh shape, so an 8-chip TP replica's
+                # accepts roll up separately from a 1-chip one's
+                for d in at.get("last_decisions") or []:
+                    if not isinstance(d, dict):
+                        continue
+                    mesh = str(d.get("mesh") or "unsharded")
+                    bym = ar.setdefault("by_mesh", {})
+                    row = bym.setdefault(
+                        mesh, {"accept": 0, "reject": 0, "revert": 0})
+                    act = d.get("action")
+                    if act in row:
+                        row[act] += 1
         rollup["p99_ms_max"] = max(p99s) if p99s else None
         if decode_seen:
             decode["spec_accept_rate"] = (
@@ -1169,7 +1509,7 @@ class FleetMetricsAggregator:
                 continue
             try:
                 text = urllib.request.urlopen(
-                    f"http://127.0.0.1:{r.metrics_port}/metrics",
+                    f"http://{r.host}:{r.metrics_port}/metrics",
                     timeout=2.0).read().decode("utf-8", "replace")
             except Exception as e:  # noqa: BLE001 — a dead replica is a
                 # fact to report, not a scrape failure
@@ -1274,9 +1614,25 @@ class ServingFleet:
                  quiet_children: bool = False,
                  trace_dir: Optional[str] = None,
                  incident_bundles: Optional[bool] = None,
-                 diagnostic_dir: Optional[str] = None):
+                 diagnostic_dir: Optional[str] = None,
+                 hosts: Optional[Sequence[str]] = None):
         from ..fluid import core
         self.spec = spec
+        # host-level placement: "host:port" endpoints of running host
+        # agents (python -m paddle_tpu.distributed.launch --host-agent).
+        # Replicas place round-robin across agents; the monitor
+        # heartbeats each agent over the chaos-hardened framed RPC and a
+        # partitioned host ejects EVERY replica it placed there
+        # (fleet.hosts_up is the gauge, host_down/host_up the events).
+        self.host_agents: List[Dict[str, Any]] = []
+        if hosts:
+            from ..distributed.launch import HostAgentClient
+            for ep in hosts:
+                h, p = str(ep).rsplit(":", 1)
+                self.host_agents.append({
+                    "endpoint": str(ep),
+                    "client": HostAgentClient(h, int(p)),
+                    "up": True, "missed": 0})
         # observability knobs: trace_dir turns tracing on in every
         # replica subprocess, one trace file per replica
         # (<trace_dir>/trace-<name>.json) for tools/timeline.py stitch;
@@ -1311,6 +1667,9 @@ class ServingFleet:
         self._c_replace = m.counter("fleet.replacements")
         self._c_miss = m.counter("fleet.scrape_misses")
         self._g_up = m.gauge("fleet.replicas_up")
+        self._g_hosts = m.gauge("fleet.hosts_up")
+        if self.host_agents:
+            self._g_hosts.set(len(self.host_agents))
 
         handles = list(replicas or [])
         if not handles:
@@ -1335,6 +1694,9 @@ class ServingFleet:
                              max_attempts=max_attempts,
                              attempt_timeout_s=rpc_timeout_s,
                              request_timeout_s=request_timeout_s)
+        self.router.on_decode_migration = \
+            lambda sess, old, new: self._event(
+                "decode_migrate", new, session=sess, source=old)
         for h in handles:
             self._wire_breaker(h)
         self._g_up.set(len(self.router.admitted()))
@@ -1363,11 +1725,17 @@ class ServingFleet:
     # -- spawn ---------------------------------------------------------------
     def spawn_replica(self, name: Optional[str] = None) -> ReplicaHandle:
         """Start one replica subprocess and wait for its ready line
-        (engine built + warmed + export plane up)."""
+        (engine built + warmed + export plane up).  With host agents
+        configured the replica places round-robin across them (the
+        agent forks and supervises the process); otherwise it is a
+        direct child."""
         self._n_spawned += 1
         name = name or f"r{self._n_spawned - 1}"
+        if self.host_agents:
+            return self._spawn_on_agent(name)
         env = dict(os.environ)
         env.update(self.env)
+        env.update(self._spec_env())
         if self.persistent_cache_dir:
             env["FLAGS_persistent_cache_dir"] = str(
                 self.persistent_cache_dir)
@@ -1415,6 +1783,53 @@ class ServingFleet:
                     warmup=info.get("warmup"), pid=info.get("pid"))
         return handle
 
+    def _spec_env(self) -> Dict[str, str]:
+        """Env the replica spec implies for its child process: the
+        emulated multi-chip host (XLA must see the device count BEFORE
+        jax initialises in the child — an env var, not a spec the child
+        could apply too late) and, for sharded replicas, the
+        device-truth capture that feeds the /stats hbm block."""
+        env: Dict[str, str] = {}
+        spec = self.spec or {}
+        n_dev = int(spec.get("emulate_devices") or 0)
+        if n_dev > 1:
+            flag = f"--xla_force_host_platform_device_count={n_dev}"
+            base = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in base:
+                env["XLA_FLAGS"] = (base + " " + flag).strip()
+        if spec.get("mesh"):
+            env.setdefault("FLAGS_device_cost_analysis", "true")
+        return env
+
+    def _spawn_on_agent(self, name: str) -> ReplicaHandle:
+        """Place one replica on the next up host agent (round-robin)."""
+        live = [a for a in self.host_agents if a["up"]]
+        if not live:
+            raise RuntimeError("no host agent is up")
+        agent = live[(self._n_spawned - 1) % len(live)]
+        env = dict(self.env)
+        env.update(self._spec_env())
+        if self.persistent_cache_dir:
+            env["FLAGS_persistent_cache_dir"] = str(
+                self.persistent_cache_dir)
+        t_spawn = time.monotonic()
+        info = agent["client"].spawn(name, self.spec, env=env,
+                                     timeout_s=self.spawn_timeout_s)
+        handle = ReplicaHandle(name,
+                               rpc_port=info["rpc_port"],
+                               metrics_port=info["metrics_port"],
+                               rpc_timeout_s=self.rpc_timeout_s,
+                               warmup_report=info.get("warmup"),
+                               host=agent["client"].host,
+                               agent=agent["client"])
+        handle.host_endpoint = agent["endpoint"]
+        handle.spawned_at = t_spawn
+        handle.ready_at = time.monotonic()
+        self._event("spawn", name, host=agent["endpoint"],
+                    spinup_s=round(handle.ready_at - t_spawn, 3),
+                    warmup=info.get("warmup"), pid=info.get("pid"))
+        return handle
+
     # -- breaker lifecycle ---------------------------------------------------
     def _wire_breaker(self, h: ReplicaHandle) -> None:
         """Breaker transitions feed the ejection/readmission lifecycle:
@@ -1436,6 +1851,8 @@ class ServingFleet:
     # -- monitor -------------------------------------------------------------
     def _monitor(self) -> None:
         while not self._stop.wait(self.scrape_interval_s):
+            if self.host_agents:
+                self._heartbeat_hosts()
             for r in list(self.router.replicas):
                 if r.state in ("stopped", "draining", "dead"):
                     continue
@@ -1473,13 +1890,51 @@ class ServingFleet:
                 if r.state == "up" and verdict in ("stalled", "breached"):
                     self.eject(r, verdict)
                 elif r.state == "ejected" and verdict == "ok" \
-                        and r.ejected_reason != "breaker_open":
+                        and r.ejected_reason not in ("breaker_open",
+                                                     "host_partition"):
                     # breaker ejections readmit through the probe path
                     # only — a healthy /healthz can't outrun an open
                     # breaker (the RPC plane may be partitioned while
-                    # the HTTP plane still answers)
+                    # the HTTP plane still answers); host_partition
+                    # ejections readmit only when the HOST's heartbeat
+                    # recovers (the whole box is suspect, not one
+                    # process)
                     self.readmit(r)
             self._g_up.set(len(self.router.admitted()))
+
+    def _heartbeat_hosts(self) -> None:
+        """One framed-RPC ping per agent per tick: ``missed_scrape_limit``
+        consecutive misses flips the host down and ejects every replica
+        it placed (reason ``host_partition``); a recovered ping flips it
+        up and readmits exactly those."""
+        for ag in self.host_agents:
+            try:
+                ag["client"].ping()
+                ok = True
+            except Exception:           # noqa: BLE001 — a missed
+                ok = False              # heartbeat is the signal
+            if ok:
+                ag["missed"] = 0
+                if not ag["up"]:
+                    ag["up"] = True
+                    self._event("host_up", ag["endpoint"])
+                    for r in self._host_replicas(ag["endpoint"]):
+                        if r.state == "ejected" \
+                                and r.ejected_reason == "host_partition":
+                            self.readmit(r)
+            else:
+                ag["missed"] += 1
+                if ag["missed"] >= self.missed_scrape_limit and ag["up"]:
+                    ag["up"] = False
+                    self._event("host_down", ag["endpoint"],
+                                missed=ag["missed"])
+                    for r in self._host_replicas(ag["endpoint"]):
+                        self.eject(r, "host_partition")
+        self._g_hosts.set(sum(1 for a in self.host_agents if a["up"]))
+
+    def _host_replicas(self, endpoint: str) -> List[ReplicaHandle]:
+        return [r for r in list(self.router.replicas)
+                if getattr(r, "host_endpoint", None) == endpoint]
 
     def _mark_dead(self, r: ReplicaHandle, reason: str) -> None:
         if r.state != "dead":
@@ -1633,14 +2088,31 @@ class ServingFleet:
         return self.router.infer(feed, session=session,
                                  deadline_ms=deadline_ms, timeout=timeout)
 
+    def submit_decode(self, prompt, max_new_tokens=16, eos_id=None,
+                      session=None) -> FleetFuture:
+        return self.router.submit_decode(prompt,
+                                         max_new_tokens=max_new_tokens,
+                                         eos_id=eos_id, session=session)
+
+    def decode(self, prompt, max_new_tokens=16, eos_id=None,
+               session=None, timeout=None) -> Dict[str, Any]:
+        return self.router.decode(prompt, max_new_tokens=max_new_tokens,
+                                  eos_id=eos_id, session=session,
+                                  timeout=timeout)
+
+    def decode_session(self, session: Optional[str] = None
+                       ) -> DecodeSession:
+        return DecodeSession(self, session=session)
+
     # -- introspection -------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         m = trace.metrics()
         lat = m.histogram("fleet.latency_seconds").stats()
-        return {
+        out = {
             "replicas": [{
                 "name": r.name, "state": r.state,
                 "reason": r.ejected_reason,
+                "host": getattr(r, "host_endpoint", None),
                 "outstanding": r.outstanding,
                 "queue_depth": r.last_stats.get("queue_depth"),
                 "status": r.last_stats.get("status"),
@@ -1655,10 +2127,20 @@ class ServingFleet:
             "breaker_opens": m.counter("fleet.breaker_opens").value,
             "breaker_closes": m.counter("fleet.breaker_closes").value,
             "failures": m.counter("fleet.failures").value,
+            "decode_migrations": m.counter("decode.migrations").value,
             "latency": {k: lat[k] for k in
                         ("count", "avg", "p50", "p95", "p99")},
             "events": len(self.events),
         }
+        if self.host_agents:
+            out["hosts"] = [{"endpoint": a["endpoint"], "up": a["up"],
+                             "missed": a["missed"],
+                             "replicas": [r.name for r in
+                                          self._host_replicas(
+                                              a["endpoint"])]}
+                            for a in self.host_agents]
+            out["hosts_up"] = sum(1 for a in self.host_agents if a["up"])
+        return out
 
     def close(self, timeout_s: float = 30.0) -> None:
         from ..fluid import metrics_export

@@ -1,0 +1,264 @@
+"""Fleet topology: sharded replica specs, decode routed through the
+fleet (bit-identical to engine-direct), session pin -> eject ->
+migration with token-stream identity preserved, migrated KV pages not
+leaked, and host-agent placement.
+
+Routing/migration tests run on IN-PROCESS replica handles over real
+DecodeEngines (same engines a subprocess replica would build — the
+identity contract is about the engines, not the transport).  One
+subprocess test covers the host-agent spawn path; the full partition
+drill lives in tools/ci_smoke.py.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.fluid import trace                        # noqa: E402
+from paddle_tpu.serving import decode as DC               # noqa: E402
+from paddle_tpu.serving import fleet as F                 # noqa: E402
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def make_decode_fleet(n=2, seed=5, page_size=4, prefix_cache=True,
+                      pool_pages=24, **fleet_kw):
+    """N in-process replicas over bit-identical demo decode models
+    (same seed => same weights, the subprocess contract)."""
+    handles = []
+    for i in range(n):
+        m = DC.build_demo_decode_model(vocab=29, d_model=12, max_len=24,
+                                       seed=seed, page_size=page_size)
+        eng = DC.DecodeEngine(m, name=f"dec{i}", max_batch=4,
+                              paged=True, page_size=page_size,
+                              pool_pages=pool_pages,
+                              prefix_cache=prefix_cache)
+        handles.append(F.ReplicaHandle(f"r{i}", engine=eng))
+    fleet_kw.setdefault("scrape_interval_s", 0.05)
+    fleet_kw.setdefault("auto_replace", False)
+    return F.ServingFleet(replicas=handles, **fleet_kw), handles
+
+
+class TestShardedSpec:
+    def test_demo_spec_carries_mesh(self):
+        spec = F.demo_mlp_spec(mesh={"tp": 8}, sharding="tp",
+                               emulate_devices=8)
+        assert spec["mesh"] == {"tp": 8}
+        assert spec["sharding"] == "tp"
+        assert spec["emulate_devices"] == 8
+
+    def test_spec_env_emulates_devices_and_prices_hbm(self, monkeypatch):
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        spec = F.demo_mlp_spec(mesh={"tp": 8}, sharding="tp",
+                               emulate_devices=8)
+        env = F.ServingFleet._spec_env(SimpleNamespace(spec=spec))
+        assert "--xla_force_host_platform_device_count=8" \
+            in env.get("XLA_FLAGS", "")
+        assert env.get("FLAGS_device_cost_analysis") == "true"
+        # unsharded spec injects neither
+        plain = F.demo_mlp_spec()
+        env2 = F.ServingFleet._spec_env(SimpleNamespace(spec=plain))
+        assert "XLA_FLAGS" not in env2
+        assert "FLAGS_device_cost_analysis" not in env2
+
+    def test_engine_stats_report_sharding_plan(self):
+        # tp:1 is a degenerate but real plan — the stats plumbing is
+        # identical for tp:8 (ci covers the emulated multi-device case
+        # in a subprocess, where XLA_FLAGS can still take effect)
+        spec = F.demo_mlp_spec(mesh={"tp": 1}, sharding="tp")
+        eng = F.build_engine_from_spec(spec)
+        try:
+            sh = eng.stats().get("sharding")
+            assert sh is not None
+            assert sh["mode"] == "tp"
+            assert sh["mesh_shape"] == {"tp": 1}
+        finally:
+            eng.close()
+
+
+class TestRoutedDecode:
+    def test_routed_equals_engine_direct_across_buckets(self):
+        # two prompt lengths that land in different prefill buckets
+        prompts = [[3, 1, 4], [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4]]
+        budgets = [6, 5]
+        ref_model = DC.build_demo_decode_model(vocab=29, d_model=12,
+                                               max_len=24, seed=5,
+                                               page_size=4)
+        ref = DC.decode_sequential(ref_model, prompts,
+                                   max_new_tokens=budgets)
+        fl, _ = make_decode_fleet(n=2, seed=5)
+        try:
+            for p, b, want in zip(prompts, budgets, ref):
+                got = fl.decode(p, max_new_tokens=b, timeout=60)
+                assert got["tokens"] == [int(t) for t in want["tokens"]]
+                assert got["prompt_len"] == len(p)
+        finally:
+            fl.close()
+
+    def test_decode_spread_and_session_affinity(self):
+        fl, _ = make_decode_fleet(n=2, seed=5, policy="round_robin")
+        try:
+            free = [fl.submit_decode([1 + i, 2, 3], max_new_tokens=3)
+                    for i in range(6)]
+            [f.result(60) for f in free]
+            assert {f.replica for f in free} == {"r0", "r1"}
+            pinned = [fl.submit_decode([4, 5, 6], max_new_tokens=3,
+                                       session="s1") for _ in range(4)]
+            [f.result(60) for f in pinned]
+            assert len({f.replica for f in pinned}) == 1
+        finally:
+            fl.close()
+
+
+class TestMigration:
+    def test_pin_eject_migrate_token_identity(self):
+        """The acceptance gate: a pinned session survives its replica's
+        ejection with a bit-identical token stream, across two turns
+        whose full-history prompts land in different prefill buckets."""
+        m0 = trace.metrics().counter("decode.migrations").value
+        fl, handles = make_decode_fleet(n=2, seed=5)
+        try:
+            sess = fl.decode_session()
+            turn1 = sess.generate([3, 1, 4], max_new_tokens=4,
+                                  timeout=60)
+            first = sess.replica
+            assert first in ("r0", "r1")
+            # forced migration: eject the pinned replica
+            fl.eject(first, "drill")
+            turn2 = sess.generate([2, 7], max_new_tokens=5, timeout=60)
+            second = sess.replica
+            assert second != first, (first, second)
+            assert trace.metrics().counter(
+                "decode.migrations").value - m0 == 1
+            assert fl.stats()["decode_migrations"] == \
+                trace.metrics().counter("decode.migrations").value
+            migr = fl.events_of("decode_migrate")
+            assert migr and migr[0]["source"] == first
+
+            # identity: replaying the same history turn-by-turn on a
+            # fresh engine-direct model emits the same streams
+            ref_model = DC.build_demo_decode_model(
+                vocab=29, d_model=12, max_len=24, seed=5, page_size=4)
+            ref1 = DC.decode_sequential(ref_model, [[3, 1, 4]],
+                                        max_new_tokens=[4])[0]
+            assert turn1["tokens"] == [int(t) for t in ref1["tokens"]]
+            hist2 = [3, 1, 4] + turn1["tokens"] + [2, 7]
+            assert len(hist2) != 3      # second turn = a deeper bucket
+            ref2 = DC.decode_sequential(ref_model, [hist2],
+                                        max_new_tokens=[5])[0]
+            assert turn2["tokens"] == [int(t) for t in ref2["tokens"]]
+        finally:
+            fl.close()
+
+    def test_migrated_session_kv_pages_not_leaked(self):
+        """After a migration the OLD replica's warm prefix pages for the
+        session are dropped — its pool gauges return to empty instead of
+        leaking the orphaned pages."""
+        fl, handles = make_decode_fleet(n=2, seed=5, prefix_cache=True)
+        try:
+            sess = fl.decode_session()
+            # page-aligned history so the prefix cache retains pages
+            sess.generate([2, 4, 6, 8, 1, 3, 5, 7], max_new_tokens=4,
+                          timeout=60)
+            first = sess.replica
+            old = next(h for h in handles if h.name == first)
+            # after the turn completes, the only pages still in use on
+            # the pinned replica are the session's warm prefix pages
+            wait_for(lambda: (old.engine.stats()["paged"]
+                              ["kv_pages_in_use"]) > 0, 10,
+                     "prefix pages cached on the pinned replica")
+            fl.eject(first, "drill")
+            sess.generate([9, 9], max_new_tokens=3, timeout=60)
+            assert sess.replica != first
+
+            def drained():
+                st = old.engine.stats()["paged"]
+                return (st["prefix_drops"] > 0
+                        and st["kv_pages_in_use"] == 0)
+            wait_for(drained, 10, "migrated session's pages dropped")
+        finally:
+            fl.close()
+
+    def test_release_prefix_direct(self):
+        m = DC.build_demo_decode_model(vocab=29, d_model=12, max_len=24,
+                                       seed=5, page_size=4)
+        eng = DC.DecodeEngine(m, max_batch=4, paged=True, page_size=4,
+                              pool_pages=24, prefix_cache=True)
+        try:
+            prompt = [2, 4, 6, 8, 1, 3, 5, 7]
+            eng.submit(prompt, max_new_tokens=3).result(timeout=60)
+            wait_for(lambda: eng.stats()["paged"]["kv_pages_in_use"] > 0,
+                     10, "prefix cached")
+            freed = eng.release_prefix(prompt)
+            assert freed == 2, freed    # 8 tokens / page_size 4
+            st = eng.stats()["paged"]
+            assert st["kv_pages_in_use"] == 0
+            # idempotent: a second drop frees nothing
+            assert eng.release_prefix(prompt) == 0
+        finally:
+            eng.close()
+
+
+class TestHostPlacement:
+    def test_host_agent_round_robin_placement(self):
+        """Two real host agents, one replica placed on each; infer
+        flows end-to-end and /stats reports the host topology."""
+        agents, ports = [], []
+        fl = None
+        try:
+            for _ in range(2):
+                p = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "paddle_tpu.distributed.launch", "--host-agent",
+                     "--port", "0"],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True)
+                ready = json.loads(p.stdout.readline())
+                assert ready["ready"] and ready["host_agent"]
+                agents.append(p)
+                ports.append(int(ready["port"]))
+            fl = F.ServingFleet(
+                spec=F.demo_mlp_spec(hidden=16), n_replicas=2,
+                hosts=[f"127.0.0.1:{pt}" for pt in ports],
+                scrape_interval_s=0.2, auto_replace=False,
+                quiet_children=True)
+            eps = {r.name: r.host_endpoint for r in fl.router.replicas}
+            assert eps["r0"] != eps["r1"]
+            out = fl.submit(
+                {"x": np.ones((2, 16), "float32")}).result(60)
+            assert next(iter(out.values())).shape[0] == 2
+            st = fl.stats()
+            assert st["hosts_up"] == 2
+            assert {h["endpoint"] for h in st["hosts"]} == \
+                {f"127.0.0.1:{pt}" for pt in ports}
+            assert all(row["host"] == eps[row["name"]]
+                       for row in st["replicas"])
+        finally:
+            if fl is not None:
+                fl.close()
+            for p in agents:
+                p.kill()
+                p.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -951,6 +951,90 @@ def main():
           f"checksum-caught, breaker open->probe->closed, "
           f"{chaos_wall:.1f}s wall OK", flush=True)
 
+    step("host partition: seeded faultline cuts one host agent "
+         "mid-burst -> heartbeat ejects its replicas, 0 lost, "
+         "readmission after the window heals")
+    # breaker headroom: this drill must prove the HOST path (heartbeat
+    # -> host_down -> eject(host_partition)), not the per-replica
+    # breaker racing it to the ejection
+    fluid.core.set_flags({"FLAGS_fleet_breaker_failures": 50})
+    host_dir = tempfile.mkdtemp(prefix="smoke-hosts-")
+    agentsH, agent_portsH = [], []
+    flH = fltH = None
+    t_part0 = time.monotonic()
+    try:
+        for _ in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--host-agent", "--port", "0"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
+            ready = json.loads(p.stdout.readline())
+            agentsH.append(p)
+            agent_portsH.append(int(ready["port"]))
+        flH = FL.ServingFleet(
+            spec=FL.demo_mlp_spec(queue_depth=128), n_replicas=2,
+            hosts=[f"127.0.0.1:{pt}" for pt in agent_portsH],
+            scrape_interval_s=0.15, missed_scrape_limit=2,
+            auto_replace=False,
+            persistent_cache_dir=os.path.join(host_dir, "cache"),
+            rpc_timeout_s=3.0, max_attempts=30, quiet_children=True)
+        assert flH.stats()["hosts_up"] == 2
+        r1H = flH._resolve("r1")        # round-robin: r1 sits on agent 2
+        assert r1H.host_endpoint == f"127.0.0.1:{agent_portsH[1]}"
+        # the partition: every connection to agent 2's box — the agent's
+        # heartbeat port AND its replica's RPC port — resets for 3s.
+        # HTTP scrapes are NOT faultline-hooked, so detection must come
+        # from the framed-RPC heartbeat, not a scrape miss.
+        part_spec = {"seed": 20260807, "faults": [
+            {"kind": "latency", "prob": 0.2, "ms": 3, "jitter_ms": 5},
+            {"kind": "reset", "prob": 1.0, "start_s": 0.5, "end_s": 3.5,
+             "endpoint": f"*:{agent_portsH[1]}"},
+            {"kind": "reset", "prob": 1.0, "start_s": 0.5, "end_s": 3.5,
+             "endpoint": f"*:{r1H.rpc_port}"},
+        ]}
+        # replay contract: same seed => same decision streams
+        assert (FLT.Faultline(part_spec).decision_fingerprint(256)
+                == FLT.Faultline(part_spec).decision_fingerprint(256))
+        fltH = FLT.install(part_spec)
+        futsH = []
+        for i in range(80):             # paced burst spanning the window
+            futsH.append(flH.submit({"x": poolG[: 1 + i % 8]}))
+            time.sleep(0.04)
+        _wait(lambda: flH.events_of("host_down"), 30, "host_down event")
+        assert r1H.state == "ejected", r1H.state
+        assert r1H.ejected_reason == "host_partition", r1H.ejected_reason
+        assert flH.stats()["hosts_up"] == 1
+        outsH = [f.result(timeout=120) for f in futsH]
+        assert len(outsH) == 80         # zero accepted requests lost
+        # after the window the heartbeat heals: host_up readmits exactly
+        # the replicas the partition ejected
+        _wait(lambda: flH.events_of("host_up"), 60, "host_up event")
+        _wait(lambda: r1H.state == "up", 30,
+              "readmission after partition heals")
+        assert flH.stats()["hosts_up"] == 2
+        # the readmitted replica serves real traffic again
+        futsH2 = [flH.submit({"x": poolG[:4]}) for _ in range(8)]
+        [f.result(timeout=60) for f in futsH2]
+        part_wall = time.monotonic() - t_part0
+        assert part_wall < 90, f"host-partition drill blew the wall " \
+                               f"budget: {part_wall:.1f}s"
+        injH = dict(fltH.injected)
+    finally:
+        if fltH is not None:
+            FLT.uninstall()
+        fluid.core.set_flags({"FLAGS_fleet_breaker_failures": 5})
+        if flH is not None:
+            flH.close()
+        for p in agentsH:
+            p.kill()
+            p.wait(timeout=10)
+        shutil.rmtree(host_dir, ignore_errors=True)
+    print(f"[smoke]   host partition: {sum(injH.values())} faults "
+          f"{injH}, heartbeat -> host_down -> eject(host_partition), "
+          f"80/80 served, hosts_up 2->1->2, {part_wall:.1f}s wall OK",
+          flush=True)
+
     step("decode: batched join/leave bit-identical to sequential "
          "across prefill/decode buckets")
     from paddle_tpu.serving import decode as DC

@@ -22,6 +22,15 @@ evidence):
 
     python tools/serve_bench.py --fleet 3 --kill-replica-at 2.0
 
+Topology mode (ROADMAP item 2's scaling protocol): TP-sharded replicas
+over emulated devices, per-chip throughput, a 1-replica baseline for
+the scaling ratio, the sharded-vs-unsharded per-device HBM compare,
+and — with ``--decode`` — a routed-decode leg so one JSON line carries
+examples/s/chip AND tokens/s/chip for the whole fleet:
+
+    python tools/serve_bench.py --fleet 2 --replica-mesh tp:8 \\
+        --scaling --decode
+
 Chaos mode (docs/robustness.md — the network half of the failure
 model): a seeded schedule mixing latency, drops, resets, frame
 corruption, and trickle against the fleet's RPC plane; reports lost
@@ -85,18 +94,27 @@ def run_open_loop(engine, feed_of_rows, qps: float, n_requests: int,
     req_rows = [int(sizes[i % len(sizes)]) for i in rng.permutation(
         n_requests)]
     futures, rejected = [], 0
+    rows_of = _FUTURE_ROWS
     t0 = time.perf_counter()
     for i in range(n_requests):
         lag = sched[i] - (time.perf_counter() - t0)
         if lag > 0:
             time.sleep(lag)
         try:
-            futures.append(engine.submit(feed_of_rows(req_rows[i]),
-                                         deadline_ms=deadline_ms))
+            fut = engine.submit(feed_of_rows(req_rows[i]),
+                                deadline_ms=deadline_ms)
+            rows_of[id(fut)] = (fut, req_rows[i])
+            futures.append(fut)
         except Exception:           # noqa: BLE001 — QueueFull counts
             rejected += 1
     wall_submit = time.perf_counter() - t0
     return futures, wall_submit, float(sched[-1]), rejected
+
+
+# future -> submitted row count (futures are __slots__ classes, so the
+# side table keeps the fut alive and the rows findable for the per-chip
+# examples/s accounting)
+_FUTURE_ROWS: dict = {}
 
 
 def collect(futures, timeout=120.0):
@@ -397,11 +415,156 @@ def chaos_schedule(seed: int, duration_s: float):
     return parent, child
 
 
+def parse_mesh(s):
+    """``"tp:8"`` / ``"dp:2,tp:4"`` -> ``{"tp": 8}`` / ordered dict."""
+    if not s:
+        return None
+    out = {}
+    for part in str(s).split(","):
+        axis, _, n = part.partition(":")
+        out[axis.strip()] = int(n)
+    return out
+
+
+def _mesh_chips(mesh) -> int:
+    n = 1
+    for v in (mesh or {}).values():
+        n *= int(v)
+    return max(1, n)
+
+
+def _completed_examples(futures) -> int:
+    """Sum the row counts of futures that actually completed (results
+    are cached by now — collect() already waited them out)."""
+    total = 0
+    for f in futures:
+        try:
+            f.result(timeout=0.05)
+            total += int(_FUTURE_ROWS.get(id(f), (None, 0))[1])
+        except Exception:           # noqa: BLE001 — failed ones
+            pass
+        _FUTURE_ROWS.pop(id(f), None)
+    return total
+
+
+def _fleet_hbm_peak(fl):
+    """Max per-device HBM peak (bytes) + device count across the
+    fleet's replica ``/stats`` payloads (present when the replica ran
+    with FLAGS_device_cost_analysis)."""
+    peak, devices = 0, 1
+    for r in fl.router.replicas:
+        try:
+            st = r.scrape(timeout_s=5.0) if not r.in_process \
+                else (r.last_stats or {})
+        except Exception:           # noqa: BLE001 — best effort
+            st = r.last_stats or {}
+        hbm = (st or {}).get("hbm") or {}
+        if hbm.get("per_device_peak_bytes", 0) > peak:
+            peak = int(hbm["per_device_peak_bytes"])
+            devices = int(hbm.get("mesh_devices", 1))
+    return (peak or None), devices
+
+
+def _unsharded_hbm_control(spec, cache_dir, max_rows, quiet=True):
+    """Spawn ONE unsharded single-device replica of the same model,
+    push one max-size batch through it, and return its per-device HBM
+    peak — the control leg of the sharding-reduces-per-chip-memory
+    claim (same batch, no mesh)."""
+    from paddle_tpu.serving import fleet as fleet_mod
+
+    control = {k: v for k, v in spec.items()
+               if k not in ("mesh", "sharding", "emulate_devices")}
+    fl = fleet_mod.ServingFleet(
+        spec=control, n_replicas=1, auto_replace=False,
+        persistent_cache_dir=cache_dir, scrape_interval_s=0.25,
+        quiet_children=quiet,
+        env={"FLAGS_device_cost_analysis": "true"})
+    try:
+        rng = np.random.RandomState(3)
+        feed = {"x": rng.randn(max_rows,
+                               int(spec.get("features", 16))
+                               ).astype("float32")}
+        fl.submit(feed).result(timeout=60)
+        peak, _ = _fleet_hbm_peak(fl)
+    finally:
+        fl.close()
+    return peak
+
+
+def fleet_decode_leg(n_replicas=2, n_requests=24, max_new=6, qps=50.0,
+                     page_size=4, shared_prefix_ratio=0.5, vocab=29,
+                     cache_dir=None, policy="least_queue", seed=0,
+                     quiet=True):
+    """Decode THROUGH the router: N subprocess decode replicas behind
+    session-affinity routing, open-loop prompt arrivals, tokens/s/chip
+    for the whole fleet.  The identity contract (routed == engine-
+    direct, preserved across migration) is proved by the test suite;
+    this leg prices the plane."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.serving import fleet as fleet_mod
+
+    own_cache = cache_dir is None
+    cache_dir = cache_dir or tempfile.mkdtemp(prefix="serve-dec-cache-")
+    spec = fleet_mod.demo_decode_spec(vocab=vocab, page_size=page_size,
+                                      seed=seed)
+    prompts = decode_workload(n_requests, shared_prefix_ratio, vocab,
+                              page_size, seed=seed)
+    rng = np.random.RandomState(11)
+    sched = np.cumsum(rng.exponential(1.0 / max(qps, 1e-9),
+                                      size=len(prompts)))
+    fl = fleet_mod.ServingFleet(
+        spec=spec, n_replicas=int(n_replicas), policy=policy,
+        auto_replace=False, persistent_cache_dir=cache_dir,
+        scrape_interval_s=0.25, quiet_children=quiet)
+    futs, rejected, tokens, failed = [], 0, 0, 0
+    try:
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            lag = sched[i] - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                futs.append(fl.submit_decode(p, max_new_tokens=max_new))
+            except Exception:       # noqa: BLE001 — queue rejections
+                rejected += 1
+        by_replica = {}
+        for f in futs:
+            try:
+                tokens += len(f.result(timeout=180)["tokens"])
+                by_replica[f.replica] = by_replica.get(f.replica, 0) + 1
+            except Exception:       # noqa: BLE001 — timeouts count
+                failed += 1
+        wall = time.perf_counter() - t0
+        fstats = fl.stats()
+    finally:
+        fl.close()
+        if own_cache:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    return {
+        "replicas": int(n_replicas),
+        "requests": len(prompts),
+        "completed": len(futs) - failed,
+        "rejected_at_submit": rejected,
+        "tokens": tokens,
+        "tokens_per_sec_per_chip": round(
+            tokens / wall / max(int(n_replicas), 1), 1)
+            if wall > 0 else 0.0,
+        "requests_by_replica": by_replica,
+        "decode_migrations": fstats.get("decode_migrations", 0),
+        "config": {"max_new": max_new, "qps": qps,
+                   "page_size": page_size,
+                   "shared_prefix_ratio": shared_prefix_ratio},
+    }
+
+
 def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                 kill_at=None, policy="least_queue", hidden=64,
                 max_batch=32, max_wait_us=2000, queue_depth=256,
                 cache_dir=None, watchdog_stall_s=2.0, deadline_ms=None,
-                seed=0, chaos_seed=None):
+                seed=0, chaos_seed=None, replica_mesh=None,
+                sharding="tp", decode=False, quiet=True):
     """The kill-mid-run fleet protocol: N subprocess replicas behind the
     router, open-loop Poisson load, SIGKILL one replica at ``kill_at``
     seconds into the run (auto_replace spawns a warm replacement from
@@ -419,10 +582,14 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
     own_cache = cache_dir is None
     cache_dir = cache_dir or tempfile.mkdtemp(prefix="serve-fleet-cache-")
     m = trace.metrics()
+    chips_per_replica = _mesh_chips(replica_mesh)
     spec = fleet_mod.demo_mlp_spec(
         hidden=hidden, features=16, max_batch=max_batch,
         max_wait_us=max_wait_us, queue_depth=queue_depth, seed=seed,
-        watchdog_stall_s=watchdog_stall_s)
+        watchdog_stall_s=watchdog_stall_s,
+        mesh=replica_mesh,
+        sharding=sharding if replica_mesh else None,
+        emulate_devices=chips_per_replica if replica_mesh else None)
     duration_s = n_requests / max(qps, 1e-9)
     chaos_parent = chaos_child = None
     env = None
@@ -435,7 +602,7 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
         auto_replace=True, persistent_cache_dir=cache_dir,
         scrape_interval_s=0.25, missed_scrape_limit=2,
         max_attempts=30 if chaos_seed is not None else 6,
-        rpc_timeout_s=10.0, quiet_children=True, env=env)
+        rpc_timeout_s=10.0, quiet_children=quiet, env=env)
     fleet_up_s = time.perf_counter() - t_up0
     fl_inject = None
     corrupt0 = m.counter("rpc.corrupt_frames").value
@@ -478,6 +645,7 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
             deadline_ms=deadline_ms)
         done, failed = collect(futures, timeout=180.0)
         wall = time.perf_counter() - t0
+        examples = _completed_examples(futures)
         slowest = slowest_requests(futures)
         if kt is not None:
             kt.join(timeout=10)
@@ -533,11 +701,41 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
                 "breaker_events": len(fl.events_of("breaker_open"))
                     + len(fl.events_of("breaker_close")),
             }
+        hbm_peak = hbm_devices = hbm_compare = None
+        if replica_mesh:
+            # same-batch probe: one max_batch-row request so the peak
+            # belongs to the same executable size the unsharded control
+            # below will run
+            probe = {"x": np.random.RandomState(3).randn(
+                max_batch, 16).astype("float32")}
+            try:
+                fl.submit(probe).result(timeout=60)
+            except Exception:       # noqa: BLE001 — probe is best-effort
+                pass
+            hbm_peak, hbm_devices = _fleet_hbm_peak(fl)
+            un_peak = _unsharded_hbm_control(spec, cache_dir,
+                                             max_rows=max_batch,
+                                             quiet=quiet)
+            if hbm_peak and un_peak:
+                hbm_compare = {
+                    "sharded_per_device_peak_bytes": hbm_peak,
+                    "unsharded_per_device_peak_bytes": un_peak,
+                    "sharded_below_unsharded": hbm_peak < un_peak,
+                }
         fstats = fl.stats()
     finally:
         if fl_inject is not None:
             faultline.uninstall()
         fl.close()
+    dec_leg = None
+    try:
+        if decode:
+            # routed-decode leg rides the same report line: one JSON
+            # object carries examples/s/chip AND tokens/s/chip
+            dec_leg = fleet_decode_leg(
+                n_replicas=n_replicas, policy=policy, seed=seed,
+                quiet=quiet)
+    finally:
         if own_cache:
             shutil.rmtree(cache_dir, ignore_errors=True)
 
@@ -546,10 +744,16 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
         "value": round(done / wall, 1) if wall > 0 else 0.0,
         "unit": "req/s",
         "replicas": int(n_replicas),
+        "chips_per_replica": chips_per_replica,
+        "total_chips": int(n_replicas) * chips_per_replica,
         "policy": policy,
         "offered_qps": round(qps, 1),
         "requests": n_requests,
         "completed": done,
+        "examples": examples,
+        "examples_per_sec_per_chip": round(
+            examples / wall / (int(n_replicas) * chips_per_replica), 1)
+            if wall > 0 else 0.0,
         # the invariant the kill drill proves: accepted requests lost
         "lost": failed,
         "rejected_at_submit": rejected,
@@ -572,8 +776,18 @@ def fleet_bench(n_replicas=2, qps=200.0, n_requests=400, sizes=(1, 2, 4, 8),
         "config": {"max_batch": max_batch, "max_wait_us": max_wait_us,
                    "queue_depth": queue_depth, "sizes": list(sizes),
                    "hidden": hidden, "deadline_ms": deadline_ms,
-                   "watchdog_stall_s": watchdog_stall_s},
+                   "watchdog_stall_s": watchdog_stall_s,
+                   "replica_mesh": replica_mesh},
     }
+    if hbm_peak:
+        report["hbm"] = {"per_device_peak_bytes": hbm_peak,
+                         "mesh_devices": hbm_devices}
+    if hbm_compare is not None:
+        report["hbm_compare"] = hbm_compare
+    if dec_leg is not None:
+        report["decode"] = dec_leg
+        report["tokens_per_sec_per_chip"] = \
+            dec_leg["tokens_per_sec_per_chip"]
     if chaos is not None:
         report["metric"] = "fleet_chaos_qps"
         report["chaos"] = chaos
@@ -614,7 +828,20 @@ def main(argv=None):
                          "traffic against dense vs block-paged KV vs "
                          "paged+prefix-cache engines at equal device "
                          "memory; reports TTFT p50/p99, tokens/sec/chip "
-                         "and the concurrency/TTFT win booleans")
+                         "and the concurrency/TTFT win booleans.  With "
+                         "--fleet: adds a routed-decode leg so the one "
+                         "JSON line carries examples/s/chip AND "
+                         "tokens/s/chip")
+    ap.add_argument("--replica-mesh", default=None, metavar="SPEC",
+                    help="fleet mode: per-replica device mesh, e.g. "
+                         "'tp:8' (emulated on CPU via "
+                         "--xla_force_host_platform_device_count); "
+                         "reports per-chip throughput and the sharded-"
+                         "vs-unsharded per-device HBM compare")
+    ap.add_argument("--scaling", action="store_true",
+                    help="fleet mode: also run a 1-replica baseline at "
+                         "the same offered load and report the "
+                         "N-replica/1-replica throughput ratio")
     ap.add_argument("--shared-prefix-ratio", type=float, default=0.6,
                     metavar="R", help="decode mode: fraction of requests "
                     "sharing one page-aligned warm prompt prefix")
@@ -644,7 +871,7 @@ def main(argv=None):
     sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
     if args.chaos is not None and not args.fleet:
         args.fleet = 2                  # chaos is a fleet drill
-    if args.decode:
+    if args.decode and not args.fleet:
         # decode rounds are token-budgeted, not request-budgeted: the
         # open-loop default of 400 requests would run for minutes on CPU
         n_dec = n if (args.seconds or args.requests != 400) else 32
@@ -653,14 +880,35 @@ def main(argv=None):
             n_requests=n_dec, qps=args.qps, max_new=args.max_new,
             page_size=args.page_size, spec=args.spec)
     elif args.fleet:
-        report = fleet_bench(
-            n_replicas=args.fleet, qps=args.qps, n_requests=n,
-            sizes=sizes, kill_at=args.kill_replica_at,
-            policy=args.policy, hidden=args.hidden,
+        mesh = parse_mesh(args.replica_mesh)
+        fleet_kw = dict(
+            qps=args.qps, n_requests=n,
+            sizes=sizes, policy=args.policy, hidden=args.hidden,
             max_batch=args.max_batch, max_wait_us=args.max_wait_us,
             queue_depth=args.queue_depth, cache_dir=args.cache_dir,
             watchdog_stall_s=args.watchdog_stall_s,
-            deadline_ms=args.deadline_ms, chaos_seed=args.chaos)
+            deadline_ms=args.deadline_ms, replica_mesh=mesh)
+        report = fleet_bench(
+            n_replicas=args.fleet, kill_at=args.kill_replica_at,
+            chaos_seed=args.chaos, decode=args.decode, **fleet_kw)
+        if args.scaling and args.fleet > 1:
+            base = fleet_bench(n_replicas=1, **fleet_kw)
+            ratio = (round(report["value"] / base["value"], 2)
+                     if base["value"] else None)
+            try:
+                host_cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                host_cores = os.cpu_count() or 1
+            report["scaling"] = {
+                "baseline_replicas": 1,
+                "baseline_qps": base["value"],
+                "fleet_qps": report["value"],
+                "ratio": ratio,
+                # replica subprocesses scale with real cores; on a
+                # single-core host the ratio is CPU-conserved (~1.0),
+                # so the artifact carries the denominator that explains it
+                "host_cpu_cores": host_cores,
+            }
     else:
         report = serve_bench(
             qps=args.qps, n_requests=n, sizes=sizes,
